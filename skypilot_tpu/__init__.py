@@ -1,0 +1,43 @@
+"""skypilot-tpu: a TPU-native orchestration + training/serving framework.
+
+A ground-up rebuild of the capabilities of SkyPilot (reference:
+/root/reference, BitPhinix/skypilot @ 2025-01-27) designed for TPU pod
+slices as the native execution target: Task/Resources YAML + Python DSL,
+cost/availability optimizer, GCP queued-resources provisioner, per-host gang
+runtime with jax.distributed coordination (no Ray, no NCCL), managed jobs
+with preemption recovery, autoscaled serving, and a first-class JAX
+parallelism library (mesh presets, ring attention, Pallas kernels) the
+reference delegates to user containers.
+"""
+
+__version__ = '0.1.0'
+
+from skypilot_tpu.accelerators import TpuTopology, parse_tpu
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__all__ = [
+    'Dag',
+    'Resources',
+    'Task',
+    'TpuTopology',
+    'parse_tpu',
+    '__version__',
+]
+
+
+def __getattr__(name):
+    """Lazy exports for the heavier layers (keeps `import skypilot_tpu`
+    fast, mirroring the reference's lazy adaptors sky/adaptors/common.py)."""
+    if name in ('launch', 'exec', 'down', 'stop', 'start', 'status', 'queue',
+                'cancel', 'tail_logs', 'autostop'):
+        from skypilot_tpu import core
+        return getattr(core, name)
+    if name == 'optimize':
+        from skypilot_tpu import optimizer
+        return optimizer.Optimizer.optimize
+    if name == 'Storage':
+        from skypilot_tpu.data import storage
+        return storage.Storage
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

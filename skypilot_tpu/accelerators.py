@@ -1,0 +1,153 @@
+"""TPU (and GPU) accelerator registry with first-class pod-slice topology.
+
+In the reference, TPU knowledge is scattered: name canonicalization in
+sky/utils/accelerator_registry.py, `tpu-` prefix inference in
+sky/resources.py:527, host sizing hacks in sky/clouds/gcp.py:604-633, and
+is_tpu_vm_pod helpers in sky/clouds/utils/gcp_utils.py:28-57. Here topology is
+a first-class object: an accelerator string like ``tpu-v5e-16`` resolves to a
+slice topology (chips, hosts, chips/host, ICI mesh shape) that the rest of the
+stack (optimizer, provisioner, gang runtime, parallelism presets) consumes.
+"""
+import dataclasses
+import re
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+# Per-generation hardware constants.
+# peak_bf16_tflops and hbm_gib are PER CHIP. `counts_cores` generations name
+# slices by TensorCore count (v2-8 is 4 chips / 1 host); later generations
+# name by chip count directly (v5e-16 is 16 chips).
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    name: str                    # canonical short name, e.g. 'v5e'
+    gcp_accelerator_type: str    # name used in the GCP TPU API, e.g. 'v5litepod'
+    counts_cores: bool           # slice size counted in cores (v2/v3) vs chips
+    chips_per_host: int          # chips per host VM in multi-host slices
+    max_single_host_chips: int   # largest slice that fits one host VM
+    peak_bf16_tflops: float      # per chip
+    hbm_gib: float               # per chip
+    ici_axes: int                # 2 => 2D torus (v2/v3/v5e/v6e), 3 => 3D (v4/v5p)
+    supports_spot: bool = True
+
+
+TPU_GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', 'v2', True, 4, 4, 45.0, 16.0, 2),
+    'v3': TpuGeneration('v3', 'v3', True, 4, 4, 105.0, 32.0, 2),
+    'v4': TpuGeneration('v4', 'v4', True, 4, 4, 275.0, 32.0, 3),
+    'v5e': TpuGeneration('v5e', 'v5litepod', False, 4, 8, 197.0, 16.0, 2),
+    'v5p': TpuGeneration('v5p', 'v5p', True, 4, 4, 459.0, 95.0, 3),
+    'v6e': TpuGeneration('v6e', 'v6e', False, 4, 8, 918.0, 32.0, 2),
+}
+
+# Aliases accepted in user YAML / CLI for each generation.
+_GEN_ALIASES = {
+    'v2': 'v2', 'v3': 'v3', 'v4': 'v4',
+    'v5e': 'v5e', 'v5litepod': 'v5e', 'v5lite': 'v5e',
+    'v5p': 'v5p', 'v6e': 'v6e', 'trillium': 'v6e',
+}
+
+_TPU_RE = re.compile(r'^tpu[-_]?(?P<gen>[a-z0-9]+?)(?:pod)?[-_](?P<size>\d+)$',
+                     re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuTopology:
+    """Resolved topology of a TPU slice request.
+
+    The unit of provisioning is the whole slice (queued resource): all hosts
+    are allocated atomically and are inherently gang-scheduled — this is what
+    replaces the reference's Ray placement-group STRICT_SPREAD machinery
+    (sky/backends/cloud_vm_ray_backend.py:361).
+    """
+    generation: TpuGeneration
+    size: int            # the number in the name (cores for v2-v4/v5p, chips for v5e/v6e)
+    chips: int           # total chips in the slice
+    num_hosts: int       # host VMs in the slice
+    chips_per_host: int
+
+    @property
+    def name(self) -> str:
+        return f'tpu-{self.generation.name}-{self.size}'
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        """Name as the GCP TPU API expects, e.g. 'v5litepod-16'."""
+        return f'{self.generation.gcp_accelerator_type}-{self.size}'
+
+    @property
+    def is_pod(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def devices_per_host(self) -> int:
+        """JAX local device count per host (chips; each chip is one device on
+        v4+; v2/v3 expose 2 cores/chip but modern JAX shows one device per
+        chip with megacore)."""
+        return self.chips_per_host
+
+    @property
+    def total_peak_bf16_tflops(self) -> float:
+        return self.chips * self.generation.peak_bf16_tflops
+
+    @property
+    def total_hbm_gib(self) -> float:
+        return self.chips * self.generation.hbm_gib
+
+    def default_mesh_shape(self) -> Tuple[int, int]:
+        """(num_hosts, chips_per_host) — the trivial DCN×ICI-friendly split."""
+        return (self.num_hosts, self.chips_per_host)
+
+
+def parse_tpu(name: str) -> Optional[TpuTopology]:
+    """Parse an accelerator string into a TpuTopology, or None if not a TPU.
+
+    Accepts: tpu-v5e-16, tpu-v5litepod-16, tpu_v4-32, tpu-v3-8, ...
+    Raises InvalidAcceleratorError for a tpu-* string with bad gen/size.
+    """
+    m = _TPU_RE.match(name.strip())
+    if m is None:
+        if name.strip().lower().startswith('tpu'):
+            raise exceptions.InvalidAcceleratorError(
+                f'Malformed TPU accelerator name: {name!r}. Expected e.g. '
+                f'"tpu-v5e-16" or "tpu-v4-32".')
+        return None
+    gen_alias = m.group('gen').lower()
+    size = int(m.group('size'))
+    if gen_alias not in _GEN_ALIASES:
+        raise exceptions.InvalidAcceleratorError(
+            f'Unknown TPU generation {gen_alias!r} in {name!r}. Known: '
+            f'{sorted(set(_GEN_ALIASES))}')
+    gen = TPU_GENERATIONS[_GEN_ALIASES[gen_alias]]
+    if size <= 0 or (size & (size - 1)) != 0 and size % 4 != 0:
+        raise exceptions.InvalidAcceleratorError(
+            f'Invalid TPU slice size {size} in {name!r}.')
+    chips = size // 2 if gen.counts_cores else size
+    if chips < 1:
+        raise exceptions.InvalidAcceleratorError(
+            f'TPU slice {name!r} resolves to zero chips.')
+    if chips <= gen.max_single_host_chips:
+        num_hosts, chips_per_host = 1, chips
+    else:
+        if chips % gen.chips_per_host != 0:
+            raise exceptions.InvalidAcceleratorError(
+                f'TPU slice {name!r} ({chips} chips) is not divisible by '
+                f'{gen.chips_per_host} chips/host.')
+        num_hosts, chips_per_host = chips // gen.chips_per_host, gen.chips_per_host
+    return TpuTopology(generation=gen, size=size, chips=chips,
+                       num_hosts=num_hosts, chips_per_host=chips_per_host)
+
+
+def is_tpu(acc_name: str) -> bool:
+    try:
+        return parse_tpu(acc_name) is not None
+    except exceptions.InvalidAcceleratorError:
+        return True  # malformed, but clearly intended as TPU
+
+
+def canonicalize(acc_name: str) -> str:
+    """Canonical accelerator name ('tpu-v5e-16'; GPUs uppercased: 'A100')."""
+    topo = parse_tpu(acc_name)
+    if topo is not None:
+        return topo.name
+    return acc_name.strip().upper().replace('_', '-')

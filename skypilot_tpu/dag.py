@@ -1,0 +1,85 @@
+"""DAG of tasks with a thread-local `with Dag():` context.
+
+Mirrors the reference's sky/dag.py:7 (networkx DiGraph wrapper + `>>`
+chaining) with the same tiny surface: add/remove tasks, chain edges,
+is_chain(), tasks property, context manager.
+"""
+import threading
+from typing import List, Optional
+
+import networkx as nx
+
+
+class Dag:
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List['Task'] = []  # insertion order  # noqa: F821
+
+    def add(self, task) -> None:
+        self.graph.add_node(task)
+        self.tasks.append(task)
+
+    def remove(self, task) -> None:
+        self.graph.remove_node(task)
+        self.tasks.remove(task)
+
+    def add_edge(self, op1, op2) -> None:
+        assert op1 in self.graph.nodes
+        assert op2 in self.graph.nodes
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        pre = f'Dag({self.name})' if self.name else 'Dag'
+        return f'{pre}<{len(self.tasks)} task(s)>'
+
+    def is_chain(self) -> bool:
+        """True iff the DAG is a linear chain (reference: sky/dag.py:53)."""
+        nodes = list(self.graph.nodes)
+        out_degrees = [self.graph.out_degree(n) for n in nodes]
+        in_degrees = [self.graph.in_degree(n) for n in nodes]
+        return (len(nodes) <= 1 or
+                (nx.is_directed_acyclic_graph(self.graph) and
+                 all(d <= 1 for d in out_degrees) and
+                 all(d <= 1 for d in in_degrees) and
+                 sum(out_degrees) == len(nodes) - 1))
+
+    def get_sorted_tasks(self) -> List['Task']:  # noqa: F821
+        return list(nx.topological_sort(self.graph))
+
+    def validate(self) -> None:
+        if not nx.is_directed_acyclic_graph(self.graph):
+            raise ValueError('DAG has a cycle.')
+
+
+class _DagContext(threading.local):
+    """Thread-local stack of active Dags (reference: sky/dag.py:71)."""
+
+    def __init__(self):
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_dag_context = _DagContext()
+push_dag = _dag_context.push
+pop_dag = _dag_context.pop
+get_current_dag = _dag_context.current

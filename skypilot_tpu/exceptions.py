@@ -1,0 +1,179 @@
+"""Exception taxonomy for skypilot-tpu.
+
+Modeled on the reference's taxonomy (sky/exceptions.py:22-287): the failover
+provisioner is driven by typed errors (ResourcesUnavailableError), the CLI
+maps the rest to user-facing messages.
+"""
+from typing import List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidTaskError(SkyTpuError):
+    """Task/YAML spec failed validation."""
+
+
+class InvalidResourcesError(SkyTpuError):
+    """Resources spec is malformed or internally inconsistent."""
+
+
+class InvalidAcceleratorError(InvalidResourcesError):
+    """Unknown accelerator name or unsupported topology."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled/credentialed."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """Requested resources could not be provisioned anywhere.
+
+    Drives the failover loop (reference: sky/exceptions.py ResourcesUnavailableError,
+    consumed by RetryingVmProvisioner at cloud_vm_ray_backend.py:1911).
+
+    Attributes:
+        no_failover: if True, the provisioner must not try other locations
+            (e.g. the user pinned a zone, or the error is non-retryable).
+        failover_history: chain of errors seen across attempted locations.
+    """
+
+    def __init__(self, message: str, no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources do not match the existing cluster's resources."""
+
+
+class ProvisionTimeoutError(ResourcesUnavailableError):
+    """Provisioning (e.g. a queued-resource) timed out waiting for capacity."""
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster but it is not.
+
+    Attributes:
+        cluster_status: the observed status (a ClusterStatus or None).
+        handle: the cluster handle if one exists.
+    """
+
+    def __init__(self, message: str, cluster_status=None, handle=None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """The cluster was launched by a different cloud identity."""
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster is not in the state database."""
+
+
+class NotSupportedError(SkyTpuError):
+    """Operation unsupported for this cloud/resource combination
+    (e.g. stopping a multi-host TPU pod slice; reference blocks the same at
+    sky/clouds/gcp.py:184-190)."""
+
+
+class CommandError(SkyTpuError):
+    """A remote or local command failed.
+
+    Attributes:
+        returncode: the command's exit status.
+        command: the command string (possibly abridged).
+        error_msg: extra detail for the user.
+        detailed_reason: stderr tail, if captured.
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f'Command failed with return code {returncode}: {error_msg}')
+
+
+class JobError(SkyTpuError):
+    """A job-level failure on the cluster."""
+
+
+class JobNotFoundError(JobError):
+    """No such job id on the cluster."""
+
+
+class ManagedJobError(SkyTpuError):
+    """Managed-job controller-level failure."""
+
+
+class ManagedJobReachedMaxRetriesError(ManagedJobError):
+    """Recovery gave up after max retries (reference: sky/exceptions.py:72)."""
+
+
+class ManagedJobStatusError(ManagedJobError):
+    """Managed job is in an unexpected state."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was terminated by user signal."""
+
+
+class StorageError(SkyTpuError):
+    """Base for storage subsystem errors."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageBucketDeleteError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+class StorageSourceError(StorageError):
+    """Invalid source for a Storage object."""
+
+
+class StorageNameError(StorageError):
+    """Invalid bucket/storage name."""
+
+
+class StorageModeError(StorageError):
+    """Invalid mode (MOUNT/COPY) for this store."""
+
+
+class CloudUserIdentityError(SkyTpuError):
+    """Could not determine the active cloud identity."""
+
+
+class CloudError(SkyTpuError):
+    """Opaque error from a cloud API call."""
+
+
+class NetworkError(SkyTpuError):
+    """Client could not reach a required network endpoint."""
+
+
+class CheckpointError(SkyTpuError):
+    """Checkpoint save/restore failure (Orbax layer)."""

@@ -1,0 +1,270 @@
+"""Resources: what hardware a task wants.
+
+Mirrors the reference's sky/resources.py:30 `Resources` (cloud/region/zone/
+instance_type/cpus/memory/accelerators/spot/disk/ports/labels), but TPU-first:
+``accelerators: tpu-v5e-16`` resolves to a pod-slice topology object
+(accelerators.TpuTopology) and num_nodes is *derived* from the slice's host
+count rather than user-specified. The reference instead passes TPU extras
+through an opaque `accelerator_args` dict (sky/resources.py:527 infers
+cloud=GCP from the `tpu-` prefix; host sizing hacks live in
+sky/clouds/gcp.py:604-633).
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import accelerators as acc_lib
+from skypilot_tpu import exceptions
+
+_DEFAULT_DISK_SIZE_GB = 100
+
+
+def _parse_accelerators(
+    value: Union[None, str, Dict[str, int]]
+) -> Optional[Dict[str, int]]:
+    """Normalize 'V100:4' / 'tpu-v5e-16' / {'A100': 8} to {name: count}."""
+    if value is None:
+        return None
+    if isinstance(value, dict):
+        if len(value) != 1:
+            raise exceptions.InvalidResourcesError(
+                f'accelerators must name exactly one accelerator, got {value}')
+        name, count = next(iter(value.items()))
+        try:
+            count = int(count)
+        except (TypeError, ValueError):
+            raise exceptions.InvalidResourcesError(
+                f'Bad accelerator count {count!r} for {name!r}') from None
+        return {acc_lib.canonicalize(str(name)): count}
+    if isinstance(value, str):
+        if ':' in value:
+            name, count_str = value.rsplit(':', 1)
+            try:
+                count = int(count_str)
+            except ValueError:
+                raise exceptions.InvalidResourcesError(
+                    f'Bad accelerator count in {value!r}') from None
+        else:
+            name, count = value, 1
+        return {acc_lib.canonicalize(name): count}
+    raise exceptions.InvalidResourcesError(
+        f'accelerators must be str or dict, got {type(value)}')
+
+
+@dataclasses.dataclass(eq=False)  # identity hash/eq: Resources live in sets
+class Resources:
+    """A (possibly partial) hardware requirement.
+
+    Partial specs are completed by the optimizer against the catalog
+    (reference: sky/optimizer.py:1238 _fill_in_launchable_resources).
+    """
+    cloud: Optional[str] = None          # 'gcp' | 'local' (more later)
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    instance_type: Optional[str] = None
+    accelerators: Optional[Union[str, Dict[str, int]]] = None
+    cpus: Optional[Union[int, str]] = None       # e.g. 8 or '8+'
+    memory: Optional[Union[int, str]] = None     # GiB, e.g. 32 or '32+'
+    use_spot: bool = False
+    spot_recovery: Optional[str] = None          # managed-jobs strategy name
+    disk_size: int = _DEFAULT_DISK_SIZE_GB
+    disk_tier: Optional[str] = None              # low|medium|high|best
+    image_id: Optional[str] = None
+    ports: Optional[List[Union[int, str]]] = None
+    labels: Optional[Dict[str, str]] = None
+    # --- TPU-specific ---
+    runtime_version: Optional[str] = None        # TPU software version
+    reserved: bool = False                       # use reserved capacity quota
+    autostop: Optional[int] = None               # idle minutes; -1 = down
+    job_recovery: Optional[str] = None
+
+    _tpu_topology: Optional[acc_lib.TpuTopology] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def __post_init__(self):
+        self.accelerators = _parse_accelerators(self.accelerators)
+        if self.cloud is not None:
+            self.cloud = str(self.cloud).lower()
+        if self.ports is not None:
+            if isinstance(self.ports, (int, str)):
+                self.ports = [self.ports]
+            self.ports = [str(p) for p in self.ports]
+        self._resolve_tpu()
+        self._validate()
+
+    # ------------------------------------------------------------------ TPU
+    def _resolve_tpu(self) -> None:
+        self._tpu_topology = None
+        if not self.accelerators:
+            return
+        name, count = next(iter(self.accelerators.items()))
+        topo = acc_lib.parse_tpu(name)
+        if topo is None:
+            return
+        if count != 1:
+            raise exceptions.InvalidResourcesError(
+                f'TPU slices are atomic; use the slice size in the name '
+                f'(got {name}:{count}; did you mean tpu-'
+                f'{topo.generation.name}-{topo.size * count}?)')
+        self._tpu_topology = topo
+        if self.cloud is None:
+            self.cloud = 'gcp'  # TPUs only exist on GCP (reference:
+            # sky/resources.py:527 makes the same inference).
+
+    @property
+    def tpu_topology(self) -> Optional[acc_lib.TpuTopology]:
+        return self._tpu_topology
+
+    @property
+    def is_tpu(self) -> bool:
+        return self._tpu_topology is not None
+
+    @property
+    def accelerator_name(self) -> Optional[str]:
+        if not self.accelerators:
+            return None
+        return next(iter(self.accelerators))
+
+    @property
+    def accelerator_count(self) -> int:
+        if not self.accelerators:
+            return 0
+        if self.is_tpu:
+            return self._tpu_topology.chips
+        return next(iter(self.accelerators.values()))
+
+    @property
+    def num_hosts(self) -> int:
+        """Host VMs implied by the accelerator (1 for non-TPU)."""
+        return self._tpu_topology.num_hosts if self.is_tpu else 1
+
+    # ------------------------------------------------------------- validate
+    def _validate(self) -> None:
+        if self.disk_size <= 0:
+            raise exceptions.InvalidResourcesError('disk_size must be > 0')
+        if self.disk_tier is not None and self.disk_tier not in (
+                'low', 'medium', 'high', 'best'):
+            raise exceptions.InvalidResourcesError(
+                f'Invalid disk_tier {self.disk_tier!r}')
+        for field, getter in (('cpus', self.cpus_at_least),
+                              ('memory', self.memory_at_least)):
+            try:
+                val = getter()
+                if val is not None and val <= 0:
+                    raise ValueError
+            except ValueError:
+                raise exceptions.InvalidResourcesError(
+                    f'Invalid {field} spec {getattr(self, field)!r}') from None
+        if self.use_spot and self.reserved:
+            raise exceptions.InvalidResourcesError(
+                'use_spot and reserved are mutually exclusive')
+        if self.zone is not None and self.region is None:
+            # Infer region from zone (GCP convention: region = zone minus
+            # trailing '-x').
+            self.region = self.zone.rsplit('-', 1)[0]
+
+    # ------------------------------------------------------------ ordering
+    def cpus_at_least(self) -> Optional[float]:
+        if self.cpus is None:
+            return None
+        s = str(self.cpus)
+        return float(s[:-1]) if s.endswith('+') else float(s)
+
+    def memory_at_least(self) -> Optional[float]:
+        if self.memory is None:
+            return None
+        s = str(self.memory)
+        return float(s[:-1]) if s.endswith('+') else float(s)
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """Whether `other` (an existing cluster's resources) can serve this
+        request. Reference: sky/resources.py:1085."""
+        if self.cloud is not None and other.cloud != self.cloud:
+            return False
+        if self.region is not None and other.region != self.region:
+            return False
+        if self.zone is not None and other.zone != self.zone:
+            return False
+        if self.accelerators is not None:
+            if other.accelerators is None:
+                return False
+            name = self.accelerator_name
+            if name not in other.accelerators:
+                return False
+            if self.accelerators[name] > other.accelerators[name]:
+                return False
+        if self.use_spot and not other.use_spot:
+            return False
+        if self.instance_type is not None and (other.instance_type !=
+                                               self.instance_type):
+            return False
+        return True
+
+    # ---------------------------------------------------------------- yaml
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        for key in ('cloud', 'region', 'zone', 'instance_type', 'cpus',
+                    'memory', 'disk_tier', 'image_id', 'runtime_version',
+                    'spot_recovery', 'job_recovery'):
+            val = getattr(self, key)
+            if val is not None:
+                cfg[key] = val
+        if self.accelerators:
+            name = self.accelerator_name
+            count = self.accelerators[name]
+            cfg['accelerators'] = name if count == 1 else f'{name}:{count}'
+        if self.use_spot:
+            cfg['use_spot'] = True
+        if self.reserved:
+            cfg['reserved'] = True
+        if self.disk_size != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self.disk_size
+        if self.ports:
+            cfg['ports'] = list(self.ports)
+        if self.labels:
+            cfg['labels'] = dict(self.labels)
+        if self.autostop is not None:
+            cfg['autostop'] = self.autostop
+        return cfg
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        if not config:
+            return cls()
+        config = dict(config)
+        known = {f.name for f in dataclasses.fields(cls)
+                 if not f.name.startswith('_')}
+        unknown = set(config) - known
+        if unknown:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown resources fields: {sorted(unknown)}')
+        return cls(**config)
+
+    def copy(self, **override) -> 'Resources':
+        cfg = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if not f.name.startswith('_')
+        }
+        # accelerators already normalized to dict; copy to avoid aliasing.
+        if cfg.get('accelerators'):
+            cfg['accelerators'] = dict(cfg['accelerators'])
+        cfg.update(override)
+        return Resources(**cfg)
+
+    def __str__(self) -> str:
+        parts = []
+        if self.cloud:
+            parts.append(self.cloud.upper())
+        if self.instance_type:
+            parts.append(self.instance_type)
+        if self.accelerators:
+            name = self.accelerator_name
+            count = self.accelerators[name]
+            parts.append(name if self.is_tpu else f'{name}:{count}')
+        if self.use_spot:
+            parts.append('[spot]')
+        if self.zone:
+            parts.append(f'({self.zone})')
+        elif self.region:
+            parts.append(f'({self.region})')
+        return ' '.join(parts) if parts else '<empty>'

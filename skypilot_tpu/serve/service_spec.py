@@ -1,0 +1,106 @@
+"""Service spec: the `service:` YAML section.
+
+Mirrors the reference's SkyServiceSpec (sky/serve/service_spec.py): readiness
+probe (path/post_data/initial_delay), replica policy (min/max,
+target_qps_per_replica, upscale/downscale delays), on-demand fallback.
+"""
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import schemas
+
+DEFAULT_INITIAL_DELAY_SECONDS = 1200
+DEFAULT_PROBE_TIMEOUT_SECONDS = 15
+DEFAULT_UPSCALE_DELAY_SECONDS = 300
+DEFAULT_DOWNSCALE_DELAY_SECONDS = 1200
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    readiness_path: str = '/'
+    initial_delay_seconds: float = DEFAULT_INITIAL_DELAY_SECONDS
+    probe_timeout_seconds: float = DEFAULT_PROBE_TIMEOUT_SECONDS
+    post_data: Optional[Any] = None
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None  # None => fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS
+    downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS
+    base_ondemand_fallback_replicas: int = 0
+
+    def __post_init__(self):
+        if not self.readiness_path.startswith('/'):
+            raise exceptions.InvalidTaskError(
+                f'readiness_probe path must start with "/", got '
+                f'{self.readiness_path!r}')
+        if self.max_replicas is not None and (self.max_replicas <
+                                              self.min_replicas):
+            raise exceptions.InvalidTaskError(
+                'max_replicas must be >= min_replicas')
+        if self.max_replicas is not None and self.max_replicas > \
+                self.min_replicas and self.target_qps_per_replica is None:
+            raise exceptions.InvalidTaskError(
+                'autoscaling (max_replicas > min_replicas) requires '
+                'target_qps_per_replica')
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return (self.max_replicas is not None and
+                self.max_replicas > self.min_replicas)
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        schemas.validate_service_config(config)
+        kwargs: Dict[str, Any] = {}
+        probe = config['readiness_probe']
+        if isinstance(probe, str):
+            kwargs['readiness_path'] = probe
+        else:
+            kwargs['readiness_path'] = probe['path']
+            if 'initial_delay_seconds' in probe:
+                kwargs['initial_delay_seconds'] = probe[
+                    'initial_delay_seconds']
+            if 'post_data' in probe:
+                kwargs['post_data'] = probe['post_data']
+            if 'timeout_seconds' in probe:
+                kwargs['probe_timeout_seconds'] = probe['timeout_seconds']
+        policy = config.get('replica_policy')
+        if policy is not None:
+            for src, dst in (('min_replicas', 'min_replicas'),
+                             ('max_replicas', 'max_replicas'),
+                             ('target_qps_per_replica',
+                              'target_qps_per_replica'),
+                             ('upscale_delay_seconds',
+                              'upscale_delay_seconds'),
+                             ('downscale_delay_seconds',
+                              'downscale_delay_seconds'),
+                             ('base_ondemand_fallback_replicas',
+                              'base_ondemand_fallback_replicas')):
+                if src in policy:
+                    kwargs[dst] = policy[src]
+        elif 'replicas' in config:
+            kwargs['min_replicas'] = config['replicas']
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        probe: Dict[str, Any] = {'path': self.readiness_path}
+        if self.initial_delay_seconds != DEFAULT_INITIAL_DELAY_SECONDS:
+            probe['initial_delay_seconds'] = self.initial_delay_seconds
+        if self.probe_timeout_seconds != DEFAULT_PROBE_TIMEOUT_SECONDS:
+            probe['timeout_seconds'] = self.probe_timeout_seconds
+        if self.post_data is not None:
+            probe['post_data'] = self.post_data
+        policy: Dict[str, Any] = {'min_replicas': self.min_replicas}
+        if self.max_replicas is not None:
+            policy['max_replicas'] = self.max_replicas
+        if self.target_qps_per_replica is not None:
+            policy['target_qps_per_replica'] = self.target_qps_per_replica
+        if self.upscale_delay_seconds != DEFAULT_UPSCALE_DELAY_SECONDS:
+            policy['upscale_delay_seconds'] = self.upscale_delay_seconds
+        if self.downscale_delay_seconds != DEFAULT_DOWNSCALE_DELAY_SECONDS:
+            policy['downscale_delay_seconds'] = self.downscale_delay_seconds
+        if self.base_ondemand_fallback_replicas:
+            policy['base_ondemand_fallback_replicas'] = (
+                self.base_ondemand_fallback_replicas)
+        return {'readiness_probe': probe, 'replica_policy': policy}

@@ -1,0 +1,287 @@
+"""Task: the user-facing unit of work.
+
+Mirrors the reference's sky/task.py:171 `Task` (setup/run commands,
+num_nodes, envs, file/storage mounts, service spec, YAML round-trip,
+env-var substitution, `>>` chaining into the ambient Dag) — with one
+TPU-first change: when the resources name a multi-host TPU slice,
+``num_nodes`` is derived from the slice topology and must not conflict
+with a user-specified value.
+"""
+import copy
+import os
+import re
+from typing import Any, Dict, List, Optional, Set, Union
+
+import yaml
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import schemas
+
+_VALID_NAME_RE = re.compile(r'^[a-zA-Z0-9]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+# Env vars the runtime exports into every task (the distributed contract;
+# reference: sky/skylet/constants.py:263-266 SKYPILOT_NUM_NODES/NODE_IPS/
+# NUM_GPUS_PER_NODE/NODE_RANK). We export both SKYT_* and SKYPILOT_*-compatible
+# aliases plus the JAX coordinator triplet; see runtime/gang.py.
+RUNTIME_ENV_VARS = (
+    'SKYT_NUM_NODES', 'SKYT_NODE_RANK', 'SKYT_NODE_IPS',
+    'SKYT_NUM_ACCELERATORS_PER_NODE', 'SKYT_TASK_ID',
+    'SKYT_COORDINATOR_ADDRESS',
+)
+
+
+def _substitute_env_vars(text: str, envs: Dict[str, str]) -> str:
+    """Substitute $VAR / ${VAR} for *user-provided* envs only (reference
+    semantics: sky/task.py uses the task's `envs` for YAML substitution)."""
+
+    def repl(m: 're.Match') -> str:
+        var = m.group('braced') or m.group('plain')
+        if var in envs:
+            return str(envs[var])
+        return m.group(0)
+
+    pattern = re.compile(
+        r'\$(?:\{(?P<braced>[A-Za-z_][A-Za-z0-9_]*)\}'
+        r'|(?P<plain>[A-Za-z_][A-Za-z0-9_]*))')
+    return pattern.sub(repl, text)
+
+
+class Task:
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[str] = None,
+        envs: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
+        service: Optional[Any] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.envs = {k: str(v) if v is not None else ''
+                     for k, v in (envs or {}).items()}
+        self.workdir = workdir
+        self._user_num_nodes = num_nodes
+        self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
+        self.service = service
+        self.resources: Set[resources_lib.Resources] = set()
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_runtime_s: Optional[float] = None
+
+        self._validate()
+        # Register with the ambient Dag, if any (reference: sky/task.py uses
+        # the thread-local _DagContext the same way).
+        current = dag_lib.get_current_dag()
+        if current is not None:
+            current.add(self)
+
+    # ------------------------------------------------------------ validate
+    def _validate(self) -> None:
+        if self.name is not None and not _VALID_NAME_RE.match(self.name):
+            raise exceptions.InvalidTaskError(
+                f'Invalid task name {self.name!r}: must be alphanumeric '
+                f'with -_. separators.')
+        if self.run is not None and not isinstance(self.run, str):
+            raise exceptions.InvalidTaskError('run must be a shell string')
+        if self._user_num_nodes is not None and self._user_num_nodes < 1:
+            raise exceptions.InvalidTaskError('num_nodes must be >= 1')
+        if self.workdir is not None:
+            expanded = os.path.abspath(os.path.expanduser(self.workdir))
+            if not os.path.isdir(expanded):
+                raise exceptions.InvalidTaskError(
+                    f'workdir {self.workdir!r} is not an existing directory')
+
+    # ----------------------------------------------------------- num_nodes
+    @property
+    def num_nodes(self) -> int:
+        """Host count. For TPU pod slices this comes from the topology: all
+        hosts of the slice are one gang (reference forces the user to align
+        num_nodes manually; we derive it)."""
+        tpu_hosts = sorted({(res.tpu_topology.num_hosts,
+                             res.accelerator_name)
+                            for res in self.resources if res.is_tpu})
+        pod_hosts = [(h, n) for h, n in tpu_hosts if h > 1]
+        if not pod_hosts:
+            return self._user_num_nodes or 1
+        # Any multi-host candidate forces all TPU candidates to agree, or
+        # the gang size would depend on which candidate the optimizer picks.
+        pod_hosts = tpu_hosts
+        if len({h for h, _ in pod_hosts}) > 1:
+            raise exceptions.InvalidTaskError(
+                f'Candidate resources imply different host counts: '
+                f'{pod_hosts}. All TPU candidates must have the same '
+                f'number of hosts.')
+        topo_nodes, acc_name = pod_hosts[0]
+        if (self._user_num_nodes is not None and
+                self._user_num_nodes != topo_nodes):
+            raise exceptions.InvalidTaskError(
+                f'num_nodes={self._user_num_nodes} conflicts with '
+                f'{acc_name} ({topo_nodes} hosts). Omit num_nodes for '
+                f'TPU slices.')
+        return topo_nodes
+
+    # ----------------------------------------------------------- resources
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        self.num_nodes  # re-check topology consistency
+        return self
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        self.file_mounts = dict(file_mounts or {})
+        return self
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self.envs.update({k: str(v) for k, v in envs.items()})
+        return self
+
+    def set_service(self, service) -> 'Task':
+        self.service = service
+        return self
+
+    # ---------------------------------------------------------------- yaml
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        config = copy.deepcopy(config or {})
+        raw_envs = config.get('envs') or {}
+        # Only None means "declared but unset" (an explicit '' is a legal
+        # value — reference semantics); unset vars must come via overrides.
+        envs = {k: ('' if v is None else str(v)) for k, v in raw_envs.items()}
+        unset = {k for k, v in raw_envs.items() if v is None}
+        envs.update({k: str(v) for k, v in (env_overrides or {}).items()})
+        unset -= set(env_overrides or {})
+        if unset:
+            raise exceptions.InvalidTaskError(
+                f'Env var(s) {sorted(unset)} declared with no value; '
+                f'pass --env.')
+        # Substitute user envs into string fields before validation
+        # (reference: sky/task.py:347 from_yaml_config does the same).
+        config['envs'] = envs
+
+        def sub(v):
+            return _substitute_env_vars(v, envs) if isinstance(v, str) else v
+
+        for key in ('run', 'setup', 'workdir', 'name'):
+            if key in config and config[key] is not None:
+                config[key] = sub(config[key])
+        if 'file_mounts' in config and config['file_mounts']:
+            config['file_mounts'] = {
+                sub(k): (sub(v) if isinstance(v, str) else v)
+                for k, v in config['file_mounts'].items()
+            }
+        schemas.validate_task_config(config)
+
+        # file_mounts entries whose value is a dict are storage mounts
+        # (reference: sky/task.py:951 sync_storage_mounts).
+        file_mounts, storage_mounts = {}, {}
+        for dst, src in (config.get('file_mounts') or {}).items():
+            if isinstance(src, dict):
+                storage_mounts[dst] = src
+            else:
+                file_mounts[dst] = src
+        storage_mounts.update(config.get('storage_mounts') or {})
+
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+            file_mounts=file_mounts,
+            storage_mounts=storage_mounts,
+        )
+        res_config = config.get('resources') or {}
+        any_of = res_config.pop('any_of', None)
+        if any_of:
+            candidates = []
+            for cand in any_of:
+                merged = {**res_config, **cand}
+                candidates.append(
+                    resources_lib.Resources.from_yaml_config(merged))
+            task.set_resources(set(candidates))
+        else:
+            task.set_resources(
+                resources_lib.Resources.from_yaml_config(res_config))
+        if 'service' in config and config['service'] is not None:
+            from skypilot_tpu.serve import service_spec
+            task.service = service_spec.ServiceSpec.from_yaml_config(
+                config['service'])
+        return task
+
+    @classmethod
+    def from_yaml(cls, path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        """Load a task from a YAML file (reference: sky/task.py:494)."""
+        with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f)
+        if config is None:
+            config = {}
+        if not isinstance(config, dict):
+            raise exceptions.InvalidTaskError(
+                f'YAML at {path} must be a mapping, got {type(config)}')
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        if len(self.resources) == 1:
+            cfg['resources'] = next(iter(self.resources)).to_yaml_config()
+        elif len(self.resources) > 1:
+            cfg['resources'] = {
+                'any_of': [r.to_yaml_config() for r in self.resources]
+            }
+        if self._user_num_nodes is not None:
+            cfg['num_nodes'] = self._user_num_nodes
+        for key in ('workdir', 'setup', 'run'):
+            val = getattr(self, key)
+            if val is not None:
+                cfg[key] = val
+        if self.envs:
+            cfg['envs'] = dict(self.envs)
+        if self.file_mounts:
+            cfg['file_mounts'] = dict(self.file_mounts)
+        if self.storage_mounts:
+            sm = {}
+            for dst, s in self.storage_mounts.items():
+                sm[dst] = s.to_yaml_config() if hasattr(s, 'to_yaml_config') \
+                    else s
+            cfg['file_mounts'] = {**cfg.get('file_mounts', {}), **sm}
+        if self.service is not None:
+            cfg['service'] = self.service.to_yaml_config() if hasattr(
+                self.service, 'to_yaml_config') else self.service
+        return cfg
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_yaml_config(), sort_keys=False)
+
+    # ------------------------------------------------------------ chaining
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """`a >> b` adds edge a→b in the ambient Dag (sky/task.py:1159)."""
+        current = dag_lib.get_current_dag()
+        if current is None:
+            raise RuntimeError('`>>` requires an active `with Dag():` block')
+        current.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        label = self.name or (self.run.splitlines()[0][:40] + '…'
+                              if self.run and len(self.run) > 40
+                              else self.run) or '<empty>'
+        return f'Task({label})'

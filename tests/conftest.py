@@ -1,0 +1,30 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPUs (the fake multi-host harness the reference lacks —
+SURVEY.md §4 implication). Must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+# Make the repo root importable when pytest is run from anywhere.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_state_dir(tmp_path, monkeypatch):
+    """Redirect the framework's state directory (~/.skypilot_tpu) to tmp."""
+    monkeypatch.setenv('SKYT_STATE_DIR', str(tmp_path / 'state'))
+    # Reset cached module-level state DB handles between tests.
+    import skypilot_tpu.state as state
+    state.reset_db_for_testing()
+    yield tmp_path / 'state'
+    state.reset_db_for_testing()

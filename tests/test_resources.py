@@ -1,0 +1,105 @@
+"""Spec-core tests (mirrors reference tests/unit_tests/test_resources.py)."""
+import pytest
+
+from skypilot_tpu import Resources, exceptions
+from skypilot_tpu.accelerators import parse_tpu
+
+
+class TestTpuTopology:
+    def test_v5e_16(self):
+        t = parse_tpu('tpu-v5e-16')
+        assert t.chips == 16
+        assert t.num_hosts == 4
+        assert t.chips_per_host == 4
+        assert t.is_pod
+        assert t.gcp_accelerator_type == 'v5litepod-16'
+
+    def test_v5e_single_host(self):
+        for size, hosts in ((4, 1), (8, 1)):
+            t = parse_tpu(f'tpu-v5e-{size}')
+            assert t.num_hosts == hosts
+            assert t.chips == size
+
+    def test_core_counted_generations(self):
+        # v2/v3/v4/v5p slice names count TensorCores: chips = size/2.
+        t = parse_tpu('tpu-v3-32')
+        assert t.chips == 16 and t.num_hosts == 4
+        t = parse_tpu('tpu-v2-8')
+        assert t.chips == 4 and t.num_hosts == 1
+        t = parse_tpu('tpu-v4-16')
+        assert t.chips == 8 and t.num_hosts == 2
+        t = parse_tpu('tpu-v5p-8')
+        assert t.chips == 4 and t.num_hosts == 1
+
+    def test_aliases(self):
+        assert parse_tpu('tpu-v5litepod-16').name == 'tpu-v5e-16'
+        assert parse_tpu('tpu-trillium-8').name == 'tpu-v6e-8'
+
+    def test_non_tpu(self):
+        assert parse_tpu('A100') is None
+        assert parse_tpu('V100-SXM') is None
+
+    def test_malformed(self):
+        with pytest.raises(exceptions.InvalidAcceleratorError):
+            parse_tpu('tpu-v99-8')
+        with pytest.raises(exceptions.InvalidAcceleratorError):
+            parse_tpu('tpu-v5e')
+
+    def test_flops_accounting(self):
+        t = parse_tpu('tpu-v5e-16')
+        assert t.total_peak_bf16_tflops == pytest.approx(16 * 197.0)
+
+
+class TestResources:
+    def test_tpu_infers_gcp(self):
+        r = Resources(accelerators='tpu-v5e-16')
+        assert r.cloud == 'gcp'
+        assert r.is_tpu
+        assert r.num_hosts == 4
+        assert r.accelerator_count == 16
+
+    def test_tpu_count_must_be_one(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(accelerators='tpu-v5e-4:4')
+
+    def test_gpu_accelerator_string(self):
+        r = Resources(accelerators='A100:8')
+        assert r.accelerators == {'A100': 8}
+        assert not r.is_tpu
+        assert r.num_hosts == 1
+
+    def test_zone_infers_region(self):
+        r = Resources(zone='us-central2-b')
+        assert r.region == 'us-central2'
+
+    def test_spot_reserved_exclusive(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(use_spot=True, reserved=True)
+
+    def test_yaml_round_trip(self):
+        r = Resources(accelerators='tpu-v5e-16', use_spot=True,
+                      zone='us-west4-a', disk_size=200)
+        r2 = Resources.from_yaml_config(r.to_yaml_config())
+        assert r2.accelerators == {'tpu-v5e-16': 1}
+        assert r2.use_spot and r2.zone == 'us-west4-a'
+        assert r2.disk_size == 200
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources.from_yaml_config({'acelerators': 'A100'})
+
+    def test_less_demanding_than(self):
+        want = Resources(accelerators='tpu-v5e-8')
+        have = Resources(accelerators='tpu-v5e-8', zone='us-west4-a')
+        assert want.less_demanding_than(have)
+        assert not Resources(accelerators='tpu-v5e-16').less_demanding_than(
+            have)
+        assert not Resources(use_spot=True).less_demanding_than(
+            Resources())
+
+    def test_copy_override(self):
+        r = Resources(accelerators='tpu-v5e-16')
+        r2 = r.copy(zone='us-west4-a', use_spot=True)
+        assert r2.zone == 'us-west4-a' and r2.use_spot
+        assert r2.tpu_topology.chips == 16
+        assert not r.use_spot

@@ -1,0 +1,207 @@
+"""Task DSL + YAML tests (mirrors reference tests/test_yaml_parser.py and
+unit task tests)."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions
+
+
+def _write(tmp_path, content):
+    p = tmp_path / 'task.yaml'
+    p.write_text(textwrap.dedent(content))
+    return str(p)
+
+
+class TestTaskYaml:
+    def test_minimal(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            name: hello
+            run: echo hi
+        """))
+        assert t.name == 'hello'
+        assert t.run == 'echo hi'
+        assert t.num_nodes == 1
+
+    def test_empty_yaml(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, ""))
+        assert t.run is None
+
+    def test_tpu_derives_num_nodes(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            resources:
+              accelerators: tpu-v5e-16
+            run: python train.py
+        """))
+        assert t.num_nodes == 4
+
+    def test_num_nodes_conflict(self, tmp_path):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml(_write(tmp_path, """
+                num_nodes: 2
+                resources:
+                  accelerators: tpu-v5e-16
+            """)).num_nodes  # noqa: B018
+
+    def test_env_substitution(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            envs:
+              MODEL: llama3-8b
+            run: python train.py --model $MODEL --out ${MODEL}.ckpt
+        """))
+        assert t.run == 'python train.py --model llama3-8b --out llama3-8b.ckpt'
+
+    def test_env_override_required(self, tmp_path):
+        path = _write(tmp_path, """
+            envs:
+              HF_TOKEN:
+            run: echo $HF_TOKEN
+        """)
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml(path)
+        t = Task.from_yaml(path, env_overrides={'HF_TOKEN': 'abc'})
+        assert t.run == 'echo abc'
+
+    def test_unknown_field_rejected(self, tmp_path):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml(_write(tmp_path, """
+                runn: echo typo
+            """))
+
+    def test_any_of_resources(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            resources:
+              use_spot: true
+              any_of:
+                - accelerators: tpu-v5e-16
+                - accelerators: tpu-v6e-16
+            run: echo hi
+        """))
+        assert len(t.resources) == 2
+        assert all(r.use_spot for r in t.resources)
+
+    def test_storage_mount_split(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            file_mounts:
+              /data: ./local_dir
+              /ckpt:
+                name: my-bucket
+                mode: MOUNT
+            run: ls /ckpt
+        """))
+        assert '/data' in t.file_mounts
+        assert '/ckpt' in t.storage_mounts
+
+    def test_round_trip(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            name: rt
+            resources:
+              accelerators: tpu-v5e-8
+              use_spot: true
+            envs:
+              A: b
+            run: echo $A
+        """))
+        t2 = Task.from_yaml_config(t.to_yaml_config())
+        assert t2.name == 'rt'
+        assert next(iter(t2.resources)).use_spot
+        assert t2.run == 'echo b'
+
+    def test_service_spec(self, tmp_path):
+        t = Task.from_yaml(_write(tmp_path, """
+            service:
+              readiness_probe: /health
+              replica_policy:
+                min_replicas: 2
+                max_replicas: 5
+                target_qps_per_replica: 2.5
+            run: python -m server
+        """))
+        assert t.service.readiness_path == '/health'
+        assert t.service.autoscaling_enabled
+
+
+class TestDag:
+    def test_chain(self):
+        with Dag() as dag:
+            a = Task('a', run='echo a')
+            b = Task('b', run='echo b')
+            c = Task('c', run='echo c')
+            a >> b >> c
+        assert len(dag) == 3
+        assert dag.is_chain()
+        assert dag.get_sorted_tasks() == [a, b, c]
+
+    def test_non_chain(self):
+        with Dag() as dag:
+            a = Task('a', run='echo a')
+            b = Task('b', run='echo b')
+            c = Task('c', run='echo c')
+            a >> c
+            b >> c
+        assert not dag.is_chain()
+
+    def test_tasks_register_with_ambient_dag(self):
+        with Dag() as dag:
+            Task('solo', run='echo hi')
+        assert len(dag.tasks) == 1
+
+    def test_set_resources(self):
+        t = Task('t', run='x')
+        t.set_resources(Resources(accelerators='tpu-v5e-4'))
+        assert t.num_nodes == 1
+
+
+class TestReviewRegressions:
+    """Regressions from the round-1 code review."""
+
+    def test_config_not_mutated(self):
+        cfg = {'resources': {'any_of': [{'accelerators': 'tpu-v5e-16'},
+                                        {'accelerators': 'tpu-v6e-16'}]},
+               'run': 'x'}
+        t1 = Task.from_yaml_config(cfg)
+        t2 = Task.from_yaml_config(cfg)
+        assert len(t1.resources) == 2 and len(t2.resources) == 2
+
+    def test_any_of_differing_hosts_rejected(self):
+        with pytest.raises(exceptions.InvalidTaskError):
+            Task.from_yaml_config(
+                {'resources': {'any_of': [{'accelerators': 'tpu-v5e-16'},
+                                          {'accelerators': 'tpu-v5e-8'}]},
+                 'run': 'x'}).num_nodes  # noqa: B018
+
+    def test_empty_string_env_is_legal(self):
+        t = Task.from_yaml_config({'envs': {'EXTRA': ''}, 'run': 'echo $EXTRA'})
+        assert t.envs['EXTRA'] == ''
+
+    def test_scalar_ports(self):
+        from skypilot_tpu import Resources
+        assert Resources(ports=8080).ports == ['8080']
+        assert Resources(ports='8080').ports == ['8080']
+        assert Resources(ports=[8080, '9000-9010']).ports == ['8080',
+                                                              '9000-9010']
+
+    def test_dict_accelerator_bad_count(self):
+        from skypilot_tpu import Resources
+        with pytest.raises(exceptions.InvalidResourcesError):
+            Resources(accelerators={'A100': 'eight'})
+
+    def test_cycle_is_not_chain(self):
+        with Dag() as dag:
+            a = Task('a', run='x')
+            b = Task('b', run='x')
+            Task('c', run='x')
+            a >> b
+            b >> a
+        assert not dag.is_chain()
+
+    def test_service_spec_full_round_trip(self):
+        from skypilot_tpu.serve.service_spec import ServiceSpec
+        s = ServiceSpec(readiness_path='/h', probe_timeout_seconds=60,
+                        min_replicas=1, max_replicas=3,
+                        target_qps_per_replica=2.0,
+                        upscale_delay_seconds=30,
+                        downscale_delay_seconds=100,
+                        base_ondemand_fallback_replicas=2)
+        s2 = ServiceSpec.from_yaml_config(s.to_yaml_config())
+        assert s2 == s
