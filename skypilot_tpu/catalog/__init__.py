@@ -1,0 +1,167 @@
+"""Service catalog: instance types, accelerators, prices, zones.
+
+Mirrors the reference's sky/clouds/service_catalog/ API surface
+(list_accelerators, get_hourly_cost, validate_region_zone; find_offerings
+replaces get_instance_type_for_accelerator) over pinned in-package CSVs
+(see data_fetchers/fetch_gcp.py for regeneration).
+"""
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import common
+
+_CATALOGS: Dict[str, common.LazyDataFrame] = {
+    'gcp': common.LazyDataFrame('gcp'),
+    'local': common.LazyDataFrame('local'),
+}
+
+
+def _df(cloud: str):
+    cloud = cloud.lower()
+    if cloud not in _CATALOGS:
+        raise exceptions.InvalidResourcesError(
+            f'No catalog for cloud {cloud!r}')
+    return _CATALOGS[cloud].df
+
+
+def invalidate_cache() -> None:
+    for c in _CATALOGS.values():
+        c.invalidate()
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceOffering:
+    cloud: str
+    instance_type: str
+    accelerator_name: Optional[str]
+    accelerator_count: int
+    vcpus: float
+    memory_gib: float
+    region: str
+    zone: str
+    price: Optional[float]       # $/hour on-demand, whole offering
+    spot_price: Optional[float]  # $/hour spot, None if no spot
+
+    def hourly_cost(self, use_spot: bool) -> Optional[float]:
+        return self.spot_price if use_spot else self.price
+
+
+def _f(v) -> Optional[float]:
+    try:
+        f = float(v)
+        return None if math.isnan(f) else f
+    except (TypeError, ValueError):
+        return None
+
+
+def _row_to_offering(cloud: str, row) -> InstanceOffering:
+    acc = row.AcceleratorName if isinstance(row.AcceleratorName, str) and \
+        row.AcceleratorName else None
+    return InstanceOffering(
+        cloud=cloud,
+        instance_type=row.InstanceType,
+        accelerator_name=acc,
+        accelerator_count=int(_f(row.AcceleratorCount) or 0),
+        vcpus=_f(row.vCPUs) or 0.0,
+        memory_gib=_f(row.MemoryGiB) or 0.0,
+        region=row.Region,
+        zone=row.AvailabilityZone,
+        price=_f(row.Price),
+        spot_price=_f(row.SpotPrice),
+    )
+
+
+def list_accelerators(cloud: str = 'gcp',
+                      name_filter: Optional[str] = None
+                      ) -> Dict[str, List[InstanceOffering]]:
+    """{accelerator_name: [offerings]} (reference:
+    service_catalog/__init__.py list_accelerators)."""
+    df = _df(cloud)
+    df = df[df['AcceleratorName'].fillna('') != '']
+    if name_filter:
+        df = df[df['AcceleratorName'].str.contains(name_filter, case=False,
+                                                   regex=False)]
+    out: Dict[str, List[InstanceOffering]] = {}
+    for row in df.itertuples(index=False):
+        off = _row_to_offering(cloud, row)
+        out.setdefault(off.accelerator_name, []).append(off)
+    return out
+
+
+def find_offerings(cloud: str,
+                   instance_type: Optional[str] = None,
+                   accelerator: Optional[str] = None,
+                   accelerator_count: Optional[int] = None,
+                   region: Optional[str] = None,
+                   zone: Optional[str] = None,
+                   use_spot: bool = False,
+                   min_cpus: Optional[float] = None,
+                   min_memory: Optional[float] = None
+                   ) -> List[InstanceOffering]:
+    """All offerings matching the filters, cheapest first.
+
+    `accelerator` semantics: None = any (no filter); '' = offerings with NO
+    accelerator (plain CPU VMs) — so a CPU-only request never resolves to a
+    TPU/GPU machine.
+    """
+    df = common.filter_instances(_df(cloud), instance_type=instance_type,
+                                 accelerator=accelerator, region=region,
+                                 zone=zone, use_spot=use_spot)
+    if accelerator_count is not None:
+        df = df[df['AcceleratorCount'].fillna(0).astype(int) ==
+                accelerator_count]
+    if min_cpus is not None:
+        df = df[df['vCPUs'] >= min_cpus]
+    if min_memory is not None:
+        df = df[df['MemoryGiB'] >= min_memory]
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()]
+    df = df.sort_values(col)
+    return [_row_to_offering(cloud, r) for r in df.itertuples(index=False)]
+
+
+def get_hourly_cost(cloud: str, instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    offs = find_offerings(cloud, instance_type=instance_type, region=region,
+                          zone=zone, use_spot=use_spot)
+    if not offs:
+        raise exceptions.InvalidResourcesError(
+            f'No pricing for {instance_type} (spot={use_spot}, '
+            f'region={region}, zone={zone}) on {cloud}')
+    return offs[0].hourly_cost(use_spot)
+
+
+def regions_zones(cloud: str) -> List[Tuple[str, List[str]]]:
+    df = _df(cloud)
+    out: Dict[str, List[str]] = {}
+    pairs = df[['Region', 'AvailabilityZone']].drop_duplicates().sort_values(
+        ['Region', 'AvailabilityZone'])
+    for row in pairs.itertuples(index=False):
+        out.setdefault(row.Region, []).append(row.AvailabilityZone)
+    return list(out.items())
+
+
+def validate_region_zone(cloud: str, region: Optional[str],
+                         zone: Optional[str]) -> None:
+    pairs = dict(regions_zones(cloud))
+    if region is not None and region not in pairs:
+        raise exceptions.InvalidResourcesError(
+            f'Region {region!r} not found in the {cloud} catalog. Known: '
+            f'{sorted(pairs)}')
+    if zone is not None:
+        region_of_zone = common.region_from_zone(zone)
+        if zone not in pairs.get(region_of_zone, []):
+            raise exceptions.InvalidResourcesError(
+                f'Zone {zone!r} not found in the {cloud} catalog.')
+        if region is not None and region != region_of_zone:
+            raise exceptions.InvalidResourcesError(
+                f'Zone {zone!r} is not in region {region!r} '
+                f'(it is in {region_of_zone!r}).')
+
+
+def instance_type_exists(cloud: str, instance_type: str) -> bool:
+    df = _df(cloud)
+    return not df[df['InstanceType'] == instance_type].empty

@@ -1,0 +1,72 @@
+"""Catalog plumbing: lazy CSV loading + query helpers.
+
+Mirrors the reference's service_catalog/common.py:122 LazyDataFrame +
+read_catalog(:159). The reference fetches hosted CSVs from GitHub with a TTL;
+we ship pinned CSVs in-package (this environment has no egress) and keep the
+same refresh hook shape for a future hosted catalog.
+"""
+import os
+import threading
+from typing import Callable, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu.utils.common_utils import region_from_zone  # noqa: F401
+# (re-exported: catalog callers historically import it from here)
+
+_CATALOG_DIR = os.path.join(os.path.dirname(__file__), 'data')
+
+
+class LazyDataFrame:
+    """Loads the CSV on first use; one per (cloud) catalog file."""
+
+    def __init__(self, name: str,
+                 post_process: Optional[Callable] = None) -> None:
+        self._name = name
+        self._post_process = post_process
+        self._df: Optional[pd.DataFrame] = None
+        self._lock = threading.Lock()
+
+    @property
+    def df(self) -> pd.DataFrame:
+        if self._df is None:
+            with self._lock:
+                if self._df is None:
+                    path = os.path.join(_CATALOG_DIR, f'{self._name}.csv')
+                    df = pd.read_csv(path)
+                    if self._post_process is not None:
+                        df = self._post_process(df)
+                    self._df = df
+        return self._df
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._df = None
+
+
+def filter_instances(df: pd.DataFrame,
+                     instance_type: Optional[str] = None,
+                     accelerator: Optional[str] = None,
+                     region: Optional[str] = None,
+                     zone: Optional[str] = None,
+                     use_spot: Optional[bool] = None) -> pd.DataFrame:
+    if instance_type is not None:
+        df = df[df['InstanceType'] == instance_type]
+    if accelerator is not None:
+        df = df[df['AcceleratorName'].fillna('') == accelerator]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    if use_spot:
+        df = df[df['SpotPrice'].notna()]
+    return df
+
+
+def cheapest_zones(df: pd.DataFrame, use_spot: bool) -> List[Tuple[str, str,
+                                                                   float]]:
+    """[(region, zone, price)] ascending by price."""
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df[df[col].notna()]
+    rows = df.sort_values(col)[['Region', 'AvailabilityZone', col]]
+    return [tuple(r) for r in rows.itertuples(index=False, name=None)]

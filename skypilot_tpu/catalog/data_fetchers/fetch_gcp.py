@@ -1,0 +1,133 @@
+"""Regenerate the GCP catalog CSV.
+
+Reference analog: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py,
+which scrapes the GCP pricing/SKU APIs. This environment has no network
+egress, so the default (and only implemented) mode emits a pinned static
+table of public list prices (USD/hour, as of 2025) for the TPU types,
+GPU VMs and CPU VMs the framework targets. When egress exists, wire
+`--from-api` to the Cloud Billing Catalog API (services/6F81-5844-456A).
+
+TPU pricing is PER CHIP per hour; slice price = chips x chip price. Rows are
+emitted per (accelerator, zone) for the slice sizes users actually request so
+the optimizer can compare availability across zones without arithmetic at
+query time.
+"""
+import argparse
+import csv
+import os
+
+# accelerator family -> (per-chip $/h on-demand, per-chip $/h spot, zones)
+TPU_OFFERINGS = {
+    'v2': (1.125, 0.3375, ['us-central1-b', 'us-central1-c',
+                           'europe-west4-a', 'asia-east1-c']),
+    'v3': (2.00, 0.60, ['us-central1-a', 'europe-west4-a']),
+    'v4': (3.22, 0.966, ['us-central2-b']),
+    'v5e': (1.20, 0.54, ['us-central1-a', 'us-west4-a', 'us-east1-c',
+                         'us-east5-a', 'europe-west4-b', 'asia-southeast1-b']),
+    'v5p': (4.20, 1.89, ['us-east5-a', 'us-central1-a', 'europe-west4-b']),
+    'v6e': (2.70, 1.215, ['us-east5-b', 'us-east1-d', 'europe-west4-a',
+                          'asia-northeast1-b']),
+}
+
+# Slice sizes (in the generation's own naming unit) to materialize.
+TPU_SIZES = {
+    'v2': [8, 32, 128, 256, 512],
+    'v3': [8, 32, 128, 256, 512, 1024],
+    'v4': [8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096],
+    'v5e': [1, 4, 8, 16, 32, 64, 128, 256],
+    'v5p': [8, 16, 32, 64, 128, 256, 512, 1024, 2048],
+    'v6e': [1, 4, 8, 16, 32, 64, 128, 256],
+}
+
+GPU_VMS = [
+    # (instance_type, acc_name, acc_count, vcpus, mem, price, spot, zones)
+    ('a2-highgpu-1g', 'A100', 1, 12, 85, 3.67, 1.10,
+     ['us-central1-a', 'europe-west4-a']),
+    ('a2-highgpu-8g', 'A100', 8, 96, 680, 29.39, 8.80,
+     ['us-central1-a', 'europe-west4-a']),
+    ('a2-ultragpu-8g', 'A100-80GB', 8, 96, 1360, 40.55, 12.16,
+     ['us-central1-a']),
+    ('a3-highgpu-8g', 'H100', 8, 208, 1872, 88.49, 26.55,
+     ['us-central1-a', 'us-east5-a']),
+    ('n1-standard-8-v100x1', 'V100', 1, 8, 30, 2.78, 0.83,
+     ['us-central1-a']),
+    ('g2-standard-16', 'L4', 1, 16, 64, 1.32, 0.40,
+     ['us-central1-a', 'us-east4-a']),
+]
+
+CPU_VMS = [
+    ('n2-standard-4', 4, 16, 0.194, 0.047),
+    ('n2-standard-8', 8, 32, 0.388, 0.094),
+    ('n2-standard-16', 16, 64, 0.777, 0.188),
+    ('n2-standard-32', 32, 128, 1.554, 0.376),
+    ('n2-highmem-8', 8, 64, 0.524, 0.127),
+    ('e2-standard-8', 8, 32, 0.268, 0.080),
+]
+CPU_VM_ZONES = ['us-central1-a', 'us-central1-b', 'us-west4-a', 'us-east1-c',
+                'us-east5-a', 'us-east5-b', 'us-central2-b', 'europe-west4-a',
+                'europe-west4-b', 'asia-southeast1-b']
+
+# Host VM shape allocated per TPU host (informational; the TPU API
+# allocates these implicitly with the slice).
+TPU_HOST_VCPUS = {'v2': 96, 'v3': 96, 'v4': 240, 'v5e': 112, 'v5p': 208,
+                  'v6e': 180}
+TPU_HOST_MEM = {'v2': 340, 'v3': 340, 'v4': 407, 'v5e': 192, 'v5p': 448,
+                'v6e': 720}
+
+HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
+          'MemoryGiB', 'Region', 'AvailabilityZone', 'Price', 'SpotPrice']
+
+
+def emit_static(out_path: str) -> int:
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..',
+                                    '..'))
+    from skypilot_tpu import accelerators as acc_lib
+    rows = []
+    for gen, (price, spot, zones) in TPU_OFFERINGS.items():
+        for size in TPU_SIZES[gen]:
+            name = f'tpu-{gen}-{size}'
+            try:
+                topo = acc_lib.parse_tpu(name)
+            except Exception:
+                continue
+            slice_price = round(topo.chips * price, 4)
+            slice_spot = round(topo.chips * spot, 4)
+            spot_ok = topo.generation.supports_spot
+            for zone in zones:
+                region = zone.rsplit('-', 1)[0]
+                rows.append([
+                    name, name, 1,
+                    TPU_HOST_VCPUS[gen] * topo.num_hosts,
+                    TPU_HOST_MEM[gen] * topo.num_hosts,
+                    region, zone, slice_price,
+                    slice_spot if spot_ok else '',
+                ])
+    for (itype, acc, cnt, vcpus, mem, price, spot, zones) in GPU_VMS:
+        for zone in zones:
+            region = zone.rsplit('-', 1)[0]
+            rows.append([itype, acc, cnt, vcpus, mem, region, zone, price,
+                         spot])
+    for (itype, vcpus, mem, price, spot) in CPU_VMS:
+        for zone in CPU_VM_ZONES:
+            region = zone.rsplit('-', 1)[0]
+            rows.append([itype, '', '', vcpus, mem, region, zone, price,
+                         spot])
+    with open(out_path, 'w', newline='', encoding='utf-8') as f:
+        w = csv.writer(f)
+        w.writerow(HEADER)
+        w.writerows(rows)
+    return len(rows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--out', default=os.path.join(
+        os.path.dirname(__file__), '..', 'data', 'gcp.csv'))
+    args = parser.parse_args()
+    n = emit_static(args.out)
+    print(f'Wrote {n} rows to {args.out}')
+
+
+if __name__ == '__main__':
+    main()

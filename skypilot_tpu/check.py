@@ -1,0 +1,44 @@
+"""Credential check: probe each cloud, cache enabled clouds in the state DB.
+
+Mirrors the reference's sky/check.py:18 `check` +
+get_cached_enabled_clouds_or_refresh (:162).
+"""
+from typing import List, Optional
+
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe all registered clouds; persist and return the enabled set."""
+    enabled = []
+    lines = []
+    for name in clouds_lib.Cloud.registered_names():
+        cloud = clouds_lib.Cloud.from_name(name)
+        ok, reason = cloud.check_credentials()
+        if ok:
+            enabled.append(name)
+            lines.append(f'  ✓ {name}')
+        else:
+            lines.append(f'  ✗ {name}: {reason}')
+    state.set_enabled_clouds(enabled)
+    if not quiet:
+        print('Checked clouds:')
+        print('\n'.join(lines))
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = True) -> List[str]:
+    cached = state.get_enabled_clouds()
+    if cached is None:
+        cached = check(quiet=True)
+    if raise_if_no_cloud_access and not cached:
+        raise exceptions.NoCloudAccessError(
+            'No cloud is enabled. Run `skyt check` for details.')
+    return cached
+
+
+def cloud_in_iterable(cloud: Optional[str], enabled: List[str]) -> bool:
+    return cloud is None or cloud in enabled

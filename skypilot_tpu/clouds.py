@@ -1,0 +1,187 @@
+"""Cloud abstraction: per-cloud capability contract + registry.
+
+Mirrors the reference's abstract `Cloud` (sky/clouds/cloud.py:115) with its
+`CloudImplementationFeatures` feature-flag gate (sky/clouds/cloud.py:27),
+collapsed to the clouds that matter for a TPU-native framework: GCP (the
+only cloud with TPUs) and Local (an on-host pseudo-cloud used for tests and
+single-machine dev, playing the role the reference's LocalDockerBackend +
+monkeypatched clouds play in its test tier 2).
+"""
+import enum
+from typing import Dict, Iterator, List, Optional, Tuple, Type
+
+from skypilot_tpu import exceptions
+
+
+class CloudFeature(enum.Enum):
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    IMAGE_ID = 'image_id'
+    OPEN_PORTS = 'open_ports'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+    STORAGE_MOUNTING = 'storage_mounting'
+
+
+class Region:
+    def __init__(self, name: str, zones: Optional[List[str]] = None) -> None:
+        self.name = name
+        self.zones = zones or []
+
+    def __repr__(self) -> str:
+        return f'Region({self.name})'
+
+
+class Cloud:
+    """Base class. Subclasses register themselves by NAME."""
+
+    NAME: str = ''
+    _REGISTRY: Dict[str, Type['Cloud']] = {}
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.NAME:
+            Cloud._REGISTRY[cls.NAME] = cls
+
+    # -- registry --------------------------------------------------------
+    @classmethod
+    def from_name(cls, name: str) -> 'Cloud':
+        key = name.lower()
+        if key not in cls._REGISTRY:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown cloud {name!r}. Known: {sorted(cls._REGISTRY)}')
+        return cls._REGISTRY[key]()
+
+    @classmethod
+    def registered_names(cls) -> List[str]:
+        return sorted(cls._REGISTRY)
+
+    # -- capability contract --------------------------------------------
+    def features(self) -> frozenset:
+        raise NotImplementedError
+
+    def unsupported_features_for(self, resources) -> List[CloudFeature]:
+        """Features the given resources need but this cloud lacks
+        (reference: check_features_are_supported)."""
+        needed = set()
+        if resources.use_spot:
+            needed.add(CloudFeature.SPOT_INSTANCE)
+        if resources.ports:
+            needed.add(CloudFeature.OPEN_PORTS)
+        if resources.image_id:
+            needed.add(CloudFeature.IMAGE_ID)
+        if resources.disk_tier:
+            needed.add(CloudFeature.CUSTOM_DISK_TIER)
+        if resources.autostop is not None:
+            needed.add(CloudFeature.AUTOSTOP)
+        return sorted(needed - set(self.features()), key=lambda f: f.value)
+
+    def supports_stopping(self, resources) -> bool:
+        return CloudFeature.STOP in self.features()
+
+    # -- catalog hooks ---------------------------------------------------
+    def regions(self) -> List[Region]:
+        raise NotImplementedError
+
+    def zones_for(self, region: str,
+                  resources) -> Iterator[Optional[str]]:
+        """Yield candidate zones (None => region-level provisioning)."""
+        raise NotImplementedError
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return self.NAME.upper()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Cloud) and self.NAME == other.NAME
+
+    def __hash__(self) -> int:
+        return hash(self.NAME)
+
+
+class GCP(Cloud):
+    """GCP: the TPU cloud. TPU slices are provisioned as queued resources /
+    TPU-VMs (reference analog: sky/clouds/gcp.py + GCPTPUVMInstance at
+    sky/provision/gcp/instance_utils.py:1185)."""
+
+    NAME = 'gcp'
+
+    def features(self) -> frozenset:
+        return frozenset({
+            CloudFeature.STOP, CloudFeature.AUTOSTOP,
+            CloudFeature.MULTI_NODE, CloudFeature.SPOT_INSTANCE,
+            CloudFeature.IMAGE_ID, CloudFeature.OPEN_PORTS,
+            CloudFeature.CUSTOM_DISK_TIER, CloudFeature.STORAGE_MOUNTING,
+        })
+
+    def unsupported_features_for(self, resources) -> List[CloudFeature]:
+        missing = super().unsupported_features_for(resources)
+        # Multi-host TPU slices cannot be stopped, only deleted (the
+        # reference blocks the same: sky/clouds/gcp.py:184-190).
+        if (resources.is_tpu and resources.tpu_topology.is_pod and
+                resources.autostop is not None and resources.autostop >= 0):
+            missing.append(CloudFeature.STOP)
+        return missing
+
+    def supports_stopping(self, resources) -> bool:
+        if resources.is_tpu and resources.tpu_topology.is_pod:
+            return False
+        return True
+
+    def regions(self) -> List[Region]:
+        from skypilot_tpu import catalog
+        return [Region(r, z) for r, z in catalog.regions_zones('gcp')]
+
+    def zones_for(self, region: str, resources) -> Iterator[Optional[str]]:
+        from skypilot_tpu import catalog
+        if resources.zone is not None:
+            yield resources.zone
+            return
+        for r, zones in catalog.regions_zones('gcp'):
+            if r == region:
+                yield from zones
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        import os
+        import shutil
+        if os.environ.get('GOOGLE_APPLICATION_CREDENTIALS'):
+            return True, None
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.path.exists(adc):
+            return True, None
+        if shutil.which('gcloud') is not None:
+            return True, None
+        return False, ('No GCP credentials: set '
+                       'GOOGLE_APPLICATION_CREDENTIALS, run `gcloud auth '
+                       'application-default login`, or install gcloud.')
+
+
+class Local(Cloud):
+    """Local pseudo-cloud: 'provisions' worker processes on this machine.
+
+    Exists so the full pipeline (optimizer → provision → runtime → exec) runs
+    end-to-end offline; also the substrate for the fake multi-host test
+    harness (SURVEY.md §4 implication).
+    """
+
+    NAME = 'local'
+
+    def features(self) -> frozenset:
+        return frozenset({
+            CloudFeature.MULTI_NODE, CloudFeature.AUTOSTOP,
+            CloudFeature.OPEN_PORTS,
+        })
+
+    def regions(self) -> List[Region]:
+        return [Region('local', ['local'])]
+
+    def zones_for(self, region: str, resources) -> Iterator[Optional[str]]:
+        yield None
+
+    def check_credentials(self) -> Tuple[bool, Optional[str]]:
+        return True, None

@@ -1,0 +1,245 @@
+"""Optimizer: pick (cloud, region/zone, instance/slice) per task.
+
+Mirrors the reference's sky/optimizer.py:108 Optimizer.optimize: fill in
+launchable candidates from the catalog (:1238), estimate cost or time per
+candidate (:238), then choose per-task via DP on chain DAGs (:401) with an
+inter-task egress cost model. The reference's general-DAG ILP path (:462)
+uses pulp, which is unavailable here; general DAGs fall back to per-task
+greedy (exact when egress is zero, which is the overwhelmingly common case —
+the reference itself special-cases chains).
+"""
+import dataclasses
+import enum
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu import catalog
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import clouds as clouds_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+_DEFAULT_RUNTIME_S = 3600.0  # assumed when the task gives no estimate
+
+# $/GB egress (coarse; reference models the same three tiers).
+_EGRESS_INTRA_REGION = 0.0
+_EGRESS_CROSS_REGION = 0.01
+_EGRESS_CROSS_CLOUD = 0.12
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+@dataclasses.dataclass(frozen=True)
+class LaunchablePlan:
+    """A concrete, priceable choice for one task."""
+    resources: resources_lib.Resources   # fully specified (zone, type)
+    hourly_cost: float                   # whole allocation, $/h
+    estimated_runtime_s: float
+
+    @property
+    def estimated_cost(self) -> float:
+        return self.hourly_cost * self.estimated_runtime_s / 3600.0
+
+
+class Optimizer:
+
+    @staticmethod
+    def optimize(dag, minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked_resources: Optional[List] = None,
+                 quiet: bool = False):
+        """Assign task.best_resources for every task in the dag."""
+        dag.validate()
+        tasks = dag.get_sorted_tasks()
+        per_task: Dict[object, List[LaunchablePlan]] = {}
+        for task in tasks:
+            plans = _fill_in_launchable_plans(task, blocked_resources)
+            if not plans:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No launchable resources found for task {task!r}. '
+                    f'Try other accelerators/regions (see `skyt show-tpus`).')
+            per_task[task] = plans
+
+        if dag.is_chain():
+            choice = _optimize_chain_dp(tasks, per_task, minimize)
+        else:
+            logger.warning('General (non-chain) DAG: optimizing per-task '
+                           '(egress between branches not modeled).')
+            choice = {t: _best_plan(per_task[t], minimize) for t in tasks}
+
+        for task, plan in choice.items():
+            task.best_resources = plan.resources
+            task.estimated_runtime_s = plan.estimated_runtime_s
+        if not quiet:
+            _print_plan_table(choice)
+        return dag
+
+    @staticmethod
+    def plan_for_task(task, minimize: OptimizeTarget = OptimizeTarget.COST,
+                      blocked_resources: Optional[List] = None
+                      ) -> List[LaunchablePlan]:
+        """All feasible plans for one task, best first (used by failover)."""
+        plans = _fill_in_launchable_plans(task, blocked_resources)
+        key = ((lambda p: p.estimated_cost)
+               if minimize == OptimizeTarget.COST
+               else (lambda p: p.estimated_runtime_s))
+        return sorted(plans, key=key)
+
+
+def _is_blocked(res: resources_lib.Resources,
+                blocked: Optional[List]) -> bool:
+    """Reference: blocked-resource filter sky/optimizer.py:1170 — a blocked
+    entry matches if all its non-None fields equal the candidate's."""
+    if not blocked:
+        return False
+    for b in blocked:
+        fields = (('cloud', b.cloud), ('region', b.region),
+                  ('zone', b.zone), ('instance_type', b.instance_type),
+                  ('accelerator_name', b.accelerator_name))
+        if all(want is None or getattr(res, name) == want
+               for name, want in fields):
+            return True
+    return False
+
+
+def _fill_in_launchable_plans(task,
+                              blocked_resources: Optional[List] = None
+                              ) -> List[LaunchablePlan]:
+    enabled = check_lib.get_cached_enabled_clouds_or_refresh()
+    runtime = task.estimated_runtime_s or _DEFAULT_RUNTIME_S
+    plans: List[LaunchablePlan] = []
+    candidates = task.resources or {resources_lib.Resources()}
+    for res in candidates:
+        clouds_to_try = ([res.cloud] if res.cloud is not None else enabled)
+        for cloud_name in clouds_to_try:
+            if cloud_name not in enabled:
+                continue
+            try:
+                cloud = clouds_lib.Cloud.from_name(cloud_name)
+            except exceptions.InvalidResourcesError:
+                continue
+            missing = cloud.unsupported_features_for(res)
+            if missing:
+                logger.debug(f'{cloud_name} lacks {missing} for {res}')
+                continue
+            plans.extend(_plans_on_cloud(cloud_name, res, runtime,
+                                         blocked_resources))
+    return plans
+
+
+def _plans_on_cloud(cloud_name: str, res: resources_lib.Resources,
+                    runtime: float,
+                    blocked: Optional[List]) -> List[LaunchablePlan]:
+    acc_count = None
+    if res.accelerators and not res.is_tpu:
+        acc_count = res.accelerators[res.accelerator_name]
+    # '' = CPU-VMs-only: a request without accelerators must never resolve
+    # to a TPU/GPU offering just because one is cheap.
+    acc_filter = res.accelerator_name if res.accelerators else ''
+    offerings = catalog.find_offerings(
+        cloud_name,
+        instance_type=res.instance_type,
+        accelerator=acc_filter,
+        accelerator_count=acc_count,
+        region=res.region,
+        zone=res.zone,
+        use_spot=res.use_spot,
+        min_cpus=res.cpus_at_least(),
+        min_memory=res.memory_at_least(),
+    )
+    plans = []
+    for off in offerings:
+        concrete = res.copy(cloud=cloud_name, region=off.region,
+                            zone=off.zone, instance_type=off.instance_type)
+        if _is_blocked(concrete, blocked):
+            continue
+        per_alloc = off.hourly_cost(res.use_spot)
+        if per_alloc is None:
+            continue
+        # TPU rows price the whole slice (all hosts); VM rows price one VM.
+        multiplier = 1 if res.is_tpu else max(1, _task_nodes(res))
+        plans.append(LaunchablePlan(resources=concrete,
+                                    hourly_cost=per_alloc * multiplier,
+                                    estimated_runtime_s=runtime))
+    return plans
+
+
+def _task_nodes(res: resources_lib.Resources) -> int:
+    return res.num_hosts
+
+
+def _best_plan(plans: List[LaunchablePlan],
+               minimize: OptimizeTarget) -> LaunchablePlan:
+    if minimize == OptimizeTarget.COST:
+        return min(plans, key=lambda p: p.estimated_cost)
+    return min(plans, key=lambda p: p.estimated_runtime_s)
+
+
+def _egress_cost_per_gb(a: resources_lib.Resources,
+                        b: resources_lib.Resources) -> float:
+    if a.cloud != b.cloud:
+        return _EGRESS_CROSS_CLOUD
+    if a.region != b.region:
+        return _EGRESS_CROSS_REGION
+    return _EGRESS_INTRA_REGION
+
+
+def _optimize_chain_dp(tasks, per_task, minimize: OptimizeTarget
+                       ) -> Dict[object, 'LaunchablePlan']:
+    """DP over the chain (reference: sky/optimizer.py:401 _optimize_by_dp).
+
+    State: best objective to finish tasks[0..i] ending with plan j.
+    Edge cost: egress between consecutive tasks' locations, scaled by the
+    upstream task's output size estimate (task.output_size_gb, default 0).
+    """
+    # dp[j] = (score, backpointer list of plans)
+    prev_plans = per_task[tasks[0]]
+    dp: List[Tuple[float, List[LaunchablePlan]]] = []
+    for p in prev_plans:
+        score = (p.estimated_cost if minimize == OptimizeTarget.COST
+                 else p.estimated_runtime_s)
+        dp.append((score, [p]))
+    for task in tasks[1:]:
+        new_dp: List[Tuple[float, List[LaunchablePlan]]] = []
+        for p in per_task[task]:
+            base = (p.estimated_cost if minimize == OptimizeTarget.COST
+                    else p.estimated_runtime_s)
+            best_score, best_path = None, None
+            for (prev_score, path) in dp:
+                prev_p = path[-1]
+                out_gb = getattr(tasks[len(path) - 1], 'output_size_gb',
+                                 0.0) or 0.0
+                egress = (_egress_cost_per_gb(prev_p.resources, p.resources) *
+                          out_gb if minimize == OptimizeTarget.COST else 0.0)
+                s = prev_score + base + egress
+                if best_score is None or s < best_score:
+                    best_score, best_path = s, path + [p]
+            new_dp.append((best_score, best_path))
+        dp = new_dp
+    best_score, best_path = min(dp, key=lambda t: t[0])
+    return dict(zip(tasks, best_path))
+
+
+def _print_plan_table(choice: Dict[object, LaunchablePlan]) -> None:
+    try:
+        from rich.console import Console
+        from rich.table import Table
+        table = Table(title='Optimizer plan')
+        for col in ('Task', 'Resources', 'Zone', '$/hr', 'Est. cost'):
+            table.add_column(col)
+        for task, plan in choice.items():
+            table.add_row(
+                getattr(task, 'name', None) or '-',
+                str(plan.resources),
+                plan.resources.zone or '-',
+                f'{plan.hourly_cost:.2f}',
+                f'{plan.estimated_cost:.2f}')
+        Console().print(table)
+    except Exception:  # rich is cosmetic
+        for task, plan in choice.items():
+            logger.info(f'{task}: {plan.resources} '
+                        f'(${plan.hourly_cost:.2f}/h)')

@@ -158,9 +158,8 @@ class Resources:
             raise exceptions.InvalidResourcesError(
                 'use_spot and reserved are mutually exclusive')
         if self.zone is not None and self.region is None:
-            # Infer region from zone (GCP convention: region = zone minus
-            # trailing '-x').
-            self.region = self.zone.rsplit('-', 1)[0]
+            from skypilot_tpu.utils import common_utils
+            self.region = common_utils.region_from_zone(self.zone)
 
     # ------------------------------------------------------------ ordering
     def cpus_at_least(self) -> Optional[float]:
@@ -255,7 +254,7 @@ class Resources:
         parts = []
         if self.cloud:
             parts.append(self.cloud.upper())
-        if self.instance_type:
+        if self.instance_type and self.instance_type != self.accelerator_name:
             parts.append(self.instance_type)
         if self.accelerators:
             name = self.accelerator_name

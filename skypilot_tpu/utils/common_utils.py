@@ -1,0 +1,28 @@
+"""Small shared helpers (reference analog: sky/utils/common_utils.py)."""
+import hashlib
+import os
+import re
+import uuid
+
+
+def region_from_zone(zone: str) -> str:
+    """GCP convention: region = zone minus the trailing '-x' suffix."""
+    return zone.rsplit('-', 1)[0]
+
+
+def make_cluster_name(prefix: str = 'skyt') -> str:
+    """Default cluster name: <prefix>-<user>-<4 hex> (reference generates
+    sky-<hash>-<user> similarly)."""
+    user = re.sub(r'[^a-z0-9]', '', os.environ.get('USER', 'user').lower()) \
+        or 'user'
+    return f'{prefix}-{user}-{uuid.uuid4().hex[:4]}'
+
+
+def user_hash() -> str:
+    """Stable per-user hash for telemetry/controller names."""
+    ident = f"{os.environ.get('USER', '')}-{os.path.expanduser('~')}"
+    return hashlib.md5(ident.encode()).hexdigest()[:8]
+
+
+def truncate(text: str, max_len: int = 80) -> str:
+    return text if len(text) <= max_len else text[:max_len - 1] + '…'

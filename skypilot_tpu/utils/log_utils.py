@@ -1,0 +1,36 @@
+"""Logging setup (reference analog: sky/sky_logging.py).
+
+Env controls: SKYT_DEBUG=1 for debug level, SKYT_MINIMIZE_LOGGING=1 to quiet
+info chatter (mirrors SKYPILOT_DEBUG / SKYPILOT_MINIMIZE_LOGGING).
+"""
+import logging
+import os
+import sys
+
+_FORMAT = '%(levelname).1s %(asctime)s %(name)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    root = logging.getLogger('skypilot_tpu')
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+    root.addHandler(handler)
+    if os.environ.get('SKYT_DEBUG'):
+        root.setLevel(logging.DEBUG)
+    elif os.environ.get('SKYT_MINIMIZE_LOGGING'):
+        root.setLevel(logging.WARNING)
+    else:
+        root.setLevel(logging.INFO)
+    root.propagate = False
+
+
+def init_logger(name: str) -> logging.Logger:
+    _configure_root()
+    return logging.getLogger(name)

@@ -1,0 +1,150 @@
+"""Catalog + optimizer dry-run tests, fully offline (mirrors the reference's
+tests/test_optimizer_dryruns.py with its enable_all_clouds monkeypatch trick,
+tests/common.py:11)."""
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, catalog, exceptions
+from skypilot_tpu.optimizer import OptimizeTarget, Optimizer
+
+
+@pytest.fixture
+def enable_clouds(tmp_state_dir, monkeypatch):
+    from skypilot_tpu import state
+    state.set_enabled_clouds(['gcp', 'local'])
+    yield
+
+
+class TestCatalog:
+    def test_list_accelerators(self):
+        accs = catalog.list_accelerators('gcp')
+        assert 'tpu-v5e-16' in accs
+        assert 'A100' in accs
+        assert all(o.price is not None for o in accs['tpu-v5e-16'])
+
+    def test_tpu_slice_price_scales_with_chips(self):
+        p4 = catalog.find_offerings('gcp', accelerator='tpu-v5e-4')[0].price
+        p16 = catalog.find_offerings('gcp', accelerator='tpu-v5e-16')[0].price
+        assert p16 == pytest.approx(4 * p4)
+
+    def test_find_offerings_spot(self):
+        offs = catalog.find_offerings('gcp', accelerator='tpu-v5e-16',
+                                      use_spot=True)
+        assert offs and all(o.spot_price is not None for o in offs)
+        assert offs[0].spot_price < offs[0].price
+
+    def test_validate_region_zone(self):
+        catalog.validate_region_zone('gcp', 'us-central1', None)
+        with pytest.raises(exceptions.InvalidResourcesError):
+            catalog.validate_region_zone('gcp', 'mars-north1', None)
+        with pytest.raises(exceptions.InvalidResourcesError):
+            catalog.validate_region_zone('gcp', None, 'us-central1-zz')
+
+    def test_cpu_filter(self):
+        offs = catalog.find_offerings('gcp', min_cpus=16, min_memory=64)
+        assert offs
+        assert all(o.vcpus >= 16 and o.memory_gib >= 64 for o in offs)
+
+
+class TestOptimizer:
+    def test_single_tpu_task(self, enable_clouds):
+        with Dag() as dag:
+            t = Task('train', run='python train.py')
+            t.set_resources(Resources(accelerators='tpu-v5e-16'))
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources is not None
+        assert t.best_resources.zone is not None
+        assert t.best_resources.accelerator_name == 'tpu-v5e-16'
+
+    def test_cost_picks_spot_when_allowed(self, enable_clouds):
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources({
+                Resources(accelerators='tpu-v5e-16', use_spot=True),
+                Resources(accelerators='tpu-v5e-16'),
+            })
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.use_spot
+
+    def test_zone_pin_respected(self, enable_clouds):
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources(Resources(accelerators='tpu-v5e-16',
+                                      zone='us-west4-a'))
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.zone == 'us-west4-a'
+
+    def test_unavailable_raises(self, enable_clouds):
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources(Resources(accelerators='tpu-v5e-16',
+                                      zone='europe-west4-a'))  # v5e not there
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            Optimizer.optimize(dag, quiet=True)
+
+    def test_blocked_resources_failover(self, enable_clouds):
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources(Resources(accelerators='tpu-v5e-16'))
+        Optimizer.optimize(dag, quiet=True)
+        first_zone = t.best_resources.zone
+        blocked = [t.best_resources.copy()]
+        Optimizer.optimize(dag, blocked_resources=blocked, quiet=True)
+        assert t.best_resources.zone != first_zone
+
+    def test_chain_dp_prefers_colocation(self, enable_clouds):
+        with Dag() as dag:
+            a = Task('prep', run='x')
+            a.set_resources(Resources(cpus='8+', cloud='gcp'))
+            b = Task('train', run='y')
+            b.set_resources(Resources(accelerators='tpu-v4-8'))  # us-central2
+            a >> b
+        a.output_size_gb = 500.0
+        Optimizer.optimize(dag, quiet=True)
+        # Egress pressure should pull the prep task into the TPU's region.
+        assert a.best_resources.region == b.best_resources.region
+
+    def test_time_target(self, enable_clouds):
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources(Resources(accelerators='tpu-v5e-4'))
+        Optimizer.optimize(dag, minimize=OptimizeTarget.TIME, quiet=True)
+        assert t.best_resources is not None
+
+    def test_gpu_head_to_head(self, enable_clouds):
+        # TPU v5e-4 ($4.8/h) should beat A100:8 ($29/h) on cost.
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources({Resources(accelerators='tpu-v5e-4'),
+                             Resources(accelerators='A100:8')})
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.is_tpu
+
+
+class TestReviewRegressions:
+    def test_cpu_only_never_gets_accelerators(self, enable_clouds):
+        from skypilot_tpu.optimizer import Optimizer
+        from skypilot_tpu import Dag, Resources, Task
+        with Dag() as dag:
+            t = Task('cpu', run='x')
+            t.set_resources(Resources(cpus='64+', cloud='gcp'))
+        import skypilot_tpu.exceptions as ex
+        # No CPU VM in the catalog has >=64 vCPUs; must NOT fall back to TPU.
+        import pytest as _pytest
+        with _pytest.raises(ex.ResourcesUnavailableError):
+            Optimizer.optimize(dag, quiet=True)
+
+    def test_cpu_only_picks_cpu_vm(self, enable_clouds):
+        from skypilot_tpu.optimizer import Optimizer
+        from skypilot_tpu import Dag, Resources, Task
+        with Dag() as dag:
+            t = Task('cpu', run='x')
+            t.set_resources(Resources(cpus='8+', cloud='gcp'))
+        Optimizer.optimize(dag, quiet=True)
+        assert t.best_resources.accelerators is None
+        assert t.best_resources.instance_type.startswith(('n2', 'e2'))
+
+    def test_region_zone_mismatch_rejected(self):
+        import pytest as _pytest
+        from skypilot_tpu import catalog, exceptions
+        with _pytest.raises(exceptions.InvalidResourcesError):
+            catalog.validate_region_zone('gcp', 'us-west4', 'us-central1-a')
