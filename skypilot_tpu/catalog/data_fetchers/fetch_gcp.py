@@ -83,6 +83,7 @@ def emit_static(out_path: str) -> int:
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..',
                                     '..'))
     from skypilot_tpu import accelerators as acc_lib
+    from skypilot_tpu.utils.common_utils import region_from_zone
     rows = []
     for gen, (price, spot, zones) in TPU_OFFERINGS.items():
         for size in TPU_SIZES[gen]:
@@ -95,7 +96,7 @@ def emit_static(out_path: str) -> int:
             slice_spot = round(topo.chips * spot, 4)
             spot_ok = topo.generation.supports_spot
             for zone in zones:
-                region = zone.rsplit('-', 1)[0]
+                region = region_from_zone(zone)
                 rows.append([
                     name, name, 1,
                     TPU_HOST_VCPUS[gen] * topo.num_hosts,
@@ -105,12 +106,12 @@ def emit_static(out_path: str) -> int:
                 ])
     for (itype, acc, cnt, vcpus, mem, price, spot, zones) in GPU_VMS:
         for zone in zones:
-            region = zone.rsplit('-', 1)[0]
+            region = region_from_zone(zone)
             rows.append([itype, acc, cnt, vcpus, mem, region, zone, price,
                          spot])
     for (itype, vcpus, mem, price, spot) in CPU_VMS:
         for zone in CPU_VM_ZONES:
-            region = zone.rsplit('-', 1)[0]
+            region = region_from_zone(zone)
             rows.append([itype, '', '', vcpus, mem, region, zone, price,
                          spot])
     with open(out_path, 'w', newline='', encoding='utf-8') as f:

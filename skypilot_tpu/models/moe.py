@@ -1,0 +1,199 @@
+"""Mixture-of-Experts layers: expert parallelism over the 'ep' mesh axis.
+
+The reference serves Mixtral-8x7B by shelling out to vLLM+megablocks on
+CUDA (llm/mixtral/serve.yaml, SURVEY.md §2.10 "Expert parallel"); here MoE
+is a first-class GShard/Switch-style layer: top-k routing with capacity,
+dispatch/combine as einsums (XLA lowers these to all-to-alls over the 'ep'
+axis when experts are sharded), expert FFN weights carrying the 'expert'
+logical axis. Aux losses (load-balance + router z) returned for the
+trainer.
+"""
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama as llama_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_z_coef: float = 1e-3
+    load_balance_coef: float = 1e-2
+
+
+class MoeMLP(nn.Module):
+    """Drop-in replacement for LlamaMLP with expert routing.
+
+    x: [B, S, D] -> ([B, S, D], aux_losses dict)
+    """
+    cfg: 'llama_lib.LlamaConfig'
+    moe: MoeConfig
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, dict]:
+        cfg, moe = self.cfg, self.moe
+        dtype = jnp.dtype(cfg.dtype)
+        b, s, d = x.shape
+        e = moe.num_experts
+        k = moe.experts_per_token
+        capacity = max(int(moe.capacity_factor * s * k / e), 1)
+
+        router_w = self.param(
+            'router',
+            nn.with_logical_partitioning(nn.initializers.lecun_normal(),
+                                         ('embed', 'expert')),
+            (d, e), jnp.dtype(cfg.param_dtype))
+        logits = jnp.einsum('bsd,de->bse', x.astype(jnp.float32),
+                            router_w.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # --- top-k routing with capacity (GShard formulation) -----------
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)       # [B,S,k]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B,S,k,E]
+        # position of each token in its expert's buffer
+        pos_in_expert = (jnp.cumsum(onehot, axis=1) - onehot)  # [B,S,k,E]
+        pos = jnp.einsum('bske,bske->bsk', pos_in_expert, onehot)
+        keep = pos < capacity
+        gate_vals = gate_vals * keep.astype(gate_vals.dtype)
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [B,S,k,C]
+        # dispatch [B,S,E,C] / combine [B,S,E,C]
+        dispatch = jnp.einsum('bske,bskc->bsec', onehot, pos_oh)
+        combine = jnp.einsum('bsk,bske,bskc->bsec', gate_vals, onehot,
+                             pos_oh)
+
+        # --- expert computation ----------------------------------------
+        expert_in = jnp.einsum('bsec,bsd->ebcd', dispatch,
+                               x.astype(jnp.float32)).astype(dtype)
+        expert_in = nn.with_logical_constraint(
+            expert_in, ('act_expert', 'act_batch', None, 'act_embed'))
+
+        w_gate = self.param(
+            'w_gate', nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ('expert', 'embed', 'mlp')),
+            (e, d, cfg.mlp_dim), jnp.dtype(cfg.param_dtype))
+        w_up = self.param(
+            'w_up', nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ('expert', 'embed', 'mlp')),
+            (e, d, cfg.mlp_dim), jnp.dtype(cfg.param_dtype))
+        w_down = self.param(
+            'w_down', nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ('expert', 'mlp', 'embed')),
+            (e, cfg.mlp_dim, d), jnp.dtype(cfg.param_dtype))
+
+        gate = jnp.einsum('ebcd,edm->ebcm', expert_in, w_gate.astype(dtype))
+        up = jnp.einsum('ebcd,edm->ebcm', expert_in, w_up.astype(dtype))
+        hidden = nn.silu(gate) * up
+        hidden = nn.with_logical_constraint(
+            hidden, ('act_expert', 'act_batch', None, 'act_mlp'))
+        expert_out = jnp.einsum('ebcm,emd->ebcd', hidden,
+                                w_down.astype(dtype))
+
+        out = jnp.einsum('bsec,ebcd->bsd',
+                         combine.astype(jnp.float32),
+                         expert_out.astype(jnp.float32)).astype(dtype)
+        out = nn.with_logical_constraint(
+            out, ('act_batch', 'act_seq', 'act_embed'))
+
+        # --- aux losses -------------------------------------------------
+        # load balance (Switch): E * sum_e f_e * p_e
+        density = jnp.mean(onehot[..., 0, :], axis=(0, 1)) if k == 1 else \
+            jnp.mean(onehot.sum(2), axis=(0, 1)) / k      # fraction routed
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        lb_loss = e * jnp.sum(density * mean_prob) * moe.load_balance_coef
+        z_loss = jnp.mean(
+            jax.nn.logsumexp(logits, axis=-1) ** 2) * moe.router_z_coef
+        return out, {'moe_load_balance': lb_loss, 'moe_router_z': z_loss}
+
+
+class MoeBlock(nn.Module):
+    cfg: 'llama_lib.LlamaConfig'
+    moe: MoeConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, segment_ids=None):
+        x = x + llama_lib.LlamaAttention(self.cfg, name='attn')(
+            llama_lib.RMSNorm(self.cfg, name='attn_norm')(x), cos, sin,
+            segment_ids)
+        mlp_out, aux = MoeMLP(self.cfg, self.moe, name='moe_mlp')(
+            llama_lib.RMSNorm(self.cfg, name='mlp_norm')(x))
+        x = x + mlp_out
+        aux_total = sum(aux.values())
+        return x, aux_total
+
+
+class MixtralModel(nn.Module):
+    """Mixtral-style decoder: Llama backbone with MoE MLP blocks."""
+    cfg: 'llama_lib.LlamaConfig'
+    moe: MoeConfig = MoeConfig()
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, segment_ids=None):
+        from skypilot_tpu.ops import rope
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        b, s = tokens.shape
+        embed = self.param(
+            'tok_embed',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+            (cfg.vocab_size, cfg.dim), jnp.dtype(cfg.param_dtype))
+        x = embed.astype(dtype)[tokens]
+        x = nn.with_logical_constraint(
+            x, ('act_batch', 'act_seq', 'act_embed'))
+        if positions is None:
+            positions = rope.positions_from_segment_ids(segment_ids, b, s)
+        cos, sin = rope.rope_freqs(positions, cfg.head_dim, cfg.rope_theta,
+                                   use_llama31_scaling=cfg.use_llama31_rope)
+        aux_total = 0.0
+        block = MoeBlock
+        if cfg.remat:
+            block = nn.remat(MoeBlock, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            (x, aux_total), _ = nn.scan(
+                lambda mdl, carry, _: (
+                    (lambda o: (o[0], carry[1] + o[1]))(
+                        mdl(carry[0], cos, sin, segment_ids)), None),
+                variable_axes={'params': 0},
+                split_rngs={'params': True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: 'layers'},
+            )(block(cfg, self.moe, name='layers'),
+              (x, jnp.zeros((), jnp.float32)), None)
+        else:
+            for i in range(cfg.n_layers):
+                x, aux = block(cfg, self.moe, name=f'layer_{i}')(
+                    x, cos, sin, segment_ids)
+                aux_total = aux_total + aux
+        x = llama_lib.RMSNorm(cfg, name='final_norm')(x)
+        logits = llama_lib._dense(cfg.vocab_size, ('embed', 'vocab'),
+                                  'lm_head', cfg.param_dtype, dtype)(x)
+        logits = nn.with_logical_constraint(
+            logits, ('act_batch', 'act_seq', 'act_vocab'))
+        self.sow('intermediates', 'moe_aux_loss', aux_total)
+        return logits
+
+
+# Mixtral-8x7B shapes (vocab 32000, dim 4096, 32 layers, 8 experts top-2).
+MIXTRAL_CONFIGS = {
+    'debug-moe': (llama_lib.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq_len=128, dtype='float32',
+        param_dtype='float32', use_llama31_rope=False, remat=False),
+        MoeConfig(num_experts=4, experts_per_token=2)),
+    'mixtral-8x7b': (llama_lib.LlamaConfig(
+        vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        mlp_dim=14336, max_seq_len=32768, rope_theta=1e6,
+        use_llama31_rope=False),
+        MoeConfig(num_experts=8, experts_per_token=2)),
+}

@@ -1,0 +1,89 @@
+"""Attention ops: XLA reference implementation + dispatch.
+
+The XLA path is the correctness baseline and the grad path on CPU; on TPU
+the Pallas flash kernel (ops/flash_attention.py) is used for the hot
+forward/backward. GQA (grouped KV heads) handled by logical head repeat
+folded into the einsum — no materialized K/V repeat.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # logits are f32 until softmax, so -1e9 never overflows
+
+
+def _causal_mask(q_len: int, k_len: int, q_offset: int = 0) -> jax.Array:
+    """[q_len, k_len] bool, True = attendable. q_offset shifts query
+    positions (used for decode and for ring-attention blocks)."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    k_pos = jnp.arange(k_len)[None, :]
+    return q_pos >= k_pos
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  segment_ids: Optional[jax.Array] = None,
+                  kv_segment_ids: Optional[jax.Array] = None,
+                  q_offset: int = 0,
+                  softmax_scale: Optional[float] = None) -> jax.Array:
+    """q: [B, Sq, Hq, D]; k,v: [B, Sk, Hkv, D]; Hq % Hkv == 0.
+
+    Returns [B, Sq, Hq, D]. Logits and softmax in f32.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    assert hq % hkv == 0, (hq, hkv)
+    groups = hq // hkv
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    qg = q.reshape(b, sq, hkv, groups, d)
+    logits = jnp.einsum('bqhgd,bkhd->bhgqk', qg, k,
+                        preferred_element_type=jnp.float32)
+    logits = logits * scale
+
+    mask = None
+    if causal:
+        mask = _causal_mask(sq, sk, q_offset)[None, None, None]
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        seg_mask = (segment_ids[:, None, None, :, None] ==
+                    kv_seg[:, None, None, None, :])
+        mask = seg_mask if mask is None else (mask & seg_mask)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum('bhgqk,bkhd->bqhgd', probs.astype(v.dtype), v)
+    return out.reshape(b, sq, hq, d)
+
+
+@functools.partial(jax.jit, static_argnames=('causal', 'impl'))
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True,
+              segment_ids: Optional[jax.Array] = None,
+              impl: str = 'auto') -> jax.Array:
+    """Dispatch: 'auto' uses the Pallas flash kernel on TPU when shapes
+    allow, else the XLA reference."""
+    if impl == 'auto':
+        impl = 'flash' if _flash_ok(q, k) else 'xla'
+    if impl == 'flash':
+        from skypilot_tpu.ops import flash_attention
+        return flash_attention.flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids)
+    return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids)
+
+
+def _flash_ok(q: jax.Array, k: jax.Array) -> bool:
+    try:
+        import importlib.util
+        if importlib.util.find_spec('skypilot_tpu.ops.flash_attention') \
+                is None:
+            return False
+        on_tpu = jax.devices()[0].platform == 'tpu'
+    except Exception:
+        on_tpu = False
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[3]
+    return (on_tpu and sq % 128 == 0 and sk % 128 == 0 and
+            d in (64, 128, 256) and sq >= 128)

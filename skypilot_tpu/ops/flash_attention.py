@@ -1,0 +1,169 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Blockwise online-softmax attention (Flash-Attention-2 schedule): grid over
+(batch, q_heads, q_blocks, k_blocks) with the k axis innermost so the VMEM
+scratch accumulators (running max m, running sum l, output acc) persist
+across k iterations of one q block. Causal masking skips fully-masked k
+blocks via pl.when; GQA is folded into the k/v index_map (head h reads kv
+head h // group). Backward pass uses XLA recompute via custom_vjp — the
+flash win in training is the forward (the backward is recomputed under
+jax.checkpoint per layer anyway); a Pallas backward kernel is the next
+optimization step.
+
+Kernel conventions follow /opt/skills/guides/pallas_guide.md (block specs,
+scratch via pl.pallas_call scratch_shapes, MXU-aligned 128 tiles).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from skypilot_tpu.ops import attention as attention_ops
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _interpret_mode() -> bool:
+    """Pallas interpret mode off-TPU (CPU tests exercise kernel logic)."""
+    try:
+        return jax.devices()[0].platform != 'tpu'
+    except Exception:
+        return True
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                num_k_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0, 0]                   # [block_q, d]
+        k = k_ref[0, 0]                   # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:]                 # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)            # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)   # [bq, 1]
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    if causal:
+        # Skip k blocks entirely above the diagonal.
+        first_masked = (qi + 1) * block_q  # k positions >= this are masked
+        pl.when(ki * block_k < first_masked)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[:]
+        l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> output 0
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True,
+                    segment_ids: Optional[jax.Array] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D] -> [B, Sq, Hq, D].
+
+    segment_ids is not yet supported by the kernel (falls back to XLA).
+    """
+    if segment_ids is not None:
+        return attention_ops.mha_reference(q, k, v, causal=causal,
+                                           segment_ids=segment_ids)
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    assert hq % hkv == 0
+    group = hq // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q,
+                                                     block_k)
+    nq, nk = sq // block_q, sk // block_k
+    scale = d ** -0.5
+
+    # Kernel layout: [B, H, S, D] (head-major so blocks are contiguous).
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=_interpret_mode(),
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _fwd_rule(q, k, v, causal, segment_ids, block_q, block_k):
+    out = flash_attention(q, k, v, causal, segment_ids, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd_rule(causal, segment_ids, block_q, block_k, res, g):
+    q, k, v = res
+    # Backward via XLA recompute of the reference attention. O(S^2) memory
+    # per block is bounded by the remat granularity of the caller.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_ops.mha_reference(
+            q_, k_, v_, causal=causal, segment_ids=segment_ids), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,24 @@
+"""Normalization ops.
+
+RMSNorm as used by the Llama family. Computation in float32 regardless of
+input dtype (bf16 accumulation loses too much precision for variance), cast
+back on return — XLA fuses the whole thing into neighboring ops, so there is
+no reason for a Pallas kernel here (the op is bandwidth-trivial after
+fusion).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5,
+             zero_centered: bool = False) -> jax.Array:
+    """y = x / rms(x) * w   (w stored as (1+w) when zero_centered, the
+    Gemma convention, so zero-init works with weight decay)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    if zero_centered:
+        w = 1.0 + w
+    return (y * w).astype(dtype)

@@ -1,0 +1,64 @@
+"""Rotary position embeddings (RoPE), including the Llama-3.1 frequency
+scaling. Pure function of (positions, head_dim); computed in f32 and applied
+via the split-half rotation (the HF/Llama convention, not interleaved).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=('head_dim', 'theta',
+                                             'use_llama31_scaling'))
+def rope_freqs(positions: jax.Array, head_dim: int,
+               theta: float = 500000.0,
+               use_llama31_scaling: bool = False):
+    """Return (cos, sin) of shape positions.shape + (head_dim//2,)."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                        dtype=jnp.float32) / head_dim))
+    if use_llama31_scaling:
+        # Llama-3.1 long-context NTK-by-parts scaling (factor 8, original
+        # context 8192), reference implementation in Meta's llama3 repo.
+        factor, low_mult, high_mult, old_ctx = 8.0, 1.0, 4.0, 8192
+        low = old_ctx / low_mult
+        high = old_ctx / high_mult
+        wavelen = 2.0 * jnp.pi / freqs
+        smooth = jnp.clip((old_ctx / wavelen - low_mult) /
+                          (high_mult - low_mult), 0.0, 1.0)
+        scaled = jnp.where(wavelen > low, freqs / factor, freqs)
+        mid = (1.0 - smooth) * freqs / factor + smooth * freqs
+        in_mid = (wavelen <= low) & (wavelen >= high)
+        freqs = jnp.where(in_mid, mid, scaled)
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [..., seq, heads, head_dim]; cos/sin: [..., seq, head_dim//2]."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    # broadcast cos/sin over the heads axis
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)
+
+
+def positions_from_segment_ids(
+        segment_ids: Optional[jax.Array], batch: int,
+        seq: int) -> jax.Array:
+    """Default positions 0..seq-1 per example (packing-aware later)."""
+    if segment_ids is None:
+        return jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    # restart positions at each segment boundary
+    def per_example(seg):
+        def step(carry, s):
+            prev_seg, pos = carry
+            pos = jnp.where(s == prev_seg, pos + 1, 0)
+            return (s, pos), pos
+        (_, _), out = jax.lax.scan(step, (seg[0], -1), seg)
+        return out
+    return jax.vmap(per_example)(segment_ids)

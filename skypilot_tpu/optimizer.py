@@ -57,11 +57,14 @@ class Optimizer:
         tasks = dag.get_sorted_tasks()
         per_task: Dict[object, List[LaunchablePlan]] = {}
         for task in tasks:
-            plans = _fill_in_launchable_plans(task, blocked_resources)
+            plans, hints = _fill_in_launchable_plans(task, blocked_resources)
             if not plans:
+                hint_txt = (' ' + '; '.join(hints)) if hints else (
+                    ' Try other accelerators/regions '
+                    '(see `skyt show-tpus`).')
                 raise exceptions.ResourcesUnavailableError(
-                    f'No launchable resources found for task {task!r}. '
-                    f'Try other accelerators/regions (see `skyt show-tpus`).')
+                    f'No launchable resources found for task '
+                    f'{task!r}.{hint_txt}')
             per_task[task] = plans
 
         if dag.is_chain():
@@ -83,7 +86,7 @@ class Optimizer:
                       blocked_resources: Optional[List] = None
                       ) -> List[LaunchablePlan]:
         """All feasible plans for one task, best first (used by failover)."""
-        plans = _fill_in_launchable_plans(task, blocked_resources)
+        plans, _ = _fill_in_launchable_plans(task, blocked_resources)
         key = ((lambda p: p.estimated_cost)
                if minimize == OptimizeTarget.COST
                else (lambda p: p.estimated_runtime_s))
@@ -106,34 +109,44 @@ def _is_blocked(res: resources_lib.Resources,
     return False
 
 
-def _fill_in_launchable_plans(task,
-                              blocked_resources: Optional[List] = None
-                              ) -> List[LaunchablePlan]:
+def _fill_in_launchable_plans(
+        task, blocked_resources: Optional[List] = None
+) -> Tuple[List[LaunchablePlan], List[str]]:
+    """Returns (plans, hints) — hints explain why candidates were skipped
+    (surfaced when no plan is launchable)."""
     enabled = check_lib.get_cached_enabled_clouds_or_refresh()
     runtime = task.estimated_runtime_s or _DEFAULT_RUNTIME_S
     plans: List[LaunchablePlan] = []
+    hints: List[str] = []
     candidates = task.resources or {resources_lib.Resources()}
     for res in candidates:
         clouds_to_try = ([res.cloud] if res.cloud is not None else enabled)
         for cloud_name in clouds_to_try:
             if cloud_name not in enabled:
+                hints.append(
+                    f'{res} requires cloud {cloud_name!r}, which is not '
+                    f'enabled — run `skyt check` (missing credentials?)')
                 continue
             try:
                 cloud = clouds_lib.Cloud.from_name(cloud_name)
             except exceptions.InvalidResourcesError:
+                hints.append(f'unknown cloud {cloud_name!r}')
                 continue
             missing = cloud.unsupported_features_for(res)
             if missing:
-                logger.debug(f'{cloud_name} lacks {missing} for {res}')
+                hints.append(f'{cloud_name} lacks '
+                             f'{[f.value for f in missing]} for {res}')
                 continue
             plans.extend(_plans_on_cloud(cloud_name, res, runtime,
-                                         blocked_resources))
-    return plans
+                                         blocked_resources,
+                                         num_nodes=task.num_nodes))
+    return plans, hints
 
 
 def _plans_on_cloud(cloud_name: str, res: resources_lib.Resources,
                     runtime: float,
-                    blocked: Optional[List]) -> List[LaunchablePlan]:
+                    blocked: Optional[List],
+                    num_nodes: int = 1) -> List[LaunchablePlan]:
     acc_count = None
     if res.accelerators and not res.is_tpu:
         acc_count = res.accelerators[res.accelerator_name]
@@ -160,16 +173,13 @@ def _plans_on_cloud(cloud_name: str, res: resources_lib.Resources,
         per_alloc = off.hourly_cost(res.use_spot)
         if per_alloc is None:
             continue
-        # TPU rows price the whole slice (all hosts); VM rows price one VM.
-        multiplier = 1 if res.is_tpu else max(1, _task_nodes(res))
+        # TPU rows price the whole slice (all hosts); VM rows price one VM,
+        # so multi-node VM tasks pay per node.
+        multiplier = 1 if res.is_tpu else max(1, num_nodes)
         plans.append(LaunchablePlan(resources=concrete,
                                     hourly_cost=per_alloc * multiplier,
                                     estimated_runtime_s=runtime))
     return plans
-
-
-def _task_nodes(res: resources_lib.Resources) -> int:
-    return res.num_hosts
 
 
 def _best_plan(plans: List[LaunchablePlan],

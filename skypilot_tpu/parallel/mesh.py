@@ -1,0 +1,137 @@
+"""Device-mesh presets: the TPU-native replacement for the reference's
+"export SKYPILOT_NODE_* and let the user's NCCL launcher sort it out"
+(SURVEY.md §2.10).
+
+One canonical 6-axis mesh covers every parallelism the reference's recipes
+delegate to workload internals:
+
+  pp    pipeline stages          (reference: deepspeed-multinode recipes)
+  dp    pure data parallel       (reference: resnet_distributed_torch DDP)
+  cp    context/sequence parallel — ring attention (absent in reference)
+  fsdp  sharded data parallel    (reference: DeepSpeed ZeRO recipes)
+  ep    expert parallel          (reference: llm/mixtral via megablocks)
+  tp    tensor parallel          (reference: llm/vllm --tensor-parallel-size)
+
+Axis order is chosen so the *innermost* axes (tp, ep) land on adjacent ICI
+neighbors when JAX maps the mesh onto the slice torus, and the outermost
+(pp, dp) cross DCN in multi-slice deployments — collectives that need the
+most bandwidth ride the fastest links. Size-1 axes are free: every model in
+this framework is written against all six names.
+"""
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES: Tuple[str, ...] = ('pp', 'dp', 'cp', 'fsdp', 'ep', 'tp')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named parallelism layout. Multiply to the device count."""
+    pp: int = 1
+    dp: int = 1
+    cp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.pp, self.dp, self.cp, self.fsdp, self.ep, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return dict(zip(MESH_AXES, self.shape))
+
+    def __str__(self) -> str:
+        active = [f'{a}={s}' for a, s in self.axis_sizes().items() if s > 1]
+        return 'MeshSpec(' + (', '.join(active) or '1 device') + ')'
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The ambient mesh from an enclosing `with mesh:` block, or None.
+
+    Reads jax's thread-local resource env (the pjit-era mechanism that the
+    Mesh context manager populates; stable across jax releases for years).
+    """
+    from jax._src import mesh as jax_mesh_internal
+    m = jax_mesh_internal.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def build_mesh(spec: MeshSpec,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Create a jax.sharding.Mesh with the canonical axis names.
+
+    Devices are laid out in row-major order over the spec shape, so the
+    innermost axis (tp) strides over consecutive devices — on a TPU slice,
+    consecutive devices are ICI neighbors within a host before crossing
+    hosts, which is exactly where tp's all-reduces belong.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = spec.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f'{spec} needs {n} devices, only {len(devices)} available')
+    dev_array = np.array(devices[:n]).reshape(spec.shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def auto_spec(n_devices: int,
+              tp: Optional[int] = None,
+              fsdp: Optional[int] = None,
+              pp: int = 1,
+              cp: int = 1,
+              ep: int = 1,
+              model_params_b: Optional[float] = None,
+              hbm_gib_per_device: float = 16.0) -> MeshSpec:
+    """Pick a sensible layout for `n_devices`.
+
+    Heuristic (the scaling-book recipe): shard the model with fsdp until
+    params fit comfortably (~4 bytes/param train state with bf16 + f32 adam
+    moments), use tp only when a single layer's working set outgrows HBM or
+    the user asks, and give the rest to dp.
+    """
+    remaining = n_devices
+    for name, val in (('pp', pp), ('cp', cp), ('ep', ep)):
+        if remaining % val != 0:
+            raise ValueError(f'{name}={val} does not divide {remaining}')
+        remaining //= val
+    if tp is None:
+        tp = 1
+    if remaining % tp != 0:
+        raise ValueError(f'tp={tp} does not divide {remaining}')
+    remaining //= tp
+    if fsdp is None:
+        if model_params_b is None:
+            fsdp = remaining  # default: full parameter sharding (ZeRO-3-ish)
+        else:
+            # ~18 bytes/param full train state (bf16 params+grads, f32
+            # master + two adam moments); find the min fsdp that fits.
+            state_gib = model_params_b * 1e9 * 18.0 / (2**30)
+            fsdp = 1
+            while (state_gib / (fsdp * max(tp, 1)) >
+                   0.6 * hbm_gib_per_device and fsdp < remaining):
+                fsdp *= 2
+    if remaining % fsdp != 0:
+        raise ValueError(f'fsdp={fsdp} does not divide {remaining}')
+    dp = remaining // fsdp
+    return MeshSpec(pp=pp, dp=dp, cp=cp, fsdp=fsdp, ep=ep, tp=tp)
+
+
+def mesh_for_topology(topology, tp: Optional[int] = None,
+                      **kwargs) -> MeshSpec:
+    """Spec for a TPU slice: defaults tp to the chips-per-host (tp inside a
+    host rides the fastest ICI hop) and fsdp across hosts."""
+    n = topology.chips
+    if tp is None:
+        tp = min(topology.chips_per_host, n)
+    return auto_spec(n, tp=tp, **kwargs)

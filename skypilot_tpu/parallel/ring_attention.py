@@ -1,0 +1,107 @@
+"""Ring attention: context parallelism over the 'cp' mesh axis.
+
+The reference has NO sequence/context parallelism anywhere (SURVEY.md §5
+"Long-context: Absent") — this is designed fresh for the TPU torus:
+sequence-sharded Q stays resident; K/V chunks rotate around the ring of
+'cp'-axis neighbors via jax.lax.ppermute (ICI neighbor hops), with online
+softmax (flash-style m/l accumulators) merging each chunk's contribution.
+Peak memory per device is O(S/cp · S/cp) per chunk pair — long contexts
+scale with ring size. XLA overlaps each hop's ppermute with the previous
+chunk's attention math (the collective is issued before its result is
+needed).
+
+Causality: chunks are ordered by global offset; fully-future chunks
+contribute zero through the online-softmax merge (masked to -inf).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, q_offset, k_offset, scale):
+    """One K/V chunk's contribution, flash-style.
+
+    q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D].
+    Returns (numerator [B,Sq,Hq,D] f32, rowmax [B,Sq,Hq,1] f32,
+             rowsum [B,Sq,Hq,1] f32).
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    group = hq // hkv
+    qg = q.reshape(b, sq, hkv, group, d)
+    s = jnp.einsum('bqhgd,bkhd->bqhgk', qg, k,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(sq)
+    k_pos = k_offset + jnp.arange(sk)
+    mask = q_pos[:, None] >= k_pos[None, :]          # [Sq, Sk]
+    s = jnp.where(mask[None, :, None, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)           # [B,Sq,Hkv,G,1]
+    # Fully-masked rows: clamp m to 0 so p = exp(NEG_INF) = 0 (instead of
+    # exp(NEG_INF - NEG_INF) = 1).
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe)                          # [B,Sq,Hkv,G,Sk]
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    num = jnp.einsum('bqhgk,bkhd->bqhgd', p,
+                     v.astype(jnp.float32))
+    return (num.reshape(b, sq, hq, d),
+            m_safe.reshape(b, sq, hq, 1),
+            l.reshape(b, sq, hq, 1))
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = 'cp', causal: bool = True,
+                   softmax_scale: Optional[float] = None) -> jax.Array:
+    """Per-shard computation; must run inside shard_map with q/k/v
+    sequence-sharded over `axis_name`. For the jit/GSPMD entry point see
+    ring_attention_sharded()."""
+    assert causal, 'non-causal ring attention not yet wired'
+    b, sq, hq, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    cp = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    chunk = sq  # local chunk length; global seq = cp * chunk
+
+    acc0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    m0 = jnp.full((b, sq, hq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, sq, hq, 1), jnp.float32)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def body(carry, step):
+        k_c, v_c, acc, m_run, l_run = carry
+        # The chunk we hold at `step` originated at rank (my_idx - step).
+        src = jax.lax.rem(my_idx - step + cp, cp)
+        num, m_new, l_new = _chunk_attention(
+            q, k_c, v_c, my_idx * chunk, src * chunk, scale)
+        m_tot = jnp.maximum(m_run, m_new)
+        alpha_run = jnp.exp(m_run - m_tot)
+        alpha_new = jnp.exp(m_new - m_tot)
+        acc = acc * alpha_run + num * alpha_new
+        l_run = l_run * alpha_run + l_new * alpha_new
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_c, v_c, acc, m_tot, l_run), None
+
+    (_, _, acc, _, l_run), _ = jax.lax.scan(
+        body, (k, v, acc0, m0, l0), jnp.arange(cp))
+    l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = True,
+                           axis_name: str = 'cp'):
+    """jit/GSPMD entry: wraps ring_attention in shard_map over `mesh`.
+
+    q, k, v: [B, S, H, D]; S is split over `axis_name` (GSPMD inserts the
+    reshard if the inputs arrive with a different layout).
+    """
+    from jax.experimental.shard_map import shard_map
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)

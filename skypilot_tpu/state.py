@@ -38,7 +38,9 @@ class StorageStatus(enum.Enum):
     READY = 'READY'
 
 
-_DB_LOCK = threading.Lock()
+# RLock: helpers like _get_hash() call _get_db() while a public function
+# already holds the lock (remove_cluster deadlocked with a plain Lock).
+_DB_LOCK = threading.RLock()
 _DB: Optional[sqlite3.Connection] = None
 
 
@@ -112,7 +114,8 @@ def add_or_update_cluster(name: str, handle: Any,
                VALUES (?, ?, ?, ?, ?, ?, ?)
                ON CONFLICT(name) DO UPDATE SET
                  handle=excluded.handle, status=excluded.status,
-                 last_use=excluded.last_use""" +
+                 last_use=excluded.last_use,
+                 requested_resources=excluded.requested_resources""" +
             (', launched_at=excluded.launched_at' if is_launch else ''),
             (name, now, handle_blob, _history_cmd(), status.value,
              cluster_hash, req_blob))
@@ -141,7 +144,10 @@ def _record_history(db, name, cluster_hash, handle, requested_resources,
         'SELECT usage_intervals FROM cluster_history WHERE cluster_hash=?',
         (cluster_hash,)).fetchone()
     intervals = pickle.loads(row['usage_intervals']) if row else []
-    if launched_at is not None:
+    # Only open a new interval if the previous one is closed — a relaunch
+    # of a live cluster must not leave an un-closable open interval behind.
+    if launched_at is not None and not (intervals and
+                                        intervals[-1][1] is None):
         intervals.append((launched_at, None))
     db.execute(
         """INSERT INTO cluster_history

@@ -1,0 +1,169 @@
+"""Sharded training: init, train step, loss — the pjit path.
+
+This is the TPU-native replacement for what the reference's recipes do with
+torchtune/DeepSpeed launchers (SURVEY.md §2.10): one jitted train step whose
+in/out shardings come from the model's logical axis annotations, so the same
+code runs DP, FSDP, TP, CP, EP or any product of them by changing the mesh,
+with XLA inserting all collectives over ICI/DCN.
+"""
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    # Gradient accumulation (microbatches per step); 1 = off.
+    grad_accum: int = 1
+
+
+def make_optimizer(tcfg: TrainerConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=tcfg.learning_rate,
+        warmup_steps=tcfg.warmup_steps,
+        decay_steps=max(tcfg.total_steps, tcfg.warmup_steps + 1),
+        end_value=tcfg.learning_rate * 0.1)
+    tx = optax.chain(
+        optax.clip_by_global_norm(tcfg.grad_clip),
+        optax.adamw(schedule, b1=tcfg.b1, b2=tcfg.b2,
+                    weight_decay=tcfg.weight_decay),
+    )
+    if tcfg.grad_accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=tcfg.grad_accum)
+    return tx
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       mask: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Mean next-token CE in f32. targets -100 or mask==0 are ignored.
+
+    Returns (loss, n_tokens)."""
+    logits = logits.astype(jnp.float32)
+    if mask is None:
+        mask = (targets >= 0).astype(jnp.float32)
+    targets = jnp.maximum(targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_loss = -jnp.take_along_axis(logp, targets[..., None],
+                                      axis=-1)[..., 0]
+    n = jnp.maximum(mask.sum(), 1.0)
+    return (token_loss * mask).sum() / n, n
+
+
+@flax.struct.dataclass
+class TrainStateS:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+    def apply_gradients(self, grads, tx):
+        updates, new_opt = tx.update(grads, self.opt_state, self.params)
+        return TrainStateS(step=self.step + 1,
+                           params=optax.apply_updates(self.params, updates),
+                           opt_state=new_opt)
+
+
+def logical_state_shardings(model: nn.Module, tx, mesh: Mesh,
+                            sample_batch: jax.Array,
+                            rules=sharding_lib.DEFAULT_RULES):
+    """Shardings for the full TrainStateS, derived from the model's logical
+    annotations (flax nn.get_partition_spec over an eval_shape init)."""
+    def _init(rng):
+        variables = model.init(rng, sample_batch)
+        params = variables['params']
+        return TrainStateS(step=jnp.zeros((), jnp.int32), params=params,
+                           opt_state=tx.init(params))
+
+    abs_state = jax.eval_shape(_init, jax.random.PRNGKey(0))
+    logical = nn.get_partition_spec(abs_state)
+    return nn.logical_to_mesh_sharding(logical, mesh, list(rules)), _init
+
+
+def create_sharded_state(model: nn.Module, tx, mesh: Mesh,
+                         sample_batch: jax.Array, rng: jax.Array,
+                         rules=sharding_lib.DEFAULT_RULES) -> Tuple[
+                             'TrainStateS', Any]:
+    """Initialize the train state directly into its sharded layout (no
+    host-side full materialization — required at 70B scale)."""
+    shardings, _init = logical_state_shardings(model, tx, mesh, sample_batch,
+                                               rules)
+    with mesh, nn.logical_axis_rules(list(rules)):
+        state = jax.jit(_init, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_train_step(model: nn.Module, tx, mesh: Mesh,
+                    rules=sharding_lib.DEFAULT_RULES,
+                    donate: bool = True) -> Callable:
+    """Returns jitted (state, batch) -> (state, metrics).
+
+    batch: {'tokens': [B,S], 'targets': [B,S], optional 'segment_ids'}.
+    """
+    batch_axes = ('act_batch', 'act_seq')
+
+    def step_fn(state: TrainStateS, batch):
+        # Constrain batch leaves onto the data axes (works for any subset
+        # of {tokens, targets, segment_ids} without pytree-matching games).
+        batch = {k: sharding_lib.constrain(v, mesh, batch_axes, rules)
+                 for k, v in batch.items()}
+
+        def loss_fn(params):
+            logits, mutated = model.apply(
+                {'params': params}, batch['tokens'],
+                segment_ids=batch.get('segment_ids'),
+                mutable=['intermediates'])
+            loss, n_tok = cross_entropy_loss(logits, batch['targets'])
+            # Aux losses sown by the model (MoE load-balance/z-loss).
+            for aux in jax.tree.leaves(
+                    mutated.get('intermediates', {}).get(
+                        'moe_aux_loss', ())):
+                loss = loss + aux
+            return loss, n_tok
+
+        (loss, n_tok), grads = jax.value_and_grad(loss_fn,
+                                                  has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads, tx)
+        gnorm = optax.global_norm(grads)
+        metrics = {'loss': loss, 'tokens': n_tok, 'grad_norm': gnorm}
+        return new_state, metrics
+
+    _jitted = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    def wrapped(state, batch):
+        # The state keeps the sharded layout it was created with; jit
+        # propagates it. Logical rules must be ambient for the constraints.
+        with mesh, nn.logical_axis_rules(list(rules)):
+            return _jitted(state, batch)
+
+    return wrapped
+
+
+def make_eval_step(model: nn.Module, mesh: Mesh,
+                   rules=sharding_lib.DEFAULT_RULES) -> Callable:
+    def eval_fn(params, batch):
+        logits = model.apply({'params': params}, batch['tokens'])
+        loss, n = cross_entropy_loss(logits, batch['targets'])
+        return {'loss': loss, 'tokens': n}
+
+    jitted = jax.jit(eval_fn)
+
+    def wrapped(params, batch):
+        with mesh, nn.logical_axis_rules(list(rules)):
+            return jitted(params, batch)
+    return wrapped

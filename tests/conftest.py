@@ -7,11 +7,18 @@ SURVEY.md §4 implication). Must run before jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force-set (not setdefault): the base image pins JAX_PLATFORMS=axon (the
+# tunneled TPU) and its sitecustomize additionally pins the jax config, so
+# both the env var and jax.config must be overridden before first use.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (
         _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 # Make the repo root importable when pytest is run from anywhere.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -148,3 +148,30 @@ class TestReviewRegressions:
         from skypilot_tpu import catalog, exceptions
         with _pytest.raises(exceptions.InvalidResourcesError):
             catalog.validate_region_zone('gcp', 'us-west4', 'us-central1-a')
+
+    def test_multinode_vm_cost_scales(self, enable_clouds):
+        from skypilot_tpu.optimizer import Optimizer
+        from skypilot_tpu import Dag, Resources, Task
+        with Dag() as d1:
+            t1 = Task('one', run='x', num_nodes=1)
+            t1.set_resources(Resources(instance_type='n2-standard-8',
+                                       cloud='gcp'))
+        with Dag() as d4:
+            t4 = Task('four', run='x', num_nodes=4)
+            t4.set_resources(Resources(instance_type='n2-standard-8',
+                                       cloud='gcp'))
+        p1 = Optimizer.plan_for_task(t1)[0]
+        p4 = Optimizer.plan_for_task(t4)[0]
+        assert p4.hourly_cost == pytest.approx(4 * p1.hourly_cost)
+
+    def test_disabled_cloud_hint(self, enable_clouds):
+        from skypilot_tpu import state
+        from skypilot_tpu.optimizer import Optimizer
+        from skypilot_tpu import Dag, Resources, Task
+        state.set_enabled_clouds(['local'])
+        with Dag() as dag:
+            t = Task('t', run='x')
+            t.set_resources(Resources(accelerators='tpu-v5e-16'))
+        with pytest.raises(exceptions.ResourcesUnavailableError,
+                           match='not enabled'):
+            Optimizer.optimize(dag, quiet=True)

@@ -1,0 +1,61 @@
+"""State DB tests (mirrors reference tests/test_global_user_state.py)."""
+import pickle
+
+from skypilot_tpu import state
+
+
+class FakeHandle:
+    def __init__(self, name):
+        self.cluster_name = name
+        self.num_hosts = 4
+        self.launched_resources = None
+
+
+class TestClusterState:
+    def test_add_get_remove(self, tmp_state_dir):
+        state.add_or_update_cluster('c1', FakeHandle('c1'),
+                                    status=state.ClusterStatus.UP)
+        rec = state.get_cluster('c1')
+        assert rec['status'] == state.ClusterStatus.UP
+        assert rec['handle'].cluster_name == 'c1'
+        state.remove_cluster('c1')   # regression: deadlocked with Lock
+        assert state.get_cluster('c1') is None
+
+    def test_relaunch_updates_resources_and_intervals(self, tmp_state_dir):
+        state.add_or_update_cluster('c1', FakeHandle('c1'),
+                                    requested_resources='r1')
+        state.add_or_update_cluster('c1', FakeHandle('c1'),
+                                    requested_resources='r2')
+        rec = state.get_cluster('c1')
+        assert rec['requested_resources'] == 'r2'
+        state.remove_cluster('c1')
+        hist = state.get_cluster_history()
+        (entry,) = [h for h in hist if h['name'] == 'c1']
+        # exactly one closed interval despite the double launch
+        assert len(entry['usage_intervals']) == 1
+        assert entry['usage_intervals'][0][1] is not None
+
+    def test_status_update(self, tmp_state_dir):
+        state.add_or_update_cluster('c2', FakeHandle('c2'))
+        state.update_cluster_status('c2', state.ClusterStatus.STOPPED)
+        assert state.get_cluster('c2')['status'] == \
+            state.ClusterStatus.STOPPED
+
+    def test_autostop(self, tmp_state_dir):
+        state.add_or_update_cluster('c3', FakeHandle('c3'))
+        state.set_cluster_autostop('c3', 30, to_down=True)
+        rec = state.get_cluster('c3')
+        assert rec['autostop'] == 30 and rec['to_down']
+
+    def test_storage(self, tmp_state_dir):
+        state.add_or_update_storage('b1', {'bucket': 'b1'},
+                                    state.StorageStatus.READY)
+        assert state.get_storage('b1')['status'] == \
+            state.StorageStatus.READY
+        state.remove_storage('b1')
+        assert state.get_storage('b1') is None
+
+    def test_config_kv(self, tmp_state_dir):
+        state.set_config('k', {'a': 1})
+        assert state.get_config('k') == {'a': 1}
+        assert state.get_config('missing', 42) == 42
