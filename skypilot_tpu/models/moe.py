@@ -58,8 +58,14 @@ class MoeMLP(nn.Module):
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(-1, keepdims=True), 1e-9)
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [B,S,k,E]
-        # position of each token in its expert's buffer
-        pos_in_expert = (jnp.cumsum(onehot, axis=1) - onehot)  # [B,S,k,E]
+        # Position of each token in its expert's buffer, slot-major (GShard):
+        # all slot-j assignments are placed after every slot-<j assignment to
+        # the same expert, so a token picking expert X as 1st choice and a
+        # token picking X as 2nd choice never collide in one capacity slot.
+        pos_in_slot = jnp.cumsum(onehot, axis=1) - onehot      # [B,S,k,E]
+        slot_counts = jnp.sum(onehot, axis=1)                  # [B,k,E]
+        slot_offset = jnp.cumsum(slot_counts, axis=1) - slot_counts
+        pos_in_expert = pos_in_slot + slot_offset[:, None]     # [B,S,k,E]
         pos = jnp.einsum('bske,bske->bsk', pos_in_expert, onehot)
         keep = pos < capacity
         gate_vals = gate_vals * keep.astype(gate_vals.dtype)

@@ -87,7 +87,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     segment_ids: Optional[jax.Array] = None,
@@ -96,10 +95,18 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D] -> [B, Sq, Hq, D].
 
     segment_ids is not yet supported by the kernel (falls back to XLA).
+    The dispatch happens OUTSIDE the custom_vjp: segment_ids is a traced
+    array and must never appear in nondiff_argnums.
     """
     if segment_ids is not None:
         return attention_ops.mha_reference(q, k, v, causal=causal,
                                            segment_ids=segment_ids)
+    return _flash(q, k, v, causal, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+           block_q: int, block_k: int) -> jax.Array:
     return _flash_fwd_impl(q, k, v, causal, block_q, block_k)
 
 
@@ -151,19 +158,19 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
     return out.transpose(0, 2, 1, 3)
 
 
-def _fwd_rule(q, k, v, causal, segment_ids, block_q, block_k):
-    out = flash_attention(q, k, v, causal, segment_ids, block_q, block_k)
+def _fwd_rule(q, k, v, causal, block_q, block_k):
+    out = _flash(q, k, v, causal, block_q, block_k)
     return out, (q, k, v)
 
 
-def _bwd_rule(causal, segment_ids, block_q, block_k, res, g):
+def _bwd_rule(causal, block_q, block_k, res, g):
     q, k, v = res
     # Backward via XLA recompute of the reference attention. O(S^2) memory
     # per block is bounded by the remat granularity of the caller.
     _, vjp = jax.vjp(
         lambda q_, k_, v_: attention_ops.mha_reference(
-            q_, k_, v_, causal=causal, segment_ids=segment_ids), q, k, v)
+            q_, k_, v_, causal=causal), q, k, v)
     return vjp(g)
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+_flash.defvjp(_fwd_rule, _bwd_rule)

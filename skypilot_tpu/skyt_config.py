@@ -1,0 +1,77 @@
+"""User config: ~/.skypilot_tpu/config.yaml with nested-key access.
+
+Mirrors the reference's sky/skypilot_config.py (get_nested :102, set_nested
+:155, _try_load_config :178): a small YAML file of overrides — controller
+resources, GCP project/service-account, proxies, per-cloud defaults —
+loaded once per process, snapshotted & shipped to controller VMs so the
+controller sees the same config the client did.
+"""
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import yaml
+
+CONFIG_PATH = '~/.skypilot_tpu/config.yaml'
+ENV_VAR_CONFIG_PATH = 'SKYT_CONFIG'
+
+_config: Optional[Dict[str, Any]] = None
+_config_path_loaded: Optional[str] = None
+_lock = threading.Lock()
+
+
+def _config_path() -> str:
+    return os.path.expanduser(
+        os.environ.get(ENV_VAR_CONFIG_PATH, CONFIG_PATH))
+
+
+def _try_load_config() -> Dict[str, Any]:
+    global _config, _config_path_loaded
+    path = _config_path()
+    with _lock:
+        if _config is not None and _config_path_loaded == path:
+            return _config
+        _config = {}
+        _config_path_loaded = path
+        if os.path.exists(path):
+            with open(path, 'r', encoding='utf-8') as f:
+                loaded = yaml.safe_load(f)
+            if isinstance(loaded, dict):
+                _config = loaded
+        return _config
+
+
+def reload_for_testing() -> None:
+    global _config
+    with _lock:
+        _config = None
+
+
+def loaded() -> bool:
+    return bool(_try_load_config())
+
+
+def get_nested(keys: Iterable[str], default_value: Any = None) -> Any:
+    """config.get_nested(('gcp', 'project_id')) → value or default."""
+    cur: Any = _try_load_config()
+    for key in keys:
+        if not isinstance(cur, dict) or key not in cur:
+            return default_value
+        cur = cur[key]
+    return cur
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:
+    """Return a copy of the config dict with keys set (does NOT persist —
+    reference semantics: used to prepare controller config snapshots)."""
+    cfg = copy.deepcopy(_try_load_config())
+    cur = cfg
+    for key in keys[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[keys[-1]] = value
+    return cfg
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_try_load_config())

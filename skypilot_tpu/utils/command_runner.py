@@ -1,0 +1,304 @@
+"""Command runners: the control/data plane to cluster hosts.
+
+Mirrors the reference's sky/utils/command_runner.py (CommandRunner :153,
+SSHCommandRunner :392 with ControlMaster/ProxyCommand, rsync :215-301) with
+one addition the reference lacks: a LocalProcessRunner that executes against
+a per-host home directory on the local machine — the transport for the
+`local` pseudo-cloud that makes the full multi-host path testable offline
+(SURVEY.md §4 implication).
+"""
+import dataclasses
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# Exit code ssh itself returns on connection failure (distinct from the
+# remote command's own exit codes). Reference: command_runner.py:255.
+SSH_CONNECTION_ERROR_CODE = 255
+
+_DEFAULT_SSH_OPTIONS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'ServerAliveInterval=5',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'LogLevel=ERROR',
+]
+
+
+def _shell_wrap(cmd: str, env: Optional[Dict[str, str]] = None,
+                cwd: Optional[str] = None) -> str:
+    """Wrap a command for `bash -c` execution with env exports."""
+    parts = []
+    for key, val in (env or {}).items():
+        parts.append(f'export {key}={shlex.quote(str(val))}')
+    if cwd:
+        parts.append(f'cd {shlex.quote(cwd)}')
+    parts.append(cmd)
+    return ' && '.join(parts) if len(parts) > 1 else cmd
+
+
+class CommandRunner:
+    """Abstract runner bound to one host."""
+
+    def __init__(self, node_id: str) -> None:
+        self.node_id = node_id
+
+    def run(self,
+            cmd: str,
+            *,
+            env: Optional[Dict[str, str]] = None,
+            cwd: Optional[str] = None,
+            stream_logs: bool = False,
+            log_path: Optional[str] = None,
+            require_outputs: bool = False,
+            timeout: Optional[float] = None
+            ) -> Union[int, Tuple[int, str, str]]:
+        """Run `cmd` via bash on the host.
+
+        Returns exit code, or (code, stdout, stderr) if require_outputs.
+        """
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        """Sync a file/dir. up=True: local source → host target."""
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        try:
+            return self.run('true', timeout=15) == 0
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    def run_or_raise(self, cmd: str, failure_message: str, **kwargs) -> str:
+        kwargs['require_outputs'] = True
+        code, stdout, stderr = self.run(cmd, **kwargs)
+        if code != 0:
+            raise exceptions.CommandError(code, cmd, failure_message,
+                                          detailed_reason=stderr[-2048:])
+        return stdout
+
+
+def _execute_local(full_cmd: List[str], *, stream_logs: bool,
+                   log_path: Optional[str], require_outputs: bool,
+                   timeout: Optional[float]
+                   ) -> Union[int, Tuple[int, str, str]]:
+    """Shared popen plumbing for both runners (the subprocess side of the
+    reference's command_runner run(): tee to log file, optional capture).
+
+    Both pipes are drained by dedicated threads — draining stdout to EOF
+    before touching stderr deadlocks once the child fills the 64KiB stderr
+    pipe buffer.
+    """
+    import io
+    import threading
+
+    stdout_chunks: List[str] = []
+    stderr_chunks: List[str] = []
+    log_file = open(log_path, 'a', encoding='utf-8') if log_path else None
+    log_lock = threading.Lock()
+
+    def _drain(pipe: io.TextIOBase, chunks: List[str],
+               to_console) -> None:
+        for line in pipe:
+            chunks.append(line)
+            if log_file:
+                with log_lock:
+                    log_file.write(line)
+                    log_file.flush()
+            if stream_logs:
+                print(line, end='', flush=True, file=to_console)
+
+    try:
+        proc = subprocess.Popen(full_cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        import sys
+        threads = [
+            threading.Thread(target=_drain,
+                             args=(proc.stdout, stdout_chunks, sys.stdout),
+                             daemon=True),
+            threading.Thread(target=_drain,
+                             args=(proc.stderr, stderr_chunks, sys.stderr),
+                             daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise exceptions.CommandError(
+                124, ' '.join(full_cmd[:6]) + ' …', 'command timed out')
+        for t in threads:
+            t.join(timeout=10)
+        code = proc.returncode
+    finally:
+        if log_file:
+            log_file.close()
+    if require_outputs:
+        return code, ''.join(stdout_chunks), ''.join(stderr_chunks)
+    return code
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH/rsync to a real host (reference: command_runner.py:392)."""
+
+    def __init__(self,
+                 ip: str,
+                 ssh_user: str,
+                 ssh_private_key: str,
+                 port: int = 22,
+                 ssh_proxy_command: Optional[str] = None,
+                 ssh_control_name: Optional[str] = None) -> None:
+        super().__init__(f'{ssh_user}@{ip}:{port}')
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_private_key = os.path.expanduser(ssh_private_key)
+        self.port = port
+        self.ssh_proxy_command = ssh_proxy_command
+        self._control_path = None
+        if ssh_control_name is not None:
+            # ControlMaster multiplexing: reuse one TCP/auth handshake across
+            # the many short commands provisioning issues (reference
+            # command_runner.py ssh_control_name).
+            d = os.path.join(tempfile.gettempdir(), 'skyt_ssh_control')
+            os.makedirs(d, exist_ok=True)
+            self._control_path = os.path.join(d, ssh_control_name)
+
+    def _ssh_base(self) -> List[str]:
+        args = ['ssh'] + _DEFAULT_SSH_OPTIONS + [
+            '-i', self.ssh_private_key, '-p', str(self.port)]
+        if self._control_path is not None:
+            args += ['-o', 'ControlMaster=auto',
+                     '-o', f'ControlPath={self._control_path}-%C',
+                     '-o', 'ControlPersist=120s']
+        if self.ssh_proxy_command:
+            args += ['-o', f'ProxyCommand={self.ssh_proxy_command}']
+        return args
+
+    def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
+            log_path=None, require_outputs=False, timeout=None):
+        wrapped = _shell_wrap(cmd, env, cwd)
+        full = self._ssh_base() + [f'{self.ssh_user}@{self.ip}',
+                                   f'bash --login -c {shlex.quote(wrapped)}']
+        return _execute_local(full, stream_logs=stream_logs,
+                              log_path=log_path,
+                              require_outputs=require_outputs,
+                              timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        ssh_cmd = ' '.join(
+            shlex.quote(a) for a in self._ssh_base())
+        args = ['rsync', '-Pavz', '--timeout=60', '-e', ssh_cmd]
+        for pat in excludes or []:
+            args += ['--exclude', pat]
+        remote = f'{self.ssh_user}@{self.ip}:{target if up else source}'
+        if up:
+            args += [source, remote]
+        else:
+            args += [remote, target]
+        code = _execute_local(args, stream_logs=False, log_path=None,
+                              require_outputs=False, timeout=None)
+        if code != 0:
+            raise exceptions.CommandError(
+                code, f'rsync {"up" if up else "down"} {source}',
+                f'rsync to {self.node_id} failed')
+
+
+class LocalProcessRunner(CommandRunner):
+    """Executes against a per-host home dir on this machine.
+
+    Each `local` cloud host is a directory; HOME and SKYT_AGENT_HOME are
+    remapped so agents/jobs of different "hosts" never collide. This is the
+    fake multi-host harness the reference lacks (SURVEY.md §4).
+    """
+
+    def __init__(self, host_dir: str, rank: int = 0) -> None:
+        super().__init__(f'local:{host_dir}')
+        self.host_dir = os.path.abspath(os.path.expanduser(host_dir))
+        self.rank = rank
+        self.ip = '127.0.0.1'
+
+    def run(self, cmd, *, env=None, cwd=None, stream_logs=False,
+            log_path=None, require_outputs=False, timeout=None):
+        os.makedirs(self.host_dir, exist_ok=True)
+        merged_env = {
+            'HOME': self.host_dir,
+            'SKYT_AGENT_HOME': self.host_dir,
+            'PATH': os.environ.get('PATH', ''),
+        }
+        merged_env.update(env or {})
+        wrapped = _shell_wrap(cmd, merged_env, cwd or self.host_dir)
+        full = ['bash', '-c', wrapped]
+        return _execute_local(full, stream_logs=stream_logs,
+                              log_path=log_path,
+                              require_outputs=require_outputs,
+                              timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        # Pure-Python sync with rsync trailing-slash semantics ('src/' copies
+        # contents, 'src' copies the directory itself) — build/test images
+        # may lack the rsync binary; real SSH hosts use SSHCommandRunner.
+        if up:
+            dst = target
+            if not os.path.isabs(dst):
+                dst = os.path.join(self.host_dir, dst)
+            src = source
+        else:
+            src = source
+            if not os.path.isabs(src):
+                src = os.path.join(self.host_dir, src)
+            dst = target
+        src = os.path.expanduser(src)
+        dst = os.path.expanduser(dst)
+        _python_sync(src, dst, excludes or [])
+
+
+def _python_sync(src: str, dst: str, excludes: List[str]) -> None:
+    import fnmatch
+    import shutil
+
+    def ignore(_dir: str, names: List[str]) -> List[str]:
+        out = []
+        for name in names:
+            if any(fnmatch.fnmatch(name, pat) for pat in excludes):
+                out.append(name)
+        return out
+
+    if os.path.isdir(src.rstrip('/')):
+        contents_only = src.endswith('/')
+        src = src.rstrip('/')
+        if not contents_only:
+            dst = os.path.join(dst, os.path.basename(src))
+        os.makedirs(dst, exist_ok=True)
+        shutil.copytree(src, dst, ignore=ignore, dirs_exist_ok=True,
+                        symlinks=True)
+    elif os.path.exists(src):
+        if dst.endswith('/') or os.path.isdir(dst):
+            os.makedirs(dst, exist_ok=True)
+            dst = os.path.join(dst, os.path.basename(src))
+        else:
+            os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+        shutil.copy2(src, dst)
+    else:
+        raise exceptions.CommandError(1, f'sync {src} {dst}',
+                                      f'source {src} does not exist')
+
+
+@dataclasses.dataclass
+class SSHCredentials:
+    """Bundle of what's needed to construct SSHCommandRunners."""
+    ssh_user: str
+    ssh_private_key: str
+    ssh_proxy_command: Optional[str] = None

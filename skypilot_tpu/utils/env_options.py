@@ -1,0 +1,25 @@
+"""Typed environment flags (reference analog: sky/utils/env_options.py)."""
+import enum
+import os
+
+
+class Options(enum.Enum):
+    """Each member is (env var name, default)."""
+    IS_DEBUG = ('SKYT_DEBUG', False)
+    DISABLE_USAGE_COLLECTION = ('SKYT_DISABLE_USAGE_COLLECTION', True)
+    MINIMIZE_LOGGING = ('SKYT_MINIMIZE_LOGGING', False)
+    SHOW_DEBUG_INFO = ('SKYT_SHOW_DEBUG_INFO', False)
+
+    def __init__(self, env_var: str, default: bool) -> None:
+        self.env_var = env_var
+        self.default = default
+
+    def get(self) -> bool:
+        val = os.environ.get(self.env_var)
+        if val is None:
+            return self.default
+        return val.lower() not in ('0', 'false', 'no', '')
+
+    @property
+    def env_key(self) -> str:
+        return self.env_var

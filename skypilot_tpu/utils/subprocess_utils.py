@@ -1,0 +1,112 @@
+"""Subprocess helpers (reference analog: sky/utils/subprocess_utils.py).
+
+Parallel fan-out, process-tree kill (used by the agent to cancel jobs and by
+the orphan-killer daemon), and streamed command execution.
+"""
+import os
+import signal
+import subprocess
+import time
+from concurrent import futures
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import psutil
+
+from skypilot_tpu import exceptions
+
+
+def run_in_parallel(fn: Callable, args: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map fn over args with a thread pool, preserving order.
+
+    Reference: sky/utils/subprocess_utils.py run_in_parallel (it uses daemon
+    multiprocessing; threads suffice here because our workers are
+    ssh/subprocess-bound, not CPU-bound).
+    """
+    if not args:
+        return []
+    num_threads = num_threads or min(len(args), 32)
+    with futures.ThreadPoolExecutor(max_workers=num_threads) as pool:
+        return list(pool.map(fn, args))
+
+
+def kill_process_tree(pid: int, include_parent: bool = True,
+                      sig: int = signal.SIGTERM,
+                      timeout: float = 5.0) -> None:
+    """SIGTERM (then SIGKILL after timeout) a process and its descendants.
+
+    Reference: sky/utils/subprocess_utils.py kill_children_processes and
+    sky/skylet/subprocess_daemon.py.
+    """
+    try:
+        parent = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = parent.children(recursive=True)
+    if include_parent:
+        procs.append(parent)
+    for p in procs:
+        try:
+            p.send_signal(sig)
+        except psutil.NoSuchProcess:
+            pass
+    _, alive = psutil.wait_procs(procs, timeout=timeout)
+    for p in alive:
+        try:
+            p.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def run(cmd: str, **kwargs) -> subprocess.CompletedProcess:
+    """Run a shell command, raising CommandError on failure."""
+    proc = subprocess.run(cmd, shell=True, capture_output=True, text=True,
+                          **kwargs)
+    if proc.returncode != 0:
+        raise exceptions.CommandError(
+            proc.returncode, cmd, error_msg=proc.stdout[-2048:],
+            detailed_reason=proc.stderr[-2048:])
+    return proc
+
+
+def run_no_outputs(cmd: str, **kwargs) -> int:
+    """Run, discarding outputs; returns the exit code."""
+    return subprocess.run(cmd, shell=True, stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL, **kwargs).returncode
+
+
+def run_with_retries(cmd: str, max_retries: int = 3,
+                     retry_wait_s: float = 1.0,
+                     retryable_returncodes: Optional[Sequence[int]] = None
+                     ) -> Tuple[int, str, str]:
+    """Run with bounded retries (reference: command_runner retries ssh port
+    races similarly). Returns (returncode, stdout, stderr)."""
+    assert max_retries >= 0
+    for attempt in range(max_retries + 1):
+        proc = subprocess.run(cmd, shell=True, capture_output=True, text=True)
+        if proc.returncode == 0:
+            return proc.returncode, proc.stdout, proc.stderr
+        if (retryable_returncodes is not None and
+                proc.returncode not in retryable_returncodes):
+            break
+        if attempt < max_retries:
+            time.sleep(retry_wait_s)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def daemonize() -> None:
+    """Double-fork daemonization for host-side daemons (agent, controllers).
+
+    The skylet analog must survive the provisioning SSH session exiting.
+    """
+    if os.fork() > 0:
+        os._exit(0)
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)
+    devnull = os.open(os.devnull, os.O_RDWR)
+    os.dup2(devnull, 0)
+    # stdout/stderr too: a daemon writing to the dead SSH session's pty
+    # would die on SIGPIPE/EIO. Daemons log to files instead.
+    os.dup2(devnull, 1)
+    os.dup2(devnull, 2)

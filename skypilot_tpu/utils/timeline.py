@@ -1,0 +1,135 @@
+"""Chrome-trace-format client operation tracing.
+
+Mirrors the reference's sky/utils/timeline.py (Event :21-60, @timeline.event
+decorator :80+, FileLockEvent) — events are written when SKYT_DEBUG is set
+and viewable in chrome://tracing / perfetto.
+"""
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import filelock
+
+from skypilot_tpu.utils import env_options
+
+_events: List[Dict[str, Any]] = []
+_events_lock = threading.Lock()
+_enabled: Optional[bool] = None
+
+
+def _is_enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = env_options.Options.IS_DEBUG.get()
+    return _enabled
+
+
+class Event:
+    """A (B)egin/(E)nd trace event pair; usable as a context manager."""
+
+    def __init__(self, name: str, message: Optional[str] = None) -> None:
+        self._name = name
+        self._message = message
+
+    def begin(self) -> None:
+        if not _is_enabled():
+            return
+        event = {
+            'name': self._name,
+            'cat': 'skyt',
+            'ph': 'B',
+            'ts': f'{time.time() * 1e6:.3f}',
+            'pid': str(os.getpid()),
+            'tid': str(threading.current_thread().ident),
+        }
+        if self._message is not None:
+            event['args'] = {'message': self._message}
+        with _events_lock:
+            _events.append(event)
+
+    def end(self) -> None:
+        if not _is_enabled():
+            return
+        with _events_lock:
+            _events.append({
+                'name': self._name,
+                'cat': 'skyt',
+                'ph': 'E',
+                'ts': f'{time.time() * 1e6:.3f}',
+                'pid': str(os.getpid()),
+                'tid': str(threading.current_thread().ident),
+            })
+
+    def __enter__(self) -> 'Event':
+        self.begin()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.end()
+
+
+def event(name_or_fn=None, message: Optional[str] = None):
+    """Decorator tracing a function call (reference: timeline.py:80)."""
+
+    def decorator(fn: Callable) -> Callable:
+        name = name_or_fn if isinstance(name_or_fn, str) else \
+            f'{fn.__module__}.{fn.__qualname__}'
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with Event(name, message):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    if callable(name_or_fn):
+        return decorator(name_or_fn)
+    return decorator
+
+
+class FileLockEvent:
+    """A filelock whose wait time shows up on the timeline (reference:
+    timeline.py FileLockEvent — lock contention is a known client slow path).
+    """
+
+    def __init__(self, lockfile: str, timeout: float = -1) -> None:
+        self._lockfile = lockfile
+        os.makedirs(os.path.dirname(os.path.abspath(lockfile)), exist_ok=True)
+        self._lock = filelock.FileLock(lockfile, timeout=timeout)
+        self._hold_event = Event(f'[FileLock.hold]:{lockfile}')
+
+    def acquire(self):
+        with Event(f'[FileLock.acquire]:{self._lockfile}'):
+            self._lock.acquire()
+        self._hold_event.begin()
+
+    def release(self):
+        self._hold_event.end()
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *args):
+        self.release()
+
+
+def save_timeline() -> None:
+    if not _is_enabled() or not _events:
+        return
+    path = os.environ.get(
+        'SKYT_TIMELINE_FILE',
+        os.path.expanduser(f'~/.skypilot_tpu/timeline-{os.getpid()}.json'))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with _events_lock:
+        payload = {'traceEvents': list(_events)}
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+
+
+atexit.register(save_timeline)

@@ -1,0 +1,121 @@
+"""Tests for cross-cutting utils: command runners, config, subprocess."""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import skyt_config
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import subprocess_utils
+
+
+class TestLocalProcessRunner:
+
+    def test_run_basic(self, tmp_path):
+        r = command_runner.LocalProcessRunner(str(tmp_path / 'host0'))
+        assert r.run('true') == 0
+        assert r.run('false') == 1
+
+    def test_home_remap(self, tmp_path):
+        host = tmp_path / 'host0'
+        r = command_runner.LocalProcessRunner(str(host))
+        code, out, _ = r.run('echo $HOME', require_outputs=True)
+        assert code == 0
+        assert out.strip() == str(host)
+
+    def test_env_and_cwd(self, tmp_path):
+        host = tmp_path / 'h'
+        sub = host / 'subdir'
+        sub.mkdir(parents=True)
+        r = command_runner.LocalProcessRunner(str(host))
+        code, out, _ = r.run('echo $FOO-$(pwd)', env={'FOO': 'bar'},
+                             cwd=str(sub), require_outputs=True)
+        assert out.strip() == f'bar-{sub}'
+
+    def test_log_path(self, tmp_path):
+        r = command_runner.LocalProcessRunner(str(tmp_path / 'h'))
+        log = tmp_path / 'out.log'
+        assert r.run('echo hello', log_path=str(log)) == 0
+        assert 'hello' in log.read_text()
+
+    def test_rsync_up_down(self, tmp_path):
+        src = tmp_path / 'src'
+        src.mkdir()
+        (src / 'a.txt').write_text('data')
+        host = tmp_path / 'h'
+        r = command_runner.LocalProcessRunner(str(host))
+        r.rsync(str(src) + '/', str(host / 'dst'), up=True)
+        assert (host / 'dst' / 'a.txt').read_text() == 'data'
+        r.rsync(str(host / 'dst') + '/', str(tmp_path / 'back'), up=False)
+        assert (tmp_path / 'back' / 'a.txt').read_text() == 'data'
+
+    def test_run_or_raise(self, tmp_path):
+        r = command_runner.LocalProcessRunner(str(tmp_path / 'h'))
+        assert r.run_or_raise('echo ok', 'should not fail').strip() == 'ok'
+        with pytest.raises(exceptions.CommandError):
+            r.run_or_raise('exit 3', 'expected failure')
+
+
+class TestSSHCommandBuild:
+
+    def test_ssh_base_options(self, tmp_path):
+        key = tmp_path / 'key'
+        key.write_text('')
+        r = command_runner.SSHCommandRunner('10.0.0.1', 'ubuntu', str(key),
+                                            ssh_control_name='abc')
+        base = r._ssh_base()
+        assert 'ssh' == base[0]
+        assert '-i' in base and str(key) in base
+        joined = ' '.join(base)
+        assert 'StrictHostKeyChecking=no' in joined
+        assert 'ControlMaster=auto' in joined
+
+    def test_proxy_command(self, tmp_path):
+        key = tmp_path / 'key'
+        key.write_text('')
+        r = command_runner.SSHCommandRunner(
+            '10.0.0.1', 'ubuntu', str(key),
+            ssh_proxy_command='corkscrew proxy 8080 %h %p')
+        assert any('ProxyCommand=corkscrew' in a for a in r._ssh_base())
+
+
+class TestConfig:
+
+    def test_missing_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYT_CONFIG', str(tmp_path / 'nope.yaml'))
+        skyt_config.reload_for_testing()
+        assert not skyt_config.loaded()
+        assert skyt_config.get_nested(('gcp', 'project_id'), 'dflt') == 'dflt'
+
+    def test_nested_get_set(self, tmp_path, monkeypatch):
+        cfg = tmp_path / 'config.yaml'
+        cfg.write_text('gcp:\n  project_id: proj-1\n  zone: us-central2-b\n')
+        monkeypatch.setenv('SKYT_CONFIG', str(cfg))
+        skyt_config.reload_for_testing()
+        assert skyt_config.loaded()
+        assert skyt_config.get_nested(('gcp', 'project_id')) == 'proj-1'
+        assert skyt_config.get_nested(('gcp', 'missing'), 42) == 42
+        updated = skyt_config.set_nested(('jobs', 'controller', 'cpus'), 8)
+        assert updated['jobs']['controller']['cpus'] == 8
+        # set_nested must not mutate the loaded config.
+        assert skyt_config.get_nested(('jobs',)) is None
+
+
+class TestSubprocessUtils:
+
+    def test_run_in_parallel(self):
+        out = subprocess_utils.run_in_parallel(lambda x: x * 2, [1, 2, 3])
+        assert out == [2, 4, 6]
+
+    def test_run_raises(self):
+        with pytest.raises(exceptions.CommandError):
+            subprocess_utils.run('exit 7')
+
+    def test_kill_process_tree(self):
+        import subprocess
+        import time
+        proc = subprocess.Popen(['bash', '-c', 'sleep 100 & sleep 100'])
+        time.sleep(0.2)
+        subprocess_utils.kill_process_tree(proc.pid)
+        time.sleep(0.2)
+        assert proc.poll() is not None
