@@ -74,6 +74,8 @@ class MoeMLP(nn.Module):
         dispatch = jnp.einsum('bske,bskc->bsec', onehot, pos_oh)
         combine = jnp.einsum('bsk,bske,bskc->bsec', gate_vals, onehot,
                              pos_oh)
+        # no-op in normal apply; tests read it with mutable=['intermediates']
+        self.sow('intermediates', 'dispatch', dispatch)
 
         # --- expert computation ----------------------------------------
         expert_in = jnp.einsum('bsec,bsd->ebcd', dispatch,

@@ -1,0 +1,224 @@
+"""Per-host agent daemon: the skylet analog.
+
+Reference: sky/skylet/skylet.py (20s event loop) + the Ray worker processes.
+One agent runs on every host of a cluster. The head (rank 0) additionally
+runs the coordination HTTP server (runtime/server.py) and the autostop
+event. Workers (all ranks, including the head's own worker thread) poll the
+head for gang directives and execute jobs through runtime/log_lib.
+
+Start (done by the provisioner over SSH / local runner):
+    python -m skypilot_tpu.runtime.agent --config ~/.skyt/agent.json
+The process daemonizes; its pid is written to ~/.skyt/agent.pid.
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import requests
+
+from skypilot_tpu.runtime import autostop_lib
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.runtime import log_lib
+from skypilot_tpu.runtime import server as server_lib
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = log_utils.init_logger(__name__)
+
+WORK_POLL_INTERVAL_S = 1.0
+EVENT_INTERVAL_S = 20  # reference: sky/skylet/events.py:26
+
+
+class RunningJob:
+    def __init__(self, job_id: int, thread: threading.Thread) -> None:
+        self.job_id = job_id
+        self.thread = thread
+        self.pid: Optional[int] = None
+        self.killed = False
+
+
+class Worker:
+    """Polls the head for directives; executes jobs locally."""
+
+    def __init__(self, config: server_lib.ClusterConfig) -> None:
+        self.config = config
+        self.head_url = f'http://{config.head_ip}:{config.head_port}'
+        self.running: Dict[int, RunningJob] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- HTTP
+    def _get(self, path: str) -> Dict[str, Any]:
+        resp = requests.get(self.head_url + path, timeout=10)
+        resp.raise_for_status()
+        return resp.json()
+
+    def _post(self, path: str, payload: Dict[str, Any]) -> None:
+        requests.post(self.head_url + path, json=payload,
+                      timeout=10).raise_for_status()
+
+    def _report(self, job_id: int, event: str,
+                returncode: Optional[int] = None) -> None:
+        try:
+            self._post('/report', {'job_id': job_id,
+                                   'rank': self.config.rank,
+                                   'event': event,
+                                   'returncode': returncode})
+        except requests.RequestException as e:
+            logger.warning('report %s for job %d failed: %s', event, job_id,
+                           e)
+
+    # ------------------------------------------------------------- loop
+    def poll_once(self) -> None:
+        data = self._get(f'/work?rank={self.config.rank}')
+        for directive in data.get('directives', []):
+            action = directive['action']
+            job_id = directive['job_id']
+            with self._lock:
+                if action == 'run' and job_id not in self.running:
+                    rj = RunningJob(job_id, None)
+                    thread = threading.Thread(
+                        target=self._execute, args=(directive, rj),
+                        daemon=True, name=f'job-{job_id}')
+                    rj.thread = thread
+                    self.running[job_id] = rj
+                    thread.start()
+                elif action == 'kill':
+                    rj = self.running.get(job_id)
+                    if rj is not None and rj.pid and not rj.killed:
+                        rj.killed = True
+                        logger.info('killing job %d (pid %s)', job_id,
+                                    rj.pid)
+                        subprocess_utils.kill_process_tree(rj.pid)
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                self.poll_once()
+            except requests.RequestException as e:
+                logger.warning('head unreachable: %s', e)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('worker poll error')
+            time.sleep(WORK_POLL_INTERVAL_S)
+
+    # ---------------------------------------------------------- execution
+    def _execute(self, directive: Dict[str, Any], rj: RunningJob) -> None:
+        job_id = directive['job_id']
+        spec = directive['spec']
+        env = dict(directive['env'])
+        rank = self.config.rank
+        log_dir = job_lib.log_dir_for_job(job_id)
+        os.makedirs(log_dir, exist_ok=True)
+        run_log = os.path.join(log_dir, f'rank-{rank}.log')
+        workdir = os.path.join(job_lib.agent_home(), 'skyt_workdir')
+        if os.path.isdir(workdir):
+            env.setdefault('SKYT_WORKDIR', workdir)
+
+        setup = spec.get('setup')
+        if setup:
+            self._report(job_id, 'setup_started')
+            script = log_lib.make_task_bash_script(setup, env)
+            rc, pid = self._run_tracked(script, run_log, rj)
+            os.unlink(script)
+            if rc != 0:
+                self._report(job_id, 'setup_failed', rc)
+                return
+
+        run_cmd = spec.get('run') or 'true'
+        self._report(job_id, 'run_started')
+        script = log_lib.make_task_bash_script(run_cmd, env)
+        rc, _ = self._run_tracked(script, run_log, rj)
+        os.unlink(script)
+        self._report(job_id, 'done', rc)
+        with self._lock:
+            self.running.pop(job_id, None)
+
+    def _run_tracked(self, script: str, log_path: str,
+                     rj: RunningJob) -> tuple:
+        """run_with_log but exposing the child pid for kill directives."""
+        import subprocess
+        log_path = os.path.expanduser(log_path)
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, 'a', encoding='utf-8') as log_file:
+            proc = subprocess.Popen(['bash', script],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True, text=True)
+            rj.pid = proc.pid
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                log_file.write(line)
+                log_file.flush()
+            proc.wait()
+            return proc.returncode, proc.pid
+
+
+class HeadLoop:
+    """Head-only periodic events: scheduling tick + autostop.
+
+    Reference: sky/skylet/events.py (AutostopEvent, JobSchedulerEvent).
+    """
+
+    def __init__(self, state: server_lib.HeadState) -> None:
+        self.state = state
+        self._last_autostop_check = 0.0
+
+    def run_forever(self) -> None:
+        while True:
+            try:
+                self.state.schedule_step()
+                now = time.time()
+                if now - self._last_autostop_check >= EVENT_INTERVAL_S:
+                    self._last_autostop_check = now
+                    autostop_lib.autostop_event(self.state.config)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('head loop error')
+            time.sleep(EVENT_INTERVAL_S)
+
+
+def write_pid_file() -> None:
+    path = os.path.join(job_lib.skyt_dir(), 'agent.pid')
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--config', required=True,
+                        help='path to agent.json')
+    parser.add_argument('--foreground', action='store_true',
+                        help='do not daemonize (tests)')
+    args = parser.parse_args(argv)
+
+    config = server_lib.ClusterConfig.load(os.path.expanduser(args.config))
+    if not args.foreground:
+        subprocess_utils.daemonize()
+    write_pid_file()
+    job_lib.set_kv('agent_start_time', str(time.time()))
+
+    log_path = os.path.join(job_lib.skyt_dir(), 'agent.log')
+    log_utils.add_file_handler(log_path)
+    logger.info('agent starting: cluster=%s rank=%d',
+                config.cluster_name, config.rank)
+
+    is_head = config.rank == 0
+    if is_head:
+        state = server_lib.HeadState(config)
+        httpd = server_lib.make_server(state, config.head_port)
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name='head-http').start()
+        threading.Thread(target=HeadLoop(state).run_forever, daemon=True,
+                         name='head-loop').start()
+
+    worker = Worker(config)
+    # Graceful shutdown for tests / teardown.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    worker.run_forever()
+
+
+if __name__ == '__main__':
+    main()

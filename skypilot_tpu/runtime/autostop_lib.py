@@ -1,0 +1,58 @@
+"""Autostop: stop/tear down an idle cluster from the inside.
+
+Reference: sky/skylet/autostop_lib.py + AutostopEvent
+(sky/skylet/events.py:90-260). The head agent checks every EVENT_INTERVAL:
+if an autostop is configured and no job has been active for `idle_minutes`,
+it invokes the cluster's own provision module to stop (or `down`) itself.
+TPU-specific: multi-host pod slices cannot be stopped, only deleted — the
+provisioner raises NotSupportedError and the event falls back to down if
+the user asked for `down`, else logs and leaves the cluster up (the same
+guard the reference applies at sky/clouds/gcp.py:184-190).
+"""
+import time
+
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def get_autostop_config() -> tuple:
+    """(idle_minutes, down). idle_minutes < 0 means disabled."""
+    idle = int(job_lib.get_kv('autostop_idle_minutes') or -1)
+    down = (job_lib.get_kv('autostop_down') or '0') == '1'
+    return idle, down
+
+
+def set_autostop_config(idle_minutes: int, down: bool) -> None:
+    job_lib.set_kv('autostop_idle_minutes', str(int(idle_minutes)))
+    job_lib.set_kv('autostop_down', '1' if down else '0')
+
+
+def autostop_event(config) -> None:
+    """One tick of the autostop check (head agent only)."""
+    idle_minutes, down = get_autostop_config()
+    if idle_minutes < 0:
+        return
+    if not job_lib.is_cluster_idle():
+        return
+    idle_s = time.time() - job_lib.last_activity_time()
+    if idle_s < idle_minutes * 60:
+        return
+    logger.info('cluster idle for %.0fs (>= %d min): autostop (down=%s)',
+                idle_s, idle_minutes, down)
+    # Mark so a concurrent status refresh can tell "stopping" from crashed.
+    job_lib.set_kv('autostopping', '1')
+    try:
+        from skypilot_tpu import provision
+        if down:
+            provision.terminate_instances(config.cloud, config.cluster_name,
+                                          config.provider_config,
+                                          from_inside=True)
+        else:
+            provision.stop_instances(config.cloud, config.cluster_name,
+                                     config.provider_config,
+                                     from_inside=True)
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('autostop failed')
+        job_lib.set_kv('autostopping', '0')

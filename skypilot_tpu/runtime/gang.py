@@ -1,0 +1,89 @@
+"""Gang execution env contract: what every rank's job process sees.
+
+Replaces the reference's RayCodeGen env export (SKYPILOT_NODE_IPS/
+NUM_NODES/NODE_RANK/NUM_GPUS_PER_NODE, sky/backends/cloud_vm_ray_backend.py
+:569-630 and sky/skylet/constants.py:263-266) with a TPU-first contract:
+the JAX coordinator triplet (JAX_COORDINATOR_ADDRESS/NUM_PROCESSES/
+PROCESS_ID — honored by jax.distributed.initialize()) is exported directly,
+so `jax.distributed.initialize()` with no args works on any cluster this
+framework launches, CPU or TPU. SKYPILOT_* aliases are kept so reference
+recipes run unmodified.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def make_task_id(job_id: int, cluster_name: str, task_name: str) -> str:
+    """Reference: SKYPILOT_TASK_ID (sky/skylet/constants.py:63) format:
+    sky-<timestamp>-<cluster>-<job>."""
+    ts = time.strftime('%Y-%m-%d-%H-%M-%S')
+    return f'skyt-{ts}_{cluster_name}_{task_name or "task"}-{job_id}'
+
+
+def job_env_vars(
+    *,
+    job_id: int,
+    rank: int,
+    ips: List[str],
+    cluster_name: str,
+    task_name: Optional[str] = None,
+    accelerators_per_node: int = 0,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+    user_envs: Optional[Dict[str, str]] = None,
+    export_jax_coordinator: Optional[bool] = None,
+) -> Dict[str, str]:
+    """Build the full env for one rank of a gang job."""
+    num_nodes = len(ips)
+    head_ip = ips[0]
+    coord = f'{head_ip}:{coordinator_port}'
+    env: Dict[str, str] = {}
+    # User envs first: the runtime contract below must win conflicts.
+    env.update({k: str(v) for k, v in (user_envs or {}).items()})
+    env.update({
+        'SKYT_NUM_NODES': str(num_nodes),
+        'SKYT_NODE_RANK': str(rank),
+        'SKYT_NODE_IPS': '\n'.join(ips),
+        'SKYT_NUM_ACCELERATORS_PER_NODE': str(accelerators_per_node),
+        'SKYT_COORDINATOR_ADDRESS': coord,
+        'SKYT_TASK_ID': make_task_id(job_id, cluster_name, task_name),
+        'SKYT_CLUSTER_NAME': cluster_name,
+        'SKYT_JOB_ID': str(job_id),
+        # Reference-compatible aliases (sky/skylet/constants.py:263-266):
+        # lets the reference's distributed recipes (torch DDP, DeepSpeed
+        # hostfiles) run unmodified on this framework.
+        'SKYPILOT_NUM_NODES': str(num_nodes),
+        'SKYPILOT_NODE_RANK': str(rank),
+        'SKYPILOT_NODE_IPS': '\n'.join(ips),
+        'SKYPILOT_NUM_GPUS_PER_NODE': str(accelerators_per_node),
+        'SKYPILOT_TASK_ID': make_task_id(job_id, cluster_name, task_name),
+    })
+    if export_jax_coordinator is None:
+        export_jax_coordinator = num_nodes > 1
+    if export_jax_coordinator:
+        # jax.distributed.initialize() reads these when called with no args
+        # (jax/_src/clusters cluster detection). On single-host jobs they
+        # are omitted so plain single-process JAX works untouched.
+        env.update({
+            'JAX_COORDINATOR_ADDRESS': coord,
+            'JAX_NUM_PROCESSES': str(num_nodes),
+            'JAX_PROCESS_ID': str(rank),
+        })
+    return env
+
+
+def spec_env_for_rank(spec: Dict[str, Any], rank: int,
+                      cluster_name: str) -> Dict[str, str]:
+    """Env for one rank from a job spec dict (runtime/server.py wire form)."""
+    return job_env_vars(
+        job_id=spec['job_id'],
+        rank=rank,
+        ips=spec['ips'],
+        cluster_name=cluster_name,
+        task_name=spec.get('name'),
+        accelerators_per_node=spec.get('accelerators_per_node', 0),
+        coordinator_port=spec.get('coordinator_port',
+                                  DEFAULT_COORDINATOR_PORT),
+        user_envs=spec.get('envs'),
+    )

@@ -1,0 +1,106 @@
+"""On-host log runner: run bash with streamed + filed logs; tail/follow.
+
+Mirrors the reference's sky/skylet/log_lib.py (run_with_log :130,
+make_task_bash_script :264, run_bash_command_with_log :311,
+_follow_job_logs :339, tail_logs :387). This is what the per-host agent
+executes a job's setup/run scripts through.
+"""
+import os
+import subprocess
+import tempfile
+import time
+from typing import Dict, Iterator, Optional, Tuple
+
+_BASH_PRELUDE = """\
+#!/bin/bash
+source ~/.bashrc 2> /dev/null || true
+set -a
+"""
+
+
+def make_task_bash_script(codegen: str,
+                          env_vars: Optional[Dict[str, str]] = None) -> str:
+    """Write the task script to a temp file; returns its path.
+
+    Reference: log_lib.py:264 — login-shell semantics so user dotfile env
+    (conda, PATH) is visible, `set -a` so exported vars reach subprocesses.
+    """
+    import shlex
+    script = [_BASH_PRELUDE]
+    for k, v in (env_vars or {}).items():
+        # shlex.quote: values may contain newlines (SKYT_NODE_IPS is one IP
+        # per line, reference-compatible) — POSIX single-quoting keeps them.
+        script.append(f'export {k}={shlex.quote(str(v))}')
+    script += ['set +a', 'cd "${SKYT_WORKDIR:-$HOME}" 2>/dev/null || true',
+               codegen]
+    fd, path = tempfile.mkstemp(prefix='skyt_task_', suffix='.sh')
+    with os.fdopen(fd, 'w') as f:
+        f.write('\n'.join(script) + '\n')
+    os.chmod(path, 0o755)
+    return path
+
+
+def run_with_log(cmd, log_path: str,
+                 *,
+                 env_vars: Optional[Dict[str, str]] = None,
+                 stream_logs: bool = False,
+                 start_new_session: bool = True,
+                 cwd: Optional[str] = None) -> Tuple[int, int]:
+    """Run cmd (list or shell str), teeing stdout+stderr to log_path.
+
+    Returns (returncode, pid). start_new_session puts the job in its own
+    process group so cancellation can kill the whole tree (reference:
+    log_lib.py run_with_log uses the same trick).
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    shell = isinstance(cmd, str)
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in (env_vars or {}).items()})
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(cmd, shell=shell, cwd=cwd, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=start_new_session,
+                                text=True)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            log_file.write(line)
+            log_file.flush()
+            if stream_logs:
+                print(line, end='', flush=True)
+        proc.wait()
+        return proc.returncode, proc.pid
+
+
+def tail_logs(log_path: str, *, follow: bool = False,
+              job_done: Optional[callable] = None,
+              from_start: bool = True,
+              poll_interval: float = 0.5) -> Iterator[str]:
+    """Yield log lines; in follow mode keep reading until job_done() is
+    True AND the file is drained (reference: log_lib.py:339 follow loop).
+    """
+    log_path = os.path.expanduser(log_path)
+    # Wait briefly for the file to appear (job may still be starting).
+    deadline = time.time() + (30 if follow else 0)
+    while not os.path.exists(log_path):
+        if time.time() > deadline:
+            return
+        time.sleep(poll_interval)
+    with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
+        if not from_start:
+            f.seek(0, os.SEEK_END)
+        while True:
+            line = f.readline()
+            if line:
+                yield line
+                continue
+            if not follow:
+                return
+            if job_done is not None and job_done():
+                # Drain whatever arrived between the check and now.
+                rest = f.read()
+                if rest:
+                    yield rest
+                return
+            time.sleep(poll_interval)

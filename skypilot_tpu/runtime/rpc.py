@@ -1,0 +1,134 @@
+"""On-host RPC CLI: the client's door into the head agent.
+
+The reference generates Python source strings and pipes them through SSH
+(`JobLibCodeGen` sky/skylet/job_lib.py:803). Here the shipped package
+itself is the protocol: the backend runs
+    python -m skypilot_tpu.runtime.rpc <op> [--payload JSON]
+on the head host (over SSH or the local runner); this module relays to the
+head agent's HTTP server on localhost and prints one JSON document. No
+string codegen, and the wire format is versioned with the package.
+"""
+import argparse
+import json
+import os
+import sys
+
+import requests
+
+from skypilot_tpu.runtime import gang as gang_lib
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.runtime import log_lib
+from skypilot_tpu.runtime import server as server_lib
+
+
+def _agent_config() -> server_lib.ClusterConfig:
+    path = os.path.join(job_lib.skyt_dir(), 'agent.json')
+    return server_lib.ClusterConfig.load(path)
+
+
+def _base_url() -> str:
+    cfg = _agent_config()
+    return f'http://127.0.0.1:{cfg.head_port}'
+
+
+def op_submit(payload):
+    resp = requests.post(_base_url() + '/jobs/submit',
+                         json={'spec': payload['spec']}, timeout=30)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def op_queue(payload):
+    url = _base_url() + '/jobs'
+    resp = requests.get(url, timeout=30)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def op_status(payload):
+    resp = requests.get(_base_url() + f"/jobs/{payload['job_id']}",
+                        timeout=30)
+    if resp.status_code == 404:
+        return {'error': 'not found'}
+    resp.raise_for_status()
+    return resp.json()
+
+
+def op_cancel(payload):
+    resp = requests.post(_base_url() + f"/jobs/{payload['job_id']}/cancel",
+                         json={}, timeout=30)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def op_autostop(payload):
+    resp = requests.post(_base_url() + '/autostop', json=payload, timeout=30)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def op_tail(payload):
+    """Stream a job's rank-0 log to stdout; NOT JSON (follows until the job
+    is terminal when --follow)."""
+    job_id = int(payload['job_id'])
+    follow = bool(payload.get('follow', True))
+    log_path = os.path.join(job_lib.log_dir_for_job(job_id), 'rank-0.log')
+
+    def job_done() -> bool:
+        try:
+            resp = requests.get(_base_url() + f'/jobs/{job_id}', timeout=10)
+            if resp.status_code != 200:
+                return True
+            return job_lib.JobStatus(resp.json()['status']).is_terminal()
+        except requests.RequestException:
+            return True
+
+    for line in log_lib.tail_logs(log_path, follow=follow,
+                                  job_done=job_done):
+        print(line, end='', flush=True)
+    status = None
+    try:
+        resp = requests.get(_base_url() + f'/jobs/{job_id}', timeout=10)
+        if resp.status_code == 200:
+            status = resp.json()['status']
+    except requests.RequestException:
+        pass
+    print(f'\n### Job {job_id} finished with status: {status} ###'
+          if status and job_lib.JobStatus(status).is_terminal() else '',
+          file=sys.stderr)
+    return None
+
+
+def op_task_id(payload):
+    """Echo the env contract for a hypothetical rank (debugging aid)."""
+    cfg = _agent_config()
+    env = gang_lib.job_env_vars(job_id=0, rank=0, ips=cfg.ips,
+                                cluster_name=cfg.cluster_name)
+    return {'env': env}
+
+
+OPS = {
+    'submit': op_submit,
+    'queue': op_queue,
+    'status': op_status,
+    'cancel': op_cancel,
+    'autostop': op_autostop,
+    'tail': op_tail,
+    'env': op_task_id,
+}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('op', choices=sorted(OPS))
+    parser.add_argument('--payload', default='{}',
+                        help='JSON arguments for the op')
+    args = parser.parse_args(argv)
+    payload = json.loads(args.payload)
+    out = OPS[args.op](payload)
+    if out is not None:
+        print(json.dumps(out, default=str))
+
+
+if __name__ == '__main__':
+    main()

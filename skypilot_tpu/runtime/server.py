@@ -1,0 +1,278 @@
+"""Head-agent coordination server: gang dispatch without Ray.
+
+The reference gang-schedules via a generated Ray driver (placement group
+STRICT_SPREAD + per-node ray tasks, sky/backends/cloud_vm_ray_backend.py:361,
+SURVEY.md §3.5). A TPU pod slice is already gang-allocated, so this is a
+~10x simpler pull model: the head agent owns the job queue (runtime/job_lib)
+and serves directives over HTTP on the slice-internal network; every host's
+worker loop (runtime/agent.py) polls `/work?rank=r`, executes, and reports.
+
+Endpoints (JSON):
+  GET  /health                  liveness + cluster identity
+  POST /jobs/submit             {spec} -> {job_id}
+  GET  /jobs                    [?status=...] -> [job]
+  GET  /jobs/<id>               job + gang records
+  POST /jobs/<id>/cancel        cancel (kill directives fan out via /work)
+  GET  /work?rank=r             [{action: run|kill, job_id, spec?, env?}]
+  POST /report                  {job_id, rank, event, returncode}
+  POST /autostop                {idle_minutes, down}
+  GET  /autostop                current autostop config
+"""
+import json
+import threading
+import time
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.runtime import gang as gang_lib
+from skypilot_tpu.runtime import job_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+DEFAULT_AGENT_PORT = 46580
+
+
+class ClusterConfig:
+    """Static cluster identity, written by the provisioner to
+    $SKYT_AGENT_HOME/.skyt/agent.json on every host."""
+
+    def __init__(self, cfg: Dict[str, Any]) -> None:
+        self.cluster_name: str = cfg['cluster_name']
+        self.num_nodes: int = int(cfg['num_nodes'])
+        self.rank: int = int(cfg.get('rank', 0))
+        self.ips: List[str] = list(cfg['ips'])
+        self.head_ip: str = cfg.get('head_ip', self.ips[0])
+        self.head_port: int = int(cfg.get('head_port', DEFAULT_AGENT_PORT))
+        self.coordinator_port: int = int(
+            cfg.get('coordinator_port', gang_lib.DEFAULT_COORDINATOR_PORT))
+        self.accelerators_per_node: int = int(
+            cfg.get('accelerators_per_node', 0))
+        self.cloud: str = cfg.get('cloud', 'local')
+        self.provider_config: Dict[str, Any] = cfg.get('provider_config', {})
+        self.raw = dict(cfg)
+
+    @classmethod
+    def load(cls, path: str) -> 'ClusterConfig':
+        with open(path, 'r', encoding='utf-8') as f:
+            return cls(json.load(f))
+
+
+class HeadState:
+    """Gang bookkeeping + scheduling, shared by server handlers and the
+    agent's scheduler loop. All mutations funnel through job_lib (sqlite)."""
+
+    def __init__(self, config: ClusterConfig) -> None:
+        self.config = config
+        self.scheduler = job_lib.FIFOScheduler()
+        self.lock = threading.RLock()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, spec: Dict[str, Any]) -> int:
+        spec = dict(spec)
+        spec.setdefault('num_nodes', self.config.num_nodes
+                        if spec.get('gang', True) else 1)
+        # A job can use fewer nodes than the cluster has, never more.
+        spec['num_nodes'] = min(int(spec['num_nodes']),
+                                self.config.num_nodes)
+        job_id = job_lib.add_job(spec.get('name'), spec,
+                                 spec.get('username', ''))
+        logger.info('submitted job %d (%s)', job_id, spec.get('name'))
+        return job_id
+
+    # ---------------------------------------------------------- scheduling
+    def schedule_step(self) -> None:
+        with self.lock:
+            job_id = self.scheduler.schedule_step()
+            if job_id is not None:
+                job_lib.set_status(job_id, job_lib.JobStatus.SETTING_UP)
+                logger.info('dispatching job %d', job_id)
+
+    # ------------------------------------------------------------ directives
+    def work_for_rank(self, rank: int) -> List[Dict[str, Any]]:
+        directives = []
+        active = job_lib.get_jobs([job_lib.JobStatus.SETTING_UP,
+                                   job_lib.JobStatus.RUNNING])
+        for job in active:
+            recs = {r['rank']: r for r in job_lib.gang_records(
+                job['job_id'])}
+            rec = recs.get(rank)
+            if rec is None:
+                continue
+            if rec['status'] == 'PENDING':
+                job_lib.gang_mark(job['job_id'], rank, 'DISPATCHED')
+                directives.append(self._run_directive(job, rank))
+        # Kill directives: job reached a terminal state but this rank's
+        # process may still be running (failure elsewhere / cancellation).
+        terminal = job_lib.get_jobs([job_lib.JobStatus.CANCELLED,
+                                     job_lib.JobStatus.FAILED,
+                                     job_lib.JobStatus.FAILED_SETUP])
+        for job in terminal:
+            for rec in job_lib.gang_records(job['job_id']):
+                if rec['rank'] == rank and rec['status'] in ('DISPATCHED',
+                                                             'SETUP',
+                                                             'RUNNING'):
+                    directives.append({'action': 'kill',
+                                       'job_id': job['job_id']})
+        return directives
+
+    def _run_directive(self, job: Dict[str, Any],
+                       rank: int) -> Dict[str, Any]:
+        spec = dict(job['spec'])
+        num_nodes = int(spec.get('num_nodes', self.config.num_nodes))
+        spec['job_id'] = job['job_id']
+        spec['ips'] = self.config.ips[:num_nodes]
+        spec['coordinator_port'] = self.config.coordinator_port
+        spec.setdefault('accelerators_per_node',
+                        self.config.accelerators_per_node)
+        env = gang_lib.spec_env_for_rank(spec, rank,
+                                         self.config.cluster_name)
+        return {'action': 'run', 'job_id': job['job_id'], 'spec': spec,
+                'env': env}
+
+    # -------------------------------------------------------------- reports
+    def report(self, job_id: int, rank: int, event: str,
+               returncode: Optional[int] = None) -> None:
+        job = job_lib.get_job(job_id)
+        if job is None:
+            return
+        status = job['status']
+        if event == 'setup_started':
+            job_lib.gang_mark(job_id, rank, 'SETUP')
+        elif event == 'setup_failed':
+            job_lib.gang_mark(job_id, rank, 'DONE', returncode)
+            if not status.is_terminal():
+                job_lib.set_status(job_id, job_lib.JobStatus.FAILED_SETUP)
+        elif event == 'run_started':
+            job_lib.gang_mark(job_id, rank, 'RUNNING')
+            if status == job_lib.JobStatus.SETTING_UP:
+                job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
+        elif event == 'done':
+            job_lib.gang_mark(job_id, rank, 'DONE', returncode)
+            if (returncode or 0) != 0:
+                if not status.is_terminal():
+                    job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+            elif job_lib.gang_all_done(job_id):
+                if job_lib.gang_any_failed(job_id):
+                    if not status.is_terminal():
+                        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
+                elif not status.is_terminal():
+                    job_lib.set_status(job_id, job_lib.JobStatus.SUCCEEDED)
+
+    def cancel(self, job_id: int) -> bool:
+        job = job_lib.get_job(job_id)
+        if job is None or job['status'].is_terminal():
+            return False
+        job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED)
+        return True
+
+
+def _json_bytes(obj: Any) -> bytes:
+    return json.dumps(obj, default=str).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: HeadState = None  # set by make_server
+
+    # Silence default per-request stderr logging.
+    def log_message(self, fmt, *args):  # noqa: N802
+        pass
+
+    def _reply(self, obj: Any, code: int = 200) -> None:
+        body = _json_bytes(obj)
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict[str, Any]:
+        length = int(self.headers.get('Content-Length', 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def do_GET(self):  # noqa: N802
+        try:
+            parsed = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(parsed.query)
+            parts = [p for p in parsed.path.split('/') if p]
+            st = self.state
+            if parsed.path == '/health':
+                self._reply({'ok': True,
+                             'cluster': st.config.cluster_name,
+                             'num_nodes': st.config.num_nodes,
+                             'time': time.time()})
+            elif parsed.path == '/work':
+                rank = int(q.get('rank', ['0'])[0])
+                st.schedule_step()
+                self._reply({'directives': st.work_for_rank(rank)})
+            elif parts[:1] == ['jobs'] and len(parts) == 1:
+                statuses = None
+                if 'status' in q:
+                    statuses = [job_lib.JobStatus(s) for s in q['status']]
+                self._reply({'jobs': [_job_wire(j) for j in
+                                      job_lib.get_jobs(statuses)]})
+            elif parts[:1] == ['jobs'] and len(parts) == 2:
+                job = job_lib.get_job(int(parts[1]))
+                if job is None:
+                    self._reply({'error': 'not found'}, 404)
+                else:
+                    wire = _job_wire(job)
+                    wire['gang'] = job_lib.gang_records(job['job_id'])
+                    self._reply(wire)
+            elif parsed.path == '/autostop':
+                self._reply({
+                    'idle_minutes': int(job_lib.get_kv('autostop_idle_minutes')
+                                        or -1),
+                    'down': (job_lib.get_kv('autostop_down') or '0') == '1',
+                })
+            else:
+                self._reply({'error': 'unknown path'}, 404)
+        except Exception as e:  # pylint: disable=broad-except
+            traceback.print_exc()
+            self._reply({'error': str(e)}, 500)
+
+    def do_POST(self):  # noqa: N802
+        try:
+            parts = [p for p in self.path.split('?')[0].split('/') if p]
+            st = self.state
+            body = self._body()
+            if parts == ['jobs', 'submit']:
+                job_id = st.submit(body['spec'])
+                st.schedule_step()
+                self._reply({'job_id': job_id})
+            elif len(parts) == 3 and parts[0] == 'jobs' and \
+                    parts[2] == 'cancel':
+                ok = st.cancel(int(parts[1]))
+                self._reply({'cancelled': ok})
+            elif parts == ['report']:
+                st.report(body['job_id'], body['rank'], body['event'],
+                          body.get('returncode'))
+                self._reply({'ok': True})
+            elif parts == ['autostop']:
+                job_lib.set_kv('autostop_idle_minutes',
+                               str(int(body['idle_minutes'])))
+                job_lib.set_kv('autostop_down',
+                               '1' if body.get('down') else '0')
+                self._reply({'ok': True})
+            else:
+                self._reply({'error': 'unknown path'}, 404)
+        except Exception as e:  # pylint: disable=broad-except
+            traceback.print_exc()
+            self._reply({'error': str(e)}, 500)
+
+
+def _job_wire(job: Dict[str, Any]) -> Dict[str, Any]:
+    wire = dict(job)
+    wire['status'] = job['status'].value
+    return wire
+
+
+def make_server(state: HeadState, port: int) -> ThreadingHTTPServer:
+    handler = type('BoundHandler', (_Handler,), {'state': state})
+    server = ThreadingHTTPServer(('0.0.0.0', port), handler)
+    server.daemon_threads = True
+    return server

@@ -53,13 +53,17 @@ def loaded() -> bool:
 
 
 def get_nested(keys: Iterable[str], default_value: Any = None) -> Any:
-    """config.get_nested(('gcp', 'project_id')) → value or default."""
+    """config.get_nested(('gcp', 'project_id')) → value or default.
+
+    Containers are deep-copied: callers must not be able to mutate the
+    process-wide cached config through the return value.
+    """
     cur: Any = _try_load_config()
     for key in keys:
         if not isinstance(cur, dict) or key not in cur:
             return default_value
         cur = cur[key]
-    return cur
+    return copy.deepcopy(cur) if isinstance(cur, (dict, list)) else cur
 
 
 def set_nested(keys: Tuple[str, ...], value: Any) -> Dict[str, Any]:

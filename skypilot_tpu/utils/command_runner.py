@@ -119,8 +119,11 @@ def _execute_local(full_cmd: List[str], *, stream_logs: bool,
                 print(line, end='', flush=True, file=to_console)
 
     try:
+        # start_new_session so a timeout can kill the whole process group,
+        # not just the bash wrapper.
         proc = subprocess.Popen(full_cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, text=True)
+                                stderr=subprocess.PIPE, text=True,
+                                start_new_session=True)
         import sys
         threads = [
             threading.Thread(target=_drain,
@@ -135,13 +138,19 @@ def _execute_local(full_cmd: List[str], *, stream_logs: bool,
         try:
             proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
-            proc.kill()
+            from skypilot_tpu.utils import subprocess_utils
+            subprocess_utils.kill_process_tree(proc.pid)
+            for t in threads:
+                t.join(timeout=5)
             raise exceptions.CommandError(
                 124, ' '.join(full_cmd[:6]) + ' …', 'command timed out')
         for t in threads:
             t.join(timeout=10)
         code = proc.returncode
     finally:
+        # Drain threads have exited (EOF after child death) before the log
+        # file is closed; the joins above guarantee it except on pathological
+        # hangs, where closing loudly is preferable to leaking the fd.
         if log_file:
             log_file.close()
     if require_outputs:

@@ -34,3 +34,14 @@ def _configure_root() -> None:
 def init_logger(name: str) -> logging.Logger:
     _configure_root()
     return logging.getLogger(name)
+
+def add_file_handler(path: str) -> None:
+    """Attach a file handler to the framework root logger (daemons log to
+    files — their stdio points at /dev/null after daemonize)."""
+    _configure_root()
+    parent = os.path.dirname(os.path.expanduser(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    handler = logging.FileHandler(os.path.expanduser(path))
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
+    logging.getLogger('skypilot_tpu').addHandler(handler)

@@ -125,7 +125,9 @@ def save_timeline() -> None:
     path = os.environ.get(
         'SKYT_TIMELINE_FILE',
         os.path.expanduser(f'~/.skypilot_tpu/timeline-{os.getpid()}.json'))
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     with _events_lock:
         payload = {'traceEvents': list(_events)}
     with open(path, 'w', encoding='utf-8') as f:

@@ -35,3 +35,8 @@ def tmp_state_dir(tmp_path, monkeypatch):
     state.reset_db_for_testing()
     yield tmp_path / 'state'
     state.reset_db_for_testing()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'integration: spawns real agent/controller subprocesses')

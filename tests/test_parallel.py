@@ -134,37 +134,19 @@ class TestMoE:
     def test_topk_no_capacity_slot_collision(self):
         """Regression: with k=2, a token routed to expert X as 1st choice
         and another routed to X as 2nd choice must land in DIFFERENT
-        capacity slots (GShard slot-major positions). Each (expert, slot)
-        holds at most one token."""
+        capacity slots (GShard slot-major positions). Asserts on the
+        layer's OWN dispatch tensor (sown intermediate), so reverting the
+        moe.py fix fails this test."""
         cfg, mcfg = moe_lib.MIXTRAL_CONFIGS['debug-moe']
         layer = moe_lib.MoeMLP(cfg, mcfg)
         rng = np.random.default_rng(1)
         x = jnp.array(rng.normal(size=(2, 16, cfg.dim)), jnp.float32)
-        import flax.linen as nn
-        vars_ = nn.meta.unbox(layer.init(jax.random.PRNGKey(0), x))
-
-        # Re-derive the dispatch tensor exactly as the layer builds it.
-        router_w = vars_['params']['router']
-        logits = jnp.einsum('bsd,de->bse', x, router_w)
-        probs = jax.nn.softmax(logits, axis=-1)
-        k = mcfg.experts_per_token
-        e = mcfg.num_experts
-        s = x.shape[1]
-        capacity = max(int(mcfg.capacity_factor * s * k / e), 1)
-        _, expert_idx = jax.lax.top_k(probs, k)
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
-        pos_in_slot = jnp.cumsum(onehot, axis=1) - onehot
-        slot_counts = jnp.sum(onehot, axis=1)
-        slot_offset = jnp.cumsum(slot_counts, axis=1) - slot_counts
-        pos_in_expert = pos_in_slot + slot_offset[:, None]
-        pos = jnp.einsum('bske,bske->bsk', pos_in_expert, onehot)
-        keep = pos < capacity
-        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
-        dispatch = jnp.einsum('bske,bskc->bsec', onehot * keep[..., None],
-                              pos_oh)
+        vars_ = layer.init(jax.random.PRNGKey(0), x)
+        (_, _), inter = layer.apply(vars_, x, mutable=['intermediates'])
+        dispatch, = inter['intermediates']['dispatch']  # [B,S,E,C]
         # At most one token occupies any (expert, capacity slot).
-        occupancy = dispatch.sum(axis=1)  # [B, E, C]
-        assert float(occupancy.max()) <= 1.0 + 1e-6
-        # Every kept token occupies exactly one slot.
-        assert float(dispatch.sum()) == pytest.approx(
-            float(keep.sum()))
+        occupancy = np.asarray(dispatch.sum(axis=1))    # [B,E,C]
+        assert occupancy.max() <= 1.0 + 1e-6, occupancy.max()
+        # With the default capacity factor at least one expert receives
+        # second-choice traffic in this random batch (the collision case).
+        assert dispatch.sum() > 0
