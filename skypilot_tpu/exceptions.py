@@ -171,6 +171,11 @@ class CloudError(SkyTpuError):
     """Opaque error from a cloud API call."""
 
 
+class ProvisionerError(CloudError):
+    """Cloud provisioner op failed (reference: sky/provision errors that
+    feed the failover handlers, sky/backends/cloud_vm_ray_backend.py:697)."""
+
+
 class NetworkError(SkyTpuError):
     """Client could not reach a required network endpoint."""
 

@@ -1,0 +1,101 @@
+"""Provisioner dispatch façade.
+
+Reference: sky/provision/__init__.py:29-196 — routes
+`provision.<op>(provider_name, ...)` to `skypilot_tpu.provision.<cloud>.
+instance.<op>` by module-name reflection so each cloud implements a flat
+function API instead of a class hierarchy.
+"""
+import importlib
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision.common import (ClusterInfo, Endpoint,
+                                           InstanceInfo, ProvisionConfig,
+                                           ProvisionError, ProvisionRecord)
+
+_SUPPORTED = ('gcp', 'local')
+
+
+def _route(provider_name: str, op: str, *args, **kwargs) -> Any:
+    provider = provider_name.lower()
+    if provider not in _SUPPORTED:
+        raise ValueError(f'Unknown provision provider {provider_name!r}; '
+                         f'supported: {_SUPPORTED}')
+    module = importlib.import_module(
+        f'skypilot_tpu.provision.{provider}.instance')
+    impl = getattr(module, op, None)
+    if impl is None:
+        raise NotImplementedError(
+            f'provider {provider!r} does not implement {op!r}')
+    return impl(*args, **kwargs)
+
+
+# --------------------------------------------------------------- lifecycle
+def bootstrap_config(provider_name: str,
+                     config: ProvisionConfig) -> ProvisionConfig:
+    """One-time per-launch environment prep (VPC/firewall/IAM).
+
+    Reference: sky/provision/__init__.py bootstrap_instances."""
+    return _route(provider_name, 'bootstrap_config', config)
+
+
+def run_instances(provider_name: str,
+                  config: ProvisionConfig) -> ProvisionRecord:
+    """Create (or resume) all hosts of the cluster. For TPU slices this is
+    ONE atomic queued-resource request, not per-VM calls."""
+    return _route(provider_name, 'run_instances', config)
+
+
+def wait_instances(provider_name: str, region: str, cluster_name: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout: float = 1200.0) -> None:
+    return _route(provider_name, 'wait_instances', region, cluster_name,
+                  state, provider_config=provider_config, timeout=timeout)
+
+
+def stop_instances(provider_name: str, cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    return _route(provider_name, 'stop_instances', cluster_name,
+                  provider_config)
+
+
+def terminate_instances(provider_name: str, cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    return _route(provider_name, 'terminate_instances', cluster_name,
+                  provider_config)
+
+
+def query_instances(provider_name: str, cluster_name: str,
+                    provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    """instance_id -> status string ('running'/'stopped'/'terminated'/...)."""
+    return _route(provider_name, 'query_instances', cluster_name,
+                  provider_config)
+
+
+def get_cluster_info(provider_name: str, region: Optional[str],
+                     cluster_name: str,
+                     provider_config: Dict[str, Any]) -> ClusterInfo:
+    return _route(provider_name, 'get_cluster_info', region, cluster_name,
+                  provider_config)
+
+
+def open_ports(provider_name: str, cluster_name: str, ports: List[int],
+               provider_config: Dict[str, Any]) -> None:
+    return _route(provider_name, 'open_ports', cluster_name, ports,
+                  provider_config)
+
+
+def cleanup_ports(provider_name: str, cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    return _route(provider_name, 'cleanup_ports', cluster_name,
+                  provider_config)
+
+
+__all__ = [
+    'ClusterInfo', 'Endpoint', 'InstanceInfo', 'ProvisionConfig',
+    'ProvisionError', 'ProvisionRecord', 'bootstrap_config',
+    'run_instances', 'wait_instances', 'stop_instances',
+    'terminate_instances', 'query_instances', 'get_cluster_info',
+    'open_ports', 'cleanup_ports',
+]

@@ -1,0 +1,128 @@
+"""Shared provisioner dataclasses.
+
+Reference: sky/provision/common.py:39-264 (ProvisionConfig, ProvisionRecord,
+InstanceInfo, ClusterInfo, Endpoint hierarchy). TPU-first difference: a
+"node" here is a *host of a pod slice*; for TPU clusters all hosts are
+created/deleted atomically by one queued-resource operation, so the
+bootstrapping surface is far smaller than the reference's per-VM path.
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a cloud module needs to create the cluster.
+
+    Reference: sky/provision/common.py:63 ProvisionConfig.
+    """
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name: str
+    num_nodes: int
+    # Opaque per-cloud node properties (machine type, tpu topology,
+    # runtime_version, spot, labels, ...).
+    node_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    authentication_config: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
+    ports_to_open: List[int] = dataclasses.field(default_factory=list)
+    # Filled by bootstrapping (VPC, firewall, service account).
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances: what was created/resumed where.
+
+    Reference: sky/provision/common.py:92 ProvisionRecord.
+    """
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name: str
+    head_instance_id: str
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    created_instance_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.resumed_instance_ids or
+                instance_id in self.created_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One host. Reference: sky/provision/common.py:109 InstanceInfo."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    ssh_port: int = 22
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Full post-provision cluster description.
+
+    Reference: sky/provision/common.py:233 ClusterInfo.
+    """
+    provider_name: str
+    head_instance_id: str
+    # instance_id -> InstanceInfo, ordered: head first, then by rank.
+    instances: Dict[str, InstanceInfo] = dataclasses.field(
+        default_factory=dict)
+    ssh_user: str = ''
+    ssh_key_path: Optional[str] = None
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    custom_envs: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def instance_ids(self) -> List[str]:
+        ids = [self.head_instance_id]
+        ids += [i for i in self.instances if i != self.head_instance_id]
+        return ids
+
+    def ordered(self) -> List[InstanceInfo]:
+        return [self.instances[i] for i in self.instance_ids()]
+
+    def internal_ips(self) -> List[str]:
+        return [i.internal_ip for i in self.ordered()]
+
+    def external_ips(self) -> List[str]:
+        return [i.get_feasible_ip() for i in self.ordered()]
+
+    def num_instances(self) -> int:
+        return len(self.instances)
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """An exposed (ip, port). Reference: sky/provision/common.py:264."""
+    host: str
+    port: int
+
+    def url(self, scheme: str = 'http') -> str:
+        return f'{scheme}://{self.host}:{self.port}'
+
+
+class ProvisionError(exceptions.ProvisionerError):
+    """Raised by cloud modules on unrecoverable provisioning failure.
+
+    Carries structured info so the failover loop
+    (backends/failover.py) can decide what to blocklist — the analog of the
+    reference's FailoverCloudErrorHandler parsing
+    (sky/backends/cloud_vm_ray_backend.py:697,905).
+    """
+
+    def __init__(self, message: str, *,
+                 blocked_zone: Optional[str] = None,
+                 blocked_region: Optional[str] = None,
+                 retryable: bool = True) -> None:
+        super().__init__(message)
+        self.blocked_zone = blocked_zone
+        self.blocked_region = blocked_region
+        self.retryable = retryable

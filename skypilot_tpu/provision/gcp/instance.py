@@ -1,0 +1,354 @@
+"""GCP TPU-VM provisioner ops (queued-resources first).
+
+Reference: sky/provision/gcp/instance.py + instance_utils.py:1185
+(GCPTPUVMInstance). TPU-first redesign:
+ - A cluster IS one TPU pod slice: provisioning is a single atomic
+   queuedResources request (all hosts or nothing) instead of the
+   reference's N-VM loop — gang allocation comes from the platform.
+ - Preemption semantics: spot/preemptible TPU slices are DELETED by GCP,
+   never stopped (the reference special-cases this at
+   sky/clouds/gcp.py:184-190); recovery is re-acquisition, which the
+   managed-jobs layer drives.
+ - SSH keys are injected via node metadata patch (reference:
+   instance_utils.py:1340).
+
+node_config keys consumed here:
+  accelerator_type ('v5litepod-16'), runtime_version ('tpu-ubuntu2204-base'),
+  spot (bool), reserved (bool), network/subnetwork, tags, metadata (dict),
+  ssh_public_key (str).
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import tpu_api
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# GCP labels marking our clusters (reference uses ray-cluster-name tags).
+_CLUSTER_LABEL = 'skyt-cluster-name'
+
+_CREATING_STATES = ('CREATING', 'ACCEPTED', 'PROVISIONING', 'WAITING_FOR_'
+                    'RESOURCES')
+_QR_TERMINAL_BAD = ('FAILED', 'SUSPENDED')
+
+
+def _project_zone(provider_config: Dict[str, Any]):
+    project = provider_config.get('project') or tpu_api.default_project()
+    zone = provider_config.get('availability_zone') or provider_config.get(
+        'zone')
+    if not project or not zone:
+        raise common.ProvisionError(
+            f'gcp provider_config needs project+zone, got {provider_config}',
+            retryable=False)
+    return project, zone
+
+
+def bootstrap_config(config: common.ProvisionConfig
+                     ) -> common.ProvisionConfig:
+    """Fill provider defaults. Firewall/VPC bootstrap is handled lazily by
+    open_ports; TPU VMs land on the default VPC otherwise (the reference's
+    heavyweight VPC/IAM bootstrap, sky/provision/gcp/config.py, is only
+    needed for its custom-VPC config paths)."""
+    pc = config.provider_config
+    pc.setdefault('project', tpu_api.default_project())
+    pc.setdefault('availability_zone', config.zone)
+    # Keep node network tags in provider_config so open_ports (which only
+    # receives provider_config) targets the same tags.
+    pc.setdefault('tags', config.node_config.get('tags', ['skyt']))
+    return config
+
+
+def _node_body(config: common.ProvisionConfig) -> Dict[str, Any]:
+    nc = config.node_config
+    metadata = dict(nc.get('metadata', {}))
+    ssh_key = nc.get('ssh_public_key')
+    if ssh_key:
+        user = nc.get('ssh_user', 'skyt')
+        metadata['ssh-keys'] = f'{user}:{ssh_key}'
+    body: Dict[str, Any] = {
+        'acceleratorType': nc['accelerator_type'],
+        'runtimeVersion': nc.get('runtime_version', 'tpu-ubuntu2204-base'),
+        'networkConfig': {
+            'network': nc.get('network', 'default'),
+            'enableExternalIps': nc.get('external_ips', True),
+        },
+        'labels': {_CLUSTER_LABEL: config.cluster_name,
+                   **nc.get('labels', {})},
+        'metadata': {k: str(v) for k, v in metadata.items()},
+        'tags': nc.get('tags', ['skyt']),
+    }
+    if nc.get('subnetwork'):
+        body['networkConfig']['subnetwork'] = nc['subnetwork']
+    if nc.get('spot'):
+        body['schedulingConfig'] = {'preemptible': True, 'spot': True}
+    elif nc.get('reserved'):
+        body['schedulingConfig'] = {'reserved': True}
+    if nc.get('service_account'):
+        body['serviceAccount'] = {'email': nc['service_account']}
+    return body
+
+
+def _qr_id(cluster_name: str) -> str:
+    return cluster_name
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    project, zone = _project_zone(config.provider_config)
+    cluster = config.cluster_name
+    node_id = cluster
+
+    # Resume path: node already exists (stopped single-host TPU VM).
+    try:
+        node = tpu_api.get_node(project, zone, node_id)
+    except tpu_api.TpuApiError as e:
+        if e.status != 404:
+            raise _provision_error(e, zone)
+        node = None
+    if node is not None:
+        state = node.get('state')
+        if state == 'READY':
+            return common.ProvisionRecord(
+                'gcp', config.region, zone, cluster, node_id,
+                resumed_instance_ids=[])
+        if state == 'STOPPED':
+            logger.info('Starting stopped TPU %s', node_id)
+            op = tpu_api.start_node(project, zone, node_id)
+            tpu_api.wait_operation(op)
+            return common.ProvisionRecord(
+                'gcp', config.region, zone, cluster, node_id,
+                resumed_instance_ids=[node_id])
+        if state in _CREATING_STATES:
+            return common.ProvisionRecord(
+                'gcp', config.region, zone, cluster, node_id,
+                created_instance_ids=[node_id])
+        raise common.ProvisionError(
+            f'TPU {node_id} in unexpected state {state}', blocked_zone=zone)
+
+    # Fresh acquisition through a queued resource (atomic pod-slice gang).
+    body = {
+        'tpu': {'nodeSpec': [{
+            'parent': f'projects/{project}/locations/{zone}',
+            'nodeId': node_id,
+            'node': _node_body(config),
+        }]},
+    }
+    if config.node_config.get('spot'):
+        body['spot'] = {}
+    else:
+        body['guaranteed'] = {'reserved':
+                              bool(config.node_config.get('reserved'))}
+    valid_until = config.node_config.get('provision_timeout_s')
+    if valid_until:
+        body['queueingPolicy'] = {
+            'validUntilDuration': f'{int(valid_until)}s'}
+    try:
+        tpu_api.create_queued_resource(project, zone, _qr_id(cluster), body)
+    except tpu_api.TpuApiError as e:
+        if e.status == 409:  # already queued — treat as in-progress
+            logger.info('queued resource %s already exists', cluster)
+        else:
+            raise _provision_error(e, zone)
+    return common.ProvisionRecord(
+        'gcp', config.region, zone, cluster, node_id,
+        created_instance_ids=[node_id])
+
+
+def _provision_error(e: 'tpu_api.TpuApiError',
+                     zone: str) -> common.ProvisionError:
+    """Map TPU API errors to failover decisions — the analog of the
+    reference's GCP failover handler (cloud_vm_ray_backend.py:933)."""
+    msg = e.message.lower()
+    out_of_capacity = (e.status == 429 or 'stockout' in msg or
+                       'no more capacity' in msg or
+                       'resources were not found' in msg or
+                       'resource_exhausted' in msg)
+    quota = e.status == 403 and 'quota' in msg
+    if out_of_capacity:
+        return common.ProvisionError(f'capacity: {e}', blocked_zone=zone)
+    if quota:
+        # Quota is per-region: block the whole region, not just the zone.
+        return common.ProvisionError(f'quota: {e}', blocked_region='*')
+    if e.status in (400, 403, 404):
+        return common.ProvisionError(str(e), retryable=False)
+    return common.ProvisionError(str(e), blocked_zone=zone)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout: float = 1200.0) -> None:
+    """Block until the queued resource is ACTIVE and the node READY."""
+    if provider_config is None:
+        raise common.ProvisionError('gcp wait_instances needs '
+                                    'provider_config', retryable=False)
+    project, zone = _project_zone(provider_config)
+    if state != 'running':
+        return
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            qr = tpu_api.get_queued_resource(project, zone,
+                                             _qr_id(cluster_name))
+            raw = qr.get('state')
+            qr_state = raw.get('state') if isinstance(raw, dict) else raw
+        except tpu_api.TpuApiError as e:
+            if e.status != 404:
+                raise _provision_error(e, zone)
+            qr_state = None  # direct node (resume path) or legacy create
+        if qr_state in _QR_TERMINAL_BAD:
+            raise common.ProvisionError(
+                f'queued resource {cluster_name}: {qr_state}',
+                blocked_zone=zone)
+        try:
+            node = tpu_api.get_node(project, zone, cluster_name)
+            if node.get('state') == 'READY':
+                return
+        except tpu_api.TpuApiError as e:
+            if e.status != 404:
+                raise _provision_error(e, zone)
+        time.sleep(10)
+    raise common.ProvisionError(
+        f'TPU {cluster_name} not READY within {timeout}s (still queued?)',
+        blocked_zone=zone)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    project, zone = _project_zone(provider_config)
+    try:
+        node = tpu_api.get_node(project, zone, cluster_name)
+    except tpu_api.TpuApiError as e:
+        raise _provision_error(e, zone)
+    hosts = len(node.get('networkEndpoints', [1]))
+    if hosts > 1:
+        # Pod slices cannot be stopped (reference blocks this too,
+        # sky/clouds/gcp.py:184-190).
+        raise common.ProvisionError(
+            f'TPU pod slice {cluster_name} ({hosts} hosts) cannot be '
+            'stopped; use down/terminate', retryable=False)
+    op = tpu_api.stop_node(project, zone, cluster_name)
+    tpu_api.wait_operation(op)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    project, zone = _project_zone(provider_config)
+    # Deleting the queued resource (force=True) deletes the node(s) too.
+    try:
+        op = tpu_api.delete_queued_resource(project, zone,
+                                            _qr_id(cluster_name))
+        # Wait so an immediate relaunch of the same name doesn't find a
+        # DELETING node and wrongly blocklist the zone.
+        tpu_api.wait_operation(op)
+        return
+    except tpu_api.TpuApiError as e:
+        if e.status != 404:
+            logger.warning('queued-resource delete failed (%s); falling '
+                           'back to node delete', e)
+    try:
+        op = tpu_api.delete_node(project, zone, cluster_name)
+        tpu_api.wait_operation(op)
+    except tpu_api.TpuApiError as e:
+        if e.status != 404:
+            raise _provision_error(e, zone)
+
+
+_STATE_MAP = {
+    'READY': 'running',
+    'CREATING': 'pending',
+    'STARTING': 'pending',
+    'REPAIRING': 'pending',
+    'STOPPED': 'stopped',
+    'STOPPING': 'stopping',
+    'DELETING': 'terminated',
+    'PREEMPTED': 'terminated',
+    'TERMINATED': 'terminated',
+}
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    project, zone = _project_zone(provider_config)
+    try:
+        node = tpu_api.get_node(project, zone, cluster_name)
+    except tpu_api.TpuApiError as e:
+        if e.status == 404:
+            return {}
+        raise _provision_error(e, zone)
+    status = _STATE_MAP.get(node.get('state'), 'unknown')
+    # One entry per host, same id namespace as get_cluster_info / local
+    # provider ('<cluster>-host-<rank>'); a slice is atomic so every host
+    # shares the node's state.
+    n_hosts = max(len(node.get('networkEndpoints', [])), 1)
+    return {f'{cluster_name}-host-{r}': status for r in range(n_hosts)}
+
+
+def get_cluster_info(region: Optional[str], cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    project, zone = _project_zone(provider_config)
+    try:
+        node = tpu_api.get_node(project, zone, cluster_name)
+    except tpu_api.TpuApiError as e:
+        raise _provision_error(e, zone)
+    endpoints = node.get('networkEndpoints', [])
+    instances: Dict[str, common.InstanceInfo] = {}
+    for rank, ep in enumerate(endpoints):
+        iid = f'{cluster_name}-host-{rank}'
+        access = ep.get('accessConfig', {})
+        instances[iid] = common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=ep.get('ipAddress', ''),
+            external_ip=access.get('externalIp'),
+            tags={'rank': str(rank)})
+    return common.ClusterInfo(
+        provider_name='gcp',
+        head_instance_id=f'{cluster_name}-host-0',
+        instances=instances,
+        ssh_user=provider_config.get('ssh_user', 'skyt'),
+        ssh_key_path=provider_config.get('ssh_private_key'),
+        provider_config=dict(provider_config))
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               provider_config: Dict[str, Any]) -> None:
+    """Create a firewall rule for the cluster's network tag via the compute
+    REST API (reference: sky/provision/gcp/config.py firewall bootstrap)."""
+    if not ports:
+        return
+    project, _ = _project_zone(provider_config)
+    import requests as _requests
+    rule = {
+        'name': f'skyt-{cluster_name}-ports',
+        'direction': 'INGRESS',
+        'allowed': [{'IPProtocol': 'tcp',
+                     'ports': [str(p) for p in ports]}],
+        'sourceRanges': ['0.0.0.0/0'],
+        # Must match the network tags on the node (_node_body default).
+        'targetTags': provider_config.get('tags', ['skyt']),
+    }
+    resp = _requests.post(
+        f'https://compute.googleapis.com/compute/v1/projects/{project}'
+        '/global/firewalls',
+        headers={'Authorization': f'Bearer {tpu_api.access_token()}'},
+        json=rule, timeout=60)
+    if resp.status_code == 409:
+        return  # already exists
+    if resp.status_code >= 400:
+        raise common.ProvisionError(
+            f'open_ports {ports} failed ({resp.status_code}): {resp.text}',
+            retryable=False)
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    project, _ = _project_zone(provider_config)
+    import requests as _requests
+    resp = _requests.delete(
+        f'https://compute.googleapis.com/compute/v1/projects/{project}'
+        f'/global/firewalls/skyt-{cluster_name}-ports',
+        headers={'Authorization': f'Bearer {tpu_api.access_token()}'},
+        timeout=60)
+    if resp.status_code >= 400 and resp.status_code != 404:
+        logger.warning('cleanup_ports failed (%d)', resp.status_code)

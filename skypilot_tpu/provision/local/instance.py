@@ -1,0 +1,270 @@
+"""Local provisioner: multi-"host" clusters as per-host directories.
+
+This is the offline/dev provider — the fake multi-host harness the
+reference lacks (SURVEY.md §4 implication). A cluster of N hosts is N
+directories under SKYT_LOCAL_ROOT (default ~/.skyt_local), each with its
+own HOME/SKYT_AGENT_HOME; every host runs a real agent daemon
+(runtime/agent.py) as a subprocess on 127.0.0.1, with one shared head HTTP
+port. The backend then exercises the exact same code paths (HTTP submit,
+gang fan-out, log tail) it uses against real TPU hosts over SSH.
+
+Reference analog: none (SkyPilot's LocalDockerBackend is the closest,
+sky/backends/local_docker_backend.py) — but here it is a first-class
+provider so the entire CLI stack is testable with zero cloud access.
+"""
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def local_root() -> str:
+    d = os.environ.get('SKYT_LOCAL_ROOT',
+                       os.path.expanduser('~/.skyt_local'))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(local_root(), cluster_name)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), 'meta.json')
+
+
+def _host_dir(cluster_name: str, rank: int) -> str:
+    return os.path.join(_cluster_dir(cluster_name), f'host-{rank}')
+
+
+def _load_meta(cluster_name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_meta_path(cluster_name), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _save_meta(cluster_name: str, meta: Dict[str, Any]) -> None:
+    os.makedirs(_cluster_dir(cluster_name), exist_ok=True)
+    with open(_meta_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _agent_pid(cluster_name: str, rank: int) -> Optional[int]:
+    path = os.path.join(_host_dir(cluster_name, rank), '.skyt', 'agent.pid')
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if pid is None:
+        return False
+    try:
+        # Reap if it is our own exited child (otherwise it stays a zombie
+        # and kill(pid, 0) keeps succeeding).
+        os.waitpid(pid, os.WNOHANG)
+    except OSError:
+        pass
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            return f.read().split(')')[-1].split()[0] != 'Z'
+    except OSError:
+        return True
+
+
+# ------------------------------------------------------------------ ops
+def bootstrap_config(config: common.ProvisionConfig
+                     ) -> common.ProvisionConfig:
+    config.provider_config.setdefault('root', local_root())
+    return config
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cluster = config.cluster_name
+    meta = _load_meta(cluster)
+    created: List[str] = []
+    resumed: List[str] = []
+    if meta is None:
+        meta = {
+            'num_nodes': config.num_nodes,
+            'head_port': _free_port(),
+            'coordinator_port': _free_port(),
+            'accelerators_per_node':
+                config.node_config.get('accelerators_per_node', 0),
+        }
+        _save_meta(cluster, meta)
+    if meta['num_nodes'] != config.num_nodes:
+        raise common.ProvisionError(
+            f'cluster {cluster} exists with {meta["num_nodes"]} nodes; '
+            f'requested {config.num_nodes}', retryable=False)
+
+    ips = ['127.0.0.1'] * meta['num_nodes']
+    for rank in range(meta['num_nodes']):
+        iid = f'{cluster}-host-{rank}'
+        if _pid_alive(_agent_pid(cluster, rank)):
+            resumed.append(iid)
+            continue
+        _start_agent(cluster, rank, meta, ips)
+        created.append(iid)
+    config.provider_config['head_port'] = meta['head_port']
+    return common.ProvisionRecord(
+        provider_name='local', region='local', zone=None,
+        cluster_name=cluster, head_instance_id=f'{cluster}-host-0',
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def _start_agent(cluster: str, rank: int, meta: Dict[str, Any],
+                 ips: List[str]) -> None:
+    host_dir = _host_dir(cluster, rank)
+    skyt = os.path.join(host_dir, '.skyt')
+    os.makedirs(skyt, exist_ok=True)
+    agent_cfg = {
+        'cluster_name': cluster,
+        'num_nodes': meta['num_nodes'],
+        'rank': rank,
+        'ips': ips,
+        'head_ip': '127.0.0.1',
+        'head_port': meta['head_port'],
+        'coordinator_port': meta['coordinator_port'],
+        'accelerators_per_node': meta.get('accelerators_per_node', 0),
+        'cloud': 'local',
+    }
+    cfg_path = os.path.join(skyt, 'agent.json')
+    with open(cfg_path, 'w', encoding='utf-8') as f:
+        json.dump(agent_cfg, f)
+    env = dict(os.environ)
+    env['HOME'] = host_dir
+    env['SKYT_AGENT_HOME'] = host_dir
+    log_f = open(os.path.join(skyt, 'agent.out'), 'a',  # noqa: SIM115
+                 encoding='utf-8')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.runtime.agent',
+         '--config', cfg_path, '--foreground'],
+        env=env, stdout=log_f, stderr=subprocess.STDOUT,
+        start_new_session=True)
+    # --foreground keeps the child as our direct child; record its pid
+    # ourselves (the daemonized path writes its own pid file).
+    with open(os.path.join(skyt, 'agent.pid'), 'w', encoding='utf-8') as f:
+        f.write(str(proc.pid))
+    logger.debug('local agent rank %d for %s: pid %d', rank, cluster,
+                 proc.pid)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = 'running',
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout: float = 30.0) -> None:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise common.ProvisionError(f'no such local cluster {cluster_name}')
+    if state != 'running':
+        return
+    deadline = time.time() + timeout
+    port = meta['head_port']
+    while time.time() < deadline:
+        try:
+            with socket.create_connection(('127.0.0.1', port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise common.ProvisionError(
+        f'local cluster {cluster_name}: head agent did not come up on '
+        f'port {port}')
+
+
+def _kill_agents(cluster_name: str) -> None:
+    meta = _load_meta(cluster_name) or {}
+    for rank in range(meta.get('num_nodes', 0)):
+        pid = _agent_pid(cluster_name, rank)
+        if _pid_alive(pid):
+            try:
+                os.killpg(os.getpgid(pid), signal.SIGTERM)
+            except OSError:
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                except OSError:
+                    pass
+    # Give agents a moment to exit before callers reuse ports/dirs.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not any(_pid_alive(_agent_pid(cluster_name, r))
+                   for r in range(meta.get('num_nodes', 0))):
+            return
+        time.sleep(0.1)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Dict[str, Any]) -> None:
+    _kill_agents(cluster_name)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Dict[str, Any]) -> None:
+    _kill_agents(cluster_name)
+    shutil.rmtree(_cluster_dir(cluster_name), ignore_errors=True)
+
+
+def query_instances(cluster_name: str, provider_config: Dict[str, Any]
+                    ) -> Dict[str, Optional[str]]:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return {}
+    out: Dict[str, Optional[str]] = {}
+    for rank in range(meta['num_nodes']):
+        alive = _pid_alive(_agent_pid(cluster_name, rank))
+        out[f'{cluster_name}-host-{rank}'] = (
+            'running' if alive else 'stopped')
+    return out
+
+
+def get_cluster_info(region: Optional[str], cluster_name: str,
+                     provider_config: Dict[str, Any]) -> common.ClusterInfo:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise common.ProvisionError(f'no such local cluster {cluster_name}')
+    instances = {}
+    for rank in range(meta['num_nodes']):
+        iid = f'{cluster_name}-host-{rank}'
+        instances[iid] = common.InstanceInfo(
+            instance_id=iid, internal_ip='127.0.0.1', external_ip=None,
+            ssh_port=0, tags={'rank': str(rank),
+                              'host_dir': _host_dir(cluster_name, rank)})
+    return common.ClusterInfo(
+        provider_name='local', head_instance_id=f'{cluster_name}-host-0',
+        instances=instances, ssh_user=os.environ.get('USER', 'root'),
+        provider_config={'head_port': meta['head_port'],
+                         'root': local_root()})
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               provider_config: Dict[str, Any]) -> None:
+    pass  # localhost: nothing to open
+
+
+def cleanup_ports(cluster_name: str,
+                  provider_config: Dict[str, Any]) -> None:
+    pass

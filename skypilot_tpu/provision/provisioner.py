@@ -1,0 +1,209 @@
+"""Provisioning orchestrator: create hosts → wait → runtime setup.
+
+Reference: sky/provision/provisioner.py (bulk_provision :123,
+teardown_cluster :219, wait_for_ssh :365, post_provision_runtime_setup
+:557) + sky/provision/instance_setup.py. The runtime setup here is ~10x
+smaller than the reference's because there is no Ray to install and no
+wheel to ship for the common case: hosts get an agent.json + the
+skypilot_tpu package (rsynced when absent) and start
+`python -m skypilot_tpu.runtime.agent`.
+"""
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import provision
+from skypilot_tpu.provision import common
+from skypilot_tpu.runtime import gang as gang_lib
+from skypilot_tpu.runtime import server as server_lib
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+logger = log_utils.init_logger(__name__)
+
+_MAX_RETRY = 3
+SSH_WAIT_TIMEOUT_S = 600
+
+
+@timeline.event
+def bulk_provision(provider_name: str,
+                   config: common.ProvisionConfig) -> common.ProvisionRecord:
+    """Create all hosts, retrying transient failures.
+
+    Reference: sky/provision/provisioner.py:123 bulk_provision."""
+    config = provision.bootstrap_config(provider_name, config)
+    last_err: Optional[Exception] = None
+    for attempt in range(_MAX_RETRY):
+        try:
+            record = provision.run_instances(provider_name, config)
+            _wait(provider_name, config, record)
+            return record
+        except common.ProvisionError as e:
+            if not e.retryable or e.blocked_zone or e.blocked_region:
+                raise  # failover decision belongs to the caller
+            last_err = e
+            logger.warning('provision attempt %d/%d failed: %s',
+                           attempt + 1, _MAX_RETRY, e)
+            time.sleep(2 * (attempt + 1))
+    assert last_err is not None
+    raise last_err
+
+
+def _wait(provider_name: str, config: common.ProvisionConfig,
+          record: common.ProvisionRecord) -> None:
+    provision.wait_instances(
+        provider_name, config.region, config.cluster_name, 'running',
+        provider_config=config.provider_config,
+        timeout=config.node_config.get('provision_timeout_s', 1200))
+
+
+@timeline.event
+def teardown_cluster(provider_name: str, cluster_name: str,
+                     provider_config: Dict[str, Any],
+                     terminate: bool = True) -> None:
+    """Reference: sky/provision/provisioner.py:219."""
+    if terminate:
+        provision.terminate_instances(provider_name, cluster_name,
+                                      provider_config)
+        try:
+            provision.cleanup_ports(provider_name, cluster_name,
+                                    provider_config)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('cleanup_ports: %s', e)
+    else:
+        provision.stop_instances(provider_name, cluster_name,
+                                 provider_config)
+
+
+def get_command_runners(cluster_info: common.ClusterInfo
+                        ) -> List[command_runner.CommandRunner]:
+    """One runner per host, head first.
+
+    Reference: CloudVmRayResourceHandle.get_command_runners
+    (sky/backends/cloud_vm_ray_backend.py:2344)."""
+    runners: List[command_runner.CommandRunner] = []
+    for info in cluster_info.ordered():
+        if cluster_info.provider_name == 'local':
+            runners.append(command_runner.LocalProcessRunner(
+                info.tags['host_dir'], rank=int(info.tags.get('rank', 0))))
+        else:
+            runners.append(command_runner.SSHCommandRunner(
+                info.get_feasible_ip(),
+                ssh_user=cluster_info.ssh_user,
+                ssh_private_key=cluster_info.ssh_key_path,
+                port=info.ssh_port or 22))
+    return runners
+
+
+@timeline.event
+def wait_for_ssh(cluster_info: common.ClusterInfo,
+                 timeout: float = SSH_WAIT_TIMEOUT_S) -> None:
+    """Block until every host answers a trivial command.
+
+    Reference: sky/provision/provisioner.py:365 wait_for_ssh."""
+    runners = get_command_runners(cluster_info)
+
+    def _probe(runner: command_runner.CommandRunner) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if runner.check_connection():
+                return
+            time.sleep(5)
+        raise common.ProvisionError(
+            f'host {runner.node_id} unreachable after {timeout}s')
+
+    subprocess_utils.run_in_parallel(_probe, runners)
+
+
+@timeline.event
+def post_provision_runtime_setup(
+        provider_name: str,
+        cluster_name: str,
+        cluster_info: common.ClusterInfo,
+        *,
+        accelerators_per_node: int = 0,
+        head_port: Optional[int] = None,
+        envs: Optional[Dict[str, str]] = None) -> None:
+    """Install + start the per-host agent on every host (head first so
+    workers find the coordinator HTTP server up).
+
+    Reference: sky/provision/provisioner.py:557
+    post_provision_runtime_setup + instance_setup.py
+    start_ray_on_head_node/start_skylet_on_head_node — collapsed to one
+    step because the agent IS both the gang scheduler and the skylet.
+    """
+    if provider_name == 'local':
+        # Local provider starts agents itself in run_instances (the agent
+        # subprocess needs this interpreter's environment).
+        return
+    runners = get_command_runners(cluster_info)
+    ips = cluster_info.internal_ips()
+    head_port = head_port or server_lib.DEFAULT_AGENT_PORT
+
+    def _setup_host(idx_runner) -> None:
+        rank, runner = idx_runner
+        agent_cfg = {
+            'cluster_name': cluster_name,
+            'num_nodes': len(ips),
+            'rank': rank,
+            'ips': ips,
+            'head_ip': ips[0],
+            'head_port': head_port,
+            'coordinator_port': gang_lib.DEFAULT_COORDINATOR_PORT,
+            'accelerators_per_node': accelerators_per_node,
+            'cloud': provider_name,
+        }
+        with tempfile.NamedTemporaryFile('w', suffix='.json',
+                                         delete=False) as f:
+            json.dump(agent_cfg, f)
+            local_cfg = f.name
+        try:
+            runner.run('mkdir -p ~/.skyt', stream_logs=False)
+            runner.rsync(local_cfg, '.skyt/agent.json', up=True)
+            _ensure_package(runner)
+            # Idempotent start: skip if the pid in agent.pid is alive.
+            # PYTHONPATH is set inline (non-interactive SSH shells do not
+            # read ~/.bashrc); the agent passes its env to jobs, so jobs
+            # see the package too.
+            runner.run_or_raise(
+                'if [ -f ~/.skyt/agent.pid ] && '
+                'kill -0 $(cat ~/.skyt/agent.pid) 2>/dev/null; then '
+                'echo agent already running; else '
+                'PYTHONPATH="$HOME/.skyt/lib:$PYTHONPATH" '
+                f'{_python()} -m skypilot_tpu.runtime.agent '
+                '--config ~/.skyt/agent.json; fi',
+                failure_message=f'agent start failed on rank {rank}')
+        finally:
+            os.unlink(local_cfg)
+
+    # Head (rank 0) first, then workers in parallel.
+    _setup_host((0, runners[0]))
+    if len(runners) > 1:
+        subprocess_utils.run_in_parallel(_setup_host,
+                                         list(enumerate(runners))[1:])
+
+
+def _python() -> str:
+    return 'python3'
+
+
+def _ensure_package(runner: command_runner.CommandRunner) -> None:
+    """Ship the skypilot_tpu package to the host if it can't import it.
+
+    Reference analog: wheel build+ship (sky/backends/wheel_utils.py:136);
+    here a plain rsync of the source tree into ~/.skyt/lib + PYTHONPATH
+    in the agent env, no wheel build needed.
+    """
+    rc, _, _ = runner.run(
+        f'{_python()} -c "import skypilot_tpu" 2>/dev/null',
+        require_outputs=True, stream_logs=False)
+    if rc == 0:
+        return
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runner.run('mkdir -p ~/.skyt/lib', stream_logs=False)
+    runner.rsync(pkg_dir, '.skyt/lib/', up=True,
+                 excludes=['__pycache__', '*.pyc'])

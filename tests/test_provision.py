@@ -1,0 +1,238 @@
+"""Provision layer tests: dispatch, local provider end-to-end, GCP
+error-mapping (mocked HTTP).
+
+Reference test analog: the reference has no provisioner unit tests (it
+relies on smoke tests, SURVEY.md §4.4); the local provider makes this
+layer testable offline.
+"""
+import os
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu import provision
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import provisioner
+
+
+def test_dispatch_unknown_provider():
+    with pytest.raises(ValueError, match='Unknown provision provider'):
+        provision.query_instances('nope', 'c', {})
+
+
+@pytest.fixture()
+def local_cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYT_LOCAL_ROOT', str(tmp_path / 'local'))
+    name = 'prov-test'
+    cfg = common.ProvisionConfig(provider_name='local', region='local',
+                                 zone=None, cluster_name=name, num_nodes=2)
+    yield name, cfg
+    provisioner.teardown_cluster('local', name, {}, terminate=True)
+
+
+def _wait_job(port, jid, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = requests.get(f'http://127.0.0.1:{port}/jobs/{jid}',
+                          timeout=5).json()
+        if st['status'] in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
+                            'CANCELLED'):
+            return st
+        time.sleep(0.3)
+    raise TimeoutError(f'job {jid} did not finish')
+
+
+def test_local_provision_gang_job(local_cluster):
+    name, cfg = local_cluster
+    record = provisioner.bulk_provision('local', cfg)
+    assert record.head_instance_id == f'{name}-host-0'
+    assert len(record.created_instance_ids) == 2
+
+    statuses = provision.query_instances('local', name, {})
+    assert all(s == 'running' for s in statuses.values())
+
+    info = provision.get_cluster_info('local', 'local', name,
+                                      cfg.provider_config)
+    assert info.num_instances() == 2
+    port = info.provider_config['head_port']
+
+    # Gang job across both "hosts" with the rank/env contract.
+    resp = requests.post(
+        f'http://127.0.0.1:{port}/jobs/submit',
+        json={'spec': {'name': 'hello', 'num_nodes': 2, 'envs': {},
+                       'run': 'echo rank $SKYT_NODE_RANK '
+                              'coord $SKYT_COORDINATOR_ADDRESS'}},
+        timeout=5)
+    jid = resp.json()['job_id']
+    st = _wait_job(port, jid)
+    assert st['status'] == 'SUCCEEDED'
+    assert len(st['gang']) == 2
+    assert all(g['returncode'] == 0 for g in st['gang'])
+
+    # Both ranks wrote logs in their own host dir.
+    root = os.environ['SKYT_LOCAL_ROOT']
+    for rank in range(2):
+        log = os.path.join(root, name, f'host-{rank}', '.skyt', 'logs',
+                           str(jid), f'rank-{rank}.log')
+        content = open(log, encoding='utf-8').read()
+        assert f'rank {rank}' in content
+
+    # Idempotent re-provision resumes, not creates.
+    record2 = provisioner.bulk_provision('local', cfg)
+    assert record2.created_instance_ids == []
+    assert len(record2.resumed_instance_ids) == 2
+
+
+def test_local_stop_and_terminate(local_cluster):
+    name, cfg = local_cluster
+    provisioner.bulk_provision('local', cfg)
+    provision.stop_instances('local', name, {})
+    statuses = provision.query_instances('local', name, {})
+    assert all(s == 'stopped' for s in statuses.values())
+    provision.terminate_instances('local', name, {})
+    assert provision.query_instances('local', name, {}) == {}
+
+
+# ----------------------------------------------------------------- GCP
+class _FakeResp:
+    def __init__(self, status, payload):
+        self.status_code = status
+        self._payload = payload
+        self.content = b'x'
+        self.text = str(payload)
+
+    def json(self):
+        return self._payload
+
+
+def _fake_session(responses):
+    """responses: list of (method, path_substr, status, payload)."""
+    calls = []
+
+    class _Sess:
+        def request(self, method, url, **kwargs):
+            calls.append((method, url))
+            for m, sub, status, payload in responses:
+                if m == method and sub in url:
+                    return _FakeResp(status, payload)
+            return _FakeResp(404, {'error': {'message': 'not found'}})
+
+    return _Sess, calls
+
+
+def test_gcp_capacity_error_blocks_zone(monkeypatch):
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    sess, _ = _fake_session([
+        ('GET', '/nodes/c1', 404, {'error': {'message': 'not found'}}),
+        ('POST', '/queuedResources', 429,
+         {'error': {'message': 'There is no more capacity in the zone'}}),
+    ])
+    monkeypatch.setattr(tpu_api, '_session', sess)
+    cfg = common.ProvisionConfig(
+        provider_name='gcp', region='us-central2', zone='us-central2-b',
+        cluster_name='c1', num_nodes=4,
+        node_config={'accelerator_type': 'v4-32'},
+        provider_config={'project': 'p', 'availability_zone':
+                         'us-central2-b'})
+    with pytest.raises(common.ProvisionError) as exc:
+        gcp_instance.run_instances(cfg)
+    assert exc.value.blocked_zone == 'us-central2-b'
+
+
+def test_gcp_quota_error_blocks_region(monkeypatch):
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    sess, _ = _fake_session([
+        ('GET', '/nodes/c1', 404, {'error': {'message': 'not found'}}),
+        ('POST', '/queuedResources', 403,
+         {'error': {'message': 'Quota exceeded for TPU v5e cores'}}),
+    ])
+    monkeypatch.setattr(tpu_api, '_session', sess)
+    cfg = common.ProvisionConfig(
+        provider_name='gcp', region='us-west4', zone='us-west4-a',
+        cluster_name='c1', num_nodes=4,
+        node_config={'accelerator_type': 'v5litepod-16'},
+        provider_config={'project': 'p', 'availability_zone': 'us-west4-a'})
+    with pytest.raises(common.ProvisionError) as exc:
+        gcp_instance.run_instances(cfg)
+    assert exc.value.blocked_region == '*'
+
+
+def test_gcp_queued_resource_body(monkeypatch):
+    """The queued-resource request carries the pod-slice node spec."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    bodies = {}
+
+    class _Sess:
+        def request(self, method, url, data=None, **kwargs):
+            if method == 'GET' and '/nodes/' in url:
+                return _FakeResp(404, {'error': {'message': 'nf'}})
+            if method == 'POST' and '/queuedResources' in url:
+                import json as _json
+                bodies.update(_json.loads(data))
+                return _FakeResp(200, {'name': 'op/1'})
+            return _FakeResp(404, {'error': {'message': 'nf'}})
+
+    monkeypatch.setattr(tpu_api, '_session', lambda: _Sess())
+    cfg = common.ProvisionConfig(
+        provider_name='gcp', region='us-west4', zone='us-west4-a',
+        cluster_name='tr-16', num_nodes=4,
+        node_config={'accelerator_type': 'v5litepod-16', 'spot': True,
+                     'runtime_version': 'v2-alpha-tpuv5-lite',
+                     'ssh_public_key': 'ssh-ed25519 AAAA test'},
+        provider_config={'project': 'p', 'availability_zone': 'us-west4-a'})
+    record = gcp_instance.run_instances(cfg)
+    assert record.created_instance_ids == ['tr-16']
+    assert 'spot' in bodies
+    node = bodies['tpu']['nodeSpec'][0]['node']
+    assert node['acceleratorType'] == 'v5litepod-16'
+    assert node['schedulingConfig']['preemptible'] is True
+    assert 'ssh-keys' in node['metadata']
+
+
+def test_gcp_state_mapping(monkeypatch):
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    sess, _ = _fake_session([
+        ('GET', '/nodes/c1', 200, {'state': 'PREEMPTED'}),
+    ])
+    monkeypatch.setattr(tpu_api, '_session', sess)
+    out = gcp_instance.query_instances(
+        'c1', {'project': 'p', 'availability_zone': 'z'})
+    # Per-host id namespace, matching get_cluster_info / local provider.
+    assert out == {'c1-host-0': 'terminated'}
+
+
+def test_gcp_cluster_info_ranks(monkeypatch):
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    sess, _ = _fake_session([
+        ('GET', '/nodes/pod', 200, {
+            'state': 'READY',
+            'networkEndpoints': [
+                {'ipAddress': '10.0.0.2',
+                 'accessConfig': {'externalIp': '34.1.1.2'}},
+                {'ipAddress': '10.0.0.3',
+                 'accessConfig': {'externalIp': '34.1.1.3'}},
+            ]}),
+    ])
+    monkeypatch.setattr(tpu_api, '_session', sess)
+    info = gcp_instance.get_cluster_info(
+        'us-west4', 'pod', {'project': 'p', 'availability_zone': 'z',
+                            'ssh_user': 'me'})
+    assert info.internal_ips() == ['10.0.0.2', '10.0.0.3']
+    assert info.external_ips() == ['34.1.1.2', '34.1.1.3']
+    assert info.head_instance_id == 'pod-host-0'
