@@ -1,0 +1,5 @@
+"""`python -m skypilot_tpu` → the skyt CLI."""
+from skypilot_tpu.cli import main
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,65 @@
+"""Abstract execution backend.
+
+Reference: sky/backends/backend.py:22-121 — the 8-method contract the
+whole system compiles to (provision / sync_workdir / sync_file_mounts /
+setup / execute / teardown + handle plumbing).
+"""
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Pickleable pointer to a launched cluster.
+
+    Reference: sky/backends/backend.py:22 Backend.ResourceHandle."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleT = TypeVar('_HandleT', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleT]):
+    """Reference: sky/backends/backend.py:28 Backend."""
+
+    NAME = 'backend'
+
+    # --------------------------------------------------------- lifecycle
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional[Any],
+                  *,
+                  dryrun: bool = False,
+                  stream_logs: bool = True,
+                  cluster_name: Optional[str] = None,
+                  retry_until_up: bool = False) -> Optional[_HandleT]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleT, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleT,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleT, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: _HandleT, task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        """Submit the task; returns the job id (None on dryrun)."""
+        raise NotImplementedError
+
+    def teardown(self, handle: _HandleT, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- info
+    def register_info(self, **kwargs: Any) -> None:
+        """Optimizer/requested-feature info (reference backend.py:50)."""
+        del kwargs

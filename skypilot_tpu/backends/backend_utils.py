@@ -1,0 +1,181 @@
+"""Backend utilities: head-agent client, cluster status refresh.
+
+Reference: sky/backends/backend_utils.py (status refresh state machine
+:1790, get_clusters :2423) — shrunk because there is no cluster YAML, no
+SSH config juggling, and no `ray status` parsing: cluster health is
+(a) provider instance states and (b) the head agent's /health endpoint.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import state
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+_HEALTH_TIMEOUT_S = 5
+
+
+class HeadClient:
+    """HTTP client to a cluster's head agent (runtime/server.py API)."""
+
+    def __init__(self, base_url: str) -> None:
+        self.base_url = base_url.rstrip('/')
+
+    # ------------------------------------------------------------ basics
+    def health(self, timeout: float = _HEALTH_TIMEOUT_S) -> Optional[Dict]:
+        try:
+            resp = requests.get(f'{self.base_url}/health', timeout=timeout)
+            resp.raise_for_status()
+            return resp.json()
+        except requests.RequestException:
+            return None
+
+    def submit(self, spec: Dict[str, Any]) -> int:
+        resp = requests.post(f'{self.base_url}/jobs/submit',
+                             json={'spec': spec}, timeout=30)
+        resp.raise_for_status()
+        return resp.json()['job_id']
+
+    def jobs(self, statuses: Optional[List[str]] = None
+             ) -> List[Dict[str, Any]]:
+        params = [('status', s) for s in (statuses or [])]
+        resp = requests.get(f'{self.base_url}/jobs', params=params,
+                            timeout=30)
+        resp.raise_for_status()
+        return resp.json()['jobs']
+
+    def job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        resp = requests.get(f'{self.base_url}/jobs/{job_id}', timeout=30)
+        if resp.status_code == 404:
+            return None
+        resp.raise_for_status()
+        return resp.json()
+
+    def cancel(self, job_id: int) -> bool:
+        resp = requests.post(f'{self.base_url}/jobs/{job_id}/cancel',
+                             json={}, timeout=30)
+        resp.raise_for_status()
+        return resp.json().get('cancelled', False)
+
+    def set_autostop(self, idle_minutes: int, down: bool) -> None:
+        resp = requests.post(f'{self.base_url}/autostop',
+                             json={'idle_minutes': idle_minutes,
+                                   'down': down}, timeout=30)
+        resp.raise_for_status()
+
+    def tail_logs(self, job_id: int, *, follow: bool = True,
+                  poll: float = 0.5):
+        """Yield log chunks for a job (head rank-0 log) until terminal."""
+        offset = 0
+        while True:
+            resp = requests.get(f'{self.base_url}/logs/{job_id}',
+                                params={'offset': offset}, timeout=30)
+            if resp.status_code == 404:
+                raise exceptions.JobNotFoundError(f'job {job_id} not found')
+            resp.raise_for_status()
+            out = resp.json()
+            if out['data']:
+                yield out['data']
+            offset = out['offset']
+            if out['done'] and not out['data']:
+                return
+            if not follow and not out['data']:
+                return
+            if not out['data']:
+                time.sleep(poll)
+
+    def wait_job(self, job_id: int, timeout: Optional[float] = None,
+                 poll: float = 1.0) -> Dict[str, Any]:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            job = self.job(job_id)
+            if job is None:
+                raise exceptions.JobNotFoundError(f'job {job_id} vanished')
+            if job['status'] in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP',
+                                 'CANCELLED'):
+                return job
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f'job {job_id} still {job["status"]}')
+            time.sleep(poll)
+
+
+# -------------------------------------------------------------- status
+def refresh_cluster_status(name: str,
+                           handle) -> Optional[state.ClusterStatus]:
+    """3-way reconciliation: provider instance states + head /health.
+
+    Reference: _update_cluster_status_no_lock
+    (sky/backends/backend_utils.py:1790): all running + healthy runtime →
+    UP; all stopped → STOPPED; gone → removed from state; anything else →
+    INIT.
+    """
+    try:
+        statuses = provision.query_instances(handle.provider_name, name,
+                                             handle.provider_config)
+    except exceptions.SkyTpuError as e:
+        logger.warning('status query for %s failed: %s', name, e)
+        return state.get_cluster(name)['status'] if state.get_cluster(
+            name) else None
+    if not statuses:
+        # Cluster no longer exists at the provider (e.g. TPU preempted →
+        # deleted). Drop it from local state.
+        state.remove_cluster(name)
+        return None
+    values = list(statuses.values())
+    if all(v == 'running' for v in values):
+        healthy = HeadClient(handle.head_url()).health() is not None
+        new = (state.ClusterStatus.UP if healthy
+               else state.ClusterStatus.INIT)
+    elif all(v in ('stopped', 'stopping') for v in values):
+        new = state.ClusterStatus.STOPPED
+    elif any(v == 'terminated' for v in values):
+        # Partial termination (TPU slices are atomic so normally all-or-
+        # nothing; treat partial as broken INIT).
+        new = state.ClusterStatus.INIT
+    else:
+        new = state.ClusterStatus.INIT
+    state.update_cluster_status(name, new)
+    return new
+
+
+def get_cluster_record(name: str, *, refresh: bool = False
+                       ) -> Optional[Dict[str, Any]]:
+    record = state.get_cluster(name)
+    if record is None:
+        return None
+    if refresh:
+        status = refresh_cluster_status(name, record['handle'])
+        if status is None:
+            return None
+        record = state.get_cluster(name)
+    return record
+
+
+def get_clusters(*, refresh: bool = False) -> List[Dict[str, Any]]:
+    """Reference: sky/backends/backend_utils.py:2423 get_clusters."""
+    records = state.get_clusters()
+    if not refresh:
+        return records
+    out = []
+    for rec in records:
+        fresh = get_cluster_record(rec['name'], refresh=True)
+        if fresh is not None:
+            out.append(fresh)
+    return out
+
+
+def check_cluster_up(name: str) -> 'Any':
+    """Returns the handle or raises ClusterNotUpError / DoesNotExist."""
+    record = state.get_cluster(name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {name!r} does not exist.')
+    if record['status'] != state.ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {name!r} is {record["status"].value}, not UP.')
+    return record['handle']

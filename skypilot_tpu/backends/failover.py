@@ -1,0 +1,121 @@
+"""Cross-plan failover provisioning loop.
+
+Reference: sky/backends/cloud_vm_ray_backend.py:1121 RetryingVmProvisioner
+(_yield_zones :1165, _retry_zones :1291, provision_with_retries :1911) +
+the FailoverCloudErrorHandlers (:697,:905). Redesigned smaller: the
+optimizer already returns ALL feasible (cloud, region, zone, type) plans
+sorted by preference (optimizer.plan_for_task), and provision errors carry
+structured blocklist hints (common.ProvisionError.blocked_zone/region), so
+failover is one loop over plans with a blocklist filter — no per-cloud
+error-string parsing layered on stdout scraping.
+"""
+import dataclasses
+import time
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class ProvisionAttempt:
+    plan: optimizer_lib.LaunchablePlan
+    error: Optional[str] = None
+
+
+class RetryingProvisioner:
+    """Try plans in optimizer order until one provisions."""
+
+    def __init__(self,
+                 cluster_name: str,
+                 *,
+                 retry_until_up: bool = False,
+                 gap_seconds: float = 30.0) -> None:
+        self.cluster_name = cluster_name
+        self.retry_until_up = retry_until_up
+        self.gap_seconds = gap_seconds
+        self.blocked: List[resources_lib.Resources] = []
+        self.attempts: List[ProvisionAttempt] = []
+
+    def _block(self, res: resources_lib.Resources,
+               err: common.ProvisionError) -> None:
+        if err.blocked_region:
+            region = (None if err.blocked_region == '*' else
+                      err.blocked_region) or res.region
+            self.blocked.append(resources_lib.Resources(
+                cloud=res.cloud, region=region))
+        elif err.blocked_zone:
+            self.blocked.append(resources_lib.Resources(
+                cloud=res.cloud, region=res.region,
+                zone=err.blocked_zone))
+        else:
+            # Unretryable without a location hint: block the exact choice.
+            self.blocked.append(resources_lib.Resources(
+                cloud=res.cloud, region=res.region, zone=res.zone,
+                instance_type=res.instance_type,
+                accelerators=dict(res.accelerators)
+                if res.accelerators else None))
+
+    def provision_with_retries(
+            self, task, to_provision: optimizer_lib.LaunchablePlan,
+            make_config) -> 'tuple[optimizer_lib.LaunchablePlan, object]':
+        """make_config(plan) -> common.ProvisionConfig; returns the winning
+        (plan, ProvisionRecord)."""
+        plan: Optional[optimizer_lib.LaunchablePlan] = to_provision
+        while True:
+            while plan is not None:
+                res = plan.resources
+                logger.info('Provisioning %s on %s (%s/%s)...',
+                            self.cluster_name, res.cloud, res.region,
+                            res.zone or '-')
+                try:
+                    record = provisioner.bulk_provision(
+                        res.cloud, make_config(plan))
+                    return plan, record
+                except common.ProvisionError as e:
+                    logger.warning('Provision failed: %s', e)
+                    self.attempts.append(ProvisionAttempt(plan, str(e)))
+                    self._cleanup_attempt(res)
+                    self._block(res, e)
+                    plan = self._next_plan(task)
+            if not self.retry_until_up:
+                break
+            logger.info('All plans exhausted; retrying in %ds '
+                        '(--retry-until-up)', self.gap_seconds)
+            time.sleep(self.gap_seconds)
+            self.blocked.clear()
+            plan = self._next_plan(task)
+        tried = ', '.join(
+            f'{a.plan.resources.cloud}/{a.plan.resources.zone or a.plan.resources.region}'  # noqa: E501
+            for a in self.attempts)
+        raise exceptions.ResourcesUnavailableError(
+            f'Failed to provision {self.cluster_name} after trying: '
+            f'{tried or "no feasible plans"}.')
+
+    def _cleanup_attempt(self, res: resources_lib.Resources) -> None:
+        """Best-effort teardown of a partially-created attempt so a queued
+        resource does not linger and later materialize a billed slice
+        nobody tracks (reference: teardown on failover,
+        cloud_vm_ray_backend.py _retry_zones)."""
+        from skypilot_tpu import provision
+        try:
+            provision.terminate_instances(
+                res.cloud, self.cluster_name,
+                {'project': None, 'availability_zone': res.zone,
+                 'zone': res.zone} if res.cloud == 'gcp' else {})
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug('cleanup after failed attempt: %s', e)
+
+    def _next_plan(self, task) -> Optional[optimizer_lib.LaunchablePlan]:
+        try:
+            plans = optimizer_lib.Optimizer.plan_for_task(
+                task, blocked_resources=self.blocked)
+        except exceptions.ResourcesUnavailableError:
+            return None
+        return plans[0] if plans else None

@@ -1,0 +1,456 @@
+"""TpuVmBackend — THE execution engine.
+
+Reference: sky/backends/cloud_vm_ray_backend.py:2544 CloudVmRayBackend
+(_provision :2681, _sync_workdir :3018, _setup :3090, _execute :3393,
+teardown_no_lock :3780, set_autostop :4136) + CloudVmRayResourceHandle
+(:2062). TPU-first redesign highlights:
+ - No Ray, no codegen: jobs are submitted to the head agent's HTTP API
+   (runtime/server.py); the gang fan-out is the agent's job, and slice
+   membership is static so there is no placement-group dance.
+ - Provision failover consumes structured ProvisionError hints
+   (backends/failover.py) instead of parsing cloud CLI stdout.
+ - The handle stores the ClusterInfo snapshot; IPs are refreshed from the
+   provider on demand (reference: update_cluster_ips :2226).
+"""
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import provision
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import failover
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.runtime import server as server_lib
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+logger = log_utils.init_logger(__name__)
+
+WORKDIR_TARGET = 'skyt_workdir'
+
+
+class TpuVmResourceHandle(backend_lib.ResourceHandle):
+    """Reference: CloudVmRayResourceHandle
+    (sky/backends/cloud_vm_ray_backend.py:2062)."""
+
+    _VERSION = 1
+
+    def __init__(self, *, cluster_name: str,
+                 launched_resources: resources_lib.Resources,
+                 num_hosts: int,
+                 cluster_info: provision_common.ClusterInfo,
+                 head_port: int,
+                 hourly_cost: float = 0.0) -> None:
+        self._version = self._VERSION
+        self.cluster_name = cluster_name
+        self.launched_resources = launched_resources
+        self.num_hosts = num_hosts
+        self.cluster_info = cluster_info
+        self.head_port = head_port
+        self.hourly_cost = hourly_cost
+
+    # ------------------------------------------------------------ props
+    @property
+    def provider_name(self) -> str:
+        return self.cluster_info.provider_name
+
+    @property
+    def provider_config(self) -> Dict[str, Any]:
+        return self.cluster_info.provider_config
+
+    def get_cluster_name(self) -> str:
+        return self.cluster_name
+
+    def head_url(self) -> str:
+        if self.provider_name == 'local':
+            return f'http://127.0.0.1:{self.head_port}'
+        head = self.cluster_info.ordered()[0]
+        return f'http://{head.get_feasible_ip()}:{self.head_port}'
+
+    def head_client(self) -> backend_utils.HeadClient:
+        return backend_utils.HeadClient(self.head_url())
+
+    def update_cluster_info(self) -> None:
+        """Re-query the provider for fresh IPs (reference:
+        update_cluster_ips :2226)."""
+        self.cluster_info = provision.get_cluster_info(
+            self.provider_name, self.launched_resources.region,
+            self.cluster_name, self.provider_config)
+
+    def get_command_runners(self) -> List[command_runner.CommandRunner]:
+        return provisioner.get_command_runners(self.cluster_info)
+
+    def __repr__(self) -> str:
+        return (f'TpuVmResourceHandle(name={self.cluster_name!r}, '
+                f'hosts={self.num_hosts}, '
+                f'resources={self.launched_resources})')
+
+
+class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
+    """Reference: CloudVmRayBackend
+    (sky/backends/cloud_vm_ray_backend.py:2544)."""
+
+    NAME = 'tpuvm'
+
+    def __init__(self) -> None:
+        self._optimize_target = optimizer_lib.OptimizeTarget.COST
+
+    def register_info(self, **kwargs: Any) -> None:
+        self._optimize_target = kwargs.get('minimize_cost_or_time',
+                                           self._optimize_target)
+
+    # -------------------------------------------------------- provision
+    @timeline.event
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional[optimizer_lib.LaunchablePlan],
+                  *,
+                  dryrun: bool = False,
+                  stream_logs: bool = True,
+                  cluster_name: Optional[str] = None,
+                  retry_until_up: bool = False
+                  ) -> Optional[TpuVmResourceHandle]:
+        if cluster_name is None:
+            cluster_name = task.name or 'skyt-cluster'
+
+        # Existing-cluster path (reference: _check_existing_cluster :4279).
+        record = state.get_cluster(cluster_name)
+        if record is not None:
+            handle = record['handle']
+            status = backend_utils.refresh_cluster_status(
+                cluster_name, handle)
+            if status == state.ClusterStatus.UP:
+                logger.info('Cluster %s is already UP; reusing.',
+                            cluster_name)
+                return handle
+            if status is not None:
+                logger.info('Cluster %s is %s; re-provisioning.',
+                            cluster_name, status.value)
+                # Reuse its launched resources so restart is in-place.
+                plan = optimizer_lib.LaunchablePlan(
+                    resources=handle.launched_resources, hourly_cost=0.0,
+                    estimated_runtime_s=0.0)
+                return self._provision_from_plan(
+                    task, plan, cluster_name, retry_until_up, dryrun)
+
+        if to_provision is None:
+            plans = optimizer_lib.Optimizer.plan_for_task(
+                task, minimize=self._optimize_target)
+            if not plans:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No feasible resources for task {task!r}')
+            to_provision = plans[0]
+        return self._provision_from_plan(task, to_provision, cluster_name,
+                                         retry_until_up, dryrun)
+
+    def _provision_from_plan(self, task, plan, cluster_name: str,
+                             retry_until_up: bool,
+                             dryrun: bool) -> Optional[TpuVmResourceHandle]:
+        if dryrun:
+            logger.info('Dryrun: would provision %s', plan.resources)
+            return None
+        retrier = failover.RetryingProvisioner(
+            cluster_name, retry_until_up=retry_until_up)
+        plan, record = retrier.provision_with_retries(
+            task, plan,
+            lambda p: _make_provision_config(p, cluster_name,
+                                             task.num_nodes))
+        res = plan.resources
+        info = provision.get_cluster_info(
+            res.cloud, res.region, cluster_name,
+            _make_provision_config(plan, cluster_name,
+                                   task.num_nodes).provider_config)
+        head_port = info.provider_config.get('head_port',
+                                             server_lib.DEFAULT_AGENT_PORT)
+        handle = TpuVmResourceHandle(
+            cluster_name=cluster_name,
+            launched_resources=res,
+            num_hosts=info.num_instances(),
+            cluster_info=info,
+            head_port=head_port,
+            hourly_cost=plan.hourly_cost)
+        state.add_or_update_cluster(cluster_name, handle,
+                                    requested_resources=task.resources,
+                                    status=state.ClusterStatus.INIT)
+
+        provisioner.wait_for_ssh(info)
+        provisioner.post_provision_runtime_setup(
+            res.cloud, cluster_name, info,
+            accelerators_per_node=_accels_per_host(res),
+            head_port=head_port)
+        # Agent port must be reachable from the client on real clouds.
+        if res.cloud != 'local':
+            ports = [head_port] + [int(p) for p in (res.ports or [])]
+            provision.open_ports(res.cloud, cluster_name, ports,
+                                 info.provider_config)
+        # Wait for the head agent to answer.
+        client = handle.head_client()
+        import time as _time
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            if client.health() is not None:
+                break
+            _time.sleep(1)
+        else:
+            raise exceptions.ClusterNotUpError(
+                f'head agent on {cluster_name} did not come up')
+        state.add_or_update_cluster(cluster_name, handle,
+                                    requested_resources=task.resources,
+                                    status=state.ClusterStatus.UP)
+        return handle
+
+    # ------------------------------------------------------------- sync
+    @timeline.event
+    def sync_workdir(self, handle: TpuVmResourceHandle,
+                     workdir: str) -> None:
+        """rsync the workdir to every host (reference: _sync_workdir
+        :3018)."""
+        workdir = os.path.abspath(os.path.expanduser(workdir))
+        if not os.path.isdir(workdir):
+            raise exceptions.InvalidTaskError(
+                f'workdir {workdir!r} is not a directory')
+        runners = handle.get_command_runners()
+
+        def _sync(runner: command_runner.CommandRunner) -> None:
+            runner.rsync(workdir + '/', WORKDIR_TARGET + '/', up=True,
+                         excludes=['.git', '__pycache__'])
+
+        subprocess_utils.run_in_parallel(_sync, runners)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: TpuVmResourceHandle,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        """Local-file mounts via rsync; bucket mounts via the data layer.
+
+        Reference: _execute_file_mounts :4412 + _execute_storage_mounts
+        :4549."""
+        runners = handle.get_command_runners()
+        for target, source in (all_file_mounts or {}).items():
+            if _is_cloud_uri(source):
+                self._download_cloud_uri(runners, source, target)
+                continue
+            src = os.path.abspath(os.path.expanduser(source))
+            if not os.path.exists(src):
+                raise exceptions.InvalidTaskError(
+                    f'file_mount source {source!r} does not exist')
+
+            def _sync(runner, _src=src, _dst=target):
+                if _dst.startswith('~/'):
+                    _dst = _dst[2:]
+                parent = os.path.dirname(_dst.rstrip('/'))
+                if parent and not os.path.isabs(parent):
+                    runner.run(f'mkdir -p ~/{parent}', stream_logs=False)
+                elif parent:
+                    runner.run(f'sudo mkdir -p {parent} && sudo chown '
+                               f'$(whoami) {parent}', stream_logs=False)
+                runner.rsync(_src, _dst, up=True)
+
+            subprocess_utils.run_in_parallel(_sync, runners)
+        if storage_mounts:
+            from skypilot_tpu.data import storage_mounting
+            storage_mounting.mount_storages(runners, storage_mounts)
+
+    def _download_cloud_uri(self, runners, source: str,
+                            target: str) -> None:
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.download_command(source, target)
+
+        def _fetch(runner):
+            runner.run_or_raise(
+                cmd, failure_message=f'download {source} failed')
+
+        subprocess_utils.run_in_parallel(_fetch, runners)
+
+    # ------------------------------------------------------------ setup
+    @timeline.event
+    def setup(self, handle: TpuVmResourceHandle, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        """Run the setup script on every host via the runners (reference:
+        _setup :3090). Runs in the workdir with the task's envs."""
+        if not task.setup:
+            return
+        runners = handle.get_command_runners()
+        env = dict(task.envs or {})
+
+        # cd into the synced workdir when one exists (cwd= would be
+        # shell-quoted, defeating ~ expansion — do it in the script).
+        script = (f'[ -d ~/{WORKDIR_TARGET} ] && cd ~/{WORKDIR_TARGET}; '
+                  f'{task.setup}')
+
+        def _setup(idx_runner) -> None:
+            rank, runner = idx_runner
+            rc, out, err = runner.run(
+                script, env=env, require_outputs=True, stream_logs=False)
+            if rc != 0:
+                raise exceptions.CommandError(
+                    rc, f'setup on rank {rank}',
+                    (out or '') + (err or ''))
+
+        subprocess_utils.run_in_parallel(_setup,
+                                         list(enumerate(runners)))
+
+    # ---------------------------------------------------------- execute
+    @timeline.event
+    def execute(self, handle: TpuVmResourceHandle, task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        if dryrun:
+            logger.info('Dryrun: would submit %r', task)
+            return None
+        if task.run is None:
+            logger.info('Nothing to run (no `run` section).')
+            return None
+        spec = {
+            'name': task.name,
+            'run': task.run,
+            'num_nodes': task.num_nodes,
+            'envs': dict(task.envs or {}),
+            'accelerators_per_node': _accels_per_host(
+                handle.launched_resources),
+        }
+        job_id = handle.head_client().submit(spec)
+        logger.info('Job %d submitted on %s.', job_id,
+                    handle.cluster_name)
+        if not detach_run:
+            self.tail_logs(handle, job_id)
+        return job_id
+
+    # ------------------------------------------------------------- logs
+    def tail_logs(self, handle: TpuVmResourceHandle,
+                  job_id: Optional[int], *, follow: bool = True) -> int:
+        client = handle.head_client()
+        if job_id is None:
+            jobs = client.jobs()
+            if not jobs:
+                raise exceptions.JobNotFoundError(
+                    f'no jobs on {handle.cluster_name}')
+            job_id = max(j['job_id'] for j in jobs)
+        for chunk in client.tail_logs(job_id, follow=follow):
+            print(chunk, end='', flush=True)
+        job = client.job(job_id)
+        return 0 if job and job['status'] == 'SUCCEEDED' else 1
+
+    def sync_down_logs(self, handle: TpuVmResourceHandle,
+                       job_id: int, local_dir: str) -> str:
+        """rsync the job's log dir from every host (reference:
+        sync_down_logs :3596)."""
+        os.makedirs(local_dir, exist_ok=True)
+        runners = handle.get_command_runners()
+        for rank, runner in enumerate(runners):
+            dst = os.path.join(local_dir, f'host-{rank}')
+            os.makedirs(dst, exist_ok=True)
+            try:
+                runner.rsync(f'.skyt/logs/{job_id}/', dst + '/', up=False)
+            except exceptions.CommandError as e:
+                logger.warning('log sync from rank %d failed: %s', rank, e)
+        return local_dir
+
+    # ---------------------------------------------------------- teardown
+    @timeline.event
+    def teardown(self, handle: TpuVmResourceHandle, terminate: bool,
+                 purge: bool = False) -> None:
+        name = handle.cluster_name
+        try:
+            provisioner.teardown_cluster(handle.provider_name, name,
+                                         handle.provider_config,
+                                         terminate=terminate)
+        except exceptions.SkyTpuError:
+            if not purge:
+                raise
+            logger.warning('teardown of %s failed; purging state anyway.',
+                           name)
+        if terminate:
+            state.remove_cluster(name)
+        else:
+            state.update_cluster_status(name, state.ClusterStatus.STOPPED)
+
+    # ---------------------------------------------------------- jobs api
+    def set_autostop(self, handle: TpuVmResourceHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        handle.head_client().set_autostop(idle_minutes, down)
+        state.set_cluster_autostop(handle.cluster_name, idle_minutes, down)
+
+    def get_job_queue(self, handle: TpuVmResourceHandle
+                      ) -> List[Dict[str, Any]]:
+        return handle.head_client().jobs()
+
+    def cancel_jobs(self, handle: TpuVmResourceHandle,
+                    job_ids: Optional[List[int]] = None,
+                    all_jobs: bool = False) -> List[int]:
+        client = handle.head_client()
+        if all_jobs or not job_ids:
+            jobs = client.jobs(statuses=['INIT', 'PENDING', 'SETTING_UP',
+                                         'RUNNING'])
+            job_ids = [j['job_id'] for j in jobs]
+        cancelled = []
+        for jid in job_ids:
+            if client.cancel(jid):
+                cancelled.append(jid)
+        return cancelled
+
+
+def _accels_per_host(res: resources_lib.Resources) -> int:
+    if res.is_tpu:
+        return res.tpu_topology.devices_per_host
+    return res.accelerator_count
+
+
+def _is_cloud_uri(source: str) -> bool:
+    return source.startswith(('gs://', 's3://', 'r2://', 'cos://'))
+
+
+def _make_provision_config(plan: optimizer_lib.LaunchablePlan,
+                           cluster_name: str,
+                           num_nodes: int = 1
+                           ) -> provision_common.ProvisionConfig:
+    res = plan.resources
+    node_config: Dict[str, Any] = {}
+    if res.cloud == 'gcp' and res.is_tpu:
+        node_config = {
+            'accelerator_type': res.tpu_topology.gcp_accelerator_type,
+            'runtime_version': res.runtime_version or
+                               _default_runtime_version(res),
+            'spot': res.use_spot,
+            'reserved': res.reserved,
+            'ssh_public_key': _public_key(),
+        }
+    elif res.cloud == 'local':
+        node_config = {'accelerators_per_node': 0}
+    return provision_common.ProvisionConfig(
+        provider_name=res.cloud,
+        region=res.region or 'local',
+        zone=res.zone,
+        cluster_name=cluster_name,
+        # TPU slices: host count is fixed by the topology. VM/local
+        # clusters: the task's num_nodes drives the host count.
+        num_nodes=res.num_hosts if res.is_tpu else max(1, num_nodes),
+        node_config=node_config,
+        ports_to_open=[int(p) for p in (res.ports or [])],
+    )
+
+
+def _default_runtime_version(res: resources_lib.Resources) -> str:
+    gen = res.tpu_topology.generation.name
+    return {
+        'v2': 'tpu-ubuntu2204-base', 'v3': 'tpu-ubuntu2204-base',
+        'v4': 'tpu-ubuntu2204-base', 'v5e': 'v2-alpha-tpuv5-lite',
+        'v5p': 'v2-alpha-tpuv5', 'v6e': 'v2-alpha-tpuv6e',
+    }.get(gen, 'tpu-ubuntu2204-base')
+
+
+def _public_key() -> Optional[str]:
+    for name in ('skyt-key.pub', 'id_ed25519.pub', 'id_rsa.pub'):
+        path = os.path.expanduser(f'~/.ssh/{name}')
+        if os.path.exists(path):
+            with open(path, 'r', encoding='utf-8') as f:
+                return f.read().strip()
+    return None

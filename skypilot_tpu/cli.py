@@ -1,0 +1,348 @@
+"""skyt — the CLI.
+
+Reference: sky/cli.py (click group :914-934; launch :1038, exec :1167,
+status :1513, queue :1902, logs :1964, cancel :2058, stop :2134, autostop
+:2212, start :2338, down :2535, check :2901, show_gpus :2954, storage
+:3362, jobs :3450, serve :3449). Same verb surface, TPU-first flags.
+"""
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+import click
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    fmt = '  '.join(f'{{:<{w}}}' for w in widths)
+    lines = [fmt.format(*headers)]
+    lines += [fmt.format(*[str(c) for c in row]) for row in rows]
+    return '\n'.join(lines)
+
+
+def _load_task(entrypoint: str, *, name: Optional[str] = None,
+               workdir: Optional[str] = None,
+               cloud: Optional[str] = None,
+               accelerators: Optional[str] = None,
+               num_nodes: Optional[int] = None,
+               use_spot: Optional[bool] = None,
+               envs: Optional[List[str]] = None):
+    """YAML path or inline command → Task, with CLI overrides (reference:
+    _make_task_or_dag_from_entrypoint_with_overrides, sky/cli.py:696)."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(
+            entrypoint):
+        task = task_lib.Task.from_yaml(entrypoint)
+    else:
+        task = task_lib.Task(run=entrypoint)
+    if name:
+        task.name = name
+    if workdir:
+        task.workdir = workdir
+    if num_nodes:
+        task._user_num_nodes = num_nodes  # pylint: disable=protected-access
+    override: Dict[str, Any] = {}
+    if cloud:
+        override['cloud'] = cloud
+    if accelerators:
+        override['accelerators'] = accelerators
+    if use_spot is not None:
+        override['use_spot'] = use_spot
+    if override:
+        base = list(task.resources) or [resources_lib.Resources()]
+        task.set_resources({r.copy(**override) for r in base})
+    if envs:
+        task.update_envs(dict(e.split('=', 1) for e in envs))
+    return task
+
+
+@click.group()
+@click.version_option(message='%(version)s',
+                      package_name='skypilot_tpu',
+                      version=__import__('skypilot_tpu').__version__)
+def cli():
+    """skyt: TPU-native cluster launcher and job orchestrator."""
+
+
+# ------------------------------------------------------------------ launch
+@cli.command()
+@click.argument('entrypoint', required=True)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--name', '-n', default=None, help='Task name.')
+@click.option('--workdir', default=None, type=click.Path(exists=True))
+@click.option('--cloud', default=None)
+@click.option('--gpus', '--tpus', 'accelerators', default=None,
+              help='Accelerator spec, e.g. tpu-v5e-16.')
+@click.option('--num-nodes', default=None, type=int)
+@click.option('--use-spot/--no-use-spot', default=None)
+@click.option('--env', 'envs', multiple=True, help='KEY=VAL.')
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--down', is_flag=True, default=False,
+              help='Tear down after the job finishes.')
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+@click.option('--idle-minutes-to-autostop', '-i', default=None, type=int)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def launch(entrypoint, cluster, name, workdir, cloud, accelerators,
+           num_nodes, use_spot, envs, detach_run, dryrun, down,
+           retry_until_up, idle_minutes_to_autostop, yes):
+    """Launch a task (provision + setup + run). Reference: sky launch."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, name=name, workdir=workdir, cloud=cloud,
+                      accelerators=accelerators, num_nodes=num_nodes,
+                      use_spot=use_spot, envs=list(envs))
+    if not yes and not dryrun:
+        click.confirm(f'Launching task on cluster '
+                      f'{cluster or task.name or "skyt-cluster"!r}. '
+                      f'Proceed?', default=True, abort=True)
+    job_id = execution.launch(
+        task, cluster_name=cluster, dryrun=dryrun, down=down,
+        detach_run=detach_run, retry_until_up=retry_until_up,
+        idle_minutes_to_autostop=idle_minutes_to_autostop)
+    if job_id is not None and detach_run:
+        click.echo(f'Job submitted, ID: {job_id}')
+
+
+@cli.command(name='exec')
+@click.argument('cluster', required=True)
+@click.argument('entrypoint', required=True)
+@click.option('--name', '-n', default=None)
+@click.option('--workdir', default=None, type=click.Path(exists=True))
+@click.option('--env', 'envs', multiple=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(cluster, entrypoint, name, workdir, envs, detach_run):
+    """Run a task on an existing cluster (skips provision/setup)."""
+    from skypilot_tpu import execution
+    task = _load_task(entrypoint, name=name, workdir=workdir,
+                      envs=list(envs))
+    job_id = execution.exec(task, cluster, detach_run=detach_run)
+    if job_id is not None and detach_run:
+        click.echo(f'Job submitted, ID: {job_id}')
+
+
+# ------------------------------------------------------------------ status
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(clusters, refresh):
+    """Show clusters. Reference: sky status."""
+    from skypilot_tpu import core
+    records = core.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = []
+    for r in records:
+        handle = r['handle']
+        res = handle.launched_resources
+        autostop = (f'{r["autostop"]}m' +
+                    ('(down)' if r['to_down'] else '')
+                    if r['autostop'] >= 0 else '-')
+        rows.append([r['name'], str(res), handle.num_hosts,
+                     r['status'].value, autostop])
+    click.echo(_fmt_table(rows, ['NAME', 'RESOURCES', 'HOSTS', 'STATUS',
+                                 'AUTOSTOP']))
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def queue(cluster, skip_finished):
+    """Show a cluster's job queue. Reference: sky queue."""
+    from skypilot_tpu import core
+    jobs = core.queue(cluster, skip_finished=skip_finished)
+    rows = [[j['job_id'], j.get('name') or '-', j['status'],
+             j.get('submitted_at') or '-'] for j in jobs]
+    click.echo(_fmt_table(rows, ['ID', 'NAME', 'STATUS', 'SUBMITTED']))
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+@click.option('--sync-down', is_flag=True, default=False,
+              help='Download logs instead of streaming.')
+def logs(cluster, job_id, no_follow, sync_down):
+    """Tail job logs. Reference: sky logs."""
+    from skypilot_tpu import core
+    if sync_down:
+        if job_id is None:
+            raise click.UsageError('--sync-down needs a JOB_ID')
+        path = core.download_logs(cluster, job_id)
+        click.echo(f'Logs synced to {path}')
+        return
+    sys.exit(core.tail_logs(cluster, job_id, follow=not no_follow))
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs, yes):
+    """Cancel jobs. Reference: sky cancel."""
+    from skypilot_tpu import core
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Provide JOB_IDS or --all.')
+    if not yes:
+        what = 'ALL jobs' if all_jobs else f'jobs {list(job_ids)}'
+        click.confirm(f'Cancel {what} on {cluster!r}?', default=True,
+                      abort=True)
+    cancelled = core.cancel(cluster, list(job_ids) or None,
+                            all_jobs=all_jobs)
+    click.echo(f'Cancelled: {cancelled or "none"}')
+
+
+# --------------------------------------------------------------- lifecycle
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes):
+    """Stop clusters (restartable). Reference: sky stop."""
+    from skypilot_tpu import core
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Stop {name!r}?', default=True, abort=True)
+        core.stop(name)
+        click.echo(f'Cluster {name} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def start(clusters, retry_until_up, yes):
+    """Restart stopped clusters. Reference: sky start."""
+    from skypilot_tpu import core
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Start {name!r}?', default=True, abort=True)
+        core.start(name, retry_until_up=retry_until_up)
+        click.echo(f'Cluster {name} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--purge', is_flag=True, default=False,
+              help='Remove state even if cloud teardown fails.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def down(clusters, purge, yes):
+    """Terminate clusters. Reference: sky down."""
+    from skypilot_tpu import core
+    for name in clusters:
+        if not yes:
+            click.confirm(f'Terminate {name!r}?', default=True,
+                          abort=True)
+        core.down(name, purge=purge)
+        click.echo(f'Cluster {name} terminated.')
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--idle-minutes', '-i', required=True, type=int)
+@click.option('--down', is_flag=True, default=False,
+              help='Terminate instead of stop when idle.')
+@click.option('--cancel', 'cancel_autostop', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down, cancel_autostop):
+    """Schedule autostop. Reference: sky autostop."""
+    from skypilot_tpu import core
+    if cancel_autostop:
+        idle_minutes = -1
+    core.autostop(cluster, idle_minutes, down=down)
+    if idle_minutes < 0:
+        click.echo(f'Autostop cancelled on {cluster}.')
+    else:
+        click.echo(f'{cluster} will {"terminate" if down else "stop"} '
+                   f'after {idle_minutes} idle minutes.')
+
+
+# ------------------------------------------------------------------- info
+@cli.command()
+def check():
+    """Probe cloud credentials. Reference: sky check."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
+
+
+@cli.command(name='show-tpus')
+@click.option('--cloud', default='gcp')
+@click.option('--all', '-a', 'show_all', is_flag=True, default=False,
+              help='Include GPU/CPU offerings.')
+def show_tpus(cloud, show_all):
+    """List TPU (and optionally GPU) offerings with prices.
+
+    Reference: sky show-gpus."""
+    from skypilot_tpu import catalog
+    by_acc = catalog.list_accelerators(cloud)
+    rows = []
+    for acc_name, offs in sorted(by_acc.items()):
+        if not show_all and not acc_name.startswith('tpu'):
+            continue
+        for off in offs:
+            rows.append([acc_name, off.region, off.zone or '-',
+                         f'${off.price:.2f}'
+                         if off.price is not None else '-',
+                         f'${off.spot_price:.2f}'
+                         if off.spot_price is not None else '-'])
+    click.echo(_fmt_table(rows, ['ACCELERATOR', 'REGION', 'ZONE', '$/H',
+                                 'SPOT $/H']))
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Accumulated cluster costs. Reference: sky cost-report."""
+    from skypilot_tpu import core
+    rows = []
+    for r in core.cost_report():
+        hours = r['duration_s'] / 3600.0
+        rows.append([r['name'], r['num_nodes'], f'{hours:.1f}h',
+                     f'${r["cost"]:.2f}'])
+    click.echo(_fmt_table(rows, ['NAME', 'HOSTS', 'UPTIME', 'COST']))
+
+
+# ---------------------------------------------------------------- storage
+@cli.group()
+def storage():
+    """Storage management. Reference: sky storage."""
+
+
+@storage.command(name='ls')
+def storage_ls():
+    from skypilot_tpu import core
+    rows = [[s['name'], s['status'].value] for s in core.storage_ls()]
+    click.echo(_fmt_table(rows, ['NAME', 'STATUS']))
+
+
+@storage.command(name='delete')
+@click.argument('names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(names, yes):
+    from skypilot_tpu import core
+    for name in names:
+        if not yes:
+            click.confirm(f'Delete storage {name!r}?', default=True,
+                          abort=True)
+        core.storage_delete(name)
+        click.echo(f'Storage {name} deleted.')
+
+
+def main() -> None:
+    try:
+        cli()
+    except exceptions.SkyTpuError as e:
+        click.echo(f'Error: {e}', err=True)
+        sys.exit(1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,178 @@
+"""Library API for cluster lifecycle + job management.
+
+Reference: sky/core.py:38-822 (status, start, stop, down, autostop, queue,
+cancel, tail_logs, download_logs, job_status, cost_report, storage_ls/
+delete). Each function is a thin, importable entrypoint over the backend.
+"""
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import tpu_backend
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# Re-exported for users: skyt.launch / skyt.exec live in execution.py.
+from skypilot_tpu.execution import exec  # noqa: F401,E402  pylint: disable=redefined-builtin
+from skypilot_tpu.execution import launch  # noqa: F401,E402
+
+
+def _backend() -> tpu_backend.TpuVmBackend:
+    return tpu_backend.TpuVmBackend()
+
+
+def _handle_or_raise(cluster_name: str) -> tpu_backend.TpuVmResourceHandle:
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record['handle']
+
+
+# ------------------------------------------------------------------ status
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Reference: sky/core.py:38 status."""
+    records = backend_utils.get_clusters(refresh=refresh)
+    if cluster_names:
+        wanted = set(cluster_names)
+        records = [r for r in records if r['name'] in wanted]
+    return records
+
+
+def endpoints(cluster_name: str,
+              port: Optional[int] = None) -> Dict[int, str]:
+    """Reference: sky/core.py:113 endpoints."""
+    handle = _handle_or_raise(cluster_name)
+    head_ip = handle.cluster_info.ordered()[0].get_feasible_ip()
+    res = handle.launched_resources
+    ports = [int(p) for p in (res.ports or [])]
+    if port is not None:
+        ports = [port]
+    return {p: f'{head_ip}:{p}' for p in ports}
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Accumulated cost per cluster from usage intervals.
+
+    Reference: sky/core.py:136 cost_report."""
+    out = []
+    for rec in state.get_cluster_history():
+        res = rec.get('launched_resources')
+        # launched_resources in history is the pickled handle's resources;
+        # the handle records the plan's hourly cost at launch.
+        hourly = rec.get('hourly_cost')
+        duration = 0
+        for start, end in rec.get('usage_intervals', []):
+            duration += (end or int(time.time())) - start
+        out.append({
+            'name': rec['name'],
+            'num_nodes': rec['num_nodes'],
+            'resources': res,
+            'duration_s': duration,
+            'cost': (hourly or 0.0) * duration / 3600.0,
+        })
+    return out
+
+
+# --------------------------------------------------------------- lifecycle
+def start(cluster_name: str, retry_until_up: bool = False) -> None:
+    """Restart a STOPPED cluster. Reference: sky/core.py:245."""
+    record = state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    handle = record['handle']
+    from skypilot_tpu import task as task_lib
+    t = task_lib.Task(name=cluster_name,
+                      num_nodes=(None
+                                 if handle.launched_resources.is_tpu
+                                 else handle.num_hosts))
+    t.set_resources(handle.launched_resources)
+    _backend().provision(t, None, cluster_name=cluster_name,
+                         retry_until_up=retry_until_up)
+
+
+def stop(cluster_name: str) -> None:
+    """Reference: sky/core.py:317 stop. TPU pod slices cannot stop
+    (provider raises); single-host TPU VMs can."""
+    handle = _handle_or_raise(cluster_name)
+    _backend().teardown(handle, terminate=False)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    """Reference: sky/core.py:375 down."""
+    handle = _handle_or_raise(cluster_name)
+    _backend().teardown(handle, terminate=True, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down: bool = False) -> None:  # pylint: disable=redefined-outer-name
+    """Reference: sky/core.py:408 autostop. idle_minutes < 0 cancels."""
+    handle = _handle_or_raise(cluster_name)
+    _backend().set_autostop(handle, idle_minutes, down)
+
+
+# -------------------------------------------------------------------- jobs
+def queue(cluster_name: str,
+          skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """Reference: sky/core.py:517 queue."""
+    handle = _handle_or_raise(cluster_name)
+    jobs = _backend().get_job_queue(handle)
+    if skip_finished:
+        jobs = [j for j in jobs if j['status'] not in
+                ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED')]
+    return jobs
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Reference: sky/core.py:579 cancel."""
+    handle = _handle_or_raise(cluster_name)
+    return _backend().cancel_jobs(handle, job_ids, all_jobs=all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """Reference: sky/core.py:666 tail_logs."""
+    handle = _handle_or_raise(cluster_name)
+    return _backend().tail_logs(handle, job_id, follow=follow)
+
+
+def download_logs(cluster_name: str, job_id: int,
+                  local_dir: str = '~/skyt_logs') -> str:
+    """Reference: sky/core.py:705 download_logs."""
+    import os
+    handle = _handle_or_raise(cluster_name)
+    target = os.path.expanduser(f'{local_dir}/{cluster_name}/{job_id}')
+    return _backend().sync_down_logs(handle, job_id, target)
+
+
+def job_status(cluster_name: str, job_ids: Optional[List[int]] = None
+               ) -> Dict[int, Optional[str]]:
+    """Reference: sky/core.py:747 job_status."""
+    handle = _handle_or_raise(cluster_name)
+    jobs = _backend().get_job_queue(handle)
+    by_id = {j['job_id']: j['status'] for j in jobs}
+    if job_ids is None:
+        return by_id
+    return {jid: by_id.get(jid) for jid in job_ids}
+
+
+# ----------------------------------------------------------------- storage
+def storage_ls() -> List[Dict[str, Any]]:
+    """Reference: sky/core.py:800 storage_ls."""
+    return state.get_storages()
+
+
+def storage_delete(name: str) -> None:
+    """Reference: sky/core.py:822 storage_delete."""
+    record = state.get_storage(name)
+    if record is None:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    from skypilot_tpu.data import storage as storage_lib
+    storage_lib.Storage.delete_by_name(name)

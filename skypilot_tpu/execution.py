@@ -1,0 +1,165 @@
+"""Staged launch/exec pipeline.
+
+Reference: sky/execution.py — Stage enum (:31), _execute (:95, stage walk
+:270-320), launch (:347), exec (:480 — skips provision/setup stages).
+"""
+import enum
+from typing import Any, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import backend_utils
+from skypilot_tpu.backends import tpu_backend
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    """Reference: sky/execution.py:31 Stage."""
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _convert_to_dag(entrypoint: Union['task_lib.Task', 'dag_lib.Dag']
+                    ) -> 'dag_lib.Dag':
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    d = dag_lib.Dag()
+    d.add(entrypoint)
+    return d
+
+
+def _execute(
+    entrypoint: Union['task_lib.Task', 'dag_lib.Dag'],
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    cluster_name: Optional[str] = None,
+    detach_run: bool = False,
+    stages: Optional[List[Stage]] = None,
+    optimize_target: optimizer_lib.OptimizeTarget =
+        optimizer_lib.OptimizeTarget.COST,
+    retry_until_up: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    quiet_optimizer: bool = False,
+) -> Optional[int]:
+    """Reference: sky/execution.py:95 _execute. Returns the job id."""
+    dag = _convert_to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        raise exceptions.NotSupportedError(
+            'launch/exec take a single task; use skyt.jobs for DAGs '
+            '(reference has the same restriction, sky/execution.py:153).')
+    task = dag.tasks[0]
+    if stages is None:
+        stages = list(Stage)
+
+    backend = tpu_backend.TpuVmBackend()
+    backend.register_info(minimize_cost_or_time=optimize_target)
+
+    handle: Optional[tpu_backend.TpuVmResourceHandle] = None
+    to_provision: Optional[optimizer_lib.LaunchablePlan] = None
+
+    if Stage.OPTIMIZE in stages:
+        # Skip optimization when the target cluster already exists — its
+        # resources are fixed (reference: sky/execution.py:258 same guard).
+        existing = (state.get_cluster(cluster_name)
+                    if cluster_name else None)
+        if existing is None:
+            plans = optimizer_lib.Optimizer.plan_for_task(
+                task, minimize=optimize_target)
+            if not plans:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No launchable resources for {task!r}')
+            to_provision = plans[0]
+            if not quiet_optimizer:
+                logger.info(
+                    'Best plan: %s ($%.2f/h)', to_provision.resources,
+                    to_provision.hourly_cost)
+
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, to_provision, dryrun=dryrun,
+                                   stream_logs=stream_logs,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+        if dryrun:
+            return None
+    else:
+        assert cluster_name is not None, 'exec path needs a cluster name'
+        handle = backend_utils.check_cluster_up(cluster_name)
+
+    assert handle is not None
+    job_id: Optional[int] = None
+    try:
+        if Stage.SYNC_WORKDIR in stages and task.workdir:
+            backend.sync_workdir(handle, task.workdir)
+        if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                                 task.storage_mounts):
+            backend.sync_file_mounts(handle, task.file_mounts,
+                                     task.storage_mounts)
+        if Stage.SETUP in stages:
+            backend.setup(handle, task)
+        if Stage.PRE_EXEC in stages:
+            if idle_minutes_to_autostop is not None:
+                backend.set_autostop(handle, idle_minutes_to_autostop,
+                                     down=down)
+        if Stage.EXEC in stages:
+            job_id = backend.execute(handle, task, detach_run=detach_run)
+    finally:
+        if Stage.DOWN in stages and down and \
+                idle_minutes_to_autostop is None:
+            backend.teardown(handle, terminate=True)
+    return job_id
+
+
+def launch(
+    task: Union['task_lib.Task', 'dag_lib.Dag'],
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    down: bool = False,
+    stream_logs: bool = True,
+    detach_run: bool = False,
+    optimize_target: optimizer_lib.OptimizeTarget =
+        optimizer_lib.OptimizeTarget.COST,
+    retry_until_up: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+) -> Optional[int]:
+    """Provision (if needed) + run. Reference: sky/execution.py:347."""
+    return _execute(task,
+                    dryrun=dryrun,
+                    down=down,
+                    stream_logs=stream_logs,
+                    cluster_name=cluster_name,
+                    detach_run=detach_run,
+                    optimize_target=optimize_target,
+                    retry_until_up=retry_until_up,
+                    idle_minutes_to_autostop=idle_minutes_to_autostop)
+
+
+def exec(  # pylint: disable=redefined-builtin
+    task: Union['task_lib.Task', 'dag_lib.Dag'],
+    cluster_name: str,
+    *,
+    dryrun: bool = False,
+    detach_run: bool = False,
+) -> Optional[int]:
+    """Fast path onto an UP cluster: sync workdir + submit (skips
+    provision/setup). Reference: sky/execution.py:480."""
+    if dryrun:
+        logger.info('Dryrun: would exec on %s', cluster_name)
+        return None
+    return _execute(task,
+                    cluster_name=cluster_name,
+                    detach_run=detach_run,
+                    stages=[Stage.SYNC_WORKDIR, Stage.EXEC])

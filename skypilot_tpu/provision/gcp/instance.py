@@ -94,6 +94,12 @@ def _qr_id(cluster_name: str) -> str:
     return cluster_name
 
 
+def _host_id(cluster_name: str, rank: int) -> str:
+    """The per-host instance-id namespace shared by run_instances,
+    query_instances, and get_cluster_info."""
+    return f'{cluster_name}-host-{rank}'
+
+
 def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     project, zone = _project_zone(config.provider_config)
     cluster = config.cluster_name
@@ -108,21 +114,24 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         node = None
     if node is not None:
         state = node.get('state')
+        n_hosts = max(len(node.get('networkEndpoints', [])),
+                      config.num_nodes, 1)
+        host_ids = [_host_id(cluster, r) for r in range(n_hosts)]
         if state == 'READY':
             return common.ProvisionRecord(
-                'gcp', config.region, zone, cluster, node_id,
+                'gcp', config.region, zone, cluster, host_ids[0],
                 resumed_instance_ids=[])
         if state == 'STOPPED':
             logger.info('Starting stopped TPU %s', node_id)
             op = tpu_api.start_node(project, zone, node_id)
             tpu_api.wait_operation(op)
             return common.ProvisionRecord(
-                'gcp', config.region, zone, cluster, node_id,
-                resumed_instance_ids=[node_id])
+                'gcp', config.region, zone, cluster, host_ids[0],
+                resumed_instance_ids=host_ids)
         if state in _CREATING_STATES:
             return common.ProvisionRecord(
-                'gcp', config.region, zone, cluster, node_id,
-                created_instance_ids=[node_id])
+                'gcp', config.region, zone, cluster, host_ids[0],
+                created_instance_ids=host_ids)
         raise common.ProvisionError(
             f'TPU {node_id} in unexpected state {state}', blocked_zone=zone)
 
@@ -146,13 +155,31 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     try:
         tpu_api.create_queued_resource(project, zone, _qr_id(cluster), body)
     except tpu_api.TpuApiError as e:
-        if e.status == 409:  # already queued — treat as in-progress
-            logger.info('queued resource %s already exists', cluster)
+        if e.status == 409:
+            # Name collision: either a live QR (in-progress → fine) or a
+            # stale FAILED/SUSPENDED one from an earlier attempt that
+            # would brick this cluster name — delete and recreate.
+            qr = tpu_api.get_queued_resource(project, zone,
+                                             _qr_id(cluster))
+            raw = qr.get('state')
+            qr_state = raw.get('state') if isinstance(raw, dict) else raw
+            if qr_state in _QR_TERMINAL_BAD:
+                logger.info('deleting stale %s queued resource %s',
+                            qr_state, cluster)
+                op = tpu_api.delete_queued_resource(project, zone,
+                                                    _qr_id(cluster))
+                tpu_api.wait_operation(op)
+                tpu_api.create_queued_resource(project, zone,
+                                               _qr_id(cluster), body)
+            else:
+                logger.info('queued resource %s already exists (%s)',
+                            cluster, qr_state)
         else:
             raise _provision_error(e, zone)
     return common.ProvisionRecord(
-        'gcp', config.region, zone, cluster, node_id,
-        created_instance_ids=[node_id])
+        'gcp', config.region, zone, cluster, _host_id(cluster, 0),
+        created_instance_ids=[_host_id(cluster, r)
+                              for r in range(config.num_nodes)])
 
 
 def _provision_error(e: 'tpu_api.TpuApiError',
@@ -318,7 +345,6 @@ def open_ports(cluster_name: str, ports: List[int],
     if not ports:
         return
     project, _ = _project_zone(provider_config)
-    import requests as _requests
     rule = {
         'name': f'skyt-{cluster_name}-ports',
         'direction': 'INGRESS',
@@ -328,27 +354,41 @@ def open_ports(cluster_name: str, ports: List[int],
         # Must match the network tags on the node (_node_body default).
         'targetTags': provider_config.get('tags', ['skyt']),
     }
-    resp = _requests.post(
-        f'https://compute.googleapis.com/compute/v1/projects/{project}'
-        '/global/firewalls',
-        headers={'Authorization': f'Bearer {tpu_api.access_token()}'},
-        json=rule, timeout=60)
-    if resp.status_code == 409:
-        return  # already exists
-    if resp.status_code >= 400:
+    url = (f'{_COMPUTE_API}/projects/{project}/global/firewalls')
+    try:
+        op = tpu_api._request('POST', url, body=rule)  # pylint: disable=protected-access
+        _wait_compute_op(op)
+    except tpu_api.TpuApiError as e:
+        if e.status == 409:
+            return  # already exists
         raise common.ProvisionError(
-            f'open_ports {ports} failed ({resp.status_code}): {resp.text}',
-            retryable=False)
+            f'open_ports {ports} failed: {e}', retryable=False)
+
+
+_COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+
+
+def _wait_compute_op(op: Dict[str, Any], timeout: float = 120.0) -> None:
+    """Poll a compute (not TPU) long-running operation to DONE — its wire
+    format differs from TPU ops ('status' field + selfLink polling)."""
+    link = op.get('selfLink')
+    deadline = time.time() + timeout
+    while link and op.get('status') != 'DONE' and time.time() < deadline:
+        time.sleep(2)
+        op = tpu_api._request('GET', link)  # pylint: disable=protected-access
+    err = (op.get('error') or {}).get('errors')
+    if err:
+        raise common.ProvisionError(f'compute operation failed: {err}',
+                                    retryable=False)
 
 
 def cleanup_ports(cluster_name: str,
                   provider_config: Dict[str, Any]) -> None:
     project, _ = _project_zone(provider_config)
-    import requests as _requests
-    resp = _requests.delete(
-        f'https://compute.googleapis.com/compute/v1/projects/{project}'
-        f'/global/firewalls/skyt-{cluster_name}-ports',
-        headers={'Authorization': f'Bearer {tpu_api.access_token()}'},
-        timeout=60)
-    if resp.status_code >= 400 and resp.status_code != 404:
-        logger.warning('cleanup_ports failed (%d)', resp.status_code)
+    url = (f'{_COMPUTE_API}/projects/{project}/global/firewalls/'
+           f'skyt-{cluster_name}-ports')
+    try:
+        tpu_api._request('DELETE', url)  # pylint: disable=protected-access
+    except tpu_api.TpuApiError as e:
+        if e.status != 404:
+            logger.warning('cleanup_ports failed: %s', e)

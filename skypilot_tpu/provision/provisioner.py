@@ -198,7 +198,11 @@ def _ensure_package(runner: command_runner.CommandRunner) -> None:
     here a plain rsync of the source tree into ~/.skyt/lib + PYTHONPATH
     in the agent env, no wheel build needed.
     """
+    # Probe with the same PYTHONPATH the agent start uses, so a package
+    # installed into ~/.skyt/lib by a previous setup passes the probe
+    # (otherwise every restart re-rsyncs the whole tree).
     rc, _, _ = runner.run(
+        'PYTHONPATH="$HOME/.skyt/lib:$PYTHONPATH" '
         f'{_python()} -c "import skypilot_tpu" 2>/dev/null',
         require_outputs=True, stream_logs=False)
     if rc == 0:

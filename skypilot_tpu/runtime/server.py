@@ -19,6 +19,7 @@ Endpoints (JSON):
   GET  /autostop                current autostop config
 """
 import json
+import os
 import threading
 import time
 import traceback
@@ -223,6 +224,31 @@ class _Handler(BaseHTTPRequestHandler):
                     wire = _job_wire(job)
                     wire['gang'] = job_lib.gang_records(job['job_id'])
                     self._reply(wire)
+            elif parts[:1] == ['logs'] and len(parts) == 2:
+                # Incremental log read: head host's rank-0 log for the job.
+                # Client polls with ?offset=<bytes read so far>; replies
+                # {data, offset, done}. Keeps log streaming transport-
+                # agnostic (same path for local and SSH-reached clusters).
+                job_id = int(parts[1])
+                offset = int(q.get('offset', ['0'])[0])
+                job = job_lib.get_job(job_id)
+                if job is None:
+                    self._reply({'error': 'not found'}, 404)
+                else:
+                    path = os.path.join(job_lib.log_dir_for_job(job_id),
+                                        'rank-0.log')
+                    data = ''
+                    new_offset = offset
+                    try:
+                        with open(path, 'r', encoding='utf-8',
+                                  errors='replace') as f:
+                            f.seek(offset)
+                            data = f.read()
+                            new_offset = f.tell()
+                    except OSError:
+                        pass
+                    self._reply({'data': data, 'offset': new_offset,
+                                 'done': job['status'].is_terminal()})
             elif parsed.path == '/autostop':
                 self._reply({
                     'idle_minutes': int(job_lib.get_kv('autostop_idle_minutes')

@@ -81,7 +81,8 @@ def _create_tables(db: sqlite3.Connection) -> None:
         num_nodes INTEGER,
         requested_resources BLOB,
         launched_resources BLOB,
-        usage_intervals BLOB);
+        usage_intervals BLOB,
+        hourly_cost REAL DEFAULT 0);
     CREATE TABLE IF NOT EXISTS config (
         key TEXT PRIMARY KEY,
         value TEXT);
@@ -92,6 +93,13 @@ def _create_tables(db: sqlite3.Connection) -> None:
         last_use TEXT,
         status TEXT);
     """)
+    # Migrations for DBs created before a column existed (CREATE IF NOT
+    # EXISTS never alters an existing table).
+    try:
+        db.execute('ALTER TABLE cluster_history ADD COLUMN '
+                   'hourly_cost REAL DEFAULT 0')
+    except sqlite3.OperationalError:
+        pass  # already present
     db.commit()
 
 
@@ -149,17 +157,19 @@ def _record_history(db, name, cluster_hash, handle, requested_resources,
     if launched_at is not None and not (intervals and
                                         intervals[-1][1] is None):
         intervals.append((launched_at, None))
+    hourly_cost = getattr(handle, 'hourly_cost', 0.0) or 0.0
     db.execute(
         """INSERT INTO cluster_history
            (cluster_hash, name, num_nodes, requested_resources,
-            launched_resources, usage_intervals)
-           VALUES (?, ?, ?, ?, ?, ?)
+            launched_resources, usage_intervals, hourly_cost)
+           VALUES (?, ?, ?, ?, ?, ?, ?)
            ON CONFLICT(cluster_hash) DO UPDATE SET
              launched_resources=excluded.launched_resources,
              num_nodes=excluded.num_nodes,
-             usage_intervals=excluded.usage_intervals""",
+             usage_intervals=excluded.usage_intervals,
+             hourly_cost=excluded.hourly_cost""",
         (cluster_hash, name, num_nodes, pickle.dumps(requested_resources),
-         pickle.dumps(launched), pickle.dumps(intervals)))
+         pickle.dumps(launched), pickle.dumps(intervals), hourly_cost))
     db.commit()
 
 
@@ -241,6 +251,7 @@ def get_cluster_history() -> List[Dict[str, Any]]:
             if r['launched_resources'] else None,
             'usage_intervals': pickle.loads(r['usage_intervals'])
             if r['usage_intervals'] else [],
+            'hourly_cost': r['hourly_cost'],
         })
     return out
 

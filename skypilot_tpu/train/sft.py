@@ -1,0 +1,174 @@
+"""SFT training entrypoint: `python -m skypilot_tpu.train.sft`.
+
+The workload behind examples/llama_finetune.yaml — the TPU-native rebuild
+of the reference's llm/llama-3_1-finetuning/lora.yaml (torchtune launcher)
+as a framework-owned pjit program: multi-host init from the gang env
+contract, sharded Llama/Mixtral, async Orbax checkpoint/resume (the
+preemption-recovery half the managed-jobs controller needs), JSONL or
+synthetic data.
+"""
+import argparse
+import json
+import os
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def parse_mesh(spec: Optional[str], n_devices: int):
+    """'fsdp=8,tp=2' → MeshSpec; None → auto for the device count."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    if not spec or spec == 'auto':
+        return mesh_lib.auto_spec(n_devices)
+    axes = {}
+    for part in spec.split(','):
+        k, v = part.split('=')
+        axes[k.strip()] = int(v)
+    unknown = set(axes) - set(mesh_lib.MESH_AXES)
+    if unknown:
+        raise ValueError(f'unknown mesh axes {unknown}')
+    return mesh_lib.MeshSpec(**axes)
+
+
+def synthetic_batches(vocab_size: int, batch: int, seq: int,
+                      seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    rng = np.random.default_rng(seed)
+    while True:
+        toks = rng.integers(0, vocab_size, (batch, seq + 1), dtype=np.int32)
+        yield {'tokens': toks[:, :-1], 'targets': toks[:, 1:]}
+
+
+def jsonl_batches(path: str, vocab_size: int, batch: int, seq: int
+                  ) -> Iterator[Dict[str, np.ndarray]]:
+    """Pack {'text' or 'tokens'} JSONL rows into fixed [B,S] batches.
+    Byte-level fallback tokenizer keeps this dependency-free; pass
+    pre-tokenized 'tokens' for real runs."""
+    def _tokens():
+        while True:
+            with open(path, 'r', encoding='utf-8') as f:
+                for line in f:
+                    row = json.loads(line)
+                    if 'tokens' in row:
+                        yield from (int(t) % vocab_size
+                                    for t in row['tokens'])
+                    else:
+                        yield from (b % vocab_size
+                                    for b in row['text'].encode())
+                    yield 0  # document separator
+
+    stream = _tokens()
+    while True:
+        flat = np.fromiter(stream, dtype=np.int32,
+                           count=batch * (seq + 1))
+        arr = flat.reshape(batch, seq + 1)
+        yield {'tokens': arr[:, :-1], 'targets': arr[:, 1:]}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama3-1b')
+    parser.add_argument('--mesh', default='auto',
+                        help="e.g. 'fsdp=8,tp=2' or 'auto'")
+    parser.add_argument('--steps', type=int, default=1000)
+    parser.add_argument('--batch', type=int, default=8)
+    parser.add_argument('--seq', type=int, default=2048)
+    parser.add_argument('--lr', type=float, default=3e-4)
+    parser.add_argument('--data', default=None,
+                        help='JSONL path; default synthetic')
+    parser.add_argument('--checkpoint-dir', default=None)
+    parser.add_argument('--checkpoint-every', type=int, default=100)
+    parser.add_argument('--resume', default='auto',
+                        choices=['auto', 'never'])
+    parser.add_argument('--log-every', type=int, default=10)
+    args = parser.parse_args(argv)
+
+    # Some TPU images pin a platform plugin that wins over the env var;
+    # honor an explicit JAX_PLATFORMS the way tests/conftest.py does.
+    if os.environ.get('JAX_PLATFORMS'):
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+    # Multi-host: the gang env contract (runtime/gang.py) exports the JAX
+    # coordinator triplet, so no-arg initialize() works on any cluster this
+    # framework launches.
+    if os.environ.get('JAX_COORDINATOR_ADDRESS'):
+        jax.distributed.initialize()
+    logger.info('process %d/%d, %d local / %d global devices',
+                jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import moe
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import trainer
+
+    if args.model in llama.CONFIGS:
+        cfg = llama.CONFIGS[args.model]
+        model = llama.LlamaModel(cfg)
+    elif args.model in moe.MIXTRAL_CONFIGS:
+        cfg, moe_cfg = moe.MIXTRAL_CONFIGS[args.model]
+        model = moe.MixtralModel(cfg, moe_cfg)
+    else:
+        raise SystemExit(
+            f'unknown model {args.model}; choose from '
+            f'{sorted([*llama.CONFIGS, *moe.MIXTRAL_CONFIGS])}')
+
+    spec = parse_mesh(args.mesh, jax.device_count())
+    mesh = mesh_lib.build_mesh(spec)
+    logger.info('mesh: %s', spec)
+
+    tcfg = trainer.TrainerConfig(learning_rate=args.lr,
+                                 total_steps=args.steps)
+    tx = trainer.make_optimizer(tcfg)
+    sample = jnp.zeros((args.batch, args.seq), jnp.int32)
+    state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
+                                            jax.random.PRNGKey(0))
+
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        ckpt = ckpt_lib.Checkpointer(
+            args.checkpoint_dir,
+            save_interval_steps=args.checkpoint_every)
+        if args.resume == 'auto':
+            restored = ckpt.restore(state)
+            if restored is not None:
+                state = restored
+                start_step = int(jax.device_get(state.step))
+                logger.info('resumed from step %d', start_step)
+
+    step_fn = trainer.make_train_step(model, tx, mesh)
+    batches = (jsonl_batches(args.data, cfg.vocab_size, args.batch,
+                             args.seq)
+               if args.data else
+               synthetic_batches(cfg.vocab_size, args.batch, args.seq))
+
+    t0 = time.perf_counter()
+    tokens_seen = 0
+    for step in range(start_step, args.steps):
+        batch = next(batches)
+        state, metrics = step_fn(state, batch)
+        tokens_seen += args.batch * args.seq * jax.process_count()
+        if ckpt is not None:
+            ckpt.save(step + 1, state)
+        if (step + 1) % args.log_every == 0:
+            loss = float(jax.device_get(metrics['loss']))
+            dt = time.perf_counter() - t0
+            logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
+                        step + 1, args.steps, loss, tokens_seen / dt)
+    if ckpt is not None:
+        if ckpt.latest_step() != args.steps:
+            ckpt.save(args.steps, state, force=True)
+        ckpt.close()
+    logger.info('done: %d steps', args.steps - start_step)
+
+
+if __name__ == '__main__':
+    main()

@@ -33,6 +33,10 @@ def _configure_root() -> None:
 
 def init_logger(name: str) -> logging.Logger:
     _configure_root()
+    # Modules run via `python -m` have __name__ == '__main__'; reparent
+    # them under the framework root so they inherit its handler.
+    if not name.startswith('skypilot_tpu'):
+        name = f'skypilot_tpu.{name}'
     return logging.getLogger(name)
 
 def add_file_handler(path: str) -> None:

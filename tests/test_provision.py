@@ -191,7 +191,9 @@ def test_gcp_queued_resource_body(monkeypatch):
                      'ssh_public_key': 'ssh-ed25519 AAAA test'},
         provider_config={'project': 'p', 'availability_zone': 'us-west4-a'})
     record = gcp_instance.run_instances(cfg)
-    assert record.created_instance_ids == ['tr-16']
+    assert record.created_instance_ids == [
+        f'tr-16-host-{r}' for r in range(4)]
+    assert record.head_instance_id == 'tr-16-host-0'
     assert 'spot' in bodies
     node = bodies['tpu']['nodeSpec'][0]['node']
     assert node['acceleratorType'] == 'v5litepod-16'
