@@ -119,8 +119,8 @@ def refresh_cluster_status(name: str,
                                              handle.provider_config)
     except exceptions.SkyTpuError as e:
         logger.warning('status query for %s failed: %s', name, e)
-        return state.get_cluster(name)['status'] if state.get_cluster(
-            name) else None
+        record = state.get_cluster(name)
+        return record['status'] if record else None
     if not statuses:
         # Cluster no longer exists at the provider (e.g. TPU preempted →
         # deleted). Drop it from local state.

@@ -45,11 +45,12 @@ class RetryingProvisioner:
 
     def _block(self, res: resources_lib.Resources,
                err: common.ProvisionError) -> None:
-        if err.blocked_region:
-            region = (None if err.blocked_region == '*' else
-                      err.blocked_region) or res.region
+        if err.blocked_region == '*':
+            # Project-wide failure (e.g. quota): block the whole cloud.
+            self.blocked.append(resources_lib.Resources(cloud=res.cloud))
+        elif err.blocked_region:
             self.blocked.append(resources_lib.Resources(
-                cloud=res.cloud, region=region))
+                cloud=res.cloud, region=err.blocked_region))
         elif err.blocked_zone:
             self.blocked.append(resources_lib.Resources(
                 cloud=res.cloud, region=res.region,
@@ -64,9 +65,11 @@ class RetryingProvisioner:
 
     def provision_with_retries(
             self, task, to_provision: optimizer_lib.LaunchablePlan,
-            make_config) -> 'tuple[optimizer_lib.LaunchablePlan, object]':
+            make_config) -> 'tuple[optimizer_lib.LaunchablePlan, object, object]':
         """make_config(plan) -> common.ProvisionConfig; returns the winning
-        (plan, ProvisionRecord)."""
+        (plan, ProvisionRecord, bootstrapped ProvisionConfig) — the config
+        is mutated in place by bootstrap_config (project/zone defaults),
+        and callers need those fields for get_cluster_info etc."""
         plan: Optional[optimizer_lib.LaunchablePlan] = to_provision
         while True:
             while plan is not None:
@@ -74,10 +77,10 @@ class RetryingProvisioner:
                 logger.info('Provisioning %s on %s (%s/%s)...',
                             self.cluster_name, res.cloud, res.region,
                             res.zone or '-')
+                config = make_config(plan)
                 try:
-                    record = provisioner.bulk_provision(
-                        res.cloud, make_config(plan))
-                    return plan, record
+                    record = provisioner.bulk_provision(res.cloud, config)
+                    return plan, record, config
                 except common.ProvisionError as e:
                     logger.warning('Provision failed: %s', e)
                     self.attempts.append(ProvisionAttempt(plan, str(e)))

@@ -159,15 +159,15 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
             return None
         retrier = failover.RetryingProvisioner(
             cluster_name, retry_until_up=retry_until_up)
-        plan, record = retrier.provision_with_retries(
+        plan, record, config = retrier.provision_with_retries(
             task, plan,
             lambda p: _make_provision_config(p, cluster_name,
                                              task.num_nodes))
         res = plan.resources
+        # config was bootstrapped in place by bulk_provision (project/zone
+        # defaults filled); a fresh _make_provision_config would lack them.
         info = provision.get_cluster_info(
-            res.cloud, res.region, cluster_name,
-            _make_provision_config(plan, cluster_name,
-                                   task.num_nodes).provider_config)
+            res.cloud, res.region, cluster_name, config.provider_config)
         head_port = info.provider_config.get('head_port',
                                              server_lib.DEFAULT_AGENT_PORT)
         handle = TpuVmResourceHandle(
@@ -387,7 +387,11 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                     job_ids: Optional[List[int]] = None,
                     all_jobs: bool = False) -> List[int]:
         client = handle.head_client()
-        if all_jobs or not job_ids:
+        if not all_jobs and not job_ids:
+            raise exceptions.JobError(
+                'cancel needs explicit job ids or all_jobs=True '
+                '(refusing to cancel everything implicitly).')
+        if all_jobs:
             jobs = client.jobs(statuses=['INIT', 'PENDING', 'SETTING_UP',
                                          'RUNNING'])
             job_ids = [j['job_id'] for j in jobs]

@@ -248,7 +248,7 @@ def down(clusters, purge, yes):
 
 @cli.command()
 @click.argument('cluster', required=True)
-@click.option('--idle-minutes', '-i', required=True, type=int)
+@click.option('--idle-minutes', '-i', default=None, type=int)
 @click.option('--down', is_flag=True, default=False,
               help='Terminate instead of stop when idle.')
 @click.option('--cancel', 'cancel_autostop', is_flag=True, default=False)
@@ -257,6 +257,8 @@ def autostop(cluster, idle_minutes, down, cancel_autostop):
     from skypilot_tpu import core
     if cancel_autostop:
         idle_minutes = -1
+    elif idle_minutes is None:
+        raise click.UsageError('Pass --idle-minutes N or --cancel.')
     core.autostop(cluster, idle_minutes, down=down)
     if idle_minutes < 0:
         click.echo(f'Autostop cancelled on {cluster}.')

@@ -1,0 +1,6 @@
+"""Data & storage layer (reference: sky/data/ + sky/cloud_stores.py)."""
+from skypilot_tpu.data.storage import StorageMode
+from skypilot_tpu.data.storage import StoreType
+from skypilot_tpu.data.storage import Storage
+
+__all__ = ['Storage', 'StoreType', 'StorageMode']

@@ -1,0 +1,40 @@
+"""Download commands for bucket-URI file_mounts.
+
+Reference: sky/cloud_stores.py (492 LoC) — `CloudStorage` classes that
+build existence-check + download commands (gsutil / aws s3 / azcopy /
+rclone) run on the remote host. Here: one function, scheme-dispatched.
+GCS is first-class; s3/r2/https work wherever the remote host has the
+matching CLI (TPU VMs ship gsutil + curl).
+"""
+import shlex
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_utils
+
+
+def download_command(source: str, target: str) -> str:
+    """Shell command (run on the remote host) to fetch `source` into
+    `target`. Directory sources sync recursively; file sources copy."""
+    scheme, bucket, path = data_utils.split_uri(source)
+    q_target = shlex.quote(target)
+    if scheme == 'gs':
+        return (f'mkdir -p {q_target} && '
+                f'(gsutil -m rsync -r {shlex.quote(source)} {q_target} '
+                f'2>/dev/null || gsutil cp {shlex.quote(source)} '
+                f'{q_target})')
+    if scheme == 'local':
+        src_dir = f'{data_utils.local_store_root()}/{bucket}'
+        if path:
+            src_dir = f'{src_dir}/{path}'
+        q_src = shlex.quote(src_dir)
+        return (f'mkdir -p {q_target} && if [ -d {q_src} ]; then '
+                f'cp -a {q_src}/. {q_target}/; else '
+                f'cp -a {q_src} {q_target}/; fi')
+    if scheme in ('s3', 'r2'):
+        return (f'mkdir -p {q_target} && '
+                f'aws s3 sync {shlex.quote(source)} {q_target}')
+    if scheme in ('http', 'https'):
+        return (f'mkdir -p {q_target} && cd {q_target} && '
+                f'curl -fsSLO {shlex.quote(source)}')
+    raise exceptions.StorageSourceError(
+        f'Cannot build a download command for scheme {scheme!r}')

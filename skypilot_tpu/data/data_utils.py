@@ -1,0 +1,55 @@
+"""URI and bucket helpers for the data layer.
+
+Reference: sky/data/data_utils.py (739 LoC) — URI split/verify and
+per-cloud bucket helpers. GCS-first here: the TPU-native framework treats
+``gs://`` as the primary scheme; ``local://`` is the offline store used by
+the local provider and the test harness.
+"""
+import os
+import re
+import urllib.parse
+from typing import Tuple
+
+from skypilot_tpu import exceptions
+
+CLOUD_SCHEMES = ('gs', 'local')
+# Schemes we can *download from* on a remote host but not manage as stores.
+DOWNLOAD_ONLY_SCHEMES = ('s3', 'r2', 'cos', 'https', 'http')
+
+# GCS bucket naming rules (subset): 3-63 chars, lowercase letters, digits,
+# dashes, underscores, dots; must start/end alphanumeric.
+_BUCKET_NAME_RE = re.compile(r'^[a-z0-9][a-z0-9._-]{1,61}[a-z0-9]$')
+
+
+def split_uri(uri: str) -> Tuple[str, str, str]:
+    """'gs://bucket/a/b' -> ('gs', 'bucket', 'a/b')."""
+    parsed = urllib.parse.urlsplit(uri)
+    if not parsed.scheme:
+        raise exceptions.StorageSourceError(f'Not a URI: {uri!r}')
+    return parsed.scheme, parsed.netloc, parsed.path.lstrip('/')
+
+
+def is_cloud_uri(source: str) -> bool:
+    return any(source.startswith(f'{s}://')
+               for s in CLOUD_SCHEMES + DOWNLOAD_ONLY_SCHEMES)
+
+
+def verify_bucket_name(name: str) -> None:
+    """Reference: sky/data/storage.py validate_name — GCS naming rules."""
+    if not _BUCKET_NAME_RE.match(name):
+        raise exceptions.StorageNameError(
+            f'Invalid bucket name {name!r}: must be 3-63 chars of '
+            f'[a-z0-9._-], starting/ending alphanumeric.')
+    if '..' in name or name.startswith('goog'):
+        raise exceptions.StorageNameError(
+            f'Invalid bucket name {name!r} (reserved pattern).')
+
+
+def local_store_root() -> str:
+    """Root directory that backs ``local://`` buckets (offline store)."""
+    root = os.environ.get(
+        'SKYT_LOCAL_STORAGE_ROOT',
+        os.path.join(os.environ.get('SKYT_LOCAL_ROOT',
+                                    os.path.expanduser('~/.skyt_local')),
+                     '_storage'))
+    return os.path.abspath(os.path.expanduser(root))

@@ -1,0 +1,78 @@
+"""Mount-command builders for bucket stores.
+
+Reference: sky/data/mounting_utils.py — version-pinned FUSE binaries and
+mount command builders (gcsfuse :42-62 pinned v2.2.0, goofys, blobfuse2),
+plus an idempotent mount-script wrapper with an installed-check.
+
+GCS-first: gcsfuse is the one FUSE path that matters on TPU VMs (they are
+GCP VMs; buckets are GCS). ``local://`` stores "mount" via symlink — that
+is what makes MOUNT mode testable offline on the local provider.
+"""
+import shlex
+
+GCSFUSE_VERSION = '2.2.0'
+
+_MOUNT_SCRIPT = """\
+set -e
+MOUNT_PATH={mount_path}
+if grep -qs "$MOUNT_PATH" /proc/mounts; then
+  echo "already mounted at $MOUNT_PATH"; exit 0
+fi
+{install_cmd}
+sudo mkdir -p $MOUNT_PATH
+sudo chown $(whoami) $MOUNT_PATH
+{mount_cmd}
+"""
+
+
+def gcsfuse_install_command() -> str:
+    """Install the pinned gcsfuse if absent (reference pins v2.2.0,
+    sky/data/mounting_utils.py:16)."""
+    return (
+        f'gcsfuse --version 2>/dev/null | grep -q {GCSFUSE_VERSION} || '
+        f'(curl -fsSL -o /tmp/gcsfuse.deb https://github.com/'
+        f'GoogleCloudPlatform/gcsfuse/releases/download/v{GCSFUSE_VERSION}/'
+        f'gcsfuse_{GCSFUSE_VERSION}_amd64.deb && '
+        f'sudo dpkg -i /tmp/gcsfuse.deb || sudo apt-get install -f -y)')
+
+
+def gcsfuse_mount_command(bucket: str, mount_path: str,
+                          sub_path: str = '') -> str:
+    """Build the full idempotent gcsfuse mount script.
+
+    --implicit-dirs: GCS has no real directories; without it empty prefixes
+    are invisible. Stat/type cache TTLs mirror the reference's tuning for
+    read-heavy training workloads.
+    """
+    only_dir = f'--only-dir {shlex.quote(sub_path)} ' if sub_path else ''
+    mount_cmd = (f'gcsfuse --implicit-dirs '
+                 f'--stat-cache-capacity 4096 '
+                 f'--stat-cache-ttl 5s --type-cache-ttl 5s '
+                 f'--rename-dir-limit 10000 '
+                 f'{only_dir}'
+                 f'{shlex.quote(bucket)} {shlex.quote(mount_path)}')
+    return _MOUNT_SCRIPT.format(mount_path=shlex.quote(mount_path),
+                                install_cmd=gcsfuse_install_command(),
+                                mount_cmd=mount_cmd)
+
+
+def local_mount_command(store_dir: str, mount_path: str) -> str:
+    """'Mount' a local:// store by symlinking its backing directory.
+
+    Gives MOUNT-mode semantics (writes propagate to the store) without FUSE
+    — the offline analog the test harness uses.
+    """
+    q_store = shlex.quote(store_dir)
+    q_mount = shlex.quote(mount_path)
+    return (f'set -e; mkdir -p {q_store}; '
+            f'mkdir -p "$(dirname {q_mount})"; '
+            f'if [ -L {q_mount} ] || [ -e {q_mount} ]; then '
+            f'rm -rf {q_mount}; fi; '
+            f'ln -s {q_store} {q_mount}')
+
+
+def unmount_command(mount_path: str) -> str:
+    q = shlex.quote(mount_path)
+    return (f'if [ -L {q} ]; then rm {q}; '
+            f'elif grep -qs {q} /proc/mounts; then '
+            f'fusermount -u {q} || sudo umount -l {q}; fi')

@@ -1,0 +1,390 @@
+"""Storage: named bucket abstraction (GCS-first).
+
+Reference: sky/data/storage.py (3,526 LoC) — `StoreType` (:109),
+`StorageMode` (:192), `AbstractStore` (:197), `Storage` (:384),
+`GcsStore` (:1511, gsutil rsync batching). The reference supports five
+object stores (S3/GCS/Azure/R2/COS); the TPU-native rebuild is GCS-first
+(TPU VMs are GCP VMs — one bucket family rides the same network as the
+chips) plus a ``local://`` store that backs the offline test harness and
+the local provider. Download-only access to foreign schemes (s3:// etc.)
+lives in cloud_stores.py.
+"""
+import dataclasses
+import enum
+import fnmatch
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data import storage_utils
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+class StoreType(enum.Enum):
+    """Reference: sky/data/storage.py:109."""
+    GCS = 'GCS'
+    LOCAL = 'LOCAL'
+
+    @classmethod
+    def from_scheme(cls, scheme: str) -> 'StoreType':
+        if scheme == 'gs':
+            return cls.GCS
+        if scheme == 'local':
+            return cls.LOCAL
+        raise exceptions.StorageSourceError(
+            f'No store type for scheme {scheme!r} (managed stores: '
+            f'gs://, local://).')
+
+    @property
+    def scheme(self) -> str:
+        return {'GCS': 'gs', 'LOCAL': 'local'}[self.value]
+
+
+class StorageMode(enum.Enum):
+    """Reference: sky/data/storage.py:192."""
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+
+
+def _run(cmd: List[str], failure: str, **kwargs) -> str:
+    proc = subprocess.run(cmd, capture_output=True, text=True, check=False,
+                          **kwargs)
+    if proc.returncode != 0:
+        raise exceptions.StorageError(
+            f'{failure}: {" ".join(cmd)!r} failed with '
+            f'{proc.stderr.strip() or proc.stdout.strip()}')
+    return proc.stdout
+
+
+@dataclasses.dataclass
+class StorageHandle:
+    """Pickled into the state DB (reference pickles the Storage's
+    StorageMetadata, sky/data/storage.py:384)."""
+    storage_name: str
+    source: Optional[str]
+    mode: str
+    store_types: List[str]
+    sky_managed: bool
+
+
+class AbstractStore:
+    """One bucket in one store. Reference: sky/data/storage.py:197."""
+
+    store_type: StoreType
+
+    def __init__(self, name: str, source: Optional[str],
+                 sky_managed: bool = True) -> None:
+        data_utils.verify_bucket_name(name)
+        self.name = name
+        self.source = source
+        # sky_managed: we created the bucket, so delete() removes it;
+        # external buckets are never deleted (reference is_sky_managed).
+        self.sky_managed = sky_managed
+
+    # Lifecycle ----------------------------------------------------------
+    def initialize(self) -> None:
+        """Create the bucket if needed; set sky_managed accordingly."""
+        raise NotImplementedError
+
+    def upload(self, source: str) -> None:
+        """Sync a local directory/file into the bucket."""
+        raise NotImplementedError
+
+    def delete(self) -> None:
+        raise NotImplementedError
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    # Remote-side commands ----------------------------------------------
+    def mount_command(self, mount_path: str) -> str:
+        raise NotImplementedError
+
+    def download_command(self, target: str) -> str:
+        raise NotImplementedError
+
+    @property
+    def uri(self) -> str:
+        return f'{self.store_type.scheme}://{self.name}'
+
+
+class GcsStore(AbstractStore):
+    """GCS bucket via the gsutil/gcloud CLI (the TPU VM has both baked in;
+    the client needs gcloud auth). Reference: sky/data/storage.py:1511 —
+    same tool choice (gsutil -m rsync), no SDK dependency.
+    """
+
+    store_type = StoreType.GCS
+
+    def initialize(self) -> None:
+        if self.exists():
+            # Pre-existing bucket — never delete it on `storage delete`.
+            self.sky_managed = False
+            return
+        if self.source is not None and data_utils.is_cloud_uri(self.source):
+            raise exceptions.StorageBucketGetError(
+                f'Source bucket {self.source!r} does not exist.')
+        _run(['gsutil', 'mb', f'gs://{self.name}'],
+             failure=f'Could not create bucket {self.name!r}')
+        self.sky_managed = True
+
+    def exists(self) -> bool:
+        proc = subprocess.run(['gsutil', 'ls', '-b', f'gs://{self.name}'],
+                              capture_output=True, text=True, check=False)
+        return proc.returncode == 0
+
+    def upload(self, source: str) -> None:
+        source = os.path.abspath(os.path.expanduser(source))
+        if os.path.isdir(source):
+            excludes = storage_utils.get_excluded_files(source)
+            # gsutil -x takes a single pipe-joined python-regex.
+            regex = '|'.join(fnmatch.translate(p) for p in excludes)
+            _run(['gsutil', '-m', 'rsync', '-r', '-x', regex, source,
+                  f'gs://{self.name}'],
+                 failure=f'Upload to {self.name!r} failed')
+        else:
+            _run(['gsutil', 'cp', source, f'gs://{self.name}/'],
+                 failure=f'Upload to {self.name!r} failed')
+
+    def delete(self) -> None:
+        if not self.sky_managed:
+            logger.info('Bucket %s is external; not deleting.', self.name)
+            return
+        _run(['gsutil', '-m', 'rm', '-r', f'gs://{self.name}'],
+             failure=f'Could not delete bucket {self.name!r}')
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.gcsfuse_mount_command(self.name, mount_path)
+
+    def download_command(self, target: str) -> str:
+        return (f'mkdir -p {target} && '
+                f'gsutil -m rsync -r gs://{self.name} {target}')
+
+
+class LocalStore(AbstractStore):
+    """Directory-backed bucket under SKYT_LOCAL_STORAGE_ROOT.
+
+    The offline analog of GcsStore: same lifecycle, upload, MOUNT
+    (symlink) and COPY semantics — what makes the storage layer testable
+    without a cloud (SURVEY.md §4 implication: fake-cloud tier).
+    """
+
+    store_type = StoreType.LOCAL
+
+    @property
+    def bucket_dir(self) -> str:
+        return os.path.join(data_utils.local_store_root(), self.name)
+
+    def initialize(self) -> None:
+        if self.exists():
+            self.sky_managed = False
+            return
+        if self.source is not None and data_utils.is_cloud_uri(self.source):
+            raise exceptions.StorageBucketGetError(
+                f'Source bucket {self.source!r} does not exist.')
+        os.makedirs(self.bucket_dir, exist_ok=True)
+        self.sky_managed = True
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.bucket_dir)
+
+    def upload(self, source: str) -> None:
+        source = os.path.abspath(os.path.expanduser(source))
+        os.makedirs(self.bucket_dir, exist_ok=True)
+        if os.path.isdir(source):
+            excludes = storage_utils.get_excluded_files(source)
+
+            def ignore(_d: str, names: List[str]) -> List[str]:
+                return [n for n in names
+                        if any(fnmatch.fnmatch(n, p) for p in excludes)]
+
+            shutil.copytree(source, self.bucket_dir, ignore=ignore,
+                            dirs_exist_ok=True, symlinks=True)
+        elif os.path.exists(source):
+            shutil.copy2(source, self.bucket_dir)
+        else:
+            raise exceptions.StorageUploadError(
+                f'Source {source!r} does not exist')
+
+    def delete(self) -> None:
+        if not self.sky_managed:
+            return
+        shutil.rmtree(self.bucket_dir, ignore_errors=True)
+
+    def mount_command(self, mount_path: str) -> str:
+        return mounting_utils.local_mount_command(self.bucket_dir,
+                                                  mount_path)
+
+    def download_command(self, target: str) -> str:
+        return (f'mkdir -p {target} && '
+                f'cp -a {self.bucket_dir}/. {target}/')
+
+
+_STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.LOCAL: LocalStore}
+
+
+def default_store_type() -> StoreType:
+    """Store used when a spec names none: SKYT_DEFAULT_STORE env >
+    config `storage.default_store` > GCS. The local provider / test
+    harness sets `local` so no cloud CLI is ever invoked offline."""
+    from skypilot_tpu import skyt_config
+    name = os.environ.get(
+        'SKYT_DEFAULT_STORE',
+        skyt_config.get_nested(('storage', 'default_store'), 'gcs'))
+    return StoreType(str(name).upper())
+
+
+class Storage:
+    """Named bucket abstraction. Reference: sky/data/storage.py:384.
+
+    source semantics (same as reference):
+      * None          — scratch bucket named `name`, created on demand.
+      * local path    — uploaded into the bucket on add_store().
+      * gs://bucket   — external bucket; name defaults to the bucket name,
+                        nothing is uploaded, never deleted.
+    """
+
+    def __init__(self,
+                 name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 persistent: bool = True) -> None:
+        if name is None and source is None:
+            raise exceptions.StorageError(
+                'Storage needs a name or a source.')
+        if source is not None and data_utils.is_cloud_uri(source):
+            scheme, bucket, _ = data_utils.split_uri(source)
+            if scheme not in ('gs', 'local'):
+                raise exceptions.StorageSourceError(
+                    f'Managed storage supports gs:// and local:// sources; '
+                    f'for one-shot downloads from {scheme}:// use a plain '
+                    f'file_mount (cloud_stores.py).')
+            if name is None:
+                name = bucket
+        elif source is not None:
+            expanded = os.path.abspath(os.path.expanduser(source))
+            if not os.path.exists(expanded):
+                raise exceptions.StorageSourceError(
+                    f'Local source {source!r} does not exist.')
+            if name is None:
+                raise exceptions.StorageNameError(
+                    'A storage with a local source needs an explicit name.')
+        assert name is not None
+        data_utils.verify_bucket_name(name)
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self.stores: Dict[StoreType, AbstractStore] = {}
+
+    # ----------------------------------------------------------- lifecycle
+    def add_store(self, store_type: StoreType = StoreType.GCS) -> \
+            AbstractStore:
+        """Create/attach the bucket in `store_type` and upload a local
+        source if present. Reference: Storage.add_store + sync."""
+        if store_type in self.stores:
+            return self.stores[store_type]
+        source_is_uri = (self.source is not None and
+                         data_utils.is_cloud_uri(self.source))
+        store = _STORE_CLASSES[store_type](self.name, self.source)
+        state.add_or_update_storage(self.name, self._handle(),
+                                    state.StorageStatus.INIT)
+        store.initialize()
+        if self.source is not None and not source_is_uri:
+            try:
+                store.upload(self.source)
+            except exceptions.StorageError:
+                state.add_or_update_storage(
+                    self.name, self._handle(),
+                    state.StorageStatus.UPLOAD_FAILED)
+                raise
+        self.stores[store_type] = store
+        state.add_or_update_storage(self.name, self._handle(),
+                                    state.StorageStatus.READY)
+        return store
+
+    def delete(self, store_type: Optional[StoreType] = None) -> None:
+        """Reference: Storage.delete — removes bucket(s) + state row."""
+        targets = ([store_type] if store_type is not None
+                   else list(self.stores))
+        for st in targets:
+            store = self.stores.pop(st, None)
+            if store is not None:
+                store.delete()
+        if not self.stores:
+            state.remove_storage(self.name)
+
+    @classmethod
+    def delete_by_name(cls, name: str) -> None:
+        record = state.get_storage(name)
+        if record is None:
+            raise exceptions.StorageError(f'Storage {name!r} not found.')
+        handle: StorageHandle = record['handle']
+        storage = cls.from_handle(handle)
+        storage.delete()
+
+    @classmethod
+    def from_handle(cls, handle: StorageHandle) -> 'Storage':
+        storage = cls(name=handle.storage_name, source=handle.source,
+                      mode=StorageMode(handle.mode))
+        for st_name in handle.store_types:
+            st = StoreType(st_name)
+            store = _STORE_CLASSES[st](handle.storage_name, handle.source,
+                                       sky_managed=handle.sky_managed)
+            storage.stores[st] = store
+        return storage
+
+    def _handle(self) -> StorageHandle:
+        sky_managed = all(s.sky_managed for s in self.stores.values()) \
+            if self.stores else True
+        return StorageHandle(storage_name=self.name, source=self.source,
+                             mode=self.mode.value,
+                             store_types=[s.value for s in self.stores],
+                             sky_managed=sky_managed)
+
+    # ---------------------------------------------------------------- yaml
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        """A `file_mounts` dict value: {name, source, store, mode,
+        persistent}. Reference: Storage.from_yaml_config."""
+        mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        storage = cls(name=config.get('name'),
+                      source=config.get('source'),
+                      mode=mode,
+                      persistent=config.get('persistent', True))
+        if 'store' in config and config['store'] is not None:
+            storage._requested_store = StoreType(  # pylint: disable=attribute-defined-outside-init
+                str(config['store']).upper())
+        return storage
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {'name': self.name}
+        if self.source is not None:
+            cfg['source'] = self.source
+        cfg['mode'] = self.mode.value
+        if not self.persistent:
+            cfg['persistent'] = False
+        if self.stores:
+            cfg['store'] = next(iter(self.stores)).value.lower()
+        return cfg
+
+    @property
+    def requested_store(self) -> StoreType:
+        explicit = getattr(self, '_requested_store', None)
+        if explicit is not None:
+            return explicit
+        if self.source is not None and data_utils.is_cloud_uri(self.source):
+            scheme, _, _ = data_utils.split_uri(self.source)
+            return StoreType.from_scheme(scheme)
+        return default_store_type()
+
+    def __repr__(self) -> str:
+        return (f'Storage({self.name!r}, source={self.source!r}, '
+                f'mode={self.mode.value})')

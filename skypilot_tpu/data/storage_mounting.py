@@ -1,0 +1,68 @@
+"""Bridge between the backend and the storage layer.
+
+Reference: sky/backends/cloud_vm_ray_backend.py:4549
+`_execute_storage_mounts` — ensures each task storage exists + is
+uploaded, then runs the per-store MOUNT (FUSE) or COPY (download)
+command on every host in parallel.
+"""
+from typing import Any, Dict, List, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.utils import command_runner as command_runner_lib
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def to_storage(obj: Union['storage_lib.Storage', Dict[str, Any], str]
+               ) -> 'storage_lib.Storage':
+    """Coerce a task.storage_mounts value (raw YAML dict, URI string, or
+    Storage) into a Storage object."""
+    if isinstance(obj, storage_lib.Storage):
+        return obj
+    if isinstance(obj, str):
+        return storage_lib.Storage(source=obj)
+    if isinstance(obj, dict):
+        return storage_lib.Storage.from_yaml_config(obj)
+    raise exceptions.StorageError(
+        f'Cannot interpret storage mount spec {obj!r}')
+
+
+def mount_storages(
+        runners: List['command_runner_lib.CommandRunner'],
+        storage_mounts: Dict[str, Any]) -> None:
+    """Create/upload each storage, then mount or copy it on every host."""
+    for mount_path, spec in storage_mounts.items():
+        storage = to_storage(spec)
+        store = storage.add_store(storage.requested_store)
+        if storage.mode is storage_lib.StorageMode.MOUNT:
+            cmd = store.mount_command(mount_path)
+            what = 'mount'
+        else:
+            cmd = store.download_command(mount_path)
+            what = 'copy'
+        logger.info('Storage %s: %s %s -> %s', storage.name, what,
+                    store.uri, mount_path)
+
+        def _apply(runner, _cmd=cmd, _uri=store.uri, _path=mount_path,
+                   _what=what):
+            runner.run_or_raise(
+                _cmd,
+                failure_message=f'{_what} of {_uri} at {_path} failed')
+
+        subprocess_utils.run_in_parallel(_apply, runners)
+
+
+def unmount_storages(
+        runners: List['command_runner_lib.CommandRunner'],
+        storage_mounts: Dict[str, Any]) -> None:
+    from skypilot_tpu.data import mounting_utils
+    for mount_path in storage_mounts:
+        cmd = mounting_utils.unmount_command(mount_path)
+
+        def _apply(runner, _cmd=cmd):
+            runner.run(_cmd, stream_logs=False)
+
+        subprocess_utils.run_in_parallel(_apply, runners)

@@ -1,0 +1,35 @@
+"""Excluded-file handling for uploads.
+
+Reference: sky/data/storage_utils.py (~230 LoC) — honors `.skyignore`
+(one glob per line, '#' comments) falling back to `.gitignore` patterns.
+We use the same precedence with a `.skytignore` name plus the reference's
+`.skyignore` as an alias so existing projects port over unchanged.
+"""
+import os
+from typing import List
+
+IGNORE_FILES = ('.skytignore', '.skyignore', '.gitignore')
+
+DEFAULT_EXCLUDES = ['.git', '__pycache__', '*.pyc']
+
+
+def get_excluded_files(src_dir: str) -> List[str]:
+    """Return glob patterns to exclude when uploading `src_dir`.
+
+    First ignore-file found (in IGNORE_FILES order) wins, matching the
+    reference's skyignore-overrides-gitignore behavior
+    (sky/data/storage_utils.py).
+    """
+    excludes = list(DEFAULT_EXCLUDES)
+    for fname in IGNORE_FILES:
+        path = os.path.join(src_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith('#') or line.startswith('!'):
+                    continue
+                excludes.append(line.rstrip('/'))
+        break
+    return excludes

@@ -118,7 +118,14 @@ def _execute(
     finally:
         if Stage.DOWN in stages and down and \
                 idle_minutes_to_autostop is None:
-            backend.teardown(handle, terminate=True)
+            if detach_run:
+                # The job was only just submitted — tearing down now would
+                # kill it. Let the agent's autostop event tear the cluster
+                # down once the queue drains (reference routes --down
+                # through autostop for the same reason).
+                backend.set_autostop(handle, 0, down=True)
+            else:
+                backend.teardown(handle, terminate=True)
     return job_id
 
 

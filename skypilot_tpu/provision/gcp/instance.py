@@ -380,6 +380,10 @@ def _wait_compute_op(op: Dict[str, Any], timeout: float = 120.0) -> None:
     if err:
         raise common.ProvisionError(f'compute operation failed: {err}',
                                     retryable=False)
+    if link and op.get('status') != 'DONE':
+        raise common.ProvisionError(
+            f'compute operation {link} not DONE after {timeout}s '
+            f'(status={op.get("status")!r})', retryable=True)
 
 
 def cleanup_ports(cluster_name: str,

@@ -52,8 +52,12 @@ def jsonl_batches(path: str, vocab_size: int, batch: int, seq: int
     pre-tokenized 'tokens' for real runs."""
     def _tokens():
         while True:
+            n_rows = 0
             with open(path, 'r', encoding='utf-8') as f:
                 for line in f:
+                    if not line.strip():
+                        continue
+                    n_rows += 1
                     row = json.loads(line)
                     if 'tokens' in row:
                         yield from (int(t) % vocab_size
@@ -62,6 +66,8 @@ def jsonl_batches(path: str, vocab_size: int, batch: int, seq: int
                         yield from (b % vocab_size
                                     for b in row['text'].encode())
                     yield 0  # document separator
+            if n_rows == 0:
+                raise ValueError(f'no data rows in {path!r}')
 
     stream = _tokens()
     while True:

@@ -1,0 +1,182 @@
+"""Storage layer tests — run fully offline on the local:// store.
+
+Reference test strategy: sky tests/test_storage.py + storage smoke tests
+(SURVEY.md §4.6); here the LocalStore gives the same lifecycle coverage
+without a cloud.
+"""
+import os
+
+import pytest
+
+from skypilot_tpu import core
+from skypilot_tpu import exceptions
+from skypilot_tpu import state
+from skypilot_tpu.data import cloud_stores
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.data import storage_mounting
+from skypilot_tpu.data import storage_utils
+from skypilot_tpu.utils import command_runner
+
+
+@pytest.fixture()
+def storage_env(tmp_path, tmp_state_dir, monkeypatch):
+    monkeypatch.setenv('SKYT_LOCAL_STORAGE_ROOT', str(tmp_path / 'buckets'))
+    monkeypatch.setenv('SKYT_DEFAULT_STORE', 'local')
+    yield tmp_path
+
+
+def _make_src(tmp_path, files=('a.txt', 'sub/b.txt')):
+    src = tmp_path / 'src'
+    for rel in files:
+        p = src / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(f'content of {rel}')
+    return src
+
+
+def test_scratch_bucket_lifecycle(storage_env):
+    s = storage_lib.Storage(name='scratch-bkt')
+    store = s.add_store(storage_lib.StoreType.LOCAL)
+    assert store.exists()
+    assert state.get_storage('scratch-bkt')['status'] == \
+        state.StorageStatus.READY
+    s.delete()
+    assert not store.exists()
+    assert state.get_storage('scratch-bkt') is None
+
+
+def test_local_source_upload(storage_env):
+    src = _make_src(storage_env)
+    s = storage_lib.Storage(name='up-bkt', source=str(src))
+    store = s.add_store(storage_lib.StoreType.LOCAL)
+    assert (storage_env / 'buckets' / 'up-bkt' / 'a.txt').read_text() == \
+        'content of a.txt'
+    assert (storage_env / 'buckets' / 'up-bkt' / 'sub' / 'b.txt').exists()
+    store.delete()
+
+
+def test_skyignore_excludes_upload(storage_env):
+    src = _make_src(storage_env, files=('keep.txt', 'drop.log', 'x.pyc'))
+    (src / '.skytignore').write_text('*.log\n# comment\n')
+    s = storage_lib.Storage(name='ign-bkt', source=str(src))
+    s.add_store(storage_lib.StoreType.LOCAL)
+    bucket = storage_env / 'buckets' / 'ign-bkt'
+    assert (bucket / 'keep.txt').exists()
+    assert not (bucket / 'drop.log').exists()
+    assert not (bucket / 'x.pyc').exists()  # default excludes
+
+
+def test_excluded_files_precedence(tmp_path):
+    src = tmp_path / 'd'
+    src.mkdir()
+    (src / '.gitignore').write_text('git-only\n')
+    assert 'git-only' in storage_utils.get_excluded_files(str(src))
+    (src / '.skytignore').write_text('skyt-only\n')
+    excludes = storage_utils.get_excluded_files(str(src))
+    assert 'skyt-only' in excludes
+    assert 'git-only' not in excludes
+
+
+def test_external_bucket_not_deleted(storage_env):
+    # Pre-create the bucket out-of-band => treated as external.
+    bucket = storage_env / 'buckets' / 'ext-bkt'
+    bucket.mkdir(parents=True)
+    (bucket / 'data.txt').write_text('external')
+    s = storage_lib.Storage(source='local://ext-bkt')
+    assert s.name == 'ext-bkt'
+    store = s.add_store(storage_lib.StoreType.LOCAL)
+    assert not store.sky_managed
+    s.delete()
+    assert bucket.exists()  # external data survives delete
+
+
+def test_missing_source_bucket_raises(storage_env):
+    s = storage_lib.Storage(source='local://no-such-bkt')
+    with pytest.raises(exceptions.StorageBucketGetError):
+        s.add_store(storage_lib.StoreType.LOCAL)
+
+
+def test_storage_validation():
+    with pytest.raises(exceptions.StorageError):
+        storage_lib.Storage()  # neither name nor source
+    with pytest.raises(exceptions.StorageNameError):
+        storage_lib.Storage(name='UPPER')  # invalid bucket name
+    with pytest.raises(exceptions.StorageSourceError):
+        storage_lib.Storage(name='ok-name', source='/no/such/path')
+    with pytest.raises(exceptions.StorageSourceError):
+        storage_lib.Storage(source='s3://foreign')  # not a managed scheme
+
+
+def test_mount_mode_symlink(storage_env):
+    host = storage_env / 'host0'
+    host.mkdir()
+    runner = command_runner.LocalProcessRunner(str(host))
+    mount_path = str(host / 'mnt' / 'data')
+    storage_mounting.mount_storages(
+        [runner], {mount_path: {'name': 'mnt-bkt', 'mode': 'MOUNT'}})
+    # Writes through the mount land in the bucket (MOUNT semantics).
+    with open(os.path.join(mount_path, 'out.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('written-via-mount')
+    assert (storage_env / 'buckets' / 'mnt-bkt' / 'out.txt').read_text() \
+        == 'written-via-mount'
+    storage_mounting.unmount_storages([runner], {mount_path: None})
+    assert not os.path.lexists(mount_path)
+    # Bucket data survives unmount.
+    assert (storage_env / 'buckets' / 'mnt-bkt' / 'out.txt').exists()
+
+
+def test_copy_mode(storage_env):
+    src = _make_src(storage_env)
+    host = storage_env / 'host0'
+    host.mkdir()
+    runner = command_runner.LocalProcessRunner(str(host))
+    target = str(host / 'data')
+    storage_mounting.mount_storages(
+        [runner],
+        {target: {'name': 'cp-bkt', 'source': str(src), 'mode': 'COPY'}})
+    assert (host / 'data' / 'a.txt').read_text() == 'content of a.txt'
+    # COPY is a snapshot: bucket changes don't propagate.
+    (storage_env / 'buckets' / 'cp-bkt' / 'new.txt').write_text('later')
+    assert not (host / 'data' / 'new.txt').exists()
+
+
+def test_core_storage_ls_delete(storage_env):
+    s = storage_lib.Storage(name='ls-bkt')
+    s.add_store(storage_lib.StoreType.LOCAL)
+    names = [r['name'] for r in core.storage_ls()]
+    assert 'ls-bkt' in names
+    core.storage_delete('ls-bkt')
+    assert core.storage_ls() == []
+    with pytest.raises(exceptions.StorageError):
+        core.storage_delete('ls-bkt')
+
+
+def test_storage_yaml_roundtrip(storage_env):
+    cfg = {'name': 'yml-bkt', 'mode': 'COPY', 'persistent': False}
+    s = storage_lib.Storage.from_yaml_config(cfg)
+    assert s.mode is storage_lib.StorageMode.COPY
+    assert not s.persistent
+    out = s.to_yaml_config()
+    assert out['name'] == 'yml-bkt'
+    assert out['mode'] == 'COPY'
+    assert out['persistent'] is False
+
+
+def test_download_commands():
+    cmd = cloud_stores.download_command('gs://bkt/path', '/dst')
+    assert 'gsutil' in cmd and '/dst' in cmd
+    cmd = cloud_stores.download_command('s3://bkt/path', '/dst')
+    assert 'aws s3 sync' in cmd
+    cmd = cloud_stores.download_command('https://x.test/f.bin', '/dst')
+    assert 'curl' in cmd
+    with pytest.raises(exceptions.StorageSourceError):
+        cloud_stores.download_command('ftp://x/y', '/dst')
+
+
+def test_split_uri():
+    assert data_utils.split_uri('gs://b/a/c.txt') == ('gs', 'b', 'a/c.txt')
+    assert data_utils.split_uri('local://bkt') == ('local', 'bkt', '')
+    with pytest.raises(exceptions.StorageSourceError):
+        data_utils.split_uri('not-a-uri')
