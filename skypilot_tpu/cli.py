@@ -338,6 +338,161 @@ def storage_delete(names, yes):
         click.echo(f'Storage {name} deleted.')
 
 
+# ------------------------------------------------------------------- jobs
+@cli.group()
+def jobs():
+    """Managed jobs with preemption recovery. Reference: sky jobs."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint', required=True)
+@click.option('--name', '-n', default=None)
+@click.option('--workdir', default=None, type=click.Path(exists=True))
+@click.option('--cloud', default=None)
+@click.option('--gpus', '--tpus', 'accelerators', default=None)
+@click.option('--num-nodes', default=None, type=int)
+@click.option('--use-spot/--no-use-spot', default=None)
+@click.option('--env', 'envs', multiple=True, help='KEY=VAL.')
+@click.option('--retry-until-up/--no-retry-until-up', default=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_launch(entrypoint, name, workdir, cloud, accelerators, num_nodes,
+                use_spot, envs, retry_until_up, detach_run, yes):
+    """Launch a managed job. Reference: sky jobs launch (cli.py:3500)."""
+    from skypilot_tpu.jobs import core as jobs_core
+    task = _load_task(entrypoint, name=name, workdir=workdir, cloud=cloud,
+                      accelerators=accelerators, num_nodes=num_nodes,
+                      use_spot=use_spot, envs=envs)
+    if not yes:
+        click.confirm(f'Launch managed job {name or task.name or "?"!r}?',
+                      default=True, abort=True)
+    job_id = jobs_core.launch(task, name, retry_until_up=retry_until_up,
+                              detach=detach_run)
+    click.echo(f'Managed job {job_id} submitted.')
+
+
+@jobs.command(name='queue')
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def jobs_queue(skip_finished):
+    """Reference: sky jobs queue."""
+    from skypilot_tpu.jobs import core as jobs_core
+    rows = []
+    for j in jobs_core.queue(skip_finished=skip_finished):
+        rows.append([j['job_id'], j['name'] or '-', j['status'].value,
+                     j['recovery_count'],
+                     j.get('failure_reason') or '-'])
+    click.echo(_fmt_table(rows, ['ID', 'NAME', 'STATUS', 'RECOVERIES',
+                                 'REASON']))
+
+
+@jobs.command(name='cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel(job_ids, all_jobs, yes):
+    """Reference: sky jobs cancel."""
+    from skypilot_tpu.jobs import core as jobs_core
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Provide JOB_IDS or --all.')
+    if not yes:
+        what = 'ALL managed jobs' if all_jobs else f'jobs {list(job_ids)}'
+        click.confirm(f'Cancel {what}?', default=True, abort=True)
+    cancelled = jobs_core.cancel(list(job_ids) or None, all_jobs=all_jobs)
+    click.echo(f'Cancelled: {cancelled or "none"}')
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', required=False, type=int)
+@click.option('--controller', is_flag=True, default=False,
+              help='Tail the controller process log instead.')
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs(job_id, controller, no_follow):
+    """Reference: sky jobs logs."""
+    from skypilot_tpu.jobs import core as jobs_core
+    sys.exit(jobs_core.tail_logs(job_id, follow=not no_follow,
+                                 controller=controller))
+
+
+# ------------------------------------------------------------------ serve
+@cli.group()
+def serve():
+    """Autoscaled model serving. Reference: sky serve."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint', required=True)
+@click.option('--service-name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up(entrypoint, service_name, yes):
+    """Start a service. Reference: sky serve up."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(entrypoint)
+    if not yes:
+        click.confirm(
+            f'Start service {service_name or task.name or "?"!r}?',
+            default=True, abort=True)
+    name, endpoint = serve_core.up(task, service_name)
+    click.echo(f'Service {name} starting. Endpoint: {endpoint}')
+
+
+@serve.command(name='update')
+@click.argument('service_name', required=True)
+@click.argument('entrypoint', required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_update(service_name, entrypoint, yes):
+    """Rolling-update a service. Reference: sky serve update."""
+    from skypilot_tpu.serve import core as serve_core
+    task = _load_task(entrypoint)
+    if not yes:
+        click.confirm(f'Update service {service_name!r}?', default=True,
+                      abort=True)
+    version = serve_core.update(task, service_name)
+    click.echo(f'Service {service_name} rolling to version {version}.')
+
+
+@serve.command(name='down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--purge', '-p', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_names, purge, yes):
+    """Tear down service(s). Reference: sky serve down."""
+    from skypilot_tpu.serve import core as serve_core
+    for name in service_names:
+        if not yes:
+            click.confirm(f'Tear down service {name!r}?', default=True,
+                          abort=True)
+        serve_core.down(name, purge=purge)
+        click.echo(f'Service {name} terminated.')
+
+
+@serve.command(name='status')
+@click.argument('service_names', nargs=-1)
+def serve_status(service_names):
+    """Reference: sky serve status."""
+    from skypilot_tpu.serve import core as serve_core
+    for svc in serve_core.status(list(service_names) or None):
+        click.echo(f'{svc["name"]}: {svc["status"].value} '
+                   f'(v{svc["version"]}) endpoint={svc["endpoint"]}')
+        rows = [[r['replica_id'], r['cluster_name'],
+                 r['status'].value, r['endpoint'] or '-',
+                 r['version']] for r in svc['replicas']]
+        click.echo(_fmt_table(rows, ['ID', 'CLUSTER', 'STATUS',
+                                     'ENDPOINT', 'VERSION']))
+
+
+@serve.command(name='logs')
+@click.argument('service_name', required=True)
+@click.option('--replica-id', type=int, default=None,
+              help='Tail this replica\'s cluster log instead.')
+@click.option('--follow/--no-follow', default=False)
+def serve_logs(service_name, replica_id, follow):
+    """Reference: sky serve logs."""
+    from skypilot_tpu.serve import core as serve_core
+    target = 'replica' if replica_id is not None else 'controller'
+    sys.exit(serve_core.tail_logs(service_name, target=target,
+                                  replica_id=replica_id, follow=follow))
+
+
 def main() -> None:
     try:
         cli()

@@ -64,10 +64,17 @@ def local_mount_command(store_dir: str, mount_path: str) -> str:
     """
     q_store = shlex.quote(store_dir)
     q_mount = shlex.quote(mount_path)
+    # Never delete pre-existing data at the mount point: an old symlink is
+    # replaced, an empty dir is removed, anything else is an error (the
+    # gcsfuse path likewise refuses to mount over existing content).
     return (f'set -e; mkdir -p {q_store}; '
             f'mkdir -p "$(dirname {q_mount})"; '
-            f'if [ -L {q_mount} ] || [ -e {q_mount} ]; then '
-            f'rm -rf {q_mount}; fi; '
+            f'if [ -L {q_mount} ]; then rm {q_mount}; '
+            f'elif [ -d {q_mount} ]; then rmdir {q_mount} || '
+            f'{{ echo "mount path {q_mount} is a non-empty directory" '
+            f'>&2; exit 1; }}; '
+            f'elif [ -e {q_mount} ]; then '
+            f'echo "mount path {q_mount} exists" >&2; exit 1; fi; '
             f'ln -s {q_store} {q_mount}')
 
 

@@ -144,8 +144,20 @@ class GcsStore(AbstractStore):
         source = os.path.abspath(os.path.expanduser(source))
         if os.path.isdir(source):
             excludes = storage_utils.get_excluded_files(source)
-            # gsutil -x takes a single pipe-joined python-regex.
-            regex = '|'.join(fnmatch.translate(p) for p in excludes)
+            # gsutil -x takes a single pipe-joined python-regex matched
+            # against each file's bucket-relative path. A bare name like
+            # '.git' must also exclude everything *inside* it, and match
+            # at any path depth — fnmatch.translate alone anchors to the
+            # whole path and would miss '.git/config'.
+            parts = []
+            for p in excludes:
+                seg = fnmatch.translate(p)
+                # Strip the terminating \Z (or \)\Z wrapper tail) that
+                # translate() appends, keeping the (?s:...) group.
+                if seg.endswith(r'\Z'):
+                    seg = seg[:-2]
+                parts.append(f'(^|.*/){seg}($|/.*)')
+            regex = '|'.join(parts)
             _run(['gsutil', '-m', 'rsync', '-r', '-x', regex, source,
                   f'gs://{self.name}'],
                  failure=f'Upload to {self.name!r} failed')

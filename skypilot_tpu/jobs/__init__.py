@@ -1,0 +1,8 @@
+"""Managed jobs (reference: sky/jobs/)."""
+from skypilot_tpu.jobs.core import cancel
+from skypilot_tpu.jobs.core import launch
+from skypilot_tpu.jobs.core import queue
+from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['launch', 'queue', 'cancel', 'tail_logs', 'ManagedJobStatus']

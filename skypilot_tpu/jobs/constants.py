@@ -1,0 +1,25 @@
+"""Managed-jobs constants. Reference: sky/jobs/constants.py."""
+import os
+
+# Poll gap of the controller watch loop (reference:
+# sky/jobs/controller.py JOB_STATUS_CHECK_GAP_SECONDS = 20); env-tunable
+# so the offline test harness can run recovery scenarios in seconds.
+def status_check_gap_seconds() -> float:
+    return float(os.environ.get('SKYT_JOBS_CHECK_GAP', '20'))
+
+
+# Grace period before a non-terminal, unreachable cluster is declared
+# preempted (reference: sky/jobs/controller.py:240-270 forces a cloud
+# status query after the job status probe fails).
+def preemption_grace_seconds() -> float:
+    return float(os.environ.get('SKYT_JOBS_PREEMPTION_GRACE', '30'))
+
+
+JOBS_CLUSTER_NAME_PREFIX = '{name}-{job_id}'
+CONTROLLER_LOG_DIR = 'managed_jobs'
+SIGNAL_DIR = 'managed_jobs/signals'
+
+# Max consecutive launch attempts before giving up (reference:
+# recovery_strategy.py MAX_JOB_CHECKING_RETRY + launch retries).
+MAX_LAUNCH_RETRIES = 3
+LAUNCH_RETRY_BACKOFF_SECONDS = 5.0

@@ -1,0 +1,248 @@
+"""Managed jobs client API: launch/queue/cancel/tail_logs.
+
+Reference: sky/jobs/core.py (:30 launch, :138 queue, :225 cancel,
+:281 tail_logs). The reference templates a controller VM
+(jobs-controller.yaml.j2) and recursively `sky.launch`es it; the
+TPU-native build runs the controller as a detached client-side process
+sharing the state DB ("consolidated controller") — no Ray, no SSH-codegen
+tunnel, identical watch-loop/recovery semantics (see jobs/controller.py).
+A VM-hosted controller can be layered back on by launching
+`python -m skypilot_tpu.jobs.controller` as a cluster job.
+"""
+import os
+import signal as signal_lib
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Union
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.jobs import controller as controller_lib
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def _jobs_dir() -> str:
+    d = os.path.join(cluster_state.state_dir(),
+                     constants.CONTROLLER_LOG_DIR)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def launch(entrypoint: Union[Any, 'list'],
+           name: Optional[str] = None,
+           *,
+           retry_until_up: bool = True,
+           detach: bool = True) -> int:
+    """Submit a managed job; returns its managed-job id.
+
+    Reference: sky/jobs/core.py:30 launch. `retry_until_up` defaults True
+    (managed jobs exist to outlive capacity trouble).
+    """
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+
+    if isinstance(entrypoint, dag_lib.Dag):
+        tasks = list(entrypoint.tasks)
+        if not entrypoint.is_chain():
+            raise exceptions.NotSupportedError(
+                'managed jobs support chain DAGs only (same restriction '
+                'as the reference, sky/jobs/core.py).')
+    elif isinstance(entrypoint, task_lib.Task):
+        tasks = [entrypoint]
+    else:
+        raise exceptions.ManagedJobError(
+            f'launch takes a Task or Dag, got {type(entrypoint)}')
+    if not tasks:
+        raise exceptions.ManagedJobError('empty dag')
+
+    job_name = name or tasks[0].name or 'managed'
+    job_id = jobs_state.create_job(job_name, '', len(tasks),
+                                   retry_until_up=retry_until_up)
+
+    dag_yaml = os.path.join(_jobs_dir(), f'dag-{job_id}.yaml')
+    with open(dag_yaml, 'w', encoding='utf-8') as f:
+        yaml.safe_dump_all([t.to_yaml_config() for t in tasks], f,
+                           sort_keys=False)
+    jobs_state.set_dag_yaml(job_id, dag_yaml)
+
+    log_path = os.path.join(_jobs_dir(), f'controller-{job_id}.log')
+    # SUBMITTED before spawn: the controller immediately writes STARTING
+    # and must not be overwritten by a slower parent.
+    jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
+    env = dict(os.environ)
+    with open(log_path, 'ab') as logf:
+        proc = subprocess.Popen(  # pylint: disable=consider-using-with
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id), '--dag-yaml', dag_yaml],
+            stdout=logf, stderr=subprocess.STDOUT, stdin=subprocess.DEVNULL,
+            env=env, start_new_session=True)
+    jobs_state.set_controller_pid(job_id, proc.pid)
+    logger.info('Managed job %d (%s) submitted; controller pid %d. '
+                'Logs: %s', job_id, job_name, proc.pid, log_path)
+    if not detach:
+        tail_logs(job_id, follow=True)
+    return job_id
+
+
+def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
+    """Reference: sky/jobs/core.py:138 queue."""
+    jobs = jobs_state.get_jobs(skip_finished=skip_finished)
+    # Reconcile: a dead controller with a non-terminal status means the
+    # controller crashed/was killed (reference: skylet
+    # ManagedJobUpdateEvent does this on the controller VM).
+    for job in jobs:
+        if _controller_dead(job):
+            jobs_state.set_status(
+                job['job_id'], jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                'controller process died')
+            job['status'] = jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+    return jobs
+
+
+# Freshly submitted jobs may not have their controller PID recorded yet
+# (launch() Popens after writing SUBMITTED); don't declare them dead
+# inside this window.
+_SUBMIT_GRACE_SECONDS = 15.0
+
+
+def _controller_dead(job: Dict[str, Any]) -> bool:
+    if job['status'].is_terminal() or \
+            job['status'] is jobs_state.ManagedJobStatus.PENDING:
+        return False
+    if not job.get('controller_pid'):
+        return (time.time() - (job.get('submitted_at') or 0) >
+                _SUBMIT_GRACE_SECONDS)
+    return not _controller_alive(job)
+
+
+def _controller_alive(job: Dict[str, Any]) -> bool:
+    pid = job.get('controller_pid')
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            return f.read().split(')')[-1].split()[0] != 'Z'
+    except OSError:
+        return True
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Signal-file cancellation. Reference: sky/jobs/core.py:225."""
+    if not all_jobs and not job_ids:
+        raise exceptions.ManagedJobError(
+            'cancel needs explicit job ids or all_jobs=True.')
+    if all_jobs:
+        job_ids = [j['job_id'] for j in jobs_state.get_jobs()
+                   if not j['status'].is_terminal()]
+    cancelled = []
+    for jid in job_ids or []:
+        job = jobs_state.get_job(jid)
+        if job is None or job['status'].is_terminal():
+            continue
+        with open(controller_lib.signal_path(jid), 'w',
+                  encoding='utf-8') as f:
+            f.write('CANCEL')
+        # Wake the controller: its watch loop sleeps in whole poll gaps.
+        if job.get('controller_pid'):
+            try:
+                os.kill(job['controller_pid'], signal_lib.SIGINT)
+            except OSError:
+                pass
+        cancelled.append(jid)
+    return cancelled
+
+
+def wait(job_id: int, timeout: float = 300.0) -> Dict[str, Any]:
+    """Block until the managed job reaches a terminal status (test/dev
+    helper; the reference exposes the same via `sky jobs logs --follow`)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        job = jobs_state.get_job(job_id)
+        if job is None:
+            raise exceptions.ManagedJobError(f'job {job_id} not found')
+        if job['status'].is_terminal():
+            return job
+        time.sleep(0.5)
+    raise exceptions.ManagedJobStatusError(
+        f'job {job_id} not terminal after {timeout}s: '
+        f'{jobs_state.get_job(job_id)["status"]}')
+
+
+def tail_logs(job_id: Optional[int] = None, *, follow: bool = True,
+              controller: bool = False) -> int:
+    """Stream a managed job's logs.
+
+    controller=True tails the controller process log; otherwise the job
+    cluster's rank-0 log. Reference: sky/jobs/core.py:281."""
+    if job_id is None:
+        jobs = jobs_state.get_jobs()
+        if not jobs:
+            raise exceptions.ManagedJobError('no managed jobs')
+        job_id = max(j['job_id'] for j in jobs)
+    job = jobs_state.get_job(job_id)
+    if job is None:
+        raise exceptions.ManagedJobError(f'job {job_id} not found')
+
+    if controller:
+        path = os.path.join(_jobs_dir(), f'controller-{job_id}.log')
+        return _tail_file(path, follow and not job['status'].is_terminal())
+
+    # Wait out launch/recovery phases, then delegate to the cluster log
+    # stream; loop because the cluster can disappear mid-stream.
+    from skypilot_tpu import core as cluster_core
+    while True:
+        job = jobs_state.get_job(job_id)
+        assert job is not None
+        cluster_name = job.get('cluster_name')
+        if _controller_dead(job):
+            jobs_state.set_status(
+                job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
+                'controller process died')
+            continue
+        if job['status'].is_terminal():
+            if cluster_name and cluster_state.get_cluster(cluster_name):
+                return cluster_core.tail_logs(cluster_name, None,
+                                              follow=False)
+            print(f'Job {job_id} {job["status"].value}'
+                  + (f": {job['failure_reason']}"
+                     if job.get('failure_reason') else ''))
+            return 0 if job['status'] is \
+                jobs_state.ManagedJobStatus.SUCCEEDED else 1
+        if cluster_name and cluster_state.get_cluster(cluster_name):
+            try:
+                cluster_core.tail_logs(cluster_name, None, follow=follow)
+                if not follow:
+                    return 0
+            except exceptions.SkyTpuError:
+                pass  # cluster lost mid-stream; wait for recovery
+        if not follow:
+            print(f'Job {job_id} is {job["status"].value}; no logs yet.')
+            return 0
+        time.sleep(2)
+
+
+def _tail_file(path: str, follow: bool) -> int:
+    if not os.path.exists(path):
+        print(f'(no log file at {path})')
+        return 1
+    with open(path, 'r', encoding='utf-8', errors='replace') as f:
+        while True:
+            chunk = f.read()
+            if chunk:
+                print(chunk, end='', flush=True)
+            elif not follow:
+                return 0
+            else:
+                time.sleep(0.5)

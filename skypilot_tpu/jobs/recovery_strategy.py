@@ -1,0 +1,157 @@
+"""Recovery strategies for managed jobs.
+
+Reference: sky/jobs/recovery_strategy.py (543 LoC) — `StrategyExecutor`
+registry via __init_subclass__ + make() factory (:62,94), `launch()` with
+retry/backoff (:246), `FAILOVER` (:372, retry same location first then
+fail over) and `EAGER_NEXT_REGION` (:458, default — immediately move on:
+on TPU queued resources a preempted slice is *deleted*, so the same zone
+is the least likely place to find capacity again).
+"""
+import time
+from typing import Any, Dict, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state as state_lib
+from skypilot_tpu.jobs import constants
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+DEFAULT_RECOVERY_STRATEGY = 'EAGER_NEXT_REGION'
+
+_REGISTRY: Dict[str, Type['StrategyExecutor']] = {}
+
+
+def terminate_cluster(cluster_name: str, max_retry: int = 3) -> None:
+    """Best-effort teardown (reference: recovery_strategy.py:39)."""
+    from skypilot_tpu import core
+    for attempt in range(max_retry):
+        try:
+            core.down(cluster_name, purge=attempt == max_retry - 1)
+            return
+        except exceptions.ClusterDoesNotExist:
+            return
+        except exceptions.SkyTpuError as e:
+            logger.warning('teardown of %s failed (attempt %d): %s',
+                           cluster_name, attempt + 1, e)
+            time.sleep(2 * (attempt + 1))
+
+
+class StrategyExecutor:
+    """Launch/recover one task's cluster. Reference: :62."""
+
+    NAME = 'BASE'
+
+    def __init__(self, cluster_name: str, task: Any,
+                 retry_until_up: bool = False) -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.retry_until_up = retry_until_up
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if cls.NAME in _REGISTRY:
+            raise ValueError(f'duplicate strategy {cls.NAME}')
+        _REGISTRY[cls.NAME] = cls
+
+    @classmethod
+    def make(cls, cluster_name: str, task: Any,
+             strategy: Optional[str] = None,
+             retry_until_up: bool = False) -> 'StrategyExecutor':
+        name = (strategy or DEFAULT_RECOVERY_STRATEGY).upper()
+        if name not in _REGISTRY:
+            raise exceptions.ManagedJobError(
+                f'Unknown recovery strategy {name!r}; '
+                f'have {sorted(_REGISTRY)}')
+        return _REGISTRY[name](cluster_name, task,
+                               retry_until_up=retry_until_up)
+
+    # ------------------------------------------------------------ launch
+    def launch(self) -> int:
+        """First launch. Returns the cluster job id of the submitted run.
+
+        Reference: :114 launch / :246 _launch — retry with backoff;
+        optionally forever when retry_until_up.
+        """
+        return self._launch_with_retries()
+
+    def recover(self) -> int:
+        """Relaunch after a preemption/failure. Subclasses override the
+        location preference."""
+        raise NotImplementedError
+
+    def _launch_once(self, reuse_last_location: bool) -> int:
+        from skypilot_tpu import execution
+        task = self.task
+        if not reuse_last_location:
+            # A fresh optimizer pass over all candidate locations happens
+            # inside launch() anyway; nothing to pin here.
+            pass
+        job_id = execution.launch(task,
+                                  cluster_name=self.cluster_name,
+                                  detach_run=True,
+                                  stream_logs=False,
+                                  retry_until_up=False)
+        if job_id is None:
+            raise exceptions.ManagedJobError(
+                f'launch on {self.cluster_name} submitted no job '
+                f'(task has no run section?)')
+        return job_id
+
+    def _launch_with_retries(self, reuse_last_location: bool = False) -> int:
+        backoff = constants.LAUNCH_RETRY_BACKOFF_SECONDS
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return self._launch_once(reuse_last_location)
+            except (exceptions.ResourcesUnavailableError,
+                    exceptions.ProvisionerError,
+                    exceptions.ClusterNotUpError) as e:
+                # Leave no half-provisioned cluster behind before retrying.
+                terminate_cluster(self.cluster_name)
+                if (attempt >= constants.MAX_LAUNCH_RETRIES and
+                        not self.retry_until_up):
+                    raise exceptions.ManagedJobReachedMaxRetriesError(
+                        f'Failed to launch {self.cluster_name} after '
+                        f'{attempt} attempts: {e}') from e
+                logger.info('Launch attempt %d failed (%s); retrying in '
+                            '%.0fs', attempt, e, backoff)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 300.0)
+
+
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the same cluster/location first, then fail over.
+
+    Reference: :372 FAILOVER. With our failover provisioner the "same
+    location first" preference comes from relaunching the existing
+    (STOPPED/INIT) cluster record, which reuses its launched resources
+    in place before falling back to a fresh optimizer pass.
+    """
+
+    NAME = 'FAILOVER'
+
+    def recover(self) -> int:
+        try:
+            return self._launch_with_retries(reuse_last_location=True)
+        except exceptions.ManagedJobReachedMaxRetriesError:
+            # Drop the pinned record and let the optimizer pick anywhere.
+            terminate_cluster(self.cluster_name)
+            return self._launch_with_retries()
+
+
+class EagerNextRegionStrategyExecutor(StrategyExecutor):
+    """Immediately move to the next location (default).
+
+    Reference: :458 EAGER_NEXT_REGION. TPU preemptions delete the queued
+    resource, so the stale cluster record is purged first — the optimizer
+    + failover loop then starts from the best remaining plan.
+    """
+
+    NAME = 'EAGER_NEXT_REGION'
+
+    def recover(self) -> int:
+        if state_lib.get_cluster(self.cluster_name) is not None:
+            terminate_cluster(self.cluster_name)
+        return self._launch_with_retries()

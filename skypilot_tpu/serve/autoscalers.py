@@ -1,0 +1,111 @@
+"""Request-rate autoscaling for serving replicas.
+
+Reference: sky/serve/autoscalers.py (634 LoC) — base Autoscaler (:57),
+`RequestRateAutoscaler` (:141; target calc :183-191: ceil(qps_window /
+target_qps_per_replica) clipped to [min,max] with consecutive-period
+upscale/downscale delays), `FallbackRequestRateAutoscaler` (:476,
+on-demand base + spot overflow).
+"""
+import dataclasses
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# Window over which QPS is measured (reference default 60s).
+QPS_WINDOW_SECONDS = 60.0
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    target_num_replicas: int
+    reason: str
+
+
+class Autoscaler:
+    def __init__(self, spec: 'spec_lib.ServiceSpec') -> None:
+        self.spec = spec
+        self.target_num_replicas = spec.min_replicas
+
+    def update_spec(self, spec: 'spec_lib.ServiceSpec') -> None:
+        self.spec = spec
+
+    def collect_request_timestamps(self, ts: List[float]) -> None:
+        raise NotImplementedError
+
+    def evaluate_scaling(self, num_ready: int) -> AutoscalerDecision:
+        raise NotImplementedError
+
+
+class RequestRateAutoscaler(Autoscaler):
+    """Reference: sky/serve/autoscalers.py:141."""
+
+    def __init__(self, spec: 'spec_lib.ServiceSpec') -> None:
+        super().__init__(spec)
+        self.request_timestamps: List[float] = []
+        # Consecutive decision periods the raw target has exceeded /
+        # undershot the current target (reference upscale/downscale
+        # counters).
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    def collect_request_timestamps(self, ts: List[float]) -> None:
+        self.request_timestamps.extend(ts)
+        cutoff = time.time() - QPS_WINDOW_SECONDS
+        self.request_timestamps = [t for t in self.request_timestamps
+                                   if t >= cutoff]
+
+    def _raw_target(self) -> int:
+        spec = self.spec
+        if not spec.autoscaling_enabled:
+            return spec.min_replicas
+        assert spec.target_qps_per_replica is not None
+        qps = len(self.request_timestamps) / QPS_WINDOW_SECONDS
+        target = math.ceil(qps / spec.target_qps_per_replica)
+        upper = spec.max_replicas or spec.min_replicas
+        return max(spec.min_replicas, min(upper, target))
+
+    def evaluate_scaling(self, num_ready: int) -> AutoscalerDecision:
+        raw = self._raw_target()
+        now = time.time()
+        current = self.target_num_replicas
+        if raw > current:
+            self._downscale_since = None
+            if self._upscale_since is None:
+                self._upscale_since = now
+            if now - self._upscale_since >= self.spec.upscale_delay_seconds:
+                self.target_num_replicas = raw
+                self._upscale_since = None
+                return AutoscalerDecision(
+                    raw, f'sustained load -> upscale to {raw}')
+        elif raw < current:
+            self._upscale_since = None
+            if self._downscale_since is None:
+                self._downscale_since = now
+            if now - self._downscale_since >= \
+                    self.spec.downscale_delay_seconds:
+                self.target_num_replicas = raw
+                self._downscale_since = None
+                return AutoscalerDecision(
+                    raw, f'sustained idle -> downscale to {raw}')
+        else:
+            self._upscale_since = None
+            self._downscale_since = None
+        return AutoscalerDecision(current, 'steady')
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot replicas with an on-demand floor.
+
+    Reference: :476 — keep `base_ondemand_fallback_replicas` on-demand
+    replicas always; scale spot replicas for the rest. The replica
+    manager reads `ondemand_base` off the decision via spec.
+    """
+
+    @property
+    def ondemand_base(self) -> int:
+        return self.spec.base_ondemand_fallback_replicas

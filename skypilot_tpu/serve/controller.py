@@ -1,0 +1,150 @@
+"""Serve controller: replica reconciliation + autoscaling + sync API.
+
+Reference: sky/serve/controller.py (:33 SkyServeController, :55-87
+autoscaler loop, :91-146 endpoints /controller/load_balancer_sync and
+/controller/update_service). FastAPI there; aiohttp here (and the
+reconcile/probe work runs on plain threads so the HTTP loop never blocks
+on cluster operations).
+"""
+import asyncio
+import json
+import threading
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+import os
+
+def _loop_interval() -> float:
+    return float(os.environ.get('SKYT_SERVE_CONTROLLER_INTERVAL', '2'))
+
+
+class SkyServeController:
+    """Reference: sky/serve/controller.py:33."""
+
+    def __init__(self, service_name: str, spec: 'spec_lib.ServiceSpec',
+                 task_yaml: str, port: int) -> None:
+        self.service_name = service_name
+        self.port = port
+        self.replica_manager = replica_managers.ReplicaManager(
+            service_name, spec, task_yaml)
+        autoscaler_cls = (autoscalers.FallbackRequestRateAutoscaler
+                          if spec.base_ondemand_fallback_replicas > 0
+                          else autoscalers.RequestRateAutoscaler)
+        self.autoscaler = autoscaler_cls(spec)
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- main loop
+    def _control_loop(self) -> None:
+        """Probe → autoscale → reconcile (reference's three daemon
+        threads collapsed into one ordered loop: each phase feeds the
+        next, and none is latency-critical)."""
+        while not self._stop.is_set():
+            try:
+                self.replica_manager.probe_all()
+                ready = len(self.replica_manager.ready_urls())
+                decision = self.autoscaler.evaluate_scaling(ready)
+                ondemand_base = getattr(self.autoscaler, 'ondemand_base',
+                                        0)
+                self.replica_manager.reconcile(
+                    decision.target_num_replicas,
+                    ondemand_base=ondemand_base)
+                self._update_service_status(ready)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('control loop iteration failed')
+            self._stop.wait(_loop_interval())
+
+    def _update_service_status(self, num_ready: int) -> None:
+        svc = serve_state.get_service(self.service_name)
+        if svc is None or svc['status'] is \
+                serve_state.ServiceStatus.SHUTTING_DOWN:
+            return
+        if num_ready > 0:
+            status = serve_state.ServiceStatus.READY
+        elif self.replica_manager.num_alive() > 0:
+            status = serve_state.ServiceStatus.REPLICA_INIT
+        else:
+            status = serve_state.ServiceStatus.NO_REPLICA
+        if status != svc['status']:
+            serve_state.set_service_status(self.service_name, status)
+
+    # ------------------------------------------------------------- HTTP
+    async def _handle_lb_sync(self, request: web.Request) -> web.Response:
+        """Reference: /controller/load_balancer_sync — LB reports request
+        timestamps, controller returns ready replica URLs."""
+        payload = await request.json()
+        ts = payload.get('request_timestamps', [])
+        self.autoscaler.collect_request_timestamps([float(t) for t in ts])
+        return web.json_response(
+            {'ready_replica_urls': self.replica_manager.ready_urls()})
+
+    async def _handle_update_service(self, request: web.Request
+                                     ) -> web.Response:
+        """Reference: /controller/update_service — rolling update."""
+        payload = await request.json()
+        spec = spec_lib.ServiceSpec.from_yaml_config(payload['service'])
+        task_yaml = payload['task_yaml']
+        version = int(payload['version'])
+        self.replica_manager.update_version(spec, task_yaml, version)
+        self.autoscaler.update_spec(spec)
+        serve_state.set_service_spec(self.service_name, spec, task_yaml,
+                                     version)
+        logger.info('service %s updated to version %d', self.service_name,
+                    version)
+        return web.json_response({'ok': True, 'version': version})
+
+    async def _handle_status(self, request: web.Request) -> web.Response:
+        del request
+        replicas = []
+        for info in self.replica_manager.replicas.values():
+            replicas.append({
+                'replica_id': info.replica_id,
+                'cluster_name': info.cluster_name,
+                'status': info.status.value,
+                'endpoint': info.endpoint,
+                'version': info.version,
+                'use_spot': info.use_spot,
+            })
+        return web.json_response({
+            'service': self.service_name,
+            'target_num_replicas': self.autoscaler.target_num_replicas,
+            'replicas': replicas,
+        })
+
+    async def _handle_terminate(self, request: web.Request) -> web.Response:
+        """Graceful teardown: stop scaling, tear replicas down, ack."""
+        del request
+        logger.info('terminate requested for %s', self.service_name)
+        serve_state.set_service_status(
+            self.service_name, serve_state.ServiceStatus.SHUTTING_DOWN)
+        self._stop.set()
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None,
+                                   self.replica_manager.terminate_all)
+        return web.json_response({'ok': True})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post('/controller/load_balancer_sync',
+                            self._handle_lb_sync)
+        app.router.add_post('/controller/update_service',
+                            self._handle_update_service)
+        app.router.add_post('/controller/terminate',
+                            self._handle_terminate)
+        app.router.add_get('/controller/status', self._handle_status)
+        return app
+
+    def start_control_loop(self) -> None:
+        self._loop_thread = threading.Thread(target=self._control_loop,
+                                             daemon=True)
+        self._loop_thread.start()

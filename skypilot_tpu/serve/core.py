@@ -1,0 +1,227 @@
+"""SkyServe client API: up/update/down/status/tail_logs.
+
+Reference: sky/serve/core.py (:94 up, :303 update, :436 down, :499
+status, :595 tail_logs). The reference launches a controller VM per
+service group; the TPU-native build runs one detached service process per
+service on the client machine (same consolidation as jobs/core.py — see
+that docstring for the trade-off).
+"""
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import requests
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def _serve_dir() -> str:
+    d = os.path.join(cluster_state.state_dir(), 'serve')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _controller_url(svc: Dict[str, Any]) -> str:
+    return f'http://127.0.0.1:{svc["controller_port"]}'
+
+
+def up(task: Any, service_name: Optional[str] = None,
+       wait_ready_timeout: float = 0.0) -> Tuple[str, str]:
+    """Start a service; returns (service_name, endpoint).
+
+    Reference: sky/serve/core.py:94 up."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task needs a `service:` section for serve up.')
+    if task.run is None:
+        raise exceptions.InvalidTaskError(
+            'Service task needs a `run` command.')
+    service_name = service_name or task.name or 'service'
+    task_yaml = os.path.join(_serve_dir(), f'{service_name}.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(task.to_yaml_config(), f, sort_keys=False)
+
+    controller_port, lb_port = _free_port(), _free_port()
+    if not serve_state.add_service(service_name, task.service, task_yaml,
+                                   controller_port, lb_port):
+        raise exceptions.NotSupportedError(
+            f'Service {service_name!r} already exists. Use '
+            f'`serve update` to change it or `serve down` first.')
+
+    log_path = os.path.join(_serve_dir(), f'{service_name}.log')
+    with open(log_path, 'ab') as logf:
+        proc = subprocess.Popen(  # pylint: disable=consider-using-with
+            [sys.executable, '-m', 'skypilot_tpu.serve.service',
+             '--service-name', service_name],
+            stdout=logf, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, env=dict(os.environ),
+            start_new_session=True)
+    serve_state.set_service_controller_pid(service_name, proc.pid)
+    endpoint = f'http://127.0.0.1:{lb_port}'
+    logger.info('Service %s starting: endpoint %s (controller pid %d, '
+                'logs %s)', service_name, endpoint, proc.pid, log_path)
+    if wait_ready_timeout > 0:
+        _wait_status(service_name, serve_state.ServiceStatus.READY,
+                     wait_ready_timeout)
+    return service_name, endpoint
+
+
+def _wait_status(service_name: str, want: serve_state.ServiceStatus,
+                 timeout: float) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        svc = serve_state.get_service(service_name)
+        if svc is not None and svc['status'] is want:
+            return
+        if svc is not None and svc['status'].is_terminal():
+            raise exceptions.SkyTpuError(
+                f'service {service_name} entered {svc["status"].value}')
+        time.sleep(0.5)
+    raise exceptions.SkyTpuError(
+        f'service {service_name} not {want.value} after {timeout}s')
+
+
+def update(task: Any, service_name: str) -> int:
+    """Rolling update to a new task/spec version. Reference: :303."""
+    svc = serve_state.get_service(service_name)
+    if svc is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} does not exist.')
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task needs a `service:` section.')
+    version = svc['version'] + 1
+    task_yaml = os.path.join(_serve_dir(),
+                             f'{service_name}.task.v{version}.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(task.to_yaml_config(), f, sort_keys=False)
+    resp = requests.post(
+        _controller_url(svc) + '/controller/update_service',
+        json={'service': task.service.to_yaml_config(),
+              'task_yaml': task_yaml,
+              'version': version},
+        timeout=10)
+    resp.raise_for_status()
+    logger.info('Service %s rolling to version %d.', service_name, version)
+    return version
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    """Tear the service + its replicas down. Reference: :436."""
+    svc = serve_state.get_service(service_name)
+    if svc is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} does not exist.')
+    try:
+        resp = requests.post(_controller_url(svc) + '/controller/terminate',
+                             json={}, timeout=300)
+        resp.raise_for_status()
+    except requests.RequestException as e:
+        if not purge:
+            raise exceptions.SkyTpuError(
+                f'Controller of {service_name} unreachable ({e}); '
+                f'rerun with purge=True to force-clean state.') from e
+        logger.warning('controller unreachable; purging state: %s', e)
+        _force_cleanup(service_name)
+        return
+    # Wait for the service process to clear the state row.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if serve_state.get_service(service_name) is None:
+            return
+        time.sleep(0.5)
+    if purge:
+        _force_cleanup(service_name)
+    else:
+        raise exceptions.SkyTpuError(
+            f'{service_name} still shutting down; check `serve status`.')
+
+
+def _force_cleanup(service_name: str) -> None:
+    from skypilot_tpu import core
+    for info in serve_state.get_replicas(service_name):
+        try:
+            core.down(info.cluster_name, purge=True)
+        except exceptions.SkyTpuError:
+            pass
+    svc = serve_state.get_service(service_name)
+    if svc and svc.get('controller_pid'):
+        try:
+            os.kill(svc['controller_pid'], 9)
+        except OSError:
+            pass
+    serve_state.remove_service(service_name)
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    """Service + replica details. Reference: :499."""
+    services = serve_state.get_services()
+    if service_names:
+        wanted = set(service_names)
+        services = [s for s in services if s['name'] in wanted]
+    out = []
+    for svc in services:
+        replicas = [{
+            'replica_id': r.replica_id,
+            'cluster_name': r.cluster_name,
+            'status': r.status,
+            'endpoint': r.endpoint,
+            'version': r.version,
+            'use_spot': r.use_spot,
+        } for r in serve_state.get_replicas(svc['name'])]
+        out.append({
+            'name': svc['name'],
+            'status': svc['status'],
+            'version': svc['version'],
+            'endpoint': f'http://127.0.0.1:{svc["lb_port"]}',
+            'replicas': replicas,
+        })
+    return out
+
+
+def tail_logs(service_name: str, *, target: str = 'controller',
+              replica_id: Optional[int] = None,
+              follow: bool = False) -> int:
+    """Tail controller/LB log (one file — same process) or a replica's
+    cluster log. Reference: :595."""
+    svc = serve_state.get_service(service_name)
+    if svc is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} does not exist.')
+    if target == 'replica':
+        from skypilot_tpu import core
+        assert replica_id is not None, 'replica_id required'
+        for info in serve_state.get_replicas(service_name):
+            if info.replica_id == replica_id:
+                return core.tail_logs(info.cluster_name, None,
+                                      follow=follow)
+        raise exceptions.SkyTpuError(f'no replica {replica_id}')
+    path = os.path.join(_serve_dir(), f'{service_name}.log')
+    if not os.path.exists(path):
+        print(f'(no log at {path})')
+        return 1
+    with open(path, 'r', encoding='utf-8', errors='replace') as f:
+        while True:
+            chunk = f.read()
+            if chunk:
+                print(chunk, end='', flush=True)
+            elif not follow:
+                return 0
+            else:
+                time.sleep(0.5)

@@ -1,0 +1,86 @@
+"""Pluggable load-balancing policies.
+
+Reference: sky/serve/load_balancing_policies.py (:22 base, :47
+RoundRobinPolicy — the only one implemented there). We add
+LeastConnectionsPolicy, which matters for TPU inference replicas where
+requests are long-lived (continuous batching) and round-robin piles onto
+busy replicas.
+"""
+import random
+import threading
+from typing import Dict, List, Optional
+
+
+class LoadBalancingPolicy:
+    def __init__(self) -> None:
+        self.ready_replicas: List[str] = []
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        raise NotImplementedError
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def on_request_done(self, replica: str) -> None:
+        """Hook for policies that track in-flight requests."""
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    """Reference: :47 — index cycles; replica-set changes reshuffle to
+    avoid synchronized thundering across LB restarts."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            if set(replicas) != set(self.ready_replicas):
+                replicas = list(replicas)
+                random.shuffle(replicas)
+                self.ready_replicas = replicas
+                self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = self.ready_replicas[self._index %
+                                          len(self.ready_replicas)]
+            self._index += 1
+            return replica
+
+
+class LeastConnectionsPolicy(LoadBalancingPolicy):
+    """Pick the ready replica with the fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._inflight: Dict[str, int] = {}
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self.ready_replicas = list(replicas)
+            self._inflight = {r: self._inflight.get(r, 0)
+                              for r in replicas}
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = min(self.ready_replicas,
+                          key=lambda r: self._inflight.get(r, 0))
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
+            return replica
+
+    def on_request_done(self, replica: str) -> None:
+        with self._lock:
+            if replica in self._inflight and self._inflight[replica] > 0:
+                self._inflight[replica] -= 1
+
+
+POLICIES = {
+    'round_robin': RoundRobinPolicy,
+    'least_connections': LeastConnectionsPolicy,
+}

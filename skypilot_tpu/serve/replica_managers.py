@@ -1,0 +1,315 @@
+"""Replica lifecycle management for serving.
+
+Reference: sky/serve/replica_managers.py (1,233 LoC) — `ReplicaInfo`
+(:382), `ReplicaManager` (:560), `SkyPilotReplicaManager` (:604) with
+three daemon threads (process-pool refresher :940, job-status fetcher
+:1003, readiness prober :1019), spot-preemption detection + recovery,
+versioned rolling updates.
+
+TPU-native deltas: replicas are launched in daemon threads (no
+subprocess pool — `execution.launch` is importable, the reference forks
+`sky.launch` subprocesses because Ray state is process-bound), and
+preemption detection leans on the provider query (a preempted TPU
+queued-resource is *deleted*, so a missing cluster record == preempted).
+"""
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# Consecutive probe failures before READY -> NOT_READY (reference
+# _consecutive_failure_threshold ~ 180s / probe interval).
+NOT_READY_THRESHOLD = 3
+# Consecutive failures while NOT_READY before giving up -> FAILED.
+FAILED_THRESHOLD = 10
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    """Reference: sky/serve/replica_managers.py:382."""
+    replica_id: int
+    cluster_name: str
+    version: int
+    status: serve_state.ReplicaStatus
+    endpoint: Optional[str] = None
+    use_spot: bool = False
+    launched_at: float = 0.0
+    first_ready_at: Optional[float] = None
+    consecutive_failures: int = 0
+    failure_reason: Optional[str] = None
+
+    @property
+    def is_alive(self) -> bool:
+        return self.status in (serve_state.ReplicaStatus.PENDING,
+                               serve_state.ReplicaStatus.PROVISIONING,
+                               serve_state.ReplicaStatus.STARTING,
+                               serve_state.ReplicaStatus.READY,
+                               serve_state.ReplicaStatus.NOT_READY)
+
+
+class ReplicaManager:
+    """Reference: sky/serve/replica_managers.py:560."""
+
+    def __init__(self, service_name: str, spec: 'spec_lib.ServiceSpec',
+                 task_yaml: str, version: int = 1) -> None:
+        self.service_name = service_name
+        self.spec = spec
+        self.task_yaml = task_yaml
+        self.version = version
+        self.replicas: Dict[int, ReplicaInfo] = {
+            info.replica_id: info
+            for info in serve_state.get_replicas(service_name)}
+        self._next_id = max(self.replicas, default=0) + 1
+        self._threads: Dict[int, threading.Thread] = {}
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------ persist
+    def _save(self, info: ReplicaInfo) -> None:
+        serve_state.upsert_replica(self.service_name, info.replica_id,
+                                   info)
+
+    def _drop(self, info: ReplicaInfo) -> None:
+        with self._lock:
+            self.replicas.pop(info.replica_id, None)
+        serve_state.remove_replica(self.service_name, info.replica_id)
+
+    # ------------------------------------------------------------- launch
+    def _load_task(self):
+        from skypilot_tpu import task as task_lib
+        return task_lib.Task.from_yaml(self.task_yaml)
+
+    def launch_replica(self, use_spot: Optional[bool] = None) -> int:
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            info = ReplicaInfo(
+                replica_id=rid,
+                cluster_name=f'{self.service_name}-{rid}',
+                version=self.version,
+                status=serve_state.ReplicaStatus.PROVISIONING,
+                use_spot=bool(use_spot),
+                launched_at=time.time())
+            self.replicas[rid] = info
+            self._save(info)
+            th = threading.Thread(target=self._launch_thread,
+                                  args=(info,), daemon=True)
+            self._threads[rid] = th
+            th.start()
+            return rid
+
+    def _launch_thread(self, info: ReplicaInfo) -> None:
+        from skypilot_tpu import execution
+        try:
+            task = self._load_task()
+            port = self._replica_port(task)
+            task.envs['SKYT_REPLICA_PORT'] = str(port)
+            if info.use_spot:
+                for res in task.resources:
+                    res.use_spot = True  # spot overflow replicas
+            execution.launch(task, cluster_name=info.cluster_name,
+                             detach_run=True, stream_logs=False)
+            record = cluster_state.get_cluster(info.cluster_name)
+            assert record is not None
+            handle = record['handle']
+            head = handle.cluster_info.ordered()[0]
+            ip = head.get_feasible_ip()
+            info.endpoint = f'http://{ip}:{port}'
+            info.status = serve_state.ReplicaStatus.STARTING
+            self._save(info)
+            logger.info('replica %d up at %s', info.replica_id,
+                        info.endpoint)
+        except exceptions.SkyTpuError as e:
+            logger.warning('replica %d launch failed: %s',
+                           info.replica_id, e)
+            info.status = serve_state.ReplicaStatus.FAILED
+            info.failure_reason = str(e)
+            self._save(info)
+
+    def _replica_port(self, task) -> int:
+        """Replica serving port: first task resources port, else (local
+        clouds, where every replica shares 127.0.0.1) a fresh free one."""
+        for res in task.resources:
+            if res.ports:
+                if res.cloud != 'local':
+                    return int(res.ports[0])
+        import socket
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    # ---------------------------------------------------------- teardown
+    def terminate_replica(self, rid: int, sync: bool = False) -> None:
+        with self._lock:
+            info = self.replicas.get(rid)
+            if info is None:
+                return
+            info.status = serve_state.ReplicaStatus.SHUTTING_DOWN
+            self._save(info)
+        th = threading.Thread(target=self._terminate_thread,
+                              args=(info,), daemon=True)
+        th.start()
+        if sync:
+            th.join(timeout=60)
+
+    def _terminate_thread(self, info: ReplicaInfo) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(info.cluster_name, purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except exceptions.SkyTpuError as e:
+            logger.warning('teardown of replica %d failed: %s',
+                           info.replica_id, e)
+        self._drop(info)
+
+    def terminate_all(self) -> None:
+        with self._lock:
+            rids = [r for r in self.replicas]
+        threads = []
+        for rid in rids:
+            info = self.replicas.get(rid)
+            if info is None:
+                continue
+            info.status = serve_state.ReplicaStatus.SHUTTING_DOWN
+            self._save(info)
+            th = threading.Thread(target=self._terminate_thread,
+                                  args=(info,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120)
+
+    # ------------------------------------------------------------- probe
+    def _probe_one(self, info: ReplicaInfo) -> bool:
+        url = info.endpoint + self.spec.readiness_path
+        try:
+            if self.spec.post_data is not None:
+                resp = requests.post(
+                    url, json=self.spec.post_data,
+                    timeout=self.spec.probe_timeout_seconds)
+            else:
+                resp = requests.get(
+                    url, timeout=self.spec.probe_timeout_seconds)
+            return resp.status_code == 200
+        except requests.RequestException:
+            return False
+
+    def probe_all(self) -> None:
+        """One probe pass (reference: _replica_prober :1019 + parallel
+        probes :497-543)."""
+        for info in list(self.replicas.values()):
+            if info.status not in (serve_state.ReplicaStatus.STARTING,
+                                   serve_state.ReplicaStatus.READY,
+                                   serve_state.ReplicaStatus.NOT_READY):
+                continue
+            # Preemption first: a deleted cluster can still answer DNS.
+            if cluster_state.get_cluster(info.cluster_name) is None:
+                logger.info('replica %d cluster gone -> PREEMPTED',
+                            info.replica_id)
+                info.status = serve_state.ReplicaStatus.PREEMPTED
+                self._save(info)
+                self.terminate_replica(info.replica_id)
+                continue
+            ok = self._probe_one(info)
+            if ok:
+                if info.first_ready_at is None:
+                    info.first_ready_at = time.time()
+                info.consecutive_failures = 0
+                if info.status is not serve_state.ReplicaStatus.READY:
+                    logger.info('replica %d READY', info.replica_id)
+                info.status = serve_state.ReplicaStatus.READY
+                self._save(info)
+                continue
+            info.consecutive_failures += 1
+            if info.status is serve_state.ReplicaStatus.STARTING:
+                if time.time() - info.launched_at > \
+                        self.spec.initial_delay_seconds:
+                    info.status = serve_state.ReplicaStatus.FAILED
+                    info.failure_reason = (
+                        f'not ready within initial_delay_seconds='
+                        f'{self.spec.initial_delay_seconds}')
+                    self._save(info)
+                    self.terminate_replica(info.replica_id)
+            elif info.consecutive_failures >= FAILED_THRESHOLD:
+                info.status = serve_state.ReplicaStatus.FAILED
+                info.failure_reason = 'readiness probe kept failing'
+                self._save(info)
+                self.terminate_replica(info.replica_id)
+            elif info.consecutive_failures >= NOT_READY_THRESHOLD:
+                info.status = serve_state.ReplicaStatus.NOT_READY
+                self._save(info)
+            else:
+                self._save(info)
+
+    # ---------------------------------------------------------- reconcile
+    def reconcile(self, target: int, ondemand_base: int = 0) -> None:
+        """Drive alive-replica count to `target`; retire old versions once
+        enough new-version replicas are READY (rolling update,
+        reference: versioned updates in SkyPilotReplicaManager)."""
+        with self._lock:
+            alive = [r for r in self.replicas.values() if r.is_alive]
+            cur_version = [r for r in alive if r.version == self.version]
+            old_version = [r for r in alive if r.version != self.version]
+
+            # Rolling update: bring up new-version replicas to `target`,
+            # and keep enough old replicas alive that READY(new) + old
+            # never drops below target — retire only the surplus.
+            if old_version:
+                new_ready = sum(
+                    1 for r in cur_version
+                    if r.status is serve_state.ReplicaStatus.READY)
+                if len(cur_version) < target:
+                    for _ in range(target - len(cur_version)):
+                        self.launch_replica()
+                n_keep_old = max(0, target - new_ready)
+                for info in old_version[n_keep_old:]:
+                    self.terminate_replica(info.replica_id)
+                return
+
+            n_alive = len(cur_version)
+            if n_alive < target:
+                # ondemand base first, spot for overflow (fallback
+                # autoscaler semantics).
+                n_ondemand = sum(1 for r in cur_version if not r.use_spot)
+                for _ in range(target - n_alive):
+                    use_spot = (ondemand_base > 0 and
+                                n_ondemand >= ondemand_base)
+                    self.launch_replica(use_spot=use_spot)
+                    if not use_spot:
+                        n_ondemand += 1
+            elif len(cur_version) > target:
+                # Scale down: prefer NOT_READY/STARTING, then newest.
+                order = sorted(
+                    cur_version,
+                    key=lambda r: (r.status is
+                                   serve_state.ReplicaStatus.READY,
+                                   -r.replica_id))
+                for info in order[:len(cur_version) - target]:
+                    self.terminate_replica(info.replica_id)
+
+    def update_version(self, spec: 'spec_lib.ServiceSpec',
+                       task_yaml: str, version: int) -> None:
+        self.spec = spec
+        self.task_yaml = task_yaml
+        self.version = version
+
+    # ------------------------------------------------------------- views
+    def ready_urls(self) -> List[str]:
+        with self._lock:
+            return [r.endpoint for r in self.replicas.values()
+                    if r.status is serve_state.ReplicaStatus.READY and
+                    r.endpoint]
+
+    def num_alive(self) -> int:
+        with self._lock:
+            return sum(1 for r in self.replicas.values() if r.is_alive)

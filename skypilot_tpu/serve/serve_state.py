@@ -1,0 +1,196 @@
+"""SkyServe-equivalent state: services + replicas in sqlite.
+
+Reference: sky/serve/serve_state.py (536 LoC) — services table, replicas
+table with pickled ReplicaInfo, status enums. Lives in the client state
+dir because the TPU-native controller is a consolidated client-side
+process (see serve/core.py), not a controller VM.
+"""
+import enum
+import os
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import state as state_lib
+
+
+class ServiceStatus(enum.Enum):
+    """Reference: sky/serve/serve_state.py ServiceStatus."""
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    NO_REPLICA = 'NO_REPLICA'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    FAILED_CLEANUP = 'FAILED_CLEANUP'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.FAILED, ServiceStatus.FAILED_CLEANUP)
+
+
+class ReplicaStatus(enum.Enum):
+    """Reference: sky/serve/serve_state.py ReplicaStatus."""
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED = 'FAILED'
+
+    def is_terminal(self) -> bool:
+        return self is ReplicaStatus.FAILED
+
+
+_DB_LOCK = threading.RLock()
+_DB: Optional[sqlite3.Connection] = None
+_DB_PATH: Optional[str] = None
+
+
+def _get_db() -> sqlite3.Connection:
+    global _DB, _DB_PATH
+    path = os.path.join(state_lib.state_dir(), 'serve.db')
+    with _DB_LOCK:
+        if _DB is None or _DB_PATH != path:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            _DB = sqlite3.connect(path, check_same_thread=False,
+                                  timeout=10.0)
+            _DB.row_factory = sqlite3.Row
+            _DB.execute("""
+                CREATE TABLE IF NOT EXISTS services (
+                    name TEXT PRIMARY KEY,
+                    status TEXT,
+                    spec BLOB,
+                    task_yaml TEXT,
+                    version INTEGER DEFAULT 1,
+                    controller_port INTEGER,
+                    lb_port INTEGER,
+                    controller_pid INTEGER,
+                    created_at REAL)""")
+            _DB.execute("""
+                CREATE TABLE IF NOT EXISTS replicas (
+                    service_name TEXT,
+                    replica_id INTEGER,
+                    info BLOB,
+                    PRIMARY KEY (service_name, replica_id))""")
+            _DB.commit()
+            _DB_PATH = path
+        return _DB
+
+
+def reset_db_for_testing() -> None:
+    global _DB, _DB_PATH
+    with _DB_LOCK:
+        if _DB is not None:
+            _DB.close()
+        _DB = None
+        _DB_PATH = None
+
+
+# ---------------------------------------------------------------- services
+def add_service(name: str, spec: Any, task_yaml: str,
+                controller_port: int, lb_port: int) -> bool:
+    """False if the service already exists."""
+    db = _get_db()
+    with _DB_LOCK:
+        try:
+            db.execute(
+                """INSERT INTO services (name, status, spec, task_yaml,
+                                         controller_port, lb_port,
+                                         created_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?)""",
+                (name, ServiceStatus.CONTROLLER_INIT.value,
+                 pickle.dumps(spec), task_yaml, controller_port, lb_port,
+                 time.time()))
+            db.commit()
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    db = _get_db()
+    with _DB_LOCK:
+        db.execute('UPDATE services SET status=? WHERE name=?',
+                   (status.value, name))
+        db.commit()
+
+
+def set_service_controller_pid(name: str, pid: int) -> None:
+    db = _get_db()
+    with _DB_LOCK:
+        db.execute('UPDATE services SET controller_pid=? WHERE name=?',
+                   (pid, name))
+        db.commit()
+
+
+def set_service_spec(name: str, spec: Any, task_yaml: str,
+                     version: int) -> None:
+    db = _get_db()
+    with _DB_LOCK:
+        db.execute(
+            'UPDATE services SET spec=?, task_yaml=?, version=? '
+            'WHERE name=?',
+            (pickle.dumps(spec), task_yaml, version, name))
+        db.commit()
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    db = _get_db()
+    row = db.execute('SELECT * FROM services WHERE name=?',
+                     (name,)).fetchone()
+    return _service_row(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    db = _get_db()
+    rows = db.execute('SELECT * FROM services ORDER BY name').fetchall()
+    return [_service_row(r) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    db = _get_db()
+    with _DB_LOCK:
+        db.execute('DELETE FROM services WHERE name=?', (name,))
+        db.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+        db.commit()
+
+
+def _service_row(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ServiceStatus(d['status'])
+    d['spec'] = pickle.loads(d['spec'])
+    return d
+
+
+# ---------------------------------------------------------------- replicas
+def upsert_replica(service_name: str, replica_id: int, info: Any) -> None:
+    db = _get_db()
+    with _DB_LOCK:
+        db.execute(
+            """INSERT INTO replicas (service_name, replica_id, info)
+               VALUES (?, ?, ?)
+               ON CONFLICT(service_name, replica_id)
+               DO UPDATE SET info=excluded.info""",
+            (service_name, replica_id, pickle.dumps(info)))
+        db.commit()
+
+
+def remove_replica(service_name: str, replica_id: int) -> None:
+    db = _get_db()
+    with _DB_LOCK:
+        db.execute(
+            'DELETE FROM replicas WHERE service_name=? AND replica_id=?',
+            (service_name, replica_id))
+        db.commit()
+
+
+def get_replicas(service_name: str) -> List[Any]:
+    db = _get_db()
+    rows = db.execute(
+        'SELECT info FROM replicas WHERE service_name=? '
+        'ORDER BY replica_id', (service_name,)).fetchall()
+    return [pickle.loads(r['info']) for r in rows]

@@ -1,0 +1,76 @@
+"""Service entrypoint: runs controller + load balancer for one service.
+
+Reference: sky/serve/service.py (:131 _start — starts controller and LB
+as separate processes, :38 signal-file termination, :64 storage cleanup).
+Here both aiohttp apps share one asyncio loop in one process (they are
+I/O-bound; the blocking cluster work lives on the controller's threads),
+so a service is exactly one daemon process.
+
+Run:  python -m skypilot_tpu.serve.service --service-name NAME
+"""
+import argparse
+import asyncio
+import os
+
+from aiohttp import web
+
+from skypilot_tpu.serve import controller as controller_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+async def _serve(service_name: str) -> None:
+    svc = serve_state.get_service(service_name)
+    assert svc is not None, f'service {service_name} not in state DB'
+    spec = svc['spec']
+    controller = controller_lib.SkyServeController(
+        service_name, spec, svc['task_yaml'], svc['controller_port'])
+    lb = lb_lib.SkyServeLoadBalancer(
+        controller_url=f'http://127.0.0.1:{svc["controller_port"]}',
+        port=svc['lb_port'],
+        policy=getattr(spec, 'load_balancing_policy', None)
+        or 'round_robin')
+
+    controller_runner = web.AppRunner(controller.make_app())
+    await controller_runner.setup()
+    await web.TCPSite(controller_runner, '0.0.0.0',
+                      svc['controller_port']).start()
+    lb_runner = web.AppRunner(lb.make_app())
+    await lb_runner.setup()
+    await web.TCPSite(lb_runner, '0.0.0.0', svc['lb_port']).start()
+
+    controller.start_control_loop()
+    serve_state.set_service_status(service_name,
+                                   serve_state.ServiceStatus.REPLICA_INIT)
+    logger.info('service %s: controller :%d, load balancer :%d',
+                service_name, svc['controller_port'], svc['lb_port'])
+
+    # Run until terminated via /controller/terminate (which tears down
+    # replicas) — then clean up the service row and exit.
+    while True:
+        await asyncio.sleep(1)
+        svc = serve_state.get_service(service_name)
+        if svc is None:
+            break
+        if svc['status'] is serve_state.ServiceStatus.SHUTTING_DOWN and \
+                controller.replica_manager.num_alive() == 0:
+            serve_state.remove_service(service_name)
+            break
+    await lb_runner.cleanup()
+    await controller_runner.cleanup()
+    logger.info('service %s shut down.', service_name)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    args = parser.parse_args(argv)
+    serve_state.set_service_controller_pid(args.service_name, os.getpid())
+    asyncio.run(_serve(args.service_name))
+
+
+if __name__ == '__main__':
+    main()
