@@ -1,0 +1,5 @@
+"""Benchmark harness (reference: sky/benchmark/)."""
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.benchmark import benchmark_utils
+
+__all__ = ['benchmark_state', 'benchmark_utils']

@@ -1,0 +1,192 @@
+"""Benchmark harness: launch one cluster per candidate resource, collect
+step timestamps, interpolate cost/time to completion.
+
+Reference: sky/benchmark/benchmark_utils.py (891 LoC) —
+`generate_benchmark_configs` (:432), `launch_benchmark_clusters` (:488),
+`_update_benchmark_result` (:274). The step timestamps come from the
+skyt_callback summary (callbacks/base.py) synced down from each head
+host.
+"""
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import state as cluster_state
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import subprocess_utils
+
+logger = log_utils.init_logger(__name__)
+
+_CLUSTER_PREFIX = 'skyt-bench'
+_REMOTE_SUMMARY = '.skyt/benchmarks/summary.json'
+
+
+def cluster_name(benchmark: str, idx: int) -> str:
+    return f'{_CLUSTER_PREFIX}-{benchmark}-{idx}'
+
+
+def generate_benchmark_candidates(task) -> List[Any]:
+    """One candidate per task resources entry (`any_of` fans out).
+
+    Reference: :432 generate_benchmark_configs — candidates come from
+    resource overrides; here the Task DSL's any_of IS the candidate set.
+    """
+    return sorted(task.resources, key=repr)
+
+
+def launch_benchmark_clusters(benchmark: str, task,
+                              candidates: List[Any]) -> List[str]:
+    """Launch one cluster per candidate in parallel. Reference: :488."""
+    import copy
+
+    from skypilot_tpu import execution
+    from skypilot_tpu import optimizer as optimizer_lib
+
+    clusters = []
+
+    def _launch(pair: Tuple[int, Any]) -> Optional[str]:
+        idx, resources = pair
+        name = cluster_name(benchmark, idx)
+        t = copy.deepcopy(task)
+        t.set_resources(resources)
+        # Force the callback to the canonical summary location —
+        # _fetch_summary syncs exactly this path down, so a user-set
+        # SKYT_BENCHMARK_DIR would silently break collection.
+        t.envs['SKYT_BENCHMARK_DIR'] = '~/.skyt/benchmarks'
+        plans = optimizer_lib.Optimizer.plan_for_task(t)
+        hourly = plans[0].hourly_cost if plans else 0.0
+        benchmark_state.add_result(benchmark, name, resources, hourly)
+        try:
+            execution.launch(t, cluster_name=name, detach_run=True,
+                             stream_logs=False)
+            benchmark_state.update_result(
+                benchmark, name, benchmark_state.BenchmarkStatus.RUNNING,
+                None)
+            return name
+        except exceptions.SkyTpuError as e:
+            logger.warning('benchmark cluster %s failed to launch: %s',
+                           name, e)
+            benchmark_state.update_result(
+                benchmark, name,
+                benchmark_state.BenchmarkStatus.TERMINATED, None)
+            return None
+
+    results = subprocess_utils.run_in_parallel(
+        _launch, list(enumerate(candidates)))
+    clusters = [c for c in results if c]
+    return clusters
+
+
+def update_benchmark_results(benchmark: str) -> None:
+    """Sync each cluster's summary.json down (in parallel — one slow or
+    unreachable head must not serialize the rest) and recompute
+    estimates. Reference: :274 _update_benchmark_result."""
+    live = [rec for rec in benchmark_state.get_results(benchmark)
+            if rec['status'] is not
+            benchmark_state.BenchmarkStatus.TERMINATED]
+    if not live:
+        return
+
+    def _one(rec):
+        summary = _fetch_summary(rec['cluster'])
+        if summary is None:
+            return
+        result = _interpolate(summary, rec['hourly_cost'])
+        status = benchmark_state.BenchmarkStatus.RUNNING
+        total = summary.get('total_steps')
+        if total and summary.get('num_steps', 0) >= total:
+            status = benchmark_state.BenchmarkStatus.FINISHED
+        benchmark_state.update_result(benchmark, rec['cluster'], status,
+                                      result)
+
+    subprocess_utils.run_in_parallel(_one, live)
+
+
+def _fetch_summary(cluster: str) -> Optional[Dict[str, Any]]:
+    record = cluster_state.get_cluster(cluster)
+    if record is None:
+        return None
+    handle = record['handle']
+    runner = handle.get_command_runners()[0]
+    local = os.path.join(cluster_state.state_dir(), 'benchmarks', cluster)
+    os.makedirs(local, exist_ok=True)
+    target = os.path.join(local, 'summary.json')
+    try:
+        runner.rsync(_REMOTE_SUMMARY, target, up=False)
+        with open(target, 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (exceptions.CommandError, OSError, json.JSONDecodeError):
+        return None
+
+
+def _interpolate(summary: Dict[str, Any],
+                 hourly_cost: float) -> Dict[str, Any]:
+    out = dict(summary)
+    spi = summary.get('seconds_per_step')
+    num = summary.get('num_steps') or 0
+    total = summary.get('total_steps')
+    if summary.get('first_step_time') and num:
+        elapsed = summary['last_step_time'] - summary['boot_time']
+        out['elapsed_s'] = elapsed
+        out['cost_so_far'] = hourly_cost * elapsed / 3600.0
+    if spi and total:
+        remaining = max(0, total - num) * spi
+        out['eta_s'] = remaining
+        est_total_s = out.get('elapsed_s', 0) + remaining
+        out['est_total_s'] = est_total_s
+        out['est_total_cost'] = hourly_cost * est_total_s / 3600.0
+    if spi:
+        out['cost_per_step'] = hourly_cost * spi / 3600.0
+    return out
+
+
+def report(benchmark: str) -> List[Dict[str, Any]]:
+    """Comparison rows across candidate clusters."""
+    rows = []
+    for rec in benchmark_state.get_results(benchmark):
+        r = rec['result'] or {}
+        rows.append({
+            'cluster': rec['cluster'],
+            'resources': rec['resources'],
+            'status': rec['status'].value,
+            'hourly_cost': rec['hourly_cost'],
+            'num_steps': r.get('num_steps'),
+            'seconds_per_step': r.get('seconds_per_step'),
+            'cost_per_step': r.get('cost_per_step'),
+            'eta_s': r.get('eta_s'),
+            'est_total_cost': r.get('est_total_cost'),
+        })
+    return rows
+
+
+def terminate_benchmark_clusters(benchmark: str) -> None:
+    from skypilot_tpu import core
+    for rec in benchmark_state.get_results(benchmark):
+        try:
+            core.down(rec['cluster'], purge=True)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except exceptions.SkyTpuError as e:
+            logger.warning('teardown of %s failed: %s', rec['cluster'], e)
+        benchmark_state.update_result(
+            benchmark, rec['cluster'],
+            benchmark_state.BenchmarkStatus.TERMINATED, None)
+
+
+def wait_for_results(benchmark: str, timeout: float = 60.0,
+                     min_steps: int = 2) -> bool:
+    """Poll until every live cluster reports >= min_steps (dev/test)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        update_benchmark_results(benchmark)
+        recs = [r for r in benchmark_state.get_results(benchmark)
+                if r['status'] is not
+                benchmark_state.BenchmarkStatus.TERMINATED]
+        if recs and all((r['result'] or {}).get('num_steps', 0) >=
+                        min_steps for r in recs):
+            return True
+        time.sleep(1)
+    return False

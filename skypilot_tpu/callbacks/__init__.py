@@ -1,0 +1,26 @@
+"""Step-timestamp callbacks for benchmarking (reference: sky/callbacks/,
+the separately-installable `sky_callback` package).
+
+The callback writes timestamped step events to a JSON summary the
+benchmark harness syncs down and interpolates into $/step and
+time-to-completion estimates (reference: sky_callback/base.py:21
+BaseCallback + benchmark_utils._update_benchmark_result :274).
+
+Usage in any training loop:
+    from skypilot_tpu import callbacks
+    cb = callbacks.SkytCallback(total_steps=10000)
+    for batch in data:
+        ...
+        cb.on_step_end()
+
+or:
+    with callbacks.step_timer(total_steps=10000) as cb:
+        for batch in data:
+            ...
+            cb.on_step_end()
+"""
+from skypilot_tpu.callbacks.base import SkytCallback
+from skypilot_tpu.callbacks.base import step_timer
+from skypilot_tpu.callbacks.base import summary_path
+
+__all__ = ['SkytCallback', 'step_timer', 'summary_path']

@@ -1,0 +1,97 @@
+"""SkytCallback: buffered step-timestamp writer.
+
+Reference: sky/callbacks/sky_callback/base.py:21 BaseCallback — writes
+`summary.json` step timestamps via an async writer thread so the training
+loop never blocks on disk.
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+_DEFAULT_DIR = '~/.skyt/benchmarks'
+_FLUSH_INTERVAL_S = 2.0
+
+
+def summary_path(benchmark_dir: Optional[str] = None) -> str:
+    d = os.path.expanduser(
+        benchmark_dir or
+        os.environ.get('SKYT_BENCHMARK_DIR', _DEFAULT_DIR))
+    return os.path.join(d, 'summary.json')
+
+
+class SkytCallback:
+    """Records per-step wall timestamps; flushes asynchronously."""
+
+    def __init__(self, total_steps: Optional[int] = None,
+                 benchmark_dir: Optional[str] = None,
+                 warmup_steps: int = 1) -> None:
+        self._path = summary_path(benchmark_dir)
+        os.makedirs(os.path.dirname(self._path), exist_ok=True)
+        self.total_steps = total_steps
+        self.warmup_steps = warmup_steps
+        self._timestamps = [time.time()]
+        self._lock = threading.Lock()
+        self._dirty = True
+        self._stop = threading.Event()
+        self._writer = threading.Thread(target=self._flush_loop,
+                                        daemon=True)
+        self._writer.start()
+
+    def on_step_end(self) -> None:
+        with self._lock:
+            self._timestamps.append(time.time())
+            self._dirty = True
+
+    # ------------------------------------------------------------- flush
+    def _summary(self) -> dict:
+        ts = self._timestamps
+        num_steps = len(ts) - 1
+        out = {
+            'boot_time': ts[0],
+            'num_steps': num_steps,
+            'total_steps': self.total_steps,
+            'warmup_steps': self.warmup_steps,
+            'first_step_time': ts[1] if num_steps >= 1 else None,
+            'last_step_time': ts[-1] if num_steps >= 1 else None,
+        }
+        # Steady-state seconds/step, excluding warmup (compile) steps:
+        # window runs from the end of step `warmup_steps` (ts[k], k =
+        # warmup index in ts where ts[0] is boot) to the last step.
+        k = self.warmup_steps
+        if len(ts) > k + 1:
+            out['seconds_per_step'] = (ts[-1] - ts[k]) / (len(ts) - 1 - k)
+        return out
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(_FLUSH_INTERVAL_S):
+            self._flush()
+        self._flush()
+
+    def _flush(self) -> None:
+        with self._lock:
+            if not self._dirty:
+                return
+            summary = self._summary()
+            self._dirty = False
+        tmp = self._path + '.tmp'
+        with open(tmp, 'w', encoding='utf-8') as f:
+            json.dump(summary, f)
+        os.replace(tmp, self._path)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._writer.join(timeout=5)
+
+
+@contextlib.contextmanager
+def step_timer(total_steps: Optional[int] = None,
+               benchmark_dir: Optional[str] = None
+               ) -> Iterator[SkytCallback]:
+    cb = SkytCallback(total_steps=total_steps, benchmark_dir=benchmark_dir)
+    try:
+        yield cb
+    finally:
+        cb.close()

@@ -413,6 +413,107 @@ def jobs_logs(job_id, controller, no_follow):
                                  controller=controller))
 
 
+# ------------------------------------------------------------------ bench
+@cli.group()
+def bench():
+    """Benchmark a task across candidate resources. Reference: sky
+    bench."""
+
+
+@bench.command(name='launch')
+@click.argument('entrypoint', required=True)
+@click.option('--benchmark', '-b', 'benchmark_name', required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_launch(entrypoint, benchmark_name, yes):
+    """Launch one cluster per candidate resource (task `any_of`)."""
+    from skypilot_tpu.benchmark import benchmark_state
+    from skypilot_tpu.benchmark import benchmark_utils
+    task = _load_task(entrypoint)
+    candidates = benchmark_utils.generate_benchmark_candidates(task)
+    if not candidates:
+        raise click.UsageError(
+            'The task has no resources to benchmark — use a YAML with a '
+            '`resources:` section (`any_of:` fans out candidates).')
+    if benchmark_state.get_benchmark(benchmark_name) is not None:
+        raise click.UsageError(
+            f'Benchmark {benchmark_name!r} already exists. '
+            f'`skyt bench down {benchmark_name}` and '
+            f'`skyt bench delete {benchmark_name}` first.')
+    if not yes:
+        click.confirm(
+            f'Launch {len(candidates)} benchmark clusters?', default=True,
+            abort=True)
+    benchmark_state.add_benchmark(benchmark_name, entrypoint)
+    clusters = benchmark_utils.launch_benchmark_clusters(
+        benchmark_name, task, candidates)
+    click.echo(f'Benchmark {benchmark_name}: launched {clusters}')
+
+
+@bench.command(name='show')
+@click.argument('benchmark_name', required=True)
+def bench_show(benchmark_name):
+    """Show interpolated $/step and ETA per candidate."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    benchmark_utils.update_benchmark_results(benchmark_name)
+    rows = []
+    for r in benchmark_utils.report(benchmark_name):
+        def _fmt(val, spec):
+            return format(val, spec) if val is not None else '-'
+        rows.append([
+            r['cluster'], str(r['resources']), r['status'],
+            f"${r['hourly_cost']:.2f}",
+            _fmt(r['num_steps'], 'd'),
+            _fmt(r['seconds_per_step'], '.3f'),
+            ('$' + _fmt(r['cost_per_step'], '.6f'))
+            if r['cost_per_step'] is not None else '-',
+            (_fmt(r['eta_s'], '.0f') + 's')
+            if r['eta_s'] is not None else '-',
+        ])
+    click.echo(_fmt_table(rows, ['CLUSTER', 'RESOURCES', 'STATUS', '$/HR',
+                                 'STEPS', 'S/STEP', '$/STEP', 'ETA']))
+
+
+@bench.command(name='ls')
+def bench_ls():
+    from skypilot_tpu.benchmark import benchmark_state
+    rows = [[b['name'], b['task_yaml']]
+            for b in benchmark_state.get_benchmarks()]
+    click.echo(_fmt_table(rows, ['BENCHMARK', 'TASK']))
+
+
+@bench.command(name='down')
+@click.argument('benchmark_name', required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_down(benchmark_name, yes):
+    """Terminate all clusters of a benchmark."""
+    from skypilot_tpu.benchmark import benchmark_utils
+    if not yes:
+        click.confirm(f'Terminate benchmark {benchmark_name!r} clusters?',
+                      default=True, abort=True)
+    benchmark_utils.terminate_benchmark_clusters(benchmark_name)
+    click.echo('Done.')
+
+
+@bench.command(name='delete')
+@click.argument('benchmark_name', required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def bench_delete(benchmark_name, yes):
+    from skypilot_tpu.benchmark import benchmark_state
+    live = [r['cluster'] for r in
+            benchmark_state.get_results(benchmark_name)
+            if r['status'] is not
+            benchmark_state.BenchmarkStatus.TERMINATED]
+    if live:
+        raise click.UsageError(
+            f'Benchmark {benchmark_name!r} still has clusters {live}; '
+            f'run `skyt bench down {benchmark_name}` first.')
+    if not yes:
+        click.confirm(f'Delete benchmark {benchmark_name!r} records?',
+                      default=True, abort=True)
+    benchmark_state.remove_benchmark(benchmark_name)
+    click.echo(f'Benchmark {benchmark_name} deleted.')
+
+
 # ------------------------------------------------------------------ serve
 @cli.group()
 def serve():

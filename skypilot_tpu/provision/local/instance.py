@@ -159,6 +159,15 @@ def _start_agent(cluster: str, rank: int, meta: Dict[str, Any],
     env = dict(os.environ)
     env['HOME'] = host_dir
     env['SKYT_AGENT_HOME'] = host_dir
+    # The agent (and every job it spawns) must import skypilot_tpu no
+    # matter the driver's cwd — put the package root on PYTHONPATH, the
+    # local analog of the SSH path's PYTHONPATH=$HOME/.skyt/lib shipping
+    # (provision/provisioner.py _ensure_package).
+    import skypilot_tpu
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(skypilot_tpu.__file__)))
+    env['PYTHONPATH'] = pkg_root + (
+        os.pathsep + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
     log_f = open(os.path.join(skyt, 'agent.out'), 'a',  # noqa: SIM115
                  encoding='utf-8')
     proc = subprocess.Popen(

@@ -1,0 +1,99 @@
+"""Benchmark harness tests: callback summary format offline; full
+launch → collect → interpolate → terminate loop on the local provider.
+
+Reference: sky/benchmark/ + sky_callback (SURVEY.md §2.9).
+"""
+import json
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import callbacks
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import state
+from skypilot_tpu.benchmark import benchmark_state
+from skypilot_tpu.benchmark import benchmark_utils
+
+
+def test_callback_summary(tmp_path):
+    cb = callbacks.SkytCallback(total_steps=10,
+                                benchmark_dir=str(tmp_path),
+                                warmup_steps=1)
+    for _ in range(5):
+        time.sleep(0.01)
+        cb.on_step_end()
+    cb.close()
+    with open(tmp_path / 'summary.json', encoding='utf-8') as f:
+        s = json.load(f)
+    assert s['num_steps'] == 5
+    assert s['total_steps'] == 10
+    assert s['seconds_per_step'] > 0
+    assert s['first_step_time'] <= s['last_step_time']
+
+
+def test_step_timer_context(tmp_path):
+    with callbacks.step_timer(total_steps=3,
+                              benchmark_dir=str(tmp_path)) as cb:
+        cb.on_step_end()
+    with open(tmp_path / 'summary.json', encoding='utf-8') as f:
+        assert json.load(f)['num_steps'] == 1
+
+
+def test_interpolation():
+    summary = {'boot_time': 100.0, 'num_steps': 10, 'total_steps': 110,
+               'first_step_time': 101.0, 'last_step_time': 120.0,
+               'seconds_per_step': 2.0}
+    r = benchmark_utils._interpolate(summary, hourly_cost=3.6)  # pylint: disable=protected-access
+    assert r['elapsed_s'] == 20.0
+    assert r['cost_so_far'] == pytest.approx(0.02)
+    assert r['eta_s'] == 200.0
+    assert r['est_total_s'] == 220.0
+    assert r['cost_per_step'] == pytest.approx(0.002)
+
+
+@pytest.fixture()
+def bench_env(tmp_path, tmp_state_dir, monkeypatch):
+    monkeypatch.setenv('SKYT_LOCAL_ROOT', str(tmp_path / 'local'))
+    state.reset_db_for_testing()
+    benchmark_state.reset_db_for_testing()
+    yield
+    from skypilot_tpu import core
+    for rec in state.get_clusters():
+        try:
+            core.down(rec['name'], purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    state.reset_db_for_testing()
+    benchmark_state.reset_db_for_testing()
+
+
+_BENCH_RUN = (
+    "python -c \""
+    "import time\n"
+    "from skypilot_tpu import callbacks\n"
+    "cb = callbacks.SkytCallback(total_steps=4, warmup_steps=0)\n"
+    "for _ in range(4):\n"
+    "    time.sleep(0.05); cb.on_step_end()\n"
+    "cb.close()\"")
+
+
+@pytest.mark.integration
+def test_benchmark_end_to_end(bench_env):
+    t = sky.Task(name='bt', run=_BENCH_RUN)
+    t.set_resources(resources_lib.Resources(cloud='local'))
+    candidates = benchmark_utils.generate_benchmark_candidates(t)
+    assert len(candidates) == 1
+    benchmark_state.add_benchmark('b1', 'inline')
+    clusters = benchmark_utils.launch_benchmark_clusters('b1', t,
+                                                         candidates)
+    assert clusters == ['skyt-bench-b1-0']
+    assert benchmark_utils.wait_for_results('b1', timeout=60,
+                                            min_steps=4)
+    rows = benchmark_utils.report('b1')
+    assert rows[0]['num_steps'] == 4
+    assert rows[0]['seconds_per_step'] > 0
+    benchmark_utils.terminate_benchmark_clusters('b1')
+    assert state.get_clusters() == []
+    assert benchmark_state.get_results('b1')[0]['status'] is \
+        benchmark_state.BenchmarkStatus.TERMINATED
