@@ -85,8 +85,9 @@ def update_benchmark_results(benchmark: str) -> None:
     unreachable head must not serialize the rest) and recompute
     estimates. Reference: :274 _update_benchmark_result."""
     live = [rec for rec in benchmark_state.get_results(benchmark)
-            if rec['status'] is not
-            benchmark_state.BenchmarkStatus.TERMINATED]
+            if rec['status'] not in
+            (benchmark_state.BenchmarkStatus.TERMINATED,
+             benchmark_state.BenchmarkStatus.FINISHED)]
     if not live:
         return
 
@@ -170,7 +171,11 @@ def terminate_benchmark_clusters(benchmark: str) -> None:
         except exceptions.ClusterDoesNotExist:
             pass
         except exceptions.SkyTpuError as e:
-            logger.warning('teardown of %s failed: %s', rec['cluster'], e)
+            # Keep the row live so `bench delete`'s guard still sees the
+            # cluster — marking TERMINATED here would orphan a billed VM.
+            logger.warning('teardown of %s failed: %s; row kept',
+                           rec['cluster'], e)
+            continue
         benchmark_state.update_result(
             benchmark, rec['cluster'],
             benchmark_state.BenchmarkStatus.TERMINATED, None)

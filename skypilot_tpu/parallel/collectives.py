@@ -1,0 +1,131 @@
+"""Collective-communication benchmark: the ICI/DCN `nccl_test` analog.
+
+Reference: examples/nccl_test.yaml runs nccl-tests' all_reduce_perf over
+2 nodes (sample output 3.85 GBps bus bandwidth, 16 ranks — BASELINE.md).
+On TPU the collectives are XLA-compiled over ICI, so the benchmark is a
+jitted psum/all-gather/ppermute over a mesh axis, timed after warmup.
+
+Run standalone on any host (real TPU slice or CPU mesh):
+    python -m skypilot_tpu.parallel.collectives --axis tp --mb 64
+"""
+import argparse
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+# bus-bandwidth correction factors (match nccl-tests conventions):
+# all-reduce moves 2(n-1)/n bytes per byte of payload per rank.
+def _busbw_factor(op: str, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    if op == 'all_reduce':
+        return 2.0 * (n - 1) / n
+    if op in ('all_gather', 'reduce_scatter'):
+        return (n - 1) / n
+    if op == 'ppermute':
+        return 1.0
+    raise ValueError(f'unknown op {op}')
+
+
+def _make_op(op: str, axis: str, mesh: Mesh):
+    n = mesh.shape[axis]
+
+    def all_reduce(x):
+        return jax.lax.psum(x, axis)
+
+    def all_gather(x):
+        return jax.lax.all_gather(x, axis)
+
+    def reduce_scatter(x):
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+
+    def ppermute(x):
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis, perm)
+
+    fns = {'all_reduce': all_reduce, 'all_gather': all_gather,
+           'reduce_scatter': reduce_scatter, 'ppermute': ppermute}
+    return fns[op]
+
+
+def bench_collective(mesh: Mesh, axis: str, op: str,
+                     payload_mb: float = 64.0,
+                     iters: int = 10) -> Dict[str, float]:
+    """Time `op` over `axis`; returns {algbw_gbps, busbw_gbps, time_ms}.
+
+    Payload is the per-device shard size (matching nccl-tests' per-rank
+    message size convention).
+    """
+    n = mesh.shape[axis]
+    # Round to a multiple of n: psum_scatter(tiled=True) needs the
+    # scattered dimension divisible by the axis size.
+    elems = max(n, int(payload_mb * 1e6 / 4) // n * n)
+    spec = P(axis)
+    sharding = NamedSharding(mesh, spec)
+    # Global array sharded over the axis: per-device shard = payload.
+    x = jax.device_put(
+        jnp.ones((n * elems,), jnp.float32), sharding)
+
+    inner = _make_op(op, axis, mesh)
+
+    def _sharded(x):
+        y = inner(x)
+        # Reduce to a scalar so the collective cannot be DCE'd and the
+        # output layout doesn't dominate timing; the closing psum makes
+        # the output provably replicated (shard_map out_specs=P()).
+        return jax.lax.psum(jnp.sum(y[..., :1]), axis)
+
+    fn = jax.jit(mesh_lib.shard_map(_sharded, mesh, in_specs=spec,
+                                    out_specs=P()))
+
+    fn(x).block_until_ready()  # compile + warm
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+
+    payload_bytes = elems * 4
+    algbw = payload_bytes / elapsed / 1e9
+    busbw = algbw * _busbw_factor(op, n)
+    return {'op': op, 'axis': axis, 'ranks': n,
+            'payload_mb': payload_mb,
+            'time_ms': elapsed * 1e3,
+            'algbw_gbps': algbw, 'busbw_gbps': busbw}
+
+
+def bench_all(mesh: Mesh, axis: str, payload_mb: float = 64.0,
+              ops: Optional[List[str]] = None) -> List[Dict[str, float]]:
+    ops = ops or ['all_reduce', 'all_gather', 'reduce_scatter',
+                  'ppermute']
+    return [bench_collective(mesh, axis, op, payload_mb) for op in ops]
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--axis', default='tp')
+    parser.add_argument('--mb', type=float, default=64.0,
+                        help='per-device payload in MB')
+    parser.add_argument('--ops', nargs='*', default=None)
+    args = parser.parse_args(argv)
+
+    devices = jax.devices()
+    n = len(devices)
+    spec = mesh_lib.MeshSpec(**{args.axis: n})
+    mesh = mesh_lib.build_mesh(spec, devices)
+    print(f'# {n}x {devices[0].device_kind} over axis {args.axis!r}')
+    for r in bench_all(mesh, args.axis, args.mb, args.ops):
+        print(f"{r['op']:<16} ranks={r['ranks']} "
+              f"payload={r['payload_mb']:.0f}MB "
+              f"time={r['time_ms']:.2f}ms "
+              f"algbw={r['algbw_gbps']:.2f}GB/s "
+              f"busbw={r['busbw_gbps']:.2f}GB/s")
+
+
+if __name__ == '__main__':
+    main()

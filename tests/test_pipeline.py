@@ -1,0 +1,104 @@
+"""Pipeline parallelism + collectives benchmark tests (8-device CPU
+mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.parallel import collectives
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.parallel import pipeline
+
+
+def _mesh(pp):
+    spec = mesh_lib.MeshSpec(pp=pp)
+    return mesh_lib.build_mesh(spec, jax.devices()[:pp])
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params['w'] + params['b'])
+
+
+def _make_params(key, num_stages, dim):
+    per_stage = []
+    for i in range(num_stages):
+        k1, k2, key = jax.random.split(key, 3)
+        per_stage.append({
+            'w': jax.random.normal(k1, (dim, dim)) * 0.3,
+            'b': jax.random.normal(k2, (dim,)) * 0.1,
+        })
+    return pipeline.stack_stage_params(per_stage), per_stage
+
+
+def _sequential(per_stage, x):
+    for p in per_stage:
+        x = _stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize('pp,m', [(2, 4), (4, 8)])
+def test_pipeline_matches_sequential(pp, m):
+    mesh = _mesh(pp)
+    dim, bm = 8, 2
+    stacked, per_stage = _make_params(jax.random.PRNGKey(0), pp, dim)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (m, bm, dim))
+    out = pipeline.pipeline_apply(_stage_fn, stacked, xs, mesh)
+    want = jnp.stack([_sequential(per_stage, xs[i]) for i in range(m)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grad_matches_sequential():
+    pp, m, dim, bm = 2, 4, 6, 2
+    mesh = _mesh(pp)
+    stacked, per_stage = _make_params(jax.random.PRNGKey(2), pp, dim)
+    batch = jax.random.normal(jax.random.PRNGKey(3), (m * bm, dim))
+    targets = jax.random.normal(jax.random.PRNGKey(4), (m * bm, dim))
+
+    loss = pipeline.pipeline_loss_fn(
+        _stage_fn, lambda y, t: jnp.mean((y - t) ** 2), mesh,
+        num_microbatches=m)
+    g_pipe = jax.grad(loss)(stacked, batch, targets)
+
+    def seq_loss(stacked_params):
+        per = [jax.tree.map(lambda l, i=i: l[i], stacked_params)
+               for i in range(pp)]
+        y = _sequential(per, batch)
+        return jnp.mean((y - targets) ** 2)
+
+    g_seq = jax.grad(seq_loss)(stacked)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g_pipe, g_seq)
+
+
+def test_pipeline_rejects_too_few_microbatches():
+    mesh = _mesh(4)
+    stacked, _ = _make_params(jax.random.PRNGKey(0), 4, 4)
+    xs = jnp.zeros((2, 1, 4))
+    with pytest.raises(ValueError):
+        pipeline.pipeline_apply(_stage_fn, stacked, xs, mesh)
+
+
+def test_microbatch_roundtrip():
+    x = jnp.arange(24).reshape(12, 2)
+    mb = pipeline.microbatch(x, 4)
+    assert mb.shape == (4, 3, 2)
+    np.testing.assert_array_equal(np.asarray(pipeline.unmicrobatch(mb)),
+                                  np.asarray(x))
+    with pytest.raises(ValueError):
+        pipeline.microbatch(x, 5)
+
+
+def test_collectives_bench_smoke():
+    n = min(8, len(jax.devices()))
+    spec = mesh_lib.MeshSpec(tp=n)
+    mesh = mesh_lib.build_mesh(spec, jax.devices()[:n])
+    rows = collectives.bench_all(mesh, 'tp', payload_mb=0.5)
+    assert {r['op'] for r in rows} == {'all_reduce', 'all_gather',
+                                       'reduce_scatter', 'ppermute'}
+    for r in rows:
+        assert r['ranks'] == n
+        assert r['time_ms'] > 0
+        assert r['algbw_gbps'] > 0
