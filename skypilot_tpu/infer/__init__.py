@@ -1,0 +1,6 @@
+"""TPU-native LLM inference (reference: llm/vllm recipes — the reference
+serves vLLM as an opaque container; here the engine is first-class)."""
+from skypilot_tpu.infer.engine import InferenceEngine
+from skypilot_tpu.infer.engine import SamplingParams
+
+__all__ = ['InferenceEngine', 'SamplingParams']

@@ -1,0 +1,150 @@
+"""HTTP serving front-end for the inference engine.
+
+The in-replica server the serve layer probes and proxies to (reference
+serves vLLM's OpenAI-compatible server in a container; llm/vllm/
+service.yaml readiness-probes /v1/models). Endpoints:
+
+  GET  /health            — 200 once the engine loop is live (readiness
+                            probe target).
+  POST /generate          — {"tokens": [...]} or {"text": "..."},
+                            optional max_tokens/temperature/top_k/
+                            stream. stream=true sends one JSON line per
+                            token as soon as it is sampled (TTFT = first
+                            chunk latency).
+  GET  /stats             — engine slot/queue stats.
+
+Run:  python -m skypilot_tpu.infer.server --model debug --port 8000
+
+Text uses the framework's byte-level fallback tokenizer (train/sft.py);
+pass pre-tokenized ids for real deployments.
+"""
+import argparse
+import asyncio
+import functools
+import json
+from typing import List
+
+from aiohttp import web
+
+from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def byte_encode(text: str, vocab_size: int) -> List[int]:
+    return [b % vocab_size for b in text.encode()]
+
+
+def byte_decode(tokens: List[int]) -> str:
+    return bytes(t for t in tokens if 0 < t < 256).decode(
+        'utf-8', errors='replace')
+
+
+class InferenceServer:
+    def __init__(self, engine: 'engine_lib.InferenceEngine') -> None:
+        self.engine = engine
+
+    async def _health(self, request: web.Request) -> web.Response:
+        del request
+        if self.engine.ready.is_set():
+            return web.json_response({'status': 'ok'})
+        return web.json_response({'status': 'starting'}, status=503)
+
+    async def _stats(self, request: web.Request) -> web.Response:
+        del request
+        return web.json_response(self.engine.stats())
+
+    async def _generate(self, request: web.Request) -> web.StreamResponse:
+        payload = await request.json()
+        if 'tokens' in payload:
+            tokens = [int(t) for t in payload['tokens']]
+        elif 'text' in payload:
+            tokens = byte_encode(payload['text'],
+                                 self.engine.cfg.vocab_size)
+        else:
+            return web.json_response(
+                {'error': 'need "tokens" or "text"'}, status=400)
+        if not tokens:
+            return web.json_response({'error': 'empty prompt'},
+                                     status=400)
+        params = engine_lib.SamplingParams(
+            max_new_tokens=int(payload.get('max_tokens', 128)),
+            temperature=float(payload.get('temperature', 0.0)),
+            top_k=int(payload.get('top_k', 0)),
+            eos_token=payload.get('eos_token'))
+        req_id, out_q = self.engine.submit(tokens, params)
+        loop = asyncio.get_running_loop()
+
+        if payload.get('stream'):
+            resp = web.StreamResponse(
+                headers={'Content-Type': 'application/x-ndjson'})
+            await resp.prepare(request)
+            while True:
+                tok = await loop.run_in_executor(
+                    None, functools.partial(out_q.get, timeout=300))
+                if tok is None:
+                    break
+                await resp.write(
+                    json.dumps({'token': tok}).encode() + b'\n')
+            await resp.write_eof()
+            return resp
+
+        out: List[int] = []
+        while True:
+            tok = await loop.run_in_executor(
+                None, functools.partial(out_q.get, timeout=300))
+            if tok is None:
+                break
+            out.append(tok)
+        return web.json_response({
+            'request_id': req_id,
+            'tokens': out,
+            'text': byte_decode(out),
+        })
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/health', self._health)
+        app.router.add_get('/stats', self._stats)
+        app.router.add_post('/generate', self._generate)
+        return app
+
+
+def build_engine(model_name: str, num_slots: int,
+                 max_seq_len: int) -> 'engine_lib.InferenceEngine':
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+
+    cfg = llama.CONFIGS[model_name]
+    import dataclasses as _dc
+    cfg = _dc.replace(cfg, remat=False,
+                      max_seq_len=min(cfg.max_seq_len, max_seq_len))
+    model = llama.LlamaModel(cfg)
+    sample = jnp.zeros((1, 8), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), sample)
+    return engine_lib.InferenceEngine(model, params,
+                                      num_slots=num_slots,
+                                      max_seq_len=max_seq_len)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='debug')
+    parser.add_argument('--port', type=int, default=8000)
+    parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--max-seq-len', type=int, default=2048)
+    args = parser.parse_args(argv)
+
+    engine = build_engine(args.model, args.num_slots, args.max_seq_len)
+    engine.start()
+    server = InferenceServer(engine)
+    logger.info('inference server: model=%s port=%d slots=%d',
+                args.model, args.port, args.num_slots)
+    web.run_app(server.make_app(), port=args.port, print=None)
+
+
+if __name__ == '__main__':
+    main()

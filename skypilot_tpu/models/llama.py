@@ -85,7 +85,12 @@ class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, segment_ids=None):
+    def __call__(self, x, cos, sin, segment_ids=None, cache=None,
+                 positions=None):
+        """cache: optional (k,v) of [B, S_cache, Hkv, Hd] for incremental
+        decoding — new K/V are written at `positions` (per-batch write
+        offsets) and attention runs against the whole cache with a
+        position mask. Returns (out, new_cache) when cache is given."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         h, hk, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -107,6 +112,24 @@ class LlamaAttention(nn.Module):
         v = nn.with_logical_constraint(
             v, ('act_batch', 'act_seq', 'act_kv_heads', None))
 
+        if cache is not None:
+            assert positions is not None, 'cache path needs positions'
+            k_cache, v_cache = cache
+            start = positions[:, 0]  # write offset per sequence
+            k_cache = jax.vmap(
+                lambda c, kk, i: jax.lax.dynamic_update_slice(
+                    c, kk, (i, 0, 0)))(k_cache, k, start)
+            v_cache = jax.vmap(
+                lambda c, vv, i: jax.lax.dynamic_update_slice(
+                    c, vv, (i, 0, 0)))(v_cache, v, start)
+            out = _cached_attention(q, k_cache, v_cache, positions)
+            out = out.reshape(b, s, h * hd)
+            out = _dense(cfg.dim, ('heads', 'embed'), 'wo',
+                         cfg.param_dtype, dtype)(out)
+            return nn.with_logical_constraint(
+                out, ('act_batch', 'act_seq', 'act_embed')), \
+                (k_cache, v_cache)
+
         if cfg.attn_impl == 'ring':
             from skypilot_tpu.parallel import mesh as mesh_lib
             from skypilot_tpu.parallel import ring_attention
@@ -127,6 +150,16 @@ class LlamaAttention(nn.Module):
                      dtype)(out)
         return nn.with_logical_constraint(
             out, ('act_batch', 'act_seq', 'act_embed'))
+
+
+def _cached_attention(q, k_cache, v_cache, positions):
+    """Attention of q [B,S,H,Hd] against the full cache [B,Sc,Hkv,Hd],
+    masked so query at global position p sees keys at positions <= p
+    (cache slots beyond the written prefix are masked out by the same
+    rule because writes are left-aligned). Delegates to the tested GQA
+    reference (ops/attention.py) with per-batch query positions."""
+    return attention_ops.mha_reference(q, k_cache, v_cache,
+                                       q_positions=positions)
 
 
 class LlamaMLP(nn.Module):
@@ -167,20 +200,34 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, segment_ids=None):
-        x = x + LlamaAttention(self.cfg, name='attn')(
-            RMSNorm(self.cfg, name='attn_norm')(x), cos, sin, segment_ids)
+    def __call__(self, x, cos, sin, segment_ids=None, cache=None,
+                 positions=None):
+        attn_in = RMSNorm(self.cfg, name='attn_norm')(x)
+        if cache is not None:
+            attn_out, new_cache = LlamaAttention(self.cfg, name='attn')(
+                attn_in, cos, sin, segment_ids, cache, positions)
+        else:
+            attn_out = LlamaAttention(self.cfg, name='attn')(
+                attn_in, cos, sin, segment_ids)
+            new_cache = None
+        x = x + attn_out
         x = x + LlamaMLP(self.cfg, name='mlp')(
             RMSNorm(self.cfg, name='mlp_norm')(x))
-        return x
+        return (x, new_cache) if cache is not None else x
 
 
 class LlamaModel(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, segment_ids=None):
-        """tokens: [B, S] int32 -> logits [B, S, vocab] (compute dtype)."""
+    def __call__(self, tokens, positions=None, segment_ids=None,
+                 cache=None):
+        """tokens: [B, S] int32 -> logits [B, S, vocab] (compute dtype).
+
+        cache: optional {'k': [L,B,Sc,Hkv,Hd], 'v': ...} for incremental
+        decoding (see infer/engine.py). With a cache, `positions` must be
+        the global positions of `tokens` (per batch) and the return is
+        (logits, new_cache)."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         b, s = tokens.shape
@@ -200,23 +247,52 @@ class LlamaModel(nn.Module):
             use_llama31_scaling=cfg.use_llama31_rope)
 
         block = LlamaBlock
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(
                 LlamaBlock,
                 policy=jax.checkpoint_policies.save_only_these_names(),
                 prevent_cse=not cfg.scan_layers)
+        new_cache = None
         if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, cos, sin, segment_ids),
-                                       None),
-                variable_axes={'params': 0},
-                split_rngs={'params': True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: 'layers'},
-            )(block(cfg, name='layers'), x, None)
+            if cache is not None:
+                def body(mdl, carry, layer_cache):
+                    y, upd = mdl(carry, cos, sin, segment_ids,
+                                 (layer_cache['k'], layer_cache['v']),
+                                 positions)
+                    return y, {'k': upd[0], 'v': upd[1]}
+                x, new_cache = nn.scan(
+                    body,
+                    variable_axes={'params': 0},
+                    split_rngs={'params': True},
+                    length=cfg.n_layers,
+                    in_axes=0, out_axes=0,
+                    metadata_params={nn.PARTITION_NAME: 'layers'},
+                )(block(cfg, name='layers'), x, cache)
+            else:
+                x, _ = nn.scan(
+                    lambda mdl, carry, _: (
+                        mdl(carry, cos, sin, segment_ids), None),
+                    variable_axes={'params': 0},
+                    split_rngs={'params': True},
+                    length=cfg.n_layers,
+                    metadata_params={nn.PARTITION_NAME: 'layers'},
+                )(block(cfg, name='layers'), x, None)
         else:
+            caches_out = []
             for i in range(cfg.n_layers):
-                x = block(cfg, name=f'layer_{i}')(x, cos, sin, segment_ids)
+                if cache is not None:
+                    layer_cache = (cache['k'][i], cache['v'][i])
+                    x, upd = block(cfg, name=f'layer_{i}')(
+                        x, cos, sin, segment_ids, layer_cache, positions)
+                    caches_out.append(upd)
+                else:
+                    x = block(cfg, name=f'layer_{i}')(x, cos, sin,
+                                                      segment_ids)
+            if cache is not None:
+                new_cache = {
+                    'k': jnp.stack([c[0] for c in caches_out]),
+                    'v': jnp.stack([c[1] for c in caches_out]),
+                }
 
         x = RMSNorm(cfg, name='final_norm')(x)
         if cfg.tie_embeddings:
@@ -224,5 +300,6 @@ class LlamaModel(nn.Module):
         else:
             logits = _dense(cfg.vocab_size, ('embed', 'vocab'), 'lm_head',
                             cfg.param_dtype, dtype)(x)
-        return nn.with_logical_constraint(
+        logits = nn.with_logical_constraint(
             logits, ('act_batch', 'act_seq', 'act_vocab'))
+        return (logits, new_cache) if cache is not None else logits

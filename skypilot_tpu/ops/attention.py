@@ -27,10 +27,15 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   segment_ids: Optional[jax.Array] = None,
                   kv_segment_ids: Optional[jax.Array] = None,
                   q_offset: int = 0,
+                  q_positions: Optional[jax.Array] = None,
                   softmax_scale: Optional[float] = None) -> jax.Array:
     """q: [B, Sq, Hq, D]; k,v: [B, Sk, Hkv, D]; Hq % Hkv == 0.
 
     Returns [B, Sq, Hq, D]. Logits and softmax in f32.
+
+    q_positions: optional [B, Sq] global query positions for the causal
+    mask (per-batch offsets — the KV-cache decode path); overrides
+    q_offset. Keys are assumed at positions 0..Sk-1.
     """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -44,7 +49,11 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     logits = logits * scale
 
     mask = None
-    if causal:
+    if q_positions is not None:
+        k_pos = jnp.arange(sk)
+        mask = (q_positions[:, None, None, :, None] >=
+                k_pos[None, None, None, None, :])
+    elif causal:
         mask = _causal_mask(sq, sk, q_offset)[None, None, None]
     if segment_ids is not None:
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids

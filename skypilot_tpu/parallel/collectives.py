@@ -67,9 +67,11 @@ def bench_collective(mesh: Mesh, axis: str, op: str,
     elems = max(n, int(payload_mb * 1e6 / 4) // n * n)
     spec = P(axis)
     sharding = NamedSharding(mesh, spec)
-    # Global array sharded over the axis: per-device shard = payload.
-    x = jax.device_put(
-        jnp.ones((n * elems,), jnp.float32), sharding)
+    # Materialize directly sharded (jit with out_shardings): a host-side
+    # global array would hold n x payload on one device first and cannot
+    # target non-addressable (multi-host) meshes at all.
+    x = jax.jit(lambda: jnp.ones((n * elems,), jnp.float32),
+                out_shardings=sharding)()
 
     inner = _make_op(op, axis, mesh)
 
@@ -90,7 +92,12 @@ def bench_collective(mesh: Mesh, axis: str, op: str,
     out.block_until_ready()
     elapsed = (time.perf_counter() - start) / iters
 
+    # nccl-tests size conventions: all_reduce/ppermute report the
+    # per-rank buffer; all_gather/reduce_scatter report the total
+    # (gathered / pre-reduce) buffer — busbw factors above assume this.
     payload_bytes = elems * 4
+    if op in ('all_gather', 'reduce_scatter'):
+        payload_bytes *= n
     algbw = payload_bytes / elapsed / 1e9
     busbw = algbw * _busbw_factor(op, n)
     return {'op': op, 'axis': axis, 'ranks': n,
