@@ -413,6 +413,16 @@ def jobs_logs(job_id, controller, no_follow):
                                  controller=controller))
 
 
+@cli.command()
+@click.option('--port', default=None, type=int)
+def dashboard(port):
+    """Web dashboard of clusters/jobs/services. Reference: sky jobs
+    dashboard."""
+    from skypilot_tpu import dashboard as dashboard_lib
+    dashboard_lib.run(port if port is not None
+                      else dashboard_lib.DEFAULT_PORT)
+
+
 # ------------------------------------------------------------------ bench
 @cli.group()
 def bench():

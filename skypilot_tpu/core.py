@@ -11,6 +11,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import state
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.backends import tpu_backend
+from skypilot_tpu.usage import usage_lib
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
@@ -33,6 +34,7 @@ def _handle_or_raise(cluster_name: str) -> tpu_backend.TpuVmResourceHandle:
 
 
 # ------------------------------------------------------------------ status
+@usage_lib.entrypoint
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False) -> List[Dict[str, Any]]:
     """Reference: sky/core.py:38 status."""
@@ -43,6 +45,7 @@ def status(cluster_names: Optional[List[str]] = None,
     return records
 
 
+@usage_lib.entrypoint
 def endpoints(cluster_name: str,
               port: Optional[int] = None) -> Dict[int, str]:
     """Reference: sky/core.py:113 endpoints."""
@@ -55,6 +58,7 @@ def endpoints(cluster_name: str,
     return {p: f'{head_ip}:{p}' for p in ports}
 
 
+@usage_lib.entrypoint
 def cost_report() -> List[Dict[str, Any]]:
     """Accumulated cost per cluster from usage intervals.
 
@@ -79,6 +83,7 @@ def cost_report() -> List[Dict[str, Any]]:
 
 
 # --------------------------------------------------------------- lifecycle
+@usage_lib.entrypoint
 def start(cluster_name: str, retry_until_up: bool = False) -> None:
     """Restart a STOPPED cluster. Reference: sky/core.py:245."""
     record = state.get_cluster(cluster_name)
@@ -96,6 +101,7 @@ def start(cluster_name: str, retry_until_up: bool = False) -> None:
                          retry_until_up=retry_until_up)
 
 
+@usage_lib.entrypoint
 def stop(cluster_name: str) -> None:
     """Reference: sky/core.py:317 stop. TPU pod slices cannot stop
     (provider raises); single-host TPU VMs can."""
@@ -103,12 +109,14 @@ def stop(cluster_name: str) -> None:
     _backend().teardown(handle, terminate=False)
 
 
+@usage_lib.entrypoint
 def down(cluster_name: str, purge: bool = False) -> None:
     """Reference: sky/core.py:375 down."""
     handle = _handle_or_raise(cluster_name)
     _backend().teardown(handle, terminate=True, purge=purge)
 
 
+@usage_lib.entrypoint
 def autostop(cluster_name: str, idle_minutes: int,
              down: bool = False) -> None:  # pylint: disable=redefined-outer-name
     """Reference: sky/core.py:408 autostop. idle_minutes < 0 cancels."""
@@ -117,6 +125,7 @@ def autostop(cluster_name: str, idle_minutes: int,
 
 
 # -------------------------------------------------------------------- jobs
+@usage_lib.entrypoint
 def queue(cluster_name: str,
           skip_finished: bool = False) -> List[Dict[str, Any]]:
     """Reference: sky/core.py:517 queue."""
@@ -128,6 +137,7 @@ def queue(cluster_name: str,
     return jobs
 
 
+@usage_lib.entrypoint
 def cancel(cluster_name: str,
            job_ids: Optional[List[int]] = None,
            all_jobs: bool = False) -> List[int]:
@@ -136,6 +146,7 @@ def cancel(cluster_name: str,
     return _backend().cancel_jobs(handle, job_ids, all_jobs=all_jobs)
 
 
+@usage_lib.entrypoint
 def tail_logs(cluster_name: str, job_id: Optional[int] = None,
               follow: bool = True) -> int:
     """Reference: sky/core.py:666 tail_logs."""
@@ -143,6 +154,7 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
     return _backend().tail_logs(handle, job_id, follow=follow)
 
 
+@usage_lib.entrypoint
 def download_logs(cluster_name: str, job_id: int,
                   local_dir: str = '~/skyt_logs') -> str:
     """Reference: sky/core.py:705 download_logs."""
@@ -152,6 +164,7 @@ def download_logs(cluster_name: str, job_id: int,
     return _backend().sync_down_logs(handle, job_id, target)
 
 
+@usage_lib.entrypoint
 def job_status(cluster_name: str, job_ids: Optional[List[int]] = None
                ) -> Dict[int, Optional[str]]:
     """Reference: sky/core.py:747 job_status."""
@@ -164,11 +177,13 @@ def job_status(cluster_name: str, job_ids: Optional[List[int]] = None
 
 
 # ----------------------------------------------------------------- storage
+@usage_lib.entrypoint
 def storage_ls() -> List[Dict[str, Any]]:
     """Reference: sky/core.py:800 storage_ls."""
     return state.get_storages()
 
 
+@usage_lib.entrypoint
 def storage_delete(name: str) -> None:
     """Reference: sky/core.py:822 storage_delete."""
     record = state.get_storage(name)

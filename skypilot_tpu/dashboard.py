@@ -1,0 +1,167 @@
+"""Web dashboard: clusters, managed jobs, services on one page.
+
+Reference: sky/jobs/dashboard/dashboard.py (flask behind an SSH port
+forward) + the serve status CLI. Consolidated here into one aiohttp app
+over the local state DBs (the controllers run client-side, so no port
+forward is needed).
+
+Run:  skyt dashboard            (or python -m skypilot_tpu.dashboard)
+"""
+import argparse
+import html
+import time
+
+from aiohttp import web
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>skypilot-tpu</title>
+<meta http-equiv="refresh" content="10">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem; }}
+ h2 {{ margin-top: 2rem; }}
+ table {{ border-collapse: collapse; min-width: 40rem; }}
+ th, td {{ border: 1px solid #ccc; padding: .35rem .7rem;
+           text-align: left; font-size: .9rem; }}
+ th {{ background: #f0f0f0; }}
+ .ok {{ color: #0a7d32; font-weight: 600; }}
+ .bad {{ color: #b00020; font-weight: 600; }}
+ .dim {{ color: #777; }}
+</style></head><body>
+<h1>skypilot-tpu</h1>
+<p class="dim">refreshed {now}</p>
+<h2>Clusters</h2>{clusters}
+<h2>Managed jobs</h2>{jobs}
+<h2>Services</h2>{services}
+</body></html>"""
+
+_GOOD = {'UP', 'SUCCEEDED', 'READY', 'RUNNING'}
+_BAD = {'FAILED', 'FAILED_SETUP', 'FAILED_CONTROLLER', 'FAILED_NO_RESOURCE',
+        'FAILED_PRECHECKS', 'FAILED_CLEANUP', 'PREEMPTED'}
+
+
+def _table(headers, rows):
+    if not rows:
+        return '<p class="dim">none</p>'
+    out = ['<table><tr>']
+    out += [f'<th>{html.escape(h)}</th>' for h in headers]
+    out.append('</tr>')
+    for row in rows:
+        out.append('<tr>')
+        for cell in row:
+            text = html.escape(str(cell))
+            cls = ('ok' if text in _GOOD else
+                   'bad' if text in _BAD else '')
+            out.append(f'<td class="{cls}">{text}</td>')
+        out.append('</tr>')
+    out.append('</table>')
+    return ''.join(out)
+
+
+def _clusters_html() -> str:
+    from skypilot_tpu import state
+    rows = []
+    for r in state.get_clusters():
+        handle = r['handle']
+        autostop = (f"{r['autostop']}m" if r.get('autostop', -1) >= 0
+                    else '-')
+        rows.append([r['name'], str(handle.launched_resources),
+                     handle.num_hosts, r['status'].value, autostop])
+    return _table(['name', 'resources', 'hosts', 'status', 'autostop'],
+                  rows)
+
+
+def _jobs_html() -> str:
+    # Read-only view: jobs_core.queue() would also RECONCILE (probe
+    # controller PIDs and write FAILED_CONTROLLER) — a monitoring page
+    # must not have write side effects.
+    from skypilot_tpu.jobs import state as jobs_state
+    rows = []
+    for j in jobs_state.get_jobs():
+        rows.append([j['job_id'], j['name'] or '-', j['status'].value,
+                     j['recovery_count'],
+                     j.get('failure_reason') or '-'])
+    return _table(['id', 'name', 'status', 'recoveries', 'reason'], rows)
+
+
+def _services_html() -> str:
+    from skypilot_tpu.serve import core as serve_core
+    rows = []
+    for s in serve_core.status():
+        ready = sum(1 for r in s['replicas']
+                    if r['status'].value == 'READY')
+        rows.append([s['name'], s['status'].value, f'v{s["version"]}',
+                     f"{ready}/{len(s['replicas'])}", s['endpoint']])
+    return _table(['service', 'status', 'version', 'ready', 'endpoint'],
+                  rows)
+
+
+def _render_page() -> str:
+    return _PAGE.format(
+        now=time.strftime('%Y-%m-%d %H:%M:%S'),
+        clusters=_clusters_html(),
+        jobs=_jobs_html(),
+        services=_services_html())
+
+
+def _gather_state() -> dict:
+    from skypilot_tpu import state
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.serve import core as serve_core
+    return {
+        'clusters': [{'name': r['name'], 'status': r['status'].value,
+                      'hosts': r['handle'].num_hosts}
+                     for r in state.get_clusters()],
+        'jobs': [{'id': j['job_id'], 'name': j['name'],
+                  'status': j['status'].value,
+                  'recoveries': j['recovery_count']}
+                 for j in jobs_state.get_jobs()],
+        'services': [{'name': s['name'], 'status': s['status'].value,
+                      'version': s['version'],
+                      'replicas': len(s['replicas'])}
+                     for s in serve_core.status()],
+    }
+
+
+# The gather/render steps do blocking sqlite + pickle work — run them on
+# the default executor so one slow read never stalls the event loop.
+async def index(request: web.Request) -> web.Response:
+    del request
+    import asyncio
+    page = await asyncio.get_running_loop().run_in_executor(
+        None, _render_page)
+    return web.Response(text=page, content_type='text/html')
+
+
+async def api_state(request: web.Request) -> web.Response:
+    """JSON view of the same state (for tooling)."""
+    del request
+    import asyncio
+    data = await asyncio.get_running_loop().run_in_executor(
+        None, _gather_state)
+    return web.json_response(data)
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get('/', index)
+    app.router.add_get('/api/state', api_state)
+    return app
+
+
+DEFAULT_PORT = 8265
+
+
+def run(port: int = DEFAULT_PORT) -> None:
+    print(f'Dashboard: http://127.0.0.1:{port}')
+    web.run_app(make_app(), port=port, print=None)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args(argv)
+    run(args.port)
+
+
+if __name__ == '__main__':
+    main()

@@ -13,6 +13,7 @@ from skypilot_tpu import state
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.backends import tpu_backend
+from skypilot_tpu.usage import usage_lib
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
@@ -129,6 +130,7 @@ def _execute(
     return job_id
 
 
+@usage_lib.entrypoint
 def launch(
     task: Union['task_lib.Task', 'dag_lib.Dag'],
     cluster_name: Optional[str] = None,
@@ -154,6 +156,7 @@ def launch(
                     idle_minutes_to_autostop=idle_minutes_to_autostop)
 
 
+@usage_lib.entrypoint
 def exec(  # pylint: disable=redefined-builtin
     task: Union['task_lib.Task', 'dag_lib.Dag'],
     cluster_name: str,

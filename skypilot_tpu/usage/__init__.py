@@ -1,0 +1,5 @@
+"""Usage telemetry (reference: sky/usage/usage_lib.py)."""
+from skypilot_tpu.usage.usage_lib import entrypoint
+from skypilot_tpu.usage.usage_lib import messages
+
+__all__ = ['entrypoint', 'messages']

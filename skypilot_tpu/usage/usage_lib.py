@@ -1,0 +1,164 @@
+"""Usage telemetry: schema-versioned event reports.
+
+Reference: sky/usage/usage_lib.py (470 LoC) — `MessageToReport` (:42),
+`UsageMessageToReport` (:66), `_send_to_loki` (:296), the `entrypoint`
+decorator (:446) wrapping every public API call.
+
+Two deliberate differences from the reference:
+  * OFF by default (the reference is opt-out; privacy-first here): set
+    SKYT_USAGE_COLLECTION=1 and `usage.endpoint` in config to enable.
+  * Reports land as JSON lines in a local spool file; an enabled
+    endpoint POSTs the same JSON (best-effort, fire-and-forget thread).
+Everything else (run id, schema version, entrypoint name, duration,
+exception type) matches the reference's property set.
+"""
+import functools
+import json
+import os
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import skyt_config
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+_SCHEMA_VERSION = 1
+_RUN_ID = str(uuid.uuid4())
+
+
+def _enabled() -> bool:
+    return os.environ.get('SKYT_USAGE_COLLECTION', '0') == '1'
+
+
+def _spool_path() -> str:
+    from skypilot_tpu import state
+    return os.path.join(state.state_dir(), 'usage.jsonl')
+
+
+class MessageToReport:
+    """One schema-versioned usage record. Reference: :42."""
+
+    def __init__(self, entrypoint_name: str) -> None:
+        self.schema_version = _SCHEMA_VERSION
+        self.run_id = _RUN_ID
+        self.entrypoint = entrypoint_name
+        self.start_time = time.time()
+        self.duration_s: Optional[float] = None
+        self.exception: Optional[str] = None
+        self.extra: Dict[str, Any] = {}
+
+    def finish(self, exception: Optional[BaseException]) -> None:
+        self.duration_s = time.time() - self.start_time
+        if exception is not None:
+            # Type + sanitized last frame only — never user data/paths.
+            tb = traceback.extract_tb(exception.__traceback__)
+            last = tb[-1] if tb else None
+            self.exception = (
+                f'{type(exception).__name__}'
+                + (f'@{os.path.basename(last.filename)}:{last.lineno}'
+                   if last else ''))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            'schema_version': self.schema_version,
+            'run_id': self.run_id,
+            'entrypoint': self.entrypoint,
+            'start_time': self.start_time,
+            'duration_s': self.duration_s,
+            'exception': self.exception,
+            **self.extra,
+        }
+
+
+class _Messages:
+    """Ambient collector for the current entrypoint (reference keeps a
+    module-global `messages` the same way)."""
+
+    def __init__(self) -> None:
+        self._local = threading.local()
+
+    @property
+    def current(self) -> Optional[MessageToReport]:
+        return getattr(self._local, 'msg', None)
+
+    def set(self, msg: Optional[MessageToReport]) -> None:
+        self._local.msg = msg
+
+    def annotate(self, **kwargs: Any) -> None:
+        if self.current is not None:
+            self.current.extra.update(kwargs)
+
+
+messages = _Messages()
+
+
+# Rotate the spool before it grows unbounded: nothing drains it when no
+# endpoint is configured.
+_SPOOL_MAX_BYTES = 5 * 1024 * 1024
+
+
+def _report(msg: MessageToReport) -> None:
+    """Best-effort, catch-everything: this runs in the entrypoint
+    decorator's finally block — a telemetry error must never replace the
+    API call's real result or exception."""
+    try:
+        record = msg.to_json()
+        line = json.dumps(record, default=str)
+        path = _spool_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            if os.path.getsize(path) > _SPOOL_MAX_BYTES:
+                os.replace(path, path + '.1')
+        except OSError:
+            pass
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(line + '\n')
+        endpoint = skyt_config.get_nested(('usage', 'endpoint'))
+        if endpoint:
+            threading.Thread(target=_post, args=(endpoint, record),
+                             daemon=True).start()
+    except Exception:  # pylint: disable=broad-except
+        logger.debug('usage report failed', exc_info=True)
+
+
+def _post(endpoint: str, record: Dict[str, Any]) -> None:
+    try:
+        import requests
+        requests.post(endpoint, json=record, timeout=5)
+    except Exception:  # pylint: disable=broad-except
+        pass  # telemetry must never break the product
+
+
+def entrypoint(name_or_fn):
+    """Decorator recording one usage message per outermost API call.
+
+    Reference: usage_lib.entrypoint (:446)."""
+
+    def make(name):
+        def deco(fn):
+            @functools.wraps(fn)
+            def wrapped(*args, **kwargs):
+                if not _enabled() or messages.current is not None:
+                    return fn(*args, **kwargs)  # nested call: no-op
+                msg = MessageToReport(name)
+                messages.set(msg)
+                exc: Optional[BaseException] = None
+                try:
+                    return fn(*args, **kwargs)
+                except BaseException as e:
+                    exc = e
+                    raise
+                finally:
+                    msg.finish(exc)
+                    messages.set(None)
+                    _report(msg)
+            return wrapped
+        return deco
+
+    if callable(name_or_fn):
+        return make(name_or_fn.__name__)(name_or_fn)
+    return make(name_or_fn)

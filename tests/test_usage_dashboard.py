@@ -1,0 +1,102 @@
+"""Usage telemetry + dashboard tests."""
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu import state
+from skypilot_tpu.usage import usage_lib
+
+
+@pytest.fixture()
+def usage_env(tmp_state_dir, monkeypatch):
+    monkeypatch.setenv('SKYT_USAGE_COLLECTION', '1')
+    yield tmp_state_dir
+
+
+def test_entrypoint_disabled_by_default(tmp_state_dir, monkeypatch):
+    monkeypatch.delenv('SKYT_USAGE_COLLECTION', raising=False)
+
+    @usage_lib.entrypoint
+    def my_api():
+        return 42
+
+    assert my_api() == 42
+    import os
+    assert not os.path.exists(usage_lib._spool_path())  # pylint: disable=protected-access
+
+
+def test_entrypoint_records_success_and_failure(usage_env):
+    @usage_lib.entrypoint
+    def good():
+        usage_lib.messages.annotate(foo='bar')
+        return 'ok'
+
+    @usage_lib.entrypoint('named_api')
+    def bad():
+        raise ValueError('boom')
+
+    assert good() == 'ok'
+    with pytest.raises(ValueError):
+        bad()
+
+    with open(usage_lib._spool_path(), encoding='utf-8') as f:  # pylint: disable=protected-access
+        records = [json.loads(l) for l in f]
+    assert len(records) == 2
+    ok_rec = records[0]
+    assert ok_rec['entrypoint'] == 'good'
+    assert ok_rec['exception'] is None
+    assert ok_rec['duration_s'] >= 0
+    assert ok_rec['foo'] == 'bar'
+    bad_rec = records[1]
+    assert bad_rec['entrypoint'] == 'named_api'
+    assert bad_rec['exception'].startswith('ValueError')
+
+
+def test_nested_entrypoints_report_once(usage_env):
+    @usage_lib.entrypoint
+    def inner():
+        return 1
+
+    @usage_lib.entrypoint
+    def outer():
+        return inner() + 1
+
+    assert outer() == 2
+    with open(usage_lib._spool_path(), encoding='utf-8') as f:  # pylint: disable=protected-access
+        records = [json.loads(l) for l in f]
+    assert [r['entrypoint'] for r in records] == ['outer']
+
+
+@pytest.mark.integration
+def test_dashboard_serves_state(tmp_state_dir):
+    from aiohttp import web
+
+    from skypilot_tpu import dashboard as dashboard_lib
+
+    state.reset_db_for_testing()
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+
+    th = threading.Thread(
+        target=lambda: web.run_app(dashboard_lib.make_app(), port=port,
+                                   print=None, handle_signals=False),
+        daemon=True)
+    th.start()
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 20
+    resp = None
+    while time.time() < deadline:
+        try:
+            resp = requests.get(base + '/', timeout=2)
+            break
+        except requests.RequestException:
+            time.sleep(0.2)
+    assert resp is not None and resp.status_code == 200
+    assert 'skypilot-tpu' in resp.text
+    api = requests.get(base + '/api/state', timeout=5).json()
+    assert set(api) == {'clusters', 'jobs', 'services'}
