@@ -1,13 +1,16 @@
 """Benchmark: flagship-model training throughput on the local accelerator.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "extra_metrics": [...]}
 
 On the TPU (1 chip, v5e): Llama-1B-shaped bf16 train step; reports model
 FLOPs utilization (MFU). Baseline = 0.45 MFU, the BASELINE.json north-star
 target for Llama-3.1-8B SFT on v5e-16 (tokens/sec/chip is printed to stderr
-as auxiliary context). On CPU the same harness runs a debug model so the
-script never hard-fails in smoke environments.
+as auxiliary context). extra_metrics carries the serving benchmark
+(p50 TTFT + decode tok/s/chip on the continuous-batching engine,
+BASELINE.md's serve row; baseline 500ms TTFT). On CPU the same harness
+runs a debug model so the script never hard-fails in smoke environments.
 """
 import dataclasses
 import json
@@ -16,9 +19,9 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 BASELINE_MFU = 0.45
+BASELINE_TTFT_MS = 500.0  # BASELINE.json: 70B serve p50 TTFT < 500ms
 
 PEAK_FLOPS = {  # bf16 peak per chip
     'TPU v5 lite': 197e12,
@@ -34,6 +37,36 @@ def _peak_flops(device) -> float:
         if kind.startswith(prefix):
             return flops
     return 1e12  # unknown / CPU: nominal
+
+
+def serve_metrics(on_tpu: bool) -> list:
+    """Serving TTFT/throughput on the continuous-batching engine
+    (BASELINE.md serve row). Random weights: latency is shape-bound."""
+    from skypilot_tpu.benchmark import serve_bench
+
+    if on_tpu:
+        scfg = serve_bench.ServeBenchConfig(
+            model='llama3-1b', prompt_len=512, max_new_tokens=64,
+            num_requests=16, num_slots=8, max_seq_len=1024,
+            decode_chunk=32)
+    else:
+        scfg = serve_bench.ServeBenchConfig(
+            model='debug', prompt_len=16, max_new_tokens=8,
+            num_requests=4, num_slots=2, max_seq_len=64)
+    r = serve_bench.run_serve_bench(scfg)
+    print(f'# serve: p50_ttft={r["p50_ttft_ms"]:.1f}ms '
+          f'p99_ttft={r["p99_ttft_ms"]:.1f}ms '
+          f'decode={r["decode_tok_per_sec"]:,.0f} tok/s',
+          file=sys.stderr)
+    return [
+        {'metric': 'serve_p50_ttft_ms_llama1b_1chip',
+         'value': round(r['p50_ttft_ms'], 1), 'unit': 'ms',
+         'vs_baseline': round(BASELINE_TTFT_MS / max(r['p50_ttft_ms'],
+                                                     1e-3), 4)},
+        {'metric': 'serve_decode_tok_per_sec_per_chip',
+         'value': round(r['decode_tok_per_sec'], 1),
+         'unit': 'tok/s/chip', 'vs_baseline': None},
+    ]
 
 
 def main() -> None:
@@ -118,11 +151,19 @@ def main() -> None:
           f'tokens/sec/chip={tokens_per_sec:,.0f} '
           f'step_time={dt/steps*1000:.1f}ms loss={float(metrics["loss"]):.3f}',
           file=sys.stderr)
+
+    try:
+        extra = serve_metrics(on_tpu)
+    except Exception as e:  # pylint: disable=broad-except
+        print(f'# serve bench failed: {e!r}', file=sys.stderr)
+        extra = []
+
     print(json.dumps({
         'metric': 'train_mfu_llama1b_1chip',
         'value': round(mfu, 4),
         'unit': 'MFU',
         'vs_baseline': round(mfu / BASELINE_MFU, 4),
+        'extra_metrics': extra,
     }))
 
 

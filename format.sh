@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Lint + syntax gate (reference: format.sh running black/isort/mypy/
+# pylint). The image ships none of those, so this runs the offline
+# equivalents: compileall (syntax across the tree) + tools/lint.py
+# (unused imports, whitespace, line length).
+set -e
+cd "$(dirname "$0")"
+python -m compileall -q skypilot_tpu tests tools bench.py __graft_entry__.py
+python tools/lint.py "$@"
+echo "format.sh: clean"

@@ -61,7 +61,7 @@ class TpuTopology:
     (sky/backends/cloud_vm_ray_backend.py:361).
     """
     generation: TpuGeneration
-    size: int            # the number in the name (cores for v2-v4/v5p, chips for v5e/v6e)
+    size: int            # number in the name (cores v2-v4/v5p, chips v5e/v6e)
     chips: int           # total chips in the slice
     num_hosts: int       # host VMs in the slice
     chips_per_host: int

@@ -1,0 +1,109 @@
+"""SSH key lifecycle: generate + load the framework keypair.
+
+Reference: sky/authentication.py:1-120 generates ~/.ssh/sky-key once and
+injects the public half per cloud. Here the key is ~/.ssh/skyt-key
+(ed25519 via the system ssh-keygen; RSA via the cryptography package as
+a fallback), and injection happens through TPU-VM node metadata
+(provision/gcp/tpu_api.py ssh-keys) — no per-cloud registration quirks
+needed for the TPU-first cloud set.
+
+First-run UX: everything that needs a key calls get_or_generate_keypair()
+— a fresh machine with an empty ~/.ssh works without manual setup.
+"""
+import functools
+import os
+import subprocess
+from typing import Optional, Tuple
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+PRIVATE_KEY_PATH = '~/.ssh/skyt-key'
+PUBLIC_KEY_PATH = '~/.ssh/skyt-key.pub'
+_KEY_COMMENT = 'skypilot-tpu'
+
+
+def _expand(path: str) -> str:
+    return os.path.expanduser(path)
+
+
+def _generate_ssh_keygen(private_path: str) -> bool:
+    try:
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+             '-f', private_path, '-C', _KEY_COMMENT],
+            check=True, capture_output=True)
+        return True
+    except (OSError, subprocess.CalledProcessError) as e:
+        logger.debug('ssh-keygen unavailable/failed: %r', e)
+        return False
+
+
+def _generate_cryptography(private_path: str) -> bool:
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+    except ImportError:
+        return False
+    key = ed25519.Ed25519PrivateKey.generate()
+    pem = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption())
+    pub = key.public_key().public_bytes(
+        encoding=serialization.Encoding.OpenSSH,
+        format=serialization.PublicFormat.OpenSSH)
+    with open(private_path, 'wb', opener=functools.partial(
+            os.open, mode=0o600)) as f:
+        f.write(pem)
+    with open(private_path + '.pub', 'w', encoding='utf-8') as f:
+        f.write(pub.decode() + f' {_KEY_COMMENT}\n')
+    return True
+
+
+def get_or_generate_keypair() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_str); generates the pair
+    under ~/.ssh on first use (reference: sky/authentication.py
+    _generate_rsa_key_pair + get_or_generate_keys)."""
+    private = _expand(PRIVATE_KEY_PATH)
+    public = _expand(PUBLIC_KEY_PATH)
+    if not (os.path.exists(private) and os.path.exists(public)):
+        ssh_dir = os.path.dirname(private)
+        os.makedirs(ssh_dir, mode=0o700, exist_ok=True)
+        # Clear a half-present pair before regenerating.
+        for p in (private, public):
+            if os.path.exists(p):
+                os.remove(p)
+        if not _generate_ssh_keygen(private):
+            if not _generate_cryptography(private):
+                raise RuntimeError(
+                    'cannot generate an SSH keypair: neither ssh-keygen '
+                    'nor the cryptography package is available; create '
+                    f'{PRIVATE_KEY_PATH} manually')
+        os.chmod(private, 0o600)
+        logger.info('generated SSH keypair at %s', private)
+    with open(public, 'r', encoding='utf-8') as f:
+        return private, f.read().strip()
+
+
+def public_key(generate: bool = True) -> Optional[str]:
+    """The framework public key; pre-existing user keys are honored
+    first so an operator's own identity keeps working."""
+    for name in ('skyt-key.pub', 'id_ed25519.pub', 'id_rsa.pub'):
+        path = _expand(f'~/.ssh/{name}')
+        if os.path.exists(path):
+            with open(path, 'r', encoding='utf-8') as f:
+                return f.read().strip()
+    if not generate:
+        return None
+    return get_or_generate_keypair()[1]
+
+
+def private_key_path() -> Optional[str]:
+    """Matching private key for whichever public key public_key() used."""
+    for name in ('skyt-key', 'id_ed25519', 'id_rsa'):
+        path = _expand(f'~/.ssh/{name}')
+        if os.path.exists(path) and os.path.exists(path + '.pub'):
+            return path
+    return None

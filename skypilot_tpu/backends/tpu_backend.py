@@ -452,9 +452,11 @@ def _default_runtime_version(res: resources_lib.Resources) -> str:
 
 
 def _public_key() -> Optional[str]:
-    for name in ('skyt-key.pub', 'id_ed25519.pub', 'id_rsa.pub'):
-        path = os.path.expanduser(f'~/.ssh/{name}')
-        if os.path.exists(path):
-            with open(path, 'r', encoding='utf-8') as f:
-                return f.read().strip()
-    return None
+    """Framework/user public key; generates ~/.ssh/skyt-key on a fresh
+    machine (reference: sky/authentication.py)."""
+    from skypilot_tpu import authentication
+    try:
+        return authentication.public_key(generate=True)
+    except RuntimeError as e:
+        logger.warning('%s', e)
+        return None

@@ -1,0 +1,113 @@
+"""Serving latency/throughput benchmark: p50 TTFT + decode tok/s/chip.
+
+Measures the BASELINE.md serving metrics (p50 TTFT <500ms target for the
+70B on a v5e slice; here sized to the local device count) against the
+in-process continuous-batching engine — no HTTP in the loop, so the
+number is the engine's, not aiohttp's. The reference's analog is vLLM's
+own benchmark_serving.py driven over a SkyServe endpoint.
+
+TTFT here = submit -> first sampled token (prefill + queue wait), the
+same definition the serve layer's probe-to-first-chunk sees minus network.
+"""
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ServeBenchConfig:
+    model: str = 'llama3-1b'
+    prompt_len: int = 512
+    max_new_tokens: int = 64
+    num_requests: int = 16
+    num_slots: int = 8
+    max_seq_len: int = 1024
+    decode_chunk: int = 16
+    tp: int = 1
+
+
+def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
+                    engine=None) -> Dict[str, float]:
+    """Two phases:
+
+    1. Unloaded TTFT: sequential single requests; p50/p99 of
+       submit -> first token (pure prefill + one dispatch). This is the
+       SLO number — load-dependent queue wait is a capacity question,
+       not a latency one.
+    2. Saturated throughput: a burst of num_requests; total generated
+       tokens / wall time = decode tok/s at full continuous batching,
+       plus the p50 TTFT under that burst (reported separately).
+    """
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+
+    cfg = cfg or ServeBenchConfig()
+    own_engine = engine is None
+    if own_engine:
+        engine = server_lib.build_engine(
+            cfg.model, cfg.num_slots, cfg.max_seq_len,
+            tp=cfg.tp, decode_chunk=cfg.decode_chunk)
+        engine.start()
+
+    rng = np.random.default_rng(0)
+    vocab = engine.cfg.vocab_size
+
+    def one_prompt() -> List[int]:
+        return rng.integers(1, vocab, cfg.prompt_len).tolist()
+
+    def drain(pairs):
+        """pairs: [(t_submit, queue)]; returns (ttfts, total_tokens)."""
+        ttfts, total = [], 0
+        for t_submit, q in pairs:
+            first = True
+            while True:
+                tok = q.get(timeout=600)
+                if tok is None:
+                    break
+                if first:
+                    ttfts.append(time.perf_counter() - t_submit)
+                    first = False
+                total += 1
+        return ttfts, total
+
+    try:
+        # Warmup: compile the prompt's prefill bucket + greedy decode
+        # chunk outside the timing.
+        engine.warmup(buckets=[engine._bucket_for(cfg.prompt_len)])
+
+        # Phase 1: unloaded TTFT, sequential.
+        n_seq = min(cfg.num_requests, 8)
+        ttfts = []
+        for _ in range(n_seq):
+            params = engine_lib.SamplingParams(max_new_tokens=1)
+            t0 = time.perf_counter()
+            _, q = engine.submit(one_prompt(), params)
+            t, _ = drain([(t0, q)])
+            ttfts.extend(t)
+
+        # Phase 2: saturated burst.
+        submitted = []
+        t_start = time.perf_counter()
+        for _ in range(cfg.num_requests):
+            params = engine_lib.SamplingParams(
+                max_new_tokens=cfg.max_new_tokens)
+            _, q = engine.submit(one_prompt(), params)
+            submitted.append((time.perf_counter(), q))
+        loaded_ttfts, total_tokens = drain(submitted)
+        t_total = time.perf_counter() - t_start
+    finally:
+        if own_engine:
+            engine.stop()
+
+    ttfts_ms = np.asarray(sorted(ttfts)) * 1000.0
+    loaded_ms = np.asarray(sorted(loaded_ttfts)) * 1000.0
+    return {
+        'p50_ttft_ms': float(np.percentile(ttfts_ms, 50)),
+        'p99_ttft_ms': float(np.percentile(ttfts_ms, 99)),
+        'p50_ttft_loaded_ms': float(np.percentile(loaded_ms, 50)),
+        'decode_tok_per_sec': total_tokens / t_total,
+        'requests_per_sec': cfg.num_requests / t_total,
+        'total_time_s': t_total,
+    }

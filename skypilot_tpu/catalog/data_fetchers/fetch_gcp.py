@@ -1,20 +1,32 @@
 """Regenerate the GCP catalog CSV.
 
 Reference analog: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py,
-which scrapes the GCP pricing/SKU APIs. This environment has no network
-egress, so the default (and only implemented) mode emits a pinned static
-table of public list prices (USD/hour, as of 2025) for the TPU types,
-GPU VMs and CPU VMs the framework targets. When egress exists, wire
-`--from-api` to the Cloud Billing Catalog API (services/6F81-5844-456A).
+which scrapes the GCP pricing/SKU APIs. Two modes:
 
-TPU pricing is PER CHIP per hour; slice price = chips x chip price. Rows are
-emitted per (accelerator, zone) for the slice sizes users actually request so
-the optimizer can compare availability across zones without arithmetic at
-query time.
+* static (default): a pinned table of public list prices (USD/hour, as
+  of 2025) — works with zero egress, and is the offline fallback.
+* --from-api: refresh per-chip TPU prices from the Cloud Billing
+  Catalog API (the reference's data source), keeping the static tables
+  for slice shapes and zone lists — SKUs carry prices per region, not
+  zone topology. Requires an API key (--api-key / GCP_API_KEY) and
+  egress; falls back to the static prices for anything the SKU scan
+  doesn't cover.
+
+TPU pricing is PER CHIP per hour; slice price = chips x chip price.
+
+Rows are emitted per (accelerator, zone) for the slice sizes users
+actually request so the optimizer can compare availability across zones
+without arithmetic at query time.
 """
 import argparse
 import csv
 import os
+import re
+from typing import Dict, Iterator, Optional, Tuple
+
+BILLING_API = 'https://cloudbilling.googleapis.com/v1'
+# Cloud TPU SKUs live under the Compute Engine service.
+COMPUTE_SERVICE = '6F81-5844-456A'
 
 # accelerator family -> (per-chip $/h on-demand, per-chip $/h spot, zones)
 TPU_OFFERINGS = {
@@ -78,7 +90,9 @@ HEADER = ['InstanceType', 'AcceleratorName', 'AcceleratorCount', 'vCPUs',
           'MemoryGiB', 'Region', 'AvailabilityZone', 'Price', 'SpotPrice']
 
 
-def emit_static(out_path: str) -> int:
+def _emit(out_path: str, tpu_zone_prices=None) -> int:
+    """tpu_zone_prices: optional {gen: {zone: (chip_price, chip_spot)}}
+    overriding the static per-chip prices (the --from-api path)."""
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..',
                                     '..'))
@@ -92,10 +106,14 @@ def emit_static(out_path: str) -> int:
                 topo = acc_lib.parse_tpu(name)
             except Exception:
                 continue
-            slice_price = round(topo.chips * price, 4)
-            slice_spot = round(topo.chips * spot, 4)
             spot_ok = topo.generation.supports_spot
             for zone in zones:
+                chip_p, chip_s = price, spot
+                if tpu_zone_prices and zone in tpu_zone_prices.get(
+                        gen, {}):
+                    chip_p, chip_s = tpu_zone_prices[gen][zone]
+                slice_price = round(topo.chips * chip_p, 4)
+                slice_spot = round(topo.chips * chip_s, 4)
                 region = region_from_zone(zone)
                 rows.append([
                     name, name, 1,
@@ -121,12 +139,114 @@ def emit_static(out_path: str) -> int:
     return len(rows)
 
 
+# ------------------------------------------------------- live API mode
+def iter_skus(api_key: str, service: str = COMPUTE_SERVICE,
+              session=None) -> Iterator[Dict]:
+    """Page through the Cloud Billing Catalog SKU list (reference:
+    fetch_gcp.py's pricing pull; this is the public, key-auth API)."""
+    if session is None:
+        import requests
+        session = requests.Session()
+    token = None
+    while True:
+        params = {'key': api_key, 'pageSize': 5000}
+        if token:
+            params['pageToken'] = token
+        resp = session.get(f'{BILLING_API}/services/{service}/skus',
+                           params=params, timeout=30)
+        resp.raise_for_status()
+        payload = resp.json()
+        yield from payload.get('skus', [])
+        token = payload.get('nextPageToken')
+        if not token:
+            return
+
+
+_TPU_DESC = re.compile(r'\bTpu[- ]?(v\d+[ep]?)\b', re.IGNORECASE)
+
+
+def _sku_unit_price(sku: Dict) -> Optional[float]:
+    try:
+        rate = sku['pricingInfo'][0]['pricingExpression']
+        tier = rate['tieredRates'][-1]['unitPrice']
+        return int(tier.get('units', 0)) + tier.get('nanos', 0) / 1e9
+    except (KeyError, IndexError, TypeError, ValueError):
+        return None
+
+
+def tpu_chip_prices(skus) -> Dict[Tuple[str, str, bool], float]:
+    """{(generation, region, is_spot): per-chip $/h} from a SKU scan.
+
+    Matches descriptions like 'Tpu v5e hourly' / 'Preemptible Tpu v4
+    pod' — per-chip-hour usage units — skipping committed-use SKUs.
+    """
+    out: Dict[Tuple[str, str, bool], float] = {}
+    for sku in skus:
+        desc = sku.get('description', '')
+        m = _TPU_DESC.search(desc)
+        if not m:
+            continue
+        if 'Commitment' in desc or sku.get('category', {}).get(
+                'usageType') == 'Commit1Yr':
+            continue
+        gen = m.group(1).lower()
+        spot = sku.get('category', {}).get('usageType') == 'Preemptible' \
+            or 'preemptible' in desc.lower() or 'spot' in desc.lower()
+        price = _sku_unit_price(sku)
+        if price is None or price <= 0:
+            continue
+        for region in sku.get('serviceRegions', []):
+            key = (gen, region, spot)
+            # Keep the cheapest matching SKU per key (some regions list
+            # multiple, e.g. pod vs single-host; prices match per chip).
+            if key not in out or price < out[key]:
+                out[key] = price
+    return out
+
+
+def emit_from_api(out_path: str, api_key: str, session=None) -> int:
+    """Static tables for shapes/zones; live per-chip prices where the
+    SKU scan covers a (generation, region)."""
+    from skypilot_tpu.utils.common_utils import region_from_zone
+
+    live = tpu_chip_prices(iter_skus(api_key, session=session))
+    updated = {}
+    for gen, (price, spot, zones) in TPU_OFFERINGS.items():
+        by_zone = {}
+        for zone in zones:
+            region = region_from_zone(zone)
+            p = live.get((gen, region, False), price)
+            s = live.get((gen, region, True), spot)
+            by_zone[zone] = (p, s)
+        updated[gen] = by_zone
+    return _emit(out_path, tpu_zone_prices=updated)
+
+
+def emit_static(out_path: str) -> int:
+    return _emit(out_path, tpu_zone_prices=None)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--out', default=os.path.join(
         os.path.dirname(__file__), '..', 'data', 'gcp.csv'))
+    parser.add_argument('--from-api', action='store_true',
+                        help='refresh TPU prices from the Cloud Billing '
+                             'Catalog API (needs egress + API key)')
+    parser.add_argument('--api-key',
+                        default=os.environ.get('GCP_API_KEY'))
     args = parser.parse_args()
-    n = emit_static(args.out)
+    if args.from_api:
+        if not args.api_key:
+            raise SystemExit('--from-api needs --api-key or GCP_API_KEY')
+        try:
+            n = emit_from_api(args.out, args.api_key)
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'API fetch failed ({e!r}); falling back to static '
+                  f'tables')
+            n = emit_static(args.out)
+    else:
+        n = emit_static(args.out)
     print(f'Wrote {n} rows to {args.out}')
 
 

@@ -103,9 +103,12 @@ def start(cluster_name: str, retry_until_up: bool = False) -> None:
 
 @usage_lib.entrypoint
 def stop(cluster_name: str) -> None:
-    """Reference: sky/core.py:317 stop. TPU pod slices cannot stop
-    (provider raises); single-host TPU VMs can."""
+    """Reference: sky/core.py:317 stop. TPU pod slices cannot stop —
+    preemption/stop semantics for queued resources are delete-only — so
+    this is blocked up front via the cloud capability check, exactly as
+    the reference blocks it (sky/clouds/gcp.py:184-190)."""
     handle = _handle_or_raise(cluster_name)
+    _check_stoppable(handle, 'stop')
     _backend().teardown(handle, terminate=False)
 
 
@@ -119,9 +122,29 @@ def down(cluster_name: str, purge: bool = False) -> None:
 @usage_lib.entrypoint
 def autostop(cluster_name: str, idle_minutes: int,
              down: bool = False) -> None:  # pylint: disable=redefined-outer-name
-    """Reference: sky/core.py:408 autostop. idle_minutes < 0 cancels."""
+    """Reference: sky/core.py:408 autostop. idle_minutes < 0 cancels.
+
+    `down=False` on an unstoppable cluster (multi-host TPU slice) is
+    rejected — only autodown is meaningful there."""
     handle = _handle_or_raise(cluster_name)
+    if idle_minutes >= 0 and not down:
+        _check_stoppable(handle, 'autostop (use --down)')
     _backend().set_autostop(handle, idle_minutes, down)
+
+
+def _check_stoppable(handle, op: str) -> None:
+    from skypilot_tpu import clouds as clouds_lib
+    res = handle.launched_resources
+    try:
+        cloud = clouds_lib.Cloud.from_name(res.cloud)
+    except exceptions.InvalidResourcesError:
+        return
+    if hasattr(cloud, 'supports_stopping') and \
+            not cloud.supports_stopping(res):
+        raise exceptions.NotSupportedError(
+            f'{op}: {res.accelerator_name or res.cloud} clusters cannot '
+            f'be stopped (multi-host TPU slices are delete-only; use '
+            f'`skyt down`).')
 
 
 # -------------------------------------------------------------------- jobs

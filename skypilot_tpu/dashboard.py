@@ -151,16 +151,19 @@ def make_app() -> web.Application:
 DEFAULT_PORT = 8265
 
 
-def run(port: int = DEFAULT_PORT) -> None:
-    print(f'Dashboard: http://127.0.0.1:{port}')
-    web.run_app(make_app(), port=port, print=None)
+def run(port: int = DEFAULT_PORT, host: str = '127.0.0.1') -> None:
+    # Loopback by default: the dashboard exposes cluster state with no
+    # auth; pass host='0.0.0.0' explicitly to share it.
+    print(f'Dashboard: http://{host}:{port}')
+    web.run_app(make_app(), host=host, port=port, print=None)
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    parser.add_argument('--host', default='127.0.0.1')
     args = parser.parse_args(argv)
-    run(args.port)
+    run(args.port, args.host)
 
 
 if __name__ == '__main__':

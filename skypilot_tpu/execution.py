@@ -4,7 +4,7 @@ Reference: sky/execution.py — Stage enum (:31), _execute (:95, stage walk
 :270-320), launch (:347), exec (:480 — skips provision/setup stages).
 """
 import enum
-from typing import Any, List, Optional, Union
+from typing import List, Optional, Union
 
 from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
@@ -80,8 +80,10 @@ def _execute(
             plans = optimizer_lib.Optimizer.plan_for_task(
                 task, minimize=optimize_target)
             if not plans:
+                _, hints = optimizer_lib._fill_in_launchable_plans(task)
+                hint_txt = ('\n  ' + '\n  '.join(hints)) if hints else ''
                 raise exceptions.ResourcesUnavailableError(
-                    f'No launchable resources for {task!r}')
+                    f'No launchable resources for {task!r}.{hint_txt}')
             to_provision = plans[0]
             if not quiet_optimizer:
                 logger.info(

@@ -16,6 +16,7 @@ the engine itself first-class, JetStream-style:
 TTFT = prefill latency + queue wait, the p50 target BASELINE.md sets for
 serving. greedy/temperature/top-k sampling.
 """
+import contextlib
 import dataclasses
 import queue
 import threading
@@ -70,10 +71,22 @@ class InferenceEngine:
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
-                 decode_chunk: int = 16) -> None:
+                 decode_chunk: int = 16,
+                 mesh=None, rules=None) -> None:
+        """mesh: optional jax.sharding.Mesh — the engine then runs
+        tp-sharded: params must already carry their NamedShardings
+        (models/weights.py load_llama_params/shard_params) and the KV
+        cache is sharded over the tp axis on kv_heads. This is how a
+        model larger than one chip's HBM serves (the reference's
+        --tensor-parallel-size, llm/vllm/serve.yaml)."""
         self.model = model
         self.cfg = model.cfg
         self.params = params
+        self.mesh = mesh
+        if rules is None:
+            from skypilot_tpu.parallel import sharding as sharding_lib
+            rules = sharding_lib.DEFAULT_RULES
+        self.rules = list(rules)
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len or self.cfg.max_seq_len
         # Tokens generated per device dispatch: the host pulls one
@@ -89,8 +102,22 @@ class InferenceEngine:
         dtype = jnp.dtype(self.cfg.dtype)
         shape = (self.cfg.n_layers, num_slots, self.max_seq_len,
                  self.cfg.n_kv_heads, self.cfg.head_dim)
-        self.cache = {'k': jnp.zeros(shape, dtype),
-                      'v': jnp.zeros(shape, dtype)}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            tp = mesh.shape.get('tp', 1)
+            # Shard the cache over tp on kv_heads (matching the model's
+            # 'act_kv_heads' constraint); replicate if tp doesn't divide.
+            kv_axis = 'tp' if tp > 1 and \
+                self.cfg.n_kv_heads % tp == 0 else None
+            cache_sharding = NamedSharding(
+                mesh, P(None, None, None, kv_axis, None))
+            with self._ctx():
+                self.cache = {
+                    'k': jnp.zeros(shape, dtype, device=cache_sharding),
+                    'v': jnp.zeros(shape, dtype, device=cache_sharding)}
+        else:
+            self.cache = {'k': jnp.zeros(shape, dtype),
+                          'v': jnp.zeros(shape, dtype)}
         # Host-side slot table.
         self._slots: List[Optional[_Request]] = [None] * num_slots
         self._lengths = np.zeros((num_slots,), np.int32)
@@ -99,6 +126,10 @@ class InferenceEngine:
         self._topks = np.zeros((num_slots,), np.int32)
         self._keys = np.zeros((num_slots, 2), np.uint32)
         self._waiting: 'queue.Queue[_Request]' = queue.Queue()
+        # Device-resident decode args (last, lens, temps, keys, topks);
+        # rebuilt from the host mirrors only after an admission touches
+        # them — otherwise every chunk would pay H2D transfer latency.
+        self._dev_args = None
         self._next_id = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -111,9 +142,21 @@ class InferenceEngine:
         # copy every decode step (hundreds of MB at 8 slots x 2k ctx).
         self._jit_decode_n = jax.jit(self._decode_n_impl,
                                      donate_argnums=(1,),
-                                     static_argnames=('n',))
+                                     static_argnames=('n', 'sampling'))
         self._jit_insert = jax.jit(self._insert_impl,
                                    donate_argnums=(0,))
+
+    def _ctx(self):
+        """Ambient mesh + flax logical axis rules for every device call
+        (no-op off-mesh). The model's nn.with_logical_constraint calls
+        only bind when these are active."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        import flax.linen as nn
+        stack = contextlib.ExitStack()
+        stack.enter_context(self.mesh)
+        stack.enter_context(nn.logical_axis_rules(self.rules))
+        return stack
 
     # ------------------------------------------------------------ jitted
     def _prefill_impl(self, params, tokens, length, bucket):
@@ -127,11 +170,12 @@ class InferenceEngine:
         dtype = jnp.dtype(self.cfg.dtype)
         cache = {'k': jnp.zeros(shape, dtype),
                  'v': jnp.zeros(shape, dtype)}
-        logits, new_cache = self.model.apply(params, tokens,
-                                             positions=positions,
-                                             cache=cache)
-        last = jax.vmap(lambda l, i: l[i])(logits, length - 1)
-        return last, new_cache
+        # Logits only at the prompt's last token (128k-vocab lm_head over
+        # every prompt position would be ~20% of prefill FLOPs, unused).
+        logits, new_cache = self.model.apply(
+            params, tokens, positions=positions, cache=cache,
+            logit_positions=(length - 1)[:, None])
+        return logits[:, 0, :], new_cache
 
     def _insert_impl(self, cache, prefill_cache, slot):
         """Copy a prefill cache (B=1, S=bucket) into `slot` of the global
@@ -142,12 +186,17 @@ class InferenceEngine:
         return jax.tree.map(upd, cache, prefill_cache)
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
-                       keys, topks, n):
+                       keys, topks, n, sampling):
         """Generate `n` tokens per slot in ONE dispatch: a device-side
         lax.scan of decode steps with on-device sampling (greedy when
         temps[i] == 0, else temperature categorical). The host pulls one
         [n, SLOTS] token batch per round trip — decode stays
         compute-bound even when dispatch/transfer latency is tens of ms.
+
+        `sampling` is static: the greedy-only variant compiles without
+        the top-k sort / categorical / rng-split ops — top_k over a 128k
+        vocab costs several ms/step on TPU, pure overhead when every
+        active request is greedy (the common serving case).
         Returns (tokens [n, SLOTS], new_cache, new_keys)."""
 
         def step(carry, _):
@@ -157,6 +206,8 @@ class InferenceEngine:
                                              cache=cache)
             logits = logits[:, 0, :].astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if not sampling:
+                return (cache, greedy, lens + 1, keys), greedy
             keys = jax.vmap(jax.random.split, in_axes=0,
                             out_axes=0)(keys)[:, 0]
             # Per-slot top-k (k <= _TOPK_BUCKET) via a fixed top-k sort +
@@ -175,9 +226,11 @@ class InferenceEngine:
             tok = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
             return (cache, tok, lens + 1, keys), tok
 
-        (cache, _, _, keys), toks = jax.lax.scan(
+        (cache, last, lens, keys), toks = jax.lax.scan(
             step, (cache, last_tokens, lengths, keys), None, length=n)
-        return toks, cache, keys
+        # last/lens returned device-resident so the next chunk's call
+        # needs no host->device transfers in the steady state.
+        return toks, cache, keys, last, lens
 
     # ----------------------------------------------------------- sampling
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
@@ -236,6 +289,31 @@ class InferenceEngine:
         if self._thread:
             self._thread.join(timeout=10)
 
+    def warmup(self, buckets: Optional[List[int]] = None) -> None:
+        """Pre-compile prefill (per bucket), cache insert, and the greedy
+        decode chunk by running real dummy requests through the engine —
+        so the first user request after /health goes green pays no
+        compile (TTFT SLO). Call before or after start(); runs the loop
+        inline when the engine thread isn't up yet."""
+        started = self._thread is not None and self._thread.is_alive()
+        if not started:
+            self.start()
+        try:
+            for b in buckets or self.prefill_buckets:
+                if b >= self.max_seq_len:
+                    continue
+                n_new = min(self.decode_chunk,
+                            self.max_seq_len - 1 - b)
+                if n_new < 1:
+                    continue
+                self.generate([1] * b,
+                              SamplingParams(max_new_tokens=n_new))
+        finally:
+            if not started:
+                self.stop()
+                self._stop.clear()
+                self._thread = None
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             active = sum(1 for s in self._slots if s is not None)
@@ -260,11 +338,12 @@ class InferenceEngine:
         bucket = self._bucket_for(n)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :n] = req.tokens
-        logits, prefill_cache = self._jit_prefill(
-            self.params, jnp.asarray(padded), jnp.asarray([n]),
-            bucket=bucket)
-        # Trim/pad the prefill cache S axis into the global cache.
-        self.cache = self._insert_cache(prefill_cache, slot)
+        with self._ctx():
+            logits, prefill_cache = self._jit_prefill(
+                self.params, jnp.asarray(padded), jnp.asarray([n]),
+                bucket=bucket)
+            # Trim/pad the prefill cache S axis into the global cache.
+            self.cache = self._insert_cache(prefill_cache, slot)
         first = self._sample(np.asarray(logits)[0], req)
         req.first_token_at = time.time()
         req.slot = slot
@@ -277,6 +356,7 @@ class InferenceEngine:
         self._topks[slot] = min(req.params.top_k, _TOPK_BUCKET)
         self._keys[slot] = np.asarray(
             jax.random.PRNGKey(req.params.seed + req.req_id))
+        self._dev_args = None  # decode args changed; re-upload once
         if self._req_done(req, first):
             self._release(slot)
         return True
@@ -338,29 +418,39 @@ class InferenceEngine:
                 if not admitted:
                     time.sleep(0.002)
                 continue
-            # Chunk size: bounded by the smallest remaining token budget
-            # among active requests (no wasted compute past completion)
-            # and by remaining cache space.
-            rem_budget = min(self._slots[i].params.max_new_tokens -
-                             self._slots[i].generated for i in active)
+            # Chunk size: the configured chunk, capped by remaining cache
+            # space. Do NOT shrink to the smallest remaining token budget
+            # — each distinct n is a separate XLA compile (~seconds), so
+            # running the full chunk and discarding post-completion
+            # tokens host-side is far cheaper than a recompile ladder.
             rem_space = self.max_seq_len - 1 - int(
                 max(self._lengths[i] for i in active))
-            bound = max(1, min(self.decode_chunk, rem_budget, rem_space))
+            bound = max(1, min(self.decode_chunk, rem_space))
             # Quantize to a power of two: `n` is a static jit arg, so
             # arbitrary chunk values would each trigger a fresh compile.
             chunk = 1 << (bound.bit_length() - 1)
-            toks, self.cache, keys = self._jit_decode_n(
-                self.params, self.cache,
-                jnp.asarray(self._last_tokens),
-                jnp.asarray(self._lengths),
-                jnp.asarray(self._temps),
-                jnp.asarray(self._keys),
-                jnp.asarray(self._topks),
-                n=chunk)
+            sampling = any(self._temps[i] > 0 for i in active)
+            if self._dev_args is None:
+                self._dev_args = (jnp.asarray(self._last_tokens),
+                                  jnp.asarray(self._lengths),
+                                  jnp.asarray(self._temps),
+                                  jnp.asarray(self._keys),
+                                  jnp.asarray(self._topks))
+            d_last, d_lens, d_temps, d_keys, d_topks = self._dev_args
+            with self._ctx():
+                toks, self.cache, keys, d_last, d_lens = \
+                    self._jit_decode_n(
+                        self.params, self.cache, d_last, d_lens,
+                        d_temps, d_keys, d_topks,
+                        n=chunk, sampling=sampling)
+            self._dev_args = (d_last, d_lens, d_temps, keys, d_topks)
             toks_np = np.asarray(toks)        # [chunk, SLOTS]
-            # np.array (copy): np.asarray of a jax array is a read-only
-            # view, and _admit_one writes per-slot keys in place.
-            self._keys = np.array(keys)
+            if sampling:
+                # Mirror the advanced rng keys so the next admission's
+                # re-upload doesn't rewind other slots' streams.
+                # (np.array: asarray of a jax array is a read-only view,
+                # and _admit_one writes per-slot keys in place.)
+                self._keys = np.array(keys)
             pre_lengths = self._lengths.copy()
             self._lengths += chunk            # device advanced every slot
             self._last_tokens = toks_np[-1].copy()

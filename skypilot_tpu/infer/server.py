@@ -13,37 +13,43 @@ service.yaml readiness-probes /v1/models). Endpoints:
                             chunk latency).
   GET  /stats             — engine slot/queue stats.
 
-Run:  python -m skypilot_tpu.infer.server --model debug --port 8000
+Run:
+  # random-weight debug model, byte tokenizer:
+  python -m skypilot_tpu.infer.server --model debug --port 8000
+  # real checkpoint (HF dir: *.safetensors + config.json +
+  # tokenizer.json), tp-sharded over 4 chips:
+  python -m skypilot_tpu.infer.server --checkpoint /path/llama3-8b --tp 4
 
-Text uses the framework's byte-level fallback tokenizer (train/sft.py);
-pass pre-tokenized ids for real deployments.
+Reference parity: llm/vllm/serve.yaml:1-30 (vLLM --model ... behind a
+readiness-probed service).
 """
 import argparse
 import asyncio
 import functools
 import json
-from typing import List
+from typing import List, Optional
 
 from aiohttp import web
 
 from skypilot_tpu.infer import engine as engine_lib
+from skypilot_tpu.infer import tokenizer as tokenizer_lib
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
 
-
-def byte_encode(text: str, vocab_size: int) -> List[int]:
-    return [b % vocab_size for b in text.encode()]
-
-
-def byte_decode(tokens: List[int]) -> str:
-    return bytes(t for t in tokens if 0 < t < 256).decode(
-        'utf-8', errors='replace')
+# Back-compat aliases (older callers/tests import these from here).
+byte_encode = lambda text, vocab_size: \
+    tokenizer_lib.ByteTokenizer(vocab_size).encode(text)  # noqa: E731
+byte_decode = lambda tokens: \
+    tokenizer_lib.ByteTokenizer().decode(tokens)  # noqa: E731
 
 
 class InferenceServer:
-    def __init__(self, engine: 'engine_lib.InferenceEngine') -> None:
+    def __init__(self, engine: 'engine_lib.InferenceEngine',
+                 tokenizer=None) -> None:
         self.engine = engine
+        self.tokenizer = tokenizer or tokenizer_lib.ByteTokenizer(
+            engine.cfg.vocab_size)
 
     async def _health(self, request: web.Request) -> web.Response:
         del request
@@ -60,19 +66,19 @@ class InferenceServer:
         if 'tokens' in payload:
             tokens = [int(t) for t in payload['tokens']]
         elif 'text' in payload:
-            tokens = byte_encode(payload['text'],
-                                 self.engine.cfg.vocab_size)
+            tokens = self.tokenizer.encode(payload['text'])
         else:
             return web.json_response(
                 {'error': 'need "tokens" or "text"'}, status=400)
         if not tokens:
             return web.json_response({'error': 'empty prompt'},
                                      status=400)
+        eos = payload.get('eos_token', self.tokenizer.eos_id)
         params = engine_lib.SamplingParams(
             max_new_tokens=int(payload.get('max_tokens', 128)),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
-            eos_token=payload.get('eos_token'))
+            eos_token=eos)
         req_id, out_q = self.engine.submit(tokens, params)
         loop = asyncio.get_running_loop()
 
@@ -97,10 +103,14 @@ class InferenceServer:
             if tok is None:
                 break
             out.append(tok)
+        if eos is not None and out and out[-1] == eos:
+            out_text = out[:-1]
+        else:
+            out_text = out
         return web.json_response({
             'request_id': req_id,
             'tokens': out,
-            'text': byte_decode(out),
+            'text': self.tokenizer.decode(out_text),
         })
 
     def make_app(self) -> web.Application:
@@ -111,38 +121,112 @@ class InferenceServer:
         return app
 
 
-def build_engine(model_name: str, num_slots: int,
-                 max_seq_len: int) -> 'engine_lib.InferenceEngine':
+def build_engine(model_name: Optional[str] = None,
+                 num_slots: int = 8,
+                 max_seq_len: int = 2048,
+                 checkpoint: Optional[str] = None,
+                 tp: int = 1,
+                 decode_chunk: int = 16) -> 'engine_lib.InferenceEngine':
+    """Engine factory.
+
+    checkpoint: HF-format dir (config.json + *.safetensors) — real
+    weights, tp-sharded over the first `tp` local devices. Without a
+    checkpoint, a randomly initialized `model_name` config (debug use).
+    """
+    import dataclasses as _dc
+
     import jax
     import jax.numpy as jnp
 
     from skypilot_tpu.models import llama
 
-    cfg = llama.CONFIGS[model_name]
-    import dataclasses as _dc
-    cfg = _dc.replace(cfg, remat=False,
-                      max_seq_len=min(cfg.max_seq_len, max_seq_len))
-    model = llama.LlamaModel(cfg)
-    sample = jnp.zeros((1, 8), jnp.int32)
-    params = jax.jit(model.init)(jax.random.PRNGKey(0), sample)
+    mesh = None
+    if tp > 1:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=tp))
+
+    if checkpoint:
+        from skypilot_tpu.models import weights as weights_lib
+        cfg = weights_lib.load_config(
+            checkpoint, remat=False, param_dtype='bfloat16',
+            dtype='bfloat16')
+        cfg = _dc.replace(cfg,
+                          max_seq_len=min(cfg.max_seq_len, max_seq_len))
+        model = llama.LlamaModel(cfg)
+        params = weights_lib.load_llama_params(cfg, checkpoint, mesh=mesh)
+    else:
+        from skypilot_tpu.models import moe
+        name = model_name or 'debug'
+        if name in moe.MIXTRAL_CONFIGS:
+            cfg, moe_cfg = moe.MIXTRAL_CONFIGS[name]
+            # Dropless routing for serving: finite capacity drops tokens
+            # as a function of batch shape, making outputs depend on
+            # which requests happen to be batched together.
+            moe_cfg = _dc.replace(moe_cfg, capacity_factor=8.0)
+            make_model = lambda c: moe.MixtralModel(c, moe_cfg)  # noqa: E731
+        else:
+            cfg = llama.CONFIGS[name]
+            make_model = llama.LlamaModel
+        if cfg.param_dtype == 'float32' and cfg.dtype == 'bfloat16':
+            # Inference wants bf16-resident weights: a f32 master copy
+            # doubles HBM traffic per decode step for no benefit.
+            cfg = _dc.replace(cfg, param_dtype='bfloat16')
+        cfg = _dc.replace(cfg, remat=False,
+                          max_seq_len=min(cfg.max_seq_len, max_seq_len))
+        model = make_model(cfg)
+        sample = jnp.zeros((1, 8), jnp.int32)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), sample)
+        if mesh is not None:
+            from skypilot_tpu.models import weights as weights_lib
+            params = weights_lib.shard_params(params, model, cfg, mesh)
     return engine_lib.InferenceEngine(model, params,
                                       num_slots=num_slots,
-                                      max_seq_len=max_seq_len)
+                                      max_seq_len=cfg.max_seq_len,
+                                      decode_chunk=decode_chunk,
+                                      mesh=mesh)
 
 
 def main(argv=None) -> None:
+    import os
+
+    # Some TPU images pin a platform plugin that wins over the env var;
+    # honor an explicit JAX_PLATFORMS (same dance as train/sft.py).
+    if os.environ.get('JAX_PLATFORMS'):
+        import jax
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
     parser = argparse.ArgumentParser()
-    parser.add_argument('--model', default='debug')
+    parser.add_argument('--model', default='debug',
+                        help='config preset (ignored with --checkpoint)')
+    parser.add_argument('--checkpoint', default=None,
+                        help='HF-format checkpoint dir')
+    parser.add_argument('--tokenizer', default=None,
+                        help='tokenizer.json path/dir (defaults to the '
+                             'checkpoint dir)')
+    parser.add_argument('--tp', type=int, default=1,
+                        help='tensor-parallel degree (local devices)')
     parser.add_argument('--port', type=int, default=8000)
     parser.add_argument('--num-slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=2048)
     args = parser.parse_args(argv)
 
-    engine = build_engine(args.model, args.num_slots, args.max_seq_len)
+    engine = build_engine(args.model, args.num_slots, args.max_seq_len,
+                          checkpoint=args.checkpoint, tp=args.tp)
+    tok_path = args.tokenizer or args.checkpoint
+    tokenizer = None
+    if tok_path:
+        try:
+            tokenizer = tokenizer_lib.load_tokenizer(tok_path)
+        except FileNotFoundError:
+            logger.warning('no tokenizer.json at %s; using byte '
+                           'fallback', tok_path)
     engine.start()
-    server = InferenceServer(engine)
-    logger.info('inference server: model=%s port=%d slots=%d',
-                args.model, args.port, args.num_slots)
+    logger.info('warming up (compiling prefill buckets + decode)...')
+    engine.warmup()
+    server = InferenceServer(engine, tokenizer)
+    logger.info('inference server: model=%s ckpt=%s tp=%d port=%d '
+                'slots=%d', args.model, args.checkpoint, args.tp,
+                args.port, args.num_slots)
     web.run_app(server.make_app(), port=args.port, print=None)
 
 

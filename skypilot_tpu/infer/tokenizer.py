@@ -1,0 +1,143 @@
+"""Tokenizers for the serving path.
+
+The reference delegates tokenization to vLLM/HF inside the serving
+container (llm/vllm/serve.yaml); here the replica server owns it so the
+HTTP API can accept raw text. Two implementations behind one interface:
+
+  * HFTokenizer — loads a HuggingFace `tokenizer.json` (the format every
+    Llama-family checkpoint ships) via the `tokenizers` runtime; bos/eos
+    ids are resolved from tokenizer_config.json / config.json when
+    present.
+  * ByteTokenizer — dependency-free byte-level fallback for debug models
+    and tests (formerly inlined in infer/server.py).
+
+`load_tokenizer(path)` picks the right one: a directory or tokenizer.json
+file -> HFTokenizer; None -> ByteTokenizer.
+"""
+import json
+import os
+from typing import List, Optional
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids (mod vocab). Debug/test fallback only."""
+
+    def __init__(self, vocab_size: int = 256) -> None:
+        self.vocab_size = vocab_size
+        self.bos_id: Optional[int] = None
+        self.eos_id: Optional[int] = None
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        del add_bos
+        return [b % self.vocab_size for b in text.encode()]
+
+    def decode(self, tokens: List[int]) -> str:
+        return bytes(t for t in tokens if 0 < t < 256).decode(
+            'utf-8', errors='replace')
+
+
+class HFTokenizer:
+    """A HuggingFace fast tokenizer loaded from tokenizer.json.
+
+    Uses the `tokenizers` runtime directly (no transformers import on the
+    serving path — it is heavy and pulls torch).
+    """
+
+    def __init__(self, tokenizer_json: str,
+                 bos_id: Optional[int] = None,
+                 eos_id: Optional[int] = None) -> None:
+        import tokenizers  # local import: optional dependency
+
+        self._tok = tokenizers.Tokenizer.from_file(tokenizer_json)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        if bos_id is None or eos_id is None:
+            auto_bos, auto_eos = _special_ids_near(tokenizer_json, self._tok)
+            self.bos_id = bos_id if bos_id is not None else auto_bos
+            self.eos_id = eos_id if eos_id is not None else auto_eos
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        if add_bos and self.bos_id is not None and (
+                not ids or ids[0] != self.bos_id):
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, tokens: List[int]) -> str:
+        # bos/eos may not be flagged special in the vocab; strip by id.
+        specials = {self.bos_id, self.eos_id}
+        toks = [t for t in tokens if t not in specials]
+        return self._tok.decode(toks, skip_special_tokens=True)
+
+
+def _special_ids_near(tokenizer_json: str, tok
+                      ) -> 'tuple[Optional[int], Optional[int]]':
+    """Resolve bos/eos ids from sibling HF config files, falling back to
+    well-known token strings in the vocab."""
+    d = os.path.dirname(os.path.abspath(tokenizer_json))
+    bos_tok = eos_tok = None
+    for fname in ('tokenizer_config.json', 'config.json'):
+        path = os.path.join(d, fname)
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, encoding='utf-8') as f:
+                cfg = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # config.json carries ids; tokenizer_config.json carries strings.
+        if isinstance(cfg.get('bos_token_id'), int):
+            return cfg['bos_token_id'], _first_int(cfg.get('eos_token_id'))
+        bos_tok = bos_tok or _token_str(cfg.get('bos_token'))
+        eos_tok = eos_tok or _token_str(cfg.get('eos_token'))
+    candidates_bos = [bos_tok, '<|begin_of_text|>', '<s>', '<bos>']
+    candidates_eos = [eos_tok, '<|end_of_text|>', '</s>', '<eos>']
+    bos_id = _first_vocab_id(tok, candidates_bos)
+    eos_id = _first_vocab_id(tok, candidates_eos)
+    return bos_id, eos_id
+
+
+def _token_str(val):
+    if isinstance(val, str):
+        return val
+    if isinstance(val, dict):  # AddedToken serialization
+        return val.get('content')
+    return None
+
+
+def _first_int(val):
+    if isinstance(val, int):
+        return val
+    if isinstance(val, list) and val and isinstance(val[0], int):
+        return val[0]  # llama-3.1 style eos list; first is <|end_of_text|>
+    return None
+
+
+def _first_vocab_id(tok, candidates) -> Optional[int]:
+    for c in candidates:
+        if not c:
+            continue
+        tid = tok.token_to_id(c)
+        if tid is not None:
+            return tid
+    return None
+
+
+def load_tokenizer(path: Optional[str] = None,
+                   vocab_size: int = 256):
+    """Factory: path to a checkpoint dir / tokenizer.json -> HFTokenizer;
+    None -> ByteTokenizer(vocab_size)."""
+    if path is None:
+        return ByteTokenizer(vocab_size)
+    if os.path.isdir(path):
+        tj = os.path.join(path, 'tokenizer.json')
+        if not os.path.exists(tj):
+            raise FileNotFoundError(f'no tokenizer.json under {path}')
+        path = tj
+    logger.info('loading tokenizer from %s', path)
+    return HFTokenizer(path)

@@ -19,6 +19,11 @@ JOBS_CLUSTER_NAME_PREFIX = '{name}-{job_id}'
 CONTROLLER_LOG_DIR = 'managed_jobs'
 SIGNAL_DIR = 'managed_jobs/signals'
 
+# Cluster-hosted controller (reference: sky-jobs-controller-<hash>,
+# sky/jobs/core.py:30-137). One shared cluster; each managed job is one
+# cluster job on it.
+CONTROLLER_CLUSTER_NAME = 'skyt-jobs-controller'
+
 # Max consecutive launch attempts before giving up (reference:
 # recovery_strategy.py MAX_JOB_CHECKING_RETRY + launch retries).
 MAX_LAUNCH_RETRIES = 3

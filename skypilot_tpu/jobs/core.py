@@ -39,11 +39,21 @@ def launch(entrypoint: Union[Any, 'list'],
            name: Optional[str] = None,
            *,
            retry_until_up: bool = True,
-           detach: bool = True) -> int:
+           detach: bool = True,
+           controller: Optional[str] = None) -> int:
     """Submit a managed job; returns its managed-job id.
 
     Reference: sky/jobs/core.py:30 launch. `retry_until_up` defaults True
     (managed jobs exist to outlive capacity trouble).
+
+    controller: 'process' (default) runs the watch loop as a detached
+    client-side process; 'cluster' launches it as a job on a controller
+    cluster (the reference's jobs-controller VM recursion,
+    sky/jobs/core.py:30-137 + sky/templates/jobs-controller.yaml.j2) —
+    the managed job then survives the client machine entirely. Override
+    the default with SKYT_JOBS_CONTROLLER or config key
+    jobs.controller.mode; controller resources come from config key
+    jobs.controller.resources (default: a small CPU VM).
     """
     from skypilot_tpu import dag as dag_lib
     from skypilot_tpu import task as task_lib
@@ -62,6 +72,19 @@ def launch(entrypoint: Union[Any, 'list'],
     if not tasks:
         raise exceptions.ManagedJobError('empty dag')
 
+    if controller is None:
+        from skypilot_tpu import skyt_config
+        controller = os.environ.get(
+            'SKYT_JOBS_CONTROLLER',
+            skyt_config.get_nested(('jobs', 'controller', 'mode'),
+                                   'process'))
+    if controller not in ('process', 'cluster'):
+        # Validate before any state is created: a typo must not leave a
+        # SUBMITTED row with no controller behind.
+        raise exceptions.ManagedJobError(
+            f"controller must be 'process' or 'cluster', got "
+            f'{controller!r}')
+
     job_name = name or tasks[0].name or 'managed'
     job_id = jobs_state.create_job(job_name, '', len(tasks),
                                    retry_until_up=retry_until_up)
@@ -72,23 +95,71 @@ def launch(entrypoint: Union[Any, 'list'],
                            sort_keys=False)
     jobs_state.set_dag_yaml(job_id, dag_yaml)
 
-    log_path = os.path.join(_jobs_dir(), f'controller-{job_id}.log')
     # SUBMITTED before spawn: the controller immediately writes STARTING
     # and must not be overwritten by a slower parent.
     jobs_state.set_status(job_id, jobs_state.ManagedJobStatus.SUBMITTED)
-    env = dict(os.environ)
-    with open(log_path, 'ab') as logf:
-        proc = subprocess.Popen(  # pylint: disable=consider-using-with
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id), '--dag-yaml', dag_yaml],
-            stdout=logf, stderr=subprocess.STDOUT, stdin=subprocess.DEVNULL,
-            env=env, start_new_session=True)
-    jobs_state.set_controller_pid(job_id, proc.pid)
-    logger.info('Managed job %d (%s) submitted; controller pid %d. '
-                'Logs: %s', job_id, job_name, proc.pid, log_path)
+
+    if controller == 'cluster':
+        _launch_controller_on_cluster(job_id, dag_yaml)
+    else:
+        log_path = os.path.join(_jobs_dir(), f'controller-{job_id}.log')
+        env = dict(os.environ)
+        with open(log_path, 'ab') as logf:
+            proc = subprocess.Popen(  # pylint: disable=consider-using-with
+                [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+                 '--job-id', str(job_id), '--dag-yaml', dag_yaml],
+                stdout=logf, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL,
+                env=env, start_new_session=True)
+        jobs_state.set_controller_pid(job_id, proc.pid)
+        logger.info('Managed job %d (%s) submitted; controller pid %d. '
+                    'Logs: %s', job_id, job_name, proc.pid, log_path)
     if not detach:
         tail_logs(job_id, follow=True)
     return job_id
+
+
+def _launch_controller_on_cluster(job_id: int, dag_yaml: str) -> None:
+    """Run the watch loop as a job on the shared controller cluster.
+
+    The controller cluster is launched (or reused) through the normal
+    execution pipeline — the reference's recursion trick, which keeps
+    the controller just another cluster running our own module. The DAG
+    yaml ships via file_mounts; the run command falls back to the
+    client-side path for providers that share the filesystem (local).
+    State note: on the local provider the controller shares the client
+    state DB (SKYT_STATE_DIR passthrough), which is what makes the
+    kill-the-client e2e meaningful; a cloud-VM controller keeps its own
+    state dir on the VM, matching the reference's controller-side DB.
+    """
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import skyt_config
+    from skypilot_tpu import task as task_lib
+
+    remote_dag = f'~/.skyt/managed/dag-{job_id}.yaml'
+    res_cfg = skyt_config.get_nested(('jobs', 'controller', 'resources'),
+                                     {'cpus': '4+'})
+    envs = {k: os.environ[k]
+            for k in ('SKYT_STATE_DIR', 'SKYT_LOCAL_ROOT',
+                      'SKYT_DEFAULT_STORE', 'SKYT_JOBS_CHECK_GAP',
+                      'SKYT_JOBS_PREEMPTION_GRACE')
+            if k in os.environ}
+    run_cmd = (
+        f'DAG={remote_dag}; [ -f "$DAG" ] || DAG={dag_yaml}; '
+        f'exec {sys.executable} -m skypilot_tpu.jobs.controller '
+        f'--job-id {job_id} --dag-yaml "$DAG"')
+    ctask = task_lib.Task(name=f'jobs-controller-{job_id}', run=run_cmd,
+                          envs=envs)
+    ctask.set_resources(resources_lib.Resources(**res_cfg))
+    ctask.file_mounts = {remote_dag: dag_yaml}
+    execution.launch(ctask,
+                     cluster_name=constants.CONTROLLER_CLUSTER_NAME,
+                     detach_run=True, stream_logs=False)
+    jobs_state.set_controller_cluster(
+        job_id, constants.CONTROLLER_CLUSTER_NAME)
+    logger.info('Managed job %d: controller running on cluster %s',
+                job_id, constants.CONTROLLER_CLUSTER_NAME)
 
 
 def queue(skip_finished: bool = False) -> List[Dict[str, Any]]:
@@ -115,6 +186,11 @@ _SUBMIT_GRACE_SECONDS = 15.0
 def _controller_dead(job: Dict[str, Any]) -> bool:
     if job['status'].is_terminal() or \
             job['status'] is jobs_state.ManagedJobStatus.PENDING:
+        return False
+    if job.get('controller_cluster'):
+        # Cluster-hosted controller: supervised by that cluster's agent,
+        # not by a client pid; its own failure shows up as the cluster
+        # job failing, not via a local liveness probe.
         return False
     if not job.get('controller_pid'):
         return (time.time() - (job.get('submitted_at') or 0) >
@@ -200,12 +276,18 @@ def tail_logs(job_id: Optional[int] = None, *, follow: bool = True,
         return _tail_file(path, follow and not job['status'].is_terminal())
 
     # Wait out launch/recovery phases, then delegate to the cluster log
-    # stream; loop because the cluster can disappear mid-stream.
+    # stream; loop because the cluster can disappear mid-stream. Each
+    # cluster *incarnation* is streamed at most once (a completed follow
+    # stream restarting from the top would duplicate output) — recovery
+    # reuses the same cluster name, so the incarnation key includes the
+    # recovery count.
     from skypilot_tpu import core as cluster_core
+    streamed_incarnation = None
     while True:
         job = jobs_state.get_job(job_id)
         assert job is not None
         cluster_name = job.get('cluster_name')
+        incarnation = (cluster_name, job.get('recovery_count', 0))
         if _controller_dead(job):
             jobs_state.set_status(
                 job_id, jobs_state.ManagedJobStatus.FAILED_CONTROLLER,
@@ -220,8 +302,10 @@ def tail_logs(job_id: Optional[int] = None, *, follow: bool = True,
                      if job.get('failure_reason') else ''))
             return 0 if job['status'] is \
                 jobs_state.ManagedJobStatus.SUCCEEDED else 1
-        if cluster_name and cluster_state.get_cluster(cluster_name):
+        if cluster_name and cluster_state.get_cluster(cluster_name) and \
+                incarnation != streamed_incarnation:
             try:
+                streamed_incarnation = incarnation
                 cluster_core.tail_logs(cluster_name, None, follow=follow)
                 if not follow:
                     return 0

@@ -84,7 +84,13 @@ def _get_db() -> sqlite3.Connection:
                     recovery_count INTEGER DEFAULT 0,
                     failure_reason TEXT,
                     controller_pid INTEGER,
+                    controller_cluster TEXT,
                     retry_until_up INTEGER DEFAULT 0)""")
+            try:  # migrate pre-controller_cluster DBs
+                _DB.execute('ALTER TABLE managed_jobs ADD COLUMN '
+                            'controller_cluster TEXT')
+            except sqlite3.OperationalError:
+                pass  # column already exists
             _DB.commit()
             _DB_PATH = path
         return _DB
@@ -151,6 +157,12 @@ def set_task_index(job_id: int, task_index: int) -> None:
 
 def set_controller_pid(job_id: int, pid: int) -> None:
     _update(job_id, controller_pid=pid)
+
+
+def set_controller_cluster(job_id: int, cluster: str) -> None:
+    """Cluster-hosted controller (reference: the jobs-controller VM,
+    sky/jobs/core.py:30-137)."""
+    _update(job_id, controller_cluster=cluster)
 
 
 def bump_recovery_count(job_id: int) -> None:

@@ -12,8 +12,6 @@ the Pallas flash kernel on TPU.
 Shapes follow Llama 3 (GQA, SwiGLU, RMSNorm, RoPE theta 5e5, vocab 128256).
 """
 import dataclasses
-from typing import Optional
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -221,13 +219,18 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, segment_ids=None,
-                 cache=None):
+                 cache=None, logit_positions=None):
         """tokens: [B, S] int32 -> logits [B, S, vocab] (compute dtype).
 
         cache: optional {'k': [L,B,Sc,Hkv,Hd], 'v': ...} for incremental
         decoding (see infer/engine.py). With a cache, `positions` must be
         the global positions of `tokens` (per batch) and the return is
-        (logits, new_cache)."""
+        (logits, new_cache).
+
+        logit_positions: optional [B, P] — compute logits only at these
+        token indices (prefill wants just the last position; the lm_head
+        over a 128k vocab at every prompt position is ~20% of prefill
+        FLOPs plus a [S, vocab] HBM write, all wasted)."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         b, s = tokens.shape
@@ -295,6 +298,9 @@ class LlamaModel(nn.Module):
                 }
 
         x = RMSNorm(cfg, name='final_norm')(x)
+        if logit_positions is not None:
+            x = jnp.take_along_axis(
+                x, logit_positions[:, :, None], axis=1)
         if cfg.tie_embeddings:
             logits = jnp.einsum('bsd,vd->bsv', x, embed.astype(dtype))
         else:

@@ -129,14 +129,26 @@ class MoeBlock(nn.Module):
     moe: MoeConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, segment_ids=None):
-        x = x + llama_lib.LlamaAttention(self.cfg, name='attn')(
-            llama_lib.RMSNorm(self.cfg, name='attn_norm')(x), cos, sin,
-            segment_ids)
+    def __call__(self, x, cos, sin, segment_ids=None, cache=None,
+                 positions=None):
+        """cache/positions mirror llama_lib.LlamaBlock: with a cache the
+        return is ((x, aux), new_cache) for incremental decoding."""
+        attn_in = llama_lib.RMSNorm(self.cfg, name='attn_norm')(x)
+        new_cache = None
+        if cache is not None:
+            attn_out, new_cache = llama_lib.LlamaAttention(
+                self.cfg, name='attn')(attn_in, cos, sin, segment_ids,
+                                       cache, positions)
+        else:
+            attn_out = llama_lib.LlamaAttention(self.cfg, name='attn')(
+                attn_in, cos, sin, segment_ids)
+        x = x + attn_out
         mlp_out, aux = MoeMLP(self.cfg, self.moe, name='moe_mlp')(
             llama_lib.RMSNorm(self.cfg, name='mlp_norm')(x))
         x = x + mlp_out
         aux_total = sum(aux.values())
+        if cache is not None:
+            return (x, aux_total), new_cache
         return x, aux_total
 
 
@@ -146,7 +158,13 @@ class MixtralModel(nn.Module):
     moe: MoeConfig = MoeConfig()
 
     @nn.compact
-    def __call__(self, tokens, positions=None, segment_ids=None):
+    def __call__(self, tokens, positions=None, segment_ids=None,
+                 cache=None, logit_positions=None):
+        """Mirrors llama_lib.LlamaModel: with `cache`
+        ({'k': [L,B,Sc,Hkv,Hd], 'v': ...}) the return is
+        (logits, new_cache) for incremental decoding — the serving
+        engine runs Mixtral exactly like Llama (reference:
+        llm/mixtral/serve.yaml serves it through vLLM)."""
         from skypilot_tpu.ops import rope
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
@@ -164,32 +182,66 @@ class MixtralModel(nn.Module):
         cos, sin = rope.rope_freqs(positions, cfg.head_dim, cfg.rope_theta,
                                    use_llama31_scaling=cfg.use_llama31_rope)
         aux_total = 0.0
+        new_cache = None
         block = MoeBlock
-        if cfg.remat:
+        if cfg.remat and cache is None:
             block = nn.remat(MoeBlock, prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
-            (x, aux_total), _ = nn.scan(
-                lambda mdl, carry, _: (
-                    (lambda o: (o[0], carry[1] + o[1]))(
-                        mdl(carry[0], cos, sin, segment_ids)), None),
-                variable_axes={'params': 0},
-                split_rngs={'params': True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: 'layers'},
-            )(block(cfg, self.moe, name='layers'),
-              (x, jnp.zeros((), jnp.float32)), None)
+            if cache is not None:
+                def body(mdl, carry, layer_cache):
+                    (y, aux), upd = mdl(
+                        carry[0], cos, sin, segment_ids,
+                        (layer_cache['k'], layer_cache['v']), positions)
+                    return (y, carry[1] + aux), {'k': upd[0],
+                                                 'v': upd[1]}
+                (x, aux_total), new_cache = nn.scan(
+                    body,
+                    variable_axes={'params': 0},
+                    split_rngs={'params': True},
+                    length=cfg.n_layers,
+                    in_axes=0, out_axes=0,
+                    metadata_params={nn.PARTITION_NAME: 'layers'},
+                )(block(cfg, self.moe, name='layers'),
+                  (x, jnp.zeros((), jnp.float32)), cache)
+            else:
+                (x, aux_total), _ = nn.scan(
+                    lambda mdl, carry, _: (
+                        (lambda o: (o[0], carry[1] + o[1]))(
+                            mdl(carry[0], cos, sin, segment_ids)), None),
+                    variable_axes={'params': 0},
+                    split_rngs={'params': True},
+                    length=cfg.n_layers,
+                    metadata_params={nn.PARTITION_NAME: 'layers'},
+                )(block(cfg, self.moe, name='layers'),
+                  (x, jnp.zeros((), jnp.float32)), None)
         else:
+            caches_out = []
             for i in range(cfg.n_layers):
-                x, aux = block(cfg, self.moe, name=f'layer_{i}')(
-                    x, cos, sin, segment_ids)
+                if cache is not None:
+                    (x, aux), upd = block(cfg, self.moe,
+                                          name=f'layer_{i}')(
+                        x, cos, sin, segment_ids,
+                        (cache['k'][i], cache['v'][i]), positions)
+                    caches_out.append(upd)
+                else:
+                    x, aux = block(cfg, self.moe, name=f'layer_{i}')(
+                        x, cos, sin, segment_ids)
                 aux_total = aux_total + aux
+            if cache is not None:
+                new_cache = {
+                    'k': jnp.stack([c[0] for c in caches_out]),
+                    'v': jnp.stack([c[1] for c in caches_out]),
+                }
         x = llama_lib.RMSNorm(cfg, name='final_norm')(x)
+        if logit_positions is not None:
+            x = jnp.take_along_axis(
+                x, logit_positions[:, :, None], axis=1)
         logits = llama_lib._dense(cfg.vocab_size, ('embed', 'vocab'),
                                   'lm_head', cfg.param_dtype, dtype)(x)
         logits = nn.with_logical_constraint(
             logits, ('act_batch', 'act_seq', 'act_vocab'))
         self.sow('intermediates', 'moe_aux_loss', aux_total)
-        return logits
+        return (logits, new_cache) if cache is not None else logits
 
 
 # Mixtral-8x7B shapes (vocab 32000, dim 4096, 32 layers, 8 experts top-2).

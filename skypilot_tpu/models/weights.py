@@ -1,0 +1,316 @@
+"""Checkpoint I/O for the Llama family: HF safetensors <-> flax params.
+
+The reference serves real checkpoints by pointing vLLM at a HF model dir
+(llm/vllm/serve.yaml `--model meta-llama/...`); the TPU-native equivalent
+is a direct safetensors -> sharded-jax-array loader:
+
+  * reads the standard HF Llama layout (model.safetensors[.index.json] +
+    config.json) without importing torch/transformers;
+  * transposes HF [out, in] weights into flax Dense [in, out] kernels and
+    stacks per-layer tensors along a leading axis when the model scans
+    layers (models/llama.py nn.scan);
+  * when a mesh is given, every leaf is device_put with the NamedSharding
+    derived from the model's logical axis annotations (parallel/
+    sharding.py) — params land tp/fsdp-sharded without ever
+    materializing a full replica per device (required at 70B scale).
+
+RoPE note: our apply_rope uses the split-half convention (ops/rope.py),
+which is exactly the HF Llama layout — q/k projections load with no
+permutation.
+"""
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# (our leaf under a layer) -> (HF suffix, transpose?)
+_LAYER_MAP = {
+    ('attn_norm', 'weight'): ('input_layernorm.weight', False),
+    ('attn', 'wq', 'kernel'): ('self_attn.q_proj.weight', True),
+    ('attn', 'wk', 'kernel'): ('self_attn.k_proj.weight', True),
+    ('attn', 'wv', 'kernel'): ('self_attn.v_proj.weight', True),
+    ('attn', 'wo', 'kernel'): ('self_attn.o_proj.weight', True),
+    ('mlp_norm', 'weight'): ('post_attention_layernorm.weight', False),
+    ('mlp', 'w_gate', 'kernel'): ('mlp.gate_proj.weight', True),
+    ('mlp', 'w_up', 'kernel'): ('mlp.up_proj.weight', True),
+    ('mlp', 'w_down', 'kernel'): ('mlp.down_proj.weight', True),
+}
+
+_TOP_MAP = {
+    ('tok_embed',): ('model.embed_tokens.weight', False),
+    ('final_norm', 'weight'): ('model.norm.weight', False),
+    ('lm_head', 'kernel'): ('lm_head.weight', True),
+}
+
+
+class _ShardReader:
+    """Random access over a sharded/unsharded safetensors checkpoint."""
+
+    def __init__(self, ckpt_dir: str) -> None:
+        import safetensors  # local import: serving-path dependency
+
+        self._safe_open = safetensors.safe_open
+        self.ckpt_dir = ckpt_dir
+        index = os.path.join(ckpt_dir, 'model.safetensors.index.json')
+        self._weight_map: Dict[str, str] = {}
+        if os.path.exists(index):
+            with open(index, encoding='utf-8') as f:
+                self._weight_map = json.load(f)['weight_map']
+        else:
+            files = sorted(f for f in os.listdir(ckpt_dir)
+                           if f.endswith('.safetensors'))
+            if not files:
+                raise FileNotFoundError(
+                    f'no *.safetensors under {ckpt_dir}')
+            for fname in files:
+                with self._safe_open(os.path.join(ckpt_dir, fname),
+                                     framework='np') as f:
+                    for key in f.keys():
+                        self._weight_map[key] = fname
+        self._handles: Dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._weight_map
+
+    def get(self, name: str) -> np.ndarray:
+        fname = self._weight_map[name]
+        if fname not in self._handles:
+            self._handles[fname] = self._safe_open(
+                os.path.join(self.ckpt_dir, fname), framework='np')
+        return self._handles[fname].get_tensor(name)
+
+
+def _np_cast(arr: np.ndarray, dtype) -> np.ndarray:
+    # bfloat16 safetensors arrive as ml_dtypes bfloat16 numpy arrays;
+    # astype handles both directions.
+    return arr.astype(dtype) if arr.dtype != dtype else arr
+
+
+def load_llama_params(cfg, ckpt_dir: str, *,
+                      mesh=None,
+                      rules=sharding_lib.DEFAULT_RULES,
+                      param_dtype: Optional[str] = None) -> Dict[str, Any]:
+    """HF Llama checkpoint dir -> {'params': ...} for models/llama.py.
+
+    cfg: LlamaConfig matching the checkpoint shapes. mesh: optional
+    jax.sharding.Mesh — leaves are placed with their logical shardings
+    (tp/fsdp per parallel/sharding.py DEFAULT_RULES).
+    """
+    from skypilot_tpu.models import llama as llama_lib
+
+    target = param_dtype or cfg.param_dtype
+    if target == 'bfloat16':
+        import ml_dtypes
+        dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dtype = np.dtype(target)
+
+    reader = _ShardReader(ckpt_dir)
+    shardings = None
+    if mesh is not None:
+        model = llama_lib.LlamaModel(cfg)
+        shardings = param_shardings(model, cfg, mesh, rules)
+
+    def put(path: tuple, arr: np.ndarray):
+        if shardings is not None:
+            return jax.device_put(arr, _leaf_at(shardings, path))
+        return jnp.asarray(arr)
+
+    params: Dict[str, Any] = {}
+
+    def assemble(path: tuple, hf_name: str, transpose: bool):
+        arr = reader.get(hf_name)
+        if transpose:
+            arr = arr.T
+        _set_at(params, path, put(path, _np_cast(arr, dtype)))
+
+    for path, (hf_name, transpose) in _TOP_MAP.items():
+        if path == ('lm_head', 'kernel'):
+            if cfg.tie_embeddings:
+                continue
+            if hf_name not in reader:
+                # Tied checkpoint loaded into an untied config: reuse the
+                # embedding, transposed.
+                arr = reader.get('model.embed_tokens.weight').T
+                _set_at(params, path, put(path, _np_cast(arr, dtype)))
+                logger.info('lm_head tied to embeddings in checkpoint')
+                continue
+        assemble(path, hf_name, transpose)
+
+    for path, (suffix, transpose) in _LAYER_MAP.items():
+        if cfg.scan_layers:
+            per_layer = [
+                reader.get(f'model.layers.{i}.{suffix}')
+                for i in range(cfg.n_layers)]
+            arr = np.stack([a.T if transpose else a for a in per_layer])
+            _set_at(params, ('layers',) + path,
+                    put(('layers',) + path, _np_cast(arr, dtype)))
+        else:
+            for i in range(cfg.n_layers):
+                arr = reader.get(f'model.layers.{i}.{suffix}')
+                if transpose:
+                    arr = arr.T
+                full = (f'layer_{i}',) + path
+                _set_at(params, full, put(full, _np_cast(arr, dtype)))
+
+    logger.info('loaded %d-layer llama params from %s (sharded=%s)',
+                cfg.n_layers, ckpt_dir, mesh is not None)
+    return {'params': params}
+
+
+def save_hf_checkpoint(cfg, variables: Dict[str, Any],
+                       out_dir: str) -> None:
+    """Inverse of load_llama_params: write our params as an HF-format
+    safetensors checkpoint (single shard) + config.json. Used for export
+    and for loader round-trip tests."""
+    import flax.linen as nn
+    import safetensors.numpy
+
+    # init() returns nn.Partitioned-boxed leaves; strip the metadata.
+    params = nn.meta.unbox(variables['params'])
+    os.makedirs(out_dir, exist_ok=True)
+    out: Dict[str, np.ndarray] = {}
+
+    def grab(path: tuple) -> Optional[np.ndarray]:
+        leaf = _get_at(params, path)
+        return None if leaf is None else np.asarray(jax.device_get(leaf))
+
+    for path, (hf_name, transpose) in _TOP_MAP.items():
+        arr = grab(path)
+        if arr is None:
+            continue
+        out[hf_name] = arr.T if transpose else arr
+    for path, (suffix, transpose) in _LAYER_MAP.items():
+        if cfg.scan_layers:
+            stacked = grab(('layers',) + path)
+            for i in range(cfg.n_layers):
+                arr = stacked[i]
+                out[f'model.layers.{i}.{suffix}'] = (
+                    arr.T if transpose else arr)
+        else:
+            for i in range(cfg.n_layers):
+                arr = grab((f'layer_{i}',) + path)
+                out[f'model.layers.{i}.{suffix}'] = (
+                    arr.T if transpose else arr)
+
+    # safetensors requires contiguous, native-endian arrays.
+    out = {k: np.ascontiguousarray(v) for k, v in out.items()}
+    safetensors.numpy.save_file(
+        out, os.path.join(out_dir, 'model.safetensors'))
+    with open(os.path.join(out_dir, 'config.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(config_to_hf(cfg), f, indent=2)
+
+
+def param_shardings(model, cfg, mesh, rules=sharding_lib.DEFAULT_RULES):
+    """NamedShardings for the model's {'params': ...} tree from its
+    logical annotations (eval_shape: no memory allocated)."""
+    import flax.linen as nn
+
+    sample = jnp.zeros((1, 8), jnp.int32)
+    abs_vars = jax.eval_shape(model.init, jax.random.PRNGKey(0), sample)
+    logical = nn.get_partition_spec(abs_vars)
+    return nn.logical_to_mesh_sharding(logical, mesh, list(rules))['params']
+
+
+def shard_params(variables: Dict[str, Any], model, cfg, mesh,
+                 rules=sharding_lib.DEFAULT_RULES) -> Dict[str, Any]:
+    """Re-place an existing params tree onto `mesh` per the logical
+    rules (for params that were initialized unsharded, e.g. tests)."""
+    import flax.linen as nn
+
+    shardings = param_shardings(model, cfg, mesh, rules)
+    params = jax.tree.map(jax.device_put,
+                          nn.meta.unbox(variables['params']), shardings)
+    return {'params': params}
+
+
+def config_from_hf(hf_config: Dict[str, Any], **overrides):
+    """HF config.json dict -> LlamaConfig."""
+    from skypilot_tpu.models import llama as llama_lib
+
+    rope_scaling = hf_config.get('rope_scaling') or {}
+    kw = dict(
+        vocab_size=hf_config['vocab_size'],
+        dim=hf_config['hidden_size'],
+        n_layers=hf_config['num_hidden_layers'],
+        n_heads=hf_config['num_attention_heads'],
+        n_kv_heads=hf_config.get('num_key_value_heads',
+                                 hf_config['num_attention_heads']),
+        mlp_dim=hf_config['intermediate_size'],
+        max_seq_len=hf_config.get('max_position_embeddings', 8192),
+        rope_theta=hf_config.get('rope_theta', 500000.0),
+        use_llama31_rope=rope_scaling.get('rope_type') == 'llama3',
+        norm_eps=hf_config.get('rms_norm_eps', 1e-5),
+        tie_embeddings=hf_config.get('tie_word_embeddings', False),
+    )
+    kw.update(overrides)
+    return llama_lib.LlamaConfig(**kw)
+
+
+def config_to_hf(cfg) -> Dict[str, Any]:
+    """LlamaConfig -> HF config.json dict (what save_hf_checkpoint
+    writes; enough for transformers.LlamaForCausalLM to reload)."""
+    out = {
+        'architectures': ['LlamaForCausalLM'],
+        'model_type': 'llama',
+        'vocab_size': cfg.vocab_size,
+        'hidden_size': cfg.dim,
+        'num_hidden_layers': cfg.n_layers,
+        'num_attention_heads': cfg.n_heads,
+        'num_key_value_heads': cfg.n_kv_heads,
+        'intermediate_size': cfg.mlp_dim,
+        'max_position_embeddings': cfg.max_seq_len,
+        'rope_theta': cfg.rope_theta,
+        'rms_norm_eps': cfg.norm_eps,
+        'tie_word_embeddings': cfg.tie_embeddings,
+        'head_dim': cfg.head_dim,
+        'hidden_act': 'silu',
+        'torch_dtype': 'float32',
+    }
+    if cfg.use_llama31_rope:
+        out['rope_scaling'] = {
+            'rope_type': 'llama3', 'factor': 8.0,
+            'low_freq_factor': 1.0, 'high_freq_factor': 4.0,
+            'original_max_position_embeddings': 8192,
+        }
+    return out
+
+
+def load_config(ckpt_dir: str, **overrides):
+    """Read config.json from a checkpoint dir -> LlamaConfig."""
+    with open(os.path.join(ckpt_dir, 'config.json'),
+              encoding='utf-8') as f:
+        return config_from_hf(json.load(f), **overrides)
+
+
+# ------------------------------------------------------------- tree utils
+def _set_at(tree: Dict[str, Any], path: tuple, value) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def _get_at(tree: Dict[str, Any], path: tuple):
+    node = tree
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    return node
+
+
+def _leaf_at(tree, path: tuple):
+    node = tree
+    for key in path:
+        node = node[key]
+    return node

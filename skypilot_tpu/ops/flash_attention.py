@@ -1,19 +1,29 @@
-"""Flash attention (forward) as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
-Blockwise online-softmax attention (Flash-Attention-2 schedule): grid over
-(batch, q_heads, q_blocks, k_blocks) with the k axis innermost so the VMEM
-scratch accumulators (running max m, running sum l, output acc) persist
-across k iterations of one q block. Causal masking skips fully-masked k
-blocks via pl.when; GQA is folded into the k/v index_map (head h reads kv
-head h // group). Backward pass uses XLA recompute via custom_vjp — the
-flash win in training is the forward (the backward is recomputed under
-jax.checkpoint per layer anyway); a Pallas backward kernel is the next
-optimization step.
+Blockwise online-softmax attention (Flash-Attention-2 schedule):
 
-Kernel conventions follow /opt/skills/guides/pallas_guide.md (block specs,
-scratch via pl.pallas_call scratch_shapes, MXU-aligned 128 tiles).
+* forward: grid over (batch, q_heads, q_blocks, k_blocks) with the k axis
+  innermost so the VMEM scratch accumulators (running max m, running sum
+  l, output acc) persist across k iterations of one q block; also emits
+  the per-row logsumexp L for the backward. Causal masking skips
+  fully-masked k blocks via pl.when; GQA is folded into the k/v index_map
+  (head h reads kv head h // group). Segment ids (packed sequences) are
+  masked in-kernel.
+* backward: two kernels, both recomputing p = exp(s - L) blockwise from
+  the saved residuals (q, k, v, L, delta = rowsum(dO*O)) — no O(S^2)
+  materialization:
+    - dq kernel: same grid as forward (k innermost), accumulates
+      dq += ds @ k in VMEM scratch;
+    - dk/dv kernel: grid (batch, q_heads, k_blocks, q_blocks) with q
+      innermost, accumulates dk/dv per *query* head; the GQA group sum
+      down to kv heads happens outside the kernel (one cheap XLA
+      reduce), avoiding non-contiguous output revisits.
+
+Kernel conventions follow /opt/skills/guides/pallas_guide.md (block
+specs, scratch via pl.pallas_call scratch_shapes, MXU-aligned tiles).
 """
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -21,9 +31,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from skypilot_tpu.ops import attention as attention_ops
-
 NEG_INF = -1e30
+
+
+def _bwd_impl_choice() -> str:
+    """'pallas' (default) or 'xla' — SKYT_FLASH_BWD overrides. The XLA
+    path recomputes reference attention under custom_vjp (the round-1
+    behavior); the escape hatch exists so a pathological kernel compile
+    can never take down a training run."""
+    return os.environ.get('SKYT_FLASH_BWD', 'pallas')
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
@@ -37,9 +53,31 @@ def _interpret_mode() -> bool:
         return True
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                num_k_blocks: int):
+def _block_mask(s, qi, ki, block_q, block_k, causal,
+                q_seg_ref, k_seg_ref):
+    """Apply causal and/or segment masking to a [block_q, block_k] score
+    block. Returns the masked scores."""
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    if q_seg_ref is not None:
+        q_seg = q_seg_ref[0]              # [block_q]
+        k_seg = k_seg_ref[0]              # [block_k]
+        s = jnp.where(q_seg[:, None] == k_seg[None, :], s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, num_k_blocks: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, q_seg_ref, k_seg_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        q_seg_ref = k_seg_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -55,12 +93,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _block_mask(s, qi, ki, block_q, block_k, causal,
+                        q_seg_ref, k_seg_ref)
         m_prev = m_scr[:]                 # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -83,8 +117,112 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
         l = l_scr[:]
-        l = jnp.where(l == 0.0, 1.0, l)   # fully-masked rows -> output 0
-        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> out 0
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # Logsumexp residual; 0 for fully-masked rows so the backward's
+        # p = exp(NEG_INF - 0) is exactly 0.
+        lse = jnp.where(l[:, 0] > 0.0,
+                        m_scr[:][:, 0] + jnp.log(safe_l[:, 0]), 0.0)
+        lse_ref[0, 0, 0] = lse
+
+
+def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
+               block_k: int, num_k_blocks: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         q_seg_ref, k_seg_ref, dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        q_seg_ref = k_seg_ref = None
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q = q_ref[0, 0]                   # [bq, d]
+        k = k_ref[0, 0]                   # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _block_mask(s, qi, ki, block_q, block_k, causal,
+                        q_seg_ref, k_seg_ref)
+        lse = lse_ref[0, 0, 0]            # [bq]
+        p = jnp.exp(s - lse[:, None])     # [bq, bk]
+        do = do_ref[0, 0]                 # [bq, d]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        delta = delta_ref[0, 0, 0]        # [bq]
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        first_masked = (qi + 1) * block_q
+        pl.when(ki * block_k < first_masked)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, num_q_blocks: int, has_seg: bool):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         q_seg_ref, k_seg_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        q_seg_ref = k_seg_ref = None
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0, 0]                   # [bq, d]
+        k = k_ref[0, 0]                   # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        s = _block_mask(s, qi, ki, block_q, block_k, causal,
+                        q_seg_ref, k_seg_ref)
+        lse = lse_ref[0, 0, 0]            # [bq]
+        p = jnp.exp(s - lse[:, None])     # [bq, bk]
+        do = do_ref[0, 0]                 # [bq, d]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        delta = delta_ref[0, 0, 0]        # [bq]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+
+    if causal:
+        # Skip q blocks entirely above the diagonal (all q_pos < k_pos).
+        pl.when((qi + 1) * block_q > ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -94,33 +232,37 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D] -> [B, Sq, Hq, D].
 
-    segment_ids is not yet supported by the kernel (falls back to XLA).
-    The dispatch happens OUTSIDE the custom_vjp: segment_ids is a traced
-    array and must never appear in nondiff_argnums.
+    segment_ids: optional [B, S] int32 packed-sequence ids, masked
+    in-kernel (forward and backward).
     """
-    if segment_ids is not None:
-        return attention_ops.mha_reference(q, k, v, causal=causal,
-                                           segment_ids=segment_ids)
-    return _flash(q, k, v, causal, block_q, block_k)
+    return _flash(q, k, v, segment_ids, causal, block_q, block_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-           block_q: int, block_k: int) -> jax.Array:
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, segment_ids, causal, block_q, block_k):
+    out, _ = _flash_fwd_impl(q, k, v, segment_ids, causal, block_q,
+                             block_k)
+    return out
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
+def _shape_checks(q, k, block_q, block_k):
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
-    assert hq % hkv == 0
-    group = hq // hkv
+    assert hq % hkv == 0, (hq, hkv)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q,
                                                      block_k)
+    return b, sq, sk, hq, hkv, d, block_q, block_k
+
+
+def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k):
+    b, sq, sk, hq, hkv, d, block_q, block_k = _shape_checks(
+        q, k, block_q, block_k)
+    group = hq // hkv
     nq, nk = sq // block_q, sk // block_k
     scale = d ** -0.5
+    has_seg = segment_ids is not None
 
     # Kernel layout: [B, H, S, D] (head-major so blocks are contiguous).
     qt = q.transpose(0, 2, 1, 3)
@@ -129,22 +271,39 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk)
+        block_k=block_k, num_k_blocks=nk, has_seg=has_seg)
 
-    out = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+    ]
+    operands = [qt, kt, vt]
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, qi, ki: (bi, ki)),
+        ]
+        operands += [seg, seg]
+
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b, hq, nq, nk),
-        in_specs=[
+        in_specs=in_specs,
+        out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, nq, block_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
             pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
@@ -154,23 +313,138 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k):
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=_interpret_mode(),
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    )(*operands)
+    return out.transpose(0, 2, 1, 3), lse
 
 
-def _fwd_rule(q, k, v, causal, block_q, block_k):
-    out = _flash(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+def _fwd_rule(q, k, v, segment_ids, causal, block_q, block_k):
+    out, lse = _flash_fwd_impl(q, k, v, segment_ids, causal, block_q,
+                               block_k)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _bwd_rule(causal, block_q, block_k, res, g):
-    q, k, v = res
-    # Backward via XLA recompute of the reference attention. O(S^2) memory
-    # per block is bounded by the remat granularity of the caller.
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_ops.mha_reference(
-            q_, k_, v_, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, segment_ids, out, lse = res
+    if _bwd_impl_choice() == 'xla':
+        from skypilot_tpu.ops import attention as attention_ops
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: attention_ops.mha_reference(
+                q_, k_, v_, causal=causal, segment_ids=segment_ids),
+            q, k, v)
+        return (*vjp(g), None)
+    b, sq, sk, hq, hkv, d, block_q, block_k = _shape_checks(
+        q, k, block_q, block_k)
+    group = hq // hkv
+    nq, nk = sq // block_q, sk // block_k
+    scale = d ** -0.5
+    has_seg = segment_ids is not None
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)         # dO, [b, hq, sq, d]
+    ot = out.transpose(0, 2, 1, 3)
+
+    # delta_i = sum_d dO_i * O_i, the softmax-grad row correction.
+    delta = (dot.astype(jnp.float32) * ot.astype(jnp.float32)).sum(-1)
+    delta = delta.reshape(b, hq, nq, block_q)
+
+    qkv_spec = lambda bi, hi, qi, ki: (bi, hi, qi, 0)  # noqa: E731
+    kv_spec = lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)  # noqa: E731
+    row_spec = lambda bi, hi, qi, ki: (bi, hi, qi, 0)  # noqa: E731
+
+    common_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), qkv_spec),       # q
+        pl.BlockSpec((1, 1, block_k, d), kv_spec),        # k
+        pl.BlockSpec((1, 1, block_k, d), kv_spec),        # v
+        pl.BlockSpec((1, 1, block_q, d), qkv_spec),       # dO
+        pl.BlockSpec((1, 1, 1, block_q), row_spec),       # lse
+        pl.BlockSpec((1, 1, 1, block_q), row_spec),       # delta
+    ]
+    operands = [qt, kt, vt, dot, lse, delta]
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)
+        common_in_specs += [
+            pl.BlockSpec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, qi, ki: (bi, ki)),
+        ]
+        operands += [seg, seg]
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk, has_seg=has_seg)
+    dqt = pl.pallas_call(
+        dq_kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=list(common_in_specs),
+        out_specs=pl.BlockSpec((1, 1, block_q, d), qkv_spec),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=_interpret_mode(),
+    )(*operands)
+
+    # dk/dv per *query* head: the kernel walks q blocks innermost for a
+    # fixed k block; the kv-head (GQA group) reduction is one XLA sum.
+    def dkv_q_spec(bi, hi, ki, qi):
+        return (bi, hi, qi, 0)
+
+    def dkv_kv_spec(bi, hi, ki, qi):
+        return (bi, hi // group, ki, 0)
+
+    def dkv_row_spec(bi, hi, ki, qi):
+        return (bi, hi, qi, 0)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), dkv_q_spec),      # q
+        pl.BlockSpec((1, 1, block_k, d), dkv_kv_spec),     # k
+        pl.BlockSpec((1, 1, block_k, d), dkv_kv_spec),     # v
+        pl.BlockSpec((1, 1, block_q, d), dkv_q_spec),      # dO
+        pl.BlockSpec((1, 1, 1, block_q), dkv_row_spec),    # lse
+        pl.BlockSpec((1, 1, 1, block_q), dkv_row_spec),    # delta
+    ]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q), lambda bi, hi, ki, qi: (bi, qi)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, ki, qi: (bi, ki)),
+        ]
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_q_blocks=nq, has_seg=has_seg)
+    dk_spec = lambda bi, hi, ki, qi: (bi, hi, ki, 0)  # noqa: E731
+    dkt, dvt = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, hq, nk, nq),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), dk_spec),
+            pl.BlockSpec((1, 1, block_k, d), dk_spec),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hq, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=_interpret_mode(),
+    )(*operands)
+
+    if group > 1:
+        dkt = dkt.reshape(b, hkv, group, sk, d).sum(2)
+        dvt = dvt.reshape(b, hkv, group, sk, d).sum(2)
+
+    dq = dqt.transpose(0, 2, 1, 3)
+    dk = dkt.transpose(0, 2, 1, 3)
+    dv = dvt.transpose(0, 2, 1, 3)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_fwd_rule, _bwd_rule)

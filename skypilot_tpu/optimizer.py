@@ -10,7 +10,9 @@ the reference itself special-cases chains).
 """
 import dataclasses
 import enum
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
 
 from skypilot_tpu import catalog
 from skypilot_tpu import check as check_lib
@@ -70,9 +72,7 @@ class Optimizer:
         if dag.is_chain():
             choice = _optimize_chain_dp(tasks, per_task, minimize)
         else:
-            logger.warning('General (non-chain) DAG: optimizing per-task '
-                           '(egress between branches not modeled).')
-            choice = {t: _best_plan(per_task[t], minimize) for t in tasks}
+            choice = _optimize_general_ilp(dag, tasks, per_task, minimize)
 
         for task, plan in choice.items():
             task.best_resources = plan.resources
@@ -137,9 +137,13 @@ def _fill_in_launchable_plans(
                 hints.append(f'{cloud_name} lacks '
                              f'{[f.value for f in missing]} for {res}')
                 continue
-            plans.extend(_plans_on_cloud(cloud_name, res, runtime,
-                                         blocked_resources,
-                                         num_nodes=task.num_nodes))
+            cloud_plans = _plans_on_cloud(cloud_name, res, runtime,
+                                          blocked_resources,
+                                          num_nodes=task.num_nodes)
+            if not cloud_plans:
+                hints.append(
+                    f'{cloud_name}: no catalog offering matches {res}')
+            plans.extend(cloud_plans)
     return plans, hints
 
 
@@ -232,6 +236,139 @@ def _optimize_chain_dp(tasks, per_task, minimize: OptimizeTarget
         dp = new_dp
     best_score, best_path = min(dp, key=lambda t: t[0])
     return dict(zip(tasks, best_path))
+
+
+# Plans per task fed to the ILP; edge variables scale as K^2 per DAG
+# edge, so cap K (plans are pre-sorted best-first, the optimum is
+# overwhelmingly within the cheapest few dozen).
+_ILP_MAX_PLANS_PER_TASK = 50
+_INF = float('inf')
+
+
+def _optimize_general_ilp(dag, tasks, per_task,
+                          minimize: OptimizeTarget
+                          ) -> Dict[object, 'LaunchablePlan']:
+    """Joint plan assignment on a general DAG as a MILP
+    (reference: sky/optimizer.py:462 _optimize_by_ilp, via pulp; here
+    scipy.optimize.milp / HiGHS — pulp is not in the image).
+
+    COST: min Σ_t cost(x_t) + Σ_(u,v) egress(x_u, x_v) * out_gb(u),
+    with one-hot x_t over task t's plans and continuous AND-linearized
+    edge variables (e >= x_u + x_v - 1 is tight under minimization).
+
+    TIME: min makespan M with finish-time variables
+    F_v >= F_u + runtime(x_v) along every edge (egress time not
+    modeled, matching the chain DP).
+    """
+    try:
+        import scipy.optimize as sopt
+        import scipy.sparse as ssp
+    except ImportError:  # pragma: no cover - scipy is baked in
+        logger.warning('scipy unavailable; falling back to per-task '
+                       'greedy (egress between branches not modeled).')
+        return {t: _best_plan(per_task[t], minimize) for t in tasks}
+
+    def base(p: LaunchablePlan) -> float:
+        return (p.estimated_cost if minimize == OptimizeTarget.COST
+                else p.estimated_runtime_s)
+
+    plans = {t: sorted(per_task[t], key=base)[:_ILP_MAX_PLANS_PER_TASK]
+             for t in tasks}
+    offset: Dict[object, int] = {}
+    n = 0
+    for t in tasks:
+        offset[t] = n
+        n += len(plans[t])
+    n_x = n
+
+    edges = list(dag.graph.edges)
+    rows, cols, vals = [], [], []   # constraint matrix triplets
+    lb_con, ub_con = [], []         # per-constraint bounds
+    n_con = 0
+
+    def add_con(entries, lo, hi):
+        nonlocal n_con
+        for col, val in entries:
+            rows.append(n_con)
+            cols.append(col)
+            vals.append(val)
+        lb_con.append(lo)
+        ub_con.append(hi)
+        n_con += 1
+
+    cost = []
+    integrality = []
+
+    if minimize == OptimizeTarget.COST:
+        # Edge AND variables, continuous in [0, 1].
+        e_offset: Dict[tuple, int] = {}
+        for (u, v) in edges:
+            e_offset[(u, v)] = n
+            n += len(plans[u]) * len(plans[v])
+        cost = [0.0] * n
+        integrality = [1] * n_x + [0] * (n - n_x)
+        for t in tasks:
+            for j, p in enumerate(plans[t]):
+                cost[offset[t] + j] = base(p)
+        for (u, v) in edges:
+            out_gb = getattr(u, 'output_size_gb', 0.0) or 0.0
+            for i, pu in enumerate(plans[u]):
+                for j, pv in enumerate(plans[v]):
+                    eg = _egress_cost_per_gb(pu.resources,
+                                             pv.resources) * out_gb
+                    idx = e_offset[(u, v)] + i * len(plans[v]) + j
+                    cost[idx] = eg
+                    if eg > 0.0:
+                        # x_u_i + x_v_j - e <= 1
+                        add_con([(offset[u] + i, 1.0),
+                                 (offset[v] + j, 1.0),
+                                 (idx, -1.0)], -_INF, 1.0)
+    else:
+        # Finish-time vars F_t (continuous) + makespan M.
+        f_offset = {t: n + i for i, t in enumerate(tasks)}
+        n += len(tasks)
+        m_idx = n
+        n += 1
+        cost = [0.0] * n
+        cost[m_idx] = 1.0
+        integrality = [1] * n_x + [0] * (n - n_x)
+        for t in tasks:
+            # F_t - runtime(x_t) >= (0 | F_u for each pred u)
+            preds = list(dag.graph.predecessors(t))
+            rt = [(offset[t] + j, -p.estimated_runtime_s)
+                  for j, p in enumerate(plans[t])]
+            if not preds:
+                add_con([(f_offset[t], 1.0)] + rt, 0.0, _INF)
+            for u in preds:
+                add_con([(f_offset[t], 1.0), (f_offset[u], -1.0)] + rt,
+                        0.0, _INF)
+            # M >= F_t
+            add_con([(m_idx, 1.0), (f_offset[t], -1.0)], 0.0, _INF)
+
+    # One-hot per task.
+    for t in tasks:
+        add_con([(offset[t] + j, 1.0) for j in range(len(plans[t]))],
+                1.0, 1.0)
+
+    a_mat = ssp.csr_matrix((vals, (rows, cols)), shape=(n_con, n))
+    lb_var = [0.0] * n
+    ub_var = [1.0] * n_x + [_INF] * (n - n_x)
+    if minimize == OptimizeTarget.COST:
+        ub_var = [1.0] * n
+    res = sopt.milp(
+        c=cost, integrality=integrality,
+        bounds=sopt.Bounds(lb_var, ub_var),
+        constraints=sopt.LinearConstraint(a_mat, lb_con, ub_con))
+    if not res.success:  # pragma: no cover - HiGHS on a feasible model
+        logger.warning('ILP failed (%s); per-task greedy fallback.',
+                       res.message)
+        return {t: _best_plan(per_task[t], minimize) for t in tasks}
+
+    choice = {}
+    for t in tasks:
+        j = int(np.argmax(res.x[offset[t]:offset[t] + len(plans[t])]))
+        choice[t] = plans[t][j]
+    return choice
 
 
 def _print_plan_table(choice: Dict[object, LaunchablePlan]) -> None:

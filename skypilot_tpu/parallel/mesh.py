@@ -99,6 +99,62 @@ def build_mesh(spec: MeshSpec,
     return Mesh(dev_array, MESH_AXES)
 
 
+def build_hybrid_mesh(ici: MeshSpec, dcn: MeshSpec,
+                      devices: Optional[Sequence[jax.Device]] = None,
+                      num_slices: Optional[int] = None) -> Mesh:
+    """Multi-slice mesh: `ici` axes live within a slice (fast ICI
+    torus), `dcn` axes cross slices (data-center network). Final mesh
+    axis size = ici_axis * dcn_axis, DCN-major — so e.g.
+    ici=MeshSpec(fsdp=4), dcn=MeshSpec(dp=2) over 2 slices of 4 chips
+    gives a (dp=2, fsdp=4) mesh whose dp collectives ride DCN and fsdp
+    collectives ride ICI. This is the multi-slice/megascale analog of
+    the reference's multi-node NCCL-over-Ethernet
+    (examples/nccl_test.yaml); SURVEY.md §5 "Distributed communication
+    backend".
+
+    Real TPU slices are detected via device.slice_index (set by the
+    runtime under multi-slice env vars — runtime/gang.py exports them);
+    CPU/test devices are chunked into `num_slices` contiguous groups so
+    the same code dry-runs on a forced-host-platform mesh.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n_dcn = dcn.num_devices
+    n_ici = ici.num_devices
+    if num_slices is None:
+        slice_ids = {getattr(d, 'slice_index', 0) for d in devices}
+        num_slices = len(slice_ids) if len(slice_ids) > 1 else n_dcn
+    if n_dcn != num_slices:
+        raise ValueError(
+            f'dcn spec {dcn} needs {n_dcn} slices, have {num_slices}')
+    if n_ici * n_dcn > len(devices):
+        raise ValueError(
+            f'{ici} x {dcn} needs {n_ici * n_dcn} devices, '
+            f'have {len(devices)}')
+
+    have_slice_attr = len({getattr(d, 'slice_index', 0)
+                           for d in devices}) > 1
+    if have_slice_attr:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici.shape, dcn.shape, devices=devices,
+            allow_split_physical_axes=True)
+    else:
+        # Emulated slices: contiguous device chunks. Shape the array as
+        # dcn_axes + ici_axes, then interleave to (dcn_0, ici_0, ...)
+        # and merge each pair — identical semantics to
+        # mesh_utils.create_hybrid_device_mesh.
+        arr = np.array(devices[:n_ici * n_dcn]).reshape(
+            dcn.shape + ici.shape)
+        order = []
+        for i in range(len(MESH_AXES)):
+            order += [i, i + len(MESH_AXES)]
+        arr = arr.transpose(order)
+        dev_array = arr.reshape(tuple(
+            d * i for d, i in zip(dcn.shape, ici.shape)))
+    return Mesh(dev_array, MESH_AXES)
+
+
 def auto_spec(n_devices: int,
               tp: Optional[int] = None,
               fsdp: Optional[int] = None,

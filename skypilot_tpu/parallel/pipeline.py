@@ -65,6 +65,8 @@ def pipeline_apply(
         # body produces pp-varying values (ppermute / stage-dependent
         # writes), and scan requires carry types to be invariant.
         def _vary(x):
+            if hasattr(jax.lax, 'pcast'):  # jax >= 0.9
+                return jax.lax.pcast(x, ('pp',), to='varying')
             try:
                 return jax.lax.pvary(x, ('pp',))
             except AttributeError:  # older jax: no varying-axis types

@@ -57,6 +57,14 @@ def bootstrap_config(config: common.ProvisionConfig
     # Keep node network tags in provider_config so open_ports (which only
     # receives provider_config) targets the same tags.
     pc.setdefault('tags', config.node_config.get('tags', ['skyt']))
+    if 'ssh_private_key' not in pc:
+        # The private half of whatever public key went into node
+        # metadata (backends/tpu_backend.py _public_key), so command
+        # runners can actually connect (sky/authentication.py parity).
+        from skypilot_tpu import authentication
+        key = authentication.private_key_path()
+        if key:
+            pc['ssh_private_key'] = key
     return config
 
 

@@ -11,7 +11,6 @@ Start (done by the provisioner over SSH / local runner):
 The process daemonizes; its pid is written to ~/.skyt/agent.pid.
 """
 import argparse
-import json
 import os
 import signal
 import sys

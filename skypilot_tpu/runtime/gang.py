@@ -33,8 +33,13 @@ def job_env_vars(
     coordinator_port: int = DEFAULT_COORDINATOR_PORT,
     user_envs: Optional[Dict[str, str]] = None,
     export_jax_coordinator: Optional[bool] = None,
+    num_slices: int = 1,
 ) -> Dict[str, str]:
-    """Build the full env for one rank of a gang job."""
+    """Build the full env for one rank of a gang job.
+
+    num_slices > 1: hosts are split into contiguous per-slice groups
+    (rank order) and each rank additionally gets the MEGASCALE_* DCN
+    contract (multislice_env_vars)."""
     num_nodes = len(ips)
     head_ip = ips[0]
     coord = f'{head_ip}:{coordinator_port}'
@@ -70,7 +75,41 @@ def job_env_vars(
             'JAX_NUM_PROCESSES': str(num_nodes),
             'JAX_PROCESS_ID': str(rank),
         })
+    if num_slices > 1:
+        if num_nodes % num_slices != 0:
+            raise ValueError(
+                f'num_slices={num_slices} must divide '
+                f'num_nodes={num_nodes}')
+        hosts_per_slice = num_nodes // num_slices
+        env.update(multislice_env_vars(
+            slice_id=rank // hosts_per_slice,
+            num_slices=num_slices,
+            coordinator_ip=head_ip))
     return env
+
+
+DEFAULT_MEGASCALE_PORT = 8080
+
+
+def multislice_env_vars(*, slice_id: int, num_slices: int,
+                        coordinator_ip: str,
+                        port: int = DEFAULT_MEGASCALE_PORT
+                        ) -> Dict[str, str]:
+    """Megascale env for one host of a multi-slice deployment.
+
+    These are the inter-slice (DCN) analog of the JAX coordinator
+    triplet: the TPU runtime reads MEGASCALE_* to bring up the
+    inter-slice transport, after which XLA collectives whose mesh axes
+    cross slices (parallel/mesh.py build_hybrid_mesh dcn axes) ride DCN
+    transparently. Reference's equivalent layer is NCCL-over-Ethernet
+    env wiring (examples/nccl_test.yaml); SURVEY.md §5.
+    """
+    return {
+        'MEGASCALE_COORDINATOR_ADDRESS': f'{coordinator_ip}:{port}',
+        'MEGASCALE_NUM_SLICES': str(num_slices),
+        'MEGASCALE_SLICE_ID': str(slice_id),
+        'MEGASCALE_PORT': str(port),
+    }
 
 
 def spec_env_for_rank(spec: Dict[str, Any], rank: int,
@@ -86,4 +125,5 @@ def spec_env_for_rank(spec: Dict[str, Any], rank: int,
         coordinator_port=spec.get('coordinator_port',
                                   DEFAULT_COORDINATOR_PORT),
         user_envs=spec.get('envs'),
+        num_slices=spec.get('num_slices', 1),
     )

@@ -7,9 +7,7 @@ reconcile/probe work runs on plain threads so the HTTP loop never blocks
 on cluster operations).
 """
 import asyncio
-import json
 import threading
-import time
 from typing import Optional
 
 from aiohttp import web

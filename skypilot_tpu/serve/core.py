@@ -36,49 +36,119 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _two_free_ports() -> 'tuple[int, int]':
+    """Two distinct free ports: both probe sockets held open together so
+    the OS cannot hand out the same port twice."""
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(('127.0.0.1', 0))
+        s2.bind(('127.0.0.1', 0))
+        return s1.getsockname()[1], s2.getsockname()[1]
+
+
 def _controller_url(svc: Dict[str, Any]) -> str:
     return f'http://127.0.0.1:{svc["controller_port"]}'
 
 
 def up(task: Any, service_name: Optional[str] = None,
-       wait_ready_timeout: float = 0.0) -> Tuple[str, str]:
+       wait_ready_timeout: float = 0.0,
+       controller: Optional[str] = None) -> Tuple[str, str]:
     """Start a service; returns (service_name, endpoint).
 
-    Reference: sky/serve/core.py:94 up."""
+    Reference: sky/serve/core.py:94 up.
+
+    controller: 'process' (default) runs controller+LB as a detached
+    client-side process; 'cluster' launches them as a job on the shared
+    controller cluster (the reference's sky-serve-controller VM,
+    sky/serve/core.py:94-300) so the service survives the client.
+    Override default via SKYT_SERVE_CONTROLLER or config key
+    serve.controller.mode."""
     if task.service is None:
         raise exceptions.InvalidTaskError(
             'Task needs a `service:` section for serve up.')
     if task.run is None:
         raise exceptions.InvalidTaskError(
             'Service task needs a `run` command.')
+    if controller is None:
+        from skypilot_tpu import skyt_config
+        controller = os.environ.get(
+            'SKYT_SERVE_CONTROLLER',
+            skyt_config.get_nested(('serve', 'controller', 'mode'),
+                                   'process'))
+    if controller not in ('process', 'cluster'):
+        # Validate before add_service: a typo must not leave the service
+        # name taken with nothing running.
+        raise exceptions.NotSupportedError(
+            f"serve controller must be 'process' or 'cluster', got "
+            f'{controller!r}')
     service_name = service_name or task.name or 'service'
     task_yaml = os.path.join(_serve_dir(), f'{service_name}.task.yaml')
     with open(task_yaml, 'w', encoding='utf-8') as f:
         yaml.safe_dump(task.to_yaml_config(), f, sort_keys=False)
 
-    controller_port, lb_port = _free_port(), _free_port()
+    controller_port, lb_port = _two_free_ports()
     if not serve_state.add_service(service_name, task.service, task_yaml,
                                    controller_port, lb_port):
         raise exceptions.NotSupportedError(
             f'Service {service_name!r} already exists. Use '
             f'`serve update` to change it or `serve down` first.')
 
-    log_path = os.path.join(_serve_dir(), f'{service_name}.log')
-    with open(log_path, 'ab') as logf:
-        proc = subprocess.Popen(  # pylint: disable=consider-using-with
-            [sys.executable, '-m', 'skypilot_tpu.serve.service',
-             '--service-name', service_name],
-            stdout=logf, stderr=subprocess.STDOUT,
-            stdin=subprocess.DEVNULL, env=dict(os.environ),
-            start_new_session=True)
-    serve_state.set_service_controller_pid(service_name, proc.pid)
-    endpoint = f'http://127.0.0.1:{lb_port}'
-    logger.info('Service %s starting: endpoint %s (controller pid %d, '
-                'logs %s)', service_name, endpoint, proc.pid, log_path)
+    if controller == 'cluster':
+        _launch_controller_on_cluster(service_name)
+        endpoint = f'http://127.0.0.1:{lb_port}'
+    else:
+        log_path = os.path.join(_serve_dir(), f'{service_name}.log')
+        with open(log_path, 'ab') as logf:
+            proc = subprocess.Popen(  # pylint: disable=consider-using-with
+                [sys.executable, '-m', 'skypilot_tpu.serve.service',
+                 '--service-name', service_name],
+                stdout=logf, stderr=subprocess.STDOUT,
+                stdin=subprocess.DEVNULL, env=dict(os.environ),
+                start_new_session=True)
+        serve_state.set_service_controller_pid(service_name, proc.pid)
+        endpoint = f'http://127.0.0.1:{lb_port}'
+        logger.info('Service %s starting: endpoint %s (controller pid '
+                    '%d, logs %s)', service_name, endpoint, proc.pid,
+                    log_path)
     if wait_ready_timeout > 0:
         _wait_status(service_name, serve_state.ServiceStatus.READY,
                      wait_ready_timeout)
     return service_name, endpoint
+
+
+SERVE_CONTROLLER_CLUSTER = 'skyt-serve-controller'
+
+
+def _launch_controller_on_cluster(service_name: str) -> None:
+    """Run the service (controller + LB) as a job on the shared serve
+    controller cluster — the reference's sky-serve-controller VM
+    recursion (sky/serve/core.py:195 launches the controller task via
+    sky.launch). On the local provider the controller shares the client
+    state DB via env passthrough; a cloud VM keeps its own."""
+    import sys
+
+    from skypilot_tpu import execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import skyt_config
+    from skypilot_tpu import task as task_lib
+
+    res_cfg = skyt_config.get_nested(
+        ('serve', 'controller', 'resources'), {'cpus': '4+'})
+    envs = {k: os.environ[k]
+            for k in ('SKYT_STATE_DIR', 'SKYT_LOCAL_ROOT',
+                      'SKYT_DEFAULT_STORE',
+                      'SKYT_SERVE_CONTROLLER_INTERVAL',
+                      'SKYT_SERVE_LB_SYNC_INTERVAL')
+            if k in os.environ}
+    ctask = task_lib.Task(
+        name=f'serve-controller-{service_name}',
+        run=(f'exec {sys.executable} -m skypilot_tpu.serve.service '
+             f'--service-name {service_name}'),
+        envs=envs)
+    ctask.set_resources(resources_lib.Resources(**res_cfg))
+    execution.launch(ctask, cluster_name=SERVE_CONTROLLER_CLUSTER,
+                     detach_run=True, stream_logs=False)
+    logger.info('Service %s: controller running on cluster %s',
+                service_name, SERVE_CONTROLLER_CLUSTER)
 
 
 def _wait_status(service_name: str, want: serve_state.ServiceStatus,

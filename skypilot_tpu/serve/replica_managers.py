@@ -72,6 +72,54 @@ class ReplicaManager:
         self._next_id = max(self.replicas, default=0) + 1
         self._threads: Dict[int, threading.Thread] = {}
         self._lock = threading.RLock()
+        self._recover_orphans()
+
+    def _recover_orphans(self) -> None:
+        """Reconcile persisted replicas after a controller restart.
+
+        Launch intent is persisted (PROVISIONING row) *before* the launch
+        thread starts, so a controller killed mid-launch leaves rows
+        whose threads are gone. On restart: rows whose cluster actually
+        exists are kept (the prober advances them); rows whose cluster
+        never materialized are torn down + dropped so reconcile()
+        relaunches to target. Reference: the supervised process pool in
+        sky/serve/replica_managers.py:940-1019 rediscovers launch
+        processes the same way.
+        """
+        from skypilot_tpu import state as cluster_state
+        for info in list(self.replicas.values()):
+            if info.status not in (serve_state.ReplicaStatus.PROVISIONING,
+                                   serve_state.ReplicaStatus.STARTING,
+                                   serve_state.ReplicaStatus.SHUTTING_DOWN):
+                continue
+            record = cluster_state.get_cluster(info.cluster_name)
+            if info.status is serve_state.ReplicaStatus.SHUTTING_DOWN or \
+                    record is None:
+                logger.info('recovering orphaned replica %d (%s, '
+                            'cluster %s): terminating',
+                            info.replica_id, info.status.value,
+                            'present' if record else 'absent')
+                info.status = serve_state.ReplicaStatus.SHUTTING_DOWN
+                self._save(info)
+                threading.Thread(target=self._terminate_thread,
+                                 args=(info,), daemon=True).start()
+            else:
+                # Cluster exists: recompute the endpoint and let the
+                # prober drive it to READY.
+                try:
+                    handle = record['handle']
+                    head = handle.cluster_info.ordered()[0]
+                    if info.endpoint is None:
+                        info.endpoint = f'http://{head.get_feasible_ip()}:80'
+                    info.status = serve_state.ReplicaStatus.STARTING
+                    self._save(info)
+                    logger.info('recovered replica %d (cluster alive)',
+                                info.replica_id)
+                except Exception:  # pylint: disable=broad-except
+                    logger.warning('replica %d unrecoverable; dropping',
+                                   info.replica_id)
+                    threading.Thread(target=self._terminate_thread,
+                                     args=(info,), daemon=True).start()
 
     # ------------------------------------------------------------ persist
     def _save(self, info: ReplicaInfo) -> None:
@@ -272,6 +320,11 @@ class ReplicaManager:
                     for _ in range(target - len(cur_version)):
                         self.launch_replica()
                 n_keep_old = max(0, target - new_ready)
+                # Keep READY old replicas (serving capacity) and retire
+                # NOT_READY/STARTING ones first.
+                old_version.sort(
+                    key=lambda r: r.status is not
+                    serve_state.ReplicaStatus.READY)
                 for info in old_version[n_keep_old:]:
                     self.terminate_replica(info.replica_id)
                 return

@@ -34,9 +34,13 @@ async def _serve(service_name: str) -> None:
         policy=getattr(spec, 'load_balancing_policy', None)
         or 'round_robin')
 
+    # Controller admin API (terminate/update_service) is unauthenticated
+    # by design (reference parity) — bind loopback only; every legit
+    # client (serve/core.py, the LB) connects via 127.0.0.1. Only the
+    # load balancer is the externally reachable endpoint.
     controller_runner = web.AppRunner(controller.make_app())
     await controller_runner.setup()
-    await web.TCPSite(controller_runner, '0.0.0.0',
+    await web.TCPSite(controller_runner, '127.0.0.1',
                       svc['controller_port']).start()
     lb_runner = web.AppRunner(lb.make_app())
     await lb_runner.setup()

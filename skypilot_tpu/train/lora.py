@@ -1,0 +1,165 @@
+"""LoRA adapters: parameter-efficient finetuning, the TPU-native rebuild
+of the reference's llm/llama-3_1-finetuning/lora.yaml (torchtune LoRA).
+
+Functional design (no model surgery): adapters live in a *separate*
+pytree shaped like {path: {'a': [in, r], 'b': [r, out]}} for every
+targeted kernel; the train step merges W + (alpha/r) * A @ B on the fly
+inside the jitted forward — XLA fuses the low-rank update into the
+matmul's producer, and the optimizer/grad machinery only ever sees the
+adapter tree (frozen base params are captured as constants). Scanned
+layer stacks (models/llama.py nn.scan) just get a leading [L] axis on A
+and B.
+
+B initializes to zero so step 0 is exactly the base model.
+"""
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# Default targets: every linear in attention + MLP (torchtune's
+# lora_attn_modules + apply_lora_to_mlp equivalent).
+DEFAULT_TARGETS = ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down')
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Sequence[str] = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _target_kernels(params: Dict[str, Any], cfg: LoRAConfig):
+    """Yield (path_tuple, kernel) for every targeted Dense kernel."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        keys = tuple(
+            k.key for k in path
+            if isinstance(k, jax.tree_util.DictKey))
+        if not keys or keys[-1] != 'kernel':
+            continue
+        if len(keys) >= 2 and keys[-2] in cfg.targets:
+            yield keys, leaf
+
+
+def init_lora_params(params: Dict[str, Any], cfg: LoRAConfig,
+                     rng: jax.Array) -> Dict[str, Any]:
+    """Adapter tree for `params` (the raw {'params': ...}['params'] or
+    boxed tree — boxes are read through). A ~ N(0, 1/rank), B = 0."""
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+    lora: Dict[str, Any] = {}
+    n_adapted = 0
+    for keys, kernel in _target_kernels(params, cfg):
+        *prefix, in_dim, out_dim = kernel.shape
+        rng, sub = jax.random.split(rng)
+        a = jax.random.normal(
+            sub, (*prefix, in_dim, cfg.rank),
+            dtype=kernel.dtype) * (1.0 / cfg.rank)
+        b = jnp.zeros((*prefix, cfg.rank, out_dim), kernel.dtype)
+        node = lora
+        for k in keys[:-1]:
+            node = node.setdefault(k, {})
+        node['kernel'] = {'a': a, 'b': b}
+        n_adapted += 1
+    if n_adapted == 0:
+        raise ValueError(
+            f'no kernels matched LoRA targets {cfg.targets!r}')
+    logger.info('LoRA: %d adapted kernels, rank=%d alpha=%.1f',
+                n_adapted, cfg.rank, cfg.alpha)
+    return lora
+
+
+def merge_lora(params: Dict[str, Any], lora: Dict[str, Any],
+               cfg: LoRAConfig) -> Dict[str, Any]:
+    """params with W := W + scaling * A @ B for every adapted kernel.
+    Runs inside jit — the merge is fused, nothing persists."""
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+
+    def walk(p_node, l_node):
+        out = {}
+        for k, v in p_node.items():
+            if k in l_node and isinstance(l_node[k], dict) and \
+                    set(l_node[k].keys()) == {'a', 'b'}:
+                ab = l_node[k]
+                delta = jnp.einsum('...ir,...ro->...io', ab['a'], ab['b'])
+                out[k] = v + cfg.scaling * delta.astype(v.dtype)
+            elif k in l_node and isinstance(v, dict):
+                out[k] = walk(v, l_node[k])
+            else:
+                out[k] = v
+        return out
+
+    return walk(params, lora)
+
+
+def make_lora_train_step(model, frozen_params: Dict[str, Any], tx,
+                         mesh, cfg: LoRAConfig,
+                         rules=None):
+    """Jitted (lora_state, batch) -> (lora_state, metrics); gradients and
+    optimizer state cover ONLY the adapter tree. Mirrors
+    trainer.make_train_step."""
+    import flax.linen as nn
+    import optax
+
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import trainer
+
+    if rules is None:
+        rules = sharding_lib.DEFAULT_RULES
+    frozen = nn.meta.unbox(frozen_params)
+    batch_axes = ('act_batch', 'act_seq')
+
+    def step_fn(state: 'trainer.TrainStateS', batch):
+        batch = {k: sharding_lib.constrain(v, mesh, batch_axes, rules)
+                 for k, v in batch.items()}
+
+        def loss_fn(lora):
+            merged = merge_lora(frozen, lora, cfg)
+            logits = model.apply({'params': merged}, batch['tokens'],
+                                 segment_ids=batch.get('segment_ids'))
+            loss, n_tok = trainer.cross_entropy_loss(logits,
+                                                     batch['targets'])
+            return loss, n_tok
+
+        (loss, n_tok), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads, tx)
+        metrics = {'loss': loss, 'tokens': n_tok,
+                   'grad_norm': optax.global_norm(grads)}
+        return new_state, metrics
+
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+
+    def wrapped(state, batch):
+        with mesh, nn.logical_axis_rules(list(rules)):
+            return jitted(state, batch)
+
+    return wrapped
+
+
+def create_lora_state(model, frozen_params, tx, cfg: LoRAConfig,
+                      rng: jax.Array) -> 'Any':
+    """TrainStateS over the adapter tree only (step, lora params,
+    optimizer state). Adapters are tiny; they stay replicated — the
+    base params keep whatever sharding they were loaded with."""
+    from skypilot_tpu.train import trainer
+
+    lora = init_lora_params(frozen_params, cfg, rng)
+    return trainer.TrainStateS(step=jnp.zeros((), jnp.int32),
+                               params=lora, opt_state=tx.init(lora))
+
+
+def num_lora_params(lora: Dict[str, Any]) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(lora))

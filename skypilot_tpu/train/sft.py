@@ -88,6 +88,11 @@ def main(argv=None) -> None:
     parser.add_argument('--lr', type=float, default=3e-4)
     parser.add_argument('--data', default=None,
                         help='JSONL path; default synthetic')
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='> 0 enables LoRA: only adapter params '
+                             'train (reference: llm/llama-3_1-finetuning'
+                             '/lora.yaml)')
+    parser.add_argument('--lora-alpha', type=float, default=16.0)
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
     parser.add_argument('--resume', default='auto',
@@ -136,6 +141,17 @@ def main(argv=None) -> None:
     state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
                                             jax.random.PRNGKey(0))
 
+    lora_cfg = None
+    if args.lora_rank > 0:
+        from skypilot_tpu.train import lora as lora_lib
+        lora_cfg = lora_lib.LoRAConfig(rank=args.lora_rank,
+                                       alpha=args.lora_alpha)
+        frozen = state.params
+        state = lora_lib.create_lora_state(model, frozen, tx, lora_cfg,
+                                           jax.random.PRNGKey(1))
+        logger.info('LoRA: %d trainable params',
+                    lora_lib.num_lora_params(state.params))
+
     ckpt = None
     start_step = 0
     if args.checkpoint_dir:
@@ -150,7 +166,12 @@ def main(argv=None) -> None:
                 start_step = int(jax.device_get(state.step))
                 logger.info('resumed from step %d', start_step)
 
-    step_fn = trainer.make_train_step(model, tx, mesh)
+    if lora_cfg is not None:
+        from skypilot_tpu.train import lora as lora_lib
+        step_fn = lora_lib.make_lora_train_step(model, frozen, tx, mesh,
+                                                lora_cfg)
+    else:
+        step_fn = trainer.make_train_step(model, tx, mesh)
     batches = (jsonl_batches(args.data, cfg.vocab_size, args.batch,
                              args.seq)
                if args.data else

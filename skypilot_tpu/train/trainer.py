@@ -7,7 +7,6 @@ code runs DP, FSDP, TP, CP, EP or any product of them by changing the mesh,
 with XLA inserting all collectives over ICI/DCN.
 """
 import dataclasses
-import functools
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
@@ -15,7 +14,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from skypilot_tpu.parallel import sharding as sharding_lib
 

@@ -1,0 +1,69 @@
+"""SSH key lifecycle tests (reference: sky/authentication.py)."""
+import os
+import stat
+
+import pytest
+
+from skypilot_tpu import authentication
+
+
+@pytest.fixture
+def fresh_home(tmp_path, monkeypatch):
+    """A HOME with no ~/.ssh at all — the first-run machine."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    yield home
+
+
+def test_generates_keypair_on_fresh_home(fresh_home):
+    priv, pub = authentication.get_or_generate_keypair()
+    assert os.path.exists(priv)
+    assert os.path.exists(priv + '.pub')
+    assert pub.split()[0] in ('ssh-ed25519', 'ssh-rsa')
+    mode = stat.S_IMODE(os.stat(priv).st_mode)
+    assert mode == 0o600
+    ssh_dir = os.path.dirname(priv)
+    assert stat.S_IMODE(os.stat(ssh_dir).st_mode) == 0o700
+
+
+def test_generation_is_idempotent(fresh_home):
+    priv1, pub1 = authentication.get_or_generate_keypair()
+    with open(priv1, 'rb') as f:
+        key_bytes = f.read()
+    priv2, pub2 = authentication.get_or_generate_keypair()
+    assert (priv1, pub1) == (priv2, pub2)
+    with open(priv2, 'rb') as f:
+        assert f.read() == key_bytes
+
+
+def test_public_key_prefers_existing_user_key(fresh_home):
+    ssh = fresh_home / '.ssh'
+    ssh.mkdir(mode=0o700)
+    (ssh / 'id_ed25519.pub').write_text('ssh-ed25519 AAAA user@host\n')
+    (ssh / 'id_ed25519').write_text('fake-private\n')
+    assert authentication.public_key() == 'ssh-ed25519 AAAA user@host'
+    # No skyt-key generated when a user key exists.
+    assert not (ssh / 'skyt-key').exists()
+    assert authentication.private_key_path() == str(ssh / 'id_ed25519')
+
+
+def test_private_key_matches_generated(fresh_home):
+    priv, _ = authentication.get_or_generate_keypair()
+    assert authentication.private_key_path() == priv
+
+
+def test_half_present_pair_regenerated(fresh_home):
+    ssh = fresh_home / '.ssh'
+    ssh.mkdir(mode=0o700)
+    (ssh / 'skyt-key').write_text('orphaned private half\n')
+    priv, pub = authentication.get_or_generate_keypair()
+    with open(priv, 'r', encoding='utf-8') as f:
+        assert 'orphaned' not in f.read()
+    assert pub
+
+
+def test_backend_public_key_generates(fresh_home, tmp_state_dir):
+    from skypilot_tpu.backends import tpu_backend
+    pub = tpu_backend._public_key()
+    assert pub and pub.split()[0] in ('ssh-ed25519', 'ssh-rsa')

@@ -1,0 +1,64 @@
+"""Cloud-capability guards on cluster lifecycle ops (reference:
+CloudImplementationFeatures, sky/clouds/cloud.py:27; TPU-pod stop block
+sky/clouds/gcp.py:184-190)."""
+import pytest
+
+from skypilot_tpu import core, exceptions
+from skypilot_tpu import resources as resources_lib
+
+
+class _FakeHandle:
+    def __init__(self, res):
+        self.cluster_name = 'c'
+        self.launched_resources = res
+
+
+def _patch_handle(monkeypatch, res):
+    monkeypatch.setattr(core, '_handle_or_raise',
+                        lambda name: _FakeHandle(res))
+    calls = []
+
+    class _FakeBackend:
+        def teardown(self, handle, terminate=False, purge=False):
+            calls.append(('teardown', terminate))
+
+        def set_autostop(self, handle, idle, down):
+            calls.append(('autostop', idle, down))
+
+    monkeypatch.setattr(core, '_backend', lambda: _FakeBackend())
+    return calls
+
+
+def test_stop_blocked_for_tpu_pod(monkeypatch, tmp_state_dir):
+    res = resources_lib.Resources(cloud='gcp',
+                                  accelerators='tpu-v5e-16')
+    calls = _patch_handle(monkeypatch, res)
+    with pytest.raises(exceptions.NotSupportedError):
+        core.stop('c')
+    assert not calls
+
+
+def test_stop_allowed_for_single_host_tpu(monkeypatch, tmp_state_dir):
+    res = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-4')
+    calls = _patch_handle(monkeypatch, res)
+    core.stop('c')
+    assert calls == [('teardown', False)]
+
+
+def test_autostop_stop_mode_blocked_for_pod(monkeypatch, tmp_state_dir):
+    res = resources_lib.Resources(cloud='gcp',
+                                  accelerators='tpu-v5e-16')
+    calls = _patch_handle(monkeypatch, res)
+    with pytest.raises(exceptions.NotSupportedError):
+        core.autostop('c', 10, down=False)
+    # Autodown is fine (delete is always supported).
+    core.autostop('c', 10, down=True)
+    assert calls == [('autostop', 10, True)]
+
+
+def test_autostop_cancel_never_blocked(monkeypatch, tmp_state_dir):
+    res = resources_lib.Resources(cloud='gcp',
+                                  accelerators='tpu-v5e-16')
+    calls = _patch_handle(monkeypatch, res)
+    core.autostop('c', -1, down=False)
+    assert calls == [('autostop', -1, False)]

@@ -15,7 +15,6 @@ from skypilot_tpu import execution
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import state
-from skypilot_tpu.backends import backend_utils
 from skypilot_tpu.cli import cli
 
 

@@ -1,0 +1,102 @@
+"""Catalog fetcher tests: static emit + live-API SKU parsing against a
+canned Billing Catalog payload (no network; reference:
+sky/clouds/service_catalog/data_fetchers/fetch_gcp.py)."""
+import csv
+
+from skypilot_tpu.catalog.data_fetchers import fetch_gcp
+
+
+class _FakeResp:
+    def __init__(self, payload):
+        self._payload = payload
+
+    def raise_for_status(self):
+        pass
+
+    def json(self):
+        return self._payload
+
+
+class _FakeSession:
+    """Two pages of SKUs, exercising pagination."""
+
+    def __init__(self):
+        self.pages = [
+            {'skus': [
+                {'description': 'Tpu v5e hourly',
+                 'category': {'usageType': 'OnDemand'},
+                 'serviceRegions': ['us-central1'],
+                 'pricingInfo': [{'pricingExpression': {'tieredRates': [
+                     {'unitPrice': {'units': '1', 'nanos': 500000000}},
+                 ]}}]},
+                {'description': 'Preemptible Tpu v5e hourly',
+                 'category': {'usageType': 'Preemptible'},
+                 'serviceRegions': ['us-central1'],
+                 'pricingInfo': [{'pricingExpression': {'tieredRates': [
+                     {'unitPrice': {'units': '0', 'nanos': 600000000}},
+                 ]}}]},
+                {'description': 'Commitment v1: Tpu v5e for 1 year',
+                 'category': {'usageType': 'Commit1Yr'},
+                 'serviceRegions': ['us-central1'],
+                 'pricingInfo': [{'pricingExpression': {'tieredRates': [
+                     {'unitPrice': {'units': '0', 'nanos': 100000000}},
+                 ]}}]},
+            ], 'nextPageToken': 'p2'},
+            {'skus': [
+                {'description': 'N2 Instance Core running in Americas',
+                 'category': {'usageType': 'OnDemand'},
+                 'serviceRegions': ['us-central1'],
+                 'pricingInfo': [{'pricingExpression': {'tieredRates': [
+                     {'unitPrice': {'units': '0', 'nanos': 31000000}},
+                 ]}}]},
+            ]},
+        ]
+        self.calls = []
+
+    def get(self, url, params=None, timeout=None):
+        self.calls.append(params)
+        page = 1 if params.get('pageToken') else 0
+        return _FakeResp(self.pages[page])
+
+
+def test_static_emit_covers_expected_families(tmp_path):
+    out = tmp_path / 'gcp.csv'
+    n = fetch_gcp.emit_static(str(out))
+    assert n > 100
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    names = {r['AcceleratorName'] for r in rows}
+    assert 'tpu-v5e-16' in names
+    assert 'A100' in names
+    assert any(r['InstanceType'] == 'n2-standard-4' for r in rows)
+
+
+def test_sku_parse_pagination_and_filtering():
+    session = _FakeSession()
+    skus = list(fetch_gcp.iter_skus('key', session=session))
+    assert len(skus) == 4
+    assert len(session.calls) == 2
+    assert session.calls[1]['pageToken'] == 'p2'
+
+    prices = fetch_gcp.tpu_chip_prices(skus)
+    assert prices[('v5e', 'us-central1', False)] == 1.5
+    assert prices[('v5e', 'us-central1', True)] == 0.6
+    # Commitment SKU skipped; non-TPU SKU skipped.
+    assert len(prices) == 2
+
+
+def test_emit_from_api_overrides_prices(tmp_path):
+    out = tmp_path / 'gcp.csv'
+    n = fetch_gcp.emit_from_api(str(out), 'key', session=_FakeSession())
+    assert n > 100
+    with open(out) as f:
+        rows = list(csv.DictReader(f))
+    v5e16 = [r for r in rows if r['AcceleratorName'] == 'tpu-v5e-16'
+             and r['Region'] == 'us-central1'][0]
+    # 16 chips x live $1.50 (static table says $1.20).
+    assert float(v5e16['Price']) == 24.0
+    assert float(v5e16['SpotPrice']) == 9.6
+    # Regions without live SKUs keep static prices.
+    other = [r for r in rows if r['AcceleratorName'] == 'tpu-v5e-16'
+             and r['Region'] == 'europe-west4'][0]
+    assert float(other['Price']) == 19.2

@@ -6,7 +6,6 @@ import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import pytest
 import requests
 
@@ -36,6 +35,39 @@ def _reference_greedy(model, params, prompt, n_new):
         out.append(nxt)
         toks.append(nxt)
     return out
+
+
+def test_moe_cached_decode_matches_full_recompute():
+    """Mixtral (MoE) through the same engine: KV-cache decode must equal
+    full-context recompute (reference serves Mixtral via vLLM,
+    llm/mixtral/serve.yaml; here it is first-class)."""
+    import dataclasses
+
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    cfg = dataclasses.replace(cfg, max_seq_len=64)
+    # Dropless capacity: with a finite capacity factor the GShard router
+    # drops tokens as a function of the *batch shape*, so padded prefill
+    # vs incremental recompute would legitimately diverge. Serving wants
+    # shape-invariant outputs -> capacity >= worst case.
+    moe_cfg = dataclasses.replace(moe_cfg, capacity_factor=8.0)
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    prompt = [5, 17, 3, 99, 42]
+    want = _reference_greedy(model, params, prompt, 6)
+
+    eng = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16])
+    eng.start()
+    try:
+        got = eng.generate(prompt, engine_lib.SamplingParams(
+            max_new_tokens=6))
+    finally:
+        eng.stop()
+    assert got == want
 
 
 def test_cached_decode_matches_full_recompute(small_model):

@@ -1,0 +1,14 @@
+"""Keep the tree lint-clean: tools/lint.py must pass (the reference
+gates CI on format.sh; SURVEY.md §4.6)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_lint_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'lint.py')],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, f'lint issues:\n{proc.stdout}'

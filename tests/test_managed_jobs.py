@@ -105,6 +105,64 @@ def test_managed_job_preemption_recovery(jobs_env):
     assert job['recovery_count'] >= 1
 
 
+@pytest.fixture()
+def cluster_controller_env(jobs_env, tmp_path, monkeypatch):
+    """Controller-on-cluster mode with local-provider controller
+    resources (reference: jobs-controller VM)."""
+    cfg = tmp_path / 'skyt_config.yaml'
+    cfg.write_text(
+        'jobs:\n  controller:\n    resources:\n      cloud: local\n')
+    monkeypatch.setenv('SKYT_CONFIG', str(cfg))
+    from skypilot_tpu import skyt_config
+    skyt_config.reload_for_testing()
+    yield
+    skyt_config.reload_for_testing()
+
+
+def test_managed_job_cluster_controller_survives_client(
+        cluster_controller_env):
+    """Controller runs as a job on the controller cluster: no client pid
+    anywhere in the job row, so nothing dies with the client
+    (reference: sky/jobs/core.py:30-137 controller-VM launch)."""
+    t = _local_task('mj-vm', 'echo via-controller-cluster')
+    jid = jobs_core.launch(t, retry_until_up=False,
+                           controller='cluster')
+    job = jobs_state.get_job(jid)
+    assert job['controller_cluster'] == 'skyt-jobs-controller'
+    assert not job.get('controller_pid')
+    # queue() must not declare a pid-less cluster controller dead.
+    assert all(r['status'] != jobs_state.ManagedJobStatus.FAILED_CONTROLLER
+               for r in jobs_core.queue())
+    job = jobs_core.wait(jid, timeout=90)
+    assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    # The controller cluster itself is alive and reusable.
+    assert state.get_cluster('skyt-jobs-controller') is not None
+
+
+def test_managed_job_cluster_controller_recovers_preemption(
+        cluster_controller_env):
+    """Full recovery semantics through the cluster-hosted controller:
+    kill the job cluster mid-run; the controller (itself a cluster job,
+    with the client idle) relaunches it."""
+    t = _local_task('mj-vmrec', 'sleep 4 && echo done')
+    jid = jobs_core.launch(t, retry_until_up=False,
+                           controller='cluster')
+    cluster = f'mj-vmrec-{jid}'
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        job = jobs_state.get_job(jid)
+        if job['status'] == jobs_state.ManagedJobStatus.RUNNING and \
+                state.get_cluster(cluster) is not None:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f'job never RUNNING: {jobs_state.get_job(jid)}')
+    core.down(cluster, purge=True)
+    job = jobs_core.wait(jid, timeout=90)
+    assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    assert job['recovery_count'] >= 1
+
+
 def test_managed_job_cancel(jobs_env):
     t = _local_task('mj-cxl', 'sleep 300')
     jid = jobs_core.launch(t, retry_until_up=False)

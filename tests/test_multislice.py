@@ -1,0 +1,108 @@
+"""Multi-slice (DCN) mesh and megascale env tests on the 8-device CPU
+mesh: 2 emulated slices of 4 devices each."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.runtime import gang
+
+
+class TestHybridMesh:
+    def test_axis_sizes_multiply(self):
+        mesh = mesh_lib.build_hybrid_mesh(
+            mesh_lib.MeshSpec(fsdp=2, tp=2), mesh_lib.MeshSpec(dp=2),
+            num_slices=2)
+        assert mesh.shape['dp'] == 2
+        assert mesh.shape['fsdp'] == 2
+        assert mesh.shape['tp'] == 2
+
+    def test_dp_crosses_slices(self):
+        """The dcn axis (dp) must span the two emulated slice chunks;
+        the ici axes (fsdp, tp) must stay within one chunk."""
+        devices = jax.devices()[:8]
+        slice_of = {id(d): i // 4 for i, d in enumerate(devices)}
+        mesh = mesh_lib.build_hybrid_mesh(
+            mesh_lib.MeshSpec(fsdp=2, tp=2), mesh_lib.MeshSpec(dp=2),
+            devices=devices, num_slices=2)
+        arr = mesh.devices  # [pp, dp, cp, fsdp, ep, tp]
+        # Fix all ici coords; walking dp must change slice.
+        for f in range(2):
+            for t in range(2):
+                slices = {slice_of[id(arr[0, dpi, 0, f, 0, t])]
+                          for dpi in range(2)}
+                assert slices == {0, 1}, 'dp does not cross slices'
+        # Fix dp; walking fsdp/tp must stay within one slice.
+        for dpi in range(2):
+            slices = {slice_of[id(arr[0, dpi, 0, f, 0, t])]
+                      for f in range(2) for t in range(2)}
+            assert len(slices) == 1, 'ici axes leak across slices'
+
+    def test_pp_dcn_axis(self):
+        mesh = mesh_lib.build_hybrid_mesh(
+            mesh_lib.MeshSpec(tp=4), mesh_lib.MeshSpec(pp=2),
+            num_slices=2)
+        assert mesh.shape['pp'] == 2
+        assert mesh.shape['tp'] == 4
+
+    def test_wrong_slice_count_raises(self):
+        with pytest.raises(ValueError):
+            mesh_lib.build_hybrid_mesh(
+                mesh_lib.MeshSpec(tp=2), mesh_lib.MeshSpec(dp=4),
+                num_slices=2)
+
+    def test_train_step_on_hybrid_mesh(self):
+        """A full sharded train step where dp crosses the slice
+        boundary — the dry-run proof that multi-slice sharding compiles
+        and executes."""
+        from skypilot_tpu.models import llama
+        from skypilot_tpu.train import trainer
+
+        mesh = mesh_lib.build_hybrid_mesh(
+            mesh_lib.MeshSpec(fsdp=2, tp=2), mesh_lib.MeshSpec(dp=2),
+            num_slices=2)
+        cfg = llama.CONFIGS['debug']
+        model = llama.LlamaModel(cfg)
+        tcfg = trainer.TrainerConfig(warmup_steps=1, total_steps=4)
+        tx = trainer.make_optimizer(tcfg)
+        sample = jnp.zeros((4, 64), jnp.int32)
+        state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
+                                                jax.random.PRNGKey(0))
+        step = trainer.make_train_step(model, tx, mesh, donate=False)
+        rng = np.random.default_rng(0)
+        data = {'tokens': jnp.array(rng.integers(0, cfg.vocab_size,
+                                                 (4, 64)), jnp.int32),
+                'targets': jnp.array(rng.integers(0, cfg.vocab_size,
+                                                  (4, 64)), jnp.int32)}
+        state, metrics = step(state, data)
+        assert np.isfinite(float(metrics['loss']))
+
+
+class TestMegascaleEnv:
+    def test_multislice_env_vars(self):
+        env = gang.multislice_env_vars(slice_id=1, num_slices=2,
+                                       coordinator_ip='10.0.0.1')
+        assert env['MEGASCALE_COORDINATOR_ADDRESS'] == '10.0.0.1:8080'
+        assert env['MEGASCALE_NUM_SLICES'] == '2'
+        assert env['MEGASCALE_SLICE_ID'] == '1'
+
+    def test_job_env_with_slices(self):
+        ips = [f'10.0.0.{i}' for i in range(4)]
+        env = gang.job_env_vars(job_id=1, rank=3, ips=ips,
+                                cluster_name='c', num_slices=2)
+        assert env['MEGASCALE_SLICE_ID'] == '1'  # rank 3 of 2x2
+        assert env['MEGASCALE_NUM_SLICES'] == '2'
+        assert env['JAX_PROCESS_ID'] == '3'
+
+    def test_job_env_single_slice_no_megascale(self):
+        env = gang.job_env_vars(job_id=1, rank=0,
+                                ips=['10.0.0.1', '10.0.0.2'],
+                                cluster_name='c')
+        assert 'MEGASCALE_NUM_SLICES' not in env
+
+    def test_bad_slice_division_raises(self):
+        with pytest.raises(ValueError):
+            gang.job_env_vars(job_id=1, rank=0,
+                              ips=['a', 'b', 'c'], cluster_name='c',
+                              num_slices=2)

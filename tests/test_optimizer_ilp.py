@@ -1,0 +1,177 @@
+"""General-DAG ILP optimizer tests, including random-DAG brute-force
+equivalence (reference: tests/test_optimizer_random_dag.py)."""
+import itertools
+import types
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from skypilot_tpu import Dag, Task
+from skypilot_tpu.optimizer import (LaunchablePlan, OptimizeTarget,
+                                    _egress_cost_per_gb,
+                                    _optimize_general_ilp)
+
+
+def _plan(cloud, region, hourly, runtime_s):
+    res = types.SimpleNamespace(cloud=cloud, region=region, zone=None)
+    return LaunchablePlan(resources=res, hourly_cost=hourly,
+                          estimated_runtime_s=runtime_s)
+
+
+def _cost_objective(dag, tasks, assign):
+    total = sum(assign[t].estimated_cost for t in tasks)
+    for (u, v) in dag.graph.edges:
+        out_gb = getattr(u, 'output_size_gb', 0.0) or 0.0
+        total += _egress_cost_per_gb(assign[u].resources,
+                                     assign[v].resources) * out_gb
+    return total
+
+
+def _makespan(dag, tasks, assign):
+    finish = {}
+    for t in nx.topological_sort(dag.graph):
+        start = max((finish[u] for u in dag.graph.predecessors(t)),
+                    default=0.0)
+        finish[t] = start + assign[t].estimated_runtime_s
+    return max(finish.values())
+
+
+def _brute_force(dag, tasks, per_task, objective):
+    best, best_assign = None, None
+    for combo in itertools.product(*(per_task[t] for t in tasks)):
+        assign = dict(zip(tasks, combo))
+        val = objective(dag, tasks, assign)
+        if best is None or val < best - 1e-12:
+            best, best_assign = val, assign
+    return best, best_assign
+
+
+def _diamond():
+    """a -> (b, c) -> d: the canonical non-chain DAG."""
+    with Dag() as dag:
+        a, b, c, d = (Task(n, run='x') for n in 'abcd')
+    for t in (a, b, c, d):
+        t.output_size_gb = 10.0
+    dag.add_edge(a, b)
+    dag.add_edge(a, c)
+    dag.add_edge(b, d)
+    dag.add_edge(c, d)
+    return dag, [a, b, c, d]
+
+
+class TestGeneralDagILP:
+    def test_cost_prefers_colocation(self):
+        dag, tasks = _diamond()
+        # Root task is gcp-only; every other task is individually
+        # cheaper on aws, but 10 GB x $0.12/GB cross-cloud egress per
+        # cut edge beats the $0.10 per-task saving -> all-gcp wins.
+        # (A per-task greedy would pick aws for b/c/d.)
+        per_task = {t: [_plan('gcp', 'us-central1', 1.1, 3600),
+                        _plan('aws', 'us-east-1', 1.0, 3600)]
+                    for t in tasks}
+        per_task[tasks[0]] = [_plan('gcp', 'us-central1', 1.1, 3600)]
+        choice = _optimize_general_ilp(dag, tasks, per_task,
+                                       OptimizeTarget.COST)
+        clouds = {choice[t].resources.cloud for t in tasks}
+        assert clouds == {'gcp'}
+
+    def test_cost_ignores_egress_when_outputs_tiny(self):
+        dag, tasks = _diamond()
+        for t in tasks:
+            t.output_size_gb = 0.0
+        per_task = {t: [_plan('gcp', 'us-central1', 1.1, 3600),
+                        _plan('aws', 'us-east-1', 1.0, 3600)]
+                    for t in tasks}
+        choice = _optimize_general_ilp(dag, tasks, per_task,
+                                       OptimizeTarget.COST)
+        clouds = {choice[t].resources.cloud for t in tasks}
+        assert clouds == {'aws'}
+
+    def test_time_minimizes_makespan(self):
+        dag, tasks = _diamond()
+        # Critical path runs through b (slow option cheap, fast option
+        # exists); TIME target must take the fast one on the critical
+        # path but is free to keep c slow.
+        per_task = {
+            tasks[0]: [_plan('gcp', 'r', 1.0, 100)],
+            tasks[1]: [_plan('gcp', 'r', 1.0, 5000),
+                       _plan('gcp', 'r', 8.0, 500)],
+            tasks[2]: [_plan('gcp', 'r', 1.0, 400)],
+            tasks[3]: [_plan('gcp', 'r', 1.0, 100)],
+        }
+        choice = _optimize_general_ilp(dag, tasks, per_task,
+                                       OptimizeTarget.TIME)
+        want, _ = _brute_force(dag, tasks, per_task, _makespan)
+        got = _makespan(dag, tasks, choice)
+        assert got == pytest.approx(want)
+        assert choice[tasks[1]].estimated_runtime_s == 500
+
+    @pytest.mark.parametrize('seed', range(6))
+    def test_random_dag_cost_matches_bruteforce(self, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(3, 6))
+        with Dag() as dag:
+            tasks = [Task(f't{i}', run='x') for i in range(n)]
+        for i, t in enumerate(tasks):
+            t.output_size_gb = float(rng.uniform(0, 50))
+            for j in range(i + 1, n):
+                if rng.random() < 0.5:
+                    dag.add_edge(t, tasks[j])
+        assert not dag.is_chain() or n <= 2 or True
+        clouds = [('gcp', 'us-central1'), ('gcp', 'europe-west4'),
+                  ('aws', 'us-east-1')]
+        per_task = {}
+        for t in tasks:
+            k = int(rng.integers(2, 4))
+            per_task[t] = [
+                _plan(*clouds[int(rng.integers(0, len(clouds)))],
+                      float(rng.uniform(0.5, 5.0)),
+                      float(rng.uniform(600, 7200)))
+                for _ in range(k)]
+        choice = _optimize_general_ilp(dag, tasks, per_task,
+                                       OptimizeTarget.COST)
+        want, _ = _brute_force(dag, tasks, per_task, _cost_objective)
+        got = _cost_objective(dag, tasks, choice)
+        assert got == pytest.approx(want, rel=1e-9)
+
+    @pytest.mark.parametrize('seed', range(3))
+    def test_random_dag_time_matches_bruteforce(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        n = int(rng.integers(3, 6))
+        with Dag() as dag:
+            tasks = [Task(f't{i}', run='x') for i in range(n)]
+        for i, t in enumerate(tasks):
+            for j in range(i + 1, n):
+                if rng.random() < 0.5:
+                    dag.add_edge(t, tasks[j])
+        per_task = {t: [_plan('gcp', 'r', 1.0,
+                              float(rng.uniform(100, 5000)))
+                        for _ in range(int(rng.integers(2, 4)))]
+                    for t in tasks}
+        choice = _optimize_general_ilp(dag, tasks, per_task,
+                                       OptimizeTarget.TIME)
+        want, _ = _brute_force(dag, tasks, per_task, _makespan)
+        got = _makespan(dag, tasks, choice)
+        assert got == pytest.approx(want, rel=1e-9)
+
+    def test_end_to_end_nonchain_dag(self, tmp_state_dir):
+        """Full Optimizer.optimize on a non-chain DAG over the real
+        catalog path."""
+        from skypilot_tpu import Resources, state
+        from skypilot_tpu.optimizer import Optimizer
+        state.set_enabled_clouds(['gcp', 'local'])
+        with Dag() as dag:
+            a = Task('a', run='x')
+            b = Task('b', run='x')
+            c = Task('c', run='x')
+            d = Task('d', run='x')
+            for t in (a, b, c, d):
+                t.set_resources(Resources(cpus='2+'))
+        dag.add_edge(a, b)
+        dag.add_edge(a, c)
+        dag.add_edge(b, d)
+        dag.add_edge(c, d)
+        Optimizer.optimize(dag, quiet=True)
+        for t in (a, b, c, d):
+            assert t.best_resources is not None

@@ -143,6 +143,87 @@ def _wait_ready(name, want_ready, timeout=90):
                 f'{serve_core.status([name])}')
 
 
+def test_replica_manager_recovers_orphans(serve_env):
+    """Controller killed mid-launch: the persisted PROVISIONING row has
+    no cluster. A fresh manager (restart) must tear the orphan down so
+    reconcile() can relaunch to target
+    (reference: sky/serve/replica_managers.py:940-1019 supervision)."""
+    from skypilot_tpu.serve import replica_managers
+
+    spec = spec_lib.ServiceSpec(readiness_path='/', min_replicas=1)
+    serve_state.add_service('osvc', spec, '/tmp/nonexistent.yaml', 1, 2)
+    # Simulate the dead controller's persisted launch intent.
+    orphan = replica_managers.ReplicaInfo(
+        replica_id=1, cluster_name='osvc-1', version=1,
+        status=serve_state.ReplicaStatus.PROVISIONING)
+    serve_state.upsert_replica('osvc', 1, orphan)
+
+    mgr = replica_managers.ReplicaManager('osvc', spec,
+                                          '/tmp/nonexistent.yaml')
+    deadline = time.time() + 10
+    while time.time() < deadline and 1 in mgr.replicas:
+        time.sleep(0.1)
+    assert 1 not in mgr.replicas, 'orphan not reconciled'
+    assert all(r.replica_id != 1
+               for r in serve_state.get_replicas('osvc'))
+
+
+def test_replica_manager_keeps_live_cluster_on_restart(serve_env):
+    """Mid-launch rows whose cluster DID come up are adopted as
+    STARTING, not torn down."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import execution
+    from skypilot_tpu.serve import replica_managers
+
+    t = sky.Task(name='osvc2-1', run='true')
+    t.set_resources(resources_lib.Resources(cloud='local'))
+    execution.launch(t, cluster_name='osvc2-1', detach_run=True,
+                     stream_logs=False)
+
+    spec = spec_lib.ServiceSpec(readiness_path='/', min_replicas=1)
+    serve_state.add_service('osvc2', spec, '/tmp/nonexistent.yaml', 3, 4)
+    row = replica_managers.ReplicaInfo(
+        replica_id=1, cluster_name='osvc2-1', version=1,
+        status=serve_state.ReplicaStatus.PROVISIONING)
+    serve_state.upsert_replica('osvc2', 1, row)
+
+    mgr = replica_managers.ReplicaManager('osvc2', spec,
+                                          '/tmp/nonexistent.yaml')
+    assert 1 in mgr.replicas
+    assert mgr.replicas[1].status is serve_state.ReplicaStatus.STARTING
+    assert mgr.replicas[1].endpoint is not None
+
+
+@pytest.mark.integration
+def test_serve_cluster_controller(serve_env, tmp_path, monkeypatch):
+    """Controller+LB run as a job on the serve controller cluster (the
+    reference's sky-serve-controller VM): no client-side controller
+    pid; service serves and tears down normally."""
+    cfg = tmp_path / 'skyt_config.yaml'
+    cfg.write_text(
+        'serve:\n  controller:\n    resources:\n      cloud: local\n')
+    monkeypatch.setenv('SKYT_CONFIG', str(cfg))
+    from skypilot_tpu import skyt_config
+    skyt_config.reload_for_testing()
+    try:
+        name, endpoint = serve_core.up(_service_task(min_replicas=1),
+                                       'csvc', controller='cluster')
+        svc = serve_state.get_service('csvc')
+        assert not svc.get('controller_pid')
+        _wait_ready(name, 1)
+        resp = requests.get(endpoint, timeout=5)
+        assert resp.status_code == 200
+        assert resp.text.startswith('hello-from-')
+        assert state.get_cluster('skyt-serve-controller') is not None
+        serve_core.down(name)
+        deadline = time.time() + 60
+        while time.time() < deadline and serve_state.get_service(name):
+            time.sleep(0.5)
+        assert serve_state.get_service(name) is None
+    finally:
+        skyt_config.reload_for_testing()
+
+
 @pytest.mark.integration
 def test_serve_lifecycle(serve_env):
     name, endpoint = serve_core.up(_service_task(min_replicas=2), 'svc')

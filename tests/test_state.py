@@ -1,5 +1,4 @@
 """State DB tests (mirrors reference tests/test_global_user_state.py)."""
-import pickle
 
 from skypilot_tpu import state
 

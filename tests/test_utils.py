@@ -1,5 +1,4 @@
 """Tests for cross-cutting utils: command runners, config, subprocess."""
-import os
 
 import pytest
 

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Dependency-free linter (the image ships no ruff/pylint/mypy; the
+reference gates commits on format.sh — this is the offline equivalent).
+
+Checks:
+  * syntax (ast.parse)
+  * unused imports (module scope and function scope, string-match
+    aware for __all__/docstring re-exports)
+  * tabs and trailing whitespace
+  * lines over the limit (default 88)
+
+Exit 0 = clean. Used by format.sh and tests/test_lint.py.
+"""
+import ast
+import re
+import sys
+from pathlib import Path
+
+LINE_LIMIT = 88
+
+# Imports that exist for side effects or re-export by convention.
+_SIDE_EFFECT_OK = {'skypilot_tpu', 'conftest'}
+
+
+def _imported_names(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split('.')[0]
+                yield node.lineno, alias.name, name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == '__future__':
+                continue
+            for alias in node.names:
+                if alias.name == '*':
+                    continue
+                name = alias.asname or alias.name
+                yield node.lineno, alias.name, name
+
+
+def check_file(path: Path):
+    issues = []
+    src = path.read_text(encoding='utf-8')
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f'{path}:{e.lineno}: syntax error: {e.msg}']
+
+    is_init = path.name == '__init__.py'
+    lines = src.splitlines()
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # base captured via its Name node
+    # Names referenced inside strings (docstring examples, __all__).
+    text_blob = src
+    if not is_init:
+        for lineno, _full, name in _imported_names(tree):
+            if name in used or name in _SIDE_EFFECT_OK:
+                continue
+            if lineno <= len(lines) and 'noqa' in lines[lineno - 1]:
+                continue
+            # String annotations ('spec_lib.ServiceSpec') and __all__.
+            if re.search(rf'[\'"]{re.escape(name)}\b', text_blob):
+                continue
+            issues.append(f'{path}:{lineno}: unused import {name!r}')
+
+    for i, line in enumerate(src.splitlines(), 1):
+        if '\t' in line:
+            issues.append(f'{path}:{i}: tab character')
+        if line != line.rstrip():
+            issues.append(f'{path}:{i}: trailing whitespace')
+        if len(line) > LINE_LIMIT and 'http' not in line and \
+                'noqa' not in line and 'pylint:' not in line:
+            issues.append(f'{path}:{i}: line too long '
+                          f'({len(line)} > {LINE_LIMIT})')
+    return issues
+
+
+def main(argv):
+    roots = argv or ['skypilot_tpu', 'tests', 'tools', 'bench.py',
+                     '__graft_entry__.py']
+    files = []
+    for root in roots:
+        p = Path(root)
+        if p.is_dir():
+            files += sorted(p.rglob('*.py'))
+        elif p.exists():
+            files.append(p)
+    all_issues = []
+    for f in files:
+        if '__pycache__' in str(f):
+            continue
+        all_issues += check_file(f)
+    for issue in all_issues:
+        print(issue)
+    print(f'{len(files)} files checked, {len(all_issues)} issue(s)')
+    return 1 if all_issues else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
