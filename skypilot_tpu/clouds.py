@@ -172,9 +172,11 @@ class Local(Cloud):
     NAME = 'local'
 
     def features(self) -> frozenset:
+        # STOP is real: the local provider persists instance state and
+        # implements stop_instances (provision/local/instance.py).
         return frozenset({
-            CloudFeature.MULTI_NODE, CloudFeature.AUTOSTOP,
-            CloudFeature.OPEN_PORTS,
+            CloudFeature.STOP, CloudFeature.MULTI_NODE,
+            CloudFeature.AUTOSTOP, CloudFeature.OPEN_PORTS,
         })
 
     def regions(self) -> List[Region]:

