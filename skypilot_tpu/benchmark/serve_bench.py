@@ -88,6 +88,7 @@ def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
             ttfts.extend(t)
 
         # Phase 2: saturated burst.
+        engine.reset_perf()
         submitted = []
         t_start = time.perf_counter()
         for _ in range(cfg.num_requests):
@@ -97,6 +98,7 @@ def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
             submitted.append((time.perf_counter(), q))
         loaded_ttfts, total_tokens = drain(submitted)
         t_total = time.perf_counter() - t_start
+        perf = engine.perf_stats()
     finally:
         if own_engine:
             engine.stop()
@@ -107,7 +109,12 @@ def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
         'p50_ttft_ms': float(np.percentile(ttfts_ms, 50)),
         'p99_ttft_ms': float(np.percentile(ttfts_ms, 99)),
         'p50_ttft_loaded_ms': float(np.percentile(loaded_ms, 50)),
+        # Wall-clock rate over the whole burst (prefills included) — a
+        # capacity number, NOT decode speed.
         'decode_tok_per_sec': total_tokens / t_total,
+        # Steady-state pipelined decode rate, prefill/admission excluded
+        # (engine pull-to-pull accounting) — the decode-speed number.
+        'decode_tok_per_sec_steady': perf['steady_decode_tok_per_sec'],
         'requests_per_sec': cfg.num_requests / t_total,
         'total_time_s': t_total,
     }

@@ -171,9 +171,33 @@ def queue(cluster, skip_finished):
 @click.option('--no-follow', is_flag=True, default=False)
 @click.option('--sync-down', is_flag=True, default=False,
               help='Download logs instead of streaming.')
-def logs(cluster, job_id, no_follow, sync_down):
-    """Tail job logs. Reference: sky logs."""
+@click.option('--profile', is_flag=True, default=False,
+              help='Download the job\'s jax.profiler trace (the job must '
+                   'have run with SKYT_PROFILE=1 in its envs).')
+def logs(cluster, job_id, no_follow, sync_down, profile):
+    """Tail job logs. Reference: sky logs; --profile is the SURVEY §5
+    jax.profiler collection the reference lacks."""
+    import os
+
     from skypilot_tpu import core
+    if profile:
+        import glob as glob_mod
+        if job_id is None:
+            raise click.UsageError('--profile needs a JOB_ID')
+        path = core.download_logs(cluster, job_id)
+        # Logs land per host (host-<rank>/...); traces live inside them.
+        prof_dirs = sorted(
+            glob_mod.glob(os.path.join(path, '*', 'profile')) +
+            glob_mod.glob(os.path.join(path, 'profile')))
+        if not prof_dirs:
+            raise click.ClickException(
+                f'no profile trace in job {job_id} logs — launch with '
+                'env SKYT_PROFILE=1 (envs: {SKYT_PROFILE: 1} in the task '
+                'YAML) to collect one')
+        for d in prof_dirs:
+            click.echo(f'Profile trace synced to {d}')
+        click.echo(f'View: tensorboard --logdir {prof_dirs[0]}')
+        return
     if sync_down:
         if job_id is None:
             raise click.UsageError('--sync-down needs a JOB_ID')

@@ -116,6 +116,11 @@ class Worker:
         workdir = os.path.join(job_lib.agent_home(), 'skyt_workdir')
         if os.path.isdir(workdir):
             env.setdefault('SKYT_WORKDIR', workdir)
+        if env.get('SKYT_PROFILE') not in (None, '', '0'):
+            # jax.profiler traces land INSIDE the job's log dir, so the
+            # existing sync-down path ships them (`skyt logs --profile`).
+            env.setdefault('SKYT_PROFILE_DIR',
+                           os.path.join(log_dir, 'profile', f'rank-{rank}'))
 
         setup = spec.get('setup')
         if setup:
@@ -148,6 +153,23 @@ class Worker:
                                     stderr=subprocess.STDOUT,
                                     start_new_session=True, text=True)
             rj.pid = proc.pid
+            # Orphan reaper: if THIS agent dies (crash/SIGKILL) the job
+            # session would outlive it holding chips; a stdlib-only
+            # sibling watches both pids and kills the job's process
+            # group when the agent disappears (reference:
+            # sky/skylet/subprocess_daemon.py). Exits on its own when
+            # the job finishes.
+            reaper = subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.runtime.reaper',
+                 '--parent-pid', str(os.getpid()),
+                 '--target-pid', str(proc.pid)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True)
+            # Reap finished reapers so they don't sit as zombies in this
+            # long-running agent's process table.
+            self._reapers = [r for r in getattr(self, '_reapers', [])
+                             if r.poll() is None]
+            self._reapers.append(reaper)
             assert proc.stdout is not None
             for line in proc.stdout:
                 log_file.write(line)

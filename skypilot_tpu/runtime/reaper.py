@@ -1,0 +1,94 @@
+"""Orphan reaper: kills a job's process group when its agent dies.
+
+The agent runs every job in its own session (start_new_session=True), so
+an agent crash/SIGKILL would orphan the job tree and leave TPU chips
+held by a process nobody tracks. The agent therefore spawns one reaper
+per tracked job; the reaper watches BOTH pids and:
+
+  * exits quietly when the job finishes (normal case);
+  * SIGTERMs, then after a grace period SIGKILLs, the job's process
+    group when the agent disappears (orphan case).
+
+Reference analog: sky/skylet/subprocess_daemon.py (psutil-based parent
+wait). This one is stdlib-only (os.kill(pid, 0) liveness probes) so it
+runs in any environment the agent itself runs in.
+"""
+import argparse
+import os
+import signal
+import sys
+import time
+
+POLL_INTERVAL_S = 1.0
+TERM_GRACE_S = 5.0
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass
+    # A SIGKILLed agent whose parent hasn't waited on it yet is a zombie:
+    # kill(pid, 0) still succeeds, but the agent is gone and its jobs are
+    # orphans — treat Z as dead. (comm can contain spaces/parens, so
+    # split at the LAST ')'.)
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            return f.read().rsplit(')', 1)[1].split()[0] != 'Z'
+    except OSError:
+        return True
+
+
+def _group_alive(pgid: int) -> bool:
+    try:
+        os.killpg(pgid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+def _kill_group(pgid: int, sig: int) -> None:
+    try:
+        os.killpg(pgid, sig)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def reap(parent_pid: int, target_pid: int,
+         poll_interval: float = POLL_INTERVAL_S,
+         term_grace: float = TERM_GRACE_S) -> int:
+    """Watch loop. Returns 0 when the job group is gone."""
+    # The job was started with start_new_session=True, so its pid IS its
+    # process-group id.
+    pgid = target_pid
+    while True:
+        if not _group_alive(pgid):
+            return 0
+        if not _alive(parent_pid):
+            _kill_group(pgid, signal.SIGTERM)
+            deadline = time.time() + term_grace
+            while time.time() < deadline and _group_alive(pgid):
+                time.sleep(0.2)
+            _kill_group(pgid, signal.SIGKILL)
+            return 0
+        time.sleep(poll_interval)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--parent-pid', type=int, required=True)
+    parser.add_argument('--target-pid', type=int, required=True)
+    parser.add_argument('--poll-interval', type=float,
+                        default=POLL_INTERVAL_S)
+    parser.add_argument('--term-grace', type=float, default=TERM_GRACE_S)
+    args = parser.parse_args(argv)
+    return reap(args.parent_pid, args.target_pid, args.poll_interval,
+                args.term_grace)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

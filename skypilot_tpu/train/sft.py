@@ -177,19 +177,28 @@ def main(argv=None) -> None:
                if args.data else
                synthetic_batches(cfg.vocab_size, args.batch, args.seq))
 
+    from skypilot_tpu.utils import profiling
+    prof = profiling.StepProfiler()   # no-op unless SKYT_PROFILE_DIR set
+
     t0 = time.perf_counter()
     tokens_seen = 0
-    for step in range(start_step, args.steps):
-        batch = next(batches)
-        state, metrics = step_fn(state, batch)
-        tokens_seen += args.batch * args.seq * jax.process_count()
-        if ckpt is not None:
-            ckpt.save(step + 1, state)
-        if (step + 1) % args.log_every == 0:
-            loss = float(jax.device_get(metrics['loss']))
-            dt = time.perf_counter() - t0
-            logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
-                        step + 1, args.steps, loss, tokens_seen / dt)
+    try:
+        for step in range(start_step, args.steps):
+            prof.on_step(step - start_step)
+            batch = next(batches)
+            state, metrics = step_fn(state, batch)
+            tokens_seen += args.batch * args.seq * jax.process_count()
+            if ckpt is not None:
+                ckpt.save(step + 1, state)
+            if (step + 1) % args.log_every == 0:
+                loss = float(jax.device_get(metrics['loss']))
+                dt = time.perf_counter() - t0
+                logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
+                            step + 1, args.steps, loss, tokens_seen / dt)
+    finally:
+        # A crash inside the profiled window must still flush the trace
+        # — the failing run is the one most worth profiling.
+        prof.stop()
     if ckpt is not None:
         if ckpt.latest_step() != args.steps:
             ckpt.save(args.steps, state, force=True)

@@ -1,0 +1,72 @@
+"""jax.profiler trace collection for jobs (SURVEY.md §5 plan:
+`skyt logs --profile`; beats the reference's client-only Chrome timeline,
+sky/utils/timeline.py:21, which never sees device time).
+
+Env contract (set per-job by the agent, runtime/agent.py):
+  SKYT_PROFILE         "1" on the *launch* side requests profiling;
+  SKYT_PROFILE_DIR     where the trace lands — the agent points this
+                       inside the job's log dir so the existing
+                       `skyt logs --sync-down` machinery ships traces
+                       with no extra transport;
+  SKYT_PROFILE_START_STEP   first profiled step, default 2 (skip
+                            compile);
+  SKYT_PROFILE_NUM_STEPS    profiled step count, default 3.
+
+The trace is TensorBoard-loadable (plugins/profile/<ts>/*.xplane.pb):
+`tensorboard --logdir <dir>` -> Profile tab, or xprof. Training loops
+call `StepProfiler.on_step(i)` at the top of every step and `stop()`
+after the loop; both are no-ops unless SKYT_PROFILE_DIR is set, so the
+hook costs nothing in production runs.
+"""
+import os
+from typing import Optional
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+class StepProfiler:
+    """Profiles steps [start, start + num) of a training loop."""
+
+    def __init__(self, trace_dir: Optional[str] = None) -> None:
+        self.trace_dir = trace_dir or os.environ.get('SKYT_PROFILE_DIR')
+        self.start_step = int(
+            os.environ.get('SKYT_PROFILE_START_STEP', '2'))
+        self.num_steps = int(os.environ.get('SKYT_PROFILE_NUM_STEPS', '3'))
+        self._active = False
+        self._done = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.trace_dir is not None
+
+    def on_step(self, step: int) -> None:
+        """Call at the top of every step with a 0-based loop index."""
+        if not self.enabled or self._done:
+            return
+        if self._active and step >= self.start_step + self.num_steps:
+            self.stop()
+        elif not self._active and step >= self.start_step:
+            import jax
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            logger.info('profiling steps %d..%d -> %s', step,
+                        step + self.num_steps - 1, self.trace_dir)
+
+    def stop(self) -> None:
+        """Idempotent; call after the loop in case it ended mid-trace."""
+        if not self._active:
+            return
+        import jax
+        # Make sure the profiled steps' device work is in the trace, not
+        # still in flight when the collector stops.
+        try:
+            jax.effects_barrier()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+        logger.info('profile trace written to %s', self.trace_dir)

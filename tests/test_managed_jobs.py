@@ -221,3 +221,40 @@ def test_strategy_registry():
     assert make('c', t, 'failover').NAME == 'FAILOVER'
     with pytest.raises(exceptions.ManagedJobError):
         make('c', t, 'nope')
+
+
+def test_probe_narrows_exceptions(monkeypatch):
+    """Only network errors mean 'cluster unreachable'; a programming
+    error in the probe must propagate (and fail the controller) instead
+    of masquerading as a preemption and triggering spurious recovery."""
+    import requests
+
+    from skypilot_tpu import state as cluster_state
+    from skypilot_tpu.jobs import controller as controller_mod
+
+    class _Handle:
+        def __init__(self, exc):
+            self._exc = exc
+
+        def head_client(self):
+            raise self._exc
+
+    probe = controller_mod.JobsController._probe_job_status
+
+    def with_exc(exc):
+        monkeypatch.setattr(cluster_state, 'get_cluster',
+                            lambda name: {'handle': _Handle(exc)})
+        return lambda: probe(object.__new__(controller_mod.JobsController),
+                             'c', 1)
+
+    # Network-ish errors -> None ("unreachable"), the recovery trigger.
+    assert with_exc(requests.ConnectionError('down'))() is None
+    assert with_exc(requests.Timeout('slow'))() is None
+    assert with_exc(OSError('socket'))() is None
+    # Programming errors surface.
+    with pytest.raises(TypeError):
+        with_exc(TypeError('bug'))()
+    # Missing cluster record -> None (cluster gone).
+    monkeypatch.setattr(cluster_state, 'get_cluster', lambda name: None)
+    assert probe(object.__new__(controller_mod.JobsController),
+                 'c', 1) is None

@@ -1,0 +1,98 @@
+"""jax.profiler collection tests (SURVEY.md §5: `skyt logs --profile`).
+
+Tier 1: StepProfiler writes a TensorBoard-loadable trace
+(plugins/profile/<ts>/*.xplane.pb) around the requested steps.
+Tier 2: full path — job launched with SKYT_PROFILE=1 on a local cluster,
+trace collected by the agent env contract, synced down with the logs.
+"""
+import glob
+import os
+import time
+
+import pytest
+
+
+def _xplanes(root: str):
+    return glob.glob(os.path.join(root, '**', '*.xplane.pb'),
+                     recursive=True)
+
+
+def test_step_profiler_writes_trace(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.utils import profiling
+
+    monkeypatch.setenv('SKYT_PROFILE_START_STEP', '1')
+    monkeypatch.setenv('SKYT_PROFILE_NUM_STEPS', '2')
+    prof = profiling.StepProfiler(trace_dir=str(tmp_path / 'trace'))
+    assert prof.enabled
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((64, 64))
+    for step in range(5):
+        prof.on_step(step)
+        f(x).block_until_ready()
+    prof.stop()
+    assert _xplanes(str(tmp_path / 'trace')), 'no xplane.pb written'
+
+
+def test_step_profiler_disabled_is_noop(monkeypatch):
+    from skypilot_tpu.utils import profiling
+
+    monkeypatch.delenv('SKYT_PROFILE_DIR', raising=False)
+    prof = profiling.StepProfiler()
+    assert not prof.enabled
+    for step in range(3):
+        prof.on_step(step)   # must not start a trace
+    prof.stop()
+
+
+@pytest.mark.integration
+def test_profile_synced_down_with_logs(tmp_path, tmp_state_dir,
+                                       monkeypatch):
+    monkeypatch.setenv('SKYT_LOCAL_ROOT', str(tmp_path / 'local'))
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import core, execution
+    from skypilot_tpu import resources as resources_lib
+
+    prog = ("import jax, jax.numpy as jnp\n"
+            "from skypilot_tpu.utils import profiling\n"
+            "prof = profiling.StepProfiler()\n"
+            "assert prof.enabled, 'agent did not set SKYT_PROFILE_DIR'\n"
+            "f = jax.jit(lambda x: (x @ x).sum())\n"
+            "x = jnp.ones((32, 32))\n"
+            "for s in range(5):\n"
+            "    prof.on_step(s)\n"
+            "    f(x).block_until_ready()\n"
+            "prof.stop()\n")
+    script = tmp_path / 'prof_job.py'
+    script.write_text(prog)
+
+    t = sky.Task(name='profjob',
+                 run=f'python {script}',
+                 envs={'SKYT_PROFILE': '1',
+                       'SKYT_PROFILE_START_STEP': '1',
+                       'SKYT_PROFILE_NUM_STEPS': '2'})
+    t.set_resources(resources_lib.Resources(cloud='local'))
+    jid = execution.launch(t, cluster_name='c-prof', detach_run=True)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            status = core.job_status('c-prof', [jid])[jid]
+            if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):
+                break
+            time.sleep(0.5)
+        assert status == 'SUCCEEDED', f'job ended {status}'
+        local = core.download_logs(
+            'c-prof', jid, local_dir=str(tmp_path / 'synced'))
+        # Logs are synced per host: host-<rank>/profile/rank-<r>/...
+        prof_root = os.path.join(local, 'host-0', 'profile')
+        assert os.path.isdir(prof_root), 'profile dir not synced'
+        assert _xplanes(prof_root), 'no xplane.pb in synced trace'
+    finally:
+        core.down('c-prof', purge=True)

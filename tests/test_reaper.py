@@ -1,0 +1,119 @@
+"""Orphan-reaper tests: the job process group must die when its agent
+dies (reference analog: sky/skylet/subprocess_daemon.py).
+
+Two tiers: the reaper process in isolation (fake parent), and the full
+agent path on a local cluster (kill -9 the real agent, assert the job
+tree is reaped).
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.waitpid(pid, os.WNOHANG)
+    except (ChildProcessError, OSError):
+        pass
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    # Direct children linger as zombies until waited; /proc disambiguates.
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            return f.read().split()[2] != 'Z'
+    except OSError:
+        return False
+
+
+def _spawn_reaper(parent_pid: int, target_pid: int) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.runtime.reaper',
+         '--parent-pid', str(parent_pid),
+         '--target-pid', str(target_pid),
+         '--poll-interval', '0.2', '--term-grace', '2'],
+        cwd=REPO, env={**os.environ, 'PYTHONPATH': REPO},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def test_reaper_kills_group_on_parent_death():
+    fake_parent = subprocess.Popen(['sleep', '300'])
+    # Job session: a bash with a child, to prove the whole GROUP dies.
+    job = subprocess.Popen(['bash', '-c', 'sleep 300 & wait'],
+                           start_new_session=True)
+    reaper = _spawn_reaper(fake_parent.pid, job.pid)
+    try:
+        time.sleep(0.5)
+        assert _alive(job.pid)
+        fake_parent.kill()
+        fake_parent.wait()
+        deadline = time.time() + 10
+        while time.time() < deadline and _alive(job.pid):
+            time.sleep(0.2)
+        assert not _alive(job.pid), 'job survived agent death'
+        assert reaper.wait(timeout=10) == 0
+    finally:
+        for p in (fake_parent, job, reaper):
+            try:
+                p.kill()
+            except OSError:
+                pass
+
+
+def test_reaper_exits_when_job_finishes():
+    job = subprocess.Popen(['sleep', '0.3'], start_new_session=True)
+    reaper = _spawn_reaper(os.getpid(), job.pid)
+    try:
+        job.wait()
+        assert reaper.wait(timeout=10) == 0
+    finally:
+        try:
+            reaper.kill()
+        except OSError:
+            pass
+
+
+@pytest.mark.integration
+def test_agent_death_reaps_job(tmp_path, tmp_state_dir, monkeypatch):
+    """kill -9 the real agent of a local cluster; the running job's
+    process tree must be reaped by the spawned reaper."""
+    monkeypatch.setenv('SKYT_LOCAL_ROOT', str(tmp_path / 'local'))
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import core, execution
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.provision.local import instance as local_instance
+
+    pid_file = tmp_path / 'jobpid'
+    t = sky.Task(name='orphan',
+                 run=f'echo $$ > {pid_file}; sleep 300')
+    t.set_resources(resources_lib.Resources(cloud='local'))
+    execution.launch(t, cluster_name='c-orphan', detach_run=True)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not pid_file.exists():
+            time.sleep(0.2)
+        assert pid_file.exists(), 'job never started'
+        job_pid = int(pid_file.read_text().strip())
+        assert _alive(job_pid)
+
+        agent_pid = local_instance._agent_pid('c-orphan', 0)
+        assert agent_pid is not None
+        os.kill(agent_pid, signal.SIGKILL)
+
+        deadline = time.time() + 15
+        while time.time() < deadline and _alive(job_pid):
+            time.sleep(0.3)
+        assert not _alive(job_pid), 'job survived agent SIGKILL'
+    finally:
+        try:
+            core.down('c-orphan', purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
