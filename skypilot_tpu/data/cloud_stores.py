@@ -31,8 +31,15 @@ def download_command(source: str, target: str) -> str:
                 f'cp -a {q_src}/. {q_target}/; else '
                 f'cp -a {q_src} {q_target}/; fi')
     if scheme in ('s3', 'r2'):
+        ep = ''
+        if scheme == 'r2':
+            # Raises when SKYT_R2_ENDPOINT is unset — a silent fallback
+            # would sync from a same-named *AWS* bucket instead of R2.
+            from skypilot_tpu.data import storage as storage_lib
+            ep = f' --endpoint-url {shlex.quote(storage_lib.R2Store.endpoint())}'
+            source = 's3://' + source[len('r2://'):]
         return (f'mkdir -p {q_target} && '
-                f'aws s3 sync {shlex.quote(source)} {q_target}')
+                f'aws s3 sync {shlex.quote(source)} {q_target}{ep}')
     if scheme in ('http', 'https'):
         return (f'mkdir -p {q_target} && cd {q_target} && '
                 f'curl -fsSLO {shlex.quote(source)}')

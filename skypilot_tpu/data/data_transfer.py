@@ -1,0 +1,100 @@
+"""Cloud-to-cloud bucket transfer.
+
+Reference: sky/data/data_transfer.py — GCP Storage Transfer Service for
+s3->gcs. CLI-first here (matching the stores): same-family transfers go
+direct (one rsync/sync process, data never touches this machine twice);
+cross-family transfers stream through a local spool directory using the
+two stores' native CLIs — no Transfer-Service IAM setup, works from any
+machine with both CLIs, and the spool is deleted afterwards.
+
+    transfer('gs://weights', 's3://weights-replica')
+    transfer('s3://raw', 'gs://raw')
+"""
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def _r2_flags() -> List[str]:
+    ep = os.environ.get('SKYT_R2_ENDPOINT',
+                        os.environ.get('R2_ENDPOINT', ''))
+    if not ep:
+        raise exceptions.StorageError(
+            'R2 transfer needs SKYT_R2_ENDPOINT in the environment.')
+    return ['--endpoint-url', ep]
+
+
+def _run(cmd: List[str], failure: str) -> None:
+    logger.info('transfer: %s', ' '.join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          check=False)
+    if proc.returncode != 0:
+        raise exceptions.StorageError(
+            f'{failure}: {" ".join(cmd)!r} failed with '
+            f'{proc.stderr.strip() or proc.stdout.strip()}')
+
+
+def _sync_cmd(scheme: str, src: str, dst: str) -> List[List[str]]:
+    """Command(s) syncing src -> dst where at least one side is a
+    `scheme` URI and the other is a URI of the same family or a local
+    path."""
+    if scheme == 'gs':
+        return [['gsutil', '-m', 'rsync', '-r', src, dst]]
+    if scheme == 's3':
+        return [['aws', 's3', 'sync', src, dst]]
+    if scheme == 'r2':
+        def fix(u: str) -> str:
+            return 's3://' + u[len('r2://'):] if u.startswith('r2://') \
+                else u
+        return [['aws', 's3', 'sync', fix(src), fix(dst), *_r2_flags()]]
+    if scheme == 'local':
+        def path(u: str) -> str:
+            if u.startswith('local://'):
+                _, bucket, sub = data_utils.split_uri(u)
+                p = os.path.join(data_utils.local_store_root(), bucket)
+                return os.path.join(p, sub) if sub else p
+            return u
+        return [['mkdir', '-p', path(dst)],
+                ['cp', '-a', f'{path(src)}/.', f'{path(dst)}/']]
+    raise exceptions.StorageSourceError(
+        f'No transfer strategy for scheme {scheme!r}')
+
+
+def transfer(src_uri: str, dst_uri: str,
+             spool_dir: Optional[str] = None) -> None:
+    """Copy all objects under src_uri to dst_uri.
+
+    Same-family (gs->gs, s3->s3, r2->r2, local->local): direct sync.
+    Cross-family: download into a spool dir, upload, delete the spool.
+    """
+    s_scheme, _, _ = data_utils.split_uri(src_uri)
+    d_scheme, _, _ = data_utils.split_uri(dst_uri)
+    family = {'gs': 'gs', 's3': 's3', 'r2': 'r2', 'local': 'local'}
+    if s_scheme not in family or d_scheme not in family:
+        raise exceptions.StorageSourceError(
+            f'transfer() supports gs/s3/r2/local URIs, got '
+            f'{s_scheme!r} -> {d_scheme!r}')
+
+    if s_scheme == d_scheme:
+        for cmd in _sync_cmd(s_scheme, src_uri, dst_uri):
+            _run(cmd, failure=f'transfer {src_uri} -> {dst_uri}')
+        return
+
+    own_spool = spool_dir is None
+    spool = spool_dir or tempfile.mkdtemp(prefix='skyt-transfer-')
+    try:
+        for cmd in _sync_cmd(s_scheme, src_uri, spool):
+            _run(cmd, failure=f'download {src_uri}')
+        for cmd in _sync_cmd(d_scheme, spool, dst_uri):
+            _run(cmd, failure=f'upload to {dst_uri}')
+    finally:
+        if own_spool:
+            shutil.rmtree(spool, ignore_errors=True)

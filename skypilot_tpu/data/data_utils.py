@@ -12,9 +12,9 @@ from typing import Tuple
 
 from skypilot_tpu import exceptions
 
-CLOUD_SCHEMES = ('gs', 'local')
+CLOUD_SCHEMES = ('gs', 's3', 'r2', 'local')
 # Schemes we can *download from* on a remote host but not manage as stores.
-DOWNLOAD_ONLY_SCHEMES = ('s3', 'r2', 'cos', 'https', 'http')
+DOWNLOAD_ONLY_SCHEMES = ('cos', 'https', 'http')
 
 # GCS bucket naming rules (subset): 3-63 chars, lowercase letters, digits,
 # dashes, underscores, dots; must start/end alphanumeric.

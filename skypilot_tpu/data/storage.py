@@ -30,21 +30,24 @@ logger = log_utils.init_logger(__name__)
 class StoreType(enum.Enum):
     """Reference: sky/data/storage.py:109."""
     GCS = 'GCS'
+    S3 = 'S3'
+    R2 = 'R2'
     LOCAL = 'LOCAL'
 
     @classmethod
     def from_scheme(cls, scheme: str) -> 'StoreType':
-        if scheme == 'gs':
-            return cls.GCS
-        if scheme == 'local':
-            return cls.LOCAL
+        mapping = {'gs': cls.GCS, 's3': cls.S3, 'r2': cls.R2,
+                   'local': cls.LOCAL}
+        if scheme in mapping:
+            return mapping[scheme]
         raise exceptions.StorageSourceError(
             f'No store type for scheme {scheme!r} (managed stores: '
-            f'gs://, local://).')
+            f'gs://, s3://, r2://, local://).')
 
     @property
     def scheme(self) -> str:
-        return {'GCS': 'gs', 'LOCAL': 'local'}[self.value]
+        return {'GCS': 'gs', 'S3': 's3', 'R2': 'r2',
+                'LOCAL': 'local'}[self.value]
 
 
 class StorageMode(enum.Enum):
@@ -180,6 +183,108 @@ class GcsStore(AbstractStore):
                 f'gsutil -m rsync -r gs://{self.name} {target}')
 
 
+class S3Store(AbstractStore):
+    """S3 bucket via the aws CLI (same tool-over-SDK choice as GcsStore's
+    gsutil; the reference's S3Store is boto3, sky/data/storage.py:1080).
+
+    COPY mode is first-class (download_command); MOUNT needs a FUSE
+    binary (goofys) the TPU VM image does not ship — requesting it
+    raises with that explanation (reference mounts via goofys,
+    sky/data/mounting_utils.py:24).
+    """
+
+    store_type = StoreType.S3
+
+    def _aws(self, *args: str) -> List[str]:
+        return ['aws', *args]
+
+    def _endpoint_flags(self) -> List[str]:
+        return []
+
+    def _endpoint_str(self) -> str:
+        return ' '.join(self._endpoint_flags())
+
+    def initialize(self) -> None:
+        if self.exists():
+            self.sky_managed = False
+            return
+        if self.source is not None and data_utils.is_cloud_uri(self.source):
+            raise exceptions.StorageBucketGetError(
+                f'Source bucket {self.source!r} does not exist.')
+        _run(self._aws('s3', 'mb', f's3://{self.name}',
+                       *self._endpoint_flags()),
+             failure=f'Could not create bucket {self.name!r}')
+        self.sky_managed = True
+
+    def exists(self) -> bool:
+        proc = subprocess.run(
+            self._aws('s3api', 'head-bucket', '--bucket', self.name,
+                      *self._endpoint_flags()),
+            capture_output=True, text=True, check=False)
+        return proc.returncode == 0
+
+    def upload(self, source: str) -> None:
+        source = os.path.abspath(os.path.expanduser(source))
+        if os.path.isdir(source):
+            # aws sync --exclude takes globs relative to the source dir;
+            # a bare name must also exclude its contents.
+            flags: List[str] = []
+            for p in storage_utils.get_excluded_files(source):
+                flags += ['--exclude', p, '--exclude', f'{p}/*']
+            _run(self._aws('s3', 'sync', source, f's3://{self.name}',
+                           *flags, *self._endpoint_flags()),
+                 failure=f'Upload to {self.name!r} failed')
+        elif os.path.exists(source):
+            _run(self._aws('s3', 'cp', source, f's3://{self.name}/',
+                           *self._endpoint_flags()),
+                 failure=f'Upload to {self.name!r} failed')
+        else:
+            raise exceptions.StorageUploadError(
+                f'Source {source!r} does not exist')
+
+    def delete(self) -> None:
+        if not self.sky_managed:
+            logger.info('Bucket %s is external; not deleting.', self.name)
+            return
+        _run(self._aws('s3', 'rb', f's3://{self.name}', '--force',
+                       *self._endpoint_flags()),
+             failure=f'Could not delete bucket {self.name!r}')
+
+    def mount_command(self, mount_path: str) -> str:
+        raise exceptions.StorageError(
+            f'MOUNT mode is not supported for {self.store_type.value} '
+            f'stores yet (needs a goofys FUSE binary on the host); use '
+            f'mode: COPY.')
+
+    def download_command(self, target: str) -> str:
+        ep = self._endpoint_str()
+        ep = f' {ep}' if ep else ''
+        return (f'mkdir -p {target} && '
+                f'aws s3 sync s3://{self.name} {target}{ep}')
+
+
+class R2Store(S3Store):
+    """Cloudflare R2: S3-compatible API behind an account endpoint
+    (reference: sky/data/storage.py:2732 — boto3 with profile 'r2').
+    The endpoint comes from SKYT_R2_ENDPOINT (or R2_ENDPOINT), e.g.
+    https://<account_id>.r2.cloudflarestorage.com."""
+
+    store_type = StoreType.R2
+
+    @staticmethod
+    def endpoint() -> str:
+        ep = os.environ.get('SKYT_R2_ENDPOINT',
+                            os.environ.get('R2_ENDPOINT', ''))
+        if not ep:
+            raise exceptions.StorageError(
+                'R2 needs SKYT_R2_ENDPOINT (https://<account_id>.'
+                'r2.cloudflarestorage.com) in the environment.')
+        return ep
+
+    def _endpoint_flags(self) -> List[str]:
+        return ['--endpoint-url', self.endpoint()]
+
+
 class LocalStore(AbstractStore):
     """Directory-backed bucket under SKYT_LOCAL_STORAGE_ROOT.
 
@@ -239,7 +344,8 @@ class LocalStore(AbstractStore):
                 f'cp -a {self.bucket_dir}/. {target}/')
 
 
-_STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.LOCAL: LocalStore}
+_STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store,
+                  StoreType.R2: R2Store, StoreType.LOCAL: LocalStore}
 
 
 def default_store_type() -> StoreType:
@@ -273,11 +379,12 @@ class Storage:
                 'Storage needs a name or a source.')
         if source is not None and data_utils.is_cloud_uri(source):
             scheme, bucket, _ = data_utils.split_uri(source)
-            if scheme not in ('gs', 'local'):
+            if scheme not in ('gs', 's3', 'r2', 'local'):
                 raise exceptions.StorageSourceError(
-                    f'Managed storage supports gs:// and local:// sources; '
-                    f'for one-shot downloads from {scheme}:// use a plain '
-                    f'file_mount (cloud_stores.py).')
+                    f'Managed storage supports gs://, s3://, r2:// and '
+                    f'local:// sources; for one-shot downloads from '
+                    f'{scheme}:// use a plain file_mount '
+                    f'(cloud_stores.py).')
             if name is None:
                 name = bucket
         elif source is not None:

@@ -130,30 +130,47 @@ class PagePool:
         return pool.at[:, page_ids].set(chunk.astype(pool.dtype))
 
     @staticmethod
-    def gather_view(pool, tables):
-        """Materialize the per-slot contiguous KV view.
+    def gather_view_layer(pool, tables):
+        """One layer's per-slot contiguous KV view — THE production
+        gather (models/llama.py paged attention calls this).
 
-        pool:   [L, n_pages, P, H, d]
+        pool:   [n_pages, P, H, d]
         tables: [slots, max_pages] int32
-        -> [L, slots, max_pages*P, H, d]
+        -> [slots, max_pages*P, H, d]
         """
-        l, _, p, h, d = pool.shape
+        _, p, h, d = pool.shape
         slots, mp = tables.shape
-        v = pool[:, tables]                        # [L, slots, mp, P, H, d]
-        return v.reshape(l, slots, mp * p, h, d)
+        return pool[tables].reshape(slots, mp * p, h, d)
 
     @staticmethod
-    def append_token(pool, new_kv, tables, lengths):
-        """Scatter one decoded token's KV for every slot.
+    def append_token_layer(pool, new_kv, tables, lengths):
+        """Scatter one decoded token's KV for every slot, one layer —
+        THE production scatter (models/llama.py paged attention).
 
-        new_kv:  [L, slots, H, d] — the row each slot just wrote at
+        pool:    [n_pages, P, H, d]
+        new_kv:  [slots, H, d] — the row each slot writes at
                  position lengths[slot].
         tables:  [slots, max_pages] int32
-        lengths: [slots] int32 — the position the token was written at.
+        lengths: [slots] int32 — the position the token is written at.
         """
-        p = pool.shape[2]
-        slots = tables.shape[0]
+        p = pool.shape[1]
         page = jnp.take_along_axis(
             tables, (lengths // p)[:, None], axis=1)[:, 0]   # [slots]
         off = lengths % p                                    # [slots]
-        return pool.at[:, page, off].set(new_kv.astype(pool.dtype))
+        return pool.at[page, off].set(new_kv.astype(pool.dtype))
+
+    @staticmethod
+    def gather_view(pool, tables):
+        """All-layer convenience wrapper: [L, n_pages, P, H, d] ->
+        [L, slots, mp*P, H, d]. Single-sourced on the layer kernel."""
+        return jax.vmap(
+            lambda pl: PagePool.gather_view_layer(pl, tables))(pool)
+
+    @staticmethod
+    def append_token(pool, new_kv, tables, lengths):
+        """All-layer convenience wrapper over append_token_layer
+        (pool [L, ...], new_kv [L, slots, H, d])."""
+        return jax.vmap(
+            lambda pl, kv: PagePool.append_token_layer(pl, kv, tables,
+                                                       lengths)
+        )(pool, new_kv)

@@ -126,12 +126,22 @@ def build_engine(model_name: Optional[str] = None,
                  max_seq_len: int = 2048,
                  checkpoint: Optional[str] = None,
                  tp: int = 1,
-                 decode_chunk: int = 16) -> 'engine_lib.InferenceEngine':
+                 decode_chunk: int = 16,
+                 cache_mode: str = 'auto',
+                 pool_tokens: Optional[int] = None,
+                 dtype: str = 'bfloat16'
+                 ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
     checkpoint: HF-format dir (config.json + *.safetensors) — real
     weights, tp-sharded over the first `tp` local devices. Without a
     checkpoint, a randomly initialized `model_name` config (debug use).
+
+    cache_mode: 'auto' (paged for llama-family, dense for MoE — the MoE
+    decode path predates the paged cache), 'paged', or 'dense'.
+    pool_tokens: paged-pool HBM budget in tokens (default: the dense
+    equivalent, num_slots * max_seq_len — same HBM, more headroom; pass
+    less to actually shrink the cache).
     """
     import dataclasses as _dc
 
@@ -148,8 +158,7 @@ def build_engine(model_name: Optional[str] = None,
     if checkpoint:
         from skypilot_tpu.models import weights as weights_lib
         cfg = weights_lib.load_config(
-            checkpoint, remat=False, param_dtype='bfloat16',
-            dtype='bfloat16')
+            checkpoint, remat=False, param_dtype=dtype, dtype=dtype)
         cfg = _dc.replace(cfg,
                           max_seq_len=min(cfg.max_seq_len, max_seq_len))
         model = llama.LlamaModel(cfg)
@@ -179,11 +188,16 @@ def build_engine(model_name: Optional[str] = None,
         if mesh is not None:
             from skypilot_tpu.models import weights as weights_lib
             params = weights_lib.shard_params(params, model, cfg, mesh)
+    if cache_mode == 'auto':
+        is_moe = model.__class__.__name__ == 'MixtralModel'
+        cache_mode = 'dense' if is_moe else 'paged'
     return engine_lib.InferenceEngine(model, params,
                                       num_slots=num_slots,
                                       max_seq_len=cfg.max_seq_len,
                                       decode_chunk=decode_chunk,
-                                      mesh=mesh)
+                                      mesh=mesh,
+                                      cache_mode=cache_mode,
+                                      pool_tokens=pool_tokens)
 
 
 def main(argv=None) -> None:
@@ -208,10 +222,18 @@ def main(argv=None) -> None:
     parser.add_argument('--port', type=int, default=8000)
     parser.add_argument('--num-slots', type=int, default=8)
     parser.add_argument('--max-seq-len', type=int, default=2048)
+    parser.add_argument('--dtype', default='bfloat16',
+                        help='compute/weight dtype (bfloat16|float32); '
+                             'float32 reproduces transformers greedy '
+                             'outputs bit-for-bit in parity checks')
+    parser.add_argument('--cache-mode', default='auto',
+                        choices=['auto', 'paged', 'dense'],
+                        help='KV cache layout (auto: paged for llama)')
     args = parser.parse_args(argv)
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
-                          checkpoint=args.checkpoint, tp=args.tp)
+                          checkpoint=args.checkpoint, tp=args.tp,
+                          cache_mode=args.cache_mode, dtype=args.dtype)
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
     if tok_path:

@@ -112,21 +112,42 @@ class LlamaAttention(nn.Module):
 
         if cache is not None:
             assert positions is not None, 'cache path needs positions'
-            k_cache, v_cache = cache
-            start = positions[:, 0]  # write offset per sequence
-            k_cache = jax.vmap(
-                lambda c, kk, i: jax.lax.dynamic_update_slice(
-                    c, kk, (i, 0, 0)))(k_cache, k, start)
-            v_cache = jax.vmap(
-                lambda c, vv, i: jax.lax.dynamic_update_slice(
-                    c, vv, (i, 0, 0)))(v_cache, v, start)
-            out = _cached_attention(q, k_cache, v_cache, positions)
+            if len(cache) == 3:
+                # Paged decode path: cache = (k_pool [n_pages, P, Hkv,
+                # hd], v_pool, tables [B, max_pages]). One token per
+                # sequence is scattered into (tables[b, pos//P], pos%P)
+                # and attention runs over the gathered per-layer view —
+                # the page indirection lives HERE so only one layer's KV
+                # is ever materialized contiguously (infer/paged_cache.py
+                # holds the pool accounting).
+                assert s == 1, 'paged cache is a decode-only path'
+                from skypilot_tpu.infer.paged_cache import PagePool
+                k_pool, v_pool, tables = cache
+                pos = positions[:, 0]
+                k_pool = PagePool.append_token_layer(k_pool, k[:, 0],
+                                                     tables, pos)
+                v_pool = PagePool.append_token_layer(v_pool, v[:, 0],
+                                                     tables, pos)
+                k_view = PagePool.gather_view_layer(k_pool, tables)
+                v_view = PagePool.gather_view_layer(v_pool, tables)
+                out = _cached_attention(q, k_view, v_view, positions)
+                new_cache = (k_pool, v_pool)
+            else:
+                k_cache, v_cache = cache
+                start = positions[:, 0]  # write offset per sequence
+                k_cache = jax.vmap(
+                    lambda c, kk, i: jax.lax.dynamic_update_slice(
+                        c, kk, (i, 0, 0)))(k_cache, k, start)
+                v_cache = jax.vmap(
+                    lambda c, vv, i: jax.lax.dynamic_update_slice(
+                        c, vv, (i, 0, 0)))(v_cache, v, start)
+                out = _cached_attention(q, k_cache, v_cache, positions)
+                new_cache = (k_cache, v_cache)
             out = out.reshape(b, s, h * hd)
             out = _dense(cfg.dim, ('heads', 'embed'), 'wo',
                          cfg.param_dtype, dtype)(out)
             return nn.with_logical_constraint(
-                out, ('act_batch', 'act_seq', 'act_embed')), \
-                (k_cache, v_cache)
+                out, ('act_batch', 'act_seq', 'act_embed')), new_cache
 
         if cfg.attn_impl == 'ring':
             from skypilot_tpu.parallel import mesh as mesh_lib
@@ -256,11 +277,19 @@ class LlamaModel(nn.Module):
                 policy=jax.checkpoint_policies.save_only_these_names(),
                 prevent_cse=not cfg.scan_layers)
         new_cache = None
+        # Paged decode: 'tables' is the per-slot block table shared by
+        # every layer — kept OUT of the per-layer scan/stack (closure /
+        # passthrough), while k/v are the per-layer page pools.
+        tables = cache.get('tables') if cache is not None else None
         if cfg.scan_layers:
             if cache is not None:
+                kv_cache = {'k': cache['k'], 'v': cache['v']}
+
                 def body(mdl, carry, layer_cache):
-                    y, upd = mdl(carry, cos, sin, segment_ids,
-                                 (layer_cache['k'], layer_cache['v']),
+                    lc = (layer_cache['k'], layer_cache['v'])
+                    if tables is not None:
+                        lc = lc + (tables,)
+                    y, upd = mdl(carry, cos, sin, segment_ids, lc,
                                  positions)
                     return y, {'k': upd[0], 'v': upd[1]}
                 x, new_cache = nn.scan(
@@ -270,7 +299,9 @@ class LlamaModel(nn.Module):
                     length=cfg.n_layers,
                     in_axes=0, out_axes=0,
                     metadata_params={nn.PARTITION_NAME: 'layers'},
-                )(block(cfg, name='layers'), x, cache)
+                )(block(cfg, name='layers'), x, kv_cache)
+                if tables is not None:
+                    new_cache = {**new_cache, 'tables': tables}
             else:
                 x, _ = nn.scan(
                     lambda mdl, carry, _: (
@@ -285,6 +316,8 @@ class LlamaModel(nn.Module):
             for i in range(cfg.n_layers):
                 if cache is not None:
                     layer_cache = (cache['k'][i], cache['v'][i])
+                    if tables is not None:
+                        layer_cache = layer_cache + (tables,)
                     x, upd = block(cfg, name=f'layer_{i}')(
                         x, cos, sin, segment_ids, layer_cache, positions)
                     caches_out.append(upd)
@@ -296,6 +329,8 @@ class LlamaModel(nn.Module):
                     'k': jnp.stack([c[0] for c in caches_out]),
                     'v': jnp.stack([c[1] for c in caches_out]),
                 }
+                if tables is not None:
+                    new_cache['tables'] = tables
 
         x = RMSNorm(cfg, name='final_norm')(x)
         if logit_positions is not None:

@@ -105,7 +105,10 @@ def test_storage_validation():
     with pytest.raises(exceptions.StorageSourceError):
         storage_lib.Storage(name='ok-name', source='/no/such/path')
     with pytest.raises(exceptions.StorageSourceError):
-        storage_lib.Storage(source='s3://foreign')  # not a managed scheme
+        storage_lib.Storage(source='cos://foreign')  # unmanaged scheme
+    # s3:// and r2:// became managed schemes (S3Store/R2Store).
+    assert storage_lib.Storage(source='s3://foreign').requested_store \
+        == storage_lib.StoreType.S3
 
 
 def test_mount_mode_symlink(storage_env):
