@@ -12,8 +12,10 @@ as auxiliary context). extra_metrics carries the serving benchmark
 BASELINE.md's serve row; baseline 500ms TTFT). On CPU the same harness
 runs a debug model so the script never hard-fails in smoke environments.
 """
+import contextlib
 import dataclasses
 import json
+import signal
 import sys
 import time
 
@@ -22,6 +24,26 @@ import jax.numpy as jnp
 
 BASELINE_MFU = 0.45
 BASELINE_TTFT_MS = 500.0  # BASELINE.json: 70B serve p50 TTFT < 500ms
+
+
+class PhaseTimeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def phase_deadline(seconds: int, what: str):
+    """A wedged accelerator (e.g. a hung device program on the far side
+    of the dispatch tunnel) must surface as a failed PHASE with a JSON
+    line, not a bench that never returns."""
+    def _raise(signum, frame):
+        raise PhaseTimeout(f'{what} exceeded {seconds}s (device hung?)')
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 PEAK_FLOPS = {  # bf16 peak per chip
     'TPU v5 lite': 197e12,
@@ -161,6 +183,24 @@ def train_mfu(dev, on_tpu: bool) -> float:
 
 
 def main() -> None:
+    import os
+    import threading
+
+    # Last-resort watchdog: SIGALRM cannot interrupt a hang inside a
+    # blocking C call (a wedged device program never returns to the
+    # bytecode loop), so a timer THREAD emits the JSON line and exits
+    # the process. 40 min >> any healthy full bench (~3 min).
+    def _die():
+        print(json.dumps({
+            'metric': 'train_mfu_llama1b_1chip', 'value': None,
+            'unit': 'MFU', 'vs_baseline': None, 'extra_metrics': [],
+            'error': 'bench watchdog: device call never returned '
+                     '(accelerator hung)'}), flush=True)
+        os._exit(0)
+    killer = threading.Timer(2400, _die)
+    killer.daemon = True
+    killer.start()
+
     dev = jax.devices()[0]
     on_tpu = dev.platform == 'tpu'
 
@@ -168,14 +208,16 @@ def main() -> None:
     mfu = None
     train_err = None
     try:
-        mfu = train_mfu(dev, on_tpu)
-    except Exception as e:  # pylint: disable=broad-except
+        with phase_deadline(1200, 'train bench'):
+            mfu = train_mfu(dev, on_tpu)
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         train_err = repr(e)
         print(f'# train bench failed: {e!r}', file=sys.stderr)
 
     try:
-        extra = serve_metrics(on_tpu)
-    except Exception as e:  # pylint: disable=broad-except
+        with phase_deadline(900, 'serve bench'):
+            extra = serve_metrics(on_tpu)
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# serve bench failed: {e!r}', file=sys.stderr)
         extra = []
 
@@ -189,6 +231,7 @@ def main() -> None:
     }
     if train_err is not None:
         line['error'] = train_err
+    killer.cancel()
     print(json.dumps(line))
 
 

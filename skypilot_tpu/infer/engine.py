@@ -122,12 +122,14 @@ class InferenceEngine:
             tp = mesh.shape.get('tp', 1)
             # Shard the cache over tp on kv_heads (matching the model's
             # 'act_kv_heads' constraint); replicate if tp doesn't divide.
-            # Dense [L, slots, S, H, d] and paged [L, pages, P, H, d]
-            # both carry kv_heads on axis 3.
+            # kv_heads is axis 3 of the dense cache [L, slots, S, H, d]
+            # and axis 2 of the page-major pool [L, pages, H, P, d].
             kv_axis = 'tp' if tp > 1 and \
                 self.cfg.n_kv_heads % tp == 0 else None
-            cache_sharding = NamedSharding(
-                mesh, P(None, None, None, kv_axis, None))
+            spec = (P(None, None, kv_axis, None, None)
+                    if cache_mode == 'paged'
+                    else P(None, None, None, kv_axis, None))
+            cache_sharding = NamedSharding(mesh, spec)
         if cache_mode == 'paged':
             # Paged (block-table) cache: HBM scales with tokens actually
             # reserved, not slots x max_seq (VERDICT r2 missing #1).
@@ -243,6 +245,30 @@ class InferenceEngine:
                             axis=-1).astype(jnp.int32)
         return greedy, logits, new_cache
 
+    @staticmethod
+    def _pin_paged_layouts(cache):
+        """Pin the page pools' jit-boundary layout to row-major.
+
+        Without this, XLA's layout assignment picks a TRANSPOSED layout
+        for the pool at the decode/insert jit outputs (the scatter and
+        the Pallas attention kernel prefer different layouts) and
+        inserts full-pool transpose copies at every chunk boundary —
+        measured ~26ms/chunk for the 1B. Donation then aliases cleanly
+        call-to-call. TPU-only (CPU layouts are fixed anyway)."""
+        if 'tables' not in cache:
+            return cache
+        try:
+            if jax.devices()[0].platform != 'tpu':
+                return cache
+            from jax.experimental.layout import (Format, Layout,
+                                                 with_layout_constraint)
+            fmt = Format(Layout(major_to_minor=(0, 1, 2, 3, 4)))
+            return {**cache,
+                    'k': with_layout_constraint(cache['k'], fmt),
+                    'v': with_layout_constraint(cache['v'], fmt)}
+        except Exception:  # pylint: disable=broad-except
+            return cache
+
     def _insert_impl(self, cache, prefill_cache, slot, args, first_tok,
                      length, temp, key, topk):
         """ONE fused dispatch per admission: copy a prefill cache (B=1,
@@ -272,7 +298,7 @@ class InferenceEngine:
         prompt positions (n_ins static via the shape, so one compile per
         distinct page count). table_row: [max_pages] int32."""
         from skypilot_tpu.infer import paged_cache
-        p = cache['k'].shape[2]
+        p = cache['k'].shape[3]    # [L, n_pages, H, P, d] — P axis
         need = page_ids.shape[0] * p
         pk, pv = prefill_cache['k'], prefill_cache['v']
         if pk.shape[2] < need:   # bucket smaller than the page span
@@ -287,8 +313,8 @@ class InferenceEngine:
                                                     page_ids),
             'tables': cache['tables'].at[slot].set(table_row),
         }
-        return new_cache, _update_args(args, slot, first_tok, length,
-                                       temp, key, topk)
+        return self._pin_paged_layouts(new_cache), _update_args(
+            args, slot, first_tok, length, temp, key, topk)
 
     def _clear_slot_impl(self, cache, slot):
         """Neutralize a released slot's block-table row (point it at the
@@ -341,6 +367,8 @@ class InferenceEngine:
 
         (cache, last, lens, keys), toks = jax.lax.scan(
             step, (cache, last_tokens, lengths, keys), None, length=n)
+        if 'tables' in cache:
+            cache = self._pin_paged_layouts(cache)
         # last/lens returned device-resident so the next chunk's call
         # needs no host->device transfers in the steady state.
         return toks, cache, keys, last, lens

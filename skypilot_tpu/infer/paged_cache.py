@@ -5,7 +5,7 @@ long requests actually are; the reference gets vLLM's paged attention
 for free (/root/reference/llm/vllm/serve.yaml). This is the TPU-native
 equivalent: a page POOL
 
-    k/v: [n_layers, n_pages, page_size, kv_heads, head_dim]
+    k/v: [n_layers, n_pages, kv_heads, page_size, head_dim]
 
 plus a per-slot block table mapping logical token positions to pages.
 HBM scales with tokens actually reserved, so at equal HBM the engine
@@ -71,7 +71,12 @@ class PagePool:
                  device_put=None) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
-        shape = (n_layers, cfg.n_pages, cfg.page_size, kv_heads, head_dim)
+        # Page-major pool: one page holds ALL kv heads ([H, P, d]
+        # contiguous), so the Pallas paged-attention kernel
+        # (ops/paged_attention.py) fetches a slot's whole page in ONE
+        # block — grid (slots, pages), not (slots, heads, pages); per-
+        # invocation and DMA-issue overhead dominate at decode sizes.
+        shape = (n_layers, cfg.n_pages, kv_heads, cfg.page_size, head_dim)
         put = device_put or (lambda x: x)
         self.pools: Dict[str, jax.Array] = {
             'k': put(jnp.zeros(shape, dtype)),
@@ -117,51 +122,62 @@ class PagePool:
     def insert_prompt(pool, prompt_kv, page_ids):
         """Scatter a prefill cache into reserved pages.
 
-        pool:      [L, n_pages, P, H, d] (donated by the caller's jit)
+        pool:      [L, n_pages, H, P, d] (donated by the caller's jit)
         prompt_kv: [L, 1, S_bucket, H, d] from the prefill
         page_ids:  [n] int32 — the first n reserved pages; n*P tokens of
                    the prompt KV are stored (n is static via the shape).
         """
         n = page_ids.shape[0]
         l, _, _, h, d = prompt_kv.shape
-        p = pool.shape[2]
+        p = pool.shape[3]
         chunk = prompt_kv[:, 0, :n * p]            # [L, n*P, H, d]
-        chunk = chunk.reshape(l, n, p, h, d)
+        chunk = chunk.reshape(l, n, p, h, d).transpose(0, 1, 3, 2, 4)
         return pool.at[:, page_ids].set(chunk.astype(pool.dtype))
 
     @staticmethod
     def gather_view_layer(pool, tables):
-        """One layer's per-slot contiguous KV view — THE production
-        gather (models/llama.py paged attention calls this).
+        """One layer's per-slot contiguous KV view — the XLA decode
+        path's gather (models/llama.py paged attention; on TPU the
+        Pallas kernel reads pages directly instead).
 
-        pool:   [n_pages, P, H, d]
+        pool:   [n_pages, H, P, d]
         tables: [slots, max_pages] int32
         -> [slots, max_pages*P, H, d]
         """
-        _, p, h, d = pool.shape
+        _, h, p, d = pool.shape
         slots, mp = tables.shape
-        return pool[tables].reshape(slots, mp * p, h, d)
+        v = pool[tables]                       # [slots, mp, H, P, d]
+        return v.transpose(0, 1, 3, 2, 4).reshape(slots, mp * p, h, d)
 
     @staticmethod
     def append_token_layer(pool, new_kv, tables, lengths):
         """Scatter one decoded token's KV for every slot, one layer —
         THE production scatter (models/llama.py paged attention).
 
-        pool:    [n_pages, P, H, d]
+        pool:    [n_pages, H, P, d]
         new_kv:  [slots, H, d] — the row each slot writes at
                  position lengths[slot].
         tables:  [slots, max_pages] int32
         lengths: [slots] int32 — the position the token is written at.
         """
-        p = pool.shape[1]
+        p = pool.shape[2]
+        mp = tables.shape[1]
         page = jnp.take_along_axis(
-            tables, (lengths // p)[:, None], axis=1)[:, 0]   # [slots]
+            tables, jnp.clip(lengths // p, 0, mp - 1)[:, None],
+            axis=1)[:, 0]                                    # [slots]
         off = lengths % p                                    # [slots]
-        return pool.at[page, off].set(new_kv.astype(pool.dtype))
+        # This scatter IS the production append (both decode paths).
+        # The layout fight it provokes at the jit boundary (XLA would
+        # pick a transposed pool output layout and pay full-pool
+        # transpose copies per chunk) is resolved by the engine pinning
+        # the pool's boundary layout (engine._pin_paged_layouts).
+        # Advanced indices (page, off) separated by the ':' head slice
+        # land first in the result: [slots, H, d].
+        return pool.at[page, :, off].set(new_kv.astype(pool.dtype))
 
     @staticmethod
     def gather_view(pool, tables):
-        """All-layer convenience wrapper: [L, n_pages, P, H, d] ->
+        """All-layer convenience wrapper: [L, n_pages, H, P, d] ->
         [L, slots, mp*P, H, d]. Single-sourced on the layer kernel."""
         return jax.vmap(
             lambda pl: PagePool.gather_view_layer(pl, tables))(pool)

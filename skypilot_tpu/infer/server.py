@@ -12,6 +12,16 @@ service.yaml readiness-probes /v1/models). Endpoints:
                             token as soon as it is sampled (TTFT = first
                             chunk latency).
   GET  /stats             — engine slot/queue stats.
+  GET  /v1/models         — OpenAI-compatible model listing (the
+                            reference's service.yaml readiness-probes
+                            this exact path).
+  POST /v1/completions    — OpenAI-compatible completions (prompt str or
+                            list, max_tokens/temperature/stop via eos,
+                            stream=true -> SSE chunks + [DONE]).
+  POST /v1/chat/completions — OpenAI-compatible chat (messages ->
+                            a minimal generic chat template; model-
+                            specific templates can subclass
+                            InferenceServer._apply_chat_template).
 
 Run:
   # random-weight debug model, byte tokenizer:
@@ -46,10 +56,11 @@ byte_decode = lambda tokens: \
 
 class InferenceServer:
     def __init__(self, engine: 'engine_lib.InferenceEngine',
-                 tokenizer=None) -> None:
+                 tokenizer=None, model_id: str = 'skypilot-tpu') -> None:
         self.engine = engine
         self.tokenizer = tokenizer or tokenizer_lib.ByteTokenizer(
             engine.cfg.vocab_size)
+        self.model_id = model_id
 
     async def _health(self, request: web.Request) -> web.Response:
         del request
@@ -113,11 +124,208 @@ class InferenceServer:
             'text': self.tokenizer.decode(out_text),
         })
 
+    # ----------------------------------------------- OpenAI-compatible
+    # The reference serves vLLM's OpenAI API (llm/vllm/serve.yaml probes
+    # /v1/models); these endpoints make our replicas drop-in for OpenAI
+    # SDK clients pointed at the service endpoint.
+
+    def _sampling_from_openai(self,
+                              payload) -> 'engine_lib.SamplingParams':
+        temp = float(payload.get('temperature', 0.0))
+        return engine_lib.SamplingParams(
+            max_new_tokens=int(payload.get('max_tokens', 128)),
+            temperature=temp,
+            top_k=int(payload.get('top_k', 0)),
+            eos_token=self.tokenizer.eos_id,
+            seed=int(payload.get('seed', 0)))
+
+    async def _drain(self, out_q) -> List[int]:
+        loop = asyncio.get_running_loop()
+        out: List[int] = []
+        while True:
+            tok = await loop.run_in_executor(
+                None, functools.partial(out_q.get, timeout=300))
+            if tok is None:
+                return out
+            out.append(tok)
+
+    def _finish(self, out: List[int],
+                params: 'engine_lib.SamplingParams'):
+        """(visible_tokens, finish_reason) — eos is not surfaced."""
+        if params.eos_token is not None and out and \
+                out[-1] == params.eos_token:
+            return out[:-1], 'stop'
+        return out, ('length' if len(out) >= params.max_new_tokens
+                     else 'stop')
+
+    async def _models(self, request: web.Request) -> web.Response:
+        del request
+        return web.json_response({
+            'object': 'list',
+            'data': [{'id': self.model_id, 'object': 'model',
+                      'owned_by': 'skypilot-tpu'}],
+        })
+
+    async def _sse(self, request, make_chunk, out_q, params):
+        """Stream tokens as OpenAI SSE chunks; a final chunk carries the
+        finish_reason (OpenAI protocol), then [DONE]."""
+        loop = asyncio.get_running_loop()
+        resp = web.StreamResponse(
+            headers={'Content-Type': 'text/event-stream',
+                     'Cache-Control': 'no-cache'})
+        await resp.prepare(request)
+        n = 0
+        saw_eos = False
+        while True:
+            tok = await loop.run_in_executor(
+                None, functools.partial(out_q.get, timeout=300))
+            if tok is None:
+                break
+            n += 1
+            if params.eos_token is not None and tok == params.eos_token:
+                saw_eos = True
+                continue   # eos hidden; the final chunk signals stop
+            piece = self.tokenizer.decode([tok])
+            await resp.write(b'data: ' +
+                             json.dumps(make_chunk(piece)).encode() +
+                             b'\n\n')
+        reason = 'stop' if saw_eos or n < params.max_new_tokens \
+            else 'length'
+        await resp.write(b'data: ' +
+                         json.dumps(make_chunk(None, reason)).encode() +
+                         b'\n\n')
+        await resp.write(b'data: [DONE]\n\n')
+        await resp.write_eof()
+        return resp
+
+    def _prompt_token_lists(self, prompt):
+        """OpenAI prompt forms: str | [str] | [int] | [[int]] ->
+        list of token lists (None on malformed input)."""
+        if isinstance(prompt, str):
+            return [self.tokenizer.encode(prompt)]
+        if isinstance(prompt, list) and prompt:
+            if all(isinstance(x, int) for x in prompt):
+                return [list(prompt)]
+            if all(isinstance(x, str) for x in prompt):
+                return [self.tokenizer.encode(x) for x in prompt]
+            if all(isinstance(x, list) and
+                   all(isinstance(t, int) for t in x) for x in prompt):
+                return [list(x) for x in prompt]
+        return None
+
+    async def _completions(self, request: web.Request):
+        payload = await request.json()
+        prompt = payload.get('prompt')
+        if prompt is None:
+            return web.json_response({'error': 'prompt required'},
+                                     status=400)
+        token_lists = self._prompt_token_lists(prompt)
+        if token_lists is None or any(not t for t in token_lists):
+            return web.json_response(
+                {'error': 'prompt must be a non-empty string, token '
+                          'array, or list of either'}, status=400)
+        # Validate BEFORE submitting: rejected work must not occupy
+        # engine slots.
+        if payload.get('stream') and len(token_lists) != 1:
+            return web.json_response(
+                {'error': 'stream supports a single prompt'},
+                status=400)
+        params = self._sampling_from_openai(payload)
+        subs = [self.engine.submit(t, params) for t in token_lists]
+
+        if payload.get('stream'):
+            rid, out_q = subs[0]
+
+            def chunk(piece, reason=None):
+                return {'id': f'cmpl-{rid}', 'object': 'text_completion',
+                        'model': self.model_id,
+                        'choices': [{'index': 0,
+                                     'text': piece or '',
+                                     'finish_reason': reason}]}
+            return await self._sse(request, chunk, out_q, params)
+
+        choices = []
+        total_out = 0
+        for i, (rid, out_q) in enumerate(subs):
+            out = await self._drain(out_q)
+            total_out += len(out)
+            visible, reason = self._finish(out, params)
+            choices.append({'index': i,
+                            'text': self.tokenizer.decode(visible),
+                            'finish_reason': reason})
+        n_in = sum(len(t) for t in token_lists)
+        return web.json_response({
+            'id': f'cmpl-{subs[0][0]}', 'object': 'text_completion',
+            'model': self.model_id, 'choices': choices,
+            'usage': {'prompt_tokens': n_in,
+                      'completion_tokens': total_out,
+                      'total_tokens': n_in + total_out},
+        })
+
+    def _apply_chat_template(self, messages) -> str:
+        """Minimal generic template. Model-specific formats (Llama-3
+        header tokens etc.) can be layered on via tokenizer config; the
+        API surface is what the reference exposes through vLLM."""
+        parts = []
+        for m in messages:
+            parts.append(f"<|{m.get('role', 'user')}|>\n"
+                         f"{m.get('content', '')}")
+        parts.append('<|assistant|>\n')
+        return '\n'.join(parts)
+
+    async def _chat_completions(self, request: web.Request):
+        payload = await request.json()
+        messages = payload.get('messages')
+        if not messages:
+            return web.json_response({'error': 'messages required'},
+                                     status=400)
+        params = self._sampling_from_openai(payload)
+        tokens = self.tokenizer.encode(
+            self._apply_chat_template(messages))
+        rid, out_q = self.engine.submit(tokens, params)
+
+        if payload.get('stream'):
+            first = {'sent': False}
+
+            def chunk(piece, reason=None):
+                delta = {}
+                if not first['sent']:
+                    # OpenAI protocol: the first delta carries the role.
+                    delta['role'] = 'assistant'
+                    first['sent'] = True
+                if piece is not None:
+                    delta['content'] = piece
+                return {'id': f'chatcmpl-{rid}',
+                        'object': 'chat.completion.chunk',
+                        'model': self.model_id,
+                        'choices': [{'index': 0, 'delta': delta,
+                                     'finish_reason': reason}]}
+            return await self._sse(request, chunk, out_q, params)
+
+        out = await self._drain(out_q)
+        visible, reason = self._finish(out, params)
+        return web.json_response({
+            'id': f'chatcmpl-{rid}', 'object': 'chat.completion',
+            'model': self.model_id,
+            'choices': [{'index': 0,
+                         'message': {'role': 'assistant',
+                                     'content': self.tokenizer.decode(
+                                         visible)},
+                         'finish_reason': reason}],
+            'usage': {'prompt_tokens': len(tokens),
+                      'completion_tokens': len(out),
+                      'total_tokens': len(tokens) + len(out)},
+        })
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/health', self._health)
         app.router.add_get('/stats', self._stats)
         app.router.add_post('/generate', self._generate)
+        app.router.add_get('/v1/models', self._models)
+        app.router.add_post('/v1/completions', self._completions)
+        app.router.add_post('/v1/chat/completions',
+                            self._chat_completions)
         return app
 
 
@@ -137,8 +345,8 @@ def build_engine(model_name: Optional[str] = None,
     weights, tp-sharded over the first `tp` local devices. Without a
     checkpoint, a randomly initialized `model_name` config (debug use).
 
-    cache_mode: 'auto' (paged for llama-family, dense for MoE — the MoE
-    decode path predates the paged cache), 'paged', or 'dense'.
+    cache_mode: 'auto' (= paged; MoE shares the llama attention layer so
+    paged decode covers both families), 'paged', or 'dense'.
     pool_tokens: paged-pool HBM budget in tokens (default: the dense
     equivalent, num_slots * max_seq_len — same HBM, more headroom; pass
     less to actually shrink the cache).
@@ -189,8 +397,9 @@ def build_engine(model_name: Optional[str] = None,
             from skypilot_tpu.models import weights as weights_lib
             params = weights_lib.shard_params(params, model, cfg, mesh)
     if cache_mode == 'auto':
-        is_moe = model.__class__.__name__ == 'MixtralModel'
-        cache_mode = 'dense' if is_moe else 'paged'
+        # Paged for all families: MoE shares the llama attention layer,
+        # so the paged decode path covers it too (tested against dense).
+        cache_mode = 'paged'
     return engine_lib.InferenceEngine(model, params,
                                       num_slots=num_slots,
                                       max_seq_len=cfg.max_seq_len,
@@ -245,7 +454,10 @@ def main(argv=None) -> None:
     engine.start()
     logger.info('warming up (compiling prefill buckets + decode)...')
     engine.warmup()
-    server = InferenceServer(engine, tokenizer)
+    import os as _os
+    model_id = (_os.path.basename(args.checkpoint.rstrip('/'))
+                if args.checkpoint else args.model)
+    server = InferenceServer(engine, tokenizer, model_id=model_id)
     logger.info('inference server: model=%s ckpt=%s tp=%d port=%d '
                 'slots=%d', args.model, args.checkpoint, args.tp,
                 args.port, args.num_slots)

@@ -113,24 +113,38 @@ class LlamaAttention(nn.Module):
         if cache is not None:
             assert positions is not None, 'cache path needs positions'
             if len(cache) == 3:
-                # Paged decode path: cache = (k_pool [n_pages, P, Hkv,
+                # Paged decode path: cache = (k_pool [n_pages, Hkv, P,
                 # hd], v_pool, tables [B, max_pages]). One token per
-                # sequence is scattered into (tables[b, pos//P], pos%P)
-                # and attention runs over the gathered per-layer view —
-                # the page indirection lives HERE so only one layer's KV
+                # sequence is scattered into (tables[b, pos//P], pos%P);
+                # attention either runs the Pallas paged kernel (reads
+                # pages directly) or the gathered per-layer view — the
+                # page indirection lives HERE so at most one layer's KV
                 # is ever materialized contiguously (infer/paged_cache.py
                 # holds the pool accounting).
                 assert s == 1, 'paged cache is a decode-only path'
+                import os as _os
+
                 from skypilot_tpu.infer.paged_cache import PagePool
                 k_pool, v_pool, tables = cache
                 pos = positions[:, 0]
-                k_pool = PagePool.append_token_layer(k_pool, k[:, 0],
-                                                     tables, pos)
-                v_pool = PagePool.append_token_layer(v_pool, v[:, 0],
-                                                     tables, pos)
-                k_view = PagePool.gather_view_layer(k_pool, tables)
-                v_view = PagePool.gather_view_layer(v_pool, tables)
-                out = _cached_attention(q, k_view, v_view, positions)
+                k_pool = PagePool.append_token_layer(
+                    k_pool, k[:, 0], tables, pos)
+                v_pool = PagePool.append_token_layer(
+                    v_pool, v[:, 0], tables, pos)
+                if _os.environ.get('SKYT_PAGED_ATTN', 'pallas') == \
+                        'pallas':
+                    # Pallas kernel DMAs each slot's pages directly (no
+                    # materialized contiguous view; escape hatch:
+                    # SKYT_PAGED_ATTN=xla). The engine pins the pool's
+                    # jit-boundary layout so the scatter above and this
+                    # kernel agree (engine._pin_paged_layouts).
+                    from skypilot_tpu.ops import paged_attention
+                    out = paged_attention.paged_decode_attention(
+                        q[:, 0], k_pool, v_pool, tables, pos)[:, None]
+                else:
+                    k_view = PagePool.gather_view_layer(k_pool, tables)
+                    v_view = PagePool.gather_view_layer(v_pool, tables)
+                    out = _cached_attention(q, k_view, v_view, positions)
                 new_cache = (k_pool, v_pool)
             else:
                 k_cache, v_cache = cache

@@ -183,15 +183,22 @@ class MixtralModel(nn.Module):
                                    use_llama31_scaling=cfg.use_llama31_rope)
         aux_total = 0.0
         new_cache = None
+        # Paged decode: same tables plumbing as llama (the attention
+        # layer is shared, so the paged branch comes for free).
+        tables = cache.get('tables') if cache is not None else None
         block = MoeBlock
         if cfg.remat and cache is None:
             block = nn.remat(MoeBlock, prevent_cse=not cfg.scan_layers)
         if cfg.scan_layers:
             if cache is not None:
+                kv_cache = {'k': cache['k'], 'v': cache['v']}
+
                 def body(mdl, carry, layer_cache):
+                    lc = (layer_cache['k'], layer_cache['v'])
+                    if tables is not None:
+                        lc = lc + (tables,)
                     (y, aux), upd = mdl(
-                        carry[0], cos, sin, segment_ids,
-                        (layer_cache['k'], layer_cache['v']), positions)
+                        carry[0], cos, sin, segment_ids, lc, positions)
                     return (y, carry[1] + aux), {'k': upd[0],
                                                  'v': upd[1]}
                 (x, aux_total), new_cache = nn.scan(
@@ -202,7 +209,9 @@ class MixtralModel(nn.Module):
                     in_axes=0, out_axes=0,
                     metadata_params={nn.PARTITION_NAME: 'layers'},
                 )(block(cfg, self.moe, name='layers'),
-                  (x, jnp.zeros((), jnp.float32)), cache)
+                  (x, jnp.zeros((), jnp.float32)), kv_cache)
+                if tables is not None:
+                    new_cache = {**new_cache, 'tables': tables}
             else:
                 (x, aux_total), _ = nn.scan(
                     lambda mdl, carry, _: (
@@ -218,10 +227,13 @@ class MixtralModel(nn.Module):
             caches_out = []
             for i in range(cfg.n_layers):
                 if cache is not None:
+                    layer_cache = (cache['k'][i], cache['v'][i])
+                    if tables is not None:
+                        layer_cache = layer_cache + (tables,)
                     (x, aux), upd = block(cfg, self.moe,
                                           name=f'layer_{i}')(
-                        x, cos, sin, segment_ids,
-                        (cache['k'][i], cache['v'][i]), positions)
+                        x, cos, sin, segment_ids, layer_cache,
+                        positions)
                     caches_out.append(upd)
                 else:
                     x, aux = block(cfg, self.moe, name=f'layer_{i}')(
@@ -232,6 +244,8 @@ class MixtralModel(nn.Module):
                     'k': jnp.stack([c[0] for c in caches_out]),
                     'v': jnp.stack([c[1] for c in caches_out]),
                 }
+                if tables is not None:
+                    new_cache['tables'] = tables
         x = llama_lib.RMSNorm(cfg, name='final_norm')(x)
         if logit_positions is not None:
             x = jnp.take_along_axis(

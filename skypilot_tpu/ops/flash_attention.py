@@ -231,6 +231,21 @@ def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                            causal: bool = True):
+    """FORWARD-ONLY flash attention that also returns the per-row
+    logsumexp: (out [B,Sq,Hq,D], lse [B,Hq,Sq] f32).
+
+    For callers that merge partial attentions themselves (ring
+    attention's cross-chunk online-softmax combine). Not differentiable
+    — wrap it in your own custom_vjp (parallel/ring_attention.py routes
+    its backward through the einsum path).
+    """
+    out, lse = _flash_fwd_impl(q, k, v, None, causal, DEFAULT_BLOCK_Q,
+                               DEFAULT_BLOCK_K)
+    return out, lse[..., 0]
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     segment_ids: Optional[jax.Array] = None,

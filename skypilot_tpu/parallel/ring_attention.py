@@ -55,13 +55,36 @@ def _chunk_attention(q, k, v, q_offset, k_offset, scale):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis_name: str = 'cp', causal: bool = True,
-                   softmax_scale: Optional[float] = None) -> jax.Array:
+                   softmax_scale: Optional[float] = None,
+                   impl: str = 'auto') -> jax.Array:
     """Per-shard computation; must run inside shard_map with q/k/v
     sequence-sharded over `axis_name`. For the jit/GSPMD entry point see
-    ring_attention_sharded()."""
+    ring_attention_sharded().
+
+    impl: 'auto' picks the flash-forward variant (Pallas blockwise
+    kernel per chunk — no materialized [chunk, chunk] score tensor) when
+    shapes allow, else the einsum path; the backward always runs the
+    einsum path (see _ring_flash). 'xla' forces einsum;
+    SKYT_RING_IMPL=xla overrides globally.
+    """
     assert causal, 'non-causal ring attention not yet wired'
+    import os
     b, sq, hq, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    if impl == 'auto':
+        impl = 'xla' if os.environ.get('SKYT_RING_IMPL') == 'xla' \
+            else 'flash'
+    flash_ok = (d in (64, 128, 256) and sq % 128 == 0 and
+                (sq <= 256 or sq % 256 == 0))
+    if impl == 'flash' and flash_ok:
+        return _ring_flash(q, k, v, axis_name, scale)
+    return _ring_einsum(q, k, v, axis_name, scale)
+
+
+def _ring_einsum(q, k, v, axis_name, scale):
+    """Differentiable einsum ring (the backward path for _ring_flash and
+    the fallback for flash-incompatible shapes)."""
+    b, sq, hq, d = q.shape
     cp = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     chunk = sq  # local chunk length; global seq = cp * chunk
@@ -90,6 +113,88 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         body, (k, v, acc0, m0, l0), jnp.arange(cp))
     l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
     return (acc / l_safe).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis_name, scale):
+    """Flash-forward ring: each chunk pair runs the Pallas flash kernel
+    (diag chunk causal, past chunks full, future chunks skipped) and the
+    per-chunk (out, lse) pairs merge with a stable log-sum-exp combine.
+    Backward recomputes through the einsum ring — same cost as before
+    this existed; the forward is the hot path (inference, and the fwd
+    half of training)."""
+    return _ring_flash_impl(q, k, v, axis_name, scale)
+
+
+def _ring_flash_impl(q, k, v, axis_name, scale):
+    from skypilot_tpu.ops import flash_attention as flash_lib
+
+    b, sq, hq, d = q.shape
+    cp = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def diag(args):
+        q_, k_, v_ = args
+        o, lse = flash_lib.flash_attention_fwd_lse(q_, k_, v_,
+                                                   causal=True)
+        return o.astype(jnp.float32), lse.transpose(0, 2, 1)
+
+    def past(args):
+        q_, k_, v_ = args
+        o, lse = flash_lib.flash_attention_fwd_lse(q_, k_, v_,
+                                                   causal=False)
+        return o.astype(jnp.float32), lse.transpose(0, 2, 1)
+
+    def future(args):
+        q_, _, _ = args
+        return (jnp.zeros(q_.shape, jnp.float32),
+                jnp.full((b, sq, hq), NEG_INF, jnp.float32))
+
+    out0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    lse0 = jnp.full((b, sq, hq), NEG_INF, jnp.float32)
+
+    def body(carry, step):
+        k_c, v_c, out_run, lse_run = carry
+        src = jax.lax.rem(my_idx - step + cp, cp)
+        o_c, lse_c = jax.lax.cond(
+            src == my_idx, diag,
+            lambda a: jax.lax.cond(src < my_idx, past, future, a),
+            (q, k_c, v_c))
+        # Stable pairwise combine of normalized partial attentions:
+        # out = (out_run*e^lse_run + o_c*e^lse_c) / (e^lse_run+e^lse_c).
+        m = jnp.maximum(lse_run, lse_c)
+        m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+        w_run = jnp.exp(lse_run - m_safe)
+        w_c = jnp.exp(lse_c - m_safe)
+        denom = w_run + w_c
+        safe = jnp.where(denom == 0.0, 1.0, denom)
+        out_new = (out_run * w_run[..., None] +
+                   o_c * w_c[..., None]) / safe[..., None]
+        lse_new = jnp.where(denom == 0.0, NEG_INF,
+                            m_safe + jnp.log(safe))
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_c, v_c, out_new, lse_new), None
+
+    (_, _, out, _), _ = jax.lax.scan(body, (k, v, out0, lse0),
+                                     jnp.arange(cp))
+    return out.astype(q.dtype)
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, scale):
+    return _ring_flash_impl(q, k, v, axis_name, scale), (q, k, v)
+
+
+def _ring_flash_bwd_rule(axis_name, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _ring_einsum(q_, k_, v_, axis_name, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
 
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, causal: bool = True,

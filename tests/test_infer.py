@@ -207,3 +207,118 @@ def test_http_server(small_model):
     stats = requests.get(base + '/stats', timeout=5).json()
     assert stats['num_slots'] == 2
     eng.stop()
+
+
+@pytest.mark.integration
+def test_openai_compat_endpoints(small_model):
+    """OpenAI-compatible surface (reference: vLLM's OpenAI server behind
+    SkyServe; llm/vllm/service.yaml probes /v1/models)."""
+    from aiohttp import web
+
+    from skypilot_tpu.infer import server as server_lib
+
+    model, params = small_model
+    eng = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16])
+    eng.start()
+    srv = server_lib.InferenceServer(eng, model_id='debug-model')
+
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+
+    th = threading.Thread(
+        target=lambda: web.run_app(srv.make_app(), port=port, print=None,
+                                   handle_signals=False), daemon=True)
+    th.start()
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health', timeout=2).status_code \
+                    == 200:
+                break
+        except requests.RequestException:
+            time.sleep(0.2)
+
+    try:
+        models = requests.get(base + '/v1/models', timeout=5).json()
+        assert models['data'][0]['id'] == 'debug-model'
+
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': 'hi', 'max_tokens': 4},
+                          timeout=120).json()
+        assert r['object'] == 'text_completion'
+        assert r['choices'][0]['finish_reason'] in ('stop', 'length')
+        assert r['usage']['completion_tokens'] >= 1
+        assert isinstance(r['choices'][0]['text'], str)
+
+        # Batch of prompts -> one choice per prompt, indexed.
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': ['a', 'bb'], 'max_tokens': 3},
+                          timeout=120).json()
+        assert [c['index'] for c in r['choices']] == [0, 1]
+
+        # OpenAI also accepts token-array prompts: [int] is ONE prompt,
+        # [[int]] a batch of one.
+        want = _reference_greedy(model, params, [9, 9, 9], 3)
+        r1 = requests.post(base + '/v1/completions',
+                           json={'prompt': [9, 9, 9], 'max_tokens': 3},
+                           timeout=120).json()
+        assert len(r1['choices']) == 1
+        assert r1['usage']['prompt_tokens'] == 3
+        r2 = requests.post(base + '/v1/completions',
+                           json={'prompt': [[9, 9, 9]],
+                                 'max_tokens': 3}, timeout=120).json()
+        assert r1['choices'][0]['text'] == r2['choices'][0]['text']
+        del want
+
+        # Streaming SSE: data: chunks, final chunk carries the
+        # finish_reason, then [DONE].
+        resp = requests.post(base + '/v1/completions',
+                             json={'prompt': 'hi', 'max_tokens': 3,
+                                   'stream': True},
+                             timeout=120, stream=True)
+        lines = [l.decode() for l in resp.iter_lines() if l]
+        assert lines[-1] == 'data: [DONE]'
+        import json as json_lib
+        chunks = [json_lib.loads(l[len('data: '):]) for l in lines[:-1]]
+        assert all(c['object'] == 'text_completion' for c in chunks)
+        assert chunks[-1]['choices'][0]['finish_reason'] == 'length'
+        assert all(c['choices'][0]['finish_reason'] is None
+                   for c in chunks[:-1])
+
+        # stream + multi-prompt rejected BEFORE any engine work.
+        assert requests.post(base + '/v1/completions',
+                             json={'prompt': ['a', 'b'], 'stream': True},
+                             timeout=10).status_code == 400
+        assert requests.get(base + '/stats',
+                            timeout=5).json()['waiting'] == 0
+
+        r = requests.post(
+            base + '/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'hello'}],
+                  'max_tokens': 4}, timeout=120).json()
+        assert r['object'] == 'chat.completion'
+        assert r['choices'][0]['message']['role'] == 'assistant'
+
+        # Chat streaming: first delta carries the assistant role.
+        resp = requests.post(
+            base + '/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'hi'}],
+                  'max_tokens': 3, 'stream': True},
+            timeout=120, stream=True)
+        lines = [l.decode() for l in resp.iter_lines() if l]
+        chunks = [json_lib.loads(l[len('data: '):]) for l in lines[:-1]]
+        assert chunks[0]['choices'][0]['delta'].get('role') == \
+            'assistant'
+        assert chunks[-1]['choices'][0]['finish_reason'] == 'length'
+
+        assert requests.post(base + '/v1/completions', json={},
+                             timeout=10).status_code == 400
+        assert requests.post(base + '/v1/chat/completions', json={},
+                             timeout=10).status_code == 400
+    finally:
+        eng.stop()

@@ -156,6 +156,40 @@ def test_engine_sharded_decode_matches_unsharded(debug_ckpt):
     assert eng.cache['k'].sharding.spec[3] == 'tp'
 
 
+def test_engine_sharded_paged_decode_matches_unsharded(debug_ckpt):
+    """tp-sharded PAGED engine: the page pool shards kv_heads on axis 2
+    ([L, pages, H, P, d]) and decode matches the unsharded engine."""
+    cfg, model, params, ckpt_dir = debug_ckpt
+    prompt = [5, 17, 3, 99, 42]
+
+    eng_plain = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                           max_seq_len=64,
+                                           prefill_buckets=[16],
+                                           cache_mode='paged',
+                                           page_size=16)
+    eng_plain.start()
+    try:
+        want = eng_plain.generate(prompt, engine_lib.SamplingParams(
+            max_new_tokens=8))
+    finally:
+        eng_plain.stop()
+
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=2))
+    sharded = weights.load_llama_params(cfg, ckpt_dir, mesh=mesh)
+    eng = engine_lib.InferenceEngine(model, sharded, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16], mesh=mesh,
+                                     cache_mode='paged', page_size=16)
+    eng.start()
+    try:
+        got = eng.generate(prompt, engine_lib.SamplingParams(
+            max_new_tokens=8))
+    finally:
+        eng.stop()
+    assert got == want
+    assert eng.cache['k'].sharding.spec[2] == 'tp'
+
+
 # ---------------------------------------------------------------- tokenizer
 def test_byte_tokenizer_roundtrip():
     tok = tokenizer_lib.ByteTokenizer(256)

@@ -148,3 +148,48 @@ def test_slot_reuse_no_corruption():
     finally:
         paged.stop()
         dense.stop()
+
+
+def test_moe_paged_matches_dense():
+    """The MoE model shares LlamaAttention, so paged decode works for
+    Mixtral-style serving too (reference analog: llm/mixtral/serve.yaml
+    via vLLM's paged attention)."""
+    import dataclasses as _dc
+
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    cfg = _dc.replace(cfg, max_seq_len=64)
+    moe_cfg = _dc.replace(moe_cfg, capacity_factor=8.0)
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    prompts = _prompts(cfg.vocab_size, [5, 19, 33])
+    dense = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=64,
+                                       cache_mode='dense')
+    paged = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=64,
+                                       cache_mode='paged', page_size=16)
+    assert _run(dense, prompts, max_new=6) == _run(paged, prompts,
+                                                   max_new=6)
+
+
+def test_bucket_smaller_than_page():
+    """Prompt bucket (32) smaller than a page (64): the insert pads the
+    prefill KV up to the page span. Regression: the pad length was read
+    off the wrong pool axis after the page-major relayout, crashing
+    every admission at the server's default page size."""
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    prompts = _prompts(vocab, [5, 9])
+    paged = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=128,
+                                       prefill_buckets=[32],
+                                       cache_mode='paged', page_size=64)
+    dense = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=128,
+                                       prefill_buckets=[32],
+                                       cache_mode='dense')
+    assert _run(paged, prompts, max_new=4) == _run(dense, prompts,
+                                                   max_new=4)

@@ -60,7 +60,14 @@ def test_profile_synced_down_with_logs(tmp_path, tmp_state_dir,
     from skypilot_tpu import core, execution
     from skypilot_tpu import resources as resources_lib
 
-    prog = ("import jax, jax.numpy as jnp\n"
+    # Pin the platform from the env var (some TPU images pin a platform
+    # plugin in sitecustomize that wins over JAX_PLATFORMS alone — the
+    # same dance train/sft.py and infer/server.py do).
+    prog = ("import os, jax\n"
+            "if os.environ.get('JAX_PLATFORMS'):\n"
+            "    jax.config.update('jax_platforms',\n"
+            "                      os.environ['JAX_PLATFORMS'])\n"
+            "import jax.numpy as jnp\n"
             "from skypilot_tpu.utils import profiling\n"
             "prof = profiling.StepProfiler()\n"
             "assert prof.enabled, 'agent did not set SKYT_PROFILE_DIR'\n"

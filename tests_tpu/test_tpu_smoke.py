@@ -113,15 +113,52 @@ class TestTrainStepFlash:
         assert np.isfinite(loss)
 
 
-class TestEnginePrefillDecode:
-    """One prefill + a few decode steps on the chip."""
+class TestPagedAttentionLowers:
+    """The paged decode kernel must compile through Mosaic at serving
+    shapes (1B-like: hkv=8, G=4, d=64, P=64) and match the gather
+    reference."""
 
-    def test_prefill_decode(self):
+    def test_paged_kernel_matches_gather(self):
+        from skypilot_tpu.infer.paged_cache import PagePool
+        from skypilot_tpu.ops import attention as attention_ops
+        from skypilot_tpu.ops import paged_attention
+
+        rng = np.random.default_rng(0)
+        slots, hq, hkv, d, p, mp = 8, 32, 8, 64, 64, 16
+        n_pages = slots * mp + 1
+        q = jnp.asarray(rng.normal(size=(slots, hq, d)), jnp.bfloat16)
+        kp = jnp.asarray(rng.normal(size=(n_pages, hkv, p, d)),
+                         jnp.bfloat16)
+        vp = jnp.asarray(rng.normal(size=(n_pages, hkv, p, d)),
+                         jnp.bfloat16)
+        tables = jnp.asarray(
+            np.arange(1, 1 + slots * mp).reshape(slots, mp), jnp.int32)
+        lengths = jnp.asarray([575, 3, 100, 64, 63, 200, 17, 512],
+                              jnp.int32)
+        out = paged_attention.paged_decode_attention(q, kp, vp, tables,
+                                                     lengths)
+        kv = PagePool.gather_view_layer(kp, tables)
+        vv = PagePool.gather_view_layer(vp, tables)
+        ref = attention_ops.mha_reference(q[:, None], kv, vv,
+                                          q_positions=lengths[:, None])
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref[:, 0],
+                                                    np.float32),
+            atol=3e-2, rtol=3e-2)
+
+
+class TestEnginePrefillDecode:
+    """One prefill + a few decode steps on the chip, both cache modes
+    (paged engages the Pallas paged-attention kernel + layout pin)."""
+
+    @pytest.mark.parametrize('cache_mode', ['dense', 'paged'])
+    def test_prefill_decode(self, cache_mode):
         from skypilot_tpu.infer import engine as engine_lib
         from skypilot_tpu.infer import server as server_lib
 
         engine = server_lib.build_engine('debug', num_slots=2,
-                                         max_seq_len=128)
+                                         max_seq_len=128,
+                                         cache_mode=cache_mode)
         engine.start()
         try:
             params = engine_lib.SamplingParams(max_new_tokens=4)
