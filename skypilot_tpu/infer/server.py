@@ -107,21 +107,12 @@ class InferenceServer:
             await resp.write_eof()
             return resp
 
-        out: List[int] = []
-        while True:
-            tok = await loop.run_in_executor(
-                None, functools.partial(out_q.get, timeout=300))
-            if tok is None:
-                break
-            out.append(tok)
-        if eos is not None and out and out[-1] == eos:
-            out_text = out[:-1]
-        else:
-            out_text = out
+        out = await self._drain(out_q)
+        visible, _ = self._finish(out, params)
         return web.json_response({
             'request_id': req_id,
             'tokens': out,
-            'text': self.tokenizer.decode(out_text),
+            'text': self.tokenizer.decode(visible),
         })
 
     # ----------------------------------------------- OpenAI-compatible
@@ -151,12 +142,15 @@ class InferenceServer:
 
     def _finish(self, out: List[int],
                 params: 'engine_lib.SamplingParams'):
-        """(visible_tokens, finish_reason) — eos is not surfaced."""
+        """(visible_tokens, finish_reason) — eos is not surfaced.
+
+        OpenAI semantics: 'stop' ONLY for an eos; anything else (hit
+        max_tokens, or the engine truncated at its max_seq_len) is
+        'length'."""
         if params.eos_token is not None and out and \
                 out[-1] == params.eos_token:
             return out[:-1], 'stop'
-        return out, ('length' if len(out) >= params.max_new_tokens
-                     else 'stop')
+        return out, 'length'
 
     async def _models(self, request: web.Request) -> web.Response:
         del request
@@ -174,23 +168,43 @@ class InferenceServer:
             headers={'Content-Type': 'text/event-stream',
                      'Cache-Control': 'no-cache'})
         await resp.prepare(request)
-        n = 0
         saw_eos = False
+        # Multi-byte UTF-8 sequences can span tokens: hold tokens whose
+        # prefix decode ends in U+FFFD until the sequence completes, so
+        # clients never see replacement-char mojibake mid-stream.
+        held: List[int] = []
+
+        def decode_incremental(tok: Optional[int]) -> Optional[str]:
+            if tok is not None:
+                held.append(tok)
+            if not held:
+                return None
+            text = self.tokenizer.decode(list(held))
+            if tok is not None and text.endswith('�'):
+                return None          # incomplete sequence; keep holding
+            held.clear()
+            return text or None
+
         while True:
             tok = await loop.run_in_executor(
                 None, functools.partial(out_q.get, timeout=300))
             if tok is None:
                 break
-            n += 1
             if params.eos_token is not None and tok == params.eos_token:
                 saw_eos = True
                 continue   # eos hidden; the final chunk signals stop
-            piece = self.tokenizer.decode([tok])
+            piece = decode_incremental(tok)
+            if piece is None:
+                continue
             await resp.write(b'data: ' +
                              json.dumps(make_chunk(piece)).encode() +
                              b'\n\n')
-        reason = 'stop' if saw_eos or n < params.max_new_tokens \
-            else 'length'
+        tail = decode_incremental(None)   # flush any held tokens
+        if tail is not None:
+            await resp.write(b'data: ' +
+                             json.dumps(make_chunk(tail)).encode() +
+                             b'\n\n')
+        reason = 'stop' if saw_eos else 'length'
         await resp.write(b'data: ' +
                          json.dumps(make_chunk(None, reason)).encode() +
                          b'\n\n')
@@ -276,9 +290,11 @@ class InferenceServer:
     async def _chat_completions(self, request: web.Request):
         payload = await request.json()
         messages = payload.get('messages')
-        if not messages:
-            return web.json_response({'error': 'messages required'},
-                                     status=400)
+        if not messages or not isinstance(messages, list) or \
+                not all(isinstance(m, dict) for m in messages):
+            return web.json_response(
+                {'error': 'messages must be a non-empty list of '
+                          '{role, content} objects'}, status=400)
         params = self._sampling_from_openai(payload)
         tokens = self.tokenizer.encode(
             self._apply_chat_template(messages))

@@ -262,18 +262,20 @@ def test_openai_compat_endpoints(small_model):
         assert [c['index'] for c in r['choices']] == [0, 1]
 
         # OpenAI also accepts token-array prompts: [int] is ONE prompt,
-        # [[int]] a batch of one.
+        # [[int]] a batch of one — both must decode the greedy reference
+        # continuation.
         want = _reference_greedy(model, params, [9, 9, 9], 3)
+        want_text = srv.tokenizer.decode(want)
         r1 = requests.post(base + '/v1/completions',
                            json={'prompt': [9, 9, 9], 'max_tokens': 3},
                            timeout=120).json()
         assert len(r1['choices']) == 1
         assert r1['usage']['prompt_tokens'] == 3
+        assert r1['choices'][0]['text'] == want_text
         r2 = requests.post(base + '/v1/completions',
                            json={'prompt': [[9, 9, 9]],
                                  'max_tokens': 3}, timeout=120).json()
-        assert r1['choices'][0]['text'] == r2['choices'][0]['text']
-        del want
+        assert r2['choices'][0]['text'] == want_text
 
         # Streaming SSE: data: chunks, final chunk carries the
         # finish_reason, then [DONE].

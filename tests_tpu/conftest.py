@@ -25,10 +25,22 @@ def pytest_configure(config):
 
 
 def _on_tpu() -> bool:
+    """Probe for a WORKING TPU in a subprocess with a timeout: on a
+    machine whose device tunnel is wedged, jax.devices() (and any first
+    device op) can hang forever — the gate must SKIP, not hang the
+    format.sh run."""
+    import subprocess
+    import sys
     try:
-        import jax
-        return jax.devices()[0].platform == 'tpu'
-    except Exception:  # pylint: disable=broad-except
+        out = subprocess.run(
+            [sys.executable, '-c',
+             'import jax, jax.numpy as jnp;'
+             'x = jnp.ones((8, 8)) @ jnp.ones((8, 8));'
+             'jax.block_until_ready(x);'
+             'print(jax.devices()[0].platform)'],
+            capture_output=True, text=True, timeout=120, check=False)
+        return out.stdout.strip().endswith('tpu')
+    except (subprocess.TimeoutExpired, OSError):
         return False
 
 
