@@ -180,8 +180,12 @@ class InferenceServer:
             if not held:
                 return None
             text = self.tokenizer.decode(list(held))
-            if tok is not None and text.endswith('�'):
-                return None          # incomplete sequence; keep holding
+            # Hold at most 4 tokens (a UTF-8 sequence spans <= 4 bytes):
+            # output that legitimately decodes to U+FFFD — or a
+            # degenerate stream of invalid bytes — must still flow
+            # instead of buffering until end-of-stream.
+            if tok is not None and text.endswith('�') and len(held) < 4:
+                return None          # likely incomplete; keep holding
             held.clear()
             return text or None
 

@@ -83,7 +83,11 @@ def test_managed_job_user_failure_no_recovery(jobs_env):
 
 def test_managed_job_preemption_recovery(jobs_env):
     """Kill the job cluster mid-run; the controller must relaunch it."""
-    t = _local_task('mj-rec', 'sleep 4 && echo recovered-done')
+    # A wide-enough run window that the simulated preemption always
+    # lands while the job is still running, even on a loaded machine
+    # (with sleep 4 the job could finish before core.down executed and
+    # the test raced cluster teardown).
+    t = _local_task('mj-rec', 'sleep 12 && echo recovered-done')
     jid = jobs_core.launch(t, retry_until_up=False)
     cluster = f'mj-rec-{jid}'
     # Wait until RUNNING with a live cluster.
@@ -100,7 +104,7 @@ def test_managed_job_preemption_recovery(jobs_env):
     # Simulate preemption: tear the cluster down behind its back.
     core.down(cluster, purge=True)
 
-    job = jobs_core.wait(jid, timeout=90)
+    job = jobs_core.wait(jid, timeout=150)
     assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     assert job['recovery_count'] >= 1
 
@@ -133,7 +137,7 @@ def test_managed_job_cluster_controller_survives_client(
     # queue() must not declare a pid-less cluster controller dead.
     assert all(r['status'] != jobs_state.ManagedJobStatus.FAILED_CONTROLLER
                for r in jobs_core.queue())
-    job = jobs_core.wait(jid, timeout=90)
+    job = jobs_core.wait(jid, timeout=150)
     assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     # The controller cluster itself is alive and reusable.
     assert state.get_cluster('skyt-jobs-controller') is not None
@@ -158,7 +162,7 @@ def test_managed_job_cluster_controller_recovers_preemption(
     else:
         pytest.fail(f'job never RUNNING: {jobs_state.get_job(jid)}')
     core.down(cluster, purge=True)
-    job = jobs_core.wait(jid, timeout=90)
+    job = jobs_core.wait(jid, timeout=150)
     assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     assert job['recovery_count'] >= 1
 
@@ -185,7 +189,7 @@ def test_managed_job_chain_dag(jobs_env):
         b = _local_task('step-b', 'echo B')
         a >> b
     jid = jobs_core.launch(dag, name='chain', retry_until_up=False)
-    job = jobs_core.wait(jid, timeout=90)
+    job = jobs_core.wait(jid, timeout=150)
     assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
     assert job['task_index'] == 1  # reached the second task
     assert job['num_tasks'] == 2

@@ -232,12 +232,17 @@ def test_serve_lifecycle(serve_env):
 
     # Proxy round-robins across both replicas (reference:
     # tests/skyserve/load_balancer/test_round_robin.py).
+    # Poll until both replicas answer: the LB's replica-set sync can lag
+    # READY status by one sync interval (a fixed request count flakes on
+    # slow machines).
     seen = set()
-    for _ in range(8):
+    deadline = time.time() + 30
+    while time.time() < deadline and len(seen) < 2:
         resp = requests.get(endpoint + '/', timeout=10)
         assert resp.status_code == 200
         assert resp.text.startswith('hello-from-')
         seen.add(resp.text)
+        time.sleep(0.1)
     assert len(seen) == 2
 
     # Replica failure -> detected -> replaced (preemption semantics).

@@ -32,16 +32,29 @@ def _on_tpu() -> bool:
     import subprocess
     import sys
     try:
-        out = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, '-c',
              'import jax, jax.numpy as jnp;'
              'x = jnp.ones((8, 8)) @ jnp.ones((8, 8));'
              'jax.block_until_ready(x);'
              'print(jax.devices()[0].platform)'],
-            capture_output=True, text=True, timeout=120, check=False)
-        return out.stdout.strip().endswith('tpu')
-    except (subprocess.TimeoutExpired, OSError):
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+    except OSError:
         return False
+    try:
+        out, _ = proc.communicate(timeout=120)
+    except subprocess.TimeoutExpired:
+        # Bounded post-kill wait too: a child stuck in an uninterruptible
+        # device ioctl (D state) ignores SIGKILL — abandon it rather than
+        # hang the gate in the unbounded wait subprocess.run would do.
+        proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return False
+    return (out or '').strip().endswith('tpu')
 
 
 def pytest_collection_modifyitems(config, items):
