@@ -409,7 +409,11 @@ def _accels_per_host(res: resources_lib.Resources) -> int:
 
 
 def _is_cloud_uri(source: str) -> bool:
-    return source.startswith(('gs://', 's3://', 'r2://', 'cos://'))
+    # Single source of truth for scheme lists: data_utils (adding a
+    # store there automatically makes its URIs valid file_mount sources
+    # here).
+    from skypilot_tpu.data import data_utils
+    return data_utils.is_cloud_uri(source)
 
 
 def _make_provision_config(plan: optimizer_lib.LaunchablePlan,

@@ -40,6 +40,14 @@ def download_command(source: str, target: str) -> str:
             source = 's3://' + source[len('r2://'):]
         return (f'mkdir -p {q_target} && '
                 f'aws s3 sync {shlex.quote(source)} {q_target}{ep}')
+    if scheme == 'az':
+        from skypilot_tpu.data import storage as storage_lib
+        acct = storage_lib.AzureBlobStore.account()
+        src = bucket if not path else f'{bucket}/{path}'
+        return (f'mkdir -p {q_target} && az storage blob download-batch '
+                f'--destination {q_target} --source {shlex.quote(src)} '
+                f'--account-name {shlex.quote(acct)} --overwrite '
+                f'--output json')
     if scheme in ('http', 'https'):
         return (f'mkdir -p {q_target} && cd {q_target} && '
                 f'curl -fsSLO {shlex.quote(source)}')

@@ -12,7 +12,7 @@ from typing import Tuple
 
 from skypilot_tpu import exceptions
 
-CLOUD_SCHEMES = ('gs', 's3', 'r2', 'local')
+CLOUD_SCHEMES = ('gs', 's3', 'az', 'r2', 'local')
 # Schemes we can *download from* on a remote host but not manage as stores.
 DOWNLOAD_ONLY_SCHEMES = ('cos', 'https', 'http')
 

@@ -31,23 +31,33 @@ class StoreType(enum.Enum):
     """Reference: sky/data/storage.py:109."""
     GCS = 'GCS'
     S3 = 'S3'
+    AZURE = 'AZURE'
     R2 = 'R2'
     LOCAL = 'LOCAL'
 
     @classmethod
     def from_scheme(cls, scheme: str) -> 'StoreType':
-        mapping = {'gs': cls.GCS, 's3': cls.S3, 'r2': cls.R2,
-                   'local': cls.LOCAL}
-        if scheme in mapping:
-            return mapping[scheme]
+        for st, sch in _SCHEMES.items():
+            if sch == scheme:
+                return st
+        managed = ', '.join(f'{s}://' for s in _SCHEMES.values())
         raise exceptions.StorageSourceError(
             f'No store type for scheme {scheme!r} (managed stores: '
-            f'gs://, s3://, r2://, local://).')
+            f'{managed}).')
 
     @property
     def scheme(self) -> str:
-        return {'GCS': 'gs', 'S3': 's3', 'R2': 'r2',
-                'LOCAL': 'local'}[self.value]
+        return _SCHEMES[self]
+
+
+# The one scheme<->store mapping; data_utils.CLOUD_SCHEMES must list the
+# same schemes (asserted below) so URI validation everywhere stays in
+# sync with the registered stores.
+_SCHEMES = {StoreType.GCS: 'gs', StoreType.S3: 's3',
+            StoreType.AZURE: 'az', StoreType.R2: 'r2',
+            StoreType.LOCAL: 'local'}
+assert set(_SCHEMES.values()) == set(data_utils.CLOUD_SCHEMES), \
+    (_SCHEMES, data_utils.CLOUD_SCHEMES)
 
 
 class StorageMode(enum.Enum):
@@ -263,6 +273,104 @@ class S3Store(AbstractStore):
                 f'aws s3 sync s3://{self.name} {target}{ep}')
 
 
+class AzureBlobStore(AbstractStore):
+    """Azure Blob container via the az CLI (reference: AzureBlobStore,
+    sky/data/storage.py:1956 — SDK-based there; CLI here matching the
+    gsutil/aws choice). The storage account comes from
+    SKYT_AZURE_STORAGE_ACCOUNT; auth is whatever `az login` set up.
+
+    COPY-mode first like S3/R2: MOUNT needs blobfuse2 on the host.
+    """
+
+    store_type = StoreType.AZURE
+
+    @staticmethod
+    def account() -> str:
+        acct = os.environ.get('SKYT_AZURE_STORAGE_ACCOUNT', '')
+        if not acct:
+            raise exceptions.StorageError(
+                'Azure storage needs SKYT_AZURE_STORAGE_ACCOUNT in the '
+                'environment.')
+        return acct
+
+    def _az(self, *args: str) -> List[str]:
+        # --output json: exists() parses JSON, and a user-level
+        # ~/.azure/config output=table would otherwise break the parse
+        # (misread as "missing" -> create -> sky_managed=True -> delete()
+        # could remove an external container).
+        return ['az', 'storage', *args, '--account-name', self.account(),
+                '--output', 'json']
+
+    def initialize(self) -> None:
+        if self.exists():
+            self.sky_managed = False
+            return
+        if self.source is not None and data_utils.is_cloud_uri(self.source):
+            raise exceptions.StorageBucketGetError(
+                f'Source container {self.source!r} does not exist.')
+        _run(self._az('container', 'create', '--name', self.name),
+             failure=f'Could not create container {self.name!r}')
+        self.sky_managed = True
+
+    def exists(self) -> bool:
+        proc = subprocess.run(
+            self._az('container', 'exists', '--name', self.name),
+            capture_output=True, text=True, check=False)
+        return proc.returncode == 0 and '"exists": true' in proc.stdout
+
+    def upload(self, source: str) -> None:
+        import tempfile
+        source = os.path.abspath(os.path.expanduser(source))
+        if os.path.isdir(source):
+            # `az storage blob upload-batch` has no exclude flag (only
+            # the include-side --pattern), so excludes are applied
+            # client-side: upload a filtered staging copy.
+            excludes = storage_utils.get_excluded_files(source)
+
+            def ignore(_d: str, names: List[str]) -> List[str]:
+                return [n for n in names
+                        if any(fnmatch.fnmatch(n, p) for p in excludes)]
+
+            with tempfile.TemporaryDirectory(
+                    prefix='skyt-az-upload-') as staging:
+                stage_dir = os.path.join(staging, 'data')
+                shutil.copytree(source, stage_dir, ignore=ignore,
+                                symlinks=True)
+                _run(self._az('blob', 'upload-batch', '--destination',
+                              self.name, '--source', stage_dir,
+                              '--overwrite'),
+                     failure=f'Upload to {self.name!r} failed')
+        elif os.path.exists(source):
+            _run(self._az('blob', 'upload', '--container-name', self.name,
+                          '--file', source, '--name',
+                          os.path.basename(source), '--overwrite'),
+                 failure=f'Upload to {self.name!r} failed')
+        else:
+            raise exceptions.StorageUploadError(
+                f'Source {source!r} does not exist')
+
+    def delete(self) -> None:
+        if not self.sky_managed:
+            logger.info('Container %s is external; not deleting.',
+                        self.name)
+            return
+        _run(self._az('container', 'delete', '--name', self.name),
+             failure=f'Could not delete container {self.name!r}')
+
+    def mount_command(self, mount_path: str) -> str:
+        raise exceptions.StorageError(
+            'MOUNT mode is not supported for AZURE stores yet (needs '
+            'blobfuse2 on the host); use mode: COPY.')
+
+    def download_command(self, target: str) -> str:
+        # --overwrite: re-running a COPY mount on an existing cluster
+        # must refresh files like the gsutil/aws sync commands do.
+        return (f'mkdir -p {target} && az storage blob download-batch '
+                f'--destination {target} --source {self.name} '
+                f'--account-name {self.account()} --overwrite '
+                f'--output json')
+
+
 class R2Store(S3Store):
     """Cloudflare R2: S3-compatible API behind an account endpoint
     (reference: sky/data/storage.py:2732 — boto3 with profile 'r2').
@@ -345,7 +453,8 @@ class LocalStore(AbstractStore):
 
 
 _STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store,
-                  StoreType.R2: R2Store, StoreType.LOCAL: LocalStore}
+                  StoreType.AZURE: AzureBlobStore, StoreType.R2: R2Store,
+                  StoreType.LOCAL: LocalStore}
 
 
 def default_store_type() -> StoreType:
@@ -379,12 +488,13 @@ class Storage:
                 'Storage needs a name or a source.')
         if source is not None and data_utils.is_cloud_uri(source):
             scheme, bucket, _ = data_utils.split_uri(source)
-            if scheme not in ('gs', 's3', 'r2', 'local'):
+            if scheme not in data_utils.CLOUD_SCHEMES:
+                managed = ', '.join(f'{s}://'
+                                    for s in data_utils.CLOUD_SCHEMES)
                 raise exceptions.StorageSourceError(
-                    f'Managed storage supports gs://, s3://, r2:// and '
-                    f'local:// sources; for one-shot downloads from '
-                    f'{scheme}:// use a plain file_mount '
-                    f'(cloud_stores.py).')
+                    f'Managed storage supports {managed} sources; for '
+                    f'one-shot downloads from {scheme}:// use a plain '
+                    f'file_mount (cloud_stores.py).')
             if name is None:
                 name = bucket
         elif source is not None:

@@ -111,6 +111,8 @@ def fake_clouds(tmp_path, monkeypatch):
 def _mk_source(tmp_path):
     src = tmp_path / 'src'
     (src / 'sub').mkdir(parents=True)
+    (src / '.git').mkdir()
+    (src / '.git' / 'config').write_text('x')
     (src / 'a.txt').write_text('A')
     (src / 'sub' / 'b.txt').write_text('B')
     return src
@@ -212,3 +214,111 @@ class TestDataTransfer:
     def test_rejects_unknown_scheme(self, fake_clouds):
         with pytest.raises(exceptions.StorageSourceError):
             data_transfer.transfer('ftp://x', 'gs://y')
+
+
+AZ_FAKE = '''#!/usr/bin/env python3
+"""Fake `az` CLI: local-dir containers + invocation log. STRICT about
+flags (real az rejects unknown arguments; a permissive fake once masked
+a nonexistent --exclude-pattern flag)."""
+import json, os, shutil, sys
+
+root = os.environ['FAKE_AZ_ROOT']
+log = os.environ.get('FAKE_CLI_LOG')
+if log:
+    with open(log, 'a') as f:
+        f.write(' '.join(sys.argv) + '\\n')
+args = sys.argv[1:]
+
+KNOWN_FLAGS = {'--account-name': 1, '--output': 1, '--name': 1,
+               '--destination': 1, '--source': 1, '--container-name': 1,
+               '--file': 1, '--overwrite': 0}
+_i = 0
+while _i < len(args):
+    _a = args[_i]
+    if _a.startswith('--'):
+        if _a not in KNOWN_FLAGS:
+            sys.exit(f'az: unrecognized arguments: {_a}')
+        _i += 1 + KNOWN_FLAGS[_a]
+    else:
+        _i += 1
+
+def val(flag):
+    return args[args.index(flag) + 1]
+
+assert args[0] == 'storage', args
+assert '--account-name' in args, 'account-name flag required'
+if args[1] == 'container' and args[2] == 'create':
+    os.makedirs(os.path.join(root, val('--name')), exist_ok=True)
+elif args[1] == 'container' and args[2] == 'exists':
+    ok = os.path.isdir(os.path.join(root, val('--name')))
+    print(json.dumps({'exists': ok}))
+elif args[1] == 'container' and args[2] == 'delete':
+    shutil.rmtree(os.path.join(root, val('--name')), ignore_errors=True)
+elif args[1] == 'blob' and args[2] == 'upload-batch':
+    dst = os.path.join(root, val('--destination'))
+    shutil.copytree(val('--source'), dst, dirs_exist_ok=True)
+elif args[1] == 'blob' and args[2] == 'upload':
+    dst = os.path.join(root, val('--container-name'))
+    os.makedirs(dst, exist_ok=True)
+    shutil.copy2(val('--file'), os.path.join(dst, val('--name')))
+elif args[1] == 'blob' and args[2] == 'download-batch':
+    shutil.copytree(os.path.join(root, val('--source')),
+                    val('--destination'), dirs_exist_ok=True)
+else:
+    sys.exit(f'fake az: unhandled {args}')
+'''
+
+
+@pytest.fixture()
+def fake_azure(tmp_path, monkeypatch, fake_clouds):
+    bindir = tmp_path / 'bin'
+    az_root = tmp_path / 'azroot'
+    az_root.mkdir()
+    p = bindir / 'az'
+    p.write_text(AZ_FAKE)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv('FAKE_AZ_ROOT', str(az_root))
+    monkeypatch.setenv('SKYT_AZURE_STORAGE_ACCOUNT', 'unitacct')
+    return az_root
+
+
+class TestAzureStore:
+    def test_lifecycle(self, fake_azure, tmp_path, tmp_state_dir):
+        src = _mk_source(tmp_path)
+        st = storage.Storage(name='az-bkt', source=str(src),
+                             mode=storage.StorageMode.COPY)
+        store = st.add_store(storage.StoreType.AZURE)
+        assert store.exists()
+        assert (fake_azure / 'az-bkt' / 'a.txt').read_text() == 'A'
+        assert (fake_azure / 'az-bkt' / 'sub' / 'b.txt').read_text() \
+            == 'B'
+        # Client-side excludes: .git never reaches the container.
+        assert not (fake_azure / 'az-bkt' / '.git').exists()
+        cmd = store.download_command('/data')
+        assert 'az storage blob download-batch' in cmd
+        assert '--overwrite' in cmd
+        with pytest.raises(exceptions.StorageError):
+            store.mount_command('/mnt')
+        st.delete()
+        assert not (fake_azure / 'az-bkt').exists()
+
+    def test_requires_account(self, fake_azure, monkeypatch):
+        monkeypatch.delenv('SKYT_AZURE_STORAGE_ACCOUNT', raising=False)
+        with pytest.raises(exceptions.StorageError, match='ACCOUNT'):
+            storage.AzureBlobStore('az-bkt', None).exists()
+
+    def test_scheme_selects_store(self, fake_azure):
+        st = storage.Storage(source='az://somewhere')
+        assert st.requested_store == storage.StoreType.AZURE
+
+    def test_az_file_mount_download_command(self, fake_azure):
+        """Plain az:// file_mount sources route through cloud_stores
+        (regression: az was in CLOUD_SCHEMES but two consumers outside
+        the data layer didn't know the scheme)."""
+        from skypilot_tpu.backends import tpu_backend
+        from skypilot_tpu.data import cloud_stores
+
+        assert tpu_backend._is_cloud_uri('az://bkt/path')
+        cmd = cloud_stores.download_command('az://bkt/sub', '/data')
+        assert 'az storage blob download-batch' in cmd
+        assert 'bkt/sub' in cmd and '--overwrite' in cmd
