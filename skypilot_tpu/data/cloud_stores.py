@@ -30,14 +30,16 @@ def download_command(source: str, target: str) -> str:
         return (f'mkdir -p {q_target} && if [ -d {q_src} ]; then '
                 f'cp -a {q_src}/. {q_target}/; else '
                 f'cp -a {q_src} {q_target}/; fi')
-    if scheme in ('s3', 'r2'):
+    if scheme in ('s3', 'r2', 'cos'):
         ep = ''
-        if scheme == 'r2':
-            # Raises when SKYT_R2_ENDPOINT is unset — a silent fallback
-            # would sync from a same-named *AWS* bucket instead of R2.
+        if scheme in ('r2', 'cos'):
+            # Raises when SKYT_{R2,COS}_ENDPOINT is unset — a silent
+            # fallback would sync from a same-named *AWS* bucket instead.
             from skypilot_tpu.data import storage as storage_lib
-            ep = f' --endpoint-url {shlex.quote(storage_lib.R2Store.endpoint())}'
-            source = 's3://' + source[len('r2://'):]
+            store_cls = (storage_lib.R2Store if scheme == 'r2'
+                         else storage_lib.IbmCosStore)
+            ep = f' --endpoint-url {shlex.quote(store_cls.endpoint())}'
+            source = 's3://' + source[len(scheme) + 3:]
         return (f'mkdir -p {q_target} && '
                 f'aws s3 sync {shlex.quote(source)} {q_target}{ep}')
     if scheme == 'az':

@@ -23,13 +23,13 @@ from skypilot_tpu.utils import log_utils
 logger = log_utils.init_logger(__name__)
 
 
-def _r2_flags() -> List[str]:
-    ep = os.environ.get('SKYT_R2_ENDPOINT',
-                        os.environ.get('R2_ENDPOINT', ''))
-    if not ep:
-        raise exceptions.StorageError(
-            'R2 transfer needs SKYT_R2_ENDPOINT in the environment.')
-    return ['--endpoint-url', ep]
+def _endpoint_flags(scheme: str) -> List[str]:
+    """--endpoint-url flags for S3-compatible stores (r2, cos) —
+    resolution (env vars + unset error) lives on the store classes."""
+    from skypilot_tpu.data import storage as storage_lib
+    cls = {'r2': storage_lib.R2Store,
+           'cos': storage_lib.IbmCosStore}[scheme]
+    return ['--endpoint-url', cls.endpoint()]
 
 
 def _run(cmd: List[str], failure: str) -> None:
@@ -50,11 +50,12 @@ def _sync_cmd(scheme: str, src: str, dst: str) -> List[List[str]]:
         return [['gsutil', '-m', 'rsync', '-r', src, dst]]
     if scheme == 's3':
         return [['aws', 's3', 'sync', src, dst]]
-    if scheme == 'r2':
+    if scheme in ('r2', 'cos'):
         def fix(u: str) -> str:
-            return 's3://' + u[len('r2://'):] if u.startswith('r2://') \
-                else u
-        return [['aws', 's3', 'sync', fix(src), fix(dst), *_r2_flags()]]
+            return 's3://' + u[len(scheme) + 3:] \
+                if u.startswith(f'{scheme}://') else u
+        return [['aws', 's3', 'sync', fix(src), fix(dst),
+                 *_endpoint_flags(scheme)]]
     if scheme == 'local':
         def path(u: str) -> str:
             if u.startswith('local://'):
@@ -72,15 +73,16 @@ def transfer(src_uri: str, dst_uri: str,
              spool_dir: Optional[str] = None) -> None:
     """Copy all objects under src_uri to dst_uri.
 
-    Same-family (gs->gs, s3->s3, r2->r2, local->local): direct sync.
+    Same-family (gs->gs, s3->s3, r2->r2, cos->cos, local->local):
+    direct sync.
     Cross-family: download into a spool dir, upload, delete the spool.
     """
     s_scheme, _, _ = data_utils.split_uri(src_uri)
     d_scheme, _, _ = data_utils.split_uri(dst_uri)
-    family = {'gs': 'gs', 's3': 's3', 'r2': 'r2', 'local': 'local'}
+    family = ('gs', 's3', 'r2', 'cos', 'local')
     if s_scheme not in family or d_scheme not in family:
         raise exceptions.StorageSourceError(
-            f'transfer() supports gs/s3/r2/local URIs, got '
+            f'transfer() supports gs/s3/r2/cos/local URIs, got '
             f'{s_scheme!r} -> {d_scheme!r}')
 
     if s_scheme == d_scheme:

@@ -12,9 +12,9 @@ from typing import Tuple
 
 from skypilot_tpu import exceptions
 
-CLOUD_SCHEMES = ('gs', 's3', 'az', 'r2', 'local')
+CLOUD_SCHEMES = ('gs', 's3', 'az', 'r2', 'cos', 'local')
 # Schemes we can *download from* on a remote host but not manage as stores.
-DOWNLOAD_ONLY_SCHEMES = ('cos', 'https', 'http')
+DOWNLOAD_ONLY_SCHEMES = ('https', 'http')
 
 # GCS bucket naming rules (subset): 3-63 chars, lowercase letters, digits,
 # dashes, underscores, dots; must start/end alphanumeric.

@@ -2,12 +2,13 @@
 
 Reference: sky/data/storage.py (3,526 LoC) — `StoreType` (:109),
 `StorageMode` (:192), `AbstractStore` (:197), `Storage` (:384),
-`GcsStore` (:1511, gsutil rsync batching). The reference supports five
-object stores (S3/GCS/Azure/R2/COS); the TPU-native rebuild is GCS-first
+`GcsStore` (:1511, gsutil rsync batching). The reference's five object
+stores (S3/GCS/Azure/R2/COS) are all implemented; GCS is first-class
 (TPU VMs are GCP VMs — one bucket family rides the same network as the
-chips) plus a ``local://`` store that backs the offline test harness and
-the local provider. Download-only access to foreign schemes (s3:// etc.)
-lives in cloud_stores.py.
+chips), S3/R2/COS ride the aws CLI (R2/COS via S3-compatible endpoints),
+Azure rides the az CLI, and a ``local://`` store backs the offline test
+harness and the local provider. Download-only access to bucket-URI
+file_mounts lives in cloud_stores.py.
 """
 import dataclasses
 import enum
@@ -33,6 +34,7 @@ class StoreType(enum.Enum):
     S3 = 'S3'
     AZURE = 'AZURE'
     R2 = 'R2'
+    COS = 'COS'
     LOCAL = 'LOCAL'
 
     @classmethod
@@ -55,7 +57,7 @@ class StoreType(enum.Enum):
 # sync with the registered stores.
 _SCHEMES = {StoreType.GCS: 'gs', StoreType.S3: 's3',
             StoreType.AZURE: 'az', StoreType.R2: 'r2',
-            StoreType.LOCAL: 'local'}
+            StoreType.COS: 'cos', StoreType.LOCAL: 'local'}
 assert set(_SCHEMES.values()) == set(data_utils.CLOUD_SCHEMES), \
     (_SCHEMES, data_utils.CLOUD_SCHEMES)
 
@@ -393,6 +395,30 @@ class R2Store(S3Store):
         return ['--endpoint-url', self.endpoint()]
 
 
+class IbmCosStore(S3Store):
+    """IBM Cloud Object Storage via its S3-compatible API (reference:
+    IBMCosStore, sky/data/storage.py:3116 — rclone + ibm_boto3 there; the
+    aws CLI against the regional COS endpoint here, matching the R2
+    design). The endpoint comes from SKYT_COS_ENDPOINT (or COS_ENDPOINT),
+    e.g. https://s3.us-south.cloud-object-storage.appdomain.cloud."""
+
+    store_type = StoreType.COS
+
+    @staticmethod
+    def endpoint() -> str:
+        ep = os.environ.get('SKYT_COS_ENDPOINT',
+                            os.environ.get('COS_ENDPOINT', ''))
+        if not ep:
+            raise exceptions.StorageError(
+                'IBM COS needs SKYT_COS_ENDPOINT (https://s3.<region>.'
+                'cloud-object-storage.appdomain.cloud) in the '
+                'environment.')
+        return ep
+
+    def _endpoint_flags(self) -> List[str]:
+        return ['--endpoint-url', self.endpoint()]
+
+
 class LocalStore(AbstractStore):
     """Directory-backed bucket under SKYT_LOCAL_STORAGE_ROOT.
 
@@ -454,7 +480,7 @@ class LocalStore(AbstractStore):
 
 _STORE_CLASSES = {StoreType.GCS: GcsStore, StoreType.S3: S3Store,
                   StoreType.AZURE: AzureBlobStore, StoreType.R2: R2Store,
-                  StoreType.LOCAL: LocalStore}
+                  StoreType.COS: IbmCosStore, StoreType.LOCAL: LocalStore}
 
 
 def default_store_type() -> StoreType:

@@ -105,10 +105,13 @@ def test_storage_validation():
     with pytest.raises(exceptions.StorageSourceError):
         storage_lib.Storage(name='ok-name', source='/no/such/path')
     with pytest.raises(exceptions.StorageSourceError):
-        storage_lib.Storage(source='cos://foreign')  # unmanaged scheme
-    # s3:// and r2:// became managed schemes (S3Store/R2Store).
+        storage_lib.Storage(source='ftp://foreign')  # unmanaged scheme
+    # s3://, r2://, and cos:// became managed schemes (S3Store/R2Store/
+    # IbmCosStore).
     assert storage_lib.Storage(source='s3://foreign').requested_store \
         == storage_lib.StoreType.S3
+    assert storage_lib.Storage(source='cos://foreign').requested_store \
+        == storage_lib.StoreType.COS
 
 
 def test_mount_mode_symlink(storage_env):

@@ -178,6 +178,58 @@ class TestR2Store:
                    for c in aws_calls), aws_calls
 
 
+class TestIbmCosStore:
+    """IBM COS rides the same S3-compatible endpoint path as R2.
+    Reference parity: sky/data/storage.py:3116 (IBMCosStore)."""
+
+    def test_requires_endpoint(self, fake_clouds, monkeypatch):
+        monkeypatch.delenv('SKYT_COS_ENDPOINT', raising=False)
+        monkeypatch.delenv('COS_ENDPOINT', raising=False)
+        with pytest.raises(exceptions.StorageError, match='ENDPOINT'):
+            storage.IbmCosStore('cos-bkt', None).exists()
+
+    def test_endpoint_on_every_call(self, fake_clouds, tmp_path,
+                                    tmp_state_dir, monkeypatch):
+        monkeypatch.setenv(
+            'SKYT_COS_ENDPOINT',
+            'https://s3.us-south.cloud-object-storage.appdomain.cloud')
+        src = _mk_source(tmp_path)
+        st = storage.Storage(name='cos-bkt', source=str(src),
+                             mode=storage.StorageMode.COPY)
+        store = st.add_store(storage.StoreType.COS)
+        assert store.exists()
+        assert 'endpoint-url' in store.download_command('/data')
+        st.delete()
+        calls = fake_clouds['log'].read_text().splitlines()
+        aws_calls = [c for c in calls if '/aws' in c.split()[0]]
+        assert aws_calls, 'no aws invocations recorded'
+        assert all('--endpoint-url https://s3.us-south.'
+                   'cloud-object-storage.appdomain.cloud' in c
+                   for c in aws_calls), aws_calls
+
+    def test_scheme_selects_store(self, fake_clouds):
+        st = storage.Storage(source='cos://somewhere')
+        assert st.requested_store == storage.StoreType.COS
+
+    def test_cos_file_mount_download_command(self, fake_clouds,
+                                             monkeypatch):
+        monkeypatch.setenv('SKYT_COS_ENDPOINT', 'https://cos.example')
+        from skypilot_tpu.data import cloud_stores
+        cmd = cloud_stores.download_command('cos://bkt/sub', '/data')
+        assert 'aws s3 sync s3://bkt/sub /data' in cmd
+        assert '--endpoint-url https://cos.example' in cmd
+
+    def test_cos_transfer_cross_family(self, fake_clouds, tmp_path,
+                                       monkeypatch):
+        monkeypatch.setenv('SKYT_COS_ENDPOINT', 'https://cos.example')
+        src = _mk_source(tmp_path)
+        subprocess.run(['gsutil', 'mb', 'gs://gsrc'], check=True)
+        subprocess.run(['gsutil', 'rsync', str(src), 'gs://gsrc'],
+                       check=True)
+        data_transfer.transfer('gs://gsrc', 'cos://cdst')
+        assert (fake_clouds['s3'] / 'cdst' / 'a.txt').read_text() == 'A'
+
+
 class TestDataTransfer:
     def test_same_family_direct(self, fake_clouds, tmp_path):
         src = _mk_source(tmp_path)
