@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
@@ -56,6 +57,10 @@ class _Request:
     slot: Optional[int] = None
     generated: int = 0
     rng: Any = None
+    # Prompt page hashes, computed once at first admission attempt (a
+    # deferred request retries every loop tick; re-hashing the prompt
+    # each time is O(n) host work for an unchanging value).
+    page_hashes: Optional[List[bytes]] = None
 
 
 def _round_up_pow2(n: int, lo: int = 32) -> int:
@@ -86,7 +91,8 @@ class InferenceEngine:
                  mesh=None, rules=None,
                  cache_mode: str = 'dense',
                  page_size: int = 64,
-                 pool_tokens: Optional[int] = None) -> None:
+                 pool_tokens: Optional[int] = None,
+                 prefix_caching: bool = True) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
@@ -115,6 +121,12 @@ class InferenceEngine:
 
         dtype = jnp.dtype(self.cfg.dtype)
         self.cache_mode = cache_mode
+        # Prefix caching (paged mode only): admissions whose prompt
+        # shares full pages with a published prefix skip both the KV
+        # writes AND the prefill compute for the shared span — the
+        # shared-system-prompt TTFT win vLLM's automatic prefix caching
+        # gives the reference.
+        self.prefix_caching = prefix_caching and cache_mode == 'paged'
         self.pool = None
         cache_sharding = None
         if mesh is not None:
@@ -192,6 +204,8 @@ class InferenceEngine:
 
         self._jit_prefill = jax.jit(self._prefill_impl,
                                     static_argnames=('bucket',))
+        self._jit_prefill_suffix = jax.jit(self._prefill_suffix_impl,
+                                           static_argnames=('bucket',))
         # Donate the cache: without it XLA materializes a full cache
         # copy every decode step (hundreds of MB at 8 slots x 2k ctx).
         self._jit_decode_n = jax.jit(self._decode_n_impl,
@@ -245,6 +259,30 @@ class InferenceEngine:
                             axis=-1).astype(jnp.int32)
         return greedy, logits, new_cache
 
+    def _prefill_suffix_impl(self, params, tokens, start, length,
+                             k_pool, v_pool, table_row, bucket):
+        """Prefix-cached prefill: only the prompt SUFFIX (tokens
+        [1, bucket], global positions start..start+bucket) runs through
+        the model; the shared prefix KV is gathered from the slot's
+        already-populated pages and attended over via the dense
+        continuation path. Returns (greedy, logits [1, V], new_cache
+        {'k','v'} [L, 1, max_pages*P, H, d]) — the full per-slot view
+        including the prefix, which the paged insert then scatters back
+        (private pages only, via src_off)."""
+        del bucket
+        from skypilot_tpu.infer.paged_cache import PagePool
+        b, s = tokens.shape
+        positions = start + jnp.arange(s)[None, :].repeat(b, 0)
+        view = {'k': PagePool.gather_view(k_pool, table_row[None]),
+                'v': PagePool.gather_view(v_pool, table_row[None])}
+        logits, new_cache = self.model.apply(
+            params, tokens, positions=positions, cache=view,
+            logit_positions=(length - start - 1)[:, None])
+        logits = logits[:, 0, :]
+        greedy = jnp.argmax(logits.astype(jnp.float32),
+                            axis=-1).astype(jnp.int32)
+        return greedy, logits, new_cache
+
     @staticmethod
     def _pin_paged_layouts(cache):
         """Pin the page pools' jit-boundary layout to row-major.
@@ -289,14 +327,16 @@ class InferenceEngine:
 
     def _insert_paged_impl(self, cache, prefill_cache, slot, args,
                            first_tok, length, temp, key, topk,
-                           page_ids, table_row):
+                           page_ids, table_row, src_off):
         """Paged-mode admission: scatter the prompt KV into the reserved
         pages, install the slot's block-table row, and update the decode
         args — one fused dispatch, same contract as _insert_impl.
 
-        page_ids: [n_ins] int32 — pages receiving the first n_ins*P
-        prompt positions (n_ins static via the shape, so one compile per
-        distinct page count). table_row: [max_pages] int32."""
+        page_ids: [n_ins] int32 — pages receiving prompt KV positions
+        [src_off, src_off + n_ins*P) (n_ins static via the shape, so one
+        compile per distinct page count). A prefix-cached admission
+        passes src_off = shared_pages*P so only the computed suffix
+        pages are written. table_row: [max_pages] int32."""
         from skypilot_tpu.infer import paged_cache
         p = cache['k'].shape[3]    # [L, n_pages, H, P, d] — P axis
         need = page_ids.shape[0] * p
@@ -308,9 +348,9 @@ class InferenceEngine:
             pv = jnp.pad(pv, pad)
         new_cache = {
             'k': paged_cache.PagePool.insert_prompt(cache['k'], pk,
-                                                    page_ids),
+                                                    page_ids, src_off),
             'v': paged_cache.PagePool.insert_prompt(cache['v'], pv,
-                                                    page_ids),
+                                                    page_ids, src_off),
             'tables': cache['tables'].at[slot].set(table_row),
         }
         return self._pin_paged_layouts(new_cache), _update_args(
@@ -422,6 +462,7 @@ class InferenceEngine:
             out.append(tok)
 
     def start(self) -> None:
+        self._stop.clear()    # restartable: start after stop works
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -440,15 +481,25 @@ class InferenceEngine:
         if not started:
             self.start()
         try:
-            for b in buckets or self.prefill_buckets:
+            last_warm = None
+            for bi, b in enumerate(buckets or self.prefill_buckets):
                 if b >= self.max_seq_len:
                     continue
                 n_new = min(self.decode_chunk,
                             self.max_seq_len - 1 - b)
                 if n_new < 1:
                     continue
-                self.generate([1] * b,
+                # Distinct token per bucket: with prefix caching on, a
+                # shared token would route later buckets through the
+                # suffix path and leave their FULL prefill uncompiled.
+                last_warm = ([bi + 2] * b, n_new)
+                self.generate(last_warm[0],
                               SamplingParams(max_new_tokens=n_new))
+            if self.prefix_caching and last_warm is not None:
+                # Re-run the largest warmed prompt to compile the
+                # prefix-cached suffix-prefill path.
+                self.generate(last_warm[0],
+                              SamplingParams(max_new_tokens=last_warm[1]))
         finally:
             if not started:
                 self.stop()
@@ -472,6 +523,8 @@ class InferenceEngine:
         p['steady_decode_tok_per_sec'] = (
             p['steady_tokens'] / p['steady_time_s']
             if p['steady_time_s'] > 0 else 0.0)
+        if self.prefix_caching and self.pool is not None:
+            p['prefix_cache'] = dict(self.pool.prefix_stats)
         return p
 
     def reset_perf(self) -> None:
@@ -513,23 +566,62 @@ class InferenceEngine:
         n = len(req.tokens)
         bucket = self._bucket_for(n)
         row = None
+        n_cached = 0
+        hashes: List[bytes] = []
         if self.cache_mode == 'paged':
             # Reserve the worst case this request can touch — prompt +
             # max_new — so decode can never exhaust the pool mid-flight.
             total = min(n + req.params.max_new_tokens, self.max_seq_len)
-            row = self.pool.try_reserve(slot, total)
-            if row is None:
+            psize = self.pool.cfg.page_size
+            if self.prefix_caching:
+                if req.page_hashes is None:
+                    req.page_hashes = paged_cache_hashes(req.tokens,
+                                                         psize)
+                hashes = req.page_hashes
+            # Cap the shared span at (n-1)//P pages: at least one real
+            # token must run through the model to produce next-token
+            # logits.
+            res = self.pool.try_reserve_prefix(
+                slot, total, hashes[:(n - 1) // psize])
+            if res is None:
                 # Pool full: keep FIFO order, retry after releases.
                 self._deferred = req
                 return False
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :n] = req.tokens
+            row, n_cached = res
+            if n_cached > 0:
+                sb = self._bucket_for(n - n_cached * psize)
+                max_span = self.pool.cfg.max_pages_per_slot * psize
+                if n_cached * psize + sb > max_span:
+                    # The suffix bucket's padded writes would spill past
+                    # the per-slot view (dynamic_update_slice would
+                    # clamp the start and corrupt the cache) — rare;
+                    # fall back to a full prefill.
+                    self.pool.release(slot)
+                    res = self.pool.try_reserve_prefix(slot, total, ())
+                    if res is None:
+                        self._deferred = req
+                        return False
+                    row, n_cached = res
         temp = max(0.0, req.params.temperature)
         key = jax.random.PRNGKey(req.params.seed + req.req_id)
         with self._ctx():
-            greedy, logits, prefill_cache = self._jit_prefill(
-                self.params, jnp.asarray(padded), jnp.asarray([n]),
-                bucket=bucket)
+            if n_cached > 0:
+                psize = self.pool.cfg.page_size
+                start = n_cached * psize
+                suffix = req.tokens[start:]
+                sb = self._bucket_for(len(suffix))
+                padded = np.zeros((1, sb), np.int32)
+                padded[0, :len(suffix)] = suffix
+                greedy, logits, prefill_cache = self._jit_prefill_suffix(
+                    self.params, jnp.asarray(padded), jnp.int32(start),
+                    jnp.asarray([n]), self.cache['k'], self.cache['v'],
+                    jnp.asarray(row), bucket=sb)
+            else:
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :n] = req.tokens
+                greedy, logits, prefill_cache = self._jit_prefill(
+                    self.params, jnp.asarray(padded), jnp.asarray([n]),
+                    bucket=bucket)
             if temp > 0.0:
                 first = self._sample(np.asarray(logits)[0], req)
             else:
@@ -542,10 +634,24 @@ class InferenceEngine:
             if self.cache_mode == 'paged':
                 reserved = int((row > 0).sum())
                 p = self.pool.cfg.page_size
-                n_ins = min(-(-bucket // p), reserved)
+                if n_cached > 0:
+                    # Write only the computed suffix pages; the shared
+                    # prefix pages already hold this content.
+                    n_ins = min(-(-n // p), reserved) - n_cached
+                    ids = row[n_cached:n_cached + n_ins]
+                    src = n_cached * p
+                else:
+                    n_ins = min(-(-bucket // p), reserved)
+                    ids = row[:n_ins]
+                    src = 0
                 self.cache, self._dev_args = self._jit_insert_paged(
                     self.cache, prefill_cache, *ins_args,
-                    jnp.asarray(row[:n_ins]), jnp.asarray(row))
+                    jnp.asarray(ids), jnp.asarray(row), jnp.int32(src))
+                if self.prefix_caching:
+                    # Publish every full page the slot now holds; later
+                    # readers order after this insert via the dispatch
+                    # chain.
+                    self.pool.publish(slot, hashes[:n // p])
             else:
                 # Trim/pad the prefill cache S axis to the global
                 # cache's.

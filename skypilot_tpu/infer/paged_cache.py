@@ -30,12 +30,28 @@ Device-side ops are shape-static for XLA:
 Page 0 is a shared dummy: unreserved table entries point at it and are
 never read unmasked (attention masks positions >= length).
 """
+import collections
 import dataclasses
-from typing import Dict, List, Optional
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def page_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
+    """Chained content hashes of a prompt's FULL pages — the prefix-cache
+    key (vLLM's automatic prefix caching, which the reference gets via
+    llm/vllm/serve.yaml). hash[i] covers tokens[0 : (i+1)*page_size], so
+    two prompts share page i iff they agree on everything up to it."""
+    h = hashlib.blake2b(digest_size=16)
+    out: List[bytes] = []
+    for i in range(len(tokens) // page_size):
+        h.update(np.asarray(tokens[i * page_size:(i + 1) * page_size],
+                            dtype=np.int64).tobytes())
+        out.append(h.digest())
+    return out
 
 
 @dataclasses.dataclass
@@ -88,6 +104,18 @@ class PagePool:
         # decode args and is updated on device at insert.
         self.tables = np.zeros((num_slots, cfg.max_pages_per_slot),
                                np.int32)
+        # Prefix cache: content-hash -> page, plus per-page refcounts.
+        # Pages with refcount 0 that still hold published content sit in
+        # an LRU pool (_cached_free) and are reclaimed only when _free is
+        # empty — so a released system prompt's KV stays warm as long as
+        # HBM allows (vLLM's automatic prefix caching).
+        self._refs = np.zeros((cfg.n_pages,), np.int64)
+        self._registry: Dict[bytes, int] = {}
+        self._page_hash: Dict[int, bytes] = {}
+        self._cached_free: 'collections.OrderedDict[int, None]' = \
+            collections.OrderedDict()
+        self.prefix_stats = {'hit_pages': 0, 'miss_pages': 0,
+                             'evictions': 0}
 
     # --------------------------------------------------- host accounting
     def pages_needed(self, total_tokens: int) -> int:
@@ -95,42 +123,122 @@ class PagePool:
                    self.cfg.max_pages_per_slot)
 
     def free_pages(self) -> int:
-        return len(self._free)
+        """Allocatable pages: never-published free pages plus published
+        pages no live slot references (reclaimable via eviction)."""
+        return len(self._free) + len(self._cached_free)
+
+    def _alloc_page(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._cached_free:
+            # Evict the least-recently-released published page.
+            page, _ = self._cached_free.popitem(last=False)
+            h = self._page_hash.pop(page)
+            del self._registry[h]
+            self.prefix_stats['evictions'] += 1
+            return page
+        return None
+
+    def _unref(self, page: int) -> None:
+        self._refs[page] -= 1
+        assert self._refs[page] >= 0, f'page {page} refcount underflow'
+        if self._refs[page] == 0:
+            if page in self._page_hash:
+                self._cached_free[page] = None
+                self._cached_free.move_to_end(page)
+            else:
+                self._free.append(page)
 
     def try_reserve(self, slot: int, total_tokens: int) -> Optional[np.ndarray]:
         """Reserve pages covering total_tokens for `slot`. Returns the
         slot's full table row (np [max_pages_per_slot]) or None if the
         pool cannot satisfy the reservation."""
+        res = self.try_reserve_prefix(slot, total_tokens, ())
+        return None if res is None else res[0]
+
+    def try_reserve_prefix(
+            self, slot: int, total_tokens: int,
+            lookup_hashes: Sequence[bytes]
+    ) -> Optional[Tuple[np.ndarray, int]]:
+        """Reserve pages covering total_tokens for `slot`, sharing the
+        longest registered run of `lookup_hashes` (page_hashes() of the
+        prompt's full pages). Returns (table row, n shared pages) or
+        None if the pool cannot satisfy the reservation."""
         n = self.pages_needed(total_tokens)
-        if n > len(self._free):
-            return None
         assert not self._owned[slot], f'slot {slot} already holds pages'
-        pages = [self._free.pop() for _ in range(n)]
+        shared: List[int] = []
+        for h in lookup_hashes[:n]:
+            page = self._registry.get(h)
+            if page is None:
+                break
+            shared.append(page)
+        for page in shared:
+            if self._refs[page] == 0:
+                self._cached_free.pop(page, None)
+            self._refs[page] += 1
+        if n - len(shared) > len(self._free) + len(self._cached_free):
+            # Cannot satisfy: bail BEFORE _alloc_page evicts anything —
+            # a doomed oversized reservation must not wipe the warm
+            # prefix cache on its way to being deferred.
+            for p in shared:
+                self._unref(p)
+            return None
+        private: List[int] = []
+        for _ in range(n - len(shared)):
+            page = self._alloc_page()
+            assert page is not None   # guaranteed by the check above
+            private.append(page)
+        for page in private:
+            self._refs[page] += 1
+        self.prefix_stats['hit_pages'] += len(shared)
+        self.prefix_stats['miss_pages'] += n - len(shared)
+        pages = shared + private
         self._owned[slot] = pages
         row = np.zeros((self.cfg.max_pages_per_slot,), np.int32)
         row[:n] = pages
         self.tables[slot] = row
-        return row
+        return row, len(shared)
+
+    def publish(self, slot: int, hashes: Sequence[bytes]) -> None:
+        """Register hash -> page for the slot's leading pages (call once
+        their contents are scheduled to be written — single dispatch
+        chain, so later readers order after the write)."""
+        pages = self._owned[slot]
+        for i, h in enumerate(hashes):
+            if i >= len(pages):
+                break
+            page = pages[i]
+            if h in self._registry:
+                continue      # an identical page is already published
+            if page in self._page_hash:
+                continue      # page already published under another key
+            self._registry[h] = page
+            self._page_hash[page] = h
 
     def release(self, slot: int) -> None:
-        self._free.extend(self._owned[slot])
+        for page in self._owned[slot]:
+            self._unref(page)
         self._owned[slot] = []
         self.tables[slot] = 0
 
     # ----------------------------------------------------- device kernels
     @staticmethod
-    def insert_prompt(pool, prompt_kv, page_ids):
+    def insert_prompt(pool, prompt_kv, page_ids, src_off=0):
         """Scatter a prefill cache into reserved pages.
 
         pool:      [L, n_pages, H, P, d] (donated by the caller's jit)
         prompt_kv: [L, 1, S_bucket, H, d] from the prefill
-        page_ids:  [n] int32 — the first n reserved pages; n*P tokens of
-                   the prompt KV are stored (n is static via the shape).
+        page_ids:  [n] int32 — the pages receiving prompt KV positions
+                   [src_off, src_off + n*P) (n is static via the shape).
+        src_off:   dynamic token offset — a prefix-cached admission only
+                   writes the pages it computed, not the shared prefix.
         """
         n = page_ids.shape[0]
         l, _, _, h, d = prompt_kv.shape
         p = pool.shape[3]
-        chunk = prompt_kv[:, 0, :n * p]            # [L, n*P, H, d]
+        chunk = jax.lax.dynamic_slice(
+            prompt_kv, (0, 0, src_off, 0, 0),
+            (l, 1, n * p, h, d))[:, 0]             # [L, n*P, H, d]
         chunk = chunk.reshape(l, n, p, h, d).transpose(0, 1, 3, 2, 4)
         return pool.at[:, page_ids].set(chunk.astype(pool.dtype))
 

@@ -357,7 +357,8 @@ def build_engine(model_name: Optional[str] = None,
                  decode_chunk: int = 16,
                  cache_mode: str = 'auto',
                  pool_tokens: Optional[int] = None,
-                 dtype: str = 'bfloat16'
+                 dtype: str = 'bfloat16',
+                 prefix_caching: bool = True
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
@@ -426,7 +427,8 @@ def build_engine(model_name: Optional[str] = None,
                                       decode_chunk=decode_chunk,
                                       mesh=mesh,
                                       cache_mode=cache_mode,
-                                      pool_tokens=pool_tokens)
+                                      pool_tokens=pool_tokens,
+                                      prefix_caching=prefix_caching)
 
 
 def main(argv=None) -> None:
@@ -458,11 +460,14 @@ def main(argv=None) -> None:
     parser.add_argument('--cache-mode', default='auto',
                         choices=['auto', 'paged', 'dense'],
                         help='KV cache layout (auto: paged for llama)')
+    parser.add_argument('--no-prefix-caching', action='store_true',
+                        help='disable KV prefix caching (paged mode)')
     args = parser.parse_args(argv)
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
                           checkpoint=args.checkpoint, tp=args.tp,
-                          cache_mode=args.cache_mode, dtype=args.dtype)
+                          cache_mode=args.cache_mode, dtype=args.dtype,
+                          prefix_caching=not args.no_prefix_caching)
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
     if tok_path:

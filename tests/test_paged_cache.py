@@ -44,6 +44,83 @@ class TestAccounting:
             pool.try_reserve(0, 4)
 
 
+class TestPrefixCache:
+    """Host-side prefix registry: sharing, refcounts, LRU eviction.
+    vLLM-automatic-prefix-caching analog (llm/vllm/serve.yaml)."""
+
+    def test_page_hashes_chain(self):
+        p = 4
+        a = paged_cache.page_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], p)
+        b = paged_cache.page_hashes([1, 2, 3, 4, 9, 9, 9, 9], p)
+        assert len(a) == 2          # only FULL pages are hashed
+        assert len(b) == 2
+        assert a[0] == b[0]         # same first page
+        assert a[1] != b[1]         # diverging second page
+        # Chained: same page content after a different prefix differs.
+        c = paged_cache.page_hashes([9, 9, 9, 9, 5, 6, 7, 8], p)
+        assert c[1] != a[1]
+
+    def test_share_refcount_release(self):
+        pool = _pool()
+        h = paged_cache.page_hashes(list(range(1, 9)), 4)   # 2 pages
+        row0, m0 = pool.try_reserve_prefix(0, 12, h)        # 3 pages
+        assert m0 == 0
+        pool.publish(0, h)
+        free_before = pool.free_pages()
+        row1, m1 = pool.try_reserve_prefix(1, 12, h)
+        assert m1 == 2                          # both full pages shared
+        assert (row1[:2] == row0[:2]).all()
+        assert row1[2] != row0[2]               # private third page
+        # Sharing consumed only ONE new page.
+        assert pool.free_pages() == free_before - 1
+        # Slot 0 releases; shared pages stay live for slot 1.
+        pool.release(0)
+        row2, m2 = pool.try_reserve_prefix(2, 12, h)
+        assert m2 == 2 and (row2[:2] == row1[:2]).all()
+
+    def test_released_pages_stay_warm_then_evict(self):
+        pool = _pool()                          # 8 usable pages
+        h = paged_cache.page_hashes(list(range(1, 9)), 4)
+        pool.try_reserve_prefix(0, 8, h)        # 2 pages
+        pool.publish(0, h)
+        pool.release(0)
+        # Nothing active, but the published pages are still hits.
+        row, m = pool.try_reserve_prefix(1, 8, h)
+        assert m == 2
+        pool.release(1)
+        # Demand for all 8 pages evicts the cached ones (LRU) rather
+        # than failing.
+        row2, m2 = pool.try_reserve_prefix(2, 32, ())
+        assert row2 is not None and (row2 > 0).sum() == 4
+        pool.try_reserve_prefix(0, 16, ())
+        assert pool.free_pages() == 0
+        assert pool.prefix_stats['evictions'] > 0
+        # The evicted prefix no longer hits.
+        pool.release(2)
+        _, m3 = pool.try_reserve_prefix(2, 8, h)
+        assert m3 == 0
+
+    def test_reserve_rollback_on_exhaustion(self):
+        pool = _pool()                          # 8 usable pages, 4/slot
+        h = paged_cache.page_hashes(list(range(1, 9)), 4)   # 2 hashes
+        pool.try_reserve_prefix(0, 12, ())      # slot0: 3 pages
+        pool.publish(0, h)                      # its first 2 published
+        pool.try_reserve_prefix(1, 16, ())      # slot1: 4 pages
+        assert pool.free_pages() == 1
+        refs_before = pool._refs.copy()
+        # Slot2 wants 4 pages, shares slot0's 2 published ones, but the
+        # 2 private pages it still needs exceed the 1 free page: the
+        # reservation must fail AND roll the shared refcounts back.
+        assert pool.try_reserve_prefix(2, 16, h) is None
+        assert (pool._refs == refs_before).all()
+        assert pool.free_pages() == 1
+        # The registry survived the failure: once space frees up the
+        # same reservation succeeds with both shared pages.
+        pool.release(1)
+        res = pool.try_reserve_prefix(2, 16, h)
+        assert res is not None and res[1] == 2
+
+
 class TestDeviceKernels:
     def test_insert_gather_roundtrip(self):
         pool = _pool()

@@ -175,6 +175,89 @@ def test_moe_paged_matches_dense():
                                                    max_new=6)
 
 
+def test_prefix_cache_matches_dense():
+    """Requests sharing a long system-prompt prefix: the paged engine
+    with prefix caching must produce dense-engine outputs token-for-
+    token while actually hitting the prefix cache (vLLM automatic
+    prefix caching analog, llm/vllm/serve.yaml)."""
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    rng = np.random.default_rng(7)
+    system = rng.integers(1, vocab, 40).tolist()   # 2.5 pages of 16
+    prompts = [system + rng.integers(1, vocab, k).tolist()
+               for k in (3, 9, 5)]
+    prompts.append(list(prompts[0]))               # exact repeat
+    dense = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=128,
+                                       cache_mode='dense')
+    paged = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=128,
+                                       cache_mode='paged', page_size=16)
+    assert paged.prefix_caching
+    out_d = _run(dense, prompts)
+    out_p = _run(paged, prompts)
+    assert out_d == out_p
+    # Later requests really shared the system prefix's full pages.
+    assert paged.pool.prefix_stats['hit_pages'] >= 2
+
+
+def test_prefix_cache_sequential_repeat():
+    """The same prompt served twice: the second admission reuses every
+    full page except the last-token page and still matches."""
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    prompt = _prompts(vocab, [50], seed=3)[0]
+    paged = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                       max_seq_len=128,
+                                       cache_mode='paged', page_size=16)
+    out1 = _run(paged, [prompt])
+    hits0 = paged.pool.prefix_stats['hit_pages']
+    out2 = _run(paged, [prompt])
+    assert out1 == out2
+    # 50 tokens / 16 = 3 full pages; lookup capped at (50-1)//16 = 3.
+    assert paged.pool.prefix_stats['hit_pages'] - hits0 == 3
+
+
+def test_prefix_caching_off():
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    prompt = _prompts(vocab, [40], seed=4)[0]
+    paged = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                       max_seq_len=128,
+                                       cache_mode='paged', page_size=16,
+                                       prefix_caching=False)
+    _run(paged, [prompt])
+    out = _run(paged, [prompt])
+    assert paged.pool.prefix_stats['hit_pages'] == 0
+    dense = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                       max_seq_len=128,
+                                       cache_mode='dense')
+    assert _run(dense, [prompt]) == out
+
+
+def test_prefix_cache_suffix_bucket_overflow_falls_back():
+    """A cached prefix whose suffix bucket would spill past the per-slot
+    view must fall back to a full prefill (not corrupt the cache):
+    max_seq 64, pages of 16 -> view span 64; prompt 50 with 16 cached
+    leaves a 34-token suffix that buckets to 64 -> 16+64 > 64."""
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    rng = np.random.default_rng(11)
+    head = rng.integers(1, vocab, 16).tolist()
+    p_a = head + rng.integers(1, vocab, 34).tolist()
+    p_b = head + rng.integers(1, vocab, 34).tolist()
+    paged = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                       max_seq_len=64,
+                                       prefill_buckets=[32],
+                                       cache_mode='paged', page_size=16)
+    dense = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                       max_seq_len=64,
+                                       prefill_buckets=[32],
+                                       cache_mode='dense')
+    assert _run(paged, [p_a, p_b], max_new=6) == \
+        _run(dense, [p_a, p_b], max_new=6)
+
+
 def test_bucket_smaller_than_page():
     """Prompt bucket (32) smaller than a page (64): the insert pads the
     prefill KV up to the page span. Regression: the pad length was read

@@ -172,3 +172,34 @@ class TestEnginePrefillDecode:
             assert len(toks) == 4
         finally:
             engine.stop()
+
+    def test_prefix_cached_admission(self):
+        """The prefix-cache suffix-prefill path (pool gather + dense
+        continuation + offset page scatter) must lower on the chip and
+        reproduce the uncached outputs."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(1, 250, 80).tolist()   # > 1 page of 64
+
+        def run_twice(prefix_caching):
+            engine = server_lib.build_engine(
+                'debug', num_slots=2, max_seq_len=256,
+                cache_mode='paged', prefix_caching=prefix_caching)
+            engine.start()
+            try:
+                outs = []
+                for _ in range(2):
+                    outs.append(engine.generate(
+                        prompt,
+                        engine_lib.SamplingParams(max_new_tokens=4)))
+                hits = engine.pool.prefix_stats['hit_pages']
+                return outs, hits
+            finally:
+                engine.stop()
+
+        cached, hits = run_twice(True)
+        assert hits >= 1, 'second admission should share prefix pages'
+        uncached, _ = run_twice(False)
+        assert cached == uncached
