@@ -35,6 +35,11 @@ class LlamaConfig:
     dtype: str = 'bfloat16'          # activations/params compute dtype
     param_dtype: str = 'float32'     # master param dtype
     remat: bool = True               # checkpoint each block
+    # What the per-block checkpoint saves: 'full' recomputes everything
+    # (min memory, ~+2N FLOPs of recompute per bwd token), 'dots' saves
+    # matmul outputs and recomputes only elementwise ops (near-zero
+    # recompute cost, ~2x activation memory) — jax dots_saveable policy.
+    remat_policy: str = 'full'
     scan_layers: bool = True
     attn_impl: str = 'auto'          # 'auto' | 'flash' | 'xla' | 'ring'
     tie_embeddings: bool = False
@@ -286,9 +291,12 @@ class LlamaModel(nn.Module):
 
         block = LlamaBlock
         if cfg.remat and cache is None:
+            policy = (jax.checkpoint_policies.dots_saveable
+                      if cfg.remat_policy == 'dots' else
+                      jax.checkpoint_policies.save_only_these_names())
             block = nn.remat(
                 LlamaBlock,
-                policy=jax.checkpoint_policies.save_only_these_names(),
+                policy=policy,
                 prevent_cse=not cfg.scan_layers)
         new_cache = None
         # Paged decode: 'tables' is the per-slot block table shared by

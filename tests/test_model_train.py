@@ -110,6 +110,40 @@ class TestModel:
         cfg = llama.CONFIGS['debug']
         assert cfg.n_heads % cfg.n_kv_heads == 0
 
+    def test_remat_policies_agree(self):
+        """remat_policy changes WHAT the backward recomputes, never the
+        math: loss and grads under 'dots' (save matmul outputs) must
+        match 'full' (save nothing) — the bench's SKYT_BENCH_REMAT knob
+        flips between them."""
+        import dataclasses
+
+        import flax
+
+        tokens = _batch(b=2, s=16)
+        grads = {}
+        for pol in ('full', 'dots'):
+            cfg = dataclasses.replace(llama.CONFIGS['debug'],
+                                      remat=True, remat_policy=pol)
+            model = llama.LlamaModel(cfg)
+            variables = model.init(jax.random.PRNGKey(3),
+                                   tokens['tokens'])
+
+            def loss_fn(params):
+                logits = model.apply({'params': params},
+                                     tokens['tokens'])
+                loss, _ = trainer.cross_entropy_loss(logits,
+                                                     tokens['targets'])
+                return loss
+
+            loss, g = jax.value_and_grad(loss_fn)(
+                flax.core.unfreeze(variables)['params'])
+            grads[pol] = (float(loss), g)
+        assert np.isclose(grads['full'][0], grads['dots'][0], rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            grads['full'][1], grads['dots'][1])
+
     def test_eval_step(self, debug_setup):
         cfg, model, mesh, tx, state = debug_setup
         ev = trainer.make_eval_step(model, mesh)
