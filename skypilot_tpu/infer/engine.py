@@ -92,7 +92,8 @@ class InferenceEngine:
                  cache_mode: str = 'dense',
                  page_size: int = 64,
                  pool_tokens: Optional[int] = None,
-                 prefix_caching: bool = True) -> None:
+                 prefix_caching: bool = True,
+                 spec_decode: int = 0) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
@@ -127,6 +128,15 @@ class InferenceEngine:
         # shared-system-prompt TTFT win vLLM's automatic prefix caching
         # gives the reference.
         self.prefix_caching = prefix_caching and cache_mode == 'paged'
+        # Speculative decoding (greedy batches only): propose
+        # `spec_decode` draft tokens per step by n-gram lookup in the
+        # slot's own token history (prompt-lookup decoding — model-free,
+        # so acceptance gating makes outputs EXACTLY equal to plain
+        # greedy), verify all drafts in one s=k+1 forward, and emit
+        # accepted_prefix+1 tokens per step. Decode is HBM-bound (each
+        # step streams the full weights), so every accepted draft is a
+        # nearly-free extra token.
+        self.spec_decode = max(0, int(spec_decode))
         self.pool = None
         cache_sharding = None
         if mesh is not None:
@@ -175,12 +185,25 @@ class InferenceEngine:
                               'v': jnp.zeros(shape, dtype)}
         # FIFO head deferred by pool exhaustion (paged mode only).
         self._deferred: Optional[_Request] = None
-        # Host-side slot table. _lengths/_temps are host mirrors the loop
-        # reads (chunk sizing, sampling-variant choice); last tokens, rng
-        # keys, and top-ks live ONLY on device (self._dev_args).
+        # Host-side slot table. _lengths is an UPPER-BOUND estimate used
+        # for chunk sizing (with speculative decode an in-flight chunk's
+        # true advance is only known at pull time); _conf_lengths is the
+        # confirmed actual length, updated as chunks are pulled. last
+        # tokens, rng keys, and top-ks live ONLY on device
+        # (self._dev_args).
         self._slots: List[Optional[_Request]] = [None] * num_slots
         self._lengths = np.zeros((num_slots,), np.int32)
+        self._conf_lengths = np.zeros((num_slots,), np.int32)
         self._temps = np.zeros((num_slots,), np.float32)
+        # Device-resident token history per slot (prompt + generated) —
+        # the n-gram proposer's haystack. Only maintained by the spec
+        # decode path; +k+2 tail slack keeps the per-step k+1-token
+        # write from ever clamping.
+        self._dev_hist = (
+            jnp.zeros((num_slots,
+                       self.max_seq_len + self.spec_decode + 2),
+                      jnp.int32)
+            if self.spec_decode > 0 else None)
         self._waiting: 'queue.Queue[_Request]' = queue.Queue()
         # Device-resident decode args (last, lens, temps, keys, topks);
         # built once from the host mirrors, then updated ON DEVICE (the
@@ -198,7 +221,9 @@ class InferenceEngine:
         # decode rate with prefill excluded (the serve bench's
         # steady-state metric; VERDICT r2 weak #4).
         self.perf = {'decode_tokens': 0, 'decode_chunks': 0,
-                     'steady_tokens': 0, 'steady_time_s': 0.0}
+                     'steady_tokens': 0, 'steady_time_s': 0.0,
+                     'spec_steps': 0, 'spec_tokens': 0,
+                     'spec_verify_steps': 0, 'spec_accepted': 0}
         self._last_pull_t: Optional[float] = None
         self._had_admission = False
 
@@ -206,11 +231,19 @@ class InferenceEngine:
                                     static_argnames=('bucket',))
         self._jit_prefill_suffix = jax.jit(self._prefill_suffix_impl,
                                            static_argnames=('bucket',))
+        self._jit_decode_spec = jax.jit(self._decode_spec_impl,
+                                        donate_argnums=(1, 4),
+                                        static_argnames=('n', 'k'))
+        self._jit_hist_insert = jax.jit(self._hist_insert_impl,
+                                        donate_argnums=(0,))
         # Donate the cache: without it XLA materializes a full cache
         # copy every decode step (hundreds of MB at 8 slots x 2k ctx).
-        self._jit_decode_n = jax.jit(self._decode_n_impl,
-                                     donate_argnums=(1,),
-                                     static_argnames=('n', 'sampling'))
+        # With spec decode the history buffer rides along (donated too)
+        # so plain-path chunks keep the proposer's invariant intact.
+        self._jit_decode_n = jax.jit(
+            self._decode_n_impl,
+            donate_argnums=(1, 7) if self.spec_decode > 0 else (1,),
+            static_argnames=('n', 'sampling'))
         # Donate the global cache and the decode-arg arrays (updated in
         # place); the prefill cache is NOT donatable (B=1 buffers cannot
         # alias the B=slots cache).
@@ -365,7 +398,7 @@ class InferenceEngine:
                     jnp.zeros_like(cache['tables'][slot]))}
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
-                       keys, topks, n, sampling):
+                       keys, topks, hist, n, sampling):
         """Generate `n` tokens per slot in ONE dispatch: a device-side
         lax.scan of decode steps with on-device sampling (greedy when
         temps[i] == 0, else temperature categorical). The host pulls one
@@ -378,15 +411,25 @@ class InferenceEngine:
         active request is greedy (the common serving case).
         Returns (tokens [n, SLOTS], new_cache, new_keys)."""
 
+        n_slots = self.num_slots
+
+        def write_hist(hist, lens, tok):
+            # Keep the spec proposer's invariant (hist[b, lens[b]] ==
+            # last token) intact across plain-path chunks.
+            if hist is None:
+                return None
+            return hist.at[jnp.arange(n_slots), lens + 1].set(tok)
+
         def step(carry, _):
-            cache, last, lens, keys = carry
+            cache, last, lens, keys, hist = carry
             logits, cache = self.model.apply(params, last[:, None],
                                              positions=lens[:, None],
                                              cache=cache)
             logits = logits[:, 0, :].astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if not sampling:
-                return (cache, greedy, lens + 1, keys), greedy
+                return (cache, greedy, lens + 1, keys,
+                        write_hist(hist, lens, greedy)), greedy
             keys = jax.vmap(jax.random.split, in_axes=0,
                             out_axes=0)(keys)[:, 0]
             # Per-slot top-k (k <= _TOPK_BUCKET) via a fixed top-k sort +
@@ -403,15 +446,76 @@ class InferenceEngine:
                 lambda k, lg, t: jax.random.categorical(
                     k, lg / jnp.maximum(t, 1e-6)))(keys, filtered, temps)
             tok = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
-            return (cache, tok, lens + 1, keys), tok
+            return (cache, tok, lens + 1, keys,
+                    write_hist(hist, lens, tok)), tok
 
-        (cache, last, lens, keys), toks = jax.lax.scan(
-            step, (cache, last_tokens, lengths, keys), None, length=n)
+        (cache, last, lens, keys, hist), toks = jax.lax.scan(
+            step, (cache, last_tokens, lengths, keys, hist), None,
+            length=n)
         if 'tables' in cache:
             cache = self._pin_paged_layouts(cache)
         # last/lens returned device-resident so the next chunk's call
         # needs no host->device transfers in the steady state.
-        return toks, cache, keys, last, lens
+        return toks, cache, keys, last, lens, hist
+
+    def _hist_insert_impl(self, hist, slot, tokens, length, first_tok):
+        """Install an admitted prompt (+ its first generated token) into
+        the slot's device token history. Invariant the spec decoder
+        relies on: hist[slot, lens[slot]] == last token fed."""
+        hist = jax.lax.dynamic_update_slice(hist, tokens, (slot, 0))
+        return hist.at[slot, length].set(first_tok)
+
+    def _decode_spec_impl(self, params, cache, last_tokens, lengths,
+                          hist, n, k):
+        """`n` speculative decode iterations in ONE dispatch (greedy
+        only). Each iteration: propose k draft tokens per slot by
+        matching the history's trailing bigram against its own past
+        (prompt-lookup decoding), run a single s=k+1 forward, accept the
+        longest draft prefix agreeing with the model's greedy argmax,
+        and emit accepted+1 tokens. Drafts never change outputs — a
+        wrong draft is simply rejected — so results are token-identical
+        to the plain greedy path (tested). Returns (toks [n, SLOTS,
+        k+1], counts [n, SLOTS] valid-token counts, ...)."""
+        s_hist = hist.shape[1]
+
+        def propose(h, length):
+            # Most recent i where (h[i], h[i+1]) equals the trailing
+            # bigram (h[L-1], h[L]); draft = the k tokens after it. No
+            # match -> a junk draft that verification will reject.
+            b0 = h[jnp.clip(length - 1, 0, s_hist - 1)]
+            b1 = h[jnp.clip(length, 0, s_hist - 1)]
+            idx = jnp.arange(s_hist - 1)
+            ok = (h[:-1] == b0) & (h[1:] == b1) & (idx + 1 < length)
+            i = jnp.where(ok.any(), jnp.where(ok, idx, -1).max(),
+                          length - 1)
+            return jax.lax.dynamic_slice(
+                h, (jnp.clip(i + 2, 0, s_hist - k),), (k,))
+
+        def step(carry, _):
+            cache, last, lens, hist = carry
+            draft = jax.vmap(propose)(hist, lens)        # [SLOTS, k]
+            toks_in = jnp.concatenate([last[:, None], draft], axis=1)
+            positions = lens[:, None] + jnp.arange(k + 1)[None, :]
+            logits, cache = self.model.apply(
+                params, toks_in, positions=positions, cache=cache)
+            g = jnp.argmax(logits.astype(jnp.float32),
+                           axis=-1).astype(jnp.int32)    # [SLOTS, k+1]
+            match = (draft == g[:, :k]).astype(jnp.int32)
+            acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [SLOTS] 0..k
+            new_last = jnp.take_along_axis(g, acc[:, None],
+                                           axis=1)[:, 0]
+            # Write all k+1 emitted candidates; entries past acc+1 are
+            # junk the proposer never reads (its window stops at lens).
+            hist = jax.vmap(
+                lambda h, row, i: jax.lax.dynamic_update_slice(
+                    h, row, (i,)))(hist, g, lens + 1)
+            return (cache, new_last, lens + acc + 1, hist), (g, acc + 1)
+
+        (cache, last, lens, hist), (toks, counts) = jax.lax.scan(
+            step, (cache, last_tokens, lengths, hist), None, length=n)
+        if 'tables' in cache:
+            cache = self._pin_paged_layouts(cache)
+        return toks, counts, cache, last, lens, hist
 
     # ----------------------------------------------------------- sampling
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
@@ -500,6 +604,19 @@ class InferenceEngine:
                 # prefix-cached suffix-prefill path.
                 self.generate(last_warm[0],
                               SamplingParams(max_new_tokens=last_warm[1]))
+            if self.spec_decode > 0:
+                # Near max_seq_len the loop falls back to the plain
+                # greedy path with small pow2 chunks — pre-trace those
+                # here or the first long request pays the compile
+                # mid-serving. Distinct token per prompt: no prefix
+                # sharing with the warms above.
+                c = 1
+                while c <= self.spec_decode:
+                    n_prompt = self.max_seq_len - 1 - c
+                    if n_prompt >= 1:
+                        self.generate([50 + c] * n_prompt,
+                                      SamplingParams(max_new_tokens=c))
+                    c *= 2
         finally:
             if not started:
                 self.stop()
@@ -523,13 +640,20 @@ class InferenceEngine:
         p['steady_decode_tok_per_sec'] = (
             p['steady_tokens'] / p['steady_time_s']
             if p['steady_time_s'] > 0 else 0.0)
+        if self.spec_decode > 0:
+            # Mean accepted drafts per verify step (tokens/step - 1).
+            p['spec_accept_per_step'] = (
+                p['spec_accepted'] / p['spec_verify_steps']
+                if p['spec_verify_steps'] > 0 else 0.0)
         if self.prefix_caching and self.pool is not None:
             p['prefix_cache'] = dict(self.pool.prefix_stats)
         return p
 
     def reset_perf(self) -> None:
         self.perf = {'decode_tokens': 0, 'decode_chunks': 0,
-                     'steady_tokens': 0, 'steady_time_s': 0.0}
+                     'steady_tokens': 0, 'steady_time_s': 0.0,
+                     'spec_steps': 0, 'spec_tokens': 0,
+                     'spec_verify_steps': 0, 'spec_accepted': 0}
         self._last_pull_t = None
 
     # ---------------------------------------------------------- main loop
@@ -668,12 +792,23 @@ class InferenceEngine:
                         prefill_cache)
                 self.cache, self._dev_args = self._jit_insert(
                     self.cache, prefill_cache, *ins_args)
+            if self.spec_decode > 0:
+                # Full prompt (not just a prefix-cached suffix) into the
+                # device history for the n-gram proposer.
+                hb = self._bucket_for(n)
+                hist_toks = np.zeros((1, hb), np.int32)
+                hist_toks[0, :n] = req.tokens
+                self._dev_hist = self._jit_hist_insert(
+                    self._dev_hist, jnp.int32(slot),
+                    jnp.asarray(hist_toks), jnp.int32(n),
+                    jnp.int32(first))
         req.first_token_at = time.time()
         req.slot = slot
         req.generated = 1
         req.out_queue.put(first)
         self._slots[slot] = req
         self._lengths[slot] = n
+        self._conf_lengths[slot] = n
         self._temps[slot] = temp
         self._had_admission = True
         if self._req_done(req, first):
@@ -696,6 +831,7 @@ class InferenceEngine:
             req.out_queue.put(None)
         self._slots[slot] = None
         self._lengths[slot] = 0
+        self._conf_lengths[slot] = 0
         if self.cache_mode == 'paged' and req is not None:
             # Host: pages back to the free list. Device: point the
             # slot's table row at the dummy page — this dispatch chains
@@ -742,7 +878,7 @@ class InferenceEngine:
         # loses ~45% of throughput to the pull; pipelined decode is
         # device-limited. Cost: slot release (and therefore admission
         # under load) lags by one chunk.
-        pending = None  # (toks_dev, [(slot, req)], pre_lengths, chunk)
+        pending = None  # (kind, toks_dev, counts_dev, entries, chunk)
         while not self._stop.is_set():
             # Admit as many waiting requests as there are free slots.
             # Device-side arg/cache updates order after any in-flight
@@ -753,6 +889,7 @@ class InferenceEngine:
             active = [i for i, r in enumerate(self._slots)
                       if r is not None]
             new_pending = None
+            upper = 0
             if active:
                 # Chunk size: the configured chunk, capped by remaining
                 # cache space. Do NOT shrink to the smallest remaining
@@ -762,58 +899,106 @@ class InferenceEngine:
                 # cheaper than a recompile ladder.
                 rem_space = self.max_seq_len - 1 - int(
                     max(self._lengths[i] for i in active))
-                bound = max(1, min(self.decode_chunk, rem_space))
-                # Quantize to a power of two: `n` is a static jit arg, so
-                # arbitrary chunk values would each trigger a compile.
-                chunk = 1 << (bound.bit_length() - 1)
                 sampling = any(self._temps[i] > 0 for i in active)
+                k = self.spec_decode
+                # Speculation needs headroom for the worst case (every
+                # draft accepted) and greedy-only slots; otherwise fall
+                # back to the plain path for this chunk.
+                use_spec = k > 0 and not sampling and \
+                    rem_space // (k + 1) >= 1
                 self._ensure_dev_args()
                 d_last, d_lens, d_temps, d_keys, d_topks = self._dev_args
-                with self._ctx():
-                    toks, self.cache, keys, d_last, d_lens = \
-                        self._jit_decode_n(
-                            self.params, self.cache, d_last, d_lens,
-                            d_temps, d_keys, d_topks,
-                            n=chunk, sampling=sampling)
-                self._dev_args = (d_last, d_lens, d_temps, keys, d_topks)
                 entries = [(i, self._slots[i]) for i in active]
-                new_pending = (toks, entries, self._lengths.copy(), chunk)
-                self._lengths += chunk    # device advanced every slot
+                if use_spec:
+                    bound = max(1, min(self.decode_chunk,
+                                       rem_space // (k + 1)))
+                    chunk = 1 << (bound.bit_length() - 1)
+                    with self._ctx():
+                        toks, counts, self.cache, d_last, d_lens, \
+                            self._dev_hist = self._jit_decode_spec(
+                                self.params, self.cache, d_last, d_lens,
+                                self._dev_hist, n=chunk, k=k)
+                    self._dev_args = (d_last, d_lens, d_temps, d_keys,
+                                      d_topks)
+                    new_pending = ('spec', toks, counts, entries, chunk)
+                    upper = chunk * (k + 1)
+                else:
+                    bound = max(1, min(self.decode_chunk, rem_space))
+                    # Power of two: `n` is a static jit arg, arbitrary
+                    # values would each trigger a compile.
+                    chunk = 1 << (bound.bit_length() - 1)
+                    with self._ctx():
+                        toks, self.cache, keys, d_last, d_lens, \
+                            self._dev_hist = self._jit_decode_n(
+                                self.params, self.cache, d_last, d_lens,
+                                d_temps, d_keys, d_topks,
+                                self._dev_hist,
+                                n=chunk, sampling=sampling)
+                    self._dev_args = (d_last, d_lens, d_temps, keys,
+                                      d_topks)
+                    new_pending = ('plain', toks, None, entries, chunk)
+                    upper = chunk
             if pending is not None:
                 self._finish_chunk(pending)
             elif not active and not admitted:
                 time.sleep(0.002)
+            # Resync the sizing estimate: confirmed lengths plus the
+            # in-flight chunk's worst-case advance.
+            self._lengths = self._conf_lengths + upper
             pending = new_pending
         if pending is not None:
             self._finish_chunk(pending)
 
     def _finish_chunk(self, pending) -> None:
         """Pull a dispatched chunk's tokens and deliver them; release
-        completed slots. The sync point of the pipeline."""
-        toks_dev, entries, pre_lengths, chunk = pending
-        toks_np = np.asarray(toks_dev)        # [chunk, SLOTS] sync
+        completed slots and advance the confirmed lengths. The sync
+        point of the pipeline."""
+        kind, toks_dev, counts_dev, entries, chunk = pending
+        toks_np = np.asarray(toks_dev)        # sync point
+        counts_np = np.asarray(counts_dev) if counts_dev is not None \
+            else None
         now = time.perf_counter()
         delivered = 0
+        # Per-slot running ACTUAL position of the token being delivered
+        # (confirmed length is only advanced at chunk pulls, so it is
+        # this chunk's true starting point).
+        base = {i: int(self._conf_lengths[i]) for i, _ in entries}
         for t in range(chunk):
             for i, req in entries:
                 if self._slots[i] is not req:
                     continue  # finished earlier / slot re-admitted
-                tok = int(toks_np[t, i])
-                req.generated += 1
-                delivered += 1
-                req.out_queue.put(tok)
+                if kind == 'spec':
+                    # [chunk, SLOTS, k+1]; first counts[t, i] are valid.
+                    run = toks_np[t, i, :int(counts_np[t, i])]
+                    # Acceptance accounting: each delivered run is one
+                    # verify step emitting 1 + accepted-drafts tokens.
+                    self.perf['spec_verify_steps'] += 1
+                    self.perf['spec_accepted'] += len(run) - 1
+                else:
+                    run = toks_np[t:t + 1, i]             # one token
                 p = req.params
-                # Length check uses this token's own position
-                # (pre-chunk length + t + 1), not the post-chunk
-                # total — otherwise valid tokens later in the final
-                # chunk would be dropped.
-                if (p.eos_token is not None and tok == p.eos_token) \
-                        or req.generated >= p.max_new_tokens \
-                        or pre_lengths[i] + t + 1 >= \
-                        self.max_seq_len - 1:
-                    self._release(i)
+                for tok in run:
+                    tok = int(tok)
+                    req.generated += 1
+                    delivered += 1
+                    base[i] += 1
+                    req.out_queue.put(tok)
+                    # Length check uses this token's own position, not
+                    # the post-chunk total — otherwise valid tokens
+                    # later in the final chunk would be dropped.
+                    if (p.eos_token is not None and tok == p.eos_token) \
+                            or req.generated >= p.max_new_tokens \
+                            or base[i] >= self.max_seq_len - 1:
+                        self._release(i)
+                        break
+        for i, req in entries:
+            if self._slots[i] is req:
+                self._conf_lengths[i] = base[i]
         self.perf['decode_tokens'] += delivered
         self.perf['decode_chunks'] += 1
+        if kind == 'spec':
+            self.perf['spec_steps'] += chunk
+            self.perf['spec_tokens'] += delivered
         # Steady-state rate: pull-to-pull intervals with no admission in
         # between (prefill and its sync excluded by construction).
         if self._last_pull_t is not None and not self._had_admission:

@@ -284,6 +284,27 @@ class PagePool:
         return pool.at[page, :, off].set(new_kv.astype(pool.dtype))
 
     @staticmethod
+    def append_tokens_layer(pool, new_kv, tables, start):
+        """Scatter a short run of decoded tokens per slot, one layer —
+        the speculative-decode append (s = draft+1 tokens per step).
+
+        pool:   [n_pages, H, P, d]
+        new_kv: [slots, s, H, d] — token j of slot b is written at
+                position start[b] + j.
+        tables: [slots, max_pages] int32
+        start:  [slots] int32
+        """
+        slots, s, h, d = new_kv.shape
+        p = pool.shape[2]
+        mp = tables.shape[1]
+        pos = start[:, None] + jnp.arange(s)[None, :]       # [slots, s]
+        page = jnp.take_along_axis(
+            tables, jnp.clip(pos // p, 0, mp - 1), axis=1)  # [slots, s]
+        off = pos % p
+        return pool.at[page.reshape(-1), :, off.reshape(-1)].set(
+            new_kv.reshape(slots * s, h, d).astype(pool.dtype))
+
+    @staticmethod
     def gather_view(pool, tables):
         """All-layer convenience wrapper: [L, n_pages, H, P, d] ->
         [L, slots, mp*P, H, d]. Single-sourced on the layer kernel."""

@@ -358,7 +358,8 @@ def build_engine(model_name: Optional[str] = None,
                  cache_mode: str = 'auto',
                  pool_tokens: Optional[int] = None,
                  dtype: str = 'bfloat16',
-                 prefix_caching: bool = True
+                 prefix_caching: bool = True,
+                 spec_decode: int = 0
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
@@ -428,7 +429,8 @@ def build_engine(model_name: Optional[str] = None,
                                       mesh=mesh,
                                       cache_mode=cache_mode,
                                       pool_tokens=pool_tokens,
-                                      prefix_caching=prefix_caching)
+                                      prefix_caching=prefix_caching,
+                                      spec_decode=spec_decode)
 
 
 def main(argv=None) -> None:
@@ -462,12 +464,16 @@ def main(argv=None) -> None:
                         help='KV cache layout (auto: paged for llama)')
     parser.add_argument('--no-prefix-caching', action='store_true',
                         help='disable KV prefix caching (paged mode)')
+    parser.add_argument('--spec-decode', type=int, default=0,
+                        help='n-gram speculative decoding draft length '
+                             '(0 = off; greedy requests only)')
     args = parser.parse_args(argv)
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
                           checkpoint=args.checkpoint, tp=args.tp,
                           cache_mode=args.cache_mode, dtype=args.dtype,
-                          prefix_caching=not args.no_prefix_caching)
+                          prefix_caching=not args.no_prefix_caching,
+                          spec_decode=args.spec_decode)
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
     if tok_path:

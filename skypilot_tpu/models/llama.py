@@ -119,25 +119,33 @@ class LlamaAttention(nn.Module):
             assert positions is not None, 'cache path needs positions'
             if len(cache) == 3:
                 # Paged decode path: cache = (k_pool [n_pages, Hkv, P,
-                # hd], v_pool, tables [B, max_pages]). One token per
-                # sequence is scattered into (tables[b, pos//P], pos%P);
+                # hd], v_pool, tables [B, max_pages]). Each sequence's
+                # new token(s) scatter into (tables[b, pos//P], pos%P);
                 # attention either runs the Pallas paged kernel (reads
                 # pages directly) or the gathered per-layer view — the
                 # page indirection lives HERE so at most one layer's KV
                 # is ever materialized contiguously (infer/paged_cache.py
                 # holds the pool accounting).
-                assert s == 1, 'paged cache is a decode-only path'
                 import os as _os
 
                 from skypilot_tpu.infer.paged_cache import PagePool
                 k_pool, v_pool, tables = cache
                 pos = positions[:, 0]
-                k_pool = PagePool.append_token_layer(
-                    k_pool, k[:, 0], tables, pos)
-                v_pool = PagePool.append_token_layer(
-                    v_pool, v[:, 0], tables, pos)
-                if _os.environ.get('SKYT_PAGED_ATTN', 'pallas') == \
-                        'pallas':
+                if s == 1:
+                    k_pool = PagePool.append_token_layer(
+                        k_pool, k[:, 0], tables, pos)
+                    v_pool = PagePool.append_token_layer(
+                        v_pool, v[:, 0], tables, pos)
+                else:
+                    # Speculative decode: a short run of s = draft+1
+                    # tokens per slot is written and attended in one
+                    # step (infer/engine.py _decode_spec_impl).
+                    k_pool = PagePool.append_tokens_layer(
+                        k_pool, k, tables, pos)
+                    v_pool = PagePool.append_tokens_layer(
+                        v_pool, v, tables, pos)
+                if s == 1 and _os.environ.get(
+                        'SKYT_PAGED_ATTN', 'pallas') == 'pallas':
                     # Pallas kernel DMAs each slot's pages directly (no
                     # materialized contiguous view; escape hatch:
                     # SKYT_PAGED_ATTN=xla). The engine pins the pool's

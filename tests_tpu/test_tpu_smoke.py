@@ -173,6 +173,29 @@ class TestEnginePrefillDecode:
         finally:
             engine.stop()
 
+    def test_spec_decode_lowers(self):
+        """The speculative decode step (multi-token paged append +
+        gather-view attention + on-device verify) must lower and match
+        the plain greedy engine on the chip."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        prompt = [5, 9, 2] * 8
+
+        def gen(spec):
+            engine = server_lib.build_engine(
+                'debug', num_slots=2, max_seq_len=256,
+                cache_mode='paged', spec_decode=spec)
+            engine.start()
+            try:
+                return engine.generate(
+                    prompt,
+                    engine_lib.SamplingParams(max_new_tokens=16))
+            finally:
+                engine.stop()
+
+        assert gen(4) == gen(0)
+
     def test_prefix_cached_admission(self):
         """The prefix-cache suffix-prefill path (pool gather + dense
         continuation + offset page scatter) must lower on the chip and
