@@ -1,0 +1,110 @@
+"""Framework adapters for SkytCallback.
+
+Reference: sky/callbacks/sky_callback/integrations/ — the reference ships
+Keras / PyTorch Lightning / HF Trainer adapters so `sky bench` step
+timestamps come for free from any training loop. TPU-native equivalents
+here: HF `transformers` Trainer (in the image), Keras 3, and a generic
+step-iterator wrapper that covers hand-written JAX loops (the idiomatic
+TPU case — flax loops are plain Python `for` loops, not a Trainer).
+
+Every adapter degrades to a no-op import error only at construction, so
+importing this module never requires the frameworks themselves.
+"""
+from typing import Iterable, Iterator, Optional, TypeVar
+
+from skypilot_tpu.callbacks import base
+
+T = TypeVar('T')
+
+
+def wrap_steps(iterable: Iterable[T],
+               total_steps: Optional[int] = None,
+               benchmark_dir: Optional[str] = None) -> Iterator[T]:
+    """Generic adapter: wrap any step iterable (the JAX-native loop).
+
+        for batch in skyt_callback.wrap_steps(loader, total_steps=1000):
+            state, metrics = train_step(state, batch)
+
+    Timestamps one step per yielded item; flushes on exhaustion or
+    break/exception. A `break` out of the loop counts the in-progress
+    step (its work finished before the break).
+    """
+    with base.step_timer(total_steps=total_steps,
+                         benchmark_dir=benchmark_dir) as cb:
+        in_step = False
+        try:
+            for item in iterable:
+                in_step = True
+                yield item
+                cb.on_step_end()
+                in_step = False
+        except GeneratorExit:
+            if in_step:
+                cb.on_step_end()
+            raise
+
+
+def hf_trainer_callback(benchmark_dir: Optional[str] = None):
+    """`transformers.TrainerCallback` adapter (reference:
+    sky_callback/integrations/transformers.py analog):
+
+        trainer = transformers.Trainer(..., callbacks=[
+            skyt_callback.hf_trainer_callback()])
+    """
+    from transformers import TrainerCallback
+
+    class _SkytHFCallback(TrainerCallback):
+        def __init__(self) -> None:
+            self._cb: Optional[base.SkytCallback] = None
+            self._dir = benchmark_dir
+
+        def on_train_begin(self, args, state, control, **kwargs):
+            if self._cb is not None:   # retried train(): no thread leak
+                self._cb.close()
+            self._cb = base.SkytCallback(total_steps=state.max_steps,
+                                         benchmark_dir=self._dir)
+
+        def on_step_end(self, args, state, control, **kwargs):
+            if self._cb is not None:
+                self._cb.on_step_end()
+
+        def on_train_end(self, args, state, control, **kwargs):
+            if self._cb is not None:
+                self._cb.close()
+                self._cb = None
+
+    return _SkytHFCallback()
+
+
+def keras_callback(benchmark_dir: Optional[str] = None):
+    """Keras adapter (reference: sky_callback/integrations/keras.py
+    analog): `model.fit(..., callbacks=[skyt_callback.keras_callback()])`.
+    One step per batch."""
+    import keras
+
+    class _SkytKerasCallback(keras.callbacks.Callback):
+        def __init__(self) -> None:
+            super().__init__()
+            self._cb: Optional[base.SkytCallback] = None
+            self._dir = benchmark_dir
+
+        def on_train_begin(self, logs=None):
+            if self._cb is not None:   # retried fit(): no thread leak
+                self._cb.close()
+            total = None
+            params = getattr(self, 'params', None) or {}
+            if params.get('steps') and params.get('epochs'):
+                total = params['steps'] * params['epochs']
+            self._cb = base.SkytCallback(total_steps=total,
+                                         benchmark_dir=self._dir)
+
+        def on_train_batch_end(self, batch, logs=None):
+            if self._cb is not None:
+                self._cb.on_step_end()
+
+        def on_train_end(self, logs=None):
+            if self._cb is not None:
+                self._cb.close()
+                self._cb = None
+
+    return _SkytKerasCallback()
