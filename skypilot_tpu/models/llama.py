@@ -154,6 +154,14 @@ class LlamaAttention(nn.Module):
                     from skypilot_tpu.ops import paged_attention
                     out = paged_attention.paged_decode_attention(
                         q[:, 0], k_pool, v_pool, tables, pos)[:, None]
+                elif s > 1 and _os.environ.get(
+                        'SKYT_SPEC_PAGED_ATTN', 'xla') == 'pallas':
+                    # Multi-query kernel for the speculative verify
+                    # step. Opt-in until validated on real TPU (the
+                    # default gather path is the known-good fallback).
+                    from skypilot_tpu.ops import paged_attention
+                    out = paged_attention.paged_decode_attention_mq(
+                        q, k_pool, v_pool, tables, pos)
                 else:
                     k_view = PagePool.gather_view_layer(k_pool, tables)
                     v_view = PagePool.gather_view_layer(v_pool, tables)

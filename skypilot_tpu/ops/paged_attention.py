@@ -90,6 +90,114 @@ def _kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
+def _kernel_mq(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+               m_scr, l_scr, acc_scr, *, page_size: int, num_pages: int,
+               scale: float, g: int, t: int):
+    """Multi-query (speculative-verify) variant: the query block folds
+    T consecutive tokens into the sublane axis as [H, T*G, d]; row r is
+    query token r // G at position lens[s] + r // G, masked causally
+    per token. Same flash running-softmax scratch scheme as _kernel."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = lens_ref[s]            # FIRST query token's position
+    page_id = tables_ref[s, j]
+
+    # A page is useful if any of the T queries can attend into it.
+    @pl.when(jnp.logical_and(j * page_size <= pos + (t - 1),
+                             jnp.logical_or(page_id != 0, j == 0)))
+    def _compute():
+        q = q_ref[0]                        # [H, T*G, d]
+        st = jax.lax.dot_general(
+            q, k_ref[0], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [H, T*G, P]
+        idx = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, st.shape, 2)
+        t_idx = jax.lax.broadcasted_iota(jnp.int32, st.shape, 1) // g
+        st = jnp.where(idx <= pos + t_idx, st, NEG_INF)
+        m_prev = m_scr[..., :1]             # [H, T*G, 1]
+        m_cur = jnp.max(st, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(st - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[..., :1] + jnp.sum(p, axis=2,
+                                                 keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [H, T*G, d]
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_pages - 1)
+    def _finalize():
+        l = l_scr[..., :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=('interpret',))
+def paged_decode_attention_mq(q: jax.Array, k_pool: jax.Array,
+                              v_pool: jax.Array, tables: jax.Array,
+                              lengths: jax.Array,
+                              interpret: Optional[bool] = None
+                              ) -> jax.Array:
+    """Multi-query paged decode (speculative verify): q [S, T, Hq, d] —
+    T consecutive tokens per slot, token t at position lengths[s] + t
+    (all T tokens' KV already appended). Returns [S, T, Hq, d].
+    """
+    s_slots, t, hq, d = q.shape
+    _, hkv, page_size, _ = k_pool.shape
+    assert hq % hkv == 0, (hq, hkv)
+    g = hq // hkv
+    mp = tables.shape[1]
+    scale = d ** -0.5
+    # [S, T, Hkv, G, d] -> [S, Hkv, T, G, d] -> [S, Hkv, T*G, d]:
+    # row r of the sublane axis is (token r // G, q-head-in-group r % G).
+    qg = q.reshape(s_slots, t, hkv, g, d).transpose(0, 2, 1, 3, 4) \
+         .reshape(s_slots, hkv, t * g, d)
+
+    kernel = functools.partial(_kernel_mq, page_size=page_size,
+                               num_pages=mp, scale=scale, g=g, t=t)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_slots, mp),
+        in_specs=[
+            pl.BlockSpec((1, hkv, t * g, d),
+                         lambda s, j, tbl, lns: (s, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, page_size, d),
+                         lambda s, j, tbl, lns: (tbl[s, j], 0, 0, 0)),
+            pl.BlockSpec((1, hkv, page_size, d),
+                         lambda s, j, tbl, lns: (tbl[s, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, t * g, d),
+                               lambda s, j, tbl, lns: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, t * g, LANES), jnp.float32),  # running max
+            pltpu.VMEM((hkv, t * g, LANES), jnp.float32),  # running sum
+            pltpu.VMEM((hkv, t * g, d), jnp.float32),      # accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, hkv, t * g, d),
+                                       q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary')),
+        interpret=_interpret_mode() if interpret is None else interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool,
+      v_pool)
+    return out.reshape(s_slots, hkv, t, g, d).transpose(0, 2, 1, 3, 4) \
+              .reshape(s_slots, t, hq, d)
+
+
 @functools.partial(jax.jit, static_argnames=('interpret',))
 def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, tables: jax.Array,

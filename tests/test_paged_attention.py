@@ -99,3 +99,86 @@ class TestPagedDecodeAttention:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(ref, np.float32),
             atol=3e-2, rtol=3e-2)
+
+
+class TestPagedDecodeAttentionMQ:
+    """Multi-query (speculative verify) variant vs the gather reference:
+    T consecutive tokens per slot at positions lengths[s]..+T-1."""
+
+    def _mq_setup(self, slots=3, t=4, hq=4, hkv=2, d=64, n_pages=12,
+                  p=16, seed=1):
+        rng = np.random.default_rng(seed)
+        q = jnp.asarray(rng.normal(size=(slots, t, hq, d)), jnp.float32)
+        k_pool = jnp.asarray(rng.normal(size=(n_pages, hkv, p, d)),
+                             jnp.float32)
+        v_pool = jnp.asarray(rng.normal(size=(n_pages, hkv, p, d)),
+                             jnp.float32)
+        return q, k_pool, v_pool
+
+    def _mq_reference(self, q, k_pool, v_pool, tables, lengths):
+        t = q.shape[1]
+        k_view = PagePool.gather_view_layer(k_pool, tables)
+        v_view = PagePool.gather_view_layer(v_pool, tables)
+        positions = lengths[:, None] + jnp.arange(t)[None, :]
+        return attention_ops.mha_reference(q, k_view, v_view,
+                                           q_positions=positions)
+
+    def test_matches_reference_varied_lengths(self):
+        q, k_pool, v_pool = self._mq_setup()
+        tables = jnp.asarray([[1, 2, 3, 11],
+                              [4, 5, 0, 0],
+                              [6, 7, 8, 9]], jnp.int32)
+        # Run straddles a page boundary for slot 0 (len 14, T=4 -> 18).
+        lengths = jnp.asarray([14, 17, 33], jnp.int32)
+        out = paged_attention.paged_decode_attention_mq(
+            q, k_pool, v_pool, tables, lengths)
+        ref = self._mq_reference(q, k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_t1_matches_single_query_kernel(self):
+        q, k_pool, v_pool = self._mq_setup(t=1)
+        tables = jnp.asarray([[1, 2, 0, 0],
+                              [3, 0, 0, 0],
+                              [4, 5, 6, 0]], jnp.int32)
+        lengths = jnp.asarray([20, 3, 40], jnp.int32)
+        out = paged_attention.paged_decode_attention_mq(
+            q, k_pool, v_pool, tables, lengths)
+        ref = paged_attention.paged_decode_attention(
+            q[:, 0], k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out[:, 0]),
+                                   np.asarray(ref), atol=2e-5,
+                                   rtol=2e-5)
+
+    def test_causal_within_run(self):
+        """Token 0 of the run must NOT see tokens 1..T-1's KV rows (the
+        pool is random everywhere, so any causal leak — token 0
+        attending positions lengths[s]+1.. — diverges from the
+        single-query kernel's output, which by construction only
+        attends <= lengths[s])."""
+        q, k_pool, v_pool = self._mq_setup(slots=1, t=3)
+        tables = jnp.asarray([[2, 3, 0, 0]], jnp.int32)
+        lengths = jnp.asarray([10], jnp.int32)
+        out = paged_attention.paged_decode_attention_mq(
+            q, k_pool, v_pool, tables, lengths)
+        single = paged_attention.paged_decode_attention(
+            q[:, 0], k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out[:, 0]),
+                                   np.asarray(single), atol=2e-5,
+                                   rtol=2e-5)
+
+    def test_bf16_gqa(self):
+        q, k_pool, v_pool = self._mq_setup(t=4, hq=8, hkv=2, seed=2)
+        q = q.astype(jnp.bfloat16)
+        k_pool = k_pool.astype(jnp.bfloat16)
+        v_pool = v_pool.astype(jnp.bfloat16)
+        tables = jnp.asarray([[1, 2, 3, 4],
+                              [5, 6, 0, 0],
+                              [7, 8, 9, 10]], jnp.int32)
+        lengths = jnp.asarray([50, 20, 35], jnp.int32)
+        out = paged_attention.paged_decode_attention_mq(
+            q, k_pool, v_pool, tables, lengths)
+        ref = self._mq_reference(q, k_pool, v_pool, tables, lengths)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)

@@ -92,6 +92,24 @@ def test_spec_accepts_on_looping_output():
     assert p['spec_accept_per_step'] > 0.2, p
 
 
+def test_spec_pallas_mq_path_matches(monkeypatch):
+    """Opt-in multi-query Pallas verify path (interpret mode on CPU)
+    produces identical outputs to the gather path."""
+    monkeypatch.setenv('SKYT_SPEC_PAGED_ATTN', 'pallas')
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    prompts = _prompts(vocab, [7, 19], seed=6) + [[5, 9, 2] * 8]
+    plain = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                       max_seq_len=128,
+                                       cache_mode='paged', page_size=16)
+    spec = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                      max_seq_len=128,
+                                      cache_mode='paged', page_size=16,
+                                      spec_decode=3)
+    assert _run(plain, prompts, max_new=12) == \
+        _run(spec, prompts, max_new=12)
+
+
 def test_spec_with_sampling_mix_falls_back():
     """A batch containing a temperature-sampled request must route
     through the plain path (speculation is greedy-only) and still finish

@@ -173,6 +173,29 @@ class TestEnginePrefillDecode:
         finally:
             engine.stop()
 
+    def test_spec_mq_kernel_lowers(self, monkeypatch):
+        """The multi-query paged-attention kernel must lower through
+        Mosaic and match the plain engine (validates flipping
+        SKYT_SPEC_PAGED_ATTN to default-pallas)."""
+        monkeypatch.setenv('SKYT_SPEC_PAGED_ATTN', 'pallas')
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        prompt = [5, 9, 2] * 8
+        outs = {}
+        for spec in (4, 0):
+            engine = server_lib.build_engine(
+                'debug', num_slots=2, max_seq_len=256,
+                cache_mode='paged', spec_decode=spec)
+            engine.start()
+            try:
+                outs[spec] = engine.generate(
+                    prompt,
+                    engine_lib.SamplingParams(max_new_tokens=16))
+            finally:
+                engine.stop()
+        assert outs[4] == outs[0]
+
     def test_spec_decode_lowers(self):
         """The speculative decode step (multi-token paged append +
         gather-view attention + on-device verify) must lower and match
