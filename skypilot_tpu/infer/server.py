@@ -359,7 +359,8 @@ def build_engine(model_name: Optional[str] = None,
                  pool_tokens: Optional[int] = None,
                  dtype: str = 'bfloat16',
                  prefix_caching: bool = True,
-                 spec_decode: int = 0
+                 spec_decode: int = 0,
+                 quantize: str = 'none'
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
@@ -397,6 +398,12 @@ def build_engine(model_name: Optional[str] = None,
         from skypilot_tpu.models import moe
         name = model_name or 'debug'
         if name in moe.MIXTRAL_CONFIGS:
+            if quantize == 'int8':
+                # Reject BEFORE the (expensive) random init — the
+                # family is already known from the preset name.
+                raise ValueError('--quantize int8 supports llama-family '
+                                 'models only (MoE experts are not '
+                                 'quantized yet)')
             cfg, moe_cfg = moe.MIXTRAL_CONFIGS[name]
             # Dropless routing for serving: finite capacity drops tokens
             # as a function of batch shape, making outputs depend on
@@ -418,6 +425,16 @@ def build_engine(model_name: Optional[str] = None,
         if mesh is not None:
             from skypilot_tpu.models import weights as weights_lib
             params = weights_lib.shard_params(params, model, cfg, mesh)
+    if quantize == 'int8':
+        # Weight-only int8: halve the HBM bytes every decode step
+        # streams (models/quant.py). Llama-family only (the MoE branch
+        # above rejects before init).
+        from skypilot_tpu.models import quant as quant_lib
+        params = quant_lib.quantize_params(params)
+        cfg = _dc.replace(cfg, quant='int8')
+        model = llama.LlamaModel(cfg)
+    elif quantize != 'none':
+        raise ValueError(f'unknown quantize mode {quantize!r}')
     if cache_mode == 'auto':
         # Paged for all families: MoE shares the llama attention layer,
         # so the paged decode path covers it too (tested against dense).
@@ -467,13 +484,18 @@ def main(argv=None) -> None:
     parser.add_argument('--spec-decode', type=int, default=0,
                         help='n-gram speculative decoding draft length '
                              '(0 = off; greedy requests only)')
+    parser.add_argument('--quantize', default='none',
+                        choices=['none', 'int8'],
+                        help='weight-only quantization (int8 = w8a16; '
+                             'halves decode HBM traffic)')
     args = parser.parse_args(argv)
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
                           checkpoint=args.checkpoint, tp=args.tp,
                           cache_mode=args.cache_mode, dtype=args.dtype,
                           prefix_caching=not args.no_prefix_caching,
-                          spec_decode=args.spec_decode)
+                          spec_decode=args.spec_decode,
+                          quantize=args.quantize)
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
     if tok_path:

@@ -43,6 +43,12 @@ class LlamaConfig:
     scan_layers: bool = True
     attn_impl: str = 'auto'          # 'auto' | 'flash' | 'xla' | 'ring'
     tie_embeddings: bool = False
+    # Weight-only quantization for serving: 'none' | 'int8'. int8 stores
+    # every projection kernel as int8 + per-output-channel scales
+    # (models/quant.py quantize_params converts a float tree); decode is
+    # weight-HBM-bound, so halving the bytes per step is a direct
+    # decode-throughput win. Embeddings/norms stay high precision.
+    quant: str = 'none'
 
     @property
     def head_dim(self) -> int:
@@ -76,7 +82,36 @@ CONFIGS = {
 }
 
 
-def _dense(features, logical_axes, name, param_dtype, dtype):
+class QuantDense(nn.Module):
+    """Weight-only int8 linear: kernel int8 [in, out] + per-output-
+    channel float scale [out]. `y = (x @ int8_kernel) * scale` is exact
+    for per-column scales — XLA fuses the cast and the scale multiply
+    into the matmul, so HBM reads half the bytes per decode step while
+    the MXU still runs the compute dtype."""
+    features: int
+    logical_axes: tuple
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            'kernel',
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), self.logical_axes),
+            (x.shape[-1], self.features), jnp.int8)
+        scale = self.param(
+            'scale',
+            nn.with_logical_partitioning(
+                nn.initializers.ones_init(), (self.logical_axes[-1],)),
+            (self.features,), jnp.float32)
+        y = jnp.dot(x, kernel.astype(self.dtype))
+        return y * scale.astype(self.dtype)
+
+
+def _dense(features, logical_axes, name, param_dtype, dtype, quant='none'):
+    if quant == 'int8':
+        return QuantDense(features=features, logical_axes=logical_axes,
+                          name=name, dtype=dtype)
     return nn.Dense(
         features=features, use_bias=False, name=name,
         dtype=dtype, param_dtype=param_dtype,
@@ -100,11 +135,11 @@ class LlamaAttention(nn.Module):
         b, s, _ = x.shape
 
         q = _dense(h * hd, ('embed', 'heads'), 'wq', cfg.param_dtype,
-                   dtype)(x).reshape(b, s, h, hd)
+                   dtype, cfg.quant)(x).reshape(b, s, h, hd)
         k = _dense(hk * hd, ('embed', 'kv_heads'), 'wk', cfg.param_dtype,
-                   dtype)(x).reshape(b, s, hk, hd)
+                   dtype, cfg.quant)(x).reshape(b, s, hk, hd)
         v = _dense(hk * hd, ('embed', 'kv_heads'), 'wv', cfg.param_dtype,
-                   dtype)(x).reshape(b, s, hk, hd)
+                   dtype, cfg.quant)(x).reshape(b, s, hk, hd)
 
         q = rope.apply_rope(q, cos, sin)
         k = rope.apply_rope(k, cos, sin)
@@ -180,7 +215,7 @@ class LlamaAttention(nn.Module):
                 new_cache = (k_cache, v_cache)
             out = out.reshape(b, s, h * hd)
             out = _dense(cfg.dim, ('heads', 'embed'), 'wo',
-                         cfg.param_dtype, dtype)(out)
+                         cfg.param_dtype, dtype, cfg.quant)(out)
             return nn.with_logical_constraint(
                 out, ('act_batch', 'act_seq', 'act_embed')), new_cache
 
@@ -201,7 +236,7 @@ class LlamaAttention(nn.Module):
                                           impl=cfg.attn_impl)
         out = out.reshape(b, s, h * hd)
         out = _dense(cfg.dim, ('heads', 'embed'), 'wo', cfg.param_dtype,
-                     dtype)(out)
+                     dtype, cfg.quant)(out)
         return nn.with_logical_constraint(
             out, ('act_batch', 'act_seq', 'act_embed'))
 
@@ -224,14 +259,14 @@ class LlamaMLP(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         gate = _dense(cfg.mlp_dim, ('embed', 'mlp'), 'w_gate',
-                      cfg.param_dtype, dtype)(x)
+                      cfg.param_dtype, dtype, cfg.quant)(x)
         up = _dense(cfg.mlp_dim, ('embed', 'mlp'), 'w_up',
-                    cfg.param_dtype, dtype)(x)
+                    cfg.param_dtype, dtype, cfg.quant)(x)
         hidden = nn.silu(gate) * up
         hidden = nn.with_logical_constraint(
             hidden, ('act_batch', 'act_seq', 'act_mlp'))
         out = _dense(cfg.dim, ('mlp', 'embed'), 'w_down',
-                     cfg.param_dtype, dtype)(hidden)
+                     cfg.param_dtype, dtype, cfg.quant)(hidden)
         return nn.with_logical_constraint(
             out, ('act_batch', 'act_seq', 'act_embed'))
 
@@ -378,7 +413,7 @@ class LlamaModel(nn.Module):
             logits = jnp.einsum('bsd,vd->bsv', x, embed.astype(dtype))
         else:
             logits = _dense(cfg.vocab_size, ('embed', 'vocab'), 'lm_head',
-                            cfg.param_dtype, dtype)(x)
+                            cfg.param_dtype, dtype, cfg.quant)(x)
         logits = nn.with_logical_constraint(
             logits, ('act_batch', 'act_seq', 'act_vocab'))
         return (logits, new_cache) if cache is not None else logits

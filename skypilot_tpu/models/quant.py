@@ -1,0 +1,94 @@
+"""Weight-only int8 quantization for serving.
+
+Converts a float Llama/Mixtral param tree into the layout
+`QuantDense` (models/llama.py) expects: every projection `kernel`
+becomes int8 with a per-output-channel symmetric `scale`
+(w ≈ int8 * scale). Decode streams the full weights from HBM every
+step, so int8 halves the bytes — the standard TPU serving quantization
+(the reference gets w8a16 from vLLM flags; here it is first-class).
+
+Embeddings (gathers, quality-sensitive) and norm scales are left in
+their original dtype; `lm_head` is quantized like any projection.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# Param-dict keys holding projection kernels to quantize. Norms store
+# their weight under a different name and embeddings are a bare param,
+# so matching on a 'kernel' leaf of ndim >= 2 is sufficient — but the
+# explicit check keeps accidental future 'kernel' params out.
+_KERNEL_KEY = 'kernel'
+
+
+def _quantize_kernel(w: jax.Array) -> Dict[str, jax.Array]:
+    """w [..., in, out] float -> {'kernel': int8, 'scale': f32[..., out]}.
+
+    Per-output-channel symmetric: scale = max|w| / 127 over the `in`
+    axis (axis -2); works unchanged for nn.scan-stacked kernels
+    [L, in, out] (scale [L, out])."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return {_KERNEL_KEY: q, 'scale': scale}
+
+
+def quantize_params(params: Any) -> Any:
+    """Quantize every projection kernel in a float param tree.
+
+    Input: the `{'params': ...}` variables dict (or the inner params
+    dict) from a float model; output has the same structure with each
+    `{'kernel': float[..., in, out]}` dict gaining int8 kernel + scale —
+    exactly the tree a `quant='int8'` model's init produces, so
+    sharding-spec derivation and `model.apply` work unchanged.
+    """
+
+    import dataclasses
+
+    import flax.linen as nn
+
+    def walk(node):
+        if isinstance(node, dict):
+            box = node.get(_KERNEL_KEY)
+            # init() leaves are nn.LogicallyPartitioned boxes (the
+            # logical-axis metadata); checkpoint-loaded params are bare
+            # arrays. Handle both, reboxing so sharding survives.
+            is_box = isinstance(box, nn.meta.AxisMetadata)
+            w = box.unbox() if is_box else box
+            if w is not None and len(node) == 1 and \
+                    hasattr(w, 'ndim') and w.ndim >= 2 and \
+                    jnp.issubdtype(w.dtype, jnp.floating):
+                qd = _quantize_kernel(w)
+                if is_box:
+                    # Drop only the `in` axis name: scan-stacked
+                    # kernels are ('layers', in, out) -> scale
+                    # ('layers', out).
+                    names = tuple(box.names)
+                    qd = {
+                        _KERNEL_KEY: box.replace_boxed(qd[_KERNEL_KEY]),
+                        'scale': dataclasses.replace(
+                            box, value=qd['scale'],
+                            names=names[:-2] + (names[-1],)),
+                    }
+                return qd
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    # flax FrozenDict or plain dict both answer to dict protocol via
+    # unfreeze; keep plain dicts plain.
+    try:
+        import flax
+        if isinstance(params, flax.core.FrozenDict):
+            return flax.core.freeze(walk(flax.core.unfreeze(params)))
+    except ImportError:  # pragma: no cover - flax is baked in
+        pass
+    return walk(params)
+
+
+def dequantize_kernel(q: jax.Array, scale: jax.Array,
+                      dtype=jnp.float32) -> jax.Array:
+    """Inverse transform (tests / export)."""
+    return (q.astype(jnp.float32) * scale[..., None, :]).astype(dtype)

@@ -219,6 +219,25 @@ class TestEnginePrefillDecode:
 
         assert gen(4) == gen(0)
 
+    def test_quantized_engine_lowers(self):
+        """int8 weight-only serving (QuantDense) must lower and decode
+        on the chip."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        engine = server_lib.build_engine('debug', num_slots=2,
+                                         max_seq_len=128,
+                                         cache_mode='paged',
+                                         quantize='int8')
+        engine.start()
+        try:
+            out = engine.generate(
+                [1, 2, 3, 4, 5, 6, 7, 8],
+                engine_lib.SamplingParams(max_new_tokens=4))
+            assert len(out) == 4
+        finally:
+            engine.stop()
+
     def test_prefix_cached_admission(self):
         """The prefix-cache suffix-prefill path (pool gather + dense
         continuation + offset page scatter) must lower on the chip and
