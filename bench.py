@@ -128,6 +128,41 @@ def serve_metrics(on_tpu: bool) -> list:
     ]
 
 
+def serve_int8_metric(bf16_steady: float) -> list:
+    """int8 weight-only pass (TPU workload shape): same serve workload
+    on a quantized engine — decode is weight-HBM-bound, so this
+    quantifies the --quantize int8 speedup. Runs as its OWN phase in
+    main() so a slow/failed int8 pass can never cost the mandatory bf16
+    metrics."""
+    from skypilot_tpu.benchmark import serve_bench
+    from skypilot_tpu.infer import server as server_lib
+
+    scfg = serve_bench.ServeBenchConfig(
+        model='llama3-1b', prompt_len=512, max_new_tokens=64,
+        num_requests=16, num_slots=8, max_seq_len=1024,
+        decode_chunk=32)
+    qengine = server_lib.build_engine(scfg.model, scfg.num_slots,
+                                      scfg.max_seq_len, tp=scfg.tp,
+                                      decode_chunk=scfg.decode_chunk,
+                                      prefix_caching=False,
+                                      quantize='int8')
+    qengine.start()
+    try:
+        qruns = [serve_bench.run_serve_bench(scfg, engine=qengine)
+                 for _ in range(2)]
+    finally:
+        qengine.stop()
+    int8_steady = max(x['decode_tok_per_sec_steady'] for x in qruns)
+    print(f'# serve int8: decode_steady={int8_steady:,.0f} tok/s',
+          file=sys.stderr)
+    return [
+        {'metric': 'serve_decode_steady_tok_per_sec_per_chip_int8',
+         'value': round(int8_steady, 1), 'unit': 'tok/s/chip',
+         'vs_baseline': round(int8_steady / max(bf16_steady, 1e-6),
+                              4)},  # speedup vs the bf16 engine
+    ]
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -307,6 +342,20 @@ def main() -> None:
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# serve bench failed: {e!r}', file=sys.stderr)
         extra = []
+
+    if on_tpu and extra:
+        # Optional int8 pass: its own phase + deadline so it can only
+        # ADD a metric, never cost the bf16 ones above.
+        bf16_steady = next(
+            (m['value'] for m in extra
+             if m['metric'] == 'serve_decode_steady_tok_per_sec_per_chip'),
+            0.0)
+        try:
+            with phase_deadline(600, 'serve int8 bench'):
+                extra = extra + serve_int8_metric(bf16_steady)
+            partial['extra'] = extra
+        except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+            print(f'# serve int8 bench failed: {e!r}', file=sys.stderr)
 
     line = {
         'metric': metric_name,
