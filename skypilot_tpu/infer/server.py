@@ -392,18 +392,13 @@ def build_engine(model_name: Optional[str] = None,
             checkpoint, remat=False, param_dtype=dtype, dtype=dtype)
         cfg = _dc.replace(cfg,
                           max_seq_len=min(cfg.max_seq_len, max_seq_len))
-        model = llama.LlamaModel(cfg)
+        make_model = llama.LlamaModel
+        model = make_model(cfg)
         params = weights_lib.load_llama_params(cfg, checkpoint, mesh=mesh)
     else:
         from skypilot_tpu.models import moe
         name = model_name or 'debug'
         if name in moe.MIXTRAL_CONFIGS:
-            if quantize == 'int8':
-                # Reject BEFORE the (expensive) random init — the
-                # family is already known from the preset name.
-                raise ValueError('--quantize int8 supports llama-family '
-                                 'models only (MoE experts are not '
-                                 'quantized yet)')
             cfg, moe_cfg = moe.MIXTRAL_CONFIGS[name]
             # Dropless routing for serving: finite capacity drops tokens
             # as a function of batch shape, making outputs depend on
@@ -427,12 +422,12 @@ def build_engine(model_name: Optional[str] = None,
             params = weights_lib.shard_params(params, model, cfg, mesh)
     if quantize == 'int8':
         # Weight-only int8: halve the HBM bytes every decode step
-        # streams (models/quant.py). Llama-family only (the MoE branch
-        # above rejects before init).
+        # streams (models/quant.py). Covers llama projections AND MoE
+        # expert weights (routers stay float).
         from skypilot_tpu.models import quant as quant_lib
         params = quant_lib.quantize_params(params)
         cfg = _dc.replace(cfg, quant='int8')
-        model = llama.LlamaModel(cfg)
+        model = make_model(cfg)
     elif quantize != 'none':
         raise ValueError(f'unknown quantize mode {quantize!r}')
     if cache_mode == 'auto':

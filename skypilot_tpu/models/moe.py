@@ -83,29 +83,46 @@ class MoeMLP(nn.Module):
         expert_in = nn.with_logical_constraint(
             expert_in, ('act_expert', 'act_batch', None, 'act_embed'))
 
-        w_gate = self.param(
-            'w_gate', nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(batch_axis=(0,)),
-                ('expert', 'embed', 'mlp')),
-            (e, d, cfg.mlp_dim), jnp.dtype(cfg.param_dtype))
-        w_up = self.param(
-            'w_up', nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(batch_axis=(0,)),
-                ('expert', 'embed', 'mlp')),
-            (e, d, cfg.mlp_dim), jnp.dtype(cfg.param_dtype))
-        w_down = self.param(
-            'w_down', nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(batch_axis=(0,)),
-                ('expert', 'mlp', 'embed')),
-            (e, cfg.mlp_dim, d), jnp.dtype(cfg.param_dtype))
+        def expert_w(name, shape, axes):
+            """Expert weight, optionally int8 (weight-only) with a
+            per-(expert, out-channel) scale — models/quant.py converts
+            float trees to this layout."""
+            if cfg.quant == 'int8':
+                w = self.param(
+                    name, nn.with_logical_partitioning(
+                        nn.initializers.zeros_init(), axes), shape,
+                    jnp.int8)
+                scale = self.param(
+                    f'{name}_scale', nn.with_logical_partitioning(
+                        nn.initializers.ones_init(),
+                        (axes[0], axes[-1])),
+                    (shape[0], shape[-1]), jnp.float32)
+                return w, scale
+            w = self.param(
+                name, nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(batch_axis=(0,)),
+                    axes), shape, jnp.dtype(cfg.param_dtype))
+            return w, None
 
-        gate = jnp.einsum('ebcd,edm->ebcm', expert_in, w_gate.astype(dtype))
-        up = jnp.einsum('ebcd,edm->ebcm', expert_in, w_up.astype(dtype))
+        def expert_mm(x_in, w, scale, spec):
+            y = jnp.einsum(spec, x_in, w.astype(dtype))
+            if scale is not None:
+                y = y * scale.astype(dtype)[:, None, None, :]
+            return y
+
+        w_gate, sg = expert_w('w_gate', (e, d, cfg.mlp_dim),
+                              ('expert', 'embed', 'mlp'))
+        w_up, su = expert_w('w_up', (e, d, cfg.mlp_dim),
+                            ('expert', 'embed', 'mlp'))
+        w_down, sd = expert_w('w_down', (e, cfg.mlp_dim, d),
+                              ('expert', 'mlp', 'embed'))
+
+        gate = expert_mm(expert_in, w_gate, sg, 'ebcd,edm->ebcm')
+        up = expert_mm(expert_in, w_up, su, 'ebcd,edm->ebcm')
         hidden = nn.silu(gate) * up
         hidden = nn.with_logical_constraint(
             hidden, ('act_expert', 'act_batch', None, 'act_mlp'))
-        expert_out = jnp.einsum('ebcm,emd->ebcd', hidden,
-                                w_down.astype(dtype))
+        expert_out = expert_mm(hidden, w_down, sd, 'ebcm,emd->ebcd')
 
         out = jnp.einsum('bsec,ebcd->bsd',
                          combine.astype(jnp.float32),
@@ -251,7 +268,8 @@ class MixtralModel(nn.Module):
             x = jnp.take_along_axis(
                 x, logit_positions[:, :, None], axis=1)
         logits = llama_lib._dense(cfg.vocab_size, ('embed', 'vocab'),
-                                  'lm_head', cfg.param_dtype, dtype)(x)
+                                  'lm_head', cfg.param_dtype, dtype,
+                                  cfg.quant)(x)
         logits = nn.with_logical_constraint(
             logits, ('act_batch', 'act_seq', 'act_vocab'))
         self.sow('intermediates', 'moe_aux_loss', aux_total)

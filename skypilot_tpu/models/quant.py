@@ -20,6 +20,9 @@ import jax.numpy as jnp
 # so matching on a 'kernel' leaf of ndim >= 2 is sufficient — but the
 # explicit check keeps accidental future 'kernel' params out.
 _KERNEL_KEY = 'kernel'
+# MoE expert einsum weights (models/moe.py MoeMLP), identified by their
+# names next to a 'router' sibling.
+_MOE_EXPERT_KEYS = ('w_gate', 'w_up', 'w_down')
 
 
 def _quantize_kernel(w: jax.Array) -> Dict[str, jax.Array]:
@@ -50,30 +53,46 @@ def quantize_params(params: Any) -> Any:
 
     import flax.linen as nn
 
+    def quantizable(box):
+        # init() leaves are nn.LogicallyPartitioned boxes (the
+        # logical-axis metadata); checkpoint-loaded params are bare
+        # arrays. Handle both, reboxing so sharding survives.
+        w = box.unbox() if isinstance(box, nn.meta.AxisMetadata) else box
+        return (w is not None and hasattr(w, 'ndim') and w.ndim >= 2
+                and jnp.issubdtype(w.dtype, jnp.floating))
+
+    def convert(box):
+        """-> (quantized kernel, scale), boxed like the input. The
+        scale drops only the `in` axis name: scan-stacked kernels are
+        ('layers', ..., in, out) -> scale ('layers', ..., out)."""
+        if isinstance(box, nn.meta.AxisMetadata):
+            qd = _quantize_kernel(box.unbox())
+            names = tuple(box.names)
+            return (box.replace_boxed(qd[_KERNEL_KEY]),
+                    dataclasses.replace(box, value=qd['scale'],
+                                        names=names[:-2] +
+                                        (names[-1],)))
+        qd = _quantize_kernel(box)
+        return qd[_KERNEL_KEY], qd['scale']
+
     def walk(node):
         if isinstance(node, dict):
-            box = node.get(_KERNEL_KEY)
-            # init() leaves are nn.LogicallyPartitioned boxes (the
-            # logical-axis metadata); checkpoint-loaded params are bare
-            # arrays. Handle both, reboxing so sharding survives.
-            is_box = isinstance(box, nn.meta.AxisMetadata)
-            w = box.unbox() if is_box else box
-            if w is not None and len(node) == 1 and \
-                    hasattr(w, 'ndim') and w.ndim >= 2 and \
-                    jnp.issubdtype(w.dtype, jnp.floating):
-                qd = _quantize_kernel(w)
-                if is_box:
-                    # Drop only the `in` axis name: scan-stacked
-                    # kernels are ('layers', in, out) -> scale
-                    # ('layers', out).
-                    names = tuple(box.names)
-                    qd = {
-                        _KERNEL_KEY: box.replace_boxed(qd[_KERNEL_KEY]),
-                        'scale': dataclasses.replace(
-                            box, value=qd['scale'],
-                            names=names[:-2] + (names[-1],)),
-                    }
-                return qd
+            # QuantDense projection scope: exactly {'kernel': w}.
+            if set(node) == {_KERNEL_KEY} and \
+                    quantizable(node[_KERNEL_KEY]):
+                k, s = convert(node[_KERNEL_KEY])
+                return {_KERNEL_KEY: k, 'scale': s}
+            # MoeMLP scope: expert einsum weights next to the router
+            # (which stays float — tiny and routing-quality-critical).
+            if 'router' in node and \
+                    any(k in node for k in _MOE_EXPERT_KEYS):
+                out = {}
+                for k, v in node.items():
+                    if k in _MOE_EXPERT_KEYS and quantizable(v):
+                        out[k], out[f'{k}_scale'] = convert(v)
+                    else:
+                        out[k] = walk(v)
+                return out
             return {k: walk(v) for k, v in node.items()}
         return node
 

@@ -8,7 +8,6 @@ transform (models/quant.py + QuantDense).
 import dataclasses
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -129,9 +128,44 @@ def test_quantized_engine_tp_sharded():
     assert run(2) == run(1)
 
 
-def test_quantize_rejects_moe():
+def test_quantized_moe_structure_and_logits():
+    """MoE expert weights quantize too (per-(expert, out-channel)
+    scales; router stays float) — tree matches the quant model's init
+    and logits stay close."""
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    moe_cfg = dataclasses.replace(moe_cfg, capacity_factor=8.0)
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    qparams = quant.quantize_params(params)
+    qcfg = dataclasses.replace(cfg, quant='int8')
+    qmodel = moe.MixtralModel(qcfg, moe_cfg)
+    qinit = jax.jit(qmodel.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    assert jax.tree.structure(qparams) == jax.tree.structure(qinit)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(1, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    lf = model.apply(params, tokens)
+    lq = qmodel.apply(qparams, tokens)
+    denom = np.maximum(np.abs(np.asarray(lf)).max(), 1e-6)
+    rel = np.abs(np.asarray(lq) - np.asarray(lf)).max() / denom
+    assert rel < 0.05, rel
+
+
+def test_quantized_moe_engine_serves():
+    from skypilot_tpu.infer import engine as engine_lib
     from skypilot_tpu.infer import server as server_lib
 
-    with pytest.raises(ValueError, match='llama-family'):
-        server_lib.build_engine('debug-moe', num_slots=1,
-                                max_seq_len=64, quantize='int8')
+    eng = server_lib.build_engine('debug-moe', num_slots=1,
+                                  max_seq_len=64, cache_mode='paged',
+                                  quantize='int8')
+    eng.start()
+    try:
+        out = eng.generate([1, 2, 3, 4, 5],
+                           engine_lib.SamplingParams(max_new_tokens=4))
+        assert len(out) == 4
+    finally:
+        eng.stop()
