@@ -93,7 +93,8 @@ class InferenceEngine:
                  page_size: int = 64,
                  pool_tokens: Optional[int] = None,
                  prefix_caching: bool = True,
-                 spec_decode: int = 0) -> None:
+                 spec_decode: int = 0,
+                 prefill_chunk: int = 0) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
@@ -137,6 +138,18 @@ class InferenceEngine:
         # step streams the full weights), so every accepted draft is a
         # nearly-free extra token.
         self.spec_decode = max(0, int(spec_decode))
+        # Chunked prefill (paged mode only): a prompt longer than
+        # `prefill_chunk` tokens is prefilled one chunk per engine-loop
+        # iteration, with decode chunks for running requests in
+        # between — one long admission can no longer stall every active
+        # stream for its whole prefill (vLLM's chunked prefill).
+        # 0 disables (admission prefills whole prompts inline).
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        if self.prefill_chunk and cache_mode == 'paged':
+            # Page-aligned so chunk boundaries land on page boundaries.
+            self.prefill_chunk = max(page_size,
+                                     (self.prefill_chunk // page_size)
+                                     * page_size)
         self.pool = None
         cache_sharding = None
         if mesh is not None:
@@ -185,6 +198,12 @@ class InferenceEngine:
                               'v': jnp.zeros(shape, dtype)}
         # FIFO head deferred by pool exhaustion (paged mode only).
         self._deferred: Optional[_Request] = None
+        # In-progress chunked prefill (at most one): {req, slot, row,
+        # hashes, start, n}. The slot holds its reservation but stays
+        # OUT of the decode batch (its device table row is only
+        # installed by the final chunk's insert, so zombie decode writes
+        # land in the dummy page) until the first token is produced.
+        self._chunked: Optional[Dict[str, Any]] = None
         # Host-side slot table. _lengths is an UPPER-BOUND estimate used
         # for chunk sizing (with speculative decode an in-flight chunk's
         # true advance is only known at pull time); _conf_lengths is the
@@ -223,7 +242,8 @@ class InferenceEngine:
         self.perf = {'decode_tokens': 0, 'decode_chunks': 0,
                      'steady_tokens': 0, 'steady_time_s': 0.0,
                      'spec_steps': 0, 'spec_tokens': 0,
-                     'spec_verify_steps': 0, 'spec_accepted': 0}
+                     'spec_verify_steps': 0, 'spec_accepted': 0,
+                     'prefill_chunks': 0}
         self._last_pull_t: Optional[float] = None
         self._had_admission = False
 
@@ -251,6 +271,8 @@ class InferenceEngine:
                                    donate_argnums=(0, 3))
         self._jit_insert_paged = jax.jit(self._insert_paged_impl,
                                          donate_argnums=(0, 3))
+        self._jit_insert_pages = jax.jit(self._insert_pages_impl,
+                                         donate_argnums=(0,))
         self._jit_clear_slot = jax.jit(self._clear_slot_impl,
                                        donate_argnums=(0,))
 
@@ -388,6 +410,21 @@ class InferenceEngine:
         }
         return self._pin_paged_layouts(new_cache), _update_args(
             args, slot, first_tok, length, temp, key, topk)
+
+    def _insert_pages_impl(self, cache, prefill_cache, page_ids,
+                           src_off):
+        """Chunked prefill: write one chunk's pages into the pool
+        WITHOUT installing the slot's table row or decode args — the
+        slot only becomes decodable at the final chunk's full insert."""
+        from skypilot_tpu.infer import paged_cache
+        new_cache = {
+            'k': paged_cache.PagePool.insert_prompt(
+                cache['k'], prefill_cache['k'], page_ids, src_off),
+            'v': paged_cache.PagePool.insert_prompt(
+                cache['v'], prefill_cache['v'], page_ids, src_off),
+            'tables': cache['tables'],
+        }
+        return self._pin_paged_layouts(new_cache)
 
     def _clear_slot_impl(self, cache, slot):
         """Neutralize a released slot's block-table row (point it at the
@@ -653,7 +690,8 @@ class InferenceEngine:
         self.perf = {'decode_tokens': 0, 'decode_chunks': 0,
                      'steady_tokens': 0, 'steady_time_s': 0.0,
                      'spec_steps': 0, 'spec_tokens': 0,
-                     'spec_verify_steps': 0, 'spec_accepted': 0}
+                     'spec_verify_steps': 0, 'spec_accepted': 0,
+                     'prefill_chunks': 0}
         self._last_pull_t = None
 
     # ---------------------------------------------------------- main loop
@@ -697,6 +735,17 @@ class InferenceEngine:
             # max_new — so decode can never exhaust the pool mid-flight.
             total = min(n + req.params.max_new_tokens, self.max_seq_len)
             psize = self.pool.cfg.page_size
+            if self.prefill_chunk and self._chunked is not None and \
+                    n > self.prefill_chunk:
+                # A long prompt behind an in-progress chunked prefill:
+                # defer BEFORE reserving — reserve-then-release every
+                # loop iteration would churn the pool and the prefix
+                # registry for the whole of the other prompt's prefill.
+                # (A full prefix hit could shrink the suffix below the
+                # chunk; the reserve path handles that once the current
+                # chunked prefill finishes.)
+                self._deferred = req
+                return False
             if self.prefix_caching:
                 if req.page_hashes is None:
                     req.page_hashes = paged_cache_hashes(req.tokens,
@@ -712,6 +761,25 @@ class InferenceEngine:
                 self._deferred = req
                 return False
             row, n_cached = res
+            if self.prefill_chunk and \
+                    n - n_cached * psize > self.prefill_chunk:
+                # Long prompt: prefill one chunk per loop iteration so
+                # running requests keep decoding in between. Evaluated
+                # BEFORE the suffix-bucket-overflow fallback — chunk
+                # buckets are page-rounded pieces, so the overflow
+                # cannot occur on this path and the cached prefix is
+                # kept.
+                if self._chunked is not None:
+                    # One chunked prefill at a time; keep FIFO order.
+                    self.pool.release(slot)
+                    self._deferred = req
+                    return False
+                self._slots[slot] = req
+                req.slot = slot
+                self._chunked = {'req': req, 'slot': slot, 'row': row,
+                                 'hashes': hashes,
+                                 'start': n_cached * psize, 'n': n}
+                return True
             if n_cached > 0:
                 sb = self._bucket_for(n - n_cached * psize)
                 max_span = self.pool.cfg.max_pages_per_slot * psize
@@ -792,12 +860,20 @@ class InferenceEngine:
                         prefill_cache)
                 self.cache, self._dev_args = self._jit_insert(
                     self.cache, prefill_cache, *ins_args)
-            if self.spec_decode > 0:
-                # Full prompt (not just a prefix-cached suffix) into the
-                # device history for the n-gram proposer.
-                hb = self._bucket_for(n)
-                hist_toks = np.zeros((1, hb), np.int32)
-                hist_toks[0, :n] = req.tokens
+        self._complete_admission(req, slot, n, first, temp)
+        return True
+
+    def _complete_admission(self, req: '_Request', slot: int, n: int,
+                            first: int, temp: float) -> None:
+        """Shared admission tail: device history (spec decode), first
+        token delivery, host slot bookkeeping."""
+        if self.spec_decode > 0:
+            # Full prompt (not just a prefix-cached suffix) into the
+            # device history for the n-gram proposer.
+            hb = self._bucket_for(n)
+            hist_toks = np.zeros((1, hb), np.int32)
+            hist_toks[0, :n] = req.tokens
+            with self._ctx():
                 self._dev_hist = self._jit_hist_insert(
                     self._dev_hist, jnp.int32(slot),
                     jnp.asarray(hist_toks), jnp.int32(n),
@@ -813,7 +889,75 @@ class InferenceEngine:
         self._had_admission = True
         if self._req_done(req, first):
             self._release(slot)
-        return True
+
+    def _advance_chunked(self) -> None:
+        """Run ONE chunk of the in-progress chunked prefill (if any).
+        Every chunk rides the prefix-cache suffix path: gather the
+        slot's pages so far, run this chunk's tokens through the model,
+        scatter the new pages back (tables untouched until the final
+        chunk, so the slot stays out of the decode batch). The final
+        chunk produces the first token and activates the slot."""
+        st = self._chunked
+        if st is None:
+            return
+        req, slot, row = st['req'], st['slot'], st['row']
+        start, n, hashes = st['start'], st['n'], st['hashes']
+        psize = self.pool.cfg.page_size
+        mp_span = self.pool.cfg.max_pages_per_slot * psize
+        piece = min(self.prefill_chunk, n - start)
+        self.perf['prefill_chunks'] += 1
+        # A prefill chunk shares this iteration with the decode chunk;
+        # exclude the interval from the steady-state decode rate (same
+        # rule as admissions — 'prefill excluded by construction').
+        self._had_admission = True
+        final = start + piece >= n
+        sb = self._bucket_for(piece)
+        if start + sb > mp_span:
+            # Padded writes must not spill past the per-slot view; a
+            # page-rounded piece always fits (start and mp_span are
+            # page-aligned and start + piece <= n <= mp_span).
+            sb = -(-piece // psize) * psize
+        padded = np.zeros((1, sb), np.int32)
+        padded[0, :piece] = req.tokens[start:start + piece]
+        # Intermediate chunks pass their own end as `length` (the logit
+        # row is computed but unused); the final chunk passes the true
+        # prompt length and its logits become the first token.
+        length_arg = n if final else start + piece
+        first_page = start // psize
+        end_page = min(-(-(start + piece) // psize),
+                       int((row > 0).sum()))
+        ids = row[first_page:end_page]
+        with self._ctx():
+            greedy, logits, pc = self._jit_prefill_suffix(
+                self.params, jnp.asarray(padded), jnp.int32(start),
+                jnp.asarray([length_arg]), self.cache['k'],
+                self.cache['v'], jnp.asarray(row), bucket=sb)
+            if not final:
+                self.cache = self._jit_insert_pages(
+                    self.cache, pc, jnp.asarray(ids),
+                    jnp.int32(first_page * psize))
+                if self.prefix_caching:
+                    self.pool.publish(
+                        slot, hashes[:(start + piece) // psize])
+                st['start'] = start + piece
+                return
+            temp = max(0.0, req.params.temperature)
+            if temp > 0.0:
+                first = self._sample(np.asarray(logits)[0], req)
+            else:
+                first = int(np.asarray(greedy)[0])
+            key = jax.random.PRNGKey(req.params.seed + req.req_id)
+            self._ensure_dev_args()
+            self.cache, self._dev_args = self._jit_insert_paged(
+                self.cache, pc, jnp.int32(slot), self._dev_args,
+                jnp.int32(first), jnp.int32(n), jnp.float32(temp), key,
+                jnp.int32(min(req.params.top_k, _TOPK_BUCKET)),
+                jnp.asarray(ids), jnp.asarray(row),
+                jnp.int32(first_page * psize))
+            if self.prefix_caching:
+                self.pool.publish(slot, hashes[:n // psize])
+        self._chunked = None
+        self._complete_admission(req, slot, n, first, temp)
 
     def _req_done(self, req: _Request, token: int) -> bool:
         p = req.params
@@ -829,6 +973,9 @@ class InferenceEngine:
         req = self._slots[slot]
         if req is not None:
             req.out_queue.put(None)
+        if self._chunked is not None and self._chunked['slot'] == slot:
+            # Crash-path release mid-chunked-prefill: abandon it.
+            self._chunked = None
         self._slots[slot] = None
         self._lengths[slot] = 0
         self._conf_lengths[slot] = 0
@@ -886,8 +1033,15 @@ class InferenceEngine:
             admitted = False
             while None in self._slots and self._admit_one():
                 admitted = True
+            # One chunk of any in-progress long-prompt prefill, then a
+            # decode chunk — running requests keep streaming while the
+            # long admission fills its pages.
+            chunking = self._chunked is not None
+            self._advance_chunked()
             active = [i for i, r in enumerate(self._slots)
-                      if r is not None]
+                      if r is not None and not (
+                          self._chunked is not None
+                          and self._chunked['slot'] == i)]
             new_pending = None
             upper = 0
             if active:
@@ -940,7 +1094,7 @@ class InferenceEngine:
                     upper = chunk
             if pending is not None:
                 self._finish_chunk(pending)
-            elif not active and not admitted:
+            elif not active and not admitted and not chunking:
                 time.sleep(0.002)
             # Resync the sizing estimate: confirmed lengths plus the
             # in-flight chunk's worst-case advance.

@@ -360,7 +360,8 @@ def build_engine(model_name: Optional[str] = None,
                  dtype: str = 'bfloat16',
                  prefix_caching: bool = True,
                  spec_decode: int = 0,
-                 quantize: str = 'none'
+                 quantize: str = 'none',
+                 prefill_chunk: int = 0
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
@@ -442,7 +443,8 @@ def build_engine(model_name: Optional[str] = None,
                                       cache_mode=cache_mode,
                                       pool_tokens=pool_tokens,
                                       prefix_caching=prefix_caching,
-                                      spec_decode=spec_decode)
+                                      spec_decode=spec_decode,
+                                      prefill_chunk=prefill_chunk)
 
 
 def main(argv=None) -> None:
@@ -483,6 +485,10 @@ def main(argv=None) -> None:
                         choices=['none', 'int8'],
                         help='weight-only quantization (int8 = w8a16; '
                              'halves decode HBM traffic)')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='chunked prefill: long prompts prefill in '
+                             'chunks of this many tokens, interleaved '
+                             'with decode (0 = off)')
     args = parser.parse_args(argv)
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
@@ -490,7 +496,8 @@ def main(argv=None) -> None:
                           cache_mode=args.cache_mode, dtype=args.dtype,
                           prefix_caching=not args.no_prefix_caching,
                           spec_decode=args.spec_decode,
-                          quantize=args.quantize)
+                          quantize=args.quantize,
+                          prefill_chunk=args.prefill_chunk)
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
     if tok_path:

@@ -219,6 +219,28 @@ class TestEnginePrefillDecode:
 
         assert gen(4) == gen(0)
 
+    def test_chunked_prefill_lowers(self):
+        """Chunked prefill's page-write path (insert w/o table install,
+        suffix continuation per chunk) must lower and match."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        prompt = list(range(1, 101))
+
+        def gen(chunk):
+            engine = server_lib.build_engine(
+                'debug', num_slots=2, max_seq_len=256,
+                cache_mode='paged', prefill_chunk=chunk)
+            engine.start()
+            try:
+                return engine.generate(
+                    prompt,
+                    engine_lib.SamplingParams(max_new_tokens=8))
+            finally:
+                engine.stop()
+
+        assert gen(64) == gen(0)
+
     def test_quantized_engine_lowers(self):
         """int8 weight-only serving (QuantDense) must lower and decode
         on the chip."""
