@@ -39,6 +39,101 @@ class PhaseTimeout(Exception):
     pass
 
 
+class DeviceUnavailable(Exception):
+    """The accelerator never became reachable within the retry window."""
+
+
+def _acquire_device():
+    """Initialize the JAX backend INSIDE the bench guards.
+
+    The tunneled chip fails two ways: a wedge HANGS inside a blocking C
+    call (SIGALRM-immune), and a down backend RAISES at init — round 3
+    lost its whole artifact to that raise at the one unguarded
+    ``jax.devices()``. So: probe in a child process (hang-proof, bounded
+    by a subprocess timeout) and retry over a bounded window — the wedge
+    comes and goes — then init in-process only after a probe succeeds.
+    If the in-process init still hangs (re-wedge race), the bounded
+    join below raises DeviceUnavailable once the window closes and
+    main() emits the null-JSON artifact.
+    """
+    # Only a non-TPU platform (CPU smoke env) bypasses the probe loop:
+    # the image sets JAX_PLATFORMS=axon globally, so "env var present"
+    # does NOT mean "no tunnel".
+    plat = os.environ.get('JAX_PLATFORMS', '')
+    if plat and plat not in ('axon', 'tpu'):
+        return jax.devices()[0]
+    import subprocess
+    import threading
+    window = float(os.environ.get('SKYT_BENCH_INIT_RETRY_S', '1200'))
+    interval = float(
+        os.environ.get('SKYT_BENCH_INIT_PROBE_INTERVAL_S', '120'))
+    probe_timeout = float(
+        os.environ.get('SKYT_BENCH_INIT_PROBE_TIMEOUT_S', '90'))
+    deadline = time.monotonic() + window
+    attempt = 0
+    while True:
+        # Stage 1: child-process probes until one succeeds. A child is
+        # the only hang-proof way to ask "is the tunnel up?" — the init
+        # call blocks in C when wedged.
+        probed_ok = False
+        while not probed_ok:
+            attempt += 1
+            try:
+                r = subprocess.run(
+                    [sys.executable, '-c',
+                     'import jax; print(jax.devices()[0].platform)'],
+                    capture_output=True, timeout=probe_timeout, text=True)
+                probed_ok = r.returncode == 0
+                if not probed_ok:
+                    tail = (r.stderr or '').strip().splitlines()
+                    print(f'# device probe {attempt} failed: '
+                          f'{tail[-1] if tail else "?"}', file=sys.stderr)
+            except subprocess.TimeoutExpired:
+                print(f'# device probe {attempt} timed out '
+                      '(tunnel wedged?)', file=sys.stderr)
+            if probed_ok:
+                continue
+            if time.monotonic() >= deadline:
+                raise DeviceUnavailable(
+                    f'tpu unavailable after {int(window)}s '
+                    f'({attempt} probes)')
+            time.sleep(min(interval,
+                           max(0.0, deadline - time.monotonic())))
+        # Stage 2: in-process init — which can STILL hang even right
+        # after a successful probe (observed: the flaky tunnel answers
+        # one process and wedges the next). Run it in a daemon thread
+        # with a bounded join. A stuck init holds jax's backend lock,
+        # so no second in-process attempt is possible: we keep waiting
+        # on this one thread until the window closes (it completes if
+        # the tunnel recovers).
+        cell = {}
+
+        def _init():
+            try:
+                cell['dev'] = jax.devices()[0]
+            except Exception as e:  # pylint: disable=broad-except
+                cell['err'] = e
+        t = threading.Thread(target=_init, daemon=True)
+        t.start()
+        t.join(timeout=max(60.0, deadline - time.monotonic()))
+        if 'dev' in cell:
+            return cell['dev']
+        if t.is_alive():
+            raise DeviceUnavailable(
+                'in-process backend init hung after a successful probe '
+                f'(window {int(window)}s exhausted)')
+        # Init raised (fast-fail, the round-3 mode). jax leaves no
+        # backend cached on failure, so a fresh attempt is allowed:
+        # go back to probing if window remains.
+        print(f'# in-process init failed: {cell["err"]!r}',
+              file=sys.stderr)
+        if time.monotonic() >= deadline:
+            raise DeviceUnavailable(
+                f'backend init kept failing for {int(window)}s; '
+                f'last: {cell["err"]!r}')
+        time.sleep(min(interval, max(0.0, deadline - time.monotonic())))
+
+
 @contextlib.contextmanager
 def phase_deadline(seconds: int, what: str):
     """A wedged accelerator (e.g. a hung device program on the far side
@@ -112,19 +207,25 @@ def serve_metrics(on_tpu: bool) -> list:
           f'decode_wall={r["decode_tok_per_sec"]:,.0f} tok/s '
           f'decode_steady={r["decode_tok_per_sec_steady"]:,.0f} tok/s',
           file=sys.stderr)
+    # best_of records the selection policy (p50/p99 from the min-TTFT
+    # run, decode rates max'd across runs) so downstream comparisons to
+    # a single-run BASELINE measurement know these are best-of-N.
     return [
         {'metric': 'serve_p50_ttft_ms_llama1b_1chip',
          'value': round(r['p50_ttft_ms'], 1), 'unit': 'ms',
          'vs_baseline': round(BASELINE_TTFT_MS / max(r['p50_ttft_ms'],
-                                                     1e-3), 4)},
+                                                     1e-3), 4),
+         'best_of': len(runs)},
         {'metric': 'serve_decode_steady_tok_per_sec_per_chip',
          'value': round(r['decode_tok_per_sec_steady'], 1),
          'unit': 'tok/s/chip',
          'vs_baseline': round(r['decode_tok_per_sec_steady'] / 1000.0,
-                              4)},  # target: >=1,000 tok/s/chip (1B)
+                              4),  # target: >=1,000 tok/s/chip (1B)
+         'best_of': len(runs)},
         {'metric': 'serve_decode_wall_tok_per_sec_per_chip',
          'value': round(r['decode_tok_per_sec'], 1),
-         'unit': 'tok/s/chip', 'vs_baseline': None},
+         'unit': 'tok/s/chip', 'vs_baseline': None,
+         'best_of': len(runs)},
     ]
 
 
@@ -158,8 +259,11 @@ def serve_int8_metric(bf16_steady: float) -> list:
     return [
         {'metric': 'serve_decode_steady_tok_per_sec_per_chip_int8',
          'value': round(int8_steady, 1), 'unit': 'tok/s/chip',
-         'vs_baseline': round(int8_steady / max(bf16_steady, 1e-6),
-                              4)},  # speedup vs the bf16 engine
+         # speedup vs the bf16 engine; None when the bf16 phase
+         # produced no number (a ratio against a floor is nonsense)
+         'vs_baseline': (round(int8_steady / bf16_steady, 4)
+                         if bf16_steady > 0 else None),
+         'best_of': len(qruns)},
     ]
 
 
@@ -315,11 +419,37 @@ def main() -> None:
             'error': 'bench watchdog: device call never returned '
                      '(accelerator hung)'}), flush=True)
         os._exit(0)
-    killer = threading.Timer(2400, _die)
+    # Sized to cover the configurable init-retry window (plus stage-2
+    # join slack) so a raised SKYT_BENCH_INIT_RETRY_S is never truncated
+    # mid-probe by a watchdog that misdiagnoses "device call never
+    # returned"; the timer restarts at 2400s after acquisition.
+    init_window = float(os.environ.get('SKYT_BENCH_INIT_RETRY_S', '1200'))
+    killer = threading.Timer(max(2400, init_window + 300), _die)
     killer.daemon = True
     killer.start()
 
-    dev = jax.devices()[0]
+    # Backend init is a phase like any other: a dead tunnel must yield
+    # a null-JSON artifact with rc 0, never a bare traceback (the round-3
+    # failure mode).
+    try:
+        dev = _acquire_device()
+    except (Exception, DeviceUnavailable) as e:  # pylint: disable=broad-except
+        print(json.dumps({
+            'metric': partial['metric'], 'value': None, 'unit': 'MFU',
+            'vs_baseline': None, 'extra_metrics': [],
+            'error': f'backend init failed: {e!r}'}), flush=True)
+        # A stuck init thread may still hold jax's backend lock;
+        # interpreter shutdown (atexit) could block on it. Hard-exit —
+        # the JSON line above is the artifact.
+        sys.stdout.flush()
+        os._exit(0)
+    # Device acquisition may have consumed most of the watchdog's 40 min
+    # (retry window up to 20 min); restart the clock so the bench phases
+    # get their full budget.
+    killer.cancel()
+    killer = threading.Timer(2400, _die)
+    killer.daemon = True
+    killer.start()
     on_tpu = dev.platform == 'tpu'
 
     # Phases are independent: each failure is reported, neither is lost.
@@ -363,6 +493,9 @@ def main() -> None:
         'unit': 'MFU',
         'vs_baseline': (round(mfu / BASELINE_MFU, 4)
                         if mfu is not None else None),
+        # selection policy: TPU train MFU is the best of 4 timed windows
+        # (co-tenant tunnel load; see _run_train)
+        'best_of': 4 if on_tpu else 1,
         'extra_metrics': extra,
     }
     if train_err is not None:
