@@ -424,7 +424,12 @@ def main() -> None:
     # mid-probe by a watchdog that misdiagnoses "device call never
     # returned"; the timer restarts at 2400s after acquisition.
     init_window = float(os.environ.get('SKYT_BENCH_INIT_RETRY_S', '1200'))
-    killer = threading.Timer(max(2400, init_window + 300), _die)
+    init_probe_timeout = float(
+        os.environ.get('SKYT_BENCH_INIT_PROBE_TIMEOUT_S', '90'))
+    # Slack = one full probe that starts just before the window closes,
+    # plus the stage-2 join's 60s floor, plus margin.
+    killer = threading.Timer(
+        max(2400, init_window + init_probe_timeout + 180), _die)
     killer.daemon = True
     killer.start()
 

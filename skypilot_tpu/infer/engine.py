@@ -769,11 +769,13 @@ class InferenceEngine:
                 # buckets are page-rounded pieces, so the overflow
                 # cannot occur on this path and the cached prefix is
                 # kept.
-                if self._chunked is not None:
-                    # One chunked prefill at a time; keep FIFO order.
-                    self.pool.release(slot)
-                    self._deferred = req
-                    return False
+                # One chunked prefill at a time: the pre-reserve check
+                # above already deferred any long prompt while one is in
+                # progress (n - n_cached*psize > chunk implies
+                # n > chunk), and nothing between there and here can
+                # start one — this is all on the engine loop thread.
+                assert self._chunked is None, \
+                    'chunked prefill started between defer check and reserve'
                 self._slots[slot] = req
                 req.slot = slot
                 self._chunked = {'req': req, 'slot': slot, 'row': row,
@@ -870,7 +872,12 @@ class InferenceEngine:
         if self.spec_decode > 0:
             # Full prompt (not just a prefix-cached suffix) into the
             # device history for the n-gram proposer.
-            hb = self._bucket_for(n)
+            # Clamp the insert width to the history buffer: the pow2
+            # bucket for a near-max_seq_len prompt can exceed the
+            # buffer's max_seq_len + k + 2 width when max_seq_len is
+            # not a power of two (n <= max_seq_len < width always, so
+            # the clamped slice still holds the whole prompt).
+            hb = min(self._bucket_for(n), int(self._dev_hist.shape[1]))
             hist_toks = np.zeros((1, hb), np.int32)
             hist_toks[0, :n] = req.tokens
             with self._ctx():

@@ -238,3 +238,23 @@ def test_spec_max_seq_tail():
     assert out_p == out_s
     # Cut off by max_seq_len, not max_new.
     assert len(out_s[0]) < 64
+
+
+def test_spec_non_pow2_max_seq_hist_width():
+    """Regression: with a non-power-of-two max_seq_len, a long prompt's
+    pow2 admission bucket can exceed the history buffer's
+    max_seq_len + k + 2 width; the insert must clamp, not error out
+    (an unclamped dynamic_update_slice kills the engine loop thread and
+    every request hangs)."""
+    model, params = _model_and_params()
+    vocab = model.cfg.vocab_size
+    # width = 48 + 2 + 2 = 52; n=40 buckets to 64 > 52 without the clamp
+    prompt = _prompts(vocab, [40], seed=7)[0]
+    plain = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                       max_seq_len=48)
+    spec = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                      max_seq_len=48, spec_decode=2)
+    out_p = _run(plain, [prompt], max_new=8)
+    out_s = _run(spec, [prompt], max_new=8)
+    assert out_p == out_s
+    assert all(len(o) == 8 for o in out_s)
