@@ -24,7 +24,9 @@ from skypilot_tpu.callbacks.base import step_timer
 from skypilot_tpu.callbacks.base import summary_path
 from skypilot_tpu.callbacks.integrations import hf_trainer_callback
 from skypilot_tpu.callbacks.integrations import keras_callback
+from skypilot_tpu.callbacks.integrations import lightning_callback
 from skypilot_tpu.callbacks.integrations import wrap_steps
 
 __all__ = ['SkytCallback', 'step_timer', 'summary_path',
-           'hf_trainer_callback', 'keras_callback', 'wrap_steps']
+           'hf_trainer_callback', 'keras_callback', 'lightning_callback',
+           'wrap_steps']

@@ -27,7 +27,12 @@ def wrap_steps(iterable: Iterable[T],
 
     Timestamps one step per yielded item; flushes on exhaustion or
     break/exception. A `break` out of the loop counts the in-progress
-    step (its work finished before the break).
+    step (its work finished before the break). Caveat: a generator
+    cannot distinguish `break` from an exception raised in the
+    consumer's loop body — both arrive as GeneratorExit — so a step
+    that FAILED mid-body is also counted, slightly skewing $/step
+    timing toward the failure point. If exact accounting under
+    exceptions matters, call `cb.on_step_end()` yourself.
     """
     with base.step_timer(total_steps=total_steps,
                          benchmark_dir=benchmark_dir) as cb:
@@ -108,3 +113,68 @@ def keras_callback(benchmark_dir: Optional[str] = None):
                 self._cb = None
 
     return _SkytKerasCallback()
+
+
+def lightning_callback(benchmark_dir: Optional[str] = None,
+                       total_steps: Optional[int] = None):
+    """PyTorch Lightning adapter (reference:
+    sky_callback/integrations/pytorch_lightning.py analog):
+
+        trainer = pl.Trainer(..., callbacks=[
+            skyt_callback.lightning_callback()])
+
+    total_steps is inferred from `trainer.estimated_stepping_batches`
+    when not given; only global rank 0 records (one summary per run,
+    matching the reference). Lightning itself is optional: when neither
+    `lightning.pytorch` nor `pytorch_lightning` is importable the
+    adapter is a plain object exposing the same hook names, which
+    Lightning-compatible shims (and the unit tests) drive directly.
+    """
+    pl_base = object
+    try:
+        import lightning.pytorch as pl  # noqa: F401
+        pl_base = pl.Callback
+    except ImportError:
+        try:
+            import pytorch_lightning as pl  # noqa: F401
+            pl_base = pl.Callback
+        except ImportError:
+            pass
+
+    class _SkytLightningCallback(pl_base):
+        def __init__(self) -> None:
+            self._cb: Optional[base.SkytCallback] = None
+            self._dir = benchmark_dir
+            self._total = total_steps
+
+        def _infer_total_steps(self, trainer) -> Optional[int]:
+            if self._total is not None:
+                return self._total
+            total = getattr(trainer, 'estimated_stepping_batches', None)
+            if total is None or total == float('inf') or total < 0:
+                return None
+            return int(total)
+
+        def on_train_start(self, trainer, pl_module) -> None:
+            del pl_module
+            if getattr(trainer, 'global_rank', 0) != 0:
+                return
+            if self._cb is not None:   # retried fit(): no thread leak
+                self._cb.close()
+            self._cb = base.SkytCallback(
+                total_steps=self._infer_total_steps(trainer),
+                benchmark_dir=self._dir)
+
+        def on_train_batch_end(self, trainer, pl_module, outputs,
+                               batch, batch_idx) -> None:
+            del trainer, pl_module, outputs, batch, batch_idx
+            if self._cb is not None:
+                self._cb.on_step_end()
+
+        def on_train_end(self, trainer, pl_module) -> None:
+            del trainer, pl_module
+            if self._cb is not None:
+                self._cb.close()
+                self._cb = None
+
+    return _SkytLightningCallback()

@@ -8,6 +8,7 @@ GCS-first: gcsfuse is the one FUSE path that matters on TPU VMs (they are
 GCP VMs; buckets are GCS). ``local://`` stores "mount" via symlink — that
 is what makes MOUNT mode testable offline on the local provider.
 """
+import hashlib
 import shlex
 
 GCSFUSE_VERSION = '2.2.0'
@@ -53,6 +54,95 @@ def gcsfuse_mount_command(bucket: str, mount_path: str,
                  f'{shlex.quote(bucket)} {shlex.quote(mount_path)}')
     return _MOUNT_SCRIPT.format(mount_path=shlex.quote(mount_path),
                                 install_cmd=gcsfuse_install_command(),
+                                mount_cmd=mount_cmd)
+
+
+GOOFYS_VERSION = '0.24.0'
+BLOBFUSE2_VERSION = '2.2.0'
+
+
+def goofys_install_command() -> str:
+    """Install goofys if absent (reference mounts S3/R2 via goofys,
+    sky/data/mounting_utils.py:24-40). A static single binary — fetch
+    straight to /usr/local/bin."""
+    return (
+        'command -v goofys >/dev/null || '
+        f'(sudo curl -fsSL -o /usr/local/bin/goofys '
+        f'https://github.com/kahing/goofys/releases/download/'
+        f'v{GOOFYS_VERSION}/goofys && '
+        f'sudo chmod 755 /usr/local/bin/goofys)')
+
+
+def _fuse_allow_other_command() -> str:
+    """Unprivileged FUSE mounts may pass -o allow_other only when
+    /etc/fuse.conf enables user_allow_other (commented out on stock
+    Debian/Ubuntu); make it so before mounting."""
+    return ('grep -q "^user_allow_other" /etc/fuse.conf 2>/dev/null || '
+            'echo user_allow_other | sudo tee -a /etc/fuse.conf '
+            '>/dev/null')
+
+
+def goofys_mount_command(bucket: str, mount_path: str,
+                         endpoint: str = '') -> str:
+    """Idempotent goofys mount for any S3-compatible store.
+
+    One builder covers S3, R2, and IBM COS: the latter two only differ
+    by --endpoint (their stores already speak the S3 API — the same
+    design choice as their aws-CLI data paths). ``-o allow_other``
+    (enabled in /etc/fuse.conf by the install step) keeps the mount
+    readable by the job user regardless of which user ran setup.
+    """
+    ep = f'--endpoint {shlex.quote(endpoint)} ' if endpoint else ''
+    install_cmd = (f'{goofys_install_command()}\n'
+                   f'{_fuse_allow_other_command()}')
+    mount_cmd = (f'goofys -o allow_other --stat-cache-ttl 5s '
+                 f'--type-cache-ttl 5s {ep}'
+                 f'{shlex.quote(bucket)} {shlex.quote(mount_path)}')
+    return _MOUNT_SCRIPT.format(mount_path=shlex.quote(mount_path),
+                                install_cmd=install_cmd,
+                                mount_cmd=mount_cmd)
+
+
+def blobfuse2_install_command() -> str:
+    """Install blobfuse2 if absent (reference: blobfuse2 for Azure,
+    sky/data/mounting_utils.py:65+). Ubuntu/Debian path via Microsoft's
+    package repo — TPU-fleet hosts are Debian-family."""
+    return (
+        'command -v blobfuse2 >/dev/null || '
+        '(sudo curl -fsSL -o /tmp/packages-microsoft-prod.deb '
+        'https://packages.microsoft.com/config/ubuntu/22.04/'
+        'packages-microsoft-prod.deb && '
+        'sudo dpkg -i /tmp/packages-microsoft-prod.deb && '
+        'sudo apt-get update -qq && '
+        'sudo apt-get install -y -qq libfuse3-dev fuse3 blobfuse2)')
+
+
+def blobfuse2_mount_command(account: str, container: str,
+                            mount_path: str) -> str:
+    """Idempotent blobfuse2 mount of an Azure Blob container.
+
+    Auth rides the azure CLI login already required by the AZURE data
+    path (AZURE_STORAGE_AUTH_TYPE=azcli), so no key material is ever
+    written to disk; a tmp-path block cache keeps reads training-speed.
+    The cache dir is keyed by (account, container, mount_path): blobfuse2
+    refuses to share a tmp-path between active mounts, so mounting the
+    same container twice — or same-named containers from two accounts —
+    must not collide.
+    """
+    key = hashlib.sha256(
+        f'{account}\0{container}\0{mount_path}'.encode()).hexdigest()[:12]
+    cache_dir = f'/tmp/.blobfuse2-cache-{container}-{key}'
+    install_cmd = (f'{blobfuse2_install_command()}\n'
+                   f'{_fuse_allow_other_command()}')
+    mount_cmd = (f'mkdir -p {shlex.quote(cache_dir)} && '
+                 f'AZURE_STORAGE_ACCOUNT={shlex.quote(account)} '
+                 f'AZURE_STORAGE_AUTH_TYPE=azcli '
+                 f'blobfuse2 mount {shlex.quote(mount_path)} '
+                 f'--container-name {shlex.quote(container)} '
+                 f'--tmp-path {shlex.quote(cache_dir)} '
+                 f'--allow-other')
+    return _MOUNT_SCRIPT.format(mount_path=shlex.quote(mount_path),
+                                install_cmd=install_cmd,
                                 mount_cmd=mount_cmd)
 
 

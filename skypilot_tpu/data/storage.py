@@ -199,9 +199,8 @@ class S3Store(AbstractStore):
     """S3 bucket via the aws CLI (same tool-over-SDK choice as GcsStore's
     gsutil; the reference's S3Store is boto3, sky/data/storage.py:1080).
 
-    COPY mode is first-class (download_command); MOUNT needs a FUSE
-    binary (goofys) the TPU VM image does not ship — requesting it
-    raises with that explanation (reference mounts via goofys,
+    COPY mode batch-syncs via the aws CLI; MOUNT mode self-installs
+    goofys and FUSE-mounts the bucket (reference mounts via goofys,
     sky/data/mounting_utils.py:24).
     """
 
@@ -212,6 +211,10 @@ class S3Store(AbstractStore):
 
     def _endpoint_flags(self) -> List[str]:
         return []
+
+    def _mount_endpoint(self) -> str:
+        """S3-compatible subclasses (R2/COS) return their endpoint."""
+        return ''
 
     def _endpoint_str(self) -> str:
         return ' '.join(self._endpoint_flags())
@@ -263,10 +266,11 @@ class S3Store(AbstractStore):
              failure=f'Could not delete bucket {self.name!r}')
 
     def mount_command(self, mount_path: str) -> str:
-        raise exceptions.StorageError(
-            f'MOUNT mode is not supported for {self.store_type.value} '
-            f'stores yet (needs a goofys FUSE binary on the host); use '
-            f'mode: COPY.')
+        # One goofys builder covers S3 and the S3-compatible stores
+        # (R2/COS override _mount_endpoint, matching their aws-CLI
+        # data paths).
+        return mounting_utils.goofys_mount_command(
+            self.name, mount_path, endpoint=self._mount_endpoint())
 
     def download_command(self, target: str) -> str:
         ep = self._endpoint_str()
@@ -281,7 +285,8 @@ class AzureBlobStore(AbstractStore):
     gsutil/aws choice). The storage account comes from
     SKYT_AZURE_STORAGE_ACCOUNT; auth is whatever `az login` set up.
 
-    COPY-mode first like S3/R2: MOUNT needs blobfuse2 on the host.
+    MOUNT mode uses blobfuse2 with az-CLI auth (no key material on
+    disk); COPY mode batch-downloads via the az CLI.
     """
 
     store_type = StoreType.AZURE
@@ -360,9 +365,8 @@ class AzureBlobStore(AbstractStore):
              failure=f'Could not delete container {self.name!r}')
 
     def mount_command(self, mount_path: str) -> str:
-        raise exceptions.StorageError(
-            'MOUNT mode is not supported for AZURE stores yet (needs '
-            'blobfuse2 on the host); use mode: COPY.')
+        return mounting_utils.blobfuse2_mount_command(
+            self.account(), self.name, mount_path)
 
     def download_command(self, target: str) -> str:
         # --overwrite: re-running a COPY mount on an existing cluster
@@ -394,6 +398,9 @@ class R2Store(S3Store):
     def _endpoint_flags(self) -> List[str]:
         return ['--endpoint-url', self.endpoint()]
 
+    def _mount_endpoint(self) -> str:
+        return self.endpoint()
+
 
 class IbmCosStore(S3Store):
     """IBM Cloud Object Storage via its S3-compatible API (reference:
@@ -417,6 +424,9 @@ class IbmCosStore(S3Store):
 
     def _endpoint_flags(self) -> List[str]:
         return ['--endpoint-url', self.endpoint()]
+
+    def _mount_endpoint(self) -> str:
+        return self.endpoint()
 
 
 class LocalStore(AbstractStore):

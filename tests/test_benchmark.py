@@ -80,6 +80,39 @@ def test_hf_trainer_callback_adapter(tmp_path):
     assert s['num_steps'] == 3 and s['total_steps'] == 7
 
 
+def test_lightning_callback_adapter(tmp_path):
+    """PyTorch Lightning adapter (reference:
+    sky_callback/integrations/pytorch_lightning.py analog); Lightning is
+    not in the image, so the stub-Trainer path drives the same hooks the
+    real Trainer would."""
+    import types
+
+    cb = callbacks.lightning_callback(benchmark_dir=str(tmp_path))
+    trainer = types.SimpleNamespace(global_rank=0,
+                                    estimated_stepping_batches=9)
+    cb.on_train_start(trainer, None)
+    for i in range(4):
+        cb.on_train_batch_end(trainer, None, None, None, i)
+    cb.on_train_end(trainer, None)
+    with open(tmp_path / 'summary.json', encoding='utf-8') as f:
+        s = json.load(f)
+    assert s['num_steps'] == 4 and s['total_steps'] == 9
+
+
+def test_lightning_callback_nonzero_rank_records_nothing(tmp_path):
+    """Only global rank 0 writes a summary (one per run, matching the
+    reference); other ranks' hooks are no-ops."""
+    import types
+
+    cb = callbacks.lightning_callback(benchmark_dir=str(tmp_path))
+    trainer = types.SimpleNamespace(global_rank=1,
+                                    estimated_stepping_batches=9)
+    cb.on_train_start(trainer, None)
+    cb.on_train_batch_end(trainer, None, None, None, 0)
+    cb.on_train_end(trainer, None)
+    assert not (tmp_path / 'summary.json').exists()
+
+
 def test_interpolation():
     summary = {'boot_time': 100.0, 'num_steps': 10, 'total_steps': 110,
                'first_step_time': 101.0, 'last_step_time': 120.0,

@@ -131,8 +131,9 @@ class TestS3Store:
                 ).read_text() == 'B'
         cmd = store.download_command('/data')
         assert 'aws s3 sync s3://unit-bkt /data' in cmd
-        with pytest.raises(exceptions.StorageError):
-            store.mount_command('/mnt')   # FUSE not supported yet
+        mnt = store.mount_command('/mnt')
+        assert 'goofys' in mnt and 'unit-bkt /mnt' in mnt
+        assert '--endpoint' not in mnt   # plain S3: default endpoint
         st.delete()
         assert not (fake_clouds['s3'] / 'unit-bkt').exists()
 
@@ -178,6 +179,16 @@ class TestR2Store:
                    for c in aws_calls), aws_calls
 
 
+    def test_r2_mount_command_carries_endpoint(self, fake_clouds,
+                                               monkeypatch):
+        monkeypatch.setenv('SKYT_R2_ENDPOINT',
+                           'https://acct.r2.cloudflarestorage.com')
+        store = storage.R2Store('r2-bkt', None)
+        mnt = store.mount_command('/mnt')
+        assert 'goofys' in mnt and 'r2-bkt /mnt' in mnt
+        assert '--endpoint https://acct.r2.cloudflarestorage.com' in mnt
+
+
 class TestIbmCosStore:
     """IBM COS rides the same S3-compatible endpoint path as R2.
     Reference parity: sky/data/storage.py:3116 (IBMCosStore)."""
@@ -206,6 +217,17 @@ class TestIbmCosStore:
         assert all('--endpoint-url https://s3.us-south.'
                    'cloud-object-storage.appdomain.cloud' in c
                    for c in aws_calls), aws_calls
+
+    def test_cos_mount_command_carries_endpoint(self, fake_clouds,
+                                                monkeypatch):
+        monkeypatch.setenv(
+            'SKYT_COS_ENDPOINT',
+            'https://s3.us-south.cloud-object-storage.appdomain.cloud')
+        store = storage.IbmCosStore('cos-bkt', None)
+        mnt = store.mount_command('/mnt')
+        assert 'goofys' in mnt and 'cos-bkt /mnt' in mnt
+        assert ('--endpoint https://s3.us-south.'
+                'cloud-object-storage.appdomain.cloud') in mnt
 
     def test_scheme_selects_store(self, fake_clouds):
         st = storage.Storage(source='cos://somewhere')
@@ -349,8 +371,10 @@ class TestAzureStore:
         cmd = store.download_command('/data')
         assert 'az storage blob download-batch' in cmd
         assert '--overwrite' in cmd
-        with pytest.raises(exceptions.StorageError):
-            store.mount_command('/mnt')
+        mnt = store.mount_command('/mnt')
+        assert 'blobfuse2 mount /mnt' in mnt
+        assert '--container-name az-bkt' in mnt
+        assert 'AZURE_STORAGE_AUTH_TYPE=azcli' in mnt
         st.delete()
         assert not (fake_azure / 'az-bkt').exists()
 
