@@ -26,6 +26,26 @@ class ServeBenchConfig:
     max_seq_len: int = 1024
     decode_chunk: int = 16
     tp: int = 1
+    # >0 turns on n-gram speculative decoding (k drafts/verify step).
+    spec_decode: int = 0
+    # 'random': i.i.d. token prompts — the throughput workload, but
+    # adversarial for prompt-lookup (no n-gram ever repeats).
+    # 'doc': document-grounded prompts with internal phrase repetition
+    # (the summarize/RAG shape prompt-lookup exists for).
+    workload: str = 'random'
+
+
+def doc_prompt(rng, vocab: int, prompt_len: int) -> List[int]:
+    """A "document" built from a handful of phrases tiled to length:
+    real long-prompt workloads (summarization, RAG, code) repeat
+    n-grams, which is exactly the structure the prompt-lookup proposer
+    drafts from. Module-level so tests exercise the same generator the
+    bench runs."""
+    phrases = [rng.integers(1, vocab, 8).tolist() for _ in range(4)]
+    toks: List[int] = []
+    while len(toks) < prompt_len:
+        toks += phrases[int(rng.integers(0, len(phrases)))]
+    return toks[:prompt_len]
 
 
 def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
@@ -44,17 +64,26 @@ def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
     from skypilot_tpu.infer import server as server_lib
 
     cfg = cfg or ServeBenchConfig()
+    if cfg.workload not in ('random', 'doc'):
+        # Before any engine spins up: a typo'd workload must fail loud,
+        # not silently bench the random workload (~0 spec acceptance
+        # that looks like a real regression).
+        raise ValueError(f'unknown workload {cfg.workload!r}; '
+                         f"expected 'random' or 'doc'")
     own_engine = engine is None
     if own_engine:
         engine = server_lib.build_engine(
             cfg.model, cfg.num_slots, cfg.max_seq_len,
-            tp=cfg.tp, decode_chunk=cfg.decode_chunk)
+            tp=cfg.tp, decode_chunk=cfg.decode_chunk,
+            spec_decode=cfg.spec_decode)
         engine.start()
 
     rng = np.random.default_rng(0)
     vocab = engine.cfg.vocab_size
 
     def one_prompt() -> List[int]:
+        if cfg.workload == 'doc':
+            return doc_prompt(rng, vocab, cfg.prompt_len)
         return rng.integers(1, vocab, cfg.prompt_len).tolist()
 
     def drain(pairs):
@@ -117,4 +146,8 @@ def run_serve_bench(cfg: Optional[ServeBenchConfig] = None,
         'decode_tok_per_sec_steady': perf['steady_decode_tok_per_sec'],
         'requests_per_sec': cfg.num_requests / t_total,
         'total_time_s': t_total,
+        # Speculation accounting (0s when the engine has spec off):
+        # accept rate = extra tokens gained per verify step.
+        'spec_verify_steps': perf.get('spec_verify_steps', 0),
+        'spec_accept_per_step': perf.get('spec_accept_per_step', 0.0),
     }
