@@ -200,6 +200,7 @@ def _best_of_serve_runs(scfg, n: int = 2, **engine_kwargs) -> list:
                                      scfg.max_seq_len, tp=scfg.tp,
                                      decode_chunk=scfg.decode_chunk,
                                      prefix_caching=False,
+                                     spec_decode=scfg.spec_decode,
                                      **engine_kwargs)
     engine.start()
     try:
@@ -279,7 +280,7 @@ def serve_spec_metric(on_tpu: bool) -> list:
     for k in (0, 4):
         mk = _tpu_serve_cfg if on_tpu else _cpu_serve_cfg
         scfg = mk(workload='doc', spec_decode=k)
-        runs = _best_of_serve_runs(scfg, spec_decode=k)
+        runs = _best_of_serve_runs(scfg)
         # Wall rate over the whole burst: well-defined for both engines
         # on the identical workload (the steady accumulator needs
         # admission-free pull intervals, which short spec runs may
@@ -490,11 +491,14 @@ def main() -> None:
         # the JSON line above is the artifact.
         sys.stdout.flush()
         os._exit(0)
-    # Device acquisition may have consumed most of the watchdog's 40 min
+    # Device acquisition may have consumed most of the watchdog's budget
     # (retry window up to 20 min); restart the clock so the bench phases
-    # get their full budget.
+    # get their full budget. 3600s ~= the sum of all phase deadlines
+    # (train 1200 + serve 900 + int8 600 + spec 1200): the watchdog only
+    # fires when a phase hangs in a C call its own SIGALRM deadline
+    # cannot interrupt.
     killer.cancel()
-    killer = threading.Timer(2400, _die)
+    killer = threading.Timer(3600, _die)
     killer.daemon = True
     killer.start()
     on_tpu = dev.platform == 'tpu'
@@ -535,9 +539,11 @@ def main() -> None:
             print(f'# serve int8 bench failed: {e!r}', file=sys.stderr)
 
     # Spec-decode pass (doc workload): runs on CPU too — tiny shapes —
-    # so smoke environments validate the full metric set.
+    # so smoke environments validate the full metric set. Deadline
+    # covers TWO engine compiles + 4 passes (double the bf16 serve
+    # phase's work — sized accordingly).
     try:
-        with phase_deadline(600, 'serve spec-decode bench'):
+        with phase_deadline(1200, 'serve spec-decode bench'):
             extra = extra + serve_spec_metric(on_tpu)
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except

@@ -1,12 +1,32 @@
 #!/usr/bin/env bash
-# Lint + syntax gate (reference: format.sh running black/isort/mypy/
-# pylint). The image ships none of those, so this runs the offline
-# equivalents: compileall (syntax across the tree) + tools/lint.py
-# (unused imports, whitespace, line length).
+# Lint + syntax + test gate (reference: format.sh running black/isort/
+# mypy/pylint + the unit/smoke test split, SURVEY §4). The image ships
+# none of those linters, so this runs the offline equivalents:
+# compileall (syntax across the tree) + tools/lint.py (unused imports,
+# whitespace, line length).
+#
+# Test tiers:
+#   ./format.sh         fast tier: lint + non-heavy unit tests (<2 min)
+#                       + the on-TPU lowering gate (auto-skips off-TPU)
+#   ./format.sh --full  everything: adds the compile-heavy JAX suites
+#                       and subprocess integration tests (~30 min on the
+#                       1-core host) — run before snapshots/releases.
 set -e
 cd "$(dirname "$0")"
+
+FULL=0
+ARGS=()
+for a in "$@"; do
+  if [ "$a" = "--full" ]; then FULL=1; else ARGS+=("$a"); fi
+done
+
 python -m compileall -q skypilot_tpu tests tests_tpu tools bench.py __graft_entry__.py
-python tools/lint.py "$@"
+python tools/lint.py "${ARGS[@]}"
+if [ "$FULL" = "1" ]; then
+  python -m pytest tests/ -q
+else
+  python -m pytest tests/ -q -m "not heavy and not integration"
+fi
 # On-TPU lowering gate (auto-skips on CPU-only machines): Mosaic must
 # accept the Pallas kernels — interpret-mode CPU tests cannot catch a
 # BlockSpec the real compiler rejects (VERDICT r2, Weak #2).

@@ -40,3 +40,6 @@ def tmp_state_dir(tmp_path, monkeypatch):
 def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'integration: spawns real agent/controller subprocesses')
+    config.addinivalue_line(
+        'markers', 'heavy: compile-heavy JAX suites / long subprocess '
+        'suites excluded from the fast tier (see format.sh)')

@@ -15,6 +15,10 @@ from skypilot_tpu import state
 from skypilot_tpu.benchmark import benchmark_state
 from skypilot_tpu.benchmark import benchmark_utils
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+# Most tests here are fast pure-python; only the engine-building / subprocess
+# ones are marked heavy individually.
+
 
 def test_callback_summary(tmp_path):
     cb = callbacks.SkytCallback(total_steps=10,
@@ -113,6 +117,7 @@ def test_lightning_callback_nonzero_rank_records_nothing(tmp_path):
     assert not (tmp_path / 'summary.json').exists()
 
 
+@pytest.mark.heavy
 def test_serve_bench_doc_workload_spec_decode(tmp_path):
     """Doc-grounded workload + spec decode: the bench must report
     speculation accounting (verify steps ran; acceptance measured).
@@ -194,6 +199,7 @@ _BENCH_RUN = (
 
 
 @pytest.mark.integration
+@pytest.mark.heavy
 def test_benchmark_end_to_end(bench_env):
     t = sky.Task(name='bt', run=_BENCH_RUN)
     t.set_resources(resources_lib.Resources(cloud='local'))

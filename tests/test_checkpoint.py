@@ -6,6 +6,9 @@ import pytest
 
 from skypilot_tpu.train import sft
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 def test_parse_mesh_explicit():
     spec = sft.parse_mesh('fsdp=4,tp=2', 8)

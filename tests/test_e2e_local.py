@@ -17,6 +17,9 @@ from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import state
 from skypilot_tpu.cli import cli
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 @pytest.fixture()
 def local_env(tmp_path, monkeypatch):

@@ -12,6 +12,9 @@ import requests
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.models import llama
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 @pytest.fixture(scope='module')
 def small_model():

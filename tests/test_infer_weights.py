@@ -19,6 +19,9 @@ from skypilot_tpu.infer import tokenizer as tokenizer_lib
 from skypilot_tpu.models import llama, weights
 from skypilot_tpu.parallel import mesh as mesh_lib
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 @pytest.fixture(scope='module')
 def debug_ckpt(tmp_path_factory):

@@ -11,6 +11,9 @@ from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.train import lora as lora_lib
 from skypilot_tpu.train import trainer
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 @pytest.fixture(scope='module')
 def base():

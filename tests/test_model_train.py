@@ -9,6 +9,9 @@ from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.train import trainer
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 @pytest.fixture(scope='module')
 def debug_setup():

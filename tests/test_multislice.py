@@ -8,6 +8,9 @@ import pytest
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.runtime import gang
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 class TestHybridMesh:
     def test_axis_sizes_multiply(self):

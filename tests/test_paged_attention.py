@@ -1,12 +1,16 @@
 """Pallas paged-attention kernel vs the gather-based XLA reference
 (interpret mode on CPU; tests_tpu/ compiles it on the chip)."""
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
 from skypilot_tpu.infer.paged_cache import PagePool
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops import paged_attention
+
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
 
 
 def _setup(slots=3, hq=4, hkv=2, d=64, n_pages=9, p=16, mp=4, seed=0):

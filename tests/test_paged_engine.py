@@ -12,6 +12,9 @@ import jax.numpy as jnp
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.models import llama
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 def _model_and_params(scan_layers=True):
     cfg = dataclasses.replace(llama.CONFIGS['debug'],

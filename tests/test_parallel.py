@@ -11,6 +11,9 @@ from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.parallel import ring_attention
 from skypilot_tpu.train import trainer
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 def _qkv(b=2, s=64, hq=4, hkv=2, d=16, seed=0):
     rng = np.random.default_rng(seed)

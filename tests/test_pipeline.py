@@ -9,6 +9,9 @@ from skypilot_tpu.parallel import collectives
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.parallel import pipeline
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 
 def _mesh(pp):
     spec = mesh_lib.MeshSpec(pp=pp)

@@ -6,6 +6,7 @@ through vLLM, which supplies w8a16); here it is a first-class model
 transform (models/quant.py + QuantDense).
 """
 import dataclasses
+import pytest
 
 import numpy as np
 
@@ -13,6 +14,9 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.models import llama, quant
+
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
 
 
 def _float_model(**over):

@@ -19,6 +19,9 @@ from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 
+# Compile-heavy (JAX jit on the 1-core CPU host) or subprocess-driven:
+pytestmark = pytest.mark.heavy
+
 REPLICA_SERVER = (
     "python -c \""
     "import http.server, os, json;\n"

@@ -19,6 +19,9 @@ import pytest
 from skypilot_tpu import exceptions
 from skypilot_tpu.data import data_transfer, storage
 
+# Subprocess-driven (fake cloud CLIs): excluded from the fast tier.
+pytestmark = pytest.mark.heavy
+
 FAKE_CLI = textwrap.dedent('''\
     #!/usr/bin/env python3
     """Fake `aws`/`gsutil`: local-dir object stores + invocation log."""
