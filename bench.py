@@ -34,6 +34,16 @@ import jax.numpy as jnp
 BASELINE_MFU = 0.45
 BASELINE_TTFT_MS = 500.0  # BASELINE.json: 70B serve p50 TTFT < 500ms
 
+# Per-phase SIGALRM deadlines (seconds). The post-acquisition watchdog
+# is derived from their sum, so adding/retuning a phase cannot starve a
+# later one.
+PHASE_DEADLINES = {
+    'train bench': 1200,
+    'serve bench': 900,
+    'serve int8 bench': 600,
+    'serve spec-decode bench': 1200,
+}
+
 
 class PhaseTimeout(Exception):
     pass
@@ -493,12 +503,11 @@ def main() -> None:
         os._exit(0)
     # Device acquisition may have consumed most of the watchdog's budget
     # (retry window up to 20 min); restart the clock so the bench phases
-    # get their full budget. 3600s ~= the sum of all phase deadlines
-    # (train 1200 + serve 900 + int8 600 + spec 1200): the watchdog only
-    # fires when a phase hangs in a C call its own SIGALRM deadline
+    # get their full budget: sum of phase deadlines + slack. The watchdog
+    # only fires when a phase hangs in a C call its own SIGALRM deadline
     # cannot interrupt.
     killer.cancel()
-    killer = threading.Timer(3600, _die)
+    killer = threading.Timer(sum(PHASE_DEADLINES.values()) + 300, _die)
     killer.daemon = True
     killer.start()
     on_tpu = dev.platform == 'tpu'
@@ -508,7 +517,7 @@ def main() -> None:
     metric_name = 'train_mfu_llama1b_1chip'
     train_err = None
     try:
-        with phase_deadline(1200, 'train bench'):
+        with phase_deadline(PHASE_DEADLINES['train bench'], 'train bench'):
             mfu, metric_name = train_mfu(dev, on_tpu)
         partial['mfu'] = mfu
         partial['metric'] = metric_name
@@ -517,7 +526,7 @@ def main() -> None:
         print(f'# train bench failed: {e!r}', file=sys.stderr)
 
     try:
-        with phase_deadline(900, 'serve bench'):
+        with phase_deadline(PHASE_DEADLINES['serve bench'], 'serve bench'):
             extra = serve_metrics(on_tpu)
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
@@ -532,7 +541,8 @@ def main() -> None:
              if m['metric'] == 'serve_decode_steady_tok_per_sec_per_chip'),
             0.0)
         try:
-            with phase_deadline(600, 'serve int8 bench'):
+            with phase_deadline(PHASE_DEADLINES['serve int8 bench'],
+                                'serve int8 bench'):
                 extra = extra + serve_int8_metric(bf16_steady)
             partial['extra'] = extra
         except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
@@ -543,7 +553,8 @@ def main() -> None:
     # covers TWO engine compiles + 4 passes (double the bf16 serve
     # phase's work — sized accordingly).
     try:
-        with phase_deadline(1200, 'serve spec-decode bench'):
+        with phase_deadline(PHASE_DEADLINES['serve spec-decode bench'],
+                            'serve spec-decode bench'):
             extra = extra + serve_spec_metric(on_tpu)
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except

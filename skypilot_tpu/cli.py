@@ -382,15 +382,40 @@ def jobs():
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch(entrypoint, name, workdir, cloud, accelerators, num_nodes,
                 use_spot, envs, retry_until_up, detach_run, yes):
-    """Launch a managed job. Reference: sky jobs launch (cli.py:3500)."""
+    """Launch a managed job (single task, or a multi-document pipeline
+    YAML run as a chain DAG). Reference: sky jobs launch (cli.py:3500)."""
+    from skypilot_tpu import dag as dag_lib
     from skypilot_tpu.jobs import core as jobs_core
-    task = _load_task(entrypoint, name=name, workdir=workdir, cloud=cloud,
-                      accelerators=accelerators, num_nodes=num_nodes,
-                      use_spot=use_spot, envs=envs)
+    task = None
+    if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(
+            entrypoint):
+        env_overrides = dict(e.split('=', 1) for e in envs) if envs \
+            else None
+        task = dag_lib.maybe_load_pipeline(entrypoint, env_overrides)
+    if task is not None:
+        # Per-task resource overrides are ambiguous across a pipeline's
+        # stages — fail loud instead of silently dropping them.
+        dropped = [f for f, v in [('--workdir', workdir),
+                                  ('--cloud', cloud),
+                                  ('--accelerators', accelerators),
+                                  ('--num-nodes', num_nodes),
+                                  ('--use-spot', use_spot)]
+                   if v is not None]
+        if dropped:
+            raise click.UsageError(
+                f'{", ".join(dropped)} cannot override a multi-stage '
+                f'pipeline YAML; set per-stage values in the YAML.')
+    else:
+        task = _load_task(entrypoint, name=name, workdir=workdir,
+                          cloud=cloud, accelerators=accelerators,
+                          num_nodes=num_nodes, use_spot=use_spot,
+                          envs=envs)
+    label = name or task.name or '?'
     if not yes:
-        click.confirm(f'Launch managed job {name or task.name or "?"!r}?',
+        click.confirm(f'Launch managed job {label!r}?',
                       default=True, abort=True)
-    job_id = jobs_core.launch(task, name, retry_until_up=retry_until_up,
+    job_id = jobs_core.launch(task, name or task.name,
+                              retry_until_up=retry_until_up,
                               detach=detach_run)
     click.echo(f'Managed job {job_id} submitted.')
 

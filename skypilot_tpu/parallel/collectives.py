@@ -9,10 +9,17 @@ Run standalone on any host (real TPU slice or CPU mesh):
     python -m skypilot_tpu.parallel.collectives --axis tp --mb 64
 """
 import argparse
+import os
 import time
 from typing import Dict, List, Optional
 
 import jax
+
+# Entry-point platform pin: the image's axon TPU plugin wins over the
+# JAX_PLATFORMS env var unless the config is set before first backend
+# use (same preamble as bench.py / infer/server.py).
+if os.environ.get('JAX_PLATFORMS'):
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -195,6 +195,35 @@ def test_managed_job_chain_dag(jobs_env):
     assert job['num_tasks'] == 2
 
 
+def test_managed_job_pipeline_yaml_e2e(jobs_env, tmp_path):
+    """The examples/pipeline.yaml FORMAT run end-to-end: multi-doc YAML
+    -> chain Dag -> jobs controller executes both stages in order."""
+    out = tmp_path / 'order.txt'
+    yml = tmp_path / 'pipe.yaml'
+    yml.write_text(f"""\
+name: yaml-pipe
+---
+name: stage-prep
+resources:
+  cloud: local
+run: echo prep >> {out}
+---
+name: stage-train
+resources:
+  cloud: local
+run: echo train >> {out}
+""")
+    from skypilot_tpu import dag as dag_lib
+    assert dag_lib.yaml_is_pipeline(str(yml))
+    dag = dag_lib.load_chain_dag_from_yaml(str(yml))
+    jid = jobs_core.launch(dag, name='yaml-pipe', retry_until_up=False)
+    job = jobs_core.wait(jid, timeout=150)
+    assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    assert job['num_tasks'] == 2
+    # Both stages ran, in chain order.
+    assert out.read_text().split() == ['prep', 'train']
+
+
 def test_queue_reconciles_dead_controller(jobs_env):
     t = _local_task('mj-dead', 'sleep 300')
     jid = jobs_core.launch(t, retry_until_up=False)

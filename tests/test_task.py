@@ -205,3 +205,56 @@ class TestReviewRegressions:
                         base_ondemand_fallback_replicas=2)
         s2 = ServiceSpec.from_yaml_config(s.to_yaml_config())
         assert s2 == s
+
+
+class TestPipelineYaml:
+    """Multi-document pipeline YAML -> chain Dag (reference:
+    sky/utils/dag_utils.py load_chain_dag_from_yaml)."""
+
+    def test_load_example_pipeline(self):
+        import os
+        from skypilot_tpu import dag as dag_lib
+        path = os.path.join(os.path.dirname(__file__), '..', 'examples',
+                            'pipeline.yaml')
+        dag = dag_lib.load_chain_dag_from_yaml(path)
+        assert dag.name == 'tokenize-then-train'
+        assert len(dag) == 2
+        assert dag.is_chain()
+        names = [t.name for t in dag.get_sorted_tasks()]
+        assert names == ['tokenize', 'train']
+
+    def test_yaml_is_pipeline(self, tmp_path):
+        from skypilot_tpu import dag as dag_lib
+        single = tmp_path / 'single.yaml'
+        single.write_text('name: solo\nrun: echo hi\n')
+        assert not dag_lib.yaml_is_pipeline(str(single))
+        multi = tmp_path / 'multi.yaml'
+        multi.write_text('name: pipe\n---\nname: a\nrun: echo a\n'
+                         '---\nname: b\nrun: echo b\n')
+        assert dag_lib.yaml_is_pipeline(str(multi))
+
+    def test_empty_pipeline_raises(self, tmp_path):
+        import pytest as _pytest
+        from skypilot_tpu import dag as dag_lib
+        p = tmp_path / 'empty.yaml'
+        p.write_text('name: nothing\n')
+        with _pytest.raises(ValueError, match='no task documents'):
+            dag_lib.load_chain_dag_from_yaml(str(p))
+
+
+def test_all_example_yamls_load():
+    """Every recipe in examples/ parses through the real loaders:
+    single-doc YAMLs as Tasks, multi-doc as chain Dags."""
+    import glob
+    import os
+    from skypilot_tpu import dag as dag_lib
+    ex_dir = os.path.join(os.path.dirname(__file__), '..', 'examples')
+    paths = sorted(glob.glob(os.path.join(ex_dir, '*.yaml')))
+    assert len(paths) >= 7
+    for p in paths:
+        if dag_lib.yaml_is_pipeline(p):
+            dag = dag_lib.load_chain_dag_from_yaml(p)
+            assert len(dag) >= 2 and dag.is_chain()
+        else:
+            t = Task.from_yaml(p)
+            assert t.run
