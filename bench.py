@@ -42,6 +42,7 @@ PHASE_DEADLINES = {
     'serve bench': 900,
     'serve int8 bench': 600,
     'serve spec-decode bench': 1200,
+    'serve 8b int8 bench': 900,
 }
 
 
@@ -320,6 +321,36 @@ def serve_spec_metric(on_tpu: bool) -> list:
     ]
 
 
+def serve_8b_int8_metric() -> list:
+    """TRUE Llama-3.1-8B-shaped serving, int8 weight-only, ONE chip.
+
+    8B int8 weights (~8.5GB) fit a single 16GB v5e — the first real
+    step from the 1B proxy toward BASELINE.md's 70B serve row, runnable
+    on the hardware that exists. Reduced slots (4 x 2048 paged) keep
+    the KV pool ~1GB. Engine init fuses init+quantize in one jit so the
+    bf16 tree is never fully resident (infer/server.py).
+    """
+    scfg = _tpu_serve_cfg(model='llama3-8b', num_slots=4,
+                          max_seq_len=2048, prompt_len=512,
+                          max_new_tokens=32, num_requests=8)
+    runs = _best_of_serve_runs(scfg, quantize='int8')
+    r = min(runs, key=lambda x: x['p50_ttft_ms'])
+    steady = max(x['decode_tok_per_sec_steady'] for x in runs)
+    print(f'# serve 8b int8: p50_ttft={r["p50_ttft_ms"]:.1f}ms '
+          f'decode_steady={steady:,.0f} tok/s', file=sys.stderr)
+    return [
+        {'metric': 'serve_p50_ttft_ms_8b_int8_1chip',
+         'value': round(r['p50_ttft_ms'], 1), 'unit': 'ms',
+         # BASELINE.md 70B serve row: p50 TTFT < 500ms (here 8B/1chip)
+         'vs_baseline': round(BASELINE_TTFT_MS /
+                              max(r['p50_ttft_ms'], 1e-3), 4),
+         'best_of': len(runs)},
+        {'metric': 'serve_decode_steady_tok_per_sec_8b_int8_1chip',
+         'value': round(steady, 1), 'unit': 'tok/s/chip',
+         'vs_baseline': None, 'best_of': len(runs)},
+    ]
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -454,7 +485,8 @@ def main() -> None:
     # Last-resort watchdog: SIGALRM cannot interrupt a hang inside a
     # blocking C call (a wedged device program never returns to the
     # bytecode loop), so a timer THREAD emits the JSON line and exits
-    # the process. 40 min >> any healthy full bench (~3 min). It reads
+    # the process (healthy full bench ~3 min; budget covers the worst
+    # case of every phase at its deadline). It reads
     # the phases' results from this shared cell so a completed train
     # number survives a serve-phase hang.
     partial = {'mfu': None, 'extra': [],
@@ -475,14 +507,16 @@ def main() -> None:
     # Sized to cover the configurable init-retry window (plus stage-2
     # join slack) so a raised SKYT_BENCH_INIT_RETRY_S is never truncated
     # mid-probe by a watchdog that misdiagnoses "device call never
-    # returned"; the timer restarts at 2400s after acquisition.
+    # returned"; the timer restarts after acquisition at
+    # sum(PHASE_DEADLINES) + slack.
     init_window = float(os.environ.get('SKYT_BENCH_INIT_RETRY_S', '1200'))
     init_probe_timeout = float(
         os.environ.get('SKYT_BENCH_INIT_PROBE_TIMEOUT_S', '90'))
     # Slack = one full probe that starts just before the window closes,
     # plus the stage-2 join's 60s floor, plus margin.
     killer = threading.Timer(
-        max(2400, init_window + init_probe_timeout + 180), _die)
+        max(sum(PHASE_DEADLINES.values()) + 300,
+            init_window + init_probe_timeout + 180), _die)
     killer.daemon = True
     killer.start()
 
@@ -547,6 +581,17 @@ def main() -> None:
             partial['extra'] = extra
         except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
             print(f'# serve int8 bench failed: {e!r}', file=sys.stderr)
+
+    if on_tpu:
+        # 8B int8 single-chip pass (TPU only: an 8B model on the 1-core
+        # CPU host would blow the phase budget and the RAM).
+        try:
+            with phase_deadline(PHASE_DEADLINES['serve 8b int8 bench'],
+                                'serve 8b int8 bench'):
+                extra = extra + serve_8b_int8_metric()
+            partial['extra'] = extra
+        except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+            print(f'# serve 8b int8 bench failed: {e!r}', file=sys.stderr)
 
     # Spec-decode pass (doc workload): runs on CPU too — tiny shapes —
     # so smoke environments validate the full metric set. Deadline

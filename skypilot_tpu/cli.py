@@ -37,10 +37,16 @@ def _load_task(entrypoint: str, *, name: Optional[str] = None,
                envs: Optional[List[str]] = None):
     """YAML path or inline command → Task, with CLI overrides (reference:
     _make_task_or_dag_from_entrypoint_with_overrides, sky/cli.py:696)."""
+    from skypilot_tpu import dag as dag_lib
     from skypilot_tpu import resources as resources_lib
     from skypilot_tpu import task as task_lib
     if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(
             entrypoint):
+        if dag_lib.yaml_is_pipeline(entrypoint):
+            raise click.UsageError(
+                f'{entrypoint} is a multi-document pipeline YAML; '
+                f'pipelines run as managed jobs: '
+                f'`skyt jobs launch {entrypoint}`.')
         task = task_lib.Task.from_yaml(entrypoint)
     else:
         task = task_lib.Task(run=entrypoint)
@@ -61,8 +67,20 @@ def _load_task(entrypoint: str, *, name: Optional[str] = None,
         base = list(task.resources) or [resources_lib.Resources()]
         task.set_resources({r.copy(**override) for r in base})
     if envs:
-        task.update_envs(dict(e.split('=', 1) for e in envs))
+        task.update_envs(_parse_envs(envs))
     return task
+
+
+def _parse_envs(envs: 'List[str]') -> 'Dict[str, str]':
+    """--env KEY=VAL pairs -> dict, with a usable error on bad shapes."""
+    out: Dict[str, str] = {}
+    for e in envs:
+        if '=' not in e:
+            raise click.UsageError(
+                f'--env expects KEY=VAL, got {e!r}')
+        k, v = e.split('=', 1)
+        out[k] = v
+    return out
 
 
 @click.group()
@@ -389,8 +407,7 @@ def jobs_launch(entrypoint, name, workdir, cloud, accelerators, num_nodes,
     task = None
     if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(
             entrypoint):
-        env_overrides = dict(e.split('=', 1) for e in envs) if envs \
-            else None
+        env_overrides = _parse_envs(envs) if envs else None
         task = dag_lib.maybe_load_pipeline(entrypoint, env_overrides)
     if task is not None:
         # Per-task resource overrides are ambiguous across a pipeline's

@@ -387,6 +387,7 @@ def build_engine(model_name: Optional[str] = None,
         from skypilot_tpu.parallel import mesh as mesh_lib
         mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=tp))
 
+    already_quantized = False
     if checkpoint:
         from skypilot_tpu.models import weights as weights_lib
         cfg = weights_lib.load_config(
@@ -417,7 +418,18 @@ def build_engine(model_name: Optional[str] = None,
                           max_seq_len=min(cfg.max_seq_len, max_seq_len))
         model = make_model(cfg)
         sample = jnp.zeros((1, 8), jnp.int32)
-        params = jax.jit(model.init)(jax.random.PRNGKey(0), sample)
+        if quantize == 'int8' and mesh is None:
+            # Fused init+quantize inside ONE jit: XLA frees each bf16
+            # kernel right after its int8 copy is formed, so the full
+            # bf16 tree (2x the int8 bytes) is never resident at once —
+            # this is what lets an ~8B model initialize on a single
+            # 16GB v5e chip (weights ~8.5GB int8 vs ~16GB bf16).
+            from skypilot_tpu.models import quant as quant_lib
+            params = jax.jit(lambda k: quant_lib.quantize_params(
+                model.init(k, sample)))(jax.random.PRNGKey(0))
+            already_quantized = True
+        else:
+            params = jax.jit(model.init)(jax.random.PRNGKey(0), sample)
         if mesh is not None:
             from skypilot_tpu.models import weights as weights_lib
             params = weights_lib.shard_params(params, model, cfg, mesh)
@@ -426,7 +438,8 @@ def build_engine(model_name: Optional[str] = None,
         # streams (models/quant.py). Covers llama projections AND MoE
         # expert weights (routers stay float).
         from skypilot_tpu.models import quant as quant_lib
-        params = quant_lib.quantize_params(params)
+        if not already_quantized:
+            params = quant_lib.quantize_params(params)
         cfg = _dc.replace(cfg, quant='int8')
         model = make_model(cfg)
     elif quantize != 'none':

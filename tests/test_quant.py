@@ -173,3 +173,31 @@ def test_quantized_moe_engine_serves():
         assert len(out) == 4
     finally:
         eng.stop()
+
+
+def test_fused_init_quantize_matches_sequential():
+    """build_engine's fused init+quantize (one jit, so the full bf16
+    tree is never resident — what lets 8B int8 init on a 16GB chip)
+    must produce the same tree as init-then-quantize, modulo fusion
+    reordering noise in the scales (±1 quantization step on q)."""
+    import numpy as np
+
+    model = llama.LlamaModel(llama.CONFIGS['debug'])
+    sample = jnp.zeros((1, 8), jnp.int32)
+    seq = quant.quantize_params(
+        jax.jit(model.init)(jax.random.PRNGKey(0), sample))
+    fused = jax.jit(lambda k: quant.quantize_params(
+        model.init(k, sample)))(jax.random.PRNGKey(0))
+    la = jax.tree.leaves_with_path(seq)
+    lb = jax.tree.leaves_with_path(fused)
+    assert len(la) == len(lb)
+    for (pa, a), (pb, b) in zip(la, lb):
+        assert pa == pb and a.dtype == b.dtype and a.shape == b.shape
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype == np.int8:
+            assert np.abs(a.astype(np.int32) -
+                          b.astype(np.int32)).max() <= 1
+        else:
+            np.testing.assert_allclose(a.astype(np.float32),
+                                       b.astype(np.float32),
+                                       rtol=1e-5, atol=1e-8)
