@@ -316,6 +316,10 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
             'envs': dict(task.envs or {}),
             'accelerators_per_node': _accels_per_host(
                 handle.launched_resources),
+            # >1 adds the MEGASCALE_* DCN contract to every rank's env
+            # (runtime/gang.py): contiguous host groups become slices.
+            'num_slices': getattr(handle.launched_resources,
+                                  'num_slices', 1),
         }
         job_id = handle.head_client().submit(spec)
         logger.info('Job %d submitted on %s.', job_id,
@@ -430,6 +434,10 @@ def _make_provision_config(plan: optimizer_lib.LaunchablePlan,
             'spot': res.use_spot,
             'reserved': res.reserved,
             'ssh_public_key': _public_key(),
+            # Multislice: the provisioner turns this into N nodeSpec
+            # entries in ONE queued resource (atomic cross-slice gang).
+            'num_slices': res.num_slices,
+            'hosts_per_slice': res.hosts_per_slice,
         }
     elif res.cloud == 'local':
         node_config = {'accelerators_per_node': 0}

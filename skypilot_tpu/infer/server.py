@@ -85,8 +85,13 @@ class InferenceServer:
             return web.json_response({'error': 'empty prompt'},
                                      status=400)
         eos = payload.get('eos_token', self.tokenizer.eos_id)
+        # 'max_tokens' is the OpenAI-convention name; accept the
+        # engine-side 'max_new_tokens' as an alias (same meaning here:
+        # /generate counts generated tokens only).
+        max_new = payload.get('max_tokens',
+                              payload.get('max_new_tokens', 128))
         params = engine_lib.SamplingParams(
-            max_new_tokens=int(payload.get('max_tokens', 128)),
+            max_new_tokens=int(max_new),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
             eos_token=eos)
@@ -396,7 +401,12 @@ def build_engine(model_name: Optional[str] = None,
                           max_seq_len=min(cfg.max_seq_len, max_seq_len))
         make_model = llama.LlamaModel
         model = make_model(cfg)
-        params = weights_lib.load_llama_params(cfg, checkpoint, mesh=mesh)
+        # int8: stream-quantize each tensor on host during load so the
+        # bf16 tree is never resident in HBM (8B fits one 16GB chip).
+        params = weights_lib.load_llama_params(
+            cfg, checkpoint, mesh=mesh,
+            quantize='int8' if quantize == 'int8' else 'none')
+        already_quantized = quantize == 'int8'
     else:
         from skypilot_tpu.models import moe
         name = model_name or 'debug'

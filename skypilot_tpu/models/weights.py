@@ -94,18 +94,41 @@ def _np_cast(arr: np.ndarray, dtype) -> np.ndarray:
     return arr.astype(dtype) if arr.dtype != dtype else arr
 
 
+def _np_quantize_kernel(arr: np.ndarray) -> 'tuple[np.ndarray, np.ndarray]':
+    """Host-side mirror of models/quant.py _quantize_kernel (same
+    per-output-channel symmetric scheme, numpy so the full-precision
+    tensor never reaches the device)."""
+    wf = arr.astype(np.float32)
+    amax = np.max(np.abs(wf), axis=-2)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(wf / scale[..., None, :]), -127,
+                127).astype(np.int8)
+    return q, scale
+
+
 def load_llama_params(cfg, ckpt_dir: str, *,
                       mesh=None,
                       rules=sharding_lib.DEFAULT_RULES,
-                      param_dtype: Optional[str] = None) -> Dict[str, Any]:
+                      param_dtype: Optional[str] = None,
+                      quantize: str = 'none') -> Dict[str, Any]:
     """HF Llama checkpoint dir -> {'params': ...} for models/llama.py.
 
     cfg: LlamaConfig matching the checkpoint shapes. mesh: optional
     jax.sharding.Mesh — leaves are placed with their logical shardings
     (tp/fsdp per parallel/sharding.py DEFAULT_RULES).
+
+    quantize='int8': each projection kernel is quantized ON HOST as it
+    streams out of the safetensors shards, so only int8 (+ scale) ever
+    reaches the device — the full bf16 tree (2x the bytes) is never
+    resident in HBM. This is what lets an 8B checkpoint load onto a
+    single 16GB chip. The emitted tree matches what
+    models/quant.quantize_params produces (projection scopes gain
+    int8 kernel + f32 scale; embeddings/norms stay float).
     """
     from skypilot_tpu.models import llama as llama_lib
 
+    if quantize not in ('none', 'int8'):
+        raise ValueError(f'unknown quantize mode {quantize!r}')
     target = param_dtype or cfg.param_dtype
     if target == 'bfloat16':
         import ml_dtypes
@@ -116,8 +139,11 @@ def load_llama_params(cfg, ckpt_dir: str, *,
     reader = _ShardReader(ckpt_dir)
     shardings = None
     if mesh is not None:
-        model = llama_lib.LlamaModel(cfg)
-        shardings = param_shardings(model, cfg, mesh, rules)
+        import dataclasses as _dc
+        scfg = _dc.replace(cfg, quant='int8') if quantize == 'int8' \
+            else cfg
+        model = llama_lib.LlamaModel(scfg)
+        shardings = param_shardings(model, scfg, mesh, rules)
 
     def put(path: tuple, arr: np.ndarray):
         if shardings is not None:
@@ -126,11 +152,23 @@ def load_llama_params(cfg, ckpt_dir: str, *,
 
     params: Dict[str, Any] = {}
 
+    def store(path: tuple, arr: np.ndarray):
+        """Cast-and-place one assembled host tensor; int8 mode splits
+        projection kernels (path leaf 'kernel', ndim >= 2 — the same
+        scopes quantize_params converts) into q + scale on host."""
+        if quantize == 'int8' and path[-1] == 'kernel' and arr.ndim >= 2:
+            q, scale = _np_quantize_kernel(arr)
+            _set_at(params, path, put(path, q))
+            spath = path[:-1] + ('scale',)
+            _set_at(params, spath, put(spath, scale))
+            return
+        _set_at(params, path, put(path, _np_cast(arr, dtype)))
+
     def assemble(path: tuple, hf_name: str, transpose: bool):
         arr = reader.get(hf_name)
         if transpose:
             arr = arr.T
-        _set_at(params, path, put(path, _np_cast(arr, dtype)))
+        store(path, arr)
 
     for path, (hf_name, transpose) in _TOP_MAP.items():
         if path == ('lm_head', 'kernel'):
@@ -139,8 +177,7 @@ def load_llama_params(cfg, ckpt_dir: str, *,
             if hf_name not in reader:
                 # Tied checkpoint loaded into an untied config: reuse the
                 # embedding, transposed.
-                arr = reader.get('model.embed_tokens.weight').T
-                _set_at(params, path, put(path, _np_cast(arr, dtype)))
+                store(path, reader.get('model.embed_tokens.weight').T)
                 logger.info('lm_head tied to embeddings in checkpoint')
                 continue
         assemble(path, hf_name, transpose)
@@ -151,18 +188,17 @@ def load_llama_params(cfg, ckpt_dir: str, *,
                 reader.get(f'model.layers.{i}.{suffix}')
                 for i in range(cfg.n_layers)]
             arr = np.stack([a.T if transpose else a for a in per_layer])
-            _set_at(params, ('layers',) + path,
-                    put(('layers',) + path, _np_cast(arr, dtype)))
+            store(('layers',) + path, arr)
         else:
             for i in range(cfg.n_layers):
                 arr = reader.get(f'model.layers.{i}.{suffix}')
                 if transpose:
                     arr = arr.T
-                full = (f'layer_{i}',) + path
-                _set_at(params, full, put(full, _np_cast(arr, dtype)))
+                store((f'layer_{i}',) + path, arr)
 
-    logger.info('loaded %d-layer llama params from %s (sharded=%s)',
-                cfg.n_layers, ckpt_dir, mesh is not None)
+    logger.info('loaded %d-layer llama params from %s (sharded=%s, '
+                'quantize=%s)', cfg.n_layers, ckpt_dir,
+                mesh is not None, quantize)
     return {'params': params}
 
 

@@ -177,9 +177,10 @@ def _plans_on_cloud(cloud_name: str, res: resources_lib.Resources,
         per_alloc = off.hourly_cost(res.use_spot)
         if per_alloc is None:
             continue
-        # TPU rows price the whole slice (all hosts); VM rows price one VM,
-        # so multi-node VM tasks pay per node.
-        multiplier = 1 if res.is_tpu else max(1, num_nodes)
+        # TPU rows price ONE slice (all its hosts) — multislice pays per
+        # slice; VM rows price one VM, so multi-node VM tasks pay per
+        # node.
+        multiplier = res.num_slices if res.is_tpu else max(1, num_nodes)
         plans.append(LaunchablePlan(resources=concrete,
                                     hourly_cost=per_alloc * multiplier,
                                     estimated_runtime_s=runtime))

@@ -108,12 +108,30 @@ def _host_id(cluster_name: str, rank: int) -> str:
     return f'{cluster_name}-host-{rank}'
 
 
+def _slice_node_ids(cluster_name: str, num_slices: int) -> list:
+    """TPU node ids for a cluster. Single slice keeps the bare cluster
+    name (backward compatible); multislice names each slice node."""
+    if num_slices <= 1:
+        return [cluster_name]
+    return [f'{cluster_name}-s{i}' for i in range(num_slices)]
+
+
 def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     project, zone = _project_zone(config.provider_config)
     cluster = config.cluster_name
-    node_id = cluster
+    num_slices = int(config.node_config.get('num_slices', 1))
+    node_ids = _slice_node_ids(cluster, num_slices)
+    node_id = node_ids[0]
+    # Downstream entry points (get_cluster_info, stop, terminate) only
+    # receive provider_config; record the slice shape there.
+    config.provider_config['num_slices'] = num_slices
+    config.provider_config['hosts_per_slice'] = int(
+        config.node_config.get('hosts_per_slice',
+                               config.num_nodes // max(1, num_slices)))
 
     # Resume path: node already exists (stopped single-host TPU VM).
+    # Slice 0 stands for the gang: the queued resource created them
+    # atomically, so they exist (or not) together.
     try:
         node = tpu_api.get_node(project, zone, node_id)
     except tpu_api.TpuApiError as e:
@@ -143,13 +161,15 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
         raise common.ProvisionError(
             f'TPU {node_id} in unexpected state {state}', blocked_zone=zone)
 
-    # Fresh acquisition through a queued resource (atomic pod-slice gang).
+    # Fresh acquisition through a queued resource (atomic pod-slice
+    # gang; multislice = one QR with N nodeSpec entries, so all slices
+    # are granted or none are).
     body = {
         'tpu': {'nodeSpec': [{
             'parent': f'projects/{project}/locations/{zone}',
-            'nodeId': node_id,
+            'nodeId': nid,
             'node': _node_body(config),
-        }]},
+        } for nid in node_ids]},
     }
     if config.node_config.get('spot'):
         body['spot'] = {}
@@ -237,8 +257,11 @@ def wait_instances(region: str, cluster_name: str,
                 f'queued resource {cluster_name}: {qr_state}',
                 blocked_zone=zone)
         try:
-            node = tpu_api.get_node(project, zone, cluster_name)
-            if node.get('state') == 'READY':
+            if all(tpu_api.get_node(project, zone, nid).get('state')
+                   == 'READY'
+                   for nid in _slice_node_ids(
+                       cluster_name,
+                       int(provider_config.get('num_slices', 1)))):
                 return
         except tpu_api.TpuApiError as e:
             if e.status != 404:
@@ -252,6 +275,11 @@ def wait_instances(region: str, cluster_name: str,
 def stop_instances(cluster_name: str,
                    provider_config: Dict[str, Any]) -> None:
     project, zone = _project_zone(provider_config)
+    if int(provider_config.get('num_slices', 1)) > 1:
+        # Multislice deployments are pods by definition; same rule.
+        raise common.ProvisionError(
+            f'multislice cluster {cluster_name} cannot be stopped; '
+            'use down/terminate', retryable=False)
     try:
         node = tpu_api.get_node(project, zone, cluster_name)
     except tpu_api.TpuApiError as e:
@@ -282,12 +310,14 @@ def terminate_instances(cluster_name: str,
         if e.status != 404:
             logger.warning('queued-resource delete failed (%s); falling '
                            'back to node delete', e)
-    try:
-        op = tpu_api.delete_node(project, zone, cluster_name)
-        tpu_api.wait_operation(op)
-    except tpu_api.TpuApiError as e:
-        if e.status != 404:
-            raise _provision_error(e, zone)
+    for nid in _slice_node_ids(cluster_name,
+                               int(provider_config.get('num_slices', 1))):
+        try:
+            op = tpu_api.delete_node(project, zone, nid)
+            tpu_api.wait_operation(op)
+        except tpu_api.TpuApiError as e:
+            if e.status != 404:
+                raise _provision_error(e, zone)
 
 
 _STATE_MAP = {
@@ -306,28 +336,45 @@ _STATE_MAP = {
 def query_instances(cluster_name: str, provider_config: Dict[str, Any]
                     ) -> Dict[str, Optional[str]]:
     project, zone = _project_zone(provider_config)
-    try:
-        node = tpu_api.get_node(project, zone, cluster_name)
-    except tpu_api.TpuApiError as e:
-        if e.status == 404:
-            return {}
-        raise _provision_error(e, zone)
-    status = _STATE_MAP.get(node.get('state'), 'unknown')
-    # One entry per host, same id namespace as get_cluster_info / local
-    # provider ('<cluster>-host-<rank>'); a slice is atomic so every host
-    # shares the node's state.
-    n_hosts = max(len(node.get('networkEndpoints', [])), 1)
-    return {f'{cluster_name}-host-{r}': status for r in range(n_hosts)}
+    out: Dict[str, Optional[str]] = {}
+    rank = 0
+    hosts_per_slice = int(provider_config.get('hosts_per_slice', 0))
+    for nid in _slice_node_ids(cluster_name,
+                               int(provider_config.get('num_slices', 1))):
+        try:
+            node = tpu_api.get_node(project, zone, nid)
+        except tpu_api.TpuApiError as e:
+            if e.status == 404:
+                # Keep '<cluster>-host-<rank>' ids stable: a missing
+                # slice must not shift later slices' hosts onto its
+                # rank range.
+                rank += hosts_per_slice
+                continue
+            raise _provision_error(e, zone)
+        status = _STATE_MAP.get(node.get('state'), 'unknown')
+        # One entry per host, same id namespace as get_cluster_info /
+        # local provider ('<cluster>-host-<rank>'); a slice is atomic so
+        # every host shares its node's state.
+        n_hosts = max(len(node.get('networkEndpoints', [])), 1)
+        for _ in range(n_hosts):
+            out[f'{cluster_name}-host-{rank}'] = status
+            rank += 1
+    return out
 
 
 def get_cluster_info(region: Optional[str], cluster_name: str,
                      provider_config: Dict[str, Any]) -> common.ClusterInfo:
     project, zone = _project_zone(provider_config)
-    try:
-        node = tpu_api.get_node(project, zone, cluster_name)
-    except tpu_api.TpuApiError as e:
-        raise _provision_error(e, zone)
-    endpoints = node.get('networkEndpoints', [])
+    num_slices = int(provider_config.get('num_slices', 1))
+    # Slice-major host order: slice 0's hosts first, then slice 1's, ...
+    # — the contiguous-group contract runtime/gang.py splits ranks by.
+    endpoints = []
+    for nid in _slice_node_ids(cluster_name, num_slices):
+        try:
+            node = tpu_api.get_node(project, zone, nid)
+        except tpu_api.TpuApiError as e:
+            raise _provision_error(e, zone)
+        endpoints.extend(node.get('networkEndpoints', []))
     instances: Dict[str, common.InstanceInfo] = {}
     for rank, ep in enumerate(endpoints):
         iid = f'{cluster_name}-host-{rank}'

@@ -76,6 +76,11 @@ class Resources:
     reserved: bool = False                       # use reserved capacity quota
     autostop: Optional[int] = None               # idle minutes; -1 = down
     job_recovery: Optional[str] = None
+    # Multislice: N identical slices provisioned as ONE atomic queued
+    # resource; cross-slice collectives ride DCN via the MEGASCALE env
+    # the gang runtime exports (runtime/gang.py multislice_env_vars,
+    # parallel/mesh.py build_hybrid_mesh).
+    num_slices: int = 1
 
     _tpu_topology: Optional[acc_lib.TpuTopology] = dataclasses.field(
         default=None, repr=False, compare=False)
@@ -134,7 +139,14 @@ class Resources:
 
     @property
     def num_hosts(self) -> int:
-        """Host VMs implied by the accelerator (1 for non-TPU)."""
+        """TOTAL host VMs implied by the accelerator (1 for non-TPU):
+        hosts per slice x num_slices."""
+        if not self.is_tpu:
+            return 1
+        return self._tpu_topology.num_hosts * self.num_slices
+
+    @property
+    def hosts_per_slice(self) -> int:
         return self._tpu_topology.num_hosts if self.is_tpu else 1
 
     # ------------------------------------------------------------- validate
@@ -157,6 +169,13 @@ class Resources:
         if self.use_spot and self.reserved:
             raise exceptions.InvalidResourcesError(
                 'use_spot and reserved are mutually exclusive')
+        if not isinstance(self.num_slices, int) or self.num_slices < 1:
+            raise exceptions.InvalidResourcesError(
+                f'num_slices must be an int >= 1, got {self.num_slices!r}')
+        if self.num_slices > 1 and not self.is_tpu:
+            raise exceptions.InvalidResourcesError(
+                'num_slices > 1 requires a TPU slice accelerator '
+                '(multislice is a TPU concept)')
         if self.zone is not None and self.region is None:
             from skypilot_tpu.utils import common_utils
             self.region = common_utils.region_from_zone(self.zone)
@@ -223,6 +242,8 @@ class Resources:
             cfg['labels'] = dict(self.labels)
         if self.autostop is not None:
             cfg['autostop'] = self.autostop
+        if self.num_slices != 1:
+            cfg['num_slices'] = self.num_slices
         return cfg
 
     @classmethod
@@ -260,6 +281,8 @@ class Resources:
             name = self.accelerator_name
             count = self.accelerators[name]
             parts.append(name if self.is_tpu else f'{name}:{count}')
+        if self.num_slices > 1:
+            parts.append(f'x{self.num_slices}slices')
         if self.use_spot:
             parts.append('[spot]')
         if self.zone:

@@ -105,8 +105,8 @@ class Task:
         """Host count. For TPU pod slices this comes from the topology: all
         hosts of the slice are one gang (reference forces the user to align
         num_nodes manually; we derive it)."""
-        tpu_hosts = sorted({(res.tpu_topology.num_hosts,
-                             res.accelerator_name)
+        # num_hosts is slice-aware: hosts/slice x num_slices.
+        tpu_hosts = sorted({(res.num_hosts, res.accelerator_name)
                             for res in self.resources if res.is_tpu})
         pod_hosts = [(h, n) for h, n in tpu_hosts if h > 1]
         if not pod_hosts:

@@ -82,6 +82,12 @@ def main(argv=None) -> None:
     parser.add_argument('--model', default='llama3-1b')
     parser.add_argument('--mesh', default='auto',
                         help="e.g. 'fsdp=8,tp=2' or 'auto'")
+    parser.add_argument('--dcn-mesh', default=None,
+                        help="multi-slice: the axes that cross the "
+                             "slice boundary (DCN), e.g. 'dp=2'; "
+                             "--mesh then describes ONE slice (ICI). "
+                             "Slice count/assignment comes from the "
+                             "platform (MEGASCALE env on TPU)")
     parser.add_argument('--steps', type=int, default=1000)
     parser.add_argument('--batch', type=int, default=8)
     parser.add_argument('--seq', type=int, default=2048)
@@ -130,9 +136,19 @@ def main(argv=None) -> None:
             f'unknown model {args.model}; choose from '
             f'{sorted([*llama.CONFIGS, *moe.MIXTRAL_CONFIGS])}')
 
-    spec = parse_mesh(args.mesh, jax.device_count())
-    mesh = mesh_lib.build_mesh(spec)
-    logger.info('mesh: %s', spec)
+    if args.dcn_mesh:
+        # Hybrid mesh: --mesh shards within a slice (ICI), --dcn-mesh
+        # crosses slices (DCN). Keep bandwidth-hungry axes (fsdp/tp)
+        # intra-slice; dp tolerates DCN latency.
+        dcn_spec = parse_mesh(args.dcn_mesh, 0)
+        per_slice = jax.device_count() // max(1, dcn_spec.num_devices)
+        spec = parse_mesh(args.mesh, per_slice)
+        mesh = mesh_lib.build_hybrid_mesh(spec, dcn_spec)
+        logger.info('hybrid mesh: ici=%s dcn=%s', spec, dcn_spec)
+    else:
+        spec = parse_mesh(args.mesh, jax.device_count())
+        mesh = mesh_lib.build_mesh(spec)
+        logger.info('mesh: %s', spec)
 
     tcfg = trainer.TrainerConfig(learning_rate=args.lr,
                                  total_steps=args.steps)

@@ -25,6 +25,7 @@ _RESOURCES_SCHEMA = {
         'cpus': {'anyOf': [{'type': 'integer'}, {'type': 'string'}]},
         'memory': {'anyOf': [{'type': 'integer'}, {'type': 'string'}]},
         'use_spot': {'type': 'boolean'},
+        'num_slices': {'type': 'integer', 'minimum': 1},
         'spot_recovery': {'type': 'string'},
         'job_recovery': {'type': 'string'},
         'disk_size': {'type': 'integer'},

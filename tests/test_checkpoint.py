@@ -77,3 +77,12 @@ def test_checkpointer_restore_none_when_empty(tmp_path):
     assert ckpt.latest_step() is None
     assert ckpt.restore({'x': jnp.zeros(3)}) is None
     ckpt.close()
+
+
+def test_sft_multislice_hybrid_mesh_runs():
+    """--dcn-mesh dp=2 + --mesh fsdp=2,tp=2 on the virtual 8-device
+    mesh: dp crosses the emulated slice boundary (DCN), fsdp/tp stay
+    intra-slice — the multi-slice pretrain entry point end to end."""
+    sft.main(['--model', 'debug', '--mesh', 'fsdp=2,tp=2',
+              '--dcn-mesh', 'dp=2', '--steps', '2', '--batch', '4',
+              '--seq', '32', '--log-every', '1'])

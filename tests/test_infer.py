@@ -198,6 +198,14 @@ def test_http_server(small_model):
     assert resp.status_code == 200
     assert resp.json()['tokens'] == want
 
+    # 'max_new_tokens' is accepted as an alias for 'max_tokens'.
+    resp = requests.post(base + '/generate',
+                         json={'tokens': [9, 9, 9],
+                               'max_new_tokens': 4},
+                         timeout=120)
+    assert resp.status_code == 200
+    assert resp.json()['tokens'] == want
+
     # Streaming: one ndjson line per token.
     resp = requests.post(base + '/generate',
                          json={'tokens': [9, 9, 9], 'max_tokens': 4,

@@ -241,3 +241,65 @@ def test_hf_tokenizer_config_json_ids(tmp_path):
 def test_load_tokenizer_missing_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         tokenizer_lib.load_tokenizer(str(tmp_path))
+
+
+def test_checkpoint_int8_stream_load_matches_post_quantize(debug_ckpt):
+    """quantize='int8' streams each kernel through host-side
+    quantization during load; the tree must match load-then-
+    quantize_params (± 1 quantization step from host/device float
+    rounding), with no bf16 kernel ever placed on device."""
+    from skypilot_tpu.models import quant
+
+    cfg, model, params, ckpt_dir = debug_ckpt
+    want = quant.quantize_params(
+        weights.load_llama_params(cfg, ckpt_dir))
+    got = weights.load_llama_params(cfg, ckpt_dir, quantize='int8')
+    la = jax.tree.leaves_with_path(want)
+    lb = jax.tree.leaves_with_path(got)
+    assert [p for p, _ in la] == [p for p, _ in lb]
+    for (path, a), (_, b) in zip(la, lb):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype, path
+        if a.dtype == np.int8:
+            assert np.abs(a.astype(np.int32) -
+                          b.astype(np.int32)).max() <= 1, path
+        else:
+            np.testing.assert_allclose(a.astype(np.float32),
+                                       b.astype(np.float32),
+                                       rtol=1e-5, atol=1e-8)
+
+
+def test_engine_from_checkpoint_int8_serves(debug_ckpt, tmp_path):
+    """build_engine(checkpoint=..., quantize='int8'): the stream-
+    quantized engine decodes identically to an engine quantized after a
+    full-precision load."""
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import quant
+
+    cfg, model, params, ckpt_dir = debug_ckpt
+    prompt = [5, 17, 3, 99, 42]
+
+    eng_stream = server_lib.build_engine(
+        checkpoint=ckpt_dir, num_slots=2, max_seq_len=64,
+        quantize='int8')
+    eng_stream.start()
+    try:
+        got = eng_stream.generate(prompt, engine_lib.SamplingParams(
+            max_new_tokens=8))
+    finally:
+        eng_stream.stop()
+
+    import dataclasses as _dc
+    qcfg = _dc.replace(eng_stream.cfg)
+    qparams = quant.quantize_params(
+        weights.load_llama_params(cfg, ckpt_dir))
+    qmodel = llama.LlamaModel(qcfg)
+    eng_post = engine_lib.InferenceEngine(qmodel, qparams, num_slots=2,
+                                          max_seq_len=64)
+    eng_post.start()
+    try:
+        want = eng_post.generate(prompt, engine_lib.SamplingParams(
+            max_new_tokens=8))
+    finally:
+        eng_post.stop()
+    assert got == want

@@ -175,3 +175,19 @@ class TestReviewRegressions:
         with pytest.raises(exceptions.ResourcesUnavailableError,
                            match='not enabled'):
             Optimizer.optimize(dag, quiet=True)
+
+
+def test_multislice_pays_per_slice():
+    """TPU catalog rows price one slice; num_slices=2 doubles the cost."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import optimizer as opt
+    from skypilot_tpu import resources as res_lib
+
+    def plan_for(n):
+        t = sky.Task(name='ms-cost', run='x')
+        t.set_resources(res_lib.Resources(accelerators='tpu-v5e-16',
+                                          num_slices=n))
+        return opt.Optimizer.plan_for_task(t)[0]
+
+    one, two = plan_for(1), plan_for(2)
+    assert two.hourly_cost == pytest.approx(2 * one.hourly_cost)

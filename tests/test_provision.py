@@ -238,3 +238,133 @@ def test_gcp_cluster_info_ranks(monkeypatch):
     assert info.internal_ips() == ['10.0.0.2', '10.0.0.3']
     assert info.external_ips() == ['34.1.1.2', '34.1.1.3']
     assert info.head_instance_id == 'pod-host-0'
+
+
+def test_gcp_multislice_queued_resource_body(monkeypatch):
+    """num_slices=2: ONE queued resource, TWO nodeSpec entries (atomic
+    cross-slice gang), per-slice node ids."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    bodies = {}
+
+    class _Sess:
+        def request(self, method, url, data=None, **kwargs):
+            if method == 'GET' and '/nodes/' in url:
+                return _FakeResp(404, {'error': {'message': 'nf'}})
+            if method == 'POST' and '/queuedResources' in url:
+                import json as _json
+                bodies.update(_json.loads(data))
+                return _FakeResp(200, {'name': 'op/1'})
+            return _FakeResp(404, {'error': {'message': 'nf'}})
+
+    monkeypatch.setattr(tpu_api, '_session', lambda: _Sess())
+    cfg = common.ProvisionConfig(
+        provider_name='gcp', region='us-west4', zone='us-west4-a',
+        cluster_name='ms', num_nodes=8,
+        node_config={'accelerator_type': 'v5litepod-16', 'spot': False,
+                     'runtime_version': 'v2-alpha-tpuv5-lite',
+                     'ssh_public_key': 'ssh-ed25519 AAAA test',
+                     'num_slices': 2, 'hosts_per_slice': 4},
+        provider_config={'project': 'p', 'availability_zone': 'us-west4-a'})
+    record = gcp_instance.run_instances(cfg)
+    specs = bodies['tpu']['nodeSpec']
+    assert [s['nodeId'] for s in specs] == ['ms-s0', 'ms-s1']
+    assert all(s['node']['acceleratorType'] == 'v5litepod-16'
+               for s in specs)
+    assert record.created_instance_ids == [
+        f'ms-host-{r}' for r in range(8)]
+    # The slice count rides provider_config for downstream entry points.
+    assert cfg.provider_config['num_slices'] == 2
+
+
+def test_gcp_multislice_cluster_info_slice_major(monkeypatch):
+    """get_cluster_info aggregates both slice nodes' endpoints in
+    slice-major rank order — the contiguous-group contract gang.py
+    splits MEGASCALE slices by."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    sess, _ = _fake_session([
+        ('GET', '/nodes/ms-s0', 200, {
+            'state': 'READY',
+            'networkEndpoints': [{'ipAddress': '10.0.0.2'},
+                                 {'ipAddress': '10.0.0.3'}]}),
+        ('GET', '/nodes/ms-s1', 200, {
+            'state': 'READY',
+            'networkEndpoints': [{'ipAddress': '10.0.1.2'},
+                                 {'ipAddress': '10.0.1.3'}]}),
+    ])
+    monkeypatch.setattr(tpu_api, '_session', sess)
+    info = gcp_instance.get_cluster_info(
+        'us-west4', 'ms', {'project': 'p', 'availability_zone': 'z',
+                           'num_slices': 2})
+    assert info.internal_ips() == ['10.0.0.2', '10.0.0.3',
+                                   '10.0.1.2', '10.0.1.3']
+    assert info.head_instance_id == 'ms-host-0'
+
+    out = gcp_instance.query_instances(
+        'ms', {'project': 'p', 'availability_zone': 'z',
+               'num_slices': 2})
+    assert len(out) == 4 and set(out.values()) == {'running'}
+
+
+def test_resources_num_slices():
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu import resources as res_lib
+
+    r = res_lib.Resources(accelerators='tpu-v5e-16', num_slices=2)
+    assert r.hosts_per_slice == 4 and r.num_hosts == 8
+    assert 'x2slices' in str(r)
+    r2 = res_lib.Resources.from_yaml_config(r.to_yaml_config())
+    assert r2.num_slices == 2 and r2.num_hosts == 8
+    # non-TPU multislice is rejected
+    with pytest.raises(exc.InvalidResourcesError, match='num_slices'):
+        res_lib.Resources(cloud='local', num_slices=2)
+    with pytest.raises(exc.InvalidResourcesError, match='num_slices'):
+        res_lib.Resources(accelerators='tpu-v5e-8', num_slices=0)
+
+
+def test_task_num_nodes_multislice():
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as res_lib
+
+    t = sky.Task(name='ms', run='echo hi')
+    t.set_resources(res_lib.Resources(accelerators='tpu-v5e-16',
+                                      num_slices=2))
+    assert t.num_nodes == 8
+
+
+def test_gcp_multislice_wait_requires_all_slices_ready(monkeypatch):
+    """wait_instances must poll every slice node, not the bare cluster
+    name (which never exists for multislice), and return only when ALL
+    slices are READY."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    states = {'ms-s0': iter(['READY', 'READY']),
+              'ms-s1': iter(['CREATING', 'READY'])}
+    calls = []
+
+    class _Sess:
+        def request(self, method, url, data=None, **kwargs):
+            calls.append(url)
+            if '/queuedResources/' in url:
+                return _FakeResp(200, {'state': {'state': 'ACTIVE'}})
+            for nid, it in states.items():
+                if url.endswith(f'/nodes/{nid}'):
+                    return _FakeResp(200, {'state': next(it)})
+            return _FakeResp(404, {'error': {'message': 'nf'}})
+
+    monkeypatch.setattr(tpu_api, '_session', lambda: _Sess())
+    monkeypatch.setattr('time.sleep', lambda s: None)
+    gcp_instance.wait_instances(
+        'us-west4', 'ms', state='running',
+        provider_config={'project': 'p', 'availability_zone': 'z',
+                         'num_slices': 2}, timeout=30)
+    # Second poll round saw both READY; the bare 'ms' node was never
+    # queried.
+    assert not any(u.endswith('/nodes/ms') for u in calls)
