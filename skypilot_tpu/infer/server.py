@@ -395,17 +395,31 @@ def build_engine(model_name: Optional[str] = None,
     already_quantized = False
     if checkpoint:
         from skypilot_tpu.models import weights as weights_lib
-        cfg = weights_lib.load_config(
-            checkpoint, remat=False, param_dtype=dtype, dtype=dtype)
-        cfg = _dc.replace(cfg,
-                          max_seq_len=min(cfg.max_seq_len, max_seq_len))
-        make_model = llama.LlamaModel
-        model = make_model(cfg)
+        qmode = 'int8' if quantize == 'int8' else 'none'
         # int8: stream-quantize each tensor on host during load so the
         # bf16 tree is never resident in HBM (8B fits one 16GB chip).
-        params = weights_lib.load_llama_params(
-            cfg, checkpoint, mesh=mesh,
-            quantize='int8' if quantize == 'int8' else 'none')
+        if weights_lib.checkpoint_model_type(checkpoint) == 'mixtral':
+            from skypilot_tpu.models import moe
+            cfg, moe_cfg = weights_lib.load_mixtral_config(
+                checkpoint, remat=False, param_dtype=dtype, dtype=dtype)
+            cfg = _dc.replace(
+                cfg, max_seq_len=min(cfg.max_seq_len, max_seq_len))
+            # Dropless routing for serving (same rationale as the
+            # named-config MoE branch below).
+            moe_cfg = _dc.replace(moe_cfg, capacity_factor=8.0)
+            make_model = lambda c: moe.MixtralModel(c, moe_cfg)  # noqa: E731
+            model = make_model(cfg)
+            params = weights_lib.load_mixtral_params(
+                cfg, moe_cfg, checkpoint, mesh=mesh, quantize=qmode)
+        else:
+            cfg = weights_lib.load_config(
+                checkpoint, remat=False, param_dtype=dtype, dtype=dtype)
+            cfg = _dc.replace(
+                cfg, max_seq_len=min(cfg.max_seq_len, max_seq_len))
+            make_model = llama.LlamaModel
+            model = make_model(cfg)
+            params = weights_lib.load_llama_params(
+                cfg, checkpoint, mesh=mesh, quantize=qmode)
         already_quantized = quantize == 'int8'
     else:
         from skypilot_tpu.models import moe

@@ -106,6 +106,35 @@ def _np_quantize_kernel(arr: np.ndarray) -> 'tuple[np.ndarray, np.ndarray]':
     return q, scale
 
 
+def _resolve_dtype(cfg, param_dtype: Optional[str]):
+    target = param_dtype or cfg.param_dtype
+    if target == 'bfloat16':
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(target)
+
+
+def _make_store(params: Dict[str, Any], put, quantize: str, dtype):
+    """The shared cast/quantize-and-place closure both loaders use.
+
+    int8 mode splits projection kernels (path leaf 'kernel', ndim >= 2 —
+    the same scopes models/quant.quantize_params converts) into int8 q +
+    f32 scale ON HOST; expert_weight=True uses the MoeMLP sibling-key
+    convention ('<name>' + '<name>_scale')."""
+    def store(path: tuple, arr: np.ndarray, expert_weight=False):
+        if quantize == 'int8' and (expert_weight
+                                   or (path[-1] == 'kernel'
+                                       and arr.ndim >= 2)):
+            q, scale = _np_quantize_kernel(arr)
+            spath = (path[:-1] + (f'{path[-1]}_scale',) if expert_weight
+                     else path[:-1] + ('scale',))
+            _set_at(params, path, put(path, q))
+            _set_at(params, spath, put(spath, scale))
+            return
+        _set_at(params, path, put(path, _np_cast(arr, dtype)))
+    return store
+
+
 def load_llama_params(cfg, ckpt_dir: str, *,
                       mesh=None,
                       rules=sharding_lib.DEFAULT_RULES,
@@ -129,12 +158,7 @@ def load_llama_params(cfg, ckpt_dir: str, *,
 
     if quantize not in ('none', 'int8'):
         raise ValueError(f'unknown quantize mode {quantize!r}')
-    target = param_dtype or cfg.param_dtype
-    if target == 'bfloat16':
-        import ml_dtypes
-        dtype = np.dtype(ml_dtypes.bfloat16)
-    else:
-        dtype = np.dtype(target)
+    dtype = _resolve_dtype(cfg, param_dtype)
 
     reader = _ShardReader(ckpt_dir)
     shardings = None
@@ -151,18 +175,7 @@ def load_llama_params(cfg, ckpt_dir: str, *,
         return jnp.asarray(arr)
 
     params: Dict[str, Any] = {}
-
-    def store(path: tuple, arr: np.ndarray):
-        """Cast-and-place one assembled host tensor; int8 mode splits
-        projection kernels (path leaf 'kernel', ndim >= 2 — the same
-        scopes quantize_params converts) into q + scale on host."""
-        if quantize == 'int8' and path[-1] == 'kernel' and arr.ndim >= 2:
-            q, scale = _np_quantize_kernel(arr)
-            _set_at(params, path, put(path, q))
-            spath = path[:-1] + ('scale',)
-            _set_at(params, spath, put(spath, scale))
-            return
-        _set_at(params, path, put(path, _np_cast(arr, dtype)))
+    store = _make_store(params, put, quantize, dtype)
 
     def assemble(path: tuple, hf_name: str, transpose: bool):
         arr = reader.get(hf_name)
@@ -200,6 +213,182 @@ def load_llama_params(cfg, ckpt_dir: str, *,
                 'quantize=%s)', cfg.n_layers, ckpt_dir,
                 mesh is not None, quantize)
     return {'params': params}
+
+
+# HF Mixtral layout: llama attention + per-expert MLPs under
+# block_sparse_moe (experts.{e}.w1/w3/w2 = gate/up/down, gate = router).
+_MOE_ATTN_MAP = {
+    ('attn_norm', 'weight'): ('input_layernorm.weight', False),
+    ('attn', 'wq', 'kernel'): ('self_attn.q_proj.weight', True),
+    ('attn', 'wk', 'kernel'): ('self_attn.k_proj.weight', True),
+    ('attn', 'wv', 'kernel'): ('self_attn.v_proj.weight', True),
+    ('attn', 'wo', 'kernel'): ('self_attn.o_proj.weight', True),
+    ('mlp_norm', 'weight'): ('post_attention_layernorm.weight', False),
+}
+_MOE_EXPERT_MAP = {
+    'w_gate': 'w1',   # [mlp, dim] in HF; ours [dim, mlp]
+    'w_up': 'w3',
+    'w_down': 'w2',   # [dim, mlp] in HF; ours [mlp, dim]
+}
+
+
+def checkpoint_model_type(ckpt_dir: str) -> str:
+    """'llama' | 'mixtral' | ... from the checkpoint's config.json."""
+    with open(os.path.join(ckpt_dir, 'config.json'),
+              encoding='utf-8') as f:
+        return json.load(f).get('model_type', 'llama')
+
+
+def load_mixtral_config(ckpt_dir: str, **overrides):
+    """config.json -> (LlamaConfig, MoeConfig) for models/moe.py."""
+    from skypilot_tpu.models import moe as moe_lib
+
+    with open(os.path.join(ckpt_dir, 'config.json'),
+              encoding='utf-8') as f:
+        hf = json.load(f)
+    cfg = config_from_hf(hf, **overrides)
+    moe_cfg = moe_lib.MoeConfig(
+        num_experts=hf.get('num_local_experts', 8),
+        experts_per_token=hf.get('num_experts_per_tok', 2))
+    return cfg, moe_cfg
+
+
+def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
+                        mesh=None,
+                        rules=sharding_lib.DEFAULT_RULES,
+                        param_dtype: Optional[str] = None,
+                        quantize: str = 'none') -> Dict[str, Any]:
+    """HF Mixtral checkpoint dir -> {'params': ...} for MixtralModel.
+
+    Reference analog: the reference serves Mixtral through vLLM
+    (llm/mixtral/serve.yaml); here the expert weights load straight
+    into the scan-stacked [L, E, in, out] einsum tensors of
+    models/moe.py. quantize='int8' stream-quantizes expert weights on
+    host (router + norms stay float, matching quantize_params).
+    """
+    from skypilot_tpu.models import moe as moe_lib
+
+    if quantize not in ('none', 'int8'):
+        raise ValueError(f'unknown quantize mode {quantize!r}')
+    dtype = _resolve_dtype(cfg, param_dtype)
+
+    reader = _ShardReader(ckpt_dir)
+    shardings = None
+    if mesh is not None:
+        import dataclasses as _dc
+        scfg = _dc.replace(cfg, quant='int8') if quantize == 'int8' \
+            else cfg
+        model = moe_lib.MixtralModel(scfg, moe_cfg)
+        shardings = param_shardings(model, scfg, mesh, rules)
+
+    def put(path: tuple, arr: np.ndarray):
+        if shardings is not None:
+            return jax.device_put(arr, _leaf_at(shardings, path))
+        return jnp.asarray(arr)
+
+    params: Dict[str, Any] = {}
+    store = _make_store(params, put, quantize, dtype)
+
+    for path, (hf_name, transpose) in _TOP_MAP.items():
+        if path == ('lm_head', 'kernel') and cfg.tie_embeddings:
+            continue
+        arr = reader.get(hf_name)
+        store(path, arr.T if transpose else arr)
+
+    L, E = cfg.n_layers, moe_cfg.num_experts
+    assert cfg.scan_layers, 'MixtralModel is scan-stacked'
+    for path, (suffix, transpose) in _MOE_ATTN_MAP.items():
+        per_layer = [reader.get(f'model.layers.{i}.{suffix}')
+                     for i in range(L)]
+        arr = np.stack([a.T if transpose else a for a in per_layer])
+        store(('layers',) + path, arr)
+    # Router: [L, dim, E] (HF gate.weight is [E, dim]); stays float.
+    router = np.stack([
+        reader.get(f'model.layers.{i}.block_sparse_moe.gate.weight').T
+        for i in range(L)])
+    _set_at(params, ('layers', 'moe_mlp', 'router'),
+            put(('layers', 'moe_mlp', 'router'),
+                _np_cast(router, dtype)))
+    # Experts: [L, E, in, out]. Work per LAYER so host peak stays at
+    # one layer's experts in full precision (~1GB at 8x7B): int8 mode
+    # quantizes each layer as it streams (the stacked result is int8,
+    # ~1/2 the bytes); float mode casts each layer to the target dtype
+    # before stacking (never inflates bf16 shards to f32).
+    for ours, hf_w in _MOE_EXPERT_MAP.items():
+        epath = ('layers', 'moe_mlp', ours)
+        if quantize == 'int8':
+            qs, scales = [], []
+            for i in range(L):
+                layer = np.stack([reader.get(
+                    f'model.layers.{i}.block_sparse_moe.experts.{e}'
+                    f'.{hf_w}.weight').T for e in range(E)])
+                q, s = _np_quantize_kernel(layer)
+                qs.append(q)
+                scales.append(s)
+            _set_at(params, epath, put(epath, np.stack(qs)))
+            spath = epath[:-1] + (f'{ours}_scale',)
+            _set_at(params, spath, put(spath, np.stack(scales)))
+        else:
+            stacked = np.stack([
+                np.stack([_np_cast(reader.get(
+                    f'model.layers.{i}.block_sparse_moe.experts.{e}'
+                    f'.{hf_w}.weight').T, dtype) for e in range(E)])
+                for i in range(L)])
+            _set_at(params, epath, put(epath, stacked))
+
+    logger.info('loaded %d-layer %d-expert mixtral params from %s '
+                '(sharded=%s, quantize=%s)', L, E, ckpt_dir,
+                mesh is not None, quantize)
+    return {'params': params}
+
+
+def save_hf_mixtral_checkpoint(cfg, moe_cfg, variables: Dict[str, Any],
+                               out_dir: str) -> None:
+    """Inverse of load_mixtral_params (export + loader round-trip
+    tests)."""
+    import flax.linen as nn
+    import safetensors.numpy
+
+    params = nn.meta.unbox(variables['params'])
+    os.makedirs(out_dir, exist_ok=True)
+    out: Dict[str, np.ndarray] = {}
+
+    def grab(path: tuple) -> Optional[np.ndarray]:
+        leaf = _get_at(params, path)
+        return None if leaf is None else np.asarray(jax.device_get(leaf))
+
+    for path, (hf_name, transpose) in _TOP_MAP.items():
+        arr = grab(path)
+        if arr is None:
+            continue
+        out[hf_name] = arr.T if transpose else arr
+    for path, (suffix, transpose) in _MOE_ATTN_MAP.items():
+        stacked = grab(('layers',) + path)
+        for i in range(cfg.n_layers):
+            arr = stacked[i]
+            out[f'model.layers.{i}.{suffix}'] = arr.T if transpose else arr
+    router = grab(('layers', 'moe_mlp', 'router'))
+    for i in range(cfg.n_layers):
+        out[f'model.layers.{i}.block_sparse_moe.gate.weight'] = \
+            router[i].T
+    for ours, hf_w in _MOE_EXPERT_MAP.items():
+        stacked = grab(('layers', 'moe_mlp', ours))
+        for i in range(cfg.n_layers):
+            for e in range(moe_cfg.num_experts):
+                out[f'model.layers.{i}.block_sparse_moe.experts.{e}'
+                    f'.{hf_w}.weight'] = stacked[i, e].T
+
+    out = {k: np.ascontiguousarray(v) for k, v in out.items()}
+    safetensors.numpy.save_file(
+        out, os.path.join(out_dir, 'model.safetensors'))
+    hf = config_to_hf(cfg)
+    hf.update({'architectures': ['MixtralForCausalLM'],
+               'model_type': 'mixtral',
+               'num_local_experts': moe_cfg.num_experts,
+               'num_experts_per_tok': moe_cfg.experts_per_token})
+    with open(os.path.join(out_dir, 'config.json'), 'w',
+              encoding='utf-8') as f:
+        json.dump(hf, f, indent=2)
 
 
 def save_hf_checkpoint(cfg, variables: Dict[str, Any],

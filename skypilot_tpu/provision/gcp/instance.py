@@ -354,8 +354,12 @@ def query_instances(cluster_name: str, provider_config: Dict[str, Any]
         status = _STATE_MAP.get(node.get('state'), 'unknown')
         # One entry per host, same id namespace as get_cluster_info /
         # local provider ('<cluster>-host-<rank>'); a slice is atomic so
-        # every host shares its node's state.
-        n_hosts = max(len(node.get('networkEndpoints', [])), 1)
+        # every host shares its node's state. Prefer the recorded
+        # hosts_per_slice over the live endpoint count: a CREATING node
+        # reports 0 endpoints, and rank ids must not shift across
+        # slices mid-provision.
+        n_hosts = max(len(node.get('networkEndpoints', [])),
+                      hosts_per_slice, 1)
         for _ in range(n_hosts):
             out[f'{cluster_name}-host-{rank}'] = status
             rank += 1

@@ -303,3 +303,112 @@ def test_engine_from_checkpoint_int8_serves(debug_ckpt, tmp_path):
     finally:
         eng_post.stop()
     assert got == want
+
+
+# ------------------------------------------------------------- mixtral
+@pytest.fixture(scope='module')
+def mixtral_ckpt(tmp_path_factory):
+    """A debug-size HF-format Mixtral checkpoint."""
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    cfg = dataclasses.replace(cfg, max_seq_len=64)
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(11),
+                                 jnp.zeros((1, 8), jnp.int32))
+    out = tmp_path_factory.mktemp('mixtral_ckpt')
+    weights.save_hf_mixtral_checkpoint(cfg, moe_cfg, params, str(out))
+    return cfg, moe_cfg, model, params, str(out)
+
+
+def test_mixtral_roundtrip_save_load(mixtral_ckpt):
+    import flax.linen as nn
+    cfg, moe_cfg, _, params, ckpt_dir = mixtral_ckpt
+    assert weights.checkpoint_model_type(ckpt_dir) == 'mixtral'
+    cfg2, moe_cfg2 = weights.load_mixtral_config(
+        ckpt_dir, max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        use_llama31_rope=cfg.use_llama31_rope, remat=cfg.remat)
+    assert moe_cfg2.num_experts == moe_cfg.num_experts
+    assert moe_cfg2.experts_per_token == moe_cfg.experts_per_token
+    loaded = weights.load_mixtral_params(cfg2, moe_cfg2, ckpt_dir)
+    flat_a = jax.tree_util.tree_leaves_with_path(
+        nn.meta.unbox(params['params']))
+    flat_b = jax.tree_util.tree_leaves_with_path(loaded['params'])
+    assert len(flat_a) == len(flat_b)
+    for (pa, a), (pb, b) in zip(sorted(flat_a, key=lambda x: str(x[0])),
+                                sorted(flat_b, key=lambda x: str(x[0]))):
+        assert str(pa) == str(pb)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=0, err_msg=str(pa))
+
+
+def test_mixtral_logits_match_transformers(mixtral_ckpt):
+    """Our MoE model on loaded weights == HF MixtralForCausalLM on the
+    same checkpoint. Dropless (high capacity) so no tokens drop."""
+    torch = pytest.importorskip('torch')
+    transformers = pytest.importorskip('transformers')
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg, _, _, ckpt_dir = mixtral_ckpt
+    hf_model = transformers.MixtralForCausalLM.from_pretrained(
+        ckpt_dir, torch_dtype=torch.float32)
+    hf_model.eval()
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 12))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+
+    dropless = dataclasses.replace(moe_cfg, capacity_factor=8.0)
+    model = moe.MixtralModel(cfg, dropless)
+    loaded = weights.load_mixtral_params(cfg, dropless, ckpt_dir)
+    ours = np.asarray(model.apply(loaded, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_engine_from_mixtral_checkpoint_serves(mixtral_ckpt):
+    """build_engine auto-detects model_type=mixtral and serves it."""
+    from skypilot_tpu.infer import server as server_lib
+
+    cfg, moe_cfg, model, params, ckpt_dir = mixtral_ckpt
+    eng = server_lib.build_engine(checkpoint=ckpt_dir, num_slots=2,
+                                  max_seq_len=64, dtype='float32')
+    eng.start()
+    try:
+        out = eng.generate([5, 9, 2, 31], engine_lib.SamplingParams(
+            max_new_tokens=8))
+    finally:
+        eng.stop()
+    assert len(out) == 8
+
+
+def test_mixtral_int8_stream_load_matches_post_quantize(mixtral_ckpt):
+    """Expert weights stream-quantize on host; router/norms stay float;
+    tree matches quantize_params(load(...))."""
+    from skypilot_tpu.models import quant
+
+    cfg, moe_cfg, _, _, ckpt_dir = mixtral_ckpt
+    want = quant.quantize_params(
+        weights.load_mixtral_params(cfg, moe_cfg, ckpt_dir))
+    got = weights.load_mixtral_params(cfg, moe_cfg, ckpt_dir,
+                                      quantize='int8')
+    la = jax.tree.leaves_with_path(want)
+    lb = jax.tree.leaves_with_path(got)
+    assert [p for p, _ in la] == [p for p, _ in lb]
+    n_int8 = 0
+    for (path, a), (_, b) in zip(la, lb):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype, path
+        if a.dtype == np.int8:
+            n_int8 += 1
+            assert np.abs(a.astype(np.int32) -
+                          b.astype(np.int32)).max() <= 1, path
+        else:
+            np.testing.assert_allclose(a.astype(np.float32),
+                                       b.astype(np.float32),
+                                       rtol=1e-5, atol=1e-8)
+    # 3 expert tensors + lm_head at minimum went int8; router did not.
+    assert n_int8 >= 4
+    router = got['params']['layers']['moe_mlp']['router']
+    assert router.dtype != np.int8

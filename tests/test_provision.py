@@ -368,3 +368,26 @@ def test_gcp_multislice_wait_requires_all_slices_ready(monkeypatch):
     # Second poll round saw both READY; the bare 'ms' node was never
     # queried.
     assert not any(u.endswith('/nodes/ms') for u in calls)
+
+
+def test_gcp_multislice_query_stable_ranks_while_creating(monkeypatch):
+    """A CREATING slice reports 0 endpoints; rank ids must not shift
+    the READY slice's hosts into its range."""
+    from skypilot_tpu.provision.gcp import instance as gcp_instance
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    monkeypatch.setenv('SKYT_GCP_TOKEN', 'fake-token')
+    sess, _ = _fake_session([
+        ('GET', '/nodes/ms-s0', 200, {'state': 'CREATING',
+                                      'networkEndpoints': []}),
+        ('GET', '/nodes/ms-s1', 200, {
+            'state': 'READY',
+            'networkEndpoints': [{'ipAddress': '10.0.1.2'},
+                                 {'ipAddress': '10.0.1.3'}]}),
+    ])
+    monkeypatch.setattr(tpu_api, '_session', sess)
+    out = gcp_instance.query_instances(
+        'ms', {'project': 'p', 'availability_zone': 'z',
+               'num_slices': 2, 'hosts_per_slice': 2})
+    assert out == {'ms-host-0': 'pending', 'ms-host-1': 'pending',
+                   'ms-host-2': 'running', 'ms-host-3': 'running'}
