@@ -25,6 +25,10 @@ class Checkpointer:
         import orbax.checkpoint as ocp
         self.directory = os.path.expanduser(directory)
         if not self.directory.startswith('gs://'):
+            # Orbax requires absolute paths; a relative --checkpoint-dir
+            # otherwise fails mid-save (and async saves fail half-
+            # silently on a background thread).
+            self.directory = os.path.abspath(self.directory)
             os.makedirs(self.directory, exist_ok=True)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=keep,

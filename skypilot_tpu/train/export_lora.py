@@ -1,0 +1,111 @@
+"""Merge trained LoRA adapters into a base checkpoint and export HF-format.
+
+Closes the finetune->serve loop (reference analog: torchtune LoRA
+checkpoint merge in llm/llama-3_1-finetuning, then serving the merged
+weights via vLLM):
+
+    python -m skypilot_tpu.train.sft --model llama3-8b \
+        --base-checkpoint /ckpts/llama3-8b --lora-rank 16 \
+        --checkpoint-dir /ckpts/lora-run ...
+    python -m skypilot_tpu.train.export_lora \
+        --base /ckpts/llama3-8b --adapter /ckpts/lora-run \
+        --out /ckpts/llama3-8b-merged --lora-rank 16
+    python -m skypilot_tpu.infer.server --checkpoint /ckpts/llama3-8b-merged
+
+The adapter dir is the sft run's Orbax checkpoint dir (latest step is
+restored); --lora-rank/--lora-alpha must match the training flags
+(rank is cross-checked against the restored adapter shapes).
+"""
+import argparse
+import os
+
+import jax
+
+if os.environ.get('JAX_PLATFORMS'):
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--base', required=True,
+                        help='HF-format base checkpoint dir')
+    parser.add_argument('--adapter', required=True,
+                        help='Orbax checkpoint dir from the sft LoRA run')
+    parser.add_argument('--out', required=True,
+                        help='output HF-format checkpoint dir')
+    parser.add_argument('--lora-rank', type=int, default=16)
+    parser.add_argument('--lora-alpha', type=float, default=16.0)
+    args = parser.parse_args(argv)
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import weights
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    from skypilot_tpu.train import lora as lora_lib
+    from skypilot_tpu.train import trainer
+    from skypilot_tpu.utils import log_utils
+
+    logger = log_utils.init_logger(__name__)
+
+    import jax.numpy as jnp
+
+    # Same model-family routing as sft's --base-checkpoint (LoRA on
+    # Mixtral adapts the attention projections; experts have no
+    # 'kernel'-scoped leaves so they stay untouched).
+    if weights.checkpoint_model_type(args.base) == 'mixtral':
+        from skypilot_tpu.models import moe as moe_lib
+        cfg, moe_cfg = weights.load_mixtral_config(args.base, remat=False)
+        base = weights.load_mixtral_params(cfg, moe_cfg, args.base)
+        model = moe_lib.MixtralModel(cfg, moe_cfg)
+
+        def save_merged(variables, out_dir):
+            weights.save_hf_mixtral_checkpoint(cfg, moe_cfg, variables,
+                                               out_dir)
+    else:
+        cfg = weights.load_config(args.base, remat=False)
+        base = weights.load_llama_params(cfg, args.base)
+        model = llama.LlamaModel(cfg)
+
+        def save_merged(variables, out_dir):
+            weights.save_hf_checkpoint(cfg, variables, out_dir)
+
+    lora_cfg = lora_lib.LoRAConfig(rank=args.lora_rank,
+                                   alpha=args.lora_alpha)
+    # Rebuild the adapter state's STRUCTURE exactly the way the sft run
+    # did (same boxed-params init path) — Orbax restores into a
+    # like-structured tree, and a template built from raw loaded arrays
+    # differs from the training-time structure. eval_shape keeps it
+    # abstract: no full model/optimizer state is ever materialized
+    # (matters at 8B+, where the f32 Adam state alone is ~2x params).
+    tcfg = trainer.TrainerConfig()
+    tx = trainer.make_optimizer(tcfg)
+    sample = jnp.zeros((1, 8), jnp.int32)
+
+    def _template(rng):
+        variables = model.init(rng, sample)
+        return lora_lib.create_lora_state(model, variables['params'],
+                                          tx, lora_cfg, rng)
+    state = jax.eval_shape(_template, jax.random.PRNGKey(0))
+    ckpt = ckpt_lib.Checkpointer(args.adapter, async_save=False)
+    restored = ckpt.restore(state)
+    if restored is None:
+        raise SystemExit(f'no checkpoint found under {args.adapter}')
+    step = int(jax.device_get(restored.step))
+
+    # Shape cross-check: a mismatched --lora-rank restores garbage.
+    a_leaf = next(x for x in jax.tree.leaves(restored.params)
+                  if x.ndim >= 2)
+    if a_leaf.shape[-1] != args.lora_rank and \
+            a_leaf.shape[-2] != args.lora_rank:
+        raise SystemExit(
+            f'adapter rank in checkpoint ({a_leaf.shape}) does not '
+            f'match --lora-rank {args.lora_rank}')
+
+    merged = jax.jit(lambda p, l: lora_lib.merge_lora(p, l, lora_cfg))(
+        base['params'], restored.params)
+    save_merged({'params': merged}, args.out)
+    logger.info('merged adapter (step %d, rank %d) into %s -> %s',
+                step, args.lora_rank, args.base, args.out)
+
+
+if __name__ == '__main__':
+    main()

@@ -99,6 +99,11 @@ def main(argv=None) -> None:
                              'train (reference: llm/llama-3_1-finetuning'
                              '/lora.yaml)')
     parser.add_argument('--lora-alpha', type=float, default=16.0)
+    parser.add_argument('--base-checkpoint', default=None,
+                        help='HF-format checkpoint dir: start from real '
+                             'weights instead of random init (the '
+                             'finetune case; required for meaningful '
+                             'LoRA). Loaded mesh-sharded.')
     parser.add_argument('--checkpoint-dir', default=None)
     parser.add_argument('--checkpoint-every', type=int, default=100)
     parser.add_argument('--resume', default='auto',
@@ -157,6 +162,47 @@ def main(argv=None) -> None:
     state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
                                             jax.random.PRNGKey(0))
 
+    ckpt = None
+    if args.checkpoint_dir:
+        from skypilot_tpu.train import checkpoint as ckpt_lib
+        ckpt = ckpt_lib.Checkpointer(
+            args.checkpoint_dir,
+            save_interval_steps=args.checkpoint_every)
+    will_resume = (ckpt is not None and args.resume == 'auto'
+                   and ckpt.latest_step() is not None)
+
+    if args.base_checkpoint and will_resume and args.lora_rank == 0:
+        # Full-finetune restart: the resume checkpoint holds the whole
+        # state, so streaming the HF base in first would only burn
+        # restart latency and transiently double param memory.
+        logger.info('resume checkpoint found; skipping base load')
+    elif args.base_checkpoint:
+        # Finetune from real weights: replace the randomly initialized
+        # params with the checkpoint's, loaded straight into the same
+        # sharded layout (models/weights.py device_puts per leaf).
+        from skypilot_tpu.models import weights as weights_lib
+        import flax.linen as nn_meta
+        ckpt_type = weights_lib.checkpoint_model_type(
+            args.base_checkpoint)
+        is_moe_model = args.model in moe.MIXTRAL_CONFIGS
+        if (ckpt_type == 'mixtral') != is_moe_model:
+            raise SystemExit(
+                f'--base-checkpoint is {ckpt_type!r} but --model '
+                f'{args.model!r} is {"MoE" if is_moe_model else "dense"}')
+        if is_moe_model:
+            loaded = weights_lib.load_mixtral_params(
+                cfg, moe_cfg, args.base_checkpoint, mesh=mesh)['params']
+        else:
+            loaded = weights_lib.load_llama_params(
+                cfg, args.base_checkpoint, mesh=mesh)['params']
+        boxed = jax.tree.map(
+            lambda box, arr: box.replace_boxed(arr)
+            if isinstance(box, nn_meta.meta.AxisMetadata) else arr,
+            state.params, loaded,
+            is_leaf=lambda x: isinstance(x, nn_meta.meta.AxisMetadata))
+        state = state.replace(params=boxed)
+        logger.info('loaded base checkpoint %s', args.base_checkpoint)
+
     lora_cfg = None
     if args.lora_rank > 0:
         from skypilot_tpu.train import lora as lora_lib
@@ -168,19 +214,13 @@ def main(argv=None) -> None:
         logger.info('LoRA: %d trainable params',
                     lora_lib.num_lora_params(state.params))
 
-    ckpt = None
     start_step = 0
-    if args.checkpoint_dir:
-        from skypilot_tpu.train import checkpoint as ckpt_lib
-        ckpt = ckpt_lib.Checkpointer(
-            args.checkpoint_dir,
-            save_interval_steps=args.checkpoint_every)
-        if args.resume == 'auto':
-            restored = ckpt.restore(state)
-            if restored is not None:
-                state = restored
-                start_step = int(jax.device_get(state.step))
-                logger.info('resumed from step %d', start_step)
+    if ckpt is not None and args.resume == 'auto':
+        restored = ckpt.restore(state)
+        if restored is not None:
+            state = restored
+            start_step = int(jax.device_get(state.step))
+            logger.info('resumed from step %d', start_step)
 
     if lora_cfg is not None:
         from skypilot_tpu.train import lora as lora_lib
