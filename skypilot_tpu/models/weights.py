@@ -391,6 +391,33 @@ def save_hf_mixtral_checkpoint(cfg, moe_cfg, variables: Dict[str, Any],
         json.dump(hf, f, indent=2)
 
 
+def load_checkpoint(ckpt_dir: str, *, mesh=None,
+                    quantize: str = 'none',
+                    param_dtype: Optional[str] = None,
+                    **config_overrides):
+    """Family-dispatching loader: (cfg, moe_cfg_or_None, model, params).
+
+    The one place that routes a checkpoint dir to the right config/
+    loader/model constructor (llama vs mixtral) — sft --base-checkpoint,
+    export_lora, and any future tool share it instead of copying the
+    routing."""
+    from skypilot_tpu.models import llama as llama_lib
+
+    if checkpoint_model_type(ckpt_dir) == 'mixtral':
+        from skypilot_tpu.models import moe as moe_lib
+        cfg, moe_cfg = load_mixtral_config(ckpt_dir, **config_overrides)
+        model = moe_lib.MixtralModel(cfg, moe_cfg)
+        params = load_mixtral_params(cfg, moe_cfg, ckpt_dir, mesh=mesh,
+                                     quantize=quantize,
+                                     param_dtype=param_dtype)
+        return cfg, moe_cfg, model, params
+    cfg = load_config(ckpt_dir, **config_overrides)
+    model = llama_lib.LlamaModel(cfg)
+    params = load_llama_params(cfg, ckpt_dir, mesh=mesh,
+                               quantize=quantize, param_dtype=param_dtype)
+    return cfg, None, model, params
+
+
 def save_hf_checkpoint(cfg, variables: Dict[str, Any],
                        out_dir: str) -> None:
     """Inverse of load_llama_params: write our params as an HF-format

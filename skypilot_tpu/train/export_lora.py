@@ -13,22 +13,26 @@ weights via vLLM):
     python -m skypilot_tpu.infer.server --checkpoint /ckpts/llama3-8b-merged
 
 The adapter dir is the sft run's Orbax checkpoint dir (latest step is
-restored); --lora-rank/--lora-alpha must match the training flags
-(rank is cross-checked against the restored adapter shapes).
+restored); --lora-rank/--lora-alpha must match the training flags.
+Handles llama and mixtral bases (LoRA adapts the attention/projection
+kernels either way).
 """
 import argparse
-import os
 
 import jax
 
-if os.environ.get('JAX_PLATFORMS'):
-    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+# Host-side tool: the merge runs on CPU regardless of what accelerator
+# is attached — full-precision base params (e.g. 32GB at 8B f32) belong
+# in host RAM, not a 16GB chip's HBM, and the export must work even
+# when the TPU is busy or unreachable.
+jax.config.update('jax_platforms', 'cpu')
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--base', required=True,
-                        help='HF-format base checkpoint dir')
+                        help='HF-format base checkpoint dir '
+                             '(llama or mixtral)')
     parser.add_argument('--adapter', required=True,
                         help='Orbax checkpoint dir from the sft LoRA run')
     parser.add_argument('--out', required=True,
@@ -37,7 +41,8 @@ def main(argv=None) -> None:
     parser.add_argument('--lora-alpha', type=float, default=16.0)
     args = parser.parse_args(argv)
 
-    from skypilot_tpu.models import llama
+    import jax.numpy as jnp
+
     from skypilot_tpu.models import weights
     from skypilot_tpu.train import checkpoint as ckpt_lib
     from skypilot_tpu.train import lora as lora_lib
@@ -46,26 +51,14 @@ def main(argv=None) -> None:
 
     logger = log_utils.init_logger(__name__)
 
-    import jax.numpy as jnp
+    cfg, moe_cfg, model, base = weights.load_checkpoint(args.base,
+                                                        remat=False)
 
-    # Same model-family routing as sft's --base-checkpoint (LoRA on
-    # Mixtral adapts the attention projections; experts have no
-    # 'kernel'-scoped leaves so they stay untouched).
-    if weights.checkpoint_model_type(args.base) == 'mixtral':
-        from skypilot_tpu.models import moe as moe_lib
-        cfg, moe_cfg = weights.load_mixtral_config(args.base, remat=False)
-        base = weights.load_mixtral_params(cfg, moe_cfg, args.base)
-        model = moe_lib.MixtralModel(cfg, moe_cfg)
-
-        def save_merged(variables, out_dir):
+    def save_merged(variables, out_dir):
+        if moe_cfg is not None:
             weights.save_hf_mixtral_checkpoint(cfg, moe_cfg, variables,
                                                out_dir)
-    else:
-        cfg = weights.load_config(args.base, remat=False)
-        base = weights.load_llama_params(cfg, args.base)
-        model = llama.LlamaModel(cfg)
-
-        def save_merged(variables, out_dir):
+        else:
             weights.save_hf_checkpoint(cfg, variables, out_dir)
 
     lora_cfg = lora_lib.LoRAConfig(rank=args.lora_rank,
@@ -85,20 +78,36 @@ def main(argv=None) -> None:
         return lora_lib.create_lora_state(model, variables['params'],
                                           tx, lora_cfg, rng)
     state = jax.eval_shape(_template, jax.random.PRNGKey(0))
+
     ckpt = ckpt_lib.Checkpointer(args.adapter, async_save=False)
-    restored = ckpt.restore(state)
-    if restored is None:
+    if ckpt.latest_step() is None:
         raise SystemExit(f'no checkpoint found under {args.adapter}')
+    try:
+        restored = ckpt.restore(state)
+    except Exception as e:  # pylint: disable=broad-except
+        # The usual cause: --lora-rank (or --model size) differs from
+        # the training run, so the template's adapter shapes don't
+        # match the saved arrays and Orbax refuses the restore.
+        raise SystemExit(
+            f'adapter restore failed — do --lora-rank '
+            f'{args.lora_rank} and the base model match the sft run '
+            f'that wrote {args.adapter}?\n  {e}') from e
     step = int(jax.device_get(restored.step))
 
-    # Shape cross-check: a mismatched --lora-rank restores garbage.
-    a_leaf = next(x for x in jax.tree.leaves(restored.params)
-                  if x.ndim >= 2)
-    if a_leaf.shape[-1] != args.lora_rank and \
-            a_leaf.shape[-2] != args.lora_rank:
+    # Explicit rank check: Orbax can silently restore a different-rank
+    # adapter into the template (observed: rank-2 arrays into a rank-4
+    # template), and the merge would then apply the WRONG alpha/rank
+    # scaling without any error.
+    got_rank = next(
+        leaf.shape[-1]
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            restored.params)
+        if path and getattr(path[-1], 'key', None) == 'a')
+    if got_rank != args.lora_rank:
         raise SystemExit(
-            f'adapter rank in checkpoint ({a_leaf.shape}) does not '
-            f'match --lora-rank {args.lora_rank}')
+            f'adapter in {args.adapter} has rank {got_rank}, but '
+            f'--lora-rank is {args.lora_rank}; the merge scaling '
+            f'(alpha/rank) would be wrong — pass the training rank.')
 
     merged = jax.jit(lambda p, l: lora_lib.merge_lora(p, l, lora_cfg))(
         base['params'], restored.params)

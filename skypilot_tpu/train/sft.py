@@ -189,6 +189,20 @@ def main(argv=None) -> None:
             raise SystemExit(
                 f'--base-checkpoint is {ckpt_type!r} but --model '
                 f'{args.model!r} is {"MoE" if is_moe_model else "dense"}')
+        # Fail fast on a wrong-SIZE checkpoint BEFORE the multi-minute
+        # weight stream: the loaders take shapes from the checkpoint,
+        # and a mismatch would otherwise surface as an opaque einsum
+        # error at the first train step.
+        ckpt_cfg = (weights_lib.load_mixtral_config(args.base_checkpoint)
+                    [0] if is_moe_model
+                    else weights_lib.load_config(args.base_checkpoint))
+        for f in ('dim', 'n_layers', 'n_heads', 'n_kv_heads', 'mlp_dim',
+                  'vocab_size'):
+            if getattr(ckpt_cfg, f) != getattr(cfg, f):
+                raise SystemExit(
+                    f'--base-checkpoint {f}={getattr(ckpt_cfg, f)} does '
+                    f'not match --model {args.model!r} '
+                    f'{f}={getattr(cfg, f)}')
         if is_moe_model:
             loaded = weights_lib.load_mixtral_params(
                 cfg, moe_cfg, args.base_checkpoint, mesh=mesh)['params']

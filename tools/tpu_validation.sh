@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# The blocked on-chip checklist (VERDICT r3 items 1-2): run the moment
+# the TPU tunnel answers. One command; artifacts land in
+# /tmp/tpu_validation/.
+#
+#   bash tools/tpu_validation.sh
+#
+# Steps:
+#   1. probe the chip (45s bound; exit early if wedged)
+#   2. tests_tpu/ lowering gate on-chip (covers flash attention, both
+#      paged-attention kernels, int8, chunked prefill, spec decode)
+#   3. train MFU with remat=full vs remat=dots (pick the better;
+#      floor 0.7691 from round 1, target >= 0.85)
+#   4. full bench.py -> the BENCH artifact
+#
+# After: if step 2 is green, flip SKYT_SPEC_PAGED_ATTN default to
+# 'pallas' (models/llama.py) and collapse _kernel into _kernel_mq(t=1)
+# in ops/paged_attention.py (equivalence proven by
+# test_t1_matches_single_query_kernel).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT=/tmp/tpu_validation
+mkdir -p "$OUT"
+FAIL=0
+
+step() {  # step <name> <cmd...>: run, tee, record PASS/FAIL
+    local name=$1; shift
+    if "$@" 2>&1 | tee "$OUT/$name.txt"; then
+        echo "== $name: PASS =="
+    else
+        echo "== $name: FAIL (see $OUT/$name.txt) =="
+        FAIL=1
+    fi
+}
+
+echo "== 1. probe =="
+if ! timeout 45 python -c "import jax; print(jax.devices())"; then
+    echo "tunnel wedged; aborting (re-run later)"; exit 1
+fi
+
+echo "== 2. tests_tpu gate =="
+step tests_tpu timeout 1800 python -m pytest tests_tpu/ -q
+
+echo "== 3. remat comparison (train phase only, via bench) =="
+for pol in full dots; do
+    echo "-- remat=$pol --"
+    SKYT_BENCH_REMAT=$pol SKYT_BENCH_INIT_RETRY_S=120 \
+        timeout 2000 python - <<'PYEOF' 2>&1 | tee "$OUT/remat_$pol.txt"
+import bench
+dev = bench._acquire_device()
+mfu, name = bench.train_mfu(dev, dev.platform == 'tpu')
+print(f'REMAT_RESULT {name} mfu={mfu:.4f}')
+PYEOF
+done
+
+echo "== 4. full bench =="
+if timeout 5400 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.json"
+then
+    echo "== bench: PASS =="
+else
+    echo "== bench: FAIL (see $OUT/bench.err) =="
+    FAIL=1
+fi
+
+echo "artifacts in $OUT"
+if [ "$FAIL" = "1" ]; then
+    echo "OVERALL: FAIL — do NOT flip kernel defaults"; exit 1
+fi
+echo "OVERALL: PASS — safe to flip SKYT_SPEC_PAGED_ATTN to 'pallas'"
