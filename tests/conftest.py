@@ -43,3 +43,42 @@ def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'heavy: compile-heavy JAX suites / long subprocess '
         'suites excluded from the fast tier (see format.sh)')
+
+
+@pytest.fixture(scope='session', autouse=True)
+def _reap_orphaned_test_agents(tmp_path_factory):
+    """Kill pytest-spawned runtime agents left running at session end.
+    Some kill -9 scenarios (dead-controller tests) can leave an agent
+    polling forever — 0.3% CPU + ~200MB each on the 1-core host.
+
+    Two precise rules (so concurrent pytest sessions never kill each
+    other's live agents):
+      * any agent whose --config lives under THIS session's basetemp —
+        every cluster of ours is down by now, so a survivor is an
+        orphan (pytest retains the last 3 basetemps, so "config file
+        still exists" does NOT imply live);
+      * any agent whose --config file no longer exists (stale leftover
+        from an older, rotated-out session).
+    """
+    yield
+    import re
+    import signal as sig
+    import subprocess
+    base = str(tmp_path_factory.getbasetemp().resolve())
+    try:
+        out = subprocess.run(['ps', '-eo', 'pid,args'], text=True,
+                             capture_output=True, timeout=10).stdout
+    except Exception:  # pylint: disable=broad-except
+        return
+    for line in out.splitlines():
+        m = re.search(r'^\s*(\d+)\s+.*skypilot_tpu\.runtime\.agent'
+                      r'\s+--config\s+(\S+)', line)
+        if not m:
+            continue
+        cfg_path = m.group(2)
+        ours = os.path.realpath(cfg_path).startswith(base + os.sep)
+        if ours or not os.path.exists(cfg_path):
+            try:
+                os.kill(int(m.group(1)), sig.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
