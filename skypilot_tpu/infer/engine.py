@@ -70,6 +70,80 @@ def _round_up_pow2(n: int, lo: int = 32) -> int:
     return b
 
 
+def _topk_filter(logits, topks):
+    """Per-slot top-k filter over [..., V] logits: entries below each
+    slot's k-th value become -inf; k == 0 disables. topks broadcasts
+    over any leading axes after the slot axis (axis 0)."""
+    kvals, _ = jax.lax.top_k(logits, min(_TOPK_BUCKET, logits.shape[-1]))
+    k_idx = jnp.clip(topks - 1, 0, kvals.shape[-1] - 1)
+    idx = k_idx.reshape(k_idx.shape + (1,) * (logits.ndim - k_idx.ndim))
+    kth = jnp.take_along_axis(kvals, idx, axis=-1)
+    mask = topks.reshape(topks.shape + (1,) * (logits.ndim - topks.ndim))
+    return jnp.where(jnp.logical_and(mask > 0, logits < kth),
+                     -jnp.inf, logits)
+
+
+def speculative_sample_step(logits, draft, temps, topks, keys):
+    """One slot-batched speculative-sampling verify step (the exact
+    rejection rule; standalone so its distribution is unit-testable).
+
+    logits [SLOTS, k+1, V] f32 — target logits at the k draft positions
+    plus the bonus position; draft [SLOTS, k] int32 — point-mass draft
+    tokens (prompt-lookup); temps/topks [SLOTS]; keys [SLOTS] per-slot
+    PRNG keys (this step's draws; caller advances them between steps).
+
+    Greedy slots (temp == 0): accept while draft == argmax, emit argmax
+    rows — identical to the deterministic verify. Sampled slots: accept
+    d_i with probability p_i(d_i) (p = softmax of top-k-filtered
+    logits / temp); at the first rejection sample from the residual
+    (p_i with d_i zeroed, renormalized), and after k accepts sample the
+    bonus token from p_k unmodified. The emitted token stream is
+    distributed EXACTLY as sequential sampling from p (Leviathan et al.
+    speculative sampling with a deterministic proposer).
+
+    Returns (out [SLOTS, k+1] emitted tokens — first acc+1 valid,
+    acc [SLOTS] accepted-draft counts).
+    """
+    slots, k1, _ = logits.shape
+    k = k1 - 1
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
+    g_match = (draft == greedy[:, :k])
+
+    filtered = _topk_filter(logits, topks)
+    probs = jax.nn.softmax(
+        filtered / jnp.maximum(temps, 1e-6)[:, None, None], axis=-1)
+    ks = jax.vmap(jax.random.split)(keys)        # [SLOTS, 2, key]
+    ku, kr = ks[:, 0], ks[:, 1]
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(ku)
+    p_draft = jnp.take_along_axis(probs[:, :k, :], draft[:, :, None],
+                                  axis=-1)[:, :, 0]
+    s_accept = u < p_draft
+    accept = jnp.where(temps[:, None] > 0, s_accept, g_match)
+    acc = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+    # Distribution at the emission position (index acc): residual with
+    # the rejected draft zeroed when acc < k, the bonus p_k otherwise.
+    p_at = jnp.take_along_axis(probs, acc[:, None, None],
+                               axis=1)[:, 0, :]            # [S, V]
+    d_pad = jnp.concatenate([draft, jnp.zeros((slots, 1), jnp.int32)],
+                            axis=1)
+    d_at = jnp.take_along_axis(d_pad, acc[:, None], axis=1)[:, 0]
+    exclude = (acc < k)
+    onehot = jax.nn.one_hot(d_at, probs.shape[-1], dtype=probs.dtype)
+    resid = jnp.where(exclude[:, None], p_at * (1.0 - onehot), p_at)
+    # All-mass-on-draft yet rejected cannot happen exactly (accept prob
+    # would be 1), but guard float dust: fall back to p_at.
+    resid = jnp.where(resid.sum(-1, keepdims=True) > 0, resid, p_at)
+    repl = jax.vmap(lambda kk, lr: jax.random.categorical(kk, lr))(
+        kr, jnp.log(resid)).astype(jnp.int32)
+
+    idx = jnp.arange(k + 1)[None, :]
+    s_out = jnp.where(idx < acc[:, None], d_pad,
+                      jnp.where(idx == acc[:, None], repl[:, None], 0))
+    out = jnp.where(temps[:, None] > 0, s_out, greedy)
+    return out, acc
+
+
 def _update_args(args, slot, first_tok, length, temp, key, topk):
     """Write one slot's decode args on device (shared by both insert
     impls)."""
@@ -251,9 +325,10 @@ class InferenceEngine:
                                     static_argnames=('bucket',))
         self._jit_prefill_suffix = jax.jit(self._prefill_suffix_impl,
                                            static_argnames=('bucket',))
-        self._jit_decode_spec = jax.jit(self._decode_spec_impl,
-                                        donate_argnums=(1, 4),
-                                        static_argnames=('n', 'k'))
+        self._jit_decode_spec = jax.jit(
+            self._decode_spec_impl,
+            donate_argnums=(1, 5, 7),   # cache, keys, hist
+            static_argnames=('n', 'k', 'sampling'))
         self._jit_hist_insert = jax.jit(self._hist_insert_impl,
                                         donate_argnums=(0,))
         # Donate the cache: without it XLA materializes a full cache
@@ -469,16 +544,9 @@ class InferenceEngine:
                         write_hist(hist, lens, greedy)), greedy
             keys = jax.vmap(jax.random.split, in_axes=0,
                             out_axes=0)(keys)[:, 0]
-            # Per-slot top-k (k <= _TOPK_BUCKET) via a fixed top-k sort +
-            # per-slot threshold; k == 0 disables the filter.
-            kvals, _ = jax.lax.top_k(logits,
-                                     min(_TOPK_BUCKET,
-                                         logits.shape[-1]))
-            k_idx = jnp.clip(topks - 1, 0, kvals.shape[-1] - 1)
-            kth = jnp.take_along_axis(kvals, k_idx[:, None], axis=-1)
-            filtered = jnp.where(
-                jnp.logical_and(topks[:, None] > 0, logits < kth),
-                -jnp.inf, logits)
+            # One top-k filter serves the plain AND spec sampling paths
+            # — their target distributions must stay identical.
+            filtered = _topk_filter(logits, topks)
             sampled = jax.vmap(
                 lambda k, lg, t: jax.random.categorical(
                     k, lg / jnp.maximum(t, 1e-6)))(keys, filtered, temps)
@@ -503,16 +571,24 @@ class InferenceEngine:
         return hist.at[slot, length].set(first_tok)
 
     def _decode_spec_impl(self, params, cache, last_tokens, lengths,
-                          hist, n, k):
-        """`n` speculative decode iterations in ONE dispatch (greedy
-        only). Each iteration: propose k draft tokens per slot by
-        matching the history's trailing bigram against its own past
-        (prompt-lookup decoding), run a single s=k+1 forward, accept the
-        longest draft prefix agreeing with the model's greedy argmax,
-        and emit accepted+1 tokens. Drafts never change outputs — a
-        wrong draft is simply rejected — so results are token-identical
-        to the plain greedy path (tested). Returns (toks [n, SLOTS,
-        k+1], counts [n, SLOTS] valid-token counts, ...)."""
+                          temps, keys, topks, hist, n, k, sampling):
+        """`n` speculative decode iterations in ONE dispatch. Each
+        iteration: propose k draft tokens per slot by matching the
+        history's trailing bigram against its own past (prompt-lookup
+        decoding), run a single s=k+1 forward, accept a draft prefix,
+        and emit accepted+1 tokens.
+
+        Greedy slots (temp == 0): accept the longest prefix agreeing
+        with the model's argmax — token-identical to the plain greedy
+        path (tested). Sampled slots (`sampling` static, like
+        _decode_n_impl's): rejection sampling against a point-mass
+        draft — accept draft d_i with probability p_i(d_i) under the
+        temperature/top-k-filtered target distribution, and on the
+        first rejection draw from the residual (p with d_i excluded,
+        renormalized), which preserves the exact sequential sampling
+        distribution (speculative sampling, tested distributionally via
+        speculative_sample_step). Returns (toks [n, SLOTS, k+1],
+        counts [n, SLOTS] valid-token counts, ...)."""
         s_hist = hist.shape[1]
 
         def propose(h, length):
@@ -529,30 +605,43 @@ class InferenceEngine:
                 h, (jnp.clip(i + 2, 0, s_hist - k),), (k,))
 
         def step(carry, _):
-            cache, last, lens, hist = carry
+            cache, last, lens, keys, hist = carry
             draft = jax.vmap(propose)(hist, lens)        # [SLOTS, k]
             toks_in = jnp.concatenate([last[:, None], draft], axis=1)
             positions = lens[:, None] + jnp.arange(k + 1)[None, :]
             logits, cache = self.model.apply(
                 params, toks_in, positions=positions, cache=cache)
-            g = jnp.argmax(logits.astype(jnp.float32),
-                           axis=-1).astype(jnp.int32)    # [SLOTS, k+1]
-            match = (draft == g[:, :k]).astype(jnp.int32)
-            acc = jnp.cumprod(match, axis=1).sum(axis=1)  # [SLOTS] 0..k
-            new_last = jnp.take_along_axis(g, acc[:, None],
+            logits = logits.astype(jnp.float32)          # [SLOTS, k+1, V]
+            if sampling:
+                # Advance each slot's key; this step draws from the
+                # sibling so re-runs never reuse a consumed stream.
+                ks2 = jax.vmap(jax.random.split)(keys)
+                step_keys, draw_keys = ks2[:, 0], ks2[:, 1]
+                out, acc = speculative_sample_step(
+                    logits, draft, temps, topks, draw_keys)
+            else:
+                # Greedy-only compile: no softmax/top-k/categorical ops.
+                step_keys = keys
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                match = (draft == g[:, :k]).astype(jnp.int32)
+                acc = jnp.cumprod(match, axis=1).sum(axis=1)  # 0..k
+                out = g
+            new_last = jnp.take_along_axis(out, acc[:, None],
                                            axis=1)[:, 0]
             # Write all k+1 emitted candidates; entries past acc+1 are
             # junk the proposer never reads (its window stops at lens).
             hist = jax.vmap(
                 lambda h, row, i: jax.lax.dynamic_update_slice(
-                    h, row, (i,)))(hist, g, lens + 1)
-            return (cache, new_last, lens + acc + 1, hist), (g, acc + 1)
+                    h, row, (i,)))(hist, out, lens + 1)
+            return (cache, new_last, lens + acc + 1, step_keys, hist), \
+                (out, acc + 1)
 
-        (cache, last, lens, hist), (toks, counts) = jax.lax.scan(
-            step, (cache, last_tokens, lengths, hist), None, length=n)
+        (cache, last, lens, keys, hist), (toks, counts) = jax.lax.scan(
+            step, (cache, last_tokens, lengths, keys, hist), None,
+            length=n)
         if 'tables' in cache:
             cache = self._pin_paged_layouts(cache)
-        return toks, counts, cache, last, lens, hist
+        return toks, counts, cache, last, lens, keys, hist
 
     # ----------------------------------------------------------- sampling
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
@@ -1063,10 +1152,10 @@ class InferenceEngine:
                 sampling = any(self._temps[i] > 0 for i in active)
                 k = self.spec_decode
                 # Speculation needs headroom for the worst case (every
-                # draft accepted) and greedy-only slots; otherwise fall
-                # back to the plain path for this chunk.
-                use_spec = k > 0 and not sampling and \
-                    rem_space // (k + 1) >= 1
+                # draft accepted); sampled slots ride the rejection-
+                # sampling verify (speculative_sample_step) — no
+                # greedy-only restriction.
+                use_spec = k > 0 and rem_space // (k + 1) >= 1
                 self._ensure_dev_args()
                 d_last, d_lens, d_temps, d_keys, d_topks = self._dev_args
                 entries = [(i, self._slots[i]) for i in active]
@@ -1076,9 +1165,12 @@ class InferenceEngine:
                     chunk = 1 << (bound.bit_length() - 1)
                     with self._ctx():
                         toks, counts, self.cache, d_last, d_lens, \
-                            self._dev_hist = self._jit_decode_spec(
+                            d_keys, self._dev_hist = \
+                            self._jit_decode_spec(
                                 self.params, self.cache, d_last, d_lens,
-                                self._dev_hist, n=chunk, k=k)
+                                d_temps, d_keys, d_topks,
+                                self._dev_hist, n=chunk, k=k,
+                                sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, d_keys,
                                       d_topks)
                     new_pending = ('spec', toks, counts, entries, chunk)

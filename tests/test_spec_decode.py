@@ -113,10 +113,10 @@ def test_spec_pallas_mq_path_matches(monkeypatch):
         _run(spec, prompts, max_new=12)
 
 
-def test_spec_with_sampling_mix_falls_back():
-    """A batch containing a temperature-sampled request must route
-    through the plain path (speculation is greedy-only) and still finish
-    both requests."""
+def test_spec_with_sampling_mix_rides_spec_path():
+    """A batch mixing greedy and temperature-sampled requests rides the
+    SPEC path (rejection-sampling verify for the sampled slot, argmax
+    verify for the greedy one) and finishes both."""
     model, params = _model_and_params()
     vocab = model.cfg.vocab_size
     spec = engine_lib.InferenceEngine(model, params, num_slots=2,
@@ -139,6 +139,7 @@ def test_spec_with_sampling_mix_falls_back():
                     break
                 toks.append(t)
             assert len(toks) == 8
+        assert spec.perf['spec_verify_steps'] > 0
     finally:
         spec.stop()
 
@@ -261,3 +262,66 @@ def test_spec_non_pow2_max_seq_hist_width():
     out_s = _run(spec, [prompt], max_new=8)
     assert out_p == out_s
     assert all(len(o) == 8 for o in out_s)
+
+
+def test_speculative_sample_step_unbiased():
+    """The rejection rule's first emitted token must be distributed
+    EXACTLY as sequential sampling from the target distribution —
+    accept d w.p. p(d), else residual — regardless of which draft the
+    proposer picked (the speculative-sampling guarantee)."""
+    import jax.numpy as jnp
+
+    vocab, k, trials = 8, 2, 20000
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(1, k + 1, vocab)) * 2.0,
+                         jnp.float32)
+    temps = jnp.asarray([0.7], jnp.float32)
+    # An arbitrary (deliberately mediocre) draft.
+    draft = jnp.asarray([[3, 5]], jnp.int32)
+
+    def run(topk):
+        topks = jnp.asarray([topk], jnp.int32)
+        stepped = jax.jit(jax.vmap(
+            lambda key: engine_lib.speculative_sample_step(
+                logits, draft, temps, topks, key[None])))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(trials))
+        out, acc = stepped(keys)
+        return np.asarray(out[:, 0, 0]), np.asarray(acc)
+
+    # topk off: marginal == softmax(logits_0 / T).
+    first, acc = run(0)
+    p0 = np.asarray(jax.nn.softmax(logits[0, 0] / temps[0]))
+    emp = np.bincount(first, minlength=vocab) / trials
+    np.testing.assert_allclose(emp, p0, atol=0.015)
+    # Acceptance really happens (draft token 3 has nonzero mass).
+    assert 0 < int(np.sum(acc > 0)) < trials
+
+    # topk active: marginal == the top-3-FILTERED softmax — exercising
+    # _topk_filter's 3-D broadcast on the spec path.
+    first3, _ = run(3)
+    l0 = np.asarray(logits[0, 0])
+    kth = np.sort(l0)[-3]
+    lf = np.where(l0 < kth, -np.inf, l0) / float(temps[0])
+    p3 = np.exp(lf - lf.max()); p3 /= p3.sum()
+    emp3 = np.bincount(first3, minlength=vocab) / trials
+    np.testing.assert_allclose(emp3, p3, atol=0.015)
+
+
+def test_speculative_sample_step_greedy_slots_exact():
+    """temp == 0 slots are bit-identical to the argmax verify."""
+    import jax.numpy as jnp
+
+    vocab, k = 16, 3
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, k + 1, vocab)), jnp.float32)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    # Slot 0: draft = argmax prefix (fully accepted); slot 1: junk.
+    draft = jnp.asarray([greedy[0, :k], [0, 0, 0]], jnp.int32)
+    temps = jnp.zeros((2,), jnp.float32)
+    topks = jnp.zeros((2,), jnp.int32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2))
+    out, acc = engine_lib.speculative_sample_step(
+        logits, draft, temps, topks, keys)
+    np.testing.assert_array_equal(np.asarray(out), greedy)
+    assert int(acc[0]) == k
+    assert int(acc[1]) == (1 if greedy[1, 0] == 0 else 0)
