@@ -57,6 +57,9 @@ class _Request:
     slot: Optional[int] = None
     generated: int = 0
     rng: Any = None
+    # Set (from any thread) by InferenceEngine.cancel(); the engine
+    # loop releases the slot at the next delivery boundary.
+    cancelled: bool = False
     # Prompt page hashes, computed once at first admission attempt (a
     # deferred request retries every loop tick; re-hashing the prompt
     # each time is O(n) host work for an unchanging value).
@@ -680,6 +683,27 @@ class InferenceEngine:
         self._waiting.put(req)
         return req_id, req.out_queue
 
+    def cancel(self, req_id: int) -> bool:
+        """Cancel a submitted request (any thread). A running slot is
+        released at the next delivery boundary (its queue then yields
+        None); a waiting request is dropped at admission. Returns True
+        if a live request with req_id was found."""
+        found = False
+        for req in list(self._slots):
+            if req is not None and req.req_id == req_id:
+                req.cancelled = True
+                found = True
+        d = self._deferred
+        if d is not None and d.req_id == req_id:
+            d.cancelled = True
+            found = True
+        with self._waiting.mutex:
+            for req in self._waiting.queue:
+                if req.req_id == req_id:
+                    req.cancelled = True
+                    found = True
+        return found
+
     def generate(self, tokens: List[int],
                  params: Optional[SamplingParams] = None) -> List[int]:
         """Blocking convenience: submit + drain."""
@@ -813,6 +837,10 @@ class InferenceEngine:
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 return False
+        if req.cancelled:
+            # Cancelled while waiting: never occupies a slot.
+            req.out_queue.put(None)
+            return True
         slot = self._slots.index(None)
         n = len(req.tokens)
         bucket = self._bucket_for(n)
@@ -997,6 +1025,11 @@ class InferenceEngine:
         if st is None:
             return
         req, slot, row = st['req'], st['slot'], st['row']
+        if req.cancelled:
+            # Abandon the in-progress chunked prefill; _release drops
+            # the slot's pages and clears self._chunked.
+            self._release(slot)
+            return
         start, n, hashes = st['start'], st['n'], st['hashes']
         psize = self.pool.cfg.page_size
         mp_span = self.pool.cfg.max_pages_per_slot * psize
@@ -1220,6 +1253,12 @@ class InferenceEngine:
             for i, req in entries:
                 if self._slots[i] is not req:
                     continue  # finished earlier / slot re-admitted
+                if req.cancelled:
+                    # Cancelled mid-flight: free the slot at this
+                    # delivery boundary; tokens already computed for it
+                    # in this chunk are dropped.
+                    self._release(i)
+                    continue
                 if kind == 'spec':
                     # [chunk, SLOTS, k+1]; first counts[t, i] are valid.
                     run = toks_np[t, i, :int(counts_np[t, i])]

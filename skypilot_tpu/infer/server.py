@@ -16,7 +16,9 @@ service.yaml readiness-probes /v1/models). Endpoints:
                             reference's service.yaml readiness-probes
                             this exact path).
   POST /v1/completions    — OpenAI-compatible completions (prompt str or
-                            list, max_tokens/temperature/stop via eos,
+                            list, max_tokens/temperature/top_k/seed,
+                            stop sequences (request cancelled at match),
+                            n completions per prompt,
                             stream=true -> SSE chunks + [DONE]).
   POST /v1/chat/completions — OpenAI-compatible chat (messages ->
                             a minimal generic chat template; model-
@@ -135,6 +137,127 @@ class InferenceServer:
             eos_token=self.tokenizer.eos_id,
             seed=int(payload.get('seed', 0)))
 
+    @staticmethod
+    def _parse_n(payload) -> Optional[int]:
+        """OpenAI 'n' (completions per prompt): int in [1, 128]
+        (OpenAI's own cap). None => malformed (handlers return 400)."""
+        n = payload.get('n', 1)
+        if isinstance(n, bool) or not isinstance(n, int):
+            return None
+        if not 1 <= n <= 128:
+            return None
+        return n
+
+    @staticmethod
+    def _stops_from_openai(payload) -> Optional[List[str]]:
+        """OpenAI 'stop': a string or list of strings. None => the
+        field is malformed (handlers return 400)."""
+        stop = payload.get('stop')
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            return [stop] if stop else []
+        if isinstance(stop, list) and all(isinstance(s, str)
+                                          for s in stop):
+            return [s for s in stop if s]
+        return None
+
+    def _incremental_decoder(self):
+        """Closure decoding a token stream piece-by-piece; holds
+        tokens whose prefix decode ends in U+FFFD so multi-byte UTF-8
+        sequences never surface as mojibake (pass None to flush)."""
+        held: List[int] = []
+
+        def decode_incremental(tok: Optional[int]) -> Optional[str]:
+            if tok is not None:
+                held.append(tok)
+            if not held:
+                return None
+            text = self.tokenizer.decode(list(held))
+            if tok is not None and text.endswith('\ufffd') and \
+                    len(held) < 4:
+                return None          # likely incomplete; keep holding
+            held.clear()
+            return text or None
+        return decode_incremental
+
+    @staticmethod
+    def _apply_stops(text: str, stops: List[str]) -> 'tuple[str, bool]':
+        """Truncate at the earliest stop-sequence occurrence (the stop
+        itself is not included — OpenAI semantics)."""
+        cut = None
+        for s in stops:
+            i = text.find(s)
+            if i != -1 and (cut is None or i < cut):
+                cut = i
+        if cut is None:
+            return text, False
+        return text[:cut], True
+
+    async def _drain_stopping(self, rid, out_q, params,
+                              stops: List[str]):
+        """Drain a request; with stop sequences, cancel the engine
+        request as soon as one matches so the slot frees immediately
+        instead of running to max_tokens. Returns
+        (text, finish_reason, generated_token_count) — the count is
+        tokens the engine actually produced (the cost), which can
+        exceed the truncated text's length."""
+        loop = asyncio.get_running_loop()
+        if not stops:
+            out = await self._drain(out_q)
+            visible, reason = self._finish(out, params)
+            return self.tokenizer.decode(visible), reason, len(out)
+
+        async def drain_terminal():
+            # Consume through the terminal None so the slot is really
+            # done (released) before we return.
+            while await loop.run_in_executor(
+                    None, functools.partial(out_q.get,
+                                            timeout=300)) is not None:
+                pass
+
+        decode_incremental = self._incremental_decoder()
+        max_stop = max(len(s) for s in stops)
+        acc = ''
+        generated = 0
+
+        def try_stop(piece):
+            # A new match can only END inside the new piece, so search
+            # from max_stop-1 chars before it — O(total) overall, not
+            # O(total^2) like re-decoding everything per token.
+            nonlocal acc
+            lo = max(0, len(acc) - (max_stop - 1))
+            acc += piece
+            text, matched = self._apply_stops(acc[lo:], stops)
+            return (acc[:lo] + text, matched)
+
+        while True:
+            tok = await loop.run_in_executor(
+                None, functools.partial(out_q.get, timeout=300))
+            if tok is None:
+                tail = decode_incremental(None)
+                if tail:
+                    text, matched = try_stop(tail)
+                    if matched:
+                        return text, 'stop', generated
+                return acc, 'length', generated
+            generated += 1
+            if params.eos_token is not None and \
+                    tok == params.eos_token:
+                await drain_terminal()
+                tail = decode_incremental(None)
+                if tail:
+                    acc, _ = try_stop(tail)
+                return acc, 'stop', generated
+            piece = decode_incremental(tok)
+            if piece is None:
+                continue
+            text, matched = try_stop(piece)
+            if matched:
+                self.engine.cancel(rid)
+                await drain_terminal()
+                return text, 'stop', generated
+
     async def _drain(self, out_q) -> List[int]:
         loop = asyncio.get_running_loop()
         out: List[int] = []
@@ -165,39 +288,62 @@ class InferenceServer:
                       'owned_by': 'skypilot-tpu'}],
         })
 
-    async def _sse(self, request, make_chunk, out_q, params):
+    async def _sse(self, request, make_chunk, out_q, params,
+                   stops: Optional[List[str]] = None, rid=None):
         """Stream tokens as OpenAI SSE chunks; a final chunk carries the
-        finish_reason (OpenAI protocol), then [DONE]."""
+        finish_reason (OpenAI protocol), then [DONE]. With stop
+        sequences, emission halts at the earliest match (the stop text
+        is never sent) and the engine request is cancelled."""
         loop = asyncio.get_running_loop()
         resp = web.StreamResponse(
             headers={'Content-Type': 'text/event-stream',
                      'Cache-Control': 'no-cache'})
         await resp.prepare(request)
         saw_eos = False
-        # Multi-byte UTF-8 sequences can span tokens: hold tokens whose
-        # prefix decode ends in U+FFFD until the sequence completes, so
-        # clients never see replacement-char mojibake mid-stream.
-        held: List[int] = []
+        stopped = False
+        acc = ''     # all text produced (for stop matching)
+        sent = 0     # chars of acc already emitted
+        decode_incremental = self._incremental_decoder()
 
-        def decode_incremental(tok: Optional[int]) -> Optional[str]:
-            if tok is not None:
-                held.append(tok)
-            if not held:
-                return None
-            text = self.tokenizer.decode(list(held))
-            # Hold at most 4 tokens (a UTF-8 sequence spans <= 4 bytes):
-            # output that legitimately decodes to U+FFFD — or a
-            # degenerate stream of invalid bytes — must still flow
-            # instead of buffering until end-of-stream.
-            if tok is not None and text.endswith('�') and len(held) < 4:
-                return None          # likely incomplete; keep holding
-            held.clear()
-            return text or None
+        max_stop = max((len(s) for s in stops), default=0) if stops \
+            else 0
+        ended = False   # terminal None already consumed
+
+        async def emit(piece: str, final: bool = False) -> bool:
+            """Send new text, stop-truncated. A partial stop prefix
+            can span token boundaries, so max_stop-1 trailing chars are
+            held back until `final` — the stop text (or any prefix of
+            it) is never sent. True => halt stream."""
+            nonlocal acc, sent, stopped
+            acc += piece
+            if stops:
+                cut_text, matched = self._apply_stops(acc, stops)
+            else:
+                cut_text, matched = acc, False
+            safe_end = len(cut_text) if (matched or final) else \
+                max(sent, len(cut_text) - max_stop + 1 if max_stop
+                    else len(cut_text))
+            out = cut_text[sent:safe_end]
+            if out:
+                await resp.write(b'data: ' +
+                                 json.dumps(make_chunk(out)).encode() +
+                                 b'\n\n')
+                sent += len(out)
+            if matched:
+                stopped = True
+                if rid is not None and not ended:
+                    self.engine.cancel(rid)
+                    while await loop.run_in_executor(
+                            None, functools.partial(
+                                out_q.get, timeout=300)) is not None:
+                        pass
+            return matched
 
         while True:
             tok = await loop.run_in_executor(
                 None, functools.partial(out_q.get, timeout=300))
             if tok is None:
+                ended = True
                 break
             if params.eos_token is not None and tok == params.eos_token:
                 saw_eos = True
@@ -205,15 +351,13 @@ class InferenceServer:
             piece = decode_incremental(tok)
             if piece is None:
                 continue
-            await resp.write(b'data: ' +
-                             json.dumps(make_chunk(piece)).encode() +
-                             b'\n\n')
-        tail = decode_incremental(None)   # flush any held tokens
-        if tail is not None:
-            await resp.write(b'data: ' +
-                             json.dumps(make_chunk(tail)).encode() +
-                             b'\n\n')
-        reason = 'stop' if saw_eos else 'length'
+            if await emit(piece):
+                break
+        if not stopped:
+            # Flush held tokens AND the stop-holdback window.
+            tail = decode_incremental(None) or ''
+            await emit(tail, final=True)
+        reason = 'stop' if (saw_eos or stopped) else 'length'
         await resp.write(b'data: ' +
                          json.dumps(make_chunk(None, reason)).encode() +
                          b'\n\n')
@@ -249,12 +393,26 @@ class InferenceServer:
                           'array, or list of either'}, status=400)
         # Validate BEFORE submitting: rejected work must not occupy
         # engine slots.
-        if payload.get('stream') and len(token_lists) != 1:
+        n = self._parse_n(payload)
+        if n is None:
             return web.json_response(
-                {'error': 'stream supports a single prompt'},
+                {'error': 'n must be an integer in [1, 128]'},
+                status=400)
+        if payload.get('stream') and (len(token_lists) != 1 or n != 1):
+            return web.json_response(
+                {'error': 'stream supports a single prompt with n=1'},
                 status=400)
         params = self._sampling_from_openai(payload)
-        subs = [self.engine.submit(t, params) for t in token_lists]
+        stops = self._stops_from_openai(payload)
+        if stops is None:
+            return web.json_response(
+                {'error': 'stop must be a string or list of strings'},
+                status=400)
+        # n completions per prompt, choices prompt-major (OpenAI
+        # layout). Distinct req_ids already decorrelate the sampling
+        # streams (device keys seed with seed + req_id).
+        subs = [self.engine.submit(t, params)
+                for t in token_lists for _ in range(n)]
 
         if payload.get('stream'):
             rid, out_q = subs[0]
@@ -265,16 +423,16 @@ class InferenceServer:
                         'choices': [{'index': 0,
                                      'text': piece or '',
                                      'finish_reason': reason}]}
-            return await self._sse(request, chunk, out_q, params)
+            return await self._sse(request, chunk, out_q, params,
+                                   stops=stops, rid=rid)
 
         choices = []
         total_out = 0
         for i, (rid, out_q) in enumerate(subs):
-            out = await self._drain(out_q)
-            total_out += len(out)
-            visible, reason = self._finish(out, params)
-            choices.append({'index': i,
-                            'text': self.tokenizer.decode(visible),
+            text, reason, n_gen = await self._drain_stopping(
+                rid, out_q, params, stops)
+            total_out += n_gen
+            choices.append({'index': i, 'text': text,
                             'finish_reason': reason})
         n_in = sum(len(t) for t in token_lists)
         return web.json_response({
@@ -304,12 +462,27 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'messages must be a non-empty list of '
                           '{role, content} objects'}, status=400)
+        n = self._parse_n(payload)
+        if n is None:
+            return web.json_response(
+                {'error': 'n must be an integer in [1, 128]'},
+                status=400)
+        if payload.get('stream') and n != 1:
+            return web.json_response(
+                {'error': 'stream supports n=1'}, status=400)
         params = self._sampling_from_openai(payload)
+        stops = self._stops_from_openai(payload)
+        if stops is None:
+            return web.json_response(
+                {'error': 'stop must be a string or list of strings'},
+                status=400)
         tokens = self.tokenizer.encode(
             self._apply_chat_template(messages))
-        rid, out_q = self.engine.submit(tokens, params)
+        subs = [self.engine.submit(tokens, params) for _ in range(n)]
+        rid = subs[0][0]
 
         if payload.get('stream'):
+            out_q = subs[0][1]
             first = {'sent': False}
 
             def chunk(piece, reason=None):
@@ -325,21 +498,26 @@ class InferenceServer:
                         'model': self.model_id,
                         'choices': [{'index': 0, 'delta': delta,
                                      'finish_reason': reason}]}
-            return await self._sse(request, chunk, out_q, params)
+            return await self._sse(request, chunk, out_q, params,
+                                   stops=stops, rid=rid)
 
-        out = await self._drain(out_q)
-        visible, reason = self._finish(out, params)
+        choices = []
+        total_out = 0
+        for i, (crid, out_q) in enumerate(subs):
+            text, reason, n_gen = await self._drain_stopping(
+                crid, out_q, params, stops)
+            total_out += n_gen
+            choices.append({'index': i,
+                            'message': {'role': 'assistant',
+                                        'content': text},
+                            'finish_reason': reason})
         return web.json_response({
             'id': f'chatcmpl-{rid}', 'object': 'chat.completion',
             'model': self.model_id,
-            'choices': [{'index': 0,
-                         'message': {'role': 'assistant',
-                                     'content': self.tokenizer.decode(
-                                         visible)},
-                         'finish_reason': reason}],
+            'choices': choices,
             'usage': {'prompt_tokens': len(tokens),
-                      'completion_tokens': len(out),
-                      'total_tokens': len(tokens) + len(out)},
+                      'completion_tokens': total_out,
+                      'total_tokens': len(tokens) + total_out},
         })
 
     def make_app(self) -> web.Application:

@@ -329,6 +329,81 @@ def test_openai_compat_endpoints(small_model):
             'assistant'
         assert chunks[-1]['choices'][0]['finish_reason'] == 'length'
 
+        # stop sequences: output truncated BEFORE the stop text, the
+        # engine request cancelled (slot freed), finish_reason 'stop'.
+        full = requests.post(base + '/v1/completions',
+                             json={'prompt': [9, 9, 9],
+                                   'max_tokens': 8},
+                             timeout=120).json()['choices'][0]['text']
+        assert len(full) >= 2
+        stop_at = full[1]    # some char early in the output
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': [9, 9, 9], 'max_tokens': 8,
+                                'stop': stop_at}, timeout=120).json()
+        got = r['choices'][0]['text']
+        assert stop_at not in got and full.startswith(got)
+        assert r['choices'][0]['finish_reason'] == 'stop'
+        deadline2 = time.time() + 30
+        while time.time() < deadline2:
+            st = requests.get(base + '/stats', timeout=5).json()
+            if st['active_slots'] == 0:
+                break
+            time.sleep(0.2)
+        assert st['active_slots'] == 0   # cancelled slot really freed
+
+        # Streaming with a stop sequence: stream ends with 'stop' and
+        # the stop text never appears.
+        resp = requests.post(base + '/v1/completions',
+                             json={'prompt': [9, 9, 9], 'max_tokens': 8,
+                                   'stop': stop_at, 'stream': True},
+                             timeout=120, stream=True)
+        lines = [l.decode() for l in resp.iter_lines() if l]
+        chunks = [json_lib.loads(l[len('data: '):]) for l in lines[:-1]]
+        text = ''.join(c['choices'][0]['text'] for c in chunks[:-1])
+        assert stop_at not in text
+        assert chunks[-1]['choices'][0]['finish_reason'] == 'stop'
+
+        # Multi-char stop spanning token boundaries (byte tokenizer:
+        # one token per char): the stream must never leak the stop's
+        # first char.
+        if len(full) >= 4:
+            stop2 = full[1:3]     # two chars -> spans two tokens
+            r = requests.post(base + '/v1/completions',
+                              json={'prompt': [9, 9, 9],
+                                    'max_tokens': 8, 'stop': stop2},
+                              timeout=120).json()
+            assert r['choices'][0]['text'] == full[:1]
+            assert r['choices'][0]['finish_reason'] == 'stop'
+            resp = requests.post(base + '/v1/completions',
+                                 json={'prompt': [9, 9, 9],
+                                       'max_tokens': 8, 'stop': stop2,
+                                       'stream': True},
+                                 timeout=120, stream=True)
+            lines = [l.decode() for l in resp.iter_lines() if l]
+            chunks = [json_lib.loads(l[len('data: '):])
+                      for l in lines[:-1]]
+            text = ''.join(c['choices'][0]['text'] for c in chunks[:-1])
+            assert text == full[:1]    # holdback: no stop prefix leaked
+
+        # Malformed n / stop -> 400, not 500.
+        for bad in ({'n': 0}, {'n': 'abc'}, {'n': 129}, {'stop': 7},
+                    {'stop': [1, 2]}):
+            code = requests.post(base + '/v1/completions',
+                                 json={'prompt': 'hi', **bad},
+                                 timeout=10).status_code
+            assert code == 400, bad
+
+        # n > 1: one choice per completion, prompt-major indexing.
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': 'hi', 'max_tokens': 3,
+                                'n': 2}, timeout=120).json()
+        assert [c['index'] for c in r['choices']] == [0, 1]
+        r = requests.post(
+            base + '/v1/chat/completions',
+            json={'messages': [{'role': 'user', 'content': 'hello'}],
+                  'max_tokens': 3, 'n': 2}, timeout=120).json()
+        assert len(r['choices']) == 2
+
         assert requests.post(base + '/v1/completions', json={},
                              timeout=10).status_code == 400
         assert requests.post(base + '/v1/chat/completions', json={},
