@@ -42,6 +42,10 @@ class SamplingParams:
     max_new_tokens: int = 128
     temperature: float = 0.0          # 0 => greedy
     top_k: int = 0                    # 0 => off; device path caps at 64
+    # Nucleus sampling; >= 1 (or <= 0) => off. The device path bounds
+    # the nucleus to the top-64 logits (_TOPK_BUCKET) — for real models
+    # the p-nucleus is almost always far smaller.
+    top_p: float = 1.0
     eos_token: Optional[int] = None
     seed: int = 0
 
@@ -73,32 +77,63 @@ def _round_up_pow2(n: int, lo: int = 32) -> int:
     return b
 
 
-def _topk_filter(logits, topks):
-    """Per-slot top-k filter over [..., V] logits: entries below each
-    slot's k-th value become -inf; k == 0 disables. topks broadcasts
-    over any leading axes after the slot axis (axis 0)."""
-    kvals, _ = jax.lax.top_k(logits, min(_TOPK_BUCKET, logits.shape[-1]))
+def _sampling_filter(scaled, topks, topps):
+    """Per-slot top-k AND top-p (nucleus) filter over [..., V]
+    temperature-SCALED logits: entries outside the filter become -inf.
+    topks: k == 0 disables. topps: p >= 1 or <= 0 disables; the nucleus
+    is the smallest prefix of descending-probability tokens whose
+    cumulative mass reaches p (the first token always survives).
+    Both are computed from one shared top-64 sort (_TOPK_BUCKET); the
+    nucleus normalizes within that bucket — a documented bound, and for
+    real models the p-nucleus is almost always far smaller than 64.
+    topks/topps broadcast over any leading axes after the slot axis."""
+    kvals, _ = jax.lax.top_k(scaled, min(_TOPK_BUCKET, scaled.shape[-1]))
+    extra = (1,) * (scaled.ndim - topks.ndim)
+    # top-k threshold
     k_idx = jnp.clip(topks - 1, 0, kvals.shape[-1] - 1)
-    idx = k_idx.reshape(k_idx.shape + (1,) * (logits.ndim - k_idx.ndim))
-    kth = jnp.take_along_axis(kvals, idx, axis=-1)
-    mask = topks.reshape(topks.shape + (1,) * (logits.ndim - topks.ndim))
-    return jnp.where(jnp.logical_and(mask > 0, logits < kth),
-                     -jnp.inf, logits)
+    kth = jnp.take_along_axis(kvals, k_idx.reshape(k_idx.shape + extra),
+                              axis=-1)
+    kmask = topks.reshape(topks.shape + extra) > 0
+    out = jnp.where(jnp.logical_and(kmask, scaled < kth),
+                    -jnp.inf, scaled)
+    # top-p over the top-k-RENORMALIZED distribution (the HF/vLLM
+    # warper order, matching the host-side _sample): positions past k
+    # in the sorted bucket drop out of the softmax first. Exclusive
+    # cumsum so the first token always survives.
+    pos = jnp.arange(kvals.shape[-1])
+    pos = pos.reshape((1,) * (kvals.ndim - 1) + pos.shape)
+    kvals_f = jnp.where(
+        jnp.logical_and(kmask,
+                        pos >= topks.reshape(topks.shape + extra)),
+        -jnp.inf, kvals)
+    p = jax.nn.softmax(kvals_f, axis=-1)
+    before = jnp.cumsum(p, axis=-1) - p
+    pp = topps.reshape(topps.shape + extra)
+    inside = jnp.logical_and(before < jnp.clip(pp, 0.0, 1.0),
+                             jnp.isfinite(kvals_f))
+    # Smallest surviving value = nucleus threshold.
+    thresh = jnp.min(jnp.where(inside, kvals, jnp.inf), axis=-1,
+                     keepdims=True)
+    pmask = jnp.logical_and(pp > 0.0, pp < 1.0)
+    return jnp.where(jnp.logical_and(pmask, out < thresh),
+                     -jnp.inf, out)
 
 
-def speculative_sample_step(logits, draft, temps, topks, keys):
+def speculative_sample_step(logits, draft, temps, topks, topps, keys):
     """One slot-batched speculative-sampling verify step (the exact
     rejection rule; standalone so its distribution is unit-testable).
 
     logits [SLOTS, k+1, V] f32 — target logits at the k draft positions
     plus the bonus position; draft [SLOTS, k] int32 — point-mass draft
-    tokens (prompt-lookup); temps/topks [SLOTS]; keys [SLOTS] per-slot
-    PRNG keys (this step's draws; caller advances them between steps).
+    tokens (prompt-lookup); temps/topks/topps [SLOTS]; keys [SLOTS]
+    per-slot PRNG keys (this step's draws; caller advances them between
+    steps).
 
     Greedy slots (temp == 0): accept while draft == argmax, emit argmax
     rows — identical to the deterministic verify. Sampled slots: accept
-    d_i with probability p_i(d_i) (p = softmax of top-k-filtered
-    logits / temp); at the first rejection sample from the residual
+    d_i with probability p_i(d_i) (p = softmax of the top-k/top-p
+    filtered logits / temp); at the first rejection sample from the
+    residual
     (p_i with d_i zeroed, renormalized), and after k accepts sample the
     bonus token from p_k unmodified. The emitted token stream is
     distributed EXACTLY as sequential sampling from p (Leviathan et al.
@@ -112,9 +147,9 @@ def speculative_sample_step(logits, draft, temps, topks, keys):
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, k+1]
     g_match = (draft == greedy[:, :k])
 
-    filtered = _topk_filter(logits, topks)
-    probs = jax.nn.softmax(
-        filtered / jnp.maximum(temps, 1e-6)[:, None, None], axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+    probs = jax.nn.softmax(_sampling_filter(scaled, topks, topps),
+                           axis=-1)
     ks = jax.vmap(jax.random.split)(keys)        # [SLOTS, 2, key]
     ku, kr = ks[:, 0], ks[:, 1]
     u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(ku)
@@ -147,15 +182,17 @@ def speculative_sample_step(logits, draft, temps, topks, keys):
     return out, acc
 
 
-def _update_args(args, slot, first_tok, length, temp, key, topk):
+def _update_args(args, slot, first_tok, length, temp, key, topk,
+                 topp):
     """Write one slot's decode args on device (shared by both insert
     impls)."""
-    last, lens, temps, keys, topks = args
+    last, lens, temps, keys, topks, topps = args
     return (last.at[slot].set(first_tok),
             lens.at[slot].set(length),
             temps.at[slot].set(temp),
             keys.at[slot].set(key),
-            topks.at[slot].set(topk))
+            topks.at[slot].set(topk),
+            topps.at[slot].set(topp))
 
 
 class InferenceEngine:
@@ -301,6 +338,9 @@ class InferenceEngine:
                       jnp.int32)
             if self.spec_decode > 0 else None)
         self._waiting: 'queue.Queue[_Request]' = queue.Queue()
+        # Request currently mid-admission (popped but not yet in
+        # _slots) — scanned by cancel().
+        self._admitting: Optional[_Request] = None
         # Device-resident decode args (last, lens, temps, keys, topks);
         # built once from the host mirrors, then updated ON DEVICE (the
         # fused insert kernel writes the admitted slot's entries) so the
@@ -330,7 +370,7 @@ class InferenceEngine:
                                            static_argnames=('bucket',))
         self._jit_decode_spec = jax.jit(
             self._decode_spec_impl,
-            donate_argnums=(1, 5, 7),   # cache, keys, hist
+            donate_argnums=(1, 5, 8),   # cache, keys, hist
             static_argnames=('n', 'k', 'sampling'))
         self._jit_hist_insert = jax.jit(self._hist_insert_impl,
                                         donate_argnums=(0,))
@@ -340,7 +380,7 @@ class InferenceEngine:
         # so plain-path chunks keep the proposer's invariant intact.
         self._jit_decode_n = jax.jit(
             self._decode_n_impl,
-            donate_argnums=(1, 7) if self.spec_decode > 0 else (1,),
+            donate_argnums=(1, 8) if self.spec_decode > 0 else (1,),
             static_argnames=('n', 'sampling'))
         # Donate the global cache and the decode-arg arrays (updated in
         # place); the prefill cache is NOT donatable (B=1 buffers cannot
@@ -441,7 +481,7 @@ class InferenceEngine:
             return cache
 
     def _insert_impl(self, cache, prefill_cache, slot, args, first_tok,
-                     length, temp, key, topk):
+                     length, temp, key, topk, topp):
         """ONE fused dispatch per admission: copy a prefill cache (B=1,
         S=max_seq) into `slot` of the global cache AND write the slot's
         decode args (last token, length, temp, rng key, topk) into the
@@ -456,10 +496,10 @@ class InferenceEngine:
                 big, small, (0, slot, 0, 0, 0))
         cache = jax.tree.map(upd, cache, prefill_cache)
         return cache, _update_args(args, slot, first_tok, length, temp,
-                                   key, topk)
+                                   key, topk, topp)
 
     def _insert_paged_impl(self, cache, prefill_cache, slot, args,
-                           first_tok, length, temp, key, topk,
+                           first_tok, length, temp, key, topk, topp,
                            page_ids, table_row, src_off):
         """Paged-mode admission: scatter the prompt KV into the reserved
         pages, install the slot's block-table row, and update the decode
@@ -487,7 +527,7 @@ class InferenceEngine:
             'tables': cache['tables'].at[slot].set(table_row),
         }
         return self._pin_paged_layouts(new_cache), _update_args(
-            args, slot, first_tok, length, temp, key, topk)
+            args, slot, first_tok, length, temp, key, topk, topp)
 
     def _insert_pages_impl(self, cache, prefill_cache, page_ids,
                            src_off):
@@ -513,7 +553,7 @@ class InferenceEngine:
                     jnp.zeros_like(cache['tables'][slot]))}
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
-                       keys, topks, hist, n, sampling):
+                       keys, topks, topps, hist, n, sampling):
         """Generate `n` tokens per slot in ONE dispatch: a device-side
         lax.scan of decode steps with on-device sampling (greedy when
         temps[i] == 0, else temperature categorical). The host pulls one
@@ -547,12 +587,13 @@ class InferenceEngine:
                         write_hist(hist, lens, greedy)), greedy
             keys = jax.vmap(jax.random.split, in_axes=0,
                             out_axes=0)(keys)[:, 0]
-            # One top-k filter serves the plain AND spec sampling paths
-            # — their target distributions must stay identical.
-            filtered = _topk_filter(logits, topks)
-            sampled = jax.vmap(
-                lambda k, lg, t: jax.random.categorical(
-                    k, lg / jnp.maximum(t, 1e-6)))(keys, filtered, temps)
+            # One top-k/top-p filter serves the plain AND spec
+            # sampling paths — their target distributions must stay
+            # identical. Filter AFTER temperature scaling (nucleus
+            # membership depends on the scaled distribution).
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            filtered = _sampling_filter(scaled, topks, topps)
+            sampled = jax.vmap(jax.random.categorical)(keys, filtered)
             tok = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
             return (cache, tok, lens + 1, keys,
                     write_hist(hist, lens, tok)), tok
@@ -574,7 +615,8 @@ class InferenceEngine:
         return hist.at[slot, length].set(first_tok)
 
     def _decode_spec_impl(self, params, cache, last_tokens, lengths,
-                          temps, keys, topks, hist, n, k, sampling):
+                          temps, keys, topks, topps, hist, n, k,
+                          sampling):
         """`n` speculative decode iterations in ONE dispatch. Each
         iteration: propose k draft tokens per slot by matching the
         history's trailing bigram against its own past (prompt-lookup
@@ -621,7 +663,7 @@ class InferenceEngine:
                 ks2 = jax.vmap(jax.random.split)(keys)
                 step_keys, draw_keys = ks2[:, 0], ks2[:, 1]
                 out, acc = speculative_sample_step(
-                    logits, draft, temps, topks, draw_keys)
+                    logits, draft, temps, topks, topps, draw_keys)
             else:
                 # Greedy-only compile: no softmax/top-k/categorical ops.
                 step_keys = keys
@@ -648,6 +690,9 @@ class InferenceEngine:
 
     # ----------------------------------------------------------- sampling
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
+        """Host-side sampling for a request's FIRST token (prefill pulls
+        one logits row); same temperature -> top-k -> top-p filter order
+        as the device path."""
         p = req.params
         if p.temperature <= 0.0:
             return int(np.argmax(logits))
@@ -655,6 +700,14 @@ class InferenceEngine:
         if p.top_k > 0:
             kth = np.partition(logits, -p.top_k)[-p.top_k]
             logits = np.where(logits < kth, -np.inf, logits)
+        if 0.0 < p.top_p < 1.0:
+            order = np.argsort(-logits)
+            s = logits[order]
+            sp = np.exp(s - s.max())
+            sp /= sp.sum()
+            before = np.cumsum(sp) - sp   # exclusive: top-1 survives
+            cut = order[before >= p.top_p]
+            logits[cut] = -np.inf
         logits -= logits.max()
         probs = np.exp(logits)
         probs /= probs.sum()
@@ -693,10 +746,10 @@ class InferenceEngine:
             if req is not None and req.req_id == req_id:
                 req.cancelled = True
                 found = True
-        d = self._deferred
-        if d is not None and d.req_id == req_id:
-            d.cancelled = True
-            found = True
+        for d in (self._deferred, self._admitting):
+            if d is not None and d.req_id == req_id:
+                d.cancelled = True
+                found = True
         with self._waiting.mutex:
             for req in self._waiting.queue:
                 if req.req_id == req_id:
@@ -826,7 +879,8 @@ class InferenceEngine:
                               jnp.zeros((n,), jnp.int32),
                               jnp.zeros((n,), jnp.float32),
                               jnp.zeros((n, 2), jnp.uint32),
-                              jnp.zeros((n,), jnp.int32))
+                              jnp.zeros((n,), jnp.int32),
+                              jnp.ones((n,), jnp.float32))
 
     def _admit_one(self) -> bool:
         req = self._deferred
@@ -841,6 +895,11 @@ class InferenceEngine:
             # Cancelled while waiting: never occupies a slot.
             req.out_queue.put(None)
             return True
+        # Visible to cancel() during the admission window (popped from
+        # the queue but not yet installed in _slots — a full prefill
+        # dispatch wide); the flag is then honored at the first
+        # delivery boundary.
+        self._admitting = req
         slot = self._slots.index(None)
         n = len(req.tokens)
         bucket = self._bucket_for(n)
@@ -941,7 +1000,8 @@ class InferenceEngine:
             ins_args = (jnp.int32(slot), self._dev_args,
                         jnp.int32(first), jnp.int32(n),
                         jnp.float32(temp), key,
-                        jnp.int32(min(req.params.top_k, _TOPK_BUCKET)))
+                        jnp.int32(min(req.params.top_k, _TOPK_BUCKET)),
+                        jnp.float32(req.params.top_p))
             if self.cache_mode == 'paged':
                 reserved = int((row > 0).sum())
                 p = self.pool.cfg.page_size
@@ -1007,6 +1067,9 @@ class InferenceEngine:
         req.generated = 1
         req.out_queue.put(first)
         self._slots[slot] = req
+        # Only now (installed in _slots) does cancel() see it there;
+        # no gap between the two scan targets.
+        self._admitting = None
         self._lengths[slot] = n
         self._conf_lengths[slot] = n
         self._temps[slot] = temp
@@ -1081,6 +1144,7 @@ class InferenceEngine:
                 self.cache, pc, jnp.int32(slot), self._dev_args,
                 jnp.int32(first), jnp.int32(n), jnp.float32(temp), key,
                 jnp.int32(min(req.params.top_k, _TOPK_BUCKET)),
+                jnp.float32(req.params.top_p),
                 jnp.asarray(ids), jnp.asarray(row),
                 jnp.int32(first_page * psize))
             if self.prefix_caching:
@@ -1162,6 +1226,10 @@ class InferenceEngine:
             admitted = False
             while None in self._slots and self._admit_one():
                 admitted = True
+            # Admission over: any request is now findable in _slots /
+            # _deferred / _chunked, so drop the mid-admission pointer
+            # (defer paths exit _admit_one without clearing it).
+            self._admitting = None
             # One chunk of any in-progress long-prompt prefill, then a
             # decode chunk — running requests keep streaming while the
             # long admission fills its pages.
@@ -1190,7 +1258,8 @@ class InferenceEngine:
                 # greedy-only restriction.
                 use_spec = k > 0 and rem_space // (k + 1) >= 1
                 self._ensure_dev_args()
-                d_last, d_lens, d_temps, d_keys, d_topks = self._dev_args
+                (d_last, d_lens, d_temps, d_keys, d_topks,
+                 d_topps) = self._dev_args
                 entries = [(i, self._slots[i]) for i in active]
                 if use_spec:
                     bound = max(1, min(self.decode_chunk,
@@ -1201,11 +1270,11 @@ class InferenceEngine:
                             d_keys, self._dev_hist = \
                             self._jit_decode_spec(
                                 self.params, self.cache, d_last, d_lens,
-                                d_temps, d_keys, d_topks,
+                                d_temps, d_keys, d_topks, d_topps,
                                 self._dev_hist, n=chunk, k=k,
                                 sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, d_keys,
-                                      d_topks)
+                                      d_topks, d_topps)
                     new_pending = ('spec', toks, counts, entries, chunk)
                     upper = chunk * (k + 1)
                 else:
@@ -1217,11 +1286,11 @@ class InferenceEngine:
                         toks, self.cache, keys, d_last, d_lens, \
                             self._dev_hist = self._jit_decode_n(
                                 self.params, self.cache, d_last, d_lens,
-                                d_temps, d_keys, d_topks,
+                                d_temps, d_keys, d_topks, d_topps,
                                 self._dev_hist,
                                 n=chunk, sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, keys,
-                                      d_topks)
+                                      d_topks, d_topps)
                     new_pending = ('plain', toks, None, entries, chunk)
                     upper = chunk
             if pending is not None:

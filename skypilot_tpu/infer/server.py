@@ -56,6 +56,53 @@ byte_decode = lambda tokens: \
     tokenizer_lib.ByteTokenizer().decode(tokens)  # noqa: E731
 
 
+class _StopScanner:
+    """Windowed incremental stop-sequence matcher, shared by the SSE
+    and drain paths. A new match can only END inside the newest piece,
+    so each feed() searches max(len(stop))-1 chars of history plus the
+    piece — O(total), not O(total^2) of rescanning everything."""
+
+    def __init__(self, stops: List[str]) -> None:
+        self.stops = stops
+        self.max_len = max((len(s) for s in stops), default=0)
+        self.acc = ''
+        self.cut: Optional[int] = None   # absolute earliest-match index
+
+    def feed(self, piece: str) -> bool:
+        """Append new text; True once a stop has matched."""
+        if self.cut is not None:
+            return True
+        lo = max(0, len(self.acc) - (self.max_len - 1)) \
+            if self.max_len else len(self.acc)
+        self.acc += piece
+        if not self.stops:
+            return False
+        window = self.acc[lo:]
+        best = None
+        for s in self.stops:
+            i = window.find(s)
+            if i != -1 and (best is None or i < best):
+                best = i
+        if best is not None:
+            self.cut = lo + best
+        return self.cut is not None
+
+    @property
+    def text(self) -> str:
+        """Full text, truncated before the earliest stop match."""
+        return self.acc if self.cut is None else self.acc[:self.cut]
+
+    def safe_len(self, final: bool = False) -> int:
+        """Chars emittable now: everything up to the match, else all
+        but the max(len(stop))-1 holdback (a partial stop prefix can
+        span pieces); `final` flushes the holdback."""
+        if self.cut is not None:
+            return self.cut
+        if final or not self.max_len:
+            return len(self.acc)
+        return max(0, len(self.acc) - (self.max_len - 1))
+
+
 class InferenceServer:
     def __init__(self, engine: 'engine_lib.InferenceEngine',
                  tokenizer=None, model_id: str = 'skypilot-tpu') -> None:
@@ -96,6 +143,7 @@ class InferenceServer:
             max_new_tokens=int(max_new),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
+            top_p=float(payload.get('top_p', 1.0)),
             eos_token=eos)
         req_id, out_q = self.engine.submit(tokens, params)
         loop = asyncio.get_running_loop()
@@ -134,6 +182,7 @@ class InferenceServer:
             max_new_tokens=int(payload.get('max_tokens', 128)),
             temperature=temp,
             top_k=int(payload.get('top_k', 0)),
+            top_p=float(payload.get('top_p', 1.0)),
             eos_token=self.tokenizer.eos_id,
             seed=int(payload.get('seed', 0)))
 
@@ -217,46 +266,32 @@ class InferenceServer:
                 pass
 
         decode_incremental = self._incremental_decoder()
-        max_stop = max(len(s) for s in stops)
-        acc = ''
+        scan = _StopScanner(stops)
         generated = 0
-
-        def try_stop(piece):
-            # A new match can only END inside the new piece, so search
-            # from max_stop-1 chars before it — O(total) overall, not
-            # O(total^2) like re-decoding everything per token.
-            nonlocal acc
-            lo = max(0, len(acc) - (max_stop - 1))
-            acc += piece
-            text, matched = self._apply_stops(acc[lo:], stops)
-            return (acc[:lo] + text, matched)
 
         while True:
             tok = await loop.run_in_executor(
                 None, functools.partial(out_q.get, timeout=300))
             if tok is None:
                 tail = decode_incremental(None)
-                if tail:
-                    text, matched = try_stop(tail)
-                    if matched:
-                        return text, 'stop', generated
-                return acc, 'length', generated
+                if tail and scan.feed(tail):
+                    return scan.text, 'stop', generated
+                return scan.text, 'length', generated
             generated += 1
             if params.eos_token is not None and \
                     tok == params.eos_token:
                 await drain_terminal()
                 tail = decode_incremental(None)
                 if tail:
-                    acc, _ = try_stop(tail)
-                return acc, 'stop', generated
+                    scan.feed(tail)
+                return scan.text, 'stop', generated
             piece = decode_incremental(tok)
             if piece is None:
                 continue
-            text, matched = try_stop(piece)
-            if matched:
+            if scan.feed(piece):
                 self.engine.cancel(rid)
                 await drain_terminal()
-                return text, 'stop', generated
+                return scan.text, 'stop', generated
 
     async def _drain(self, out_q) -> List[int]:
         loop = asyncio.get_running_loop()
@@ -301,29 +336,20 @@ class InferenceServer:
         await resp.prepare(request)
         saw_eos = False
         stopped = False
-        acc = ''     # all text produced (for stop matching)
-        sent = 0     # chars of acc already emitted
+        sent = 0     # chars of the scanner's text already emitted
         decode_incremental = self._incremental_decoder()
-
-        max_stop = max((len(s) for s in stops), default=0) if stops \
-            else 0
+        scan = _StopScanner(stops or [])
         ended = False   # terminal None already consumed
 
         async def emit(piece: str, final: bool = False) -> bool:
-            """Send new text, stop-truncated. A partial stop prefix
-            can span token boundaries, so max_stop-1 trailing chars are
-            held back until `final` — the stop text (or any prefix of
-            it) is never sent. True => halt stream."""
-            nonlocal acc, sent, stopped
-            acc += piece
-            if stops:
-                cut_text, matched = self._apply_stops(acc, stops)
-            else:
-                cut_text, matched = acc, False
-            safe_end = len(cut_text) if (matched or final) else \
-                max(sent, len(cut_text) - max_stop + 1 if max_stop
-                    else len(cut_text))
-            out = cut_text[sent:safe_end]
+            """Send new text, stop-truncated via the shared windowed
+            scanner. A partial stop prefix can span token boundaries,
+            so max(len(stop))-1 trailing chars are held back until
+            `final` — the stop text (or any prefix of it) is never
+            sent. True => halt stream."""
+            nonlocal sent, stopped
+            matched = scan.feed(piece)
+            out = scan.text[sent:scan.safe_len(final or matched)]
             if out:
                 await resp.write(b'data: ' +
                                  json.dumps(make_chunk(out)).encode() +
@@ -426,11 +452,15 @@ class InferenceServer:
             return await self._sse(request, chunk, out_q, params,
                                    stops=stops, rid=rid)
 
+        # Concurrent drains: a stop match in ANY completion cancels
+        # its engine request immediately (sequential drains would hold
+        # later completions' slots until earlier ones finish).
+        results = await asyncio.gather(*[
+            self._drain_stopping(rid, out_q, params, stops)
+            for rid, out_q in subs])
         choices = []
         total_out = 0
-        for i, (rid, out_q) in enumerate(subs):
-            text, reason, n_gen = await self._drain_stopping(
-                rid, out_q, params, stops)
+        for i, (text, reason, n_gen) in enumerate(results):
             total_out += n_gen
             choices.append({'index': i, 'text': text,
                             'finish_reason': reason})
@@ -501,11 +531,12 @@ class InferenceServer:
             return await self._sse(request, chunk, out_q, params,
                                    stops=stops, rid=rid)
 
+        results = await asyncio.gather(*[
+            self._drain_stopping(crid, out_q, params, stops)
+            for crid, out_q in subs])
         choices = []
         total_out = 0
-        for i, (crid, out_q) in enumerate(subs):
-            text, reason, n_gen = await self._drain_stopping(
-                crid, out_q, params, stops)
+        for i, (text, reason, n_gen) in enumerate(results):
             total_out += n_gen
             choices.append({'index': i,
                             'message': {'role': 'assistant',

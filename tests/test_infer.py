@@ -410,3 +410,38 @@ def test_openai_compat_endpoints(small_model):
                              timeout=10).status_code == 400
     finally:
         eng.stop()
+
+
+def test_engine_cancel_running_and_waiting(small_model):
+    """cancel(): a running request's queue terminates early and its slot
+    frees; a waiting request never occupies a slot."""
+    model, params = small_model
+    eng = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16],
+                                     decode_chunk=1)
+    eng.start()
+    try:
+        rid1, q1 = eng.submit([1, 2, 3], engine_lib.SamplingParams(
+            max_new_tokens=40))
+        # Occupy the only slot, then queue a second request behind it.
+        rid2, q2 = eng.submit([4, 5], engine_lib.SamplingParams(
+            max_new_tokens=40))
+        first = q1.get(timeout=120)
+        assert first is not None
+        assert eng.cancel(rid1) and eng.cancel(rid2)
+        got1 = [first]
+        while True:
+            t = q1.get(timeout=120)
+            if t is None:
+                break
+            got1.append(t)
+        assert len(got1) < 40          # ended early
+        assert q2.get(timeout=120) is None   # never ran
+        # Slot is reusable after the cancels.
+        out = eng.generate([9, 9, 9], engine_lib.SamplingParams(
+            max_new_tokens=4))
+        assert len(out) == 4
+        assert eng.cancel(12345) is False
+    finally:
+        eng.stop()

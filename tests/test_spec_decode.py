@@ -279,11 +279,12 @@ def test_speculative_sample_step_unbiased():
     # An arbitrary (deliberately mediocre) draft.
     draft = jnp.asarray([[3, 5]], jnp.int32)
 
-    def run(topk):
+    def run(topk, topp=1.0):
         topks = jnp.asarray([topk], jnp.int32)
+        topps = jnp.asarray([topp], jnp.float32)
         stepped = jax.jit(jax.vmap(
             lambda key: engine_lib.speculative_sample_step(
-                logits, draft, temps, topks, key[None])))
+                logits, draft, temps, topks, topps, key[None])))
         keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(trials))
         out, acc = stepped(keys)
         return np.asarray(out[:, 0, 0]), np.asarray(acc)
@@ -306,6 +307,20 @@ def test_speculative_sample_step_unbiased():
     emp3 = np.bincount(first3, minlength=vocab) / trials
     np.testing.assert_allclose(emp3, p3, atol=0.015)
 
+    # top_p active: marginal == the NUCLEUS-filtered softmax (smallest
+    # descending-prob prefix reaching p; exclusive cumsum).
+    firstp, _ = run(0, topp=0.6)
+    s = np.sort(np.asarray(logits[0, 0]) / float(temps[0]))[::-1]
+    order = np.argsort(-np.asarray(logits[0, 0]))
+    sp = np.exp(s - s.max()); sp /= sp.sum()
+    before = np.cumsum(sp) - sp
+    keep = order[before < 0.6]
+    lp = np.full(vocab, -np.inf)
+    lp[keep] = np.asarray(logits[0, 0])[keep] / float(temps[0])
+    pn = np.exp(lp - lp[keep].max()); pn /= pn.sum()
+    empp = np.bincount(firstp, minlength=vocab) / trials
+    np.testing.assert_allclose(empp, pn, atol=0.015)
+
 
 def test_speculative_sample_step_greedy_slots_exact():
     """temp == 0 slots are bit-identical to the argmax verify."""
@@ -321,7 +336,42 @@ def test_speculative_sample_step_greedy_slots_exact():
     topks = jnp.zeros((2,), jnp.int32)
     keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(2))
     out, acc = engine_lib.speculative_sample_step(
-        logits, draft, temps, topks, keys)
+        logits, draft, temps, topks, jnp.ones((2,), jnp.float32), keys)
     np.testing.assert_array_equal(np.asarray(out), greedy)
     assert int(acc[0]) == k
     assert int(acc[1]) == (1 if greedy[1, 0] == 0 else 0)
+
+
+def test_sampling_filter_matches_host_semantics():
+    """Device _sampling_filter and host _sample must induce the same
+    support when top_k and top_p are BOTH active (HF/vLLM warper order:
+    top-k first, nucleus over the renormalized survivors)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    for trial in range(20):
+        vocab = 12
+        logits = rng.normal(size=(vocab,)) * 2.0
+        temp, top_k, top_p = 0.7, 4, 0.55
+        scaled = logits / temp
+        # Host reference: top-k mask, renormalize, exclusive-cumsum
+        # nucleus (mirrors engine._sample).
+        l = scaled.copy()
+        kth = np.partition(l, -top_k)[-top_k]
+        l = np.where(l < kth, -np.inf, l)
+        order = np.argsort(-l)
+        s = l[order]
+        sp = np.exp(s - s.max()); sp /= sp.sum()
+        before = np.cumsum(sp) - sp
+        cut = order[before >= top_p]
+        l[cut] = -np.inf
+        host_support = set(np.where(np.isfinite(l))[0].tolist())
+
+        dev = engine_lib._sampling_filter(
+            jnp.asarray(scaled, jnp.float32)[None, :],
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32))
+        dev_support = set(np.where(np.isfinite(np.asarray(dev[0])))[0]
+                          .tolist())
+        assert dev_support == host_support, (trial, dev_support,
+                                             host_support)
