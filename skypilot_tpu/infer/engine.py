@@ -14,7 +14,9 @@ the engine itself first-class, JetStream-style:
     weights; HBM: the KV cache).
 
 TTFT = prefill latency + queue wait, the p50 target BASELINE.md sets for
-serving. greedy/temperature/top-k sampling.
+serving. greedy/temperature/top-k/top-p sampling; speculative decoding
+covers both greedy (exact) and sampled (rejection sampling, exact
+distribution) requests.
 """
 import contextlib
 import dataclasses

@@ -219,6 +219,33 @@ class TestEnginePrefillDecode:
 
         assert gen(4) == gen(0)
 
+    def test_spec_sampling_and_topp_lower(self):
+        """Round-4 sampling additions must lower on the real chip: the
+        rejection-sampling spec verify (per-slot keys + categorical in
+        a scan) and the combined top-k/top-p filter in the plain path."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        prompt = [5, 9, 2] * 8
+
+        def gen(spec):
+            engine = server_lib.build_engine(
+                'debug', num_slots=2, max_seq_len=256,
+                cache_mode='paged', spec_decode=spec)
+            engine.start()
+            try:
+                return engine.generate(
+                    prompt,
+                    engine_lib.SamplingParams(
+                        max_new_tokens=12, temperature=0.8,
+                        top_k=16, top_p=0.8))
+            finally:
+                engine.stop()
+
+        out_spec = gen(3)       # rejection-sampling verify path
+        out_plain = gen(0)      # _sampling_filter in decode_n
+        assert len(out_spec) == 12 and len(out_plain) == 12
+
     def test_chunked_prefill_lowers(self):
         """Chunked prefill's page-write path (insert w/o table install,
         suffix continuation per chunk) must lower and match."""
