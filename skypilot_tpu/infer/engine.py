@@ -50,6 +50,10 @@ class SamplingParams:
     top_p: float = 1.0
     eos_token: Optional[int] = None
     seed: int = 0
+    # True: the out_queue yields (token, logprob) pairs — the chosen
+    # token's RAW model logprob (pre-filter log-softmax, the OpenAI/
+    # vLLM convention) — instead of bare ints.
+    logprobs: bool = False
 
 
 @dataclasses.dataclass
@@ -182,6 +186,13 @@ def speculative_sample_step(logits, draft, temps, topks, topps, keys):
                       jnp.where(idx == acc[:, None], repl[:, None], 0))
     out = jnp.where(temps[:, None] > 0, s_out, greedy)
     return out, acc
+
+
+def _np_raw_lp(logits_row, tok: int) -> float:
+    """RAW model logprob of one token from a host logits row."""
+    row = logits_row.astype(np.float64)
+    m = row.max()
+    return float(row[tok] - m - np.log(np.exp(row - m).sum()))
 
 
 def _update_args(args, slot, first_tok, length, temp, key, topk,
@@ -577,6 +588,13 @@ class InferenceEngine:
                 return None
             return hist.at[jnp.arange(n_slots), lens + 1].set(tok)
 
+        def raw_lp(logits, tok):
+            # Chosen-token RAW model logprob (one logsumexp over V —
+            # noise next to the weight streaming each step costs).
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            return jnp.take_along_axis(logits, tok[:, None],
+                                       axis=-1)[:, 0] - lse
+
         def step(carry, _):
             cache, last, lens, keys, hist = carry
             logits, cache = self.model.apply(params, last[:, None],
@@ -586,7 +604,8 @@ class InferenceEngine:
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if not sampling:
                 return (cache, greedy, lens + 1, keys,
-                        write_hist(hist, lens, greedy)), greedy
+                        write_hist(hist, lens, greedy)), \
+                    (greedy, raw_lp(logits, greedy))
             keys = jax.vmap(jax.random.split, in_axes=0,
                             out_axes=0)(keys)[:, 0]
             # One top-k/top-p filter serves the plain AND spec
@@ -598,16 +617,17 @@ class InferenceEngine:
             sampled = jax.vmap(jax.random.categorical)(keys, filtered)
             tok = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
             return (cache, tok, lens + 1, keys,
-                    write_hist(hist, lens, tok)), tok
+                    write_hist(hist, lens, tok)), \
+                (tok, raw_lp(logits, tok))
 
-        (cache, last, lens, keys, hist), toks = jax.lax.scan(
+        (cache, last, lens, keys, hist), (toks, lps) = jax.lax.scan(
             step, (cache, last_tokens, lengths, keys, hist), None,
             length=n)
         if 'tables' in cache:
             cache = self._pin_paged_layouts(cache)
         # last/lens returned device-resident so the next chunk's call
         # needs no host->device transfers in the steady state.
-        return toks, cache, keys, last, lens, hist
+        return toks, lps, cache, keys, last, lens, hist
 
     def _hist_insert_impl(self, hist, slot, tokens, length, first_tok):
         """Install an admitted prompt (+ its first generated token) into
@@ -675,20 +695,26 @@ class InferenceEngine:
                 out = g
             new_last = jnp.take_along_axis(out, acc[:, None],
                                            axis=1)[:, 0]
+            # RAW model logprobs of the emitted row (OpenAI/vLLM
+            # convention: pre-filter log-softmax).
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            lps = jnp.take_along_axis(logits, out[:, :, None],
+                                      axis=-1)[:, :, 0] - lse
             # Write all k+1 emitted candidates; entries past acc+1 are
             # junk the proposer never reads (its window stops at lens).
             hist = jax.vmap(
                 lambda h, row, i: jax.lax.dynamic_update_slice(
                     h, row, (i,)))(hist, out, lens + 1)
             return (cache, new_last, lens + acc + 1, step_keys, hist), \
-                (out, acc + 1)
+                (out, lps, acc + 1)
 
-        (cache, last, lens, keys, hist), (toks, counts) = jax.lax.scan(
-            step, (cache, last_tokens, lengths, keys, hist), None,
-            length=n)
+        (cache, last, lens, keys, hist), (toks, lps, counts) = \
+            jax.lax.scan(
+                step, (cache, last_tokens, lengths, keys, hist), None,
+                length=n)
         if 'tables' in cache:
             cache = self._pin_paged_layouts(cache)
-        return toks, counts, cache, last, lens, keys, hist
+        return toks, lps, counts, cache, last, lens, keys, hist
 
     # ----------------------------------------------------------- sampling
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
@@ -760,8 +786,10 @@ class InferenceEngine:
         return found
 
     def generate(self, tokens: List[int],
-                 params: Optional[SamplingParams] = None) -> List[int]:
-        """Blocking convenience: submit + drain."""
+                 params: Optional[SamplingParams] = None) -> List[Any]:
+        """Blocking convenience: submit + drain. Items mirror the queue
+        protocol: ints, or (token, logprob) pairs when
+        params.logprobs is set."""
         _, q = self.submit(tokens, params)
         out = []
         while True:
@@ -998,6 +1026,10 @@ class InferenceEngine:
                 first = self._sample(np.asarray(logits)[0], req)
             else:
                 first = int(np.asarray(greedy)[0])   # 4-byte pull
+            # logprobs: the row pull is the documented TTFT cost of
+            # asking for them on a greedy request.
+            first_lp = _np_raw_lp(np.asarray(logits)[0], first) \
+                if req.params.logprobs else None
             self._ensure_dev_args()
             ins_args = (jnp.int32(slot), self._dev_args,
                         jnp.int32(first), jnp.int32(n),
@@ -1041,11 +1073,13 @@ class InferenceEngine:
                         prefill_cache)
                 self.cache, self._dev_args = self._jit_insert(
                     self.cache, prefill_cache, *ins_args)
-        self._complete_admission(req, slot, n, first, temp)
+        self._complete_admission(req, slot, n, first, temp,
+                                 first_lp=first_lp)
         return True
 
     def _complete_admission(self, req: '_Request', slot: int, n: int,
-                            first: int, temp: float) -> None:
+                            first: int, temp: float,
+                            first_lp: Optional[float] = None) -> None:
         """Shared admission tail: device history (spec decode), first
         token delivery, host slot bookkeeping."""
         if self.spec_decode > 0:
@@ -1067,7 +1101,8 @@ class InferenceEngine:
         req.first_token_at = time.time()
         req.slot = slot
         req.generated = 1
-        req.out_queue.put(first)
+        req.out_queue.put((first, first_lp) if req.params.logprobs
+                          else first)
         self._slots[slot] = req
         # Only now (installed in _slots) does cancel() see it there;
         # no gap between the two scan targets.
@@ -1140,6 +1175,8 @@ class InferenceEngine:
                 first = self._sample(np.asarray(logits)[0], req)
             else:
                 first = int(np.asarray(greedy)[0])
+            first_lp = _np_raw_lp(np.asarray(logits)[0], first) \
+                if req.params.logprobs else None
             key = jax.random.PRNGKey(req.params.seed + req.req_id)
             self._ensure_dev_args()
             self.cache, self._dev_args = self._jit_insert_paged(
@@ -1152,7 +1189,8 @@ class InferenceEngine:
             if self.prefix_caching:
                 self.pool.publish(slot, hashes[:n // psize])
         self._chunked = None
-        self._complete_admission(req, slot, n, first, temp)
+        self._complete_admission(req, slot, n, first, temp,
+                                 first_lp=first_lp)
 
     def _req_done(self, req: _Request, token: int) -> bool:
         p = req.params
@@ -1268,8 +1306,8 @@ class InferenceEngine:
                                        rem_space // (k + 1)))
                     chunk = 1 << (bound.bit_length() - 1)
                     with self._ctx():
-                        toks, counts, self.cache, d_last, d_lens, \
-                            d_keys, self._dev_hist = \
+                        toks, lps, counts, self.cache, d_last, \
+                            d_lens, d_keys, self._dev_hist = \
                             self._jit_decode_spec(
                                 self.params, self.cache, d_last, d_lens,
                                 d_temps, d_keys, d_topks, d_topps,
@@ -1277,7 +1315,8 @@ class InferenceEngine:
                                 sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, d_keys,
                                       d_topks, d_topps)
-                    new_pending = ('spec', toks, counts, entries, chunk)
+                    new_pending = ('spec', toks, lps, counts,
+                                   entries, chunk)
                     upper = chunk * (k + 1)
                 else:
                     bound = max(1, min(self.decode_chunk, rem_space))
@@ -1285,15 +1324,17 @@ class InferenceEngine:
                     # values would each trigger a compile.
                     chunk = 1 << (bound.bit_length() - 1)
                     with self._ctx():
-                        toks, self.cache, keys, d_last, d_lens, \
-                            self._dev_hist = self._jit_decode_n(
+                        toks, lps, self.cache, keys, d_last, \
+                            d_lens, self._dev_hist = \
+                            self._jit_decode_n(
                                 self.params, self.cache, d_last, d_lens,
                                 d_temps, d_keys, d_topks, d_topps,
                                 self._dev_hist,
                                 n=chunk, sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, keys,
                                       d_topks, d_topps)
-                    new_pending = ('plain', toks, None, entries, chunk)
+                    new_pending = ('plain', toks, lps, None,
+                                   entries, chunk)
                     upper = chunk
             if pending is not None:
                 self._finish_chunk(pending)
@@ -1310,10 +1351,14 @@ class InferenceEngine:
         """Pull a dispatched chunk's tokens and deliver them; release
         completed slots and advance the confirmed lengths. The sync
         point of the pipeline."""
-        kind, toks_dev, counts_dev, entries, chunk = pending
+        kind, toks_dev, lps_dev, counts_dev, entries, chunk = pending
         toks_np = np.asarray(toks_dev)        # sync point
         counts_np = np.asarray(counts_dev) if counts_dev is not None \
             else None
+        # Logprobs pulled only when some request in this chunk wants
+        # them (an extra [chunk, SLOTS(, k+1)] f32 transfer otherwise).
+        lps_np = np.asarray(lps_dev) if any(
+            req.params.logprobs for _, req in entries) else None
         now = time.perf_counter()
         delivered = 0
         # Per-slot running ACTUAL position of the token being delivered
@@ -1332,20 +1377,28 @@ class InferenceEngine:
                     continue
                 if kind == 'spec':
                     # [chunk, SLOTS, k+1]; first counts[t, i] are valid.
-                    run = toks_np[t, i, :int(counts_np[t, i])]
+                    nv = int(counts_np[t, i])
+                    run = toks_np[t, i, :nv]
+                    run_lps = lps_np[t, i, :nv] \
+                        if lps_np is not None else None
                     # Acceptance accounting: each delivered run is one
                     # verify step emitting 1 + accepted-drafts tokens.
                     self.perf['spec_verify_steps'] += 1
                     self.perf['spec_accepted'] += len(run) - 1
                 else:
                     run = toks_np[t:t + 1, i]             # one token
+                    run_lps = lps_np[t:t + 1, i] \
+                        if lps_np is not None else None
                 p = req.params
-                for tok in run:
+                for j, tok in enumerate(run):
                     tok = int(tok)
                     req.generated += 1
                     delivered += 1
                     base[i] += 1
-                    req.out_queue.put(tok)
+                    if p.logprobs:
+                        req.out_queue.put((tok, float(run_lps[j])))
+                    else:
+                        req.out_queue.put(tok)
                     # Length check uses this token's own position, not
                     # the post-chunk total — otherwise valid tokens
                     # later in the final chunk would be dropped.

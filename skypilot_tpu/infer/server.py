@@ -162,7 +162,7 @@ class InferenceServer:
             await resp.write_eof()
             return resp
 
-        out = await self._drain(out_q)
+        out, _lps = await self._drain(out_q)
         visible, _ = self._finish(out, params)
         return web.json_response({
             'request_id': req_id,
@@ -184,7 +184,11 @@ class InferenceServer:
             top_k=int(payload.get('top_k', 0)),
             top_p=float(payload.get('top_p', 1.0)),
             eos_token=self.tokenizer.eos_id,
-            seed=int(payload.get('seed', 0)))
+            seed=int(payload.get('seed', 0)),
+            # OpenAI 'logprobs' is an int (0 = chosen-token only, N =
+            # N alternatives); presence turns it on. Only chosen-token
+            # logprobs are computed here regardless of N (documented).
+            logprobs=payload.get('logprobs') is not None)
 
     @staticmethod
     def _parse_n(payload) -> Optional[int]:
@@ -248,14 +252,30 @@ class InferenceServer:
         """Drain a request; with stop sequences, cancel the engine
         request as soon as one matches so the slot frees immediately
         instead of running to max_tokens. Returns
-        (text, finish_reason, generated_token_count) — the count is
-        tokens the engine actually produced (the cost), which can
-        exceed the truncated text's length."""
+        (text, finish_reason, generated_token_count, logprobs) —
+        the count is tokens the engine actually produced (the cost),
+        which can exceed the truncated text's length; logprobs is
+        None unless params.logprobs (then a {'tokens': [per-token
+        text], 'token_logprobs': [...]} dict — chosen-token raw
+        logprobs; top-N alternatives are not computed)."""
         loop = asyncio.get_running_loop()
         if not stops:
-            out = await self._drain(out_q)
+            out, lps = await self._drain(out_q)
             visible, reason = self._finish(out, params)
-            return self.tokenizer.decode(visible), reason, len(out)
+            lp_obj = None
+            if lps is not None:
+                # Per-token text via prefix decodes so the pieces
+                # concatenate EXACTLY to the response text (isolated
+                # per-token decode breaks BPE/sentencepiece merges).
+                pieces, prev = [], ''
+                for j in range(len(visible)):
+                    cur = self.tokenizer.decode(visible[:j + 1])
+                    pieces.append(cur[len(prev):])
+                    prev = cur
+                lp_obj = {'tokens': pieces,
+                          'token_logprobs': lps[:len(visible)]}
+            return (self.tokenizer.decode(visible), reason, len(out),
+                    lp_obj)
 
         async def drain_terminal():
             # Consume through the terminal None so the slot is really
@@ -275,8 +295,8 @@ class InferenceServer:
             if tok is None:
                 tail = decode_incremental(None)
                 if tail and scan.feed(tail):
-                    return scan.text, 'stop', generated
-                return scan.text, 'length', generated
+                    return scan.text, 'stop', generated, None
+                return scan.text, 'length', generated, None
             generated += 1
             if params.eos_token is not None and \
                     tok == params.eos_token:
@@ -284,24 +304,33 @@ class InferenceServer:
                 tail = decode_incremental(None)
                 if tail:
                     scan.feed(tail)
-                return scan.text, 'stop', generated
+                return scan.text, 'stop', generated, None
             piece = decode_incremental(tok)
             if piece is None:
                 continue
             if scan.feed(piece):
                 self.engine.cancel(rid)
                 await drain_terminal()
-                return scan.text, 'stop', generated
+                return scan.text, 'stop', generated, None
 
-    async def _drain(self, out_q) -> List[int]:
+    async def _drain(self, out_q):
+        """-> (tokens, logprobs_or_None); the queue yields bare ints,
+        or (token, logprob) pairs when params.logprobs is set."""
         loop = asyncio.get_running_loop()
         out: List[int] = []
+        lps: List[float] = []
+        saw_pairs = False
         while True:
-            tok = await loop.run_in_executor(
+            item = await loop.run_in_executor(
                 None, functools.partial(out_q.get, timeout=300))
-            if tok is None:
-                return out
-            out.append(tok)
+            if item is None:
+                return out, (lps if saw_pairs else None)
+            if isinstance(item, tuple):
+                saw_pairs = True
+                out.append(item[0])
+                lps.append(item[1])
+            else:
+                out.append(item)
 
     def _finish(self, out: List[int],
                 params: 'engine_lib.SamplingParams'):
@@ -434,6 +463,10 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'stop must be a string or list of strings'},
                 status=400)
+        if params.logprobs and (stops or payload.get('stream')):
+            return web.json_response(
+                {'error': 'logprobs cannot combine with stop or '
+                          'stream'}, status=400)
         # n completions per prompt, choices prompt-major (OpenAI
         # layout). Distinct req_ids already decorrelate the sampling
         # streams (device keys seed with seed + req_id).
@@ -460,10 +493,13 @@ class InferenceServer:
             for rid, out_q in subs])
         choices = []
         total_out = 0
-        for i, (text, reason, n_gen) in enumerate(results):
+        for i, (text, reason, n_gen, lp_obj) in enumerate(results):
             total_out += n_gen
-            choices.append({'index': i, 'text': text,
-                            'finish_reason': reason})
+            choice = {'index': i, 'text': text,
+                      'finish_reason': reason}
+            if lp_obj is not None:
+                choice['logprobs'] = lp_obj
+            choices.append(choice)
         n_in = sum(len(t) for t in token_lists)
         return web.json_response({
             'id': f'cmpl-{subs[0][0]}', 'object': 'text_completion',
@@ -501,6 +537,12 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'stream supports n=1'}, status=400)
         params = self._sampling_from_openai(payload)
+        if params.logprobs:
+            # Chat logprobs use a different response schema (content
+            # arrays); reject loudly rather than degrade silently.
+            return web.json_response(
+                {'error': 'logprobs is not supported on chat '
+                          'completions'}, status=400)
         stops = self._stops_from_openai(payload)
         if stops is None:
             return web.json_response(
@@ -536,7 +578,7 @@ class InferenceServer:
             for crid, out_q in subs])
         choices = []
         total_out = 0
-        for i, (text, reason, n_gen) in enumerate(results):
+        for i, (text, reason, n_gen, _lp) in enumerate(results):
             total_out += n_gen
             choices.append({'index': i,
                             'message': {'role': 'assistant',

@@ -393,6 +393,25 @@ def test_openai_compat_endpoints(small_model):
                                  timeout=10).status_code
             assert code == 400, bad
 
+        # logprobs: chosen-token raw logprobs aligned with the text.
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': [9, 9, 9], 'max_tokens': 4,
+                                'logprobs': 1}, timeout=120).json()
+        lp = r['choices'][0]['logprobs']
+        assert len(lp['token_logprobs']) == len(lp['tokens']) == 4
+        assert all(isinstance(x, float) and x <= 0.0
+                   for x in lp['token_logprobs'])
+        assert ''.join(lp['tokens']) == r['choices'][0]['text']
+        # logprobs + stop / stream -> 400.
+        assert requests.post(base + '/v1/completions',
+                             json={'prompt': 'hi', 'logprobs': 1,
+                                   'stop': 'x'},
+                             timeout=10).status_code == 400
+        assert requests.post(base + '/v1/completions',
+                             json={'prompt': 'hi', 'logprobs': 1,
+                                   'stream': True},
+                             timeout=10).status_code == 400
+
         # n > 1: one choice per completion, prompt-major indexing.
         r = requests.post(base + '/v1/completions',
                           json={'prompt': 'hi', 'max_tokens': 3,
@@ -445,3 +464,52 @@ def test_engine_cancel_running_and_waiting(small_model):
         assert eng.cancel(12345) is False
     finally:
         eng.stop()
+
+
+def test_logprobs_match_recompute_reference(small_model):
+    """params.logprobs: the queue yields (token, logprob) pairs whose
+    logprob equals the raw log-softmax of a full-context recompute —
+    for the first token (host path), plain decode (device path), and
+    the speculative verify path (greedy parity extends to logprobs)."""
+    from skypilot_tpu.infer import server as server_lib
+
+    model, params = small_model
+    prompt = [5, 9, 2] * 4
+
+    def ref_lps(n_new):
+        toks = list(prompt)
+        out = []
+        for _ in range(n_new):
+            logits = model.apply(params, jnp.asarray([toks], jnp.int32))
+            row = jnp.asarray(logits[0, -1], jnp.float32)
+            lse = jax.scipy.special.logsumexp(row)
+            nxt = int(jnp.argmax(row))
+            out.append((nxt, float(row[nxt] - lse)))
+            toks.append(nxt)
+        return out
+
+    want = ref_lps(6)
+
+    def run(spec):
+        eng = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                         max_seq_len=64,
+                                         prefill_buckets=[16],
+                                         spec_decode=spec)
+        eng.start()
+        try:
+            _, q = eng.submit(prompt, engine_lib.SamplingParams(
+                max_new_tokens=6, logprobs=True))
+            got = []
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    return got
+                got.append(item)
+        finally:
+            eng.stop()
+
+    for spec in (0, 3):
+        got = run(spec)
+        assert [t for t, _ in got] == [t for t, _ in want], spec
+        for (t, lp), (_, wlp) in zip(got, want):
+            assert abs(lp - wlp) < 2e-3, (spec, t, lp, wlp)

@@ -326,3 +326,35 @@ def test_bucket_smaller_than_page():
                                        cache_mode='dense')
     assert _run(paged, prompts, max_new=4) == _run(dense, prompts,
                                                    max_new=4)
+
+
+def test_chunked_prefill_delivers_logprobs():
+    """The chunked-prefill admission tail must deliver the first
+    token's logprob like the plain admission path (regression: the
+    first_lp wiring initially missed this site and killed the loop)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(llama.CONFIGS['debug'], max_seq_len=256)
+    model = llama.LlamaModel(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    eng = engine_lib.InferenceEngine(
+        model, params, num_slots=2, max_seq_len=256,
+        cache_mode='paged', page_size=16, prefill_chunk=32)
+    eng.start()
+    try:
+        prompt = list(np.random.default_rng(0).integers(
+            1, cfg.vocab_size, 80))   # > prefill_chunk -> chunked path
+        _, q = eng.submit([int(t) for t in prompt],
+                          engine_lib.SamplingParams(max_new_tokens=4,
+                                                    logprobs=True))
+        got = []
+        while True:
+            item = q.get(timeout=300)
+            if item is None:
+                break
+            got.append(item)
+    finally:
+        eng.stop()
+    assert len(got) == 4
+    assert all(isinstance(t, tuple) and t[1] <= 0.0 for t in got)
