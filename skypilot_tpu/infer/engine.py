@@ -48,6 +48,16 @@ class SamplingParams:
     # the nucleus to the top-64 logits (_TOPK_BUCKET) — for real models
     # the p-nucleus is almost always far smaller.
     top_p: float = 1.0
+    # OpenAI-style repetition penalties over OUTPUT tokens (the vLLM
+    # counting convention; prompt tokens are not penalized):
+    #   logits[v] -= frequency_penalty * count[v]
+    #              + presence_penalty * (count[v] > 0)
+    # Applied to raw logits before temperature/top-k/top-p; work with
+    # greedy too. Speculative decoding falls back to the plain path for
+    # penalized requests (the verify target would change within a
+    # draft run), matching vLLM.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     eos_token: Optional[int] = None
     seed: int = 0
     # True: the out_queue yields (token, logprob) pairs — the chosen
@@ -196,16 +206,21 @@ def _np_raw_lp(logits_row, tok: int) -> float:
 
 
 def _update_args(args, slot, first_tok, length, temp, key, topk,
-                 topp):
+                 topp, pres, freq):
     """Write one slot's decode args on device (shared by both insert
-    impls)."""
-    last, lens, temps, keys, topks, topps = args
+    impls). The slot's output-token count row resets, then the first
+    generated token is counted (penalties cover output tokens only)."""
+    last, lens, temps, keys, topks, topps, press, freqs, counts = args
+    counts = counts.at[slot].set(0).at[slot, first_tok].set(1)
     return (last.at[slot].set(first_tok),
             lens.at[slot].set(length),
             temps.at[slot].set(temp),
             keys.at[slot].set(key),
             topks.at[slot].set(topk),
-            topps.at[slot].set(topp))
+            topps.at[slot].set(topp),
+            press.at[slot].set(pres),
+            freqs.at[slot].set(freq),
+            counts)
 
 
 class InferenceEngine:
@@ -393,8 +408,9 @@ class InferenceEngine:
         # so plain-path chunks keep the proposer's invariant intact.
         self._jit_decode_n = jax.jit(
             self._decode_n_impl,
-            donate_argnums=(1, 8) if self.spec_decode > 0 else (1,),
-            static_argnames=('n', 'sampling'))
+            donate_argnums=(1, 10, 11) if self.spec_decode > 0
+            else (1, 10),   # cache, counts (+hist under spec)
+            static_argnames=('n', 'sampling', 'penalize'))
         # Donate the global cache and the decode-arg arrays (updated in
         # place); the prefill cache is NOT donatable (B=1 buffers cannot
         # alias the B=slots cache).
@@ -494,7 +510,7 @@ class InferenceEngine:
             return cache
 
     def _insert_impl(self, cache, prefill_cache, slot, args, first_tok,
-                     length, temp, key, topk, topp):
+                     length, temp, key, topk, topp, pres, freq):
         """ONE fused dispatch per admission: copy a prefill cache (B=1,
         S=max_seq) into `slot` of the global cache AND write the slot's
         decode args (last token, length, temp, rng key, topk) into the
@@ -509,11 +525,11 @@ class InferenceEngine:
                 big, small, (0, slot, 0, 0, 0))
         cache = jax.tree.map(upd, cache, prefill_cache)
         return cache, _update_args(args, slot, first_tok, length, temp,
-                                   key, topk, topp)
+                                   key, topk, topp, pres, freq)
 
     def _insert_paged_impl(self, cache, prefill_cache, slot, args,
                            first_tok, length, temp, key, topk, topp,
-                           page_ids, table_row, src_off):
+                           pres, freq, page_ids, table_row, src_off):
         """Paged-mode admission: scatter the prompt KV into the reserved
         pages, install the slot's block-table row, and update the decode
         args — one fused dispatch, same contract as _insert_impl.
@@ -540,7 +556,8 @@ class InferenceEngine:
             'tables': cache['tables'].at[slot].set(table_row),
         }
         return self._pin_paged_layouts(new_cache), _update_args(
-            args, slot, first_tok, length, temp, key, topk, topp)
+            args, slot, first_tok, length, temp, key, topk, topp,
+            pres, freq)
 
     def _insert_pages_impl(self, cache, prefill_cache, page_ids,
                            src_off):
@@ -566,7 +583,8 @@ class InferenceEngine:
                     jnp.zeros_like(cache['tables'][slot]))}
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
-                       keys, topks, topps, hist, n, sampling):
+                       keys, topks, topps, press, freqs, counts, hist,
+                       n, sampling, penalize):
         """Generate `n` tokens per slot in ONE dispatch: a device-side
         lax.scan of decode steps with on-device sampling (greedy when
         temps[i] == 0, else temperature categorical). The host pulls one
@@ -595,39 +613,53 @@ class InferenceEngine:
             return jnp.take_along_axis(logits, tok[:, None],
                                        axis=-1)[:, 0] - lse
 
+        n_range = jnp.arange(n_slots)
+
         def step(carry, _):
-            cache, last, lens, keys, hist = carry
+            cache, last, lens, keys, counts, hist = carry
             logits, cache = self.model.apply(params, last[:, None],
                                              positions=lens[:, None],
                                              cache=cache)
             logits = logits[:, 0, :].astype(jnp.float32)
+            lp_src = logits          # logprobs report RAW model values
+            if penalize:
+                # vLLM-convention repetition penalties over OUTPUT
+                # token counts, on raw logits before temp/top-k/top-p
+                # (greedy included: they change the argmax too).
+                logits = logits \
+                    - freqs[:, None] * counts.astype(jnp.float32) \
+                    - press[:, None] * (counts > 0).astype(jnp.float32)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if not sampling:
-                return (cache, greedy, lens + 1, keys,
-                        write_hist(hist, lens, greedy)), \
-                    (greedy, raw_lp(logits, greedy))
-            keys = jax.vmap(jax.random.split, in_axes=0,
-                            out_axes=0)(keys)[:, 0]
-            # One top-k/top-p filter serves the plain AND spec
-            # sampling paths — their target distributions must stay
-            # identical. Filter AFTER temperature scaling (nucleus
-            # membership depends on the scaled distribution).
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            filtered = _sampling_filter(scaled, topks, topps)
-            sampled = jax.vmap(jax.random.categorical)(keys, filtered)
-            tok = jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
-            return (cache, tok, lens + 1, keys,
+                tok = greedy
+            else:
+                keys = jax.vmap(jax.random.split, in_axes=0,
+                                out_axes=0)(keys)[:, 0]
+                # One top-k/top-p filter serves the plain AND spec
+                # sampling paths — their target distributions must stay
+                # identical. Filter AFTER temperature scaling (nucleus
+                # membership depends on the scaled distribution).
+                scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+                filtered = _sampling_filter(scaled, topks, topps)
+                sampled = jax.vmap(jax.random.categorical)(keys,
+                                                           filtered)
+                tok = jnp.where(temps > 0, sampled.astype(jnp.int32),
+                                greedy)
+            if penalize:
+                counts = counts.at[n_range, tok].add(1)
+            return (cache, tok, lens + 1, keys, counts,
                     write_hist(hist, lens, tok)), \
-                (tok, raw_lp(logits, tok))
+                (tok, raw_lp(lp_src, tok))
 
-        (cache, last, lens, keys, hist), (toks, lps) = jax.lax.scan(
-            step, (cache, last_tokens, lengths, keys, hist), None,
-            length=n)
+        (cache, last, lens, keys, counts, hist), (toks, lps) = \
+            jax.lax.scan(
+                step, (cache, last_tokens, lengths, keys, counts,
+                       hist), None, length=n)
         if 'tables' in cache:
             cache = self._pin_paged_layouts(cache)
         # last/lens returned device-resident so the next chunk's call
         # needs no host->device transfers in the steady state.
-        return toks, lps, cache, keys, last, lens, hist
+        return toks, lps, cache, keys, last, lens, counts, hist
 
     def _hist_insert_impl(self, hist, slot, tokens, length, first_tok):
         """Install an admitted prompt (+ its first generated token) into
@@ -910,7 +942,14 @@ class InferenceEngine:
                               jnp.zeros((n,), jnp.float32),
                               jnp.zeros((n, 2), jnp.uint32),
                               jnp.zeros((n,), jnp.int32),
-                              jnp.ones((n,), jnp.float32))
+                              jnp.ones((n,), jnp.float32),
+                              jnp.zeros((n,), jnp.float32),
+                              jnp.zeros((n,), jnp.float32),
+                              # Output-token counts for the repetition
+                              # penalties: [SLOTS, V] int32 (~4MB at
+                              # 128k vocab — noise next to the cache).
+                              jnp.zeros((n, self.cfg.vocab_size),
+                                        jnp.int32))
 
     def _admit_one(self) -> bool:
         req = self._deferred
@@ -1035,7 +1074,9 @@ class InferenceEngine:
                         jnp.int32(first), jnp.int32(n),
                         jnp.float32(temp), key,
                         jnp.int32(min(req.params.top_k, _TOPK_BUCKET)),
-                        jnp.float32(req.params.top_p))
+                        jnp.float32(req.params.top_p),
+                        jnp.float32(req.params.presence_penalty),
+                        jnp.float32(req.params.frequency_penalty))
             if self.cache_mode == 'paged':
                 reserved = int((row > 0).sum())
                 p = self.pool.cfg.page_size
@@ -1184,6 +1225,8 @@ class InferenceEngine:
                 jnp.int32(first), jnp.int32(n), jnp.float32(temp), key,
                 jnp.int32(min(req.params.top_k, _TOPK_BUCKET)),
                 jnp.float32(req.params.top_p),
+                jnp.float32(req.params.presence_penalty),
+                jnp.float32(req.params.frequency_penalty),
                 jnp.asarray(ids), jnp.asarray(row),
                 jnp.int32(first_page * psize))
             if self.prefix_caching:
@@ -1291,15 +1334,23 @@ class InferenceEngine:
                 rem_space = self.max_seq_len - 1 - int(
                     max(self._lengths[i] for i in active))
                 sampling = any(self._temps[i] > 0 for i in active)
+                penalize = any(
+                    self._slots[i].params.presence_penalty != 0.0 or
+                    self._slots[i].params.frequency_penalty != 0.0
+                    for i in active)
                 k = self.spec_decode
                 # Speculation needs headroom for the worst case (every
                 # draft accepted); sampled slots ride the rejection-
-                # sampling verify (speculative_sample_step) — no
-                # greedy-only restriction.
-                use_spec = k > 0 and rem_space // (k + 1) >= 1
+                # sampling verify (speculative_sample_step). Penalized
+                # slots fall back to the plain path: the penalty target
+                # shifts WITHIN a draft run (each emitted token changes
+                # the counts), which the one-shot verify cannot honor —
+                # the same fallback vLLM makes.
+                use_spec = k > 0 and not penalize and \
+                    rem_space // (k + 1) >= 1
                 self._ensure_dev_args()
-                (d_last, d_lens, d_temps, d_keys, d_topks,
-                 d_topps) = self._dev_args
+                (d_last, d_lens, d_temps, d_keys, d_topks, d_topps,
+                 d_press, d_freqs, d_counts) = self._dev_args
                 entries = [(i, self._slots[i]) for i in active]
                 if use_spec:
                     bound = max(1, min(self.decode_chunk,
@@ -1314,7 +1365,8 @@ class InferenceEngine:
                                 self._dev_hist, n=chunk, k=k,
                                 sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, d_keys,
-                                      d_topks, d_topps)
+                                      d_topks, d_topps, d_press,
+                                      d_freqs, d_counts)
                     new_pending = ('spec', toks, lps, counts,
                                    entries, chunk)
                     upper = chunk * (k + 1)
@@ -1325,14 +1377,17 @@ class InferenceEngine:
                     chunk = 1 << (bound.bit_length() - 1)
                     with self._ctx():
                         toks, lps, self.cache, keys, d_last, \
-                            d_lens, self._dev_hist = \
+                            d_lens, d_counts, self._dev_hist = \
                             self._jit_decode_n(
                                 self.params, self.cache, d_last, d_lens,
                                 d_temps, d_keys, d_topks, d_topps,
+                                d_press, d_freqs, d_counts,
                                 self._dev_hist,
-                                n=chunk, sampling=sampling)
+                                n=chunk, sampling=sampling,
+                                penalize=penalize)
                     self._dev_args = (d_last, d_lens, d_temps, keys,
-                                      d_topks, d_topps)
+                                      d_topks, d_topps, d_press,
+                                      d_freqs, d_counts)
                     new_pending = ('plain', toks, lps, None,
                                    entries, chunk)
                     upper = chunk

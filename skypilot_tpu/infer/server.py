@@ -144,6 +144,10 @@ class InferenceServer:
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
             top_p=float(payload.get('top_p', 1.0)),
+            presence_penalty=float(payload.get('presence_penalty',
+                                               0.0)),
+            frequency_penalty=float(payload.get('frequency_penalty',
+                                                0.0)),
             eos_token=eos)
         req_id, out_q = self.engine.submit(tokens, params)
         loop = asyncio.get_running_loop()
@@ -185,10 +189,16 @@ class InferenceServer:
             top_p=float(payload.get('top_p', 1.0)),
             eos_token=self.tokenizer.eos_id,
             seed=int(payload.get('seed', 0)),
-            # OpenAI 'logprobs' is an int (0 = chosen-token only, N =
-            # N alternatives); presence turns it on. Only chosen-token
-            # logprobs are computed here regardless of N (documented).
-            logprobs=payload.get('logprobs') is not None)
+            presence_penalty=float(payload.get('presence_penalty',
+                                               0.0)),
+            frequency_penalty=float(payload.get('frequency_penalty',
+                                                0.0)),
+            # OpenAI 'logprobs': completions uses int|null (0 is a
+            # valid ON value: chosen-token only); chat uses bool.
+            # False/null => off; 0/True/N => on. Only chosen-token
+            # logprobs are computed regardless of N (documented).
+            logprobs=(payload.get('logprobs') is not None and
+                      payload.get('logprobs') is not False))
 
     @staticmethod
     def _parse_n(payload) -> Optional[int]:
@@ -264,14 +274,16 @@ class InferenceServer:
             visible, reason = self._finish(out, params)
             lp_obj = None
             if lps is not None:
-                # Per-token text via prefix decodes so the pieces
-                # concatenate EXACTLY to the response text (isolated
-                # per-token decode breaks BPE/sentencepiece merges).
-                pieces, prev = [], ''
-                for j in range(len(visible)):
-                    cur = self.tokenizer.decode(visible[:j + 1])
-                    pieces.append(cur[len(prev):])
-                    prev = cur
+                # Per-token text via the incremental decoder (one O(n)
+                # pass; a multi-byte UTF-8 sequence spanning tokens
+                # yields '' for the held tokens and the full piece at
+                # the completing token) — the pieces concatenate
+                # EXACTLY to the response text.
+                dec = self._incremental_decoder()
+                pieces = [dec(t) or '' for t in visible]
+                tail = dec(None)
+                if tail and pieces:
+                    pieces[-1] += tail
                 lp_obj = {'tokens': pieces,
                           'token_logprobs': lps[:len(visible)]}
             return (self.tokenizer.decode(visible), reason, len(out),
