@@ -91,6 +91,11 @@ def main(argv=None) -> None:
     parser.add_argument('--steps', type=int, default=1000)
     parser.add_argument('--batch', type=int, default=8)
     parser.add_argument('--seq', type=int, default=2048)
+    parser.add_argument('--attn', default='auto',
+                        choices=['auto', 'flash', 'xla', 'ring'],
+                        help="'ring' = ring attention over the cp mesh "
+                             "axis (long-context sequence parallelism; "
+                             "pair with --mesh cp=N)")
     parser.add_argument('--lr', type=float, default=3e-4)
     parser.add_argument('--data', default=None,
                         help='JSONL path; default synthetic')
@@ -130,11 +135,17 @@ def main(argv=None) -> None:
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.train import trainer
 
+    import dataclasses as _dc
+
     if args.model in llama.CONFIGS:
         cfg = llama.CONFIGS[args.model]
+        if args.attn != 'auto':
+            cfg = _dc.replace(cfg, attn_impl=args.attn)
         model = llama.LlamaModel(cfg)
     elif args.model in moe.MIXTRAL_CONFIGS:
         cfg, moe_cfg = moe.MIXTRAL_CONFIGS[args.model]
+        if args.attn != 'auto':
+            cfg = _dc.replace(cfg, attn_impl=args.attn)
         model = moe.MixtralModel(cfg, moe_cfg)
     else:
         raise SystemExit(
@@ -154,6 +165,13 @@ def main(argv=None) -> None:
         spec = parse_mesh(args.mesh, jax.device_count())
         mesh = mesh_lib.build_mesh(spec)
         logger.info('mesh: %s', spec)
+    if args.attn == 'ring' and spec.cp <= 1:
+        # Without a cp axis the model would silently fall back to full
+        # per-device attention — at long-context shapes that is an OOM
+        # or a run without the requested sequence parallelism.
+        raise SystemExit(
+            "--attn ring needs a context-parallel mesh axis: add cp=N "
+            "to --mesh (e.g. --mesh cp=8,tp=2)")
 
     tcfg = trainer.TrainerConfig(learning_rate=args.lr,
                                  total_steps=args.steps)

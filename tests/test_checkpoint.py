@@ -86,3 +86,11 @@ def test_sft_multislice_hybrid_mesh_runs():
     sft.main(['--model', 'debug', '--mesh', 'fsdp=2,tp=2',
               '--dcn-mesh', 'dp=2', '--steps', '2', '--batch', '4',
               '--seq', '32', '--log-every', '1'])
+
+
+def test_sft_ring_attention_runs():
+    """--attn ring + --mesh cp: ring attention over the context axis
+    end to end (the long_context.yaml recipe's code path)."""
+    sft.main(['--model', 'debug', '--mesh', 'cp=4,tp=2', '--attn',
+              'ring', '--steps', '2', '--batch', '2', '--seq', '64',
+              '--log-every', '1'])
