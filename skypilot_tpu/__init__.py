@@ -10,7 +10,7 @@ parallelism library (mesh presets, ring attention, Pallas kernels) the
 reference delegates to user containers.
 """
 
-__version__ = '0.1.0'
+__version__ = '0.4.0'
 
 from skypilot_tpu.accelerators import TpuTopology, parse_tpu
 from skypilot_tpu.dag import Dag
