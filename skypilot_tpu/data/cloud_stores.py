@@ -6,6 +6,7 @@ rclone) run on the remote host. Here: one function, scheme-dispatched.
 GCS is first-class; s3/r2/https work wherever the remote host has the
 matching CLI (TPU VMs ship gsutil + curl).
 """
+import os
 import shlex
 
 from skypilot_tpu import exceptions
@@ -14,22 +15,32 @@ from skypilot_tpu.data import data_utils
 
 def download_command(source: str, target: str) -> str:
     """Shell command (run on the remote host) to fetch `source` into
-    `target`. Directory sources sync recursively; file sources copy."""
+    `target`.
+
+    Directory/prefix sources sync recursively into `target` (a dir);
+    single-object sources land AS `target` (file->file, the reference's
+    file_mount semantics, sky/cloud_stores.py is_directory() dispatch).
+    Which case applies is decided at runtime on the remote host — the
+    client can't stat the bucket from here.
+    """
     scheme, bucket, path = data_utils.split_uri(source)
     q_target = shlex.quote(target)
+    q_parent = shlex.quote(os.path.dirname(target.rstrip('/')) or '.')
     if scheme == 'gs':
-        return (f'mkdir -p {q_target} && '
-                f'(gsutil -m rsync -r {shlex.quote(source)} {q_target} '
-                f'2>/dev/null || gsutil cp {shlex.quote(source)} '
-                f'{q_target})')
+        # `gsutil stat` succeeds only for objects, never prefixes.
+        return (f'if gsutil -q stat {shlex.quote(source)}; then '
+                f'mkdir -p {q_parent} && '
+                f'gsutil cp {shlex.quote(source)} {q_target}; else '
+                f'mkdir -p {q_target} && '
+                f'gsutil -m rsync -r {shlex.quote(source)} {q_target}; fi')
     if scheme == 'local':
         src_dir = f'{data_utils.local_store_root()}/{bucket}'
         if path:
             src_dir = f'{src_dir}/{path}'
         q_src = shlex.quote(src_dir)
-        return (f'mkdir -p {q_target} && if [ -d {q_src} ]; then '
-                f'cp -a {q_src}/. {q_target}/; else '
-                f'cp -a {q_src} {q_target}/; fi')
+        return (f'if [ -d {q_src} ]; then '
+                f'mkdir -p {q_target} && cp -a {q_src}/. {q_target}/; '
+                f'else mkdir -p {q_parent} && cp -a {q_src} {q_target}; fi')
     if scheme in ('s3', 'r2', 'cos'):
         ep = ''
         if scheme in ('r2', 'cos'):
@@ -40,8 +51,15 @@ def download_command(source: str, target: str) -> str:
                          else storage_lib.IbmCosStore)
             ep = f' --endpoint-url {shlex.quote(store_cls.endpoint())}'
             source = 's3://' + source[len(scheme) + 3:]
-        return (f'mkdir -p {q_target} && '
-                f'aws s3 sync {shlex.quote(source)} {q_target}{ep}')
+        # `head-object` succeeds only for exact objects (the s3 analog
+        # of `gsutil stat`) — dispatching on `aws s3 cp` failure would
+        # turn auth/network errors into a silently-empty prefix sync.
+        return (f'if aws s3api head-object --bucket {shlex.quote(bucket)} '
+                f'--key {shlex.quote(path)}{ep} >/dev/null 2>&1; then '
+                f'mkdir -p {q_parent} && '
+                f'aws s3 cp {shlex.quote(source)} {q_target}{ep}; else '
+                f'mkdir -p {q_target} && '
+                f'aws s3 sync {shlex.quote(source)} {q_target}{ep}; fi')
     if scheme == 'az':
         from skypilot_tpu.data import storage as storage_lib
         acct = storage_lib.AzureBlobStore.account()

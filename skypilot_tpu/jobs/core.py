@@ -85,6 +85,20 @@ def launch(entrypoint: Union[Any, 'list'],
             f"controller must be 'process' or 'cluster', got "
             f'{controller!r}')
 
+    if controller == 'cluster':
+        # A VM-hosted controller recovers the job long after the client
+        # is gone: client-local workdir/file_mounts must move to buckets
+        # first (reference: sky/utils/controller_utils.py:567, called
+        # from sky/jobs/core.py:78).
+        from skypilot_tpu.utils import controller_utils
+        # Validate every task's local sources before uploading anything:
+        # a typo in task N must not orphan buckets for tasks 1..N-1.
+        for t in tasks:
+            controller_utils.validate_local_sources(t)
+        for t in tasks:
+            controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+                t, task_type='jobs')
+
     job_name = name or tasks[0].name or 'managed'
     job_id = jobs_state.create_job(job_name, '', len(tasks),
                                    retry_until_up=retry_until_up)
@@ -142,7 +156,8 @@ def _launch_controller_on_cluster(job_id: int, dag_yaml: str) -> None:
                                      {'cpus': '4+'})
     envs = {k: os.environ[k]
             for k in ('SKYT_STATE_DIR', 'SKYT_LOCAL_ROOT',
-                      'SKYT_DEFAULT_STORE', 'SKYT_JOBS_CHECK_GAP',
+                      'SKYT_DEFAULT_STORE', 'SKYT_LOCAL_STORAGE_ROOT',
+                      'SKYT_JOBS_CHECK_GAP',
                       'SKYT_JOBS_PREEMPTION_GRACE')
             if k in os.environ}
     run_cmd = (

@@ -80,6 +80,14 @@ def up(task: Any, service_name: Optional[str] = None,
         raise exceptions.NotSupportedError(
             f"serve controller must be 'process' or 'cluster', got "
             f'{controller!r}')
+    if controller == 'cluster':
+        # Replicas are relaunched by the controller VM after the client
+        # is gone; move client-local sources to buckets first
+        # (reference: sky/serve/core.py calls
+        # maybe_translate_local_file_mounts_and_sync_up the same way).
+        from skypilot_tpu.utils import controller_utils
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task, task_type='serve')
     service_name = service_name or task.name or 'service'
     task_yaml = os.path.join(_serve_dir(), f'{service_name}.task.yaml')
     with open(task_yaml, 'w', encoding='utf-8') as f:
@@ -87,7 +95,8 @@ def up(task: Any, service_name: Optional[str] = None,
 
     controller_port, lb_port = _two_free_ports()
     if not serve_state.add_service(service_name, task.service, task_yaml,
-                                   controller_port, lb_port):
+                                   controller_port, lb_port,
+                                   controller_mode=controller):
         raise exceptions.NotSupportedError(
             f'Service {service_name!r} already exists. Use '
             f'`serve update` to change it or `serve down` first.')
@@ -135,7 +144,7 @@ def _launch_controller_on_cluster(service_name: str) -> None:
         ('serve', 'controller', 'resources'), {'cpus': '4+'})
     envs = {k: os.environ[k]
             for k in ('SKYT_STATE_DIR', 'SKYT_LOCAL_ROOT',
-                      'SKYT_DEFAULT_STORE',
+                      'SKYT_DEFAULT_STORE', 'SKYT_LOCAL_STORAGE_ROOT',
                       'SKYT_SERVE_CONTROLLER_INTERVAL',
                       'SKYT_SERVE_LB_SYNC_INTERVAL')
             if k in os.environ}
@@ -175,6 +184,13 @@ def update(task: Any, service_name: str) -> int:
     if task.service is None:
         raise exceptions.InvalidTaskError(
             'Task needs a `service:` section.')
+    if svc.get('controller_mode') == 'cluster':
+        # Cluster-hosted controller (mode recorded at up()): new-version
+        # replicas launch from the controller VM, so local sources must
+        # move to buckets.
+        from skypilot_tpu.utils import controller_utils
+        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+            task, task_type='serve')
     version = svc['version'] + 1
     task_yaml = os.path.join(_serve_dir(),
                              f'{service_name}.task.v{version}.yaml')

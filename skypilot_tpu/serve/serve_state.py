@@ -69,7 +69,13 @@ def _get_db() -> sqlite3.Connection:
                     controller_port INTEGER,
                     lb_port INTEGER,
                     controller_pid INTEGER,
+                    controller_mode TEXT DEFAULT 'process',
                     created_at REAL)""")
+            try:  # migrate pre-controller_mode DBs
+                _DB.execute("ALTER TABLE services ADD COLUMN "
+                            "controller_mode TEXT DEFAULT 'process'")
+            except sqlite3.OperationalError:
+                pass  # column already exists
             _DB.execute("""
                 CREATE TABLE IF NOT EXISTS replicas (
                     service_name TEXT,
@@ -92,19 +98,25 @@ def reset_db_for_testing() -> None:
 
 # ---------------------------------------------------------------- services
 def add_service(name: str, spec: Any, task_yaml: str,
-                controller_port: int, lb_port: int) -> bool:
-    """False if the service already exists."""
+                controller_port: int, lb_port: int,
+                controller_mode: str = 'process') -> bool:
+    """False if the service already exists.
+
+    controller_mode ('process'|'cluster') is recorded at creation so
+    later operations (serve update translation) branch on the recorded
+    placement, not on an inference like pid-liveness.
+    """
     db = _get_db()
     with _DB_LOCK:
         try:
             db.execute(
                 """INSERT INTO services (name, status, spec, task_yaml,
                                          controller_port, lb_port,
-                                         created_at)
-                   VALUES (?, ?, ?, ?, ?, ?, ?)""",
+                                         controller_mode, created_at)
+                   VALUES (?, ?, ?, ?, ?, ?, ?, ?)""",
                 (name, ServiceStatus.CONTROLLER_INIT.value,
                  pickle.dumps(spec), task_yaml, controller_port, lb_port,
-                 time.time()))
+                 controller_mode, time.time()))
             db.commit()
             return True
         except sqlite3.IntegrityError:

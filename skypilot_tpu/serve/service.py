@@ -61,11 +61,35 @@ async def _serve(service_name: str) -> None:
             break
         if svc['status'] is serve_state.ServiceStatus.SHUTTING_DOWN and \
                 controller.replica_manager.num_alive() == 0:
+            _cleanup_ephemeral_storages(service_name, svc['task_yaml'])
             serve_state.remove_service(service_name)
             break
     await lb_runner.cleanup()
     await controller_runner.cleanup()
     logger.info('service %s shut down.', service_name)
+
+
+def _cleanup_ephemeral_storages(service_name: str,
+                                task_yaml: str) -> None:
+    """Delete translated (persistent: False) buckets when the service
+    terminates — every version's, not just the current one (rolling
+    updates leave each version's buckets behind; reference:
+    sky/serve/service.py:64 cleanup_storage). The jobs analog lives in
+    jobs/controller.py `_cleanup`."""
+    import glob
+
+    import yaml
+
+    from skypilot_tpu.utils import controller_utils
+    pattern = os.path.join(os.path.dirname(task_yaml),
+                           f'{service_name}.task*.yaml')
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path, encoding='utf-8') as f:
+                cfg = yaml.safe_load(f) or {}
+            controller_utils.cleanup_ephemeral_storages(cfg)
+        except OSError as e:
+            logger.warning('storage cleanup skipped for %s: %s', path, e)
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,218 @@
+"""Client-side task rewriting for VM-hosted controllers.
+
+When a managed job or service is supervised by a controller running on a
+*cluster* (not a client-side process), recovery happens long after the
+client machine is gone — so a task that references client-local paths
+(`workdir:`, `file_mounts:` with local sources, storage mounts with
+local sources) would break on the first relaunch. This module uploads
+every local source to a bucket up front and rewrites the task to pull
+from the bucket instead, making the serialized task self-contained.
+
+Reference: sky/utils/controller_utils.py:567
+`maybe_translate_local_file_mounts_and_sync_up` (workdir -> bucket,
+dir-mounts -> per-mount buckets, file-mounts -> one hardlinked staging
+bucket, then replace local storage sources with bucket URIs). The
+TPU-native build keeps the same four-way split but uploads eagerly
+through the data layer (GCS-first; `local://` offline) and rewrites
+everything to plain bucket URIs that the backend's runtime download
+dispatch (data/cloud_stores.py) already understands — no special
+controller-side mount protocol.
+"""
+import getpass
+import os
+import re
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.data import storage as storage_lib
+from skypilot_tpu.data import storage_mounting
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# Bucket name templates (reference: sky/skylet/constants.py
+# WORKDIR_BUCKET_NAME / FILE_MOUNTS_BUCKET_NAME /
+# FILE_MOUNTS_FILE_ONLY_BUCKET_NAME).
+_WORKDIR_BUCKET = 'skyt-workdir-{user}-{run_id}'
+_FM_DIR_BUCKET = 'skyt-fm-{user}-{run_id}-{i}'
+_FM_FILE_BUCKET = 'skyt-fm-files-{user}-{run_id}'
+
+# Must match backends/tpu_backend.WORKDIR_TARGET: the setup/run scripts
+# `cd ~/skyt_workdir` whenever that directory exists, whether it arrived
+# by rsync (direct launch) or by bucket download (translated launch).
+WORKDIR_DST = 'skyt_workdir'
+
+
+def _clean_username() -> str:
+    user = re.sub(r'[^a-z0-9-]', '-', getpass.getuser().lower())
+    return user.strip('-') or 'user'
+
+
+def validate_local_sources(task: Any) -> None:
+    """Cheap existence/collision checks, run BEFORE any upload.
+
+    Callers translating several tasks (a chain DAG, serve up+update)
+    validate every task first so a typo in task N doesn't orphan the
+    buckets already uploaded for tasks 1..N-1.
+    """
+    if task.workdir is not None:
+        for dst in list(task.file_mounts) + list(task.storage_mounts):
+            if _normalize_dst(dst) == WORKDIR_DST:
+                raise exceptions.InvalidTaskError(
+                    f'Cannot translate workdir: {dst!r} is already a '
+                    f'file/storage mount target.')
+    for dst, src in task.file_mounts.items():
+        if data_utils.is_cloud_uri(src):
+            continue
+        if not os.path.exists(os.path.abspath(os.path.expanduser(src))):
+            raise exceptions.InvalidTaskError(
+                f'file_mount source {src!r} ({dst!r}) does not exist')
+    for dst, spec in task.storage_mounts.items():
+        # Storage() itself validates local-source existence.
+        storage_mounting.to_storage(spec)
+
+
+def maybe_translate_local_file_mounts_and_sync_up(
+        task: Any, task_type: str = 'jobs') -> None:
+    """Upload local sources to buckets and rewrite `task` in place.
+
+    After this call the task has no `workdir`, no local-path
+    `file_mounts`, and every storage mount's `source` is a bucket URI —
+    i.e. the task can be launched (and re-launched on recovery) from any
+    machine with bucket access. Translated buckets are `persistent:
+    False`, so the jobs/serve controller deletes them with the job
+    (jobs/controller.py `_maybe_delete_storage`; serve/service.py
+    shutdown cleanup via `cleanup_ephemeral_storages`).
+
+    No-op for tasks that never touch the client filesystem.
+    """
+    validate_local_sources(task)
+    run_id = uuid.uuid4().hex[:8]
+    user = _clean_username()
+    store_type = storage_lib.default_store_type()
+    # normalized dst -> Storage to upload
+    new_mounts: Dict[str, Any] = {}
+
+    # 1. workdir -> bucket, downloaded to ~/skyt_workdir on every host.
+    if task.workdir is not None:
+        bucket = _WORKDIR_BUCKET.format(user=user, run_id=run_id)
+        new_mounts[WORKDIR_DST] = storage_lib.Storage(
+            name=bucket, source=task.workdir,
+            mode=storage_lib.StorageMode.COPY, persistent=False)
+        logger.info('%s: workdir %r -> bucket %r', task_type,
+                    task.workdir, bucket)
+        task.workdir = None
+
+    # 2+3. Local file_mounts: directories get a bucket each; single
+    # files are hardlinked into one staging dir sharing one bucket.
+    file_srcs: Dict[str, str] = {}  # dst -> abs file path
+    for i, (dst, src) in enumerate(sorted(task.file_mounts.items())):
+        if data_utils.is_cloud_uri(src):
+            continue
+        expanded = os.path.abspath(os.path.expanduser(src))
+        del task.file_mounts[dst]
+        if os.path.isfile(expanded):
+            file_srcs[dst] = expanded
+            continue
+        bucket = _FM_DIR_BUCKET.format(user=user, run_id=run_id, i=i)
+        norm = _normalize_dst(dst)
+        if norm in new_mounts:
+            raise exceptions.InvalidTaskError(
+                f'file_mount targets collide after ~/ normalization: '
+                f'{dst!r} vs {norm!r}')
+        new_mounts[norm] = storage_lib.Storage(
+            name=bucket, source=src,
+            mode=storage_lib.StorageMode.COPY, persistent=False)
+        logger.info('%s: file_mount %r (%r) -> bucket %r', task_type,
+                    dst, src, bucket)
+
+    if file_srcs:
+        staging = tempfile.mkdtemp(prefix=f'skyt-fm-{run_id}-')
+        src_to_id = {}
+        for i, src in enumerate(sorted(set(file_srcs.values()))):
+            src_to_id[src] = i
+            staged = os.path.join(staging, f'file-{i}')
+            try:
+                os.link(src, staged)
+            except OSError:  # cross-device; fall back to a copy
+                shutil.copy2(src, staged)
+        bucket = _FM_FILE_BUCKET.format(user=user, run_id=run_id)
+        storage = storage_lib.Storage(
+            name=bucket, source=staging,
+            mode=storage_lib.StorageMode.COPY, persistent=False)
+        store = storage.add_store(store_type)
+        shutil.rmtree(staging, ignore_errors=True)
+        # Rewrite each file mount to the staged object's URI; the
+        # backend's runtime file-vs-prefix dispatch lands it AS dst.
+        for dst, src in file_srcs.items():
+            task.file_mounts[_normalize_dst(dst)] = (
+                f'{store.uri}/file-{src_to_id[src]}')
+        logger.info('%s: %d file mount(s) -> bucket %r', task_type,
+                    len(file_srcs), bucket)
+
+    # 4. Upload the new buckets and register them as storage mounts
+    # whose source is the bucket URI (nothing client-local survives).
+    for dst, storage in new_mounts.items():
+        store = storage.add_store(store_type)
+        task.storage_mounts[dst] = {
+            'name': storage.name,
+            'source': store.uri,
+            'mode': storage.mode.value,
+            'persistent': False,
+            'store': store_type.value.lower(),
+        }
+
+    # 5. Pre-existing storage mounts with a local source: upload now
+    # (honoring an explicitly requested store), then point the spec at
+    # the bucket URI (reference step 6).
+    for dst, spec in list(task.storage_mounts.items()):
+        storage = storage_mounting.to_storage(spec)
+        if storage.source is None or \
+                data_utils.is_cloud_uri(storage.source):
+            continue
+        store = storage.add_store(storage.requested_store)
+        task.storage_mounts[dst] = {
+            'name': storage.name,
+            'source': store.uri,
+            'mode': storage.mode.value,
+            'persistent': storage.persistent,
+            'store': store.store_type.value.lower(),
+        }
+        logger.info('%s: storage mount %r local source uploaded to %r',
+                    task_type, dst, store.uri)
+
+
+def cleanup_ephemeral_storages(task_config: Dict[str, Any]) -> None:
+    """Delete non-persistent buckets referenced by a serialized task.
+
+    The teardown half of the translation above, shared by the serve
+    controller at service shutdown (jobs has its own richer variant in
+    jobs/controller.py `_maybe_delete_storage`). Only buckets registered
+    in the state DB are touched — never an external bucket.
+    """
+    from skypilot_tpu import state
+    mounts = dict(task_config.get('file_mounts') or {})
+    mounts.update(task_config.get('storage_mounts') or {})
+    for spec in mounts.values():
+        if not isinstance(spec, dict) or spec.get('persistent', True):
+            continue
+        name = spec.get('name')
+        if not name:
+            continue
+        try:
+            if state.get_storage(name) is not None:
+                storage_lib.Storage.delete_by_name(name)
+                logger.info('deleted ephemeral storage %r', name)
+        except exceptions.SkyTpuError as e:
+            logger.warning('ephemeral storage %r not cleaned up: %s',
+                           name, e)
+
+
+def _normalize_dst(dst: str) -> str:
+    """`~/x` -> `x`: runner commands execute in the remote home, and a
+    quoted `~` would never expand (see data/cloud_stores.py quoting)."""
+    return dst[2:] if dst.startswith('~/') else dst

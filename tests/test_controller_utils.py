@@ -1,0 +1,224 @@
+"""Client-local source translation for VM-hosted controllers.
+
+Covers skypilot_tpu/utils/controller_utils.py (the analog of reference
+sky/utils/controller_utils.py:567
+`maybe_translate_local_file_mounts_and_sync_up`): after translation a
+task must be launchable from a machine that has never seen the client's
+filesystem. Uses the `local://` store so no cloud CLI runs.
+"""
+import os
+import subprocess
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import state
+from skypilot_tpu.data import cloud_stores
+from skypilot_tpu.data import data_utils
+from skypilot_tpu.utils import controller_utils
+
+
+@pytest.fixture()
+def translate_env(tmp_path, tmp_state_dir, monkeypatch):
+    monkeypatch.setenv('SKYT_LOCAL_STORAGE_ROOT', str(tmp_path / 'buckets'))
+    monkeypatch.setenv('SKYT_DEFAULT_STORE', 'local')
+    yield tmp_path
+
+
+def _translate(task):
+    controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+        task, task_type='jobs')
+
+
+def test_workdir_translated_to_bucket(translate_env, tmp_path):
+    workdir = tmp_path / 'wd'
+    workdir.mkdir()
+    (workdir / 'train.py').write_text('print("hi")\n')
+    task = sky.Task(name='t', run='python train.py', workdir=str(workdir))
+    _translate(task)
+    assert task.workdir is None
+    spec = task.storage_mounts[controller_utils.WORKDIR_DST]
+    assert spec['source'].startswith('local://skyt-workdir-')
+    assert spec['mode'] == 'COPY'
+    assert spec['persistent'] is False
+    # The bucket actually holds the workdir content (uploaded eagerly).
+    bucket_dir = os.path.join(data_utils.local_store_root(), spec['name'])
+    assert os.path.isfile(os.path.join(bucket_dir, 'train.py'))
+    # And the ephemeral bucket is registered for controller cleanup.
+    assert state.get_storage(spec['name']) is not None
+
+
+def test_dir_file_mount_becomes_storage_mount(translate_env, tmp_path):
+    src = tmp_path / 'dataset'
+    src.mkdir()
+    (src / 'x.csv').write_text('1,2\n')
+    task = sky.Task(name='t', run='ls', file_mounts={'/data': str(src)})
+    _translate(task)
+    assert task.file_mounts == {}
+    spec = task.storage_mounts['/data']
+    assert spec['source'].startswith('local://skyt-fm-')
+    bucket_dir = os.path.join(data_utils.local_store_root(), spec['name'])
+    assert os.path.isfile(os.path.join(bucket_dir, 'x.csv'))
+
+
+def test_file_mounts_rewritten_to_bucket_uris(translate_env, tmp_path):
+    cfg = tmp_path / 'config.yaml'
+    cfg.write_text('lr: 3e-4\n')
+    task = sky.Task(name='t', run='cat cfg/config.yaml',
+                    file_mounts={'~/cfg/config.yaml': str(cfg),
+                                 '/etc2/conf2.yaml': str(cfg)})
+    _translate(task)
+    # Both dsts point at the SAME staged object (same source file), with
+    # the ~/ prefix normalized away (runner cwd is the remote home).
+    uris = set(task.file_mounts.values())
+    assert len(uris) == 1
+    uri = uris.pop()
+    assert uri.startswith('local://skyt-fm-files-')
+    assert uri.endswith('/file-0')
+    assert set(task.file_mounts) == {'cfg/config.yaml', '/etc2/conf2.yaml'}
+    # Object content survived the staging hardlink + upload.
+    scheme, bucket, path = data_utils.split_uri(uri)
+    staged = os.path.join(data_utils.local_store_root(), bucket, path)
+    assert open(staged, encoding='utf-8').read() == 'lr: 3e-4\n'
+
+
+def test_cloud_uri_mounts_untouched(translate_env):
+    task = sky.Task(name='t', run='ls',
+                    file_mounts={'/d': 'gs://some-bucket/path'})
+    _translate(task)
+    assert task.file_mounts == {'/d': 'gs://some-bucket/path'}
+    assert task.storage_mounts == {}
+
+
+def test_noop_without_local_sources(translate_env):
+    task = sky.Task(name='t', run='echo hi')
+    _translate(task)
+    assert task.workdir is None
+    assert task.file_mounts == {}
+    assert task.storage_mounts == {}
+
+
+def test_existing_storage_mount_local_source_uploaded(
+        translate_env, tmp_path):
+    src = tmp_path / 'corpus'
+    src.mkdir()
+    (src / 'a.txt').write_text('aaa\n')
+    task = sky.Task(name='t', run='ls /mnt/corpus',
+                    storage_mounts={'/mnt/corpus': {
+                        'name': 'my-corpus', 'source': str(src),
+                        'mode': 'COPY'}})
+    _translate(task)
+    spec = task.storage_mounts['/mnt/corpus']
+    assert spec['source'] == 'local://my-corpus'
+    assert spec['persistent'] is True  # user default preserved
+    bucket_dir = os.path.join(data_utils.local_store_root(), 'my-corpus')
+    assert os.path.isfile(os.path.join(bucket_dir, 'a.txt'))
+
+
+def test_translated_task_yaml_is_self_contained(translate_env, tmp_path):
+    """The serialized task must round-trip with no client paths left."""
+    workdir = tmp_path / 'wd'
+    workdir.mkdir()
+    (workdir / 'm.txt').write_text('m\n')
+    task = sky.Task(name='t', run='cat m.txt', workdir=str(workdir))
+    _translate(task)
+    cfg = task.to_yaml_config()
+    assert 'workdir' not in cfg
+    assert str(tmp_path) not in str(cfg)
+    reloaded = sky.Task.from_yaml_config(cfg)
+    assert controller_utils.WORKDIR_DST in reloaded.storage_mounts
+
+
+def test_download_command_file_vs_dir_dispatch(translate_env, tmp_path):
+    """cloud_stores.download_command decides file-vs-prefix at runtime:
+    a single object lands AS the target path, a prefix syncs INTO it."""
+    root = data_utils.local_store_root()
+    os.makedirs(os.path.join(root, 'b', 'sub'), exist_ok=True)
+    with open(os.path.join(root, 'b', 'sub', 'f.txt'), 'w',
+              encoding='utf-8') as f:
+        f.write('content\n')
+
+    file_tgt = tmp_path / 'out' / 'renamed.txt'
+    cmd = cloud_stores.download_command('local://b/sub/f.txt',
+                                        str(file_tgt))
+    subprocess.run(['bash', '-c', cmd], check=True)
+    assert file_tgt.read_text() == 'content\n'
+
+    dir_tgt = tmp_path / 'outdir'
+    cmd = cloud_stores.download_command('local://b/sub', str(dir_tgt))
+    subprocess.run(['bash', '-c', cmd], check=True)
+    assert (dir_tgt / 'f.txt').read_text() == 'content\n'
+
+
+def test_workdir_collision_detected_after_normalization(
+        translate_env, tmp_path):
+    """`~/skyt_workdir` must collide with the workdir target even though
+    the raw strings differ (both normalize to the same remote dir)."""
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    assets = tmp_path / 'assets'
+    assets.mkdir()
+    task = sky.Task(name='t', run='ls', workdir=str(wd),
+                    file_mounts={'~/skyt_workdir': str(assets)})
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError, match='skyt_workdir'):
+        _translate(task)
+
+
+def test_storage_mount_requested_store_honored(
+        translate_env, tmp_path, monkeypatch):
+    """An explicit `store:` in a storage mount wins over the session
+    default (a gcs default must not hijack a local-store spec)."""
+    monkeypatch.setenv('SKYT_DEFAULT_STORE', 'gcs')
+    src = tmp_path / 'd'
+    src.mkdir()
+    (src / 'f').write_text('x')
+    task = sky.Task(name='t', run='ls',
+                    storage_mounts={'/m': {'name': 'picky', 'store': 'local',
+                                           'source': str(src),
+                                           'mode': 'COPY'}})
+    _translate(task)
+    spec = task.storage_mounts['/m']
+    assert spec['source'] == 'local://picky'
+    assert spec['store'] == 'local'
+
+
+def test_validate_before_upload_leaves_no_buckets(translate_env, tmp_path):
+    """A bad source anywhere must fail BEFORE any bucket is created."""
+    good = tmp_path / 'good'
+    good.mkdir()
+    task = sky.Task(name='t', run='ls',
+                    file_mounts={'/a': str(good),
+                                 '/b': str(tmp_path / 'missing')})
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError, match='missing'):
+        _translate(task)
+    root = data_utils.local_store_root()
+    assert not os.path.isdir(root) or os.listdir(root) == []
+
+
+def test_s3_download_command_dispatches_on_head_object():
+    """The s3 file-vs-prefix dispatch must probe with head-object, not
+    infer from `aws s3 cp` failure (which would mask auth errors as an
+    empty prefix sync)."""
+    cmd = cloud_stores.download_command('s3://bkt/model.pt', '/out/model.pt')
+    assert 'head-object' in cmd and '--bucket bkt' in cmd \
+        and '--key model.pt' in cmd
+    assert 'aws s3 cp' in cmd and 'aws s3 sync' in cmd
+    assert '2>/dev/null) ||' not in cmd
+
+
+def test_cleanup_ephemeral_storages(translate_env, tmp_path):
+    """The serve-side teardown helper removes only non-persistent,
+    state-registered buckets."""
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    (wd / 'f').write_text('x')
+    task = sky.Task(name='t', run='ls', workdir=str(wd))
+    _translate(task)
+    spec = task.storage_mounts[controller_utils.WORKDIR_DST]
+    assert state.get_storage(spec['name']) is not None
+    controller_utils.cleanup_ephemeral_storages(task.to_yaml_config())
+    assert state.get_storage(spec['name']) is None
+    bucket_dir = os.path.join(data_utils.local_store_root(), spec['name'])
+    assert not os.path.isdir(bucket_dir)

@@ -294,3 +294,57 @@ def test_probe_narrows_exceptions(monkeypatch):
     monkeypatch.setattr(cluster_state, 'get_cluster', lambda name: None)
     assert probe(object.__new__(controller_mod.JobsController),
                  'c', 1) is None
+
+
+def test_cluster_controller_translates_workdir_and_recovers(
+        cluster_controller_env, tmp_path):
+    """The headline file-mount-translation scenario (reference:
+    sky/utils/controller_utils.py:567 called from sky/jobs/core.py:78):
+    a managed job with a client-local workdir is preempted AFTER the
+    client's filesystem is gone; recovery must rebuild the workdir from
+    the translated bucket, not the client path."""
+    import shutil
+
+    import yaml as yaml_lib
+
+    workdir = tmp_path / 'client-workdir'
+    workdir.mkdir()
+    (workdir / 'marker.txt').write_text('from-client-workdir\n')
+    t = sky.Task(name='mj-wd', run='sleep 8 && cat marker.txt',
+                 workdir=str(workdir))
+    t.set_resources(resources_lib.Resources(cloud='local'))
+    jid = jobs_core.launch(t, retry_until_up=False, controller='cluster')
+
+    # Submission already rewrote the persisted DAG: no client paths.
+    job = jobs_state.get_job(jid)
+    with open(job['dag_yaml'], encoding='utf-8') as f:
+        cfgs = list(yaml_lib.safe_load_all(f))
+    assert len(cfgs) == 1 and 'workdir' not in cfgs[0]
+    assert str(workdir) not in str(cfgs[0])
+    mounts = cfgs[0]['file_mounts']
+    wd_spec = mounts['skyt_workdir']
+    assert wd_spec['source'].startswith('local://skyt-workdir-')
+
+    # The client filesystem leaves the picture entirely.
+    shutil.rmtree(workdir)
+
+    cluster = f'mj-wd-{jid}'
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        job = jobs_state.get_job(jid)
+        if job['status'] == jobs_state.ManagedJobStatus.RUNNING and \
+                state.get_cluster(cluster) is not None:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f'job never RUNNING: {jobs_state.get_job(jid)}')
+    core.down(cluster, purge=True)  # simulated preemption
+
+    job = jobs_core.wait(jid, timeout=300)
+    # `cat marker.txt` ran in ~/skyt_workdir rebuilt from the bucket —
+    # with the client dir deleted, success is only possible via the
+    # translated storage mount.
+    assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED
+    assert job['recovery_count'] >= 1
+    # Ephemeral translation bucket cleaned up with the job.
+    assert state.get_storage(wd_spec['name']) is None
