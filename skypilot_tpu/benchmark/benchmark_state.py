@@ -11,6 +11,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import state as state_lib
+from skypilot_tpu.utils import sqlite_utils
 
 
 class BenchmarkStatus(enum.Enum):
@@ -31,8 +32,7 @@ def _get_db() -> sqlite3.Connection:
     with _DB_LOCK:
         if _DB is None or _DB_PATH != path:
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            _DB = sqlite3.connect(path, check_same_thread=False)
-            _DB.row_factory = sqlite3.Row
+            _DB = sqlite_utils.connect(path)
             _DB.execute("""
                 CREATE TABLE IF NOT EXISTS benchmarks (
                     name TEXT PRIMARY KEY,

@@ -597,8 +597,17 @@ class Storage:
 
     @classmethod
     def from_handle(cls, handle: StorageHandle) -> 'Storage':
-        storage = cls(name=handle.storage_name, source=handle.source,
-                      mode=StorageMode(handle.mode))
+        """Rehydrate from the state DB WITHOUT re-validating the local
+        source: the handle may be read on a machine (or at a time) where
+        the source no longer exists — a controller VM deleting a
+        translated bucket, or the post-upload cleanup of a staging dir —
+        and deletion must still work."""
+        storage = cls.__new__(cls)
+        storage.name = handle.storage_name
+        storage.source = handle.source
+        storage.mode = StorageMode(handle.mode)
+        storage.persistent = True
+        storage.stores = {}
         for st_name in handle.store_types:
             st = StoreType(st_name)
             store = _STORE_CLASSES[st](handle.storage_name, handle.source,

@@ -216,10 +216,15 @@ class JobsController:
         except OSError:
             pass
         # Non-persistent storages are cleaned up with the job (reference:
-        # controller cleanup of ephemeral buckets).
+        # controller cleanup of ephemeral buckets). Translated
+        # single-file mounts live in one staging bucket referenced by
+        # URI string, not a storage-mount spec — clean those too.
+        from skypilot_tpu.utils import controller_utils
         for task in self.dag.tasks:
             for spec in (task.storage_mounts or {}).values():
                 self._maybe_delete_storage(spec)
+            controller_utils.cleanup_translated_file_buckets(
+                task.file_mounts or {})
 
     def _maybe_delete_storage(self, spec: Any) -> None:
         from skypilot_tpu.data import storage as storage_lib

@@ -97,7 +97,7 @@ def launch(entrypoint: Union[Any, 'list'],
             controller_utils.validate_local_sources(t)
         for t in tasks:
             controller_utils.maybe_translate_local_file_mounts_and_sync_up(
-                t, task_type='jobs')
+                t, task_type='jobs', pre_validated=True)
 
     job_name = name or tasks[0].name or 'managed'
     job_id = jobs_state.create_job(job_name, '', len(tasks),

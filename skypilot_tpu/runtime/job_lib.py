@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import sqlite_utils
+
 
 def agent_home() -> str:
     return os.path.expanduser(os.environ.get('SKYT_AGENT_HOME', '~'))
@@ -68,9 +70,7 @@ def _get_db() -> sqlite3.Connection:
         if _DB is None or _DB_HOME != home:
             if _DB is not None:
                 _DB.close()
-            _DB = sqlite3.connect(os.path.join(home, 'jobs.db'),
-                                  check_same_thread=False)
-            _DB.row_factory = sqlite3.Row
+            _DB = sqlite_utils.connect(os.path.join(home, 'jobs.db'))
             _DB.executescript("""
             CREATE TABLE IF NOT EXISTS jobs (
                 job_id INTEGER PRIMARY KEY AUTOINCREMENT,

@@ -7,6 +7,7 @@ reconcile/probe work runs on plain threads so the HTTP loop never blocks
 on cluster operations).
 """
 import asyncio
+import hmac
 import threading
 from typing import Optional
 
@@ -131,8 +132,33 @@ class SkyServeController:
                                    self.replica_manager.terminate_all)
         return web.json_response({'ok': True})
 
-    def make_app(self) -> web.Application:
-        app = web.Application()
+    def make_app(self, auth_token: Optional[str] = None
+                 ) -> web.Application:
+        """Admin API app. With auth_token set, every /controller/*
+        endpoint requires `Authorization: Bearer <token>` — the token is
+        minted per-service at serve up (serve_state.add_service) and
+        distributed only to the LB and the client state DB, so port
+        reachability alone cannot terminate or roll the service."""
+        middlewares = []
+        if auth_token:
+            expect = f'Bearer {auth_token}'
+
+            @web.middleware
+            async def _auth(request: web.Request, handler):
+                got = request.headers.get('Authorization', '')
+                # bytes compare: compare_digest raises on non-ASCII str,
+                # which would turn a garbage header into a 500.
+                if not hmac.compare_digest(
+                        got.encode('utf-8', 'surrogateescape'),
+                        expect.encode('utf-8')):
+                    return web.json_response(
+                        {'error': 'unauthorized: missing or bad '
+                                  'Authorization bearer token'},
+                        status=401)
+                return await handler(request)
+
+            middlewares.append(_auth)
+        app = web.Application(middlewares=middlewares)
         app.router.add_post('/controller/load_balancer_sync',
                             self._handle_lb_sync)
         app.router.add_post('/controller/update_service',

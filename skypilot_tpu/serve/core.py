@@ -49,6 +49,12 @@ def _controller_url(svc: Dict[str, Any]) -> str:
     return f'http://127.0.0.1:{svc["controller_port"]}'
 
 
+def _auth_headers(svc: Dict[str, Any]) -> Dict[str, str]:
+    """Bearer token for the controller admin API (minted at up())."""
+    token = svc.get('auth_token')
+    return {'Authorization': f'Bearer {token}'} if token else {}
+
+
 def up(task: Any, service_name: Optional[str] = None,
        wait_ready_timeout: float = 0.0,
        controller: Optional[str] = None) -> Tuple[str, str]:
@@ -80,19 +86,14 @@ def up(task: Any, service_name: Optional[str] = None,
         raise exceptions.NotSupportedError(
             f"serve controller must be 'process' or 'cluster', got "
             f'{controller!r}')
-    if controller == 'cluster':
-        # Replicas are relaunched by the controller VM after the client
-        # is gone; move client-local sources to buckets first
-        # (reference: sky/serve/core.py calls
-        # maybe_translate_local_file_mounts_and_sync_up the same way).
-        from skypilot_tpu.utils import controller_utils
-        controller_utils.maybe_translate_local_file_mounts_and_sync_up(
-            task, task_type='serve')
     service_name = service_name or task.name or 'service'
     task_yaml = os.path.join(_serve_dir(), f'{service_name}.task.yaml')
-    with open(task_yaml, 'w', encoding='utf-8') as f:
-        yaml.safe_dump(task.to_yaml_config(), f, sort_keys=False)
-
+    # Reserve the name BEFORE translation uploads anything and before
+    # the task yaml is (over)written: a duplicate name must not orphan
+    # freshly uploaded ephemeral buckets or clobber the live service's
+    # yaml. add_service's INSERT is the atomic claim; translation then
+    # runs against a name we own, and the yaml is written before the
+    # controller process starts reading it.
     controller_port, lb_port = _two_free_ports()
     if not serve_state.add_service(service_name, task.service, task_yaml,
                                    controller_port, lb_port,
@@ -100,6 +101,22 @@ def up(task: Any, service_name: Optional[str] = None,
         raise exceptions.NotSupportedError(
             f'Service {service_name!r} already exists. Use '
             f'`serve update` to change it or `serve down` first.')
+    try:
+        if controller == 'cluster':
+            # Replicas are relaunched by the controller VM after the
+            # client is gone; move client-local sources to buckets first
+            # (reference: sky/serve/core.py calls
+            # maybe_translate_local_file_mounts_and_sync_up the same way).
+            from skypilot_tpu.utils import controller_utils
+            controller_utils.maybe_translate_local_file_mounts_and_sync_up(
+                task, task_type='serve')
+        with open(task_yaml, 'w', encoding='utf-8') as f:
+            yaml.safe_dump(task.to_yaml_config(), f, sort_keys=False)
+    except Exception:
+        # Failed before anything started: release the claimed name so a
+        # corrected `serve up` can reuse it.
+        serve_state.remove_service(service_name)
+        raise
 
     if controller == 'cluster':
         _launch_controller_on_cluster(service_name)
@@ -201,6 +218,7 @@ def update(task: Any, service_name: str) -> int:
         json={'service': task.service.to_yaml_config(),
               'task_yaml': task_yaml,
               'version': version},
+        headers=_auth_headers(svc),
         timeout=10)
     resp.raise_for_status()
     logger.info('Service %s rolling to version %d.', service_name, version)
@@ -215,7 +233,8 @@ def down(service_name: str, purge: bool = False) -> None:
             f'Service {service_name!r} does not exist.')
     try:
         resp = requests.post(_controller_url(svc) + '/controller/terminate',
-                             json={}, timeout=300)
+                             json={}, headers=_auth_headers(svc),
+                             timeout=300)
         resp.raise_for_status()
     except requests.RequestException as e:
         if not purge:

@@ -34,9 +34,14 @@ class SkyServeLoadBalancer:
     """Reference: sky/serve/load_balancer.py:22."""
 
     def __init__(self, controller_url: str, port: int,
-                 policy: str = 'round_robin') -> None:
+                 policy: str = 'round_robin',
+                 controller_auth: Optional[str] = None) -> None:
         self.controller_url = controller_url
         self.port = port
+        # Bearer token for the controller's authenticated admin API.
+        self._controller_headers = (
+            {'Authorization': f'Bearer {controller_auth}'}
+            if controller_auth else {})
         self.policy: lb_policies.LoadBalancingPolicy = \
             lb_policies.POLICIES[policy]()
         self.request_timestamps: List[float] = []
@@ -54,6 +59,7 @@ class SkyServeLoadBalancer:
                         self.controller_url +
                         '/controller/load_balancer_sync',
                         json={'request_timestamps': ts},
+                        headers=self._controller_headers,
                         timeout=aiohttp.ClientTimeout(total=5)) as resp:
                     data = await resp.json()
                     self.policy.set_ready_replicas(

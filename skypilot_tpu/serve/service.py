@@ -28,17 +28,19 @@ async def _serve(service_name: str) -> None:
     spec = svc['spec']
     controller = controller_lib.SkyServeController(
         service_name, spec, svc['task_yaml'], svc['controller_port'])
+    auth_token = svc.get('auth_token')
     lb = lb_lib.SkyServeLoadBalancer(
         controller_url=f'http://127.0.0.1:{svc["controller_port"]}',
         port=svc['lb_port'],
         policy=getattr(spec, 'load_balancing_policy', None)
-        or 'round_robin')
+        or 'round_robin',
+        controller_auth=auth_token)
 
-    # Controller admin API (terminate/update_service) is unauthenticated
-    # by design (reference parity) — bind loopback only; every legit
-    # client (serve/core.py, the LB) connects via 127.0.0.1. Only the
+    # Controller admin API (terminate/update_service): loopback bind
+    # AND a per-service bearer token (minted at serve up) — reaching
+    # the port is not enough to terminate or roll the service. Only the
     # load balancer is the externally reachable endpoint.
-    controller_runner = web.AppRunner(controller.make_app())
+    controller_runner = web.AppRunner(controller.make_app(auth_token))
     await controller_runner.setup()
     await web.TCPSite(controller_runner, '127.0.0.1',
                       svc['controller_port']).start()
@@ -88,7 +90,10 @@ def _cleanup_ephemeral_storages(service_name: str,
             with open(path, encoding='utf-8') as f:
                 cfg = yaml.safe_load(f) or {}
             controller_utils.cleanup_ephemeral_storages(cfg)
-        except OSError as e:
+        except (OSError, yaml.YAMLError) as e:
+            # A corrupt/unreadable yaml must not wedge shutdown: the
+            # service row still has to be removed so `serve down`
+            # completes (the bucket leak is logged instead).
             logger.warning('storage cleanup skipped for %s: %s', path, e)
 
 

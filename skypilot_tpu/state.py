@@ -14,6 +14,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import sqlite_utils
+
 
 def state_dir() -> str:
     d = os.environ.get('SKYT_STATE_DIR',
@@ -49,8 +51,7 @@ def _get_db() -> sqlite3.Connection:
     with _DB_LOCK:
         if _DB is None:
             path = os.path.join(state_dir(), 'state.db')
-            _DB = sqlite3.connect(path, check_same_thread=False)
-            _DB.row_factory = sqlite3.Row
+            _DB = sqlite_utils.connect(path)
             _create_tables(_DB)
         return _DB
 

@@ -60,11 +60,23 @@ def validate_local_sources(task: Any) -> None:
     buckets already uploaded for tasks 1..N-1.
     """
     if task.workdir is not None:
+        wd = os.path.abspath(os.path.expanduser(task.workdir))
+        if not os.path.isdir(wd):
+            raise exceptions.InvalidTaskError(
+                f'workdir {task.workdir!r} is not a local directory')
         for dst in list(task.file_mounts) + list(task.storage_mounts):
             if _normalize_dst(dst) == WORKDIR_DST:
                 raise exceptions.InvalidTaskError(
                     f'Cannot translate workdir: {dst!r} is already a '
                     f'file/storage mount target.')
+    seen_dsts = set()
+    for dst in task.file_mounts:
+        norm = _normalize_dst(dst)
+        if norm in seen_dsts:
+            raise exceptions.InvalidTaskError(
+                f'file_mount targets collide after ~/ normalization: '
+                f'{dst!r} vs {norm!r}')
+        seen_dsts.add(norm)
     for dst, src in task.file_mounts.items():
         if data_utils.is_cloud_uri(src):
             continue
@@ -77,7 +89,8 @@ def validate_local_sources(task: Any) -> None:
 
 
 def maybe_translate_local_file_mounts_and_sync_up(
-        task: Any, task_type: str = 'jobs') -> None:
+        task: Any, task_type: str = 'jobs',
+        pre_validated: bool = False) -> None:
     """Upload local sources to buckets and rewrite `task` in place.
 
     After this call the task has no `workdir`, no local-path
@@ -89,8 +102,13 @@ def maybe_translate_local_file_mounts_and_sync_up(
     shutdown cleanup via `cleanup_ephemeral_storages`).
 
     No-op for tasks that never touch the client filesystem.
+
+    pre_validated: callers that already ran validate_local_sources over
+    every task in a DAG (jobs/core.py) skip the redundant re-validation
+    (each validation constructs Storage objects that stat local sources).
     """
-    validate_local_sources(task)
+    if not pre_validated:
+        validate_local_sources(task)
     run_id = uuid.uuid4().hex[:8]
     user = _clean_username()
     store_type = storage_lib.default_store_type()
@@ -109,21 +127,23 @@ def maybe_translate_local_file_mounts_and_sync_up(
 
     # 2+3. Local file_mounts: directories get a bucket each; single
     # files are hardlinked into one staging dir sharing one bucket.
-    file_srcs: Dict[str, str] = {}  # dst -> abs file path
+    file_srcs: Dict[str, str] = {}  # normalized dst -> abs file path
     for i, (dst, src) in enumerate(sorted(task.file_mounts.items())):
         if data_utils.is_cloud_uri(src):
             continue
         expanded = os.path.abspath(os.path.expanduser(src))
         del task.file_mounts[dst]
-        if os.path.isfile(expanded):
-            file_srcs[dst] = expanded
-            continue
-        bucket = _FM_DIR_BUCKET.format(user=user, run_id=run_id, i=i)
         norm = _normalize_dst(dst)
-        if norm in new_mounts:
+        # validate_local_sources raised on dst collisions; assert the
+        # invariant here too because the rewrite below is last-one-wins.
+        if norm in new_mounts or norm in file_srcs:
             raise exceptions.InvalidTaskError(
                 f'file_mount targets collide after ~/ normalization: '
                 f'{dst!r} vs {norm!r}')
+        if os.path.isfile(expanded):
+            file_srcs[norm] = expanded
+            continue
+        bucket = _FM_DIR_BUCKET.format(user=user, run_id=run_id, i=i)
         new_mounts[norm] = storage_lib.Storage(
             name=bucket, source=src,
             mode=storage_lib.StorageMode.COPY, persistent=False)
@@ -149,7 +169,7 @@ def maybe_translate_local_file_mounts_and_sync_up(
         # Rewrite each file mount to the staged object's URI; the
         # backend's runtime file-vs-prefix dispatch lands it AS dst.
         for dst, src in file_srcs.items():
-            task.file_mounts[_normalize_dst(dst)] = (
+            task.file_mounts[dst] = (
                 f'{store.uri}/file-{src_to_id[src]}')
         logger.info('%s: %d file mount(s) -> bucket %r', task_type,
                     len(file_srcs), bucket)
@@ -209,6 +229,37 @@ def cleanup_ephemeral_storages(task_config: Dict[str, Any]) -> None:
                 logger.info('deleted ephemeral storage %r', name)
         except exceptions.SkyTpuError as e:
             logger.warning('ephemeral storage %r not cleaned up: %s',
+                           name, e)
+    cleanup_translated_file_buckets(task_config.get('file_mounts') or {})
+
+
+def cleanup_translated_file_buckets(file_mounts: Dict[str, Any]) -> None:
+    """Delete the single-file staging bucket(s) a translated task points
+    at. Translation rewrites single-file mounts to plain URI strings
+    ('gs://skyt-fm-files-.../file-N'), so the dict-spec scan above never
+    sees them; recover the bucket name from the URI instead. Only
+    buckets matching the translation naming scheme AND registered in the
+    local state DB are touched — never an external bucket the user
+    mounted by URI themselves.
+    """
+    from skypilot_tpu import state
+    names = set()
+    for src in (file_mounts or {}).values():
+        if not isinstance(src, str) or not data_utils.is_cloud_uri(src):
+            continue
+        try:
+            _, bucket, _ = data_utils.split_uri(src)
+        except exceptions.StorageSourceError:
+            continue
+        if bucket.startswith('skyt-fm-files-'):
+            names.add(bucket)
+    for name in sorted(names):
+        try:
+            if state.get_storage(name) is not None:
+                storage_lib.Storage.delete_by_name(name)
+                logger.info('deleted ephemeral file bucket %r', name)
+        except exceptions.SkyTpuError as e:
+            logger.warning('ephemeral file bucket %r not cleaned up: %s',
                            name, e)
 
 

@@ -1,0 +1,33 @@
+"""One sqlite connection recipe for every state DB in the framework.
+
+Each state module (state.py, serve/serve_state.py, jobs/state.py,
+runtime/job_lib.py, benchmark/benchmark_state.py) keeps a per-process
+singleton connection serialized by an RLock; *across* processes the DBs
+are shared by design — a detached controller writes while the client CLI
+polls. Under the default rollback journal a polling reader's shared lock
+blocks the writer (a half-consumed SELECT cursor can pin it far past the
+busy timeout → "database is locked" on a healthy system). WAL gives
+single-writer/multi-reader without mutual blocking, which is exactly the
+access pattern here. Reference analog: sky/utils/db_utils.py (the
+reference keeps per-call connections; our long-lived singleton + WAL
+avoids its connection-churn instead).
+"""
+import sqlite3
+
+_BUSY_TIMEOUT_MS = 10_000
+
+
+def connect(path: str) -> sqlite3.Connection:
+    """WAL-mode connection with Row factory and a 10s writer-writer
+    busy timeout. Safe to call on an existing DB (journal_mode persists
+    in the file; re-running the pragma is a no-op)."""
+    conn = sqlite3.connect(path, check_same_thread=False,
+                           timeout=_BUSY_TIMEOUT_MS / 1000)
+    conn.row_factory = sqlite3.Row
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute(f'PRAGMA busy_timeout={_BUSY_TIMEOUT_MS}')
+    # WAL + NORMAL loses at most the last transactions on OS crash,
+    # never consistency; state rows are reconstructable (status refresh,
+    # job reconciliation), so the fsync-per-commit cost isn't worth it.
+    conn.execute('PRAGMA synchronous=NORMAL')
+    return conn

@@ -208,6 +208,55 @@ def test_s3_download_command_dispatches_on_head_object():
     assert '2>/dev/null) ||' not in cmd
 
 
+def test_cleanup_removes_translated_file_bucket(translate_env, tmp_path):
+    """Single-file mounts are rewritten to plain URI strings, not
+    dict specs — cleanup must still find and delete their shared
+    staging bucket (and leave user-supplied URI mounts alone)."""
+    cfg = tmp_path / 'c.yaml'
+    cfg.write_text('x: 1\n')
+    task = sky.Task(name='t', run='cat c.yaml',
+                    file_mounts={'~/c.yaml': str(cfg)})
+    _translate(task)
+    uri = task.file_mounts['c.yaml']
+    _, bucket, _ = data_utils.split_uri(uri)
+    assert bucket.startswith('skyt-fm-files-')
+    assert state.get_storage(bucket) is not None
+    controller_utils.cleanup_ephemeral_storages(task.to_yaml_config())
+    assert state.get_storage(bucket) is None
+    assert not os.path.isdir(
+        os.path.join(data_utils.local_store_root(), bucket))
+
+
+def test_validate_rejects_missing_workdir(translate_env, tmp_path):
+    """A workdir that vanished after Task construction (deleted dir,
+    task deserialized from stale state) must fail validation before any
+    upload, not blow up mid-translation after earlier tasks' buckets
+    are uploaded. (Task.__init__ validates at construction; this guards
+    the mutation/staleness window.)"""
+    wd = tmp_path / 'wd'
+    wd.mkdir()
+    task = sky.Task(name='t', run='ls', workdir=str(wd))
+    wd.rmdir()
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError, match='workdir'):
+        controller_utils.validate_local_sources(task)
+
+
+def test_validate_rejects_file_dst_collision(translate_env, tmp_path):
+    """`~/cfg.yaml` and `cfg.yaml` collide after normalization: silent
+    last-one-wins would drop one of the two files from the replica."""
+    a = tmp_path / 'a.yaml'
+    a.write_text('a\n')
+    b = tmp_path / 'b.yaml'
+    b.write_text('b\n')
+    task = sky.Task(name='t', run='ls',
+                    file_mounts={'~/cfg.yaml': str(a),
+                                 'cfg.yaml': str(b)})
+    from skypilot_tpu import exceptions
+    with pytest.raises(exceptions.InvalidTaskError, match='collide'):
+        _translate(task)
+
+
 def test_cleanup_ephemeral_storages(translate_env, tmp_path):
     """The serve-side teardown helper removes only non-persistent,
     state-registered buckets."""
