@@ -65,6 +65,43 @@ class SamplingParams:
     # vLLM convention) — instead of bare ints.
     logprobs: bool = False
 
+    def validate(self) -> None:
+        """Reject parameters the engine cannot honor exactly, instead
+        of silently reshaping the requested distribution.
+
+        The device sampling path computes top-k and the top-p nucleus
+        from ONE shared top-64 sort (_TOPK_BUCKET): top_k > 64 would be
+        silently clamped, so it is rejected here. The nucleus is
+        likewise bounded to the top-64 candidates — that bound cannot
+        be checked request-time (it depends on the model's step
+        distribution), so it stays a documented approximation: with
+        top_p ~1 at high temperature the tail past the 64th candidate
+        is excluded. Exact-k sampling for k > 64 would need a second,
+        wider sort compiled into every decode step; not worth it for a
+        parameter OpenAI clients essentially never use.
+        """
+        if not isinstance(self.top_k, int) or isinstance(self.top_k,
+                                                         bool):
+            raise ValueError(f'top_k must be an int, got '
+                             f'{self.top_k!r}')
+        if self.top_k < 0:
+            raise ValueError(f'top_k must be >= 0, got {self.top_k}')
+        if self.top_k > _TOPK_BUCKET:
+            raise ValueError(
+                f'top_k={self.top_k} exceeds the device sampling '
+                f'bucket ({_TOPK_BUCKET}); ask for top_k <= '
+                f'{_TOPK_BUCKET} (larger values cannot be honored '
+                f'exactly)')
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError(f'top_p must be in [0, 1], got '
+                             f'{self.top_p}')
+        if self.temperature < 0.0:
+            raise ValueError(f'temperature must be >= 0, got '
+                             f'{self.temperature}')
+        if self.max_new_tokens < 1:
+            raise ValueError(f'max_new_tokens must be >= 1, got '
+                             f'{self.max_new_tokens}')
+
 
 @dataclasses.dataclass
 class _Request:
@@ -780,6 +817,7 @@ class InferenceEngine:
         """Enqueue a request; returns (req_id, token queue). The queue
         yields generated token ids, then None when finished."""
         params = params or SamplingParams()
+        params.validate()
         if len(tokens) >= self.max_seq_len:
             raise ValueError(f'prompt length {len(tokens)} >= max_seq_len '
                              f'{self.max_seq_len}')

@@ -149,6 +149,9 @@ class InferenceServer:
             frequency_penalty=float(payload.get('frequency_penalty',
                                                 0.0)),
             eos_token=eos)
+        err = self._params_error(params)
+        if err is not None:
+            return web.json_response({'error': err}, status=400)
         req_id, out_q = self.engine.submit(tokens, params)
         loop = asyncio.get_running_loop()
 
@@ -199,6 +202,19 @@ class InferenceServer:
             # logprobs are computed regardless of N (documented).
             logprobs=(payload.get('logprobs') is not None and
                       payload.get('logprobs') is not False))
+
+    @staticmethod
+    def _params_error(params) -> Optional[str]:
+        """Error message for sampling params the engine would reject
+        (top_k > 64, out-of-range top_p/temperature) — handlers return
+        it as a 400 BEFORE submitting, so invalid work never occupies
+        an engine slot and OpenAI clients get the standard
+        invalid-parameter behavior instead of a 500."""
+        try:
+            params.validate()
+            return None
+        except ValueError as e:
+            return str(e)
 
     @staticmethod
     def _parse_n(payload) -> Optional[int]:
@@ -470,6 +486,9 @@ class InferenceServer:
                 {'error': 'stream supports a single prompt with n=1'},
                 status=400)
         params = self._sampling_from_openai(payload)
+        err = self._params_error(params)
+        if err is not None:
+            return web.json_response({'error': err}, status=400)
         stops = self._stops_from_openai(payload)
         if stops is None:
             return web.json_response(
@@ -549,6 +568,9 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'stream supports n=1'}, status=400)
         params = self._sampling_from_openai(payload)
+        err = self._params_error(params)
+        if err is not None:
+            return web.json_response({'error': err}, status=400)
         if params.logprobs:
             # Chat logprobs use a different response schema (content
             # arrays); reject loudly rather than degrade silently.

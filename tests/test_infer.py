@@ -318,6 +318,32 @@ def test_openai_compat_endpoints(small_model):
         assert requests.get(base + '/stats',
                             timeout=5).json()['waiting'] == 0
 
+        # Sampling bounds the device path cannot honor exactly are
+        # 400s, not silent clamps: top_k caps at the 64-wide device
+        # sort bucket, top_p must be a probability.
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': 'hi', 'max_tokens': 2,
+                                'temperature': 1.0, 'top_k': 200},
+                          timeout=10)
+        assert r.status_code == 400 and '64' in r.json()['error']
+        assert requests.post(base + '/v1/completions',
+                             json={'prompt': 'hi', 'top_p': 1.5},
+                             timeout=10).status_code == 400
+        assert requests.post(base + '/v1/chat/completions',
+                             json={'messages': [{'role': 'user',
+                                                 'content': 'x'}],
+                                   'top_k': 65},
+                             timeout=10).status_code == 400
+        # ... and rejected requests never occupied a slot.
+        assert requests.get(base + '/stats',
+                            timeout=5).json()['waiting'] == 0
+        # top_k at exactly the bucket bound is accepted.
+        r = requests.post(base + '/v1/completions',
+                          json={'prompt': 'hi', 'max_tokens': 2,
+                                'temperature': 1.0, 'top_k': 64},
+                          timeout=120)
+        assert r.status_code == 200
+
         r = requests.post(
             base + '/v1/chat/completions',
             json={'messages': [{'role': 'user', 'content': 'hello'}],

@@ -1,0 +1,45 @@
+"""SamplingParams.validate: the engine's honest sampling bounds.
+
+The device sampling path computes top-k and the top-p nucleus from one
+shared top-64 sort (engine._TOPK_BUCKET); values it cannot honor
+exactly must be rejected at submit time, never silently clamped
+(silent clamping gives an OpenAI client asking top_k=200 a different
+distribution with no signal). Fast tier: pure parameter logic, no
+model.
+"""
+import pytest
+
+from skypilot_tpu.infer import engine as engine_lib
+
+
+def test_defaults_valid():
+    engine_lib.SamplingParams().validate()
+
+
+def test_top_k_at_bucket_accepted():
+    engine_lib.SamplingParams(top_k=engine_lib._TOPK_BUCKET,
+                              temperature=1.0).validate()
+
+
+@pytest.mark.parametrize('kw,match', [
+    (dict(top_k=engine_lib._TOPK_BUCKET + 1), '64'),
+    (dict(top_k=-1), 'top_k'),
+    (dict(top_k=2.5), 'int'),
+    (dict(top_k=True), 'int'),
+    (dict(top_p=1.5), 'top_p'),
+    (dict(top_p=-0.1), 'top_p'),
+    (dict(temperature=-1.0), 'temperature'),
+    (dict(max_new_tokens=0), 'max_new_tokens'),
+])
+def test_invalid_params_rejected(kw, match):
+    with pytest.raises(ValueError, match=match):
+        engine_lib.SamplingParams(**kw).validate()
+
+
+def test_submit_rejects_before_enqueue():
+    """Engine.submit is the library-level backstop: a bad request must
+    raise, not enter the waiting queue."""
+    eng = engine_lib.InferenceEngine.__new__(engine_lib.InferenceEngine)
+    eng.max_seq_len = 64  # submit() checks params before anything else
+    with pytest.raises(ValueError, match='64'):
+        eng.submit([1, 2, 3], engine_lib.SamplingParams(top_k=200))
