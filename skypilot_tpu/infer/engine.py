@@ -273,13 +273,22 @@ class InferenceEngine:
                  pool_tokens: Optional[int] = None,
                  prefix_caching: bool = True,
                  spec_decode: int = 0,
-                 prefill_chunk: int = 0) -> None:
+                 prefill_chunk: int = 0,
+                 lockstep=None) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
         cache is sharded over the tp axis on kv_heads. This is how a
         model larger than one chip's HBM serves (the reference's
-        --tensor-parallel-size, llm/vllm/serve.yaml)."""
+        --tensor-parallel-size, llm/vllm/serve.yaml).
+
+        lockstep: optional infer.multihost.LockstepSync — the engine
+        then runs as one host of a multi-host replica: the mesh spans
+        every host's devices, and each loop tick starts with a control
+        broadcast from the primary host (new requests, cancels, stop)
+        so all hosts issue identical device computations. Only the
+        primary accepts submit()/cancel(); followers mirror. See
+        infer/multihost.py for the protocol."""
         self.model = model
         self.cfg = model.cfg
         self.params = params
@@ -403,6 +412,14 @@ class InferenceEngine:
                       jnp.int32)
             if self.spec_decode > 0 else None)
         self._waiting: 'queue.Queue[_Request]' = queue.Queue()
+        # Multi-host lockstep (see __init__ docstring). On the primary,
+        # submit() lands requests in _ingress and the per-tick sync
+        # moves them into _waiting AFTER broadcasting them, so follower
+        # hosts admit the identical sequence; cancels likewise take
+        # effect only at tick boundaries, identically everywhere.
+        self._lockstep = lockstep
+        self._ingress: 'queue.Queue[_Request]' = queue.Queue()
+        self._pending_cancels: List[int] = []
         # Request currently mid-admission (popped but not yet in
         # _slots) — scanned by cancel().
         self._admitting: Optional[_Request] = None
@@ -459,6 +476,24 @@ class InferenceEngine:
                                          donate_argnums=(0,))
         self._jit_clear_slot = jax.jit(self._clear_slot_impl,
                                        donate_argnums=(0,))
+
+    def _pull(self, x) -> np.ndarray:
+        """Device→host fetch for control decisions (tokens, logits,
+        counts). Single-host: plain np.asarray. Multi-host: a
+        global-mesh jit output may not be fully replicated (GSPMD
+        chooses its sharding), and np.asarray on a partially
+        addressable array raises — allgather the global value so every
+        host reads identical bytes and makes identical termination /
+        sampling decisions."""
+        if self._lockstep is not None and isinstance(x, jax.Array) and \
+                not (x.is_fully_addressable or x.is_fully_replicated):
+            from jax.experimental import multihost_utils
+            # Non-addressable global array: process_allgather (which
+            # requires tiled=True for this input class) returns the
+            # fully-replicated global value on every host.
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
 
     def _ctx(self):
         """Ambient mesh + flax logical axis rules for every device call
@@ -831,14 +866,51 @@ class InferenceEngine:
         req = _Request(req_id=req_id, tokens=list(tokens), params=params,
                        out_queue=queue.Queue(),
                        rng=np.random.default_rng(params.seed + req_id))
-        self._waiting.put(req)
+        if self._lockstep is not None:
+            if not self._lockstep.is_primary:
+                raise RuntimeError(
+                    'submit() on a follower host: multi-host requests '
+                    'enter through the primary (process 0)')
+            # Tick sync broadcasts the request, THEN admits it locally,
+            # so followers always see the identical admission stream.
+            self._ingress.put(req)
+        else:
+            self._waiting.put(req)
         return req_id, req.out_queue
 
     def cancel(self, req_id: int) -> bool:
         """Cancel a submitted request (any thread). A running slot is
         released at the next delivery boundary (its queue then yields
         None); a waiting request is dropped at admission. Returns True
-        if a live request with req_id was found."""
+        if a live request with req_id was found.
+
+        Multi-host: the flag must flip on every host at the SAME tick
+        (slot release changes the next tick's batch), so the cancel is
+        queued here and applied by the tick sync on all hosts."""
+        if self._lockstep is not None:
+            if not self._lockstep.is_primary:
+                raise RuntimeError('cancel() on a follower host')
+            found = self._find_live(req_id) or any(
+                r.req_id == req_id for r in self._drain_peek())
+            with self._lock:
+                self._pending_cancels.append(req_id)
+            return found
+        return self._apply_cancel(req_id)
+
+    def _find_live(self, req_id: int) -> bool:
+        if any(r is not None and r.req_id == req_id
+               for r in self._slots):
+            return True
+        return any(d is not None and d.req_id == req_id
+                   for d in (self._deferred, self._admitting))
+
+    def _drain_peek(self) -> List['_Request']:
+        with self._ingress.mutex:
+            pending = list(self._ingress.queue)
+        with self._waiting.mutex:
+            return pending + list(self._waiting.queue)
+
+    def _apply_cancel(self, req_id: int) -> bool:
         found = False
         for req in list(self._slots):
             if req is not None and req.req_id == req_id:
@@ -876,15 +948,39 @@ class InferenceEngine:
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=10)
+            # Lockstep: the loop exits at the next tick broadcast (the
+            # stop flag must reach followers), which can be mid-compile
+            # on first use — allow for that.
+            timeout = 60 if self._lockstep is not None else 10
+            self._thread.join(timeout=timeout)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Block until the engine loop exits. Follower hosts of a
+        multi-host replica have no HTTP server or client; their main
+        thread parks here until the primary's stop broadcast."""
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
 
     def warmup(self, buckets: Optional[List[int]] = None) -> None:
         """Pre-compile prefill (per bucket), cache insert, and the greedy
         decode chunk by running real dummy requests through the engine —
         so the first user request after /health goes green pays no
         compile (TTFT SLO). Call before or after start(); runs the loop
-        inline when the engine thread isn't up yet."""
+        inline when the engine thread isn't up yet.
+
+        Multi-host followers no-op: the primary's warmup requests reach
+        them through the tick broadcast, and the resulting (identical)
+        device calls compile there too. The primary must be start()ed
+        first — the not-started path's inline-loop cleanup would stop()
+        the engine, and in lockstep that broadcast permanently releases
+        every follower (they exit; a later start() would hang its first
+        collective waiting for processes that are gone)."""
+        if self._lockstep is not None and not self._lockstep.is_primary:
+            return
         started = self._thread is not None and self._thread.is_alive()
+        if self._lockstep is not None and not started:
+            raise RuntimeError('multi-host warmup requires start() '
+                               'first (see docstring)')
         if not started:
             self.start()
         try:
@@ -1099,13 +1195,17 @@ class InferenceEngine:
                 greedy, logits, prefill_cache = self._jit_prefill(
                     self.params, jnp.asarray(padded), jnp.asarray([n]),
                     bucket=bucket)
+            # Pull the logits row at most ONCE: in multi-host mode
+            # _pull is a cross-host collective, not a cached host copy.
+            logits_row = self._pull(logits)[0] \
+                if temp > 0.0 or req.params.logprobs else None
             if temp > 0.0:
-                first = self._sample(np.asarray(logits)[0], req)
+                first = self._sample(logits_row, req)
             else:
-                first = int(np.asarray(greedy)[0])   # 4-byte pull
+                first = int(self._pull(greedy)[0])   # 4-byte pull
             # logprobs: the row pull is the documented TTFT cost of
             # asking for them on a greedy request.
-            first_lp = _np_raw_lp(np.asarray(logits)[0], first) \
+            first_lp = _np_raw_lp(logits_row, first) \
                 if req.params.logprobs else None
             self._ensure_dev_args()
             ins_args = (jnp.int32(slot), self._dev_args,
@@ -1250,11 +1350,14 @@ class InferenceEngine:
                 st['start'] = start + piece
                 return
             temp = max(0.0, req.params.temperature)
+            # One logits pull (multi-host: each pull is a collective).
+            logits_row = self._pull(logits)[0] \
+                if temp > 0.0 or req.params.logprobs else None
             if temp > 0.0:
-                first = self._sample(np.asarray(logits)[0], req)
+                first = self._sample(logits_row, req)
             else:
-                first = int(np.asarray(greedy)[0])
-            first_lp = _np_raw_lp(np.asarray(logits)[0], first) \
+                first = int(self._pull(greedy)[0])
+            first_lp = _np_raw_lp(logits_row, first) \
                 if req.params.logprobs else None
             key = jax.random.PRNGKey(req.params.seed + req.req_id)
             self._ensure_dev_args()
@@ -1318,6 +1421,16 @@ class InferenceEngine:
             self._loop_body()
         except Exception:  # pylint: disable=broad-except
             logger.exception('engine loop crashed; failing open requests')
+            if self._lockstep is not None and self._lockstep.is_primary:
+                # Best-effort release of follower hosts parked on the
+                # next control broadcast. (A crashed FOLLOWER is the
+                # distributed runtime's problem: its missed collective
+                # trips the coordinator's failure detection.)
+                try:
+                    self._lockstep.broadcast(
+                        {'new': [], 'cancel': [], 'stop': True})
+                except Exception:  # pylint: disable=broad-except
+                    pass
             for i, req in enumerate(self._slots):
                 if req is not None:
                     self._release(i)
@@ -1340,7 +1453,16 @@ class InferenceEngine:
         # device-limited. Cost: slot release (and therefore admission
         # under load) lags by one chunk.
         pending = None  # (kind, toks_dev, counts_dev, entries, chunk)
-        while not self._stop.is_set():
+        while True:
+            if self._lockstep is not None:
+                # Control broadcast: every host gets the same requests,
+                # cancels, and stop decision for this tick. The stop
+                # flag rides the broadcast so followers exit the SAME
+                # tick as the primary (never mid-computation).
+                if self._sync_tick():
+                    break
+            elif self._stop.is_set():
+                break
             # Admit as many waiting requests as there are free slots.
             # Device-side arg/cache updates order after any in-flight
             # chunk via the dispatch chain.
@@ -1440,17 +1562,55 @@ class InferenceEngine:
         if pending is not None:
             self._finish_chunk(pending)
 
+    def _sync_tick(self) -> bool:
+        """One lockstep control exchange (multi-host only). Returns
+        True when this tick is the stop tick. See infer/multihost.py
+        for the protocol rationale."""
+        if self._lockstep.is_primary:
+            new: List[_Request] = []
+            while True:
+                try:
+                    new.append(self._ingress.get_nowait())
+                except queue.Empty:
+                    break
+            with self._lock:
+                cancels = self._pending_cancels
+                self._pending_cancels = []
+            stop = self._stop.is_set()
+            blob = None
+            if new or cancels or stop:
+                blob = {'new': [(r.req_id, r.tokens, r.params)
+                                for r in new],
+                        'cancel': cancels, 'stop': stop}
+            self._lockstep.broadcast(blob)
+            for r in new:
+                self._waiting.put(r)
+        else:
+            blob = self._lockstep.broadcast(None)
+            if blob is not None:
+                from skypilot_tpu.infer import multihost
+                for rid, toks, params in blob['new']:
+                    self._waiting.put(_Request(
+                        req_id=rid, tokens=list(toks), params=params,
+                        out_queue=multihost.DiscardQueue(),
+                        rng=np.random.default_rng(params.seed + rid)))
+        if blob is None:
+            return False
+        for rid in blob['cancel']:
+            self._apply_cancel(rid)
+        return bool(blob['stop'])
+
     def _finish_chunk(self, pending) -> None:
         """Pull a dispatched chunk's tokens and deliver them; release
         completed slots and advance the confirmed lengths. The sync
         point of the pipeline."""
         kind, toks_dev, lps_dev, counts_dev, entries, chunk = pending
-        toks_np = np.asarray(toks_dev)        # sync point
-        counts_np = np.asarray(counts_dev) if counts_dev is not None \
+        toks_np = self._pull(toks_dev)        # sync point
+        counts_np = self._pull(counts_dev) if counts_dev is not None \
             else None
         # Logprobs pulled only when some request in this chunk wants
         # them (an extra [chunk, SLOTS(, k+1)] f32 transfer otherwise).
-        lps_np = np.asarray(lps_dev) if any(
+        lps_np = self._pull(lps_dev) if any(
             req.params.logprobs for _, req in entries) else None
         now = time.perf_counter()
         delivered = 0

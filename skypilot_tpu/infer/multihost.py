@@ -1,0 +1,163 @@
+"""Multi-host serving: one engine per host in SPMD lockstep.
+
+A serving replica can be a whole multi-host TPU slice (the reference
+serves TP across a full replica cluster: llm/vllm/serve.yaml
+`--tensor-parallel-size $SKYPILOT_NUM_GPUS_PER_NODE`, replica = cluster
+in sky/serve/replica_managers.py:57). On TPU the natural analog is the
+training gang contract (runtime/gang.py): every host process joins one
+`jax.distributed` runtime, the model + KV cache shard over a global
+mesh, and — because multi-host XLA is SPMD — every process must issue
+the SAME device computations in the same order.
+
+Design: host 0 (the *primary*) owns HTTP, admission and sampling
+decisions exactly as in the single-host engine; follower hosts run the
+same engine loop but take their control inputs (new requests, cancels,
+stop) from a per-tick broadcast instead of a local queue. Everything
+else the loop decides — admission order, chunk sizes, termination — is
+a deterministic function of those inputs plus device results that are
+themselves identical on every host (one global computation), so the
+hosts stay in lockstep without any further coordination. The broadcast
+rides the same ICI/DCN fabric as the compute
+(jax.experimental.multihost_utils.broadcast_one_to_all), no side RPC
+channel.
+
+An idle tick broadcasts 8 bytes (the empty-control fast path); a tick
+with traffic broadcasts length + pickled control blob.
+"""
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+class LockstepSync:
+    """Per-tick control-plane broadcast from the primary host.
+
+    All hosts must call broadcast() the same number of times in the
+    same order (the engine loop guarantees one call per tick).
+    """
+
+    def __init__(self) -> None:
+        import jax
+        self.process_index = jax.process_index()
+        self.num_processes = jax.process_count()
+        self.is_primary = self.process_index == 0
+
+    def broadcast(self, obj: Optional[Any]) -> Any:
+        """Primary: broadcast `obj` to every host; followers pass None
+        and receive the primary's object. None/empty objects take the
+        8-byte fast path (no payload round)."""
+        from jax.experimental import multihost_utils
+        if self.is_primary:
+            payload = (np.frombuffer(pickle.dumps(obj), np.uint8)
+                       if obj is not None else
+                       np.zeros((0,), np.uint8))
+            n = np.array([payload.size], np.int64)
+        else:
+            payload = None
+            n = np.zeros((1,), np.int64)
+        n = multihost_utils.broadcast_one_to_all(n)
+        size = int(n[0])
+        if size == 0:
+            return None
+        buf = payload if self.is_primary else np.zeros((size,), np.uint8)
+        buf = multihost_utils.broadcast_one_to_all(buf)
+        return pickle.loads(np.asarray(buf).tobytes())
+
+
+class DiscardQueue:
+    """out_queue stand-in on follower hosts: tokens are delivered by
+    the primary; followers only need the queue protocol to exist."""
+
+    def put(self, item: Any) -> None:
+        del item
+
+    def get(self, *args: Any, **kwargs: Any) -> None:
+        raise RuntimeError('follower-host queues carry no tokens; '
+                           'consume results on the primary host')
+
+
+def initialize_from_env(coordinator: Optional[str] = None,
+                        num_processes: Optional[int] = None,
+                        process_id: Optional[int] = None) -> LockstepSync:
+    """Join the jax.distributed runtime and return the sync handle.
+
+    With no args this honors the gang env contract
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID,
+    runtime/gang.py:70) — the same bootstrap a training job uses, so a
+    serve replica spanning a multi-host slice needs no extra config.
+    """
+    import jax
+    if coordinator is not None:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    else:
+        from skypilot_tpu.runtime import gang
+        gang.initialize_jax_distributed()
+    logger.info('multihost serving: process %d/%d, %d global devices',
+                jax.process_index(), jax.process_count(),
+                jax.device_count())
+    return LockstepSync()
+
+
+# --------------------------------------------------------------- selftest
+# Reused by tests/test_multihost_engine.py AND __graft_entry__.py's
+# serving dryrun: N real processes on the CPU backend prove the
+# lockstep protocol end to end without TPU hosts.
+
+def _selftest_worker(coord_port: int, nprocs: int, rank: int,
+                     out_path: str) -> None:
+    import json
+
+    import jax
+
+    sync = initialize_from_env(coordinator=f'127.0.0.1:{coord_port}',
+                               num_processes=nprocs, process_id=rank)
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    eng = server_lib.build_engine(
+        'debug', num_slots=2, max_seq_len=64, tp=jax.device_count(),
+        cache_mode='paged', lockstep=sync)
+    eng.start()
+    if sync.is_primary:
+        greedy = eng.generate(
+            [5, 17, 3, 99, 42],
+            engine_lib.SamplingParams(max_new_tokens=6))
+        sampled = eng.generate(
+            [9, 9, 9],
+            engine_lib.SamplingParams(max_new_tokens=5, temperature=0.7,
+                                      top_k=8, seed=3))
+        with open(out_path, 'w', encoding='utf-8') as f:
+            json.dump({'greedy': greedy, 'sampled': sampled}, f)
+        eng.stop()
+    else:
+        eng.join()
+
+
+def main(argv=None) -> None:
+    import argparse
+    import os
+
+    # This image's TPU platform plugin wins over the env var; honor an
+    # explicit JAX_PLATFORMS (same dance as infer/server.py main).
+    if os.environ.get('JAX_PLATFORMS'):
+        import jax
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--selftest-port', type=int, required=True)
+    parser.add_argument('--selftest-nprocs', type=int, required=True)
+    parser.add_argument('--selftest-rank', type=int, required=True)
+    parser.add_argument('--selftest-out', required=True)
+    args = parser.parse_args(argv)
+    _selftest_worker(args.selftest_port, args.selftest_nprocs,
+                     args.selftest_rank, args.selftest_out)
+
+
+if __name__ == '__main__':
+    main()

@@ -651,13 +651,19 @@ def build_engine(model_name: Optional[str] = None,
                  prefix_caching: bool = True,
                  spec_decode: int = 0,
                  quantize: str = 'none',
-                 prefill_chunk: int = 0
+                 prefill_chunk: int = 0,
+                 lockstep=None
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
     checkpoint: HF-format dir (config.json + *.safetensors) — real
     weights, tp-sharded over the first `tp` local devices. Without a
     checkpoint, a randomly initialized `model_name` config (debug use).
+
+    lockstep: infer.multihost.LockstepSync for a replica spanning
+    multiple hosts — tp then counts GLOBAL devices (the mesh builder
+    uses jax.devices(), which is already global after
+    jax.distributed.initialize()).
 
     cache_mode: 'auto' (= paged; MoE shares the llama attention layer so
     paged decode covers both families), 'paged', or 'dense'.
@@ -766,7 +772,8 @@ def build_engine(model_name: Optional[str] = None,
                                       pool_tokens=pool_tokens,
                                       prefix_caching=prefix_caching,
                                       spec_decode=spec_decode,
-                                      prefill_chunk=prefill_chunk)
+                                      prefill_chunk=prefill_chunk,
+                                      lockstep=lockstep)
 
 
 def main(argv=None) -> None:
@@ -811,7 +818,25 @@ def main(argv=None) -> None:
                         help='chunked prefill: long prompts prefill in '
                              'chunks of this many tokens, interleaved '
                              'with decode (0 = off)')
+    parser.add_argument('--multihost', default='auto',
+                        choices=['auto', 'on', 'off'],
+                        help='multi-host replica over jax.distributed '
+                             '(gang env contract). auto: on when the '
+                             'gang reports >1 node (SKYT_NUM_NODES). '
+                             'Host 0 serves HTTP; other hosts run the '
+                             'engine in lockstep.')
     args = parser.parse_args(argv)
+
+    lockstep = None
+    if args.multihost == 'on' or (
+            args.multihost == 'auto' and
+            int(os.environ.get('SKYT_NUM_NODES', '1')) > 1):
+        # Same bootstrap as a training gang (runtime/gang.py env
+        # triplet): the replica's hosts form one jax.distributed
+        # runtime; jax.devices() is global from here on, so --tp counts
+        # devices across the whole slice.
+        from skypilot_tpu.infer import multihost as multihost_lib
+        lockstep = multihost_lib.initialize_from_env()
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
                           checkpoint=args.checkpoint, tp=args.tp,
@@ -819,7 +844,17 @@ def main(argv=None) -> None:
                           prefix_caching=not args.no_prefix_caching,
                           spec_decode=args.spec_decode,
                           quantize=args.quantize,
-                          prefill_chunk=args.prefill_chunk)
+                          prefill_chunk=args.prefill_chunk,
+                          lockstep=lockstep)
+    if lockstep is not None and not lockstep.is_primary:
+        # Follower host: no HTTP, no local requests — run the engine
+        # loop (driven by the primary's tick broadcasts) until the
+        # primary's stop.
+        engine.start()
+        logger.info('multihost follower %d: engine loop running',
+                    lockstep.process_index)
+        engine.join()
+        return
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
     if tok_path:

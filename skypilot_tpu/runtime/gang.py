@@ -4,11 +4,14 @@ Replaces the reference's RayCodeGen env export (SKYPILOT_NODE_IPS/
 NUM_NODES/NODE_RANK/NUM_GPUS_PER_NODE, sky/backends/cloud_vm_ray_backend.py
 :569-630 and sky/skylet/constants.py:263-266) with a TPU-first contract:
 the JAX coordinator triplet (JAX_COORDINATOR_ADDRESS/NUM_PROCESSES/
-PROCESS_ID — honored by jax.distributed.initialize()) is exported directly,
-so `jax.distributed.initialize()` with no args works on any cluster this
-framework launches, CPU or TPU. SKYPILOT_* aliases are kept so reference
-recipes run unmodified.
+PROCESS_ID) is exported on every rank, and `initialize_jax_distributed()`
+below turns it into a jax.distributed runtime on any cluster this
+framework launches, CPU or TPU. (jax's own argless initialize only
+auto-detects Slurm/OpenMPI/TPU-metadata environments — it does NOT read
+a generic env triplet, so gang jobs go through the helper.) SKYPILOT_*
+aliases are kept so reference recipes run unmodified.
 """
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -67,9 +70,9 @@ def job_env_vars(
     if export_jax_coordinator is None:
         export_jax_coordinator = num_nodes > 1
     if export_jax_coordinator:
-        # jax.distributed.initialize() reads these when called with no args
-        # (jax/_src/clusters cluster detection). On single-host jobs they
-        # are omitted so plain single-process JAX works untouched.
+        # Consumed by initialize_jax_distributed() below. On single-host
+        # jobs they are omitted so plain single-process JAX works
+        # untouched.
         env.update({
             'JAX_COORDINATOR_ADDRESS': coord,
             'JAX_NUM_PROCESSES': str(num_nodes),
@@ -86,6 +89,29 @@ def job_env_vars(
             num_slices=num_slices,
             coordinator_ip=head_ip))
     return env
+
+
+def initialize_jax_distributed() -> None:
+    """Join the jax.distributed runtime from the gang env contract.
+
+    Prefers the explicit JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID triplet this framework exports on every multi-node
+    gang rank (works on the local provider, CPU clusters, and TPU VMs
+    alike); falls back to jax's own auto-detection (TPU metadata,
+    Slurm, OpenMPI) when the triplet is absent. No-op on single-node
+    jobs (the triplet is only exported for num_nodes > 1 and there is
+    nothing to join).
+    """
+    import jax
+    coord = os.environ.get('JAX_COORDINATOR_ADDRESS')
+    n = os.environ.get('JAX_NUM_PROCESSES')
+    pid = os.environ.get('JAX_PROCESS_ID')
+    if coord and n is not None and pid is not None:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(n),
+                                   process_id=int(pid))
+    elif int(os.environ.get('SKYT_NUM_NODES', '1')) > 1:
+        jax.distributed.initialize()   # TPU-metadata/Slurm detection
 
 
 DEFAULT_MEGASCALE_PORT = 8080
