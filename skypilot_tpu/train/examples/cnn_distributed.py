@@ -1,0 +1,151 @@
+"""Multi-node data-parallel CNN training — the non-LLM DP workload.
+
+Reference analog: examples/resnet_distributed_torch.yaml (2 nodes x 1
+GPU, torch DDP over NCCL, CIFAR-10 from a download). Rebuilt
+TPU-native: the nodes join one jax.distributed runtime via the gang env
+contract (runtime/gang.py exports the coordinator triplet, so
+`jax.distributed.initialize()` needs no args), the batch shards over a
+`dp` mesh axis spanning every node's devices, and XLA inserts the
+gradient all-reduce — no DDP wrapper, no NCCL plumbing. Data is
+synthetic but LEARNABLE (labels are a fixed linear function of the
+image), so falling loss/rising accuracy proves the whole multi-node
+path end to end in a zero-egress environment.
+
+Run on every node (the gang does this for `num_nodes: 2` tasks):
+    python -m skypilot_tpu.train.examples.cnn_distributed --steps 60
+"""
+import argparse
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ResBlock(nn.Module):
+    """Norm-free residual block (small nets train fine without BN, and
+    skipping cross-replica batch stats keeps the DP story pure)."""
+    features: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(self.features, (3, 3))(x)
+        h = nn.relu(h)
+        h = nn.Conv(self.features, (3, 3))(h)
+        if x.shape[-1] != self.features:
+            x = nn.Conv(self.features, (1, 1))(x)
+        return nn.relu(x + h)
+
+
+class SmallResNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = ResBlock(32)(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = ResBlock(64)(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = ResBlock(64)(x)
+        # Flatten, not global-average-pool: the planted templates are
+        # spatial patterns, and averaging the map away leaves the head
+        # nearly blind (measured: GAP stalls at ~0.2 acc where flatten
+        # reaches ~0.9 in the same budget).
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+# Fixed random class templates — identical on every node (seed-pinned,
+# NOT the per-node data rng), so all shards label consistently.
+_TEMPLATES = np.random.default_rng(0).standard_normal(
+    (10, 32, 32, 3)).astype(np.float32)
+
+
+def synthetic_batch(rng: np.random.Generator, n: int, num_classes: int):
+    """Planted-signal images: each is its class's template (scaled
+    under the noise floor) plus unit Gaussian noise — a real learning
+    problem (SNR ~0.25 per pixel) that a small convnet solves within
+    tens of steps, so the multi-node loss curve is meaningful."""
+    y = rng.integers(0, num_classes, n).astype(np.int32)
+    x = (0.25 * _TEMPLATES[y] +
+         rng.standard_normal((n, 32, 32, 3))).astype(np.float32)
+    return x, y
+
+
+def main(argv=None) -> None:
+    # Honor an explicit JAX_PLATFORMS before backend init (same dance
+    # as infer/server.py: this image pins a TPU platform plugin).
+    if os.environ.get('JAX_PLATFORMS'):
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--steps', type=int, default=60)
+    parser.add_argument('--global-batch', type=int, default=64)
+    parser.add_argument('--lr', type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    # Multi-node: join via the gang env contract (no-op single-node).
+    from skypilot_tpu.runtime import gang
+    gang.initialize_jax_distributed()
+    nproc = jax.process_count()
+    rank = jax.process_index()
+    mesh = Mesh(np.asarray(jax.devices()), ('dp',))
+    print(f'cnn_distributed: node {rank}/{nproc}, '
+          f'{jax.device_count()} global devices, mesh dp='
+          f'{jax.device_count()}', flush=True)
+
+    model = SmallResNet()
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 32, 32, 3)))
+    tx = optax.adam(args.lr)
+    opt_state = jax.jit(tx.init)(params)
+
+    data_sharding = NamedSharding(mesh, P('dp'))
+
+    def loss_fn(params, x, y):
+        logits = model.apply(params, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+        acc = (logits.argmax(-1) == y).mean()
+        return loss, acc
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, x, y)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, acc
+
+    assert args.global_batch % nproc == 0, (args.global_batch, nproc)
+    local_n = args.global_batch // nproc
+    rng = np.random.default_rng(1234 + rank)   # distinct shards
+    t0 = time.time()
+    loss = acc = None
+    for step in range(args.steps):
+        x_np, y_np = synthetic_batch(rng, local_n, 10)
+        # Each node contributes its local shard of the global batch;
+        # XLA all-reduces the grads over dp.
+        x = jax.make_array_from_process_local_data(data_sharding, x_np)
+        y = jax.make_array_from_process_local_data(data_sharding, y_np)
+        params, opt_state, loss, acc = train_step(params, opt_state,
+                                                  x, y)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f'step {step:3d} loss {float(loss):.4f} '
+                  f'acc {float(acc):.3f}', flush=True)
+    dt = time.time() - t0
+    print(f'FINAL loss={float(loss):.4f} acc={float(acc):.3f} '
+          f'steps={args.steps} nodes={nproc} '
+          f'imgs_per_sec={args.steps * args.global_batch / dt:.1f}',
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

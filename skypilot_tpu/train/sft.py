@@ -121,11 +121,11 @@ def main(argv=None) -> None:
     if os.environ.get('JAX_PLATFORMS'):
         jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
 
-    # Multi-host: the gang env contract (runtime/gang.py) exports the JAX
-    # coordinator triplet, so no-arg initialize() works on any cluster this
-    # framework launches.
-    if os.environ.get('JAX_COORDINATOR_ADDRESS'):
-        jax.distributed.initialize()
+    # Multi-host: join via the gang env contract (runtime/gang.py
+    # exports the JAX coordinator triplet; this jax's argless
+    # initialize would not read it).
+    from skypilot_tpu.runtime import gang
+    gang.initialize_jax_distributed()
     logger.info('process %d/%d, %d local / %d global devices',
                 jax.process_index(), jax.process_count(),
                 jax.local_device_count(), jax.device_count())
