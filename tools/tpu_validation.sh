@@ -1,19 +1,22 @@
 #!/usr/bin/env bash
-# The blocked on-chip checklist (VERDICT r3 items 1-2): run the moment
-# the TPU tunnel answers. One command; artifacts land in
-# /tmp/tpu_validation/.
+# The on-chip checklist: run the moment the TPU tunnel answers. One
+# command; artifacts land in /tmp/tpu_validation/.
 #
 #   bash tools/tpu_validation.sh
 #
-# Steps:
+# ORDERED BY VALUE PER CHIP-MINUTE (round-5 lesson: the tunnel gave a
+# ~25-minute window, the old ordering spent all of it on the test gate
+# and the round's headline MFU number died with the tunnel):
 #   1. probe the chip (45s bound; exit early if wedged)
-#   2. tests_tpu/ lowering gate on-chip (covers flash attention, both
-#      paged-attention kernels, int8, chunked prefill, spec decode)
-#   3. train MFU with remat=full vs remat=dots (pick the better;
+#   2. full bench.py -> the BENCH artifact (train MFU first inside;
+#      partial results survive phase hangs)
+#   3. remat comparison (train phase with remat=dots vs =full;
 #      floor 0.7691 from round 1, target >= 0.85)
-#   4. full bench.py -> the BENCH artifact
+#   4. tests_tpu/ lowering gate on-chip, one pytest PER TEST ID with
+#      its own 420s timeout, first hang aborts (covers flash attention,
+#      both paged kernels, int8, chunked prefill, spec decode)
 #
-# After: if step 2 is green, flip SKYT_SPEC_PAGED_ATTN default to
+# After: if step 4 is green, flip SKYT_SPEC_PAGED_ATTN default to
 # 'pallas' (models/llama.py) and collapse _kernel into _kernel_mq(t=1)
 # in ops/paged_attention.py (equivalence proven by
 # test_t1_matches_single_query_kernel).
@@ -38,11 +41,17 @@ if ! timeout 45 python -c "import jax; print(jax.devices())"; then
     echo "tunnel wedged; aborting (re-run later)"; exit 1
 fi
 
-echo "== 2. tests_tpu gate =="
-step tests_tpu timeout 1800 python -m pytest tests_tpu/ -q
+echo "== 2. full bench (the headline artifact) =="
+if SKYT_BENCH_INIT_RETRY_S=240 timeout 5400 python bench.py \
+        2> "$OUT/bench.err" | tee "$OUT/bench.json"; then
+    echo "== bench: PASS =="
+else
+    echo "== bench: FAIL (see $OUT/bench.err) =="
+    FAIL=1
+fi
 
 echo "== 3. remat comparison (train phase only, via bench) =="
-for pol in full dots; do
+for pol in dots full; do
     echo "-- remat=$pol --"
     SKYT_BENCH_REMAT=$pol SKYT_BENCH_INIT_RETRY_S=120 \
         timeout 2000 python - <<'PYEOF' 2>&1 | tee "$OUT/remat_$pol.txt"
@@ -53,12 +62,32 @@ print(f'REMAT_RESULT {name} mfu={mfu:.4f}')
 PYEOF
 done
 
-echo "== 4. full bench =="
-if timeout 5400 python bench.py 2> "$OUT/bench.err" | tee "$OUT/bench.json"
-then
-    echo "== bench: PASS =="
+echo "== 4. tests_tpu gate (one pytest per test id, 420s each;"
+echo "   first HANG aborts the gate — a wedged tunnel costs one"
+echo "   timeout, not the whole window) =="
+: > "$OUT/tests_tpu.txt"
+GATE_RC=0
+while read -r tid; do
+    [ -z "$tid" ] && continue
+    echo "-- $tid" | tee -a "$OUT/tests_tpu.txt"
+    timeout 420 python -m pytest "$tid" -q >> "$OUT/tests_tpu.txt" 2>&1
+    rc=$?
+    if [ "$rc" -eq 124 ]; then
+        echo "   HANG (420s) — tunnel presumed wedged; aborting gate" \
+            | tee -a "$OUT/tests_tpu.txt"
+        GATE_RC=124; break
+    elif [ "$rc" -ne 0 ]; then
+        echo "   FAIL rc=$rc" | tee -a "$OUT/tests_tpu.txt"
+        GATE_RC=$rc
+    else
+        echo "   PASS" | tee -a "$OUT/tests_tpu.txt"
+    fi
+done < <(python -m pytest tests_tpu/ --collect-only -q 2>/dev/null \
+         | grep '::')
+if [ "$GATE_RC" -eq 0 ]; then
+    echo "== tests_tpu: PASS =="
 else
-    echo "== bench: FAIL (see $OUT/bench.err) =="
+    echo "== tests_tpu: FAIL rc=$GATE_RC (see $OUT/tests_tpu.txt) =="
     FAIL=1
 fi
 
