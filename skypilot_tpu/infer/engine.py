@@ -274,7 +274,8 @@ class InferenceEngine:
                  prefix_caching: bool = True,
                  spec_decode: int = 0,
                  prefill_chunk: int = 0,
-                 lockstep=None) -> None:
+                 lockstep=None,
+                 draft_model=None, draft_params=None) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
@@ -288,7 +289,18 @@ class InferenceEngine:
         broadcast from the primary host (new requests, cancels, stop)
         so all hosts issue identical device computations. Only the
         primary accepts submit()/cancel(); followers mirror. See
-        infer/multihost.py for the protocol."""
+        infer/multihost.py for the protocol.
+
+        draft_model/draft_params (with spec_decode k > 0): DRAFT-MODEL
+        speculative decoding — k greedy rollouts of the small draft
+        replace the n-gram proposer, all inside the same one-dispatch
+        verify step. The draft keeps its own dense KV cache aligned to
+        the slot lifecycle; a stale draft entry can only lower
+        acceptance, never correctness (the target's acceptance gate /
+        rejection sampling is unchanged, so outputs stay exactly the
+        plain path's). The reference has nothing here — vLLM-era
+        n-gram lookup is our baseline, a real draft model beats it on
+        non-repetitive text. Draft vocab must equal the target's."""
         self.model = model
         self.cfg = model.cfg
         self.params = params
@@ -402,15 +414,35 @@ class InferenceEngine:
         self._lengths = np.zeros((num_slots,), np.int32)
         self._conf_lengths = np.zeros((num_slots,), np.int32)
         self._temps = np.zeros((num_slots,), np.float32)
+        # Draft model (spec_mode 'draft'): its own dense KV cache over
+        # the same slots/positions as the target. Small by construction
+        # (the whole point of a draft), so never paged and never
+        # sharded — replicated params + cache keep the inner draft
+        # scan collective-free under a tp mesh.
+        self.draft_model = draft_model if self.spec_decode > 0 else None
+        self.draft_params = draft_params
+        self._draft_cache = None
+        if self.draft_model is not None:
+            dcfg = self.draft_model.cfg
+            assert dcfg.vocab_size == self.cfg.vocab_size, (
+                'draft/target vocab mismatch: verification compares '
+                f'token ids ({dcfg.vocab_size} vs {self.cfg.vocab_size})')
+            dshape = (dcfg.n_layers, num_slots, self.max_seq_len,
+                      dcfg.n_kv_heads, dcfg.head_dim)
+            self._draft_cache = {
+                'k': jnp.zeros(dshape, jnp.dtype(dcfg.dtype)),
+                'v': jnp.zeros(dshape, jnp.dtype(dcfg.dtype))}
         # Device-resident token history per slot (prompt + generated) —
-        # the n-gram proposer's haystack. Only maintained by the spec
-        # decode path; +k+2 tail slack keeps the per-step k+1-token
-        # write from ever clamping.
+        # the n-gram proposer's haystack. Only maintained by the
+        # n-gram spec path (a draft model replaces the proposer);
+        # +k+2 tail slack keeps the per-step k+1-token write from ever
+        # clamping.
         self._dev_hist = (
             jnp.zeros((num_slots,
                        self.max_seq_len + self.spec_decode + 2),
                       jnp.int32)
-            if self.spec_decode > 0 else None)
+            if self.spec_decode > 0 and self.draft_model is None
+            else None)
         self._waiting: 'queue.Queue[_Request]' = queue.Queue()
         # Multi-host lockstep (see __init__ docstring). On the primary,
         # submit() lands requests in _ingress and the per-tick sync
@@ -454,6 +486,14 @@ class InferenceEngine:
             self._decode_spec_impl,
             donate_argnums=(1, 5, 8),   # cache, keys, hist
             static_argnames=('n', 'k', 'sampling'))
+        self._jit_decode_spec_draft = jax.jit(
+            self._decode_spec_draft_impl,
+            donate_argnums=(2, 3, 7),   # cache, draft cache, keys
+            static_argnames=('n', 'k', 'sampling'))
+        self._jit_draft_prefill = jax.jit(
+            self._draft_prefill_impl,
+            donate_argnums=(1,),        # draft cache
+            static_argnames=('bucket',))
         self._jit_hist_insert = jax.jit(self._hist_insert_impl,
                                         donate_argnums=(0,))
         # Donate the cache: without it XLA materializes a full cache
@@ -462,8 +502,8 @@ class InferenceEngine:
         # so plain-path chunks keep the proposer's invariant intact.
         self._jit_decode_n = jax.jit(
             self._decode_n_impl,
-            donate_argnums=(1, 10, 11) if self.spec_decode > 0
-            else (1, 10),   # cache, counts (+hist under spec)
+            donate_argnums=(1, 10, 11) if self._dev_hist is not None
+            else (1, 10),   # cache, counts (+hist under n-gram spec)
             static_argnames=('n', 'sampling', 'penalize'))
         # Donate the global cache and the decode-arg arrays (updated in
         # place); the prefill cache is NOT donatable (B=1 buffers cannot
@@ -782,28 +822,8 @@ class InferenceEngine:
             positions = lens[:, None] + jnp.arange(k + 1)[None, :]
             logits, cache = self.model.apply(
                 params, toks_in, positions=positions, cache=cache)
-            logits = logits.astype(jnp.float32)          # [SLOTS, k+1, V]
-            if sampling:
-                # Advance each slot's key; this step draws from the
-                # sibling so re-runs never reuse a consumed stream.
-                ks2 = jax.vmap(jax.random.split)(keys)
-                step_keys, draw_keys = ks2[:, 0], ks2[:, 1]
-                out, acc = speculative_sample_step(
-                    logits, draft, temps, topks, topps, draw_keys)
-            else:
-                # Greedy-only compile: no softmax/top-k/categorical ops.
-                step_keys = keys
-                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                match = (draft == g[:, :k]).astype(jnp.int32)
-                acc = jnp.cumprod(match, axis=1).sum(axis=1)  # 0..k
-                out = g
-            new_last = jnp.take_along_axis(out, acc[:, None],
-                                           axis=1)[:, 0]
-            # RAW model logprobs of the emitted row (OpenAI/vLLM
-            # convention: pre-filter log-softmax).
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            lps = jnp.take_along_axis(logits, out[:, :, None],
-                                      axis=-1)[:, :, 0] - lse
+            out, lps, acc, new_last, step_keys = self._spec_verify_emit(
+                logits, draft, temps, keys, topks, topps, sampling, k)
             # Write all k+1 emitted candidates; entries past acc+1 are
             # junk the proposer never reads (its window stops at lens).
             hist = jax.vmap(
@@ -819,6 +839,133 @@ class InferenceEngine:
         if 'tables' in cache:
             cache = self._pin_paged_layouts(cache)
         return toks, lps, counts, cache, last, lens, keys, hist
+
+    def _spec_verify_emit(self, logits, draft, temps, keys, topks,
+                          topps, sampling, k):
+        """Shared verify half of every speculative step (n-gram AND
+        draft-model proposers): accept a draft prefix against the
+        target's logits, emit accepted+1 tokens and their RAW logprobs.
+
+        Greedy slots (temp == 0): accept the longest prefix agreeing
+        with the model's argmax — token-identical to the plain greedy
+        path (tested). Sampled slots (`sampling` static): rejection
+        sampling against a point-mass draft — accept draft d_i with
+        probability p_i(d_i) under the filtered target distribution,
+        first rejection draws from the residual — which preserves the
+        exact sequential sampling distribution regardless of WHERE the
+        draft came from (any deterministic proposer is a point mass).
+        """
+        logits = logits.astype(jnp.float32)              # [SLOTS, k+1, V]
+        if sampling:
+            # Advance each slot's key; this step draws from the
+            # sibling so re-runs never reuse a consumed stream.
+            ks2 = jax.vmap(jax.random.split)(keys)
+            step_keys, draw_keys = ks2[:, 0], ks2[:, 1]
+            out, acc = speculative_sample_step(
+                logits, draft, temps, topks, topps, draw_keys)
+        else:
+            # Greedy-only compile: no softmax/top-k/categorical ops.
+            step_keys = keys
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = (draft == g[:, :k]).astype(jnp.int32)
+            acc = jnp.cumprod(match, axis=1).sum(axis=1)  # 0..k
+            out = g
+        new_last = jnp.take_along_axis(out, acc[:, None], axis=1)[:, 0]
+        # RAW model logprobs of the emitted row (OpenAI/vLLM
+        # convention: pre-filter log-softmax).
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        lps = jnp.take_along_axis(logits, out[:, :, None],
+                                  axis=-1)[:, :, 0] - lse
+        return out, lps, acc, new_last, step_keys
+
+    def _decode_spec_draft_impl(self, params, draft_params, cache,
+                                dcache, last_tokens, lengths, temps,
+                                keys, topks, topps, n, k, sampling):
+        """`n` DRAFT-MODEL speculative iterations in one dispatch: k
+        greedy single-token rollouts of the small draft model (inner
+        scan over its own dense cache), then the target's s=k+1 verify
+        forward and the shared accept/emit step.
+
+        Draft-cache invariant (mirrors the target's): entries below
+        lens are settled; the token AT lens is fed — and its KV
+        written — by the next step that runs, so rejected-draft junk
+        above lens is always overwritten before it is attended from a
+        masked-in position. A draft entry made stale by a plain-path
+        interlude (penalized slots force whole chunks down
+        _decode_n_impl) only lowers acceptance; the verify gate keeps
+        outputs exactly equal to the plain path's either way."""
+        def draft_step(carry, _):
+            dc, tok, pos = carry
+            dlogits, dc = self.draft_model.apply(
+                draft_params, tok[:, None], positions=pos[:, None],
+                cache=dc)
+            nxt = jnp.argmax(dlogits[:, 0].astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            return (dc, nxt, pos + 1), nxt
+
+        def step(carry, _):
+            cache, dcache, last, lens, keys = carry
+            # k+1 rollout steps, not k: the final step's logits are
+            # discarded but its KV WRITE matters — it feeds d_k at
+            # position lens+k, matching the k+1 positions the target's
+            # verify forward writes. Without it the draft cache has a
+            # hole at lens+k whenever all k drafts are accepted, and
+            # every later rollout attends junk there (measured: ~20%
+            # acceptance on a self-draft that should be ~100%).
+            (dcache, _, _), drafts = jax.lax.scan(
+                draft_step, (dcache, last, lens), None, length=k + 1)
+            draft = jnp.moveaxis(drafts, 0, 1)[:, :k]    # [SLOTS, k]
+            toks_in = jnp.concatenate([last[:, None], draft], axis=1)
+            positions = lens[:, None] + jnp.arange(k + 1)[None, :]
+            logits, cache = self.model.apply(
+                params, toks_in, positions=positions, cache=cache)
+            out, lps, acc, new_last, step_keys = self._spec_verify_emit(
+                logits, draft, temps, keys, topks, topps, sampling, k)
+            return (cache, dcache, new_last, lens + acc + 1,
+                    step_keys), (out, lps, acc + 1)
+
+        (cache, dcache, last, lens, keys), (toks, lps, counts) = \
+            jax.lax.scan(
+                step, (cache, dcache, last_tokens, lengths, keys),
+                None, length=n)
+        if 'tables' in cache:
+            cache = self._pin_paged_layouts(cache)
+        return toks, lps, counts, cache, dcache, last, lens, keys
+
+    def _draft_prefill_impl(self, draft_params, dcache, tokens, slot,
+                            bucket):
+        """Admission tail for the draft cache: run the prompt through
+        the draft model (one logit position — the lm_head output is
+        discarded) and copy its B=1 cache into `slot`. Junk KV from
+        bucket padding lands above the slot's length, where the
+        feed-at-lens invariant overwrites it before use — the same
+        contract as the target's padded prefill."""
+        del bucket
+        dcfg = self.draft_model.cfg
+        b, s = tokens.shape
+        positions = jnp.arange(s)[None, :].repeat(b, 0)
+        shape = (dcfg.n_layers, b, s, dcfg.n_kv_heads, dcfg.head_dim)
+        dtype = jnp.dtype(dcfg.dtype)
+        c1 = {'k': jnp.zeros(shape, dtype),
+              'v': jnp.zeros(shape, dtype)}
+        _, c1 = self.draft_model.apply(
+            draft_params, tokens, positions=positions, cache=c1,
+            logit_positions=jnp.zeros((b, 1), jnp.int32))
+        s_tgt = self.max_seq_len
+
+        def fit(x):
+            if x.shape[2] > s_tgt:
+                return x[:, :, :s_tgt]
+            if x.shape[2] < s_tgt:
+                return jnp.pad(x, ((0, 0), (0, 0),
+                                   (0, s_tgt - x.shape[2]),
+                                   (0, 0), (0, 0)))
+            return x
+
+        c1 = jax.tree.map(fit, c1)
+        return jax.tree.map(
+            lambda big, small: jax.lax.dynamic_update_slice(
+                big, small, (0, slot, 0, 0, 0)), dcache, c1)
 
     # ----------------------------------------------------------- sampling
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
@@ -1259,9 +1406,21 @@ class InferenceEngine:
     def _complete_admission(self, req: '_Request', slot: int, n: int,
                             first: int, temp: float,
                             first_lp: Optional[float] = None) -> None:
-        """Shared admission tail: device history (spec decode), first
-        token delivery, host slot bookkeeping."""
-        if self.spec_decode > 0:
+        """Shared admission tail: device history (n-gram spec) or
+        draft-cache prefill (draft spec), first token delivery, host
+        slot bookkeeping."""
+        if self.draft_model is not None:
+            # The draft needs the prompt KV in ITS cache too. Prefix
+            # caching never shortcuts this (the draft cache is per-slot
+            # dense), which is fine: the draft is small by construction.
+            db = self._bucket_for(n)
+            padded = np.zeros((1, db), np.int32)
+            padded[0, :n] = req.tokens
+            with self._ctx():
+                self._draft_cache = self._jit_draft_prefill(
+                    self.draft_params, self._draft_cache,
+                    jnp.asarray(padded), jnp.int32(slot), bucket=db)
+        if self._dev_hist is not None:
             # Full prompt (not just a prefix-cached suffix) into the
             # device history for the n-gram proposer.
             # Clamp the insert width to the history buffer: the pow2
@@ -1517,13 +1676,23 @@ class InferenceEngine:
                                        rem_space // (k + 1)))
                     chunk = 1 << (bound.bit_length() - 1)
                     with self._ctx():
-                        toks, lps, counts, self.cache, d_last, \
-                            d_lens, d_keys, self._dev_hist = \
-                            self._jit_decode_spec(
-                                self.params, self.cache, d_last, d_lens,
-                                d_temps, d_keys, d_topks, d_topps,
-                                self._dev_hist, n=chunk, k=k,
-                                sampling=sampling)
+                        if self.draft_model is not None:
+                            toks, lps, counts, self.cache, \
+                                self._draft_cache, d_last, d_lens, \
+                                d_keys = self._jit_decode_spec_draft(
+                                    self.params, self.draft_params,
+                                    self.cache, self._draft_cache,
+                                    d_last, d_lens, d_temps, d_keys,
+                                    d_topks, d_topps, n=chunk, k=k,
+                                    sampling=sampling)
+                        else:
+                            toks, lps, counts, self.cache, d_last, \
+                                d_lens, d_keys, self._dev_hist = \
+                                self._jit_decode_spec(
+                                    self.params, self.cache, d_last,
+                                    d_lens, d_temps, d_keys, d_topks,
+                                    d_topps, self._dev_hist, n=chunk,
+                                    k=k, sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, d_keys,
                                       d_topks, d_topps, d_press,
                                       d_freqs, d_counts)

@@ -652,7 +652,9 @@ def build_engine(model_name: Optional[str] = None,
                  spec_decode: int = 0,
                  quantize: str = 'none',
                  prefill_chunk: int = 0,
-                 lockstep=None
+                 lockstep=None,
+                 draft_model_name: Optional[str] = None,
+                 draft_checkpoint: Optional[str] = None
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
@@ -664,6 +666,14 @@ def build_engine(model_name: Optional[str] = None,
     multiple hosts — tp then counts GLOBAL devices (the mesh builder
     uses jax.devices(), which is already global after
     jax.distributed.initialize()).
+
+    draft_model_name / draft_checkpoint (with spec_decode > 0): a
+    small DRAFT MODEL replaces the n-gram proposer. draft_checkpoint
+    loads HF weights; draft_model_name picks a config preset; the
+    special name 'self' reuses the target model+params (acceptance is
+    then 1.0 by construction — a mechanism check / upper bound, not a
+    speedup, since the draft costs as much as the target). Draft runs
+    replicated (it is small by construction), llama-family only.
 
     cache_mode: 'auto' (= paged; MoE shares the llama attention layer so
     paged decode covers both families), 'paged', or 'dense'.
@@ -763,6 +773,35 @@ def build_engine(model_name: Optional[str] = None,
         # Paged for all families: MoE shares the llama attention layer,
         # so the paged decode path covers it too (tested against dense).
         cache_mode = 'paged'
+    draft_model = draft_params = None
+    if spec_decode > 0 and (draft_model_name or draft_checkpoint):
+        if draft_model_name == 'self':
+            draft_model, draft_params = model, params
+        elif draft_checkpoint:
+            from skypilot_tpu.models import weights as weights_lib
+            dcfg = weights_lib.load_config(
+                draft_checkpoint, remat=False, param_dtype=dtype,
+                dtype=dtype)
+            dcfg = _dc.replace(
+                dcfg, max_seq_len=min(dcfg.max_seq_len, max_seq_len))
+            draft_model = llama.LlamaModel(dcfg)
+            draft_params = weights_lib.load_llama_params(
+                dcfg, draft_checkpoint)
+        else:
+            dcfg = _dc.replace(
+                llama.CONFIGS[draft_model_name], remat=False,
+                max_seq_len=max_seq_len)
+            if dcfg.param_dtype == 'float32' and dcfg.dtype == 'bfloat16':
+                dcfg = _dc.replace(dcfg, param_dtype='bfloat16')
+            draft_model = llama.LlamaModel(dcfg)
+            draft_params = jax.jit(draft_model.init)(
+                jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32))
+            logger.warning(
+                'draft model %r is RANDOMLY INITIALIZED (no '
+                '--draft-checkpoint): acceptance will be chance-level, '
+                'making decode strictly SLOWER than --spec-decode 0. '
+                'Debug use only — point --draft-checkpoint at real '
+                'small-model weights for a speedup.', draft_model_name)
     return engine_lib.InferenceEngine(model, params,
                                       num_slots=num_slots,
                                       max_seq_len=cfg.max_seq_len,
@@ -773,7 +812,9 @@ def build_engine(model_name: Optional[str] = None,
                                       prefix_caching=prefix_caching,
                                       spec_decode=spec_decode,
                                       prefill_chunk=prefill_chunk,
-                                      lockstep=lockstep)
+                                      lockstep=lockstep,
+                                      draft_model=draft_model,
+                                      draft_params=draft_params)
 
 
 def main(argv=None) -> None:
@@ -808,8 +849,18 @@ def main(argv=None) -> None:
     parser.add_argument('--no-prefix-caching', action='store_true',
                         help='disable KV prefix caching (paged mode)')
     parser.add_argument('--spec-decode', type=int, default=0,
-                        help='n-gram speculative decoding draft length '
-                             '(0 = off; greedy requests only)')
+                        help='speculative decoding draft length k '
+                             '(0 = off). Default proposer: n-gram '
+                             'prompt-lookup; see --draft-checkpoint.')
+    parser.add_argument('--draft-checkpoint', default=None,
+                        help='HF checkpoint of a small draft model: '
+                             'replaces the n-gram proposer with real '
+                             'draft-model speculative decoding '
+                             '(requires --spec-decode > 0)')
+    parser.add_argument('--draft-model', default=None,
+                        help="draft config preset, or 'self' to "
+                             'self-draft with the target (mechanism '
+                             'check; no speedup)')
     parser.add_argument('--quantize', default='none',
                         choices=['none', 'int8'],
                         help='weight-only quantization (int8 = w8a16; '
@@ -845,7 +896,9 @@ def main(argv=None) -> None:
                           spec_decode=args.spec_decode,
                           quantize=args.quantize,
                           prefill_chunk=args.prefill_chunk,
-                          lockstep=lockstep)
+                          lockstep=lockstep,
+                          draft_model_name=args.draft_model,
+                          draft_checkpoint=args.draft_checkpoint)
     if lockstep is not None and not lockstep.is_primary:
         # Follower host: no HTTP, no local requests — run the engine
         # loop (driven by the primary's tick broadcasts) until the

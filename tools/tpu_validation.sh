@@ -67,8 +67,10 @@ echo "   first HANG aborts the gate — a wedged tunnel costs one"
 echo "   timeout, not the whole window) =="
 : > "$OUT/tests_tpu.txt"
 GATE_RC=0
+GATE_COUNT=0
 while read -r tid; do
     [ -z "$tid" ] && continue
+    GATE_COUNT=$((GATE_COUNT + 1))
     echo "-- $tid" | tee -a "$OUT/tests_tpu.txt"
     timeout 420 python -m pytest "$tid" -q >> "$OUT/tests_tpu.txt" 2>&1
     rc=$?
@@ -84,8 +86,13 @@ while read -r tid; do
     fi
 done < <(python -m pytest tests_tpu/ --collect-only -q 2>/dev/null \
          | grep '::')
-if [ "$GATE_RC" -eq 0 ]; then
-    echo "== tests_tpu: PASS =="
+if [ "$GATE_COUNT" -eq 0 ]; then
+    # Collection failure/empty suite must not read as a green gate —
+    # a vacuous PASS here would green-light flipping kernel defaults.
+    echo "== tests_tpu: FAIL (collected 0 test ids) =="
+    FAIL=1
+elif [ "$GATE_RC" -eq 0 ]; then
+    echo "== tests_tpu: PASS ($GATE_COUNT tests) =="
 else
     echo "== tests_tpu: FAIL rc=$GATE_RC (see $OUT/tests_tpu.txt) =="
     FAIL=1
