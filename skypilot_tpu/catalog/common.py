@@ -44,6 +44,44 @@ class LazyDataFrame:
             self._df = None
 
 
+_STALE_AFTER_DAYS = 90.0
+
+
+def catalog_age_days(name: str = 'gcp') -> Optional[float]:
+    """Days since the catalog CSV was generated (its sidecar
+    .meta.json, written by the data fetcher), or None when no
+    provenance exists. Static list prices silently age — callers
+    surface this so $/h and cost-report numbers are read with the
+    right suspicion."""
+    import datetime
+    import json
+    path = os.path.join(_CATALOG_DIR, f'{name}.meta.json')
+    try:
+        with open(path, encoding='utf-8') as f:
+            meta = json.load(f)
+        gen = datetime.datetime.fromisoformat(meta['generated_at'])
+    except (OSError, ValueError, KeyError):
+        return None
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return (now - gen).total_seconds() / 86400.0
+
+
+def staleness_warning(name: str = 'gcp') -> Optional[str]:
+    """Human-readable warning when the catalog is stale (> 90 days) or
+    has no provenance; None when fresh."""
+    age = catalog_age_days(name)
+    refresh = ('refresh: python -m '
+               'skypilot_tpu.catalog.data_fetchers.fetch_gcp '
+               '[--from-api]')
+    if age is None:
+        return (f'{name} catalog has no generation record; prices may '
+                f'be stale ({refresh})')
+    if age > _STALE_AFTER_DAYS:
+        return (f'{name} catalog prices are {age:.0f} days old; '
+                f'{refresh}')
+    return None
+
+
 def filter_instances(df: pd.DataFrame,
                      instance_type: Optional[str] = None,
                      accelerator: Optional[str] = None,

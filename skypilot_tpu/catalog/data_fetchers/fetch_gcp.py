@@ -136,7 +136,23 @@ def _emit(out_path: str, tpu_zone_prices=None) -> int:
         w = csv.writer(f)
         w.writerow(HEADER)
         w.writerows(rows)
+    _write_meta(out_path,
+                mode='api' if tpu_zone_prices else 'static')
     return len(rows)
+
+
+def _write_meta(out_path: str, mode: str) -> None:
+    """Sidecar provenance for staleness warnings (catalog/common.py
+    catalog_age_days): static prices silently age, so the CLI tells the
+    user how old the numbers are and how to refresh them."""
+    import datetime
+    import json
+    meta = {'generated_at': datetime.datetime.now(
+                datetime.timezone.utc).isoformat(),
+            'mode': mode}
+    with open(os.path.splitext(out_path)[0] + '.meta.json', 'w',
+              encoding='utf-8') as f:
+        json.dump(meta, f)
 
 
 # ------------------------------------------------------- live API mode

@@ -100,3 +100,41 @@ def test_emit_from_api_overrides_prices(tmp_path):
     other = [r for r in rows if r['AcceleratorName'] == 'tpu-v5e-16'
              and r['Region'] == 'europe-west4'][0]
     assert float(other['Price']) == 19.2
+
+
+def test_emit_writes_provenance_meta(tmp_path):
+    """Every catalog write records generated_at + mode so the CLI can
+    warn about stale prices (the static table silently ages)."""
+    import json
+    out = tmp_path / 'gcp.csv'
+    fetch_gcp.emit_static(str(out))
+    meta = json.load(open(tmp_path / 'gcp.meta.json'))
+    assert meta['mode'] == 'static'
+    fetch_gcp.emit_from_api(str(out), 'key', session=_FakeSession())
+    meta = json.load(open(tmp_path / 'gcp.meta.json'))
+    assert meta['mode'] == 'api'
+    import datetime
+    age = (datetime.datetime.now(datetime.timezone.utc) -
+           datetime.datetime.fromisoformat(meta['generated_at']))
+    assert age.total_seconds() < 300
+
+
+def test_catalog_staleness_warning(monkeypatch, tmp_path):
+    """> 90 days -> warning with the refresh command; fresh -> None;
+    no meta -> 'no generation record'."""
+    import datetime
+    import json
+
+    from skypilot_tpu.catalog import common as catalog_common
+    monkeypatch.setattr(catalog_common, '_CATALOG_DIR', str(tmp_path))
+    assert 'no generation record' in catalog_common.staleness_warning()
+    old = (datetime.datetime.now(datetime.timezone.utc) -
+           datetime.timedelta(days=200)).isoformat()
+    json.dump({'generated_at': old, 'mode': 'static'},
+              open(tmp_path / 'gcp.meta.json', 'w'))
+    msg = catalog_common.staleness_warning()
+    assert '200 days old' in msg and 'fetch_gcp' in msg
+    now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    json.dump({'generated_at': now, 'mode': 'api'},
+              open(tmp_path / 'gcp.meta.json', 'w'))
+    assert catalog_common.staleness_warning() is None
