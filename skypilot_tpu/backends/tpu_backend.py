@@ -28,6 +28,7 @@ from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import provisioner
 from skypilot_tpu.runtime import server as server_lib
 from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import docker_utils
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import subprocess_utils
 from skypilot_tpu.utils import timeline
@@ -285,11 +286,26 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         # shell-quoted, defeating ~ expansion — do it in the script).
         script = (f'[ -d ~/{WORKDIR_TARGET} ] && cd ~/{WORKDIR_TARGET}; '
                   f'{task.setup}')
+        docker_image = docker_utils.parse_docker_image(
+            getattr(handle.launched_resources, 'image_id', None))
 
         def _setup(idx_runner) -> None:
             rank, runner = idx_runner
-            rc, out, err = runner.run(
-                script, env=env, require_outputs=True, stream_logs=False)
+            if docker_image:
+                # Container brought up here (before the first command
+                # that needs it); setup runs INSIDE with env exported
+                # there — docker exec inherits nothing.
+                name = docker_utils.container_name(handle.cluster_name,
+                                                   rank)
+                full = (docker_utils.ensure_container_cmd(
+                            docker_image, name) + '\n' +
+                        docker_utils.exec_cmd(name, script, env=env))
+                rc, out, err = runner.run(full, require_outputs=True,
+                                          stream_logs=False)
+            else:
+                rc, out, err = runner.run(
+                    script, env=env, require_outputs=True,
+                    stream_logs=False)
             if rc != 0:
                 raise exceptions.CommandError(
                     rc, f'setup on rank {rank}',
@@ -320,6 +336,10 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
             # (runtime/gang.py): contiguous host groups become slices.
             'num_slices': getattr(handle.launched_resources,
                                   'num_slices', 1),
+            # 'docker:<image>' resources: the agent execs the run
+            # script inside the container (utils/docker_utils).
+            'docker_image': docker_utils.parse_docker_image(
+                getattr(handle.launched_resources, 'image_id', None)),
         }
         job_id = handle.head_client().submit(spec)
         logger.info('Job %d submitted on %s.', job_id,

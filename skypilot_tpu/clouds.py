@@ -69,7 +69,12 @@ class Cloud:
             needed.add(CloudFeature.SPOT_INSTANCE)
         if resources.ports:
             needed.add(CloudFeature.OPEN_PORTS)
-        if resources.image_id:
+        if resources.image_id and \
+                not resources.image_id.startswith('docker:'):
+            # 'docker:<image>' is a RUNTIME wrap (utils/docker_utils:
+            # the agent execs task scripts inside a container), not a
+            # VM boot image — it needs a docker daemon, not provisioner
+            # support, so it skips the IMAGE_ID gate.
             needed.add(CloudFeature.IMAGE_ID)
         if resources.disk_tier:
             needed.add(CloudFeature.CUSTOM_DISK_TIER)

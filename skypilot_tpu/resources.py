@@ -68,6 +68,9 @@ class Resources:
     spot_recovery: Optional[str] = None          # managed-jobs strategy name
     disk_size: int = _DEFAULT_DISK_SIZE_GB
     disk_tier: Optional[str] = None              # low|medium|high|best
+    # VM boot image (provisioner feature), OR 'docker:<image>' to run
+    # the task's setup/run inside a container on the VM (runtime wrap,
+    # utils/docker_utils — works on any cloud with a docker daemon).
     image_id: Optional[str] = None
     ports: Optional[List[Union[int, str]]] = None
     labels: Optional[Dict[str, str]] = None

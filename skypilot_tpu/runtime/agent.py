@@ -135,20 +135,43 @@ class Worker:
         run_cmd = spec.get('run') or 'true'
         self._report(job_id, 'run_started')
         script = log_lib.make_task_bash_script(run_cmd, env)
-        rc, _ = self._run_tracked(script, run_log, rj)
+        docker = None
+        if spec.get('docker_image'):
+            from skypilot_tpu.utils import docker_utils
+            docker = (spec['docker_image'],
+                      docker_utils.container_name(
+                          env.get('SKYT_CLUSTER_NAME', 'cluster'),
+                          rank))
+        rc, _ = self._run_tracked(script, run_log, rj, docker=docker)
         os.unlink(script)
         self._report(job_id, 'done', rc)
         with self._lock:
             self.running.pop(job_id, None)
 
     def _run_tracked(self, script: str, log_path: str,
-                     rj: RunningJob) -> tuple:
-        """run_with_log but exposing the child pid for kill directives."""
+                     rj: RunningJob, docker=None) -> tuple:
+        """run_with_log but exposing the child pid for kill directives.
+
+        docker: optional (image, container_name) — the script then
+        executes INSIDE the long-lived task container (brought up
+        idempotently first; its stdout lands in the same job log). The
+        script file is visible in the container via the /tmp mount and
+        carries its own env exports, so the wrap is exactly
+        `docker exec <name> bash <script>`."""
         import subprocess
+        if docker is not None:
+            from skypilot_tpu.utils import docker_utils
+            image, name = docker
+            argv = ['bash', '-c',
+                    docker_utils.ensure_container_cmd(image, name)
+                    + '\nexec '
+                    + docker_utils.exec_script_cmd(name, script)]
+        else:
+            argv = ['bash', script]
         log_path = os.path.expanduser(log_path)
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         with open(log_path, 'a', encoding='utf-8') as log_file:
-            proc = subprocess.Popen(['bash', script],
+            proc = subprocess.Popen(argv,
                                     stdout=subprocess.PIPE,
                                     stderr=subprocess.STDOUT,
                                     start_new_session=True, text=True)

@@ -233,6 +233,61 @@ def test_text_classify_yaml(local_env, capsys):
     assert float(m.group(1)) > 0.9, out
 
 
+@pytest.mark.integration
+def test_docker_wrapped_task(local_env, tmp_path, monkeypatch, capsys):
+    """`image_id: docker:<image>` runs setup AND run inside a
+    container: the agent/backend bring the container up idempotently
+    (pull + run -d) and exec the task scripts in it. A fake `docker`
+    on PATH records the calls and executes the inner command on the
+    host, so the full wrap is asserted without a docker daemon."""
+    import os
+    fake = tmp_path / 'bin'
+    fake.mkdir()
+    call_log = tmp_path / 'docker_calls.log'
+    (fake / 'docker').write_text(
+        '#!/usr/bin/env bash\n'
+        f'echo "DOCKER $@" >> {call_log}\n'
+        'cmd=$1; shift\n'
+        'case "$cmd" in\n'
+        '  image|container) exit 1;;\n'     # not present -> pull/run
+        '  pull|run) exit 0;;\n'
+        '  exec) shift; exec "$@";;\n'      # drop name; run on host
+        'esac\n')
+    (fake / 'docker').chmod(0o755)
+    monkeypatch.setenv('PATH', f'{fake}:{os.environ["PATH"]}')
+
+    t = sky.Task(name='dock', setup='echo setup-in-container',
+                 run='echo run-in-container')
+    t.set_resources(resources_lib.Resources(
+        cloud='local', image_id='docker:ubuntu:22.04'))
+    jid = execution.launch(t, cluster_name='c-dock', detach_run=True)
+    job = _wait_terminal('c-dock', jid)
+    assert job['status'] == 'SUCCEEDED', job
+
+    calls = call_log.read_text()
+    assert 'pull ubuntu:22.04' in calls
+    assert 'run -d --name skyt-c-dock-r0 --network host' in calls
+    assert 'exec skyt-c-dock-r0 bash' in calls
+    core.tail_logs('c-dock', jid, follow=False)
+    out = capsys.readouterr().out
+    assert 'run-in-container' in out
+
+
+def test_bare_image_id_still_gated():
+    """A non-docker image_id still needs provisioner support: the
+    local cloud lacks IMAGE_ID, so the feature gate reports it."""
+    from skypilot_tpu import clouds
+    local_cloud = clouds.Cloud.from_name('local')
+    res = resources_lib.Resources(cloud='local',
+                                  image_id='projects/x/images/y')
+    assert clouds.CloudFeature.IMAGE_ID in \
+        local_cloud.unsupported_features_for(res)
+    res_docker = resources_lib.Resources(cloud='local',
+                                         image_id='docker:img')
+    assert clouds.CloudFeature.IMAGE_ID not in \
+        local_cloud.unsupported_features_for(res_docker)
+
+
 def test_cli_show_tpus():
     runner = CliRunner()
     res = runner.invoke(cli, ['show-tpus'])
