@@ -306,6 +306,32 @@ def test_serve_cluster_controller(serve_env, tmp_path, monkeypatch):
 
 
 @pytest.mark.integration
+def test_serve_multihost_replica(serve_env):
+    """A replica spanning MULTIPLE hosts (the reference's
+    TP-across-a-replica-cluster shape, llm/vllm/serve.yaml): the task
+    gang-runs on every host, only rank 0 binds SKYT_REPLICA_PORT (the
+    multihost engine's contract), and the replica endpoint routes to
+    the head — service goes READY and proxies."""
+    run = (
+        "if [ \"$SKYT_NODE_RANK\" = 0 ]; then " + REPLICA_SERVER +
+        "; else sleep 3600; fi")
+    t = sky.Task(name='mh', run=run, num_nodes=2)
+    t.set_resources(resources_lib.Resources(cloud='local'))
+    t.service = spec_lib.ServiceSpec(
+        readiness_path='/', min_replicas=1,
+        initial_delay_seconds=60, probe_timeout_seconds=2)
+    name, endpoint = serve_core.up(t, 'mhsvc')
+    svc = _wait_ready(name, 1)
+    replica = svc['replicas'][0]
+    handle = state.get_cluster(replica['cluster_name'])['handle']
+    assert handle.num_hosts == 2          # really a 2-host replica
+    resp = requests.get(endpoint + '/', timeout=10)
+    assert resp.status_code == 200
+    assert resp.text.startswith('hello-from-')
+    serve_core.down(name)
+
+
+@pytest.mark.integration
 def test_serve_lifecycle(serve_env):
     name, endpoint = serve_core.up(_service_task(min_replicas=2), 'svc')
     svc = _wait_ready(name, 2)
