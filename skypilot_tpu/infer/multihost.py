@@ -132,8 +132,27 @@ def _selftest_worker(coord_port: int, nprocs: int, rank: int,
             [9, 9, 9],
             engine_lib.SamplingParams(max_new_tokens=5, temperature=0.7,
                                       top_k=8, seed=3))
+        # Cancel under lockstep: the flag must flip on every host at
+        # the SAME tick (slot release changes the next tick's batch) —
+        # the most divergence-prone path. Cancel a long request
+        # mid-stream, then prove the hosts are still in lockstep by
+        # running one more request to completion.
+        rid, q = eng.submit([2, 4, 6], engine_lib.SamplingParams(
+            max_new_tokens=48))
+        got = 0
+        while got < 2:
+            if q.get(timeout=300) is None:
+                break
+            got += 1
+        eng.cancel(rid)   # may race completion; either way drains
+        while q.get(timeout=300) is not None:
+            pass                       # drained to the terminal None
+        after_cancel = eng.generate(
+            [5, 17, 3, 99, 42],
+            engine_lib.SamplingParams(max_new_tokens=6))
         with open(out_path, 'w', encoding='utf-8') as f:
-            json.dump({'greedy': greedy, 'sampled': sampled}, f)
+            json.dump({'greedy': greedy, 'sampled': sampled,
+                       'after_cancel': after_cancel}, f)
         eng.stop()
     else:
         eng.join()

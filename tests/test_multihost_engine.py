@@ -82,3 +82,7 @@ def test_two_process_lockstep_matches_single_process(tmp_path):
     # partitioning is identical, so tokens match too.
     assert got['sampled'] == ref['sampled'], (got, ref)
     assert 1 <= len(got['sampled']) <= 5
+    # A cancel happened between the sampled run and this one (see
+    # _selftest_worker): identical output proves the hosts stayed in
+    # lockstep through the mid-stream slot release.
+    assert got['after_cancel'] == ref['after_cancel'] == got['greedy']
