@@ -26,6 +26,13 @@ OUT=/tmp/tpu_validation
 mkdir -p "$OUT"
 FAIL=0
 
+# Persistent XLA compile cache: tunnel windows are short and compiles
+# through the tunnel are the expensive part — a re-run after a wedge
+# (or a second chip window) reuses every compile the first one paid
+# for.
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/skyt_jax_cache_tpu}
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-1}
+
 step() {  # step <name> <cmd...>: run, tee, record PASS/FAIL
     local name=$1; shift
     if "$@" 2>&1 | tee "$OUT/$name.txt"; then
