@@ -297,15 +297,15 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                 # there — docker exec inherits nothing.
                 name = docker_utils.container_name(handle.cluster_name,
                                                    rank)
-                full = (docker_utils.ensure_container_cmd(
-                            docker_image, name) + '\n' +
-                        docker_utils.exec_cmd(name, script, env=env))
-                rc, out, err = runner.run(full, require_outputs=True,
-                                          stream_logs=False)
+                cmd, cmd_env = (docker_utils.ensure_container_cmd(
+                                    docker_image, name) + '\n' +
+                                docker_utils.exec_cmd(name, script,
+                                                      env=env)), None
             else:
-                rc, out, err = runner.run(
-                    script, env=env, require_outputs=True,
-                    stream_logs=False)
+                cmd, cmd_env = script, env
+            rc, out, err = runner.run(cmd, env=cmd_env,
+                                      require_outputs=True,
+                                      stream_logs=False)
             if rc != 0:
                 raise exceptions.CommandError(
                     rc, f'setup on rank {rank}',

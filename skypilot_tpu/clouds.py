@@ -69,13 +69,15 @@ class Cloud:
             needed.add(CloudFeature.SPOT_INSTANCE)
         if resources.ports:
             needed.add(CloudFeature.OPEN_PORTS)
-        if resources.image_id and \
-                not resources.image_id.startswith('docker:'):
+        if resources.image_id:
+            from skypilot_tpu.utils import docker_utils
             # 'docker:<image>' is a RUNTIME wrap (utils/docker_utils:
             # the agent execs task scripts inside a container), not a
             # VM boot image — it needs a docker daemon, not provisioner
             # support, so it skips the IMAGE_ID gate.
-            needed.add(CloudFeature.IMAGE_ID)
+            if docker_utils.parse_docker_image(
+                    resources.image_id) is None:
+                needed.add(CloudFeature.IMAGE_ID)
         if resources.disk_tier:
             needed.add(CloudFeature.CUSTOM_DISK_TIER)
         if resources.autostop is not None:

@@ -158,6 +158,63 @@ def _selftest_worker(coord_port: int, nprocs: int, rank: int,
         eng.join()
 
 
+def run_selftest_gang(nprocs: int, devices_per_proc: int, out_path: str,
+                      log_dir: str, timeout: float = 900.0) -> dict:
+    """Spawn the selftest as `nprocs` REAL OS processes on the CPU
+    backend and return rank 0's output dict.
+
+    Shared by tests/test_multihost_engine.py and __graft_entry__.py's
+    serving dryrun — one harness, so cleanup rules (kill survivors on
+    any failure, log files instead of undrained PIPEs) can't drift
+    between the two.
+    """
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['XLA_FLAGS'] = ('--xla_force_host_platform_device_count='
+                        f'{devices_per_proc}')
+    # A leftover gang env (from an outer harness) must not leak into
+    # the workers' initialize path.
+    for k in ('JAX_COORDINATOR_ADDRESS', 'JAX_NUM_PROCESSES',
+              'JAX_PROCESS_ID'):
+        env.pop(k, None)
+    log_paths = [os.path.join(log_dir, f'mh-rank{r}.log')
+                 for r in range(nprocs)]
+    logs = [open(p, 'wb') for p in log_paths]
+    procs = [subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.multihost',
+         '--selftest-port', str(port),
+         '--selftest-nprocs', str(nprocs),
+         '--selftest-rank', str(rank),
+         '--selftest-out', out_path],
+        stdout=logs[rank], stderr=subprocess.STDOUT, env=env)
+        for rank in range(nprocs)]
+    try:
+        for rank, p in enumerate(procs):
+            rc = p.wait(timeout=timeout)
+            with open(log_paths[rank], encoding='utf-8',
+                      errors='replace') as f:
+                tail = f.read()[-3000:]
+            assert rc == 0, \
+                f'multihost selftest rank {rank} rc={rc}:\n{tail}'
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    with open(out_path, encoding='utf-8') as f:
+        return json.load(f)
+
+
 def main(argv=None) -> None:
     import argparse
     import os

@@ -11,70 +11,23 @@ stand-in for a serving replica spanning a multi-host TPU slice
 (reference: TP across a whole replica cluster, llm/vllm/serve.yaml
 --tensor-parallel-size over $SKYPILOT_NUM_GPUS_PER_NODE).
 """
-import json
-import os
-import socket
-import subprocess
-import sys
-
 import pytest
 
+from skypilot_tpu.infer import multihost
+
 pytestmark = pytest.mark.heavy
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        return s.getsockname()[1]
-
-
-def _run_selftest(tmp_path, tag, nprocs, devices_per_proc):
-    """Launch the selftest gang; return rank 0's output dict."""
-    out = tmp_path / f'{tag}.json'
-    port = _free_port()
-    env = dict(os.environ)
-    env['JAX_PLATFORMS'] = 'cpu'
-    env['XLA_FLAGS'] = ('--xla_force_host_platform_device_count='
-                        f'{devices_per_proc}')
-    # A leftover gang env (from an outer test harness) must not leak
-    # into the workers' argless-initialize path.
-    for k in ('JAX_COORDINATOR_ADDRESS', 'JAX_NUM_PROCESSES',
-              'JAX_PROCESS_ID'):
-        env.pop(k, None)
-    procs = []
-    logs = []
-    for rank in range(nprocs):
-        log = open(tmp_path / f'{tag}-r{rank}.log', 'wb')
-        logs.append(log)
-        procs.append(subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.infer.multihost',
-             '--selftest-port', str(port),
-             '--selftest-nprocs', str(nprocs),
-             '--selftest-rank', str(rank),
-             '--selftest-out', str(out)],
-            stdout=log, stderr=subprocess.STDOUT, env=env))
-    try:
-        for rank, p in enumerate(procs):
-            rc = p.wait(timeout=900)
-            assert rc == 0, (
-                f'{tag} rank {rank} rc={rc}:\n'
-                + (tmp_path / f'{tag}-r{rank}.log').read_text()[-3000:])
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for log in logs:
-            log.close()
-    with open(out, encoding='utf-8') as f:
-        return json.load(f)
 
 
 @pytest.mark.integration
 def test_two_process_lockstep_matches_single_process(tmp_path):
     # Reference: ONE process, 2 local devices, same tp=2 mesh.
-    ref = _run_selftest(tmp_path, 'single', nprocs=1, devices_per_proc=2)
+    ref = multihost.run_selftest_gang(
+        nprocs=1, devices_per_proc=2,
+        out_path=str(tmp_path / 'single.json'), log_dir=str(tmp_path))
     # System under test: TWO processes, 1 device each, tp=2 global mesh.
-    got = _run_selftest(tmp_path, 'multi', nprocs=2, devices_per_proc=1)
+    got = multihost.run_selftest_gang(
+        nprocs=2, devices_per_proc=1,
+        out_path=str(tmp_path / 'multi.json'), log_dir=str(tmp_path))
 
     assert got['greedy'] == ref['greedy'], (got, ref)
     assert 1 <= len(got['greedy']) <= 6
