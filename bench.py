@@ -254,7 +254,30 @@ def serve_metrics(on_tpu: bool) -> list:
          'value': round(r['decode_tok_per_sec'], 1),
          'unit': 'tok/s/chip', 'vs_baseline': None,
          'best_of': len(runs)},
+        # $/1M generated tokens at the catalog's v5e on-demand chip
+        # price (BASELINE.md primary metric; the reference's whole
+        # pitch is cost). Steady decode rate -> cost of pure
+        # generation; spot would be ~2.3x cheaper.
+        {'metric': 'serve_cost_per_mtok_usd',
+         'value': _cost_per_mtok(r['decode_tok_per_sec_steady']),
+         'unit': 'USD/1M-tok', 'vs_baseline': None,
+         'best_of': len(runs)},
     ]
+
+
+def _cost_per_mtok(tok_per_sec: float,
+                   accelerator: str = 'tpu-v5e-1') -> 'float | None':
+    """Generation cost from the engine's steady decode rate and the
+    catalog's on-demand chip price."""
+    if tok_per_sec <= 0:
+        return None
+    try:
+        from skypilot_tpu import catalog
+        offs = catalog.list_accelerators('gcp').get(accelerator) or []
+        price = min(o.price for o in offs if o.price is not None)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    return round(price / (tok_per_sec * 3600.0) * 1e6, 4)
 
 
 def serve_int8_metric(bf16_steady: float) -> list:
