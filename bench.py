@@ -168,6 +168,29 @@ PEAK_FLOPS = {  # bf16 peak per chip
 }
 
 
+def _reclaim_hbm(tag: str) -> None:
+    """Drop every reclaimable device buffer between bench phases.
+
+    Phases share one process; the 8B int8 phase needs ~10GB of the
+    v5e's 16GB HBM, so a lingering train state (params + Adam moments
+    of the 1.24B model ≈ 12GB) or an un-collected engine from an
+    earlier phase starves it (observed: RESOURCE_EXHAUSTED on the 8B
+    and spec phases after the 1B phases passed). gc drops cycles,
+    clear_caches drops jit executables' tracing residue; the live-bytes
+    print diagnoses what survives if the next phase still OOMs."""
+    import gc
+    gc.collect()
+    jax.clear_caches()
+    gc.collect()
+    try:
+        live = [b for b in jax.live_arrays() if b.size]
+        tot = sum(b.size * b.dtype.itemsize for b in live)
+        print(f'# hbm[{tag}]: {len(live)} live arrays, '
+              f'{tot/1e9:.2f}GB retained', file=sys.stderr)
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
 def _peak_flops(device) -> float:
     kind = getattr(device, 'device_kind', '')
     for prefix, flops in PEAK_FLOPS.items():
@@ -254,15 +277,18 @@ def serve_metrics(on_tpu: bool) -> list:
          'value': round(r['decode_tok_per_sec'], 1),
          'unit': 'tok/s/chip', 'vs_baseline': None,
          'best_of': len(runs)},
+    ] + ([
         # $/1M generated tokens at the catalog's v5e on-demand chip
         # price (BASELINE.md primary metric; the reference's whole
         # pitch is cost). Steady decode rate -> cost of pure
-        # generation; spot would be ~2.3x cheaper.
+        # generation; spot would be ~2.3x cheaper. TPU-only: a v5e
+        # chip price divided by a CPU debug-model rate would be a
+        # fabricated number.
         {'metric': 'serve_cost_per_mtok_usd',
          'value': _cost_per_mtok(r['decode_tok_per_sec_steady']),
          'unit': 'USD/1M-tok', 'vs_baseline': None,
          'best_of': len(runs)},
-    ]
+    ] if on_tpu else [])
 
 
 def _cost_per_mtok(tok_per_sec: float,
@@ -487,17 +513,24 @@ def _run_train(cfg, batch, seq, steps, warmup, dev, windows=1,
     with mesh, nn.logical_axis_rules(list(sharding_lib.DEFAULT_RULES)):
         run = jax.jit(scan_steps, static_argnums=(2,), donate_argnums=(0,))
         state, warm_losses = run(state, jax.random.PRNGKey(1), warmup)
-        jax.block_until_ready(warm_losses)
+        jax.device_get(warm_losses)
         # Best-of-N windows (timeit-style min): the benched chip sits
         # behind a shared dispatch tunnel and single-window step times
         # swing +-30% with co-tenant load; the fastest window is the
         # machine's actual capability, the slower ones measure the
         # neighbors.
+        #
+        # The timed region ends with a VALUE FETCH, not block_until_ready:
+        # on the tunneled axon platform block_until_ready acks at dispatch
+        # (observed: 0.1ms/step "timings" for a 1.24B model, a physically
+        # impossible 2400+ MFU), while device_get cannot return until the
+        # window's last loss — which depends on every step — exists. The
+        # one fetch RTT is amortized across the window's steps.
         dt = float('inf')
         for w in range(max(1, windows)):
             t0 = time.perf_counter()
             state, losses = run(state, jax.random.PRNGKey(2 + w), steps)
-            jax.block_until_ready(losses)
+            losses = jax.device_get(losses)
             dt = min(dt, time.perf_counter() - t0)
     metrics = {'loss': losses[-1]}
 
@@ -517,6 +550,15 @@ def _run_train(cfg, batch, seq, steps, warmup, dev, windows=1,
           f'tokens/sec/chip={tokens_per_sec/mesh.size:,.0f} '
           f'step_time={dt/steps*1000:.1f}ms loss={float(metrics["loss"]):.3f}',
           file=sys.stderr)
+    known_kind = any(getattr(dev, 'device_kind', '').startswith(p)
+                     for p in PEAK_FLOPS)
+    if mfu > 1.2 and known_kind and getattr(dev, 'platform', '') == 'tpu':
+        # A >120% MFU is physically impossible: the timer measured
+        # dispatch, not execution. Fail loudly — a fake headline number
+        # in the bench artifact is worse than an error.
+        raise RuntimeError(
+            f'non-physical MFU {mfu:.2f} — timing measured dispatch, '
+            'not execution; refusing to report it')
     return mfu
 
 
@@ -601,6 +643,8 @@ def main() -> None:
         train_err = repr(e)
         print(f'# train bench failed: {e!r}', file=sys.stderr)
 
+    if on_tpu:
+        _reclaim_hbm('post-train')
     try:
         with phase_deadline(PHASE_DEADLINES['serve bench'], 'serve bench'):
             extra = serve_metrics(on_tpu)
@@ -627,6 +671,7 @@ def main() -> None:
     if on_tpu:
         # 8B int8 single-chip pass (TPU only: an 8B model on the 1-core
         # CPU host would blow the phase budget and the RAM).
+        _reclaim_hbm('pre-8b')
         try:
             with phase_deadline(PHASE_DEADLINES['serve 8b int8 bench'],
                                 'serve 8b int8 bench'):
@@ -639,6 +684,8 @@ def main() -> None:
     # so smoke environments validate the full metric set. Deadline
     # covers TWO engine compiles + 4 passes (double the bf16 serve
     # phase's work — sized accordingly).
+    if on_tpu:
+        _reclaim_hbm('pre-spec')
     try:
         with phase_deadline(PHASE_DEADLINES['serve spec-decode bench'],
                             'serve spec-decode bench'):

@@ -91,8 +91,18 @@ while read -r tid; do
     else
         echo "   PASS" | tee -a "$OUT/tests_tpu.txt"
     fi
-done < <(python -m pytest tests_tpu/ --collect-only -q 2>/dev/null \
-         | grep '::')
+done < <(JAX_PLATFORMS=cpu python -m pytest tests_tpu/ --collect-only -q \
+             2>/dev/null | grep '::' > "$OUT/gate_ids.txt";
+         PROVEN=tools/onchip_r05/proven_tests.txt
+         if [ -f "$PROVEN" ]; then
+             # Unproven tests first: a short window should spend its
+             # minutes on tests that have never passed on-chip, not on
+             # re-proving the ones that already did.
+             grep -vxF -f "$PROVEN" "$OUT/gate_ids.txt" || true
+             grep -xF -f "$PROVEN" "$OUT/gate_ids.txt" || true
+         else
+             cat "$OUT/gate_ids.txt"
+         fi)
 if [ "$GATE_COUNT" -eq 0 ]; then
     # Collection failure/empty suite must not read as a green gate —
     # a vacuous PASS here would green-light flipping kernel defaults.
