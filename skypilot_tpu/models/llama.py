@@ -49,10 +49,20 @@ class LlamaConfig:
     # weight-HBM-bound, so halving the bytes per step is a direct
     # decode-throughput win. Embeddings/norms stay high precision.
     quant: str = 'none'
+    # Family knobs: the reference serves any HF decoder family by
+    # pointing vLLM at the checkpoint (llm/vllm/serve.yaml); this one
+    # module covers the Llama-layout families the same way —
+    # Qwen2(.5) = llama + q/k/v biases; Gemma = GeGLU + zero-centered
+    # RMSNorm + sqrt(dim) embedding scale + decoupled head_dim.
+    attn_bias: bool = False          # Qwen2: bias on q/k/v projections
+    head_dim_override: int = 0       # Gemma: head_dim != dim/n_heads
+    mlp_act: str = 'silu'            # 'silu' | 'gelu_tanh' (Gemma)
+    norm_zero_centered: bool = False  # Gemma: weight applied as (1+w)
+    embed_scale: bool = False        # Gemma: embeddings * sqrt(dim)
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
 
     def num_params(self) -> int:
         """Analytic parameter count (embedding counted once if tied)."""
@@ -60,6 +70,8 @@ class LlamaConfig:
         attn = d * self.n_heads * self.head_dim + \
             2 * d * self.n_kv_heads * self.head_dim + \
             self.n_heads * self.head_dim * d
+        if self.attn_bias:
+            attn += (self.n_heads + 2 * self.n_kv_heads) * self.head_dim
         mlp = 3 * d * self.mlp_dim
         per_layer = attn + mlp + 2 * d
         embeds = v * d * (1 if self.tie_embeddings else 2)
@@ -79,6 +91,33 @@ CONFIGS = {
     'llama3-8b': LlamaConfig(),  # the defaults above are 8B
     'llama3-70b': LlamaConfig(dim=8192, n_layers=80, n_heads=64,
                               n_kv_heads=8, mlp_dim=28672),
+    # Qwen2.5 released shapes (HF Qwen2Config: q/k/v biases, rope 1e6).
+    'qwen2-1.5b': LlamaConfig(vocab_size=151936, dim=1536, n_layers=28,
+                              n_heads=12, n_kv_heads=2, mlp_dim=8960,
+                              max_seq_len=32768, rope_theta=1e6,
+                              use_llama31_rope=False, norm_eps=1e-6,
+                              tie_embeddings=True, attn_bias=True),
+    'qwen2-7b': LlamaConfig(vocab_size=152064, dim=3584, n_layers=28,
+                            n_heads=28, n_kv_heads=4, mlp_dim=18944,
+                            max_seq_len=32768, rope_theta=1e6,
+                            use_llama31_rope=False, norm_eps=1e-6,
+                            attn_bias=True),
+    # Gemma released shapes (HF GemmaConfig: GeGLU, 1+w norms,
+    # sqrt(dim) embed scale, head_dim 256, tied embeddings).
+    'gemma-2b': LlamaConfig(vocab_size=256000, dim=2048, n_layers=18,
+                            n_heads=8, n_kv_heads=1, mlp_dim=16384,
+                            head_dim_override=256, max_seq_len=8192,
+                            rope_theta=10000.0, use_llama31_rope=False,
+                            norm_eps=1e-6, tie_embeddings=True,
+                            mlp_act='gelu_tanh', norm_zero_centered=True,
+                            embed_scale=True),
+    'gemma-7b': LlamaConfig(vocab_size=256000, dim=3072, n_layers=28,
+                            n_heads=16, n_kv_heads=16, mlp_dim=24576,
+                            head_dim_override=256, max_seq_len=8192,
+                            rope_theta=10000.0, use_llama31_rope=False,
+                            norm_eps=1e-6, tie_embeddings=True,
+                            mlp_act='gelu_tanh', norm_zero_centered=True,
+                            embed_scale=True),
 }
 
 
@@ -91,6 +130,7 @@ class QuantDense(nn.Module):
     features: int
     logical_axes: tuple
     dtype: jnp.dtype
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -105,18 +145,32 @@ class QuantDense(nn.Module):
                 nn.initializers.ones_init(), (self.logical_axes[-1],)),
             (self.features,), jnp.float32)
         y = jnp.dot(x, kernel.astype(self.dtype))
-        return y * scale.astype(self.dtype)
+        y = y * scale.astype(self.dtype)
+        if self.use_bias:
+            # Biases are tiny (one row); they stay float, only the
+            # kernel is quantized.
+            bias = self.param(
+                'bias',
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(),
+                    (self.logical_axes[-1],)),
+                (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
 
 
-def _dense(features, logical_axes, name, param_dtype, dtype, quant='none'):
+def _dense(features, logical_axes, name, param_dtype, dtype, quant='none',
+           use_bias=False):
     if quant == 'int8':
         return QuantDense(features=features, logical_axes=logical_axes,
-                          name=name, dtype=dtype)
+                          name=name, dtype=dtype, use_bias=use_bias)
     return nn.Dense(
-        features=features, use_bias=False, name=name,
+        features=features, use_bias=use_bias, name=name,
         dtype=dtype, param_dtype=param_dtype,
         kernel_init=nn.with_logical_partitioning(
-            nn.initializers.lecun_normal(), logical_axes))
+            nn.initializers.lecun_normal(), logical_axes),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (logical_axes[-1],)))
 
 
 class LlamaAttention(nn.Module):
@@ -135,11 +189,14 @@ class LlamaAttention(nn.Module):
         b, s, _ = x.shape
 
         q = _dense(h * hd, ('embed', 'heads'), 'wq', cfg.param_dtype,
-                   dtype, cfg.quant)(x).reshape(b, s, h, hd)
+                   dtype, cfg.quant,
+                   use_bias=cfg.attn_bias)(x).reshape(b, s, h, hd)
         k = _dense(hk * hd, ('embed', 'kv_heads'), 'wk', cfg.param_dtype,
-                   dtype, cfg.quant)(x).reshape(b, s, hk, hd)
+                   dtype, cfg.quant,
+                   use_bias=cfg.attn_bias)(x).reshape(b, s, hk, hd)
         v = _dense(hk * hd, ('embed', 'kv_heads'), 'wv', cfg.param_dtype,
-                   dtype, cfg.quant)(x).reshape(b, s, hk, hd)
+                   dtype, cfg.quant,
+                   use_bias=cfg.attn_bias)(x).reshape(b, s, hk, hd)
 
         q = rope.apply_rope(q, cos, sin)
         k = rope.apply_rope(k, cos, sin)
@@ -262,7 +319,12 @@ class LlamaMLP(nn.Module):
                       cfg.param_dtype, dtype, cfg.quant)(x)
         up = _dense(cfg.mlp_dim, ('embed', 'mlp'), 'w_up',
                     cfg.param_dtype, dtype, cfg.quant)(x)
-        hidden = nn.silu(gate) * up
+        if cfg.mlp_act == 'silu':
+            hidden = nn.silu(gate) * up
+        elif cfg.mlp_act == 'gelu_tanh':   # Gemma GeGLU (tanh approx)
+            hidden = nn.gelu(gate, approximate=True) * up
+        else:
+            raise ValueError(f'unknown mlp_act {cfg.mlp_act!r}')
         hidden = nn.with_logical_constraint(
             hidden, ('act_batch', 'act_seq', 'act_mlp'))
         out = _dense(cfg.dim, ('mlp', 'embed'), 'w_down',
@@ -277,12 +339,16 @@ class RMSNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # Zero-centered (Gemma) stores w and applies (1+w): identity at
+        # init is w=0, so the init must flip with the convention.
+        init = (nn.initializers.zeros_init()
+                if self.cfg.norm_zero_centered else nn.initializers.ones)
         w = self.param(
             'weight',
-            nn.with_logical_partitioning(nn.initializers.ones,
-                                         (self.axis_name,)),
+            nn.with_logical_partitioning(init, (self.axis_name,)),
             (x.shape[-1],), jnp.dtype(self.cfg.param_dtype))
-        return norms.rms_norm(x, w, eps=self.cfg.norm_eps)
+        return norms.rms_norm(x, w, eps=self.cfg.norm_eps,
+                              zero_centered=self.cfg.norm_zero_centered)
 
 
 class LlamaBlock(nn.Module):
@@ -331,6 +397,10 @@ class LlamaModel(nn.Module):
                 nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
             (cfg.vocab_size, cfg.dim), jnp.dtype(cfg.param_dtype))
         x = embed.astype(dtype)[tokens]
+        if cfg.embed_scale:
+            # Gemma scales embeddings by sqrt(dim); HF rounds the
+            # normalizer to the compute dtype first — match that.
+            x = x * jnp.asarray(cfg.dim ** 0.5, dtype)
         x = nn.with_logical_constraint(
             x, ('act_batch', 'act_seq', 'act_embed'))
 

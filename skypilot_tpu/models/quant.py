@@ -77,11 +77,18 @@ def quantize_params(params: Any) -> Any:
 
     def walk(node):
         if isinstance(node, dict):
-            # QuantDense projection scope: exactly {'kernel': w}.
-            if set(node) == {_KERNEL_KEY} and \
+            # QuantDense projection scope: {'kernel': w} plus an
+            # optional 'bias' (Qwen2 q/k/v projections). The kernel is
+            # quantized; the bias stays float and rides along — same
+            # layout QuantDense(use_bias=True) expects.
+            if set(node) <= {_KERNEL_KEY, 'bias'} and \
+                    _KERNEL_KEY in node and \
                     quantizable(node[_KERNEL_KEY]):
                 k, s = convert(node[_KERNEL_KEY])
-                return {_KERNEL_KEY: k, 'scale': s}
+                out = {_KERNEL_KEY: k, 'scale': s}
+                if 'bias' in node:
+                    out['bias'] = node['bias']
+                return out
             # MoeMLP scope: expert einsum weights next to the router
             # (which stays float — tiny and routing-quality-critical).
             if 'router' in node and \

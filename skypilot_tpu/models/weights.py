@@ -44,11 +44,26 @@ _LAYER_MAP = {
     ('mlp', 'w_down', 'kernel'): ('mlp.down_proj.weight', True),
 }
 
+# Qwen2-family checkpoints add biases on the q/k/v projections only
+# (HF Qwen2Attention); merged into the layer map when cfg.attn_bias.
+_ATTN_BIAS_MAP = {
+    ('attn', 'wq', 'bias'): ('self_attn.q_proj.bias', False),
+    ('attn', 'wk', 'bias'): ('self_attn.k_proj.bias', False),
+    ('attn', 'wv', 'bias'): ('self_attn.v_proj.bias', False),
+}
+
 _TOP_MAP = {
     ('tok_embed',): ('model.embed_tokens.weight', False),
     ('final_norm', 'weight'): ('model.norm.weight', False),
     ('lm_head', 'kernel'): ('lm_head.weight', True),
 }
+
+
+def _layer_map(cfg) -> Dict[tuple, tuple]:
+    m = dict(_LAYER_MAP)
+    if getattr(cfg, 'attn_bias', False):
+        m.update(_ATTN_BIAS_MAP)
+    return m
 
 
 class _ShardReader:
@@ -195,7 +210,7 @@ def load_llama_params(cfg, ckpt_dir: str, *,
                 continue
         assemble(path, hf_name, transpose)
 
-    for path, (suffix, transpose) in _LAYER_MAP.items():
+    for path, (suffix, transpose) in _layer_map(cfg).items():
         if cfg.scan_layers:
             per_layer = [
                 reader.get(f'model.layers.{i}.{suffix}')
@@ -440,9 +455,11 @@ def save_hf_checkpoint(cfg, variables: Dict[str, Any],
         if arr is None:
             continue
         out[hf_name] = arr.T if transpose else arr
-    for path, (suffix, transpose) in _LAYER_MAP.items():
+    for path, (suffix, transpose) in _layer_map(cfg).items():
         if cfg.scan_layers:
             stacked = grab(('layers',) + path)
+            if stacked is None:
+                continue
             for i in range(cfg.n_layers):
                 arr = stacked[i]
                 out[f'model.layers.{i}.{suffix}'] = (
@@ -450,6 +467,8 @@ def save_hf_checkpoint(cfg, variables: Dict[str, Any],
         else:
             for i in range(cfg.n_layers):
                 arr = grab((f'layer_{i}',) + path)
+                if arr is None:
+                    continue
                 out[f'model.layers.{i}.{suffix}'] = (
                     arr.T if transpose else arr)
 
@@ -486,9 +505,16 @@ def shard_params(variables: Dict[str, Any], model, cfg, mesh,
 
 
 def config_from_hf(hf_config: Dict[str, Any], **overrides):
-    """HF config.json dict -> LlamaConfig."""
+    """HF config.json dict -> LlamaConfig.
+
+    Family dispatch mirrors what vLLM does for the reference
+    (llm/vllm/serve.yaml accepts any HF model id): model_type 'llama'
+    maps 1:1; 'qwen2' adds the q/k/v biases; 'gemma' adds GeGLU,
+    zero-centered norms, the sqrt(dim) embedding scale, a decoupled
+    head_dim, and tied embeddings (the HF GemmaConfig defaults)."""
     from skypilot_tpu.models import llama as llama_lib
 
+    model_type = hf_config.get('model_type', 'llama')
     rope_scaling = hf_config.get('rope_scaling') or {}
     kw = dict(
         vocab_size=hf_config['vocab_size'],
@@ -504,16 +530,37 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
         norm_eps=hf_config.get('rms_norm_eps', 1e-5),
         tie_embeddings=hf_config.get('tie_word_embeddings', False),
     )
+    if model_type == 'qwen2':
+        # HF Qwen2Attention hardcodes q/k/v biases (no config field).
+        kw['attn_bias'] = True
+    elif model_type == 'gemma':
+        kw['mlp_act'] = 'gelu_tanh'
+        kw['norm_zero_centered'] = True
+        kw['embed_scale'] = True
+        kw['tie_embeddings'] = hf_config.get('tie_word_embeddings', True)
+    head_dim = hf_config.get('head_dim') or 0
+    if head_dim and head_dim != kw['dim'] // kw['n_heads']:
+        kw['head_dim_override'] = head_dim
     kw.update(overrides)
     return llama_lib.LlamaConfig(**kw)
 
 
 def config_to_hf(cfg) -> Dict[str, Any]:
     """LlamaConfig -> HF config.json dict (what save_hf_checkpoint
-    writes; enough for transformers.LlamaForCausalLM to reload)."""
+    writes; enough for transformers' matching *ForCausalLM to reload).
+
+    The family is recovered from the knobs: attn_bias -> qwen2,
+    norm_zero_centered -> gemma, else llama (the inverse of
+    config_from_hf's dispatch)."""
+    if cfg.norm_zero_centered:
+        model_type, arch = 'gemma', 'GemmaForCausalLM'
+    elif cfg.attn_bias:
+        model_type, arch = 'qwen2', 'Qwen2ForCausalLM'
+    else:
+        model_type, arch = 'llama', 'LlamaForCausalLM'
     out = {
-        'architectures': ['LlamaForCausalLM'],
-        'model_type': 'llama',
+        'architectures': [arch],
+        'model_type': model_type,
         'vocab_size': cfg.vocab_size,
         'hidden_size': cfg.dim,
         'num_hidden_layers': cfg.n_layers,
@@ -525,9 +572,13 @@ def config_to_hf(cfg) -> Dict[str, Any]:
         'rms_norm_eps': cfg.norm_eps,
         'tie_word_embeddings': cfg.tie_embeddings,
         'head_dim': cfg.head_dim,
-        'hidden_act': 'silu',
+        'hidden_act': ('gelu_pytorch_tanh'
+                       if cfg.mlp_act == 'gelu_tanh' else 'silu'),
         'torch_dtype': 'float32',
     }
+    if model_type == 'gemma':
+        # GemmaConfig reads 'hidden_activation' (hidden_act is legacy).
+        out['hidden_activation'] = out['hidden_act']
     if cfg.use_llama31_rope:
         out['rope_scaling'] = {
             'rope_type': 'llama3', 'factor': 8.0,
