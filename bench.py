@@ -41,6 +41,7 @@ PHASE_DEADLINES = {
     'train bench': 1200,
     'serve bench': 900,
     'serve int8 bench': 600,
+    'serve int4 bench': 600,
     'serve spec-decode bench': 1800,
     'serve 8b int8 bench': 900,
 }
@@ -322,6 +323,24 @@ def serve_int8_metric(bf16_steady: float) -> list:
          # speedup vs the bf16 engine; None when the bf16 phase
          # produced no number (a ratio against a floor is nonsense)
          'vs_baseline': (round(int8_steady / bf16_steady, 4)
+                         if bf16_steady > 0 else None),
+         'best_of': len(qruns)},
+    ]
+
+
+def serve_int4_metric(bf16_steady: float) -> list:
+    """int4 (w4a16, group-128) pass: quarter the weight bytes per
+    decode step. Beyond the reference's stack — vLLM needs a
+    pre-quantized AWQ/GPTQ checkpoint for w4; here any float model
+    stream-quantizes at load (models/quant.py)."""
+    qruns = _best_of_serve_runs(_tpu_serve_cfg(), quantize='int4')
+    int4_steady = max(x['decode_tok_per_sec_steady'] for x in qruns)
+    print(f'# serve int4: decode_steady={int4_steady:,.0f} tok/s',
+          file=sys.stderr)
+    return [
+        {'metric': 'serve_decode_steady_tok_per_sec_per_chip_int4',
+         'value': round(int4_steady, 1), 'unit': 'tok/s/chip',
+         'vs_baseline': (round(int4_steady / bf16_steady, 4)
                          if bf16_steady > 0 else None),
          'best_of': len(qruns)},
     ]
@@ -667,6 +686,14 @@ def main() -> None:
             partial['extra'] = extra
         except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
             print(f'# serve int8 bench failed: {e!r}', file=sys.stderr)
+        _reclaim_hbm('pre-int4')
+        try:
+            with phase_deadline(PHASE_DEADLINES['serve int4 bench'],
+                                'serve int4 bench'):
+                extra = extra + serve_int4_metric(bf16_steady)
+            partial['extra'] = extra
+        except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+            print(f'# serve int4 bench failed: {e!r}', file=sys.stderr)
 
     if on_tpu:
         # 8B int8 single-chip pass (TPU only: an 8B model on the 1-core

@@ -696,8 +696,8 @@ def build_engine(model_name: Optional[str] = None,
     already_quantized = False
     if checkpoint:
         from skypilot_tpu.models import weights as weights_lib
-        qmode = 'int8' if quantize == 'int8' else 'none'
-        # int8: stream-quantize each tensor on host during load so the
+        qmode = quantize if quantize in ('int8', 'int4') else 'none'
+        # int8/int4: stream-quantize each tensor on host during load so the
         # bf16 tree is never resident in HBM (8B fits one 16GB chip).
         if weights_lib.checkpoint_model_type(checkpoint) == 'mixtral':
             from skypilot_tpu.models import moe
@@ -721,7 +721,7 @@ def build_engine(model_name: Optional[str] = None,
             model = make_model(cfg)
             params = weights_lib.load_llama_params(
                 cfg, checkpoint, mesh=mesh, quantize=qmode)
-        already_quantized = quantize == 'int8'
+        already_quantized = qmode != 'none'
     else:
         from skypilot_tpu.models import moe
         name = model_name or 'debug'
@@ -743,7 +743,7 @@ def build_engine(model_name: Optional[str] = None,
                           max_seq_len=min(cfg.max_seq_len, max_seq_len))
         model = make_model(cfg)
         sample = jnp.zeros((1, 8), jnp.int32)
-        if quantize == 'int8' and mesh is None:
+        if quantize in ('int8', 'int4') and mesh is None:
             # Fused init+quantize inside ONE jit: XLA frees each bf16
             # kernel right after its int8 copy is formed, so the full
             # bf16 tree (2x the int8 bytes) is never resident at once —
@@ -751,21 +751,24 @@ def build_engine(model_name: Optional[str] = None,
             # 16GB v5e chip (weights ~8.5GB int8 vs ~16GB bf16).
             from skypilot_tpu.models import quant as quant_lib
             params = jax.jit(lambda k: quant_lib.quantize_params(
-                model.init(k, sample)))(jax.random.PRNGKey(0))
+                model.init(k, sample),
+                mode=quantize))(jax.random.PRNGKey(0))
             already_quantized = True
         else:
             params = jax.jit(model.init)(jax.random.PRNGKey(0), sample)
         if mesh is not None:
             from skypilot_tpu.models import weights as weights_lib
             params = weights_lib.shard_params(params, model, cfg, mesh)
-    if quantize == 'int8':
-        # Weight-only int8: halve the HBM bytes every decode step
-        # streams (models/quant.py). Covers llama projections AND MoE
-        # expert weights (routers stay float).
+    if quantize in ('int8', 'int4'):
+        # Weight-only quantization: halve (int8) or quarter (int4) the
+        # HBM bytes every decode step streams (models/quant.py). int8
+        # covers llama projections AND MoE expert weights (routers stay
+        # float); int4 is llama-family only (quantize_params raises on
+        # a MoE tree).
         from skypilot_tpu.models import quant as quant_lib
         if not already_quantized:
-            params = quant_lib.quantize_params(params)
-        cfg = _dc.replace(cfg, quant='int8')
+            params = quant_lib.quantize_params(params, mode=quantize)
+        cfg = _dc.replace(cfg, quant=quantize)
         model = make_model(cfg)
     elif quantize != 'none':
         raise ValueError(f'unknown quantize mode {quantize!r}')
@@ -862,9 +865,11 @@ def main(argv=None) -> None:
                              'self-draft with the target (mechanism '
                              'check; no speedup)')
     parser.add_argument('--quantize', default='none',
-                        choices=['none', 'int8'],
-                        help='weight-only quantization (int8 = w8a16; '
-                             'halves decode HBM traffic)')
+                        choices=['none', 'int8', 'int4'],
+                        help='weight-only quantization (int8 = w8a16 '
+                             'halves decode HBM traffic; int4 = w4a16 '
+                             'group-128 scales, quarters it — '
+                             'llama-family only)')
     parser.add_argument('--prefill-chunk', type=int, default=0,
                         help='chunked prefill: long prompts prefill in '
                              'chunks of this many tokens, interleaved '

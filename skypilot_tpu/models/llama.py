@@ -43,11 +43,13 @@ class LlamaConfig:
     scan_layers: bool = True
     attn_impl: str = 'auto'          # 'auto' | 'flash' | 'xla' | 'ring'
     tie_embeddings: bool = False
-    # Weight-only quantization for serving: 'none' | 'int8'. int8 stores
-    # every projection kernel as int8 + per-output-channel scales
+    # Weight-only quantization for serving: 'none' | 'int8' | 'int4'.
+    # int8 stores every projection kernel as int8 + per-output-channel
+    # scales; int4 stores group-wise (G=128) scales
     # (models/quant.py quantize_params converts a float tree); decode is
-    # weight-HBM-bound, so halving the bytes per step is a direct
-    # decode-throughput win. Embeddings/norms stay high precision.
+    # weight-HBM-bound, so halving (int8) or quartering (int4) the
+    # bytes per step is a direct decode-throughput win.
+    # Embeddings/norms stay high precision.
     quant: str = 'none'
     # Family knobs: the reference serves any HF decoder family by
     # pointing vLLM at the checkpoint (llm/vllm/serve.yaml); this one
@@ -102,6 +104,13 @@ CONFIGS = {
                             max_seq_len=32768, rope_theta=1e6,
                             use_llama31_rope=False, norm_eps=1e-6,
                             attn_bias=True),
+    # Mistral-7B-v0.1/0.2 shape (HF MistralConfig): architecturally
+    # llama; max_seq_len capped at the 4096 sliding window (weights.py
+    # clamps checkpoint configs the same way).
+    'mistral-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, mlp_dim=14336,
+                              max_seq_len=4096, rope_theta=10000.0,
+                              use_llama31_rope=False, norm_eps=1e-6),
     # Gemma released shapes (HF GemmaConfig: GeGLU, 1+w norms,
     # sqrt(dim) embed scale, head_dim 256, tied embeddings).
     'gemma-2b': LlamaConfig(vocab_size=256000, dim=2048, n_layers=18,
@@ -159,11 +168,71 @@ class QuantDense(nn.Module):
         return y
 
 
+class QuantDense4(nn.Module):
+    """Weight-only int4 linear: kernel int4 [in, out] + group-wise
+    float scales [in/G, out] (G = quant.INT4_GROUP along `in`).
+
+    y = sum_g (x_g @ k4_g) * s_g. Each group dot runs in the compute
+    dtype (inside a dot the MXU accumulates bf16 products in f32
+    natively); the cross-group scale-multiply + sum runs in f32 with
+    one final rounding, so the n_g-way accumulation cannot drift in
+    bf16 — near the error profile of a single f32-accumulated dot over
+    the dequantized kernel (pinned by test at f32 and bf16), while the
+    HBM read is a quarter of bf16. The per-group contraction is
+    [.., G] x [G, out] with G=128, a clean MXU tile."""
+    features: int
+    logical_axes: tuple
+    dtype: jnp.dtype
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from skypilot_tpu.models import quant as quant_lib
+        din = x.shape[-1]
+        g = quant_lib.int4_group_size(din)
+        n_g = din // g
+        kernel = self.param(
+            'kernel',
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), self.logical_axes),
+            (din, self.features), jnp.int4)
+        # Group axis unnamed: scales replicate across an in-sharded
+        # kernel (~0.4% of the kernel bytes) — always correct, and
+        # avoids indivisible tiny group counts on small models.
+        scale = self.param(
+            'scale',
+            nn.with_logical_partitioning(
+                nn.initializers.ones_init(),
+                (None, self.logical_axes[-1])),
+            (n_g, self.features), jnp.float32)
+        xg = x.reshape(*x.shape[:-1], n_g, g)
+        kg = kernel.astype(self.dtype).reshape(n_g, g, self.features)
+        # Each group dot runs in the compute dtype (the MXU accumulates
+        # bf16 products in f32 inside a dot anyway); the cross-group
+        # scale-multiply + sum runs in f32 so n_g-way accumulation
+        # cannot drift in bf16 — one final rounding at the end.
+        partial = jnp.einsum('...gi,gio->...go', xg, kg)
+        y = (partial.astype(jnp.float32) * scale).sum(
+            axis=-2).astype(self.dtype)
+        if self.use_bias:
+            bias = self.param(
+                'bias',
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(),
+                    (self.logical_axes[-1],)),
+                (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 def _dense(features, logical_axes, name, param_dtype, dtype, quant='none',
            use_bias=False):
     if quant == 'int8':
         return QuantDense(features=features, logical_axes=logical_axes,
                           name=name, dtype=dtype, use_bias=use_bias)
+    if quant == 'int4':
+        return QuantDense4(features=features, logical_axes=logical_axes,
+                           name=name, dtype=dtype, use_bias=use_bias)
     return nn.Dense(
         features=features, use_bias=use_bias, name=name,
         dtype=dtype, param_dtype=param_dtype,

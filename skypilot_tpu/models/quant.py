@@ -1,14 +1,18 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8/int4 quantization for serving.
 
 Converts a float Llama/Mixtral param tree into the layout
-`QuantDense` (models/llama.py) expects: every projection `kernel`
-becomes int8 with a per-output-channel symmetric `scale`
-(w ≈ int8 * scale). Decode streams the full weights from HBM every
-step, so int8 halves the bytes — the standard TPU serving quantization
-(the reference gets w8a16 from vLLM flags; here it is first-class).
+`QuantDense`/`QuantDense4` (models/llama.py) expect: every projection
+`kernel` becomes int8 with a per-output-channel symmetric `scale`
+(w ≈ int8 * scale), or int4 with group-wise (G=128 along `in`) scales.
+Decode streams the full weights from HBM every step, so int8 halves
+the bytes and int4 quarters them — w8a16 is what the reference gets
+from vLLM flags; w4a16 goes beyond it (vLLM needs a pre-quantized
+AWQ/GPTQ checkpoint; here any float checkpoint stream-quantizes at
+load).
 
 Embeddings (gathers, quality-sensitive) and norm scales are left in
 their original dtype; `lm_head` is quantized like any projection.
+MoE expert weights are int8-only.
 """
 from typing import Any, Dict
 
@@ -39,19 +43,62 @@ def _quantize_kernel(w: jax.Array) -> Dict[str, jax.Array]:
     return {_KERNEL_KEY: q, 'scale': scale}
 
 
-def quantize_params(params: Any) -> Any:
+# int4 group size along the `in` axis. 128 is the standard w4 grouping
+# (GPTQ/AWQ convention): small enough that one outlier only poisons 128
+# weights' scale, large enough that scales add <7% to the kernel bytes.
+# It also matches the MXU tile, so the grouped matmul in QuantDense4
+# runs as clean [.., 128] x [128, out] batched contractions.
+INT4_GROUP = 128
+
+
+def int4_group_size(din: int, group: int = INT4_GROUP) -> int:
+    """Group size actually used for an `in` dim: the standard group when
+    it divides evenly, else one group spanning the whole axis (debug
+    models with din < 128). MUST match between the module
+    (llama.QuantDense4), this quantizer, and the host-side stream
+    quantizer (weights._np_quantize_kernel_int4)."""
+    return group if din >= group and din % group == 0 else din
+
+
+def _quantize_kernel_int4(w: jax.Array) -> Dict[str, jax.Array]:
+    """w [..., in, out] float -> {'kernel': int4, 'scale':
+    f32[..., in/G, out]} with symmetric per-(group, out-channel) scales
+    (range ±7; the int4 -8 code is unused so the scheme stays
+    symmetric)."""
+    *lead, din, dout = w.shape
+    g = int4_group_size(din)
+    n_g = din // g
+    wf = w.astype(jnp.float32).reshape(*lead, n_g, g, dout)
+    amax = jnp.max(jnp.abs(wf), axis=-2)            # [..., n_g, out]
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -7, 7)
+    q = q.astype(jnp.int4).reshape(*lead, din, dout)
+    return {_KERNEL_KEY: q, 'scale': scale}
+
+
+def quantize_params(params: Any, mode: str = 'int8') -> Any:
     """Quantize every projection kernel in a float param tree.
 
     Input: the `{'params': ...}` variables dict (or the inner params
     dict) from a float model; output has the same structure with each
-    `{'kernel': float[..., in, out]}` dict gaining int8 kernel + scale —
-    exactly the tree a `quant='int8'` model's init produces, so
-    sharding-spec derivation and `model.apply` work unchanged.
+    `{'kernel': float[..., in, out]}` dict gaining the quantized kernel
+    + scale — exactly the tree a `quant=<mode>` model's init produces,
+    so sharding-spec derivation and `model.apply` work unchanged.
+
+    mode='int4' uses group-wise scales (scale [..., in/G, out]; the
+    group axis keeps no logical name — scales are replicated across an
+    `in`-sharded kernel, which is always correct and costs ~0.4% of the
+    kernel bytes). MoE expert weights are int8-only.
     """
 
     import dataclasses
 
     import flax.linen as nn
+
+    if mode not in ('int8', 'int4'):
+        raise ValueError(f'unknown quantize mode {mode!r}')
+    kernel_fn = _quantize_kernel if mode == 'int8' else \
+        _quantize_kernel_int4
 
     def quantizable(box):
         # init() leaves are nn.LogicallyPartitioned boxes (the
@@ -62,17 +109,20 @@ def quantize_params(params: Any) -> Any:
                 and jnp.issubdtype(w.dtype, jnp.floating))
 
     def convert(box):
-        """-> (quantized kernel, scale), boxed like the input. The
-        scale drops only the `in` axis name: scan-stacked kernels are
-        ('layers', ..., in, out) -> scale ('layers', ..., out)."""
+        """-> (quantized kernel, scale), boxed like the input. int8
+        scales drop the `in` axis name (('layers', ..., in, out) ->
+        ('layers', ..., out)); int4 scales replace it with an unnamed
+        group axis (-> ('layers', ..., None, out))."""
         if isinstance(box, nn.meta.AxisMetadata):
-            qd = _quantize_kernel(box.unbox())
+            qd = kernel_fn(box.unbox())
             names = tuple(box.names)
+            scale_names = (names[:-2] + (None, names[-1])
+                           if mode == 'int4'
+                           else names[:-2] + (names[-1],))
             return (box.replace_boxed(qd[_KERNEL_KEY]),
                     dataclasses.replace(box, value=qd['scale'],
-                                        names=names[:-2] +
-                                        (names[-1],)))
-        qd = _quantize_kernel(box)
+                                        names=scale_names))
+        qd = kernel_fn(box)
         return qd[_KERNEL_KEY], qd['scale']
 
     def walk(node):
@@ -93,6 +143,10 @@ def quantize_params(params: Any) -> Any:
             # (which stays float — tiny and routing-quality-critical).
             if 'router' in node and \
                     any(k in node for k in _MOE_EXPERT_KEYS):
+                if mode == 'int4':
+                    raise NotImplementedError(
+                        'int4 is llama-family only; MoE expert '
+                        'weights support int8')
                 out = {}
                 for k, v in node.items():
                     if k in _MOE_EXPERT_KEYS and quantizable(v):
@@ -118,3 +172,14 @@ def dequantize_kernel(q: jax.Array, scale: jax.Array,
                       dtype=jnp.float32) -> jax.Array:
     """Inverse transform (tests / export)."""
     return (q.astype(jnp.float32) * scale[..., None, :]).astype(dtype)
+
+
+def dequantize_kernel_int4(q: jax.Array, scale: jax.Array,
+                           dtype=jnp.float32) -> jax.Array:
+    """Inverse of _quantize_kernel_int4: q [..., in, out] int4 + scale
+    [..., in/G, out] -> float [..., in, out]."""
+    *lead, din, dout = q.shape
+    n_g = scale.shape[-2]
+    qf = q.astype(jnp.float32).reshape(*lead, n_g, din // n_g, dout)
+    return (qf * scale[..., None, :]).reshape(*lead, din,
+                                              dout).astype(dtype)

@@ -121,6 +121,24 @@ def _np_quantize_kernel(arr: np.ndarray) -> 'tuple[np.ndarray, np.ndarray]':
     return q, scale
 
 
+def _np_quantize_kernel_int4(
+        arr: np.ndarray) -> 'tuple[np.ndarray, np.ndarray]':
+    """Host-side mirror of models/quant.py _quantize_kernel_int4
+    (group-wise G=128 along `in`, symmetric ±7)."""
+    import ml_dtypes
+
+    from skypilot_tpu.models import quant as quant_lib
+    *lead, din, dout = arr.shape
+    g = quant_lib.int4_group_size(din)
+    n_g = din // g
+    wf = arr.astype(np.float32).reshape(*lead, n_g, g, dout)
+    amax = np.max(np.abs(wf), axis=-2)
+    scale = np.where(amax > 0, amax / 7.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(wf / scale[..., None, :]), -7, 7)
+    q = q.astype(ml_dtypes.int4).reshape(*lead, din, dout)
+    return q, scale
+
+
 def _resolve_dtype(cfg, param_dtype: Optional[str]):
     target = param_dtype or cfg.param_dtype
     if target == 'bfloat16':
@@ -137,10 +155,17 @@ def _make_store(params: Dict[str, Any], put, quantize: str, dtype):
     f32 scale ON HOST; expert_weight=True uses the MoeMLP sibling-key
     convention ('<name>' + '<name>_scale')."""
     def store(path: tuple, arr: np.ndarray, expert_weight=False):
-        if quantize == 'int8' and (expert_weight
-                                   or (path[-1] == 'kernel'
-                                       and arr.ndim >= 2)):
-            q, scale = _np_quantize_kernel(arr)
+        if quantize in ('int8', 'int4') and \
+                (expert_weight or (path[-1] == 'kernel'
+                                   and arr.ndim >= 2)):
+            if quantize == 'int4':
+                if expert_weight:
+                    raise NotImplementedError(
+                        'int4 is llama-family only; MoE expert '
+                        'weights support int8')
+                q, scale = _np_quantize_kernel_int4(arr)
+            else:
+                q, scale = _np_quantize_kernel(arr)
             spath = (path[:-1] + (f'{path[-1]}_scale',) if expert_weight
                      else path[:-1] + ('scale',))
             _set_at(params, path, put(path, q))
@@ -171,7 +196,7 @@ def load_llama_params(cfg, ckpt_dir: str, *,
     """
     from skypilot_tpu.models import llama as llama_lib
 
-    if quantize not in ('none', 'int8'):
+    if quantize not in ('none', 'int8', 'int4'):
         raise ValueError(f'unknown quantize mode {quantize!r}')
     dtype = _resolve_dtype(cfg, param_dtype)
 
@@ -179,8 +204,8 @@ def load_llama_params(cfg, ckpt_dir: str, *,
     shardings = None
     if mesh is not None:
         import dataclasses as _dc
-        scfg = _dc.replace(cfg, quant='int8') if quantize == 'int8' \
-            else cfg
+        scfg = cfg if quantize == 'none' \
+            else _dc.replace(cfg, quant=quantize)
         model = llama_lib.LlamaModel(scfg)
         shardings = param_shardings(model, scfg, mesh, rules)
 
@@ -283,6 +308,9 @@ def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
     """
     from skypilot_tpu.models import moe as moe_lib
 
+    if quantize == 'int4':
+        raise NotImplementedError(
+            'int4 is llama-family only; MoE expert weights support int8')
     if quantize not in ('none', 'int8'):
         raise ValueError(f'unknown quantize mode {quantize!r}')
     dtype = _resolve_dtype(cfg, param_dtype)
@@ -291,8 +319,8 @@ def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
     shardings = None
     if mesh is not None:
         import dataclasses as _dc
-        scfg = _dc.replace(cfg, quant='int8') if quantize == 'int8' \
-            else cfg
+        scfg = cfg if quantize == 'none' \
+            else _dc.replace(cfg, quant=quantize)
         model = moe_lib.MixtralModel(scfg, moe_cfg)
         shardings = param_shardings(model, scfg, mesh, rules)
 
@@ -533,6 +561,18 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
     if model_type == 'qwen2':
         # HF Qwen2Attention hardcodes q/k/v biases (no config field).
         kw['attn_bias'] = True
+    elif model_type == 'mistral':
+        # Architecturally llama; beyond the sliding window our dense
+        # attention diverges from HF's windowed mask, so clamp honestly
+        # rather than serve silently-different logits at long context.
+        window = hf_config.get('sliding_window')
+        if window and window < kw['max_seq_len']:
+            logger.warning(
+                'mistral sliding_window=%d < max_position_embeddings=%d:'
+                ' clamping max_seq_len to the window (windowed attention'
+                ' is not implemented; within the window the math is'
+                ' identical)', window, kw['max_seq_len'])
+            kw['max_seq_len'] = window
     elif model_type == 'gemma':
         kw['mlp_act'] = 'gelu_tanh'
         kw['norm_zero_centered'] = True

@@ -551,3 +551,45 @@ def test_qwen2_int8_stream_load_matches_post_quantize(tmp_path):
     # All 7 scan-stacked projections (wq/wk/wv/wo + gate/up/down) plus
     # lm_head went int8 despite the q/k/v biases in the same scopes.
     assert n_int8 == 8
+
+
+def test_mistral_checkpoint_dispatch(tmp_path):
+    """model_type=mistral loads through the llama path (identical math
+    within the sliding window), max_seq_len clamps to the window, and
+    logits match transformers' MistralForCausalLM."""
+    torch = pytest.importorskip('torch')
+    transformers = pytest.importorskip('transformers')
+
+    cfg = dataclasses.replace(llama.CONFIGS['debug'], max_seq_len=64,
+                              norm_eps=1e-6, rope_theta=10000.0)
+    model = llama.LlamaModel(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(5),
+                                 jnp.zeros((1, 8), jnp.int32))
+    weights.save_hf_checkpoint(cfg, params, str(tmp_path))
+    # Rewrite the config as a Mistral checkpoint with a sliding window
+    # smaller than max_position_embeddings.
+    cfg_path = tmp_path / 'config.json'
+    hf_cfg = json.loads(cfg_path.read_text())
+    hf_cfg.update(model_type='mistral',
+                  architectures=['MistralForCausalLM'],
+                  sliding_window=32)
+    cfg_path.write_text(json.dumps(hf_cfg))
+
+    cfg2 = weights.load_config(str(tmp_path), dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype,
+                               remat=False)
+    assert cfg2.max_seq_len == 32  # clamped to the window
+    loaded = weights.load_llama_params(cfg2, str(tmp_path))
+
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path), torch_dtype=torch.float32)
+    assert type(hf_model).__name__ == 'MistralForCausalLM'
+    hf_model.eval()
+    tokens = np.random.default_rng(4).integers(0, cfg.vocab_size,
+                                               (2, 12))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        llama.LlamaModel(cfg2).apply(loaded,
+                                     jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)

@@ -201,3 +201,166 @@ def test_fused_init_quantize_matches_sequential():
             np.testing.assert_allclose(a.astype(np.float32),
                                        b.astype(np.float32),
                                        rtol=1e-5, atol=1e-8)
+
+
+# -------------------------------------------------------------- int4
+# w4a16 goes beyond the reference's serving stack: vLLM needs a
+# pre-quantized AWQ/GPTQ checkpoint, here any float checkpoint (or
+# init) stream-quantizes to int4 group-128 at load.
+
+def test_int4_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(3, 256, 8)), jnp.float32)
+    qd = quant._quantize_kernel_int4(w)
+    assert qd['kernel'].dtype == jnp.int4
+    assert qd['scale'].shape == (3, 2, 8)  # 256 / G=128 -> 2 groups
+    back = quant.dequantize_kernel_int4(qd['kernel'], qd['scale'])
+    err = np.abs(np.asarray(back - w))
+    bound = np.repeat(np.asarray(qd['scale']), 128, axis=-2) / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_int4_dense_matches_dequantized_matmul():
+    """QuantDense4's grouped contraction == x @ dequantize(kernel) —
+    the scale is constant within a group, so factoring it out of the
+    per-group matmul is exact (up to float assoc., tested tight)."""
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(256, 64)), jnp.float32)
+    qd = quant._quantize_kernel_int4(w)
+    x = jnp.asarray(rng.normal(size=(4, 256)), jnp.float32)
+
+    mod = llama.QuantDense4(features=64, logical_axes=('embed', 'mlp'),
+                            dtype=jnp.float32)
+    variables = {'params': {'kernel': qd['kernel'],
+                            'scale': qd['scale']}}
+    got = np.asarray(mod.apply(variables, x))
+    want = np.asarray(
+        x @ quant.dequantize_kernel_int4(qd['kernel'], qd['scale']))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # bf16 serving dtype: partials accumulate in f32
+    # (preferred_element_type), so the only extra error vs the f32
+    # reference is the bf16 inputs + one final rounding — NOT a
+    # sqrt(n_groups) accumulation drift.
+    mod16 = llama.QuantDense4(features=64,
+                              logical_axes=('embed', 'mlp'),
+                              dtype=jnp.bfloat16)
+    got16 = np.asarray(mod16.apply(variables,
+                                   x.astype(jnp.bfloat16)),
+                       dtype=np.float32)
+    # atol scales with output magnitude: bf16 inputs carry 2^-8
+    # relative error, outputs here are O(30).
+    np.testing.assert_allclose(got16, want, rtol=3e-2,
+                               atol=0.02 * np.abs(want).max())
+
+
+def test_int4_logits_close_and_tree_matches_model():
+    cfg, model, params = _float_model()
+    qparams = quant.quantize_params(params, mode='int4')
+    qcfg = dataclasses.replace(cfg, quant='int4')
+    qmodel = llama.LlamaModel(qcfg)
+    # Tree structure == what a quant='int4' model initializes.
+    import flax.linen as nn
+    init_shapes = jax.eval_shape(qmodel.init, jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    flat_a = sorted(str(p) for p, _ in
+                    jax.tree_util.tree_leaves_with_path(
+                        nn.meta.unbox(init_shapes['params'])))
+    flat_b = sorted(str(p) for p, _ in
+                    jax.tree_util.tree_leaves_with_path(
+                        nn.meta.unbox(qparams['params'])))
+    assert flat_a == flat_b
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(1, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    lf = model.apply(params, tokens)
+    lq = qmodel.apply(qparams, tokens)
+    # Exactness claim: the int4 model == the FLOAT model on the
+    # dequantized weights (the compute path adds no error beyond the
+    # quantization itself). Quality-vs-float is workload-dependent and
+    # not asserted tightly on random debug weights — just sanity.
+    unboxed = nn.meta.unbox(qparams['params'])
+
+    def dequant(node):
+        if isinstance(node, dict) and 'kernel' in node and \
+                'scale' in node:
+            out = {k: v for k, v in node.items()
+                   if k not in ('kernel', 'scale')}
+            out['kernel'] = quant.dequantize_kernel_int4(
+                node['kernel'], node['scale'])
+            return out
+        if isinstance(node, dict):
+            return {k: dequant(v) for k, v in node.items()}
+        return node
+    ldq = model.apply({'params': dequant(unboxed)}, tokens)
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ldq),
+                               rtol=2e-4, atol=2e-4)
+    denom = np.maximum(np.abs(np.asarray(lf)).max(), 1e-6)
+    rel = np.abs(np.asarray(lq) - np.asarray(lf)).max() / denom
+    assert rel < 0.6, rel  # sanity only (see above)
+
+
+def test_int4_engine_serves():
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+
+    eng = server_lib.build_engine('debug', num_slots=2, max_seq_len=64,
+                                  cache_mode='paged',
+                                  quantize='int4')
+    assert eng.cfg.quant == 'int4'
+    eng.start()
+    try:
+        out = eng.generate([1, 2, 3, 4, 5, 6, 7, 8],
+                           engine_lib.SamplingParams(max_new_tokens=6))
+        assert len(out) == 6
+        assert all(0 <= t < eng.cfg.vocab_size for t in out)
+    finally:
+        eng.stop()
+
+
+def test_int4_stream_load_matches_post_quantize(tmp_path):
+    """Host-side int4 stream quantizer == on-device quantize_params
+    (same grouping, same ±7 symmetric scheme)."""
+    from skypilot_tpu.models import weights
+
+    cfg, model, params = _float_model(max_seq_len=64)
+    weights.save_hf_checkpoint(cfg, params, str(tmp_path))
+    want = quant.quantize_params(
+        weights.load_llama_params(cfg, str(tmp_path)), mode='int4')
+    got = weights.load_llama_params(cfg, str(tmp_path), quantize='int4')
+    la = jax.tree_util.tree_leaves_with_path(want)
+    lb = jax.tree_util.tree_leaves_with_path(got)
+    assert [str(p) for p, _ in la] == [str(p) for p, _ in lb]
+    n_int4 = 0
+    for (path, a), (_, b) in zip(la, lb):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype, path
+        if a.dtype.name == 'int4':
+            n_int4 += 1
+            assert np.abs(a.astype(np.int32) -
+                          b.astype(np.int32)).max() <= 1, path
+        else:
+            np.testing.assert_allclose(a.astype(np.float32),
+                                       b.astype(np.float32),
+                                       rtol=1e-5, atol=1e-8,
+                                       err_msg=str(path))
+    assert n_int4 == 8  # 7 stacked projections + lm_head
+
+
+def test_int4_rejects_moe():
+    from skypilot_tpu.models import moe
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(NotImplementedError, match='int4'):
+        quant.quantize_params(params, mode='int4')
+
+
+def test_int4_mixtral_checkpoint_friendly_error(tmp_path):
+    """A Mixtral checkpoint with --quantize int4 must say 'int4 is
+    llama-family only', not 'unknown quantize mode'."""
+    from skypilot_tpu.models import moe, weights
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    with pytest.raises(NotImplementedError, match='llama-family only'):
+        weights.load_mixtral_params(cfg, moe_cfg, str(tmp_path),
+                                    quantize='int4')
