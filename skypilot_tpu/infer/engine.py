@@ -64,6 +64,12 @@ class SamplingParams:
     # token's RAW model logprob (pre-filter log-softmax, the OpenAI/
     # vLLM convention) — instead of bare ints.
     logprobs: bool = False
+    # Multi-LoRA routing: index into the engine's adapter stack
+    # (infer/lora.py build_stack; 0 = base model, no adapter). The
+    # OpenAI server maps adapter NAMES to ids; at the engine level the
+    # id is just another per-request sampling knob, so it rides the
+    # multi-host request broadcast like everything else.
+    lora_id: int = 0
 
     def validate(self) -> None:
         """Reject parameters the engine cannot honor exactly, instead
@@ -101,6 +107,9 @@ class SamplingParams:
         if self.max_new_tokens < 1:
             raise ValueError(f'max_new_tokens must be >= 1, got '
                              f'{self.max_new_tokens}')
+        if not isinstance(self.lora_id, int) or self.lora_id < 0:
+            raise ValueError(f'lora_id must be an int >= 0, got '
+                             f'{self.lora_id!r}')
 
 
 @dataclasses.dataclass
@@ -275,7 +284,8 @@ class InferenceEngine:
                  spec_decode: int = 0,
                  prefill_chunk: int = 0,
                  lockstep=None,
-                 draft_model=None, draft_params=None) -> None:
+                 draft_model=None, draft_params=None,
+                 lora_stack=None) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
@@ -305,6 +315,25 @@ class InferenceEngine:
         self.cfg = model.cfg
         self.params = params
         self.mesh = mesh
+        # Multi-LoRA: the stacked adapter collection (infer/lora.py
+        # build_stack) + a per-slot adapter-id array. The stack rides
+        # into every model.apply as the 'lora' collection via _vars();
+        # id 0 (zeros) is the base model, so released slots route
+        # there. Replicated under a mesh: adapters are tiny.
+        self._lora_stack = lora_stack
+        self.num_adapters = (int(lora_stack['scaling'].shape[0])
+                             if lora_stack is not None else 0)
+        self._slot_lora = np.zeros(num_slots, np.int32)
+        if lora_stack is not None:
+            # A layout mismatch would otherwise serve base-model
+            # outputs silently (see infer/lora.py validate_stack).
+            from skypilot_tpu.infer import lora as lora_lib
+            lora_lib.validate_stack(lora_stack, params['params'])
+        if lora_stack is not None and mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            rep = NamedSharding(mesh, P())
+            self._lora_stack = jax.device_put(lora_stack, rep)
         if rules is None:
             from skypilot_tpu.parallel import sharding as sharding_lib
             rules = sharding_lib.DEFAULT_RULES
@@ -548,6 +577,18 @@ class InferenceEngine:
         return stack
 
     # ------------------------------------------------------------ jitted
+    def _vars(self, lora_ids):
+        """The variables pytree for a model call: params plus, when a
+        multi-LoRA stack is loaded, the 'lora' collection and the
+        per-sequence adapter ids ('lora_ids' pseudo-collection). The
+        jitted impls take this as their `params` argument unchanged —
+        jit just sees a wider pytree, so no impl signatures change and
+        engines without adapters trace exactly as before."""
+        if self._lora_stack is None:
+            return self.params
+        return dict(self.params, lora=self._lora_stack,
+                    lora_ids={'ids': jnp.asarray(lora_ids, jnp.int32)})
+
     def _prefill_impl(self, params, tokens, length, bucket):
         """tokens [1, bucket]; returns (next_logits [1, V],
         prefill_cache {'k','v'} with B=1, S=bucket)."""
@@ -1000,6 +1041,10 @@ class InferenceEngine:
         yields generated token ids, then None when finished."""
         params = params or SamplingParams()
         params.validate()
+        if params.lora_id >= max(1, self.num_adapters):
+            raise ValueError(
+                f'lora_id {params.lora_id} out of range: engine has '
+                f'{max(0, self.num_adapters - 1)} adapter(s) loaded')
         if len(tokens) >= self.max_seq_len:
             raise ValueError(f'prompt length {len(tokens)} >= max_seq_len '
                              f'{self.max_seq_len}')
@@ -1274,8 +1319,8 @@ class InferenceEngine:
                 return False
             if self.prefix_caching:
                 if req.page_hashes is None:
-                    req.page_hashes = paged_cache_hashes(req.tokens,
-                                                         psize)
+                    req.page_hashes = paged_cache_hashes(
+                        req.tokens, psize, salt=req.params.lora_id)
                 hashes = req.page_hashes
             # Cap the shared span at (n-1)//P pages: at least one real
             # token must run through the model to produce next-token
@@ -1304,6 +1349,7 @@ class InferenceEngine:
                     'chunked prefill started between defer check and reserve'
                 self._slots[slot] = req
                 req.slot = slot
+                self._slot_lora[slot] = req.params.lora_id
                 self._chunked = {'req': req, 'slot': slot, 'row': row,
                                  'hashes': hashes,
                                  'start': n_cached * psize, 'n': n}
@@ -1333,14 +1379,16 @@ class InferenceEngine:
                 padded = np.zeros((1, sb), np.int32)
                 padded[0, :len(suffix)] = suffix
                 greedy, logits, prefill_cache = self._jit_prefill_suffix(
-                    self.params, jnp.asarray(padded), jnp.int32(start),
+                    self._vars([req.params.lora_id]),
+                    jnp.asarray(padded), jnp.int32(start),
                     jnp.asarray([n]), self.cache['k'], self.cache['v'],
                     jnp.asarray(row), bucket=sb)
             else:
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :n] = req.tokens
                 greedy, logits, prefill_cache = self._jit_prefill(
-                    self.params, jnp.asarray(padded), jnp.asarray([n]),
+                    self._vars([req.params.lora_id]),
+                    jnp.asarray(padded), jnp.asarray([n]),
                     bucket=bucket)
             # Pull the logits row at most ONCE: in multi-host mode
             # _pull is a cross-host collective, not a cached host copy.
@@ -1438,6 +1486,7 @@ class InferenceEngine:
                     jnp.int32(first))
         req.first_token_at = time.time()
         req.slot = slot
+        self._slot_lora[slot] = req.params.lora_id
         req.generated = 1
         req.out_queue.put((first, first_lp) if req.params.logprobs
                           else first)
@@ -1496,7 +1545,8 @@ class InferenceEngine:
         ids = row[first_page:end_page]
         with self._ctx():
             greedy, logits, pc = self._jit_prefill_suffix(
-                self.params, jnp.asarray(padded), jnp.int32(start),
+                self._vars([req.params.lora_id]),
+                jnp.asarray(padded), jnp.int32(start),
                 jnp.asarray([length_arg]), self.cache['k'],
                 self.cache['v'], jnp.asarray(row), bucket=sb)
             if not final:
@@ -1553,6 +1603,7 @@ class InferenceEngine:
             # Crash-path release mid-chunked-prefill: abandon it.
             self._chunked = None
         self._slots[slot] = None
+        self._slot_lora[slot] = 0
         self._lengths[slot] = 0
         self._conf_lengths[slot] = 0
         if self.cache_mode == 'paged' and req is not None:
@@ -1680,7 +1731,8 @@ class InferenceEngine:
                             toks, lps, counts, self.cache, \
                                 self._draft_cache, d_last, d_lens, \
                                 d_keys = self._jit_decode_spec_draft(
-                                    self.params, self.draft_params,
+                                    self._vars(self._slot_lora),
+                                    self.draft_params,
                                     self.cache, self._draft_cache,
                                     d_last, d_lens, d_temps, d_keys,
                                     d_topks, d_topps, n=chunk, k=k,
@@ -1689,7 +1741,8 @@ class InferenceEngine:
                             toks, lps, counts, self.cache, d_last, \
                                 d_lens, d_keys, self._dev_hist = \
                                 self._jit_decode_spec(
-                                    self.params, self.cache, d_last,
+                                    self._vars(self._slot_lora),
+                                    self.cache, d_last,
                                     d_lens, d_temps, d_keys, d_topks,
                                     d_topps, self._dev_hist, n=chunk,
                                     k=k, sampling=sampling)
@@ -1708,7 +1761,8 @@ class InferenceEngine:
                         toks, lps, self.cache, keys, d_last, \
                             d_lens, d_counts, self._dev_hist = \
                             self._jit_decode_n(
-                                self.params, self.cache, d_last, d_lens,
+                                self._vars(self._slot_lora),
+                                self.cache, d_last, d_lens,
                                 d_temps, d_keys, d_topks, d_topps,
                                 d_press, d_freqs, d_counts,
                                 self._dev_hist,

@@ -1,0 +1,204 @@
+"""Multi-LoRA serving: stacked adapters + per-request routing.
+
+Reference analog: llm/lorax (the reference serves many LoRA adapters
+over one base model by deploying the third-party LoRAX container).
+Here it is first-class: N trained adapters are stacked into a 'lora'
+flax variable collection ([n_adapters, ...] leaves, id 0 = zeros = no
+adapter), the engine routes every sequence through its own adapter via
+a per-slot id array, and the OpenAI API selects adapters by `model`
+name (vLLM's multi-LoRA convention). The batched delta math lives in
+models/llama.py `_lora_delta` (S-LoRA-style gather + two rank-r
+einsums per projection).
+
+Adapter source: the Orbax checkpoint dir an `sft --lora-rank R` run
+writes (same input `train/export_lora.py` merges). Rank is inferred
+from the stored shapes; ranks may differ between adapters (padded to
+the max; scaling stays alpha/rank_i so outputs are unchanged).
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterSpec:
+    name: str
+    path: str
+    alpha: float = 16.0
+
+
+def load_adapter_dir(path: str) -> Dict[str, Any]:
+    """Orbax dir from an sft LoRA run -> the adapter tree
+    ({'layers': {'attn': {'wq': {'kernel': {'a', 'b'}}}}} layout).
+
+    Template-free restore: adapters are tiny (MBs) and host-side, so
+    the topology-mismatch risk StandardRestore's template guards
+    against is caught instead by build_stack's structure check."""
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+
+    ck = ckpt_lib.Checkpointer(path, async_save=False)
+    step = ck.latest_step()
+    if step is None:
+        raise FileNotFoundError(f'no Orbax checkpoint under {path}')
+    raw = ck._mgr.restore(step)  # pylint: disable=protected-access
+    ck.close()
+    if isinstance(raw, dict) and 'params' in raw:
+        raw = raw['params']
+    return raw
+
+
+def _flatten_adapter(tree: Dict[str, Any]) -> Dict[tuple, Dict[str, Any]]:
+    """Training-layout tree -> {collection_path: {'a': leaf, 'b': leaf}}
+    where collection_path replaces .../<proj>/kernel with
+    .../<proj>_ab (the scope models/llama.py reads the 'lora'
+    collection at)."""
+    flat: Dict[tuple, Dict[str, Any]] = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        if len(keys) < 3 or keys[-1] not in ('a', 'b') or \
+                keys[-2] != 'kernel':
+            raise ValueError(f'not a LoRA adapter tree: leaf at {keys}')
+        ckey = keys[:-3] + (f'{keys[-3]}_ab',)
+        flat.setdefault(ckey, {})[keys[-1]] = np.asarray(leaf)
+    return flat
+
+
+def _pad_rank(a: np.ndarray, b: np.ndarray,
+              rmax: int) -> Tuple[np.ndarray, np.ndarray]:
+    r = a.shape[-1]
+    if r == rmax:
+        return a, b
+    pad_a = [(0, 0)] * (a.ndim - 1) + [(0, rmax - r)]
+    pad_b = [(0, 0)] * (b.ndim - 2) + [(0, rmax - r), (0, 0)]
+    return np.pad(a, pad_a), np.pad(b, pad_b)
+
+
+def build_stack(adapters: Sequence[Tuple[Dict[str, Any], float]],
+                dtype: str = 'bfloat16') -> Dict[str, Any]:
+    """[(adapter_tree, alpha), ...] -> the 'lora' variable collection.
+
+    Leaves: {scope: {'<proj>_ab': {'a': [(L,) n, in, r],
+    'b': [(L,) n, r, out]}}} plus a top-level 'scaling' [n] f32 —
+    index 0 is the zeros no-op adapter (scaling 0), adapter i gets
+    index i+1. The adapter axis sits after the scan layer axis so
+    nn.scan's variable_axes={'lora': 0} slices layers as usual."""
+    if not adapters:
+        raise ValueError('build_stack needs at least one adapter')
+    flats = [_flatten_adapter(t) for t, _ in adapters]
+    keys0 = sorted(flats[0])
+    for i, f in enumerate(flats[1:], 1):
+        if sorted(f) != keys0:
+            raise ValueError(
+                f'adapter {i} targets different projections than '
+                f'adapter 0 — all served adapters must share targets')
+    ranks = [next(iter(f.values()))['a'].shape[-1] for f in flats]
+    rmax = max(ranks)
+    np_dtype = jnp.dtype(dtype)
+
+    stack: Dict[str, Any] = {}
+    for ckey in keys0:
+        a0 = flats[0][ckey]['a']
+        b0 = flats[0][ckey]['b']
+        # id 0: zeros (no adapter).
+        a_list = [np.zeros(a0.shape[:-1] + (rmax,), a0.dtype)]
+        b_list = [np.zeros(b0.shape[:-2] + (rmax,) + b0.shape[-1:],
+                           b0.dtype)]
+        for f in flats:
+            a, b = _pad_rank(f[ckey]['a'], f[ckey]['b'], rmax)
+            a_list.append(a)
+            b_list.append(b)
+        # Adapter axis after the (optional) scan layer axis: scan
+        # leaves are [L, in, r] (3D) -> stack at 1; non-scan are
+        # [in, r] (2D) -> stack at 0.
+        axis = a0.ndim - 2
+        node = stack
+        for k in ckey[:-1]:
+            node = node.setdefault(k, {})
+        node[ckey[-1]] = {
+            'a': jnp.asarray(np.stack(a_list, axis=axis), np_dtype),
+            'b': jnp.asarray(np.stack(b_list, axis=axis), np_dtype),
+        }
+    scaling = np.zeros(len(adapters) + 1, np.float32)
+    for i, ((_, alpha), r) in enumerate(zip(adapters, ranks), 1):
+        scaling[i] = alpha / r
+    stack['scaling'] = jnp.asarray(scaling)
+    logger.info('multi-LoRA stack: %d adapters, ranks %s (padded to '
+                '%d), %d adapted projections', len(adapters), ranks,
+                rmax, len(keys0))
+    return stack
+
+
+def build_stack_from_specs(specs: Sequence[AdapterSpec],
+                           dtype: str = 'bfloat16'
+                           ) -> Tuple[Dict[str, Any], Dict[str, int]]:
+    """AdapterSpecs -> ('lora' collection, {adapter name: lora_id}).
+    id 0 (the base model, no adapter) is not in the map — requests
+    naming the base model route there via the server's default."""
+    trees = [(load_adapter_dir(s.path), s.alpha) for s in specs]
+    stack = build_stack(trees, dtype=dtype)
+    return stack, {s.name: i + 1 for i, s in enumerate(specs)}
+
+
+def validate_stack(stack: Dict[str, Any],
+                   params: Dict[str, Any]) -> None:
+    """Every '<proj>_ab' path in the stack must correspond to a real
+    projection scope of the serving model's param tree.
+
+    Without this, a layout mismatch (adapter trained with
+    scan_layers=False against a scanning server, or an adapter from a
+    different model family) fails SILENTLY: models/llama.py
+    `_lora_delta` skips any projection whose variable is absent, so
+    adapter requests would serve exact base-model outputs while the
+    API advertises the adapter as loaded."""
+    valid = set()
+    for path, _ in jax.tree_util.tree_leaves_with_path(params):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        if len(keys) >= 2 and keys[-1] == 'kernel':
+            valid.add(keys[:-2] + (f'{keys[-2]}_ab',))
+    bad = []
+    for path, _ in jax.tree_util.tree_leaves_with_path(stack):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        if keys == ('scaling',) or keys[-1] in ('a', 'b') and \
+                keys[:-1] in valid:
+            continue
+        bad.append('/'.join(keys[:-1]))
+    if bad:
+        raise ValueError(
+            'LoRA stack does not match the serving model — these '
+            'adapted projections have no counterpart in the model '
+            '(layout/family mismatch? scan_layers must match the '
+            f'training run): {sorted(set(bad))[:5]}')
+
+
+def parse_lora_flag(values: Optional[List[str]]) -> List[AdapterSpec]:
+    """--lora name=path[:alpha], repeatable."""
+    specs = []
+    for v in values or []:
+        if '=' not in v:
+            raise ValueError(
+                f'--lora expects name=path[:alpha], got {v!r}')
+        name, rest = v.split('=', 1)
+        alpha = 16.0
+        if ':' in rest:
+            # Split from the right so gs:// style paths keep working
+            # when no alpha is given; a float parse decides.
+            head, tail = rest.rsplit(':', 1)
+            try:
+                alpha = float(tail)
+                rest = head
+            except ValueError:
+                pass
+        specs.append(AdapterSpec(name=name, path=rest, alpha=alpha))
+    if len({s.name for s in specs}) != len(specs):
+        raise ValueError('duplicate --lora adapter names')
+    return specs

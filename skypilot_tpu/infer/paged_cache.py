@@ -40,12 +40,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def page_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
+def page_hashes(tokens: Sequence[int], page_size: int,
+                salt: int = 0) -> List[bytes]:
     """Chained content hashes of a prompt's FULL pages — the prefix-cache
     key (vLLM's automatic prefix caching, which the reference gets via
     llm/vllm/serve.yaml). hash[i] covers tokens[0 : (i+1)*page_size], so
-    two prompts share page i iff they agree on everything up to it."""
+    two prompts share page i iff they agree on everything up to it.
+
+    salt: the request's lora_id — K/V depend on the (adapter-modified)
+    wk/wv projections, so pages must never be shared across adapters;
+    salting the chain start keeps the ids in disjoint hash spaces."""
     h = hashlib.blake2b(digest_size=16)
+    if salt:
+        h.update(int(salt).to_bytes(8, 'little'))
     out: List[bytes] = []
     for i in range(len(tokens) // page_size):
         h.update(np.asarray(tokens[i * page_size:(i + 1) * page_size],

@@ -39,7 +39,7 @@ import argparse
 import asyncio
 import functools
 import json
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from aiohttp import web
 
@@ -105,11 +105,37 @@ class _StopScanner:
 
 class InferenceServer:
     def __init__(self, engine: 'engine_lib.InferenceEngine',
-                 tokenizer=None, model_id: str = 'skypilot-tpu') -> None:
+                 tokenizer=None, model_id: str = 'skypilot-tpu',
+                 lora_names: Optional[Dict[str, int]] = None) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or tokenizer_lib.ByteTokenizer(
             engine.cfg.vocab_size)
         self.model_id = model_id
+        # Multi-LoRA routing (vLLM's OpenAI convention): 'model' in a
+        # request names either the base model or a loaded adapter.
+        self.lora_names = dict(lora_names or {})
+        if model_id in self.lora_names:
+            # _resolve_lora matches the base id first, so a colliding
+            # adapter would be silently unreachable.
+            raise ValueError(
+                f'--lora adapter name {model_id!r} collides with the '
+                f'served model id; rename the adapter')
+
+    def _resolve_lora(self, payload):
+        """-> (lora_id, error response | None). The base model id (or
+        an absent 'model' field) routes to id 0; a loaded adapter name
+        routes to its stack id; anything else is the OpenAI
+        model_not_found error."""
+        name = payload.get('model')
+        if name is None or name == self.model_id:
+            return 0, None
+        lid = self.lora_names.get(name)
+        if lid is None:
+            return 0, web.json_response(
+                {'error': {'message': f'model {name!r} not found',
+                           'type': 'invalid_request_error',
+                           'code': 'model_not_found'}}, status=404)
+        return lid, None
 
     async def _health(self, request: web.Request) -> web.Response:
         del request
@@ -139,7 +165,14 @@ class InferenceServer:
         # /generate counts generated tokens only).
         max_new = payload.get('max_tokens',
                               payload.get('max_new_tokens', 128))
+        # Optional 'lora': adapter name (same names the OpenAI routes
+        # accept in 'model').
+        lora_id, lora_err = self._resolve_lora(
+            {'model': payload['lora']} if payload.get('lora') else {})
+        if lora_err is not None:
+            return lora_err
         params = engine_lib.SamplingParams(
+            lora_id=lora_id,
             max_new_tokens=int(max_new),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
@@ -182,10 +215,12 @@ class InferenceServer:
     # /v1/models); these endpoints make our replicas drop-in for OpenAI
     # SDK clients pointed at the service endpoint.
 
-    def _sampling_from_openai(self,
-                              payload) -> 'engine_lib.SamplingParams':
+    def _sampling_from_openai(self, payload,
+                              lora_id: int = 0
+                              ) -> 'engine_lib.SamplingParams':
         temp = float(payload.get('temperature', 0.0))
         return engine_lib.SamplingParams(
+            lora_id=lora_id,
             max_new_tokens=int(payload.get('max_tokens', 128)),
             temperature=temp,
             top_k=int(payload.get('top_k', 0)),
@@ -377,7 +412,11 @@ class InferenceServer:
         return web.json_response({
             'object': 'list',
             'data': [{'id': self.model_id, 'object': 'model',
-                      'owned_by': 'skypilot-tpu'}],
+                      'owned_by': 'skypilot-tpu'}] +
+                    [{'id': name, 'object': 'model',
+                      'owned_by': 'skypilot-tpu',
+                      'parent': self.model_id}
+                     for name in sorted(self.lora_names)],
         })
 
     async def _sse(self, request, make_chunk, out_q, params,
@@ -485,7 +524,10 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'stream supports a single prompt with n=1'},
                 status=400)
-        params = self._sampling_from_openai(payload)
+        lora_id, lora_err = self._resolve_lora(payload)
+        if lora_err is not None:
+            return lora_err
+        params = self._sampling_from_openai(payload, lora_id)
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
@@ -567,7 +609,10 @@ class InferenceServer:
         if payload.get('stream') and n != 1:
             return web.json_response(
                 {'error': 'stream supports n=1'}, status=400)
-        params = self._sampling_from_openai(payload)
+        lora_id, lora_err = self._resolve_lora(payload)
+        if lora_err is not None:
+            return lora_err
+        params = self._sampling_from_openai(payload, lora_id)
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
@@ -654,7 +699,8 @@ def build_engine(model_name: Optional[str] = None,
                  prefill_chunk: int = 0,
                  lockstep=None,
                  draft_model_name: Optional[str] = None,
-                 draft_checkpoint: Optional[str] = None
+                 draft_checkpoint: Optional[str] = None,
+                 lora_stack=None
                  ) -> 'engine_lib.InferenceEngine':
     """Engine factory.
 
@@ -817,7 +863,8 @@ def build_engine(model_name: Optional[str] = None,
                                       prefill_chunk=prefill_chunk,
                                       lockstep=lockstep,
                                       draft_model=draft_model,
-                                      draft_params=draft_params)
+                                      draft_params=draft_params,
+                                      lora_stack=lora_stack)
 
 
 def main(argv=None) -> None:
@@ -874,6 +921,15 @@ def main(argv=None) -> None:
                         help='chunked prefill: long prompts prefill in '
                              'chunks of this many tokens, interleaved '
                              'with decode (0 = off)')
+    parser.add_argument('--lora', action='append', default=None,
+                        metavar='NAME=PATH[:ALPHA]',
+                        help='serve a LoRA adapter alongside the base '
+                             'model (repeatable). PATH is the Orbax '
+                             'dir an `sft --lora-rank R` run wrote; '
+                             'requests select the adapter by NAME in '
+                             "the OpenAI 'model' field (vLLM "
+                             'convention) or /generate "lora". '
+                             'ALPHA defaults to 16.')
     parser.add_argument('--multihost', default='auto',
                         choices=['auto', 'on', 'off'],
                         help='multi-host replica over jax.distributed '
@@ -894,6 +950,13 @@ def main(argv=None) -> None:
         from skypilot_tpu.infer import multihost as multihost_lib
         lockstep = multihost_lib.initialize_from_env()
 
+    lora_stack, lora_names = None, {}
+    if args.lora:
+        from skypilot_tpu.infer import lora as lora_lib
+        specs = lora_lib.parse_lora_flag(args.lora)
+        lora_stack, lora_names = lora_lib.build_stack_from_specs(
+            specs, dtype=args.dtype)
+
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
                           checkpoint=args.checkpoint, tp=args.tp,
                           cache_mode=args.cache_mode, dtype=args.dtype,
@@ -903,7 +966,8 @@ def main(argv=None) -> None:
                           prefill_chunk=args.prefill_chunk,
                           lockstep=lockstep,
                           draft_model_name=args.draft_model,
-                          draft_checkpoint=args.draft_checkpoint)
+                          draft_checkpoint=args.draft_checkpoint,
+                          lora_stack=lora_stack)
     if lockstep is not None and not lockstep.is_primary:
         # Follower host: no HTTP, no local requests — run the engine
         # loop (driven by the primary's tick broadcasts) until the
@@ -927,7 +991,8 @@ def main(argv=None) -> None:
     import os as _os
     model_id = (_os.path.basename(args.checkpoint.rstrip('/'))
                 if args.checkpoint else args.model)
-    server = InferenceServer(engine, tokenizer, model_id=model_id)
+    server = InferenceServer(engine, tokenizer, model_id=model_id,
+                             lora_names=lora_names)
     logger.info('inference server: model=%s ckpt=%s tp=%d port=%d '
                 'slots=%d', args.model, args.checkpoint, args.tp,
                 args.port, args.num_slots)

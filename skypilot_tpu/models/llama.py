@@ -242,30 +242,67 @@ def _dense(features, logical_axes, name, param_dtype, dtype, quant='none',
             nn.initializers.zeros_init(), (logical_axes[-1],)))
 
 
+def _lora_delta(mdl, name, x, lora_ids, lora_scale, dtype):
+    """Batched multi-LoRA delta for projection `name` (S-LoRA style).
+
+    Serving analog of the reference's llm/lorax recipe (LoRAX
+    container): adapters for ALL requests live stacked in the 'lora'
+    variable collection — a [n_adapters, in, r] / [n_adapters, r, out]
+    pair per projection at this module's scope, id 0 = zeros (no
+    adapter) — and each sequence in the batch gathers its own A/B by
+    `lora_ids`. Two rank-r einsums per projection (~r/in of the main
+    matmul's FLOPs); returns None when no adapters are loaded so the
+    base path traces unchanged."""
+    if lora_ids is None or not mdl.has_variable('lora', f'{name}_ab'):
+        return None
+    ab = mdl.get_variable('lora', f'{name}_ab')
+    a = jnp.take(ab['a'], lora_ids, axis=0).astype(dtype)  # [B, in, r]
+    b = jnp.take(ab['b'], lora_ids, axis=0).astype(dtype)  # [B, r, out]
+    t = jnp.einsum('bsi,bir->bsr', x, a)
+    d = jnp.einsum('bsr,bro->bso', t, b)
+    return d * lora_scale[:, None, None].astype(dtype)
+
+
+def _proj(mdl, cfg, dtype, lora_ids, lora_scale, name, feats, axes,
+          inp, use_bias=False):
+    """A projection + its (optional) multi-LoRA delta — the one place
+    the adapter path wires into the base matmul (submodule parenting
+    follows the calling module's compact context, so `name` scopes
+    under the caller as usual)."""
+    y = _dense(feats, axes, name, cfg.param_dtype, dtype, cfg.quant,
+               use_bias=use_bias)(inp)
+    d = _lora_delta(mdl, name, inp, lora_ids, lora_scale, dtype)
+    return y if d is None else y + d
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None, cache=None,
-                 positions=None):
+                 positions=None, lora_ids=None, lora_scale=None):
         """cache: optional (k,v) of [B, S_cache, Hkv, Hd] for incremental
         decoding — new K/V are written at `positions` (per-batch write
         offsets) and attention runs against the whole cache with a
-        position mask. Returns (out, new_cache) when cache is given."""
+        position mask. Returns (out, new_cache) when cache is given.
+
+        lora_ids/lora_scale: optional [B] per-sequence adapter index +
+        scaling for batched multi-LoRA serving (see _lora_delta)."""
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         h, hk, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         b, s, _ = x.shape
 
-        q = _dense(h * hd, ('embed', 'heads'), 'wq', cfg.param_dtype,
-                   dtype, cfg.quant,
-                   use_bias=cfg.attn_bias)(x).reshape(b, s, h, hd)
-        k = _dense(hk * hd, ('embed', 'kv_heads'), 'wk', cfg.param_dtype,
-                   dtype, cfg.quant,
-                   use_bias=cfg.attn_bias)(x).reshape(b, s, hk, hd)
-        v = _dense(hk * hd, ('embed', 'kv_heads'), 'wv', cfg.param_dtype,
-                   dtype, cfg.quant,
-                   use_bias=cfg.attn_bias)(x).reshape(b, s, hk, hd)
+        def proj(name, feats, axes, inp, use_bias=False):
+            return _proj(self, cfg, dtype, lora_ids, lora_scale,
+                         name, feats, axes, inp, use_bias)
+
+        q = proj('wq', h * hd, ('embed', 'heads'), x,
+                 cfg.attn_bias).reshape(b, s, h, hd)
+        k = proj('wk', hk * hd, ('embed', 'kv_heads'), x,
+                 cfg.attn_bias).reshape(b, s, hk, hd)
+        v = proj('wv', hk * hd, ('embed', 'kv_heads'), x,
+                 cfg.attn_bias).reshape(b, s, hk, hd)
 
         q = rope.apply_rope(q, cos, sin)
         k = rope.apply_rope(k, cos, sin)
@@ -340,8 +377,7 @@ class LlamaAttention(nn.Module):
                 out = _cached_attention(q, k_cache, v_cache, positions)
                 new_cache = (k_cache, v_cache)
             out = out.reshape(b, s, h * hd)
-            out = _dense(cfg.dim, ('heads', 'embed'), 'wo',
-                         cfg.param_dtype, dtype, cfg.quant)(out)
+            out = proj('wo', cfg.dim, ('heads', 'embed'), out)
             return nn.with_logical_constraint(
                 out, ('act_batch', 'act_seq', 'act_embed')), new_cache
 
@@ -361,8 +397,7 @@ class LlamaAttention(nn.Module):
                                           segment_ids=segment_ids,
                                           impl=cfg.attn_impl)
         out = out.reshape(b, s, h * hd)
-        out = _dense(cfg.dim, ('heads', 'embed'), 'wo', cfg.param_dtype,
-                     dtype, cfg.quant)(out)
+        out = proj('wo', cfg.dim, ('heads', 'embed'), out)
         return nn.with_logical_constraint(
             out, ('act_batch', 'act_seq', 'act_embed'))
 
@@ -381,13 +416,16 @@ class LlamaMLP(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lora_ids=None, lora_scale=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
-        gate = _dense(cfg.mlp_dim, ('embed', 'mlp'), 'w_gate',
-                      cfg.param_dtype, dtype, cfg.quant)(x)
-        up = _dense(cfg.mlp_dim, ('embed', 'mlp'), 'w_up',
-                    cfg.param_dtype, dtype, cfg.quant)(x)
+
+        def proj(name, feats, axes, inp):
+            return _proj(self, cfg, dtype, lora_ids, lora_scale,
+                         name, feats, axes, inp)
+
+        gate = proj('w_gate', cfg.mlp_dim, ('embed', 'mlp'), x)
+        up = proj('w_up', cfg.mlp_dim, ('embed', 'mlp'), x)
         if cfg.mlp_act == 'silu':
             hidden = nn.silu(gate) * up
         elif cfg.mlp_act == 'gelu_tanh':   # Gemma GeGLU (tanh approx)
@@ -396,8 +434,7 @@ class LlamaMLP(nn.Module):
             raise ValueError(f'unknown mlp_act {cfg.mlp_act!r}')
         hidden = nn.with_logical_constraint(
             hidden, ('act_batch', 'act_seq', 'act_mlp'))
-        out = _dense(cfg.dim, ('mlp', 'embed'), 'w_down',
-                     cfg.param_dtype, dtype, cfg.quant)(hidden)
+        out = proj('w_down', cfg.dim, ('mlp', 'embed'), hidden)
         return nn.with_logical_constraint(
             out, ('act_batch', 'act_seq', 'act_embed'))
 
@@ -425,18 +462,21 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None, cache=None,
-                 positions=None):
+                 positions=None, lora_ids=None, lora_scale=None):
         attn_in = RMSNorm(self.cfg, name='attn_norm')(x)
         if cache is not None:
             attn_out, new_cache = LlamaAttention(self.cfg, name='attn')(
-                attn_in, cos, sin, segment_ids, cache, positions)
+                attn_in, cos, sin, segment_ids, cache, positions,
+                lora_ids=lora_ids, lora_scale=lora_scale)
         else:
             attn_out = LlamaAttention(self.cfg, name='attn')(
-                attn_in, cos, sin, segment_ids)
+                attn_in, cos, sin, segment_ids,
+                lora_ids=lora_ids, lora_scale=lora_scale)
             new_cache = None
         x = x + attn_out
         x = x + LlamaMLP(self.cfg, name='mlp')(
-            RMSNorm(self.cfg, name='mlp_norm')(x))
+            RMSNorm(self.cfg, name='mlp_norm')(x),
+            lora_ids=lora_ids, lora_scale=lora_scale)
         return (x, new_cache) if cache is not None else x
 
 
@@ -479,6 +519,17 @@ class LlamaModel(nn.Module):
             positions, cfg.head_dim, cfg.rope_theta,
             use_llama31_scaling=cfg.use_llama31_rope)
 
+        # Batched multi-LoRA (serving): apply() with a 'lora' collection
+        # (stacked adapters, infer/lora.py build_stack) + a 'lora_ids'
+        # pseudo-collection ({'ids': [B] int32}) routes every sequence
+        # through its own adapter. Absent collections -> identical
+        # trace to the plain model.
+        lora_ids = lora_scale = None
+        if self.has_variable('lora_ids', 'ids'):
+            lora_ids = self.get_variable('lora_ids', 'ids')
+            scaling = self.get_variable('lora', 'scaling')  # [n_adapters]
+            lora_scale = jnp.take(scaling, lora_ids)        # [B]
+
         block = LlamaBlock
         if cfg.remat and cache is None:
             policy = (jax.checkpoint_policies.dots_saveable
@@ -502,11 +553,12 @@ class LlamaModel(nn.Module):
                     if tables is not None:
                         lc = lc + (tables,)
                     y, upd = mdl(carry, cos, sin, segment_ids, lc,
-                                 positions)
+                                 positions, lora_ids=lora_ids,
+                                 lora_scale=lora_scale)
                     return y, {'k': upd[0], 'v': upd[1]}
                 x, new_cache = nn.scan(
                     body,
-                    variable_axes={'params': 0},
+                    variable_axes={'params': 0, 'lora': 0},
                     split_rngs={'params': True},
                     length=cfg.n_layers,
                     in_axes=0, out_axes=0,
@@ -517,8 +569,10 @@ class LlamaModel(nn.Module):
             else:
                 x, _ = nn.scan(
                     lambda mdl, carry, _: (
-                        mdl(carry, cos, sin, segment_ids), None),
-                    variable_axes={'params': 0},
+                        mdl(carry, cos, sin, segment_ids,
+                            lora_ids=lora_ids,
+                            lora_scale=lora_scale), None),
+                    variable_axes={'params': 0, 'lora': 0},
                     split_rngs={'params': True},
                     length=cfg.n_layers,
                     metadata_params={nn.PARTITION_NAME: 'layers'},
@@ -531,11 +585,13 @@ class LlamaModel(nn.Module):
                     if tables is not None:
                         layer_cache = layer_cache + (tables,)
                     x, upd = block(cfg, name=f'layer_{i}')(
-                        x, cos, sin, segment_ids, layer_cache, positions)
+                        x, cos, sin, segment_ids, layer_cache, positions,
+                        lora_ids=lora_ids, lora_scale=lora_scale)
                     caches_out.append(upd)
                 else:
-                    x = block(cfg, name=f'layer_{i}')(x, cos, sin,
-                                                      segment_ids)
+                    x = block(cfg, name=f'layer_{i}')(
+                        x, cos, sin, segment_ids,
+                        lora_ids=lora_ids, lora_scale=lora_scale)
             if cache is not None:
                 new_cache = {
                     'k': jnp.stack([c[0] for c in caches_out]),
