@@ -61,10 +61,35 @@ class LlamaConfig:
     mlp_act: str = 'silu'            # 'silu' | 'gelu_tanh' (Gemma)
     norm_zero_centered: bool = False  # Gemma: weight applied as (1+w)
     embed_scale: bool = False        # Gemma: embeddings * sqrt(dim)
+    # Sliding-window attention (Mistral: every layer; Gemma-2: every
+    # other layer): query p attends keys in (p - window, p]. 0 = off.
+    sliding_window: int = 0
+    # Layer i is windowed iff i % window_pattern == 0 (1 = every
+    # layer; 2 = Gemma-2's sliding/global alternation, which starts
+    # with a sliding layer). Under nn.scan the per-layer choice is
+    # arithmetic on the scanned layer index — the body stays one
+    # homogeneous trace.
+    window_pattern: int = 1
+    attn_softcap: float = 0.0        # Gemma-2: 50.0 (tanh soft-cap)
+    final_softcap: float = 0.0       # Gemma-2: 30.0 (lm-head logits)
+    # Attention softmax scale override; 0 = 1/sqrt(head_dim). Gemma-2
+    # uses 1/sqrt(query_pre_attn_scalar).
+    attn_scale: float = 0.0
+    # Gemma-2 sandwich norms: post-attention and pre/post-feedforward
+    # RMSNorms in addition to the two pre-norms.
+    sandwich_norms: bool = False
 
     @property
     def head_dim(self) -> int:
         return self.head_dim_override or self.dim // self.n_heads
+
+    @property
+    def needs_xla_attention(self) -> bool:
+        """Window/softcap/scale-override models run attention on the
+        XLA path everywhere (incl. paged decode): the Pallas kernels
+        do not implement them, and silence would be wrong math."""
+        return (self.sliding_window > 0 or self.attn_softcap > 0.0 or
+                self.attn_scale != 0.0)
 
     def num_params(self) -> int:
         """Analytic parameter count (embedding counted once if tied)."""
@@ -75,7 +100,7 @@ class LlamaConfig:
         if self.attn_bias:
             attn += (self.n_heads + 2 * self.n_kv_heads) * self.head_dim
         mlp = 3 * d * self.mlp_dim
-        per_layer = attn + mlp + 2 * d
+        per_layer = attn + mlp + (4 if self.sandwich_norms else 2) * d
         embeds = v * d * (1 if self.tie_embeddings else 2)
         return self.n_layers * per_layer + embeds + d
 
@@ -104,13 +129,39 @@ CONFIGS = {
                             max_seq_len=32768, rope_theta=1e6,
                             use_llama31_rope=False, norm_eps=1e-6,
                             attn_bias=True),
-    # Mistral-7B-v0.1/0.2 shape (HF MistralConfig): architecturally
-    # llama; max_seq_len capped at the 4096 sliding window (weights.py
-    # clamps checkpoint configs the same way).
+    # Mistral-7B-v0.1 shape (HF MistralConfig): llama + sliding-window
+    # attention on every layer.
     'mistral-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
                               n_heads=32, n_kv_heads=8, mlp_dim=14336,
-                              max_seq_len=4096, rope_theta=10000.0,
+                              max_seq_len=32768, sliding_window=4096,
+                              rope_theta=10000.0,
                               use_llama31_rope=False, norm_eps=1e-6),
+    # Gemma-2 released shapes (HF Gemma2Config): Gemma conventions plus
+    # sandwich norms, tanh soft-caps (attn 50 / lm-head 30),
+    # 1/sqrt(query_pre_attn_scalar) attention scale, and sliding-window
+    # attention on every other layer (pattern 2, window 4096).
+    'gemma2-2b': LlamaConfig(vocab_size=256000, dim=2304, n_layers=26,
+                             n_heads=8, n_kv_heads=4, mlp_dim=9216,
+                             head_dim_override=256, max_seq_len=8192,
+                             rope_theta=10000.0, use_llama31_rope=False,
+                             norm_eps=1e-6, tie_embeddings=True,
+                             mlp_act='gelu_tanh', norm_zero_centered=True,
+                             embed_scale=True, sliding_window=4096,
+                             window_pattern=2, attn_softcap=50.0,
+                             final_softcap=30.0,
+                             attn_scale=256.0 ** -0.5,
+                             sandwich_norms=True),
+    'gemma2-9b': LlamaConfig(vocab_size=256000, dim=3584, n_layers=42,
+                             n_heads=16, n_kv_heads=8, mlp_dim=14336,
+                             head_dim_override=256, max_seq_len=8192,
+                             rope_theta=10000.0, use_llama31_rope=False,
+                             norm_eps=1e-6, tie_embeddings=True,
+                             mlp_act='gelu_tanh', norm_zero_centered=True,
+                             embed_scale=True, sliding_window=4096,
+                             window_pattern=2, attn_softcap=50.0,
+                             final_softcap=30.0,
+                             attn_scale=256.0 ** -0.5,
+                             sandwich_norms=True),
     # Gemma released shapes (HF GemmaConfig: GeGLU, 1+w norms,
     # sqrt(dim) embed scale, head_dim 256, tied embeddings).
     'gemma-2b': LlamaConfig(vocab_size=256000, dim=2048, n_layers=18,
@@ -275,12 +326,29 @@ def _proj(mdl, cfg, dtype, lora_ids, lora_scale, name, feats, axes,
     return y if d is None else y + d
 
 
+def _window_args(cfg, layer_idx):
+    """(window, window_active) for one layer. A static layer index
+    (non-scan path) resolves the alternation statically; a traced index
+    (nn.scan xs) yields a traced bool gate so the scan body stays one
+    homogeneous trace (Gemma-2's sliding/global alternation)."""
+    if cfg.sliding_window <= 0:
+        return 0, None
+    if layer_idx is None or cfg.window_pattern <= 1:
+        return cfg.sliding_window, None
+    if isinstance(layer_idx, int):
+        if layer_idx % cfg.window_pattern == 0:
+            return cfg.sliding_window, None
+        return 0, None
+    return cfg.sliding_window, (layer_idx % cfg.window_pattern) == 0
+
+
 class LlamaAttention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None, cache=None,
-                 positions=None, lora_ids=None, lora_scale=None):
+                 positions=None, lora_ids=None, lora_scale=None,
+                 layer_idx=None):
         """cache: optional (k,v) of [B, S_cache, Hkv, Hd] for incremental
         decoding — new K/V are written at `positions` (per-batch write
         offsets) and attention runs against the whole cache with a
@@ -292,6 +360,8 @@ class LlamaAttention(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         h, hk, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         b, s, _ = x.shape
+
+        window, window_active = _window_args(cfg, layer_idx)
 
         def proj(name, feats, axes, inp, use_bias=False):
             return _proj(self, cfg, dtype, lora_ids, lora_scale,
@@ -342,8 +412,9 @@ class LlamaAttention(nn.Module):
                         k_pool, k, tables, pos)
                     v_pool = PagePool.append_tokens_layer(
                         v_pool, v, tables, pos)
-                if s == 1 and _os.environ.get(
-                        'SKYT_PAGED_ATTN', 'pallas') == 'pallas':
+                if s == 1 and not cfg.needs_xla_attention and \
+                        _os.environ.get(
+                            'SKYT_PAGED_ATTN', 'pallas') == 'pallas':
                     # Pallas kernel DMAs each slot's pages directly (no
                     # materialized contiguous view; escape hatch:
                     # SKYT_PAGED_ATTN=xla). The engine pins the pool's
@@ -352,8 +423,9 @@ class LlamaAttention(nn.Module):
                     from skypilot_tpu.ops import paged_attention
                     out = paged_attention.paged_decode_attention(
                         q[:, 0], k_pool, v_pool, tables, pos)[:, None]
-                elif s > 1 and _os.environ.get(
-                        'SKYT_SPEC_PAGED_ATTN', 'xla') == 'pallas':
+                elif s > 1 and not cfg.needs_xla_attention and \
+                        _os.environ.get(
+                            'SKYT_SPEC_PAGED_ATTN', 'xla') == 'pallas':
                     # Multi-query kernel for the speculative verify
                     # step. Opt-in until validated on real TPU (the
                     # default gather path is the known-good fallback).
@@ -361,9 +433,13 @@ class LlamaAttention(nn.Module):
                     out = paged_attention.paged_decode_attention_mq(
                         q, k_pool, v_pool, tables, pos)
                 else:
+                    # Window/softcap/scale models always land here:
+                    # the gather view + masked XLA reference is the
+                    # correct math (cfg.needs_xla_attention).
                     k_view = PagePool.gather_view_layer(k_pool, tables)
                     v_view = PagePool.gather_view_layer(v_pool, tables)
-                    out = _cached_attention(q, k_view, v_view, positions)
+                    out = _cached_attention(q, k_view, v_view, positions,
+                                            cfg, window, window_active)
                 new_cache = (k_pool, v_pool)
             else:
                 k_cache, v_cache = cache
@@ -374,7 +450,8 @@ class LlamaAttention(nn.Module):
                 v_cache = jax.vmap(
                     lambda c, vv, i: jax.lax.dynamic_update_slice(
                         c, vv, (i, 0, 0)))(v_cache, v, start)
-                out = _cached_attention(q, k_cache, v_cache, positions)
+                out = _cached_attention(q, k_cache, v_cache, positions,
+                                        cfg, window, window_active)
                 new_cache = (k_cache, v_cache)
             out = out.reshape(b, s, h * hd)
             out = proj('wo', cfg.dim, ('heads', 'embed'), out)
@@ -382,6 +459,9 @@ class LlamaAttention(nn.Module):
                 out, ('act_batch', 'act_seq', 'act_embed')), new_cache
 
         if cfg.attn_impl == 'ring':
+            if cfg.needs_xla_attention:
+                raise ValueError('ring attention does not support '
+                                 'window/softcap/scale-override models')
             from skypilot_tpu.parallel import mesh as mesh_lib
             from skypilot_tpu.parallel import ring_attention
             mesh = mesh_lib.current_mesh()
@@ -393,23 +473,35 @@ class LlamaAttention(nn.Module):
                 out = ring_attention.ring_attention_sharded(
                     q, k, v, mesh, causal=True)
         else:
-            out = attention_ops.attention(q, k, v, causal=True,
-                                          segment_ids=segment_ids,
-                                          impl=cfg.attn_impl)
+            out = attention_ops.attention(
+                q, k, v, causal=True, segment_ids=segment_ids,
+                impl=cfg.attn_impl, window=window,
+                window_active=window_active,
+                logit_softcap=cfg.attn_softcap,
+                softmax_scale=cfg.attn_scale or None)
         out = out.reshape(b, s, h * hd)
         out = proj('wo', cfg.dim, ('heads', 'embed'), out)
         return nn.with_logical_constraint(
             out, ('act_batch', 'act_seq', 'act_embed'))
 
 
-def _cached_attention(q, k_cache, v_cache, positions):
+def _cached_attention(q, k_cache, v_cache, positions, cfg=None,
+                      window=0, window_active=None):
     """Attention of q [B,S,H,Hd] against the full cache [B,Sc,Hkv,Hd],
     masked so query at global position p sees keys at positions <= p
     (cache slots beyond the written prefix are masked out by the same
     rule because writes are left-aligned). Delegates to the tested GQA
-    reference (ops/attention.py) with per-batch query positions."""
+    reference (ops/attention.py) with per-batch query positions; the
+    window/softcap/scale family knobs flow through when cfg is
+    given."""
+    softcap = cfg.attn_softcap if cfg is not None else 0.0
+    scale = (cfg.attn_scale or None) if cfg is not None else None
     return attention_ops.mha_reference(q, k_cache, v_cache,
-                                       q_positions=positions)
+                                       q_positions=positions,
+                                       window=window,
+                                       window_active=window_active,
+                                       logit_softcap=softcap,
+                                       softmax_scale=scale)
 
 
 class LlamaMLP(nn.Module):
@@ -462,21 +554,30 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, segment_ids=None, cache=None,
-                 positions=None, lora_ids=None, lora_scale=None):
-        attn_in = RMSNorm(self.cfg, name='attn_norm')(x)
+                 positions=None, lora_ids=None, lora_scale=None,
+                 layer_idx=None):
+        cfg = self.cfg
+        attn_in = RMSNorm(cfg, name='attn_norm')(x)
         if cache is not None:
-            attn_out, new_cache = LlamaAttention(self.cfg, name='attn')(
+            attn_out, new_cache = LlamaAttention(cfg, name='attn')(
                 attn_in, cos, sin, segment_ids, cache, positions,
-                lora_ids=lora_ids, lora_scale=lora_scale)
+                lora_ids=lora_ids, lora_scale=lora_scale,
+                layer_idx=layer_idx)
         else:
-            attn_out = LlamaAttention(self.cfg, name='attn')(
+            attn_out = LlamaAttention(cfg, name='attn')(
                 attn_in, cos, sin, segment_ids,
-                lora_ids=lora_ids, lora_scale=lora_scale)
+                lora_ids=lora_ids, lora_scale=lora_scale,
+                layer_idx=layer_idx)
             new_cache = None
+        if cfg.sandwich_norms:   # Gemma-2: norm the residual branch
+            attn_out = RMSNorm(cfg, name='attn_post_norm')(attn_out)
         x = x + attn_out
-        x = x + LlamaMLP(self.cfg, name='mlp')(
-            RMSNorm(self.cfg, name='mlp_norm')(x),
+        mlp_out = LlamaMLP(cfg, name='mlp')(
+            RMSNorm(cfg, name='mlp_norm')(x),
             lora_ids=lora_ids, lora_scale=lora_scale)
+        if cfg.sandwich_norms:
+            mlp_out = RMSNorm(cfg, name='mlp_post_norm')(mlp_out)
+        x = x + mlp_out
         return (x, new_cache) if cache is not None else x
 
 
@@ -544,9 +645,15 @@ class LlamaModel(nn.Module):
         # every layer — kept OUT of the per-layer scan/stack (closure /
         # passthrough), while k/v are the per-layer page pools.
         tables = cache.get('tables') if cache is not None else None
+        # Alternating-window models (Gemma-2) thread the layer index
+        # through the scan as xs — the per-layer sliding/global choice
+        # becomes traced arithmetic, keeping ONE scan body.
+        need_idx = cfg.sliding_window > 0 and cfg.window_pattern > 1
         if cfg.scan_layers:
             if cache is not None:
                 kv_cache = {'k': cache['k'], 'v': cache['v']}
+                if need_idx:
+                    kv_cache['idx'] = jnp.arange(cfg.n_layers)
 
                 def body(mdl, carry, layer_cache):
                     lc = (layer_cache['k'], layer_cache['v'])
@@ -554,7 +661,8 @@ class LlamaModel(nn.Module):
                         lc = lc + (tables,)
                     y, upd = mdl(carry, cos, sin, segment_ids, lc,
                                  positions, lora_ids=lora_ids,
-                                 lora_scale=lora_scale)
+                                 lora_scale=lora_scale,
+                                 layer_idx=layer_cache.get('idx'))
                     return y, {'k': upd[0], 'v': upd[1]}
                 x, new_cache = nn.scan(
                     body,
@@ -568,15 +676,17 @@ class LlamaModel(nn.Module):
                     new_cache = {**new_cache, 'tables': tables}
             else:
                 x, _ = nn.scan(
-                    lambda mdl, carry, _: (
+                    lambda mdl, carry, idx: (
                         mdl(carry, cos, sin, segment_ids,
                             lora_ids=lora_ids,
-                            lora_scale=lora_scale), None),
+                            lora_scale=lora_scale,
+                            layer_idx=idx), None),
                     variable_axes={'params': 0, 'lora': 0},
                     split_rngs={'params': True},
                     length=cfg.n_layers,
                     metadata_params={nn.PARTITION_NAME: 'layers'},
-                )(block(cfg, name='layers'), x, None)
+                )(block(cfg, name='layers'), x,
+                  jnp.arange(cfg.n_layers) if need_idx else None)
         else:
             caches_out = []
             for i in range(cfg.n_layers):
@@ -586,12 +696,14 @@ class LlamaModel(nn.Module):
                         layer_cache = layer_cache + (tables,)
                     x, upd = block(cfg, name=f'layer_{i}')(
                         x, cos, sin, segment_ids, layer_cache, positions,
-                        lora_ids=lora_ids, lora_scale=lora_scale)
+                        lora_ids=lora_ids, lora_scale=lora_scale,
+                        layer_idx=i)
                     caches_out.append(upd)
                 else:
                     x = block(cfg, name=f'layer_{i}')(
                         x, cos, sin, segment_ids,
-                        lora_ids=lora_ids, lora_scale=lora_scale)
+                        lora_ids=lora_ids, lora_scale=lora_scale,
+                        layer_idx=i)
             if cache is not None:
                 new_cache = {
                     'k': jnp.stack([c[0] for c in caches_out]),
@@ -609,6 +721,9 @@ class LlamaModel(nn.Module):
         else:
             logits = _dense(cfg.vocab_size, ('embed', 'vocab'), 'lm_head',
                             cfg.param_dtype, dtype, cfg.quant)(x)
+        if cfg.final_softcap > 0.0:   # Gemma-2 lm-head soft-cap
+            cap = jnp.asarray(cfg.final_softcap, logits.dtype)
+            logits = cap * jnp.tanh(logits / cap)
         logits = nn.with_logical_constraint(
             logits, ('act_batch', 'act_seq', 'act_vocab'))
         return (logits, new_cache) if cache is not None else logits

@@ -63,6 +63,17 @@ def _layer_map(cfg) -> Dict[tuple, tuple]:
     m = dict(_LAYER_MAP)
     if getattr(cfg, 'attn_bias', False):
         m.update(_ATTN_BIAS_MAP)
+    if getattr(cfg, 'sandwich_norms', False):
+        # Gemma-2 names its four per-layer norms differently: HF
+        # 'post_attention_layernorm' is the POST-attention sandwich
+        # norm (for llama it is the MLP pre-norm), and the MLP gets
+        # pre/post 'feedforward' norms.
+        m[('attn_post_norm', 'weight')] = \
+            ('post_attention_layernorm.weight', False)
+        m[('mlp_norm', 'weight')] = \
+            ('pre_feedforward_layernorm.weight', False)
+        m[('mlp_post_norm', 'weight')] = \
+            ('post_feedforward_layernorm.weight', False)
     return m
 
 
@@ -562,22 +573,31 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
         # HF Qwen2Attention hardcodes q/k/v biases (no config field).
         kw['attn_bias'] = True
     elif model_type == 'mistral':
-        # Architecturally llama; beyond the sliding window our dense
-        # attention diverges from HF's windowed mask, so clamp honestly
-        # rather than serve silently-different logits at long context.
-        window = hf_config.get('sliding_window')
-        if window and window < kw['max_seq_len']:
-            logger.warning(
-                'mistral sliding_window=%d < max_position_embeddings=%d:'
-                ' clamping max_seq_len to the window (windowed attention'
-                ' is not implemented; within the window the math is'
-                ' identical)', window, kw['max_seq_len'])
-            kw['max_seq_len'] = window
+        # Architecturally llama + sliding-window attention on every
+        # layer (ops/attention.py implements the window mask, so the
+        # full max_position_embeddings context serves correctly).
+        kw['sliding_window'] = hf_config.get('sliding_window') or 0
     elif model_type == 'gemma':
         kw['mlp_act'] = 'gelu_tanh'
         kw['norm_zero_centered'] = True
         kw['embed_scale'] = True
         kw['tie_embeddings'] = hf_config.get('tie_word_embeddings', True)
+    elif model_type == 'gemma2':
+        kw['mlp_act'] = 'gelu_tanh'
+        kw['norm_zero_centered'] = True
+        kw['embed_scale'] = True
+        kw['tie_embeddings'] = hf_config.get('tie_word_embeddings', True)
+        kw['sandwich_norms'] = True
+        kw['sliding_window'] = hf_config.get('sliding_window') or 0
+        # HF Gemma2: even layers sliding, odd global.
+        kw['window_pattern'] = 2
+        kw['attn_softcap'] = hf_config.get('attn_logit_softcapping') \
+            or 0.0
+        kw['final_softcap'] = hf_config.get('final_logit_softcapping') \
+            or 0.0
+        qpas = hf_config.get('query_pre_attn_scalar')
+        if qpas:
+            kw['attn_scale'] = float(qpas) ** -0.5
     head_dim = hf_config.get('head_dim') or 0
     if head_dim and head_dim != kw['dim'] // kw['n_heads']:
         kw['head_dim_override'] = head_dim
@@ -589,13 +609,18 @@ def config_to_hf(cfg) -> Dict[str, Any]:
     """LlamaConfig -> HF config.json dict (what save_hf_checkpoint
     writes; enough for transformers' matching *ForCausalLM to reload).
 
-    The family is recovered from the knobs: attn_bias -> qwen2,
-    norm_zero_centered -> gemma, else llama (the inverse of
+    The family is recovered from the knobs: sandwich_norms -> gemma2,
+    norm_zero_centered -> gemma, attn_bias -> qwen2, sliding_window
+    (non-gemma2) -> mistral, else llama (the inverse of
     config_from_hf's dispatch)."""
-    if cfg.norm_zero_centered:
+    if cfg.sandwich_norms:
+        model_type, arch = 'gemma2', 'Gemma2ForCausalLM'
+    elif cfg.norm_zero_centered:
         model_type, arch = 'gemma', 'GemmaForCausalLM'
     elif cfg.attn_bias:
         model_type, arch = 'qwen2', 'Qwen2ForCausalLM'
+    elif cfg.sliding_window > 0:
+        model_type, arch = 'mistral', 'MistralForCausalLM'
     else:
         model_type, arch = 'llama', 'LlamaForCausalLM'
     out = {
@@ -616,9 +641,20 @@ def config_to_hf(cfg) -> Dict[str, Any]:
                        if cfg.mlp_act == 'gelu_tanh' else 'silu'),
         'torch_dtype': 'float32',
     }
-    if model_type == 'gemma':
+    if model_type in ('gemma', 'gemma2'):
         # GemmaConfig reads 'hidden_activation' (hidden_act is legacy).
         out['hidden_activation'] = out['hidden_act']
+    if model_type == 'mistral':
+        out['sliding_window'] = cfg.sliding_window
+    if model_type == 'gemma2':
+        out['sliding_window'] = cfg.sliding_window
+        out['attn_logit_softcapping'] = cfg.attn_softcap or None
+        out['final_logit_softcapping'] = cfg.final_softcap or None
+        # ALWAYS emitted: HF Gemma2Config defaults the scalar to 256,
+        # so omitting it when we scale by 1/sqrt(head_dim) would make
+        # transformers reload the checkpoint with a different scale.
+        out['query_pre_attn_scalar'] = round(
+            (cfg.attn_scale or cfg.head_dim ** -0.5) ** -2)
     if cfg.use_llama31_rope:
         out['rope_scaling'] = {
             'rope_type': 'llama3', 'factor': 8.0,

@@ -14,21 +14,16 @@ import jax.numpy as jnp
 NEG_INF = -1e9  # logits are f32 until softmax, so -1e9 never overflows
 
 
-def _causal_mask(q_len: int, k_len: int, q_offset: int = 0) -> jax.Array:
-    """[q_len, k_len] bool, True = attendable. q_offset shifts query
-    positions (used for decode and for ring-attention blocks)."""
-    q_pos = jnp.arange(q_len)[:, None] + q_offset
-    k_pos = jnp.arange(k_len)[None, :]
-    return q_pos >= k_pos
-
-
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   causal: bool = True,
                   segment_ids: Optional[jax.Array] = None,
                   kv_segment_ids: Optional[jax.Array] = None,
                   q_offset: int = 0,
                   q_positions: Optional[jax.Array] = None,
-                  softmax_scale: Optional[float] = None) -> jax.Array:
+                  softmax_scale: Optional[float] = None,
+                  window: int = 0,
+                  window_active=None,
+                  logit_softcap: float = 0.0) -> jax.Array:
     """q: [B, Sq, Hq, D]; k,v: [B, Sk, Hkv, D]; Hq % Hkv == 0.
 
     Returns [B, Sq, Hq, D]. Logits and softmax in f32.
@@ -36,6 +31,17 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     q_positions: optional [B, Sq] global query positions for the causal
     mask (per-batch offsets — the KV-cache decode path); overrides
     q_offset. Keys are assumed at positions 0..Sk-1.
+
+    window: sliding-window attention (Mistral / every other Gemma-2
+    layer): query at position p also requires p - k_pos < window.
+    window_active: optional traced BOOL — False disables the window
+    restriction at runtime. This is how Gemma-2's alternating
+    global/sliding layers stay a single homogeneous nn.scan body: the
+    per-layer choice is arithmetic on the scanned layer index, not a
+    Python branch.
+
+    logit_softcap: Gemma-2 style soft-capping, cap*tanh(logits/cap),
+    applied after the scale, before the mask.
     """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -47,14 +53,21 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     logits = jnp.einsum('bqhgd,bkhd->bhgqk', qg, k,
                         preferred_element_type=jnp.float32)
     logits = logits * scale
+    if logit_softcap > 0.0:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
 
-    mask = None
+    k_pos = jnp.arange(sk)[None, None, None, None, :]
     if q_positions is not None:
-        k_pos = jnp.arange(sk)
-        mask = (q_positions[:, None, None, :, None] >=
-                k_pos[None, None, None, None, :])
-    elif causal:
-        mask = _causal_mask(sq, sk, q_offset)[None, None, None]
+        q_pos = q_positions[:, None, None, :, None]
+    else:
+        q_pos = (jnp.arange(sq) + q_offset)[None, None, None, :, None]
+    mask = (q_pos >= k_pos) if (causal or q_positions is not None) \
+        else None
+    if window > 0:
+        wmask = (q_pos - k_pos) < window
+        if window_active is not None:
+            wmask = wmask | jnp.logical_not(window_active)
+        mask = wmask if mask is None else (mask & wmask)
     if segment_ids is not None:
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         seg_mask = (segment_ids[:, None, None, :, None] ==
@@ -68,20 +81,37 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, sq, hq, d)
 
 
-@functools.partial(jax.jit, static_argnames=('causal', 'impl'))
+@functools.partial(jax.jit, static_argnames=('causal', 'impl', 'window',
+                                             'logit_softcap',
+                                             'softmax_scale'))
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = True,
               segment_ids: Optional[jax.Array] = None,
-              impl: str = 'auto') -> jax.Array:
+              impl: str = 'auto',
+              window: int = 0,
+              window_active=None,
+              logit_softcap: float = 0.0,
+              softmax_scale: Optional[float] = None) -> jax.Array:
     """Dispatch: 'auto' uses the Pallas flash kernel on TPU when shapes
-    allow, else the XLA reference."""
+    allow, else the XLA reference. Windowed/soft-capped/rescaled
+    attention (Mistral, Gemma-2) always takes the XLA path — the flash
+    kernel does not implement them, and a silent wrong-math fast path
+    is worse than a slower correct one."""
+    needs_xla = window > 0 or logit_softcap > 0.0 or \
+        softmax_scale is not None
     if impl == 'auto':
-        impl = 'flash' if _flash_ok(q, k) else 'xla'
+        impl = 'flash' if not needs_xla and _flash_ok(q, k) else 'xla'
     if impl == 'flash':
+        if needs_xla:
+            raise ValueError('flash attention does not support '
+                             'window/softcap/scale overrides')
         from skypilot_tpu.ops import flash_attention
         return flash_attention.flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids)
-    return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids)
+    return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids,
+                         window=window, window_active=window_active,
+                         logit_softcap=logit_softcap,
+                         softmax_scale=softmax_scale)
 
 
 def _flash_ok(q: jax.Array, k: jax.Array) -> bool:

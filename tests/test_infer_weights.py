@@ -431,6 +431,17 @@ def _family_debug_cfg(family):
             base, mlp_act='gelu_tanh', norm_zero_centered=True,
             embed_scale=True, tie_embeddings=True, head_dim_override=32,
             norm_eps=1e-6, rope_theta=10000.0)
+    if family == 'gemma2':
+        # Window 8 < the 12-token test prompts and pattern 2, so the
+        # sliding/global alternation and both soft-caps are exercised;
+        # attn_scale deliberately != head_dim**-0.5.
+        return dataclasses.replace(
+            base, n_layers=4, mlp_act='gelu_tanh',
+            norm_zero_centered=True, embed_scale=True,
+            tie_embeddings=True, head_dim_override=16,
+            norm_eps=1e-6, rope_theta=10000.0, sliding_window=8,
+            window_pattern=2, attn_softcap=30.0, final_softcap=20.0,
+            attn_scale=32.0 ** -0.5, sandwich_norms=True)
     raise ValueError(family)
 
 
@@ -453,7 +464,7 @@ def _random_family_params(cfg, seed=7):
     return model, {'params': params}
 
 
-@pytest.mark.parametrize('family', ['qwen2', 'gemma'])
+@pytest.mark.parametrize('family', ['qwen2', 'gemma', 'gemma2'])
 def test_family_logits_match_transformers(family, tmp_path):
     """save -> config round-trip -> load -> logits == transformers'
     family implementation on the same checkpoint."""
@@ -471,19 +482,23 @@ def test_family_logits_match_transformers(family, tmp_path):
                                dtype=cfg.dtype,
                                param_dtype=cfg.param_dtype,
                                remat=cfg.remat)
-    assert cfg2.attn_bias == cfg.attn_bias
-    assert cfg2.mlp_act == cfg.mlp_act
-    assert cfg2.norm_zero_centered == cfg.norm_zero_centered
-    assert cfg2.embed_scale == cfg.embed_scale
-    assert cfg2.head_dim == cfg.head_dim
-    assert cfg2.tie_embeddings == cfg.tie_embeddings
+    for field in ('attn_bias', 'mlp_act', 'norm_zero_centered',
+                  'embed_scale', 'head_dim', 'tie_embeddings',
+                  'sliding_window', 'window_pattern', 'attn_softcap',
+                  'final_softcap', 'sandwich_norms'):
+        assert getattr(cfg2, field) == getattr(cfg, field), field
+    assert abs(cfg2.attn_scale - cfg.attn_scale) < 1e-9
 
     loaded = weights.load_llama_params(cfg2, str(ckpt))
 
+    # eager attention: HF's sdpa path skips Gemma-2 soft-capping and
+    # (on some versions) sliding windows; eager implements both.
     hf_model = transformers.AutoModelForCausalLM.from_pretrained(
-        str(ckpt), torch_dtype=torch.float32)
-    assert type(hf_model).__name__ == (
-        'Qwen2ForCausalLM' if family == 'qwen2' else 'GemmaForCausalLM')
+        str(ckpt), torch_dtype=torch.float32,
+        attn_implementation='eager')
+    assert type(hf_model).__name__ == {
+        'qwen2': 'Qwen2ForCausalLM', 'gemma': 'GemmaForCausalLM',
+        'gemma2': 'Gemma2ForCausalLM'}[family]
     hf_model.eval()
 
     rng = np.random.default_rng(3)
@@ -496,7 +511,7 @@ def test_family_logits_match_transformers(family, tmp_path):
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize('family', ['qwen2', 'gemma'])
+@pytest.mark.parametrize('family', ['qwen2', 'gemma', 'gemma2'])
 def test_family_engine_decode(family, tmp_path):
     """build_engine(checkpoint=<family ckpt>) decodes end-to-end —
     proves the serve path's model-type dispatch, not just logits."""
@@ -554,9 +569,10 @@ def test_qwen2_int8_stream_load_matches_post_quantize(tmp_path):
 
 
 def test_mistral_checkpoint_dispatch(tmp_path):
-    """model_type=mistral loads through the llama path (identical math
-    within the sliding window), max_seq_len clamps to the window, and
-    logits match transformers' MistralForCausalLM."""
+    """model_type=mistral loads through the llama path with
+    sliding-window attention: logits match transformers'
+    MistralForCausalLM on prompts LONGER than the window (the windowed
+    mask is the only difference from llama)."""
     torch = pytest.importorskip('torch')
     transformers = pytest.importorskip('transformers')
 
@@ -567,22 +583,24 @@ def test_mistral_checkpoint_dispatch(tmp_path):
                                  jnp.zeros((1, 8), jnp.int32))
     weights.save_hf_checkpoint(cfg, params, str(tmp_path))
     # Rewrite the config as a Mistral checkpoint with a sliding window
-    # smaller than max_position_embeddings.
+    # SMALLER than the test prompt so the window actually bites.
     cfg_path = tmp_path / 'config.json'
     hf_cfg = json.loads(cfg_path.read_text())
     hf_cfg.update(model_type='mistral',
                   architectures=['MistralForCausalLM'],
-                  sliding_window=32)
+                  sliding_window=8)
     cfg_path.write_text(json.dumps(hf_cfg))
 
     cfg2 = weights.load_config(str(tmp_path), dtype=cfg.dtype,
                                param_dtype=cfg.param_dtype,
                                remat=False)
-    assert cfg2.max_seq_len == 32  # clamped to the window
+    assert cfg2.sliding_window == 8
+    assert cfg2.max_seq_len == 64   # no clamp: the window is real now
     loaded = weights.load_llama_params(cfg2, str(tmp_path))
 
     hf_model = transformers.AutoModelForCausalLM.from_pretrained(
-        str(tmp_path), torch_dtype=torch.float32)
+        str(tmp_path), torch_dtype=torch.float32,
+        attn_implementation='eager')
     assert type(hf_model).__name__ == 'MistralForCausalLM'
     hf_model.eval()
     tokens = np.random.default_rng(4).integers(0, cfg.vocab_size,
@@ -593,3 +611,42 @@ def test_mistral_checkpoint_dispatch(tmp_path):
         llama.LlamaModel(cfg2).apply(loaded,
                                      jnp.asarray(tokens, jnp.int32)))
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+    # Sanity that the window changed the math vs no-window weights.
+    plain = np.asarray(model.apply(params,
+                                   jnp.asarray(tokens, jnp.int32)))
+    assert np.abs(plain - ours).max() > 1e-3
+
+
+def test_windowed_engine_decode_matches_full_forward(tmp_path):
+    """Gemma-2-style incremental decode (windowed + soft-capped cached
+    attention, alternating layers) == greedy rollout by full forward
+    recompute — the cache path's window mask is position-exact."""
+    cfg = _family_debug_cfg('gemma2')
+    _, variables = _random_family_params(cfg)
+    ckpt = tmp_path / 'g2'
+    weights.save_hf_checkpoint(cfg, variables, str(ckpt))
+    cfg2 = weights.load_config(str(ckpt), max_seq_len=64,
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype, remat=False)
+    loaded = weights.load_llama_params(cfg2, str(ckpt))
+    model = llama.LlamaModel(cfg2)
+
+    prompt = list(np.random.default_rng(6).integers(
+        1, cfg.vocab_size, 12))          # longer than the 8-token window
+    toks = [int(t) for t in prompt]
+    for _ in range(6):
+        logits = model.apply(loaded, jnp.asarray([toks], jnp.int32))
+        toks.append(int(np.argmax(np.asarray(logits[0, -1]))))
+    want = toks[len(prompt):]
+
+    eng = engine_lib.InferenceEngine(model, loaded, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16],
+                                     cache_mode='paged', page_size=16)
+    eng.start()
+    try:
+        got = eng.generate([int(t) for t in prompt],
+                           engine_lib.SamplingParams(max_new_tokens=6))
+    finally:
+        eng.stop()
+    assert got == want
