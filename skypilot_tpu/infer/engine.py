@@ -37,6 +37,12 @@ logger = log_utils.init_logger(__name__)
 # Device-side top-k sampling supports k up to this (one fixed-size
 # top_k sort serves all slots' per-request k values).
 _TOPK_BUCKET = 64
+# Max logit_bias entries per request; applied as a device-side
+# scatter-add of a fixed [SLOTS, _BIAS_BUCKET] (idx, val) pair, so the
+# cap keeps the decode step free of data-dependent shapes (same
+# philosophy as _TOPK_BUCKET). OpenAI clients rarely use more than a
+# handful of entries.
+_BIAS_BUCKET = 64
 
 
 @dataclasses.dataclass
@@ -64,6 +70,12 @@ class SamplingParams:
     # token's RAW model logprob (pre-filter log-softmax, the OpenAI/
     # vLLM convention) — instead of bare ints.
     logprobs: bool = False
+    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to the
+    # raw logits before temperature/top-k/top-p AND before the greedy
+    # argmax (OpenAI semantics: -100 bans, +100 effectively forces).
+    # Reported logprobs stay RAW model values (same convention as the
+    # repetition penalties). Max _BIAS_BUCKET entries.
+    logit_bias: Optional[Dict[int, float]] = None
     # Multi-LoRA routing: index into the engine's adapter stack
     # (infer/lora.py build_stack; 0 = base model, no adapter). The
     # OpenAI server maps adapter NAMES to ids; at the engine level the
@@ -110,6 +122,21 @@ class SamplingParams:
         if not isinstance(self.lora_id, int) or self.lora_id < 0:
             raise ValueError(f'lora_id must be an int >= 0, got '
                              f'{self.lora_id!r}')
+        if self.logit_bias:
+            if len(self.logit_bias) > _BIAS_BUCKET:
+                raise ValueError(
+                    f'logit_bias supports at most {_BIAS_BUCKET} '
+                    f'entries, got {len(self.logit_bias)}')
+            for t, b in self.logit_bias.items():
+                if not isinstance(t, int) or isinstance(t, bool) or \
+                        t < 0:
+                    raise ValueError(
+                        f'logit_bias keys must be token ids >= 0, '
+                        f'got {t!r}')
+                if not -100.0 <= float(b) <= 100.0:
+                    raise ValueError(
+                        f'logit_bias values must be in [-100, 100], '
+                        f'got {b!r} for token {t}')
 
 
 @dataclasses.dataclass
@@ -251,12 +278,24 @@ def _np_raw_lp(logits_row, tok: int) -> float:
     return float(row[tok] - m - np.log(np.exp(row - m).sum()))
 
 
+def _bias_arrays(params) -> 'tuple[np.ndarray, np.ndarray]':
+    """(idx [_BIAS_BUCKET] i32, val [_BIAS_BUCKET] f32) for a request's
+    logit_bias; zero padding scatter-adds 0.0 onto token 0 (no-op)."""
+    idx = np.zeros(_BIAS_BUCKET, np.int32)
+    val = np.zeros(_BIAS_BUCKET, np.float32)
+    for j, (t, b) in enumerate((params.logit_bias or {}).items()):
+        idx[j] = int(t)
+        val[j] = float(b)
+    return idx, val
+
+
 def _update_args(args, slot, first_tok, length, temp, key, topk,
-                 topp, pres, freq):
+                 topp, pres, freq, bidx, bval):
     """Write one slot's decode args on device (shared by both insert
     impls). The slot's output-token count row resets, then the first
     generated token is counted (penalties cover output tokens only)."""
-    last, lens, temps, keys, topks, topps, press, freqs, counts = args
+    (last, lens, temps, keys, topks, topps, press, freqs, counts,
+     bidxs, bvals) = args
     counts = counts.at[slot].set(0).at[slot, first_tok].set(1)
     return (last.at[slot].set(first_tok),
             lens.at[slot].set(length),
@@ -266,7 +305,9 @@ def _update_args(args, slot, first_tok, length, temp, key, topk,
             topps.at[slot].set(topp),
             press.at[slot].set(pres),
             freqs.at[slot].set(freq),
-            counts)
+            counts,
+            bidxs.at[slot].set(bidx),
+            bvals.at[slot].set(bval))
 
 
 class InferenceEngine:
@@ -506,6 +547,9 @@ class InferenceEngine:
                      'prefill_chunks': 0}
         self._last_pull_t: Optional[float] = None
         self._had_admission = False
+        # Rolling TTFT window (seconds) for /stats percentiles.
+        import collections as _collections
+        self._ttfts = _collections.deque(maxlen=512)
 
         self._jit_prefill = jax.jit(self._prefill_impl,
                                     static_argnames=('bucket',))
@@ -533,7 +577,7 @@ class InferenceEngine:
             self._decode_n_impl,
             donate_argnums=(1, 10, 11) if self._dev_hist is not None
             else (1, 10),   # cache, counts (+hist under n-gram spec)
-            static_argnames=('n', 'sampling', 'penalize'))
+            static_argnames=('n', 'sampling', 'penalize', 'biased'))
         # Donate the global cache and the decode-arg arrays (updated in
         # place); the prefill cache is NOT donatable (B=1 buffers cannot
         # alias the B=slots cache).
@@ -663,7 +707,8 @@ class InferenceEngine:
             return cache
 
     def _insert_impl(self, cache, prefill_cache, slot, args, first_tok,
-                     length, temp, key, topk, topp, pres, freq):
+                     length, temp, key, topk, topp, pres, freq,
+                     bidx, bval):
         """ONE fused dispatch per admission: copy a prefill cache (B=1,
         S=max_seq) into `slot` of the global cache AND write the slot's
         decode args (last token, length, temp, rng key, topk) into the
@@ -678,11 +723,13 @@ class InferenceEngine:
                 big, small, (0, slot, 0, 0, 0))
         cache = jax.tree.map(upd, cache, prefill_cache)
         return cache, _update_args(args, slot, first_tok, length, temp,
-                                   key, topk, topp, pres, freq)
+                                   key, topk, topp, pres, freq,
+                                   bidx, bval)
 
     def _insert_paged_impl(self, cache, prefill_cache, slot, args,
                            first_tok, length, temp, key, topk, topp,
-                           pres, freq, page_ids, table_row, src_off):
+                           pres, freq, bidx, bval, page_ids, table_row,
+                           src_off):
         """Paged-mode admission: scatter the prompt KV into the reserved
         pages, install the slot's block-table row, and update the decode
         args — one fused dispatch, same contract as _insert_impl.
@@ -710,7 +757,7 @@ class InferenceEngine:
         }
         return self._pin_paged_layouts(new_cache), _update_args(
             args, slot, first_tok, length, temp, key, topk, topp,
-            pres, freq)
+            pres, freq, bidx, bval)
 
     def _insert_pages_impl(self, cache, prefill_cache, page_ids,
                            src_off):
@@ -737,7 +784,8 @@ class InferenceEngine:
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
                        keys, topks, topps, press, freqs, counts, hist,
-                       n, sampling, penalize):
+                       bias_idx, bias_val, n, sampling, penalize,
+                       biased=False):
         """Generate `n` tokens per slot in ONE dispatch: a device-side
         lax.scan of decode steps with on-device sampling (greedy when
         temps[i] == 0, else temperature categorical). The host pulls one
@@ -782,6 +830,11 @@ class InferenceEngine:
                 logits = logits \
                     - freqs[:, None] * counts.astype(jnp.float32) \
                     - press[:, None] * (counts > 0).astype(jnp.float32)
+            if biased:
+                # OpenAI logit_bias: scatter-add each slot's (idx, val)
+                # pairs; zero padding adds 0.0 to token 0 (no-op).
+                logits = logits.at[
+                    n_range[:, None], bias_idx].add(bias_val)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if not sampling:
                 tok = greedy
@@ -1045,6 +1098,13 @@ class InferenceEngine:
             raise ValueError(
                 f'lora_id {params.lora_id} out of range: engine has '
                 f'{max(0, self.num_adapters - 1)} adapter(s) loaded')
+        if params.logit_bias:
+            bad = [t for t in params.logit_bias
+                   if t >= self.cfg.vocab_size]
+            if bad:
+                raise ValueError(
+                    f'logit_bias token ids out of vocab '
+                    f'(V={self.cfg.vocab_size}): {bad[:5]}')
         if len(tokens) >= self.max_seq_len:
             raise ValueError(f'prompt length {len(tokens)} >= max_seq_len '
                              f'{self.max_seq_len}')
@@ -1238,6 +1298,13 @@ class InferenceEngine:
                 if p['spec_verify_steps'] > 0 else 0.0)
         if self.prefix_caching and self.pool is not None:
             p['prefix_cache'] = dict(self.pool.prefix_stats)
+        if self._ttfts:
+            arr = np.asarray(self._ttfts) * 1000.0
+            p['ttft_ms'] = {
+                'p50': round(float(np.percentile(arr, 50)), 2),
+                'p90': round(float(np.percentile(arr, 90)), 2),
+                'p99': round(float(np.percentile(arr, 99)), 2),
+                'count': int(arr.size)}
         return p
 
     def reset_perf(self) -> None:
@@ -1247,6 +1314,7 @@ class InferenceEngine:
                      'spec_verify_steps': 0, 'spec_accepted': 0,
                      'prefill_chunks': 0}
         self._last_pull_t = None
+        self._ttfts.clear()   # percentiles cover the same window
 
     # ---------------------------------------------------------- main loop
     def _bucket_for(self, n: int) -> int:
@@ -1275,7 +1343,11 @@ class InferenceEngine:
                               # penalties: [SLOTS, V] int32 (~4MB at
                               # 128k vocab — noise next to the cache).
                               jnp.zeros((n, self.cfg.vocab_size),
-                                        jnp.int32))
+                                        jnp.int32),
+                              # logit_bias scatter pairs (idx 0 + val 0
+                              # padding is a harmless +0 on token 0).
+                              jnp.zeros((n, _BIAS_BUCKET), jnp.int32),
+                              jnp.zeros((n, _BIAS_BUCKET), jnp.float32))
 
     def _admit_one(self) -> bool:
         req = self._deferred
@@ -1392,10 +1464,20 @@ class InferenceEngine:
                     bucket=bucket)
             # Pull the logits row at most ONCE: in multi-host mode
             # _pull is a cross-host collective, not a cached host copy.
+            bias = req.params.logit_bias
             logits_row = self._pull(logits)[0] \
-                if temp > 0.0 or req.params.logprobs else None
+                if temp > 0.0 or req.params.logprobs or bias else None
+            # logit_bias on the FIRST token applies host-side (b=1 row
+            # already on host); reported logprobs stay raw.
+            sample_row = logits_row
+            if bias:
+                sample_row = logits_row.copy()
+                for t, b in bias.items():
+                    sample_row[int(t)] += float(b)
             if temp > 0.0:
-                first = self._sample(logits_row, req)
+                first = self._sample(sample_row, req)
+            elif bias:
+                first = int(np.argmax(sample_row))
             else:
                 first = int(self._pull(greedy)[0])   # 4-byte pull
             # logprobs: the row pull is the documented TTFT cost of
@@ -1403,13 +1485,15 @@ class InferenceEngine:
             first_lp = _np_raw_lp(logits_row, first) \
                 if req.params.logprobs else None
             self._ensure_dev_args()
+            bidx, bval = _bias_arrays(req.params)
             ins_args = (jnp.int32(slot), self._dev_args,
                         jnp.int32(first), jnp.int32(n),
                         jnp.float32(temp), key,
                         jnp.int32(min(req.params.top_k, _TOPK_BUCKET)),
                         jnp.float32(req.params.top_p),
                         jnp.float32(req.params.presence_penalty),
-                        jnp.float32(req.params.frequency_penalty))
+                        jnp.float32(req.params.frequency_penalty),
+                        jnp.asarray(bidx), jnp.asarray(bval))
             if self.cache_mode == 'paged':
                 reserved = int((row > 0).sum())
                 p = self.pool.cfg.page_size
@@ -1485,6 +1569,7 @@ class InferenceEngine:
                     jnp.asarray(hist_toks), jnp.int32(n),
                     jnp.int32(first))
         req.first_token_at = time.time()
+        self._ttfts.append(req.first_token_at - req.submitted_at)
         req.slot = slot
         self._slot_lora[slot] = req.params.lora_id
         req.generated = 1
@@ -1560,16 +1645,25 @@ class InferenceEngine:
                 return
             temp = max(0.0, req.params.temperature)
             # One logits pull (multi-host: each pull is a collective).
+            bias = req.params.logit_bias
             logits_row = self._pull(logits)[0] \
-                if temp > 0.0 or req.params.logprobs else None
+                if temp > 0.0 or req.params.logprobs or bias else None
+            sample_row = logits_row
+            if bias:   # same host-side first-token bias as _admit_one
+                sample_row = logits_row.copy()
+                for t, b in bias.items():
+                    sample_row[int(t)] += float(b)
             if temp > 0.0:
-                first = self._sample(logits_row, req)
+                first = self._sample(sample_row, req)
+            elif bias:
+                first = int(np.argmax(sample_row))
             else:
                 first = int(self._pull(greedy)[0])
             first_lp = _np_raw_lp(logits_row, first) \
                 if req.params.logprobs else None
             key = jax.random.PRNGKey(req.params.seed + req.req_id)
             self._ensure_dev_args()
+            bidx, bval = _bias_arrays(req.params)
             self.cache, self._dev_args = self._jit_insert_paged(
                 self.cache, pc, jnp.int32(slot), self._dev_args,
                 jnp.int32(first), jnp.int32(n), jnp.float32(temp), key,
@@ -1577,6 +1671,7 @@ class InferenceEngine:
                 jnp.float32(req.params.top_p),
                 jnp.float32(req.params.presence_penalty),
                 jnp.float32(req.params.frequency_penalty),
+                jnp.asarray(bidx), jnp.asarray(bval),
                 jnp.asarray(ids), jnp.asarray(row),
                 jnp.int32(first_page * psize))
             if self.prefix_caching:
@@ -1708,6 +1803,8 @@ class InferenceEngine:
                     self._slots[i].params.presence_penalty != 0.0 or
                     self._slots[i].params.frequency_penalty != 0.0
                     for i in active)
+                biased = any(self._slots[i].params.logit_bias
+                             for i in active)
                 k = self.spec_decode
                 # Speculation needs headroom for the worst case (every
                 # draft accepted); sampled slots ride the rejection-
@@ -1716,11 +1813,12 @@ class InferenceEngine:
                 # shifts WITHIN a draft run (each emitted token changes
                 # the counts), which the one-shot verify cannot honor —
                 # the same fallback vLLM makes.
-                use_spec = k > 0 and not penalize and \
-                    rem_space // (k + 1) >= 1
+                use_spec = k > 0 and not penalize and not biased \
+                    and rem_space // (k + 1) >= 1
                 self._ensure_dev_args()
                 (d_last, d_lens, d_temps, d_keys, d_topks, d_topps,
-                 d_press, d_freqs, d_counts) = self._dev_args
+                 d_press, d_freqs, d_counts, d_bidx,
+                 d_bval) = self._dev_args
                 entries = [(i, self._slots[i]) for i in active]
                 if use_spec:
                     bound = max(1, min(self.decode_chunk,
@@ -1748,7 +1846,7 @@ class InferenceEngine:
                                     k=k, sampling=sampling)
                     self._dev_args = (d_last, d_lens, d_temps, d_keys,
                                       d_topks, d_topps, d_press,
-                                      d_freqs, d_counts)
+                                      d_freqs, d_counts, d_bidx, d_bval)
                     new_pending = ('spec', toks, lps, counts,
                                    entries, chunk)
                     upper = chunk * (k + 1)
@@ -1765,12 +1863,12 @@ class InferenceEngine:
                                 self.cache, d_last, d_lens,
                                 d_temps, d_keys, d_topks, d_topps,
                                 d_press, d_freqs, d_counts,
-                                self._dev_hist,
+                                self._dev_hist, d_bidx, d_bval,
                                 n=chunk, sampling=sampling,
-                                penalize=penalize)
+                                penalize=penalize, biased=biased)
                     self._dev_args = (d_last, d_lens, d_temps, keys,
                                       d_topks, d_topps, d_press,
-                                      d_freqs, d_counts)
+                                      d_freqs, d_counts, d_bidx, d_bval)
                     new_pending = ('plain', toks, lps, None,
                                    entries, chunk)
                     upper = chunk

@@ -171,8 +171,13 @@ class InferenceServer:
             {'model': payload['lora']} if payload.get('lora') else {})
         if lora_err is not None:
             return lora_err
+        try:
+            bias = self._parse_logit_bias(payload)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
         params = engine_lib.SamplingParams(
             lora_id=lora_id,
+            logit_bias=bias,
             max_new_tokens=int(max_new),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
@@ -215,12 +220,31 @@ class InferenceServer:
     # /v1/models); these endpoints make our replicas drop-in for OpenAI
     # SDK clients pointed at the service endpoint.
 
+    @staticmethod
+    def _parse_logit_bias(payload):
+        """OpenAI logit_bias arrives with STRING token-id keys; a
+        malformed entry raises ValueError naming the actual offender
+        (handlers turn it into a 400)."""
+        raw = payload.get('logit_bias')
+        if not isinstance(raw, dict) or not raw:
+            return None
+        out = {}
+        for k, v in raw.items():
+            try:
+                out[int(k)] = float(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f'logit_bias entries must map integer token ids '
+                    f'to numbers, got {k!r}: {v!r}') from None
+        return out
+
     def _sampling_from_openai(self, payload,
                               lora_id: int = 0
                               ) -> 'engine_lib.SamplingParams':
         temp = float(payload.get('temperature', 0.0))
         return engine_lib.SamplingParams(
             lora_id=lora_id,
+            logit_bias=self._parse_logit_bias(payload),
             max_new_tokens=int(payload.get('max_tokens', 128)),
             temperature=temp,
             top_k=int(payload.get('top_k', 0)),
@@ -238,18 +262,23 @@ class InferenceServer:
             logprobs=(payload.get('logprobs') is not None and
                       payload.get('logprobs') is not False))
 
-    @staticmethod
-    def _params_error(params) -> Optional[str]:
+    def _params_error(self, params) -> Optional[str]:
         """Error message for sampling params the engine would reject
-        (top_k > 64, out-of-range top_p/temperature) — handlers return
-        it as a 400 BEFORE submitting, so invalid work never occupies
-        an engine slot and OpenAI clients get the standard
-        invalid-parameter behavior instead of a 500."""
+        (top_k > 64, out-of-range top_p/temperature, out-of-vocab
+        logit_bias ids) — handlers return it as a 400 BEFORE
+        submitting, so invalid work never occupies an engine slot and
+        OpenAI clients get the standard invalid-parameter behavior
+        instead of a 500."""
         try:
             params.validate()
-            return None
         except ValueError as e:
             return str(e)
+        bad = [t for t in (params.logit_bias or {})
+               if t >= self.engine.cfg.vocab_size]
+        if bad:
+            return (f'logit_bias token ids out of vocab '
+                    f'(V={self.engine.cfg.vocab_size}): {bad[:5]}')
+        return None
 
     @staticmethod
     def _parse_n(payload) -> Optional[int]:
@@ -527,7 +556,10 @@ class InferenceServer:
         lora_id, lora_err = self._resolve_lora(payload)
         if lora_err is not None:
             return lora_err
-        params = self._sampling_from_openai(payload, lora_id)
+        try:
+            params = self._sampling_from_openai(payload, lora_id)
+        except (TypeError, ValueError) as e:
+            return web.json_response({'error': str(e)}, status=400)
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
@@ -612,7 +644,10 @@ class InferenceServer:
         lora_id, lora_err = self._resolve_lora(payload)
         if lora_err is not None:
             return lora_err
-        params = self._sampling_from_openai(payload, lora_id)
+        try:
+            params = self._sampling_from_openai(payload, lora_id)
+        except (TypeError, ValueError) as e:
+            return web.json_response({'error': str(e)}, status=400)
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)

@@ -45,11 +45,15 @@ def synthetic_batches(vocab_size: int, batch: int, seq: int,
         yield {'tokens': toks[:, :-1], 'targets': toks[:, 1:]}
 
 
-def jsonl_batches(path: str, vocab_size: int, batch: int, seq: int
-                  ) -> Iterator[Dict[str, np.ndarray]]:
+def jsonl_batches(path: str, vocab_size: int, batch: int, seq: int,
+                  tokenizer=None) -> Iterator[Dict[str, np.ndarray]]:
     """Pack {'text' or 'tokens'} JSONL rows into fixed [B,S] batches.
-    Byte-level fallback tokenizer keeps this dependency-free; pass
-    pre-tokenized 'tokens' for real runs."""
+
+    tokenizer: optional infer.tokenizer instance (--data-tokenizer
+    points at a checkpoint dir's tokenizer.json) used for 'text' rows —
+    real-vocab finetunes. Without one, text falls back to byte-level
+    (dependency-free; fine for smoke/debug runs); pre-tokenized
+    'tokens' rows bypass both."""
     def _tokens():
         while True:
             n_rows = 0
@@ -62,6 +66,10 @@ def jsonl_batches(path: str, vocab_size: int, batch: int, seq: int
                     if 'tokens' in row:
                         yield from (int(t) % vocab_size
                                     for t in row['tokens'])
+                    elif tokenizer is not None:
+                        yield from (int(t) % vocab_size
+                                    for t in tokenizer.encode(
+                                        row['text']))
                     else:
                         yield from (b % vocab_size
                                     for b in row['text'].encode())
@@ -99,6 +107,11 @@ def main(argv=None) -> None:
     parser.add_argument('--lr', type=float, default=3e-4)
     parser.add_argument('--data', default=None,
                         help='JSONL path; default synthetic')
+    parser.add_argument('--data-tokenizer', default=None,
+                        help='tokenizer dir/file (tokenizer.json) for '
+                             "JSONL 'text' rows; default byte-level "
+                             'fallback. Typically the base checkpoint '
+                             'dir.')
     parser.add_argument('--lora-rank', type=int, default=0,
                         help='> 0 enables LoRA: only adapter params '
                              'train (reference: llm/llama-3_1-finetuning'
@@ -260,8 +273,12 @@ def main(argv=None) -> None:
                                                 lora_cfg)
     else:
         step_fn = trainer.make_train_step(model, tx, mesh)
+    data_tok = None
+    if args.data and args.data_tokenizer:
+        from skypilot_tpu.infer import tokenizer as tokenizer_lib
+        data_tok = tokenizer_lib.load_tokenizer(args.data_tokenizer)
     batches = (jsonl_batches(args.data, cfg.vocab_size, args.batch,
-                             args.seq)
+                             args.seq, tokenizer=data_tok)
                if args.data else
                synthetic_batches(cfg.vocab_size, args.batch, args.seq))
 

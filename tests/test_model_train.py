@@ -216,3 +216,24 @@ class TestOps:
         y = norms.rms_norm(x, jnp.ones((8,)))
         rms = np.sqrt(np.mean(np.asarray(y) ** 2, -1))
         np.testing.assert_allclose(rms, 1.0, rtol=1e-3)
+
+
+def test_jsonl_batches_with_hf_tokenizer(tmp_path):
+    """--data-tokenizer: JSONL 'text' rows tokenize through the real
+    tokenizer instead of the byte fallback."""
+    import json as _json
+
+    from skypilot_tpu.train import sft
+
+    data = tmp_path / 'd.jsonl'
+    data.write_text(_json.dumps({'text': 'hello world'}) + '\n')
+
+    class FakeTok:
+        def encode(self, text):
+            return [7] * len(text.split())
+    got = next(sft.jsonl_batches(str(data), 256, 1, 4,
+                                 tokenizer=FakeTok()))
+    # stream: 7 7 0 7 7 0 ... packed into [1, 5] -> tokens [1,4]
+    assert got['tokens'].tolist() == [[7, 7, 0, 7]]
+    byte = next(sft.jsonl_batches(str(data), 256, 1, 4))
+    assert byte['tokens'].tolist() == [[104, 101, 108, 108]]  # 'hell'

@@ -310,3 +310,88 @@ def test_adapter_name_collides_with_model_id():
     with pytest.raises(ValueError, match='collides'):
         server_lib.InferenceServer(eng, model_id='sql-ft',
                                    lora_names={'sql-ft': 1})
+
+
+def test_stats_report_ttft_percentiles():
+    """/stats surfaces TTFT p50/p90/p99 from the rolling window (the
+    reference reads these off vLLM's metrics endpoint)."""
+    cfg, model, params = _base()
+    eng = _engine(model, params)
+    eng.start()
+    try:
+        for _ in range(3):
+            _greedy(eng, [5, 17, 3], n=2)
+        s = eng.stats()
+    finally:
+        eng.stop()
+    t = s['ttft_ms']
+    assert t['count'] == 3
+    assert 0 < t['p50'] <= t['p90'] <= t['p99']
+
+
+# ---------------------------------------------------------- logit_bias
+# OpenAI logit_bias (vLLM serves it too): device-side scatter-add on
+# the decode path, host-side add on the admission (first-token) path.
+
+def test_logit_bias_forces_and_bans_tokens():
+    cfg, model, params = _base()
+    eng = _engine(model, params)
+    eng.start()
+    try:
+        plain = _greedy(eng, [5, 17, 3], n=4)
+        # +100 on one token dominates every logit: all outputs = 9.
+        forced = eng.generate([5, 17, 3], engine_lib.SamplingParams(
+            max_new_tokens=4, logit_bias={9: 100.0}))
+        assert forced == [9, 9, 9, 9]
+        # -100 on the greedy first token bans it everywhere.
+        banned = eng.generate([5, 17, 3], engine_lib.SamplingParams(
+            max_new_tokens=4, logit_bias={plain[0]: -100.0}))
+        assert plain[0] not in banned
+    finally:
+        eng.stop()
+
+
+def test_logit_bias_sampling_path():
+    """temperature > 0 with a dominating bias still lands on the
+    biased token (the bias applies before temperature/top-k)."""
+    cfg, model, params = _base()
+    eng = _engine(model, params)
+    eng.start()
+    try:
+        out = eng.generate([5, 17, 3], engine_lib.SamplingParams(
+            max_new_tokens=4, temperature=1.0, seed=7,
+            logit_bias={11: 100.0}))
+        assert out == [11, 11, 11, 11]
+    finally:
+        eng.stop()
+
+
+def test_logit_bias_spec_decode_falls_back_exact():
+    """Spec decoding falls back to the plain path for biased requests;
+    outputs equal the non-spec engine's."""
+    cfg, model, params = _base()
+    prompt = [5, 6, 5, 6, 5, 6]
+
+    def run(spec):
+        eng = _engine(model, params, cache_mode='paged', page_size=16,
+                      spec_decode=spec)
+        eng.start()
+        try:
+            return eng.generate(prompt, engine_lib.SamplingParams(
+                max_new_tokens=6, logit_bias={3: 5.0, 8: -5.0}))
+        finally:
+            eng.stop()
+    assert run(2) == run(0)
+
+
+def test_logit_bias_validation():
+    cfg, model, params = _base()
+    eng = _engine(model, params)
+    with pytest.raises(ValueError, match='at most 64'):
+        engine_lib.SamplingParams(
+            logit_bias={i: 1.0 for i in range(65)}).validate()
+    with pytest.raises(ValueError, match=r'\[-100, 100\]'):
+        engine_lib.SamplingParams(logit_bias={1: 200.0}).validate()
+    with pytest.raises(ValueError, match='out of vocab'):
+        eng.submit([1, 2], engine_lib.SamplingParams(
+            logit_bias={cfg.vocab_size + 5: 1.0}))
