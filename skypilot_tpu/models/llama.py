@@ -57,6 +57,9 @@ class LlamaConfig:
     # Qwen2(.5) = llama + q/k/v biases; Gemma = GeGLU + zero-centered
     # RMSNorm + sqrt(dim) embedding scale + decoupled head_dim.
     attn_bias: bool = False          # Qwen2: bias on q/k/v projections
+    # Qwen3: per-head RMSNorm on q and k (over head_dim, weights shaped
+    # [head_dim]) applied BEFORE rope; replaces Qwen2's q/k/v biases.
+    qk_norm: bool = False
     head_dim_override: int = 0       # Gemma: head_dim != dim/n_heads
     mlp_act: str = 'silu'            # 'silu' | 'gelu_tanh' (Gemma)
     norm_zero_centered: bool = False  # Gemma: weight applied as (1+w)
@@ -99,6 +102,8 @@ class LlamaConfig:
             self.n_heads * self.head_dim * d
         if self.attn_bias:
             attn += (self.n_heads + 2 * self.n_kv_heads) * self.head_dim
+        if self.qk_norm:
+            attn += 2 * self.head_dim
         mlp = 3 * d * self.mlp_dim
         per_layer = attn + mlp + (4 if self.sandwich_norms else 2) * d
         embeds = v * d * (1 if self.tie_embeddings else 2)
@@ -129,6 +134,19 @@ CONFIGS = {
                             max_seq_len=32768, rope_theta=1e6,
                             use_llama31_rope=False, norm_eps=1e-6,
                             attn_bias=True),
+    # Qwen3 released shapes (HF Qwen3Config: per-head q/k RMSNorm, no
+    # attention biases, decoupled head_dim 128).
+    'qwen3-0.6b': LlamaConfig(vocab_size=151936, dim=1024, n_layers=28,
+                              n_heads=16, n_kv_heads=8, mlp_dim=3072,
+                              head_dim_override=128, max_seq_len=32768,
+                              rope_theta=1e6, use_llama31_rope=False,
+                              norm_eps=1e-6, tie_embeddings=True,
+                              qk_norm=True),
+    'qwen3-8b': LlamaConfig(vocab_size=151936, dim=4096, n_layers=36,
+                            n_heads=32, n_kv_heads=8, mlp_dim=12288,
+                            head_dim_override=128, max_seq_len=32768,
+                            rope_theta=1e6, use_llama31_rope=False,
+                            norm_eps=1e-6, qk_norm=True),
     # Mistral-7B-v0.1 shape (HF MistralConfig): llama + sliding-window
     # attention on every layer.
     'mistral-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
@@ -374,6 +392,11 @@ class LlamaAttention(nn.Module):
         v = proj('wv', hk * hd, ('embed', 'kv_heads'), x,
                  cfg.attn_bias).reshape(b, s, hk, hd)
 
+        if cfg.qk_norm:
+            # Norm over head_dim of the reshaped [b, s, h, hd] — the
+            # Qwen3 convention (weights [hd], shared across heads).
+            q = RMSNorm(cfg, name='q_norm', axis_name=None)(q)
+            k = RMSNorm(cfg, name='k_norm', axis_name=None)(k)
         q = rope.apply_rope(q, cos, sin)
         k = rope.apply_rope(k, cos, sin)
         q = nn.with_logical_constraint(

@@ -63,6 +63,12 @@ def _layer_map(cfg) -> Dict[tuple, tuple]:
     m = dict(_LAYER_MAP)
     if getattr(cfg, 'attn_bias', False):
         m.update(_ATTN_BIAS_MAP)
+    if getattr(cfg, 'qk_norm', False):
+        # Qwen3 per-head q/k norms ([head_dim] weights).
+        m[('attn', 'q_norm', 'weight')] = \
+            ('self_attn.q_norm.weight', False)
+        m[('attn', 'k_norm', 'weight')] = \
+            ('self_attn.k_norm.weight', False)
     if getattr(cfg, 'sandwich_norms', False):
         # Gemma-2 names its four per-layer norms differently: HF
         # 'post_attention_layernorm' is the POST-attention sandwich
@@ -572,6 +578,10 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
     if model_type == 'qwen2':
         # HF Qwen2Attention hardcodes q/k/v biases (no config field).
         kw['attn_bias'] = True
+    elif model_type == 'qwen3':
+        # Qwen3 drops the biases for per-head q/k RMSNorm.
+        kw['qk_norm'] = True
+        kw['attn_bias'] = hf_config.get('attention_bias', False)
     elif model_type == 'mistral':
         # Architecturally llama + sliding-window attention on every
         # layer (ops/attention.py implements the window mask, so the
@@ -610,13 +620,15 @@ def config_to_hf(cfg) -> Dict[str, Any]:
     writes; enough for transformers' matching *ForCausalLM to reload).
 
     The family is recovered from the knobs: sandwich_norms -> gemma2,
-    norm_zero_centered -> gemma, attn_bias -> qwen2, sliding_window
-    (non-gemma2) -> mistral, else llama (the inverse of
+    norm_zero_centered -> gemma, qk_norm -> qwen3, attn_bias -> qwen2,
+    sliding_window (non-gemma2) -> mistral, else llama (the inverse of
     config_from_hf's dispatch)."""
     if cfg.sandwich_norms:
         model_type, arch = 'gemma2', 'Gemma2ForCausalLM'
     elif cfg.norm_zero_centered:
         model_type, arch = 'gemma', 'GemmaForCausalLM'
+    elif cfg.qk_norm:
+        model_type, arch = 'qwen3', 'Qwen3ForCausalLM'
     elif cfg.attn_bias:
         model_type, arch = 'qwen2', 'Qwen2ForCausalLM'
     elif cfg.sliding_window > 0:
@@ -644,6 +656,12 @@ def config_to_hf(cfg) -> Dict[str, Any]:
     if model_type in ('gemma', 'gemma2'):
         # GemmaConfig reads 'hidden_activation' (hidden_act is legacy).
         out['hidden_activation'] = out['hidden_act']
+    if model_type == 'qwen3':
+        # Read back by config_from_hf; HF defaults attention_bias to
+        # False, so an explicit value keeps biased qwen3 checkpoints
+        # round-tripping (transformers would otherwise silently drop
+        # the saved bias tensors on reload).
+        out['attention_bias'] = cfg.attn_bias
     if model_type == 'mistral':
         out['sliding_window'] = cfg.sliding_window
     if model_type == 'gemma2':

@@ -426,6 +426,10 @@ def _family_debug_cfg(family):
     if family == 'qwen2':
         return dataclasses.replace(base, attn_bias=True, norm_eps=1e-6,
                                    rope_theta=1e6)
+    if family == 'qwen3':
+        return dataclasses.replace(base, qk_norm=True, norm_eps=1e-6,
+                                   rope_theta=1e6, head_dim_override=32,
+                                   tie_embeddings=True)
     if family == 'gemma':
         return dataclasses.replace(
             base, mlp_act='gelu_tanh', norm_zero_centered=True,
@@ -464,7 +468,8 @@ def _random_family_params(cfg, seed=7):
     return model, {'params': params}
 
 
-@pytest.mark.parametrize('family', ['qwen2', 'gemma', 'gemma2'])
+@pytest.mark.parametrize('family',
+                         ['qwen2', 'qwen3', 'gemma', 'gemma2'])
 def test_family_logits_match_transformers(family, tmp_path):
     """save -> config round-trip -> load -> logits == transformers'
     family implementation on the same checkpoint."""
@@ -497,7 +502,8 @@ def test_family_logits_match_transformers(family, tmp_path):
         str(ckpt), torch_dtype=torch.float32,
         attn_implementation='eager')
     assert type(hf_model).__name__ == {
-        'qwen2': 'Qwen2ForCausalLM', 'gemma': 'GemmaForCausalLM',
+        'qwen2': 'Qwen2ForCausalLM', 'qwen3': 'Qwen3ForCausalLM',
+        'gemma': 'GemmaForCausalLM',
         'gemma2': 'Gemma2ForCausalLM'}[family]
     hf_model.eval()
 
@@ -511,7 +517,8 @@ def test_family_logits_match_transformers(family, tmp_path):
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
-@pytest.mark.parametrize('family', ['qwen2', 'gemma', 'gemma2'])
+@pytest.mark.parametrize('family',
+                         ['qwen2', 'qwen3', 'gemma', 'gemma2'])
 def test_family_engine_decode(family, tmp_path):
     """build_engine(checkpoint=<family ckpt>) decodes end-to-end —
     proves the serve path's model-type dispatch, not just logits."""
