@@ -560,6 +560,9 @@ class InferenceServer:
             params = self._sampling_from_openai(payload, lora_id)
         except (TypeError, ValueError) as e:
             return web.json_response({'error': str(e)}, status=400)
+        # Echo the requested model (adapter name for multi-LoRA
+        # requests) back in responses, the vLLM convention.
+        model_name = payload.get('model') or self.model_id
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
@@ -583,7 +586,7 @@ class InferenceServer:
 
             def chunk(piece, reason=None):
                 return {'id': f'cmpl-{rid}', 'object': 'text_completion',
-                        'model': self.model_id,
+                        'model': model_name,
                         'choices': [{'index': 0,
                                      'text': piece or '',
                                      'finish_reason': reason}]}
@@ -608,7 +611,7 @@ class InferenceServer:
         n_in = sum(len(t) for t in token_lists)
         return web.json_response({
             'id': f'cmpl-{subs[0][0]}', 'object': 'text_completion',
-            'model': self.model_id, 'choices': choices,
+            'model': model_name, 'choices': choices,
             'usage': {'prompt_tokens': n_in,
                       'completion_tokens': total_out,
                       'total_tokens': n_in + total_out},
@@ -648,6 +651,9 @@ class InferenceServer:
             params = self._sampling_from_openai(payload, lora_id)
         except (TypeError, ValueError) as e:
             return web.json_response({'error': str(e)}, status=400)
+        # Echo the requested model (adapter name for multi-LoRA
+        # requests) back in responses, the vLLM convention.
+        model_name = payload.get('model') or self.model_id
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
@@ -681,7 +687,7 @@ class InferenceServer:
                     delta['content'] = piece
                 return {'id': f'chatcmpl-{rid}',
                         'object': 'chat.completion.chunk',
-                        'model': self.model_id,
+                        'model': model_name,
                         'choices': [{'index': 0, 'delta': delta,
                                      'finish_reason': reason}]}
             return await self._sse(request, chunk, out_q, params,
@@ -700,7 +706,7 @@ class InferenceServer:
                             'finish_reason': reason})
         return web.json_response({
             'id': f'chatcmpl-{rid}', 'object': 'chat.completion',
-            'model': self.model_id,
+            'model': model_name,
             'choices': choices,
             'usage': {'prompt_tokens': len(tokens),
                       'completion_tokens': total_out,

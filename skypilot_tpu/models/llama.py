@@ -81,6 +81,11 @@ class LlamaConfig:
     # Gemma-2 sandwich norms: post-attention and pre/post-feedforward
     # RMSNorms in addition to the two pre-norms.
     sandwich_norms: bool = False
+    # HF checkpoint tensor layout: 'llama' (separate q/k/v and
+    # gate/up tensors) or 'phi3' (fused qkv_proj and gate_up_proj) —
+    # an I/O-only knob (models/weights.py splits on load, fuses on
+    # save); the module math is identical.
+    hf_layout: str = 'llama'
 
     @property
     def head_dim(self) -> int:
@@ -147,6 +152,14 @@ CONFIGS = {
                             head_dim_override=128, max_seq_len=32768,
                             rope_theta=1e6, use_llama31_rope=False,
                             norm_eps=1e-6, qk_norm=True),
+    # Phi-3-mini shape (HF Phi3Config): llama math behind fused
+    # qkv_proj/gate_up_proj checkpoint tensors; the -4k variant also
+    # carries a 2047-token sliding window.
+    'phi3-mini': LlamaConfig(vocab_size=32064, dim=3072, n_layers=32,
+                             n_heads=32, n_kv_heads=32, mlp_dim=8192,
+                             max_seq_len=4096, rope_theta=10000.0,
+                             use_llama31_rope=False, norm_eps=1e-5,
+                             sliding_window=2047, hf_layout='phi3'),
     # Mistral-7B-v0.1 shape (HF MistralConfig): llama + sliding-window
     # attention on every layer.
     'mistral-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,

@@ -112,12 +112,71 @@ class _ShardReader:
     def __contains__(self, name: str) -> bool:
         return name in self._weight_map
 
-    def get(self, name: str) -> np.ndarray:
+    def _handle(self, name: str):
         fname = self._weight_map[name]
         if fname not in self._handles:
             self._handles[fname] = self._safe_open(
                 os.path.join(self.ckpt_dir, fname), framework='np')
-        return self._handles[fname].get_tensor(name)
+        return self._handles[fname]
+
+    def get(self, name: str) -> np.ndarray:
+        return self._handle(name).get_tensor(name)
+
+    def get_rows(self, name: str, start: int, stop: int) -> np.ndarray:
+        """Read only rows [start, stop) of a tensor — safetensors
+        slices straight from the mmap, so splitting a fused tensor
+        (phi3 qkv_proj) never materializes the unneeded rows."""
+        return self._handle(name).get_slice(name)[start:stop]
+
+
+class _FusedSplitView:
+    """Reader adapter for hf_layout='phi3': q/k/v_proj rows are
+    slices of self_attn.qkv_proj (q, then k, then v) and gate/up_proj
+    rows are halves of mlp.gate_up_proj — the loader keeps speaking
+    the per-tensor llama names."""
+
+    _RE = None
+
+    def __init__(self, reader, cfg) -> None:
+        import re
+        self._r = reader
+        self._cfg = cfg
+        if _FusedSplitView._RE is None:
+            _FusedSplitView._RE = re.compile(
+                r'(model\.layers\.\d+\.)'
+                r'(?:self_attn\.(q|k|v)_proj|mlp\.(gate|up)_proj)'
+                r'\.weight$')
+
+    def __contains__(self, name: str) -> bool:
+        m = self._RE.match(name)
+        if m is None:
+            return name in self._r
+        if m.group(2):
+            return m.group(1) + 'self_attn.qkv_proj.weight' in self._r
+        return m.group(1) + 'mlp.gate_up_proj.weight' in self._r
+
+    def get(self, name: str) -> np.ndarray:
+        m = self._RE.match(name)
+        if m is None:
+            return self._r.get(name)
+        cfg = self._cfg
+        if m.group(2):
+            fused_name = m.group(1) + 'self_attn.qkv_proj.weight'
+            q_rows = cfg.n_heads * cfg.head_dim
+            kv_rows = cfg.n_kv_heads * cfg.head_dim
+            bounds = {'q': (0, q_rows),
+                      'k': (q_rows, q_rows + kv_rows),
+                      'v': (q_rows + kv_rows, q_rows + 2 * kv_rows)}
+            lo, hi = bounds[m.group(2)]
+        else:
+            fused_name = m.group(1) + 'mlp.gate_up_proj.weight'
+            lo, hi = ((0, cfg.mlp_dim) if m.group(3) == 'gate'
+                      else (cfg.mlp_dim, 2 * cfg.mlp_dim))
+        # Row-sliced read: only the requested projection's rows leave
+        # the mmap — the loader iterates suffix-major (all layers' wq,
+        # then wk, ...), so whole-tensor reads would be paid 3x for
+        # qkv and 2x for gate_up.
+        return self._r.get_rows(fused_name, lo, hi)
 
 
 def _np_cast(arr: np.ndarray, dtype) -> np.ndarray:
@@ -218,6 +277,8 @@ def load_llama_params(cfg, ckpt_dir: str, *,
     dtype = _resolve_dtype(cfg, param_dtype)
 
     reader = _ShardReader(ckpt_dir)
+    if getattr(cfg, 'hf_layout', 'llama') == 'phi3':
+        reader = _FusedSplitView(reader, cfg)
     shardings = None
     if mesh is not None:
         import dataclasses as _dc
@@ -517,6 +578,18 @@ def save_hf_checkpoint(cfg, variables: Dict[str, Any],
                 out[f'model.layers.{i}.{suffix}'] = (
                     arr.T if transpose else arr)
 
+    if getattr(cfg, 'hf_layout', 'llama') == 'phi3':
+        # Fuse back into phi3's qkv_proj/gate_up_proj layout (HF
+        # [out, in]: concatenate along the out-rows axis).
+        for i in range(cfg.n_layers):
+            pre = f'model.layers.{i}.'
+            out[pre + 'self_attn.qkv_proj.weight'] = np.concatenate(
+                [out.pop(pre + f'self_attn.{p}_proj.weight')
+                 for p in ('q', 'k', 'v')], axis=0)
+            out[pre + 'mlp.gate_up_proj.weight'] = np.concatenate(
+                [out.pop(pre + 'mlp.gate_proj.weight'),
+                 out.pop(pre + 'mlp.up_proj.weight')], axis=0)
+
     # safetensors requires contiguous, native-endian arrays.
     out = {k: np.ascontiguousarray(v) for k, v in out.items()}
     safetensors.numpy.save_file(
@@ -561,6 +634,27 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
 
     model_type = hf_config.get('model_type', 'llama')
     rope_scaling = hf_config.get('rope_scaling') or {}
+    rs_type = rope_scaling.get('rope_type', rope_scaling.get('type'))
+    if rs_type not in (None, 'default', 'llama3'):
+        # longrope/yarn/etc. would silently produce wrong positions.
+        raise ValueError(
+            f'unsupported rope_scaling type {rs_type!r} in checkpoint '
+            f'config (supported: llama3); long-context variants using '
+            f'longrope/yarn are not implemented')
+    if rs_type == 'llama3':
+        # ops/rope.py implements the Llama-3.1 constants; a different
+        # factor set (e.g. Llama-3.2's factor=32) would silently serve
+        # wrong long-context positions.
+        want = {'factor': 8.0, 'low_freq_factor': 1.0,
+                'high_freq_factor': 4.0,
+                'original_max_position_embeddings': 8192}
+        got = {k: rope_scaling.get(k) for k in want}
+        if any(got[k] is not None and float(got[k]) != v
+               for k, v in want.items()):
+            raise ValueError(
+                f'llama3 rope_scaling with non-3.1 factors is not '
+                f'implemented: checkpoint has {got}, ops/rope.py '
+                f'implements {want}')
     kw = dict(
         vocab_size=hf_config['vocab_size'],
         dim=hf_config['hidden_size'],
@@ -571,7 +665,7 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
         mlp_dim=hf_config['intermediate_size'],
         max_seq_len=hf_config.get('max_position_embeddings', 8192),
         rope_theta=hf_config.get('rope_theta', 500000.0),
-        use_llama31_rope=rope_scaling.get('rope_type') == 'llama3',
+        use_llama31_rope=rs_type == 'llama3',
         norm_eps=hf_config.get('rms_norm_eps', 1e-5),
         tie_embeddings=hf_config.get('tie_word_embeddings', False),
     )
@@ -586,6 +680,12 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
         # Architecturally llama + sliding-window attention on every
         # layer (ops/attention.py implements the window mask, so the
         # full max_position_embeddings context serves correctly).
+        kw['sliding_window'] = hf_config.get('sliding_window') or 0
+    elif model_type == 'phi3':
+        # Llama math behind fused qkv_proj/gate_up_proj tensors
+        # (split on load, fused on save); -4k minis also carry a
+        # sliding window.
+        kw['hf_layout'] = 'phi3'
         kw['sliding_window'] = hf_config.get('sliding_window') or 0
     elif model_type == 'gemma':
         kw['mlp_act'] = 'gelu_tanh'
@@ -631,6 +731,8 @@ def config_to_hf(cfg) -> Dict[str, Any]:
         model_type, arch = 'qwen3', 'Qwen3ForCausalLM'
     elif cfg.attn_bias:
         model_type, arch = 'qwen2', 'Qwen2ForCausalLM'
+    elif getattr(cfg, 'hf_layout', 'llama') == 'phi3':
+        model_type, arch = 'phi3', 'Phi3ForCausalLM'
     elif cfg.sliding_window > 0:
         model_type, arch = 'mistral', 'MistralForCausalLM'
     else:
@@ -662,8 +764,12 @@ def config_to_hf(cfg) -> Dict[str, Any]:
         # round-tripping (transformers would otherwise silently drop
         # the saved bias tensors on reload).
         out['attention_bias'] = cfg.attn_bias
-    if model_type == 'mistral':
-        out['sliding_window'] = cfg.sliding_window
+    if model_type in ('mistral', 'phi3'):
+        out['sliding_window'] = cfg.sliding_window or None
+    if model_type == 'phi3':
+        # Phi3Config defaults pad_token_id=32000, which explodes on
+        # smaller vocabs; no padding index is the general truth here.
+        out['pad_token_id'] = None
     if model_type == 'gemma2':
         out['sliding_window'] = cfg.sliding_window
         out['attn_logit_softcapping'] = cfg.attn_softcap or None

@@ -430,6 +430,11 @@ def _family_debug_cfg(family):
         return dataclasses.replace(base, qk_norm=True, norm_eps=1e-6,
                                    rope_theta=1e6, head_dim_override=32,
                                    tie_embeddings=True)
+    if family == 'phi3':
+        # Fused-tensor HF layout + a window smaller than the 12-token
+        # test prompts.
+        return dataclasses.replace(base, hf_layout='phi3',
+                                   sliding_window=8, rope_theta=10000.0)
     if family == 'gemma':
         return dataclasses.replace(
             base, mlp_act='gelu_tanh', norm_zero_centered=True,
@@ -469,7 +474,7 @@ def _random_family_params(cfg, seed=7):
 
 
 @pytest.mark.parametrize('family',
-                         ['qwen2', 'qwen3', 'gemma', 'gemma2'])
+                         ['qwen2', 'qwen3', 'gemma', 'gemma2', 'phi3'])
 def test_family_logits_match_transformers(family, tmp_path):
     """save -> config round-trip -> load -> logits == transformers'
     family implementation on the same checkpoint."""
@@ -503,8 +508,8 @@ def test_family_logits_match_transformers(family, tmp_path):
         attn_implementation='eager')
     assert type(hf_model).__name__ == {
         'qwen2': 'Qwen2ForCausalLM', 'qwen3': 'Qwen3ForCausalLM',
-        'gemma': 'GemmaForCausalLM',
-        'gemma2': 'Gemma2ForCausalLM'}[family]
+        'gemma': 'GemmaForCausalLM', 'gemma2': 'Gemma2ForCausalLM',
+        'phi3': 'Phi3ForCausalLM'}[family]
     hf_model.eval()
 
     rng = np.random.default_rng(3)
@@ -518,7 +523,7 @@ def test_family_logits_match_transformers(family, tmp_path):
 
 
 @pytest.mark.parametrize('family',
-                         ['qwen2', 'qwen3', 'gemma', 'gemma2'])
+                         ['qwen2', 'qwen3', 'gemma', 'gemma2', 'phi3'])
 def test_family_engine_decode(family, tmp_path):
     """build_engine(checkpoint=<family ckpt>) decodes end-to-end —
     proves the serve path's model-type dispatch, not just logits."""
@@ -656,4 +661,37 @@ def test_windowed_engine_decode_matches_full_forward(tmp_path):
                            engine_lib.SamplingParams(max_new_tokens=6))
     finally:
         eng.stop()
+    assert got == want
+
+
+def test_gemma2_tp_sharded_decode_matches_unsharded(tmp_path):
+    """The windowed/soft-capped family under tp=2: the traced
+    layer-index window gating and the masked XLA decode path hold up
+    under GSPMD sharding (token-exact vs the unsharded engine)."""
+    cfg = _family_debug_cfg('gemma2')
+    _, variables = _random_family_params(cfg)
+    ckpt = tmp_path / 'g2'
+    weights.save_hf_checkpoint(cfg, variables, str(ckpt))
+    cfg2 = weights.load_config(str(ckpt), max_seq_len=64,
+                               dtype=cfg.dtype,
+                               param_dtype=cfg.param_dtype, remat=False)
+    model = llama.LlamaModel(cfg2)
+    prompt = list(range(1, 13))   # > the 8-token window
+
+    def run(mesh):
+        loaded = weights.load_llama_params(cfg2, str(ckpt), mesh=mesh)
+        eng = engine_lib.InferenceEngine(model, loaded, num_slots=2,
+                                         max_seq_len=64,
+                                         prefill_buckets=[16],
+                                         cache_mode='paged',
+                                         page_size=16, mesh=mesh)
+        eng.start()
+        try:
+            return eng.generate(prompt, engine_lib.SamplingParams(
+                max_new_tokens=6))
+        finally:
+            eng.stop()
+
+    want = run(None)
+    got = run(mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=2)))
     assert got == want
