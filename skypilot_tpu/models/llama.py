@@ -461,10 +461,16 @@ class LlamaAttention(nn.Module):
                         q[:, 0], k_pool, v_pool, tables, pos)[:, None]
                 elif s > 1 and not cfg.needs_xla_attention and \
                         _os.environ.get(
-                            'SKYT_SPEC_PAGED_ATTN', 'xla') == 'pallas':
+                            'SKYT_SPEC_PAGED_ATTN',
+                            'pallas') == 'pallas':
                     # Multi-query kernel for the speculative verify
-                    # step. Opt-in until validated on real TPU (the
-                    # default gather path is the known-good fallback).
+                    # step: DMAs only each slot's owned pages instead
+                    # of gathering the max_pages*P view. Default since
+                    # the on-chip gate proved the Mosaic lowering +
+                    # engine parity on a real v5e
+                    # (tools/onchip_r05/attempt2,
+                    # tests_tpu test_spec_mq_kernel_lowers); escape
+                    # hatch: SKYT_SPEC_PAGED_ATTN=xla.
                     from skypilot_tpu.ops import paged_attention
                     out = paged_attention.paged_decode_attention_mq(
                         q, k_pool, v_pool, tables, pos)
