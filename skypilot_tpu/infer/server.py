@@ -786,7 +786,8 @@ def build_engine(model_name: Optional[str] = None,
         qmode = quantize if quantize in ('int8', 'int4') else 'none'
         # int8/int4: stream-quantize each tensor on host during load so the
         # bf16 tree is never resident in HBM (8B fits one 16GB chip).
-        if weights_lib.checkpoint_model_type(checkpoint) == 'mixtral':
+        if weights_lib.checkpoint_model_type(checkpoint) in (
+                'mixtral', 'qwen3_moe'):
             from skypilot_tpu.models import moe
             cfg, moe_cfg = weights_lib.load_mixtral_config(
                 checkpoint, remat=False, param_dtype=dtype, dtype=dtype)

@@ -277,6 +277,10 @@ class MixtralModel(nn.Module):
 
 
 # Mixtral-8x7B shapes (vocab 32000, dim 4096, 32 layers, 8 experts top-2).
+# Qwen3-MoE rides the same MixtralModel: qk-norm attention via the
+# shared LlamaAttention knobs, experts sized by moe_intermediate_size,
+# and the same softmax -> top-k -> renormalize routing
+# (norm_topk_prob=true, the released models' setting).
 MIXTRAL_CONFIGS = {
     'debug-moe': (llama_lib.LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -288,4 +292,11 @@ MIXTRAL_CONFIGS = {
         mlp_dim=14336, max_seq_len=32768, rope_theta=1e6,
         use_llama31_rope=False),
         MoeConfig(num_experts=8, experts_per_token=2)),
+    # Qwen3-30B-A3B released shape (mlp_dim = moe_intermediate_size).
+    'qwen3-30b-a3b': (llama_lib.LlamaConfig(
+        vocab_size=151936, dim=2048, n_layers=48, n_heads=32,
+        n_kv_heads=4, head_dim_override=128, mlp_dim=768,
+        max_seq_len=32768, rope_theta=1e6, use_llama31_rope=False,
+        norm_eps=1e-6, qk_norm=True),
+        MoeConfig(num_experts=128, experts_per_token=8)),
 }

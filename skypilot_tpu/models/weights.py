@@ -59,16 +59,20 @@ _TOP_MAP = {
 }
 
 
+# Qwen3(+MoE) per-head q/k norms ([head_dim] weights) — shared by the
+# dense layer map, the MoE loader and the MoE saver.
+_QK_NORM_MAP = {
+    ('attn', 'q_norm', 'weight'): ('self_attn.q_norm.weight', False),
+    ('attn', 'k_norm', 'weight'): ('self_attn.k_norm.weight', False),
+}
+
+
 def _layer_map(cfg) -> Dict[tuple, tuple]:
     m = dict(_LAYER_MAP)
     if getattr(cfg, 'attn_bias', False):
         m.update(_ATTN_BIAS_MAP)
     if getattr(cfg, 'qk_norm', False):
-        # Qwen3 per-head q/k norms ([head_dim] weights).
-        m[('attn', 'q_norm', 'weight')] = \
-            ('self_attn.q_norm.weight', False)
-        m[('attn', 'k_norm', 'weight')] = \
-            ('self_attn.k_norm.weight', False)
+        m.update(_QK_NORM_MAP)
     if getattr(cfg, 'sandwich_norms', False):
         # Gemma-2 names its four per-layer norms differently: HF
         # 'post_attention_layernorm' is the POST-attention sandwich
@@ -343,10 +347,18 @@ _MOE_ATTN_MAP = {
     ('attn', 'wo', 'kernel'): ('self_attn.o_proj.weight', True),
     ('mlp_norm', 'weight'): ('post_attention_layernorm.weight', False),
 }
-_MOE_EXPERT_MAP = {
-    'w_gate': 'w1',   # [mlp, dim] in HF; ours [dim, mlp]
-    'w_up': 'w3',
-    'w_down': 'w2',   # [dim, mlp] in HF; ours [mlp, dim]
+# Per-model_type MoE tensor naming: mixtral nests experts under
+# block_sparse_moe with w1/w3/w2; qwen3_moe uses llama-style names
+# under mlp. The math (softmax -> top-k -> renormalize) is identical.
+_MOE_SCHEMES = {
+    'mixtral': {'prefix': 'block_sparse_moe',
+                # ours [dim, mlp] <-> HF [mlp, dim] (w1=gate, w3=up,
+                # w2=down)
+                'experts': {'w_gate': 'w1', 'w_up': 'w3',
+                            'w_down': 'w2'}},
+    'qwen3_moe': {'prefix': 'mlp',
+                  'experts': {'w_gate': 'gate_proj', 'w_up': 'up_proj',
+                              'w_down': 'down_proj'}},
 }
 
 
@@ -358,15 +370,34 @@ def checkpoint_model_type(ckpt_dir: str) -> str:
 
 
 def load_mixtral_config(ckpt_dir: str, **overrides):
-    """config.json -> (LlamaConfig, MoeConfig) for models/moe.py."""
+    """config.json -> (LlamaConfig, MoeConfig) for models/moe.py.
+    Handles mixtral AND qwen3_moe (qk-norm attention, experts sized by
+    moe_intermediate_size)."""
     from skypilot_tpu.models import moe as moe_lib
 
     with open(os.path.join(ckpt_dir, 'config.json'),
               encoding='utf-8') as f:
         hf = json.load(f)
+    if hf.get('model_type') == 'qwen3_moe':
+        # Our routing renormalizes the top-k weights (the convention
+        # every released Qwen3-MoE uses); a checkpoint trained without
+        # it would silently mis-scale expert outputs.
+        if not hf.get('norm_topk_prob', False):
+            raise NotImplementedError(
+                'qwen3_moe with norm_topk_prob=false is not supported')
+        if hf.get('decoder_sparse_step', 1) != 1 or \
+                hf.get('mlp_only_layers'):
+            raise NotImplementedError(
+                'qwen3_moe with dense layers interleaved '
+                '(decoder_sparse_step/mlp_only_layers) is not '
+                'supported — every layer must be MoE')
+        # Experts are sized by moe_intermediate_size, not the dense
+        # intermediate_size.
+        overrides.setdefault('mlp_dim', hf['moe_intermediate_size'])
     cfg = config_from_hf(hf, **overrides)
     moe_cfg = moe_lib.MoeConfig(
-        num_experts=hf.get('num_local_experts', 8),
+        num_experts=hf.get('num_experts',
+                           hf.get('num_local_experts', 8)),
         experts_per_token=hf.get('num_experts_per_tok', 2))
     return cfg, moe_cfg
 
@@ -418,14 +449,19 @@ def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
 
     L, E = cfg.n_layers, moe_cfg.num_experts
     assert cfg.scan_layers, 'MixtralModel is scan-stacked'
-    for path, (suffix, transpose) in _MOE_ATTN_MAP.items():
+    scheme = _MOE_SCHEMES[checkpoint_model_type(ckpt_dir)]
+    moe_prefix, expert_names = scheme['prefix'], scheme['experts']
+    attn_map = dict(_MOE_ATTN_MAP)
+    if getattr(cfg, 'qk_norm', False):   # qwen3_moe attention norms
+        attn_map.update(_QK_NORM_MAP)
+    for path, (suffix, transpose) in attn_map.items():
         per_layer = [reader.get(f'model.layers.{i}.{suffix}')
                      for i in range(L)]
         arr = np.stack([a.T if transpose else a for a in per_layer])
         store(('layers',) + path, arr)
     # Router: [L, dim, E] (HF gate.weight is [E, dim]); stays float.
     router = np.stack([
-        reader.get(f'model.layers.{i}.block_sparse_moe.gate.weight').T
+        reader.get(f'model.layers.{i}.{moe_prefix}.gate.weight').T
         for i in range(L)])
     _set_at(params, ('layers', 'moe_mlp', 'router'),
             put(('layers', 'moe_mlp', 'router'),
@@ -435,13 +471,13 @@ def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
     # quantizes each layer as it streams (the stacked result is int8,
     # ~1/2 the bytes); float mode casts each layer to the target dtype
     # before stacking (never inflates bf16 shards to f32).
-    for ours, hf_w in _MOE_EXPERT_MAP.items():
+    for ours, hf_w in expert_names.items():
         epath = ('layers', 'moe_mlp', ours)
         if quantize == 'int8':
             qs, scales = [], []
             for i in range(L):
                 layer = np.stack([reader.get(
-                    f'model.layers.{i}.block_sparse_moe.experts.{e}'
+                    f'model.layers.{i}.{moe_prefix}.experts.{e}'
                     f'.{hf_w}.weight').T for e in range(E)])
                 q, s = _np_quantize_kernel(layer)
                 qs.append(q)
@@ -452,7 +488,7 @@ def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
         else:
             stacked = np.stack([
                 np.stack([_np_cast(reader.get(
-                    f'model.layers.{i}.block_sparse_moe.experts.{e}'
+                    f'model.layers.{i}.{moe_prefix}.experts.{e}'
                     f'.{hf_w}.weight').T, dtype) for e in range(E)])
                 for i in range(L)])
             _set_at(params, epath, put(epath, stacked))
@@ -488,25 +524,44 @@ def save_hf_mixtral_checkpoint(cfg, moe_cfg, variables: Dict[str, Any],
         for i in range(cfg.n_layers):
             arr = stacked[i]
             out[f'model.layers.{i}.{suffix}'] = arr.T if transpose else arr
+    moe_type = 'qwen3_moe' if getattr(cfg, 'qk_norm', False) \
+        else 'mixtral'
+    scheme = _MOE_SCHEMES[moe_type]
+    moe_prefix = scheme['prefix']
+    if moe_type == 'qwen3_moe':
+        for path, (suffix, _t) in _QK_NORM_MAP.items():
+            stacked = grab(('layers',) + path)
+            for i in range(cfg.n_layers):
+                out[f'model.layers.{i}.{suffix}'] = stacked[i]
     router = grab(('layers', 'moe_mlp', 'router'))
     for i in range(cfg.n_layers):
-        out[f'model.layers.{i}.block_sparse_moe.gate.weight'] = \
+        out[f'model.layers.{i}.{moe_prefix}.gate.weight'] = \
             router[i].T
-    for ours, hf_w in _MOE_EXPERT_MAP.items():
+    for ours, hf_w in scheme['experts'].items():
         stacked = grab(('layers', 'moe_mlp', ours))
         for i in range(cfg.n_layers):
             for e in range(moe_cfg.num_experts):
-                out[f'model.layers.{i}.block_sparse_moe.experts.{e}'
+                out[f'model.layers.{i}.{moe_prefix}.experts.{e}'
                     f'.{hf_w}.weight'] = stacked[i, e].T
 
     out = {k: np.ascontiguousarray(v) for k, v in out.items()}
     safetensors.numpy.save_file(
         out, os.path.join(out_dir, 'model.safetensors'))
     hf = config_to_hf(cfg)
-    hf.update({'architectures': ['MixtralForCausalLM'],
-               'model_type': 'mixtral',
-               'num_local_experts': moe_cfg.num_experts,
-               'num_experts_per_tok': moe_cfg.experts_per_token})
+    if moe_type == 'qwen3_moe':
+        hf.update({'architectures': ['Qwen3MoeForCausalLM'],
+                   'model_type': 'qwen3_moe',
+                   'num_experts': moe_cfg.num_experts,
+                   'num_experts_per_tok': moe_cfg.experts_per_token,
+                   'moe_intermediate_size': cfg.mlp_dim,
+                   'norm_topk_prob': True,
+                   'decoder_sparse_step': 1,
+                   'mlp_only_layers': []})
+    else:
+        hf.update({'architectures': ['MixtralForCausalLM'],
+                   'model_type': 'mixtral',
+                   'num_local_experts': moe_cfg.num_experts,
+                   'num_experts_per_tok': moe_cfg.experts_per_token})
     with open(os.path.join(out_dir, 'config.json'), 'w',
               encoding='utf-8') as f:
         json.dump(hf, f, indent=2)
@@ -524,7 +579,7 @@ def load_checkpoint(ckpt_dir: str, *, mesh=None,
     routing."""
     from skypilot_tpu.models import llama as llama_lib
 
-    if checkpoint_model_type(ckpt_dir) == 'mixtral':
+    if checkpoint_model_type(ckpt_dir) in ('mixtral', 'qwen3_moe'):
         from skypilot_tpu.models import moe as moe_lib
         cfg, moe_cfg = load_mixtral_config(ckpt_dir, **config_overrides)
         model = moe_lib.MixtralModel(cfg, moe_cfg)
@@ -672,7 +727,7 @@ def config_from_hf(hf_config: Dict[str, Any], **overrides):
     if model_type == 'qwen2':
         # HF Qwen2Attention hardcodes q/k/v biases (no config field).
         kw['attn_bias'] = True
-    elif model_type == 'qwen3':
+    elif model_type in ('qwen3', 'qwen3_moe'):
         # Qwen3 drops the biases for per-head q/k RMSNorm.
         kw['qk_norm'] = True
         kw['attn_bias'] = hf_config.get('attention_bias', False)

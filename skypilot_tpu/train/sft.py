@@ -216,7 +216,7 @@ def main(argv=None) -> None:
         ckpt_type = weights_lib.checkpoint_model_type(
             args.base_checkpoint)
         is_moe_model = args.model in moe.MIXTRAL_CONFIGS
-        if (ckpt_type == 'mixtral') != is_moe_model:
+        if (ckpt_type in ('mixtral', 'qwen3_moe')) != is_moe_model:
             raise SystemExit(
                 f'--base-checkpoint is {ckpt_type!r} but --model '
                 f'{args.model!r} is {"MoE" if is_moe_model else "dense"}')

@@ -695,3 +695,61 @@ def test_gemma2_tp_sharded_decode_matches_unsharded(tmp_path):
     want = run(None)
     got = run(mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=2)))
     assert got == want
+
+
+# ------------------------------------------------------ qwen3_moe
+def test_qwen3_moe_logits_and_engine(tmp_path):
+    """Qwen3-MoE (qk-norm attention + llama-named expert tensors under
+    mlp.experts): our MixtralModel on a saved qwen3_moe checkpoint
+    matches transformers' Qwen3MoeForCausalLM, and build_engine
+    dispatches it."""
+    import dataclasses as _dc
+
+    torch = pytest.importorskip('torch')
+    transformers = pytest.importorskip('transformers')
+
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    cfg = _dc.replace(cfg, max_seq_len=64, qk_norm=True,
+                      head_dim_override=32, norm_eps=1e-6,
+                      rope_theta=1e6)
+    # Dropless so the capacity-based routing equals exact top-k.
+    moe_cfg = _dc.replace(moe_cfg, capacity_factor=8.0)
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(13),
+                                 jnp.zeros((1, 8), jnp.int32))
+    ckpt = tmp_path / 'q3moe'
+    weights.save_hf_mixtral_checkpoint(cfg, moe_cfg, params, str(ckpt))
+    assert weights.checkpoint_model_type(str(ckpt)) == 'qwen3_moe'
+
+    cfg2, moe_cfg2 = weights.load_mixtral_config(
+        str(ckpt), max_seq_len=cfg.max_seq_len, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype, remat=cfg.remat)
+    assert cfg2.qk_norm and cfg2.mlp_dim == cfg.mlp_dim
+    moe_cfg2 = _dc.replace(moe_cfg2, capacity_factor=8.0)
+    loaded = weights.load_mixtral_params(cfg2, moe_cfg2, str(ckpt))
+
+    hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(ckpt), torch_dtype=torch.float32,
+        attn_implementation='eager')
+    assert type(hf_model).__name__ == 'Qwen3MoeForCausalLM'
+    hf_model.eval()
+    tokens = np.random.default_rng(9).integers(0, cfg.vocab_size,
+                                               (2, 12))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(moe.MixtralModel(cfg2, moe_cfg2).apply(
+        loaded, jnp.asarray(tokens, jnp.int32)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+    eng = server_lib.build_engine(checkpoint=str(ckpt), num_slots=2,
+                                  max_seq_len=64, dtype='float32')
+    eng.start()
+    try:
+        out = eng.generate([5, 9, 2, 31],
+                           engine_lib.SamplingParams(max_new_tokens=6))
+    finally:
+        eng.stop()
+    assert len(out) == 6
