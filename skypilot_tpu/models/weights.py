@@ -454,6 +454,8 @@ def load_mixtral_params(cfg, moe_cfg, ckpt_dir: str, *,
     attn_map = dict(_MOE_ATTN_MAP)
     if getattr(cfg, 'qk_norm', False):   # qwen3_moe attention norms
         attn_map.update(_QK_NORM_MAP)
+    if getattr(cfg, 'attn_bias', False):
+        attn_map.update(_ATTN_BIAS_MAP)
     for path, (suffix, transpose) in attn_map.items():
         per_layer = [reader.get(f'model.layers.{i}.{suffix}')
                      for i in range(L)]
@@ -519,7 +521,10 @@ def save_hf_mixtral_checkpoint(cfg, moe_cfg, variables: Dict[str, Any],
         if arr is None:
             continue
         out[hf_name] = arr.T if transpose else arr
-    for path, (suffix, transpose) in _MOE_ATTN_MAP.items():
+    attn_map = dict(_MOE_ATTN_MAP)
+    if getattr(cfg, 'attn_bias', False):
+        attn_map.update(_ATTN_BIAS_MAP)
+    for path, (suffix, transpose) in attn_map.items():
         stacked = grab(('layers',) + path)
         for i in range(cfg.n_layers):
             arr = stacked[i]

@@ -753,3 +753,42 @@ def test_qwen3_moe_logits_and_engine(tmp_path):
     finally:
         eng.stop()
     assert len(out) == 6
+
+
+def test_qwen3_moe_with_attention_bias_roundtrips(tmp_path):
+    """attention_bias=true on a MoE config loads/saves its bias
+    tensors (no released qwen3_moe uses it, but config_from_hf honors
+    the field, so the loader must too rather than fail opaquely)."""
+    import dataclasses as _dc
+
+    from skypilot_tpu.models import moe
+
+    cfg, moe_cfg = moe.MIXTRAL_CONFIGS['debug-moe']
+    cfg = _dc.replace(cfg, max_seq_len=64, qk_norm=True, attn_bias=True,
+                      head_dim_override=32, norm_eps=1e-6)
+    moe_cfg = _dc.replace(moe_cfg, capacity_factor=8.0)
+    model = moe.MixtralModel(cfg, moe_cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(17),
+                                 jnp.zeros((1, 8), jnp.int32))
+    # Randomize the zero-init biases: a dropped bias tensor must CHANGE
+    # the outputs, or this roundtrip proves nothing.
+    import flax.linen as nn
+    rng = np.random.default_rng(17)
+    params = {'params': jax.tree_util.tree_map_with_path(
+        lambda p, a: (jnp.asarray(rng.normal(0, 0.5, a.shape),
+                                  a.dtype)
+                      if p[-1].key == 'bias' else a),
+        nn.meta.unbox(params['params']))}
+    ckpt = tmp_path / 'biased'
+    weights.save_hf_mixtral_checkpoint(cfg, moe_cfg, params, str(ckpt))
+    cfg2, moe_cfg2 = weights.load_mixtral_config(
+        str(ckpt), max_seq_len=64, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype, remat=cfg.remat)
+    assert cfg2.attn_bias
+    moe_cfg2 = _dc.replace(moe_cfg2, capacity_factor=8.0)
+    loaded = weights.load_mixtral_params(cfg2, moe_cfg2, str(ckpt))
+    toks = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    import flax.linen as nn
+    a = np.asarray(model.apply(params, toks))
+    b = np.asarray(moe.MixtralModel(cfg2, moe_cfg2).apply(loaded, toks))
+    np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
