@@ -20,10 +20,11 @@ service.yaml readiness-probes /v1/models). Endpoints:
                             stop sequences (request cancelled at match),
                             n completions per prompt,
                             stream=true -> SSE chunks + [DONE]).
-  POST /v1/chat/completions — OpenAI-compatible chat (messages ->
-                            a minimal generic chat template; model-
-                            specific templates can subclass
-                            InferenceServer._apply_chat_template).
+  POST /v1/chat/completions — OpenAI-compatible chat: messages render
+                            through the checkpoint's own HF jinja
+                            chat template (tokenizer_config.json or
+                            --chat-template file), falling back to a
+                            generic role-tag format.
 
 Run:
   # random-weight debug model, byte tokenizer:
@@ -106,11 +107,39 @@ class _StopScanner:
 class InferenceServer:
     def __init__(self, engine: 'engine_lib.InferenceEngine',
                  tokenizer=None, model_id: str = 'skypilot-tpu',
-                 lora_names: Optional[Dict[str, int]] = None) -> None:
+                 lora_names: Optional[Dict[str, int]] = None,
+                 chat_template: Optional[str] = None,
+                 special_tokens: Optional[Dict[str, str]] = None) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or tokenizer_lib.ByteTokenizer(
             engine.cfg.vocab_size)
         self.model_id = model_id
+        # The checkpoint's HF chat template (jinja source), rendered
+        # for /v1/chat/completions the way vLLM renders it; None falls
+        # back to the generic role-tag format.
+        self._chat_template = None
+        self._special_tokens = dict(special_tokens or {})
+        if chat_template:
+            import jinja2
+            import jinja2.sandbox
+
+            def raise_exception(msg):
+                raise jinja2.TemplateError(msg)
+            env = jinja2.sandbox.ImmutableSandboxedEnvironment(
+                trim_blocks=True, lstrip_blocks=True)
+            env.globals['raise_exception'] = raise_exception
+            # Llama-3.1's template calls strftime_now for the system
+            # date line (same helper transformers injects).
+            import datetime as _dt
+            env.globals['strftime_now'] = (
+                lambda fmt: _dt.datetime.now().strftime(fmt))
+            try:
+                self._chat_template = env.from_string(chat_template)
+            except jinja2.TemplateError as e:
+                # Third-party template from the checkpoint: a syntax
+                # error must not make the checkpoint unservable.
+                logger.warning('chat template failed to compile (%s); '
+                               'using the generic format', e)
         # Multi-LoRA routing (vLLM's OpenAI convention): 'model' in a
         # request names either the base model or a loaded adapter.
         self.lora_names = dict(lora_names or {})
@@ -618,9 +647,20 @@ class InferenceServer:
         })
 
     def _apply_chat_template(self, messages) -> str:
-        """Minimal generic template. Model-specific formats (Llama-3
-        header tokens etc.) can be layered on via tokenizer config; the
-        API surface is what the reference exposes through vLLM."""
+        """The checkpoint's HF chat template when the tokenizer dir
+        carries one (jinja, rendered with add_generation_prompt=True —
+        what vLLM does for the reference); a minimal generic role-tag
+        format otherwise. A template render error falls back to the
+        generic format with a warning rather than 500ing the request
+        (templates are third-party code from the checkpoint)."""
+        if self._chat_template is not None:
+            try:
+                return self._chat_template.render(
+                    messages=messages, add_generation_prompt=True,
+                    **self._special_tokens)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning('chat template render failed (%s); '
+                               'using the generic format', e)
         parts = []
         for m in messages:
             parts.append(f"<|{m.get('role', 'user')}|>\n"
@@ -963,6 +1003,11 @@ def main(argv=None) -> None:
                         help='chunked prefill: long prompts prefill in '
                              'chunks of this many tokens, interleaved '
                              'with decode (0 = off)')
+    parser.add_argument('--chat-template', default=None,
+                        help='path to a jinja chat template file, '
+                             'overriding the checkpoint tokenizer '
+                             "dir's tokenizer_config.json template "
+                             '(a missing file fails startup loudly)')
     parser.add_argument('--lora', action='append', default=None,
                         metavar='NAME=PATH[:ALPHA]',
                         help='serve a LoRA adapter alongside the base '
@@ -1021,12 +1066,36 @@ def main(argv=None) -> None:
         return
     tok_path = args.tokenizer or args.checkpoint
     tokenizer = None
+    chat_template = None
+    special_tokens = {}
     if tok_path:
         try:
             tokenizer = tokenizer_lib.load_tokenizer(tok_path)
         except FileNotFoundError:
             logger.warning('no tokenizer.json at %s; using byte '
                            'fallback', tok_path)
+        if args.chat_template:
+            try:
+                with open(args.chat_template, encoding='utf-8') as f:
+                    chat_template = f.read()
+            except OSError as e:
+                raise SystemExit(
+                    f'--chat-template {args.chat_template}: {e}')
+        else:
+            chat_template = tokenizer_lib.load_chat_template(tok_path)
+        special_tokens = tokenizer_lib.special_token_strings(tok_path)
+        if chat_template:
+            logger.info('chat template loaded (%d chars)%s',
+                        len(chat_template),
+                        ' from --chat-template'
+                        if args.chat_template else '')
+    elif args.chat_template:
+        try:
+            with open(args.chat_template, encoding='utf-8') as f:
+                chat_template = f.read()
+        except OSError as e:
+            raise SystemExit(
+                f'--chat-template {args.chat_template}: {e}')
     engine.start()
     logger.info('warming up (compiling prefill buckets + decode)...')
     engine.warmup()
@@ -1034,7 +1103,9 @@ def main(argv=None) -> None:
     model_id = (_os.path.basename(args.checkpoint.rstrip('/'))
                 if args.checkpoint else args.model)
     server = InferenceServer(engine, tokenizer, model_id=model_id,
-                             lora_names=lora_names)
+                             lora_names=lora_names,
+                             chat_template=chat_template,
+                             special_tokens=special_tokens)
     logger.info('inference server: model=%s ckpt=%s tp=%d port=%d '
                 'slots=%d', args.model, args.checkpoint, args.tp,
                 args.port, args.num_slots)

@@ -75,21 +75,27 @@ class HFTokenizer:
         return self._tok.decode(toks, skip_special_tokens=True)
 
 
-def _special_ids_near(tokenizer_json: str, tok
-                      ) -> 'tuple[Optional[int], Optional[int]]':
-    """Resolve bos/eos ids from sibling HF config files, falling back to
-    well-known token strings in the vocab."""
-    d = os.path.dirname(os.path.abspath(tokenizer_json))
-    bos_tok = eos_tok = None
+def _sibling_configs(d: str):
+    """Yield parsed tokenizer_config.json / config.json dicts from a
+    checkpoint dir (skipping unreadable files)."""
     for fname in ('tokenizer_config.json', 'config.json'):
         path = os.path.join(d, fname)
         if not os.path.exists(path):
             continue
         try:
             with open(path, encoding='utf-8') as f:
-                cfg = json.load(f)
+                yield json.load(f)
         except (OSError, ValueError):
             continue
+
+
+def _special_ids_near(tokenizer_json: str, tok
+                      ) -> 'tuple[Optional[int], Optional[int]]':
+    """Resolve bos/eos ids from sibling HF config files, falling back to
+    well-known token strings in the vocab."""
+    d = os.path.dirname(os.path.abspath(tokenizer_json))
+    bos_tok = eos_tok = None
+    for cfg in _sibling_configs(d):
         # config.json carries ids; tokenizer_config.json carries strings.
         if isinstance(cfg.get('bos_token_id'), int):
             return cfg['bos_token_id'], _first_int(cfg.get('eos_token_id'))
@@ -141,3 +147,44 @@ def load_tokenizer(path: Optional[str] = None,
         path = tj
     logger.info('loading tokenizer from %s', path)
     return HFTokenizer(path)
+
+
+def load_chat_template(path: str) -> 'Optional[str]':
+    """The checkpoint's HF chat template (jinja source), if any.
+
+    path: tokenizer dir or tokenizer.json path (an explicit template
+    FILE override is read by the caller — server main's
+    --chat-template — so a bad override fails loudly there instead of
+    being silently reinterpreted as a directory here).
+    tokenizer_config.json carries it as a string, or (newer multi-
+    template format) a list of {'name', 'template'} dicts — 'default'
+    wins. The reference gets this rendering from vLLM, which reads the
+    same field."""
+    d = path if os.path.isdir(path) else os.path.dirname(
+        os.path.abspath(path))
+    for cfg in _sibling_configs(d):
+        tpl = cfg.get('chat_template')
+        if isinstance(tpl, str):
+            return tpl
+        if isinstance(tpl, list):
+            by_name = {t.get('name'): t.get('template') for t in tpl
+                       if isinstance(t, dict)}
+            return by_name.get('default') or next(
+                (t for t in by_name.values() if t), None)
+    return None
+
+
+def special_token_strings(path: str) -> 'dict':
+    """{'bos_token': ..., 'eos_token': ...} STRINGS for chat-template
+    rendering — unresolved keys are OMITTED so jinja renders them as
+    '' (Undefined) instead of the literal text 'None'."""
+    d = path if os.path.isdir(path) else os.path.dirname(
+        os.path.abspath(path))
+    out = {}
+    for cfg in _sibling_configs(d):
+        for key in ('bos_token', 'eos_token'):
+            if key not in out:
+                val = _token_str(cfg.get(key))
+                if val is not None:
+                    out[key] = val
+    return out

@@ -602,3 +602,56 @@ def test_logprobs_tokens_multibyte_alignment(small_model):
         pieces[-1] += tail
     assert ''.join(pieces) == tok.decode(visible) == 'éa'
     assert pieces == ['', 'é', 'a']
+
+
+def test_chat_template_rendering(tmp_path):
+    """A checkpoint's HF jinja chat template renders for chat
+    completions (llama-3-style header tokens), with the generic
+    role-tag fallback on render errors."""
+    import dataclasses
+    import json
+
+    from skypilot_tpu.infer import server
+    from skypilot_tpu.infer import tokenizer as tokenizer_lib
+
+    tpl = (
+        "{{ bos_token }}{% for m in messages %}"
+        "<|start_header_id|>{{ m['role'] }}<|end_header_id|>\n\n"
+        "{{ m['content'] }}<|eot_id|>{% endfor %}"
+        "{% if add_generation_prompt %}"
+        "<|start_header_id|>assistant<|end_header_id|>\n\n{% endif %}")
+    (tmp_path / 'tokenizer_config.json').write_text(json.dumps({
+        'chat_template': tpl, 'bos_token': '<BOS>',
+        'eos_token': '<EOS>'}))
+
+    cfg = dataclasses.replace(llama.CONFIGS['debug'], max_seq_len=64)
+    model = llama.LlamaModel(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    eng = engine_lib.InferenceEngine(model, params, num_slots=1,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16])
+    srv = server.InferenceServer(
+        eng,
+        chat_template=tokenizer_lib.load_chat_template(str(tmp_path)),
+        special_tokens=tokenizer_lib.special_token_strings(
+            str(tmp_path)))
+    out = srv._apply_chat_template([
+        {'role': 'system', 'content': 'be brief'},
+        {'role': 'user', 'content': 'hi'}])
+    assert out == ('<BOS><|start_header_id|>system<|end_header_id|>'
+                   '\n\nbe brief<|eot_id|>'
+                   '<|start_header_id|>user<|end_header_id|>\n\nhi'
+                   '<|eot_id|>'
+                   '<|start_header_id|>assistant<|end_header_id|>\n\n')
+    # Broken template -> generic fallback, not a crash.
+    srv2 = server.InferenceServer(
+        eng, chat_template="{{ raise_exception('nope') }}")
+    out2 = srv2._apply_chat_template([{'role': 'user', 'content': 'x'}])
+    assert out2 == '<|user|>\nx\n<|assistant|>\n'
+    # Multi-template (list) format: 'default' wins.
+    (tmp_path / 'tokenizer_config.json').write_text(json.dumps({
+        'chat_template': [
+            {'name': 'tool_use', 'template': 'T'},
+            {'name': 'default', 'template': 'D'}]}))
+    assert tokenizer_lib.load_chat_template(str(tmp_path)) == 'D'
