@@ -120,9 +120,14 @@ class InferenceServer:
         self._chat_template = None
         self._special_tokens = dict(special_tokens or {})
         if chat_template:
-            import jinja2
-            import jinja2.sandbox
-
+            try:
+                import jinja2
+                import jinja2.sandbox
+            except ImportError:
+                logger.warning('jinja2 not installed; chat requests '
+                               'use the generic role-tag format')
+                chat_template = None
+        if chat_template:
             def raise_exception(msg):
                 raise jinja2.TemplateError(msg)
             env = jinja2.sandbox.ImmutableSandboxedEnvironment(
@@ -1068,34 +1073,28 @@ def main(argv=None) -> None:
     tokenizer = None
     chat_template = None
     special_tokens = {}
-    if tok_path:
-        try:
-            tokenizer = tokenizer_lib.load_tokenizer(tok_path)
-        except FileNotFoundError:
-            logger.warning('no tokenizer.json at %s; using byte '
-                           'fallback', tok_path)
-        if args.chat_template:
-            try:
-                with open(args.chat_template, encoding='utf-8') as f:
-                    chat_template = f.read()
-            except OSError as e:
-                raise SystemExit(
-                    f'--chat-template {args.chat_template}: {e}')
-        else:
-            chat_template = tokenizer_lib.load_chat_template(tok_path)
-        special_tokens = tokenizer_lib.special_token_strings(tok_path)
-        if chat_template:
-            logger.info('chat template loaded (%d chars)%s',
-                        len(chat_template),
-                        ' from --chat-template'
-                        if args.chat_template else '')
-    elif args.chat_template:
+    if args.chat_template:
+        # Explicit override: a missing/unreadable file fails loudly.
         try:
             with open(args.chat_template, encoding='utf-8') as f:
                 chat_template = f.read()
         except OSError as e:
             raise SystemExit(
                 f'--chat-template {args.chat_template}: {e}')
+    if tok_path:
+        try:
+            tokenizer = tokenizer_lib.load_tokenizer(tok_path)
+        except FileNotFoundError:
+            logger.warning('no tokenizer.json at %s; using byte '
+                           'fallback', tok_path)
+        if chat_template is None:
+            chat_template = tokenizer_lib.load_chat_template(tok_path)
+        special_tokens = tokenizer_lib.special_token_strings(tok_path)
+    if chat_template:
+        logger.info('chat template loaded (%d chars)%s',
+                    len(chat_template),
+                    ' from --chat-template' if args.chat_template
+                    else '')
     engine.start()
     logger.info('warming up (compiling prefill buckets + decode)...')
     engine.warmup()

@@ -75,6 +75,15 @@ class RequestRateAutoscaler(Autoscaler):
         current = self.target_num_replicas
         if raw > current:
             self._downscale_since = None
+            if current == 0:
+                # Scale-to-zero wake-up: with NOTHING serving, every
+                # second of upscale delay is a second of guaranteed
+                # 503s — the delay exists to damp flapping between
+                # sizes, not to gate cold starts. Launch immediately.
+                self.target_num_replicas = raw
+                self._upscale_since = None
+                return AutoscalerDecision(
+                    raw, f'wake from zero -> upscale to {raw}')
             if self._upscale_since is None:
                 self._upscale_since = now
             if now - self._upscale_since >= self.spec.upscale_delay_seconds:

@@ -386,3 +386,31 @@ def test_serve_lifecycle(serve_env):
     serve_core.down(name)
     assert serve_core.status([name]) == []
     assert state.get_clusters() == []
+
+
+def test_scale_to_zero_and_wake():
+    """min_replicas: 0 — sustained idle scales the service to nothing;
+    the first request wakes it immediately (no upscale delay: with
+    zero replicas the delay would just be guaranteed 503s)."""
+    import time as time_lib
+
+    from skypilot_tpu.serve import autoscalers, service_spec
+
+    spec = service_spec.ServiceSpec(
+        readiness_path='/health', min_replicas=0, max_replicas=2,
+        target_qps_per_replica=1.0, upscale_delay_seconds=60.0,
+        downscale_delay_seconds=0.0)
+    a = autoscalers.RequestRateAutoscaler(spec)
+    assert a.target_num_replicas == 0
+    # Idle: stays at zero.
+    d = a.evaluate_scaling(num_ready=0)
+    assert d.target_num_replicas == 0
+    # A request arrives -> wake instantly despite the 60s upscale delay.
+    a.collect_request_timestamps([time_lib.time()])
+    d = a.evaluate_scaling(num_ready=0)
+    assert d.target_num_replicas >= 1
+    assert 'wake from zero' in d.reason
+    # Traffic stops -> back to zero after the (zero) downscale delay.
+    a.request_timestamps.clear()
+    d = a.evaluate_scaling(num_ready=1)
+    assert d.target_num_replicas == 0
