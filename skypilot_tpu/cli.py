@@ -655,6 +655,28 @@ def serve_down(service_names, purge, yes):
         click.echo(f'Service {name} terminated.')
 
 
+def _replica_perf(r) -> str:
+    """PERF cell for `serve status` from a replica's /stats snapshot.
+    The snapshot comes from an arbitrary replica's HTTP response —
+    every field is untrusted, so a mis-shaped payload renders '-' for
+    that replica instead of crashing the whole command."""
+    s = r.get('stats')
+    if not isinstance(s, dict):
+        return '-'
+    parts = []
+    ttft = s.get('ttft_ms')
+    if isinstance(ttft, dict) and isinstance(ttft.get('p50'),
+                                             (int, float)):
+        parts.append(f"p50 {ttft['p50']}ms")
+    rate = s.get('steady_decode_tok_per_sec')
+    if isinstance(rate, (int, float)) and rate:
+        parts.append(f'{rate:.0f} tok/s')
+    if isinstance(s.get('active_slots'), int) and \
+            isinstance(s.get('num_slots'), int):
+        parts.append(f"slots {s['active_slots']}/{s['num_slots']}")
+    return ' '.join(parts) or '-'
+
+
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1)
 def serve_status(service_names):
@@ -665,9 +687,9 @@ def serve_status(service_names):
                    f'(v{svc["version"]}) endpoint={svc["endpoint"]}')
         rows = [[r['replica_id'], r['cluster_name'],
                  r['status'].value, r['endpoint'] or '-',
-                 r['version']] for r in svc['replicas']]
+                 r['version'], _replica_perf(r)] for r in svc['replicas']]
         click.echo(_fmt_table(rows, ['ID', 'CLUSTER', 'STATUS',
-                                     'ENDPOINT', 'VERSION']))
+                                     'ENDPOINT', 'VERSION', 'PERF']))
 
 
 @serve.command(name='logs')

@@ -289,6 +289,9 @@ def status(service_names: Optional[List[str]] = None
             'endpoint': r.endpoint,
             'version': r.version,
             'use_spot': r.use_spot,
+            # getattr: replica rows pickled before the stats field
+            # existed restore without it.
+            'stats': getattr(r, 'stats', None),
         } for r in serve_state.get_replicas(svc['name'])]
         out.append({
             'name': svc['name'],

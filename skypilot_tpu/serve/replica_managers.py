@@ -47,6 +47,10 @@ class ReplicaInfo:
     first_ready_at: Optional[float] = None
     consecutive_failures: int = 0
     failure_reason: Optional[str] = None
+    # Last /stats snapshot from an inference-server replica (TTFT
+    # percentiles, steady decode rate, slot occupancy) — best-effort:
+    # None for replicas that don't expose /stats.
+    stats: Optional[dict] = None
 
     @property
     def is_alive(self) -> bool:
@@ -252,9 +256,32 @@ class ReplicaManager:
         except requests.RequestException:
             return False
 
+    _STATS_KEYS = ('ttft_ms', 'steady_decode_tok_per_sec',
+                   'active_slots', 'num_slots', 'waiting')
+    # Scrape /stats only every Kth probe pass: the scrape is a serial
+    # blocking GET per READY replica inside the controller's one
+    # control thread, and the data is only read by `serve status`.
+    _STATS_EVERY = 5
+
+    def _fetch_stats(self, info: ReplicaInfo) -> Optional[dict]:
+        """Best-effort /stats scrape from a READY replica (the engine
+        server exposes it; arbitrary user services 404 -> None or may
+        answer with any shape -> consumers must not trust types)."""
+        try:
+            resp = requests.get(info.endpoint + '/stats', timeout=2)
+            if resp.status_code != 200:
+                return None
+            data = resp.json()
+            if not isinstance(data, dict):
+                return None
+            return {k: data[k] for k in self._STATS_KEYS if k in data}
+        except (requests.RequestException, ValueError):
+            return None
+
     def probe_all(self) -> None:
         """One probe pass (reference: _replica_prober :1019 + parallel
         probes :497-543)."""
+        self._probe_passes = getattr(self, '_probe_passes', -1) + 1
         for info in list(self.replicas.values()):
             if info.status not in (serve_state.ReplicaStatus.STARTING,
                                    serve_state.ReplicaStatus.READY,
@@ -276,9 +303,15 @@ class ReplicaManager:
                 if info.status is not serve_state.ReplicaStatus.READY:
                     logger.info('replica %d READY', info.replica_id)
                 info.status = serve_state.ReplicaStatus.READY
+                if self._probe_passes % self._STATS_EVERY == 0 or \
+                        getattr(info, 'stats', None) is None:
+                    info.stats = self._fetch_stats(info)
                 self._save(info)
                 continue
             info.consecutive_failures += 1
+            # Stale perf numbers beside a failing replica mislead
+            # incident triage.
+            info.stats = None
             if info.status is serve_state.ReplicaStatus.STARTING:
                 if time.time() - info.launched_at > \
                         self.spec.initial_delay_seconds:

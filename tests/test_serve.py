@@ -17,6 +17,7 @@ from skypilot_tpu import state
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import core as serve_core
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 
@@ -414,3 +415,48 @@ def test_scale_to_zero_and_wake():
     a.request_timestamps.clear()
     d = a.evaluate_scaling(num_ready=1)
     assert d.target_num_replicas == 0
+
+
+def test_replica_stats_scrape(tmp_state_dir):
+    """The prober scrapes /stats off a READY inference replica and
+    `serve status` surfaces it; a replica without /stats yields None."""
+    import http.server
+    import json as json_lib
+    import threading
+
+    stats_payload = {'ttft_ms': {'p50': 42.0, 'p90': 50.0, 'p99': 60.0,
+                                 'count': 7},
+                     'steady_decode_tok_per_sec': 900.0,
+                     'active_slots': 2, 'num_slots': 8, 'waiting': 0,
+                     'irrelevant': 'dropped'}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+            if self.path == '/stats':
+                self.wfile.write(json_lib.dumps(stats_payload).encode())
+            else:
+                self.wfile.write(b'ok')
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(('127.0.0.1', 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        spec = spec_lib.ServiceSpec(readiness_path='/health')
+        mgr = replica_managers.ReplicaManager('stats-svc', spec,
+                                              task_yaml='/dev/null')
+        info = replica_managers.ReplicaInfo(
+            replica_id=1, cluster_name='nonexistent-c', version=1,
+            status=serve_state.ReplicaStatus.READY,
+            endpoint=f'http://127.0.0.1:{srv.server_port}')
+        got = mgr._fetch_stats(info)
+        assert got == {k: v for k, v in stats_payload.items()
+                       if k != 'irrelevant'}
+    finally:
+        srv.shutdown()
+    # No server at all -> None, not an exception.
+    info.endpoint = 'http://127.0.0.1:1'
+    assert mgr._fetch_stats(info) is None
