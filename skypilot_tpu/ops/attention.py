@@ -6,6 +6,7 @@ forward/backward. GQA (grouped KV heads) handled by logical head repeat
 folded into the einsum — no materialized K/V repeat.
 """
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -93,21 +94,41 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               logit_softcap: float = 0.0,
               softmax_scale: Optional[float] = None) -> jax.Array:
     """Dispatch: 'auto' uses the Pallas flash kernel on TPU when shapes
-    allow, else the XLA reference. Windowed/soft-capped/rescaled
-    attention (Mistral, Gemma-2) always takes the XLA path — the flash
-    kernel does not implement them, and a silent wrong-math fast path
-    is worse than a slower correct one."""
-    needs_xla = window > 0 or logit_softcap > 0.0 or \
-        softmax_scale is not None
+    allow, else the XLA reference. Soft-capped/rescaled attention
+    (Gemma-2) always takes the XLA path — the flash kernel does not
+    implement them, and a silent wrong-math fast path is worse than a
+    slower correct one. A STATIC sliding window (Mistral, Phi-3) has a
+    flash implementation (O(S*window) block visits) behind
+    SKYT_WINDOW_FLASH=on — opt-in until the on-chip gate proves the
+    lowering (the same discipline the paged MQ kernel went through);
+    Gemma-2's per-layer traced window gate (window_active) stays XLA
+    either way (the skip predicate must be static-per-kernel).
+    Explicit impl='flash' with a static window honors the request
+    without the env gate (it IS the opt-in). NOTE: like the other
+    SKYT_* kernel gates, the env var is read at TRACE time — under an
+    outer jit (the model) the choice is baked into the compiled
+    program, so set it before the process builds its engines, not
+    mid-run."""
+    flash_unsupported = (logit_softcap > 0.0 or
+                         softmax_scale is not None or
+                         (window > 0 and window_active is not None))
+    window_flash = (window > 0 and window_active is None and
+                    os.environ.get('SKYT_WINDOW_FLASH', 'off') == 'on')
     if impl == 'auto':
-        impl = 'flash' if not needs_xla and _flash_ok(q, k) else 'xla'
+        auto_xla = flash_unsupported or (window > 0 and
+                                         not window_flash)
+        impl = 'flash' if not auto_xla and _flash_ok(q, k) else 'xla'
     if impl == 'flash':
-        if needs_xla:
-            raise ValueError('flash attention does not support '
-                             'window/softcap/scale overrides')
+        if flash_unsupported:
+            offender = ('logit_softcap' if logit_softcap > 0.0 else
+                        'softmax_scale' if softmax_scale is not None
+                        else 'a traced window gate (window_active)')
+            raise ValueError(
+                f'flash attention does not support {offender}')
         from skypilot_tpu.ops import flash_attention
         return flash_attention.flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids)
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            window=window)
     return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids,
                          window=window, window_active=window_active,
                          logit_softcap=logit_softcap,

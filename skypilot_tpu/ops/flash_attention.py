@@ -60,16 +60,21 @@ def _interpret_mode() -> bool:
         return True
 
 
-def _block_mask(s, qi, ki, block_q, block_k, causal,
+def _block_mask(s, qi, ki, block_q, block_k, causal, window,
                 q_seg_ref, k_seg_ref):
-    """Apply causal and/or segment masking to a [block_q, block_k] score
-    block. Returns the masked scores."""
-    if causal:
+    """Apply causal / sliding-window / segment masking to a
+    [block_q, block_k] score block. window > 0 (Mistral, every other
+    Gemma-2 layer, Phi-3): query p also requires p - k_pos < window.
+    Returns the masked scores."""
+    if causal or window > 0:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if window > 0:
+            s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
     if q_seg_ref is not None:
         q_seg = q_seg_ref[0]              # [block_q]
         k_seg = k_seg_ref[0]              # [block_k]
@@ -77,8 +82,25 @@ def _block_mask(s, qi, ki, block_q, block_k, causal,
     return s
 
 
-def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
-                block_k: int, num_k_blocks: int, has_seg: bool):
+def _qk_block_overlaps(qi, ki, block_q, block_k, causal, window):
+    """Traced bool: does this (q block, k block) pair contain ANY
+    unmasked (q, k) entry under causal+window? Used to skip whole
+    blocks: above the diagonal (causal) and, with a window, entirely
+    below it."""
+    cond = True
+    if causal:
+        cond = jnp.logical_and(cond, ki * block_k < (qi + 1) * block_q)
+    if window > 0:
+        # Highest k in the block must reach the lowest q's window
+        # start: (ki+1)*bk - 1 >= qi*bq - (window - 1).
+        cond = jnp.logical_and(
+            cond, (ki + 1) * block_k > qi * block_q - window + 1)
+    return cond
+
+
+def _fwd_kernel(*refs, scale: float, causal: bool, window: int,
+                block_q: int, block_k: int, num_k_blocks: int,
+                has_seg: bool):
     if has_seg:
         (q_ref, k_ref, v_ref, q_seg_ref, k_seg_ref,
          o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
@@ -100,12 +122,17 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        s = _block_mask(s, qi, ki, block_q, block_k, causal,
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
                         q_seg_ref, k_seg_ref)
         m_prev = m_scr[:]                 # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)            # [bq, bk]
+        # Exact 0 for masked entries: a row whose FIRST visited block is
+        # fully masked has m_new == NEG_INF, and exp(NEG_INF - NEG_INF)
+        # would be 1 — with a sliding window that case is routine (rows
+        # near the end of a q block whose window starts past this k
+        # block), so guard by value rather than rely on underflow.
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
         alpha = jnp.exp(m_prev - m_new)   # [bq, 1]
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -114,10 +141,9 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         m_scr[:] = m_new
         l_scr[:] = l_new
 
-    if causal:
-        # Skip k blocks entirely above the diagonal.
-        first_masked = (qi + 1) * block_q  # k positions >= this are masked
-        pl.when(ki * block_k < first_masked)(_compute)
+    if causal or window > 0:
+        pl.when(_qk_block_overlaps(qi, ki, block_q, block_k, causal,
+                                   window))(_compute)
     else:
         _compute()
 
@@ -132,8 +158,9 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         lse_ref[0, 0] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
 
 
-def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
-               block_k: int, num_k_blocks: int, has_seg: bool):
+def _dq_kernel(*refs, scale: float, causal: bool, window: int,
+               block_q: int, block_k: int, num_k_blocks: int,
+               has_seg: bool):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          q_seg_ref, k_seg_ref, dq_ref, dq_scr) = refs
@@ -154,7 +181,7 @@ def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _block_mask(s, qi, ki, block_q, block_k, causal,
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
                         q_seg_ref, k_seg_ref)
         lse = lse_ref[0, 0][:, :1]        # [bq, 1] (lane-replicated)
         p = jnp.exp(s - lse)              # [bq, bk]
@@ -168,9 +195,9 @@ def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        first_masked = (qi + 1) * block_q
-        pl.when(ki * block_k < first_masked)(_compute)
+    if causal or window > 0:
+        pl.when(_qk_block_overlaps(qi, ki, block_q, block_k, causal,
+                                   window))(_compute)
     else:
         _compute()
 
@@ -179,8 +206,9 @@ def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
-                block_k: int, num_q_blocks: int, has_seg: bool):
+def _dkv_kernel(*refs, scale: float, causal: bool, window: int,
+                block_q: int, block_k: int, num_q_blocks: int,
+                has_seg: bool):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          q_seg_ref, k_seg_ref, dk_ref, dv_ref, dk_scr, dv_scr) = refs
@@ -202,7 +230,7 @@ def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _block_mask(s, qi, ki, block_q, block_k, causal,
+        s = _block_mask(s, qi, ki, block_q, block_k, causal, window,
                         q_seg_ref, k_seg_ref)
         lse = lse_ref[0, 0][:, :1]        # [bq, 1] (lane-replicated)
         p = jnp.exp(s - lse)              # [bq, bk]
@@ -219,9 +247,11 @@ def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
 
-    if causal:
-        # Skip q blocks entirely above the diagonal (all q_pos < k_pos).
-        pl.when((qi + 1) * block_q > ki * block_k)(_compute)
+    if causal or window > 0:
+        # Same overlap predicate, evaluated from this kernel's
+        # (ki outer, qi inner) grid order.
+        pl.when(_qk_block_overlaps(qi, ki, block_q, block_k, causal,
+                                   window))(_compute)
     else:
         _compute()
 
@@ -242,7 +272,7 @@ def flash_attention_fwd_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     its backward through the einsum path).
     """
     out, lse = _flash_fwd_impl(q, k, v, None, causal, DEFAULT_BLOCK_Q,
-                               DEFAULT_BLOCK_K)
+                               DEFAULT_BLOCK_K, 0)
     return out, lse[..., 0]
 
 
@@ -250,19 +280,26 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True,
                     segment_ids: Optional[jax.Array] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+                    block_k: int = DEFAULT_BLOCK_K,
+                    window: int = 0) -> jax.Array:
     """q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D] -> [B, Sq, Hq, D].
 
     segment_ids: optional [B, S] int32 packed-sequence ids, masked
     in-kernel (forward and backward).
+    window: sliding-window attention (> 0: query p sees k in
+    (p - window, p]). Out-of-window blocks skip their COMPUTE (the
+    same pl.when structure as the causal above-diagonal skip — a FLOP
+    saving; the grid still fetches every k/v block, so memory traffic
+    is unchanged).
     """
-    return _flash(q, k, v, segment_ids, causal, block_q, block_k)
+    return _flash(q, k, v, segment_ids, causal, block_q, block_k,
+                  window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, segment_ids, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, segment_ids, causal, block_q, block_k, window):
     out, _ = _flash_fwd_impl(q, k, v, segment_ids, causal, block_q,
-                             block_k)
+                             block_k, window)
     return out
 
 
@@ -277,7 +314,8 @@ def _shape_checks(q, k, block_q, block_k):
     return b, sq, sk, hq, hkv, d, block_q, block_k
 
 
-def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k):
+def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k,
+                    window=0):
     b, sq, sk, hq, hkv, d, block_q, block_k = _shape_checks(
         q, k, block_q, block_k)
     group = hq // hkv
@@ -291,8 +329,9 @@ def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k):
     vt = v.transpose(0, 2, 1, 3)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, has_seg=has_seg)
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        has_seg=has_seg)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d),
@@ -338,19 +377,20 @@ def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k):
     return out.transpose(0, 2, 1, 3), lse
 
 
-def _fwd_rule(q, k, v, segment_ids, causal, block_q, block_k):
+def _fwd_rule(q, k, v, segment_ids, causal, block_q, block_k, window):
     out, lse = _flash_fwd_impl(q, k, v, segment_ids, causal, block_q,
-                               block_k)
+                               block_k, window)
     return out, (q, k, v, segment_ids, out, lse)
 
 
-def _bwd_rule(causal, block_q, block_k, res, g):
+def _bwd_rule(causal, block_q, block_k, window, res, g):
     q, k, v, segment_ids, out, lse = res
     if _bwd_impl_choice() == 'xla':
         from skypilot_tpu.ops import attention as attention_ops
         _, vjp = jax.vjp(
             lambda q_, k_, v_: attention_ops.mha_reference(
-                q_, k_, v_, causal=causal, segment_ids=segment_ids),
+                q_, k_, v_, causal=causal, segment_ids=segment_ids,
+                window=window),
             q, k, v)
         return (*vjp(g), None)
     b, sq, sk, hq, hkv, d, block_q, block_k = _shape_checks(
@@ -393,8 +433,9 @@ def _bwd_rule(causal, block_q, block_k, res, g):
         operands += [seg, seg]
 
     dq_kernel = functools.partial(
-        _dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, has_seg=has_seg)
+        _dq_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        has_seg=has_seg)
     dqt = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, nq, nk),
@@ -434,8 +475,9 @@ def _bwd_rule(causal, block_q, block_k, res, g):
         ]
 
     dkv_kernel = functools.partial(
-        _dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_q_blocks=nq, has_seg=has_seg)
+        _dkv_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        has_seg=has_seg)
     dk_spec = lambda bi, hi, ki, qi: (bi, hi, ki, 0)  # noqa: E731
     dkt, dvt = pl.pallas_call(
         dkv_kernel,

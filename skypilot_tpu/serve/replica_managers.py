@@ -70,6 +70,12 @@ class ReplicaManager:
         self.spec = spec
         self.task_yaml = task_yaml
         self.version = version
+        self._probe_passes = -1
+        # replica_id -> probe pass of the last /stats ATTEMPT: the
+        # throttle must key on attempts, not on stats being None —
+        # replicas without a /stats endpoint stay None forever and
+        # would otherwise be re-fetched every pass.
+        self._stats_attempt: Dict[int, int] = {}
         self.replicas: Dict[int, ReplicaInfo] = {
             info.replica_id: info
             for info in serve_state.get_replicas(service_name)}
@@ -281,7 +287,7 @@ class ReplicaManager:
     def probe_all(self) -> None:
         """One probe pass (reference: _replica_prober :1019 + parallel
         probes :497-543)."""
-        self._probe_passes = getattr(self, '_probe_passes', -1) + 1
+        self._probe_passes += 1
         for info in list(self.replicas.values()):
             if info.status not in (serve_state.ReplicaStatus.STARTING,
                                    serve_state.ReplicaStatus.READY,
@@ -303,8 +309,11 @@ class ReplicaManager:
                 if info.status is not serve_state.ReplicaStatus.READY:
                     logger.info('replica %d READY', info.replica_id)
                 info.status = serve_state.ReplicaStatus.READY
-                if self._probe_passes % self._STATS_EVERY == 0 or \
-                        getattr(info, 'stats', None) is None:
+                last = self._stats_attempt.get(info.replica_id,
+                                               -self._STATS_EVERY)
+                if self._probe_passes - last >= self._STATS_EVERY:
+                    self._stats_attempt[info.replica_id] = \
+                        self._probe_passes
                     info.stats = self._fetch_stats(info)
                 self._save(info)
                 continue
