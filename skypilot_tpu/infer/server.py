@@ -587,6 +587,25 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'stream supports a single prompt with n=1'},
                 status=400)
+        # Honest bounds: parameters we do not implement are rejected,
+        # never silently ignored (a client asking for best_of sampling
+        # or suffix insertion must not get plain completions back
+        # unawares). echo is supported on the non-streaming path.
+        if payload.get('suffix'):
+            return web.json_response(
+                {'error': 'suffix (insertion) is not supported'},
+                status=400)
+        best_of = payload.get('best_of')
+        if best_of not in (None, 1, n):
+            return web.json_response(
+                {'error': f'best_of={best_of!r} is not supported '
+                          f'(only best_of == n == {n}, i.e. plain '
+                          'n-sampling, is implemented)'}, status=400)
+        echo = bool(payload.get('echo'))
+        if echo and payload.get('stream'):
+            return web.json_response(
+                {'error': 'echo cannot combine with stream'},
+                status=400)
         lora_id, lora_err = self._resolve_lora(payload)
         if lora_err is not None:
             return lora_err
@@ -609,6 +628,26 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'logprobs cannot combine with stop or '
                           'stream'}, status=400)
+        echo_texts = None
+        if echo:
+            if params.logprobs:
+                # The logprobs pieces are documented to concatenate
+                # exactly to the response text; echoing the prompt
+                # would silently misalign them (prompt logprobs are
+                # not computed).
+                return web.json_response(
+                    {'error': 'echo cannot combine with logprobs '
+                              '(prompt logprobs are not computed)'},
+                    status=400)
+            # Echo the LITERAL prompt strings (OpenAI semantics) —
+            # decode only token-array prompts, where no original
+            # string exists. Once per prompt, not per choice.
+            items = prompt if isinstance(prompt, list) and \
+                not isinstance(prompt[0], int) else [prompt]
+            echo_texts = [
+                item if isinstance(item, str)
+                else self.tokenizer.decode(toks)
+                for item, toks in zip(items, token_lists)]
         # n completions per prompt, choices prompt-major (OpenAI
         # layout). Distinct req_ids already decorrelate the sampling
         # streams (device keys seed with seed + req_id).
@@ -637,6 +676,10 @@ class InferenceServer:
         total_out = 0
         for i, (text, reason, n_gen, lp_obj) in enumerate(results):
             total_out += n_gen
+            if echo_texts is not None:
+                # Prompt-major choice layout: completion i belongs to
+                # prompt i // n.
+                text = echo_texts[i // n] + text
             choice = {'index': i, 'text': text,
                       'finish_reason': reason}
             if lp_obj is not None:

@@ -157,6 +157,34 @@ def test_temperature_sampling_is_deterministic_per_seed(small_model):
     assert len(a) == 5 and len(b) == 5
 
 
+def _boot_http_server(srv) -> str:
+    """Run an InferenceServer app on an ephemeral port (daemon thread)
+    and block until /health answers; returns the base URL. Shared by
+    every HTTP-surface test. Raises if the server never comes up."""
+    import socket
+
+    from aiohttp import web
+
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    threading.Thread(
+        target=lambda: web.run_app(srv.make_app(), port=port,
+                                   print=None, handle_signals=False),
+        daemon=True).start()
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health', timeout=2).status_code \
+                    == 200:
+                return base
+        except requests.RequestException:
+            pass
+        time.sleep(0.2)
+    raise RuntimeError('server never became healthy')
+
+
 @pytest.mark.integration
 def test_http_server(small_model):
     from aiohttp import web
@@ -169,27 +197,7 @@ def test_http_server(small_model):
                                      prefill_buckets=[16])
     eng.start()
     srv = server_lib.InferenceServer(eng)
-
-    import socket
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        port = s.getsockname()[1]
-
-    def run_app():
-        web.run_app(srv.make_app(), port=port, print=None,
-                    handle_signals=False)
-
-    th = threading.Thread(target=run_app, daemon=True)
-    th.start()
-    base = f'http://127.0.0.1:{port}'
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        try:
-            if requests.get(base + '/health', timeout=2).status_code \
-                    == 200:
-                break
-        except requests.RequestException:
-            time.sleep(0.2)
+    base = _boot_http_server(srv)
 
     want = _reference_greedy(model, params, [9, 9, 9], 4)
     resp = requests.post(base + '/generate',
@@ -242,25 +250,7 @@ def test_openai_compat_endpoints(small_model):
                                      prefill_buckets=[16])
     eng.start()
     srv = server_lib.InferenceServer(eng, model_id='debug-model')
-
-    import socket
-    with socket.socket() as s:
-        s.bind(('127.0.0.1', 0))
-        port = s.getsockname()[1]
-
-    th = threading.Thread(
-        target=lambda: web.run_app(srv.make_app(), port=port, print=None,
-                                   handle_signals=False), daemon=True)
-    th.start()
-    base = f'http://127.0.0.1:{port}'
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        try:
-            if requests.get(base + '/health', timeout=2).status_code \
-                    == 200:
-                break
-        except requests.RequestException:
-            time.sleep(0.2)
+    base = _boot_http_server(srv)
 
     try:
         models = requests.get(base + '/v1/models', timeout=5).json()
@@ -655,3 +645,41 @@ def test_chat_template_rendering(tmp_path):
             {'name': 'tool_use', 'template': 'T'},
             {'name': 'default', 'template': 'D'}]}))
     assert tokenizer_lib.load_chat_template(str(tmp_path)) == 'D'
+
+
+@pytest.mark.integration
+def test_completions_echo_and_unsupported_params(small_model):
+    """echo=true prepends the prompt; suffix/best_of are rejected with
+    clear 400s instead of being silently ignored."""
+    import socket
+
+    from aiohttp import web
+
+    from skypilot_tpu.infer import server as server_lib
+
+    model, params = small_model
+    eng = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16])
+    eng.start()
+    try:
+        srv = server_lib.InferenceServer(eng)
+        base = _boot_http_server(srv)
+        r = requests.post(f'{base}/v1/completions', json={
+            'prompt': 'hi', 'max_tokens': 2, 'echo': True}, timeout=120)
+        assert r.status_code == 200
+        # Literal-echo semantics: the response STARTS with exactly the
+        # string the client sent, not a tokenize/detokenize round-trip.
+        assert r.json()['choices'][0]['text'].startswith('hi')
+        r = requests.post(f'{base}/v1/completions', json={
+            'prompt': 'hi', 'max_tokens': 2, 'suffix': '!'}, timeout=60)
+        assert r.status_code == 400 and 'suffix' in r.json()['error']
+        r = requests.post(f'{base}/v1/completions', json={
+            'prompt': 'hi', 'max_tokens': 2, 'best_of': 5}, timeout=60)
+        assert r.status_code == 400 and 'best_of' in r.json()['error']
+        r = requests.post(f'{base}/v1/completions', json={
+            'prompt': 'hi', 'max_tokens': 2, 'echo': True,
+            'logprobs': 0}, timeout=60)
+        assert r.status_code == 400 and 'logprobs' in r.json()['error']
+    finally:
+        eng.stop()
