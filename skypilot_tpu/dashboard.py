@@ -13,6 +13,8 @@ import time
 
 from aiohttp import web
 
+from skypilot_tpu.utils import metrics as metrics_lib
+
 _PAGE = """<!DOCTYPE html>
 <html><head><title>skypilot-tpu</title>
 <meta http-equiv="refresh" content="10">
@@ -32,6 +34,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Clusters</h2>{clusters}
 <h2>Managed jobs</h2>{jobs}
 <h2>Services</h2>{services}
+<h2>Metrics</h2>{metrics}
 </body></html>"""
 
 _GOOD = {'UP', 'SUCCEEDED', 'READY', 'RUNNING'}
@@ -95,12 +98,34 @@ def _services_html() -> str:
                   rows)
 
 
+def _metrics_html() -> str:
+    """Registry snapshot panel for THIS process's metrics. Serve
+    daemons and inference replicas are separate processes — scrape
+    their own endpoints (/controller/metrics on a service's admin
+    port, /metrics on a replica) for those planes. One row per labeled
+    child; histograms render a count/sum summary instead of the full
+    bucket table."""
+    rows = []
+    for fam in metrics_lib.REGISTRY.snapshot():
+        for sample in fam['samples']:
+            labels = ','.join(f'{k}={v}'
+                              for k, v in sample['labels'].items())
+            if fam['type'] == 'histogram':
+                val = (f"count={sample['count']} "
+                       f"sum={sample['sum']:.4g}")
+            else:
+                val = f"{sample['value']:g}"
+            rows.append([fam['name'], fam['type'], labels or '-', val])
+    return _table(['metric', 'type', 'labels', 'value'], rows)
+
+
 def _render_page() -> str:
     return _PAGE.format(
         now=time.strftime('%Y-%m-%d %H:%M:%S'),
         clusters=_clusters_html(),
         jobs=_jobs_html(),
-        services=_services_html())
+        services=_services_html(),
+        metrics=_metrics_html())
 
 
 def _gather_state() -> dict:
@@ -141,10 +166,19 @@ async def api_state(request: web.Request) -> web.Response:
     return web.json_response(data)
 
 
+async def api_metrics(request: web.Request) -> web.Response:
+    """Prometheus text exposition of this process's registry."""
+    del request
+    return web.Response(
+        body=metrics_lib.REGISTRY.expose().encode('utf-8'),
+        headers={'Content-Type': metrics_lib.CONTENT_TYPE})
+
+
 def make_app() -> web.Application:
     app = web.Application()
     app.router.add_get('/', index)
     app.router.add_get('/api/state', api_state)
+    app.router.add_get('/metrics', api_metrics)
     return app
 
 

@@ -31,8 +31,12 @@ import numpy as np
 
 from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
+
+# Completed request traces kept for /stats?request_id= queries.
+_TRACE_KEEP = 2048
 
 # Device-side top-k sampling supports k up to this (one fixed-size
 # top_k sort serves all slots' per-request k values).
@@ -326,7 +330,9 @@ class InferenceEngine:
                  prefill_chunk: int = 0,
                  lockstep=None,
                  draft_model=None, draft_params=None,
-                 lora_stack=None) -> None:
+                 lora_stack=None,
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None) -> None:
         """mesh: optional jax.sharding.Mesh — the engine then runs
         tp-sharded: params must already carry their NamedShardings
         (models/weights.py load_llama_params/shard_params) and the KV
@@ -550,6 +556,55 @@ class InferenceEngine:
         # Rolling TTFT window (seconds) for /stats percentiles.
         import collections as _collections
         self._ttfts = _collections.deque(maxlen=512)
+        # --- metrics plane (utils/metrics.py): continuously updated
+        # counters/gauges/histograms the server exposes at /metrics.
+        # Registry is injectable for tests; get-or-create semantics make
+        # repeated engine construction in one process safe.
+        self.metrics_registry = metrics_registry or metrics_lib.REGISTRY
+        reg = self.metrics_registry
+        self._m_requests = reg.counter(
+            'skyt_infer_requests_total', 'Requests submitted')
+        self._m_prefill_tokens = reg.counter(
+            'skyt_infer_prefill_tokens_total',
+            'Prompt tokens admitted through prefill')
+        self._m_decode_tokens = reg.counter(
+            'skyt_infer_decode_tokens_total',
+            'Tokens generated by decode')
+        self._m_queue_depth = reg.gauge(
+            'skyt_infer_queue_depth',
+            'Requests queued but not yet admitted to a slot')
+        self._m_running = reg.gauge(
+            'skyt_infer_running_requests',
+            'Requests occupying a decode slot')
+        self._m_slots = reg.gauge(
+            'skyt_infer_slots_total', 'Configured decode slots')
+        self._m_slots.set(num_slots)
+        self._m_ttft = reg.histogram(
+            'skyt_infer_ttft_seconds',
+            'Time to first token (queue wait + prefill)')
+        self._m_itl = reg.histogram(
+            'skyt_infer_itl_seconds',
+            'Inter-token latency (per-chunk mean across active slots)')
+        self._m_kv_util = reg.gauge(
+            'skyt_infer_kv_cache_utilization',
+            'KV cache occupancy fraction (0-1)')
+        self._m_prefix_hit = reg.counter(
+            'skyt_infer_prefix_cache_hit_pages_total',
+            'Prompt pages served from the prefix cache')
+        self._m_prefix_miss = reg.counter(
+            'skyt_infer_prefix_cache_miss_pages_total',
+            'Prompt pages that missed the prefix cache')
+        # Last pool.prefix_stats values already folded into the
+        # counters (the pool keeps running totals; counters take the
+        # delta so restarts/resets keep Prometheus rate() math valid).
+        self._prefix_seen = {'hit_pages': 0, 'miss_pages': 0}
+        # --- request-phase traces: req_id -> monotonic-free wall-clock
+        # timestamps (queued -> prefill_start -> first_token -> done),
+        # queryable via the server's /stats?request_id=. Bounded FIFO.
+        self._traces: 'Dict[int, Dict[str, Any]]' = \
+            _collections.OrderedDict()
+        self._traces_lock = threading.Lock()
+        self._last_gauge_t = 0.0
 
         self._jit_prefill = jax.jit(self._prefill_impl,
                                     static_argnames=('bucket',))
@@ -1118,6 +1173,9 @@ class InferenceEngine:
         req = _Request(req_id=req_id, tokens=list(tokens), params=params,
                        out_queue=queue.Queue(),
                        rng=np.random.default_rng(params.seed + req_id))
+        self._m_requests.inc()
+        self._trace_event(req_id, 'queued', ts=req.submitted_at,
+                          prompt_tokens=len(tokens), status='waiting')
         if self._lockstep is not None:
             if not self._lockstep.is_primary:
                 raise RuntimeError(
@@ -1307,6 +1365,65 @@ class InferenceEngine:
                 'count': int(arr.size)}
         return p
 
+    # -------------------------------------------------- metrics/tracing
+    def _trace_event(self, req_id: int, phase: str,
+                     ts: Optional[float] = None, **extra) -> None:
+        """Record one phase timestamp for a request (first write wins,
+        so the chunked-prefill path's repeated calls are safe). The
+        table is a bounded FIFO over request ids."""
+        now = ts if ts is not None else time.time()
+        with self._traces_lock:
+            tr = self._traces.get(req_id)
+            if tr is None:
+                tr = {'request_id': req_id}
+                self._traces[req_id] = tr
+                while len(self._traces) > _TRACE_KEEP:
+                    self._traces.popitem(last=False)
+            tr.setdefault(phase, now)
+            tr.update(extra)
+
+    def request_trace(self, req_id: int) -> Optional[Dict[str, Any]]:
+        """Phase timestamps for a request (queued, prefill_start,
+        first_token, done + prompt_tokens/generated/status), or None
+        for an unknown / evicted id."""
+        with self._traces_lock:
+            tr = self._traces.get(req_id)
+            return dict(tr) if tr is not None else None
+
+    def _update_metric_gauges(self) -> None:
+        """Refresh occupancy gauges. Called every engine-loop tick but
+        throttled to ~4Hz: the loop shares cores with XLA's compute
+        threads, and scrapes don't need sub-second freshness — the
+        counters/histograms (updated at their events) stay exact."""
+        now = time.monotonic()
+        if now - self._last_gauge_t < 0.25:
+            return
+        self._last_gauge_t = now
+        waiting = self._waiting.qsize() + (
+            1 if self._deferred is not None else 0)
+        self._m_queue_depth.set(waiting)
+        self._m_running.set(
+            sum(1 for s in self._slots if s is not None))
+        if self.pool is not None:
+            total = self.pool.cfg.n_pages - 1   # page 0 is the dummy
+            if total > 0:
+                self._m_kv_util.set(
+                    (total - self.pool.free_pages()) / total)
+            if self.prefix_caching:
+                ps = self.pool.prefix_stats
+                for key, metric in (('hit_pages', self._m_prefix_hit),
+                                    ('miss_pages',
+                                     self._m_prefix_miss)):
+                    cur = int(ps.get(key, 0))
+                    if cur > self._prefix_seen[key]:
+                        metric.inc(cur - self._prefix_seen[key])
+                        self._prefix_seen[key] = cur
+        else:
+            denom = self.num_slots * self.max_seq_len
+            if denom > 0:
+                self._m_kv_util.set(
+                    float(self._conf_lengths.sum()) / denom)
+
     def reset_perf(self) -> None:
         self.perf = {'decode_tokens': 0, 'decode_chunks': 0,
                      'steady_tokens': 0, 'steady_time_s': 0.0,
@@ -1359,7 +1476,9 @@ class InferenceEngine:
             except queue.Empty:
                 return False
         if req.cancelled:
-            # Cancelled while waiting: never occupies a slot.
+            # Cancelled while waiting: never occupies a slot. Trace
+            # before the None unblocks the waiter.
+            self._trace_event(req.req_id, 'done', status='cancelled')
             req.out_queue.put(None)
             return True
         # Visible to cancel() during the admission window (popped from
@@ -1425,6 +1544,8 @@ class InferenceEngine:
                 self._chunked = {'req': req, 'slot': slot, 'row': row,
                                  'hashes': hashes,
                                  'start': n_cached * psize, 'n': n}
+                self._trace_event(req.req_id, 'prefill_start',
+                                  status='running')
                 return True
             if n_cached > 0:
                 sb = self._bucket_for(n - n_cached * psize)
@@ -1441,6 +1562,8 @@ class InferenceEngine:
                         return False
                     row, n_cached = res
         temp = max(0.0, req.params.temperature)
+        self._trace_event(req.req_id, 'prefill_start',
+                          status='running')
         key = jax.random.PRNGKey(req.params.seed + req.req_id)
         with self._ctx():
             if n_cached > 0:
@@ -1570,6 +1693,10 @@ class InferenceEngine:
                     jnp.int32(first))
         req.first_token_at = time.time()
         self._ttfts.append(req.first_token_at - req.submitted_at)
+        self._m_ttft.observe(req.first_token_at - req.submitted_at)
+        self._m_prefill_tokens.inc(n)
+        self._trace_event(req.req_id, 'first_token',
+                          ts=req.first_token_at)
         req.slot = slot
         self._slot_lora[slot] = req.params.lora_id
         req.generated = 1
@@ -1690,9 +1817,20 @@ class InferenceEngine:
             return True
         return False
 
-    def _release(self, slot: int) -> None:
+    def _release(self, slot: int,
+                 status: Optional[str] = None) -> None:
+        """status overrides the recorded trace outcome (the crash
+        handler passes 'failed' — a killed request must not read as a
+        normal completion in /stats)."""
         req = self._slots[slot]
         if req is not None:
+            # Trace BEFORE the terminal None: put() unblocks the HTTP
+            # handler, and a client hitting /stats?request_id= right
+            # after its response must see the completed trace.
+            self._trace_event(
+                req.req_id, 'done', generated=req.generated,
+                status=status or ('cancelled' if req.cancelled
+                                  else 'done'))
             req.out_queue.put(None)
         if self._chunked is not None and self._chunked['slot'] == slot:
             # Crash-path release mid-chunked-prefill: abandon it.
@@ -1738,15 +1876,19 @@ class InferenceEngine:
                     pass
             for i, req in enumerate(self._slots):
                 if req is not None:
-                    self._release(i)
+                    self._release(i, status='failed')
             if self._deferred is not None:
+                self._trace_event(self._deferred.req_id, 'done',
+                                  status='failed')
                 self._deferred.out_queue.put(None)
                 self._deferred = None
             while True:
                 try:
-                    self._waiting.get_nowait().out_queue.put(None)
+                    req = self._waiting.get_nowait()
                 except queue.Empty:
                     break
+                self._trace_event(req.req_id, 'done', status='failed')
+                req.out_queue.put(None)
             self.ready.clear()
 
     def _loop_body(self) -> None:
@@ -1872,6 +2014,7 @@ class InferenceEngine:
                     new_pending = ('plain', toks, lps, None,
                                    entries, chunk)
                     upper = chunk
+            self._update_metric_gauges()
             if pending is not None:
                 self._finish_chunk(pending)
             elif not active and not admitted and not chunking:
@@ -1986,6 +2129,7 @@ class InferenceEngine:
                 self._conf_lengths[i] = base[i]
         self.perf['decode_tokens'] += delivered
         self.perf['decode_chunks'] += 1
+        self._m_decode_tokens.inc(delivered)
         if kind == 'spec':
             self.perf['spec_steps'] += chunk
             self.perf['spec_tokens'] += delivered
@@ -1994,5 +2138,12 @@ class InferenceEngine:
         if self._last_pull_t is not None and not self._had_admission:
             self.perf['steady_tokens'] += delivered
             self.perf['steady_time_s'] += now - self._last_pull_t
+            if delivered > 0:
+                # Chunk-mean inter-token latency: tokens arrive in
+                # pulled chunks, so the per-token time within a chunk
+                # is unobservable — the pull interval divided by the
+                # chunk's delivered count is the honest estimator.
+                self._m_itl.observe((now - self._last_pull_t)
+                                    / delivered)
         self._last_pull_t = now
         self._had_admission = False

@@ -11,7 +11,13 @@ service.yaml readiness-probes /v1/models). Endpoints:
                             stream. stream=true sends one JSON line per
                             token as soon as it is sampled (TTFT = first
                             chunk latency).
-  GET  /stats             — engine slot/queue stats.
+  GET  /stats             — engine slot/queue stats;
+                            ?request_id=N returns that request's phase
+                            trace (queued → prefill_start →
+                            first_token → done timestamps).
+  GET  /metrics           — Prometheus text exposition (TTFT/ITL
+                            histograms, token counters, KV-cache and
+                            queue gauges; utils/metrics.py).
   GET  /v1/models         — OpenAI-compatible model listing (the
                             reference's service.yaml readiness-probes
                             this exact path).
@@ -47,6 +53,7 @@ from aiohttp import web
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import tokenizer as tokenizer_lib
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -178,8 +185,27 @@ class InferenceServer:
         return web.json_response({'status': 'starting'}, status=503)
 
     async def _stats(self, request: web.Request) -> web.Response:
-        del request
+        rid = request.query.get('request_id')
+        if rid is not None:
+            try:
+                rid_int = int(rid)
+            except ValueError:
+                return web.json_response(
+                    {'error': 'request_id must be an integer'},
+                    status=400)
+            trace = self.engine.request_trace(rid_int)
+            if trace is None:
+                return web.json_response(
+                    {'error': f'no trace for request {rid_int} '
+                              f'(unknown or evicted)'}, status=404)
+            return web.json_response(trace)
         return web.json_response(self.engine.stats())
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        del request
+        return web.Response(
+            body=self.engine.metrics_registry.expose().encode('utf-8'),
+            headers={'Content-Type': metrics_lib.CONTENT_TYPE})
 
     async def _generate(self, request: web.Request) -> web.StreamResponse:
         payload = await request.json()
@@ -229,7 +255,8 @@ class InferenceServer:
 
         if payload.get('stream'):
             resp = web.StreamResponse(
-                headers={'Content-Type': 'application/x-ndjson'})
+                headers={'Content-Type': 'application/x-ndjson',
+                         'X-Request-Id': str(req_id)})
             await resp.prepare(request)
             while True:
                 tok = await loop.run_in_executor(
@@ -247,7 +274,7 @@ class InferenceServer:
             'request_id': req_id,
             'tokens': out,
             'text': self.tokenizer.decode(visible),
-        })
+        }, headers={'X-Request-Id': str(req_id)})
 
     # ----------------------------------------------- OpenAI-compatible
     # The reference serves vLLM's OpenAI API (llm/vllm/serve.yaml probes
@@ -489,9 +516,11 @@ class InferenceServer:
         sequences, emission halts at the earliest match (the stop text
         is never sent) and the engine request is cancelled."""
         loop = asyncio.get_running_loop()
-        resp = web.StreamResponse(
-            headers={'Content-Type': 'text/event-stream',
-                     'Cache-Control': 'no-cache'})
+        headers = {'Content-Type': 'text/event-stream',
+                   'Cache-Control': 'no-cache'}
+        if rid is not None:
+            headers['X-Request-Id'] = str(rid)
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         saw_eos = False
         stopped = False
@@ -692,7 +721,7 @@ class InferenceServer:
             'usage': {'prompt_tokens': n_in,
                       'completion_tokens': total_out,
                       'total_tokens': n_in + total_out},
-        })
+        }, headers={'X-Request-Id': str(subs[0][0])})
 
     def _apply_chat_template(self, messages) -> str:
         """The checkpoint's HF chat template when the tokenizer dir
@@ -799,12 +828,38 @@ class InferenceServer:
             'usage': {'prompt_tokens': len(tokens),
                       'completion_tokens': total_out,
                       'total_tokens': len(tokens) + total_out},
-        })
+        }, headers={'X-Request-Id': str(rid)})
 
     def make_app(self) -> web.Application:
-        app = web.Application()
+        m_http = self.engine.metrics_registry.counter(
+            'skyt_http_requests_total', 'HTTP requests served',
+            ('path', 'code'))
+
+        @web.middleware
+        async def count_requests(request: web.Request, handler):
+            # Label with the matched route's canonical path (a fixed,
+            # bounded set) — never the raw request path, whose
+            # cardinality is attacker-controlled.
+            resource = request.match_info.route.resource
+            path = resource.canonical if resource is not None \
+                else 'unmatched'
+            try:
+                resp = await handler(request)
+            except web.HTTPException as e:
+                m_http.labels(path, str(e.status)).inc()
+                raise
+            except Exception:
+                # aiohttp turns unhandled handler exceptions into 500s
+                # — the error-rate signal this counter exists for.
+                m_http.labels(path, '500').inc()
+                raise
+            m_http.labels(path, str(resp.status)).inc()
+            return resp
+
+        app = web.Application(middlewares=[count_requests])
         app.router.add_get('/health', self._health)
         app.router.add_get('/stats', self._stats)
+        app.router.add_get('/metrics', self._metrics)
         app.router.add_post('/generate', self._generate)
         app.router.add_get('/v1/models', self._models)
         app.router.add_post('/v1/completions', self._completions)

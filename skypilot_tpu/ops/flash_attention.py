@@ -31,6 +31,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.5); support both
+# so the kernels work on whichever jax the image ships.
+_CompilerParams = getattr(pltpu, 'CompilerParams',
+                          getattr(pltpu, 'TPUCompilerParams', None))
+
 NEG_INF = -1e30
 
 # Row statistics (lse, delta) are carried as [..., seq, LANES] arrays with
@@ -369,7 +374,7 @@ def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k,
             pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
             pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=_interpret_mode(),
@@ -443,7 +448,7 @@ def _bwd_rule(causal, block_q, block_k, window, res, g):
         out_specs=pl.BlockSpec((1, 1, block_q, d), qkv_spec),
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=_interpret_mode(),
@@ -495,7 +500,7 @@ def _bwd_rule(causal, block_q, block_k, window, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=_interpret_mode(),

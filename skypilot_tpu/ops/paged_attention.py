@@ -31,6 +31,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams (~0.5); support both
+# so the paged path works on whichever jax the image ships.
+_CompilerParams = getattr(pltpu, 'CompilerParams',
+                          getattr(pltpu, 'TPUCompilerParams', None))
+
 NEG_INF = -1e30
 LANES = 128
 
@@ -189,7 +194,7 @@ def paged_decode_attention_mq(q: jax.Array, k_pool: jax.Array,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_slots, hkv, t * g, d),
                                        q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'arbitrary')),
         interpret=_interpret_mode() if interpret is None else interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool,
@@ -243,7 +248,7 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_slots, hkv, g, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'arbitrary')),
         interpret=_interpret_mode() if interpret is None else interpret,
     )(tables.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pool,

@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -27,9 +28,27 @@ class AutoscalerDecision:
 
 
 class Autoscaler:
-    def __init__(self, spec: 'spec_lib.ServiceSpec') -> None:
+    def __init__(self, spec: 'spec_lib.ServiceSpec',
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None) -> None:
         self.spec = spec
         self.target_num_replicas = spec.min_replicas
+        # Decision audit trail: every evaluate_scaling outcome lands in
+        # a labeled counter so scaling behavior is explainable after
+        # the fact (which decisions fired, how often) without log
+        # archaeology.
+        reg = metrics_registry or metrics_lib.REGISTRY
+        self._m_decisions = reg.counter(
+            'skyt_autoscaler_decisions_total',
+            'Autoscaler decisions by kind', ('decision',))
+        self._m_target = reg.gauge(
+            'skyt_autoscaler_target_replicas',
+            'Current target replica count')
+        self._m_target.set(self.target_num_replicas)
+
+    def _record_decision(self, kind: str) -> None:
+        self._m_decisions.labels(kind).inc()
+        self._m_target.set(self.target_num_replicas)
 
     def update_spec(self, spec: 'spec_lib.ServiceSpec') -> None:
         self.spec = spec
@@ -44,8 +63,10 @@ class Autoscaler:
 class RequestRateAutoscaler(Autoscaler):
     """Reference: sky/serve/autoscalers.py:141."""
 
-    def __init__(self, spec: 'spec_lib.ServiceSpec') -> None:
-        super().__init__(spec)
+    def __init__(self, spec: 'spec_lib.ServiceSpec',
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None) -> None:
+        super().__init__(spec, metrics_registry)
         self.request_timestamps: List[float] = []
         # Consecutive decision periods the raw target has exceeded /
         # undershot the current target (reference upscale/downscale
@@ -82,6 +103,7 @@ class RequestRateAutoscaler(Autoscaler):
                 # sizes, not to gate cold starts. Launch immediately.
                 self.target_num_replicas = raw
                 self._upscale_since = None
+                self._record_decision('wake_from_zero')
                 return AutoscalerDecision(
                     raw, f'wake from zero -> upscale to {raw}')
             if self._upscale_since is None:
@@ -89,6 +111,7 @@ class RequestRateAutoscaler(Autoscaler):
             if now - self._upscale_since >= self.spec.upscale_delay_seconds:
                 self.target_num_replicas = raw
                 self._upscale_since = None
+                self._record_decision('upscale')
                 return AutoscalerDecision(
                     raw, f'sustained load -> upscale to {raw}')
         elif raw < current:
@@ -99,11 +122,13 @@ class RequestRateAutoscaler(Autoscaler):
                     self.spec.downscale_delay_seconds:
                 self.target_num_replicas = raw
                 self._downscale_since = None
+                self._record_decision('downscale')
                 return AutoscalerDecision(
                     raw, f'sustained idle -> downscale to {raw}')
         else:
             self._upscale_since = None
             self._downscale_since = None
+        self._record_decision('steady')
         return AutoscalerDecision(current, 'steady')
 
 

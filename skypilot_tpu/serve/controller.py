@@ -18,6 +18,7 @@ from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -120,6 +121,17 @@ class SkyServeController:
             'replicas': replicas,
         })
 
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus exposition of this service daemon's registry —
+        the controller and LB share one process (serve/service.py), so
+        this covers LB traffic, replica lifecycle, and autoscaler
+        decision metrics. Behind the same bearer auth as the rest of
+        the admin API."""
+        del request
+        return web.Response(
+            body=metrics_lib.REGISTRY.expose().encode('utf-8'),
+            headers={'Content-Type': metrics_lib.CONTENT_TYPE})
+
     async def _handle_terminate(self, request: web.Request) -> web.Response:
         """Graceful teardown: stop scaling, tear replicas down, ack."""
         del request
@@ -166,6 +178,7 @@ class SkyServeController:
         app.router.add_post('/controller/terminate',
                             self._handle_terminate)
         app.router.add_get('/controller/status', self._handle_status)
+        app.router.add_get('/controller/metrics', self._handle_metrics)
         return app
 
     def start_control_loop(self) -> None:

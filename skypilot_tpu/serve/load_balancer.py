@@ -18,6 +18,7 @@ from aiohttp import web
 
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -35,9 +36,23 @@ class SkyServeLoadBalancer:
 
     def __init__(self, controller_url: str, port: int,
                  policy: str = 'round_robin',
-                 controller_auth: Optional[str] = None) -> None:
+                 controller_auth: Optional[str] = None,
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None) -> None:
         self.controller_url = controller_url
         self.port = port
+        reg = metrics_registry or metrics_lib.REGISTRY
+        # Per-replica traffic accounting; the 'replica' label is the
+        # replica URL — bounded by the replica count, not by clients.
+        self._m_requests = reg.counter(
+            'skyt_lb_requests_total', 'Requests proxied', ('replica',))
+        self._m_errors = reg.counter(
+            'skyt_lb_errors_total',
+            'Proxy failures (replica="none" = no ready replica)',
+            ('replica',))
+        self._m_inflight = reg.gauge(
+            'skyt_lb_inflight_requests',
+            'Requests currently being proxied', ('replica',))
         # Bearer token for the controller's authenticated admin API.
         self._controller_headers = (
             {'Authorization': f'Bearer {controller_auth}'}
@@ -62,12 +77,31 @@ class SkyServeLoadBalancer:
                         headers=self._controller_headers,
                         timeout=aiohttp.ClientTimeout(total=5)) as resp:
                     data = await resp.json()
-                    self.policy.set_ready_replicas(
-                        data.get('ready_replica_urls', []))
+                    ready = data.get('ready_replica_urls', [])
+                    self.policy.set_ready_replicas(ready)
+                    self._prune_replica_metrics(ready)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning('controller sync failed: %s', e)
                 self.request_timestamps = ts + self.request_timestamps
             await asyncio.sleep(_sync_interval())
+
+    def _prune_replica_metrics(self, ready) -> None:
+        """Evict metric children for replicas no longer in the ready
+        set — replica URLs churn across spot relaunches, and children
+        are never evicted by the registry itself, so without this the
+        long-lived LB daemon accumulates dead-replica series without
+        bound. The inflight gauge is only pruned at zero (a request
+        still draining to a retired replica must dec its own child,
+        not a recreated one)."""
+        keep = set(ready) | {'none'}
+        for metric in (self._m_requests, self._m_errors):
+            for key in metric.label_keys():
+                if key[0] not in keep:
+                    metric.remove_labels(*key)
+        for key in self._m_inflight.label_keys():
+            if key[0] not in keep and \
+                    self._m_inflight.value(*key) == 0:
+                self._m_inflight.remove_labels(*key)
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         """Reference: :116 _proxy_request_to — with retry-on-no-replica
@@ -80,14 +114,18 @@ class SkyServeLoadBalancer:
             if replica is not None:
                 break
             if time.time() > deadline:
+                self._m_errors.labels('none').inc()
                 return web.Response(
                     status=503,
                     text='No ready replicas. Use "skyt serve status" to '
                          'check the service.')
             await asyncio.sleep(1)
+        self._m_requests.labels(replica).inc()
+        self._m_inflight.labels(replica).inc()
         try:
             return await self._proxy_to(request, replica, body)
         finally:
+            self._m_inflight.labels(replica).dec()
             self.policy.on_request_done(replica)
 
     async def _proxy_to(self, request: web.Request, replica: str,
@@ -116,6 +154,7 @@ class SkyServeLoadBalancer:
                 return response
         except aiohttp.ClientError as e:
             logger.warning('proxy to %s failed: %s', replica, e)
+            self._m_errors.labels(replica).inc()
             return web.Response(status=502,
                                 text=f'Replica {replica} failed: {e}')
 

@@ -24,6 +24,7 @@ from skypilot_tpu import state as cluster_state
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -65,11 +66,27 @@ class ReplicaManager:
     """Reference: sky/serve/replica_managers.py:560."""
 
     def __init__(self, service_name: str, spec: 'spec_lib.ServiceSpec',
-                 task_yaml: str, version: int = 1) -> None:
+                 task_yaml: str, version: int = 1,
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None) -> None:
         self.service_name = service_name
         self.spec = spec
         self.task_yaml = task_yaml
         self.version = version
+        reg = metrics_registry or metrics_lib.REGISTRY
+        self._m_launches = reg.counter(
+            'skyt_serve_replica_launches_total', 'Replica launches',
+            ('service',))
+        # Per-service only: replica ids grow monotonically over churn
+        # and counter children are never evicted, so a replica_id label
+        # would leak memory on long-lived spot services. Per-replica
+        # detail lives in replica status / logs.
+        self._m_probe_failures = reg.counter(
+            'skyt_serve_probe_failures_total',
+            'Failed readiness probes', ('service',))
+        self._m_replicas = reg.gauge(
+            'skyt_serve_replicas', 'Replicas by lifecycle status',
+            ('service', 'status'))
         self._probe_passes = -1
         # replica_id -> probe pass of the last /stats ATTEMPT: the
         # throttle must key on attempts, not on stats being None —
@@ -159,6 +176,7 @@ class ReplicaManager:
                 launched_at=time.time())
             self.replicas[rid] = info
             self._save(info)
+            self._m_launches.labels(self.service_name).inc()
             th = threading.Thread(target=self._launch_thread,
                                   args=(info,), daemon=True)
             self._threads[rid] = th
@@ -284,6 +302,18 @@ class ReplicaManager:
         except (requests.RequestException, ValueError):
             return None
 
+    def _update_replica_gauges(self) -> None:
+        """Per-status replica gauge — set EVERY known status each pass
+        so counts drop back to 0 when replicas leave a state (a labeled
+        gauge never forgets a child on its own)."""
+        with self._lock:
+            counts = {s: 0 for s in serve_state.ReplicaStatus}
+            for info in self.replicas.values():
+                counts[info.status] += 1
+        for status, n in counts.items():
+            self._m_replicas.labels(self.service_name,
+                                    status.value).set(n)
+
     def probe_all(self) -> None:
         """One probe pass (reference: _replica_prober :1019 + parallel
         probes :497-543)."""
@@ -318,6 +348,7 @@ class ReplicaManager:
                 self._save(info)
                 continue
             info.consecutive_failures += 1
+            self._m_probe_failures.labels(self.service_name).inc()
             # Stale perf numbers beside a failing replica mislead
             # incident triage.
             info.stats = None
@@ -340,6 +371,7 @@ class ReplicaManager:
                 self._save(info)
             else:
                 self._save(info)
+        self._update_replica_gauges()
 
     # ---------------------------------------------------------- reconcile
     def reconcile(self, target: int, ondemand_base: int = 0) -> None:

@@ -284,8 +284,10 @@ def main(argv=None) -> None:
 
     from skypilot_tpu.utils import profiling
     prof = profiling.StepProfiler()   # no-op unless SKYT_PROFILE_DIR set
+    mpub = trainer.TrainMetricsPublisher()
 
     t0 = time.perf_counter()
+    last_t = t0
     tokens_seen = 0
     try:
         for step in range(start_step, args.steps):
@@ -296,8 +298,23 @@ def main(argv=None) -> None:
             if ckpt is not None:
                 ckpt.save(step + 1, state)
             if (step + 1) % args.log_every == 0:
-                loss = float(jax.device_get(metrics['loss']))
-                dt = time.perf_counter() - t0
+                # ONE device sync for both logged scalars; publish()
+                # then sees host floats and adds no transfers.
+                host = jax.device_get(
+                    {k: metrics[k] for k in ('loss', 'grad_norm')
+                     if k in metrics})
+                loss = float(host['loss'])
+                now = time.perf_counter()
+                dt = now - t0
+                # Step time averaged over the logging window (the
+                # device_get above already synced this window's work).
+                n_window = min(args.log_every, step + 1 - start_step)
+                mpub.publish(host,
+                             step_time_s=(now - last_t)
+                             / max(1, n_window),
+                             tokens_per_sec=tokens_seen / dt,
+                             steps=n_window)
+                last_t = now
                 logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
                             step + 1, args.steps, loss, tokens_seen / dt)
     finally:

@@ -7,7 +7,7 @@ code runs DP, FSDP, TP, CP, EP or any product of them by changing the mesh,
 with XLA inserting all collectives over ICI/DCN.
 """
 import dataclasses
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
 import flax.struct
@@ -17,6 +17,7 @@ import optax
 from jax.sharding import Mesh
 
 from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.utils import metrics as metrics_lib
 
 
 @dataclasses.dataclass
@@ -30,6 +31,53 @@ class TrainerConfig:
     grad_clip: float = 1.0
     # Gradient accumulation (microbatches per step); 1 = off.
     grad_accum: int = 1
+
+
+class TrainMetricsPublisher:
+    """Training-side view of the shared metrics plane: step time,
+    throughput, loss, and grad norm land in the same registry the
+    serving layer exposes, so the dashboard and tests read one API
+    (utils/metrics.py) for every layer.
+
+    publish() pulls only host-side floats the caller already has (or
+    device scalars it is about to log anyway) — it adds no device
+    syncs of its own to the hot loop.
+    """
+
+    def __init__(self, registry: Optional[
+            'metrics_lib.MetricsRegistry'] = None) -> None:
+        reg = registry or metrics_lib.REGISTRY
+        self.step_seconds = reg.gauge(
+            'skyt_train_step_seconds',
+            'Wall time of the most recent training step')
+        self.tokens_per_sec = reg.gauge(
+            'skyt_train_tokens_per_sec',
+            'Training throughput over the run so far')
+        self.loss = reg.gauge(
+            'skyt_train_loss', 'Most recently logged training loss')
+        self.grad_norm = reg.gauge(
+            'skyt_train_grad_norm',
+            'Most recently logged global gradient norm')
+        self.steps = reg.counter(
+            'skyt_train_steps_total', 'Training steps completed')
+
+    def publish(self, metrics: Dict[str, Any],
+                step_time_s: Optional[float] = None,
+                tokens_per_sec: Optional[float] = None,
+                steps: int = 1) -> None:
+        """metrics: the train step's output dict ({'loss', 'grad_norm',
+        ...}); device scalars are pulled here (call at log boundaries,
+        not every step, if that transfer matters)."""
+        self.steps.inc(steps)
+        if 'loss' in metrics:
+            self.loss.set(float(jax.device_get(metrics['loss'])))
+        if 'grad_norm' in metrics:
+            self.grad_norm.set(
+                float(jax.device_get(metrics['grad_norm'])))
+        if step_time_s is not None:
+            self.step_seconds.set(step_time_s)
+        if tokens_per_sec is not None:
+            self.tokens_per_sec.set(tokens_per_sec)
 
 
 def make_optimizer(tcfg: TrainerConfig) -> optax.GradientTransformation:

@@ -26,14 +26,35 @@ from skypilot_tpu.utils import log_utils
 logger = log_utils.init_logger(__name__)
 
 
+def _env_int(name: str, default: int, minimum: int = 0) -> int:
+    """Parse an int env var, falling back to `default` (with a logged
+    warning) on malformed or out-of-range values — a typo in the launch
+    YAML must degrade to default profiling, not crash the training job
+    with a bare ValueError."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        logger.warning('%s=%r is not an integer; using default %d',
+                       name, raw, default)
+        return default
+    if val < minimum:
+        logger.warning('%s=%d is below the minimum %d; using default '
+                       '%d', name, val, minimum, default)
+        return default
+    return val
+
+
 class StepProfiler:
     """Profiles steps [start, start + num) of a training loop."""
 
     def __init__(self, trace_dir: Optional[str] = None) -> None:
         self.trace_dir = trace_dir or os.environ.get('SKYT_PROFILE_DIR')
-        self.start_step = int(
-            os.environ.get('SKYT_PROFILE_START_STEP', '2'))
-        self.num_steps = int(os.environ.get('SKYT_PROFILE_NUM_STEPS', '3'))
+        self.start_step = _env_int('SKYT_PROFILE_START_STEP', 2)
+        self.num_steps = _env_int('SKYT_PROFILE_NUM_STEPS', 3,
+                                  minimum=1)
         self._active = False
         self._done = False
 

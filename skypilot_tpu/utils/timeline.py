@@ -18,14 +18,21 @@ from skypilot_tpu.utils import env_options
 
 _events: List[Dict[str, Any]] = []
 _events_lock = threading.Lock()
-_enabled: Optional[bool] = None
 
 
 def _is_enabled() -> bool:
-    global _enabled
-    if _enabled is None:
-        _enabled = env_options.Options.IS_DEBUG.get()
-    return _enabled
+    # Re-read the env every call (one dict lookup — noise next to the
+    # event append it gates): the old first-call-wins cache pinned
+    # long-lived servers toggling SKYT_DEBUG, and tests monkeypatching
+    # it, to whatever the first traced call happened to see.
+    return env_options.Options.IS_DEBUG.get()
+
+
+def reset() -> None:
+    """Drop recorded events (tests; long-lived processes rotating
+    traces after a save_timeline())."""
+    with _events_lock:
+        _events.clear()
 
 
 class Event:

@@ -103,3 +103,20 @@ def test_profile_synced_down_with_logs(tmp_path, tmp_state_dir,
         assert _xplanes(prof_root), 'no xplane.pb in synced trace'
     finally:
         core.down('c-prof', purge=True)
+
+
+def test_step_profiler_malformed_env_falls_back(monkeypatch):
+    """A typo'd SKYT_PROFILE_* value degrades to the default with a
+    warning instead of crashing the training job with a ValueError."""
+    from skypilot_tpu.utils import profiling
+
+    monkeypatch.setenv('SKYT_PROFILE_START_STEP', 'banana')
+    monkeypatch.setenv('SKYT_PROFILE_NUM_STEPS', '2.5')
+    prof = profiling.StepProfiler(trace_dir='/tmp/unused')
+    assert prof.start_step == 2 and prof.num_steps == 3
+
+    # Out-of-range num_steps (must be >= 1) also falls back.
+    monkeypatch.setenv('SKYT_PROFILE_START_STEP', '0')
+    monkeypatch.setenv('SKYT_PROFILE_NUM_STEPS', '0')
+    prof = profiling.StepProfiler(trace_dir='/tmp/unused')
+    assert prof.start_step == 0 and prof.num_steps == 3

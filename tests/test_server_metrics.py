@@ -1,0 +1,124 @@
+"""Inference-server metrics surface (the serving half of
+tests/test_metrics.py, split out beside the other HTTP-surface
+integration tests): /metrics scrapes cleanly while a completion
+streams, and the X-Request-Id header resolves to a phase trace via
+/stats?request_id=.
+"""
+import pytest
+
+from skypilot_tpu.utils import metrics as metrics_lib
+
+# ---------------------------------------------------- serving integration
+_EXPO_LINE = (r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+              r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"'
+              r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})? '
+              r'(\+Inf|-Inf|NaN|-?[0-9.e+-]+)$')
+
+
+def _assert_valid_exposition(text: str) -> None:
+    import re
+    assert text.endswith('\n')
+    for line in text.splitlines():
+        if line.startswith('# HELP ') or line.startswith('# TYPE '):
+            continue
+        assert re.match(_EXPO_LINE, line), f'bad exposition line: {line!r}'
+
+
+@pytest.mark.integration
+def test_metrics_endpoint_while_streaming():
+    """GET /metrics returns valid exposition text (TTFT histogram,
+    KV-cache utilization gauge included) while a completion streams;
+    the stream's X-Request-Id resolves to a full phase trace via
+    /stats?request_id=."""
+    import dataclasses
+    import json
+    import socket
+    import threading as th
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.CONFIGS['debug'], max_seq_len=64)
+    model = llama.LlamaModel(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    reg = metrics_lib.MetricsRegistry()
+    eng = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16],
+                                     metrics_registry=reg)
+    eng.start()
+    srv = server_lib.InferenceServer(eng)
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    th.Thread(target=lambda: web.run_app(
+        srv.make_app(), port=port, print=None, handle_signals=False),
+        daemon=True).start()
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(0.2)
+
+    try:
+        resp = requests.post(
+            base + '/generate',
+            json={'tokens': [9, 9, 9], 'max_tokens': 8, 'stream': True},
+            stream=True, timeout=120)
+        rid = resp.headers['X-Request-Id']
+        tokens = []
+        scraped_mid_stream = None
+        for line in resp.iter_lines():
+            if not line:
+                continue
+            tokens.append(json.loads(line)['token'])
+            if scraped_mid_stream is None:
+                # Scrape WHILE the completion is still streaming.
+                m = requests.get(base + '/metrics', timeout=5)
+                assert m.status_code == 200
+                scraped_mid_stream = m.text
+        assert len(tokens) == 8
+        _assert_valid_exposition(scraped_mid_stream)
+
+        final = requests.get(base + '/metrics', timeout=5)
+        assert final.headers['Content-Type'] == metrics_lib.CONTENT_TYPE
+        text = final.text
+        _assert_valid_exposition(text)
+        assert 'skyt_infer_ttft_seconds_bucket{le="+Inf"} 1' in text
+        assert '# TYPE skyt_infer_ttft_seconds histogram' in text
+        assert '# TYPE skyt_infer_kv_cache_utilization gauge' in text
+        assert 'skyt_infer_prefill_tokens_total 3' in text
+        # 8 generated = 1 from prefill + 7 from decode chunks.
+        assert 'skyt_infer_decode_tokens_total 7' in text
+
+        # Phase trace via /stats?request_id= — the acceptance path.
+        tr = requests.get(base + f'/stats?request_id={rid}',
+                          timeout=5).json()
+        assert tr['queued'] <= tr['prefill_start'] \
+            <= tr['first_token'] <= tr['done']
+        assert tr['prompt_tokens'] == 3
+        assert tr['generated'] == 8
+        assert tr['status'] == 'done'
+        # Unknown / malformed ids answer 404 / 400, not 500.
+        assert requests.get(base + '/stats?request_id=424242',
+                            timeout=5).status_code == 404
+        assert requests.get(base + '/stats?request_id=nope',
+                            timeout=5).status_code == 400
+        # Plain /stats still serves the engine summary.
+        assert requests.get(base + '/stats',
+                            timeout=5).json()['num_slots'] == 2
+    finally:
+        eng.stop()

@@ -118,3 +118,28 @@ class TestSubprocessUtils:
         subprocess_utils.kill_process_tree(proc.pid)
         time.sleep(0.2)
         assert proc.poll() is not None
+
+
+class TestTimeline:
+
+    def test_enabled_tracks_env(self, monkeypatch):
+        """SKYT_DEBUG is re-read per event: toggling it mid-process
+        (long-lived servers, tests) enables/disables tracing without a
+        restart — the old first-call cache pinned the initial value."""
+        from skypilot_tpu.utils import timeline
+        timeline.reset()
+        monkeypatch.delenv('SKYT_DEBUG', raising=False)
+        with timeline.Event('off-event'):
+            pass
+        assert not timeline._events
+        monkeypatch.setenv('SKYT_DEBUG', '1')
+        with timeline.Event('on-event'):
+            pass
+        assert [e['name'] for e in timeline._events] == \
+            ['on-event', 'on-event']        # B + E pair
+        monkeypatch.delenv('SKYT_DEBUG', raising=False)
+        with timeline.Event('off-again'):
+            pass
+        assert len(timeline._events) == 2   # no new events
+        timeline.reset()
+        assert not timeline._events

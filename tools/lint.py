@@ -8,6 +8,9 @@ Checks:
     aware for __all__/docstring re-exports)
   * tabs and trailing whitespace
   * lines over the limit (default 88)
+  * bare print() in skypilot_tpu/ — framework code must log through
+    utils/log_utils loggers so serving/metrics output stays structured
+    (exceptions: the console-surface allowlist below, or `# noqa`)
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -20,6 +23,37 @@ LINE_LIMIT = 88
 
 # Imports that exist for side effects or re-export by convention.
 _SIDE_EFFECT_OK = {'skypilot_tpu', 'conftest'}
+
+# Modules whose stdout IS the interface — CLI surfaces, console log
+# relays streaming remote job output to the user's terminal, and train
+# examples whose printed lines are the job's log contract. Everything
+# else under skypilot_tpu/ must use log_utils loggers; mark deliberate
+# one-off exceptions with `# noqa`.
+_PRINT_OK_PREFIXES = (
+    'skypilot_tpu/cli.py',
+    'skypilot_tpu/check.py',
+    'skypilot_tpu/dashboard.py',            # startup URL banner
+    'skypilot_tpu/utils/command_runner.py',  # remote stdout relay
+    'skypilot_tpu/runtime/log_lib.py',       # job log tailing
+    'skypilot_tpu/runtime/rpc.py',           # log streaming + CLI JSON
+    'skypilot_tpu/backends/tpu_backend.py',  # provision log relay
+    'skypilot_tpu/jobs/core.py',             # jobs logs CLI surface
+    'skypilot_tpu/serve/core.py',            # serve logs CLI surface
+    'skypilot_tpu/parallel/collectives.py',  # bench CLI output
+    'skypilot_tpu/catalog/data_fetchers/',   # fetcher CLI scripts
+    'skypilot_tpu/train/examples/',          # example job stdout
+)
+
+
+def _print_allowed(path: Path) -> bool:
+    posix = path.as_posix()
+    for p in _PRINT_OK_PREFIXES:
+        if p.endswith('/'):
+            if p in posix:
+                return True
+        elif posix.endswith(p):
+            return True
+    return False
 
 
 def _imported_names(tree):
@@ -66,6 +100,19 @@ def check_file(path: Path):
             if re.search(rf'[\'"]{re.escape(name)}\b', text_blob):
                 continue
             issues.append(f'{path}:{lineno}: unused import {name!r}')
+
+    if 'skypilot_tpu' in path.as_posix() and not _print_allowed(path):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'print':
+                if node.lineno <= len(lines) and \
+                        'noqa' in lines[node.lineno - 1]:
+                    continue
+                issues.append(
+                    f'{path}:{node.lineno}: bare print() — use a '
+                    f'log_utils logger (or add to the lint allowlist '
+                    f'if stdout is this module\'s interface)')
 
     for i, line in enumerate(src.splitlines(), 1):
         if '\t' in line:

@@ -117,6 +117,73 @@ else
     FAIL=1
 fi
 
+echo "== 5. /metrics scrape (debug server on-chip: the observability"
+echo "   plane must come up and expose TTFT/KV gauges where the real"
+echo "   checkpoint server will) =="
+if timeout 600 python - <<'PYEOF' 2>&1 | tee "$OUT/metrics_scrape.txt"
+import json
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+with socket.socket() as s:
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(port),
+     '--num-slots', '2', '--max-seq-len', '128'])
+base = f'http://127.0.0.1:{port}'
+try:
+    deadline = time.time() + 480   # warmup compiles through the tunnel
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            raise SystemExit(f'server died rc={proc.returncode}')
+        time.sleep(1)
+    else:
+        raise SystemExit('server never became healthy')
+    r = requests.post(base + '/generate',
+                      json={'tokens': [7, 8, 9], 'max_tokens': 8},
+                      timeout=120)
+    r.raise_for_status()
+    rid = r.headers['X-Request-Id']
+    trace = requests.get(base + f'/stats?request_id={rid}',
+                         timeout=5).json()
+    assert trace['queued'] <= trace['first_token'] <= trace['done'], \
+        trace
+    text = requests.get(base + '/metrics', timeout=5).text
+    for needle in ('# TYPE skyt_infer_ttft_seconds histogram',
+                   'skyt_infer_ttft_seconds_bucket',
+                   '# TYPE skyt_infer_kv_cache_utilization gauge',
+                   'skyt_infer_decode_tokens_total'):
+        assert needle in text, f'missing from /metrics: {needle}'
+    ttft = trace['first_token'] - trace['queued']
+    print(f'METRICS_SCRAPE_OK ttft_s={ttft:.3f} '
+          f'lines={len(text.splitlines())}')
+    print(json.dumps(trace))
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PYEOF
+then
+    echo "== metrics scrape: PASS =="
+else
+    echo "== metrics scrape: FAIL (see $OUT/metrics_scrape.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
