@@ -44,6 +44,7 @@ PHASE_DEADLINES = {
     'serve int4 bench': 600,
     'serve spec-decode bench': 1800,
     'serve 8b int8 bench': 900,
+    'host overhead bench': 600,
 }
 
 
@@ -438,6 +439,68 @@ def serve_8b_int8_metric() -> list:
     ]
 
 
+def host_overhead_metrics() -> list:
+    """Micro-bench of the host-device overlap layer (CPU-runnable: the
+    debug model's device time is tiny, so these HOST-side numbers are
+    meaningful even in smoke environments where the TPU probe times
+    out).
+
+    Reports, from the engine's own perf counters over a burst of
+    same-bucket requests:
+      * host_finish_s_per_token — steady-state host seconds of
+        post-pull delivery work per generated token (the vectorized
+        _finish_chunk's cost).
+      * admission_dispatches_per_request — target prefill dispatches
+        divided by admitted requests (< 1.0 proves batched admission
+        amortized prefills across the burst).
+    """
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+
+    n_requests, n_slots = 8, 4
+    eng = server_lib.build_engine('debug', num_slots=n_slots,
+                                  max_seq_len=64, decode_chunk=8,
+                                  cache_mode='dense',
+                                  prefix_caching=False)
+    eng.start()
+    try:
+        prompts = [[(i * 7 + j) % 50 + 1 for j in range(24)]
+                   for i in range(n_requests)]
+        # Warm the compiles (prefill buckets + insert + decode chunk)
+        # so the measured burst is steady-state, not tracing.
+        eng.generate(prompts[0], engine_lib.SamplingParams(
+            max_new_tokens=4))
+        eng.reset_perf()
+        queues = [eng.submit(p, engine_lib.SamplingParams(
+            max_new_tokens=16))[1] for p in prompts]
+        for q in queues:
+            while q.get(timeout=120) is not None:
+                pass
+        perf = eng.perf_stats()
+    finally:
+        eng.stop()
+    host_per_tok = (perf['host_finish_s']
+                    / max(perf['decode_tokens'], 1))
+    disp_per_req = (perf['prefill_dispatches']
+                    / max(perf['admitted_requests'], 1))
+    print(f'# host overhead: {host_per_tok*1e6:.1f}us host/token, '
+          f'{perf["prefill_dispatches"]} prefill dispatches / '
+          f'{perf["admitted_requests"]} requests '
+          f'(max batch {perf["admission_batch_size"]})',
+          file=sys.stderr)
+    return [
+        {'metric': 'host_finish_s_per_token',
+         'value': round(host_per_tok, 9), 'unit': 's/tok',
+         'vs_baseline': None},
+        {'metric': 'admission_dispatches_per_request',
+         'value': round(disp_per_req, 4), 'unit': 'dispatches/request',
+         # 1.0 = the old one-prefill-per-request admission; < 1.0 is
+         # the batched-admission win.
+         'vs_baseline': (round(1.0 / disp_per_req, 4)
+                         if disp_per_req > 0 else None)},
+    ]
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -720,6 +783,19 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# serve spec-decode bench failed: {e!r}', file=sys.stderr)
+
+    # Host-overhead micro-bench (the overlap layer's own numbers):
+    # runs on CPU too, so the trajectory captures the host-side win
+    # even when the TPU probe times out.
+    if on_tpu:
+        _reclaim_hbm('pre-host-overhead')
+    try:
+        with phase_deadline(PHASE_DEADLINES['host overhead bench'],
+                            'host overhead bench'):
+            extra = extra + host_overhead_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# host overhead bench failed: {e!r}', file=sys.stderr)
 
     line = {
         'metric': metric_name,

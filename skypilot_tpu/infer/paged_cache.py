@@ -156,6 +156,19 @@ class PagePool:
             else:
                 self._free.append(page)
 
+    def prefix_peek(self, lookup_hashes) -> int:
+        """Length of the leading registered-page run for these hashes —
+        a READ-ONLY probe of what try_reserve_prefix would share (no
+        refs taken, nothing evicted). The engine's batched-admission
+        path uses it to route prefix-hit prompts to the sequential
+        suffix-prefill path without churning reservations."""
+        n = 0
+        for h in lookup_hashes:
+            if self._registry.get(h) is None:
+                break
+            n += 1
+        return n
+
     def try_reserve(self, slot: int, total_tokens: int) -> Optional[np.ndarray]:
         """Reserve pages covering total_tokens for `slot`. Returns the
         slot's full table row (np [max_pages_per_slot]) or None if the

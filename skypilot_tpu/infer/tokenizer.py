@@ -158,8 +158,11 @@ def load_chat_template(path: str) -> 'Optional[str]':
     being silently reinterpreted as a directory here).
     tokenizer_config.json carries it as a string, or (newer multi-
     template format) a list of {'name', 'template'} dicts — 'default'
-    wins. The reference gets this rendering from vLLM, which reads the
-    same field."""
+    wins, then a 'chat'-named entry; an arbitrary fallback pick is
+    logged loudly (a silently chosen 'tool_use'/'rag' template would
+    change every /v1/chat/completions prompt — ADVICE r5). The
+    reference gets this rendering from vLLM, which reads the same
+    field."""
     d = path if os.path.isdir(path) else os.path.dirname(
         os.path.abspath(path))
     for cfg in _sibling_configs(d):
@@ -169,8 +172,24 @@ def load_chat_template(path: str) -> 'Optional[str]':
         if isinstance(tpl, list):
             by_name = {t.get('name'): t.get('template') for t in tpl
                        if isinstance(t, dict)}
-            return by_name.get('default') or next(
-                (t for t in by_name.values() if t), None)
+            for want in ('default', 'chat'):
+                if by_name.get(want):
+                    logger.info(
+                        'chat template: using %r of %d named templates '
+                        '(%s)', want, len(by_name),
+                        ', '.join(map(str, sorted(
+                            k for k in by_name if k is not None))))
+                    return by_name[want]
+            name, chosen = next(
+                ((n, t) for n, t in by_name.items() if t),
+                (None, None))
+            if chosen is not None:
+                logger.warning(
+                    "chat template: no 'default' or 'chat' entry among "
+                    '%s; falling back to %r — pass --chat-template to '
+                    'override', sorted(k for k in by_name
+                                       if k is not None), name)
+            return chosen
     return None
 
 

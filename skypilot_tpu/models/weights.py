@@ -603,7 +603,15 @@ def save_hf_checkpoint(cfg, variables: Dict[str, Any],
                        out_dir: str) -> None:
     """Inverse of load_llama_params: write our params as an HF-format
     safetensors checkpoint (single shard) + config.json. Used for export
-    and for loader round-trip tests."""
+    and for loader round-trip tests.
+
+    A mapped tensor absent from the params tree is only skipped
+    SILENTLY when the config knob explains it (tie_embeddings => no
+    lm_head leaf; HF reloads via the tied embedding). Any other miss is
+    a config-flag/variable-tree mismatch (e.g. attn_bias=True with no
+    bias leaves) that would otherwise surface as a confusing
+    transformers reload failure — those are written out as a loud
+    warning listing the missing HF names (ADVICE r5)."""
     import flax.linen as nn
     import safetensors.numpy
 
@@ -611,20 +619,29 @@ def save_hf_checkpoint(cfg, variables: Dict[str, Any],
     params = nn.meta.unbox(variables['params'])
     os.makedirs(out_dir, exist_ok=True)
     out: Dict[str, np.ndarray] = {}
+    missing: list = []
 
     def grab(path: tuple) -> Optional[np.ndarray]:
         leaf = _get_at(params, path)
         return None if leaf is None else np.asarray(jax.device_get(leaf))
 
+    def _optional(path: tuple) -> bool:
+        # Knob-gated absences that are CORRECT by construction.
+        return path == ('lm_head', 'kernel') and \
+            getattr(cfg, 'tie_embeddings', False)
+
     for path, (hf_name, transpose) in _TOP_MAP.items():
         arr = grab(path)
         if arr is None:
+            if not _optional(path):
+                missing.append(hf_name)
             continue
         out[hf_name] = arr.T if transpose else arr
     for path, (suffix, transpose) in _layer_map(cfg).items():
         if cfg.scan_layers:
             stacked = grab(('layers',) + path)
             if stacked is None:
+                missing.append(f'model.layers.*.{suffix}')
                 continue
             for i in range(cfg.n_layers):
                 arr = stacked[i]
@@ -634,9 +651,17 @@ def save_hf_checkpoint(cfg, variables: Dict[str, Any],
             for i in range(cfg.n_layers):
                 arr = grab((f'layer_{i}',) + path)
                 if arr is None:
+                    missing.append(f'model.layers.{i}.{suffix}')
                     continue
                 out[f'model.layers.{i}.{suffix}'] = (
                     arr.T if transpose else arr)
+    if missing:
+        logger.warning(
+            'save_hf_checkpoint: %d mapped tensor(s) missing from the '
+            'params tree and SKIPPED — the checkpoint at %s will not '
+            'reload cleanly (config flag / variable-tree mismatch?): '
+            '%s%s', len(missing), out_dir, ', '.join(missing[:8]),
+            ' ...' if len(missing) > 8 else '')
 
     if getattr(cfg, 'hf_layout', 'llama') == 'phi3':
         # Fuse back into phi3's qkv_proj/gate_up_proj layout (HF

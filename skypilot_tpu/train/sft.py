@@ -127,6 +127,11 @@ def main(argv=None) -> None:
     parser.add_argument('--resume', default='auto',
                         choices=['auto', 'never'])
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--prefetch', type=int, default=2,
+                        help='input-pipeline prefetch depth: batches '
+                             'assembled and device_put on a background '
+                             'thread while the current step runs '
+                             '(docs/performance.md). 0 disables.')
     args = parser.parse_args(argv)
 
     # Some TPU images pin a platform plugin that wins over the env var;
@@ -285,6 +290,20 @@ def main(argv=None) -> None:
     from skypilot_tpu.utils import profiling
     prof = profiling.StepProfiler()   # no-op unless SKYT_PROFILE_DIR set
     mpub = trainer.TrainMetricsPublisher()
+    # Deferred metrics: publish() pulls step k-1's loss/grad-norm while
+    # step k runs — the log boundary never syncs the step chain's head
+    # (logged loss lags one step; see trainer.DeferredMetrics).
+    dmetrics = trainer.DeferredMetrics(mpub)
+
+    # Overlap layer: assemble + device_put the next batches on a
+    # background thread while the current step runs (train/prefetch.py).
+    prefetcher = None
+    if args.prefetch > 0:
+        from skypilot_tpu.train import prefetch as prefetch_lib
+        prefetcher = prefetch_lib.Prefetcher(
+            batches, depth=args.prefetch,
+            place=prefetch_lib.make_sharded_placer(mesh))
+        batches = prefetcher
 
     t0 = time.perf_counter()
     last_t = t0
@@ -294,33 +313,32 @@ def main(argv=None) -> None:
             prof.on_step(step - start_step)
             batch = next(batches)
             state, metrics = step_fn(state, batch)
+            dmetrics.on_step(metrics)   # device refs only — no sync
             tokens_seen += args.batch * args.seq * jax.process_count()
             if ckpt is not None:
                 ckpt.save(step + 1, state)
             if (step + 1) % args.log_every == 0:
-                # ONE device sync for both logged scalars; publish()
-                # then sees host floats and adds no transfers.
-                host = jax.device_get(
-                    {k: metrics[k] for k in ('loss', 'grad_norm')
-                     if k in metrics})
-                loss = float(host['loss'])
                 now = time.perf_counter()
                 dt = now - t0
-                # Step time averaged over the logging window (the
-                # device_get above already synced this window's work).
+                # Step time averaged over the logging window; the only
+                # device pull here is DeferredMetrics' step-(k-1) read,
+                # which overlaps step k's device compute.
                 n_window = min(args.log_every, step + 1 - start_step)
-                mpub.publish(host,
-                             step_time_s=(now - last_t)
-                             / max(1, n_window),
-                             tokens_per_sec=tokens_seen / dt,
-                             steps=n_window)
+                host = dmetrics.publish(
+                    step_time_s=(now - last_t) / max(1, n_window),
+                    tokens_per_sec=tokens_seen / dt,
+                    steps=n_window)
                 last_t = now
                 logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
-                            step + 1, args.steps, loss, tokens_seen / dt)
+                            step + 1, args.steps,
+                            host.get('loss', float('nan')),
+                            tokens_seen / dt)
     finally:
         # A crash inside the profiled window must still flush the trace
         # — the failing run is the one most worth profiling.
         prof.stop()
+        if prefetcher is not None:
+            prefetcher.close()
     if ckpt is not None:
         if ckpt.latest_step() != args.steps:
             ckpt.save(args.steps, state, force=True)

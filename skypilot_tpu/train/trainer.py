@@ -80,6 +80,52 @@ class TrainMetricsPublisher:
             self.tokens_per_sec.set(tokens_per_sec)
 
 
+class DeferredMetrics:
+    """One-step-deferred metrics pulls: the overlap half of the metrics
+    plane (docs/performance.md).
+
+    The sft loop used to `jax.device_get` the CURRENT step's loss at
+    every log boundary — a host sync on the step chain's newest link,
+    stalling the host until step k finished and leaving the device idle
+    while the host logged. on_step() instead keeps the metrics pytrees
+    of the last TWO steps (device references — no transfer), and
+    publish() pulls step k-1's values while step k is still in flight:
+    the one transfer overlaps device compute, and the step chain is
+    never synced at its head.
+
+    Semantics: logged/published loss and grad_norm lag one step behind
+    the step counter (documented; at the final log boundary of a run
+    the lag is invisible in practice). This class is the ONLY sanctioned
+    home for jax.device_get on the sft hot path — tools/lint.py rejects
+    bare device pulls inside sft.py loops.
+    """
+
+    def __init__(self, publisher: 'TrainMetricsPublisher',
+                 keys: Tuple[str, ...] = ('loss', 'grad_norm')) -> None:
+        self._pub = publisher
+        self._keys = keys
+        self._prev: Optional[Dict[str, Any]] = None
+        self._cur: Optional[Dict[str, Any]] = None
+
+    def on_step(self, metrics: Dict[str, Any]) -> None:
+        """Record step k's device metrics (no transfer, no sync)."""
+        self._prev = self._cur
+        self._cur = {k: metrics[k] for k in self._keys if k in metrics}
+
+    def publish(self, step_time_s: Optional[float] = None,
+                tokens_per_sec: Optional[float] = None,
+                steps: int = 1) -> Dict[str, float]:
+        """Pull step k-1's metrics (k still in flight) and publish them;
+        returns the host floats for logging. First call of a run (no
+        k-1 yet) pulls the current step's."""
+        src = self._prev if self._prev is not None else self._cur
+        host = ({k: float(v) for k, v in
+                 jax.device_get(src).items()} if src else {})
+        self._pub.publish(host, step_time_s=step_time_s,
+                          tokens_per_sec=tokens_per_sec, steps=steps)
+        return host
+
+
 def make_optimizer(tcfg: TrainerConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=tcfg.learning_rate,

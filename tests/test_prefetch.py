@@ -1,0 +1,176 @@
+"""Train input-pipeline prefetcher (train/prefetch.py): producer/
+consumer overlap, bounded-queue backpressure, and clean shutdown on
+stop / source error — the contracts the sft loop relies on.
+
+Pure-host tests (no jax compilation): the prefetcher's concurrency
+behavior is independent of what the batches contain.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.train import prefetch as prefetch_lib
+
+
+class _CountingSource:
+    """Iterator that records how far the producer has pulled it and can
+    block until allowed (for deterministic concurrency assertions)."""
+
+    def __init__(self, n=None, fail_at=None, delay=0.0):
+        self.n = n
+        self.fail_at = fail_at
+        self.delay = delay
+        self.produced = 0
+        self.lock = threading.Lock()
+
+    def __iter__(self):
+        i = 0
+        while self.n is None or i < self.n:
+            if self.fail_at is not None and i == self.fail_at:
+                raise RuntimeError(f'source failed at item {i}')
+            if self.delay:
+                time.sleep(self.delay)
+            item = {'tokens': np.full((1, 4), i, np.int32)}
+            with self.lock:
+                self.produced += 1
+            yield item
+            i += 1
+
+
+def _wait_until(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_producer_runs_ahead_of_slow_consumer():
+    """While the consumer sits on batch 0 (a slow train step), the
+    producer must keep assembling the next batches — the overlap that
+    removes input work from the step chain."""
+    src = _CountingSource(n=100)
+    pf = prefetch_lib.Prefetcher(iter(src), depth=3)
+    try:
+        first = next(pf)
+        assert int(first['tokens'][0, 0]) == 0
+        # Consumer is now "busy"; the producer should fill the queue
+        # (depth 3) plus the one item it is offering — strictly more
+        # than the single consumed batch.
+        assert _wait_until(lambda: src.produced >= 4)
+    finally:
+        pf.close()
+
+
+def test_bounded_queue_backpressure():
+    """An infinite source must not be drained unboundedly: the producer
+    can be at most depth + 1 items ahead of the consumer (queue depth
+    plus the one item in its hand)."""
+    src = _CountingSource(n=None)     # infinite
+    pf = prefetch_lib.Prefetcher(iter(src), depth=2)
+    try:
+        _wait_until(lambda: src.produced >= 3)
+        time.sleep(0.3)               # give an unbounded bug time to run
+        assert src.produced <= 2 + 1  # depth + in-hand
+        consumed = [next(pf) for _ in range(5)]
+        assert [int(b['tokens'][0, 0]) for b in consumed] == \
+            [0, 1, 2, 3, 4]           # order preserved
+        _wait_until(lambda: src.produced >= 8)
+        time.sleep(0.2)
+        assert src.produced <= 5 + 2 + 1
+    finally:
+        pf.close()
+
+
+def test_items_delivered_in_order_and_placed():
+    """place() runs on the producer thread and its output is what the
+    consumer sees (the device_put hook)."""
+    placed = []
+
+    def place(batch):
+        placed.append(int(batch['tokens'][0, 0]))
+        return {k: v + 1000 for k, v in batch.items()}
+
+    pf = prefetch_lib.Prefetcher(iter(_CountingSource(n=5)), depth=2,
+                                 place=place)
+    try:
+        got = [int(b['tokens'][0, 0]) for b in pf]
+        assert got == [1000, 1001, 1002, 1003, 1004]
+        assert placed == [0, 1, 2, 3, 4]
+    finally:
+        pf.close()
+
+
+def test_source_error_propagates_after_good_items():
+    """A data bug fails the step loop with the ORIGINAL exception, after
+    the items produced before it were delivered."""
+    pf = prefetch_lib.Prefetcher(iter(_CountingSource(n=10, fail_at=3)),
+                                 depth=2)
+    try:
+        got = []
+        with pytest.raises(RuntimeError, match='failed at item 3'):
+            for b in pf:
+                got.append(int(b['tokens'][0, 0]))
+        assert got == [0, 1, 2]
+    finally:
+        pf.close()
+
+
+def test_close_unblocks_full_queue_producer():
+    """close() must join a producer parked on the bounded queue's
+    backpressure wait (infinite source, consumer gone)."""
+    src = _CountingSource(n=None)
+    pf = prefetch_lib.Prefetcher(iter(src), depth=1)
+    _wait_until(lambda: src.produced >= 1)
+    pf.close()
+    assert not pf._thread.is_alive()
+    # Idempotent.
+    pf.close()
+
+
+def test_finite_source_ends_iteration():
+    pf = prefetch_lib.Prefetcher(iter(_CountingSource(n=3)), depth=4)
+    try:
+        assert len(list(pf)) == 3
+        # Exhausted: further next() keeps raising StopIteration.
+        with pytest.raises(StopIteration):
+            next(pf)
+    finally:
+        pf.close()
+
+
+def test_bad_depth_rejected():
+    with pytest.raises(ValueError):
+        prefetch_lib.Prefetcher(iter(_CountingSource(n=1)), depth=0)
+
+
+def test_sft_lint_forbids_loop_syncs(tmp_path):
+    """The tools/lint.py rule backing the overlap contract: a bare
+    jax.device_get inside an sft.py loop is flagged; the real sft.py
+    is clean."""
+    import sys
+    sys.path.insert(0, 'tools')
+    try:
+        import lint as lint_mod
+    finally:
+        sys.path.pop(0)
+    from pathlib import Path
+
+    bad = tmp_path / 'skypilot_tpu' / 'train'
+    bad.mkdir(parents=True)
+    f = bad / 'sft.py'
+    f.write_text('import jax\n'
+                 'for i in range(3):\n'
+                 '    x = jax.device_get(i)\n'
+                 'y = jax.device_get(1)  # outside a loop: allowed\n')
+    issues = lint_mod.check_file(f)
+    assert any('device_get() inside the sft step loop' in i
+               for i in issues)
+    assert len([i for i in issues if 'device_get' in i]) == 1
+    # The real sft.py must pass its own rule.
+    real = Path('skypilot_tpu/train/sft.py')
+    assert not [i for i in lint_mod.check_file(real)
+                if 'step loop' in i]

@@ -11,6 +11,10 @@ Checks:
   * bare print() in skypilot_tpu/ — framework code must log through
     utils/log_utils loggers so serving/metrics output stays structured
     (exceptions: the console-surface allowlist below, or `# noqa`)
+  * host syncs (jax.device_get / block_until_ready) inside loops in
+    train/sft.py — the step loop must stay off the device's critical
+    path; metrics pulls go through trainer.DeferredMetrics
+    (docs/performance.md). Mark deliberate exceptions with `# noqa`.
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -43,6 +47,41 @@ _PRINT_OK_PREFIXES = (
     'skypilot_tpu/catalog/data_fetchers/',   # fetcher CLI scripts
     'skypilot_tpu/train/examples/',          # example job stdout
 )
+
+
+# Files whose loops may not contain host-sync calls: the sft step loop
+# is the train hot path — one bare jax.device_get per step serializes
+# host and device (the deferred-metrics helper in train/trainer.py is
+# the sanctioned pull point, one step behind the chain's head).
+_NO_SYNC_IN_LOOPS = ('skypilot_tpu/train/sft.py',)
+_SYNC_CALL_NAMES = ('device_get', 'block_until_ready')
+
+
+def _loop_sync_issues(path: Path, tree, lines):
+    """Flag device_get/block_until_ready calls inside any loop."""
+    issues = []
+    seen = set()
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, 'id', '')
+            if name not in _SYNC_CALL_NAMES or node.lineno in seen:
+                continue
+            if node.lineno <= len(lines) and \
+                    'noqa' in lines[node.lineno - 1]:
+                continue
+            seen.add(node.lineno)
+            issues.append(
+                f'{path}:{node.lineno}: {name}() inside the sft step '
+                f'loop — host syncs stall the device; pull metrics '
+                f'through trainer.DeferredMetrics (or add `# noqa` '
+                f'for a deliberate one-off)')
+    return issues
 
 
 def _print_allowed(path: Path) -> bool:
@@ -100,6 +139,9 @@ def check_file(path: Path):
             if re.search(rf'[\'"]{re.escape(name)}\b', text_blob):
                 continue
             issues.append(f'{path}:{lineno}: unused import {name!r}')
+
+    if any(path.as_posix().endswith(p) for p in _NO_SYNC_IN_LOOPS):
+        issues += _loop_sync_issues(path, tree, lines)
 
     if 'skypilot_tpu' in path.as_posix() and not _print_allowed(path):
         for node in ast.walk(tree):
