@@ -14,6 +14,7 @@ import time
 from aiohttp import web
 
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing as tracing_lib
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>skypilot-tpu</title>
@@ -35,6 +36,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Managed jobs</h2>{jobs}
 <h2>Services</h2>{services}
 <h2>Metrics</h2>{metrics}
+<h2>Slowest traces</h2>{traces}
 </body></html>"""
 
 _GOOD = {'UP', 'SUCCEEDED', 'READY', 'RUNNING'}
@@ -119,13 +121,38 @@ def _metrics_html() -> str:
     return _table(['metric', 'type', 'labels', 'value'], rows)
 
 
+def _traces_html() -> str:
+    """Slowest recent traces from THIS process's span store (flight
+    recorder first), with a per-hop breakdown — same process-locality
+    caveat as the Metrics panel: serving replicas and LB daemons each
+    expose their own store at GET /debug/traces."""
+    summ = tracing_lib.TRACER.store.summaries()
+    seen = set()
+    rows = []
+    for rec in summ['slow'] + summ['recent']:
+        if rec['trace_id'] in seen:
+            continue
+        seen.add(rec['trace_id'])
+        hops = '; '.join(
+            f"{h['name']} {h['duration_ms']:.1f}ms"
+            for h in rec['hops'] if h.get('duration_ms') is not None)
+        rows.append((rec['duration_ms'], [
+            rec['trace_id'][:16], rec['root'],
+            f"{rec['duration_ms']:.1f}ms",
+            'slow' if rec['slow'] else 'sampled', hops or '-']))
+    rows.sort(key=lambda r: -r[0])
+    return _table(['trace', 'root', 'total', 'kept by', 'hops'],
+                  [r for _, r in rows[:10]])
+
+
 def _render_page() -> str:
     return _PAGE.format(
         now=time.strftime('%Y-%m-%d %H:%M:%S'),
         clusters=_clusters_html(),
         jobs=_jobs_html(),
         services=_services_html(),
-        metrics=_metrics_html())
+        metrics=_metrics_html(),
+        traces=_traces_html())
 
 
 def _gather_state() -> dict:
@@ -174,11 +201,20 @@ async def api_metrics(request: web.Request) -> web.Response:
         headers={'Content-Type': metrics_lib.CONTENT_TYPE})
 
 
+async def api_traces(request: web.Request) -> web.Response:
+    """This process's span store (same shape as the replica/LB
+    endpoint: summaries, ?trace_id= detail, ?format=chrome dump)."""
+    payload, status = tracing_lib.debug_traces_payload(
+        tracing_lib.TRACER, request.query)
+    return web.json_response(payload, status=status)
+
+
 def make_app() -> web.Application:
     app = web.Application()
     app.router.add_get('/', index)
     app.router.add_get('/api/state', api_state)
     app.router.add_get('/metrics', api_metrics)
+    app.router.add_get('/debug/traces', api_traces)
     return app
 
 

@@ -33,11 +33,16 @@ import numpy as np
 from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing
 
 logger = log_utils.init_logger(__name__)
 
 # Completed request traces kept for /stats?request_id= queries.
 _TRACE_KEEP = 2048
+# Span events per request trace (batched-admission marks, per-chunk
+# delivery marks): bounded so a max_new_tokens=4096 request cannot grow
+# its trace without bound.
+_TRACE_EVENTS_KEEP = 64
 
 # Device-side top-k sampling supports k up to this (one fixed-size
 # top_k sort serves all slots' per-request k values).
@@ -1465,13 +1470,34 @@ class InferenceEngine:
             tr.setdefault(phase, now)
             tr.update(extra)
 
-    def request_trace(self, req_id: int) -> Optional[Dict[str, Any]]:
-        """Phase timestamps for a request (queued, prefill_start,
-        first_token, done + prompt_tokens/generated/status), or None
-        for an unknown / evicted id."""
+    def _trace_span_event(self, req_id: int, name: str,
+                          **attrs) -> None:
+        """Append a timestamped span event to a request's phase trace
+        — the per-request view of the overlap machinery (batched
+        admission, pipelined chunk delivery) that the server bridges
+        into /debug/traces child spans. Bounded per request; only
+        called when tracing is enabled (callers gate — this keeps the
+        disabled hot path identical to before)."""
         with self._traces_lock:
             tr = self._traces.get(req_id)
-            return dict(tr) if tr is not None else None
+            if tr is None:
+                return
+            evs = tr.setdefault('events', [])
+            if len(evs) < _TRACE_EVENTS_KEEP:
+                evs.append({'name': name, 'ts': time.time(), **attrs})
+
+    def request_trace(self, req_id: int) -> Optional[Dict[str, Any]]:
+        """Phase timestamps for a request (queued, prefill_start,
+        first_token, done + prompt_tokens/generated/status + span
+        events), or None for an unknown / evicted id."""
+        with self._traces_lock:
+            tr = self._traces.get(req_id)
+            if tr is None:
+                return None
+            out = dict(tr)
+            if 'events' in out:
+                out['events'] = [dict(e) for e in out['events']]
+            return out
 
     def _update_metric_gauges(self) -> None:
         """Refresh occupancy gauges. Called every engine-loop tick but
@@ -1709,12 +1735,19 @@ class InferenceEngine:
         padded = np.zeros((bp, bucket), np.int32)
         lengths = np.ones((bp,), np.int32)       # dummy rows: length 1
         lora_ids = [0] * bp
+        trace_on = tracing.enabled()
         for j, req in enumerate(cand):
             padded[j, :len(req.tokens)] = req.tokens
             lengths[j] = len(req.tokens)
             lora_ids[j] = req.params.lora_id
             self._trace_event(req.req_id, 'prefill_start',
                               status='running')
+            if trace_on:
+                # PR 2's overlap machinery, visible per request: this
+                # request's prefill was amortized across an nb-wide
+                # admission batch.
+                self._trace_span_event(req.req_id, 'batch_admission',
+                                       batch_size=nb, bucket=bucket)
         with self._ctx():
             greedy, logits, prefill_cache = self._jit_prefill(
                 self._vars(lora_ids), jnp.asarray(padded),
@@ -1860,6 +1893,9 @@ class InferenceEngine:
         temp = max(0.0, req.params.temperature)
         self._trace_event(req.req_id, 'prefill_start',
                           status='running')
+        if tracing.enabled():
+            self._trace_span_event(req.req_id, 'admission',
+                                   batch_size=1, cached_pages=n_cached)
         with self._ctx():
             if n_cached > 0:
                 psize = self.pool.cfg.page_size
@@ -2353,6 +2389,7 @@ class InferenceEngine:
             req.params.logprobs for _, req in entries) else None
         now = time.perf_counter()
         delivered = 0
+        trace_on = tracing.enabled()
         # Per-slot ACTUAL start position of this chunk's first token
         # (confirmed length is only advanced at chunk pulls, so it is
         # this chunk's true starting point).
@@ -2405,6 +2442,11 @@ class InferenceEngine:
                 req.generated += n_del
                 delivered += n_del
                 base[i] += n_del
+                if trace_on:
+                    # Pipelined-delivery boundary: n tokens of this
+                    # request surfaced from a `chunk`-wide dispatch.
+                    self._trace_span_event(req.req_id, 'decode_chunk',
+                                           n=n_del, chunk=chunk)
             if kind == 'spec':
                 # Acceptance accounting matches the sequential path: a
                 # verify step whose run STARTED before the cutoff

@@ -54,6 +54,7 @@ from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import tokenizer as tokenizer_lib
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing as tracing_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -116,11 +117,19 @@ class InferenceServer:
                  tokenizer=None, model_id: str = 'skypilot-tpu',
                  lora_names: Optional[Dict[str, int]] = None,
                  chat_template: Optional[str] = None,
-                 special_tokens: Optional[Dict[str, str]] = None) -> None:
+                 special_tokens: Optional[Dict[str, str]] = None,
+                 tracer: Optional['tracing_lib.Tracer'] = None) -> None:
         self.engine = engine
         self.tokenizer = tokenizer or tokenizer_lib.ByteTokenizer(
             engine.cfg.vocab_size)
         self.model_id = model_id
+        # Tracing plane: server spans per route (traceparent extracted
+        # from the LB / client), engine phase traces bridged in as
+        # child spans, /debug/traces as the query surface. The flight
+        # recorder snapshots engine state onto slow traces.
+        self._tracer = tracer or tracing_lib.Tracer(
+            service='infer', registry=engine.metrics_registry)
+        self._tracer.store.slow_snapshot = self._engine_state_snapshot
         # The checkpoint's HF chat template (jinja source), rendered
         # for /v1/chat/completions the way vLLM renders it; None falls
         # back to the generic role-tag format.
@@ -178,6 +187,73 @@ class InferenceServer:
                            'code': 'model_not_found'}}, status=404)
         return lid, None
 
+    def _engine_state_snapshot(self) -> Dict[str, object]:
+        """Engine occupancy at slow-trace capture time (the flight
+        recorder's context: WHY was this request slow — deep queue?
+        full slots? cold prefix cache?). Reads the same sources the
+        /metrics gauges read; cheap enough to run per retained trace."""
+        eng = self.engine
+        with eng._lock:  # pylint: disable=protected-access
+            running = sum(1 for s in eng._slots  # pylint: disable=protected-access
+                          if s is not None)
+        snap: Dict[str, object] = {
+            'queue_depth': eng._waiting.qsize(),  # pylint: disable=protected-access
+            'running_slots': running,
+            'num_slots': eng.num_slots,
+        }
+        if eng.pool is not None:
+            total = eng.pool.cfg.n_pages - 1
+            if total > 0:
+                snap['kv_cache_utilization'] = round(
+                    (total - eng.pool.free_pages()) / total, 4)
+            if eng.prefix_caching:
+                snap['prefix_cache'] = dict(eng.pool.prefix_stats)
+        return snap
+
+    def _bridge_engine_spans(self, span, rids) -> None:
+        """Attach the engine's phase trace for each request id as
+        child spans of the server span: queue wait, prefill (TTFT's
+        two halves), and decode, with the engine's batched-admission /
+        chunk-delivery span events split across them. This is what
+        turns 'the request was slow' into 'the request sat 700ms in
+        the replica queue'."""
+        for rid in rids:
+            tr = self.engine.request_trace(rid)
+            if not tr:
+                continue
+            queued = tr.get('queued')
+            prefill = tr.get('prefill_start')
+            first = tr.get('first_token')
+            done = tr.get('done')
+            events = tr.get('events', [])
+            attrs = {'engine_request_id': rid,
+                     'status': tr.get('status')}
+            if queued is not None and prefill is not None:
+                self._tracer.record_span(
+                    'engine.queue_wait', queued, prefill, parent=span,
+                    attributes=dict(
+                        attrs, prompt_tokens=tr.get('prompt_tokens')))
+            elif queued is not None and done is not None:
+                # Cancelled/failed while still queued (no prefill ever
+                # ran): the whole engine residency WAS queue wait —
+                # the flight recorder's headline case must not lose
+                # its engine span.
+                self._tracer.record_span(
+                    'engine.queue_wait', queued, done, parent=span,
+                    attributes=dict(
+                        attrs, prompt_tokens=tr.get('prompt_tokens')))
+            if prefill is not None and first is not None:
+                self._tracer.record_span(
+                    'engine.prefill', prefill, first, parent=span,
+                    attributes=attrs,
+                    events=[e for e in events if e['ts'] <= first])
+            if first is not None and done is not None:
+                self._tracer.record_span(
+                    'engine.decode', first, done, parent=span,
+                    attributes=dict(attrs,
+                                    generated=tr.get('generated')),
+                    events=[e for e in events if e['ts'] > first])
+
     async def _health(self, request: web.Request) -> web.Response:
         del request
         if self.engine.ready.is_set():
@@ -191,15 +267,28 @@ class InferenceServer:
                 rid_int = int(rid)
             except ValueError:
                 return web.json_response(
-                    {'error': 'request_id must be an integer'},
-                    status=400)
+                    {'error': f'request_id must be an integer, '
+                              f'got {rid!r}'}, status=400)
             trace = self.engine.request_trace(rid_int)
             if trace is None:
                 return web.json_response(
-                    {'error': f'no trace for request {rid_int} '
-                              f'(unknown or evicted)'}, status=404)
+                    {'error': f'no phase trace for request {rid_int} '
+                              f'(unknown or evicted)',
+                     'hint': 'phase traces are a bounded FIFO keyed '
+                             'by the X-Request-Id response header; '
+                             'end-to-end traces (incl. the LB hop) '
+                             'live at /debug/traces?trace_id=<id>'},
+                    status=404)
             return web.json_response(trace)
         return web.json_response(self.engine.stats())
+
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        """This replica's span store: recent + flight-recorded slow
+        traces. `?trace_id=` for one trace's spans, `?format=chrome`
+        for a chrome://tracing / Perfetto dump."""
+        payload, status = tracing_lib.debug_traces_payload(
+            self._tracer, request.query)
+        return web.json_response(payload, status=status)
 
     async def _metrics(self, request: web.Request) -> web.Response:
         del request
@@ -251,6 +340,10 @@ class InferenceServer:
         if err is not None:
             return web.json_response({'error': err}, status=400)
         req_id, out_q = self.engine.submit(tokens, params)
+        # Seen by the tracing middleware after the handler returns:
+        # the engine's phase trace for each id is bridged in as child
+        # spans of this request's server span.
+        request['skyt_engine_rids'] = [req_id]
         loop = asyncio.get_running_loop()
 
         if payload.get('stream'):
@@ -682,6 +775,7 @@ class InferenceServer:
         # streams (device keys seed with seed + req_id).
         subs = [self.engine.submit(t, params)
                 for t in token_lists for _ in range(n)]
+        request['skyt_engine_rids'] = [r for r, _ in subs]
 
         if payload.get('stream'):
             rid, out_q = subs[0]
@@ -788,6 +882,7 @@ class InferenceServer:
         tokens = self.tokenizer.encode(
             self._apply_chat_template(messages))
         subs = [self.engine.submit(tokens, params) for _ in range(n)]
+        request['skyt_engine_rids'] = [r for r, _ in subs]
         rid = subs[0][0]
 
         if payload.get('stream'):
@@ -834,6 +929,10 @@ class InferenceServer:
         m_http = self.engine.metrics_registry.counter(
             'skyt_http_requests_total', 'HTTP requests served',
             ('path', 'code'))
+        m_lat = self.engine.metrics_registry.histogram(
+            'skyt_http_request_seconds',
+            'HTTP request wall latency by route (streaming routes '
+            'count the full stream)', ('path',))
 
         @web.middleware
         async def count_requests(request: web.Request, handler):
@@ -844,7 +943,10 @@ class InferenceServer:
             path = resource.canonical if resource is not None \
                 else 'unmatched'
             try:
-                resp = await handler(request)
+                # Histogram.time() observes on the exception path too:
+                # error latency is latency.
+                with m_lat.labels(path).time():
+                    resp = await handler(request)
             except web.HTTPException as e:
                 m_http.labels(path, str(e.status)).inc()
                 raise
@@ -856,10 +958,39 @@ class InferenceServer:
             m_http.labels(path, str(resp.status)).inc()
             return resp
 
-        app = web.Application(middlewares=[count_requests])
+        @web.middleware
+        async def trace_requests(request: web.Request, handler):
+            # Server span per request, parented under the LB's proxy
+            # span when a traceparent arrived (streaming included: the
+            # handler returns only after write_eof, so the span covers
+            # the full stream). With SKYT_TRACE=0 start_span returns
+            # the shared no-op singleton and this middleware adds two
+            # dict lookups.
+            resource = request.match_info.route.resource
+            path = resource.canonical if resource is not None \
+                else 'unmatched'
+            ctx = self._tracer.extract(request.headers)
+            span = self._tracer.start_span(
+                'server ' + path, parent=ctx,
+                attributes={'http.method': request.method,
+                            'http.path': path})
+            lb_rid = request.headers.get('X-Request-Id')
+            if lb_rid:
+                span.set_attribute('lb_request_id', lb_rid)
+            with span:
+                resp = await handler(request)
+                span.set_attribute('http.status', resp.status)
+                if span is not tracing_lib.NOOP_SPAN:
+                    self._bridge_engine_spans(
+                        span, request.get('skyt_engine_rids', ()))
+                return resp
+
+        app = web.Application(middlewares=[count_requests,
+                                           trace_requests])
         app.router.add_get('/health', self._health)
         app.router.add_get('/stats', self._stats)
         app.router.add_get('/metrics', self._metrics)
+        app.router.add_get('/debug/traces', self._debug_traces)
         app.router.add_post('/generate', self._generate)
         app.router.add_get('/v1/models', self._models)
         app.router.add_post('/v1/completions', self._completions)

@@ -11,6 +11,7 @@ least-connections works for long-lived inference requests.
 import asyncio
 import os
 import time
+import uuid
 from typing import List, Optional
 
 import aiohttp
@@ -19,6 +20,7 @@ from aiohttp import web
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing as tracing_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -38,10 +40,16 @@ class SkyServeLoadBalancer:
                  policy: str = 'round_robin',
                  controller_auth: Optional[str] = None,
                  metrics_registry: Optional[
-                     'metrics_lib.MetricsRegistry'] = None) -> None:
+                     'metrics_lib.MetricsRegistry'] = None,
+                 tracer: Optional['tracing_lib.Tracer'] = None) -> None:
         self.controller_url = controller_url
         self.port = port
         reg = metrics_registry or metrics_lib.REGISTRY
+        # Tracing plane: one root span per proxied request, with the
+        # trace context injected toward the replica (W3C traceparent)
+        # so the replica's server/engine spans share the trace id.
+        self._tracer = tracer or tracing_lib.Tracer(
+            service='lb', registry=reg)
         # Per-replica traffic accounting; the 'replica' label is the
         # replica URL — bounded by the replica count, not by clients.
         self._m_requests = reg.counter(
@@ -105,58 +113,118 @@ class SkyServeLoadBalancer:
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         """Reference: :116 _proxy_request_to — with retry-on-no-replica
-        and streaming."""
+        and streaming. Every request gets a root span (pick-replica +
+        proxy children) and an `X-Request-Id` — the client's own if it
+        sent one, minted here otherwise — propagated to the replica and
+        echoed on the response alongside `X-Replica-Id`, so client-side
+        correlation works even with tracing sampled out."""
         self.request_timestamps.append(time.time())
         body = await request.read()
-        deadline = time.time() + 30
-        while True:
-            replica = self.policy.select_replica()
-            if replica is not None:
-                break
-            if time.time() > deadline:
-                self._m_errors.labels('none').inc()
-                return web.Response(
-                    status=503,
-                    text='No ready replicas. Use "skyt serve status" to '
-                         'check the service.')
-            await asyncio.sleep(1)
-        self._m_requests.labels(replica).inc()
-        self._m_inflight.labels(replica).inc()
-        try:
-            return await self._proxy_to(request, replica, body)
-        finally:
-            self._m_inflight.labels(replica).dec()
-            self.policy.on_request_done(replica)
+        req_id = request.headers.get('X-Request-Id') or \
+            uuid.uuid4().hex[:16]
+        # Honor an upstream client's traceparent (their tracer keeps
+        # working through ours); otherwise this span roots the trace.
+        ctx = self._tracer.extract(request.headers)
+        with self._tracer.start_span(
+                'lb.request', parent=ctx,
+                attributes={'http.method': request.method,
+                            'http.path': str(request.rel_url),
+                            'request_id': req_id}) as span:
+            with self._tracer.start_span('lb.pick_replica') as pick:
+                deadline = time.time() + 30
+                while True:
+                    replica = self.policy.select_replica()
+                    if replica is not None:
+                        break
+                    if time.time() > deadline:
+                        self._m_errors.labels('none').inc()
+                        pick.set_attribute('error', 'no ready replica')
+                        span.set_attribute('http.status', 503)
+                        return web.Response(
+                            status=503,
+                            headers={'X-Request-Id': req_id},
+                            text='No ready replicas. Use "skyt serve '
+                                 'status" to check the service.')
+                    await asyncio.sleep(1)
+                pick.set_attribute('replica', replica)
+            span.set_attribute('replica', replica)
+            self._m_requests.labels(replica).inc()
+            self._m_inflight.labels(replica).inc()
+            try:
+                resp = await self._proxy_to(request, replica, body,
+                                            req_id)
+                span.set_attribute('http.status', resp.status)
+                return resp
+            finally:
+                self._m_inflight.labels(replica).dec()
+                self.policy.on_request_done(replica)
 
     async def _proxy_to(self, request: web.Request, replica: str,
-                        body: bytes) -> web.StreamResponse:
+                        body: bytes,
+                        req_id: str) -> web.StreamResponse:
         assert self._session is not None
         url = replica + str(request.rel_url)
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
-        try:
-            async with self._session.request(
-                    request.method, url, headers=headers, data=body,
-                    timeout=aiohttp.ClientTimeout(total=None,
-                                                  sock_connect=10),
-                    allow_redirects=False) as upstream:
-                out_headers = {
-                    k: v for k, v in upstream.headers.items()
-                    if k.lower() not in _HOP_HEADERS}
-                response = web.StreamResponse(status=upstream.status,
-                                              headers=out_headers)
-                await response.prepare(request)
-                # Stream: first chunk reaches the client as soon as the
-                # replica emits it (TTFT), not when the body completes.
-                async for chunk in upstream.content.iter_any():
-                    await response.write(chunk)
-                await response.write_eof()
-                return response
-        except aiohttp.ClientError as e:
-            logger.warning('proxy to %s failed: %s', replica, e)
-            self._m_errors.labels(replica).inc()
-            return web.Response(status=502,
-                                text=f'Replica {replica} failed: {e}')
+        headers['X-Request-Id'] = req_id
+        with self._tracer.start_span(
+                'lb.proxy', attributes={'replica': replica}) as span:
+            # The proxy span's context rides the traceparent header to
+            # the replica: its server span parents under this one.
+            self._tracer.inject(headers, span)
+            response: Optional[web.StreamResponse] = None
+            try:
+                async with self._session.request(
+                        request.method, url, headers=headers, data=body,
+                        timeout=aiohttp.ClientTimeout(total=None,
+                                                      sock_connect=10),
+                        allow_redirects=False) as upstream:
+                    out_headers = {
+                        k: v for k, v in upstream.headers.items()
+                        if k.lower() not in _HOP_HEADERS}
+                    # Client-side correlation (satellite): which
+                    # replica served this, under which LB request id.
+                    # The replica's own X-Request-Id (the engine
+                    # request id) wins when present — it is the key
+                    # into that replica's /stats phase traces.
+                    out_headers.setdefault('X-Request-Id', req_id)
+                    out_headers['X-Replica-Id'] = replica
+                    span.set_attribute('http.status', upstream.status)
+                    response = web.StreamResponse(
+                        status=upstream.status, headers=out_headers)
+                    await response.prepare(request)
+                    # Stream: first chunk reaches the client as soon as
+                    # the replica emits it (TTFT), not when the body
+                    # completes.
+                    first_chunk = True
+                    async for chunk in upstream.content.iter_any():
+                        if first_chunk:
+                            span.add_event('first_chunk')
+                            first_chunk = False
+                        await response.write(chunk)
+                    await response.write_eof()
+                    return response
+            except aiohttp.ClientError as e:
+                logger.warning('proxy to %s failed: %s', replica, e)
+                self._m_errors.labels(replica).inc()
+                span.set_attribute('error', repr(e))
+                if response is not None and response.prepared:
+                    # Headers (and possibly body chunks) already went
+                    # out: a second Response on the same exchange would
+                    # corrupt the chunked framing. Terminate the
+                    # truncated stream instead; the client sees the
+                    # short body, not a mangled 502.
+                    try:
+                        await response.write_eof()
+                    except (aiohttp.ClientError, ConnectionError,
+                            RuntimeError):
+                        pass
+                    return response
+                return web.Response(
+                    status=502,
+                    headers={'X-Request-Id': req_id,
+                             'X-Replica-Id': replica},
+                    text=f'Replica {replica} failed: {e}')
 
     async def _on_startup(self, app: web.Application) -> None:
         del app
@@ -170,9 +238,21 @@ class SkyServeLoadBalancer:
         if self._session:
             await self._session.close()
 
+    async def _debug_traces(self, request: web.Request) -> web.Response:
+        """LB-local trace store (this hop's spans; the replica serves
+        its own /debug/traces with the same trace ids).
+        `?trace_id=` for one trace, `?format=chrome` for a Perfetto-
+        loadable chrome://tracing dump."""
+        payload, status = tracing_lib.debug_traces_payload(
+            self._tracer, request.query)
+        return web.json_response(payload, status=status)
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
+        # Registered before the catch-all: /debug/traces is answered
+        # by the LB itself, not proxied (each hop serves its own store).
+        app.router.add_get('/debug/traces', self._debug_traces)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app

@@ -7,6 +7,7 @@ code runs DP, FSDP, TP, CP, EP or any product of them by changing the mesh,
 with XLA inserting all collectives over ICI/DCN.
 """
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -18,6 +19,7 @@ from jax.sharding import Mesh
 
 from skypilot_tpu.parallel import sharding as sharding_lib
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing
 
 
 @dataclasses.dataclass
@@ -101,14 +103,22 @@ class DeferredMetrics:
     """
 
     def __init__(self, publisher: 'TrainMetricsPublisher',
-                 keys: Tuple[str, ...] = ('loss', 'grad_norm')) -> None:
+                 keys: Tuple[str, ...] = ('loss', 'grad_norm'),
+                 tracer: Optional['tracing.Tracer'] = None) -> None:
         self._pub = publisher
         self._keys = keys
         self._prev: Optional[Dict[str, Any]] = None
         self._cur: Optional[Dict[str, Any]] = None
+        self._tracer = tracer
+        # Start of the current logging window (set at the first
+        # on_step, advanced at every publish) — the step span's start.
+        self._window_t0: Optional[float] = None
+        self._steps_published = 0
 
     def on_step(self, metrics: Dict[str, Any]) -> None:
         """Record step k's device metrics (no transfer, no sync)."""
+        if self._window_t0 is None:
+            self._window_t0 = time.time()
         self._prev = self._cur
         self._cur = {k: metrics[k] for k in self._keys if k in metrics}
 
@@ -117,12 +127,38 @@ class DeferredMetrics:
                 steps: int = 1) -> Dict[str, float]:
         """Pull step k-1's metrics (k still in flight) and publish them;
         returns the host floats for logging. First call of a run (no
-        k-1 yet) pulls the current step's."""
+        k-1 yet) pulls the current step's.
+
+        Also emits a `train.steps` span over the logging window into
+        the tracing plane (utils/tracing.py) carrying the deferred
+        step-(k-1) annotations — the training leg of the shared
+        timeline. Forced-sampled: train publishes at log boundaries
+        (tens of seconds apart), so head-sampling them away would save
+        nothing and lose the only train spans there are."""
         src = self._prev if self._prev is not None else self._cur
         host = ({k: float(v) for k, v in
                  jax.device_get(src).items()} if src else {})
         self._pub.publish(host, step_time_s=step_time_s,
                           tokens_per_sec=tokens_per_sec, steps=steps)
+        # The window advances whether or not tracing is on: enabling
+        # SKYT_TRACE mid-run must produce a span covering ONE logging
+        # window, not the whole run so far.
+        now = time.time()
+        start = self._window_t0 if self._window_t0 is not None else now
+        self._window_t0 = now
+        if tracing.enabled():
+            attrs: Dict[str, Any] = {'steps': steps,
+                                     'step_counter':
+                                         self._steps_published + steps,
+                                     'metrics_lag_steps': 1, **host}
+            if step_time_s is not None:
+                attrs['step_time_s'] = step_time_s
+            if tokens_per_sec is not None:
+                attrs['tokens_per_sec'] = tokens_per_sec
+            (self._tracer or tracing.TRACER).record_span(
+                'train.steps', start, now, attributes=attrs,
+                sampled=True)
+        self._steps_published += steps
         return host
 
 

@@ -26,6 +26,7 @@ request ids or URLs with unbounded cardinality).
 import math
 import re
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
@@ -249,6 +250,27 @@ class Gauge(_Metric):
     value = Counter.value
 
 
+class _HistogramTimer:
+    """Context manager observing its own wall duration (seconds) into
+    a histogram child on exit — replaces hand-rolled
+    `t0 = time.perf_counter(); ...; h.observe(perf_counter() - t0)`
+    pairs. Observes on the exception path too: error latency is
+    latency."""
+
+    __slots__ = ('_child', '_t0')
+
+    def __init__(self, child: '_HistogramChild') -> None:
+        self._child = child
+        self._t0 = 0.0
+
+    def __enter__(self) -> '_HistogramTimer':
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self._child.observe(time.perf_counter() - self._t0)
+
+
 class _HistogramChild:
     def __init__(self, buckets: Sequence[float]) -> None:
         self._lock = threading.Lock()
@@ -256,6 +278,9 @@ class _HistogramChild:
         self.counts = [0] * len(buckets)    # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
+
+    def time(self) -> _HistogramTimer:
+        return _HistogramTimer(self)
 
     def observe(self, value: float) -> None:
         v = float(value)
@@ -297,6 +322,11 @@ class Histogram(_Metric):
 
     def observe(self, value: float) -> None:
         self._default_child().observe(value)
+
+    def time(self) -> _HistogramTimer:
+        """`with hist.time(): ...` — observe the block's duration.
+        Labeled histograms: `with hist.labels(...).time(): ...`."""
+        return self._default_child().time()
 
     def expose_lines(self) -> List[str]:
         lines = [f'# HELP {self.name} {_escape_help(self.help)}',

@@ -45,11 +45,12 @@ class Event:
     def begin(self) -> None:
         if not _is_enabled():
             return
+        now = time.time()
         event = {
             'name': self._name,
             'cat': 'skyt',
             'ph': 'B',
-            'ts': f'{time.time() * 1e6:.3f}',
+            'ts': f'{now * 1e6:.3f}',
             'pid': str(os.getpid()),
             'tid': str(threading.current_thread().ident),
         }
@@ -57,19 +58,29 @@ class Event:
             event['args'] = {'message': self._message}
         with _events_lock:
             _events.append(event)
+        # Bridge into the tracing plane (utils/tracing.py): the same
+        # client op shows up as a span beside serve/infer/train spans,
+        # so the planes share one timeline. Lazy import — timeline is
+        # imported by low-level utils that tracing's metrics dependency
+        # must not drag in at module import time.
+        from skypilot_tpu.utils import tracing
+        tracing.record_timeline_event(self._name, 'B', now)
 
     def end(self) -> None:
         if not _is_enabled():
             return
+        now = time.time()
         with _events_lock:
             _events.append({
                 'name': self._name,
                 'cat': 'skyt',
                 'ph': 'E',
-                'ts': f'{time.time() * 1e6:.3f}',
+                'ts': f'{now * 1e6:.3f}',
                 'pid': str(os.getpid()),
                 'tid': str(threading.current_thread().ident),
             })
+        from skypilot_tpu.utils import tracing
+        tracing.record_timeline_event(self._name, 'E', now)
 
     def __enter__(self) -> 'Event':
         self.begin()

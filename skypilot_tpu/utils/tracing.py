@@ -1,0 +1,644 @@
+"""Span-based distributed tracing with a tail-latency flight recorder.
+
+The fourth observability plane (docs/observability.md): PR 1's metrics
+see behavior in aggregate and the engine's phase traces are only
+reachable by already knowing a replica-local request id — a slow
+request through the serve load balancer was undiagnosable end-to-end.
+This module is the dependency-free substrate that stitches the hops
+together:
+
+  * a trace/span model (trace_id, span_id, parent, attributes,
+    timestamped events) with contextvar propagation, so nested spans in
+    one task/thread parent automatically;
+  * W3C `traceparent` inject/extract helpers, so the LB's root span and
+    the replica's server span share one trace id across the proxy hop
+    (and an upstream client's own tracer keeps working through ours);
+  * a thread-safe bounded in-memory span store with ring eviction, plus
+    a **tail-latency flight recorder**: traces are head-sampled at
+    `SKYT_TRACE_SAMPLE` (default 0 — keep nothing in the steady state),
+    but any trace whose end-to-end latency exceeds
+    `SKYT_TRACE_SLOW_MS` is ALWAYS retained, with a caller-provided
+    state snapshot (the inference server attaches queue depth / running
+    slots / KV- and prefix-cache occupancy) — the trace you need is the
+    one that was slow, and it is already captured when you go looking;
+  * Chrome trace-event-format export (`Tracer.chrome_trace`) for
+    loading a trace into chrome://tracing / Perfetto next to the
+    client timeline and device profiles.
+
+Env vars (re-read per call, like utils/timeline.py, so long-lived
+servers and tests can toggle at runtime):
+
+  SKYT_TRACE          master switch; '0' => zero-overhead no-op path
+                      (start_span returns a shared no-op singleton,
+                      nothing is recorded). Default on.
+  SKYT_TRACE_SAMPLE   head-sampling rate in [0, 1]: the fraction of
+                      NON-slow traces kept in the recent ring.
+                      Default 0.0 — by default only the flight
+                      recorder retains anything.
+  SKYT_TRACE_SLOW_MS  flight-recorder threshold in milliseconds
+                      (default 500): a locally-rooted trace slower
+                      than this is always retained.
+
+Design rules match utils/metrics.py: no third-party deps, thread-safe
+(HTTP handlers, the engine loop, and the train loop all record
+concurrently), one process-wide default `TRACER` plus injectable
+instances for tests, and bounded memory everywhere (open-trace table,
+recent ring, slow ring, spans-per-trace, events-per-span) with
+evictions counted in `skyt_trace_dropped_total`.
+"""
+import collections
+import contextvars
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
+
+logger = log_utils.init_logger(__name__)
+
+# W3C trace-context: version 00 has exactly four fields; FUTURE
+# versions must still parse from their first four fields, with any
+# trailing '-...' suffix ignored (the spec requires forward
+# compatibility — rejecting a version-01 header would drop a valid
+# upstream trace id).
+_TRACEPARENT_RE = re.compile(
+    r'^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})'
+    r'(-.+)?$')
+
+# Bounds (per store). Sized for a serving replica under load: the
+# recent ring at the default 0.0 sample rate only ever holds
+# explicitly-sampled traces (validation runs, train-step spans).
+_MAX_RECENT = 256
+_MAX_SLOW = 64
+_MAX_OPEN = 512
+_MAX_SPANS_PER_TRACE = 256
+_MAX_EVENTS_PER_SPAN = 64
+
+
+def enabled() -> bool:
+    """Master switch (default on). '0' selects the no-op path: span
+    creation returns a shared singleton and records nothing."""
+    return os.environ.get('SKYT_TRACE', '1') != '0'
+
+
+def sample_rate() -> float:
+    """Head-sampling rate in [0, 1]; malformed values fall back to the
+    0.0 default with a debug log rather than crashing a request."""
+    raw = os.environ.get('SKYT_TRACE_SAMPLE', '0')
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        logger.debug('malformed SKYT_TRACE_SAMPLE=%r; using 0', raw)
+        return 0.0
+
+
+def slow_threshold_ms() -> float:
+    """Flight-recorder latency threshold (ms); malformed values fall
+    back to the 500ms default."""
+    raw = os.environ.get('SKYT_TRACE_SLOW_MS', '500')
+    try:
+        return float(raw)
+    except ValueError:
+        logger.debug('malformed SKYT_TRACE_SLOW_MS=%r; using 500', raw)
+        return 500.0
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+SpanContext = collections.namedtuple(
+    'SpanContext', ['trace_id', 'span_id', 'sampled'])
+
+_current: 'contextvars.ContextVar[Optional[Span]]' = \
+    contextvars.ContextVar('skyt_trace_span', default=None)
+
+
+def current_span() -> 'Optional[Span]':
+    return _current.get()
+
+
+class Span:
+    """One timed operation. Usable as a context manager; on `end()` the
+    span is handed to its tracer's store. `local_root` marks the first
+    span of this process's participation in the trace (no parent, or a
+    parent extracted from a remote `traceparent`) — its end is when the
+    flight-recorder decision for the whole local trace is made."""
+
+    __slots__ = ('name', 'trace_id', 'span_id', 'parent_id', 'sampled',
+                 'local_root', 'start', 'end_time', 'attributes',
+                 'events', '_tracer', '_token', '_n_dropped_events')
+
+    def __init__(self, tracer: 'Tracer', name: str, trace_id: str,
+                 parent_id: Optional[str], sampled: bool,
+                 local_root: bool,
+                 attributes: Optional[Dict[str, Any]] = None) -> None:
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id(8)
+        self.parent_id = parent_id
+        self.sampled = sampled
+        self.local_root = local_root
+        self.start = time.time()
+        self.end_time: Optional[float] = None
+        self.attributes: Dict[str, Any] = dict(attributes or {})
+        self.events: List[Dict[str, Any]] = []
+        self._tracer = tracer
+        self._token = None
+        self._n_dropped_events = 0
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id, self.sampled)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, ts: Optional[float] = None,
+                  **attrs) -> None:
+        """Timestamped point annotation (bounded per span)."""
+        if len(self.events) >= _MAX_EVENTS_PER_SPAN:
+            self._n_dropped_events += 1
+            return
+        ev: Dict[str, Any] = {'name': name,
+                              'ts': ts if ts is not None else time.time()}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
+    def end(self) -> None:
+        if self.end_time is not None:    # idempotent
+            return
+        self.end_time = time.time()
+        if self._token is not None:
+            try:
+                _current.reset(self._token)
+            except ValueError:
+                # Ended from a different context (executor thread /
+                # other task) than it started in; the contextvar copy
+                # there dies with that context anyway.
+                pass
+            self._token = None
+        self._tracer._on_span_end(self)  # pylint: disable=protected-access
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            'name': self.name,
+            'trace_id': self.trace_id,
+            'span_id': self.span_id,
+            'parent_id': self.parent_id,
+            'service': self._tracer.service,
+            'start': self.start,
+            'end': self.end_time,
+            'duration_ms': (round((self.end_time - self.start) * 1e3, 3)
+                            if self.end_time is not None else None),
+        }
+        if self.attributes:
+            d['attributes'] = dict(self.attributes)
+        if self.events:
+            d['events'] = list(self.events)
+        if self._n_dropped_events:
+            d['dropped_events'] = self._n_dropped_events
+        return d
+
+    def __enter__(self) -> 'Span':
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.attributes.setdefault('error', repr(exc))
+        self.end()
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled path — start_span
+    allocates NOTHING when tracing is off."""
+
+    __slots__ = ()
+    trace_id = ''
+    span_id = ''
+    parent_id = None
+    sampled = False
+    local_root = False
+    name = ''
+    events: List[Dict[str, Any]] = []
+    attributes: Dict[str, Any] = {}
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext('', '', False)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, ts: Optional[float] = None,
+                  **attrs) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self) -> '_NoopSpan':
+        return self
+
+    def __exit__(self, *args) -> None:
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class SpanStore:
+    """Thread-safe bounded span store with ring eviction and the
+    flight-recorder retention policy.
+
+    Finished spans buffer in an open-trace table until their trace's
+    LOCAL ROOT span ends; the whole local trace is then either retained
+    (slow ring — always; recent ring — when head-sampled) or dropped.
+    Every bound eviction increments `dropped` so the store's behavior
+    under load is observable (`skyt_trace_dropped_total`)."""
+
+    def __init__(self, max_recent: int = _MAX_RECENT,
+                 max_slow: int = _MAX_SLOW,
+                 max_open: int = _MAX_OPEN,
+                 max_spans_per_trace: int = _MAX_SPANS_PER_TRACE) -> None:
+        self._lock = threading.Lock()
+        self.max_recent = max_recent
+        self.max_slow = max_slow
+        self.max_open = max_open
+        self.max_spans_per_trace = max_spans_per_trace
+        self._open: 'collections.OrderedDict[str, List[dict]]' = \
+            collections.OrderedDict()
+        self._recent: 'collections.OrderedDict[str, dict]' = \
+            collections.OrderedDict()
+        self._slow: 'collections.OrderedDict[str, dict]' = \
+            collections.OrderedDict()
+        # Attached to slow traces at retention time (the inference
+        # server points this at an engine-state reader).
+        self.slow_snapshot: Optional[Callable[[], Dict[str, Any]]] = None
+
+    def add(self, span: 'Span') -> 'tuple[int, int, Optional[dict]]':
+        """Record one finished span. Returns (recorded, dropped,
+        slow_record): counter deltas for the tracer's metrics, plus the
+        just-retained slow-trace record (if this span closed a slow
+        trace) so the snapshot hook can run outside the lock."""
+        sd = span.to_dict()
+        tid = span.trace_id
+        recorded, dropped = 1, 0
+        slow_rec = None
+        with self._lock:
+            spans = self._open.get(tid)
+            if spans is None:
+                spans = []
+                self._open[tid] = spans
+                while len(self._open) > self.max_open:
+                    _, evicted = self._open.popitem(last=False)
+                    dropped += len(evicted)
+            if len(spans) < self.max_spans_per_trace:
+                spans.append(sd)
+            else:
+                recorded, dropped = 0, dropped + 1
+            if not span.local_root:
+                return recorded, dropped, None
+            # Local root ended: decide the whole local trace's fate.
+            spans = self._open.pop(tid, [])
+            duration_ms = (span.end_time - span.start) * 1e3
+            slow = duration_ms > slow_threshold_ms()
+            rec = {'trace_id': tid, 'root': span.name,
+                   'service': sd.get('service', ''),
+                   'attributes': sd.get('attributes', {}),
+                   'start': span.start, 'end': span.end_time,
+                   'duration_ms': round(duration_ms, 3),
+                   'sampled': span.sampled, 'slow': slow,
+                   'spans': spans}
+            if slow:
+                prior = self._slow.pop(tid, None)
+                if prior is not None:
+                    rec['spans'] = prior['spans'] + rec['spans']
+                self._slow[tid] = rec
+                while len(self._slow) > self.max_slow:
+                    _, ev = self._slow.popitem(last=False)
+                    dropped += len(ev['spans'])
+                slow_rec = rec
+            if span.sampled or slow:
+                prior = self._recent.pop(tid, None)
+                if prior is not None and prior is not rec:
+                    # Two local roots of one trace in one process
+                    # (e.g. LB + replica sharing the default tracer):
+                    # merge instead of shadowing the earlier hop.
+                    rec = dict(rec)
+                    rec['spans'] = prior['spans'] + rec['spans']
+                    rec['start'] = min(prior['start'], rec['start'])
+                    rec['duration_ms'] = round(
+                        (rec['end'] - rec['start']) * 1e3, 3)
+                self._recent[tid] = rec
+                while len(self._recent) > self.max_recent:
+                    _, ev = self._recent.popitem(last=False)
+                    if not ev.get('slow'):     # still held by _slow
+                        dropped += len(ev['spans'])
+            elif not slow:
+                dropped += len(spans)
+        return recorded, dropped, slow_rec
+
+    def attach_snapshot(self, rec: dict) -> None:
+        """Run the (caller-provided) state-snapshot hook for a
+        just-retained slow trace. Called OUTSIDE the store lock: the
+        hook typically takes the engine lock, and hook latency must
+        never block concurrent span recording."""
+        hook = self.slow_snapshot
+        if hook is None:
+            return
+        try:
+            rec['state_snapshot'] = hook()
+        except Exception as e:  # pylint: disable=broad-except
+            rec['state_snapshot'] = {'error': repr(e)}
+
+    def trace(self, trace_id: str) -> Optional[dict]:
+        """Full record for one trace (slow ring first — it survives
+        recent-ring eviction), or a partial view of a still-open
+        trace, or None."""
+        with self._lock:
+            rec = self._slow.get(trace_id) or self._recent.get(trace_id)
+            if rec is not None:
+                out = dict(rec)
+                out['spans'] = list(rec['spans'])
+                return out
+            spans = self._open.get(trace_id)
+            if spans is not None:
+                return {'trace_id': trace_id, 'open': True,
+                        'spans': list(spans)}
+            return None
+
+    def summaries(self) -> Dict[str, List[dict]]:
+        """Newest-first {recent, slow} listings with per-hop breakdown
+        (span name -> duration) — the /debug/traces index payload."""
+        def brief(rec: dict) -> dict:
+            return {'trace_id': rec['trace_id'], 'root': rec['root'],
+                    'service': rec['service'], 'start': rec['start'],
+                    'attributes': rec.get('attributes', {}),
+                    'duration_ms': rec['duration_ms'],
+                    'slow': rec['slow'], 'sampled': rec['sampled'],
+                    'n_spans': len(rec['spans']),
+                    'hops': [{'name': s['name'],
+                              'service': s.get('service', ''),
+                              'duration_ms': s.get('duration_ms')}
+                             for s in rec['spans']]}
+        with self._lock:
+            recent = [brief(r) for r in
+                      reversed(list(self._recent.values()))]
+            slow = [brief(r) for r in
+                    reversed(list(self._slow.values()))]
+        return {'recent': recent, 'slow': slow}
+
+    def records(self) -> List[dict]:
+        """All retained trace records (slow + recent, deduped)."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for rec in list(self._slow.values()) + \
+                    list(self._recent.values()):
+                out[rec['trace_id']] = rec
+            return [dict(r, spans=list(r['spans']))
+                    for r in out.values()]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._open.clear()
+            self._recent.clear()
+            self._slow.clear()
+
+
+class Tracer:
+    """Creates spans, owns a SpanStore, and publishes its own overhead
+    to the metrics plane (`skyt_trace_spans_total{service}`,
+    `skyt_trace_dropped_total{service}`). `service` labels which hop
+    recorded a span (lb / infer / train / dashboard)."""
+
+    def __init__(self, service: str = 'skypilot-tpu',
+                 registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None,
+                 store: Optional[SpanStore] = None) -> None:
+        self.service = service
+        self.store = store or SpanStore()
+        reg = registry or metrics_lib.REGISTRY
+        self._m_spans = reg.counter(
+            'skyt_trace_spans_total', 'Spans recorded', ('service',))
+        self._m_dropped = reg.counter(
+            'skyt_trace_dropped_total',
+            'Spans dropped (unsampled-and-fast traces, ring eviction, '
+            'per-trace span caps)', ('service',))
+
+    # ------------------------------------------------------------ spans
+    @staticmethod
+    def _head_sample() -> bool:
+        rate = sample_rate()
+        if rate >= 1.0:
+            return True
+        return rate > 0.0 and \
+            int.from_bytes(os.urandom(4), 'big') / 2**32 < rate
+
+    def start_span(self, name: str,
+                   parent: 'Optional[Span | SpanContext]' = None,
+                   attributes: Optional[Dict[str, Any]] = None,
+                   sampled: Optional[bool] = None) -> 'Span | _NoopSpan':
+        """Open a span and make it current (contextvar). Parent
+        resolution: an explicit Span/SpanContext wins, else the ambient
+        current span, else this span roots a new trace (head-sampling
+        decides `sampled` unless forced)."""
+        if not enabled():
+            return NOOP_SPAN
+        if parent is None:
+            parent = _current.get()
+        if isinstance(parent, _NoopSpan):
+            parent = None
+        if isinstance(parent, Span):
+            span = Span(self, name, parent.trace_id, parent.span_id,
+                        parent.sampled, local_root=False,
+                        attributes=attributes)
+        elif isinstance(parent, SpanContext):
+            # Remote parent (extracted traceparent): this is the first
+            # local span of the trace — a local root. An upstream
+            # sampled=true propagates (one decision per trace), but a
+            # local SKYT_TRACE_SAMPLE can UPGRADE an unsampled trace —
+            # the mid-incident workflow of flipping one replica to
+            # full sampling must work even when every request arrives
+            # through an LB that samples at 0.
+            if sampled is None:
+                sampled = parent.sampled or self._head_sample()
+            span = Span(self, name, parent.trace_id, parent.span_id,
+                        sampled, local_root=True,
+                        attributes=attributes)
+        else:
+            if sampled is None:
+                sampled = self._head_sample()
+            span = Span(self, name, _new_id(16), None, sampled,
+                        local_root=True, attributes=attributes)
+        span._token = _current.set(span)  # pylint: disable=protected-access
+        return span
+
+    def record_span(self, name: str, start: float, end: float,
+                    parent: 'Optional[Span | SpanContext]' = None,
+                    attributes: Optional[Dict[str, Any]] = None,
+                    events: Optional[Sequence[Dict[str, Any]]] = None,
+                    sampled: Optional[bool] = None) -> None:
+        """Record an already-timed operation as a finished span —
+        the bridge for measurements made outside a `with` scope (the
+        engine's phase timestamps, train-step windows, timeline
+        events). Does not touch the ambient context."""
+        if not enabled():
+            return
+        if parent is None:
+            parent = _current.get()
+        if isinstance(parent, _NoopSpan):
+            parent = None
+        if isinstance(parent, Span):
+            span = Span(self, name, parent.trace_id, parent.span_id,
+                        parent.sampled, local_root=False,
+                        attributes=attributes)
+        elif isinstance(parent, SpanContext):
+            span = Span(self, name, parent.trace_id, parent.span_id,
+                        parent.sampled if sampled is None else sampled,
+                        local_root=False, attributes=attributes)
+        else:
+            if sampled is None:
+                sampled = self._head_sample()
+            span = Span(self, name, _new_id(16), None, sampled,
+                        local_root=True, attributes=attributes)
+        span.start = start
+        for ev in list(events or [])[:_MAX_EVENTS_PER_SPAN]:
+            span.events.append(dict(ev))
+        span.end_time = end
+        self._on_span_end(span)
+
+    def _on_span_end(self, span: 'Span') -> None:
+        recorded, dropped, slow_rec = self.store.add(span)
+        if recorded:
+            self._m_spans.labels(self.service).inc(recorded)
+        if dropped:
+            self._m_dropped.labels(self.service).inc(dropped)
+        if slow_rec is not None:
+            self.store.attach_snapshot(slow_rec)
+
+    # ----------------------------------------------------- propagation
+    def inject(self, headers: Dict[str, str],
+               span: 'Optional[Span]' = None) -> Dict[str, str]:
+        """Write the W3C `traceparent` header for `span` (default: the
+        current span) into `headers`; returns `headers`."""
+        span = span if span is not None else _current.get()
+        if span is None or isinstance(span, _NoopSpan):
+            return headers
+        flags = '01' if span.sampled else '00'
+        headers['traceparent'] = \
+            f'00-{span.trace_id}-{span.span_id}-{flags}'
+        return headers
+
+    def extract(self, headers) -> Optional[SpanContext]:
+        """Parse an incoming `traceparent` (case-insensitive header
+        lookup — aiohttp/requests both normalize, raw dicts may not).
+        Malformed or all-zero ids are rejected (None), per the W3C
+        spec: a broken upstream tracer must not corrupt ours."""
+        raw = None
+        getter = getattr(headers, 'get', None)
+        if getter is not None:
+            raw = getter('traceparent') or getter('Traceparent')
+        if not raw or not isinstance(raw, str):
+            return None
+        m = _TRACEPARENT_RE.match(raw.strip())
+        if m is None:
+            return None
+        version, trace_id, span_id, flags, suffix = m.groups()
+        if version == 'ff' or trace_id == '0' * 32 or \
+                span_id == '0' * 16:
+            return None
+        if suffix is not None and version == '00':
+            return None   # version 00 has exactly four fields
+        return SpanContext(trace_id, span_id,
+                           bool(int(flags, 16) & 0x01))
+
+    # ---------------------------------------------------------- export
+    def chrome_trace(self, trace_id: Optional[str] = None
+                     ) -> Dict[str, Any]:
+        """Chrome trace-event-format dump of retained traces (or one
+        trace) — load into chrome://tracing / Perfetto. Spans render as
+        complete ('X') events grouped by service; span events as
+        instants."""
+        if trace_id is not None:
+            rec = self.store.trace(trace_id)
+            records = [rec] if rec is not None else []
+        else:
+            records = self.store.records()
+        out: List[Dict[str, Any]] = []
+        for rec in records:
+            pid = f"trace:{rec['trace_id'][:8]}"
+            for sd in rec.get('spans', []):
+                if sd.get('end') is None:
+                    continue
+                tid = sd.get('service') or 'unknown'
+                args = dict(sd.get('attributes', {}))
+                args.update({'trace_id': rec['trace_id'],
+                             'span_id': sd['span_id'],
+                             'parent_id': sd.get('parent_id')})
+                out.append({'name': sd['name'], 'cat': 'skyt.trace',
+                            'ph': 'X', 'ts': sd['start'] * 1e6,
+                            'dur': (sd['end'] - sd['start']) * 1e6,
+                            'pid': pid, 'tid': tid, 'args': args})
+                for ev in sd.get('events', []):
+                    out.append({'name': ev['name'], 'cat': 'skyt.trace',
+                                'ph': 'i', 's': 't',
+                                'ts': ev['ts'] * 1e6,
+                                'pid': pid, 'tid': tid,
+                                'args': {k: v for k, v in ev.items()
+                                         if k not in ('name', 'ts')}})
+        return {'traceEvents': out}
+
+
+def debug_traces_payload(tracer: 'Tracer',
+                         query) -> 'tuple[Any, int]':
+    """Shared dispatch for the GET /debug/traces surfaces (inference
+    server, LB, dashboard — one implementation, three mounts):
+    `query` is any mapping with optional 'trace_id' / 'format' keys;
+    returns (json-serializable payload, http status)."""
+    tid = query.get('trace_id')
+    if query.get('format') == 'chrome':
+        return tracer.chrome_trace(tid), 200
+    if tid is not None:
+        rec = tracer.store.trace(tid)
+        if rec is None:
+            return {'error': f'no retained trace {tid!r} (unsampled, '
+                             f'evicted, or never seen at this hop)'}, \
+                404
+        return rec, 200
+    return tracer.store.summaries(), 200
+
+
+# ------------------------------------------------- timeline bridging
+# utils/timeline.py B/E events (SKYT_DEBUG client ops) re-emitted as
+# spans, so the client timeline and the distributed trace share one
+# store. Per-thread begin-stack: timeline events nest LIFO per thread.
+_tl_local = threading.local()
+
+
+def record_timeline_event(name: str, phase: str, ts: float) -> None:
+    """Called by utils/timeline.py on each begin/end event (only when
+    SKYT_DEBUG is on). Unmatched ends are ignored."""
+    if not enabled():
+        return
+    stack = getattr(_tl_local, 'stack', None)
+    if stack is None:
+        stack = _tl_local.stack = []
+    if phase == 'B':
+        stack.append((name, ts))
+        return
+    while stack:
+        b_name, b_ts = stack.pop()
+        if b_name == name:
+            TRACER.record_span(f'timeline:{name}', b_ts, ts)
+            return
+
+
+# Process-wide default tracer. Long-lived components use it unless
+# handed an instance; tests inject their own (private registry + store)
+# to stay isolated.
+TRACER = Tracer()

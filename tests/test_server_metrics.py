@@ -1,12 +1,14 @@
 """Inference-server metrics surface (the serving half of
 tests/test_metrics.py, split out beside the other HTTP-surface
 integration tests): /metrics scrapes cleanly while a completion
-streams, and the X-Request-Id header resolves to a phase trace via
-/stats?request_id=.
+streams, the X-Request-Id header resolves to a phase trace via
+/stats?request_id=, and one trace id spans the LB -> replica hop
+(utils/tracing.py).
 """
 import pytest
 
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing as tracing_lib
 
 # ---------------------------------------------------- serving integration
 _EXPO_LINE = (r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
@@ -120,5 +122,154 @@ def test_metrics_endpoint_while_streaming():
         # Plain /stats still serves the engine summary.
         assert requests.get(base + '/stats',
                             timeout=5).json()['num_slots'] == 2
+    finally:
+        eng.stop()
+
+
+@pytest.mark.integration
+def test_lb_to_server_trace_propagation(monkeypatch):
+    """One request through the serve LB yields ONE trace id visible at
+    /debug/traces on BOTH hops: the LB's root span (pick-replica +
+    proxy children) and the replica's server + engine phase spans,
+    with the server span parented under the LB's proxy span via the
+    injected traceparent. With SKYT_TRACE_SLOW_MS=0 the flight
+    recorder retains the trace and snapshots engine state onto it."""
+    import dataclasses
+    import socket
+    import threading as th
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_TRACE', '1')
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '1')
+    # Everything is 'slow': every trace exercises the flight recorder.
+    monkeypatch.setenv('SKYT_TRACE_SLOW_MS', '0')
+    # Keep the LB's controller-sync loop from spamming reconnects to
+    # the (intentionally absent) controller during the test.
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '3600')
+
+    cfg = dataclasses.replace(llama.CONFIGS['debug'], max_seq_len=64)
+    model = llama.LlamaModel(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+    reg = metrics_lib.MetricsRegistry()
+    eng = engine_lib.InferenceEngine(model, params, num_slots=2,
+                                     max_seq_len=64,
+                                     prefill_buckets=[16],
+                                     metrics_registry=reg)
+    eng.start()
+    srv_tracer = tracing_lib.Tracer(service='infer', registry=reg)
+    lb_tracer = tracing_lib.Tracer(service='lb', registry=reg)
+    srv = server_lib.InferenceServer(eng, tracer=srv_tracer)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    srv_port, lb_port = free_port(), free_port()
+    replica_url = f'http://127.0.0.1:{srv_port}'
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', lb_port, metrics_registry=reg,
+        tracer=lb_tracer)
+    lb.policy.set_ready_replicas([replica_url])
+    for app, port in ((srv.make_app(), srv_port),
+                      (lb.make_app(), lb_port)):
+        th.Thread(target=lambda a=app, p=port: web.run_app(
+            a, port=p, print=None, handle_signals=False),
+            daemon=True).start()
+    lb_base = f'http://127.0.0.1:{lb_port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            # Health THROUGH the proxy: proves the whole chain is up.
+            if requests.get(lb_base + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(0.2)
+
+    try:
+        resp = requests.post(
+            lb_base + '/generate',
+            json={'tokens': [5, 6, 7], 'max_tokens': 4}, timeout=120)
+        assert resp.status_code == 200
+        # Satellite: client-side correlation headers from the LB.
+        assert resp.headers['X-Replica-Id'] == replica_url
+        # The replica's engine request id wins (it keys /stats).
+        assert resp.headers['X-Request-Id'] == \
+            str(resp.json()['request_id'])
+
+        # ONE trace id across both hops, found via each hop's own
+        # /debug/traces surface.
+        lb_summ = requests.get(lb_base + '/debug/traces',
+                               timeout=5).json()
+        gen = [r for r in lb_summ['recent']
+               if r['attributes'].get('http.path') == '/generate']
+        assert gen, lb_summ
+        tid = gen[0]['trace_id']
+        assert gen[0]['slow']                  # flight-recorded at 0ms
+
+        lb_rec = requests.get(
+            lb_base + f'/debug/traces?trace_id={tid}', timeout=5).json()
+        lb_spans = {s['name']: s for s in lb_rec['spans']}
+        assert {'lb.request', 'lb.pick_replica',
+                'lb.proxy'} <= set(lb_spans)
+        assert lb_spans['lb.request']['parent_id'] is None  # the root
+        assert lb_spans['lb.proxy']['parent_id'] == \
+            lb_spans['lb.request']['span_id']
+
+        srv_rec = requests.get(
+            replica_url + f'/debug/traces?trace_id={tid}',
+            timeout=5).json()
+        srv_spans = {s['name']: s for s in srv_rec['spans']}
+        assert {'server /generate', 'engine.queue_wait',
+                'engine.prefill', 'engine.decode'} <= set(srv_spans)
+        # The cross-hop parent link: traceparent injected by the LB's
+        # proxy span, extracted by the replica's middleware.
+        assert srv_spans['server /generate']['parent_id'] == \
+            lb_spans['lb.proxy']['span_id']
+        for name in ('engine.queue_wait', 'engine.prefill',
+                     'engine.decode'):
+            assert srv_spans[name]['parent_id'] == \
+                srv_spans['server /generate']['span_id']
+        # Flight recorder attached an engine-state snapshot.
+        snap = srv_rec['state_snapshot']
+        assert snap['num_slots'] == 2
+        assert 'queue_depth' in snap and 'running_slots' in snap
+        # Engine span events (overlap machinery) rode along.
+        names = [e['name'] for s in srv_rec['spans']
+                 for e in s.get('events', [])]
+        assert any(n in ('admission', 'batch_admission')
+                   for n in names)
+        assert 'decode_chunk' in names
+
+        # Chrome dump is Perfetto-loadable trace-event JSON.
+        chrome = requests.get(
+            replica_url + f'/debug/traces?trace_id={tid}&format=chrome',
+            timeout=5).json()
+        assert any(e['ph'] == 'X' and e['name'] == 'engine.decode'
+                   for e in chrome['traceEvents'])
+
+        # /stats satellite: unknown ids point at the trace surface,
+        # malformed ids name the offending value.
+        r404 = requests.get(replica_url + '/stats?request_id=424242',
+                            timeout=5)
+        assert r404.status_code == 404
+        assert '/debug/traces?trace_id=' in r404.json()['hint']
+        r400 = requests.get(replica_url + '/stats?request_id=nope',
+                            timeout=5)
+        assert r400.status_code == 400
+        assert "'nope'" in r400.json()['error']
     finally:
         eng.stop()

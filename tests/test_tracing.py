@@ -1,0 +1,369 @@
+"""Tracing subsystem (utils/tracing.py): span store concurrency, ring
+eviction, W3C traceparent round-trips, flight-recorder retention vs
+head-sampling, and the zero-overhead disabled path. Pure host-side —
+no jax, no HTTP (the serving integration lives in
+tests/test_server_metrics.py).
+"""
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing
+
+
+def _tracer(service='test', **store_kwargs):
+    reg = metrics_lib.MetricsRegistry()
+    store = tracing.SpanStore(**store_kwargs) if store_kwargs else None
+    return tracing.Tracer(service=service, registry=reg,
+                          store=store), reg
+
+
+@pytest.fixture(autouse=True)
+def _trace_env(monkeypatch):
+    """Deterministic defaults: tracing on, sample everything, nothing
+    is 'slow' unless a test lowers the threshold."""
+    monkeypatch.setenv('SKYT_TRACE', '1')
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '1')
+    monkeypatch.setenv('SKYT_TRACE_SLOW_MS', '60000')
+
+
+# ------------------------------------------------------------ model
+def test_span_nesting_and_context_propagation():
+    t, _ = _tracer()
+    with t.start_span('root') as root:
+        assert tracing.current_span() is root
+        with t.start_span('child') as child:
+            assert tracing.current_span() is child
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            child.add_event('mark', detail=7)
+        assert tracing.current_span() is root
+    assert tracing.current_span() is None
+    rec = t.store.trace(root.trace_id)
+    assert rec is not None and not rec.get('open')
+    names = {s['name']: s for s in rec['spans']}
+    assert set(names) == {'root', 'child'}
+    assert names['child']['events'][0]['name'] == 'mark'
+    assert names['child']['events'][0]['detail'] == 7
+    assert rec['duration_ms'] >= names['child']['duration_ms']
+
+
+def test_span_end_idempotent_and_exception_attr():
+    t, _ = _tracer()
+    with pytest.raises(RuntimeError):
+        with t.start_span('boom') as span:
+            raise RuntimeError('kaput')
+    span.end()   # second end is a no-op, not a double record
+    rec = t.store.trace(span.trace_id)
+    assert len(rec['spans']) == 1
+    assert 'kaput' in rec['spans'][0]['attributes']['error']
+
+
+def test_record_span_manual_timing_parents_under_current():
+    t, _ = _tracer()
+    with t.start_span('root') as root:
+        t.record_span('engine.phase', root.start, root.start + 0.25,
+                      attributes={'rid': 3},
+                      events=[{'name': 'chunk', 'ts': root.start + .1}])
+    rec = t.store.trace(root.trace_id)
+    phase = next(s for s in rec['spans'] if s['name'] == 'engine.phase')
+    assert phase['parent_id'] == root.span_id
+    assert phase['duration_ms'] == pytest.approx(250, abs=1)
+    assert phase['events'][0]['name'] == 'chunk'
+
+
+def test_event_cap_is_bounded():
+    t, _ = _tracer()
+    with t.start_span('root') as root:
+        for i in range(500):
+            root.add_event(f'e{i}')
+    rec = t.store.trace(root.trace_id)
+    sd = rec['spans'][0]
+    assert len(sd['events']) == 64
+    assert sd['dropped_events'] == 500 - 64
+
+
+# ----------------------------------------------------- traceparent
+def test_traceparent_inject_extract_roundtrip():
+    t, _ = _tracer()
+    span = t.start_span('root')
+    headers = {}
+    t.inject(headers, span)
+    span.end()
+    tp = headers['traceparent']
+    assert tp == f'00-{span.trace_id}-{span.span_id}-01'
+    ctx = t.extract(headers)
+    assert ctx == tracing.SpanContext(span.trace_id, span.span_id,
+                                      True)
+    # Unsampled roots propagate flags 00 -> sampled False.
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv('SKYT_TRACE_SAMPLE', '0')
+        span2 = t.start_span('r2')
+        h2 = t.inject({}, span2)
+        span2.end()
+        assert h2['traceparent'].endswith('-00')
+        assert t.extract(h2).sampled is False
+
+
+@pytest.mark.parametrize('bad', [
+    '',
+    'garbage',
+    '00-abc-def-01',                                       # wrong widths
+    '00-' + '0' * 32 + '-' + 'a' * 16 + '-01',             # zero trace
+    '00-' + 'a' * 32 + '-' + '0' * 16 + '-01',             # zero span
+    'ff-' + 'a' * 32 + '-' + 'b' * 16 + '-01',             # version ff
+    '00-' + 'A' * 32 + '-' + 'b' * 16 + '-01',             # uppercase
+    '00-' + 'a' * 32 + '-' + 'b' * 16 + '-zz',             # bad flags
+    '00-' + 'a' * 32 + '-' + 'b' * 16,                     # truncated
+    '00-' + 'a' * 32 + '-' + 'b' * 16 + '-01-x',   # v00 extra field
+])
+def test_traceparent_malformed_rejected(bad):
+    t, _ = _tracer()
+    assert t.extract({'traceparent': bad}) is None
+
+
+def test_traceparent_future_version_accepted():
+    """W3C forward compatibility: a version > 00 header with trailing
+    fields parses from its first four fields."""
+    t, _ = _tracer()
+    ctx = t.extract({'traceparent':
+                     '01-' + 'a' * 32 + '-' + 'b' * 16 + '-01-future'})
+    assert ctx == tracing.SpanContext('a' * 32, 'b' * 16, True)
+    # Without the suffix too.
+    ctx = t.extract({'traceparent':
+                     'cc-' + 'a' * 32 + '-' + 'b' * 16 + '-00'})
+    assert ctx is not None and ctx.sampled is False
+
+
+def test_local_sample_rate_upgrades_unsampled_remote_parent(
+        monkeypatch):
+    """Flipping ONE replica to SKYT_TRACE_SAMPLE=1 mid-incident must
+    retain its traces even when the LB upstream samples at 0 (the
+    traceparent arrives with flags 00)."""
+    t, _ = _tracer()
+    remote = tracing.SpanContext('c' * 32, 'd' * 16, False)
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '0')
+    s0 = t.start_span('server', parent=remote)
+    s0.end()
+    assert s0.sampled is False           # nothing local boosts it
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '1')
+    s1 = t.start_span('server', parent=remote)
+    s1.end()
+    assert s1.sampled is True            # local upgrade
+    assert t.store.trace('c' * 32) is not None
+    # An upstream sampled=true always propagates regardless of rate.
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '0')
+    s2 = t.start_span('server', parent=remote._replace(sampled=True))
+    s2.end()
+    assert s2.sampled is True
+
+
+def test_extract_missing_or_nonstring_header():
+    t, _ = _tracer()
+    assert t.extract({}) is None
+    assert t.extract({'traceparent': None}) is None
+    # Remote parent continues the trace and marks a local root.
+    ctx = tracing.SpanContext('a' * 32, 'b' * 16, True)
+    span = t.start_span('server', parent=ctx)
+    assert span.trace_id == 'a' * 32
+    assert span.parent_id == 'b' * 16
+    assert span.local_root
+    span.end()
+    assert t.store.trace('a' * 32) is not None
+
+
+# ------------------------------------- flight recorder vs sampling
+def test_head_sampling_off_drops_fast_traces(monkeypatch):
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '0')
+    t, reg = _tracer()
+    with t.start_span('fast'):
+        pass
+    assert t.store.summaries() == {'recent': [], 'slow': []}
+    # The drop is observable, not silent.
+    assert reg.get('skyt_trace_dropped_total').value('test') == 1
+    assert reg.get('skyt_trace_spans_total').value('test') == 1
+
+
+def test_slow_trace_always_retained_with_snapshot(monkeypatch):
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', '0')    # sampling OFF
+    monkeypatch.setenv('SKYT_TRACE_SLOW_MS', '5')
+    t, _ = _tracer()
+    t.store.slow_snapshot = lambda: {'queue_depth': 3, 'running': 2}
+    with t.start_span('slow.request') as span:
+        with t.start_span('hop'):
+            time.sleep(0.02)
+    summ = t.store.summaries()
+    assert summ['recent'] and summ['slow']   # slow implies retained
+    assert summ['slow'][0]['trace_id'] == span.trace_id
+    rec = t.store.trace(span.trace_id)
+    assert rec['slow'] is True
+    assert rec['state_snapshot'] == {'queue_depth': 3, 'running': 2}
+    assert {s['name'] for s in rec['spans']} == {'slow.request', 'hop'}
+
+
+def test_snapshot_hook_failure_does_not_lose_the_trace(monkeypatch):
+    monkeypatch.setenv('SKYT_TRACE_SLOW_MS', '0')
+    t, _ = _tracer()
+
+    def bad_hook():
+        raise RuntimeError('engine gone')
+    t.store.slow_snapshot = bad_hook
+    with t.start_span('r'):
+        time.sleep(0.001)
+    rec = t.store.summaries()['slow'][0]
+    full = t.store.trace(rec['trace_id'])
+    assert 'engine gone' in full['state_snapshot']['error']
+
+
+def test_malformed_env_falls_back(monkeypatch):
+    monkeypatch.setenv('SKYT_TRACE_SAMPLE', 'lots')
+    monkeypatch.setenv('SKYT_TRACE_SLOW_MS', 'soon')
+    assert tracing.sample_rate() == 0.0
+    assert tracing.slow_threshold_ms() == 500.0
+
+
+# ------------------------------------------------- disabled no-op
+def test_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv('SKYT_TRACE', '0')
+    t, reg = _tracer()
+    span = t.start_span('x', attributes={'a': 1})
+    assert span is tracing.NOOP_SPAN          # shared singleton
+    with span as s:
+        s.add_event('e')
+        s.set_attribute('k', 'v')
+    assert t.inject({}, span) == {}           # nothing to propagate
+    t.record_span('y', 0.0, 1.0)
+    assert t.store.summaries() == {'recent': [], 'slow': []}
+    assert reg.get('skyt_trace_spans_total').value('test') == 0
+    # current-span context is untouched by no-op spans.
+    assert tracing.current_span() is None
+
+
+# ------------------------------------------------ bounds / eviction
+def test_recent_ring_eviction_under_load():
+    t, reg = _tracer(max_recent=8)
+    ids = []
+    for i in range(32):
+        with t.start_span(f'r{i}') as s:
+            ids.append(s.trace_id)
+    summ = t.store.summaries()
+    assert len(summ['recent']) == 8
+    kept = [r['trace_id'] for r in summ['recent']]
+    assert kept == list(reversed(ids[-8:]))   # newest first, FIFO evict
+    assert reg.get('skyt_trace_dropped_total').value('test') == 24
+    for tid in ids[:24]:
+        assert t.store.trace(tid) is None
+
+
+def test_open_trace_table_is_bounded():
+    t, reg = _tracer(max_open=4)
+    # Children whose local root never ends (crashed handlers) must not
+    # leak: the open table evicts FIFO past its bound.
+    ctxs = [tracing.SpanContext(f'{i:032x}', 'b' * 16, True)
+            for i in range(1, 9)]
+    for ctx in ctxs:
+        t.record_span('child', 0.0, 0.001, parent=ctx)
+    assert reg.get('skyt_trace_dropped_total').value('test') >= 4
+    # A surviving trace still finishes normally when its root arrives.
+    t.start_span('root', parent=ctxs[-1]).end()
+    assert t.store.trace(ctxs[-1].trace_id) is not None
+
+
+def test_spans_per_trace_cap():
+    t, reg = _tracer(max_spans_per_trace=10)
+    with t.start_span('root') as root:
+        for _ in range(50):
+            with t.start_span('c'):
+                pass
+    rec = t.store.trace(root.trace_id)
+    assert len(rec['spans']) == 10
+    assert reg.get('skyt_trace_dropped_total').value('test') >= 40
+
+
+def test_store_concurrency_hammer():
+    """8 threads x 50 traces x 3 spans against one small store: no
+    exceptions, counters exact, rings bounded."""
+    t, reg = _tracer(max_recent=16, max_slow=4)
+    errors = []
+
+    def worker(k):
+        try:
+            for i in range(50):
+                with t.start_span(f'w{k}.{i}') as root:
+                    with t.start_span('a'):
+                        pass
+                    t.record_span('b', root.start, root.start + .001)
+        except Exception as e:  # pylint: disable=broad-except
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    assert reg.get('skyt_trace_spans_total').value('test') == \
+        8 * 50 * 3
+    summ = t.store.summaries()
+    assert len(summ['recent']) == 16
+    assert len(summ['slow']) <= 4
+    # recorded + dropped covers every span that went in.
+    dropped = reg.get('skyt_trace_dropped_total').value('test')
+    retained = sum(r['n_spans'] for r in summ['recent'])
+    assert dropped + retained == 8 * 50 * 3
+
+
+# ------------------------------------------------------ export
+def test_chrome_trace_export_shape():
+    t, _ = _tracer()
+    with t.start_span('root') as root:
+        with t.start_span('child') as c:
+            c.add_event('mark')
+    dump = t.chrome_trace(root.trace_id)
+    evs = dump['traceEvents']
+    xs = [e for e in evs if e['ph'] == 'X']
+    marks = [e for e in evs if e['ph'] == 'i']
+    assert {e['name'] for e in xs} == {'root', 'child'}
+    assert marks[0]['name'] == 'mark'
+    for e in xs:
+        assert e['dur'] >= 0 and e['cat'] == 'skyt.trace'
+        assert e['args']['trace_id'] == root.trace_id
+    # Unknown trace id -> empty dump, not an error.
+    assert t.chrome_trace('f' * 32) == {'traceEvents': []}
+
+
+def test_timeline_bridge(monkeypatch):
+    """utils/timeline.py B/E events re-emit as spans when SKYT_DEBUG
+    is on — the client-op plane lands in the shared store."""
+    from skypilot_tpu.utils import timeline
+    monkeypatch.setenv('SKYT_DEBUG', '1')
+    timeline.reset()
+    before = len(tracing.TRACER.store.records())
+    with timeline.Event('op.launch'):
+        time.sleep(0.001)
+    recs = tracing.TRACER.store.records()
+    assert len(recs) > before
+    names = [s['name'] for r in recs for s in r['spans']]
+    assert 'timeline:op.launch' in names
+
+
+# ---------------------------------------------- metrics satellite
+def test_histogram_time_context_manager():
+    reg = metrics_lib.MetricsRegistry()
+    h = reg.histogram('t_seconds', 'help')
+    with h.time():
+        time.sleep(0.01)
+    sample = h.sample_dicts()[0]
+    assert sample['count'] == 1
+    assert 0.005 < sample['sum'] < 5.0
+    # Labeled children time independently; the exception path still
+    # observes (error latency is latency).
+    hl = reg.histogram('t2_seconds', 'help', ('route',))
+    with pytest.raises(ValueError):
+        with hl.labels('/a').time():
+            raise ValueError('x')
+    assert hl.sample_dicts()[0]['count'] == 1
+    assert hl.sample_dicts()[0]['labels'] == {'route': '/a'}

@@ -184,6 +184,93 @@ else
     FAIL=1
 fi
 
+echo "== 6. tracing plane: one trace id spans LB -> server -> engine"
+echo "   (in-process LB + debug replica; curls /debug/traces on both"
+echo "   hops and asserts the parent chain + flight-recorder snapshot) =="
+if SKYT_TRACE=1 SKYT_TRACE_SAMPLE=1 SKYT_TRACE_SLOW_MS=0 \
+        SKYT_SERVE_LB_SYNC_INTERVAL=3600 \
+        timeout 600 python - <<'PYEOF' 2>&1 | tee "$OUT/trace_chain.txt"
+import socket
+import threading
+import time
+
+import requests
+from aiohttp import web
+
+from skypilot_tpu.infer import server as server_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+
+eng = server_lib.build_engine('debug', num_slots=2, max_seq_len=128)
+eng.start()
+srv = server_lib.InferenceServer(eng)
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+srv_port, lb_port = free_port(), free_port()
+replica = f'http://127.0.0.1:{srv_port}'
+lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:9', lb_port)
+lb.policy.set_ready_replicas([replica])
+for app, port in ((srv.make_app(), srv_port), (lb.make_app(), lb_port)):
+    threading.Thread(target=lambda a=app, p=port: web.run_app(
+        a, port=p, print=None, handle_signals=False),
+        daemon=True).start()
+lb_base = f'http://127.0.0.1:{lb_port}'
+deadline = time.time() + 480   # warmup compiles through the tunnel
+while time.time() < deadline:
+    try:
+        if requests.get(lb_base + '/health',
+                        timeout=2).status_code == 200:
+            break
+    except requests.RequestException:
+        pass
+    time.sleep(1)
+else:
+    raise SystemExit('replica never became healthy through the LB')
+try:
+    r = requests.post(lb_base + '/generate',
+                      json={'tokens': [7, 8, 9], 'max_tokens': 8},
+                      timeout=120)
+    r.raise_for_status()
+    assert r.headers['X-Replica-Id'] == replica, r.headers
+    assert 'X-Request-Id' in r.headers, r.headers
+    summ = requests.get(lb_base + '/debug/traces', timeout=5).json()
+    gen = [t for t in summ['recent']
+           if t['attributes'].get('http.path') == '/generate']
+    assert gen, summ
+    tid = gen[0]['trace_id']
+    lb_rec = requests.get(
+        lb_base + f'/debug/traces?trace_id={tid}', timeout=5).json()
+    lb_spans = {s['name']: s for s in lb_rec['spans']}
+    assert {'lb.request', 'lb.pick_replica', 'lb.proxy'} <= \
+        set(lb_spans), lb_spans.keys()
+    srv_rec = requests.get(
+        replica + f'/debug/traces?trace_id={tid}', timeout=5).json()
+    srv_spans = {s['name']: s for s in srv_rec['spans']}
+    assert {'server /generate', 'engine.queue_wait', 'engine.prefill',
+            'engine.decode'} <= set(srv_spans), srv_spans.keys()
+    # The complete chain: engine spans under the server span, the
+    # server span under the LB's proxy span (via traceparent).
+    assert srv_spans['server /generate']['parent_id'] == \
+        lb_spans['lb.proxy']['span_id']
+    assert srv_spans['engine.decode']['parent_id'] == \
+        srv_spans['server /generate']['span_id']
+    assert 'state_snapshot' in srv_rec, 'flight recorder snapshot missing'
+    hops = ' '.join(f"{n}={s['duration_ms']}ms"
+                    for n, s in sorted(srv_spans.items()))
+    print(f'TRACE_CHAIN_OK trace_id={tid} {hops}')
+finally:
+    eng.stop()
+PYEOF
+then
+    echo "== trace chain: PASS =="
+else
+    echo "== trace chain: FAIL (see $OUT/trace_chain.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
