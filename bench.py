@@ -46,6 +46,7 @@ PHASE_DEADLINES = {
     'serve 8b int8 bench': 900,
     'host overhead bench': 600,
     'tracing overhead bench': 420,
+    'chaos recovery bench': 600,
 }
 
 
@@ -597,6 +598,155 @@ def tracing_overhead_metrics() -> list:
     ]
 
 
+def chaos_recovery_metrics() -> list:
+    """Recovery-time phase (CPU-runnable, docs/robustness.md): two
+    real replica server subprocesses behind the in-process LB; one is
+    SIGKILLed and the phase measures seconds from the kill to restored
+    service through the retry + circuit-breaker path:
+
+      * serve_recovery_first_success_s — kill -> first 200 (includes
+        the failed attempt, backoff, and retry on the survivor).
+      * serve_recovery_full_throughput_s — kill -> 5 consecutive
+        requests each completing within 2x the pre-kill p50 (the
+        breaker has ejected the dead replica; no request still pays a
+        connect-to-the-corpse penalty).
+    """
+    import socket
+    import statistics
+    import subprocess
+    import threading
+
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    env_keys = {'SKYT_SERVE_LB_SYNC_INTERVAL': '3600',
+                'SKYT_LB_RETRY_BACKOFF_S': '0.02',
+                'SKYT_LB_BREAKER_THRESHOLD': '2',
+                'SKYT_LB_BREAKER_COOLDOWN_S': '60'}
+    # The sync-interval override is deliberately NOT restored: the
+    # phase's daemon LB thread outlives the phase, and restoring the
+    # default would wake its parked controller-sync loop into a 2s
+    # failure-warning loop for the rest of the bench.
+    saved = {k: os.environ.get(k) for k in env_keys
+             if k != 'SKYT_SERVE_LB_SYNC_INTERVAL'}
+    os.environ.update(env_keys)
+    ports = [free_port(), free_port()]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    procs = [subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--model', 'debug', '--port', str(p),
+         '--num-slots', '2', '--max-seq-len', '64'],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for p in ports]
+    sess = requests.Session()
+    try:
+        for proc, url in zip(procs, urls):
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f'replica died rc={proc.returncode}')
+                try:
+                    if sess.get(url + '/health',
+                                timeout=2).status_code == 200:
+                        break
+                except requests.RequestException:
+                    pass
+                time.sleep(0.5)
+            else:
+                raise RuntimeError('replica never became healthy')
+        lb_port = free_port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:9', lb_port,
+            metrics_registry=metrics_lib.MetricsRegistry())
+        lb.policy.set_ready_replicas(urls)
+        threading.Thread(target=lambda: web.run_app(
+            lb.make_app(), port=lb_port, print=None,
+            handle_signals=False), daemon=True).start()
+        base = f'http://127.0.0.1:{lb_port}'
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                sess.get(base + '/metrics', timeout=2)
+                break
+            except requests.RequestException:
+                time.sleep(0.2)
+        payload = {'tokens': [7, 8, 9], 'max_tokens': 8}
+
+        def one() -> float:
+            t0 = time.perf_counter()
+            r = sess.post(base + '/generate', json=payload, timeout=60)
+            r.raise_for_status()
+            return time.perf_counter() - t0
+
+        for _ in range(4):
+            one()                       # warm both replicas + compiles
+        baseline_p50 = statistics.median(one() for _ in range(10))
+
+        procs[0].kill()                 # the chaos event
+        t_kill = time.perf_counter()
+        first_success = None
+        full_at = None
+        streak = 0
+        win_start = 0.0
+        bar = max(2 * baseline_p50, 0.05)
+        deadline = time.time() + 120
+        while time.time() < deadline and full_at is None:
+            try:
+                lat = one()
+            except requests.RequestException:
+                streak = 0
+                continue
+            now = time.perf_counter()
+            if first_success is None:
+                first_success = now - t_kill
+            if lat <= bar:
+                if streak == 0:
+                    # Restored-throughput instant = when the healthy
+                    # window STARTED (this request's send time), not
+                    # when its 5th probe finished.
+                    win_start = now - lat - t_kill
+                streak += 1
+                if streak >= 5:
+                    full_at = win_start
+            else:
+                streak = 0
+        if first_success is None:
+            raise RuntimeError('no request succeeded after the kill')
+        print(f'# chaos recovery: baseline p50={baseline_p50*1e3:.1f}ms '
+              f'first_success={first_success:.3f}s '
+              f'full_throughput={full_at if full_at else -1:.3f}s',
+              file=sys.stderr)
+        out = [
+            {'metric': 'serve_recovery_first_success_s',
+             'value': round(first_success, 3), 'unit': 's',
+             'vs_baseline': None},
+        ]
+        if full_at is not None:
+            out.append(
+                {'metric': 'serve_recovery_full_throughput_s',
+                 'value': round(full_at, 3), 'unit': 's',
+                 'vs_baseline': None})
+        return out
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -904,6 +1054,19 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# tracing overhead bench failed: {e!r}', file=sys.stderr)
+
+    # Chaos-recovery phase (robustness): seconds from a SIGKILLed
+    # replica to restored service through the LB retry + breaker path.
+    # CPU-runnable — the replicas are debug-model subprocesses.
+    if on_tpu:
+        _reclaim_hbm('pre-chaos-recovery')
+    try:
+        with phase_deadline(PHASE_DEADLINES['chaos recovery bench'],
+                            'chaos recovery bench'):
+            extra = extra + chaos_recovery_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# chaos recovery bench failed: {e!r}', file=sys.stderr)
 
     line = {
         'metric': metric_name,

@@ -156,7 +156,8 @@ def queue(cluster_name: str,
     jobs = _backend().get_job_queue(handle)
     if skip_finished:
         jobs = [j for j in jobs if j['status'] not in
-                ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED')]
+                ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED',
+                 'PREEMPTED')]
     return jobs
 
 

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
+from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing
@@ -92,6 +93,11 @@ class SamplingParams:
     # id is just another per-request sampling knob, so it rides the
     # multi-host request broadcast like everything else.
     lora_id: int = 0
+    # Absolute wall-clock deadline (time.time() seconds). Past it the
+    # request is expired in the decode loop — the slot and its KV
+    # pages free at the next delivery boundary instead of generating
+    # for an abandoned client (docs/robustness.md). None = no deadline.
+    deadline: Optional[float] = None
 
     def validate(self) -> None:
         """Reject parameters the engine cannot honor exactly, instead
@@ -163,6 +169,9 @@ class _Request:
     # Set (from any thread) by InferenceEngine.cancel(); the engine
     # loop releases the slot at the next delivery boundary.
     cancelled: bool = False
+    # Set by the loop's deadline scan: the request was cancelled
+    # because params.deadline passed (recorded as status='deadline').
+    expired: bool = False
     # Prompt page hashes, computed once at first admission attempt (a
     # deferred request retries every loop tick; re-hashing the prompt
     # each time is O(n) host work for an unchanging value).
@@ -651,6 +660,10 @@ class InferenceEngine:
         self._m_kv_util = reg.gauge(
             'skyt_infer_kv_cache_utilization',
             'KV cache occupancy fraction (0-1)')
+        self._m_deadline_expired = reg.counter(
+            'skyt_infer_deadline_expired_total',
+            'Requests expired by their per-request deadline (slot and '
+            'KV pages reclaimed)')
         self._m_prefix_hit = reg.counter(
             'skyt_infer_prefix_cache_hit_pages_total',
             'Prompt pages served from the prefix cache')
@@ -668,6 +681,7 @@ class InferenceEngine:
             _collections.OrderedDict()
         self._traces_lock = threading.Lock()
         self._last_gauge_t = 0.0
+        self._last_deadline_scan = 0.0
 
         self._jit_prefill = jax.jit(self._prefill_impl,
                                     static_argnames=('bucket',))
@@ -1320,6 +1334,49 @@ class InferenceEngine:
                     found = True
         return found
 
+    def _expire_deadlines(self) -> None:
+        """Deadline enforcement point, run by the engine loop each
+        tick: a request past params.deadline is cancelled in place, so
+        a running slot (and its KV pages) frees at the next delivery
+        boundary and a waiting request never occupies a slot at all.
+        Slots are scanned every tick (O(num_slots)); the waiting queue
+        — O(backlog) under its mutex — is throttled to ~4Hz.
+
+        Multi-host: expiry changes the next tick's batch, so it must
+        land on every host at the SAME tick — the primary routes it
+        through the cancel broadcast instead of flipping flags
+        locally."""
+        now = time.time()
+        expired: List['_Request'] = []
+        # Guard on req.expired as well as req.cancelled: in lockstep
+        # mode the cancel only lands via the NEXT tick's broadcast, so
+        # without it an already-flagged request would re-match (and
+        # re-count) every tick until then.
+        for req in (*self._slots, self._deferred, self._admitting,
+                    *self._admitting_many):
+            if req is not None and not req.cancelled and \
+                    not req.expired and \
+                    req.params.deadline is not None and \
+                    now > req.params.deadline:
+                expired.append(req)
+        if now - self._last_deadline_scan >= 0.25:
+            self._last_deadline_scan = now
+            with self._waiting.mutex:
+                for req in self._waiting.queue:
+                    if not req.cancelled and not req.expired and \
+                            req.params.deadline is not None and \
+                            now > req.params.deadline:
+                        expired.append(req)
+        for req in expired:
+            req.expired = True
+            self._m_deadline_expired.inc()
+            if self._lockstep is not None:
+                if self._lockstep.is_primary:
+                    with self._lock:
+                        self._pending_cancels.append(req.req_id)
+            else:
+                req.cancelled = True
+
     def generate(self, tokens: List[int],
                  params: Optional[SamplingParams] = None) -> List[Any]:
         """Blocking convenience: submit + drain. Items mirror the queue
@@ -1696,7 +1753,8 @@ class InferenceEngine:
         for req in cand:
             if req.cancelled:
                 self._trace_event(req.req_id, 'done',
-                                  status='cancelled')
+                                  status='deadline' if req.expired
+                                  else 'cancelled')
                 req.out_queue.put(None)
             else:
                 live.append(req)
@@ -1807,7 +1865,9 @@ class InferenceEngine:
         if req.cancelled:
             # Cancelled while waiting: never occupies a slot. Trace
             # before the None unblocks the waiter.
-            self._trace_event(req.req_id, 'done', status='cancelled')
+            self._trace_event(req.req_id, 'done',
+                              status='deadline' if req.expired
+                              else 'cancelled')
             req.out_queue.put(None)
             return True
         # Visible to cancel() during the admission window (popped from
@@ -2114,7 +2174,8 @@ class InferenceEngine:
             # after its response must see the completed trace.
             self._trace_event(
                 req.req_id, 'done', generated=req.generated,
-                status=status or ('cancelled' if req.cancelled
+                status=status or ('deadline' if req.expired
+                                  else 'cancelled' if req.cancelled
                                   else 'done'))
             req.out_queue.put(None)
         if self._chunked is not None and self._chunked['slot'] == slot:
@@ -2204,6 +2265,14 @@ class InferenceEngine:
                     break
             elif self._stop.is_set():
                 break
+            # Chaos hook (dormant unless SKYT_FAULTS arms it): 'error'
+            # here crashes the loop — the crash handler fails open
+            # requests and /health flips 503; 'latency' makes this a
+            # slow replica.
+            faults.inject('engine.loop')
+            # Deadline enforcement: expired requests cancel in place
+            # (slot + KV pages free at the next delivery boundary).
+            self._expire_deadlines()
             # Admit as many waiting requests as there are free slots.
             # Same-bucket bursts take the batched fast path (one prefill
             # dispatch for the group); everything else falls back to the

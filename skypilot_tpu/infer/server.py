@@ -46,12 +46,15 @@ import argparse
 import asyncio
 import functools
 import json
+import queue as queue_lib
+import time
 from typing import Dict, List, Optional
 
 from aiohttp import web
 
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import tokenizer as tokenizer_lib
+from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing as tracing_lib
@@ -161,6 +164,12 @@ class InferenceServer:
                 # error must not make the checkpoint unservable.
                 logger.warning('chat template failed to compile (%s); '
                                'using the generic format', e)
+        # Client-disconnect accounting: each detected disconnect also
+        # cancelled its engine request(s) (slot + KV pages freed).
+        self._m_disconnects = engine.metrics_registry.counter(
+            'skyt_server_client_disconnects_total',
+            'Requests whose client disconnected mid-flight (engine '
+            'request cancelled)')
         # Multi-LoRA routing (vLLM's OpenAI convention): 'model' in a
         # request names either the base model or a loaded adapter.
         self.lora_names = dict(lora_names or {})
@@ -186,6 +195,55 @@ class InferenceServer:
                            'type': 'invalid_request_error',
                            'code': 'model_not_found'}}, status=404)
         return lid, None
+
+    async def _q_get(self, request: web.Request, out_q,
+                     rids=()) -> object:
+        """Blocking out_queue.get, off the event loop, that aborts the
+        moment the client disconnects: the engine request(s) are
+        cancelled — the slot and its KV pages free at the next delivery
+        boundary — instead of generating into a dead socket. The get is
+        chopped into short slices so disconnects are noticed within
+        ~0.5 s even between token chunks."""
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + 300
+        while True:
+            try:
+                return await loop.run_in_executor(
+                    None, functools.partial(out_q.get, timeout=0.5))
+            except queue_lib.Empty:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    'engine produced nothing for 300s')
+            tr = request.transport
+            if tr is None or tr.is_closing():
+                # The middleware counts the disconnect and re-cancels
+                # (idempotent) — it also sees write-path resets this
+                # poll can't observe.
+                for rid in rids:
+                    self.engine.cancel(rid)
+                raise ConnectionResetError(
+                    'client disconnected mid-request')
+
+    @staticmethod
+    def _deadline_from(request: web.Request):
+        """Per-request deadline (tentpole): `X-Request-Deadline` is a
+        relative budget in seconds; returns (absolute time.time()
+        deadline | None, error response | None). Enforced by the
+        engine's decode loop via SamplingParams.deadline."""
+        hdr = request.headers.get('X-Request-Deadline')
+        if hdr is None:
+            return None, None
+        try:
+            budget = float(hdr)
+            if budget <= 0:
+                raise ValueError
+        except ValueError:
+            return None, web.json_response(
+                {'error': f'X-Request-Deadline must be a positive '
+                          f'number of seconds, got {hdr!r}'},
+                status=400)
+        return time.time() + budget, None
 
     def _engine_state_snapshot(self) -> Dict[str, object]:
         """Engine occupancy at slow-trace capture time (the flight
@@ -324,9 +382,13 @@ class InferenceServer:
             bias = self._parse_logit_bias(payload)
         except ValueError as e:
             return web.json_response({'error': str(e)}, status=400)
+        deadline, dl_err = self._deadline_from(request)
+        if dl_err is not None:
+            return dl_err
         params = engine_lib.SamplingParams(
             lora_id=lora_id,
             logit_bias=bias,
+            deadline=deadline,
             max_new_tokens=int(max_new),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
@@ -344,7 +406,6 @@ class InferenceServer:
         # the engine's phase trace for each id is bridged in as child
         # spans of this request's server span.
         request['skyt_engine_rids'] = [req_id]
-        loop = asyncio.get_running_loop()
 
         if payload.get('stream'):
             resp = web.StreamResponse(
@@ -352,8 +413,7 @@ class InferenceServer:
                          'X-Request-Id': str(req_id)})
             await resp.prepare(request)
             while True:
-                tok = await loop.run_in_executor(
-                    None, functools.partial(out_q.get, timeout=300))
+                tok = await self._q_get(request, out_q, (req_id,))
                 if tok is None:
                     break
                 await resp.write(
@@ -361,7 +421,7 @@ class InferenceServer:
             await resp.write_eof()
             return resp
 
-        out, _lps = await self._drain(out_q)
+        out, _lps = await self._drain(request, out_q, (req_id,))
         visible, _ = self._finish(out, params)
         return web.json_response({
             'request_id': req_id,
@@ -393,11 +453,13 @@ class InferenceServer:
         return out
 
     def _sampling_from_openai(self, payload,
-                              lora_id: int = 0
+                              lora_id: int = 0,
+                              deadline: Optional[float] = None
                               ) -> 'engine_lib.SamplingParams':
         temp = float(payload.get('temperature', 0.0))
         return engine_lib.SamplingParams(
             lora_id=lora_id,
+            deadline=deadline,
             logit_bias=self._parse_logit_bias(payload),
             max_new_tokens=int(payload.get('max_tokens', 128)),
             temperature=temp,
@@ -491,7 +553,7 @@ class InferenceServer:
             return text, False
         return text[:cut], True
 
-    async def _drain_stopping(self, rid, out_q, params,
+    async def _drain_stopping(self, request, rid, out_q, params,
                               stops: List[str]):
         """Drain a request; with stop sequences, cancel the engine
         request as soon as one matches so the slot frees immediately
@@ -502,9 +564,8 @@ class InferenceServer:
         None unless params.logprobs (then a {'tokens': [per-token
         text], 'token_logprobs': [...]} dict — chosen-token raw
         logprobs; top-N alternatives are not computed)."""
-        loop = asyncio.get_running_loop()
         if not stops:
-            out, lps = await self._drain(out_q)
+            out, lps = await self._drain(request, out_q, (rid,))
             visible, reason = self._finish(out, params)
             lp_obj = None
             if lps is not None:
@@ -526,9 +587,7 @@ class InferenceServer:
         async def drain_terminal():
             # Consume through the terminal None so the slot is really
             # done (released) before we return.
-            while await loop.run_in_executor(
-                    None, functools.partial(out_q.get,
-                                            timeout=300)) is not None:
+            while await self._q_get(request, out_q, (rid,)) is not None:
                 pass
 
         decode_incremental = self._incremental_decoder()
@@ -536,8 +595,7 @@ class InferenceServer:
         generated = 0
 
         while True:
-            tok = await loop.run_in_executor(
-                None, functools.partial(out_q.get, timeout=300))
+            tok = await self._q_get(request, out_q, (rid,))
             if tok is None:
                 tail = decode_incremental(None)
                 if tail and scan.feed(tail):
@@ -559,16 +617,15 @@ class InferenceServer:
                 await drain_terminal()
                 return scan.text, 'stop', generated, None
 
-    async def _drain(self, out_q):
+    async def _drain(self, request, out_q, rids=()):
         """-> (tokens, logprobs_or_None); the queue yields bare ints,
-        or (token, logprob) pairs when params.logprobs is set."""
-        loop = asyncio.get_running_loop()
+        or (token, logprob) pairs when params.logprobs is set. Aborts
+        (cancelling `rids` in the engine) if the client disconnects."""
         out: List[int] = []
         lps: List[float] = []
         saw_pairs = False
         while True:
-            item = await loop.run_in_executor(
-                None, functools.partial(out_q.get, timeout=300))
+            item = await self._q_get(request, out_q, rids)
             if item is None:
                 return out, (lps if saw_pairs else None)
             if isinstance(item, tuple):
@@ -608,7 +665,7 @@ class InferenceServer:
         finish_reason (OpenAI protocol), then [DONE]. With stop
         sequences, emission halts at the earliest match (the stop text
         is never sent) and the engine request is cancelled."""
-        loop = asyncio.get_running_loop()
+        rids = (rid,) if rid is not None else ()
         headers = {'Content-Type': 'text/event-stream',
                    'Cache-Control': 'no-cache'}
         if rid is not None:
@@ -640,15 +697,13 @@ class InferenceServer:
                 stopped = True
                 if rid is not None and not ended:
                     self.engine.cancel(rid)
-                    while await loop.run_in_executor(
-                            None, functools.partial(
-                                out_q.get, timeout=300)) is not None:
+                    while await self._q_get(request, out_q,
+                                            rids) is not None:
                         pass
             return matched
 
         while True:
-            tok = await loop.run_in_executor(
-                None, functools.partial(out_q.get, timeout=300))
+            tok = await self._q_get(request, out_q, rids)
             if tok is None:
                 ended = True
                 break
@@ -731,8 +786,12 @@ class InferenceServer:
         lora_id, lora_err = self._resolve_lora(payload)
         if lora_err is not None:
             return lora_err
+        deadline, dl_err = self._deadline_from(request)
+        if dl_err is not None:
+            return dl_err
         try:
-            params = self._sampling_from_openai(payload, lora_id)
+            params = self._sampling_from_openai(payload, lora_id,
+                                                deadline)
         except (TypeError, ValueError) as e:
             return web.json_response({'error': str(e)}, status=400)
         # Echo the requested model (adapter name for multi-LoRA
@@ -793,7 +852,7 @@ class InferenceServer:
         # its engine request immediately (sequential drains would hold
         # later completions' slots until earlier ones finish).
         results = await asyncio.gather(*[
-            self._drain_stopping(rid, out_q, params, stops)
+            self._drain_stopping(request, rid, out_q, params, stops)
             for rid, out_q in subs])
         choices = []
         total_out = 0
@@ -858,8 +917,12 @@ class InferenceServer:
         lora_id, lora_err = self._resolve_lora(payload)
         if lora_err is not None:
             return lora_err
+        deadline, dl_err = self._deadline_from(request)
+        if dl_err is not None:
+            return dl_err
         try:
-            params = self._sampling_from_openai(payload, lora_id)
+            params = self._sampling_from_openai(payload, lora_id,
+                                                deadline)
         except (TypeError, ValueError) as e:
             return web.json_response({'error': str(e)}, status=400)
         # Echo the requested model (adapter name for multi-LoRA
@@ -906,7 +969,7 @@ class InferenceServer:
                                    stops=stops, rid=rid)
 
         results = await asyncio.gather(*[
-            self._drain_stopping(crid, out_q, params, stops)
+            self._drain_stopping(request, crid, out_q, params, stops)
             for crid, out_q in subs])
         choices = []
         total_out = 0
@@ -950,6 +1013,25 @@ class InferenceServer:
             except web.HTTPException as e:
                 m_http.labels(path, str(e.status)).inc()
                 raise
+            except faults.FaultDisconnect:
+                # Injected connection drop: actually sever the socket
+                # so the peer sees a transport failure, not a tidy
+                # HTTP 500 (what a crashing replica looks like).
+                m_http.labels(path, '499').inc()
+                if request.transport is not None:
+                    request.transport.close()
+                raise
+            except ConnectionResetError:
+                # Client went away mid-request — queue-wait polls raise
+                # from _q_get, and writes into a closed transport raise
+                # aiohttp's ClientConnectionResetError (a subclass).
+                # Either way: cancel the engine request(s) so the slot
+                # and KV pages free, and count it (nginx's 499).
+                m_http.labels(path, '499').inc()
+                self._m_disconnects.inc()
+                for rid in request.get('skyt_engine_rids', ()):
+                    self.engine.cancel(rid)
+                raise
             except Exception:
                 # aiohttp turns unhandled handler exceptions into 500s
                 # — the error-rate signal this counter exists for.
@@ -978,6 +1060,14 @@ class InferenceServer:
             if lb_rid:
                 span.set_attribute('lb_request_id', lb_rid)
             with span:
+                # Chaos hook (dormant unless SKYT_FAULTS arms it):
+                # error/latency/hang/disconnect/preempt on the
+                # replica's whole HTTP surface. Inside the span so the
+                # fired fault's `fault.<kind>` event lands on THIS
+                # request's trace (count_requests, outermost, would
+                # run before the span exists); its exception handling
+                # still applies — faults raise through this middleware.
+                await faults.ainject('server.request', path=path)
                 resp = await handler(request)
                 span.set_attribute('http.status', resp.status)
                 if span is not tracing_lib.NOOP_SPAN:

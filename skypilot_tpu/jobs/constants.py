@@ -28,3 +28,7 @@ CONTROLLER_CLUSTER_NAME = 'skyt-jobs-controller'
 # recovery_strategy.py MAX_JOB_CHECKING_RETRY + launch retries).
 MAX_LAUNCH_RETRIES = 3
 LAUNCH_RETRY_BACKOFF_SECONDS = 5.0
+
+# The cooperative-preemption exit code (75) lives in
+# runtime/job_lib.EXIT_CODE_PREEMPTED — the layer that maps exit codes
+# to job statuses; import it from there.

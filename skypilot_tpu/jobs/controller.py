@@ -138,6 +138,22 @@ class JobsController:
                 # Cancelled out-of-band on the cluster; treat as user
                 # cancellation of the whole managed job.
                 raise _Cancelled()
+            if job_status == 'PREEMPTED':
+                # Cooperative preemption (EXIT_CODE_PREEMPTED): the
+                # workload checkpointed at a step boundary and asked to
+                # be rescheduled. Recover — the relaunch resumes from
+                # the checkpoint (resume from step k, not step 0) —
+                # instead of declaring user failure.
+                logger.info('task %d exited PREEMPTED (cooperative '
+                            'checkpoint); recovering', task_index)
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
+                jobs_state.bump_recovery_count(self.job_id)
+                cluster_job_id = strategy.recover()
+                jobs_state.set_status(
+                    self.job_id, jobs_state.ManagedJobStatus.RUNNING)
+                unreachable_since = None
+                continue
             if job_status is not None:
                 unreachable_since = None
                 continue

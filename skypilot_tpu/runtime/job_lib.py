@@ -36,8 +36,19 @@ def log_dir_for_job(job_id: int) -> str:
     return os.path.join(skyt_dir(), 'logs', str(job_id))
 
 
+# Cooperative-preemption exit code (EX_TEMPFAIL): a workload that
+# caught SIGTERM, checkpointed at a step boundary, and wants to be
+# RESCHEDULED exits with this (train/checkpoint.PreemptionGuard). The
+# head agent maps it to JobStatus.PREEMPTED instead of FAILED, and the
+# managed-jobs controller recovers (resume from the checkpoint) rather
+# than declaring user failure.
+EXIT_CODE_PREEMPTED = 75
+
+
 class JobStatus(enum.Enum):
-    """Reference: sky/skylet/job_lib.py:86 (same lifecycle)."""
+    """Reference: sky/skylet/job_lib.py:86 (same lifecycle, plus
+    PREEMPTED for cooperative-preemption exits — see
+    EXIT_CODE_PREEMPTED)."""
     INIT = 'INIT'
     PENDING = 'PENDING'
     SETTING_UP = 'SETTING_UP'
@@ -46,6 +57,7 @@ class JobStatus(enum.Enum):
     FAILED = 'FAILED'
     FAILED_SETUP = 'FAILED_SETUP'
     CANCELLED = 'CANCELLED'
+    PREEMPTED = 'PREEMPTED'
 
     def is_terminal(self) -> bool:
         return self in _TERMINAL
@@ -56,7 +68,7 @@ class JobStatus(enum.Enum):
 
 
 _TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
-             JobStatus.CANCELLED}
+             JobStatus.CANCELLED, JobStatus.PREEMPTED}
 
 _DB_LOCK = threading.RLock()
 _DB: Optional[sqlite3.Connection] = None
@@ -244,7 +256,16 @@ def gang_all_done(job_id: int) -> bool:
 
 
 def gang_any_failed(job_id: int) -> bool:
-    return any(r['status'] == 'DONE' and (r['returncode'] or 0) != 0
+    """True if any rank exited with a REAL failure code — cooperative
+    preemption exits (EXIT_CODE_PREEMPTED) are not failures."""
+    return any(r['status'] == 'DONE' and
+               (r['returncode'] or 0) not in (0, EXIT_CODE_PREEMPTED)
+               for r in gang_records(job_id))
+
+
+def gang_any_preempted(job_id: int) -> bool:
+    return any(r['status'] == 'DONE' and
+               (r['returncode'] or 0) == EXIT_CODE_PREEMPTED
                for r in gang_records(job_id))
 
 

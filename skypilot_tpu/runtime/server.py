@@ -152,11 +152,31 @@ class HeadState:
                 job_lib.set_status(job_id, job_lib.JobStatus.RUNNING)
         elif event == 'done':
             job_lib.gang_mark(job_id, rank, 'DONE', returncode)
-            if (returncode or 0) != 0:
+            rc = returncode or 0
+            if rc == job_lib.EXIT_CODE_PREEMPTED:
+                # Cooperative preemption: the workload checkpointed and
+                # asked to be rescheduled — not a user failure. It WINS
+                # over FAILED regardless of report ordering: when one
+                # rank checkpoints and exits 75, its siblings'
+                # collectives usually abort with real nonzero codes
+                # (often arriving first) — that collateral must not
+                # mask the recovery signal. A genuinely failing job
+                # relaunches and fails again WITHOUT any 75, so it
+                # still lands FAILED on the next attempt.
+                if status not in (job_lib.JobStatus.SUCCEEDED,
+                                  job_lib.JobStatus.CANCELLED):
+                    job_lib.set_status(job_id,
+                                       job_lib.JobStatus.PREEMPTED)
+            elif rc != 0:
                 if not status.is_terminal():
                     job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
             elif job_lib.gang_all_done(job_id):
-                if job_lib.gang_any_failed(job_id):
+                if job_lib.gang_any_preempted(job_id):
+                    if status not in (job_lib.JobStatus.SUCCEEDED,
+                                      job_lib.JobStatus.CANCELLED):
+                        job_lib.set_status(job_id,
+                                           job_lib.JobStatus.PREEMPTED)
+                elif job_lib.gang_any_failed(job_id):
                     if not status.is_terminal():
                         job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
                 elif not status.is_terminal():

@@ -2,22 +2,41 @@
 
 Reference: sky/serve/load_balancer.py (:22 SkyServeLoadBalancer, :58
 _sync_with_controller every LB_CONTROLLER_SYNC_INTERVAL_SECONDS, :116
-_proxy_request_to). Two TPU-serving-driven changes: responses are
+_proxy_request_to). TPU-serving-driven changes: responses are
 **streamed** chunk-by-chunk (the reference's httpx proxy buffers whole
 bodies — SURVEY.md §7 flags that as a TTFT risk for token streaming),
-and the policy hook gets an `on_request_done` callback so
-least-connections works for long-lived inference requests.
+the policy hook gets an `on_request_done` callback so least-connections
+works for long-lived inference requests, and the proxy path is
+**fault-tolerant** (docs/robustness.md):
+
+  * a failed / timed-out upstream attempt is retried on a *different*
+    replica with exponential backoff + jitter, as long as nothing has
+    been sent to the client (the request body is already buffered), and
+    bounded by a per-request deadline budget (`X-Request-Deadline`
+    header, else SKYT_LB_RETRY_BUDGET_S);
+  * a per-replica circuit breaker ejects a dying replica after
+    SKYT_LB_BREAKER_THRESHOLD consecutive transport failures — ahead
+    of the ~2 s controller sync — and lets a half-open probe through
+    every SKYT_LB_BREAKER_COOLDOWN_S;
+  * upstream connect/total timeouts are env-configurable
+    (SKYT_LB_UPSTREAM_CONNECT_S / SKYT_LB_UPSTREAM_TOTAL_S).
+
+Breaker and retry activity is visible in GET /metrics
+(skyt_lb_breaker_state, skyt_lb_retries_total, ...) and on the
+`lb.proxy` span attributes at /debug/traces.
 """
 import asyncio
 import os
+import random
 import time
 import uuid
-from typing import List, Optional
+from typing import Dict, List, Optional, Set, Union
 
 import aiohttp
 from aiohttp import web
 
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing as tracing_lib
@@ -28,9 +47,169 @@ _HOP_HEADERS = {'transfer-encoding', 'connection', 'keep-alive',
                 'proxy-authenticate', 'proxy-authorization', 'te',
                 'trailers', 'upgrade', 'content-length', 'host'}
 
+# Exceptions that mean "the upstream attempt failed at transport level"
+# — retryable on another replica when nothing reached the client.
+# FaultDisconnect is a ConnectionResetError; injected 'error' faults at
+# lb.proxy are included so chaos specs exercise the same path.
+_UPSTREAM_FAILURES = (aiohttp.ClientError, ConnectionError,
+                      asyncio.TimeoutError, faults.FaultError)
+
+
+class _ClientGone(Exception):
+    """The LB's OWN client vanished mid-proxy. Kept distinct from the
+    upstream failure set: a client hanging up must never read as a
+    REPLICA failure (breaker poison) or trigger a retry that generates
+    the response again for a dead socket."""
+
+
+async def _to_client(coro) -> None:
+    """Await a write toward the LB's client, converting its transport
+    failures into _ClientGone. aiohttp's write-path errors
+    (ClientConnectionResetError) inherit from BOTH ClientError and
+    ConnectionResetError, so without this conversion they are
+    indistinguishable from upstream failures by type."""
+    try:
+        await coro
+    except (ConnectionResetError, ConnectionError, RuntimeError) as e:
+        raise _ClientGone(repr(e)) from e
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
 
 def _sync_interval() -> float:
-    return float(os.environ.get('SKYT_SERVE_LB_SYNC_INTERVAL', '2'))
+    return _env_float('SKYT_SERVE_LB_SYNC_INTERVAL', 2.0)
+
+
+class CircuitBreaker:
+    """Per-replica consecutive-failure circuit breaker.
+
+    closed --(threshold consecutive transport failures)--> open
+    open   --(cooldown elapsed)--> half-open: ONE trial request per
+             cooldown window is let through
+    half-open --success--> closed;  --failure--> open (window resets)
+
+    Success = the replica produced an HTTP response (any status: an
+    application 5xx is an *answer*; the breaker tracks transport
+    health). Thread-safe; replica state is dropped via forget() when
+    the replica leaves the ready set so long-lived LBs don't accumulate
+    dead entries.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half_open'
+    # Gauge encoding (docs/robustness.md): 0 closed, 1 half-open
+    # (trial in flight), 2 open.
+    _GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, threshold: int, cooldown_s: float,
+                 registry: 'metrics_lib.MetricsRegistry') -> None:
+        import threading
+        self.threshold = max(1, threshold)
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        # replica -> {fails, open, opened_at, last_trial, trial_inflight}
+        self._state: Dict[str, dict] = {}
+        self._m_state = registry.gauge(
+            'skyt_lb_breaker_state',
+            'Circuit breaker per replica (0 closed, 1 half-open, '
+            '2 open)', ('replica',))
+        self._m_opened = registry.counter(
+            'skyt_lb_breaker_opens_total',
+            'closed->open breaker transitions', ('replica',))
+
+    def _entry(self, replica: str) -> dict:
+        return self._state.setdefault(
+            replica, {'fails': 0, 'open': False, 'opened_at': 0.0,
+                      'last_trial': 0.0, 'trial_inflight': False})
+
+    def blocked(self, replica: str) -> bool:
+        """Read-only eligibility check (no state change): True when a
+        request to `replica` would be denied right now. Used to build
+        the selection exclude-set WITHOUT burning the half-open trial
+        on replicas the policy then doesn't pick."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._state.get(replica)
+            if st is None or not st['open']:
+                return False
+            if now - st['opened_at'] < self.cooldown_s:
+                return True
+            return st['last_trial'] > 0 and \
+                now - st['last_trial'] < self.cooldown_s
+
+    def allow(self, replica: str) -> bool:
+        """May a request be sent to `replica` now? In the open state
+        this grants at most one half-open trial per cooldown window —
+        call it only for the replica actually about to be used (the
+        trial claim is a side effect); use blocked() for read-only
+        candidate filtering."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._entry(replica)
+            if not st['open']:
+                return True
+            if now - st['opened_at'] < self.cooldown_s:
+                return False
+            if now - st['last_trial'] < self.cooldown_s and \
+                    st['last_trial'] > 0:
+                return False
+            st['last_trial'] = now
+            st['trial_inflight'] = True
+            self._m_state.labels(replica).set(
+                self._GAUGE[self.HALF_OPEN])
+            return True
+
+    def record_success(self, replica: str) -> None:
+        with self._lock:
+            st = self._entry(replica)
+            st.update(fails=0, open=False, trial_inflight=False,
+                      last_trial=0.0)
+            self._m_state.labels(replica).set(self._GAUGE[self.CLOSED])
+
+    def record_failure(self, replica: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._entry(replica)
+            st['fails'] += 1
+            st['trial_inflight'] = False
+            if st['open']:
+                # Failed half-open trial: restart the open window.
+                st['opened_at'] = now
+                self._m_state.labels(replica).set(self._GAUGE[self.OPEN])
+            elif st['fails'] >= self.threshold:
+                st['open'] = True
+                st['opened_at'] = now
+                st['last_trial'] = 0.0
+                self._m_opened.labels(replica).inc()
+                self._m_state.labels(replica).set(self._GAUGE[self.OPEN])
+                logger.warning(
+                    'circuit breaker OPEN for %s after %d consecutive '
+                    'failures', replica, st['fails'])
+
+    def state(self, replica: str) -> str:
+        with self._lock:
+            st = self._state.get(replica)
+            if st is None or not st['open']:
+                return self.CLOSED
+            return self.HALF_OPEN if st['trial_inflight'] else self.OPEN
+
+    def forget(self, replica: str) -> None:
+        with self._lock:
+            self._state.pop(replica, None)
+            self._m_state.remove_labels(replica)
+
+    def prune(self, keep) -> None:
+        """Drop state for every replica not in `keep` — candidate
+        checks create entries for all ready replicas, so pruning must
+        key on the ready set, not on which replicas saw traffic."""
+        with self._lock:
+            for replica in [r for r in self._state if r not in keep]:
+                self._state.pop(replica, None)
+                self._m_state.remove_labels(replica)
 
 
 class SkyServeLoadBalancer:
@@ -45,6 +224,7 @@ class SkyServeLoadBalancer:
         self.controller_url = controller_url
         self.port = port
         reg = metrics_registry or metrics_lib.REGISTRY
+        self._registry = reg
         # Tracing plane: one root span per proxied request, with the
         # trace context injected toward the replica (W3C traceparent)
         # so the replica's server/engine spans share the trace id.
@@ -58,9 +238,25 @@ class SkyServeLoadBalancer:
             'skyt_lb_errors_total',
             'Proxy failures (replica="none" = no ready replica)',
             ('replica',))
+        self._m_retries = reg.counter(
+            'skyt_lb_retries_total',
+            'Upstream attempts retried on another replica after a '
+            'transport failure on this one', ('replica',))
         self._m_inflight = reg.gauge(
             'skyt_lb_inflight_requests',
             'Requests currently being proxied', ('replica',))
+        self._m_sync_dropped = reg.counter(
+            'skyt_lb_sync_dropped_timestamps_total',
+            'Request timestamps dropped because the controller-sync '
+            'buffer hit its cap (controller unreachable)')
+        self._m_client_disconnects = reg.counter(
+            'skyt_lb_client_disconnects_total',
+            'Requests whose client disconnected mid-proxy (not '
+            'counted as replica failures)')
+        self.breaker = CircuitBreaker(
+            threshold=int(_env_float('SKYT_LB_BREAKER_THRESHOLD', 3)),
+            cooldown_s=_env_float('SKYT_LB_BREAKER_COOLDOWN_S', 2.0),
+            registry=reg)
         # Bearer token for the controller's authenticated admin API.
         self._controller_headers = (
             {'Authorization': f'Bearer {controller_auth}'}
@@ -70,6 +266,18 @@ class SkyServeLoadBalancer:
         self.request_timestamps: List[float] = []
         self._session: Optional[aiohttp.ClientSession] = None
         self._sync_task: Optional[asyncio.Task] = None
+
+    # --------------------------------------------------- controller sync
+    def _cap_timestamps(self) -> None:
+        """Bound the unsent-timestamp buffer (satellite): with the
+        controller unreachable the old code re-queued forever and the
+        buffer grew without bound. Drop OLDEST beyond the cap — recent
+        timestamps drive autoscaling decisions — and count drops."""
+        cap = int(_env_float('SKYT_LB_MAX_PENDING_TIMESTAMPS', 16384))
+        over = len(self.request_timestamps) - max(cap, 1)
+        if over > 0:
+            del self.request_timestamps[:over]
+            self._m_sync_dropped.inc(over)
 
     async def _sync_with_controller(self) -> None:
         """Reference: :58 — report request timestamps, fetch ready
@@ -91,6 +299,7 @@ class SkyServeLoadBalancer:
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning('controller sync failed: %s', e)
                 self.request_timestamps = ts + self.request_timestamps
+                self._cap_timestamps()
             await asyncio.sleep(_sync_interval())
 
     def _prune_replica_metrics(self, ready) -> None:
@@ -100,9 +309,10 @@ class SkyServeLoadBalancer:
         long-lived LB daemon accumulates dead-replica series without
         bound. The inflight gauge is only pruned at zero (a request
         still draining to a retired replica must dec its own child,
-        not a recreated one)."""
+        not a recreated one). Breaker state goes with the replica."""
         keep = set(ready) | {'none'}
-        for metric in (self._m_requests, self._m_errors):
+        for metric in (self._m_requests, self._m_errors,
+                       self._m_retries):
             for key in metric.label_keys():
                 if key[0] not in keep:
                     metric.remove_labels(*key)
@@ -110,74 +320,222 @@ class SkyServeLoadBalancer:
             if key[0] not in keep and \
                     self._m_inflight.value(*key) == 0:
                 self._m_inflight.remove_labels(*key)
+        self.breaker.prune(keep)
+
+    # ------------------------------------------------------- proxy path
+    def _request_deadline(self, request: web.Request) -> float:
+        """Absolute monotonic deadline for this request's pick+retry
+        budget: the client's X-Request-Deadline (seconds) when present
+        and well-formed, else SKYT_LB_RETRY_BUDGET_S (default 60)."""
+        budget = _env_float('SKYT_LB_RETRY_BUDGET_S', 60.0)
+        hdr = request.headers.get('X-Request-Deadline')
+        if hdr:
+            try:
+                budget = min(budget, float(hdr))
+            except ValueError:
+                pass  # replica-side parsing 400s on malformed values
+        return time.monotonic() + max(budget, 0.0)
+
+    def _pick_replica_once(self, tried: Set[str]) -> Optional[str]:
+        """One selection honoring the breaker, preferring replicas this
+        request has not failed on yet; falls back to tried ones (with
+        backoff upstream) before giving up. Breaker filtering uses the
+        read-only blocked() check; the side-effecting allow() — which
+        claims the one half-open trial — runs only on the replica
+        actually picked. None => nothing eligible right now."""
+        ready = list(self.policy.ready_replicas)
+        denied = {r for r in ready if self.breaker.blocked(r)}
+        while True:
+            replica = self.policy.select_replica(exclude=tried | denied)
+            if replica is None and tried:
+                replica = self.policy.select_replica(exclude=denied)
+            if replica is None:
+                return None
+            if self.breaker.allow(replica):
+                return replica
+            # Lost the half-open-trial race to a concurrent request:
+            # undo the policy's inflight accounting for the unused
+            # pick (least-connections would otherwise skew forever)
+            # and try the remaining candidates instead of giving up —
+            # a healthy replica must still be reachable.
+            self.policy.on_request_done(replica)
+            denied.add(replica)
+
+    async def _wait_for_replica(self, request: web.Request,
+                                tried: Set[str],
+                                deadline: float) -> Optional[str]:
+        """Poll for an eligible replica until `deadline`, aborting the
+        moment the client disconnects (satellite: the old code held the
+        slot for the full 30 s no-replica window). Poll interval is
+        env-tunable (SKYT_LB_NO_REPLICA_POLL_S).
+
+        Fail-fast rule: when replicas ARE ready but every one of them
+        is breaker-blocked, return None immediately — holding the
+        client connection (and its buffered body) while the breaker
+        cools down would turn one dead replica into minute-long client
+        hangs. Polling is only for the genuinely-empty ready set (a
+        service still starting up)."""
+        poll = max(_env_float('SKYT_LB_NO_REPLICA_POLL_S', 1.0), 0.01)
+        while True:
+            replica = self._pick_replica_once(tried)
+            if replica is not None:
+                return replica
+            if self.policy.ready_replicas:
+                return None     # all breaker-blocked: fail fast
+            now = time.monotonic()
+            if now >= deadline:
+                return None
+            tr = request.transport
+            if tr is None or tr.is_closing():
+                raise ConnectionResetError(
+                    'client disconnected while waiting for a replica')
+            await asyncio.sleep(min(poll, deadline - now))
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
-        """Reference: :116 _proxy_request_to — with retry-on-no-replica
-        and streaming. Every request gets a root span (pick-replica +
-        proxy children) and an `X-Request-Id` — the client's own if it
-        sent one, minted here otherwise — propagated to the replica and
-        echoed on the response alongside `X-Replica-Id`, so client-side
-        correlation works even with tracing sampled out."""
+        """Reference: :116 _proxy_request_to — with streaming, retries,
+        and the circuit breaker. Every request gets a root span
+        (pick-replica + per-attempt proxy children) and an
+        `X-Request-Id` — the client's own if it sent one, minted here
+        otherwise — propagated to the replica and echoed on the
+        response alongside `X-Replica-Id`, so client-side correlation
+        works even with tracing sampled out."""
         self.request_timestamps.append(time.time())
+        self._cap_timestamps()
         body = await request.read()
         req_id = request.headers.get('X-Request-Id') or \
             uuid.uuid4().hex[:16]
         # Honor an upstream client's traceparent (their tracer keeps
         # working through ours); otherwise this span roots the trace.
         ctx = self._tracer.extract(request.headers)
+        deadline = self._request_deadline(request)
+        # The no-replica wait is additionally bounded by its own
+        # (env-tunable) timeout so a replica-less service answers 503
+        # in bounded time even under a generous retry budget.
+        no_replica_deadline = min(
+            deadline, time.monotonic() +
+            _env_float('SKYT_LB_NO_REPLICA_TIMEOUT_S', 30.0))
+        backoff = max(_env_float('SKYT_LB_RETRY_BACKOFF_S', 0.05), 0.001)
+        tried: Set[str] = set()
+        attempt = 0
+        last_err: Optional[BaseException] = None
         with self._tracer.start_span(
                 'lb.request', parent=ctx,
                 attributes={'http.method': request.method,
                             'http.path': str(request.rel_url),
                             'request_id': req_id}) as span:
-            with self._tracer.start_span('lb.pick_replica') as pick:
-                deadline = time.time() + 30
-                while True:
-                    replica = self.policy.select_replica()
-                    if replica is not None:
-                        break
-                    if time.time() > deadline:
+            while True:
+                with self._tracer.start_span('lb.pick_replica') as pick:
+                    try:
+                        replica = await self._wait_for_replica(
+                            request, tried,
+                            no_replica_deadline if attempt == 0
+                            else deadline)
+                    except ConnectionResetError:
+                        pick.set_attribute('error', 'client gone')
+                        span.set_attribute('http.status', 499)
+                        raise
+                    if replica is None:
+                        if last_err is not None:
+                            # This request already failed somewhere and
+                            # everything left is breaker-blocked: 502
+                            # with the real error beats a generic 503.
+                            pick.set_attribute('error',
+                                               'all replicas blocked')
+                            span.set_attribute('http.status', 502)
+                            span.set_attribute('retries', attempt - 1)
+                            return web.Response(
+                                status=502,
+                                headers={'X-Request-Id': req_id},
+                                text=f'All replicas failing (circuit '
+                                     f'open) after {attempt} '
+                                     f'attempt(s): {last_err}')
                         self._m_errors.labels('none').inc()
                         pick.set_attribute('error', 'no ready replica')
                         span.set_attribute('http.status', 503)
                         return web.Response(
                             status=503,
                             headers={'X-Request-Id': req_id},
-                            text='No ready replicas. Use "skyt serve '
-                                 'status" to check the service.')
-                    await asyncio.sleep(1)
-                pick.set_attribute('replica', replica)
-            span.set_attribute('replica', replica)
-            self._m_requests.labels(replica).inc()
-            self._m_inflight.labels(replica).inc()
-            try:
-                resp = await self._proxy_to(request, replica, body,
-                                            req_id)
-                span.set_attribute('http.status', resp.status)
-                return resp
-            finally:
-                self._m_inflight.labels(replica).dec()
-                self.policy.on_request_done(replica)
+                            text='No available replicas (none ready, '
+                                 'or every replica is circuit-open). '
+                                 'Use "skyt serve status" to check '
+                                 'the service.')
+                    pick.set_attribute('replica', replica)
+                span.set_attribute('replica', replica)
+                self._m_requests.labels(replica).inc()
+                self._m_inflight.labels(replica).inc()
+                try:
+                    result = await self._proxy_to(
+                        request, replica, body, req_id, attempt)
+                finally:
+                    self._m_inflight.labels(replica).dec()
+                    self.policy.on_request_done(replica)
+                if isinstance(result, web.StreamResponse):
+                    span.set_attribute('http.status', result.status)
+                    if attempt:
+                        span.set_attribute('retries', attempt)
+                    return result
+                # Transport-level failure with nothing sent to the
+                # client: eligible for a retry on another replica.
+                last_err = result
+                tried.add(replica)
+                attempt += 1
+                delay = min(backoff * (2 ** (attempt - 1)), 2.0)
+                delay *= 0.5 + random.random() * 0.5   # jitter
+                if time.monotonic() + delay >= deadline:
+                    span.set_attribute('http.status', 502)
+                    span.set_attribute('retries', attempt - 1)
+                    span.set_attribute('error', repr(last_err))
+                    return web.Response(
+                        status=502,
+                        headers={'X-Request-Id': req_id,
+                                 'X-Replica-Id': replica},
+                        text=f'Replica {replica} failed after '
+                             f'{attempt} attempt(s): {last_err}')
+                self._m_retries.labels(replica).inc()
+                span.add_event('retry', attempt=attempt,
+                               failed_replica=replica,
+                               delay_ms=round(delay * 1e3, 1))
+                await asyncio.sleep(delay)
 
-    async def _proxy_to(self, request: web.Request, replica: str,
-                        body: bytes,
-                        req_id: str) -> web.StreamResponse:
+    def _upstream_timeout(self) -> aiohttp.ClientTimeout:
+        """Connect/total upstream timeouts (satellite: total used to be
+        hardwired to None). total=0 keeps 'unlimited' — correct for
+        long token streams; deployments that want a hard cap set
+        SKYT_LB_UPSTREAM_TOTAL_S."""
+        total = _env_float('SKYT_LB_UPSTREAM_TOTAL_S', 0.0)
+        return aiohttp.ClientTimeout(
+            total=total if total > 0 else None,
+            sock_connect=_env_float('SKYT_LB_UPSTREAM_CONNECT_S', 10.0))
+
+    async def _proxy_to(
+            self, request: web.Request, replica: str, body: bytes,
+            req_id: str, attempt: int
+    ) -> Union[web.StreamResponse, BaseException]:
+        """One upstream attempt. Returns the client-facing response on
+        success OR after headers went out (no longer retryable — a
+        mid-stream failure terminates the truncated stream instead of
+        corrupting the chunked framing); returns the exception when the
+        attempt failed before anything reached the client (the caller
+        retries on another replica)."""
         assert self._session is not None
         url = replica + str(request.rel_url)
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
         headers['X-Request-Id'] = req_id
         with self._tracer.start_span(
-                'lb.proxy', attributes={'replica': replica}) as span:
+                'lb.proxy',
+                attributes={'replica': replica, 'attempt': attempt,
+                            'breaker': self.breaker.state(replica)}
+        ) as span:
             # The proxy span's context rides the traceparent header to
             # the replica: its server span parents under this one.
             self._tracer.inject(headers, span)
             response: Optional[web.StreamResponse] = None
             try:
+                await faults.ainject('lb.proxy', replica=replica)
                 async with self._session.request(
                         request.method, url, headers=headers, data=body,
-                        timeout=aiohttp.ClientTimeout(total=None,
-                                                      sock_connect=10),
+                        timeout=self._upstream_timeout(),
                         allow_redirects=False) as upstream:
                     out_headers = {
                         k: v for k, v in upstream.headers.items()
@@ -192,7 +550,7 @@ class SkyServeLoadBalancer:
                     span.set_attribute('http.status', upstream.status)
                     response = web.StreamResponse(
                         status=upstream.status, headers=out_headers)
-                    await response.prepare(request)
+                    await _to_client(response.prepare(request))
                     # Stream: first chunk reaches the client as soon as
                     # the replica emits it (TTFT), not when the body
                     # completes.
@@ -201,13 +559,32 @@ class SkyServeLoadBalancer:
                         if first_chunk:
                             span.add_event('first_chunk')
                             first_chunk = False
-                        await response.write(chunk)
-                    await response.write_eof()
+                        await _to_client(response.write(chunk))
+                    await _to_client(response.write_eof())
+                    self.breaker.record_success(replica)
                     return response
-            except aiohttp.ClientError as e:
+            except _ClientGone as e:
+                # Our OWN client vanished: the replica did nothing
+                # wrong — no breaker failure, no error metric, no
+                # retry. Exiting the async-with aborts the upstream
+                # transfer; the replica's own disconnect detection
+                # then cancels its engine request.
+                logger.info('client disconnected during proxy to %s: '
+                            '%s', replica, e)
+                self._m_client_disconnects.inc()
+                span.set_attribute('client_disconnected', True)
+                span.set_attribute('http.status', 499)
+                if response is not None and response.prepared:
+                    return response
+                return web.Response(status=499,
+                                    reason='Client Closed Request')
+            except _UPSTREAM_FAILURES as e:
                 logger.warning('proxy to %s failed: %s', replica, e)
                 self._m_errors.labels(replica).inc()
+                self.breaker.record_failure(replica)
                 span.set_attribute('error', repr(e))
+                span.set_attribute('breaker',
+                                   self.breaker.state(replica))
                 if response is not None and response.prepared:
                     # Headers (and possibly body chunks) already went
                     # out: a second Response on the same exchange would
@@ -220,11 +597,7 @@ class SkyServeLoadBalancer:
                             RuntimeError):
                         pass
                     return response
-                return web.Response(
-                    status=502,
-                    headers={'X-Request-Id': req_id,
-                             'X-Replica-Id': replica},
-                    text=f'Replica {replica} failed: {e}')
+                return e
 
     async def _on_startup(self, app: web.Application) -> None:
         del app
@@ -247,12 +620,24 @@ class SkyServeLoadBalancer:
             self._tracer, request.query)
         return web.json_response(payload, status=status)
 
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """LB-local metrics (per-replica traffic, retries, breaker
+        state, dropped sync timestamps). Like /debug/traces, this path
+        is answered by the LB itself — scrape a replica's /metrics on
+        the replica's own endpoint."""
+        del request
+        return web.Response(
+            body=self._registry.expose().encode('utf-8'),
+            headers={'Content-Type': metrics_lib.CONTENT_TYPE})
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
-        # Registered before the catch-all: /debug/traces is answered
-        # by the LB itself, not proxied (each hop serves its own store).
+        # Registered before the catch-all: /debug/traces and /metrics
+        # are answered by the LB itself, not proxied (each hop serves
+        # its own stores).
         app.router.add_get('/debug/traces', self._debug_traces)
+        app.router.add_get('/metrics', self._metrics)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app

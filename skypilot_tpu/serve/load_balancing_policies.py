@@ -8,7 +8,7 @@ busy replicas.
 """
 import random
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 
 class LoadBalancingPolicy:
@@ -19,7 +19,11 @@ class LoadBalancingPolicy:
     def set_ready_replicas(self, replicas: List[str]) -> None:
         raise NotImplementedError
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        """Pick a replica, skipping `exclude` (the LB passes replicas
+        this request already failed on plus breaker-ejected ones)."""
         raise NotImplementedError
 
     def on_request_done(self, replica: str) -> None:
@@ -42,14 +46,19 @@ class RoundRobinPolicy(LoadBalancingPolicy):
                 self.ready_replicas = replicas
                 self._index = 0
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
         with self._lock:
             if not self.ready_replicas:
                 return None
-            replica = self.ready_replicas[self._index %
-                                          len(self.ready_replicas)]
-            self._index += 1
-            return replica
+            n = len(self.ready_replicas)
+            for _ in range(n):
+                replica = self.ready_replicas[self._index % n]
+                self._index += 1
+                if not exclude or replica not in exclude:
+                    return replica
+            return None
 
 
 class LeastConnectionsPolicy(LoadBalancingPolicy):
@@ -65,11 +74,15 @@ class LeastConnectionsPolicy(LoadBalancingPolicy):
             self._inflight = {r: self._inflight.get(r, 0)
                               for r in replicas}
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
         with self._lock:
-            if not self.ready_replicas:
+            cands = [r for r in self.ready_replicas
+                     if not exclude or r not in exclude]
+            if not cands:
                 return None
-            replica = min(self.ready_replicas,
+            replica = min(cands,
                           key=lambda r: self._inflight.get(r, 0))
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
             return replica

@@ -13,6 +13,7 @@ preemption detection leans on the provider query (a preempted TPU
 queued-resource is *deleted*, so a missing cluster record == preempted).
 """
 import dataclasses
+import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -23,6 +24,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import state as cluster_state
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 
@@ -33,6 +35,19 @@ logger = log_utils.init_logger(__name__)
 NOT_READY_THRESHOLD = 3
 # Consecutive failures while NOT_READY before giving up -> FAILED.
 FAILED_THRESHOLD = 10
+
+
+def _drain_grace_seconds() -> float:
+    """Grace period a deliberately retired READY replica gets between
+    leaving the ready set (the LB stops routing to it at the next
+    sync) and the actual teardown, so in-flight requests finish."""
+    return float(os.environ.get('SKYT_SERVE_DRAIN_GRACE_S', '10'))
+
+
+def _relaunch_backoff_bounds() -> 'tuple[float, float]':
+    return (float(os.environ.get('SKYT_SERVE_RELAUNCH_BACKOFF_S', '5')),
+            float(os.environ.get('SKYT_SERVE_RELAUNCH_BACKOFF_MAX_S',
+                                 '120')))
 
 
 @dataclasses.dataclass
@@ -87,6 +102,16 @@ class ReplicaManager:
         self._m_replicas = reg.gauge(
             'skyt_serve_replicas', 'Replicas by lifecycle status',
             ('service', 'status'))
+        self._m_drains = reg.counter(
+            'skyt_serve_replica_drains_total',
+            'READY replicas retired through the drain grace period',
+            ('service',))
+        # Relaunch backoff: repeated replica failures (probe-failure ->
+        # FAILED -> reconcile relaunch) back off exponentially instead
+        # of tight-looping launches against a broken image/config; any
+        # replica reaching READY resets it.
+        self._relaunch_backoff = 0.0
+        self._next_launch_ok = 0.0
         self._probe_passes = -1
         # replica_id -> probe pass of the last /stats ATTEMPT: the
         # throttle must key on attempts, not on stats being None —
@@ -210,6 +235,19 @@ class ReplicaManager:
             info.status = serve_state.ReplicaStatus.FAILED
             info.failure_reason = str(e)
             self._save(info)
+            self._note_replica_failed()
+
+    def _note_replica_failed(self) -> None:
+        """Gate the next reconcile launch behind an exponential backoff
+        (reset when any replica reaches READY): without it a replica
+        that fails fast — bad image, bad checkpoint path — relaunches
+        in a tight provision/fail loop."""
+        base, cap = _relaunch_backoff_bounds()
+        self._relaunch_backoff = min(
+            max(self._relaunch_backoff * 2, base), cap)
+        self._next_launch_ok = time.time() + self._relaunch_backoff
+        logger.info('replica failure: relaunches gated for %.1fs',
+                    self._relaunch_backoff)
 
     def _replica_port(self, task) -> int:
         """Replica serving port: first task resources port, else (local
@@ -224,21 +262,38 @@ class ReplicaManager:
             return s.getsockname()[1]
 
     # ---------------------------------------------------------- teardown
-    def terminate_replica(self, rid: int, sync: bool = False) -> None:
+    def terminate_replica(self, rid: int, sync: bool = False,
+                          drain: bool = False) -> None:
+        """drain=True (deliberate retirement of a serving replica:
+        scale-down, rolling update): the replica leaves the ready set
+        NOW — the LB stops routing to it at its next controller sync —
+        but teardown waits SKYT_SERVE_DRAIN_GRACE_S so in-flight
+        requests finish instead of dying mid-stream. Failed/preempted
+        replicas skip the grace (nothing useful is in flight)."""
         with self._lock:
             info = self.replicas.get(rid)
             if info is None:
                 return
+            drain = drain and \
+                info.status is serve_state.ReplicaStatus.READY
             info.status = serve_state.ReplicaStatus.SHUTTING_DOWN
             self._save(info)
+        if drain:
+            self._m_drains.labels(self.service_name).inc()
         th = threading.Thread(target=self._terminate_thread,
-                              args=(info,), daemon=True)
+                              args=(info, drain), daemon=True)
         th.start()
         if sync:
             th.join(timeout=60)
 
-    def _terminate_thread(self, info: ReplicaInfo) -> None:
+    def _terminate_thread(self, info: ReplicaInfo,
+                          drain: bool = False) -> None:
         from skypilot_tpu import core
+        if drain:
+            grace = _drain_grace_seconds()
+            logger.info('replica %d draining for %.1fs before teardown',
+                        info.replica_id, grace)
+            time.sleep(grace)
         try:
             core.down(info.cluster_name, purge=True)
         except exceptions.ClusterDoesNotExist:
@@ -268,6 +323,12 @@ class ReplicaManager:
     # ------------------------------------------------------------- probe
     def _probe_one(self, info: ReplicaInfo) -> bool:
         url = info.endpoint + self.spec.readiness_path
+        try:
+            # Chaos hook: an injected error here is a failed probe
+            # (drives NOT_READY/FAILED transitions deterministically).
+            faults.inject('serve.probe', replica=info.replica_id)
+        except faults.FaultError:
+            return False
         try:
             if self.spec.post_data is not None:
                 resp = requests.post(
@@ -336,6 +397,10 @@ class ReplicaManager:
                 if info.first_ready_at is None:
                     info.first_ready_at = time.time()
                 info.consecutive_failures = 0
+                # A healthy replica proves the config launches: clear
+                # the relaunch backoff gate.
+                self._relaunch_backoff = 0.0
+                self._next_launch_ok = 0.0
                 if info.status is not serve_state.ReplicaStatus.READY:
                     logger.info('replica %d READY', info.replica_id)
                 info.status = serve_state.ReplicaStatus.READY
@@ -361,11 +426,13 @@ class ReplicaManager:
                         f'{self.spec.initial_delay_seconds}')
                     self._save(info)
                     self.terminate_replica(info.replica_id)
+                    self._note_replica_failed()
             elif info.consecutive_failures >= FAILED_THRESHOLD:
                 info.status = serve_state.ReplicaStatus.FAILED
                 info.failure_reason = 'readiness probe kept failing'
                 self._save(info)
                 self.terminate_replica(info.replica_id)
+                self._note_replica_failed()
             elif info.consecutive_failures >= NOT_READY_THRESHOLD:
                 info.status = serve_state.ReplicaStatus.NOT_READY
                 self._save(info)
@@ -386,11 +453,15 @@ class ReplicaManager:
             # Rolling update: bring up new-version replicas to `target`,
             # and keep enough old replicas alive that READY(new) + old
             # never drops below target — retire only the surplus.
+            # Repeated-failure backoff gate: skip this pass's launches
+            # (reconcile runs again shortly) instead of relaunching a
+            # failing config in a tight loop.
+            may_launch = time.time() >= self._next_launch_ok
             if old_version:
                 new_ready = sum(
                     1 for r in cur_version
                     if r.status is serve_state.ReplicaStatus.READY)
-                if len(cur_version) < target:
+                if len(cur_version) < target and may_launch:
                     for _ in range(target - len(cur_version)):
                         self.launch_replica()
                 n_keep_old = max(0, target - new_ready)
@@ -400,11 +471,12 @@ class ReplicaManager:
                     key=lambda r: r.status is not
                     serve_state.ReplicaStatus.READY)
                 for info in old_version[n_keep_old:]:
-                    self.terminate_replica(info.replica_id)
+                    # Rolling-update retirement is deliberate: drain.
+                    self.terminate_replica(info.replica_id, drain=True)
                 return
 
             n_alive = len(cur_version)
-            if n_alive < target:
+            if n_alive < target and may_launch:
                 # ondemand base first, spot for overflow (fallback
                 # autoscaler semantics).
                 n_ondemand = sum(1 for r in cur_version if not r.use_spot)
@@ -422,7 +494,8 @@ class ReplicaManager:
                                    serve_state.ReplicaStatus.READY,
                                    -r.replica_id))
                 for info in order[:len(cur_version) - target]:
-                    self.terminate_replica(info.replica_id)
+                    # Scale-down retirement is deliberate: drain.
+                    self.terminate_replica(info.replica_id, drain=True)
 
     def update_version(self, spec: 'spec_lib.ServiceSpec',
                        task_yaml: str, version: int) -> None:

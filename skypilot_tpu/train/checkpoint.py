@@ -8,12 +8,97 @@ retention, and exact-step resume — the half of preemption recovery the
 managed-jobs controller (jobs/controller.py) relies on.
 """
 import os
+import signal
+import threading
 from typing import Any, Optional
 
 import jax
+from skypilot_tpu.runtime.job_lib import EXIT_CODE_PREEMPTED
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
+
+
+class PreemptionGuard:
+    """Preemption-safe exit for training loops (docs/robustness.md).
+
+    Spot/TPU preemption arrives as SIGTERM with a short grace window;
+    operators and the chaos harness use SIGINT/SIGTERM the same way.
+    The handler only sets a flag — the step loop checks `requested` at
+    each step boundary, saves a final checkpoint, waits for the async
+    write, and exits with EXIT_CODE_PREEMPTED so the managed-jobs
+    controller recovers the job (resume from step k) instead of
+    declaring user failure.
+
+        guard = PreemptionGuard()
+        for step in ...:
+            state = step_fn(state, batch)
+            if guard.requested:
+                ckpt.save(step + 1, state, force=True)
+                ckpt.wait()
+                raise SystemExit(EXIT_CODE_PREEMPTED)
+
+    `immediate=True` covers the startup phase (weight streaming, first
+    jit compile — minutes during which no step boundary ever arrives):
+    the handler raises SystemExit(EXIT_CODE) on the spot, since nothing
+    is mid-write yet and the relaunch redoes the load anyway — far
+    better than burning the whole preemption grace window loading and
+    then dying to SIGKILL as FAILED. Call cooperative() when the step
+    loop begins so checkpoint writes are never interrupted.
+
+    Installing from a non-main thread is a no-op (signal.signal would
+    raise); `requested` then just stays False.
+    """
+
+    EXIT_CODE = EXIT_CODE_PREEMPTED
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 immediate: bool = False) -> None:
+        self._event = threading.Event()
+        self._signum: Optional[int] = None
+        self._immediate = immediate
+        self._prev = {}
+        for sig in signals:
+            try:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            except ValueError:   # not the main thread (tests)
+                logger.warning(
+                    'PreemptionGuard installed off the main thread; '
+                    'signal %s will not be caught', sig)
+
+    def restore(self) -> None:
+        """Put back the handlers this guard replaced — for callers that
+        invoke a training main() in-process (tests) and outlive it."""
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass
+        self._prev = {}
+
+    def cooperative(self) -> None:
+        """Leave immediate-exit (startup) mode: from here on the
+        handler only sets the flag and the step loop owns the exit."""
+        self._immediate = False
+
+    def _handle(self, signum, frame) -> None:
+        del frame
+        # Re-entrant-safe: only flag state; all real work (device sync,
+        # checkpoint IO, logging) happens in the step loop.
+        self._signum = signum
+        self._event.set()
+        if self._immediate:
+            raise SystemExit(self.EXIT_CODE)
+
+    @property
+    def requested(self) -> bool:
+        """True once SIGTERM/SIGINT arrived; the step loop should
+        checkpoint and exit(EXIT_CODE)."""
+        return self._event.is_set()
+
+    @property
+    def signum(self) -> Optional[int]:
+        return self._signum
 
 
 class Checkpointer:

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
@@ -198,152 +199,185 @@ def main(argv=None) -> None:
     state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
                                             jax.random.PRNGKey(0))
 
-    ckpt = None
-    if args.checkpoint_dir:
-        from skypilot_tpu.train import checkpoint as ckpt_lib
-        ckpt = ckpt_lib.Checkpointer(
-            args.checkpoint_dir,
-            save_interval_steps=args.checkpoint_every)
-    will_resume = (ckpt is not None and args.resume == 'auto'
-                   and ckpt.latest_step() is not None)
-
-    if args.base_checkpoint and will_resume and args.lora_rank == 0:
-        # Full-finetune restart: the resume checkpoint holds the whole
-        # state, so streaming the HF base in first would only burn
-        # restart latency and transiently double param memory.
-        logger.info('resume checkpoint found; skipping base load')
-    elif args.base_checkpoint:
-        # Finetune from real weights: replace the randomly initialized
-        # params with the checkpoint's, loaded straight into the same
-        # sharded layout (models/weights.py device_puts per leaf).
-        from skypilot_tpu.models import weights as weights_lib
-        import flax.linen as nn_meta
-        ckpt_type = weights_lib.checkpoint_model_type(
-            args.base_checkpoint)
-        is_moe_model = args.model in moe.MIXTRAL_CONFIGS
-        if (ckpt_type in ('mixtral', 'qwen3_moe')) != is_moe_model:
-            raise SystemExit(
-                f'--base-checkpoint is {ckpt_type!r} but --model '
-                f'{args.model!r} is {"MoE" if is_moe_model else "dense"}')
-        # Fail fast on a wrong-SIZE checkpoint BEFORE the multi-minute
-        # weight stream: the loaders take shapes from the checkpoint,
-        # and a mismatch would otherwise surface as an opaque einsum
-        # error at the first train step.
-        ckpt_cfg = (weights_lib.load_mixtral_config(args.base_checkpoint)
-                    [0] if is_moe_model
-                    else weights_lib.load_config(args.base_checkpoint))
-        for f in ('dim', 'n_layers', 'n_heads', 'n_kv_heads', 'mlp_dim',
-                  'vocab_size'):
-            if getattr(ckpt_cfg, f) != getattr(cfg, f):
-                raise SystemExit(
-                    f'--base-checkpoint {f}={getattr(ckpt_cfg, f)} does '
-                    f'not match --model {args.model!r} '
-                    f'{f}={getattr(cfg, f)}')
-        if is_moe_model:
-            loaded = weights_lib.load_mixtral_params(
-                cfg, moe_cfg, args.base_checkpoint, mesh=mesh)['params']
-        else:
-            loaded = weights_lib.load_llama_params(
-                cfg, args.base_checkpoint, mesh=mesh)['params']
-        boxed = jax.tree.map(
-            lambda box, arr: box.replace_boxed(arr)
-            if isinstance(box, nn_meta.meta.AxisMetadata) else arr,
-            state.params, loaded,
-            is_leaf=lambda x: isinstance(x, nn_meta.meta.AxisMetadata))
-        state = state.replace(params=boxed)
-        logger.info('loaded base checkpoint %s', args.base_checkpoint)
-
-    lora_cfg = None
-    if args.lora_rank > 0:
-        from skypilot_tpu.train import lora as lora_lib
-        lora_cfg = lora_lib.LoRAConfig(rank=args.lora_rank,
-                                       alpha=args.lora_alpha)
-        frozen = state.params
-        state = lora_lib.create_lora_state(model, frozen, tx, lora_cfg,
-                                           jax.random.PRNGKey(1))
-        logger.info('LoRA: %d trainable params',
-                    lora_lib.num_lora_params(state.params))
-
-    start_step = 0
-    if ckpt is not None and args.resume == 'auto':
-        restored = ckpt.restore(state)
-        if restored is not None:
-            state = restored
-            start_step = int(jax.device_get(state.step))
-            logger.info('resumed from step %d', start_step)
-
-    if lora_cfg is not None:
-        from skypilot_tpu.train import lora as lora_lib
-        step_fn = lora_lib.make_lora_train_step(model, frozen, tx, mesh,
-                                                lora_cfg)
-    else:
-        step_fn = trainer.make_train_step(model, tx, mesh)
-    data_tok = None
-    if args.data and args.data_tokenizer:
-        from skypilot_tpu.infer import tokenizer as tokenizer_lib
-        data_tok = tokenizer_lib.load_tokenizer(args.data_tokenizer)
-    batches = (jsonl_batches(args.data, cfg.vocab_size, args.batch,
-                             args.seq, tokenizer=data_tok)
-               if args.data else
-               synthetic_batches(cfg.vocab_size, args.batch, args.seq))
-
-    from skypilot_tpu.utils import profiling
-    prof = profiling.StepProfiler()   # no-op unless SKYT_PROFILE_DIR set
-    mpub = trainer.TrainMetricsPublisher()
-    # Deferred metrics: publish() pulls step k-1's loss/grad-norm while
-    # step k runs — the log boundary never syncs the step chain's head
-    # (logged loss lags one step; see trainer.DeferredMetrics).
-    dmetrics = trainer.DeferredMetrics(mpub)
-
-    # Overlap layer: assemble + device_put the next batches on a
-    # background thread while the current step runs (train/prefetch.py).
-    prefetcher = None
-    if args.prefetch > 0:
-        from skypilot_tpu.train import prefetch as prefetch_lib
-        prefetcher = prefetch_lib.Prefetcher(
-            batches, depth=args.prefetch,
-            place=prefetch_lib.make_sharded_placer(mesh))
-        batches = prefetcher
-
-    t0 = time.perf_counter()
-    last_t = t0
-    tokens_seen = 0
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    # Preemption-safe exit: SIGTERM/SIGINT requests a checkpoint at
+    # the next step boundary; the run then exits EXIT_CODE_PREEMPTED
+    # so the managed-jobs controller resumes from step k instead of
+    # relaunching from zero (docs/robustness.md). immediate=True:
+    # during startup (weight stream, first compile) there is no step
+    # boundary coming for minutes — exit with the preemption code NOW
+    # instead of burning the whole grace window loading and dying to
+    # SIGKILL as FAILED; the guard turns cooperative at the step loop.
+    guard = ckpt_lib.PreemptionGuard(immediate=True)
     try:
-        for step in range(start_step, args.steps):
-            prof.on_step(step - start_step)
-            batch = next(batches)
-            state, metrics = step_fn(state, batch)
-            dmetrics.on_step(metrics)   # device refs only — no sync
-            tokens_seen += args.batch * args.seq * jax.process_count()
-            if ckpt is not None:
-                ckpt.save(step + 1, state)
-            if (step + 1) % args.log_every == 0:
-                now = time.perf_counter()
-                dt = now - t0
-                # Step time averaged over the logging window; the only
-                # device pull here is DeferredMetrics' step-(k-1) read,
-                # which overlaps step k's device compute.
-                n_window = min(args.log_every, step + 1 - start_step)
-                host = dmetrics.publish(
-                    step_time_s=(now - last_t) / max(1, n_window),
-                    tokens_per_sec=tokens_seen / dt,
-                    steps=n_window)
-                last_t = now
-                logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
-                            step + 1, args.steps,
-                            host.get('loss', float('nan')),
-                            tokens_seen / dt)
+        ckpt = None
+        if args.checkpoint_dir:
+            ckpt = ckpt_lib.Checkpointer(
+                args.checkpoint_dir,
+                save_interval_steps=args.checkpoint_every)
+        will_resume = (ckpt is not None and args.resume == 'auto'
+                       and ckpt.latest_step() is not None)
+
+        if args.base_checkpoint and will_resume and args.lora_rank == 0:
+            # Full-finetune restart: the resume checkpoint holds the whole
+            # state, so streaming the HF base in first would only burn
+            # restart latency and transiently double param memory.
+            logger.info('resume checkpoint found; skipping base load')
+        elif args.base_checkpoint:
+            # Finetune from real weights: replace the randomly initialized
+            # params with the checkpoint's, loaded straight into the same
+            # sharded layout (models/weights.py device_puts per leaf).
+            from skypilot_tpu.models import weights as weights_lib
+            import flax.linen as nn_meta
+            ckpt_type = weights_lib.checkpoint_model_type(
+                args.base_checkpoint)
+            is_moe_model = args.model in moe.MIXTRAL_CONFIGS
+            if (ckpt_type in ('mixtral', 'qwen3_moe')) != is_moe_model:
+                raise SystemExit(
+                    f'--base-checkpoint is {ckpt_type!r} but --model '
+                    f'{args.model!r} is {"MoE" if is_moe_model else "dense"}')
+            # Fail fast on a wrong-SIZE checkpoint BEFORE the multi-minute
+            # weight stream: the loaders take shapes from the checkpoint,
+            # and a mismatch would otherwise surface as an opaque einsum
+            # error at the first train step.
+            ckpt_cfg = (weights_lib.load_mixtral_config(args.base_checkpoint)
+                        [0] if is_moe_model
+                        else weights_lib.load_config(args.base_checkpoint))
+            for f in ('dim', 'n_layers', 'n_heads', 'n_kv_heads', 'mlp_dim',
+                      'vocab_size'):
+                if getattr(ckpt_cfg, f) != getattr(cfg, f):
+                    raise SystemExit(
+                        f'--base-checkpoint {f}={getattr(ckpt_cfg, f)} does '
+                        f'not match --model {args.model!r} '
+                        f'{f}={getattr(cfg, f)}')
+            if is_moe_model:
+                loaded = weights_lib.load_mixtral_params(
+                    cfg, moe_cfg, args.base_checkpoint, mesh=mesh)['params']
+            else:
+                loaded = weights_lib.load_llama_params(
+                    cfg, args.base_checkpoint, mesh=mesh)['params']
+            boxed = jax.tree.map(
+                lambda box, arr: box.replace_boxed(arr)
+                if isinstance(box, nn_meta.meta.AxisMetadata) else arr,
+                state.params, loaded,
+                is_leaf=lambda x: isinstance(x, nn_meta.meta.AxisMetadata))
+            state = state.replace(params=boxed)
+            logger.info('loaded base checkpoint %s', args.base_checkpoint)
+
+        lora_cfg = None
+        if args.lora_rank > 0:
+            from skypilot_tpu.train import lora as lora_lib
+            lora_cfg = lora_lib.LoRAConfig(rank=args.lora_rank,
+                                           alpha=args.lora_alpha)
+            frozen = state.params
+            state = lora_lib.create_lora_state(model, frozen, tx, lora_cfg,
+                                               jax.random.PRNGKey(1))
+            logger.info('LoRA: %d trainable params',
+                        lora_lib.num_lora_params(state.params))
+
+        start_step = 0
+        if ckpt is not None and args.resume == 'auto':
+            restored = ckpt.restore(state)
+            if restored is not None:
+                state = restored
+                start_step = int(jax.device_get(state.step))
+                logger.info('resumed from step %d', start_step)
+
+        if lora_cfg is not None:
+            from skypilot_tpu.train import lora as lora_lib
+            step_fn = lora_lib.make_lora_train_step(model, frozen, tx, mesh,
+                                                    lora_cfg)
+        else:
+            step_fn = trainer.make_train_step(model, tx, mesh)
+        data_tok = None
+        if args.data and args.data_tokenizer:
+            from skypilot_tpu.infer import tokenizer as tokenizer_lib
+            data_tok = tokenizer_lib.load_tokenizer(args.data_tokenizer)
+        batches = (jsonl_batches(args.data, cfg.vocab_size, args.batch,
+                                 args.seq, tokenizer=data_tok)
+                   if args.data else
+                   synthetic_batches(cfg.vocab_size, args.batch, args.seq))
+
+        from skypilot_tpu.utils import profiling
+        prof = profiling.StepProfiler()   # no-op unless SKYT_PROFILE_DIR set
+        mpub = trainer.TrainMetricsPublisher()
+        # Deferred metrics: publish() pulls step k-1's loss/grad-norm while
+        # step k runs — the log boundary never syncs the step chain's head
+        # (logged loss lags one step; see trainer.DeferredMetrics).
+        dmetrics = trainer.DeferredMetrics(mpub)
+
+        # Overlap layer: assemble + device_put the next batches on a
+        # background thread while the current step runs (train/prefetch.py).
+        prefetcher = None
+        if args.prefetch > 0:
+            from skypilot_tpu.train import prefetch as prefetch_lib
+            prefetcher = prefetch_lib.Prefetcher(
+                batches, depth=args.prefetch,
+                place=prefetch_lib.make_sharded_placer(mesh))
+            batches = prefetcher
+
+        # Step loop from here: checkpoint writes begin, so preemption
+        # must wait for a step boundary instead of exiting mid-write.
+        guard.cooperative()
+        t0 = time.perf_counter()
+        last_t = t0
+        tokens_seen = 0
+        try:
+            for step in range(start_step, args.steps):
+                prof.on_step(step - start_step)
+                batch = next(batches)
+                state, metrics = step_fn(state, batch)
+                dmetrics.on_step(metrics)   # device refs only — no sync
+                tokens_seen += args.batch * args.seq * jax.process_count()
+                saved = ckpt.save(step + 1, state) \
+                    if ckpt is not None else False
+                # Chaos hook: kind=preempt here SIGTERMs this process, so
+                # the guard path below runs deterministically in tests.
+                faults.inject('train.step', step=step)
+                if guard.requested:
+                    if ckpt is not None:
+                        if not saved:
+                            ckpt.save(step + 1, state, force=True)
+                        ckpt.wait()   # async write must land before exit
+                        logger.info('preemption: checkpoint saved at '
+                                    'step %d', step + 1)
+                    logger.info(
+                        'preemption requested (signal %s); exiting with '
+                        'code %d for controller recovery', guard.signum,
+                        guard.EXIT_CODE)
+                    raise SystemExit(guard.EXIT_CODE)
+                if (step + 1) % args.log_every == 0:
+                    now = time.perf_counter()
+                    dt = now - t0
+                    # Step time averaged over the logging window; the only
+                    # device pull here is DeferredMetrics' step-(k-1) read,
+                    # which overlaps step k's device compute.
+                    n_window = min(args.log_every, step + 1 - start_step)
+                    host = dmetrics.publish(
+                        step_time_s=(now - last_t) / max(1, n_window),
+                        tokens_per_sec=tokens_seen / dt,
+                        steps=n_window)
+                    last_t = now
+                    logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
+                                step + 1, args.steps,
+                                host.get('loss', float('nan')),
+                                tokens_seen / dt)
+        finally:
+            # A crash inside the profiled window must still flush the trace
+            # — the failing run is the one most worth profiling.
+            prof.stop()
+            if prefetcher is not None:
+                prefetcher.close()
+        if ckpt is not None:
+            if ckpt.latest_step() != args.steps:
+                ckpt.save(args.steps, state, force=True)
+            ckpt.close()
+        logger.info('done: %d steps', args.steps - start_step)
     finally:
-        # A crash inside the profiled window must still flush the trace
-        # — the failing run is the one most worth profiling.
-        prof.stop()
-        if prefetcher is not None:
-            prefetcher.close()
-    if ckpt is not None:
-        if ckpt.latest_step() != args.steps:
-            ckpt.save(args.steps, state, force=True)
-        ckpt.close()
-    logger.info('done: %d steps', args.steps - start_step)
+        # In-process callers (tests) outlive main(): give them
+        # their SIGTERM/SIGINT handlers back however the run
+        # ends (completion, preemption SystemExit, setup error).
+        guard.restore()
 
 
 if __name__ == '__main__':

@@ -1,0 +1,295 @@
+"""Deterministic fault injection — chaos testing without the chaos.
+
+SkyPilot's signature capability is surviving failure, so failure must
+be a *testable input*, not something waited for in production. This
+module lets any layer declare a named fault point at the call site:
+
+    faults.inject('lb.proxy', replica=url)          # sync code
+    await faults.ainject('server.request')          # async code
+
+A fault point is dormant (one env lookup) until armed through the
+``SKYT_FAULTS`` spec or the programmatic API, so shipping fault points
+in hot paths is free. Every fired fault is counted in metrics
+(``skyt_faults_fired_total{point,kind}``) and recorded as an event on
+the current trace span, so chaos runs stay fully traceable through the
+observability plane (docs/robustness.md has the fault-point catalog).
+
+Spec grammar (rules split on ';', fields on ','):
+
+    SKYT_FAULTS = rule (';' rule)*
+    rule        = <point> '=' <kind>
+                  [',p=' FLOAT]       probability per eligible hit (1.0)
+                  [',count=' INT]     max fires for this rule (unlimited)
+                  [',after=' INT]     skip the first N eligible hits (0)
+                  [',arg=' FLOAT]     seconds for latency/hang
+                  [',where=' K ':' V] only fire when the call site passed
+                                      attribute K with value V
+
+Kinds:
+    error       raise FaultError at the call site
+    latency     sleep ``arg`` seconds (default 0.05) then continue
+    hang        sleep ``arg`` seconds (default 3600) then continue
+    disconnect  raise FaultDisconnect (a ConnectionResetError)
+    preempt     SIGTERM this process (exercises cooperative-preemption
+                handlers, e.g. train/checkpoint.PreemptionGuard)
+
+Example — kill a specific replica's server on its 3rd request:
+
+    SKYT_FAULTS='server.request=preempt,after=2' python -m \
+        skypilot_tpu.infer.server ...
+
+Determinism: probabilistic rules draw from a per-rule
+``random.Random`` seeded from ``SKYT_FAULTS_SEED`` (default 0) and the
+rule's index, so a chaos run replays identically.
+"""
+import dataclasses
+import os
+import random
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu.utils import metrics as metrics_lib
+
+_ENV = 'SKYT_FAULTS'
+_ENV_SEED = 'SKYT_FAULTS_SEED'
+
+KINDS = ('error', 'latency', 'hang', 'disconnect', 'preempt')
+
+_DEFAULT_ARG = {'latency': 0.05, 'hang': 3600.0}
+
+
+class FaultError(RuntimeError):
+    """An injected 'error' fault."""
+
+
+class FaultDisconnect(ConnectionResetError):
+    """An injected 'disconnect' fault (an OSError, so transport-level
+    catch blocks treat it exactly like a real peer reset)."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    point: str
+    kind: str
+    p: float = 1.0
+    count: Optional[int] = None
+    after: int = 0
+    arg: Optional[float] = None
+    where: Optional[Tuple[str, str]] = None
+    # Mutable trigger state (seen counts ELIGIBLE evaluations: point
+    # matched and `where` matched).
+    seen: int = 0
+    fired: int = 0
+    rng: Any = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f'unknown fault kind {self.kind!r} (have {KINDS})')
+        if not self.point:
+            raise ValueError('fault rule needs a point name')
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f'fault p={self.p} out of [0, 1]')
+
+
+def parse_spec(spec: str, seed: Optional[int] = None) -> List[FaultRule]:
+    """Parse a SKYT_FAULTS spec string. Raises ValueError naming the
+    offending token on malformed input."""
+    if seed is None:
+        seed = int(os.environ.get(_ENV_SEED, '0') or 0)
+    rules: List[FaultRule] = []
+    for i, raw in enumerate(s for s in spec.split(';') if s.strip()):
+        head, _, tail = raw.strip().partition(',')
+        point, eq, kind = head.partition('=')
+        if not eq or not point.strip() or not kind.strip():
+            raise ValueError(
+                f'fault rule {raw.strip()!r}: expected '
+                f'"<point>=<kind>[,field=value...]"')
+        kwargs: Dict[str, Any] = {}
+        for field in (f for f in tail.split(',') if f.strip()):
+            k, eq, v = field.partition('=')
+            k, v = k.strip(), v.strip()
+            try:
+                if k == 'p':
+                    kwargs['p'] = float(v)
+                elif k == 'count':
+                    kwargs['count'] = int(v)
+                elif k == 'after':
+                    kwargs['after'] = int(v)
+                elif k == 'arg':
+                    kwargs['arg'] = float(v)
+                elif k == 'where':
+                    wk, sep, wv = v.partition(':')
+                    if not sep:
+                        raise ValueError
+                    kwargs['where'] = (wk, wv)
+                else:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f'fault rule {raw.strip()!r}: bad field '
+                    f'{field.strip()!r}') from None
+        rule = FaultRule(point.strip(), kind.strip(), **kwargs)
+        rule.rng = random.Random((seed << 8) ^ i)
+        rules.append(rule)
+    return rules
+
+
+# ----------------------------------------------------------- module state
+_lock = threading.Lock()
+_configured = False          # programmatic config wins over the env
+_cache_spec: Optional[str] = None
+_cache_rules: List[FaultRule] = []
+_env_warned = False
+
+
+def _active() -> List[FaultRule]:
+    global _cache_spec, _cache_rules, _env_warned
+    if _configured:
+        return _cache_rules
+    spec = os.environ.get(_ENV, '')
+    if spec == _cache_spec:
+        return _cache_rules
+    with _lock:
+        if spec == _cache_spec:
+            return _cache_rules
+        try:
+            rules = parse_spec(spec) if spec else []
+        except ValueError as e:
+            # A typo'd chaos spec must fail LOUD in the log, but not
+            # take the process down with it.
+            if not _env_warned:
+                _env_warned = True
+                from skypilot_tpu.utils import log_utils
+                log_utils.init_logger(__name__).warning(
+                    'ignoring malformed %s: %s', _ENV, e)
+            rules = []
+        _cache_spec = spec
+        _cache_rules = rules
+    return _cache_rules
+
+
+def configure(spec, seed: Optional[int] = None) -> List[FaultRule]:
+    """Programmatic arming: a spec string or a list of FaultRules.
+    Overrides the env until reset(). Returns the active rules (their
+    fired/seen counters are live — tests assert on them)."""
+    global _configured, _cache_rules, _cache_spec
+    rules = parse_spec(spec, seed=seed) if isinstance(spec, str) \
+        else list(spec)
+    for i, rule in enumerate(rules):
+        if rule.rng is None:
+            rule.rng = random.Random(((seed or 0) << 8) ^ i)
+    with _lock:
+        _configured = True
+        _cache_spec = None
+        _cache_rules = rules
+    return rules
+
+
+def reset() -> None:
+    """Disarm everything (tests); the env is re-read on next inject."""
+    global _configured, _cache_rules, _cache_spec
+    with _lock:
+        _configured = False
+        _cache_spec = None
+        _cache_rules = []
+
+
+def enabled() -> bool:
+    return bool(_active())
+
+
+def fired_counts() -> Dict[Tuple[str, str], int]:
+    """(point, kind) -> fires so far, over the active rules."""
+    out: Dict[Tuple[str, str], int] = {}
+    for rule in _active():
+        key = (rule.point, rule.kind)
+        out[key] = out.get(key, 0) + rule.fired
+    return out
+
+
+# ------------------------------------------------------------- evaluation
+def _metric() -> 'metrics_lib.Counter':
+    return metrics_lib.REGISTRY.counter(
+        'skyt_faults_fired_total', 'Injected faults fired',
+        ('point', 'kind'))
+
+
+def _record(rule: FaultRule, attrs: Dict[str, Any]) -> None:
+    _metric().labels(rule.point, rule.kind).inc()
+    # Chaos runs stay traceable: the fault lands as an event on
+    # whatever span is open at the injection site.
+    from skypilot_tpu.utils import tracing
+    span = tracing.current_span()
+    if span is not None:
+        span.add_event(f'fault.{rule.kind}', point=rule.point,
+                       **{k: str(v) for k, v in attrs.items()})
+
+
+def _evaluate(rules: List[FaultRule], point: str,
+              attrs: Dict[str, Any]) -> 'Tuple[float, Optional[Exception]]':
+    """-> (seconds to sleep, exception to raise | None). Sleeping is
+    left to the caller so async sites can await instead of blocking
+    the event loop. Takes the rule list as an argument — re-reading
+    _active() here would re-acquire the non-reentrant module lock and
+    self-deadlock if the spec changed concurrently."""
+    delay = 0.0
+    exc: Optional[Exception] = None
+    with _lock:
+        for rule in rules:
+            if rule.point != point:
+                continue
+            if rule.where is not None and \
+                    str(attrs.get(rule.where[0])) != rule.where[1]:
+                continue
+            rule.seen += 1
+            if rule.seen <= rule.after:
+                continue
+            if rule.count is not None and rule.fired >= rule.count:
+                continue
+            if rule.p < 1.0 and rule.rng.random() >= rule.p:
+                continue
+            rule.fired += 1
+            _record(rule, attrs)
+            if rule.kind in ('latency', 'hang'):
+                delay += rule.arg if rule.arg is not None \
+                    else _DEFAULT_ARG[rule.kind]
+            elif rule.kind == 'error':
+                exc = FaultError(
+                    f'injected fault at {point!r}'
+                    + (f': {rule.arg}' if rule.arg is not None else ''))
+            elif rule.kind == 'disconnect':
+                exc = FaultDisconnect(
+                    f'injected disconnect at {point!r}')
+            elif rule.kind == 'preempt':
+                os.kill(os.getpid(), signal.SIGTERM)
+    return delay, exc
+
+
+def inject(point: str, **attrs) -> None:
+    """Fire any armed faults for `point` (sync call sites). No-op —
+    one env lookup — when nothing is armed."""
+    rules = _active()
+    if not rules:
+        return
+    delay, exc = _evaluate(rules, point, attrs)
+    if delay > 0:
+        time.sleep(delay)
+    if exc is not None:
+        raise exc
+
+
+async def ainject(point: str, **attrs) -> None:
+    """Async inject: latency/hang faults await instead of blocking the
+    event loop (a hung coroutine, not a hung process)."""
+    rules = _active()
+    if not rules:
+        return
+    delay, exc = _evaluate(rules, point, attrs)
+    if delay > 0:
+        import asyncio
+        await asyncio.sleep(delay)
+    if exc is not None:
+        raise exc

@@ -261,6 +261,18 @@ def test_lb_to_server_trace_propagation(monkeypatch):
         assert any(e['ph'] == 'X' and e['name'] == 'engine.decode'
                    for e in chrome['traceEvents'])
 
+        # The LB serves its own /metrics (robustness satellite): the
+        # retry/breaker families are registered and the per-replica
+        # traffic series carries this request.
+        lb_text = requests.get(lb_base + '/metrics', timeout=5).text
+        assert '# TYPE skyt_lb_retries_total counter' in lb_text
+        assert '# TYPE skyt_lb_breaker_state gauge' in lb_text
+        assert '# TYPE skyt_lb_breaker_opens_total counter' in lb_text
+        assert ('# TYPE skyt_lb_sync_dropped_timestamps_total counter'
+                in lb_text)
+        assert (f'skyt_lb_requests_total{{replica="{replica_url}"}}'
+                in lb_text)
+
         # /stats satellite: unknown ids point at the trace surface,
         # malformed ids name the offending value.
         r404 = requests.get(replica_url + '/stats?request_id=424242',

@@ -271,6 +271,122 @@ else
     FAIL=1
 fi
 
+echo "== 7. chaos drill: SKYT_FAULTS kills one replica mid-burst;"
+echo "   every request whose response headers had not been sent must"
+echo "   complete on the surviving replica (0 client-visible 5xx)"
+echo "   and the LB breaker must open on the dead one =="
+if SKYT_SERVE_LB_SYNC_INTERVAL=3600 SKYT_LB_RETRY_BACKOFF_S=0.02 \
+        SKYT_LB_BREAKER_THRESHOLD=2 SKYT_LB_BREAKER_COOLDOWN_S=60 \
+        timeout 900 python - <<'PYEOF' 2>&1 | tee "$OUT/chaos_drill.txt"
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import requests
+from aiohttp import web
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.utils import metrics as metrics_lib
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+ports = [free_port(), free_port()]
+urls = [f'http://127.0.0.1:{p}' for p in ports]
+procs = []
+for i, p in enumerate(ports):
+    env = dict(os.environ)
+    if i == 0:
+        # The chaos event, armed through the fault subsystem: replica 0
+        # SIGTERMs ITSELF on its 3rd proxied /generate (mid-burst; the
+        # where-filter keeps readiness /health probes from counting).
+        env['SKYT_FAULTS'] = \
+            'server.request=preempt,after=2,where=path:/generate'
+    procs.append(subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--model', 'debug', '--port', str(p),
+         '--num-slots', '2', '--max-seq-len', '128'],
+        env=env))
+try:
+    for proc, url in zip(procs, urls):
+        deadline = time.time() + 480   # warmup compiles via the tunnel
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise SystemExit(f'replica died rc={proc.returncode}')
+            try:
+                if requests.get(url + '/health',
+                                timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(1)
+        else:
+            raise SystemExit('replica never became healthy')
+    lb_port = free_port()
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', lb_port,
+        metrics_registry=metrics_lib.MetricsRegistry())
+    lb.policy.set_ready_replicas(urls)
+    threading.Thread(target=lambda: web.run_app(
+        lb.make_app(), port=lb_port, print=None,
+        handle_signals=False), daemon=True).start()
+    base = f'http://127.0.0.1:{lb_port}'
+    deadline = time.time() + 30     # poll until the LB app is bound
+    while time.time() < deadline:
+        try:
+            requests.get(base + '/metrics', timeout=2)
+            break
+        except requests.RequestException:
+            time.sleep(0.2)
+    results = []
+    lock = threading.Lock()
+    def one(i):
+        r = requests.post(base + '/generate',
+                          json={'tokens': [i + 1, i + 2, i + 3],
+                                'max_tokens': 8}, timeout=300)
+        with lock:
+            results.append((r.status_code,
+                            r.headers.get('X-Replica-Id')))
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(12)]
+    for th in threads:
+        th.start()
+        time.sleep(0.1)   # spread the burst across the kill
+    for th in threads:
+        th.join(timeout=300)
+    assert len(results) == 12, results
+    bad = [r for r in results if r[0] != 200]
+    assert not bad, f'client-visible failures: {bad}'
+    assert any(rep == urls[1] for _, rep in results), results
+    # Replica 0 really died (the fault fired) ...
+    deadline = time.time() + 60
+    while time.time() < deadline and procs[0].poll() is None:
+        time.sleep(1)
+    assert procs[0].poll() is not None, 'replica 0 survived the fault'
+    # ... and the breaker ejected it ahead of any controller sync.
+    text = requests.get(base + '/metrics', timeout=5).text
+    assert f'skyt_lb_breaker_state{{replica="{urls[0]}"}} 2' in text, \
+        [l for l in text.splitlines() if 'breaker' in l]
+    n0 = sum(1 for _, rep in results if rep == urls[0])
+    print(f'CHAOS_DRILL_OK 12/12 ok, {n0} served by the doomed '
+          f'replica before death, breaker=open')
+finally:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+PYEOF
+then
+    echo "== chaos drill: PASS =="
+else
+    echo "== chaos drill: FAIL (see $OUT/chaos_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
