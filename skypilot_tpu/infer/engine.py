@@ -21,6 +21,7 @@ distribution) requests.
 import contextlib
 import dataclasses
 import itertools
+import os
 import queue
 import threading
 import time
@@ -48,6 +49,11 @@ _TRACE_EVENTS_KEEP = 64
 # Device-side top-k sampling supports k up to this (one fixed-size
 # top_k sort serves all slots' per-request k values).
 _TOPK_BUCKET = 64
+# QoS priority classes (serve/qos.py defines the authoritative set;
+# duplicated here so SamplingParams.validate stays import-light — the
+# engine only imports the QoS module when SKYT_QOS=1).
+_QOS_PRIORITIES = ('interactive', 'standard', 'batch')
+
 # Max logit_bias entries per request; applied as a device-side
 # scatter-add of a fixed [SLOTS, _BIAS_BUCKET] (idx, val) pair, so the
 # cap keeps the decode step free of data-dependent shapes (same
@@ -98,6 +104,13 @@ class SamplingParams:
     # pages free at the next delivery boundary instead of generating
     # for an abandoned client (docs/robustness.md). None = no deadline.
     deadline: Optional[float] = None
+    # QoS admission class + tenant (docs/qos.md). With SKYT_QOS=1 the
+    # waiting queue orders by class (aging prevents starvation) and is
+    # DRR-fair across tenants within a class; with QoS off both fields
+    # are inert. They ride the multi-host request broadcast like every
+    # other per-request knob, so follower hosts schedule identically.
+    priority: str = 'standard'
+    tenant: str = ''
 
     def validate(self) -> None:
         """Reject parameters the engine cannot honor exactly, instead
@@ -138,6 +151,13 @@ class SamplingParams:
         if not isinstance(self.lora_id, int) or self.lora_id < 0:
             raise ValueError(f'lora_id must be an int >= 0, got '
                              f'{self.lora_id!r}')
+        if self.priority not in _QOS_PRIORITIES:
+            raise ValueError(
+                f'priority must be one of {_QOS_PRIORITIES}, got '
+                f'{self.priority!r}')
+        if not isinstance(self.tenant, str):
+            raise ValueError(f'tenant must be a string, got '
+                             f'{self.tenant!r}')
         if self.logit_bias:
             if len(self.logit_bias) > _BIAS_BUCKET:
                 raise ValueError(
@@ -162,6 +182,10 @@ class _Request:
     params: SamplingParams
     out_queue: 'queue.Queue[Optional[int]]'
     submitted_at: float = dataclasses.field(default_factory=time.time)
+    # First admission attempt (prefill start) — the queue-wait endpoint
+    # for the per-class QoS histograms. First write wins (the chunked
+    # path records once at chunk 0).
+    prefill_start_at: Optional[float] = None
     first_token_at: Optional[float] = None
     slot: Optional[int] = None
     generated: int = 0
@@ -570,7 +594,41 @@ class InferenceEngine:
                       jnp.int32)
             if self.spec_decode > 0 and self.draft_model is None
             else None)
-        self._waiting: 'queue.Queue[_Request]' = queue.Queue()
+        # Waiting queue: plain FIFO by default. With SKYT_QOS=1 the
+        # priority-aware ClassedRequestQueue replaces it — a
+        # queue.Queue subclass whose deque is kept in scheduled order
+        # (class-ordered with aging, DRR-fair across tenants), so
+        # every FIFO access pattern below keeps working unchanged.
+        # Decided at construction: the queue type cannot change under
+        # a live engine, and the SKYT_QOS=0 path stays byte-identical.
+        self._qos_queue = None
+        # Slots reserved for interactive-class admissions (QoS only):
+        # batch/standard requests leave this many slots free, so a
+        # batch flood can never occupy the whole replica and an
+        # interactive arrival prefills immediately instead of waiting
+        # out a batch decode. 0 (default) = no reservation.
+        self._qos_reserved = 0
+        if os.environ.get('SKYT_QOS', '0') not in ('', '0', 'false'):
+            from skypilot_tpu.serve import qos as qos_lib
+            self._qos_queue = qos_lib.ClassedRequestQueue(
+                meta=lambda r: qos_lib.RequestMeta(
+                    cls=r.params.priority,
+                    tenant=r.params.tenant or 'default',
+                    cost=float(len(r.tokens)
+                               + r.params.max_new_tokens),
+                    seq=r.req_id, enq_t=r.submitted_at))
+            self._waiting: 'queue.Queue[_Request]' = self._qos_queue
+            try:
+                self._qos_reserved = max(0, min(num_slots - 1, int(
+                    os.environ.get('SKYT_QOS_RESERVE_SLOTS', '0')
+                    or 0)))
+            except ValueError:
+                self._qos_reserved = 0
+        else:
+            self._waiting = queue.Queue()
+        # Last scheduled order broadcast to lockstep followers (seq
+        # list); reorders only rebroadcast when the order changed.
+        self._last_qorder: Optional[List[int]] = None
         # Multi-host lockstep (see __init__ docstring). On the primary,
         # submit() lands requests in _ingress and the per-tick sync
         # moves them into _waiting AFTER broadcasting them, so follower
@@ -674,6 +732,20 @@ class InferenceEngine:
         # counters (the pool keeps running totals; counters take the
         # delta so restarts/resets keep Prometheus rate() math valid).
         self._prefix_seen = {'hit_pages': 0, 'miss_pages': 0}
+        # Per-class QoS series, created only with SKYT_QOS=1 (the
+        # disabled path never touches them — zero overhead).
+        self._m_qos_depth = self._m_qos_wait = self._m_qos_ttft = None
+        if self._qos_queue is not None:
+            self._m_qos_depth = reg.gauge(
+                'skyt_qos_queue_depth',
+                'Waiting requests by QoS class', ('class',))
+            self._m_qos_wait = reg.histogram(
+                'skyt_qos_queue_wait_seconds',
+                'Queue wait (submit -> prefill start) by QoS class',
+                ('class',))
+            self._m_qos_ttft = reg.histogram(
+                'skyt_qos_ttft_seconds',
+                'Time to first token by QoS class', ('class',))
         # --- request-phase traces: req_id -> monotonic-free wall-clock
         # timestamps (queued -> prefill_start -> first_token -> done),
         # queryable via the server's /stats?request_id=. Bounded FIFO.
@@ -1280,7 +1352,7 @@ class InferenceEngine:
             # so followers always see the identical admission stream.
             self._ingress.put(req)
         else:
-            self._waiting.put(req)
+            self._waiting.put(req)   # qos-admission (lint-sanctioned)
         return req_id, req.out_queue
 
     def cancel(self, req_id: int) -> bool:
@@ -1570,6 +1642,9 @@ class InferenceEngine:
         self._m_queue_depth.set(waiting)
         self._m_running.set(
             sum(1 for s in self._slots if s is not None))
+        if self._qos_queue is not None:
+            for cls, depth in self._qos_queue.depths().items():
+                self._m_qos_depth.labels(cls).set(depth)
         if self.pool is not None:
             total = self.pool.cfg.n_pages - 1   # page 0 is the dummy
             if total > 0:
@@ -1589,6 +1664,40 @@ class InferenceEngine:
             if denom > 0:
                 self._m_kv_util.set(
                     float(self._conf_lengths.sum()) / denom)
+
+    def qos_depths(self) -> Optional[Dict[str, int]]:
+        """Per-class waiting depths, or None with QoS off. Read by the
+        server's /stats QoS snapshot and the flight-recorder engine
+        state."""
+        if self._qos_queue is None:
+            return None
+        return self._qos_queue.depths()
+
+    def qos_signals(self) -> Dict[str, float]:
+        """Live overload signals for the server's QoS admission
+        controller (serve/qos.OverloadController): queue depth, slot
+        count, KV/page occupancy, rolling p95 TTFT. Cheap — the
+        controller samples it at most every SKYT_QOS_REFRESH_S."""
+        sig: Dict[str, float] = {
+            'queue_depth': float(
+                self._waiting.qsize()
+                + (1 if self._deferred is not None else 0)),
+            'num_slots': float(self.num_slots),
+        }
+        if self.pool is not None:
+            total = self.pool.cfg.n_pages - 1
+            if total > 0:
+                sig['kv_util'] = (total - self.pool.free_pages()) / total
+        else:
+            denom = self.num_slots * self.max_seq_len
+            if denom > 0:
+                sig['kv_util'] = float(self._conf_lengths.sum()) / denom
+        with self._lock:
+            ttfts = tuple(self._ttfts)
+        if ttfts:
+            sig['ttft_p95_s'] = float(np.percentile(
+                np.asarray(ttfts), 95))
+        return sig
 
     def reset_perf(self) -> None:
         self.perf = _fresh_perf()
@@ -1720,6 +1829,14 @@ class InferenceEngine:
         for req in queued:
             if req.cancelled:
                 break   # let _admit_one deliver its terminal None
+            if self._qos_reserved and \
+                    req.params.priority != 'interactive' and \
+                    len(cand) >= len(free) - self._qos_reserved:
+                # Slot reservation: this candidate would eat into the
+                # interactive reserve. The scheduler keeps interactive
+                # requests at the queue head, so stopping here never
+                # strands one behind the gate.
+                break
             n = len(req.tokens)
             b = self._bucket_for(n)
             if bucket is not None and b != bucket:
@@ -1798,6 +1915,8 @@ class InferenceEngine:
             padded[j, :len(req.tokens)] = req.tokens
             lengths[j] = len(req.tokens)
             lora_ids[j] = req.params.lora_id
+            if req.prefill_start_at is None:
+                req.prefill_start_at = time.time()
             self._trace_event(req.req_id, 'prefill_start',
                               status='running')
             if trace_on:
@@ -1854,6 +1973,21 @@ class InferenceEngine:
         return True
 
     def _admit_one(self) -> bool:
+        if self._qos_reserved:
+            # Slot reservation (QoS): a non-interactive head may not
+            # take the last reserved slot(s). Cancelled heads still
+            # pass (they must pop to deliver their terminal None and
+            # never occupy a slot anyway).
+            head = self._deferred
+            if head is None:
+                with self._waiting.mutex:
+                    head = self._waiting.queue[0] \
+                        if self._waiting.queue else None
+            if head is not None and not head.cancelled and \
+                    head.params.priority != 'interactive' and \
+                    sum(1 for s in self._slots if s is None) <= \
+                    self._qos_reserved:
+                return False
         req = self._deferred
         if req is not None:
             self._deferred = None
@@ -1933,6 +2067,8 @@ class InferenceEngine:
                 self._chunked = {'req': req, 'slot': slot, 'row': row,
                                  'hashes': hashes,
                                  'start': n_cached * psize, 'n': n}
+                if req.prefill_start_at is None:
+                    req.prefill_start_at = time.time()
                 self._trace_event(req.req_id, 'prefill_start',
                                   status='running')
                 return True
@@ -1951,6 +2087,8 @@ class InferenceEngine:
                         return False
                     row, n_cached = res
         temp = max(0.0, req.params.temperature)
+        if req.prefill_start_at is None:
+            req.prefill_start_at = time.time()
         self._trace_event(req.req_id, 'prefill_start',
                           status='running')
         if tracing.enabled():
@@ -2055,6 +2193,13 @@ class InferenceEngine:
         with self._lock:   # /stats readers snapshot under the same lock
             self._ttfts.append(req.first_token_at - req.submitted_at)
         self._m_ttft.observe(req.first_token_at - req.submitted_at)
+        if self._qos_queue is not None:
+            cls = req.params.priority
+            self._m_qos_ttft.labels(cls).observe(
+                req.first_token_at - req.submitted_at)
+            start = req.prefill_start_at or req.first_token_at
+            self._m_qos_wait.labels(cls).observe(
+                max(0.0, start - req.submitted_at))
         self._m_prefill_tokens.inc(n)
         self.perf['admitted_requests'] += 1
         self._trace_event(req.req_id, 'first_token',
@@ -2273,6 +2418,13 @@ class InferenceEngine:
             # Deadline enforcement: expired requests cancel in place
             # (slot + KV pages free at the next delivery boundary).
             self._expire_deadlines()
+            # QoS: re-run the fair scheduler over the backlog (class
+            # order + aging credit + DRR tenant fairness) before this
+            # tick's admissions. Lockstep engines reorder inside
+            # _sync_tick instead — the order must ride the broadcast.
+            if self._qos_queue is not None and self._lockstep is None \
+                    and self._waiting.qsize() > 1:
+                self._qos_queue.reorder(time.time())
             # Admit as many waiting requests as there are free slots.
             # Same-bucket bursts take the batched fast path (one prefill
             # dispatch for the group); everything else falls back to the
@@ -2411,23 +2563,46 @@ class InferenceEngine:
                 cancels = self._pending_cancels
                 self._pending_cancels = []
             stop = self._stop.is_set()
+            # QoS: seat the new requests FIRST, then schedule, so the
+            # broadcast order covers them. Safe — only this thread
+            # consumes _waiting, and admission runs after the tick.
+            qorder = None
+            if self._qos_queue is not None:
+                for r in new:
+                    self._waiting.put(r)   # qos-admission (sanctioned)
+                order, changed = self._qos_queue.reorder(time.time())
+                if changed:
+                    # Followers' deques already match ours except when
+                    # this reorder rewrote it (puts and pops replicate
+                    # tick-by-tick), so only changed orders broadcast.
+                    qorder = order
+                    self._last_qorder = order
             blob = None
-            if new or cancels or stop:
+            if new or cancels or stop or qorder is not None:
                 blob = {'new': [(r.req_id, r.tokens, r.params)
                                 for r in new],
                         'cancel': cancels, 'stop': stop}
+                if qorder is not None:
+                    blob['qorder'] = qorder
             self._lockstep.broadcast(blob)
-            for r in new:
-                self._waiting.put(r)
+            if self._qos_queue is None:
+                for r in new:
+                    self._waiting.put(r)   # qos-admission (sanctioned)
         else:
             blob = self._lockstep.broadcast(None)
             if blob is not None:
                 from skypilot_tpu.infer import multihost
                 for rid, toks, params in blob['new']:
-                    self._waiting.put(_Request(
+                    self._waiting.put(_Request(  # qos-admission
                         req_id=rid, tokens=list(toks), params=params,
                         out_queue=multihost.DiscardQueue(),
                         rng=np.random.default_rng(params.seed + rid)))
+                if self._qos_queue is not None and \
+                        blob.get('qorder') is not None:
+                    # Followers never reorder locally (their clocks
+                    # must not influence admission order); they apply
+                    # the primary's broadcast schedule verbatim.
+                    self._qos_queue.apply_order(blob['qorder'])
         if blob is None:
             return False
         for rid in blob['cancel']:

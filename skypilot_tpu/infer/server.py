@@ -54,6 +54,7 @@ from aiohttp import web
 
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import tokenizer as tokenizer_lib
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
@@ -164,6 +165,16 @@ class InferenceServer:
                 # error must not make the checkpoint unservable.
                 logger.warning('chat template failed to compile (%s); '
                                'using the generic format', e)
+        # QoS admission control (docs/qos.md): per-tenant token
+        # buckets + the overload shed/degrade ladder, fed by live
+        # engine signals. None with SKYT_QOS=0 — the admission gate is
+        # then a single attribute check per request. Header PARSING
+        # (X-Priority / X-Tenant, 400 on malformed) stays on in both
+        # modes: the header contract must not depend on the flag.
+        self._qos = qos_lib.ServerQoS(
+            engine.qos_signals,
+            registry=engine.metrics_registry) \
+            if qos_lib.enabled() else None
         # Client-disconnect accounting: each detected disconnect also
         # cancelled its engine request(s) (slot + KV pages freed).
         self._m_disconnects = engine.metrics_registry.counter(
@@ -245,6 +256,52 @@ class InferenceServer:
                 status=400)
         return time.time() + budget, None
 
+    def _qos_admit(self, request: web.Request, payload=None,
+                   openai: bool = False,
+                   max_new: Optional[int] = None):
+        """QoS header contract + admission gate for one request.
+
+        -> (cls, tenant, decision | None, error response | None).
+        Malformed X-Priority / X-Tenant (or an unknown OpenAI
+        `service_tier`) is a 400 naming the offender; with QoS enabled
+        a shed/throttle decision is a 429 carrying Retry-After derived
+        from the live overload/token-bucket state. An explicit
+        X-Priority header wins over the body's service_tier. A
+        'degrade' decision is returned to the caller, which clamps
+        max_tokens before building SamplingParams."""
+        try:
+            cls = qos_lib.parse_priority(
+                request.headers.get('X-Priority'))
+            tenant = qos_lib.parse_tenant(
+                request.headers.get('X-Tenant'))
+            if openai and payload is not None and \
+                    'X-Priority' not in request.headers:
+                tier_cls = qos_lib.map_service_tier(
+                    payload.get('service_tier'))
+                if tier_cls is not None:
+                    cls = tier_cls
+        except ValueError as e:
+            return None, None, None, web.json_response(
+                {'error': str(e)}, status=400)
+        if self._qos is None:
+            return cls, tenant, None, None
+        dec = self._qos.admit(cls, tenant, max_new_tokens=max_new)
+        if dec.action in ('shed', 'throttle'):
+            verb = ('shed by overload control'
+                    if dec.action == 'shed'
+                    else 'throttled by the per-tenant rate limit')
+            return cls, tenant, dec, web.json_response(
+                {'error': f'request {verb} '
+                          f'(class={cls}, tenant={tenant}, '
+                          f'overload level {dec.level}); retry after '
+                          f'the Retry-After header',
+                 'qos': {'class': cls, 'tenant': tenant,
+                         'action': dec.action, 'level': dec.level}},
+                status=429,
+                headers={'Retry-After':
+                         qos_lib.retry_after_header(dec.retry_after)})
+        return cls, tenant, dec, None
+
     def _engine_state_snapshot(self) -> Dict[str, object]:
         """Engine occupancy at slow-trace capture time (the flight
         recorder's context: WHY was this request slow — deep queue?
@@ -266,6 +323,14 @@ class InferenceServer:
                     (total - eng.pool.free_pages()) / total, 4)
             if eng.prefix_caching:
                 snap['prefix_cache'] = dict(eng.pool.prefix_stats)
+        # Per-class queue depths + overload level on flight-recorded
+        # slow traces: "slow because 40 batch requests sat ahead of
+        # it" is the QoS plane's headline diagnosis.
+        depths = eng.qos_depths()
+        if depths is not None:
+            snap['qos_queue'] = depths
+        if self._qos is not None:
+            snap['qos_level'] = self._qos.overload.level()
         return snap
 
     def _bridge_engine_spans(self, span, rids) -> None:
@@ -338,7 +403,13 @@ class InferenceServer:
                              'live at /debug/traces?trace_id=<id>'},
                     status=404)
             return web.json_response(trace)
-        return web.json_response(self.engine.stats())
+        data = self.engine.stats()
+        if self._qos is not None:
+            # Scraped by the serve controller's replica prober and
+            # forwarded to the LB through the sync response — the
+            # per-replica QoS pressure the LB consults when picking.
+            data['qos'] = self._qos.snapshot(self.engine.qos_depths())
+        return web.json_response(data)
 
     async def _debug_traces(self, request: web.Request) -> web.Response:
         """This replica's span store: recent + flight-recorded slow
@@ -385,10 +456,24 @@ class InferenceServer:
         deadline, dl_err = self._deadline_from(request)
         if dl_err is not None:
             return dl_err
+        try:
+            max_new = int(max_new)
+        except (TypeError, ValueError):
+            return web.json_response(
+                {'error': f'max_tokens must be an integer, got '
+                          f'{max_new!r}'}, status=400)
+        qcls, qtenant, qdec, qerr = self._qos_admit(
+            request, payload, max_new=max_new)
+        if qerr is not None:
+            return qerr
+        if qdec is not None and qdec.max_new_tokens is not None:
+            max_new = min(max_new, qdec.max_new_tokens)
         params = engine_lib.SamplingParams(
             lora_id=lora_id,
             logit_bias=bias,
             deadline=deadline,
+            priority=qcls,
+            tenant=qtenant,
             max_new_tokens=int(max_new),
             temperature=float(payload.get('temperature', 0.0)),
             top_k=int(payload.get('top_k', 0)),
@@ -800,6 +885,16 @@ class InferenceServer:
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
+        qcls, qtenant, qdec, qerr = self._qos_admit(
+            request, payload, openai=True,
+            max_new=params.max_new_tokens)
+        if qerr is not None:
+            return qerr
+        params.priority = qcls
+        params.tenant = qtenant
+        if qdec is not None and qdec.max_new_tokens is not None:
+            params.max_new_tokens = min(params.max_new_tokens,
+                                        qdec.max_new_tokens)
         stops = self._stops_from_openai(payload)
         if stops is None:
             return web.json_response(
@@ -931,6 +1026,16 @@ class InferenceServer:
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
+        qcls, qtenant, qdec, qerr = self._qos_admit(
+            request, payload, openai=True,
+            max_new=params.max_new_tokens)
+        if qerr is not None:
+            return qerr
+        params.priority = qcls
+        params.tenant = qtenant
+        if qdec is not None and qdec.max_new_tokens is not None:
+            params.max_new_tokens = min(params.max_new_tokens,
+                                        qdec.max_new_tokens)
         if params.logprobs:
             # Chat logprobs use a different response schema (content
             # arrays); reject loudly rather than degrade silently.

@@ -8,9 +8,11 @@ on-demand base + spot overflow).
 """
 import dataclasses
 import math
+import os
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
@@ -19,6 +21,19 @@ logger = log_utils.init_logger(__name__)
 
 # Window over which QPS is measured (reference default 60s).
 QPS_WINDOW_SECONDS = 60.0
+
+
+def _ts_cap() -> int:
+    """Bound on every request-timestamp buffer (mirrors the LB's
+    sync-buffer cap, SKYT_LB_MAX_PENDING_TIMESTAMPS): a controller
+    that stops evaluating (or an LB flooding it) must not grow the
+    buffer without bound. Drop-oldest — recent timestamps drive the
+    decisions."""
+    try:
+        return max(1, int(os.environ.get(
+            'SKYT_AUTOSCALER_MAX_TIMESTAMPS', '') or 16384))
+    except ValueError:
+        return 16384
 
 
 @dataclasses.dataclass
@@ -45,16 +60,34 @@ class Autoscaler:
             'skyt_autoscaler_target_replicas',
             'Current target replica count')
         self._m_target.set(self.target_num_replicas)
+        self._m_dropped_ts = reg.counter(
+            'skyt_autoscaler_dropped_timestamps_total',
+            'Request timestamps dropped because an autoscaler buffer '
+            'hit its cap (SKYT_AUTOSCALER_MAX_TIMESTAMPS)')
 
     def _record_decision(self, kind: str) -> None:
         self._m_decisions.labels(kind).inc()
         self._m_target.set(self.target_num_replicas)
+
+    def _cap_buffer(self, buf: List) -> List:
+        """Drop-oldest bound on a timestamp buffer, counting drops
+        (satellite: mirrors the PR 4 LB sync-buffer fix)."""
+        over = len(buf) - _ts_cap()
+        if over > 0:
+            self._m_dropped_ts.inc(over)
+            return buf[over:]
+        return buf
 
     def update_spec(self, spec: 'spec_lib.ServiceSpec') -> None:
         self.spec = spec
 
     def collect_request_timestamps(self, ts: List[float]) -> None:
         raise NotImplementedError
+
+    def collect_qos(self, demand: List, sheds: List) -> None:
+        """Per-class (timestamp, class) demand and observed-shed
+        samples from the LB sync. Base autoscalers ignore them; the
+        QoS-aware subclass scales on them."""
 
     def evaluate_scaling(self, num_ready: int) -> AutoscalerDecision:
         raise NotImplementedError
@@ -77,8 +110,8 @@ class RequestRateAutoscaler(Autoscaler):
     def collect_request_timestamps(self, ts: List[float]) -> None:
         self.request_timestamps.extend(ts)
         cutoff = time.time() - QPS_WINDOW_SECONDS
-        self.request_timestamps = [t for t in self.request_timestamps
-                                   if t >= cutoff]
+        self.request_timestamps = self._cap_buffer(
+            [t for t in self.request_timestamps if t >= cutoff])
 
     def _raw_target(self) -> int:
         spec = self.spec
@@ -143,3 +176,86 @@ class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
     @property
     def ondemand_base(self) -> int:
         return self.spec.base_ondemand_fallback_replicas
+
+
+class QoSAwareAutoscaler(RequestRateAutoscaler):
+    """QoS-aware scaling (docs/qos.md): target replicas from per-CLASS
+    demand — interactive/standard demand at full weight, batch
+    discounted (it tolerates queueing) — plus the observed shed rate:
+    sheds mean replicas are actively refusing work, so every shed-QPS
+    worth of demand adds capacity on top of the weighted target.
+
+    Falls back to the raw request rate whenever no per-class demand
+    has been observed in the window (an LB running with SKYT_QOS=0
+    reports only raw timestamps), so enabling the mode is safe before
+    clients start tagging traffic."""
+
+    def __init__(self, spec: 'spec_lib.ServiceSpec',
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None) -> None:
+        super().__init__(spec, metrics_registry)
+        self.class_weights = qos_lib.autoscale_class_weights()
+        self._class_ts: Dict[str, List[float]] = {
+            c: [] for c in qos_lib.PRIORITIES}
+        self._shed_ts: List[float] = []
+
+    def collect_qos(self, demand: List, sheds: List) -> None:
+        cutoff = time.time() - QPS_WINDOW_SECONDS
+        for entry in demand:
+            try:
+                t, cls = float(entry[0]), str(entry[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            self._class_ts.setdefault(
+                cls if cls in self._class_ts else 'standard',
+                []).append(t)
+        for cls in self._class_ts:
+            self._class_ts[cls] = self._cap_buffer(
+                [t for t in self._class_ts[cls] if t >= cutoff])
+        for entry in sheds:
+            try:
+                self._shed_ts.append(float(entry[0]))
+            except (TypeError, ValueError, IndexError):
+                continue
+        self._shed_ts = self._cap_buffer(
+            [t for t in self._shed_ts if t >= cutoff])
+
+    def shed_qps(self) -> float:
+        cutoff = time.time() - QPS_WINDOW_SECONDS
+        return sum(1 for t in self._shed_ts
+                   if t >= cutoff) / QPS_WINDOW_SECONDS
+
+    def _raw_target(self) -> int:
+        spec = self.spec
+        if not spec.autoscaling_enabled:
+            return spec.min_replicas
+        cutoff = time.time() - QPS_WINDOW_SECONDS
+        per_class = {
+            cls: sum(1 for t in ts if t >= cutoff) / QPS_WINDOW_SECONDS
+            for cls, ts in self._class_ts.items()}
+        if not any(per_class.values()):
+            return super()._raw_target()
+        assert spec.target_qps_per_replica is not None
+        weighted = sum(self.class_weights.get(cls, 1.0) * q
+                      for cls, q in per_class.items())
+        target = math.ceil(weighted / spec.target_qps_per_replica)
+        shed = self.shed_qps()
+        if shed > 0:
+            # Replicas are refusing work: add the refused demand back
+            # as capacity (at least one extra replica).
+            target += max(1, math.ceil(shed /
+                                       spec.target_qps_per_replica))
+        upper = spec.max_replicas or spec.min_replicas
+        return max(spec.min_replicas, min(upper, target))
+
+
+def pick_autoscaler_cls(spec: 'spec_lib.ServiceSpec'):
+    """Controller-side selection: the on-demand-fallback mode keeps
+    priority (its replica-mix contract is orthogonal), then the
+    QoS-aware mode when SKYT_QOS=1, else the plain request-rate
+    autoscaler."""
+    if spec.base_ondemand_fallback_replicas > 0:
+        return FallbackRequestRateAutoscaler
+    if qos_lib.enabled():
+        return QoSAwareAutoscaler
+    return RequestRateAutoscaler

@@ -37,10 +37,9 @@ class SkyServeController:
         self.port = port
         self.replica_manager = replica_managers.ReplicaManager(
             service_name, spec, task_yaml)
-        autoscaler_cls = (autoscalers.FallbackRequestRateAutoscaler
-                          if spec.base_ondemand_fallback_replicas > 0
-                          else autoscalers.RequestRateAutoscaler)
-        self.autoscaler = autoscaler_cls(spec)
+        # QoS-aware mode (SKYT_QOS=1) scales on per-class demand +
+        # observed shed rate from the LB sync (docs/qos.md).
+        self.autoscaler = autoscalers.pick_autoscaler_cls(spec)(spec)
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
@@ -85,8 +84,17 @@ class SkyServeController:
         payload = await request.json()
         ts = payload.get('request_timestamps', [])
         self.autoscaler.collect_request_timestamps([float(t) for t in ts])
-        return web.json_response(
-            {'ready_replica_urls': self.replica_manager.ready_urls()})
+        demand = payload.get('qos_demand') or []
+        sheds = payload.get('qos_sheds') or []
+        if demand or sheds:
+            self.autoscaler.collect_qos(demand, sheds)
+        resp = {'ready_replica_urls': self.replica_manager.ready_urls()}
+        # Per-replica QoS pressure (from the prober's /stats scrapes):
+        # the LB steers shed-prone classes away from hot replicas.
+        replica_qos = self.replica_manager.ready_qos()
+        if replica_qos:
+            resp['replica_qos'] = replica_qos
+        return web.json_response(resp)
 
     async def _handle_update_service(self, request: web.Request
                                      ) -> web.Response:

@@ -36,6 +36,7 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
@@ -264,6 +265,20 @@ class SkyServeLoadBalancer:
         self.policy: lb_policies.LoadBalancingPolicy = \
             lb_policies.POLICIES[policy]()
         self.request_timestamps: List[float] = []
+        # QoS plane (docs/qos.md): per-replica pressure learned from
+        # the controller sync (the controller scrapes each replica's
+        # /stats 'qos' block), consulted when picking replicas; plus
+        # per-class demand and observed-shed buffers reported back so
+        # the QoS-aware autoscaler can scale on class demand + shed
+        # rate instead of raw request rate. All dormant with
+        # SKYT_QOS=0 (one env read per request).
+        self._replica_qos: Dict[str, dict] = {}
+        self._qos_demand: List[tuple] = []     # (ts, class)
+        self._qos_sheds: List[tuple] = []      # (ts, class)
+        self._m_qos_sheds_seen = reg.counter(
+            'skyt_lb_qos_sheds_observed_total',
+            'Upstream 429 shed responses proxied, by class',
+            ('class',))
         self._session: Optional[aiohttp.ClientSession] = None
         self._sync_task: Optional[asyncio.Task] = None
 
@@ -274,31 +289,45 @@ class SkyServeLoadBalancer:
         buffer grew without bound. Drop OLDEST beyond the cap — recent
         timestamps drive autoscaling decisions — and count drops."""
         cap = int(_env_float('SKYT_LB_MAX_PENDING_TIMESTAMPS', 16384))
-        over = len(self.request_timestamps) - max(cap, 1)
-        if over > 0:
-            del self.request_timestamps[:over]
-            self._m_sync_dropped.inc(over)
+        for buf in (self.request_timestamps, self._qos_demand,
+                    self._qos_sheds):
+            over = len(buf) - max(cap, 1)
+            if over > 0:
+                del buf[:over]
+                self._m_sync_dropped.inc(over)
 
     async def _sync_with_controller(self) -> None:
-        """Reference: :58 — report request timestamps, fetch ready
-        replicas."""
+        """Reference: :58 — report request timestamps (plus per-class
+        QoS demand/shed buffers), fetch ready replicas and their QoS
+        pressure."""
         assert self._session is not None
         while True:
             ts, self.request_timestamps = self.request_timestamps, []
+            qd, self._qos_demand = self._qos_demand, []
+            qs, self._qos_sheds = self._qos_sheds, []
+            payload = {'request_timestamps': ts}
+            if qd or qs:
+                payload['qos_demand'] = [[t, c] for t, c in qd]
+                payload['qos_sheds'] = [[t, c] for t, c in qs]
             try:
                 async with self._session.post(
                         self.controller_url +
                         '/controller/load_balancer_sync',
-                        json={'request_timestamps': ts},
+                        json=payload,
                         headers=self._controller_headers,
                         timeout=aiohttp.ClientTimeout(total=5)) as resp:
                     data = await resp.json()
                     ready = data.get('ready_replica_urls', [])
                     self.policy.set_ready_replicas(ready)
+                    rq = data.get('replica_qos')
+                    self._replica_qos = rq if isinstance(rq, dict) \
+                        else {}
                     self._prune_replica_metrics(ready)
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning('controller sync failed: %s', e)
                 self.request_timestamps = ts + self.request_timestamps
+                self._qos_demand = qd + self._qos_demand
+                self._qos_sheds = qs + self._qos_sheds
                 self._cap_timestamps()
             await asyncio.sleep(_sync_interval())
 
@@ -336,17 +365,45 @@ class SkyServeLoadBalancer:
                 pass  # replica-side parsing 400s on malformed values
         return time.monotonic() + max(budget, 0.0)
 
-    def _pick_replica_once(self, tried: Set[str]) -> Optional[str]:
+    def _qos_avoid_for(self, cls: Optional[str]) -> Set[str]:
+        """Replicas whose last-synced QoS pressure says they would
+        shed `cls` right now. Best-effort: _pick_replica_once drops
+        the set when it would leave nothing to pick."""
+        if cls is None or not self._replica_qos:
+            return set()
+        avoid = set()
+        for replica, info in self._replica_qos.items():
+            try:
+                level = int(info.get('level', 0))
+            except (TypeError, ValueError, AttributeError):
+                continue
+            if cls in qos_lib.shed_avoid_classes(level):
+                avoid.add(replica)
+        return avoid
+
+    def _pick_replica_once(self, tried: Set[str],
+                           qos_avoid: Optional[Set[str]] = None
+                           ) -> Optional[str]:
         """One selection honoring the breaker, preferring replicas this
         request has not failed on yet; falls back to tried ones (with
         backoff upstream) before giving up. Breaker filtering uses the
         read-only blocked() check; the side-effecting allow() — which
         claims the one half-open trial — runs only on the replica
-        actually picked. None => nothing eligible right now."""
+        actually picked. `qos_avoid` (replicas currently shedding this
+        request's class) is a SOFT preference: dropped entirely when
+        honoring it would leave no candidate. None => nothing eligible
+        right now."""
         ready = list(self.policy.ready_replicas)
         denied = {r for r in ready if self.breaker.blocked(r)}
+        soft = set(qos_avoid or ())
         while True:
-            replica = self.policy.select_replica(exclude=tried | denied)
+            replica = self.policy.select_replica(
+                exclude=tried | denied | soft)
+            if replica is None and soft:
+                # Pressure avoidance must never turn into an outage:
+                # a shedding replica still beats no replica.
+                soft = set()
+                continue
             if replica is None and tried:
                 replica = self.policy.select_replica(exclude=denied)
             if replica is None:
@@ -363,7 +420,9 @@ class SkyServeLoadBalancer:
 
     async def _wait_for_replica(self, request: web.Request,
                                 tried: Set[str],
-                                deadline: float) -> Optional[str]:
+                                deadline: float,
+                                qos_avoid: Optional[Set[str]] = None
+                                ) -> Optional[str]:
         """Poll for an eligible replica until `deadline`, aborting the
         moment the client disconnects (satellite: the old code held the
         slot for the full 30 s no-replica window). Poll interval is
@@ -377,7 +436,7 @@ class SkyServeLoadBalancer:
         service still starting up)."""
         poll = max(_env_float('SKYT_LB_NO_REPLICA_POLL_S', 1.0), 0.01)
         while True:
-            replica = self._pick_replica_once(tried)
+            replica = self._pick_replica_once(tried, qos_avoid)
             if replica is not None:
                 return replica
             if self.policy.ready_replicas:
@@ -400,6 +459,20 @@ class SkyServeLoadBalancer:
         response alongside `X-Replica-Id`, so client-side correlation
         works even with tracing sampled out."""
         self.request_timestamps.append(time.time())
+        qos_cls = None
+        if qos_lib.enabled():
+            # Early 400 on a malformed header (the replica would
+            # reject it anyway); both headers then propagate to the
+            # replica untouched. Demand is recorded per class for the
+            # QoS-aware autoscaler.
+            try:
+                qos_cls = qos_lib.parse_priority(
+                    request.headers.get('X-Priority'))
+                qos_lib.parse_tenant(request.headers.get('X-Tenant'))
+            except ValueError as e:
+                return web.json_response({'error': str(e)},
+                                         status=400)
+            self._qos_demand.append((time.time(), qos_cls))
         self._cap_timestamps()
         body = await request.read()
         req_id = request.headers.get('X-Request-Id') or \
@@ -423,18 +496,31 @@ class SkyServeLoadBalancer:
                 attributes={'http.method': request.method,
                             'http.path': str(request.rel_url),
                             'request_id': req_id}) as span:
+            if qos_cls is not None:
+                span.set_attribute('qos.class', qos_cls)
             while True:
                 with self._tracer.start_span('lb.pick_replica') as pick:
                     try:
                         replica = await self._wait_for_replica(
                             request, tried,
                             no_replica_deadline if attempt == 0
-                            else deadline)
+                            else deadline,
+                            qos_avoid=self._qos_avoid_for(qos_cls))
                     except ConnectionResetError:
                         pick.set_attribute('error', 'client gone')
                         span.set_attribute('http.status', 499)
                         raise
                     if replica is None:
+                        # Retry-After from the live backoff state
+                        # (satellite): with ready-but-blocked replicas
+                        # the breaker cooldown is when a half-open
+                        # trial next unblocks; with nothing ready the
+                        # next controller sync is the next chance a
+                        # replica appears.
+                        retry_after = qos_lib.retry_after_header(
+                            self.breaker.cooldown_s
+                            if self.policy.ready_replicas
+                            else max(_sync_interval(), 1.0))
                         if last_err is not None:
                             # This request already failed somewhere and
                             # everything left is breaker-blocked: 502
@@ -445,7 +531,8 @@ class SkyServeLoadBalancer:
                             span.set_attribute('retries', attempt - 1)
                             return web.Response(
                                 status=502,
-                                headers={'X-Request-Id': req_id},
+                                headers={'X-Request-Id': req_id,
+                                         'Retry-After': retry_after},
                                 text=f'All replicas failing (circuit '
                                      f'open) after {attempt} '
                                      f'attempt(s): {last_err}')
@@ -454,7 +541,8 @@ class SkyServeLoadBalancer:
                         span.set_attribute('http.status', 503)
                         return web.Response(
                             status=503,
-                            headers={'X-Request-Id': req_id},
+                            headers={'X-Request-Id': req_id,
+                                     'Retry-After': retry_after},
                             text='No available replicas (none ready, '
                                  'or every replica is circuit-open). '
                                  'Use "skyt serve status" to check '
@@ -470,6 +558,12 @@ class SkyServeLoadBalancer:
                     self._m_inflight.labels(replica).dec()
                     self.policy.on_request_done(replica)
                 if isinstance(result, web.StreamResponse):
+                    if qos_cls is not None and result.status == 429:
+                        # An upstream shed/throttle passed through:
+                        # the observed shed rate is the QoS-aware
+                        # autoscaler's scale-up signal.
+                        self._qos_sheds.append((time.time(), qos_cls))
+                        self._m_qos_sheds_seen.labels(qos_cls).inc()
                     span.set_attribute('http.status', result.status)
                     if attempt:
                         span.set_attribute('retries', attempt)

@@ -341,11 +341,15 @@ class ReplicaManager:
         except requests.RequestException:
             return False
 
+    # 'qos' is the replica's QoS pressure block (overload level,
+    # per-class queue depths) — forwarded to the LB via the sync
+    # response so replica picking can steer shed-prone classes away.
     _STATS_KEYS = ('ttft_ms', 'steady_decode_tok_per_sec',
-                   'active_slots', 'num_slots', 'waiting')
+                   'active_slots', 'num_slots', 'waiting', 'qos')
     # Scrape /stats only every Kth probe pass: the scrape is a serial
     # blocking GET per READY replica inside the controller's one
-    # control thread, and the data is only read by `serve status`.
+    # control thread, and the data is only read by `serve status` and
+    # the LB's QoS pressure steering (best-effort, staleness-tolerant).
     _STATS_EVERY = 5
 
     def _fetch_stats(self, info: ReplicaInfo) -> Optional[dict]:
@@ -509,6 +513,19 @@ class ReplicaManager:
             return [r.endpoint for r in self.replicas.values()
                     if r.status is serve_state.ReplicaStatus.READY and
                     r.endpoint]
+
+    def ready_qos(self) -> dict:
+        """endpoint -> QoS pressure block for READY replicas whose
+        last /stats scrape carried one (engine servers with SKYT_QOS=1;
+        arbitrary user services simply never appear here)."""
+        with self._lock:
+            out = {}
+            for r in self.replicas.values():
+                if r.status is serve_state.ReplicaStatus.READY and \
+                        r.endpoint and isinstance(r.stats, dict) and \
+                        isinstance(r.stats.get('qos'), dict):
+                    out[r.endpoint] = r.stats['qos']
+            return out
 
     def num_alive(self) -> int:
         with self._lock:

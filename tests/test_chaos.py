@@ -754,6 +754,94 @@ def test_chaos_replica_kill_mid_burst(monkeypatch):
                 proc.kill()
 
 
+@pytest.mark.integration
+def test_chaos_batch_flood_sheds_only_batch(monkeypatch):
+    """QoS acceptance scenario (docs/qos.md) through the REAL LB ->
+    server -> engine stack: a batch-class flood against one replica
+    with SKYT_QOS=1 and aggressive shed thresholds. Every interactive
+    request must succeed (zero 429/5xx) while batch sheds are > 0 —
+    visible in the replica's /metrics AND in the LB's observed-shed
+    counter (the QoS-aware autoscaler's scale-up signal)."""
+    port = _free_port()
+    proc = _spawn_replica(port, extra_env={
+        'SKYT_QOS': '1',
+        'SKYT_QOS_QUEUE_DEGRADE': '1',
+        'SKYT_QOS_QUEUE_SHED': '2',
+        'SKYT_QOS_DEGRADE_MAX_TOKENS': '4',
+        'SKYT_QOS_RESERVE_SLOTS': '1',
+        'SKYT_QOS_REFRESH_S': '0.05',
+        'SKYT_QOS_HOLD_S': '5',
+        # Queue depth drives the drill; the debug model's TTFT jitter
+        # must not escalate the ladder on its own.
+        'SKYT_QOS_TTFT_SLO_MS': '0',
+    })
+    url = f'http://127.0.0.1:{port}'
+    try:
+        _wait_http(url + '/health', timeout=180, proc=proc)
+        lb, base, reg = _make_lb([url], monkeypatch, SKYT_QOS='1')
+        stop = threading.Event()
+
+        def flood():
+            s = requests.Session()
+            while not stop.is_set():
+                try:
+                    r = s.post(base + '/generate',
+                               json={'tokens': [3, 4, 5],
+                                     'max_tokens': 48},
+                               headers={'X-Priority': 'batch',
+                                        'X-Tenant': 'flooder'},
+                               timeout=60)
+                    if r.status_code == 429:
+                        # Well-behaved batch clients honor Retry-After
+                        # (capped so the flood persists through the
+                        # interactive probes).
+                        time.sleep(min(float(
+                            r.headers.get('Retry-After', 1)), 0.25))
+                except requests.RequestException:
+                    pass
+
+        flooders = [threading.Thread(target=flood, daemon=True)
+                    for _ in range(6)]
+        for th in flooders:
+            th.start()
+        time.sleep(2.0)             # let the backlog build + ladder arm
+        sess = requests.Session()
+        codes = []
+        for i in range(10):
+            r = sess.post(base + '/generate',
+                          json={'tokens': [i + 1, i + 2],
+                                'max_tokens': 4},
+                          headers={'X-Priority': 'interactive'},
+                          timeout=120)
+            codes.append(r.status_code)
+        stop.set()
+        for th in flooders:
+            th.join(timeout=60)
+        # Zero interactive 429/5xx: the flood only ever sheds batch.
+        assert codes == [200] * 10, codes
+        text = requests.get(url + '/metrics', timeout=5).text
+
+        def shed(cls):
+            for line in text.splitlines():
+                if line.startswith(
+                        f'skyt_qos_shed_total{{class="{cls}"}}'):
+                    return float(line.rsplit(' ', 1)[1])
+            return 0.0
+
+        assert shed('batch') > 0, 'batch flood never shed'
+        assert shed('interactive') == 0, 'interactive was shed'
+        # The LB saw the upstream 429s and attributed them to the
+        # batch class (the autoscaler's shed-rate signal).
+        observed = reg.counter('skyt_lb_qos_sheds_observed_total', '',
+                               ('class',))
+        assert observed.value('batch') > 0
+        assert observed.value('interactive') == 0
+        del lb
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
 # ========================================== preemption-safe training exit
 @pytest.mark.integration
 def test_sft_preemption_checkpoint_and_resume(tmp_path):

@@ -20,6 +20,11 @@ Checks:
     must at least log what it ignores (docs/robustness.md). The
     audited pre-existing sites live in _EXCEPT_PASS_OK; new deliberate
     ones need `# noqa` plus a comment saying why.
+  * direct `._waiting.put(` callsites in skypilot_tpu/infer/ outside
+    the QoS admission path (docs/qos.md) — with SKYT_QOS=1 the waiting
+    queue is the priority scheduler, and code enqueueing around the
+    sanctioned sites would bypass classing silently. The sanctioned
+    sites carry a `qos-admission` marker comment.
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -87,6 +92,28 @@ def _except_pass_issues(path: Path, tree, lines):
             f'{path}:{node.lineno}: except Exception: pass — silent '
             f'broad swallow; log it, narrow the exception, or add '
             f'`# noqa` with a justification')
+    return issues
+
+
+# QoS admission discipline (docs/qos.md): the engine's waiting queue
+# is the ONE priority-scheduling point — new code in infer/ must route
+# requests through engine.submit / the lockstep tick sync, never
+# enqueue directly. Sanctioned sites are marked `qos-admission`.
+_WAITING_PUT_RE = re.compile(r'\._waiting\.put\(')
+
+
+def _waiting_put_issues(path: Path, lines):
+    issues = []
+    for i, line in enumerate(lines, 1):
+        if not _WAITING_PUT_RE.search(line):
+            continue
+        if 'qos-admission' in line or 'noqa' in line:
+            continue
+        issues.append(
+            f'{path}:{i}: direct ._waiting.put( outside the QoS '
+            f'admission path — route through engine.submit so '
+            f'priority classing cannot be bypassed (or mark a '
+            f'sanctioned admission site with `# qos-admission`)')
     return issues
 
 
@@ -183,6 +210,9 @@ def check_file(path: Path):
 
     if any(path.as_posix().endswith(p) for p in _NO_SYNC_IN_LOOPS):
         issues += _loop_sync_issues(path, tree, lines)
+
+    if 'skypilot_tpu/infer/' in path.as_posix():
+        issues += _waiting_put_issues(path, lines)
 
     if 'skypilot_tpu' in path.as_posix() and not any(
             path.as_posix().endswith(p) for p in _EXCEPT_PASS_OK):

@@ -387,6 +387,110 @@ else
     FAIL=1
 fi
 
+echo "== 8. QoS overload drill: a batch-class flood against one"
+echo "   replica with SKYT_QOS=1 — every interactive request must"
+echo "   succeed (zero 429/5xx) while batch sheds are > 0 =="
+if timeout 900 python - <<'PYEOF' 2>&1 | tee "$OUT/qos_drill.txt"
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import requests
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+port = free_port()
+url = f'http://127.0.0.1:{port}'
+env = dict(os.environ)
+env.update({
+    'SKYT_QOS': '1',
+    'SKYT_QOS_QUEUE_DEGRADE': '1',
+    'SKYT_QOS_QUEUE_SHED': '2',
+    'SKYT_QOS_DEGRADE_MAX_TOKENS': '4',
+    'SKYT_QOS_REFRESH_S': '0.05',
+    'SKYT_QOS_HOLD_S': '5',
+    'SKYT_QOS_TTFT_SLO_MS': '0',
+    'SKYT_QOS_RESERVE_SLOTS': '1',
+})
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(port),
+     '--num-slots', '2', '--max-seq-len', '128'], env=env)
+try:
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(f'replica died rc={proc.returncode}')
+        try:
+            if requests.get(url + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(1)
+    else:
+        raise SystemExit('replica never became healthy')
+    stop = threading.Event()
+    def flood():
+        s2 = requests.Session()
+        while not stop.is_set():
+            try:
+                r = s2.post(url + '/generate',
+                            json={'tokens': [3, 4, 5],
+                                  'max_tokens': 48},
+                            headers={'X-Priority': 'batch',
+                                     'X-Tenant': 'flooder'},
+                            timeout=120)
+                if r.status_code == 429:
+                    time.sleep(min(float(
+                        r.headers.get('Retry-After', 1)), 0.25))
+            except requests.RequestException:
+                pass
+    flooders = [threading.Thread(target=flood, daemon=True)
+                for _ in range(6)]
+    for th in flooders:
+        th.start()
+    time.sleep(2)
+    sess = requests.Session()
+    codes = []
+    for i in range(12):
+        r = sess.post(url + '/generate',
+                      json={'tokens': [i + 1, i + 2], 'max_tokens': 4},
+                      headers={'X-Priority': 'interactive'},
+                      timeout=120)
+        codes.append(r.status_code)
+    stop.set()
+    for th in flooders:
+        th.join(timeout=30)
+    bad = [c for c in codes if c != 200]
+    assert not bad, f'interactive failures under flood: {codes}'
+    text = requests.get(url + '/metrics', timeout=5).text
+    def shed(cls):
+        for line in text.splitlines():
+            if line.startswith(f'skyt_qos_shed_total{{class="{cls}"}}'):
+                return float(line.rsplit(' ', 1)[1])
+        return 0.0
+    assert shed('batch') > 0, 'batch flood never shed'
+    assert shed('interactive') == 0, 'interactive was shed'
+    print(f'QOS_DRILL_OK 12/12 interactive ok, '
+          f'{shed("batch"):.0f} batch sheds, 0 interactive sheds')
+finally:
+    if proc.poll() is None:
+        proc.kill()
+PYEOF
+then
+    echo "== QoS overload drill: PASS =="
+else
+    echo "== QoS overload drill: FAIL (see $OUT/qos_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
