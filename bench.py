@@ -1135,6 +1135,10 @@ def main() -> None:
             'vs_baseline': (round(mfu_p / BASELINE_MFU, 4)
                             if mfu_p is not None else None),
             'extra_metrics': partial['extra'],
+            # Distinct from 'tpu_unreachable': the device WAS acquired
+            # and partial metrics may be valid — a mid-run hang is
+            # worth an immediate retry, a dead tunnel is not.
+            'status': 'device_hang',
             'error': 'bench watchdog: device call never returned '
                      '(accelerator hung)'}), flush=True)
         os._exit(0)
@@ -1160,9 +1164,18 @@ def main() -> None:
     try:
         dev = _acquire_device()
     except (Exception, DeviceUnavailable) as e:  # pylint: disable=broad-except
+        # Structured fail-fast: a dead tunnel is an OPERATIONAL state,
+        # not a bench bug — downstream tooling (and the next session
+        # reading BENCH_r*.json) matches on status == 'tpu_unreachable'
+        # instead of parsing the error prose. The probe loop above
+        # bounded the wait (SKYT_BENCH_INIT_RETRY_S), so this line is
+        # reached in minutes, never a wedge.
+        status = ('tpu_unreachable' if isinstance(e, DeviceUnavailable)
+                  else 'backend_init_failed')
         print(json.dumps({
             'metric': partial['metric'], 'value': None, 'unit': 'MFU',
             'vs_baseline': None, 'extra_metrics': [],
+            'status': status,
             'error': f'backend init failed: {e!r}'}), flush=True)
         # A stuck init thread may still hold jax's backend lock;
         # interpreter shutdown (atexit) could block on it. Hard-exit —

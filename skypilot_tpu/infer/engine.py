@@ -1549,9 +1549,15 @@ class InferenceEngine:
             active = sum(1 for s in self._slots if s is not None)
         waiting = self._waiting.qsize() + (1 if self._deferred is not None
                                            else 0)
+        # Which kernel rung each op compiled to (tuned/conservative
+        # Pallas or the XLA floor) — silent kernel degradation must be
+        # visible wherever operators already look (docs/kernels.md).
+        from skypilot_tpu.ops import dispatch as ops_dispatch
         return {'active_slots': active, 'num_slots': self.num_slots,
                 'waiting': waiting,
-                'ready': self.ready.is_set(), **self.perf_stats()}
+                'ready': self.ready.is_set(),
+                'kernel_paths': ops_dispatch.snapshot(),
+                **self.perf_stats()}
 
     def perf_stats(self) -> Dict[str, float]:
         """Decode counters; steady_decode_tok_per_sec is the pipelined

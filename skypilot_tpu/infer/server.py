@@ -331,6 +331,12 @@ class InferenceServer:
             snap['qos_queue'] = depths
         if self._qos is not None:
             snap['qos_level'] = self._qos.overload.level()
+        # Kernel dispatch paths: a slow trace that coincides with the
+        # attention ladder degrading to the XLA rung should say so.
+        from skypilot_tpu.ops import dispatch as ops_dispatch
+        paths = ops_dispatch.snapshot()
+        if paths:
+            snap['kernel_paths'] = paths
         return snap
 
     def _bridge_engine_spans(self, span, rids) -> None:

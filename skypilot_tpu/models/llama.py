@@ -448,17 +448,40 @@ class LlamaAttention(nn.Module):
                         k_pool, k, tables, pos)
                     v_pool = PagePool.append_tokens_layer(
                         v_pool, v, tables, pos)
+                from skypilot_tpu.ops import dispatch
+
+                def _xla_gather():
+                    # Gather view + masked XLA reference: the
+                    # correctness floor of the paged ladder, and the
+                    # only correct math for window/softcap/scale
+                    # models (cfg.needs_xla_attention).
+                    k_view = PagePool.gather_view_layer(k_pool, tables)
+                    v_view = PagePool.gather_view_layer(v_pool, tables)
+                    return _cached_attention(q, k_view, v_view,
+                                             positions, cfg, window,
+                                             window_active)
+
                 if s == 1 and not cfg.needs_xla_attention and \
                         _os.environ.get(
                             'SKYT_PAGED_ATTN', 'pallas') == 'pallas':
-                    # Pallas kernel DMAs each slot's pages directly (no
-                    # materialized contiguous view; escape hatch:
+                    # Pallas kernel DMAs each slot's pages directly
+                    # (no materialized contiguous view; escape hatch:
                     # SKYT_PAGED_ATTN=xla). The engine pins the pool's
                     # jit-boundary layout so the scatter above and this
-                    # kernel agree (engine._pin_paged_layouts).
+                    # kernel agree (engine._pin_paged_layouts). Routed
+                    # through the dispatch ladder: a trace-time kernel
+                    # failure (or an armed ops.lowering fault) degrades
+                    # to the gather view instead of killing the serve
+                    # path, and the chosen path lands in
+                    # skyt_ops_kernel_path_total{op="paged_attention"}.
                     from skypilot_tpu.ops import paged_attention
-                    out = paged_attention.paged_decode_attention(
-                        q[:, 0], k_pool, v_pool, tables, pos)[:, None]
+                    out = dispatch.run_ladder('paged_attention', [
+                        ('pallas',
+                         lambda: paged_attention.paged_decode_attention(
+                             q[:, 0], k_pool, v_pool, tables,
+                             pos)[:, None]),
+                        ('xla', _xla_gather),
+                    ])
                 elif s > 1 and not cfg.needs_xla_attention and \
                         _os.environ.get(
                             'SKYT_SPEC_PAGED_ATTN',
@@ -470,18 +493,24 @@ class LlamaAttention(nn.Module):
                     # engine parity on a real v5e
                     # (tools/onchip_r05/attempt2,
                     # tests_tpu test_spec_mq_kernel_lowers); escape
-                    # hatch: SKYT_SPEC_PAGED_ATTN=xla.
+                    # hatch: SKYT_SPEC_PAGED_ATTN=xla. Same ladder as
+                    # the single-query path.
                     from skypilot_tpu.ops import paged_attention
-                    out = paged_attention.paged_decode_attention_mq(
-                        q, k_pool, v_pool, tables, pos)
+                    out = dispatch.run_ladder('paged_attention_mq', [
+                        ('pallas', lambda:
+                         paged_attention.paged_decode_attention_mq(
+                             q, k_pool, v_pool, tables, pos)),
+                        ('xla', _xla_gather),
+                    ])
                 else:
-                    # Window/softcap/scale models always land here:
-                    # the gather view + masked XLA reference is the
-                    # correct math (cfg.needs_xla_attention).
-                    k_view = PagePool.gather_view_layer(k_pool, tables)
-                    v_view = PagePool.gather_view_layer(v_pool, tables)
-                    out = _cached_attention(q, k_view, v_view, positions,
-                                            cfg, window, window_active)
+                    # 'xla_native': XLA is the REQUIRED math here
+                    # (needs_xla_attention / env escape hatch), not
+                    # ladder degradation — distinct label so the
+                    # degradation signal stays clean.
+                    out = dispatch.run_ladder(
+                        'paged_attention' if s == 1
+                        else 'paged_attention_mq',
+                        [('xla_native', _xla_gather)])
                 new_cache = (k_pool, v_pool)
             else:
                 k_cache, v_cache = cache

@@ -12,6 +12,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.ops import dispatch
+
 NEG_INF = -1e9  # logits are f32 until softmax, so -1e9 never overflows
 
 
@@ -82,9 +84,6 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, sq, hq, d)
 
 
-@functools.partial(jax.jit, static_argnames=('causal', 'impl', 'window',
-                                             'logit_softcap',
-                                             'softmax_scale'))
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = True,
               segment_ids: Optional[jax.Array] = None,
@@ -93,31 +92,79 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               window_active=None,
               logit_softcap: float = 0.0,
               softmax_scale: Optional[float] = None) -> jax.Array:
-    """Dispatch: 'auto' uses the Pallas flash kernel on TPU when shapes
-    allow, else the XLA reference. Soft-capped/rescaled attention
-    (Gemma-2) always takes the XLA path — the flash kernel does not
-    implement them, and a silent wrong-math fast path is worse than a
-    slower correct one. A STATIC sliding window (Mistral, Phi-3) has a
-    flash implementation (O(S*window) block visits) behind
-    SKYT_WINDOW_FLASH=on — opt-in until the on-chip gate proves the
-    lowering (the same discipline the paged MQ kernel went through);
-    Gemma-2's per-layer traced window gate (window_active) stays XLA
-    either way (the skip predicate must be static-per-kernel).
-    Explicit impl='flash' with a static window honors the request
-    without the env gate (it IS the opt-in). NOTE: like the other
-    SKYT_* kernel gates, the env var is read at TRACE time — under an
-    outer jit (the model) the choice is baked into the compiled
-    program, so set it before the process builds its engines, not
-    mid-run."""
+    """Public entry: eager autotune hook + the jit'd dispatch ladder.
+
+    When SKYT_AUTOTUNE=1 and the inputs are CONCRETE (not tracers —
+    i.e. this call is at setup/bench time, not inside a model trace),
+    a flash block-size sweep runs first for this shape if the autotune
+    cache has no entry; the jit'd ladder below then reads the winner.
+    One env check when disabled."""
     flash_unsupported = (logit_softcap > 0.0 or
                          softmax_scale is not None or
                          (window > 0 and window_active is not None))
-    window_flash = (window > 0 and window_active is None and
-                    os.environ.get('SKYT_WINDOW_FLASH', 'off') == 'on')
-    if impl == 'auto':
-        auto_xla = flash_unsupported or (window > 0 and
-                                         not window_flash)
-        impl = 'flash' if not auto_xla and _flash_ok(q, k) else 'xla'
+    if impl in ('auto', 'flash') and not flash_unsupported:
+        from skypilot_tpu.ops import autotune
+        # Gate the sweep on the SAME impl resolution the ladder uses:
+        # sweeping a shape whose dispatch resolves to the XLA path
+        # would burn minutes populating a cache entry nothing reads.
+        if (autotune.enabled() and not dispatch.is_tracer(q) and
+                _resolve_impl(q, k, impl, window, window_active,
+                              flash_unsupported,
+                              segment_ids is not None) == 'flash'):
+            autotune.maybe_sweep_flash(q, k, v, causal=causal,
+                                       segment_ids=segment_ids,
+                                       window=window)
+    return _attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                      impl=impl, window=window,
+                      window_active=window_active,
+                      logit_softcap=logit_softcap,
+                      softmax_scale=softmax_scale)
+
+
+@functools.partial(jax.jit, static_argnames=('causal', 'impl', 'window',
+                                             'logit_softcap',
+                                             'softmax_scale'))
+def _attention(q: jax.Array, k: jax.Array, v: jax.Array,
+               causal: bool = True,
+               segment_ids: Optional[jax.Array] = None,
+               impl: str = 'auto',
+               window: int = 0,
+               window_active=None,
+               logit_softcap: float = 0.0,
+               softmax_scale: Optional[float] = None) -> jax.Array:
+    """Dispatch: 'auto' prefers the Pallas flash kernel on TPU when
+    shapes allow, else the XLA reference — and every Pallas choice now
+    runs through the fallback ladder (ops/dispatch.py): tuned-Pallas →
+    default-Pallas → conservative full-array-block Pallas → XLA
+    reference, with the selected path recorded in
+    skyt_ops_kernel_path_total{op,path} and on the current trace span.
+    Soft-capped/rescaled attention (Gemma-2) always takes the XLA path
+    — the flash kernel does not implement them, and a silent
+    wrong-math fast path is worse than a slower correct one. A STATIC
+    sliding window (Mistral, Phi-3) has a flash implementation
+    (O(S*window) block visits) behind SKYT_WINDOW_FLASH=on — opt-in
+    until the on-chip gate proves the lowering (the same discipline
+    the paged MQ kernel went through); Gemma-2's per-layer traced
+    window gate (window_active) stays XLA either way (the skip
+    predicate must be static-per-kernel). Explicit impl='flash' with a
+    static window honors the request without the env gate (it IS the
+    opt-in). NOTE: like the other SKYT_* kernel gates, env vars are
+    read at TRACE time — under an outer jit (the model) the choice is
+    baked into the compiled program, so set them before the process
+    builds its engines, not mid-run."""
+    flash_unsupported = (logit_softcap > 0.0 or
+                         softmax_scale is not None or
+                         (window > 0 and window_active is not None))
+    impl = _resolve_impl(q, k, impl, window, window_active,
+                         flash_unsupported, segment_ids is not None)
+
+    def xla():
+        return mha_reference(q, k, v, causal=causal,
+                             segment_ids=segment_ids, window=window,
+                             window_active=window_active,
+                             logit_softcap=logit_softcap,
+                             softmax_scale=softmax_scale)
+
     if impl == 'flash':
         if flash_unsupported:
             offender = ('logit_softcap' if logit_softcap > 0.0 else
@@ -125,21 +172,72 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         else 'a traced window gate (window_active)')
             raise ValueError(
                 f'flash attention does not support {offender}')
-        from skypilot_tpu.ops import flash_attention
-        return flash_attention.flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids,
-            window=window)
-    return mha_reference(q, k, v, causal=causal, segment_ids=segment_ids,
-                         window=window, window_active=window_active,
-                         logit_softcap=logit_softcap,
-                         softmax_scale=softmax_scale)
+        from skypilot_tpu.ops import autotune
+        from skypilot_tpu.ops import flash_attention as flash_lib
+        sq, sk = q.shape[1], k.shape[1]
+        has_seg = segment_ids is not None
+
+        def rung(bq, bk):
+            return lambda: flash_lib.flash_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                block_q=bq, block_k=bk, window=window)
+
+        rungs = []
+        tuned = autotune.lookup_flash(q.shape, k.shape, q.dtype,
+                                      causal, has_seg, window)
+        if tuned is not None and tuned != (flash_lib.DEFAULT_BLOCK_Q,
+                                           flash_lib.DEFAULT_BLOCK_K):
+            rungs.append(('pallas_tuned', rung(*tuned)))
+        rungs.append(('pallas', rung(flash_lib.DEFAULT_BLOCK_Q,
+                                     flash_lib.DEFAULT_BLOCK_K)))
+        eff = dispatch.flash_blocks(sq, sk, flash_lib.DEFAULT_BLOCK_Q,
+                                    flash_lib.DEFAULT_BLOCK_K,
+                                    q.dtype, has_seg)
+        if eff != (sq, sk):   # else 'pallas' IS the full-block rung
+            rungs.append(('pallas_full', rung(sq, sk)))
+        rungs.append(('xla', xla))
+        return dispatch.run_ladder('flash_attention', rungs)
+    # 'xla_native': XLA is the CORRECT path for this op (softcap /
+    # scale / traced window / auto-resolved shape), not ladder
+    # degradation — keep it distinguishable from the 'xla' floor so
+    # operators (and tpu_validation's scrape) don't learn to ignore
+    # the real degradation signal.
+    return dispatch.run_ladder('attention', [('xla_native', xla)])
 
 
-def _flash_ok(q: jax.Array, k: jax.Array) -> bool:
+def _resolve_impl(q, k, impl: str, window: int, window_active,
+                  flash_unsupported: bool, has_seg: bool) -> str:
+    """The 'auto' gate, shared by the eager autotune hook and the
+    jit'd ladder so both agree on whether flash is in play."""
+    if impl != 'auto':
+        return impl
+    window_flash = (window > 0 and window_active is None and
+                    os.environ.get('SKYT_WINDOW_FLASH', 'off') == 'on')
+    auto_xla = flash_unsupported or (window > 0 and not window_flash)
+    return ('flash' if not auto_xla and _flash_ok(q, k, has_seg)
+            else 'xla')
+
+
+def _flash_ok(q: jax.Array, k: jax.Array, has_seg: bool = False) -> bool:
+    """Auto-dispatch gate: shapes where the flash kernel is expected
+    to WIN on TPU (tile-aligned seqs, MXU-friendly head dim, blocks
+    that fit VMEM). Any shape outside this set still works — it takes
+    the XLA reference rung instead, and an explicit impl='flash' gets
+    the shape-robust clamped blocks. has_seg matters: packed-sequence
+    blocks must be 128-aligned or full-array, so a seq that clamps to
+    a full-array block can blow the VMEM guard that a seg-less probe
+    would pass."""
     try:
         on_tpu = jax.devices()[0].platform == 'tpu'
     except Exception:  # pylint: disable=broad-except
         on_tpu = False
     sq, sk, d = q.shape[1], k.shape[1], q.shape[3]
-    return (on_tpu and sq % 128 == 0 and sk % 128 == 0 and
-            d in (64, 128, 256))
+    if not (on_tpu and sq % 8 == 0 and sk % 8 == 0 and
+            d % 64 == 0 and d <= 512):
+        return False
+    from skypilot_tpu.ops import flash_attention as flash_lib
+    bq, bk = dispatch.flash_blocks(sq, sk, flash_lib.DEFAULT_BLOCK_Q,
+                                   flash_lib.DEFAULT_BLOCK_K,
+                                   q.dtype, has_seg)
+    return dispatch.flash_vmem_ok(bq, bk, d,
+                                  jnp.dtype(q.dtype).itemsize)

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from skypilot_tpu.ops import dispatch
+
 # jax renamed TPUCompilerParams -> CompilerParams (~0.5); support both
 # so the kernels work on whichever jax the image ships.
 _CompilerParams = getattr(pltpu, 'CompilerParams',
@@ -81,8 +83,8 @@ def _block_mask(s, qi, ki, block_q, block_k, causal, window,
         if window > 0:
             s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
     if q_seg_ref is not None:
-        q_seg = q_seg_ref[0]              # [block_q]
-        k_seg = k_seg_ref[0]              # [block_k]
+        q_seg = q_seg_ref[0, 0]           # [block_q]
+        k_seg = k_seg_ref[0, 0]           # [block_k]
         s = jnp.where(q_seg[:, None] == k_seg[None, :], s, NEG_INF)
     return s
 
@@ -289,6 +291,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     window: int = 0) -> jax.Array:
     """q: [B, Sq, Hq, D]; k, v: [B, Sk, Hkv, D] -> [B, Sq, Hq, D].
 
+    block_q/block_k are REQUESTS, not contracts: they are clamped
+    through the divisibility-safe selector (ops/dispatch.py) to a
+    tile-aligned divisor of the seq dims or to the full dims, so any
+    legal input shape lowers — decode shapes included. Serving/train
+    call sites should go through ops.attention's dispatch ladder,
+    which adds the conservative-Pallas and XLA fallback rungs.
+
     segment_ids: optional [B, S] int32 packed-sequence ids, masked
     in-kernel (forward and backward).
     window: sliding-window attention (> 0: query p sees k in
@@ -308,25 +317,39 @@ def _flash(q, k, v, segment_ids, causal, block_q, block_k, window):
     return out
 
 
-def _shape_checks(q, k, block_q, block_k):
+def _shape_checks(q, k, block_q, block_k, has_seg=False):
+    """Shape-robust block selection (docs/kernels.md): requested
+    blocks are CLAMPED through the divisibility-safe selector — to a
+    tile-aligned divisor of the seq dim, or to the full dim (always
+    legal) — so any legal input shape lowers; decode shapes like the
+    BENCH_r02 (4, 32, 8, 256) no longer raise. A block pair whose
+    VMEM working set cannot fit is refused at TRACE time (a
+    ValueError the dispatch ladder catches), because the Mosaic
+    compile error it would become is not catchable."""
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
-    assert hq % hkv == 0, (hq, hkv)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q,
-                                                     block_k)
+    if hq % hkv != 0:
+        raise ValueError(
+            f'q heads ({hq}) must be a multiple of kv heads ({hkv})')
+    block_q, block_k = dispatch.flash_blocks(sq, sk, block_q, block_k,
+                                             q.dtype, has_seg)
+    if not _interpret_mode() and not dispatch.flash_vmem_ok(
+            block_q, block_k, d, jnp.dtype(q.dtype).itemsize):
+        raise ValueError(
+            f'flash blocks ({block_q}, {block_k}) x d={d} exceed the '
+            f'VMEM budget ({dispatch.VMEM_BUDGET_BYTES}B) — refusing '
+            'a certain Mosaic compile failure')
     return b, sq, sk, hq, hkv, d, block_q, block_k
 
 
 def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k,
                     window=0):
+    has_seg = segment_ids is not None
     b, sq, sk, hq, hkv, d, block_q, block_k = _shape_checks(
-        q, k, block_q, block_k)
+        q, k, block_q, block_k, has_seg)
     group = hq // hkv
     nq, nk = sq // block_q, sk // block_k
     scale = d ** -0.5
-    has_seg = segment_ids is not None
 
     # Kernel layout: [B, H, S, D] (head-major so blocks are contiguous).
     qt = q.transpose(0, 2, 1, 3)
@@ -348,10 +371,16 @@ def _flash_fwd_impl(q, k, v, segment_ids, causal, block_q, block_k,
     ]
     operands = [qt, kt, vt]
     if has_seg:
-        seg = segment_ids.astype(jnp.int32)
+        # [b, 1, s] so the seq extent rides the LANE axis of the block
+        # ((1, 1, block) passes the Mosaic last-two-dims rule for any
+        # batch; the old [b, s] layout put the batch in the sublane
+        # slot, where a 1-extent block is illegal whenever b > 1).
+        seg = segment_ids.astype(jnp.int32)[:, None, :]
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
-            pl.BlockSpec((1, block_k), lambda bi, hi, qi, ki: (bi, ki)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, 0, ki)),
         ]
         operands += [seg, seg]
 
@@ -398,12 +427,12 @@ def _bwd_rule(causal, block_q, block_k, window, res, g):
                 window=window),
             q, k, v)
         return (*vjp(g), None)
+    has_seg = segment_ids is not None
     b, sq, sk, hq, hkv, d, block_q, block_k = _shape_checks(
-        q, k, block_q, block_k)
+        q, k, block_q, block_k, has_seg)
     group = hq // hkv
     nq, nk = sq // block_q, sk // block_k
     scale = d ** -0.5
-    has_seg = segment_ids is not None
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -430,10 +459,12 @@ def _bwd_rule(causal, block_q, block_k, window, res, g):
     ]
     operands = [qt, kt, vt, dot, lse, delta]
     if has_seg:
-        seg = segment_ids.astype(jnp.int32)
+        seg = segment_ids.astype(jnp.int32)[:, None, :]  # lane-axis seq
         common_in_specs += [
-            pl.BlockSpec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
-            pl.BlockSpec((1, block_k), lambda bi, hi, qi, ki: (bi, ki)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, 0, ki)),
         ]
         operands += [seg, seg]
 
@@ -475,8 +506,10 @@ def _bwd_rule(causal, block_q, block_k, window, res, g):
     ]
     if has_seg:
         dkv_in_specs += [
-            pl.BlockSpec((1, block_q), lambda bi, hi, ki, qi: (bi, qi)),
-            pl.BlockSpec((1, block_k), lambda bi, hi, ki, qi: (bi, ki)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, ki, qi: (bi, 0, ki)),
         ]
 
     dkv_kernel = functools.partial(

@@ -328,6 +328,15 @@ def main(argv=None) -> None:
                 batch = next(batches)
                 state, metrics = step_fn(state, batch)
                 dmetrics.on_step(metrics)   # device refs only — no sync
+                if step == start_step:
+                    # First step traced+compiled the model: say which
+                    # kernel ladder rung each op landed on, so a run
+                    # silently degraded to the XLA reference (e.g. an
+                    # un-lowerable shape) is visible in the job log.
+                    from skypilot_tpu.ops import dispatch as ops_dispatch
+                    paths = ops_dispatch.snapshot()
+                    if paths:
+                        logger.info('kernel dispatch paths: %s', paths)
                 tokens_seen += args.batch * args.seq * jax.process_count()
                 saved = ckpt.save(step + 1, state) \
                     if ckpt is not None else False

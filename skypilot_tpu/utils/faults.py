@@ -41,6 +41,13 @@ Example — kill a specific replica's server on its 3rd request:
 Determinism: probabilistic rules draw from a per-rule
 ``random.Random`` seeded from ``SKYT_FAULTS_SEED`` (default 0) and the
 rule's index, so a chaos run replays identically.
+
+Trace-time fault points: ``ops.lowering`` (skypilot_tpu/ops/dispatch.py)
+fires while jax TRACES a kernel dispatch ladder, i.e. once per compiled
+(shape, dtype) — not once per request — and forces descent to the next
+ladder rung (ultimately the pure-XLA reference). Arm it BEFORE the
+process compiles its engines; shapes compiled earlier keep their baked
+path (docs/kernels.md).
 """
 import dataclasses
 import os

@@ -1,0 +1,18 @@
+"""Compat shims for jax API drift across the versions images ship.
+
+The repo targets the jax the container bakes in (0.4.37 today) while
+following current-API idiom; each shim prefers the modern spelling and
+falls back to the legacy one, so the code reads forward and runs
+everywhere. (Same discipline as the pltpu.CompilerParams /
+TPUCompilerParams alias in ops/.)
+"""
+import jax
+
+
+def tree_leaves_with_path(tree, is_leaf=None):
+    """jax.tree.leaves_with_path (jax >= 0.4.38ish) with a fallback to
+    jax.tree_util.tree_leaves_with_path (0.4.x)."""
+    fn = getattr(getattr(jax, 'tree', None), 'leaves_with_path', None)
+    if fn is None:
+        fn = jax.tree_util.tree_leaves_with_path
+    return fn(tree, is_leaf=is_leaf)

@@ -12,3 +12,31 @@ def test_lint_clean():
         [sys.executable, os.path.join(REPO, 'tools', 'lint.py')],
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0, f'lint issues:\n{proc.stdout}'
+
+
+def test_lint_forbids_pallas_call_outside_ops(tmp_path):
+    """Kernel discipline: a bare pl.pallas_call outside skypilot_tpu/
+    ops/ must flag (all kernels route through the dispatch ladder);
+    the same call under ops/ must not."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / 'skypilot_tpu' / 'infer' / 'sneaky.py'
+    bad.parent.mkdir(parents=True)
+    bad.write_text('from jax.experimental import pallas as pl\n'
+                   'out = pl.pallas_call(lambda r: None)\n')
+    issues = lint.check_file(bad)
+    assert any('pallas_call outside' in i for i in issues), issues
+
+    ok = tmp_path / 'skypilot_tpu' / 'ops' / 'kernel.py'
+    ok.parent.mkdir(parents=True)
+    ok.write_text('from jax.experimental import pallas as pl\n'
+                  'out = pl.pallas_call(lambda r: None)\n')
+    assert not any('pallas_call' in i for i in lint.check_file(ok))
+
+    # noqa escape hatch.
+    bad.write_text('from jax.experimental import pallas as pl\n'
+                   'out = pl.pallas_call(lambda r: None)  # noqa\n')
+    assert not any('pallas_call' in i for i in lint.check_file(bad))

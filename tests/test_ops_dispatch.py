@@ -1,0 +1,412 @@
+"""Kernel dispatch layer: shape-robust block selection, the
+tuned-Pallas -> conservative-Pallas -> XLA fallback ladder, the
+autotune cache, and the ops.lowering chaos path (docs/kernels.md).
+
+Everything here runs on CPU: the Pallas rungs execute in interpreter
+mode (kernel logic exercised; the Mosaic legality rules are checked
+against the STATIC mirror in ops/dispatch.py, the same predicate jax's
+_check_block_mappings enforces on-chip).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+import requests
+
+from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import autotune
+from skypilot_tpu.ops import dispatch
+from skypilot_tpu.ops import flash_attention as flash_lib
+from skypilot_tpu.utils import faults
+from skypilot_tpu.utils import metrics as metrics_lib
+
+
+def _qkv(b, sq, sk, hq, hkv, d, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, hq, d), dtype)
+    k = jax.random.normal(ks[1], (b, sk, hkv, d), dtype)
+    v = jax.random.normal(ks[2], (b, sk, hkv, d), dtype)
+    return q, k, v
+
+
+# ------------------------------------------ static block-spec selection
+class TestBlockSelection:
+
+    def test_choose_block_mirrors_mosaic_rule(self):
+        """Every selection must satisfy the exact predicate jax's
+        _check_block_mappings enforces: block % tile == 0 or block ==
+        dim — plus our kernels' exact-division invariant."""
+        for dim in (1, 3, 8, 12, 17, 48, 128, 256, 300, 1000, 4096):
+            for want in (1, 8, 100, 128, 256, 512):
+                for mult in (8, 16, 32, 128):
+                    b = dispatch.choose_block(dim, want, mult)
+                    assert dispatch.block_dim_ok(b, dim, mult), \
+                        (dim, want, mult, b)
+                    assert dim % b == 0, (dim, want, mult, b)
+                    assert b <= dim
+
+    def test_choose_block_prefers_tile_aligned_divisor(self):
+        assert dispatch.choose_block(512, 256, 128) == 256
+        assert dispatch.choose_block(48, 256, 8) == 48   # full dim
+        assert dispatch.choose_block(48, 24, 8) == 24
+        # 300 has no 8-aligned divisor <= 256 -> full-array block.
+        assert dispatch.choose_block(300, 256, 8) == 300
+        # Decode-shaped: tiny dim -> full dim (equal arm of the rule).
+        assert dispatch.choose_block(8, 256, 8) == 8
+        assert dispatch.choose_block(1, 256, 8) == 1
+
+    def test_flash_blocks_seg_uses_lane_alignment(self):
+        # Packed sequences put the seq extent on the lane axis of the
+        # segment-id blocks -> 128-aligned (or full-dim) blocks only.
+        bq, bk = dispatch.flash_blocks(512, 512, 256, 256,
+                                       jnp.float32, True)
+        assert bq % 128 == 0 and bk % 128 == 0
+        bq, _ = dispatch.flash_blocks(48, 48, 32, 32, jnp.float32, True)
+        assert bq == 48   # no 128-aligned divisor -> full dim
+
+    def test_vmem_guard_refuses_impossible_blocks(self):
+        assert dispatch.flash_vmem_ok(256, 256, 128, 2)
+        assert not dispatch.flash_vmem_ok(8192, 8192, 256, 4)
+
+
+# ------------------------------------- shape grid over the public entry
+# Adversarial shapes: (b, sq, sk, hq, hkv, d). Includes the exact
+# BENCH_r02 decode shape (4, 32, 8, 256) in BOTH layout readings —
+# [B,Sq,Hq,D] and the [B,Hq,Sq,D] kernel layout it was logged in.
+SHAPE_GRID = [
+    (4, 32, 32, 8, 8, 256),     # BENCH_r02, API layout
+    (4, 8, 8, 32, 32, 256),     # BENCH_r02, kernel-layout reading
+    (2, 1, 1, 4, 2, 64),        # decode: single query token
+    (1, 300, 300, 2, 2, 64),    # non-pow2, non-8-divisible seq
+    (1, 48, 48, 4, 4, 64),      # tiny batch, sub-block seq
+    (3, 24, 24, 2, 1, 128),     # odd batch + GQA
+]
+
+
+class TestShapeGrid:
+
+    @pytest.mark.parametrize('shape', SHAPE_GRID,
+                             ids=['x'.join(map(str, s))
+                                  for s in SHAPE_GRID])
+    def test_no_shape_raises_and_matches_reference(self, shape):
+        """No grid shape may raise from the public ops entry point;
+        golden numerics vs the XLA reference in interpreter mode."""
+        b, sq, sk, hq, hkv, d = shape
+        q, k, v = _qkv(b, sq, sk, hq, hkv, d)
+        causal = sq == sk   # cross-length decode shapes: plain attn
+        out = attention_ops.attention(q, k, v, causal=causal,
+                                      impl='flash')
+        ref = attention_ops.mha_reference(q, k, v, causal=causal)
+        assert jnp.max(jnp.abs(out - ref)) < 2e-5
+        # The grid shape must also be statically LEGAL on the Pallas
+        # rung it took (the part interpreter mode cannot prove).
+        bq, bk = dispatch.flash_blocks(sq, sk, flash_lib.DEFAULT_BLOCK_Q,
+                                       flash_lib.DEFAULT_BLOCK_K,
+                                       q.dtype, False)
+        assert dispatch.block_dim_ok(bq, sq, 8)
+        assert dispatch.block_dim_ok(bk, sk, 8)
+
+    def test_bench_r02_shape_lowers_via_flash_impl(self):
+        """The headline regression: (4, 32, 8, 256) decode-shaped
+        arrays crashed Pallas lowering in r2. Assert the flash path is
+        actually TAKEN (not silently descended past)."""
+        dispatch.reset_for_tests()
+        jax.clear_caches()   # path records at TRACE time; force one
+        q, k, v = _qkv(4, 32, 32, 8, 8, 256, seed=7)
+        out = attention_ops.attention(q, k, v, impl='flash')
+        assert out.shape == q.shape
+        assert dispatch.snapshot().get('flash_attention') == 'pallas'
+
+    def test_grad_through_clamped_blocks(self):
+        q, k, v = _qkv(1, 24, 24, 2, 2, 64, seed=3)
+        g = jax.grad(lambda q_: flash_lib.flash_attention(
+            q_, k, v).sum())(q)
+        gr = jax.grad(lambda q_: attention_ops.mha_reference(
+            q_, k, v).sum())(q)
+        assert jnp.max(jnp.abs(g - gr)) < 2e-4
+
+    def test_segment_ids_batch_gt_one(self):
+        """Packed sequences with batch > 1: the [b, 1, s] lane-axis
+        segment layout must be legal AND numerically golden."""
+        q, k, v = _qkv(2, 64, 64, 4, 4, 64, seed=5)
+        seg = jnp.stack([jnp.repeat(jnp.arange(2), 32),
+                         jnp.repeat(jnp.arange(4), 16)]).astype(
+                             jnp.int32)
+        out = flash_lib.flash_attention(q, k, v, segment_ids=seg)
+        ref = attention_ops.mha_reference(q, k, v, segment_ids=seg)
+        assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+
+# --------------------------------------------------- the fallback ladder
+class TestLadder:
+
+    def teardown_method(self):
+        faults.reset()
+
+    def test_chaos_fault_descends_to_xla(self):
+        """SKYT_FAULTS=ops.lowering=error forces every Pallas rung to
+        fail at trace time; the XLA floor must serve the exact
+        reference output and the descent must be observable."""
+        dispatch.reset_for_tests()
+        faults.configure('ops.lowering=error')
+        c = metrics_lib.REGISTRY.counter(
+            'skyt_ops_kernel_path_total',
+            'Kernel dispatch path selected at trace time',
+            ('op', 'path'))
+        before = c.value('flash_attention', 'xla')
+        q, k, v = _qkv(1, 40, 40, 2, 2, 64, seed=11)  # fresh shape
+        out = attention_ops.attention(q, k, v, impl='flash')
+        ref = attention_ops.mha_reference(q, k, v)
+        assert jnp.max(jnp.abs(out - ref)) < 1e-6
+        assert dispatch.snapshot()['flash_attention'] == 'xla'
+        assert c.value('flash_attention', 'xla') == before + 1
+
+    def test_where_filter_targets_one_rung(self):
+        """where=path:pallas kills only the default-block rung; the
+        conservative full-array rung (present because 512 > the 256
+        default block) must pick it up — partial degradation, not a
+        collapse to XLA."""
+        dispatch.reset_for_tests()
+        faults.configure('ops.lowering=error,where=path:pallas')
+        q, k, v = _qkv(1, 512, 512, 1, 1, 64, seed=13)
+        out = attention_ops.attention(q, k, v, impl='flash')
+        ref = attention_ops.mha_reference(q, k, v)
+        assert jnp.max(jnp.abs(out - ref)) < 2e-5
+        assert dispatch.snapshot()['flash_attention'] == 'pallas_full'
+
+    def test_final_rung_never_fault_injected(self):
+        """The XLA floor is the correctness guarantee: an armed
+        ops.lowering fault must not be able to kill it."""
+        faults.configure('ops.lowering=error')
+        out = dispatch.run_ladder('t_final', [('xla', lambda: 42)])
+        assert out == 42
+
+    def test_forced_path_env(self, monkeypatch):
+        monkeypatch.setenv('SKYT_OPS_FORCE_PATH', 'xla')
+        dispatch.reset_for_tests()
+        q, k, v = _qkv(1, 56, 56, 2, 2, 64, seed=17)
+        out = attention_ops.attention(q, k, v, impl='flash')
+        ref = attention_ops.mha_reference(q, k, v)
+        assert jnp.max(jnp.abs(out - ref)) < 1e-6
+        assert dispatch.snapshot()['flash_attention'] == 'xla'
+
+
+# -------------------------------------------------------- autotune cache
+class TestAutotune:
+
+    def _arm(self, monkeypatch, tmp_path):
+        path = str(tmp_path / 'autotune.json')
+        monkeypatch.setenv('SKYT_AUTOTUNE', '1')
+        monkeypatch.setenv('SKYT_AUTOTUNE_CACHE', path)
+        monkeypatch.setenv('SKYT_AUTOTUNE_REPEATS', '1')
+        autotune.reset_for_tests()
+        return path
+
+    def teardown_method(self):
+        autotune.reset_for_tests()
+
+    def test_sweep_once_then_cache_hit(self, monkeypatch, tmp_path):
+        """Acceptance: a repeated invocation with the same
+        (device_kind, shape-bucket, dtype) key is a cache HIT — no
+        re-sweep — and the winner survives a 'process restart'
+        (in-memory copy dropped, reloaded from disk)."""
+        path = self._arm(monkeypatch, tmp_path)
+        sweeps = metrics_lib.REGISTRY.counter(
+            'skyt_ops_autotune_sweeps_total',
+            'Autotune block-size sweeps executed', ('op',))
+        hits = metrics_lib.REGISTRY.counter(
+            'skyt_ops_autotune_cache_hits_total',
+            'Autotune cache hits (sweep skipped)', ('op',))
+        s0 = sweeps.value('flash_attention')
+        h0 = hits.value('flash_attention')
+        q, k, v = _qkv(1, 16, 16, 2, 2, 32, seed=19)
+        attention_ops.attention(q, k, v, impl='flash')
+        assert sweeps.value('flash_attention') == s0 + 1
+        data = json.load(open(path))
+        assert data['version'] == 1 and data['entries']
+        (key, entry), = data['entries'].items()
+        assert 'flash_attention' in key and 'float32' in key
+        assert entry['block_q'] and entry['block_k']
+
+        # Same key again: hit, no re-sweep (different VALUES, same
+        # shape bucket).
+        q2, k2, v2 = _qkv(1, 16, 16, 2, 2, 32, seed=23)
+        attention_ops.attention(q2, k2, v2, impl='flash')
+        assert sweeps.value('flash_attention') == s0 + 1
+        assert hits.value('flash_attention') == h0 + 1
+
+        # 'New process': drop memory, read back from disk.
+        autotune.get_cache().forget_loaded()
+        got = autotune.lookup_flash(q.shape, k.shape, q.dtype,
+                                    True, False, 0)
+        assert got == (entry['block_q'], entry['block_k'])
+
+    def test_corrupt_cache_degrades_to_cold_start(self, monkeypatch,
+                                                  tmp_path):
+        """Acceptance: a corrupted cache file is a cold start, never a
+        raise — and the next sweep REWRITES it atomically."""
+        path = self._arm(monkeypatch, tmp_path)
+        q, k, v = _qkv(1, 16, 16, 2, 2, 32, seed=29)
+        attention_ops.attention(q, k, v, impl='flash')
+        with open(path, 'w') as f:
+            f.write('{"version": 1, "entries": {trailing garbage')
+        autotune.reset_for_tests()
+        assert autotune.lookup_flash(q.shape, k.shape, q.dtype,
+                                     True, False, 0) is None
+        # Re-tunes and leaves a valid file behind.
+        attention_ops.attention(q, k, v, impl='flash')
+        data = json.load(open(path))
+        assert data['entries']
+
+    def test_unexpected_layouts_are_cold_starts(self, monkeypatch,
+                                                tmp_path):
+        path = self._arm(monkeypatch, tmp_path)
+        for payload in ('[]', '{"version": 99, "entries": {}}',
+                        '{"entries": 3}', ''):
+            with open(path, 'w') as f:
+                f.write(payload)
+            autotune.reset_for_tests()
+            assert autotune.get_cache().get('k') is None
+
+    def test_candidate_failure_is_skipped_not_propagated(
+            self, monkeypatch, tmp_path):
+        self._arm(monkeypatch, tmp_path)
+        calls = []
+
+        def run(cand):
+            calls.append(cand)
+            if cand != 'good':
+                raise RuntimeError('boom')
+
+        entry = autotune.sweep('t_op', 'k1', ['bad1', 'good', 'bad2'],
+                               run, lambda c: {'pick': c})
+        assert entry['pick'] == 'good'
+        assert 'bad2' in calls   # sweep continued past the failure
+
+    def test_all_candidates_failing_returns_none(self, monkeypatch,
+                                                 tmp_path):
+        self._arm(monkeypatch, tmp_path)
+        calls = []
+
+        def run(cand):
+            calls.append(cand)
+            raise RuntimeError('boom')
+
+        assert autotune.sweep('t_op2', 'k2', [1, 2], run,
+                              lambda c: {}) is None
+        # The failure is negative-cached: a later sweep for the same
+        # key must NOT re-run the (minutes-on-device) failing sweep,
+        # and the poisoned entry reads as a miss for block lookups.
+        n = len(calls)
+        assert autotune.sweep('t_op2', 'k2', [1, 2], run,
+                              lambda c: {}) == {'failed': True}
+        assert len(calls) == n   # no candidate re-executed
+        assert autotune.get_cache().get('k2') == {'failed': True}
+
+    def test_disabled_is_a_noop(self, monkeypatch, tmp_path):
+        path = str(tmp_path / 'never.json')
+        monkeypatch.delenv('SKYT_AUTOTUNE', raising=False)
+        monkeypatch.setenv('SKYT_AUTOTUNE_CACHE', path)
+        autotune.reset_for_tests()
+        q, k, v = _qkv(1, 16, 16, 2, 2, 32, seed=31)
+        attention_ops.attention(q, k, v, impl='flash')
+        assert not os.path.exists(path)
+
+
+# ------------------------------------- chaos: ops.lowering mid-serve
+@pytest.mark.integration
+def test_mid_serve_lowering_chaos_zero_5xx():
+    """Acceptance drill: a serve burst with SKYT_FAULTS=
+    ops.lowering=error armed — every Pallas rung refuses to lower, the
+    engine compiles onto the XLA floor, and ALL requests complete with
+    output identical to an unfaulted replica's. Zero client-visible
+    5xx, skyt_ops_kernel_path_total{path="xla"} > 0."""
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    ports = [free_port(), free_port()]
+    envs = [{'SKYT_FAULTS': 'ops.lowering=error'}, {}]
+    procs = []
+    for port, extra in zip(ports, envs):
+        env = dict(os.environ, JAX_PLATFORMS='cpu', **extra)
+        procs.append(subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.infer.server',
+             '--model', 'debug', '--port', str(port),
+             '--num-slots', '2', '--max-seq-len', '64'],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    try:
+        for proc, url in zip(procs, urls):
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f'replica died rc={proc.returncode}')
+                try:
+                    if requests.get(url + '/health',
+                                    timeout=2).status_code == 200:
+                        break
+                except requests.RequestException:
+                    pass
+                time.sleep(0.5)
+            else:
+                raise AssertionError('replica never became healthy')
+
+        # Burst at the FAULTED replica (concurrent, mid-stream).
+        results = [None] * 8
+        def one(i):
+            r = requests.post(
+                urls[0] + '/generate',
+                json={'tokens': [i % 4 + 1, 5, 9], 'max_tokens': 6},
+                timeout=120)
+            results[i] = (r.status_code, r.json().get('tokens'))
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(r is not None for r in results), results
+        bad = [r for r in results if r[0] != 200]
+        assert not bad, f'client-visible failures: {bad}'
+
+        # Correctness through the degraded path: the unfaulted replica
+        # (same deterministic debug init) must emit identical tokens.
+        for i in (0, 1, 2, 3):
+            want = requests.post(
+                urls[1] + '/generate',
+                json={'tokens': [i % 4 + 1, 5, 9], 'max_tokens': 6},
+                timeout=120).json()['tokens']
+            assert results[i][1] == want, (i, results[i][1], want)
+
+        # The descent is observable: faulted replica compiled onto the
+        # XLA rung; the clean one is on Pallas.
+        text = requests.get(urls[0] + '/metrics', timeout=5).text
+        xla = [l for l in text.splitlines()
+               if l.startswith('skyt_ops_kernel_path_total')
+               and 'path="xla"' in l]
+        assert xla and any(float(l.rsplit(' ', 1)[1]) > 0
+                           for l in xla), text[:2000]
+        assert 'skyt_faults_fired_total{' in text
+        stats = requests.get(urls[0] + '/stats', timeout=5).json()
+        assert 'xla' in stats['kernel_paths'].values()
+        clean = requests.get(urls[1] + '/metrics', timeout=5).text
+        assert any(
+            l.startswith('skyt_ops_kernel_path_total')
+            and 'path="pallas' in l and float(l.rsplit(' ', 1)[1]) > 0
+            for l in clean.splitlines())
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()

@@ -111,6 +111,57 @@ class TestFlashKernelLowers:
         assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
 
 
+class TestDispatchShapeGridLowers:
+    """The never-crash contract ON-CHIP: every adversarial shape in
+    the CPU grid (tests/test_ops_dispatch.py) must lower through the
+    real Mosaic pipeline — this is the half the static mirror in
+    ops/dispatch.py cannot prove from CPU. Includes the exact
+    BENCH_r02 decode shape that zeroed rounds 2-5."""
+
+    @pytest.mark.parametrize('shape', [
+        (4, 32, 32, 8, 8, 256),     # BENCH_r02, API layout
+        (4, 8, 8, 32, 32, 256),     # BENCH_r02, kernel-layout reading
+        (2, 1, 1, 4, 2, 64),        # single-query decode
+        (1, 300, 300, 2, 2, 64),    # non-8-divisible seq
+        (3, 24, 24, 2, 1, 128),     # odd batch + GQA
+    ], ids=lambda s: 'x'.join(map(str, s)))
+    def test_grid_shape_lowers(self, shape):
+        from skypilot_tpu.ops.attention import mha_reference
+        from skypilot_tpu.ops.flash_attention import flash_attention
+
+        b, sq, sk, hq, hkv, d = shape
+        q = _rand(0, (b, sq, hq, d))
+        k = _rand(1, (b, sk, hkv, d))
+        v = _rand(2, (b, sk, hkv, d))
+        causal = sq == sk
+        out = jax.jit(flash_attention, static_argnames=('causal',))(
+            q, k, v, causal=causal)
+        ref = jax.jit(mha_reference, static_argnames=('causal',))(
+            q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
+    def test_segment_ids_batch_gt_one_lowers(self):
+        """Packed sequences with batch > 1: the [B, 1, S] lane-axis
+        segment layout must pass Mosaic (the old [B, S] layout was
+        illegal for any B > 1 — a latent train crash)."""
+        from skypilot_tpu.ops.flash_attention import flash_attention
+
+        b, s, hq, hkv, d = 2, 512, 4, 2, 128
+        q = _rand(0, (b, s, hq, d))
+        k = _rand(1, (b, s, hkv, d))
+        v = _rand(2, (b, s, hkv, d))
+        seg = jnp.concatenate(
+            [jnp.zeros((b, s // 2), jnp.int32),
+             jnp.ones((b, s // 2), jnp.int32)], axis=1)
+        out = jax.jit(flash_attention,
+                      static_argnames=('causal',))(q, k, v, causal=True,
+                                                   segment_ids=seg)
+        assert out.shape == (b, s, hq, d)
+        assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
 class TestTrainStepFlash:
     """One real train step with attn_impl='flash' at seq 512 (the r2 bug
     crashed any seq > 256)."""

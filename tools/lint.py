@@ -25,6 +25,12 @@ Checks:
     queue is the priority scheduler, and code enqueueing around the
     sanctioned sites would bypass classing silently. The sanctioned
     sites carry a `qos-admission` marker comment.
+  * bare `pl.pallas_call(` outside skypilot_tpu/ops/ — every kernel
+    must live in ops/ and route through the dispatch ladder
+    (ops/dispatch.py, docs/kernels.md) so it inherits shape-robust
+    block selection, the XLA fallback rung, and kernel-path metrics.
+    A Pallas call elsewhere would reintroduce the BENCH_r02 class of
+    hard lowering crash. Mark a deliberate exception with `# noqa`.
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -114,6 +120,30 @@ def _waiting_put_issues(path: Path, lines):
             f'admission path — route through engine.submit so '
             f'priority classing cannot be bypassed (or mark a '
             f'sanctioned admission site with `# qos-admission`)')
+    return issues
+
+
+# Kernel discipline (docs/kernels.md): pl.pallas_call may only appear
+# under skypilot_tpu/ops/ — call sites elsewhere go through the
+# dispatch ladder, which guarantees a legal block spec or an XLA
+# fallback. Comments are stripped before matching so prose can't flag;
+# a docstring mentioning the literal call form still would — mark
+# those (and deliberate exceptions) with `# noqa`.
+_PALLAS_CALL_RE = re.compile(r'\bpallas_call\s*\(')
+
+
+def _pallas_call_issues(path: Path, lines):
+    issues = []
+    for i, line in enumerate(lines, 1):
+        if not _PALLAS_CALL_RE.search(line.split('#', 1)[0]):
+            continue
+        if 'noqa' in line:
+            continue
+        issues.append(
+            f'{path}:{i}: pallas_call outside skypilot_tpu/ops/ — '
+            f'kernels live in ops/ and dispatch through '
+            f'ops/dispatch.run_ladder so every shape lowers or falls '
+            f'back (or add `# noqa` with a justification)')
     return issues
 
 
@@ -213,6 +243,10 @@ def check_file(path: Path):
 
     if 'skypilot_tpu/infer/' in path.as_posix():
         issues += _waiting_put_issues(path, lines)
+
+    if 'skypilot_tpu' in path.as_posix() and \
+            'skypilot_tpu/ops/' not in path.as_posix():
+        issues += _pallas_call_issues(path, lines)
 
     if 'skypilot_tpu' in path.as_posix() and not any(
             path.as_posix().endswith(p) for p in _EXCEPT_PASS_OK):

@@ -46,7 +46,13 @@ step() {  # step <name> <cmd...>: run, tee, record PASS/FAIL
 }
 
 echo "== 1. probe =="
-if ! timeout 45 python -c "import jax; print(jax.devices())"; then
+PROBE_TIMEOUT=${SKYT_TPU_PROBE_TIMEOUT_S:-45}
+if ! timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices())"; then
+    # Structured fail-fast (same contract as bench.py's backend-init
+    # artifact): a wedged tunnel yields a parseable tpu_unreachable
+    # record in the artifact dir, not just prose on stdout.
+    printf '{"status": "tpu_unreachable", "step": "probe", "timeout_s": %s}\n' \
+        "$PROBE_TIMEOUT" | tee "$OUT/probe.json"
     echo "tunnel wedged; aborting (re-run later)"; exit 1
 fi
 
@@ -488,6 +494,85 @@ then
     echo "== QoS overload drill: PASS =="
 else
     echo "== QoS overload drill: FAIL (see $OUT/qos_drill.txt) =="
+    FAIL=1
+fi
+
+echo "== 9. kernel-path scrape: the dispatch ladder must actually be"
+echo "   on the Pallas rung on-chip — a replica silently serving from"
+echo "   the XLA fallback would pass every correctness gate while"
+echo "   giving away the TPU's perf (docs/kernels.md) =="
+# Probe the platform in a SHORT-LIVED process before the server
+# exists: once the server subprocess owns the TPU, a jax.devices()
+# in the driver would either raise (device busy) or silently read
+# 'cpu' — defeating the on-chip degradation warning below.
+SKYT_VALIDATION_PLATFORM=$(timeout 60 python -c \
+    "import jax; print(jax.devices()[0].platform)" 2>/dev/null || echo unknown)
+export SKYT_VALIDATION_PLATFORM
+if timeout 600 python - <<'PYEOF' 2>&1 | tee "$OUT/kernel_paths.txt"
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+with socket.socket() as s:
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(port),
+     '--num-slots', '2', '--max-seq-len', '128'])
+base = f'http://127.0.0.1:{port}'
+try:
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            raise SystemExit(f'server died rc={proc.returncode}')
+        time.sleep(1)
+    else:
+        raise SystemExit('server never became healthy')
+    requests.post(base + '/generate',
+                  json={'tokens': [7, 8, 9], 'max_tokens': 8},
+                  timeout=120).raise_for_status()
+    text = requests.get(base + '/metrics', timeout=5).text
+    rows = [l for l in text.splitlines()
+            if l.startswith('skyt_ops_kernel_path_total')]
+    print('\n'.join(rows) or '(no kernel-path samples)')
+    pallas = sum(float(l.rsplit(' ', 1)[1]) for l in rows
+                 if 'path="pallas' in l)
+    xla = sum(float(l.rsplit(' ', 1)[1]) for l in rows
+              if 'path="xla"' in l)
+    assert pallas > 0, (
+        'no Pallas rung selected — the serve path is running entirely '
+        'on the XLA fallback; check the ladder warnings in the server '
+        'log')
+    on_tpu = os.environ.get('SKYT_VALIDATION_PLATFORM') == 'tpu'
+    if on_tpu and xla > 0:
+        print(f'WARNING: {xla:.0f} op(s) degraded to the XLA rung '
+              'on-chip — investigate before trusting perf numbers')
+    paths = requests.get(base + '/stats',
+                         timeout=5).json().get('kernel_paths', {})
+    print(f'KERNEL_PATHS_OK pallas={pallas:.0f} xla={xla:.0f} '
+          f'stats={paths}')
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PYEOF
+then
+    echo "== kernel-path scrape: PASS =="
+else
+    echo "== kernel-path scrape: FAIL (see $OUT/kernel_paths.txt) =="
     FAIL=1
 fi
 
