@@ -182,3 +182,16 @@ class NetworkError(SkyTpuError):
 
 class CheckpointError(SkyTpuError):
     """Checkpoint save/restore failure (Orbax layer)."""
+
+
+class ServeStateCorruptError(SkyTpuError):
+    """serve.db failed sqlite's integrity check (or is not a sqlite
+    file at all). Raised at open so a restarting controller fails fast
+    with a named error instead of reading garbage replica rows and
+    silently relaunching everything (docs/robustness.md)."""
+
+
+class ServeStateSchemaError(SkyTpuError):
+    """serve.db carries a schema stamp newer than this build knows.
+    Reading it with older code could misinterpret rows written by the
+    newer one — refuse loudly rather than guess."""

@@ -76,6 +76,15 @@ def _agent_pid(cluster_name: str, rank: int) -> Optional[int]:
         return None
 
 
+def head_agent_pid(cluster_name: str) -> Optional[int]:
+    """Public liveness identity for the serve control plane: the head
+    host's agent pid. A replica row recording this (plus its start
+    token, runtime/reaper.pid_start_token) lets a restarting serve
+    controller distinguish an adoptable live replica from a dead-pid
+    orphan without waiting out probe thresholds."""
+    return _agent_pid(cluster_name, 0)
+
+
 def _pid_alive(pid: Optional[int]) -> bool:
     if pid is None:
         return False

@@ -18,12 +18,16 @@ import os
 import signal
 import sys
 import time
+from typing import Optional
 
 POLL_INTERVAL_S = 1.0
 TERM_GRACE_S = 5.0
 
 
-def _alive(pid: int) -> bool:
+def pid_alive(pid: int) -> bool:
+    """Liveness probe shared with the serve control plane: the
+    controller's restart adoption (serve/replica_managers.py) uses the
+    same check to tell an adoptable replica from a dead-pid orphan."""
     try:
         os.kill(pid, 0)
     except ProcessLookupError:
@@ -39,6 +43,22 @@ def _alive(pid: int) -> bool:
             return f.read().rsplit(')', 1)[1].split()[0] != 'Z'
     except OSError:
         return True
+
+
+_alive = pid_alive
+
+
+def pid_start_token(pid: int) -> Optional[int]:
+    """Opaque identity token for a pid: the kernel's starttime field
+    (jiffies since boot, /proc/<pid>/stat field 22). A recorded
+    (pid, token) pair still matching means it is the SAME process, not
+    a reused pid — the guard the serve controller needs before
+    adopting a replica row that survived its own crash."""
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            return int(f.read().rsplit(')', 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 def _group_alive(pgid: int) -> bool:

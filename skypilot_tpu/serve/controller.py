@@ -9,6 +9,7 @@ on cluster operations).
 import asyncio
 import hmac
 import threading
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -17,6 +18,7 @@ from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 
@@ -26,6 +28,14 @@ import os
 
 def _loop_interval() -> float:
     return float(os.environ.get('SKYT_SERVE_CONTROLLER_INTERVAL', '2'))
+
+
+def _state_prune_interval() -> float:
+    return float(os.environ.get('SKYT_SERVE_STATE_PRUNE_S', '600'))
+
+
+def _state_terminal_ttl() -> float:
+    return float(os.environ.get('SKYT_SERVE_STATE_TTL_S', '3600'))
 
 
 class SkyServeController:
@@ -48,7 +58,14 @@ class SkyServeController:
         """Probe → autoscale → reconcile (reference's three daemon
         threads collapsed into one ordered loop: each phase feeds the
         next, and none is latency-critical)."""
+        next_prune = time.time() + _state_prune_interval()
         while not self._stop.is_set():
+            # Chaos hook: SKYT_FAULTS='controller.crash=crash' SIGKILLs
+            # the controller between phases — the restart-adoption
+            # drill (docs/robustness.md "Control plane"). Deliberately
+            # OUTSIDE the try: an injected 'error' kind must escape the
+            # loop's catch-all to count as a loop crash.
+            faults.inject('controller.crash')
             try:
                 self.replica_manager.probe_all()
                 ready = len(self.replica_manager.ready_urls())
@@ -59,6 +76,14 @@ class SkyServeController:
                     decision.target_num_replicas,
                     ondemand_base=ondemand_base)
                 self._update_service_status(ready)
+                if time.time() >= next_prune:
+                    next_prune = time.time() + _state_prune_interval()
+                    pruned = serve_state.prune_terminal_replicas(
+                        _state_terminal_ttl(),
+                        service_name=self.service_name)
+                    if pruned:
+                        logger.info('pruned %d terminal replica rows '
+                                    'from serve.db', pruned)
             except Exception:  # pylint: disable=broad-except
                 logger.exception('control loop iteration failed')
             self._stop.wait(_loop_interval())
@@ -122,6 +147,8 @@ class SkyServeController:
                 'endpoint': info.endpoint,
                 'version': info.version,
                 'use_spot': info.use_spot,
+                'pid': info.pid,
+                'adopted_at': info.adopted_at,
             })
         return web.json_response({
             'service': self.service_name,

@@ -270,7 +270,17 @@ def _force_cleanup(service_name: str) -> None:
             os.kill(svc['controller_pid'], 9)
         except OSError:
             pass
+    # Row FIRST, lease file second: unlinking a lease a live leader
+    # still flocks would let a standby acquire the fresh inode and
+    # believe it leads (split-brain window). With the row gone, every
+    # LB process — leader or lease-waiting standby — exits via its
+    # service-gone check, after which the unlink is just litter
+    # removal.
     serve_state.remove_service(service_name)
+    try:
+        os.remove(serve_state.lb_lease_path(service_name))
+    except OSError:
+        pass
 
 
 def status(service_names: Optional[List[str]] = None
@@ -280,6 +290,7 @@ def status(service_names: Optional[List[str]] = None
     if service_names:
         wanted = set(service_names)
         services = [s for s in services if s['name'] in wanted]
+    from skypilot_tpu.serve import replica_managers
     out = []
     for svc in services:
         replicas = [{
@@ -289,10 +300,11 @@ def status(service_names: Optional[List[str]] = None
             'endpoint': r.endpoint,
             'version': r.version,
             'use_spot': r.use_spot,
-            # getattr: replica rows pickled before the stats field
-            # existed restore without it.
-            'stats': getattr(r, 'stats', None),
-        } for r in serve_state.get_replicas(svc['name'])]
+            'stats': r.stats,
+            'pid': r.pid,
+            'adopted_at': r.adopted_at,
+        } for r in map(replica_managers.backfill,
+                       serve_state.get_replicas(svc['name']))]
         out.append({
             'name': svc['name'],
             'status': svc['status'],

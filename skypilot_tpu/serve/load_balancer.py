@@ -21,11 +21,28 @@ works for long-lived inference requests, and the proxy path is
   * upstream connect/total timeouts are env-configurable
     (SKYT_LB_UPSTREAM_CONNECT_S / SKYT_LB_UPSTREAM_TOTAL_S).
 
+Control-plane crash tolerance (docs/robustness.md "Control plane"):
+
+  * the controller-synced replica/QoS view is factored into a
+    serializable LBState snapshot; when the controller sync FAILS the
+    LB enters a degraded **stale-state mode** — it keeps serving the
+    last-known ready set with its own health probes pruning dead
+    replicas, bounded by SKYT_LB_STALE_TTL_S — instead of draining to
+    503s the moment the controller dies (`skyt_lb_stale`,
+    `skyt_lb_stale_age_seconds`, `lb.stale` span attribute);
+  * a second LB process can run as a hot standby: LeaderLease is a
+    file-lock lease (kernel-released on ANY process death, SIGKILL
+    included) with a heartbeat stamp; the standby mirrors LBState via
+    the same controller sync endpoint and takes over the serving port
+    within one lease interval of leader death (`skyt_lb_leader`).
+
 Breaker and retry activity is visible in GET /metrics
 (skyt_lb_breaker_state, skyt_lb_retries_total, ...) and on the
 `lb.proxy` span attributes at /debug/traces.
 """
 import asyncio
+import dataclasses
+import json
 import os
 import random
 import time
@@ -84,6 +101,122 @@ def _env_float(name: str, default: float) -> float:
 
 def _sync_interval() -> float:
     return _env_float('SKYT_SERVE_LB_SYNC_INTERVAL', 2.0)
+
+
+def _stale_ttl() -> float:
+    return _env_float('SKYT_LB_STALE_TTL_S', 300.0)
+
+
+@dataclasses.dataclass
+class LBState:
+    """The LB's controller-synced world view as one serializable
+    snapshot (ROADMAP item 2's shareable-store refactor): the ready
+    replica set, per-replica QoS pressure, and when it was learned.
+    Every applied sync replaces the whole snapshot atomically, so a
+    standby mirroring the same sync endpoint converges on the same
+    state, and stale-state mode is just "keep acting on the last
+    snapshot, with an age bound"."""
+    ready_replicas: List[str] = dataclasses.field(default_factory=list)
+    replica_qos: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    # time.monotonic() of the last successful controller sync; 0.0 =
+    # never synced (fresh process: nothing to be stale ABOUT).
+    synced_at: float = 0.0
+    version: int = 0
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        if self.synced_at == 0.0:
+            return 0.0
+        return max((now if now is not None else time.monotonic()) -
+                   self.synced_at, 0.0)
+
+    def to_json(self) -> str:
+        return json.dumps({'ready_replicas': self.ready_replicas,
+                           'replica_qos': self.replica_qos,
+                           'age_s': round(self.age_s(), 3),
+                           'version': self.version})
+
+    @classmethod
+    def from_json(cls, text: str) -> 'LBState':
+        d = json.loads(text)
+        state = cls(
+            ready_replicas=[str(r) for r in d.get('ready_replicas', [])],
+            replica_qos=d.get('replica_qos') or {},
+            version=int(d.get('version', 0)))
+        # Imported snapshots carry an age, not a foreign monotonic
+        # stamp (monotonic clocks don't transfer between processes).
+        age = float(d.get('age_s', 0.0))
+        if age or state.ready_replicas:
+            state.synced_at = time.monotonic() - age
+        return state
+
+
+class LeaderLease:
+    """File-lock lease electing the ONE LB that owns the serving port.
+
+    The lease is an exclusive flock(2) on a lease file: the kernel
+    releases it the instant the holder dies — SIGKILL, OOM, anything —
+    so a standby polling try_acquire() takes over within one poll
+    interval with no heartbeat-expiry guesswork. The heartbeat write
+    (pid + wall-clock stamp) is observability, not the liveness
+    mechanism: `holder()` tells an operator who leads and how fresh it
+    is, and the stamp survives in the file after a crash for
+    post-mortems."""
+
+    def __init__(self, path: str, interval_s: Optional[float] = None
+                 ) -> None:
+        self.path = path
+        self.interval_s = interval_s if interval_s is not None else \
+            _env_float('SKYT_LB_LEASE_INTERVAL_S', 1.0)
+        self._fd: Optional[int] = None
+
+    def try_acquire(self) -> bool:
+        import fcntl
+        if self._fd is not None:
+            return True
+        os.makedirs(os.path.dirname(self.path) or '.', exist_ok=True)
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        self._fd = fd
+        self.heartbeat()
+        return True
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def heartbeat(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            payload = json.dumps({'pid': os.getpid(),
+                                  'ts': time.time()}).encode('utf-8')
+            os.lseek(self._fd, 0, os.SEEK_SET)
+            os.truncate(self._fd, 0)
+            os.write(self._fd, payload)
+        except OSError as e:
+            logger.warning('lease heartbeat write failed: %s', e)
+
+    def holder(self) -> Optional[dict]:
+        try:
+            with open(self.path, 'r', encoding='utf-8') as f:
+                return json.loads(f.read() or 'null')
+        except (OSError, ValueError):
+            return None
+
+    def release(self) -> None:
+        import fcntl
+        if self._fd is None:
+            return
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        os.close(self._fd)
+        self._fd = None
 
 
 class CircuitBreaker:
@@ -221,9 +354,21 @@ class SkyServeLoadBalancer:
                  controller_auth: Optional[str] = None,
                  metrics_registry: Optional[
                      'metrics_lib.MetricsRegistry'] = None,
-                 tracer: Optional['tracing_lib.Tracer'] = None) -> None:
+                 tracer: Optional['tracing_lib.Tracer'] = None,
+                 stale_probe_path: Optional[str] = None,
+                 stale_probe_post: Optional[dict] = None,
+                 stale_probe_timeout_s: Optional[float] = None) -> None:
         self.controller_url = controller_url
         self.port = port
+        # Stale-mode health probing uses the SERVICE's readiness
+        # contract (serve/service.py passes spec.readiness_path /
+        # post_data / probe timeout) — probing a path the replicas
+        # never promised to answer would prune healthy replicas. With
+        # no contract configured (and no SKYT_LB_STALE_PROBE_PATH
+        # override), stale mode serves the snapshot UNTOUCHED.
+        self._stale_probe_path = stale_probe_path
+        self._stale_probe_post = stale_probe_post
+        self._stale_probe_timeout_s = stale_probe_timeout_s
         reg = metrics_registry or metrics_lib.REGISTRY
         self._registry = reg
         # Tracing plane: one root span per proxied request, with the
@@ -272,15 +417,51 @@ class SkyServeLoadBalancer:
         # the QoS-aware autoscaler can scale on class demand + shed
         # rate instead of raw request rate. All dormant with
         # SKYT_QOS=0 (one env read per request).
-        self._replica_qos: Dict[str, dict] = {}
         self._qos_demand: List[tuple] = []     # (ts, class)
         self._qos_sheds: List[tuple] = []      # (ts, class)
         self._m_qos_sheds_seen = reg.counter(
             'skyt_lb_qos_sheds_observed_total',
             'Upstream 429 shed responses proxied, by class',
             ('class',))
+        # Control-plane crash tolerance: the synced world view lives in
+        # one LBState snapshot; on sync failure the LB serves from the
+        # stale snapshot (bounded by SKYT_LB_STALE_TTL_S, with its own
+        # health probes pruning dead replicas) instead of draining.
+        self.state = LBState()
+        self._stale = False
+        # replica -> consecutive stale-probe failures (pruning needs
+        # the same consecutive-failure discipline the controller's own
+        # prober has; one slow probe must not drop a loaded replica).
+        self._stale_probe_fails: Dict[str, int] = {}
+        self._m_stale = reg.gauge(
+            'skyt_lb_stale',
+            '1 while serving from a stale LBState snapshot (controller '
+            'sync failing), else 0')
+        self._m_stale_age = reg.gauge(
+            'skyt_lb_stale_age_seconds',
+            'Age of the LBState snapshot being served (0 when synced)')
+        self._m_stale_pruned = reg.counter(
+            'skyt_lb_stale_pruned_total',
+            'Replicas pruned from the stale ready set by the LB\'s own '
+            'health probes while the controller was unreachable')
+        # Hot-standby election: 1 = this process holds the leader lease
+        # (owns the serving port), 0 = standby mirroring LBState.
+        self._m_leader = reg.gauge(
+            'skyt_lb_leader',
+            'Leader-lease state of this LB process (1 leader, '
+            '0 standby)')
         self._session: Optional[aiohttp.ClientSession] = None
         self._sync_task: Optional[asyncio.Task] = None
+
+    @property
+    def _replica_qos(self) -> Dict[str, dict]:
+        """Compatibility view: the QoS-pressure map now lives on the
+        LBState snapshot (the serializable controller-synced view)."""
+        return self.state.replica_qos
+
+    @_replica_qos.setter
+    def _replica_qos(self, value: Dict[str, dict]) -> None:
+        self.state.replica_qos = value
 
     # --------------------------------------------------- controller sync
     def _cap_timestamps(self) -> None:
@@ -299,7 +480,9 @@ class SkyServeLoadBalancer:
     async def _sync_with_controller(self) -> None:
         """Reference: :58 — report request timestamps (plus per-class
         QoS demand/shed buffers), fetch ready replicas and their QoS
-        pressure."""
+        pressure. A failed sync (controller dead, network partition —
+        injectable via the `lb.sync` fault point) flips the LB into
+        stale-state mode instead of losing the front door."""
         assert self._session is not None
         while True:
             ts, self.request_timestamps = self.request_timestamps, []
@@ -310,26 +493,151 @@ class SkyServeLoadBalancer:
                 payload['qos_demand'] = [[t, c] for t, c in qd]
                 payload['qos_sheds'] = [[t, c] for t, c in qs]
             try:
+                # Chaos hook: SKYT_FAULTS='lb.sync=error' simulates a
+                # controller partition without killing anything.
+                await faults.ainject('lb.sync')
                 async with self._session.post(
                         self.controller_url +
                         '/controller/load_balancer_sync',
                         json=payload,
                         headers=self._controller_headers,
                         timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status != 200:
+                        # An error body (401 from the auth middleware,
+                        # 503 from a controller still reconciling) is
+                        # NOT a world view — treating it as one would
+                        # install an empty ready set and drain the
+                        # front door, bypassing stale-state mode.
+                        raise RuntimeError(
+                            f'controller sync HTTP {resp.status}: '
+                            f'{(await resp.text())[:200]}')
                     data = await resp.json()
                     ready = data.get('ready_replica_urls', [])
-                    self.policy.set_ready_replicas(ready)
                     rq = data.get('replica_qos')
-                    self._replica_qos = rq if isinstance(rq, dict) \
-                        else {}
-                    self._prune_replica_metrics(ready)
+                    self.apply_state(LBState(
+                        ready_replicas=list(ready),
+                        replica_qos=rq if isinstance(rq, dict) else {},
+                        synced_at=time.monotonic(),
+                        version=self.state.version + 1))
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning('controller sync failed: %s', e)
                 self.request_timestamps = ts + self.request_timestamps
                 self._qos_demand = qd + self._qos_demand
                 self._qos_sheds = qs + self._qos_sheds
                 self._cap_timestamps()
+                await self._enter_or_hold_stale()
             await asyncio.sleep(_sync_interval())
+
+    def apply_state(self, state: 'LBState') -> None:
+        """Install a fresh LBState snapshot (from a successful sync, or
+        imported by a standby) as the live routing view."""
+        self.state = state
+        self.policy.set_ready_replicas(list(state.ready_replicas))
+        self._prune_replica_metrics(state.ready_replicas)
+        if self._stale:
+            logger.info('controller sync recovered; leaving stale-'
+                        'state mode (%d ready replicas)',
+                        len(state.ready_replicas))
+        self._stale = False
+        self._stale_probe_fails.clear()
+        self._m_stale.set(0)
+        self._m_stale_age.set(0.0)
+
+    def snapshot_state(self) -> 'LBState':
+        """The live view re-narrowed to what the LB itself learned:
+        stale-mode probe pruning edits the policy's ready set without
+        rebuilding the snapshot, so export from the policy."""
+        return LBState(
+            ready_replicas=list(self.policy.ready_replicas),
+            replica_qos=dict(self.state.replica_qos),
+            synced_at=self.state.synced_at,
+            version=self.state.version)
+
+    async def _enter_or_hold_stale(self) -> None:
+        """One failed-sync step of stale-state mode: surface the mode +
+        snapshot age, prune dead replicas with our own health probes,
+        and drain once the snapshot outlives SKYT_LB_STALE_TTL_S (a
+        too-old view is worse than an honest 503)."""
+        if self.state.synced_at == 0.0:
+            return          # never synced: nothing to serve stale FROM
+        age = self.state.age_s()
+        if not self._stale:
+            self._stale = True
+            logger.warning(
+                'entering stale-state mode: serving the last-known '
+                'replica set (%d replicas, age %.1fs, ttl %.0fs) with '
+                'LB-side health probes', len(self.policy.ready_replicas),
+                age, _stale_ttl())
+        self._m_stale.set(1)
+        self._m_stale_age.set(round(age, 3))
+        if age > _stale_ttl():
+            if self.policy.ready_replicas:
+                logger.error(
+                    'stale LBState exceeded SKYT_LB_STALE_TTL_S='
+                    '%.0fs (age %.1fs): draining the ready set',
+                    _stale_ttl(), age)
+                self.policy.set_ready_replicas([])
+            return
+        await self._prune_stale_replicas()
+
+    async def _prune_stale_replicas(self) -> None:
+        """While the controller cannot tell us which replicas died, ask
+        them ourselves — with the SERVICE's readiness contract and the
+        same consecutive-failure discipline the controller's prober
+        uses. Every probe round covers the full stale SNAPSHOT (not
+        just current survivors), so a replica that failed transiently
+        and recovered re-enters the ready set; pruning requires
+        SKYT_LB_STALE_PROBE_THRESHOLD consecutive failures so one slow
+        probe under partition load can't cascade into a self-inflicted
+        drain. Without a configured readiness contract (service.py
+        passes the spec's; SKYT_LB_STALE_PROBE_PATH overrides), the
+        snapshot is served untouched — unknown probes would prune
+        healthy replicas that simply 404 an uncontracted path."""
+        candidates = list(self.state.ready_replicas)
+        path = os.environ.get('SKYT_LB_STALE_PROBE_PATH') or \
+            self._stale_probe_path
+        if not candidates or self._session is None or path is None:
+            return
+        timeout = aiohttp.ClientTimeout(total=_env_float(
+            'SKYT_LB_STALE_PROBE_TIMEOUT_S',
+            self._stale_probe_timeout_s or 2.0))
+        threshold = max(
+            1, int(_env_float('SKYT_LB_STALE_PROBE_THRESHOLD', 3)))
+
+        async def probe(replica: str) -> bool:
+            try:
+                if self._stale_probe_post is not None:
+                    req = self._session.post(replica + path,
+                                             json=self._stale_probe_post,
+                                             timeout=timeout)
+                else:
+                    req = self._session.get(replica + path,
+                                            timeout=timeout)
+                async with req as resp:
+                    return resp.status == 200
+            except (aiohttp.ClientError, ConnectionError,
+                    asyncio.TimeoutError):
+                return False
+
+        results = await asyncio.gather(*(probe(r) for r in candidates))
+        newly_dead = []
+        for replica, ok in zip(candidates, results):
+            if ok:
+                self._stale_probe_fails[replica] = 0
+                continue
+            fails = self._stale_probe_fails.get(replica, 0) + 1
+            self._stale_probe_fails[replica] = fails
+            if fails == threshold:
+                newly_dead.append(replica)
+        alive = [r for r in candidates
+                 if self._stale_probe_fails.get(r, 0) < threshold]
+        if newly_dead:
+            logger.warning('stale-state probes pruned %d dead '
+                           'replica(s) after %d consecutive failures: '
+                           '%s', len(newly_dead), threshold, newly_dead)
+            self._m_stale_pruned.inc(len(newly_dead))
+        if sorted(alive) != sorted(self.policy.ready_replicas):
+            self.policy.set_ready_replicas(alive)
 
     def _prune_replica_metrics(self, ready) -> None:
         """Evict metric children for replicas no longer in the ready
@@ -498,6 +806,13 @@ class SkyServeLoadBalancer:
                             'request_id': req_id}) as span:
             if qos_cls is not None:
                 span.set_attribute('qos.class', qos_cls)
+            if self._stale:
+                # Served from a stale snapshot (controller partition):
+                # flagged on the trace so tail-latency forensics can
+                # tell degraded-mode routing from healthy routing.
+                span.set_attribute('lb.stale', True)
+                span.set_attribute('lb.stale_age_s',
+                                   round(self.state.age_s(), 1))
             while True:
                 with self._tracer.start_span('lb.pick_replica') as pick:
                     try:
@@ -693,10 +1008,19 @@ class SkyServeLoadBalancer:
                     return response
                 return e
 
+    async def start_sync(self) -> None:
+        """Start the controller-sync loop (idempotent). Split out of
+        app startup so a hot STANDBY can mirror LBState — same sync
+        endpoint, warm replica/QoS view — long before it owns the
+        serving port (lease takeover then starts routing instantly)."""
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+            self._sync_task = asyncio.create_task(
+                self._sync_with_controller())
+
     async def _on_startup(self, app: web.Application) -> None:
         del app
-        self._session = aiohttp.ClientSession()
-        self._sync_task = asyncio.create_task(self._sync_with_controller())
+        await self.start_sync()
 
     async def _on_cleanup(self, app: web.Application) -> None:
         del app
@@ -704,6 +1028,10 @@ class SkyServeLoadBalancer:
             self._sync_task.cancel()
         if self._session:
             await self._session.close()
+            self._session = None
+
+    def set_leader(self, leader: bool) -> None:
+        self._m_leader.set(1 if leader else 0)
 
     async def _debug_traces(self, request: web.Request) -> web.Response:
         """LB-local trace store (this hop's spans; the replica serves
@@ -724,14 +1052,90 @@ class SkyServeLoadBalancer:
             body=self._registry.expose().encode('utf-8'),
             headers={'Content-Type': metrics_lib.CONTENT_TYPE})
 
+    async def _debug_lb_state(self, request: web.Request) -> web.Response:
+        """The LBState snapshot this LB is routing on, plus the degraded-
+        mode flags — the first stop when diagnosing a controller
+        partition ('is the front door stale, and how stale?')."""
+        del request
+        payload = json.loads(self.snapshot_state().to_json())
+        payload['stale'] = self._stale
+        payload['leader'] = self._m_leader.value()
+        return web.json_response(payload)
+
     def make_app(self) -> web.Application:
         app = web.Application()
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
-        # Registered before the catch-all: /debug/traces and /metrics
-        # are answered by the LB itself, not proxied (each hop serves
-        # its own stores).
+        # Registered before the catch-all: /debug/traces, /debug/
+        # lb_state and /metrics are answered by the LB itself, not
+        # proxied (each hop serves its own stores).
         app.router.add_get('/debug/traces', self._debug_traces)
+        app.router.add_get('/debug/lb_state', self._debug_lb_state)
         app.router.add_get('/metrics', self._metrics)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app
+
+
+async def serve_as_leader(lb: 'SkyServeLoadBalancer', lease: LeaderLease,
+                          host: str = '0.0.0.0', abort=None
+                          ) -> 'tuple[Optional[web.AppRunner], Optional[asyncio.Task]]':
+    """Run `lb` behind the leader lease: mirror LBState immediately
+    (standby keeps a warm view via the same controller sync), block
+    until the lease is won, then take the serving port and heartbeat.
+
+    Leader death — SIGKILL included — releases the flock in the kernel,
+    so a waiting standby acquires within one lease poll interval; the
+    port bind retries through the dead leader's socket teardown.
+    Returns (runner, heartbeat_task) once this process IS the leader
+    and is serving. `abort` (optional callable) is polled while
+    standing by; returning True gives up the wait — (None, None) — so
+    a standby of a torn-down service exits instead of waiting forever."""
+    await lb.start_sync()
+    lb.set_leader(False)
+    if not lease.try_acquire():
+        logger.info('LB standby for port %d: mirroring LBState, '
+                    'waiting on lease %s (holder: %s)', lb.port,
+                    lease.path, lease.holder())
+        while True:
+            # Abort BEFORE retrying the lock: teardown removes the
+            # service row and then the lease file, and acquiring a
+            # freshly re-created lease inode during that window would
+            # read as leadership of a dying service.
+            if abort is not None and abort():
+                logger.info('LB standby for port %d: aborting lease '
+                            'wait (service gone)', lb.port)
+                return None, None
+            if lease.try_acquire():
+                break
+            await asyncio.sleep(lease.interval_s)
+        logger.warning('LB lease %s acquired after leader death: '
+                       'taking over port %d', lease.path, lb.port)
+    lb.set_leader(True)
+    runner = web.AppRunner(lb.make_app())
+    await runner.setup()
+    deadline = time.monotonic() + \
+        _env_float('SKYT_LB_TAKEOVER_BIND_TIMEOUT_S', 30.0)
+    while True:
+        try:
+            await web.TCPSite(runner, host, lb.port,
+                              reuse_address=True).start()
+            break
+        except OSError as e:
+            if time.monotonic() >= deadline:
+                raise
+            logger.info('port %d still held (%s); retrying bind',
+                        lb.port, e)
+            await asyncio.sleep(0.2)
+
+    async def _heartbeat() -> None:
+        while True:
+            lease.heartbeat()
+            await asyncio.sleep(lease.interval_s)
+
+    task = asyncio.create_task(_heartbeat())
+    # The event loop holds only a WEAK reference to tasks; pin the
+    # heartbeat (and, through its closure, the lease) to the LB object
+    # so a GC cycle can't silently freeze the lease stamp.
+    lb._lease_heartbeat_task = task  # pylint: disable=protected-access
+    lb._leader_lease = lease  # pylint: disable=protected-access
+    return runner, task

@@ -67,6 +67,20 @@ class ReplicaInfo:
     # percentiles, steady decode rate, slot occupancy) — best-effort:
     # None for replicas that don't expose /stats.
     stats: Optional[dict] = None
+    # Liveness identity (docs/robustness.md "Control plane"): enough
+    # persisted state that a RESTARTING controller can tell an
+    # adoptable live replica from a dead orphan without relaunching.
+    # pid is the replica's head-agent pid where the provider exposes
+    # one (local provider; None for cloud replicas, whose cluster
+    # record + probe are the identity); pid_start is the kernel
+    # starttime token guarding against pid reuse.
+    pid: Optional[int] = None
+    pid_start: Optional[int] = None
+    # Set when a restart re-adopted this replica (observability).
+    adopted_at: Optional[float] = None
+    # Set when the replica reached a terminal/preempted state; the
+    # serve_state.prune_terminal_replicas sweep keys on it.
+    terminal_at: Optional[float] = None
 
     @property
     def is_alive(self) -> bool:
@@ -75,6 +89,27 @@ class ReplicaInfo:
                                serve_state.ReplicaStatus.STARTING,
                                serve_state.ReplicaStatus.READY,
                                serve_state.ReplicaStatus.NOT_READY)
+
+
+# Fields added after the first pickled rows shipped: a dataclass
+# unpickles by restoring __dict__ directly, so rows written by an
+# older build come back WITHOUT the newer attributes. Backfill them so
+# adoption logic never needs getattr() guards.
+_PICKLE_BACKFILL = {'stats': None, 'pid': None, 'pid_start': None,
+                    'adopted_at': None, 'terminal_at': None}
+
+
+def backfill(info: 'ReplicaInfo') -> 'ReplicaInfo':
+    """THE one old-pickle upgrade point — every consumer of persisted
+    ReplicaInfo rows (manager adoption, serve status) routes through
+    this instead of scattering per-field getattr guards."""
+    for field, default in _PICKLE_BACKFILL.items():
+        if not hasattr(info, field):
+            setattr(info, field, default)
+    return info
+
+
+_backfill = backfill
 
 
 class ReplicaManager:
@@ -106,6 +141,14 @@ class ReplicaManager:
             'skyt_serve_replica_drains_total',
             'READY replicas retired through the drain grace period',
             ('service',))
+        self._m_adoptions = reg.counter(
+            'skyt_serve_replica_adoptions_total',
+            'Persisted replicas re-adopted (not relaunched) by a '
+            'restarting controller', ('service',))
+        self._m_reaps = reg.counter(
+            'skyt_serve_replica_reaps_total',
+            'Persisted replicas reaped as orphans by a restarting '
+            'controller', ('service', 'reason'))
         # Relaunch backoff: repeated replica failures (probe-failure ->
         # FAILED -> reconcile relaunch) back off exponentially instead
         # of tight-looping launches against a broken image/config; any
@@ -119,28 +162,64 @@ class ReplicaManager:
         # would otherwise be re-fetched every pass.
         self._stats_attempt: Dict[int, int] = {}
         self.replicas: Dict[int, ReplicaInfo] = {
-            info.replica_id: info
+            info.replica_id: _backfill(info)
             for info in serve_state.get_replicas(service_name)}
         self._next_id = max(self.replicas, default=0) + 1
         self._threads: Dict[int, threading.Thread] = {}
         self._lock = threading.RLock()
-        self._recover_orphans()
+        self._reconcile_restart()
 
-    def _recover_orphans(self) -> None:
-        """Reconcile persisted replicas after a controller restart.
+    # ------------------------------------------------- restart adoption
+    def _reconcile_restart(self) -> None:
+        """Reconcile persisted replicas after a controller restart —
+        ADOPT, don't relaunch (docs/robustness.md "Control plane").
 
-        Launch intent is persisted (PROVISIONING row) *before* the launch
-        thread starts, so a controller killed mid-launch leaves rows
-        whose threads are gone. On restart: rows whose cluster actually
-        exists are kept (the prober advances them); rows whose cluster
-        never materialized are torn down + dropped so reconcile()
-        relaunches to target. Reference: the supervised process pool in
-        sky/serve/replica_managers.py:940-1019 rediscovers launch
-        processes the same way.
+        Mid-launch rows (PROVISIONING/STARTING/SHUTTING_DOWN) follow
+        the orphaned-launch-intent rules: a cluster that materialized
+        is kept for the prober, one that never did is torn down so
+        reconcile() relaunches the delta. Rows that were SERVING
+        (READY/NOT_READY) get the full liveness check — recorded pid
+        still the same process (runtime/reaper.pid_start_token guards
+        reuse), spec version current, readiness probe answering — and
+        are re-adopted into the manager with ZERO relaunches when it
+        passes; true orphans (dead pid, failed probe, stale version,
+        vanished cluster) are reaped, never adopted. Reference: the
+        supervised process pool in sky/serve/replica_managers.py:
+        940-1019 rediscovers launch processes the same way.
         """
         from skypilot_tpu import state as cluster_state
+        serving = [info for info in self.replicas.values()
+                   if info.status in (serve_state.ReplicaStatus.READY,
+                                      serve_state.ReplicaStatus.NOT_READY)]
+        if serving:
+            # Concurrent adoption checks: each unreachable replica
+            # costs up to retries × probe_timeout, and this runs
+            # BEFORE the controller binds its sync port — serial
+            # probing of N hung replicas would hold the whole control
+            # plane down long enough to blow the LB's stale TTL.
+            import concurrent.futures as futures
+            with futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(serving))) as pool:
+                list(pool.map(self._adopt_or_reap, serving))
+        handled = {info.replica_id for info in serving}
         for info in list(self.replicas.values()):
-            if info.status not in (serve_state.ReplicaStatus.PROVISIONING,
+            if info.replica_id in handled:
+                continue  # adopted or already reaping (SHUTTING_DOWN)
+            if info.status is serve_state.ReplicaStatus.PREEMPTED:
+                # Detected-preempted row whose teardown thread died
+                # with the old controller: finish the teardown.
+                self._reap(info, 'preempted_pre_restart')
+                continue
+            if info.status is serve_state.ReplicaStatus.FAILED:
+                # FAILED row still in the DB means the old controller
+                # died between _save(FAILED) and the teardown finishing
+                # — without this, the replica's cluster leaks forever
+                # (and the prune sweep would later erase the only
+                # record pointing at it).
+                self._reap(info, 'failed_pre_restart')
+                continue
+            if info.status not in (serve_state.ReplicaStatus.PENDING,
+                                   serve_state.ReplicaStatus.PROVISIONING,
                                    serve_state.ReplicaStatus.STARTING,
                                    serve_state.ReplicaStatus.SHUTTING_DOWN):
                 continue
@@ -172,6 +251,72 @@ class ReplicaManager:
                                    info.replica_id)
                     threading.Thread(target=self._terminate_thread,
                                      args=(info,), daemon=True).start()
+
+    def _orphan_reason(self, info: ReplicaInfo) -> Optional[str]:
+        """Why a persisted serving replica canNOT be adopted (None =
+        adoptable). Ordered cheapest-first; the HTTP probe runs last."""
+        from skypilot_tpu import state as cluster_state
+        from skypilot_tpu.runtime import reaper
+        try:
+            # Chaos hook: an injected error forces this row down the
+            # reap path (tests/test_chaos.py, SKYT_FAULTS
+            # replica.orphan=error[,where=replica:<id>]).
+            faults.inject('replica.orphan', replica=info.replica_id)
+        except faults.FaultError:
+            return 'fault_injected'
+        if info.version != self.version:
+            return 'stale_spec_version'
+        if cluster_state.get_cluster(info.cluster_name) is None:
+            return 'cluster_gone'
+        if info.pid is not None:
+            if not reaper.pid_alive(info.pid):
+                return 'dead_pid'
+            if info.pid_start is not None and \
+                    reaper.pid_start_token(info.pid) != info.pid_start:
+                return 'pid_reused'
+        if info.endpoint is None:
+            return 'probe_failed'
+        # Retry the probe: a reap here tears down and relaunches, and
+        # controller restarts correlate with replicas being under load
+        # — a single timed-out probe must not cost a healthy replica
+        # (the steady-state prober tolerates FAILED_THRESHOLD=10
+        # consecutive failures for the same condition).
+        attempts = max(1, int(os.environ.get(
+            'SKYT_SERVE_ADOPT_PROBE_RETRIES', '3') or 3))
+        for i in range(attempts):
+            if self._probe_one(info):
+                return None
+            if i + 1 < attempts:
+                time.sleep(0.5)
+        return 'probe_failed'
+
+    def _adopt_or_reap(self, info: ReplicaInfo) -> None:
+        reason = self._orphan_reason(info)
+        if reason is None:
+            info.status = serve_state.ReplicaStatus.READY
+            info.consecutive_failures = 0
+            info.adopted_at = time.time()
+            self._save(info)
+            self._m_adoptions.labels(self.service_name).inc()
+            logger.info('adopted replica %d at %s (pid %s): READY, '
+                        'no relaunch', info.replica_id, info.endpoint,
+                        info.pid)
+        else:
+            self._reap(info, reason)
+
+    def _reap(self, info: ReplicaInfo, reason: str) -> None:
+        """Terminate + drop a persisted replica a restart could not
+        adopt; reconcile() then launches the delta. Counted per reason
+        so a chaos run can assert 'reaped, not adopted'."""
+        logger.warning('reaping orphaned replica %d (%s): %s',
+                       info.replica_id, info.status.value, reason)
+        self._m_reaps.labels(self.service_name, reason).inc()
+        info.status = serve_state.ReplicaStatus.SHUTTING_DOWN
+        info.failure_reason = f'reaped on controller restart: {reason}'
+        info.terminal_at = time.time()
+        self._save(info)
+        threading.Thread(target=self._terminate_thread,
+                         args=(info,), daemon=True).start()
 
     # ------------------------------------------------------------ persist
     def _save(self, info: ReplicaInfo) -> None:
@@ -225,6 +370,8 @@ class ReplicaManager:
             head = handle.cluster_info.ordered()[0]
             ip = head.get_feasible_ip()
             info.endpoint = f'http://{ip}:{port}'
+            info.pid, info.pid_start = self._liveness_identity(handle,
+                                                               info)
             info.status = serve_state.ReplicaStatus.STARTING
             self._save(info)
             logger.info('replica %d up at %s', info.replica_id,
@@ -234,8 +381,28 @@ class ReplicaManager:
                            info.replica_id, e)
             info.status = serve_state.ReplicaStatus.FAILED
             info.failure_reason = str(e)
+            info.terminal_at = time.time()
             self._save(info)
             self._note_replica_failed()
+
+    def _liveness_identity(self, handle, info: ReplicaInfo
+                           ) -> 'tuple[Optional[int], Optional[int]]':
+        """(pid, start-token) of the replica's head process where the
+        provider exposes one — the local provider's head agent. Cloud
+        replicas return (None, None): their cluster record + readiness
+        probe are the restart-adoption identity."""
+        from skypilot_tpu.runtime import reaper
+        try:
+            if handle.provider_name == 'local':
+                from skypilot_tpu.provision.local import instance as \
+                    local_instance
+                pid = local_instance.head_agent_pid(info.cluster_name)
+                if pid is not None:
+                    return pid, reaper.pid_start_token(pid)
+        except Exception:  # pylint: disable=broad-except
+            logger.warning('liveness identity unavailable for replica '
+                           '%d', info.replica_id, exc_info=True)
+        return None, None
 
     def _note_replica_failed(self) -> None:
         """Gate the next reconcile launch behind an exponential backoff
@@ -393,6 +560,7 @@ class ReplicaManager:
                 logger.info('replica %d cluster gone -> PREEMPTED',
                             info.replica_id)
                 info.status = serve_state.ReplicaStatus.PREEMPTED
+                info.terminal_at = time.time()
                 self._save(info)
                 self.terminate_replica(info.replica_id)
                 continue
@@ -428,12 +596,14 @@ class ReplicaManager:
                     info.failure_reason = (
                         f'not ready within initial_delay_seconds='
                         f'{self.spec.initial_delay_seconds}')
+                    info.terminal_at = time.time()
                     self._save(info)
                     self.terminate_replica(info.replica_id)
                     self._note_replica_failed()
             elif info.consecutive_failures >= FAILED_THRESHOLD:
                 info.status = serve_state.ReplicaStatus.FAILED
                 info.failure_reason = 'readiness probe kept failing'
+                info.terminal_at = time.time()
                 self._save(info)
                 self.terminate_replica(info.replica_id)
                 self._note_replica_failed()

@@ -1,16 +1,29 @@
-"""Service entrypoint: runs controller + load balancer for one service.
+"""Service entrypoint: controller + load balancer for one service.
 
 Reference: sky/serve/service.py (:131 _start — starts controller and LB
 as separate processes, :38 signal-file termination, :64 storage cleanup).
-Here both aiohttp apps share one asyncio loop in one process (they are
-I/O-bound; the blocking cluster work lives on the controller's threads),
-so a service is exactly one daemon process.
+Here both aiohttp apps share one asyncio loop in one process by default
+(they are I/O-bound; the blocking cluster work lives on the controller's
+threads), so a service is exactly one daemon process.
 
-Run:  python -m skypilot_tpu.serve.service --service-name NAME
+Crash-tolerant deployments split the roles (docs/robustness.md
+"Control plane"):
+
+    python -m skypilot_tpu.serve.service --service-name NAME   # both
+    ... --service-name NAME --role controller   # control plane only
+    ... --service-name NAME --role lb           # front door only
+
+Any number of `--role lb` processes may run: the first to win the
+LeaderLease (a kernel-released file lock) serves the LB port; the rest
+mirror LBState via the controller sync as hot standbys and take over
+within one lease interval of leader death. A `--role controller`
+restart ADOPTS the replicas recorded in serve.db instead of
+relaunching them (serve/replica_managers.py).
 """
 import argparse
 import asyncio
 import os
+from typing import Optional
 
 from aiohttp import web
 
@@ -22,53 +35,95 @@ from skypilot_tpu.utils import log_utils
 logger = log_utils.init_logger(__name__)
 
 
-async def _serve(service_name: str) -> None:
-    svc = serve_state.get_service(service_name)
-    assert svc is not None, f'service {service_name} not in state DB'
-    spec = svc['spec']
+# Canonical definition lives beside the rest of the on-disk state
+# contract; re-exported here for the LB-runner callers.
+lb_lease_path = serve_state.lb_lease_path
+
+
+async def _start_controller(
+        service_name: str, svc: dict
+) -> 'tuple[controller_lib.SkyServeController, web.AppRunner]':
     controller = controller_lib.SkyServeController(
-        service_name, spec, svc['task_yaml'], svc['controller_port'])
-    auth_token = svc.get('auth_token')
+        service_name, svc['spec'], svc['task_yaml'],
+        svc['controller_port'])
+    # Controller admin API (terminate/update_service): loopback bind
+    # AND a per-service bearer token (minted at serve up) — reaching
+    # the port is not enough to terminate or roll the service. Only the
+    # load balancer is the externally reachable endpoint.
+    runner = web.AppRunner(controller.make_app(svc.get('auth_token')))
+    await runner.setup()
+    await web.TCPSite(runner, '127.0.0.1',
+                      svc['controller_port']).start()
+    controller.start_control_loop()
+    return controller, runner
+
+
+async def _start_lb(service_name: str, svc: dict
+                    ) -> Optional[web.AppRunner]:
+    """Build the LB and serve it behind the leader lease (blocks until
+    this process IS the leader — instant when no other LB runs). A
+    standby gives up the wait when the service row disappears (serve
+    down while standing by) and returns None."""
+    spec = svc['spec']
     lb = lb_lib.SkyServeLoadBalancer(
         controller_url=f'http://127.0.0.1:{svc["controller_port"]}',
         port=svc['lb_port'],
         policy=getattr(spec, 'load_balancing_policy', None)
         or 'round_robin',
-        controller_auth=auth_token)
+        controller_auth=svc.get('auth_token'),
+        # Stale-state mode probes with the service's OWN readiness
+        # contract — same path/post-data/timeout the controller's
+        # prober uses, so LB-side pruning can never be stricter than
+        # the readiness definition the replicas signed up for.
+        stale_probe_path=spec.readiness_path,
+        stale_probe_post=spec.post_data,
+        stale_probe_timeout_s=spec.probe_timeout_seconds)
+    lease = lb_lib.LeaderLease(lb_lease_path(service_name))
+    runner, _hb = await lb_lib.serve_as_leader(
+        lb, lease,
+        abort=lambda: serve_state.get_service(service_name) is None)
+    return runner
 
-    # Controller admin API (terminate/update_service): loopback bind
-    # AND a per-service bearer token (minted at serve up) — reaching
-    # the port is not enough to terminate or roll the service. Only the
-    # load balancer is the externally reachable endpoint.
-    controller_runner = web.AppRunner(controller.make_app(auth_token))
-    await controller_runner.setup()
-    await web.TCPSite(controller_runner, '127.0.0.1',
-                      svc['controller_port']).start()
-    lb_runner = web.AppRunner(lb.make_app())
-    await lb_runner.setup()
-    await web.TCPSite(lb_runner, '0.0.0.0', svc['lb_port']).start()
 
-    controller.start_control_loop()
-    serve_state.set_service_status(service_name,
-                                   serve_state.ServiceStatus.REPLICA_INIT)
-    logger.info('service %s: controller :%d, load balancer :%d',
-                service_name, svc['controller_port'], svc['lb_port'])
+async def _serve(service_name: str, role: str = 'both') -> None:
+    svc = serve_state.get_service(service_name)
+    assert svc is not None, f'service {service_name} not in state DB'
+
+    controller: Optional[controller_lib.SkyServeController] = None
+    controller_runner: Optional[web.AppRunner] = None
+    lb_runner: Optional[web.AppRunner] = None
+    if role in ('both', 'controller'):
+        controller, controller_runner = await _start_controller(
+            service_name, svc)
+    if role in ('both', 'lb'):
+        lb_runner = await _start_lb(service_name, svc)
+
+    if controller is not None:
+        serve_state.set_service_status(
+            service_name, serve_state.ServiceStatus.REPLICA_INIT)
+    logger.info('service %s (%s): controller :%d, load balancer :%d',
+                service_name, role, svc['controller_port'],
+                svc['lb_port'])
 
     # Run until terminated via /controller/terminate (which tears down
-    # replicas) — then clean up the service row and exit.
+    # replicas) — the controller role then cleans up the service row
+    # and exits; an LB-only process exits when the row disappears.
     while True:
         await asyncio.sleep(1)
         svc = serve_state.get_service(service_name)
         if svc is None:
             break
-        if svc['status'] is serve_state.ServiceStatus.SHUTTING_DOWN and \
-                controller.replica_manager.num_alive() == 0:
+        if controller is not None and \
+                svc['status'] is serve_state.ServiceStatus.SHUTTING_DOWN \
+                and controller.replica_manager.num_alive() == 0:
             _cleanup_ephemeral_storages(service_name, svc['task_yaml'])
             serve_state.remove_service(service_name)
             break
-    await lb_runner.cleanup()
-    await controller_runner.cleanup()
-    logger.info('service %s shut down.', service_name)
+    if lb_runner is not None:
+        await lb_runner.cleanup()
+    if controller_runner is not None:
+        await controller_runner.cleanup()
+    logger.info('service %s (%s) shut down.', service_name, role)
 
 
 def _cleanup_ephemeral_storages(service_name: str,
@@ -100,9 +155,16 @@ def _cleanup_ephemeral_storages(service_name: str,
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--service-name', required=True)
+    parser.add_argument('--role', choices=('both', 'controller', 'lb'),
+                        default='both',
+                        help='which halves of the control plane this '
+                             'process runs (lb processes beyond the '
+                             'first become hot standbys)')
     args = parser.parse_args(argv)
-    serve_state.set_service_controller_pid(args.service_name, os.getpid())
-    asyncio.run(_serve(args.service_name))
+    if args.role in ('both', 'controller'):
+        serve_state.set_service_controller_pid(args.service_name,
+                                               os.getpid())
+    asyncio.run(_serve(args.service_name, role=args.role))
 
 
 if __name__ == '__main__':

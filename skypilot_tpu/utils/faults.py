@@ -32,6 +32,9 @@ Kinds:
     disconnect  raise FaultDisconnect (a ConnectionResetError)
     preempt     SIGTERM this process (exercises cooperative-preemption
                 handlers, e.g. train/checkpoint.PreemptionGuard)
+    crash       SIGKILL this process — a true crash: no handlers, no
+                cleanup, nothing flushed (exercises crash RECOVERY
+                paths: controller restart adoption, LB lease takeover)
 
 Example — kill a specific replica's server on its 3rd request:
 
@@ -62,7 +65,7 @@ from skypilot_tpu.utils import metrics as metrics_lib
 _ENV = 'SKYT_FAULTS'
 _ENV_SEED = 'SKYT_FAULTS_SEED'
 
-KINDS = ('error', 'latency', 'hang', 'disconnect', 'preempt')
+KINDS = ('error', 'latency', 'hang', 'disconnect', 'preempt', 'crash')
 
 _DEFAULT_ARG = {'latency': 0.05, 'hang': 3600.0}
 
@@ -272,6 +275,8 @@ def _evaluate(rules: List[FaultRule], point: str,
                     f'injected disconnect at {point!r}')
             elif rule.kind == 'preempt':
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif rule.kind == 'crash':
+                os.kill(os.getpid(), signal.SIGKILL)
     return delay, exc
 
 

@@ -646,6 +646,666 @@ def test_fault_event_lands_on_server_span(monkeypatch):
         eng.stop()
 
 
+# ======================================== control plane: crash recovery
+def test_fault_crash_kind_sigkills_process():
+    """The new 'crash' kind is a true SIGKILL — no handlers, no
+    cleanup — distinct from 'preempt' (SIGTERM, catchable)."""
+    proc = subprocess.run(
+        [sys.executable, '-c',
+         'from skypilot_tpu.utils import faults\n'
+         "faults.configure('x.y=crash')\n"
+         "faults.inject('x.y')\n"
+         "print('survived')"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, proc
+    assert 'survived' not in proc.stdout
+
+
+def test_lbstate_snapshot_roundtrip():
+    """LBState is the serializable controller-synced view a standby
+    mirrors; age survives the JSON round trip (monotonic stamps don't
+    transfer between processes — age does)."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    state = lb_lib.LBState(
+        ready_replicas=['http://r1', 'http://r2'],
+        replica_qos={'http://r1': {'level': 2}},
+        synced_at=time.monotonic() - 5.0, version=7)
+    restored = lb_lib.LBState.from_json(state.to_json())
+    assert restored.ready_replicas == state.ready_replicas
+    assert restored.replica_qos == state.replica_qos
+    assert restored.version == 7
+    assert 4.0 < restored.age_s() < 7.0
+    # Fresh state: nothing to be stale about.
+    assert lb_lib.LBState().age_s() == 0.0
+
+
+def test_lb_stale_mode_serves_and_recovers(monkeypatch):
+    """Controller partition (the `lb.sync` fault point): the LB must
+    keep serving the last-known ready set instead of draining to 503s,
+    surface the mode in /metrics + /debug/lb_state, and leave it the
+    moment the sync heals."""
+    from aiohttp import web
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYT_LB_STALE_PROBE_TIMEOUT_S', '1')
+    live = _ok_replica('stale-live')
+
+    # Fake controller the LB really syncs from.
+    ctrl_port = _free_port()
+
+    async def sync_handler(request):
+        del request
+        return web.json_response({'ready_replica_urls': [live]})
+
+    ctrl_app = web.Application()
+    ctrl_app.router.add_post('/controller/load_balancer_sync',
+                             sync_handler)
+    _run_app_bg(ctrl_app, ctrl_port)
+
+    reg = metrics_lib.MetricsRegistry()
+    lb_port = _free_port()
+    lb = lb_lib.SkyServeLoadBalancer(
+        f'http://127.0.0.1:{ctrl_port}', lb_port, metrics_registry=reg)
+    _run_app_bg(lb.make_app(), lb_port)
+    base = f'http://127.0.0.1:{lb_port}'
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            lb.policy.ready_replicas != [live]:
+        time.sleep(0.1)
+    assert lb.policy.ready_replicas == [live]
+
+    # Partition: every further sync fails at the fault point.
+    faults.configure('lb.sync=error')
+    deadline = time.time() + 30
+    while time.time() < deadline and not lb._stale:  # pylint: disable=protected-access
+        time.sleep(0.1)
+    assert lb._stale  # pylint: disable=protected-access
+
+    # Degraded, not down: the stale replica set still serves, and the
+    # mode is visible to operators and traces.
+    for _ in range(4):
+        r = requests.get(base + '/g', timeout=10)
+        assert r.status_code == 200 and r.text == 'hello-stale-live'
+    state = requests.get(base + '/debug/lb_state', timeout=5).json()
+    assert state['stale'] is True
+    assert state['ready_replicas'] == [live]
+    assert 'skyt_lb_stale 1' in requests.get(base + '/metrics',
+                                             timeout=5).text
+
+    # Sync heals: stale mode exits, fresh state applies.
+    faults.reset()
+    deadline = time.time() + 30
+    while time.time() < deadline and lb._stale:  # pylint: disable=protected-access
+        time.sleep(0.1)
+    assert not lb._stale  # pylint: disable=protected-access
+    assert 'skyt_lb_stale 0' in requests.get(base + '/metrics',
+                                             timeout=5).text
+
+
+def test_lb_stale_probe_prunes_dead_replica(monkeypatch):
+    """Stale-mode health probes: a replica that dies while the
+    controller is partitioned away is pruned from the stale ready set
+    (no traffic pinned on a corpse for the whole partition)."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYT_LB_STALE_PROBE_TIMEOUT_S', '1')
+    monkeypatch.setenv('SKYT_LB_RETRY_BACKOFF_S', '0.01')
+    live = _ok_replica('sp-live')
+    # A REAL subprocess replica we can kill mid-partition.
+    dead_port = _free_port()
+    dead_proc = subprocess.Popen(
+        [sys.executable, '-c',
+         'import http.server, sys\n'
+         'class H(http.server.BaseHTTPRequestHandler):\n'
+         '    def do_GET(self):\n'
+         '        self.send_response(200); self.end_headers()\n'
+         '    def log_message(self, *a): pass\n'
+         f'http.server.HTTPServer(("127.0.0.1", {dead_port}), '
+         'H).serve_forever()'])
+    dead = f'http://127.0.0.1:{dead_port}'
+    ctrl_port = _free_port()
+
+    from aiohttp import web
+
+    async def sync_handler(request):
+        del request
+        return web.json_response({'ready_replica_urls': [live, dead]})
+
+    ctrl_app = web.Application()
+    ctrl_app.router.add_post('/controller/load_balancer_sync',
+                             sync_handler)
+    _run_app_bg(ctrl_app, ctrl_port)
+
+    reg = metrics_lib.MetricsRegistry()
+    lb_port = _free_port()
+    lb = lb_lib.SkyServeLoadBalancer(
+        f'http://127.0.0.1:{ctrl_port}', lb_port, metrics_registry=reg,
+        stale_probe_path='/')     # the service's readiness contract
+    _run_app_bg(lb.make_app(), lb_port)
+    try:
+        _wait_http(dead + '/x')
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                sorted(lb.policy.ready_replicas) != sorted([live, dead]):
+            time.sleep(0.1)
+        assert sorted(lb.policy.ready_replicas) == sorted([live, dead])
+        # Partition, then kill the replica DURING it.
+        faults.configure('lb.sync=error')
+        deadline = time.time() + 30
+        while time.time() < deadline and not lb._stale:  # pylint: disable=protected-access
+            time.sleep(0.1)
+        dead_proc.kill()
+        dead_proc.wait(timeout=30)
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                dead in lb.policy.ready_replicas:
+            time.sleep(0.1)
+        assert lb.policy.ready_replicas == [live]
+        pruned = reg.counter('skyt_lb_stale_pruned_total', '')
+        assert pruned.value() >= 1
+        # And traffic still flows on the survivor.
+        r = requests.get(f'http://127.0.0.1:{lb_port}/g', timeout=10)
+        assert r.status_code == 200 and r.text == 'hello-sp-live'
+    finally:
+        faults.reset()
+        if dead_proc.poll() is None:
+            dead_proc.kill()
+
+
+def test_lb_stale_probe_threshold_recovery_and_no_contract(monkeypatch):
+    """Stale-mode pruning discipline: (a) a replica is pruned only
+    after SKYT_LB_STALE_PROBE_THRESHOLD CONSECUTIVE failures (one slow
+    probe under partition load must not drop a loaded replica), (b) a
+    pruned replica that recovers is RE-ADDED (probe rounds cover the
+    full snapshot, not just survivors), (c) with no readiness contract
+    configured the snapshot is served untouched — probing a path the
+    replicas never promised would prune healthy ones."""
+    import asyncio as aio
+
+    import aiohttp
+    from aiohttp import web
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_LB_STALE_PROBE_THRESHOLD', '3')
+    monkeypatch.setenv('SKYT_LB_STALE_PROBE_TIMEOUT_S', '1')
+    health = {'ok': True}
+
+    async def hc(request):
+        del request
+        return web.Response(status=200 if health['ok'] else 500)
+
+    app = web.Application()
+    app.router.add_get('/hc', hc)
+    port = _free_port()
+    _run_app_bg(app, port)
+    url = f'http://127.0.0.1:{port}'
+    _wait_http(url + '/hc')
+
+    async def run():
+        reg = metrics_lib.MetricsRegistry()
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:9', 1, metrics_registry=reg,
+            stale_probe_path='/hc')
+        lb._session = aiohttp.ClientSession()  # pylint: disable=protected-access
+        try:
+            lb.apply_state(lb_lib.LBState(
+                ready_replicas=[url], synced_at=time.monotonic()))
+            health['ok'] = False
+            for i in range(2):
+                await lb._prune_stale_replicas()  # pylint: disable=protected-access
+                assert lb.policy.ready_replicas == [url], \
+                    f'pruned after only {i + 1} failure(s)'
+            await lb._prune_stale_replicas()  # pylint: disable=protected-access
+            assert lb.policy.ready_replicas == []     # 3rd: pruned
+            pruned = reg.counter('skyt_lb_stale_pruned_total', '')
+            assert pruned.value() == 1
+            # Recovery: the next round re-probes the full snapshot and
+            # re-admits the healed replica.
+            health['ok'] = True
+            await lb._prune_stale_replicas()  # pylint: disable=protected-access
+            assert lb.policy.ready_replicas == [url]
+            assert pruned.value() == 1                # no double count
+
+            # No contract, no env override: pruning is a no-op even
+            # with a stone-dead replica in the snapshot.
+            lb2 = lb_lib.SkyServeLoadBalancer(
+                'http://127.0.0.1:9', 1,
+                metrics_registry=metrics_lib.MetricsRegistry())
+            lb2._session = lb._session  # pylint: disable=protected-access
+            dead = f'http://127.0.0.1:{_free_port()}'
+            lb2.apply_state(lb_lib.LBState(
+                ready_replicas=[dead], synced_at=time.monotonic()))
+            await lb2._prune_stale_replicas()  # pylint: disable=protected-access
+            assert lb2.policy.ready_replicas == [dead]
+        finally:
+            await lb._session.close()  # pylint: disable=protected-access
+
+    aio.run(run())
+
+
+def test_lb_stale_ttl_drains(monkeypatch):
+    """A stale snapshot older than SKYT_LB_STALE_TTL_S stops being
+    served: a too-old world view is worse than an honest 503."""
+    import asyncio as aio
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_LB_STALE_TTL_S', '0.2')
+    reg = metrics_lib.MetricsRegistry()
+    lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:9', 1,
+                                     metrics_registry=reg)
+    lb.apply_state(lb_lib.LBState(
+        ready_replicas=['http://r1'], synced_at=time.monotonic() - 10))
+    assert lb.policy.ready_replicas == ['http://r1']
+    aio.run(lb._enter_or_hold_stale())  # pylint: disable=protected-access
+    assert lb.policy.ready_replicas == []
+    assert reg.gauge('skyt_lb_stale', '').value() == 1
+
+
+def test_leader_lease_survives_nothing_flock_released_on_kill(tmp_path):
+    """LeaderLease is kernel-backed: SIGKILLing the holder releases the
+    flock instantly, and a waiting standby acquires on its next poll —
+    no heartbeat-expiry guessing."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lease_path = str(tmp_path / 'x.lease')
+    holder = subprocess.Popen(
+        [sys.executable, '-c',
+         'import sys, time\n'
+         f'sys.path.insert(0, {repo!r})\n'
+         'from skypilot_tpu.serve import load_balancer as lb_lib\n'
+         f'lease = lb_lib.LeaderLease({lease_path!r})\n'
+         'assert lease.try_acquire()\n'
+         "print('HELD', flush=True)\n"
+         'time.sleep(3600)'],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        assert holder.stdout.readline().strip() == 'HELD'
+        ours = lb_lib.LeaderLease(lease_path, interval_s=0.1)
+        assert not ours.try_acquire()          # leader alive: denied
+        info = ours.holder()
+        assert info and info['pid'] == holder.pid
+        holder.kill()
+        holder.wait(timeout=30)
+        deadline = time.time() + 5
+        while time.time() < deadline and not ours.try_acquire():
+            time.sleep(0.05)
+        assert ours.held                       # takeover ≤ one interval
+        ours.heartbeat()
+        assert ours.holder()['pid'] == os.getpid()
+        ours.release()
+    finally:
+        if holder.poll() is None:
+            holder.kill()
+
+
+def test_restart_adopts_live_and_reaps_orphans(tmp_state_dir,
+                                               monkeypatch):
+    """Restart adoption truth table, in-process: a live probed replica
+    with a matching pid identity is ADOPTED (no relaunch); a dead-pid
+    row is reaped even though its endpoint still answers (pid identity
+    wins over a lucky probe); a stale-spec-version row is reaped; the
+    `replica.orphan` fault point forces the reap path on demand."""
+    del tmp_state_dir
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as cluster_state
+    from skypilot_tpu.runtime import reaper
+    from skypilot_tpu.serve import replica_managers
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    serve_state.reset_db_for_testing()
+    spec = spec_lib.ServiceSpec(readiness_path='/', min_replicas=4,
+                                probe_timeout_seconds=2)
+    serve_state.add_service('rsvc', spec, '/t.yaml', 1, 2)
+    live_url = _ok_replica('adopt')
+    me = os.getpid()
+    token = reaper.pid_start_token(me)
+
+    def row(rid, **kw):
+        info = replica_managers.ReplicaInfo(
+            replica_id=rid, cluster_name=f'rsvc-{rid}', version=1,
+            status=serve_state.ReplicaStatus.READY,
+            endpoint=live_url, pid=me, pid_start=token)
+        for k, v in kw.items():
+            setattr(info, k, v)
+        serve_state.upsert_replica('rsvc', rid, info)
+
+    row(1)                                     # adoptable
+    row(2, pid=999999)                         # dead pid, live endpoint
+    row(3)                                     # fault-forced orphan
+    row(4, version=2)                          # stale spec version
+    # FAILED row whose teardown the old controller never finished:
+    # must be reaped (cluster torn down), not leaked until the prune
+    # sweep erases the only record of it.
+    row(5, status=serve_state.ReplicaStatus.FAILED)
+    faults.configure('replica.orphan=error,where=replica:3')
+    monkeypatch.setattr(cluster_state, 'get_cluster',
+                        lambda name: {'handle': None})
+    downed = []
+    monkeypatch.setattr(core_lib, 'down',
+                        lambda name, purge=False: downed.append(name))
+    reg = metrics_lib.MetricsRegistry()
+    mgr = replica_managers.ReplicaManager(
+        'rsvc', spec, '/t.yaml', metrics_registry=reg)
+    assert mgr.replicas[1].status is serve_state.ReplicaStatus.READY
+    assert mgr.replicas[1].adopted_at is not None
+    adoptions = reg.counter('skyt_serve_replica_adoptions_total', '',
+                            ('service',))
+    reaps = reg.counter('skyt_serve_replica_reaps_total', '',
+                        ('service', 'reason'))
+    assert adoptions.value('rsvc') == 1
+    assert reaps.value('rsvc', 'dead_pid') == 1
+    assert reaps.value('rsvc', 'fault_injected') == 1
+    assert reaps.value('rsvc', 'stale_spec_version') == 1
+    assert reaps.value('rsvc', 'failed_pre_restart') == 1
+    # Reaped rows head to teardown, not the ready set.
+    assert mgr.ready_urls() == [live_url]
+    deadline = time.time() + 10
+    while time.time() < deadline and len(downed) < 4:
+        time.sleep(0.05)
+    assert sorted(downed) == ['rsvc-2', 'rsvc-3', 'rsvc-4', 'rsvc-5']
+
+
+# The replica task for control-plane drills: a dumb 200-everything
+# HTTP server (same shape as tests/test_serve.py REPLICA_SERVER).
+_REPLICA_SERVER = (
+    "python -c \""
+    "import http.server, os;\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        self.send_response(200); self.end_headers();\n"
+    "        self.wfile.write(('hello-from-' + "
+    "os.environ['SKYT_REPLICA_PORT']).encode())\n"
+    "    def do_POST(self):\n"
+    "        self.do_GET()\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYT_REPLICA_PORT'])), H).serve_forever()\"")
+
+
+@pytest.fixture()
+def control_plane_env(tmp_path, tmp_state_dir, monkeypatch):
+    """Local-provider serve environment with fast control loops, for
+    drills that run the real controller as a killable subprocess."""
+    del tmp_state_dir
+    from skypilot_tpu import state
+    from skypilot_tpu.serve import serve_state
+    monkeypatch.setenv('SKYT_LOCAL_ROOT', str(tmp_path / 'local'))
+    monkeypatch.setenv('SKYT_DEFAULT_STORE', 'local')
+    monkeypatch.setenv('SKYT_SERVE_CONTROLLER_INTERVAL', '0.3')
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '0.3')
+    state.reset_db_for_testing()
+    serve_state.reset_db_for_testing()
+    yield tmp_path
+    from skypilot_tpu import core as core_lib
+    for rec in state.get_clusters():
+        try:
+            core_lib.down(rec['name'], purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    state.reset_db_for_testing()
+    serve_state.reset_db_for_testing()
+
+
+def _spawn_service(name, role):
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service-name', name, '--role', role],
+        env=dict(os.environ), stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+
+
+def _wait_replicas_ready(name, want, timeout=120):
+    from skypilot_tpu.serve import serve_state
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        infos = serve_state.get_replicas(name)
+        ready = [r for r in infos
+                 if r.status is serve_state.ReplicaStatus.READY]
+        if len(ready) >= want:
+            return ready
+        time.sleep(0.5)
+    raise AssertionError(
+        f'{want} replicas never READY: '
+        f'{[(r.replica_id, r.status) for r in serve_state.get_replicas(name)]}')
+
+
+@pytest.mark.integration
+def test_chaos_controller_sigkill_adoption_zero_relaunches(
+        control_plane_env):
+    """THE control-plane acceptance drill: SIGKILL the controller
+    mid-burst. In-flight and subsequent requests keep succeeding
+    through the LB's stale-state mode (0 client-visible 5xx, replicas
+    were never touched), and a restarted controller ADOPTS every READY
+    replica — zero relaunches, asserted via /controller/metrics."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    tmp_path = control_plane_env
+    task = sky.Task(name='ccp', run=_REPLICA_SERVER)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    spec = spec_lib.ServiceSpec(
+        readiness_path='/', min_replicas=2,
+        initial_delay_seconds=60, probe_timeout_seconds=2)
+    task.service = spec
+    task_yaml = str(tmp_path / 'ccp.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(task.to_yaml_config(), f)
+    cport, lport = _free_port(), _free_port()
+    assert serve_state.add_service('ccp', spec, task_yaml, cport, lport)
+    token = serve_state.get_service('ccp')['auth_token']
+
+    ctrl = _spawn_service('ccp', 'controller')
+    lb = None
+    try:
+        _wait_replicas_ready('ccp', 2)
+        # The LB runs in OUR process (it must survive the controller
+        # kill), syncing from the real controller.
+        reg = metrics_lib.MetricsRegistry()
+        lb_port = _free_port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            f'http://127.0.0.1:{cport}', lb_port,
+            controller_auth=token, metrics_registry=reg)
+        _run_app_bg(lb.make_app(), lb_port)
+        base = f'http://127.0.0.1:{lb_port}'
+        deadline = time.time() + 60
+        while time.time() < deadline and \
+                len(lb.policy.ready_replicas) < 2:
+            time.sleep(0.2)
+        assert len(lb.policy.ready_replicas) == 2
+
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            r = requests.get(base + f'/burst-{i}', timeout=60)
+            with lock:
+                results.append(r.status_code)
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(12)]
+        for th in threads[:4]:
+            th.start()
+        # The chaos event: controller dies mid-burst, no grace.
+        ctrl.kill()
+        for th in threads[4:]:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        ctrl.wait(timeout=30)
+        assert results == [200] * 12, results
+
+        # The LB noticed the partition and kept serving stale state.
+        deadline = time.time() + 30
+        while time.time() < deadline and not lb._stale:  # pylint: disable=protected-access
+            time.sleep(0.2)
+        assert lb._stale  # pylint: disable=protected-access
+        r = requests.get(base + '/after-death', timeout=30)
+        assert r.status_code == 200
+
+        # Restart: the new controller must ADOPT, not relaunch.
+        ctrl = _spawn_service('ccp', 'controller')
+        _wait_replicas_ready('ccp', 2)
+        headers = {'Authorization': f'Bearer {token}'}
+        deadline = time.time() + 60
+        metrics_text = ''
+        while time.time() < deadline:
+            try:
+                metrics_text = requests.get(
+                    f'http://127.0.0.1:{cport}/controller/metrics',
+                    headers=headers, timeout=5).text
+                if ('skyt_serve_replica_adoptions_total'
+                        '{service="ccp"} 2') in metrics_text:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.5)
+        assert ('skyt_serve_replica_adoptions_total{service="ccp"} 2'
+                in metrics_text), metrics_text
+        # Zero relaunches: the launch counter never ticked in the
+        # restarted process, and no reap happened.
+        assert 'skyt_serve_replica_launches_total{service="ccp"}' \
+            not in metrics_text, metrics_text
+        # (sample lines carry labels — the bare name also appears in
+        # HELP/TYPE headers, so match the labeled form)
+        assert 'skyt_serve_replica_reaps_total{' not in metrics_text, \
+            metrics_text
+        # Same replica ids as before the crash — really the same
+        # replicas, not lookalikes.
+        ready = _wait_replicas_ready('ccp', 2)
+        assert {r.replica_id for r in ready} == {1, 2}
+        assert all(r.adopted_at is not None for r in ready)
+        # And the healed sync pulls the LB out of stale mode.
+        deadline = time.time() + 30
+        while time.time() < deadline and lb._stale:  # pylint: disable=protected-access
+            time.sleep(0.2)
+        assert not lb._stale  # pylint: disable=protected-access
+        assert requests.get(base + '/after-restart',
+                            timeout=30).status_code == 200
+    finally:
+        if ctrl.poll() is None:
+            ctrl.kill()
+        del lb
+
+
+@pytest.mark.integration
+def test_controller_crash_fault_point_fires(control_plane_env,
+                                            monkeypatch):
+    """`SKYT_FAULTS=controller.crash=crash` SIGKILLs the controller
+    from inside its own control loop — the arm-it-and-watch way to run
+    the restart-adoption drill without test scaffolding kills."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    tmp_path = control_plane_env
+    task = sky.Task(name='crsvc', run='sleep 3600')
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    spec = spec_lib.ServiceSpec(readiness_path='/', min_replicas=0,
+                                max_replicas=1,
+                                target_qps_per_replica=1.0)
+    task.service = spec
+    task_yaml = str(tmp_path / 'crsvc.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(task.to_yaml_config(), f)
+    assert serve_state.add_service('crsvc', spec, task_yaml,
+                                   _free_port(), _free_port())
+    monkeypatch.setenv('SKYT_FAULTS', 'controller.crash=crash,after=2')
+    ctrl = _spawn_service('crsvc', 'controller')
+    try:
+        ctrl.wait(timeout=120)
+        assert ctrl.returncode == -signal.SIGKILL, ctrl.returncode
+    finally:
+        if ctrl.poll() is None:
+            ctrl.kill()
+
+
+@pytest.mark.integration
+def test_lb_standby_takes_over_port(tmp_state_dir, monkeypatch):
+    """Hot-standby failover: two `--role lb` processes; the leader
+    owns the port, the standby mirrors LBState via the same controller
+    sync. SIGKILL the leader → the standby takes over the port within
+    ~one lease interval and serves the same replica set."""
+    from aiohttp import web
+
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service as service_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    del tmp_state_dir
+    serve_state.reset_db_for_testing()
+    monkeypatch.setenv('SKYT_LB_LEASE_INTERVAL_S', '0.2')
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '0.3')
+    replica = _ok_replica('standby-drill')
+    cport, lport = _free_port(), _free_port()
+    spec = spec_lib.ServiceSpec(readiness_path='/', min_replicas=1)
+    assert serve_state.add_service('sbsvc', spec, '/t.yaml', cport,
+                                   lport)
+
+    async def sync_handler(request):
+        del request
+        return web.json_response({'ready_replica_urls': [replica]})
+
+    ctrl_app = web.Application()
+    ctrl_app.router.add_post('/controller/load_balancer_sync',
+                             sync_handler)
+    _run_app_bg(ctrl_app, cport)
+
+    lbs = [_spawn_service('sbsvc', 'lb') for _ in range(2)]
+    base = f'http://127.0.0.1:{lport}'
+    lease_path = service_lib.lb_lease_path('sbsvc')
+    try:
+        _wait_http(base + '/g', timeout=120)
+        r = requests.get(base + '/g', timeout=10)
+        assert r.status_code == 200 and r.text == 'hello-standby-drill'
+        with open(lease_path, 'r', encoding='utf-8') as f:
+            leader_pid = __import__('json').loads(f.read())['pid']
+        assert leader_pid in [p.pid for p in lbs]
+        standby_pid = next(p.pid for p in lbs if p.pid != leader_pid)
+
+        os.kill(leader_pid, signal.SIGKILL)
+        t0 = time.time()
+        deadline = t0 + 30
+        took_over = None
+        while time.time() < deadline:
+            try:
+                r = requests.get(base + '/g', timeout=5)
+                if r.status_code == 200:
+                    took_over = time.time() - t0
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.1)
+        assert took_over is not None, 'standby never took the port'
+        assert r.text == 'hello-standby-drill'
+        with open(lease_path, 'r', encoding='utf-8') as f:
+            assert __import__('json').loads(f.read())['pid'] == \
+                standby_pid
+        # The new leader advertises leadership on its own /metrics.
+        assert 'skyt_lb_leader 1' in requests.get(
+            base + '/metrics', timeout=5).text
+    finally:
+        for p in lbs:
+            if p.poll() is None:
+                p.kill()
+        serve_state.remove_service('sbsvc')
+
+
 # ================================================ preemption guard modes
 def test_preemption_guard_immediate_exit_during_startup():
     """Startup phase (immediate=True): SIGTERM exits with

@@ -40,3 +40,32 @@ def test_lint_forbids_pallas_call_outside_ops(tmp_path):
     bad.write_text('from jax.experimental import pallas as pl\n'
                    'out = pl.pallas_call(lambda r: None)  # noqa\n')
     assert not any('pallas_call' in i for i in lint.check_file(bad))
+
+
+def test_lint_forbids_direct_sqlite_connect(tmp_path):
+    """State-DB discipline: a raw sqlite3.connect in framework code
+    must flag (it misses the WAL + busy-timeout recipe multi-process
+    sharing relies on); the sanctioned owners and `# noqa` pass."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / 'skypilot_tpu' / 'jobs' / 'sneaky_state.py'
+    bad.parent.mkdir(parents=True)
+    bad.write_text('import sqlite3\n'
+                   'conn = sqlite3.connect("/tmp/x.db")\n')
+    issues = lint.check_file(bad)
+    assert any('sqlite3.connect' in i for i in issues), issues
+
+    for owner in ('utils/sqlite_utils.py', 'serve/serve_state.py'):
+        ok = tmp_path / 'skypilot_tpu' / owner
+        ok.parent.mkdir(parents=True, exist_ok=True)
+        ok.write_text('import sqlite3\n'
+                      'conn = sqlite3.connect("/tmp/x.db")\n')
+        assert not any('sqlite3.connect' in i
+                       for i in lint.check_file(ok)), owner
+
+    bad.write_text('import sqlite3\n'
+                   'conn = sqlite3.connect("/tmp/x.db")  # noqa\n')
+    assert not any('sqlite3.connect' in i for i in lint.check_file(bad))

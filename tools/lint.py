@@ -31,6 +31,13 @@ Checks:
     block selection, the XLA fallback rung, and kernel-path metrics.
     A Pallas call elsewhere would reintroduce the BENCH_r02 class of
     hard lowering crash. Mark a deliberate exception with `# noqa`.
+  * direct `sqlite3.connect(` in skypilot_tpu/ outside
+    utils/sqlite_utils.py (and serve/serve_state.py, which owns the
+    serve.db open-with-integrity-check) — every state DB is shared
+    across processes (controller, standby LB, client CLI), and a raw
+    connect misses the WAL + busy-timeout recipe that makes that safe
+    (docs/robustness.md "Control plane"). `# noqa` for deliberate
+    exceptions.
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -147,6 +154,34 @@ def _pallas_call_issues(path: Path, lines):
     return issues
 
 
+# State-DB discipline (docs/robustness.md "Control plane"): every
+# sqlite connection in framework code goes through
+# utils/sqlite_utils.connect — WAL + busy-timeout is what lets the
+# controller, a standby LB, and the client CLI share one DB without
+# 'database is locked' flakes. serve_state.py additionally wraps the
+# open in its corrupt/fail-fast check and may own raw pragmas.
+_SQLITE_CONNECT_RE = re.compile(r'\bsqlite3\s*\.\s*connect\s*\(')
+_SQLITE_CONNECT_OK = (
+    'skypilot_tpu/utils/sqlite_utils.py',
+    'skypilot_tpu/serve/serve_state.py',
+)
+
+
+def _sqlite_connect_issues(path: Path, lines):
+    issues = []
+    for i, line in enumerate(lines, 1):
+        if not _SQLITE_CONNECT_RE.search(line.split('#', 1)[0]):
+            continue
+        if 'noqa' in line:
+            continue
+        issues.append(
+            f'{path}:{i}: direct sqlite3.connect( — state DBs are '
+            f'multi-process; open them through '
+            f'utils/sqlite_utils.connect so the WAL + busy-timeout '
+            f'recipe applies (or add `# noqa` with a justification)')
+    return issues
+
+
 # Files whose loops may not contain host-sync calls: the sft step loop
 # is the train hot path — one bare jax.device_get per step serializes
 # host and device (the deferred-metrics helper in train/trainer.py is
@@ -247,6 +282,10 @@ def check_file(path: Path):
     if 'skypilot_tpu' in path.as_posix() and \
             'skypilot_tpu/ops/' not in path.as_posix():
         issues += _pallas_call_issues(path, lines)
+
+    if 'skypilot_tpu' in path.as_posix() and not any(
+            path.as_posix().endswith(p) for p in _SQLITE_CONNECT_OK):
+        issues += _sqlite_connect_issues(path, lines)
 
     if 'skypilot_tpu' in path.as_posix() and not any(
             path.as_posix().endswith(p) for p in _EXCEPT_PASS_OK):

@@ -576,6 +576,167 @@ else
     FAIL=1
 fi
 
+echo "== 10. control-plane drill: SIGKILL the serve controller mid-"
+echo "   burst — the LB's stale-state mode must keep every request at"
+echo "   200 (0 client-visible 5xx), and a restarted controller must"
+echo "   ADOPT the replicas (relaunch counter == 0 on /metrics) =="
+if SKYT_SERVE_CONTROLLER_INTERVAL=0.3 SKYT_SERVE_LB_SYNC_INTERVAL=0.3 \
+        SKYT_STATE_DIR=/tmp/skyt_cp_drill/state \
+        SKYT_LOCAL_ROOT=/tmp/skyt_cp_drill/local \
+        SKYT_DEFAULT_STORE=local \
+        timeout 600 python - <<'PYEOF' 2>&1 | tee "$OUT/control_plane_drill.txt"
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import requests
+import yaml
+from aiohttp import web
+
+shutil.rmtree('/tmp/skyt_cp_drill', ignore_errors=True)
+
+import skypilot_tpu as sky
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import metrics as metrics_lib
+
+REPLICA = (
+    "python -c \""
+    "import http.server, os;\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        self.send_response(200); self.end_headers();\n"
+    "        self.wfile.write(b'ok')\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYT_REPLICA_PORT'])), H).serve_forever()\"")
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+task = sky.Task(name='cpd', run=REPLICA)
+task.set_resources(resources_lib.Resources(cloud='local'))
+spec = spec_lib.ServiceSpec(readiness_path='/', min_replicas=2,
+                            initial_delay_seconds=120,
+                            probe_timeout_seconds=2)
+task.service = spec
+task_yaml = '/tmp/skyt_cp_drill/cpd.task.yaml'
+os.makedirs(os.path.dirname(task_yaml), exist_ok=True)
+with open(task_yaml, 'w', encoding='utf-8') as f:
+    yaml.safe_dump(task.to_yaml_config(), f)
+cport, lport = free_port(), free_port()
+assert serve_state.add_service('cpd', spec, task_yaml, cport, lport)
+token = serve_state.get_service('cpd')['auth_token']
+
+def spawn_controller():
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service-name', 'cpd', '--role', 'controller'],
+        env=dict(os.environ))
+
+def wait_ready(n, timeout=180):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ready = [r for r in serve_state.get_replicas('cpd')
+                 if r.status is serve_state.ReplicaStatus.READY]
+        if len(ready) >= n:
+            return ready
+        time.sleep(0.5)
+    raise SystemExit(f'{n} replicas never READY')
+
+ctrl = spawn_controller()
+try:
+    wait_ready(2)
+    reg = metrics_lib.MetricsRegistry()
+    lb_port = free_port()
+    lb = lb_lib.SkyServeLoadBalancer(
+        f'http://127.0.0.1:{cport}', lb_port,
+        controller_auth=token, metrics_registry=reg)
+    threading.Thread(target=lambda: web.run_app(
+        lb.make_app(), port=lb_port, print=None,
+        handle_signals=False), daemon=True).start()
+    base = f'http://127.0.0.1:{lb_port}'
+    deadline = time.time() + 60
+    while time.time() < deadline and len(lb.policy.ready_replicas) < 2:
+        time.sleep(0.2)
+    assert len(lb.policy.ready_replicas) == 2, lb.policy.ready_replicas
+
+    results, lock = [], threading.Lock()
+    def one(i):
+        r = requests.get(base + f'/drill-{i}', timeout=60)
+        with lock:
+            results.append(r.status_code)
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(12)]
+    for th in threads[:4]:
+        th.start()
+    ctrl.kill()                      # the chaos event: no grace
+    for th in threads[4:]:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    bad = [c for c in results if c != 200]
+    assert len(results) == 12 and not bad, \
+        f'client-visible failures: {results}'
+    # Stale-state mode engaged and still serving.
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            'skyt_lb_stale 1' not in requests.get(
+                base + '/metrics', timeout=5).text:
+        time.sleep(0.3)
+    assert requests.get(base + '/post-kill', timeout=30).status_code \
+        == 200
+
+    ctrl = spawn_controller()        # restart: adopt, don't relaunch
+    wait_ready(2)
+    headers = {'Authorization': f'Bearer {token}'}
+    deadline = time.time() + 60
+    text = ''
+    while time.time() < deadline:
+        try:
+            text = requests.get(
+                f'http://127.0.0.1:{cport}/controller/metrics',
+                headers=headers, timeout=5).text
+            if 'skyt_serve_replica_adoptions_total{service="cpd"} 2' \
+                    in text:
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(0.5)
+    assert 'skyt_serve_replica_adoptions_total{service="cpd"} 2' \
+        in text, [l for l in text.splitlines() if 'replica' in l]
+    assert 'skyt_serve_replica_launches_total{service="cpd"}' \
+        not in text, 'controller RELAUNCHED instead of adopting'
+    assert 'skyt_serve_replica_reaps_total{' not in text
+    print('CONTROL_PLANE_DRILL_OK 12/12 through controller death, '
+          'adoptions=2 relaunches=0')
+finally:
+    if ctrl.poll() is None:
+        ctrl.kill()
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import state as cluster_state
+    for rec in cluster_state.get_clusters():
+        try:
+            core_lib.down(rec['name'], purge=True)
+        except Exception:
+            pass
+PYEOF
+then
+    echo "== control-plane drill: PASS =="
+else
+    echo "== control-plane drill: FAIL (see $OUT/control_plane_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
