@@ -35,13 +35,14 @@ _PAGE = """<!DOCTYPE html>
 <h2>Clusters</h2>{clusters}
 <h2>Managed jobs</h2>{jobs}
 <h2>Services</h2>{services}
+<h2>SLO / fleet</h2>{slo}
 <h2>Metrics</h2>{metrics}
 <h2>Slowest traces</h2>{traces}
 </body></html>"""
 
 _GOOD = {'UP', 'SUCCEEDED', 'READY', 'RUNNING'}
 _BAD = {'FAILED', 'FAILED_SETUP', 'FAILED_CONTROLLER', 'FAILED_NO_RESOURCE',
-        'FAILED_PRECHECKS', 'FAILED_CLEANUP', 'PREEMPTED'}
+        'FAILED_PRECHECKS', 'FAILED_CLEANUP', 'PREEMPTED', 'FIRING'}
 
 
 def _table(headers, rows):
@@ -100,6 +101,67 @@ def _services_html() -> str:
                   rows)
 
 
+def _slo_html() -> str:
+    """Fleet SLO panel: each service's controller answers
+    GET /fleet/slo on its (loopback, bearer-authed) admin port —
+    burn-rate alert state, per-class attainment, and the goodput cost
+    report (docs/observability.md "Fleet plane"). Best-effort and
+    CONCURRENT: controllers are fetched in parallel with a short
+    timeout, so N dead controllers cost one timeout per page render,
+    not N; a dead or pre-fleet controller renders as unreachable,
+    never an error page."""
+    import concurrent.futures as futures
+
+    import requests
+
+    from skypilot_tpu.serve import serve_state
+
+    def fetch(svc):
+        resp = requests.get(
+            f'http://127.0.0.1:{svc["controller_port"]}/fleet/slo',
+            headers={'Authorization':
+                     f'Bearer {svc.get("auth_token", "")}'},
+            timeout=1.0)
+        if resp.status_code != 200:
+            raise ValueError(f'HTTP {resp.status_code}')
+        return resp.json()
+
+    services = serve_state.get_services()
+    results = {}
+    if services:
+        with futures.ThreadPoolExecutor(
+                max_workers=min(8, len(services))) as pool:
+            futs = {pool.submit(fetch, svc): svc['name']
+                    for svc in services}
+            for fut, name in futs.items():
+                try:
+                    results[name] = fut.result()
+                except Exception as e:  # pylint: disable=broad-except
+                    results[name] = e
+    rows = []
+    for svc in services:
+        name = svc['name']
+        data = results.get(name)
+        if not isinstance(data, dict):
+            rows.append([name, '-', f'unreachable ({data})', '-', '-',
+                         '-'])
+            continue
+        good = data.get('goodput', {})
+        gtps = good.get('good_tokens_per_chip_second')
+        for cls, rec in sorted(data.get('slo', {}).items()):
+            att = rec.get('windows', {}).get('1h', {}).get('attainment')
+            burn5 = rec.get('windows', {}).get('5m', {}).get(
+                'burn_rate')
+            rows.append([
+                name, cls,
+                'FIRING' if rec.get('alert') else 'ok',
+                f'{att:.4f}' if att is not None else '-',
+                f'{burn5:.2f}' if burn5 is not None else '-',
+                f'{gtps}' if gtps is not None else '-'])
+    return _table(['service', 'class', 'alert', 'attainment (1h)',
+                   'burn (5m)', 'good tok/chip-s'], rows)
+
+
 def _metrics_html() -> str:
     """Registry snapshot panel for THIS process's metrics. Serve
     daemons and inference replicas are separate processes — scrape
@@ -151,6 +213,7 @@ def _render_page() -> str:
         clusters=_clusters_html(),
         jobs=_jobs_html(),
         services=_services_html(),
+        slo=_slo_html(),
         metrics=_metrics_html(),
         traces=_traces_html())
 

@@ -18,6 +18,10 @@ service.yaml readiness-probes /v1/models). Endpoints:
   GET  /metrics           — Prometheus text exposition (TTFT/ITL
                             histograms, token counters, KV-cache and
                             queue gauges; utils/metrics.py).
+  POST /debug/profile     — ?ms=N on-demand jax.profiler capture
+                            (403 unless SKYT_PROFILE_REMOTE=1;
+                            single-flight; proxied fleet-wide by the
+                            controller's POST /fleet/profile).
   GET  /v1/models         — OpenAI-compatible model listing (the
                             reference's service.yaml readiness-probes
                             this exact path).
@@ -46,6 +50,7 @@ import argparse
 import asyncio
 import functools
 import json
+import os
 import queue as queue_lib
 import time
 from typing import Dict, List, Optional
@@ -55,6 +60,7 @@ from aiohttp import web
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import tokenizer as tokenizer_lib
 from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
@@ -181,6 +187,11 @@ class InferenceServer:
             'skyt_server_client_disconnects_total',
             'Requests whose client disconnected mid-flight (engine '
             'request cancelled)')
+        # SLO goodput accounting (serve/slo.py): every finished
+        # request is classified against its class objective; the fleet
+        # scraper aggregates the resulting counters across replicas.
+        self._goodput = slo_lib.GoodputTracker(
+            registry=engine.metrics_registry)
         # Multi-LoRA routing (vLLM's OpenAI convention): 'model' in a
         # request names either the base model or a loaded adapter.
         self.lora_names = dict(lora_names or {})
@@ -283,6 +294,10 @@ class InferenceServer:
         except ValueError as e:
             return None, None, None, web.json_response(
                 {'error': str(e)}, status=400)
+        # Stash for the goodput middleware: SLO attribution needs the
+        # class/tenant even when the request is later shed or errors.
+        request['skyt_qos_cls'] = cls
+        request['skyt_qos_tenant'] = tenant
         if self._qos is None:
             return cls, tenant, None, None
         dec = self._qos.admit(cls, tenant, max_new_tokens=max_new)
@@ -382,6 +397,84 @@ class InferenceServer:
                     attributes=dict(attrs,
                                     generated=tr.get('generated')),
                     events=[e for e in events if e['ts'] > first])
+
+    def _record_slo(self, request: web.Request, status: int,
+                    t0_wall: float) -> None:
+        """Classify a finished generation request for the SLO goodput
+        counters (serve/slo.py). TTFT is SERVER-side — request arrival
+        to the engine's first token — so queueing, admission, and any
+        injected server.request latency all count against the
+        objective, exactly as the client experiences them. Non-
+        generation routes (no engine work, no parsed class) are
+        skipped; server-caused denials (429 shed, 5xx) burn budget,
+        client-side 4xx do not."""
+        rids = request.get('skyt_engine_rids', ())
+        cls = request.get('skyt_qos_cls')
+        if not rids and cls is None:
+            return
+        cls = cls or qos_lib.DEFAULT_CLASS
+        tenant = request.get('skyt_qos_tenant') or \
+            qos_lib.DEFAULT_TENANT
+        try:
+            if not rids:
+                if status == 429 or status >= 500:
+                    self._goodput.record(cls, tenant, ok=False)
+                return
+            ok = status < 400
+            for rid in rids:
+                tr = self.engine.request_trace(rid) or {}
+                first = tr.get('first_token')
+                done = tr.get('done')
+                gen = int(tr.get('generated') or 0)
+                ttft = (first - t0_wall if first is not None
+                        else None)
+                itl = ((done - first) / (gen - 1)
+                       if done is not None and first is not None
+                       and gen >= 2 else None)
+                self._goodput.record(cls, tenant, ok=ok, ttft_s=ttft,
+                                     itl_s=itl, tokens=gen)
+        except Exception:  # pylint: disable=broad-except
+            # Accounting must never turn a served request into a 500.
+            logger.exception('SLO goodput recording failed')
+
+    async def _debug_profile(self, request: web.Request
+                             ) -> web.Response:
+        """On-demand device profile: ``POST /debug/profile?ms=N``
+        captures a jax.profiler trace of whatever the replica is doing
+        for N ms (docs/observability.md "Fleet plane"). Gated on
+        SKYT_PROFILE_REMOTE=1 — a trace names every op and shape the
+        model runs, so reachability alone must not expose it — and
+        single-flight (409 while one is in progress). On CPU the host
+        trace is degraded but real."""
+        if os.environ.get('SKYT_PROFILE_REMOTE', '0') not in \
+                ('1', 'true'):
+            return web.json_response(
+                {'error': 'remote profiling disabled; start the '
+                          'replica with SKYT_PROFILE_REMOTE=1'},
+                status=403)
+        raw = request.query.get('ms', '1000')
+        try:
+            ms = float(raw)
+            if not 1 <= ms <= 60000:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'ms must be a number in [1, 60000] '
+                          f'milliseconds, got {raw!r}'}, status=400)
+        from skypilot_tpu.utils import profiling as profiling_lib
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, functools.partial(profiling_lib.capture_trace,
+                                        ms))
+        except profiling_lib.ProfilerBusy as e:
+            return web.json_response({'error': str(e)}, status=409)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('profile capture failed')
+            return web.json_response(
+                {'error': f'profile capture failed: {e!r}'},
+                status=500)
+        return web.json_response(result)
 
     async def _health(self, request: web.Request) -> web.Response:
         del request
@@ -1116,6 +1209,9 @@ class InferenceServer:
             resource = request.match_info.route.resource
             path = resource.canonical if resource is not None \
                 else 'unmatched'
+            # Wall-clock arrival: the goodput tracker's server-side
+            # TTFT reference point (engine phase traces use time.time).
+            t0_wall = time.time()
             try:
                 # Histogram.time() observes on the exception path too:
                 # error latency is latency.
@@ -1123,6 +1219,7 @@ class InferenceServer:
                     resp = await handler(request)
             except web.HTTPException as e:
                 m_http.labels(path, str(e.status)).inc()
+                self._record_slo(request, e.status, t0_wall)
                 raise
             except faults.FaultDisconnect:
                 # Injected connection drop: actually sever the socket
@@ -1147,8 +1244,10 @@ class InferenceServer:
                 # aiohttp turns unhandled handler exceptions into 500s
                 # — the error-rate signal this counter exists for.
                 m_http.labels(path, '500').inc()
+                self._record_slo(request, 500, t0_wall)
                 raise
             m_http.labels(path, str(resp.status)).inc()
+            self._record_slo(request, resp.status, t0_wall)
             return resp
 
         @web.middleware
@@ -1192,6 +1291,7 @@ class InferenceServer:
         app.router.add_get('/stats', self._stats)
         app.router.add_get('/metrics', self._metrics)
         app.router.add_get('/debug/traces', self._debug_traces)
+        app.router.add_post('/debug/profile', self._debug_profile)
         app.router.add_post('/generate', self._generate)
         app.router.add_get('/v1/models', self._models)
         app.router.add_post('/v1/completions', self._completions)

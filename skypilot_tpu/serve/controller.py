@@ -15,6 +15,7 @@ from typing import Optional
 from aiohttp import web
 
 from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import fleet as fleet_lib
 from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
@@ -45,11 +46,25 @@ class SkyServeController:
                  task_yaml: str, port: int) -> None:
         self.service_name = service_name
         self.port = port
+        # Fleet telemetry plane (docs/observability.md "Fleet plane"):
+        # the prober's visits double as /metrics scrapes into
+        # per-replica ring stores; /fleet/* serves the aggregates.
+        self.fleet: Optional[fleet_lib.FleetTelemetry] = \
+            fleet_lib.FleetTelemetry(service_name) \
+            if fleet_lib.enabled() else None
         self.replica_manager = replica_managers.ReplicaManager(
-            service_name, spec, task_yaml)
+            service_name, spec, task_yaml, telemetry=self.fleet)
         # QoS-aware mode (SKYT_QOS=1) scales on per-class demand +
         # observed shed rate from the LB sync (docs/qos.md).
         self.autoscaler = autoscalers.pick_autoscaler_cls(spec)(spec)
+        # The LB serves its own /metrics on the externally reachable
+        # port; the fleet store scrapes it under the 'lb' target so
+        # front-door series (breaker state, stale mode, per-replica
+        # traffic) sit beside the replicas' in one page.
+        self._lb_url: Optional[str] = None
+        svc = serve_state.get_service(service_name)
+        if svc is not None and svc.get('lb_port'):
+            self._lb_url = f'http://127.0.0.1:{svc["lb_port"]}'
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
 
@@ -76,6 +91,13 @@ class SkyServeController:
                     decision.target_num_replicas,
                     ondemand_base=ondemand_base)
                 self._update_service_status(ready)
+                if self.fleet is not None:
+                    # LB scrape + SLO evaluation ride the control loop
+                    # (throttled internally); both are no-raise by
+                    # contract, but the loop's catch-all guards anyway.
+                    if self._lb_url is not None:
+                        self.fleet.maybe_scrape('lb', self._lb_url)
+                    self.fleet.tick()
                 if time.time() >= next_prune:
                     next_prune = time.time() + _state_prune_interval()
                     pruned = serve_state.prune_terminal_replicas(
@@ -214,7 +236,22 @@ class SkyServeController:
                             self._handle_terminate)
         app.router.add_get('/controller/status', self._handle_status)
         app.router.add_get('/controller/metrics', self._handle_metrics)
+        if self.fleet is not None:
+            # /fleet/{metrics,slo,profile} sit behind the same bearer
+            # auth as the rest of the admin API (app middleware).
+            fleet_lib.add_fleet_routes(app, self.fleet,
+                                       self._resolve_replica_endpoint)
         return app
+
+    def _resolve_replica_endpoint(self, rid: str) -> Optional[str]:
+        """Replica id (as scraped: str(replica_id)) -> endpoint for
+        the /fleet/profile proxy; READY replicas only — profiling a
+        replica mid-relaunch would block on a dead socket."""
+        for info in self.replica_manager.replicas.values():
+            if str(info.replica_id) == rid and info.endpoint and \
+                    info.status is serve_state.ReplicaStatus.READY:
+                return info.endpoint
+        return None
 
     def start_control_loop(self) -> None:
         self._loop_thread = threading.Thread(target=self._control_loop,

@@ -1,0 +1,370 @@
+"""Fleet telemetry: central time-series aggregation over every
+replica's (and the LB's) /metrics (docs/observability.md "Fleet
+plane").
+
+The controller's prober already visits every replica on a cadence;
+this module rides that loop: each visit also scrapes the target's
+Prometheus exposition into a per-replica bounded ring store
+(utils/timeseries.py), so the control plane can answer fleet-level
+questions — aggregated exposition with a ``replica`` label at
+``GET /fleet/metrics``, SLO attainment / burn-rate alerts / goodput
+and the chip-time cost report at ``GET /fleet/slo``, and on-demand
+device profiling proxied to a chosen replica at
+``POST /fleet/profile``.
+
+Failure discipline (the part that makes this safe to bolt onto the
+probe loop): every scrape runs through the ``telemetry.scrape`` fault
+point and a bounded-timeout GET; a failing scrape counts an error and
+returns — it never raises into the prober and never blocks beyond its
+timeout. A replica whose scrapes keep failing simply AGES OUT of the
+aggregates after ``SKYT_FLEET_STALE_S`` (stale fleet state is worse
+than honest absence), and comes back on the next successful scrape.
+"""
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.serve import slo as slo_lib
+from skypilot_tpu.utils import faults
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import timeseries as ts_lib
+
+logger = log_utils.init_logger(__name__)
+
+
+def enabled() -> bool:
+    """Master switch (default ON — the scrape cost is one bounded GET
+    per replica per SKYT_FLEET_SCRAPE_S, entirely off the serve path)."""
+    return os.environ.get('SKYT_FLEET', '1') not in ('', '0', 'false')
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def _default_http_get(url: str, timeout: float) -> str:
+    import requests
+    resp = requests.get(url, timeout=timeout)
+    resp.raise_for_status()
+    return resp.text
+
+
+class FleetTelemetry:
+    """One ring store per scrape target (replica id or 'lb'), plus the
+    cross-store read protocol the SLO evaluator consumes."""
+
+    def __init__(self, service_name: str,
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None,
+                 clock: Callable[[], float] = time.time,
+                 http_get: Callable[[str, float], str] = _default_http_get,
+                 tracer=None) -> None:
+        self.service_name = service_name
+        self._clock = clock
+        self._http_get = http_get
+        self._lock = threading.Lock()
+        self._stores: Dict[str, ts_lib.TimeSeriesStore] = {}
+        self._last_attempt: Dict[str, float] = {}
+        self._last_ok: Dict[str, float] = {}
+        self.scrape_interval_s = _env_float('SKYT_FLEET_SCRAPE_S', 10.0)
+        self.scrape_timeout_s = _env_float('SKYT_FLEET_SCRAPE_TIMEOUT_S',
+                                           2.0)
+        self.stale_s = _env_float('SKYT_FLEET_STALE_S', 60.0)
+        reg = metrics_registry or metrics_lib.REGISTRY
+        self._m_scrapes = reg.counter(
+            'skyt_fleet_scrapes_total',
+            'Fleet telemetry scrapes by target and outcome',
+            ('replica', 'status'))
+        self._m_scrape_errors = reg.counter(
+            'skyt_fleet_scrape_errors_total',
+            'Failed fleet telemetry scrapes (timeouts, HTTP errors, '
+            'injected telemetry.scrape faults)', ('replica',))
+        self._m_targets = reg.gauge(
+            'skyt_fleet_targets',
+            'Scrape targets currently contributing to the fleet '
+            'aggregates (stale targets aged out)')
+        self._m_dropped = reg.gauge(
+            'skyt_fleet_dropped_series',
+            'Series dropped by per-target ring-store caps, summed '
+            'over live targets')
+        self.evaluator = slo_lib.BurnRateEvaluator(
+            source=self, registry=reg, clock=clock, tracer=tracer)
+
+    # ----------------------------------------------------------- scrape
+    def _store_for(self, target: str) -> ts_lib.TimeSeriesStore:
+        with self._lock:
+            store = self._stores.get(target)
+            if store is None:
+                store = ts_lib.TimeSeriesStore(clock=self._clock)
+                self._stores[target] = store
+            return store
+
+    def scrape(self, target: str, url: str) -> bool:
+        """One scrape of `url`/metrics into `target`'s store. NEVER
+        raises (the probe loop calls this inline); a failure — real or
+        injected via ``SKYT_FAULTS=telemetry.scrape=error[,where=
+        replica:<id>]`` — is counted and aged out, nothing more."""
+        now = self._clock()
+        self._last_attempt[target] = now
+        try:
+            faults.inject('telemetry.scrape', replica=target)
+            text = self._http_get(url.rstrip('/') + '/metrics',
+                                  self.scrape_timeout_s)
+            self._store_for(target).scrape_text(text, ts=now)
+        except Exception as e:  # pylint: disable=broad-except
+            self._m_scrapes.labels(target, 'error').inc()
+            self._m_scrape_errors.labels(target).inc()
+            logger.debug('fleet scrape of %s (%s) failed: %s',
+                         target, url, e)
+            return False
+        self._last_ok[target] = now
+        self._m_scrapes.labels(target, 'ok').inc()
+        return True
+
+    def maybe_scrape(self, target: str, url: str) -> Optional[bool]:
+        """Throttled scrape: no-op (None) until SKYT_FLEET_SCRAPE_S has
+        passed since the last ATTEMPT for this target — both the prober
+        (per replica) and the controller loop (LB) call this every
+        pass and the cadence lives here."""
+        now = self._clock()
+        if now - self._last_attempt.get(target, -1e18) < \
+                self.scrape_interval_s:
+            return None
+        return self.scrape(target, url)
+
+    def ingest_text(self, target: str, text: str,
+                    ts: Optional[float] = None) -> int:
+        """Direct ingestion seam (tests; bench feeds scrapes it
+        fetched itself). Marks the target fresh."""
+        now = self._clock() if ts is None else ts
+        n = self._store_for(target).scrape_text(text, ts=now)
+        self._last_attempt[target] = now
+        self._last_ok[target] = max(self._last_ok.get(target, 0), now)
+        return n
+
+    def drop_target(self, target: str) -> None:
+        with self._lock:
+            self._stores.pop(target, None)
+        self._last_ok.pop(target, None)
+        self._last_attempt.pop(target, None)
+
+    def _prune_stale(self, now: float) -> None:
+        """Age out targets whose last SUCCESSFUL scrape is older than
+        SKYT_FLEET_STALE_S: their frozen counters would silently
+        flatten every fleet rate and pin the goodput denominator."""
+        stale = [t for t, ok_at in list(self._last_ok.items())
+                 if now - ok_at > self.stale_s]
+        for t in stale:
+            logger.info('fleet target %r stale (last scrape %.0fs '
+                        'ago); aging out of the aggregates', t,
+                        now - self._last_ok.get(t, 0))
+            self.drop_target(t)
+
+    def live_targets(self, now: Optional[float] = None) -> List[str]:
+        if now is None:
+            now = self._clock()
+        self._prune_stale(now)
+        with self._lock:
+            targets = sorted(self._stores)
+        self._m_targets.set(len(targets))
+        self._m_dropped.set(sum(
+            s.dropped_series for s in self._live_stores()))
+        return targets
+
+    def live_replicas(self, now: Optional[float] = None) -> List[str]:
+        """Replica targets only (the LB scrape is telemetry about the
+        front door, not serving capacity — it must not inflate the
+        cost report's chip count)."""
+        return [t for t in self.live_targets(now) if t != 'lb']
+
+    def _live_stores(self) -> List[ts_lib.TimeSeriesStore]:
+        with self._lock:
+            return list(self._stores.values())
+
+    # ----------------------------- TimeSeriesStore read protocol (merged)
+    def sum_delta(self, name: str, match: Optional[Dict[str, str]],
+                  window_s: float, now: Optional[float] = None
+                  ) -> Optional[float]:
+        if now is None:
+            now = self._clock()
+        return ts_lib.merge_sum_delta(self._live_stores(), name, match,
+                                      window_s, now)
+
+    def quantile(self, family: str, match: Optional[Dict[str, str]],
+                 q: float, window_s: float,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Cross-replica windowed quantile: sum per-le bucket
+        increases across stores, then the same interpolation a single
+        store uses (ts_lib.quantile_from_buckets) — the fleet p95 is
+        computed from merged counts, never an average of per-replica
+        p95s."""
+        if now is None:
+            now = self._clock()
+        by_le: Dict[float, float] = {}
+        for store in self._live_stores():
+            for le_raw, inc in store.grouped_delta(
+                    family + '_bucket', 'le', window_s, now=now,
+                    match=match).items():
+                le = ts_lib._parse_value(le_raw)  # pylint: disable=protected-access
+                if le is not None:
+                    by_le[le] = by_le.get(le, 0.0) + inc
+        return ts_lib.quantile_from_buckets(by_le, q)
+
+    def grouped_delta(self, name: str, group_label: str,
+                      window_s: float, now: Optional[float] = None,
+                      match: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, float]:
+        if now is None:
+            now = self._clock()
+        out: Dict[str, float] = {}
+        for store in self._live_stores():
+            for key, inc in store.grouped_delta(
+                    name, group_label, window_s, now=now,
+                    match=match).items():
+                out[key] = out.get(key, 0.0) + inc
+        return out
+
+    # ------------------------------------------------------------ views
+    def fleet_metrics_text(self) -> str:
+        """Aggregated exposition: every live target's LATEST samples,
+        each stitched with a ``replica`` label; # TYPE declared once
+        per family. Scrape THIS endpoint with a Prometheus and the
+        whole fleet is one job."""
+        now = self._clock()
+        targets = self.live_targets(now)
+        types: Dict[str, str] = {}
+        per_target: List[List[str]] = []
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in targets
+                      if t in self._stores]
+        for target, store in stores:
+            per_target.append(store.expose_latest(
+                extra_labels={'replica': target}, types=types))
+        lines: List[str] = []
+        for fam, t in sorted(types.items()):
+            lines.append(f'# TYPE {fam} {t}')
+        for chunk in per_target:
+            lines.extend(chunk)
+        return '\n'.join(lines) + ('\n' if lines else '')
+
+    def fleet_slo(self, window_s: Optional[float] = None
+                  ) -> Dict[str, Any]:
+        """The ``GET /fleet/slo`` body: burn-rate/alert state per
+        class, the goodput + chip-time cost report, and per-target
+        scrape health."""
+        now = self._clock()
+        if window_s is None:
+            window_s = self.evaluator.windows.fast_long_s
+        replicas = self.live_replicas(now)
+        report = {
+            'service': self.service_name,
+            'slo': self.evaluator.evaluate(now),
+            'goodput': slo_lib.goodput_report(self, window_s, now,
+                                              replicas=len(replicas)),
+            'targets': {
+                t: {'last_scrape_age_s': round(
+                        now - self._last_ok[t], 1)
+                    if t in self._last_ok else None,
+                    'store': self._stores[t].stats()}
+                for t in self.live_targets(now)
+                if t in self._stores},
+        }
+        return report
+
+    def tick(self) -> None:
+        """Periodic evaluation (controller loop): keeps the burn-rate
+        and alert gauges moving even when nobody polls /fleet/slo."""
+        try:
+            self.live_targets()
+            self.evaluator.evaluate()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('fleet SLO evaluation failed')
+
+
+# ------------------------------------------------------- HTTP surface
+def add_fleet_routes(app, telemetry: 'FleetTelemetry',
+                     resolve_endpoint: Callable[[str], Optional[str]]
+                     ) -> None:
+    """Register the /fleet/* handlers on an aiohttp app (the serve
+    controller's admin app — so they sit behind its bearer auth — or a
+    bare app in tests/validation). `resolve_endpoint` maps a replica id
+    to its base URL for the profile proxy."""
+    import asyncio
+    import functools
+
+    from aiohttp import web
+
+    async def fleet_metrics(request: web.Request) -> web.Response:
+        del request
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(
+            None, telemetry.fleet_metrics_text)
+        return web.Response(body=text.encode('utf-8'),
+                            headers={'Content-Type':
+                                     metrics_lib.CONTENT_TYPE})
+
+    async def fleet_slo(request: web.Request) -> web.Response:
+        window = request.query.get('window_s')
+        try:
+            window_f = float(window) if window else None
+            if window_f is not None and window_f <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'window_s must be a positive number, got '
+                          f'{window!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, functools.partial(telemetry.fleet_slo,
+                                    window_s=window_f))
+        return web.json_response(payload)
+
+    async def fleet_profile(request: web.Request) -> web.Response:
+        """Proxy ``POST /fleet/profile?replica=<id>[&ms=N]`` to that
+        replica's ``/debug/profile`` (single-flight + SKYT_PROFILE_
+        REMOTE gating happen replica-side; this hop only routes)."""
+        rid = request.query.get('replica')
+        if not rid:
+            return web.json_response(
+                {'error': 'replica query parameter required',
+                 'replicas': telemetry.live_replicas()}, status=400)
+        endpoint = resolve_endpoint(rid)
+        if endpoint is None:
+            return web.json_response(
+                {'error': f'unknown or not-ready replica {rid!r}',
+                 'replicas': telemetry.live_replicas()}, status=404)
+        ms = request.query.get('ms', '1000')
+
+        def _forward():
+            import requests
+            try:
+                budget = max(float(ms), 0.0) / 1e3
+            except ValueError:
+                budget = 1.0
+            return requests.post(
+                endpoint.rstrip('/') + '/debug/profile',
+                params={'ms': ms}, timeout=budget + 30.0)
+
+        loop = asyncio.get_running_loop()
+        try:
+            upstream = await loop.run_in_executor(None, _forward)
+        except Exception as e:  # pylint: disable=broad-except
+            return web.json_response(
+                {'error': f'profile proxy to replica {rid!r} failed: '
+                          f'{e!r}'}, status=502)
+        try:
+            body = upstream.json()
+        except ValueError:
+            body = {'error': upstream.text[:500]}
+        if isinstance(body, dict):
+            body.setdefault('replica', rid)
+        return web.json_response(body, status=upstream.status_code)
+
+    app.router.add_get('/fleet/metrics', fleet_metrics)
+    app.router.add_get('/fleet/slo', fleet_slo)
+    app.router.add_post('/fleet/profile', fleet_profile)

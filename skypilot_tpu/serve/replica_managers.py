@@ -118,11 +118,16 @@ class ReplicaManager:
     def __init__(self, service_name: str, spec: 'spec_lib.ServiceSpec',
                  task_yaml: str, version: int = 1,
                  metrics_registry: Optional[
-                     'metrics_lib.MetricsRegistry'] = None) -> None:
+                     'metrics_lib.MetricsRegistry'] = None,
+                 telemetry=None) -> None:
         self.service_name = service_name
         self.spec = spec
         self.task_yaml = task_yaml
         self.version = version
+        # Fleet telemetry plane (serve/fleet.py): the prober's READY
+        # visits double as throttled /metrics scrapes. Optional — the
+        # manager works identically without it.
+        self._telemetry = telemetry
         reg = metrics_registry or metrics_lib.REGISTRY
         self._m_launches = reg.counter(
             'skyt_serve_replica_launches_total', 'Replica launches',
@@ -327,6 +332,10 @@ class ReplicaManager:
         with self._lock:
             self.replicas.pop(info.replica_id, None)
         serve_state.remove_replica(self.service_name, info.replica_id)
+        if self._telemetry is not None:
+            # A torn-down replica leaves the fleet aggregates NOW
+            # (the stale TTL would get it eventually; this is tidier).
+            self._telemetry.drop_target(str(info.replica_id))
 
     # ------------------------------------------------------------- launch
     def _load_task(self):
@@ -582,6 +591,14 @@ class ReplicaManager:
                     self._stats_attempt[info.replica_id] = \
                         self._probe_passes
                     info.stats = self._fetch_stats(info)
+                if self._telemetry is not None and info.endpoint:
+                    # Fleet scrape rides the probe visit: throttled
+                    # (SKYT_FLEET_SCRAPE_S) and no-raise by contract —
+                    # a failing scrape counts an error and ages out,
+                    # never blocks this loop (telemetry.scrape fault
+                    # point; docs/observability.md "Fleet plane").
+                    self._telemetry.maybe_scrape(
+                        str(info.replica_id), info.endpoint)
                 self._save(info)
                 continue
             info.consecutive_failures += 1

@@ -1,0 +1,443 @@
+"""SLO objectives, goodput accounting, and multi-window burn-rate
+alerting (docs/observability.md "Fleet plane").
+
+The QoS plane (serve/qos.py) decides who runs first; this module
+answers whether the promises held: per-class objectives (TTFT p95, ITL
+p95, availability), request/token GOODPUT — work delivered *within*
+its SLO, the only throughput number worth paying chips for (the
+SLO-per-dollar framing the Gemma-on-TPU paper uses) — and error-budget
+burn-rate alerting over the classic paired windows (fast 5m/1h, slow
+6h/3d) with asymmetric fire/clear hysteresis.
+
+Three pieces, one per place in the stack:
+
+  * :func:`objectives` — declarative per-class targets, env-tunable
+    via ``SKYT_SLO_*``;
+  * :class:`GoodputTracker` — lives in the infer server: classifies
+    each finished request against its class objective and publishes
+    ``skyt_slo_{good_,}{requests,tokens}_total{class,tenant}`` plus a
+    per-class TTFT histogram. These counters are what the fleet
+    scraper aggregates;
+  * :class:`BurnRateEvaluator` — lives fleet-side (serve/fleet.py):
+    reads windowed deltas of those counters from a time-series source
+    and drives ``skyt_slo_burn_rate{class,window}`` /
+    ``skyt_slo_alert{class}`` gauges, with a span event per state
+    transition.
+
+Clock discipline: like utils/timeseries.py, this file never calls
+``time.time()`` / ``time.monotonic()`` directly (tools/lint.py
+enforces it) — every clock is injected, so the burn-rate truth table
+in tests/test_slo.py replays deterministically.
+"""
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu.serve import qos as qos_lib
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import tracing
+
+logger = log_utils.init_logger(__name__)
+
+# Default per-class latency objectives (ms). Interactive mirrors the
+# BASELINE.md serve row (p50 TTFT < 500ms -> p95 objective 500ms on
+# the 1B proxy); batch tolerates queueing by design.
+_DEFAULT_TTFT_MS = {'interactive': 500.0, 'standard': 2000.0,
+                    'batch': 10000.0}
+_DEFAULT_ITL_MS = {'interactive': 100.0, 'standard': 250.0,
+                   'batch': 1000.0}
+_DEFAULT_TARGET = 0.99
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassObjective:
+    """One QoS class's promise: p95 TTFT/ITL bounds and the target
+    fraction of requests that must meet them (the SLO target whose
+    complement is the error budget)."""
+    cls: str
+    ttft_ms: float
+    itl_ms: float
+    target: float
+
+    @property
+    def budget(self) -> float:
+        """Error budget = allowed bad fraction."""
+        return max(1e-6, 1.0 - self.target)
+
+
+def objectives() -> Dict[str, ClassObjective]:
+    """Per-class objectives from the environment:
+
+    ``SKYT_SLO_TTFT_MS_<CLASS>`` / ``SKYT_SLO_ITL_MS_<CLASS>`` bound
+    the latency halves; ``SKYT_SLO_TARGET`` (global) or
+    ``SKYT_SLO_TARGET_<CLASS>`` sets the attainment target. Read at
+    call time so tests (and mid-incident operators) can retune without
+    a restart."""
+    target_all = _env_float('SKYT_SLO_TARGET', _DEFAULT_TARGET)
+    out = {}
+    for cls in qos_lib.PRIORITIES:
+        up = cls.upper()
+        out[cls] = ClassObjective(
+            cls=cls,
+            ttft_ms=_env_float(f'SKYT_SLO_TTFT_MS_{up}',
+                               _DEFAULT_TTFT_MS[cls]),
+            itl_ms=_env_float(f'SKYT_SLO_ITL_MS_{up}',
+                              _DEFAULT_ITL_MS[cls]),
+            target=min(0.999999, max(
+                0.0, _env_float(f'SKYT_SLO_TARGET_{up}', target_all))))
+    return out
+
+
+# --------------------------------------------------- goodput accounting
+class GoodputTracker:
+    """Request-completion classifier for one replica.
+
+    The infer server calls :meth:`record` once per finished engine
+    request with what actually happened (status, server-side TTFT,
+    mean ITL, generated tokens); the tracker publishes per
+    (class, tenant) goodput counters and a per-class TTFT histogram.
+    Tenant label cardinality is bounded twice over: qos.parse_tenant's
+    charset/length bound upstream, and utils/metrics' per-family
+    series cap underneath.
+
+    Objectives are re-read from the environment at most once per
+    second — the documented no-restart SKYT_SLO_* retuning must reach
+    the replica-side classifier too (counters classified against
+    stale objectives would disagree with the fleet report) — without
+    paying ~9 env parses on every request."""
+
+    def __init__(self, registry: Optional[
+            'metrics_lib.MetricsRegistry'] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        reg = registry or metrics_lib.REGISTRY
+        self._clock = clock
+        self.objectives = objectives()
+        self._objectives_at = clock()
+        labels = ('cls', 'tenant')
+        self._m_requests = reg.counter(
+            'skyt_slo_requests_total',
+            'Finished requests by QoS class and tenant', labels)
+        self._m_good_requests = reg.counter(
+            'skyt_slo_good_requests_total',
+            'Requests that finished successfully WITHIN their class '
+            'SLO (TTFT/ITL objectives)', labels)
+        self._m_tokens = reg.counter(
+            'skyt_slo_tokens_total',
+            'Generated tokens by QoS class and tenant', labels)
+        self._m_good_tokens = reg.counter(
+            'skyt_slo_good_tokens_total',
+            'Generated tokens belonging to within-SLO requests '
+            '(goodput)', labels)
+        self._m_ttft = reg.histogram(
+            'skyt_slo_ttft_seconds',
+            'Server-side TTFT (request arrival to first token) by '
+            'QoS class', ('cls',))
+
+    def record(self, cls: str, tenant: str, ok: bool,
+               ttft_s: Optional[float] = None,
+               itl_s: Optional[float] = None,
+               tokens: int = 0) -> bool:
+        """Classify one finished request; returns whether it was good
+        (successful AND within every measured latency objective)."""
+        now = self._clock()
+        if now - self._objectives_at >= 1.0:
+            self.objectives = objectives()
+            self._objectives_at = now
+        obj = self.objectives.get(cls)
+        if obj is None:
+            cls = qos_lib.DEFAULT_CLASS
+            obj = self.objectives[cls]
+        good = bool(ok)
+        if good and ttft_s is not None and \
+                ttft_s * 1e3 > obj.ttft_ms:
+            good = False
+        if good and itl_s is not None and itl_s * 1e3 > obj.itl_ms:
+            good = False
+        self._m_requests.labels(cls, tenant).inc()
+        self._m_tokens.labels(cls, tenant).inc(max(0, int(tokens)))
+        if ttft_s is not None:
+            self._m_ttft.labels(cls).observe(ttft_s)
+        # The good counters are touched (inc 0) even on a bad request:
+        # all four series must appear in the SAME scrape as their
+        # flow's first request, or a downstream windowed delta would
+        # read the missing good series as "no data" and score the
+        # window 100% bad (counter windows need both edges).
+        self._m_good_requests.labels(cls, tenant).inc(
+            1 if good else 0)
+        self._m_good_tokens.labels(cls, tenant).inc(
+            max(0, int(tokens)) if good else 0)
+        return good
+
+
+# ----------------------------------------------- burn-rate alerting
+def _fmt_window(seconds: float) -> str:
+    for unit, div in (('d', 86400.0), ('h', 3600.0), ('m', 60.0)):
+        if seconds >= div and seconds % div == 0:
+            return f'{int(seconds // div)}{unit}'
+    return f'{int(seconds)}s'
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindows:
+    """The two classic paired alert windows (Google SRE workbook
+    multi-window multi-burn-rate): the FAST pair catches a budget
+    burning in hours (page), the SLOW pair a budget leaking over days
+    (ticket). A pair fires only when BOTH its windows burn above its
+    threshold — the long window proves it is real, the short window
+    both makes detection fast and clears the alert fast once the
+    bleeding stops."""
+    fast_short_s: float = 300.0
+    fast_long_s: float = 3600.0
+    fast_threshold: float = 14.4       # 2% of budget in 1h
+    slow_short_s: float = 21600.0
+    slow_long_s: float = 259200.0
+    slow_threshold: float = 6.0        # 10% of budget in 3d (6h pair)
+
+    @classmethod
+    def from_env(cls) -> 'BurnWindows':
+        return cls(
+            fast_short_s=_env_float('SKYT_SLO_FAST_SHORT_S', 300.0),
+            fast_long_s=_env_float('SKYT_SLO_FAST_LONG_S', 3600.0),
+            fast_threshold=_env_float('SKYT_SLO_FAST_BURN', 14.4),
+            slow_short_s=_env_float('SKYT_SLO_SLOW_SHORT_S', 21600.0),
+            slow_long_s=_env_float('SKYT_SLO_SLOW_LONG_S', 259200.0),
+            slow_threshold=_env_float('SKYT_SLO_SLOW_BURN', 6.0))
+
+    def all(self) -> 'Dict[str, float]':
+        """window label -> seconds, dedup'd, short-to-long."""
+        out: Dict[str, float] = {}
+        for s in sorted({self.fast_short_s, self.fast_long_s,
+                         self.slow_short_s, self.slow_long_s}):
+            out[_fmt_window(s)] = s
+        return out
+
+
+class BurnRateEvaluator:
+    """Error-budget burn rates per class from a windowed time-series
+    source, with the paired-window alert state machine.
+
+    `source` is anything with the TimeSeriesStore read protocol —
+    ``sum_delta(name, match, window_s, now)`` and
+    ``quantile(family, match, q, window_s, now)`` — i.e. a single
+    store in tests or serve/fleet.py's cross-replica merger in
+    production.
+
+    burn(window) = bad_fraction(window) / error_budget. 1.0 means the
+    budget is burning exactly at the rate that exhausts it in one SLO
+    period; the fast pair's 14.4 means "2% of a 30-day budget gone in
+    one hour".
+
+    Hysteresis is asymmetric by construction: FIRE needs both windows
+    of a pair above its threshold; CLEAR needs every pair's SHORT
+    window back below. The long windows stay elevated for hours after
+    an incident — requiring them to clear would pin the alert long
+    after recovery, while clearing on the short window alone is the
+    standard fast-clear semantics."""
+
+    def __init__(self, source: Any,
+                 objectives_fn: Callable[
+                     [], Dict[str, ClassObjective]] = objectives,
+                 windows: Optional[BurnWindows] = None,
+                 registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None,
+                 clock: Callable[[], float] = time.time,
+                 tracer: Optional['tracing.Tracer'] = None) -> None:
+        self.source = source
+        self._objectives_fn = objectives_fn
+        self.windows = windows or BurnWindows.from_env()
+        self._clock = clock
+        self._tracer = tracer
+        reg = registry or metrics_lib.REGISTRY
+        self._m_burn = reg.gauge(
+            'skyt_slo_burn_rate',
+            'Error-budget burn rate (bad fraction / budget) per QoS '
+            'class and trailing window', ('cls', 'window'))
+        self._m_alert = reg.gauge(
+            'skyt_slo_alert',
+            'Multi-window burn-rate alert state per QoS class '
+            '(1 firing, 0 ok)', ('cls',))
+        self._m_attainment = reg.gauge(
+            'skyt_slo_attainment',
+            'Fraction of requests within SLO over the fast-long '
+            'window, per QoS class', ('cls',))
+        self._lock = threading.Lock()
+        self._firing: Dict[str, bool] = {}
+
+    # ------------------------------------------------------- internals
+    def _bad_fraction(self, cls: str, window_s: float, now: float
+                      ) -> 'tuple[Optional[float], Optional[float]]':
+        """-> (bad_fraction, total_requests) over the window; None/None
+        with no data (no data must read as 'no burn', never as 100%)."""
+        total = self.source.sum_delta('skyt_slo_requests_total',
+                                      {'cls': cls}, window_s, now=now)
+        if not total:
+            return None, total
+        good = self.source.sum_delta('skyt_slo_good_requests_total',
+                                     {'cls': cls}, window_s,
+                                     now=now) or 0.0
+        return max(0.0, min(1.0, 1.0 - good / total)), total
+
+    def _transition(self, cls: str, firing: bool, now: float,
+                    burns: Dict[str, float]) -> None:
+        """Record an alert state change: gauge, log, and a span event
+        on the tracing plane (a zero-length forced-sample span — same
+        pattern as train.steps: transitions are rare and are exactly
+        the moments worth keeping)."""
+        logger.warning('SLO alert %s for class %r (burn rates: %s)',
+                       'FIRING' if firing else 'resolved', cls,
+                       {k: round(v, 2) for k, v in burns.items()})
+        if tracing.enabled():
+            (self._tracer or tracing.TRACER).record_span(
+                'slo.alert', now, now, sampled=True,
+                attributes={'class': cls,
+                            'state': 'firing' if firing else 'resolved',
+                            **{f'burn_{k}': round(v, 3)
+                               for k, v in burns.items()}},
+                events=[{'name': 'slo.alert.firing' if firing
+                         else 'slo.alert.resolved', 'ts': now,
+                         'class': cls}])
+
+    # ------------------------------------------------------ evaluation
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One evaluation pass: refresh every gauge, run the alert
+        state machine, and return the JSON-ready report (the body of
+        ``GET /fleet/slo``'s ``slo`` section)."""
+        if now is None:
+            now = self._clock()
+        objs = self._objectives_fn()
+        w = self.windows
+        report: Dict[str, Any] = {}
+        for cls, obj in objs.items():
+            burns: Dict[str, float] = {}
+            per_window: Dict[str, Any] = {}
+            for label, seconds in w.all().items():
+                bad, total = self._bad_fraction(cls, seconds, now)
+                burn = 0.0 if bad is None else bad / obj.budget
+                burns[label] = burn
+                self._m_burn.labels(cls, label).set(round(burn, 4))
+                per_window[label] = {
+                    'burn_rate': round(burn, 4),
+                    'attainment': (None if bad is None
+                                   else round(1.0 - bad, 6)),
+                    'requests': total or 0,
+                }
+            fast_s, fast_l = (_fmt_window(w.fast_short_s),
+                              _fmt_window(w.fast_long_s))
+            slow_s, slow_l = (_fmt_window(w.slow_short_s),
+                              _fmt_window(w.slow_long_s))
+            fast_active = (burns[fast_s] >= w.fast_threshold and
+                           burns[fast_l] >= w.fast_threshold)
+            slow_active = (burns[slow_s] >= w.slow_threshold and
+                           burns[slow_l] >= w.slow_threshold)
+            with self._lock:
+                was = self._firing.get(cls, False)
+                if not was:
+                    firing = fast_active or slow_active
+                else:
+                    # Asymmetric clear: every pair's SHORT window must
+                    # drop below its threshold.
+                    firing = not (
+                        burns[fast_s] < w.fast_threshold and
+                        burns[slow_s] < w.slow_threshold)
+                self._firing[cls] = firing
+                changed = firing != was
+            self._m_alert.labels(cls).set(1 if firing else 0)
+            att = per_window[fast_l]['attainment']
+            if att is not None:
+                self._m_attainment.labels(cls).set(att)
+            if changed:
+                self._transition(cls, firing, now, burns)
+            ttft_p95 = self.source.quantile(
+                'skyt_slo_ttft_seconds', {'cls': cls}, 0.95,
+                w.fast_long_s, now=now)
+            report[cls] = {
+                'objective': {'ttft_p95_ms': obj.ttft_ms,
+                              'itl_p95_ms': obj.itl_ms,
+                              'target': obj.target},
+                'windows': per_window,
+                'alert': firing,
+                'ttft_p95_ms': (None if ttft_p95 is None
+                                else round(ttft_p95 * 1e3, 2)),
+            }
+        return report
+
+    def firing(self, cls: str) -> bool:
+        with self._lock:
+            return self._firing.get(cls, False)
+
+
+# ------------------------------------------------------- cost reporting
+def _chips_per_replica() -> float:
+    return max(0.0, _env_float('SKYT_FLEET_CHIPS_PER_REPLICA', 1.0))
+
+
+def goodput_report(source: Any, window_s: float, now: float,
+                   replicas: int) -> Dict[str, Any]:
+    """Tokens/requests served WITHIN SLO per (class, tenant) over the
+    window, plus the chip-time cost report: good tokens per chip-second
+    and its inverse — the number the Gemma-on-TPU paper argues TPU
+    serving on (what did each good token cost in chip-time?).
+
+    chip-seconds = replicas x chips-per-replica
+    (``SKYT_FLEET_CHIPS_PER_REPLICA``, from the accelerator spec; 1 for
+    single-chip replicas) x window. Replica count is the number of
+    replicas CONTRIBUTING scrapes — a replica whose series aged out
+    stops being billed."""
+    chips = replicas * _chips_per_replica()
+    chip_seconds = chips * window_s
+    classes: Dict[str, Any] = {}
+    total_good_tokens = 0.0
+    total_tokens = 0.0
+    for cls in qos_lib.PRIORITIES:
+        match = {'cls': cls}
+        tenants: Dict[str, Any] = {}
+        good_by_tenant = source.grouped_delta(
+            'skyt_slo_good_tokens_total', 'tenant', window_s,
+            now=now, match=match)
+        tok_by_tenant = source.grouped_delta(
+            'skyt_slo_tokens_total', 'tenant', window_s, now=now,
+            match=match)
+        greq_by_tenant = source.grouped_delta(
+            'skyt_slo_good_requests_total', 'tenant', window_s,
+            now=now, match=match)
+        req_by_tenant = source.grouped_delta(
+            'skyt_slo_requests_total', 'tenant', window_s, now=now,
+            match=match)
+        for tenant in sorted(set(tok_by_tenant) | set(req_by_tenant)):
+            tenants[tenant] = {
+                'good_tokens': good_by_tenant.get(tenant, 0.0),
+                'tokens': tok_by_tenant.get(tenant, 0.0),
+                'good_requests': greq_by_tenant.get(tenant, 0.0),
+                'requests': req_by_tenant.get(tenant, 0.0),
+            }
+        cls_good = sum(t['good_tokens'] for t in tenants.values())
+        cls_tok = sum(t['tokens'] for t in tenants.values())
+        total_good_tokens += cls_good
+        total_tokens += cls_tok
+        classes[cls] = {'tenants': tenants,
+                        'good_tokens': cls_good, 'tokens': cls_tok}
+    gtps = (total_good_tokens / chip_seconds
+            if chip_seconds > 0 else None)
+    return {
+        'window_s': window_s,
+        'replicas': replicas,
+        'chips': chips,
+        'accelerator': os.environ.get('SKYT_FLEET_ACCELERATOR', ''),
+        'classes': classes,
+        'good_tokens': total_good_tokens,
+        'tokens': total_tokens,
+        'good_tokens_per_chip_second': (None if gtps is None
+                                        else round(gtps, 4)),
+        'chip_seconds_per_good_token': (
+            None if not gtps else round(1.0 / gtps, 6)),
+    }

@@ -301,6 +301,20 @@ def main(argv=None) -> None:
         from skypilot_tpu.utils import profiling
         prof = profiling.StepProfiler()   # no-op unless SKYT_PROFILE_DIR set
         mpub = trainer.TrainMetricsPublisher()
+
+        # MFU source (docs/observability.md "Fleet plane"): FLOPs per
+        # step from the step's own HLO cost analysis at the LOWERED
+        # stage — global (pre-SPMD-partition, matching the global-peak
+        # denominator) and compile-free (no mid-run stall) — with the
+        # analytic 6ND-style count only as the fallback. Resolved
+        # lazily at the first log boundary; SKYT_TRAIN_MFU=0 skips it.
+        def _analytic_flops():
+            per_tok = 6 * cfg.num_params() + \
+                12 * cfg.n_layers * cfg.dim * args.seq
+            return per_tok * args.batch * args.seq * \
+                jax.process_count()
+
+        flops_state = None      # resolved -> (flops_per_step, source)
         # Deferred metrics: publish() pulls step k-1's loss/grad-norm while
         # step k runs — the log boundary never syncs the step chain's head
         # (logged loss lags one step; see trainer.DeferredMetrics).
@@ -362,10 +376,26 @@ def main(argv=None) -> None:
                     # device pull here is DeferredMetrics' step-(k-1) read,
                     # which overlaps step k's device compute.
                     n_window = min(args.log_every, step + 1 - start_step)
+                    step_time = (now - last_t) / max(1, n_window)
+                    if flops_state is None and os.environ.get(
+                            'SKYT_TRAIN_MFU', '1') not in ('0', 'false'):
+                        flops_state = profiling.train_step_flops(
+                            step_fn, state, batch,
+                            analytic=_analytic_flops)
+                        logger.info('train FLOPs/step: %s (%s)',
+                                    f'{flops_state[0]:.3e}'
+                                    if flops_state[0] else 'unknown',
+                                    flops_state[1])
+                    mfu_val = None
+                    if flops_state and flops_state[0]:
+                        denom = profiling.peak_flops(
+                            jax.devices()[0]) * jax.device_count()
+                        mfu_val = flops_state[0] / \
+                            max(step_time, 1e-9) / denom
                     host = dmetrics.publish(
-                        step_time_s=(now - last_t) / max(1, n_window),
+                        step_time_s=step_time,
                         tokens_per_sec=tokens_seen / dt,
-                        steps=n_window)
+                        steps=n_window, mfu=mfu_val)
                     last_t = now
                     logger.info('step %d/%d loss=%.4f tokens/s=%.0f',
                                 step + 1, args.steps,

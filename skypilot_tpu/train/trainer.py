@@ -62,11 +62,17 @@ class TrainMetricsPublisher:
             'Most recently logged global gradient norm')
         self.steps = reg.counter(
             'skyt_train_steps_total', 'Training steps completed')
+        self.mfu = reg.gauge(
+            'skyt_train_mfu',
+            'Model FLOPs utilization over the last logging window '
+            '(FLOPs from the compiled step\'s own cost_analysis when '
+            'the backend reports them; utils/profiling.py)')
 
     def publish(self, metrics: Dict[str, Any],
                 step_time_s: Optional[float] = None,
                 tokens_per_sec: Optional[float] = None,
-                steps: int = 1) -> None:
+                steps: int = 1,
+                mfu: Optional[float] = None) -> None:
         """metrics: the train step's output dict ({'loss', 'grad_norm',
         ...}); device scalars are pulled here (call at log boundaries,
         not every step, if that transfer matters)."""
@@ -80,6 +86,8 @@ class TrainMetricsPublisher:
             self.step_seconds.set(step_time_s)
         if tokens_per_sec is not None:
             self.tokens_per_sec.set(tokens_per_sec)
+        if mfu is not None:
+            self.mfu.set(mfu)
 
 
 class DeferredMetrics:
@@ -124,7 +132,8 @@ class DeferredMetrics:
 
     def publish(self, step_time_s: Optional[float] = None,
                 tokens_per_sec: Optional[float] = None,
-                steps: int = 1) -> Dict[str, float]:
+                steps: int = 1,
+                mfu: Optional[float] = None) -> Dict[str, float]:
         """Pull step k-1's metrics (k still in flight) and publish them;
         returns the host floats for logging. First call of a run (no
         k-1 yet) pulls the current step's.
@@ -139,7 +148,8 @@ class DeferredMetrics:
         host = ({k: float(v) for k, v in
                  jax.device_get(src).items()} if src else {})
         self._pub.publish(host, step_time_s=step_time_s,
-                          tokens_per_sec=tokens_per_sec, steps=steps)
+                          tokens_per_sec=tokens_per_sec, steps=steps,
+                          mfu=mfu)
         # The window advances whether or not tracing is on: enabling
         # SKYT_TRACE mid-run must produce a span covering ONE logging
         # window, not the whole run so far.
@@ -155,6 +165,8 @@ class DeferredMetrics:
                 attrs['step_time_s'] = step_time_s
             if tokens_per_sec is not None:
                 attrs['tokens_per_sec'] = tokens_per_sec
+            if mfu is not None:
+                attrs['mfu'] = round(mfu, 4)
             (self._tracer or tracing.TRACER).record_span(
                 'train.steps', start, now, attributes=attrs,
                 sampled=True)
@@ -280,6 +292,14 @@ def make_train_step(model: nn.Module, tx, mesh: Mesh,
         with mesh, nn.logical_axis_rules(list(rules)):
             return _jitted(state, batch)
 
+    def lowered(state, batch):
+        # AOT lowering under the same mesh/axis-rules context, for
+        # utils/profiling.train_step_flops (cost-analysis MFU).
+        # Lowering only — no backend compile, no mid-run stall.
+        with mesh, nn.logical_axis_rules(list(rules)):
+            return _jitted.lower(state, batch)
+
+    wrapped.lower = lowered
     return wrapped
 
 

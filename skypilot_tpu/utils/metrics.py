@@ -22,12 +22,22 @@ Design rules:
 Conventions: metric names are `skyt_<layer>_<what>[_total|_seconds]`;
 label sets stay tiny and bounded (replica ids, decision kinds — never
 request ids or URLs with unbounded cardinality).
+
+Cardinality guard: every metric family caps its distinct label-sets at
+``SKYT_METRICS_MAX_SERIES`` (default 1000). Beyond the cap, writes go
+to a detached child (never exposed, never stored) and each dropped
+creation is counted in ``skyt_metrics_dropped_series_total{metric}`` —
+bounded memory with a loud signal instead of unbounded dict growth.
+The fleet scraper multiplies every per-replica label by replica count,
+and tenant labels arrive from clients, so the guard is load-bearing,
+not defensive.
 """
 import math
+import os
 import re
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 _NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
 _LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
@@ -36,6 +46,16 @@ _LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
 # cold prefills; shared default for the engine histograms.
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _max_series() -> int:
+    """Per-family label-set cap (SKYT_METRICS_MAX_SERIES, default
+    1000). Read at metric construction; malformed values fall back."""
+    try:
+        return max(1, int(os.environ.get('SKYT_METRICS_MAX_SERIES', '')
+                          or 1000))
+    except ValueError:
+        return 1000
 
 
 def _fmt(v: float) -> str:
@@ -87,6 +107,14 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], Any] = {}
+        # Cardinality guard state: the cap, a drop callback installed
+        # by the owning registry (lazy — the dropped-series counter is
+        # not minted until something actually drops, so golden
+        # exposition output is unchanged in the steady state), and the
+        # shared detached child writes land on once over the cap.
+        self._series_cap = _max_series()
+        self._on_drop: Optional[Callable[[], None]] = None
+        self._overflow_child: Any = None
 
     def _make_child(self):
         raise NotImplementedError
@@ -109,12 +137,27 @@ class _Metric:
                 f'{self.name} takes {len(self.labelnames)} label '
                 f'value(s), got {len(values)}')
         key = tuple(str(v) for v in values)
+        dropped = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._make_child()
-                self._children[key] = child
-            return child
+                if len(self._children) >= self._series_cap:
+                    # Over the cap: the write still works (callers
+                    # must not crash) but lands on a shared DETACHED
+                    # child that never reaches the exposition —
+                    # bounded memory, counted loss.
+                    dropped = True
+                    if self._overflow_child is None:
+                        self._overflow_child = self._make_child()
+                    child = self._overflow_child
+                else:
+                    child = self._make_child()
+                    self._children[key] = child
+        if dropped and self._on_drop is not None:
+            # Outside self._lock: the drop counter is another metric
+            # with its own lock (and the registry's); never nest.
+            self._on_drop()
+        return child
 
     def label_keys(self) -> List[Tuple[str, ...]]:
         """Label-value tuples of all live children (for eviction
@@ -354,6 +397,10 @@ class Histogram(_Metric):
         return out
 
 
+# The guard's loss counter (one family, 'metric' label = family name).
+_DROPPED_SERIES = 'skyt_metrics_dropped_series_total'
+
+
 class MetricsRegistry:
     """Holds metric families; renders the exposition text / snapshot."""
 
@@ -386,8 +433,26 @@ class MetricsRegistry:
                             f'with buckets {existing.buckets}')
                 return existing
             metric = cls(name, help_text, labelnames, **kwargs)
+            if name != _DROPPED_SERIES:
+                # The dropped-series counter itself is exempt: its
+                # 'metric' label domain is the (bounded) family set,
+                # and wiring it to itself would recurse on overflow.
+                metric._on_drop = self._make_drop_cb(name)
             self._metrics[name] = metric
             return metric
+
+    def _make_drop_cb(self, metric_name: str) -> Callable[[], None]:
+        """Per-family drop callback. The counter is created LAZILY on
+        the first drop so registries that never overflow expose
+        byte-identical output to before the guard existed."""
+        def _cb() -> None:
+            self.counter(
+                _DROPPED_SERIES,
+                'Label-sets dropped by the per-family series cap '
+                '(SKYT_METRICS_MAX_SERIES); each increment is one '
+                'write that would have minted a new series',
+                ('metric',)).labels(metric_name).inc()
+        return _cb
 
     def counter(self, name: str, help_text: str,
                 labelnames: Sequence[str] = ()) -> Counter:

@@ -17,13 +17,160 @@ The trace is TensorBoard-loadable (plugins/profile/<ts>/*.xplane.pb):
 call `StepProfiler.on_step(i)` at the top of every step and `stop()`
 after the loop; both are no-ops unless SKYT_PROFILE_DIR is set, so the
 hook costs nothing in production runs.
+
+This module additionally owns (docs/observability.md "Fleet plane"):
+
+  * :func:`capture_trace` — a bounded ON-DEMAND capture behind a
+    process-wide single-flight lock, the backend of the infer server's
+    ``POST /debug/profile`` (and, via the controller proxy,
+    ``POST /fleet/profile``). Works degraded on CPU: the host trace is
+    still real data;
+  * the MFU estimator — :func:`train_step_flops` reads FLOPs from the
+    step's own HLO ``cost_analysis()`` at the LOWERED stage (global,
+    pre-SPMD-partition, no backend compile) and falls back to the
+    caller's analytic 6ND-style count only when the backend cannot
+    answer, so the published ``skyt_train_mfu`` metric no longer
+    depends on hand-maintained formulas.
 """
 import os
-from typing import Optional
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from skypilot_tpu.utils import log_utils
 
 logger = log_utils.init_logger(__name__)
+
+# bf16 peak FLOPs per chip (the MFU denominator). Previously a private
+# table in bench.py; owned here so the bench, the trainer's published
+# MFU, and the fleet cost report divide by the same numbers.
+PEAK_FLOPS = {
+    'TPU v5 lite': 197e12,
+    'TPU v5': 459e12,
+    'TPU v4': 275e12,
+    'TPU v6 lite': 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    """Peak bf16 FLOPs of one device; 1e12 nominal for unknown/CPU
+    (MFU against it is a smoke number, not a claim)."""
+    kind = getattr(device, 'device_kind', '')
+    for prefix, flops in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return flops
+    return 1e12
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight (single-flight lock held)."""
+
+
+# One capture at a time per process: jax.profiler keeps global state,
+# and overlapping start_trace calls abort the collector. Shared by
+# capture_trace AND StepProfiler so an on-demand capture cannot race a
+# step-window profile.
+_CAPTURE_LOCK = threading.Lock()
+
+
+def capture_trace(duration_ms: float,
+                  base_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Capture a jax.profiler trace for `duration_ms` into a fresh
+    temp dir; returns {'trace_dir', 'duration_ms', 'files', 'n_files'}.
+
+    Raises ProfilerBusy when another capture holds the single-flight
+    lock (HTTP callers map it to 409). The caller is responsible for
+    authorization (the server gates on SKYT_PROFILE_REMOTE)."""
+    import tempfile
+
+    import jax
+    if not _CAPTURE_LOCK.acquire(blocking=False):
+        raise ProfilerBusy('a profile capture is already in flight')
+    try:
+        out_dir = tempfile.mkdtemp(
+            prefix='skyt-profile-',
+            dir=base_dir or os.environ.get('SKYT_PROFILE_DIR') or None)
+        t0 = time.perf_counter()
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(max(0.0, duration_ms) / 1e3)
+        finally:
+            try:
+                jax.effects_barrier()
+            except Exception:  # noqa — best-effort flush, see stop()
+                pass
+            jax.profiler.stop_trace()
+        files = []
+        for root, _dirs, names in os.walk(out_dir):
+            for name in names:
+                files.append(os.path.relpath(os.path.join(root, name),
+                                             out_dir))
+        files.sort()
+        return {'trace_dir': out_dir,
+                'duration_ms': round((time.perf_counter() - t0) * 1e3,
+                                     1),
+                'files': files[:50], 'n_files': len(files)}
+    finally:
+        _CAPTURE_LOCK.release()
+
+
+# ----------------------------------------------------- MFU estimation
+def cost_analysis_flops(stage) -> Optional[float]:
+    """FLOPs from a jax stage's ``cost_analysis()`` (a ``Lowered`` or
+    a compiled executable), or None when the backend does not report
+    them (some platforms return nothing, older jax returns a
+    per-device list)."""
+    try:
+        ca = stage.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        flops = float(ca.get('flops', 0.0) or 0.0)
+        return flops if flops > 0 else None
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug('cost_analysis unavailable: %r', e)
+        return None
+
+
+# Back-compat alias (the original name; same function — any stage with
+# a cost_analysis() works).
+compiled_flops = cost_analysis_flops
+
+
+def train_step_flops(step_fn: Callable, *args,
+                     analytic: Optional[Any] = None
+                     ) -> 'Tuple[Optional[float], str]':
+    """FLOPs of one call of `step_fn(*args)` -> (flops, source).
+
+    Tries the HLO cost analysis first: `step_fn` must expose
+    ``.lower`` (jax.jit functions do; trainer.make_train_step attaches
+    one that re-enters its mesh/axis-rules context). Deliberately the
+    LOWERED stage's cost analysis, not the compiled executable's:
+    lowering costs no backend compile (no mid-run stall on large
+    models), and its count is GLOBAL and pre-optimization — the right
+    MFU numerator on both axes, since SPMD partitioning would report
+    per-device FLOPs against our global-peak denominator and remat
+    recompute must not inflate MFU. Falls back to `analytic` (a float
+    or zero-arg callable — the hand-maintained 6ND-style count) and
+    ultimately (None, 'unavailable')."""
+    lower = getattr(step_fn, 'lower', None)
+    if lower is not None:
+        try:
+            flops = cost_analysis_flops(lower(*args))
+            if flops is not None:
+                return flops, 'hlo_cost_analysis'
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning('HLO cost analysis failed (%r); falling '
+                           'back to the analytic FLOPs count', e)
+    try:
+        if callable(analytic):
+            analytic = analytic()
+        if analytic:
+            return float(analytic), 'analytic'
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('analytic FLOPs count failed: %r', e)
+    return None, 'unavailable'
 
 
 def _env_int(name: str, default: int, minimum: int = 0) -> int:
@@ -69,9 +216,28 @@ class StepProfiler:
         if self._active and step >= self.start_step + self.num_steps:
             self.stop()
         elif not self._active and step >= self.start_step:
-            import jax
-            os.makedirs(self.trace_dir, exist_ok=True)
-            jax.profiler.start_trace(self.trace_dir)
+            if not _CAPTURE_LOCK.acquire(blocking=False):
+                # An on-demand capture_trace is in flight: skip this
+                # window (jax.profiler is process-global; overlapping
+                # start_trace calls abort the collector).
+                logger.warning('profiler busy; skipping the step-'
+                               'window profile')
+                self._done = True
+                return
+            try:
+                import jax
+                os.makedirs(self.trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self.trace_dir)
+            except Exception as e:  # pylint: disable=broad-except
+                # Release (a leaked lock would 409 every later
+                # on-demand capture in this process) and degrade: an
+                # unwritable profile dir must cost the profile, not
+                # the training job.
+                _CAPTURE_LOCK.release()
+                self._done = True
+                logger.warning('step-window profile failed to start '
+                               '(%r); continuing unprofiled', e)
+                return
             self._active = True
             logger.info('profiling steps %d..%d -> %s', step,
                         step + self.num_steps - 1, self.trace_dir)
@@ -90,4 +256,5 @@ class StepProfiler:
         jax.profiler.stop_trace()
         self._active = False
         self._done = True
+        _CAPTURE_LOCK.release()
         logger.info('profile trace written to %s', self.trace_dir)

@@ -1,0 +1,419 @@
+"""Bounded ring time-series store — the fleet telemetry plane's core.
+
+PRs 1 and 3 gave every process its own instantaneous `/metrics`; this
+module adds HISTORY: scrape any metrics source — a local
+``MetricsRegistry`` or remote Prometheus exposition text — into
+fixed-size rings per series, then answer the questions instantaneous
+counters cannot ("what fraction of interactive requests met their TTFT
+SLO over the last hour?"): counter increase/rate over a window with
+reset handling, and windowed quantiles from histogram bucket deltas.
+
+Design rules (same discipline as utils/metrics.py):
+  * dependency-free, thread-safe;
+  * every clock is INJECTABLE — no direct ``time.time()`` /
+    ``time.monotonic()`` calls in this file (tools/lint.py enforces
+    it), so burn-rate math replays deterministically in tests;
+  * hard caps everywhere: points per series (ring, drop-oldest) and
+    series per store (drop-with-counter — a misbehaving scrape target
+    can cost us ITS data, never unbounded memory);
+  * stale series age out (`prune`), so a replica that stopped
+    answering scrapes leaves the aggregates instead of freezing them.
+
+The store is deliberately source-agnostic: `serve/fleet.py` keeps one
+per replica, `serve/slo.py` evaluates burn rates against it, and tests
+feed it synthetic exposition text under a fake clock.
+"""
+import collections
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+# One parsed exposition sample line:  name{label="v",...} value
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$')
+_LABEL_PAIR_RE = re.compile(
+    r'(?P<k>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<v>(?:[^"\\]|\\.)*)"')
+
+_UNESCAPE = {'\\\\': '\\', '\\n': '\n', '\\"': '"'}
+
+
+def _unescape_label(v: str) -> str:
+    out = []
+    i = 0
+    while i < len(v):
+        two = v[i:i + 2]
+        if two in _UNESCAPE:
+            out.append(_UNESCAPE[two])
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return ''.join(out)
+
+
+def _parse_value(raw: str) -> Optional[float]:
+    if raw == '+Inf':
+        return float('inf')
+    if raw == '-Inf':
+        return float('-inf')
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def parse_exposition(
+        text: str
+) -> 'Tuple[List[Tuple[str, Dict[str, str], float]], Dict[str, str]]':
+    """Parse Prometheus text exposition 0.0.4.
+
+    Returns ``(samples, types)``: samples as
+    ``(name, labels_dict, value)`` in input order, and the ``# TYPE``
+    declarations keyed by family name. Malformed lines are skipped
+    (scrape targets are other processes mid-restart — one garbled line
+    must not void the scrape)."""
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith('#'):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == 'TYPE':
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        value = _parse_value(m.group('value'))
+        if value is None:
+            continue
+        labels: Dict[str, str] = {}
+        raw = m.group('labels')
+        if raw:
+            for lm in _LABEL_PAIR_RE.finditer(raw):
+                labels[lm.group('k')] = _unescape_label(lm.group('v'))
+        samples.append((m.group('name'), labels, value))
+    return samples, types
+
+
+def _series_key(name: str, labels: Dict[str, str]
+                ) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return name, tuple(sorted(labels.items()))
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return max(1, int(os.environ.get(name, '') or default))
+    except ValueError:
+        return default
+
+
+def _family_of(name: str) -> str:
+    """Histogram component samples share their family's base name."""
+    for suffix in ('_bucket', '_sum', '_count'):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+class TimeSeriesStore:
+    """Rings of ``(ts, value)`` per (name, sorted-labels) series.
+
+    max_points per series (SKYT_TS_MAX_POINTS, default 360: an hour at
+    a 10 s scrape cadence) and max_series per store (SKYT_TS_MAX_SERIES,
+    default 4096). A new series beyond the cap is dropped and counted
+    in ``dropped_series`` — reads keep working, the loss is visible in
+    `stats()` (and in the fleet scraper's own metrics)."""
+
+    def __init__(self, max_series: Optional[int] = None,
+                 max_points: Optional[int] = None,
+                 clock: Callable[[], float] = time.time) -> None:
+        self.max_series = (max_series if max_series is not None
+                           else _env_int('SKYT_TS_MAX_SERIES', 4096))
+        self.max_points = (max_points if max_points is not None
+                           else _env_int('SKYT_TS_MAX_POINTS', 360))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series: 'Dict[Tuple[str, Tuple[Tuple[str, str], ...]], collections.deque]' = {}  # noqa
+        self._types: Dict[str, str] = {}
+        self.dropped_series = 0
+
+    # ------------------------------------------------------------ write
+    def observe(self, name: str, labels: Dict[str, str], value: float,
+                ts: Optional[float] = None) -> bool:
+        """Append one point; False when the series cap dropped it."""
+        if ts is None:
+            ts = self._clock()
+        key = _series_key(name, labels)
+        with self._lock:
+            ring = self._series.get(key)
+            if ring is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_series += 1
+                    return False
+                ring = collections.deque(maxlen=self.max_points)
+                self._series[key] = ring
+            ring.append((float(ts), float(value)))
+        return True
+
+    def scrape_text(self, text: str, ts: Optional[float] = None,
+                    extra_labels: Optional[Dict[str, str]] = None
+                    ) -> int:
+        """Ingest one exposition payload (every sample stamped with one
+        scrape time). Returns the number of points stored."""
+        if ts is None:
+            ts = self._clock()
+        samples, types = parse_exposition(text)
+        with self._lock:
+            self._types.update(types)
+        stored = 0
+        for name, labels, value in samples:
+            if extra_labels:
+                labels = {**labels, **extra_labels}
+            if self.observe(name, labels, value, ts=ts):
+                stored += 1
+        return stored
+
+    def scrape_registry(self, registry, ts: Optional[float] = None,
+                        extra_labels: Optional[Dict[str, str]] = None
+                        ) -> int:
+        """Ingest a LOCAL utils/metrics.MetricsRegistry (no HTTP, no
+        text round-trip beyond the registry's own renderer)."""
+        return self.scrape_text(registry.expose(), ts=ts,
+                                extra_labels=extra_labels)
+
+    # ------------------------------------------------------------- read
+    def series_keys(self) -> List[Tuple[str, Dict[str, str]]]:
+        with self._lock:
+            return [(name, dict(labels))
+                    for name, labels in self._series]
+
+    def family_type(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._types.get(name)
+
+    def points(self, name: str, labels: Dict[str, str]
+               ) -> List[Tuple[float, float]]:
+        with self._lock:
+            ring = self._series.get(_series_key(name, labels))
+            return list(ring) if ring else []
+
+    def latest(self, name: str, labels: Dict[str, str]
+               ) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            ring = self._series.get(_series_key(name, labels))
+            return ring[-1] if ring else None
+
+    def _window(self, ring, window_s: float, now: float
+                ) -> List[Tuple[float, float]]:
+        lo = now - window_s
+        return [p for p in ring if lo <= p[0] <= now]
+
+    def _matching(self, name: str, match: Optional[Dict[str, str]]
+                  ) -> List[Tuple[Dict[str, str], Any]]:
+        out = []
+        with self._lock:
+            for (n, labels), ring in self._series.items():
+                if n != name:
+                    continue
+                ld = dict(labels)
+                if match and any(ld.get(k) != v
+                                 for k, v in match.items()):
+                    continue
+                out.append((ld, list(ring)))
+        return out
+
+    @staticmethod
+    def _increase(points: List[Tuple[float, float]]) -> float:
+        """Counter increase across `points`, Prometheus-style reset
+        handling: a decrease means the source restarted from ~0, so the
+        post-reset value IS the post-reset increase."""
+        inc = 0.0
+        for (_, prev), (_, cur) in zip(points, points[1:]):
+            inc += (cur - prev) if cur >= prev else cur
+        return inc
+
+    def delta(self, name: str, labels: Dict[str, str], window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Counter increase over the trailing window (None when fewer
+        than 2 in-window points exist — no lying with zeros)."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            ring = self._series.get(_series_key(name, labels))
+            pts = self._window(ring, window_s, now) if ring else []
+        if len(pts) < 2:
+            return None
+        return self._increase(pts)
+
+    def rate(self, name: str, labels: Dict[str, str], window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """delta / actual covered time (first→last in-window point)."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            ring = self._series.get(_series_key(name, labels))
+            pts = self._window(ring, window_s, now) if ring else []
+        if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+            return None
+        return self._increase(pts) / (pts[-1][0] - pts[0][0])
+
+    def sum_delta(self, name: str, match: Optional[Dict[str, str]],
+                  window_s: float, now: Optional[float] = None
+                  ) -> Optional[float]:
+        """Counter increase summed across every series of `name` whose
+        labels are a superset of `match`. None when NO series had
+        enough points (some-missing still sums the rest)."""
+        if now is None:
+            now = self._clock()
+        total, seen = 0.0, False
+        for _labels, ring in self._matching(name, match):
+            pts = self._window(ring, window_s, now)
+            if len(pts) < 2:
+                continue
+            seen = True
+            total += self._increase(pts)
+        return total if seen else None
+
+    def grouped_delta(self, name: str, group_label: str,
+                      window_s: float, now: Optional[float] = None,
+                      match: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, float]:
+        """sum_delta split by one label's value (e.g. per-tenant
+        goodput). Series without the label group under ''."""
+        if now is None:
+            now = self._clock()
+        out: Dict[str, float] = {}
+        for labels, ring in self._matching(name, match):
+            pts = self._window(ring, window_s, now)
+            if len(pts) < 2:
+                continue
+            key = labels.get(group_label, '')
+            out[key] = out.get(key, 0.0) + self._increase(pts)
+        return out
+
+    def quantile(self, family: str, match: Optional[Dict[str, str]],
+                 q: float, window_s: float,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Windowed quantile of a scraped HISTOGRAM family: per-bucket
+        increase over the window, summed across matching series (e.g.
+        all replicas), then the classic cumulative-bucket linear
+        interpolation. None when nothing landed in the window."""
+        if now is None:
+            now = self._clock()
+        by_le: Dict[float, float] = {}
+        for labels, ring in self._matching(family + '_bucket', match):
+            le = _parse_value(labels.get('le', ''))
+            if le is None:
+                continue
+            pts = self._window(ring, window_s, now)
+            if len(pts) < 2:
+                continue
+            by_le[le] = by_le.get(le, 0.0) + self._increase(pts)
+        return quantile_from_buckets(by_le, q)
+
+    # -------------------------------------------------------- lifecycle
+    def prune(self, max_age_s: float, now: Optional[float] = None
+              ) -> int:
+        """Drop series whose NEWEST point is older than `max_age_s` —
+        a series the scraper stopped feeding is stale fleet state, and
+        a capped store must make room for live series."""
+        if now is None:
+            now = self._clock()
+        dropped = 0
+        with self._lock:
+            for key in [k for k, ring in self._series.items()
+                        if not ring or now - ring[-1][0] > max_age_s]:
+                del self._series[key]
+                dropped += 1
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._types.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {'series': len(self._series),
+                    'dropped_series': self.dropped_series,
+                    'points': sum(len(r)
+                                  for r in self._series.values())}
+
+    # ----------------------------------------------------- re-exposition
+    def expose_latest(self, extra_labels: Optional[Dict[str, str]] = None,
+                      types: Optional[Dict[str, str]] = None
+                      ) -> List[str]:
+        """Exposition lines for every series' LATEST value (the fleet
+        aggregator stitches per-replica stores into one page by calling
+        this with ``{'replica': <id>}``). TYPE lines are emitted by the
+        caller once per family (`types` collects them)."""
+        from skypilot_tpu.utils import metrics as metrics_lib
+        lines: List[str] = []
+        with self._lock:
+            items = sorted((name, labels, ring[-1][1])
+                           for (name, labels), ring
+                           in self._series.items() if ring)
+            if types is not None:
+                for fam, t in self._types.items():
+                    types.setdefault(fam, t)
+        for name, labels, value in items:
+            labels = dict(labels)
+            if extra_labels:
+                labels = {**labels, **extra_labels}
+            keys = tuple(sorted(labels))
+            rendered = metrics_lib._render_labels(  # pylint: disable=protected-access
+                keys, tuple(labels[k] for k in keys))
+            lines.append(f'{name}{rendered} '
+                         f'{metrics_lib._fmt(value)}')  # pylint: disable=protected-access
+        return lines
+
+
+def quantile_from_buckets(by_le: Dict[float, float], q: float
+                          ) -> Optional[float]:
+    """The cumulative-bucket linear interpolation, factored out so
+    cross-STORE mergers (serve/fleet.py sums per-le increases across
+    replica stores) reuse the exact math `quantile` uses within one
+    store. `by_le`: upper bound -> cumulative-count increase over the
+    window. None when nothing landed."""
+    if not by_le:
+        return None
+    bounds = sorted(by_le)
+    total = by_le.get(float('inf'), max(by_le.values()))
+    if total <= 0:
+        return None
+    target = max(0.0, min(1.0, q)) * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound in bounds:
+        cum = by_le[bound]
+        if cum >= target:
+            if bound == float('inf'):
+                return prev_bound
+            if cum <= prev_cum:
+                return bound
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    return bounds[-1] if bounds[-1] != float('inf') else prev_bound
+
+
+def merge_sum_delta(stores: Iterable[TimeSeriesStore], name: str,
+                    match: Optional[Dict[str, str]], window_s: float,
+                    now: float) -> Optional[float]:
+    """sum_delta across several stores (one per replica)."""
+    total, seen = 0.0, False
+    for store in stores:
+        d = store.sum_delta(name, match, window_s, now=now)
+        if d is not None:
+            seen = True
+            total += d
+    return total if seen else None

@@ -69,3 +69,40 @@ def test_lint_forbids_direct_sqlite_connect(tmp_path):
     bad.write_text('import sqlite3\n'
                    'conn = sqlite3.connect("/tmp/x.db")  # noqa\n')
     assert not any('sqlite3.connect' in i for i in lint.check_file(bad))
+
+
+def test_lint_forbids_wall_clock_in_slo_and_timeseries(tmp_path):
+    """Clock discipline: a direct time.time()/time.monotonic() call in
+    serve/slo.py or utils/timeseries.py must flag (those modules take
+    injectable clocks so burn-rate math replays deterministically);
+    `clock=time.time` as a default REFERENCE and `# noqa` both pass,
+    and other files are unaffected."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    for rel in ('serve/slo.py', 'utils/timeseries.py'):
+        bad = tmp_path / 'skypilot_tpu' / rel
+        bad.parent.mkdir(parents=True, exist_ok=True)
+        bad.write_text('import time\n'
+                       'now = time.time()\n'
+                       'mono = time.monotonic()\n')
+        issues = lint.check_file(bad)
+        assert sum('injectable clock' in i for i in issues) == 2, issues
+
+        bad.write_text('import time\n'
+                       'def f(clock=time.time):\n'
+                       '    return clock()\n')
+        assert not any('injectable clock' in i
+                       for i in lint.check_file(bad))
+
+        bad.write_text('import time\n'
+                       'now = time.time()  # noqa: startup stamp\n')
+        assert not any('injectable clock' in i
+                       for i in lint.check_file(bad))
+
+    other = tmp_path / 'skypilot_tpu' / 'serve' / 'controller.py'
+    other.write_text('import time\nnow = time.time()\n')
+    assert not any('injectable clock' in i
+                   for i in lint.check_file(other))

@@ -1,0 +1,238 @@
+"""utils/timeseries.py: ring eviction, counter-reset handling,
+rate/quantile math under an injectable clock, series-cap drops, and
+exposition parsing — the fleet telemetry plane's substrate."""
+import math
+
+from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import timeseries as ts_lib
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make_store(**kw):
+    kw.setdefault('clock', FakeClock())
+    return ts_lib.TimeSeriesStore(**kw)
+
+
+# ------------------------------------------------------------- parsing
+def test_parse_exposition_counters_gauges_and_types():
+    text = (
+        '# HELP x_total help text\n'
+        '# TYPE x_total counter\n'
+        'x_total{r="a",c="i"} 3\n'
+        'x_total{r="b",c="i"} 4.5\n'
+        '# TYPE g gauge\n'
+        'g 0.25\n'
+        'garbage line that is not a sample\n'
+        'bad_value{x="y"} not-a-number\n')
+    samples, types = ts_lib.parse_exposition(text)
+    assert ('x_total', {'r': 'a', 'c': 'i'}, 3.0) in samples
+    assert ('g', {}, 0.25) in samples
+    assert len(samples) == 3            # malformed lines skipped
+    assert types == {'x_total': 'counter', 'g': 'gauge'}
+
+
+def test_parse_exposition_escapes_and_inf():
+    text = ('h_bucket{le="+Inf",p="a\\"b\\\\c\\nd"} 7\n')
+    samples, _ = ts_lib.parse_exposition(text)
+    assert samples == [('h_bucket',
+                        {'le': '+Inf', 'p': 'a"b\\c\nd'}, 7.0)]
+
+
+def test_registry_roundtrip():
+    """What utils/metrics renders, timeseries parses — the two halves
+    of the plane must agree on the wire format."""
+    reg = metrics_lib.MetricsRegistry()
+    reg.counter('c_total', 'c', ('cls',)).labels('interactive').inc(5)
+    reg.histogram('h_seconds', 'h', buckets=(0.1, 1.0)).observe(0.5)
+    clock = FakeClock()
+    store = ts_lib.TimeSeriesStore(clock=clock)
+    n = store.scrape_registry(reg)
+    assert n >= 5                       # counter + buckets + sum + count
+    assert store.latest('c_total', {'cls': 'interactive'}) == (clock.t, 5.0)
+    assert store.latest('h_seconds_count', {}) == (clock.t, 1.0)
+    assert store.family_type('c_total') == 'counter'
+
+
+# ------------------------------------------------------ rings and caps
+def test_ring_eviction_keeps_newest():
+    clock = FakeClock()
+    store = make_store(max_points=3, clock=clock)
+    for i in range(10):
+        store.observe('g', {}, float(i), ts=clock.tick(1))
+    pts = store.points('g', {})
+    assert len(pts) == 3
+    assert [v for _, v in pts] == [7.0, 8.0, 9.0]
+
+
+def test_series_cap_drops_with_counter_and_keeps_serving():
+    store = make_store(max_series=2)
+    assert store.observe('a', {}, 1.0)
+    assert store.observe('b', {}, 1.0)
+    assert not store.observe('c', {}, 1.0)
+    assert not store.observe('c', {}, 2.0)
+    assert store.dropped_series == 2
+    assert store.stats()['series'] == 2
+    assert store.latest('a', {}) is not None
+    assert store.latest('c', {}) is None
+    # existing series still writable at the cap
+    assert store.observe('a', {}, 2.0)
+
+
+def test_prune_drops_stale_series():
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    store.observe('old', {}, 1.0, ts=clock.t)
+    clock.tick(100)
+    store.observe('new', {}, 1.0, ts=clock.t)
+    assert store.prune(max_age_s=50) == 1
+    assert store.latest('old', {}) is None
+    assert store.latest('new', {}) is not None
+
+
+# --------------------------------------------------------- delta / rate
+def test_delta_and_rate_simple():
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    for v in (0, 10, 20, 30):
+        store.observe('c_total', {}, v, ts=clock.tick(10))
+    assert store.delta('c_total', {}, window_s=100) == 30
+    assert store.rate('c_total', {}, window_s=100) == 1.0
+    # window narrower than the data: only the in-window increase
+    assert store.delta('c_total', {}, window_s=20) == 20
+
+
+def test_counter_reset_handling():
+    """A decrease = source restart: post-reset value counts as the
+    post-reset increase (Prometheus increase() semantics)."""
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    for v in (0, 10, 5, 7):
+        store.observe('c_total', {}, v, ts=clock.tick(10))
+    assert store.delta('c_total', {}, window_s=100) == 10 + 5 + 2
+
+
+def test_delta_none_without_enough_points():
+    store = make_store()
+    assert store.delta('c_total', {}, window_s=100) is None
+    store.observe('c_total', {}, 5.0)
+    assert store.delta('c_total', {}, window_s=100) is None
+
+
+def test_sum_and_grouped_delta_across_labels():
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    for t in range(2):
+        ts = clock.tick(10)
+        store.observe('tok_total',
+                      {'cls': 'interactive', 'tenant': 'a'},
+                      10.0 * (t + 1), ts=ts)
+        store.observe('tok_total',
+                      {'cls': 'interactive', 'tenant': 'b'},
+                      4.0 * (t + 1), ts=ts)
+        store.observe('tok_total', {'cls': 'batch', 'tenant': 'a'},
+                      100.0 * (t + 1), ts=ts)
+    assert store.sum_delta('tok_total', {'cls': 'interactive'},
+                           window_s=100) == 14.0
+    assert store.sum_delta('tok_total', None, window_s=100) == 114.0
+    assert store.sum_delta('tok_total', {'cls': 'nope'},
+                           window_s=100) is None
+    grouped = store.grouped_delta('tok_total', 'tenant', window_s=100,
+                                  match={'cls': 'interactive'})
+    assert grouped == {'a': 10.0, 'b': 4.0}
+
+
+# ------------------------------------------------------------ quantiles
+def _feed_hist(store, clock, deltas_by_le, labels=None, steps=2):
+    """Feed cumulative bucket counters whose WINDOW increase per le is
+    `deltas_by_le` (split across `steps` scrapes)."""
+    labels = labels or {}
+    cum = {le: 0.0 for le in deltas_by_le}
+    ts = clock.tick(10)
+    for le, c in cum.items():
+        store.observe('h_bucket', {**labels, 'le': le}, c, ts=ts)
+    for _ in range(steps):
+        ts = clock.tick(10)
+        for le in cum:
+            cum[le] += deltas_by_le[le] / steps
+            store.observe('h_bucket', {**labels, 'le': le}, cum[le],
+                          ts=ts)
+
+
+def test_windowed_quantile_interpolation():
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    # 10 obs <= 0.1, 10 more in (0.1, 1.0], none above.
+    _feed_hist(store, clock,
+               {'0.1': 10.0, '1': 20.0, '+Inf': 20.0})
+    p50 = store.quantile('h', None, 0.5, window_s=100)
+    assert math.isclose(p50, 0.1), p50
+    p75 = store.quantile('h', None, 0.75, window_s=100)
+    assert math.isclose(p75, 0.55), p75      # halfway into (0.1, 1.0]
+    p100 = store.quantile('h', None, 1.0, window_s=100)
+    assert math.isclose(p100, 1.0), p100
+
+
+def test_quantile_merges_across_series():
+    """Per-replica histograms merge: the fleet p95 is computed from the
+    SUM of bucket increases, not an average of per-replica p95s."""
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    _feed_hist(store, clock, {'0.1': 10.0, '1': 10.0, '+Inf': 10.0},
+               labels={'replica': '1'})
+    _feed_hist(store, clock, {'0.1': 0.0, '1': 10.0, '+Inf': 10.0},
+               labels={'replica': '2'})
+    # 10 of 20 below 0.1 => p50 = 0.1; p95 interpolates in (0.1, 1].
+    assert math.isclose(store.quantile('h', None, 0.5, window_s=1000),
+                        0.1)
+    p95 = store.quantile('h', None, 0.95, window_s=1000)
+    assert 0.1 < p95 <= 1.0
+    # match narrows to one replica
+    assert math.isclose(
+        store.quantile('h', {'replica': '2'}, 0.5, window_s=1000),
+        0.55)
+
+
+def test_quantile_none_when_empty_window():
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    _feed_hist(store, clock, {'0.1': 10.0, '+Inf': 10.0})
+    clock.tick(10_000)
+    assert store.quantile('h', None, 0.5, window_s=100) is None
+
+
+# ------------------------------------------------------- re-exposition
+def test_expose_latest_with_extra_labels():
+    clock = FakeClock()
+    store = make_store(clock=clock)
+    store.scrape_text('# TYPE c_total counter\nc_total{cls="i"} 3\n')
+    types: dict = {}
+    lines = store.expose_latest(extra_labels={'replica': '7'},
+                                types=types)
+    assert lines == ['c_total{cls="i",replica="7"} 3']
+    assert types == {'c_total': 'counter'}
+
+
+def test_deterministic_under_fake_clock():
+    """Same inputs + same clock => identical outputs (the property the
+    SLO burn-rate tests lean on)."""
+    def run():
+        clock = FakeClock()
+        store = make_store(clock=clock)
+        for v in (0, 3, 9, 27):
+            store.observe('c_total', {'cls': 'i'}, v,
+                          ts=clock.tick(7))
+        return (store.delta('c_total', {'cls': 'i'}, 100),
+                store.rate('c_total', {'cls': 'i'}, 100),
+                store.stats())
+    assert run() == run()

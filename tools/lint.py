@@ -38,6 +38,12 @@ Checks:
     connect misses the WAL + busy-timeout recipe that makes that safe
     (docs/robustness.md "Control plane"). `# noqa` for deliberate
     exceptions.
+  * direct `time.time()` / `time.monotonic()` (and perf_counter)
+    calls in serve/slo.py and utils/timeseries.py — those modules take
+    INJECTABLE clocks so SLO burn-rate math replays deterministically
+    in tests (docs/observability.md "Fleet plane"); a stray wall-clock
+    call would fork the timeline. Referencing `time.time` as a default
+    clock argument is fine — only calls flag. `# noqa` escape hatch.
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -182,6 +188,36 @@ def _sqlite_connect_issues(path: Path, lines):
     return issues
 
 
+# Clock discipline (docs/observability.md "Fleet plane"): these files
+# implement windowed SLO/burn-rate math that tests replay under fake
+# clocks — every timestamp must come through the injected clock, so a
+# direct wall-clock CALL is a determinism bug. Default arguments like
+# `clock=time.time` are references, not calls, and pass.
+_INJECTABLE_CLOCK_FILES = ('skypilot_tpu/serve/slo.py',
+                           'skypilot_tpu/utils/timeseries.py')
+_CLOCK_CALL_NAMES = ('time', 'monotonic', 'perf_counter')
+
+
+def _clock_call_issues(path: Path, tree, lines):
+    issues = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and
+                f.attr in _CLOCK_CALL_NAMES and
+                isinstance(f.value, ast.Name) and f.value.id == 'time'):
+            continue
+        if node.lineno <= len(lines) and 'noqa' in lines[node.lineno - 1]:
+            continue
+        issues.append(
+            f'{path}:{node.lineno}: direct time.{f.attr}() — this '
+            f'module must read time through its injectable clock so '
+            f'SLO math replays deterministically '
+            f'(docs/observability.md), or add `# noqa`')
+    return issues
+
+
 # Files whose loops may not contain host-sync calls: the sft step loop
 # is the train hot path — one bare jax.device_get per step serializes
 # host and device (the deferred-metrics helper in train/trainer.py is
@@ -275,6 +311,10 @@ def check_file(path: Path):
 
     if any(path.as_posix().endswith(p) for p in _NO_SYNC_IN_LOOPS):
         issues += _loop_sync_issues(path, tree, lines)
+
+    if any(path.as_posix().endswith(p)
+           for p in _INJECTABLE_CLOCK_FILES):
+        issues += _clock_call_issues(path, tree, lines)
 
     if 'skypilot_tpu/infer/' in path.as_posix():
         issues += _waiting_put_issues(path, lines)

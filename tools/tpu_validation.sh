@@ -737,6 +737,167 @@ else
     FAIL=1
 fi
 
+echo "== 11. fleet telemetry drill: burst through the real LB->server"
+echo "   stack; /fleet/slo must report nonzero goodput and"
+echo "   /fleet/metrics per-replica series; a telemetry.scrape=error"
+echo "   fault against one replica mid-burst must tick the scrape-"
+echo "   error counter and age its series out WITHOUT any client-"
+echo "   visible 5xx; /fleet/profile proxies a real capture =="
+if SKYT_SERVE_LB_SYNC_INTERVAL=3600 SKYT_FLEET_SCRAPE_S=0.2 \
+        SKYT_FLEET_STALE_S=3 SKYT_PROFILE_REMOTE=1 \
+        timeout 900 python - <<'PYEOF' 2>&1 | tee "$OUT/fleet_drill.txt"
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import requests
+from aiohttp import web
+
+from skypilot_tpu.serve import fleet as fleet_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.utils import faults
+from skypilot_tpu.utils import metrics as metrics_lib
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+ports = [free_port(), free_port()]
+urls = [f'http://127.0.0.1:{p}' for p in ports]
+procs = [subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(p),
+     '--num-slots', '2', '--max-seq-len', '128'])
+    for p in ports]
+try:
+    for proc, url in zip(procs, urls):
+        deadline = time.time() + 480   # warmup compiles via the tunnel
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise SystemExit(f'replica died rc={proc.returncode}')
+            try:
+                if requests.get(url + '/health',
+                                timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(1)
+        else:
+            raise SystemExit('replica never became healthy')
+    lb_port = free_port()
+    reg = metrics_lib.MetricsRegistry()
+    lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:9', lb_port,
+                                     metrics_registry=reg)
+    lb.policy.set_ready_replicas(urls)
+    threading.Thread(target=lambda: web.run_app(
+        lb.make_app(), port=lb_port, print=None,
+        handle_signals=False), daemon=True).start()
+    base = f'http://127.0.0.1:{lb_port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            requests.get(base + '/metrics', timeout=2)
+            break
+        except requests.RequestException:
+            time.sleep(0.2)
+
+    # Fleet plane + its HTTP surface (the same routes the controller
+    # mounts), scraping both replicas AND the LB.
+    freg = metrics_lib.MetricsRegistry()
+    fl = fleet_lib.FleetTelemetry('drill', metrics_registry=freg)
+    fleet_port = free_port()
+    fapp = web.Application()
+    fleet_lib.add_fleet_routes(
+        fapp, fl, lambda rid: dict(zip(('1', '2'), urls)).get(rid))
+    threading.Thread(target=lambda: web.run_app(
+        fapp, port=fleet_port, print=None, handle_signals=False),
+        daemon=True).start()
+    fbase = f'http://127.0.0.1:{fleet_port}'
+
+    def burst(n, start=0):
+        codes = []
+        for i in range(n):
+            r = requests.post(
+                base + '/generate',
+                json={'tokens': [start + i + 1, 4, 5],
+                      'max_tokens': 8},
+                headers={'X-Priority': 'interactive',
+                         'X-Tenant': 'drill'}, timeout=120)
+            codes.append(r.status_code)
+        return codes
+
+    burst(4)                      # prime compiles + SLO series
+    for rid, url in zip(('1', '2'), urls):
+        assert fl.scrape(rid, url)
+    assert fl.scrape('lb', base)
+    codes = burst(8, start=10)    # the measured burst
+
+    # Mid-drill chaos: scrapes of replica 1 start failing. The fleet
+    # plane must keep serving (errors counted, series aged out) and
+    # clients must never notice.
+    faults.configure('telemetry.scrape=error,where=replica:1')
+    ok1 = fl.scrape('1', urls[0])
+    for rid, url in zip(('2', 'lb'), (urls[1], base)):
+        assert fl.scrape(rid, url), rid
+    codes += burst(4, start=30)
+    assert ok1 is False, 'telemetry.scrape fault did not fire'
+    errs = freg.get('skyt_fleet_scrape_errors_total').value('1')
+    assert errs >= 1, 'scrape-error counter never ticked'
+    bad = [c for c in codes if c != 200]
+    assert not bad, f'client-visible failures: {codes}'
+
+    slo = requests.get(fbase + '/fleet/slo',
+                       params={'window_s': 300}, timeout=10).json()
+    good = slo['goodput']
+    assert good['good_tokens'] > 0, slo
+    assert good['good_tokens_per_chip_second'] > 0, slo
+    att = slo['slo']['interactive']['windows']['5m']['attainment']
+    text = requests.get(fbase + '/fleet/metrics', timeout=10).text
+    for rid in ('1', '2', 'lb'):
+        assert f'replica="{rid}"' in text, f'no series for {rid}'
+    assert 'skyt_slo_good_tokens_total' in text
+
+    # Stale age-out: replica 1's scrapes keep failing past the TTL.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        fl.scrape('1', urls[0])
+        fl.scrape('2', urls[1])
+        if 'replica="1"' not in requests.get(
+                fbase + '/fleet/metrics', timeout=10).text:
+            break
+        time.sleep(0.5)
+    else:
+        raise SystemExit('faulted replica never aged out')
+    faults.reset()
+
+    # On-demand device profile through the fleet proxy.
+    prof = requests.post(fbase + '/fleet/profile',
+                         params={'replica': '2', 'ms': '100'},
+                         timeout=60)
+    assert prof.status_code == 200, prof.text
+    body = prof.json()
+    assert body['trace_dir'] and body['replica'] == '2', body
+
+    print(f'FLEET_DRILL_OK {len(codes)}/{len(codes)} ok through the '
+          f'scrape fault, attainment={att}, '
+          f'good_tok/chip_s={good["good_tokens_per_chip_second"]}, '
+          f'scrape_errors={errs:.0f}, profile n_files='
+          f'{body["n_files"]}')
+finally:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+PYEOF
+then
+    echo "== fleet telemetry drill: PASS =="
+else
+    echo "== fleet telemetry drill: FAIL (see $OUT/fleet_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
