@@ -49,7 +49,40 @@ PHASE_DEADLINES = {
     'chaos recovery bench': 600,
     'overload bench': 420,
     'slo report bench': 420,
+    'watchdog overhead bench': 300,
 }
+
+# The bench's own rank-0 heartbeat (train/heartbeat.py): the train
+# phase steps it per timed window, so a mid-run device hang leaves a
+# record the watchdog math can classify and the postmortem bundle can
+# carry (set up in main()).
+_BENCH_HB = {'writer': None}
+
+
+def _hang_evidence(reason: str) -> dict:
+    """On a train-phase hang: classify the stall with the watchdog's
+    own budget math and dump a postmortem bundle (py-stacks of the
+    wedged threads + flight recorder + heartbeat), so the bench
+    artifact carries openable evidence instead of prose. Never raises
+    — this runs on the way out of a dying bench."""
+    out = {}
+    try:
+        from skypilot_tpu.train import postmortem as postmortem_lib
+        from skypilot_tpu.train import watchdog as watchdog_lib
+        hb = _BENCH_HB.get('writer')
+        snap = None
+        if hb is not None:
+            snap = hb.snapshot()
+            snap['ts'] = hb.last_progress()
+            out['watchdog'] = watchdog_lib.classify_stall(
+                snap, time.time())
+        bundle = postmortem_lib.dump_bundle(reason, rank=0,
+                                            heartbeat=snap)
+        if bundle:
+            out['postmortem'] = bundle
+    except Exception as e:  # pylint: disable=broad-except
+        out['postmortem_error'] = repr(e)
+    return out
 
 
 class PhaseTimeout(Exception):
@@ -1105,6 +1138,61 @@ def chaos_recovery_metrics() -> list:
                 os.environ[k] = v
 
 
+def watchdog_overhead_metrics() -> list:
+    """Heartbeat hot-path cost (CPU-runnable): per-step wall delta of
+    hb.on_step (file-backed, interval-throttled — the exact sft call)
+    against a fixed synthetic step, interleaved best-of-2 per mode
+    (same co-tenant-noise discipline as the tracing phase). Acceptance
+    (docs/observability.md "Training plane"): <=1% of a ~ms-scale step."""
+    import tempfile
+
+    import numpy as np
+
+    from skypilot_tpu.train import heartbeat as heartbeat_lib
+
+    # ~ms-scale synthetic step: short enough to run hundreds of
+    # iterations, long enough that the measured ratio means something
+    # (a real TPU step is 10-1000x longer, so this is an upper bound).
+    a = np.random.default_rng(0).standard_normal((640, 640))
+
+    def run(hb, n=200) -> float:
+        t0 = time.perf_counter()
+        for i in range(n):
+            (a @ a).sum()
+            if hb is not None:
+                hb.on_step(i)
+        return time.perf_counter() - t0
+
+    run(None, n=30)   # warm the BLAS path
+    best_off = best_on = float('inf')
+    per_step_us = None
+    with tempfile.TemporaryDirectory() as d:
+        for trial in range(3):
+            best_off = min(best_off, run(None))
+            hb = heartbeat_lib.HeartbeatWriter(
+                os.path.join(d, f'hb-{trial}.json'), 0)
+            best_on = min(best_on, run(hb))
+        # Raw per-call cost, measured directly (no synthetic step).
+        hb = heartbeat_lib.HeartbeatWriter(os.path.join(d, 'hb-raw.json'),
+                                           0)
+        n = 20000
+        t0 = time.perf_counter()
+        for i in range(n):
+            hb.on_step(i)
+        per_step_us = (time.perf_counter() - t0) / n * 1e6
+    pct = (best_on - best_off) / best_off * 100.0
+    print(f'# watchdog overhead: heartbeat on_step {per_step_us:.2f}us, '
+          f'step-time delta {pct:+.2f}% (best-of-3 each mode)',
+          file=sys.stderr)
+    return [
+        {'metric': 'heartbeat_step_overhead_pct',
+         'value': round(pct, 2), 'unit': '%', 'vs_baseline': None},
+        {'metric': 'heartbeat_on_step_us',
+         'value': round(per_step_us, 2), 'unit': 'us',
+         'vs_baseline': None},
+    ]
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -1213,11 +1301,19 @@ def _run_train(cfg, batch, seq, steps, warmup, dev, windows=1,
         # window's last loss — which depends on every step — exists. The
         # one fetch RTT is amortized across the window's steps.
         dt = float('inf')
+        hb = _BENCH_HB.get('writer')
         for w in range(max(1, windows)):
+            if hb is not None:
+                # One "step" per timed window: each window's device_get
+                # is a real progress point; silence past the watchdog
+                # budget after this is a classifiable hang.
+                hb.on_step(w)
             t0 = time.perf_counter()
             state, losses = run(state, jax.random.PRNGKey(2 + w), steps)
             losses = jax.device_get(losses)
             dt = min(dt, time.perf_counter() - t0)
+        if hb is not None:
+            hb.on_step(max(1, windows))
 
         tokens_per_step = batch * seq
         # FLOPs of the timed window from the program's own HLO cost
@@ -1283,8 +1379,12 @@ def main() -> None:
             'extra_metrics': partial['extra'],
             # Distinct from 'tpu_unreachable': the device WAS acquired
             # and partial metrics may be valid — a mid-run hang is
-            # worth an immediate retry, a dead tunnel is not.
+            # worth an immediate retry, a dead tunnel is not. The hang
+            # evidence (watchdog stall math + a postmortem bundle with
+            # the wedged threads' py-stacks) rides along, so the next
+            # session opens a bundle instead of re-deriving the prose.
             'status': 'device_hang',
+            **_hang_evidence('device_hang'),
             'error': 'bench watchdog: device call never returned '
                      '(accelerator hung)'}), flush=True)
         os._exit(0)
@@ -1339,16 +1439,34 @@ def main() -> None:
     killer.start()
     on_tpu = dev.platform == 'tpu'
 
+    # Per-window heartbeat for the train phase (hang evidence).
+    try:
+        from skypilot_tpu.train import heartbeat as heartbeat_lib
+        _BENCH_HB['writer'] = heartbeat_lib.HeartbeatWriter(
+            None, 0, device_kind=getattr(dev, 'device_kind', None))
+    except Exception:  # pylint: disable=broad-except
+        pass
+
     # Phases are independent: each failure is reported, neither is lost.
     mfu = None
     metric_name = 'train_mfu_llama1b_1chip'
     train_err = None
+    hang_evidence = {}
     try:
         with phase_deadline(PHASE_DEADLINES['train bench'], 'train bench'):
             mfu, metric_name = train_mfu(dev, on_tpu)
         partial['mfu'] = mfu
         partial['metric'] = metric_name
-    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+    except PhaseTimeout as e:
+        # The phase deadline fired with the device acquired: a hang,
+        # not a crash — classify it and dump the bundle so 'status:
+        # device_hang' carries openable evidence (satellite of the
+        # training-plane observability PR).
+        train_err = repr(e)
+        hang_evidence = _hang_evidence('device_hang')
+        print(f'# train bench hung: {e!r} evidence={hang_evidence}',
+              file=sys.stderr)
+    except Exception as e:  # pylint: disable=broad-except
         train_err = repr(e)
         print(f'# train bench failed: {e!r}', file=sys.stderr)
 
@@ -1475,6 +1593,16 @@ def main() -> None:
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# slo report bench failed: {e!r}', file=sys.stderr)
 
+    # Watchdog/heartbeat overhead phase: the training-plane heartbeat
+    # must be cheap enough to leave ON (acceptance <=1%). CPU-runnable.
+    try:
+        with phase_deadline(PHASE_DEADLINES['watchdog overhead bench'],
+                            'watchdog overhead bench'):
+            extra = extra + watchdog_overhead_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# watchdog overhead bench failed: {e!r}', file=sys.stderr)
+
     line = {
         'metric': metric_name,
         'value': round(mfu, 4) if mfu is not None else None,
@@ -1488,6 +1616,9 @@ def main() -> None:
     }
     if train_err is not None:
         line['error'] = train_err
+    if hang_evidence:
+        line['status'] = 'device_hang'
+        line.update(hang_evidence)
     killer.cancel()
     print(json.dumps(line))
 

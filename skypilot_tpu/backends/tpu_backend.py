@@ -361,6 +361,15 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         for chunk in client.tail_logs(job_id, follow=follow):
             print(chunk, end='', flush=True)
         job = client.job(job_id)
+        # Training-plane trailer (docs/observability.md "Training
+        # plane"): a HUNG gang's watchdog verdict and every rank's
+        # postmortem bundle paths belong next to the logs the operator
+        # just read. stderr keeps the log stream itself clean.
+        if job:
+            import sys as _sys
+            from skypilot_tpu.runtime import job_lib as _job_lib
+            for line in _job_lib.postmortem_trailer_lines(job):
+                print(line, file=_sys.stderr)
         return 0 if job and job['status'] == 'SUCCEEDED' else 1
 
     def sync_down_logs(self, handle: TpuVmResourceHandle,

@@ -36,13 +36,15 @@ _PAGE = """<!DOCTYPE html>
 <h2>Managed jobs</h2>{jobs}
 <h2>Services</h2>{services}
 <h2>SLO / fleet</h2>{slo}
+<h2>Postmortems</h2>{postmortems}
 <h2>Metrics</h2>{metrics}
 <h2>Slowest traces</h2>{traces}
 </body></html>"""
 
 _GOOD = {'UP', 'SUCCEEDED', 'READY', 'RUNNING'}
 _BAD = {'FAILED', 'FAILED_SETUP', 'FAILED_CONTROLLER', 'FAILED_NO_RESOURCE',
-        'FAILED_PRECHECKS', 'FAILED_CLEANUP', 'PREEMPTED', 'FIRING'}
+        'FAILED_PRECHECKS', 'FAILED_CLEANUP', 'PREEMPTED', 'FIRING',
+        'HUNG'}
 
 
 def _table(headers, rows):
@@ -162,6 +164,24 @@ def _slo_html() -> str:
                    'burn (5m)', 'good tok/chip-s'], rows)
 
 
+def _postmortems_html() -> str:
+    """Training-plane crash bundles (train/postmortem.py): the local
+    SKYT_POSTMORTEM_DIR index — reason, rank, job, and the bundle path
+    an operator opens first after a hang/crash verdict
+    (docs/observability.md "Training plane")."""
+    from skypilot_tpu.train import postmortem as postmortem_lib
+    rows = []
+    for b in postmortem_lib.list_bundles(limit=20):
+        created = b.get('created')
+        when = (time.strftime('%Y-%m-%d %H:%M:%S',
+                              time.localtime(created))
+                if isinstance(created, (int, float)) else '-')
+        rows.append([b.get('reason') or b.get('error') or '-',
+                     b.get('rank', '-'), b.get('job_id') or '-',
+                     when, b['path']])
+    return _table(['reason', 'rank', 'job', 'created', 'bundle'], rows)
+
+
 def _metrics_html() -> str:
     """Registry snapshot panel for THIS process's metrics. Serve
     daemons and inference replicas are separate processes — scrape
@@ -214,6 +234,7 @@ def _render_page() -> str:
         jobs=_jobs_html(),
         services=_services_html(),
         slo=_slo_html(),
+        postmortems=_postmortems_html(),
         metrics=_metrics_html(),
         traces=_traces_html())
 

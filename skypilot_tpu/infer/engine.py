@@ -1574,6 +1574,14 @@ class InferenceEngine:
                 if p['spec_verify_steps'] > 0 else 0.0)
         if self.prefix_caching and self.pool is not None:
             p['prefix_cache'] = dict(self.pool.prefix_stats)
+            # Occupancy (cached pages / pool pages): synced through
+            # controller -> LB as skyt_lb_replica_prefix_cache — the
+            # affinity-routing signal (docs/serving.md, ROADMAP #2).
+            total = self.pool.cfg.n_pages - 1   # page 0 is the dummy
+            cached = self.pool.prefix_cached_pages()
+            p['prefix_cache']['cached_pages'] = cached
+            if total > 0:
+                p['prefix_cache']['occupancy'] = round(cached / total, 4)
         # Snapshot under the lock: the engine thread appends
         # concurrently, and iterating a mutating deque raises
         # RuntimeError (ADVICE r5) — a /stats request must never 500.

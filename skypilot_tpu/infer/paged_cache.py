@@ -134,6 +134,12 @@ class PagePool:
         pages no live slot references (reclaimable via eviction)."""
         return len(self._free) + len(self._cached_free)
 
+    def prefix_cached_pages(self) -> int:
+        """Pages currently holding published (reusable) prefix KV —
+        referenced or warm-LRU. The occupancy signal the LB's
+        cache-affinity routing reads (ROADMAP item 2)."""
+        return len(self._registry)
+
     def _alloc_page(self) -> Optional[int]:
         if self._free:
             return self._free.pop()

@@ -138,14 +138,20 @@ class JobsController:
                 # Cancelled out-of-band on the cluster; treat as user
                 # cancellation of the whole managed job.
                 raise _Cancelled()
-            if job_status == 'PREEMPTED':
+            if job_status in ('PREEMPTED', 'HUNG'):
                 # Cooperative preemption (EXIT_CODE_PREEMPTED): the
                 # workload checkpointed at a step boundary and asked to
-                # be rescheduled. Recover — the relaunch resumes from
-                # the checkpoint (resume from step k, not step 0) —
-                # instead of declaring user failure.
-                logger.info('task %d exited PREEMPTED (cooperative '
-                            'checkpoint); recovering', task_index)
+                # be rescheduled. HUNG: the gang watchdog confirmed a
+                # rank stopped making step progress (train/watchdog.py)
+                # and already killed the gang — every rank dumped a
+                # postmortem bundle first. Both recover the same way:
+                # relaunch resumes from the last checkpoint (step k,
+                # not step 0) instead of declaring user failure.
+                logger.info(
+                    'task %d exited %s (%s); recovering', task_index,
+                    job_status,
+                    'cooperative checkpoint' if job_status == 'PREEMPTED'
+                    else 'gang watchdog hang verdict')
                 jobs_state.set_status(
                     self.job_id, jobs_state.ManagedJobStatus.RECOVERING)
                 jobs_state.bump_recovery_count(self.job_id)

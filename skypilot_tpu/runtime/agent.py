@@ -33,12 +33,37 @@ WORK_POLL_INTERVAL_S = 1.0
 EVENT_INTERVAL_S = 20  # reference: sky/skylet/events.py:26
 
 
+def _watchdog_interval_s() -> float:
+    """Head-side gang-watchdog evaluation cadence (must be finer than
+    the 20s event loop: a hang verdict's latency floor is this tick)."""
+    try:
+        return float(os.environ.get('SKYT_WATCHDOG_INTERVAL_S', '') or 2.0)
+    except ValueError:
+        return 2.0
+
+
+def _heartbeat_path(job_id: int, rank: int) -> str:
+    """Local heartbeat file for one (job, rank) — the same default the
+    dispatch env exports, so the relay can find it without plumbing."""
+    return os.path.join(job_lib.log_dir_for_job(job_id),
+                        f'heartbeat-rank-{rank}.json')
+
+
+def _postmortem_dir(job_id: int) -> str:
+    return os.path.join(job_lib.log_dir_for_job(job_id), 'postmortems')
+
+
 class RunningJob:
     def __init__(self, job_id: int, thread: threading.Thread) -> None:
         self.job_id = job_id
         self.thread = thread
         self.pid: Optional[int] = None
         self.killed = False
+        # Resolved observability paths from the dispatch env (a task
+        # env override wins over the defaults) — the heartbeat relay
+        # reads these.
+        self.hb_path: Optional[str] = None
+        self.pm_dir: Optional[str] = None
 
 
 class Worker:
@@ -49,6 +74,10 @@ class Worker:
         self.head_url = f'http://{config.head_ip}:{config.head_port}'
         self.running: Dict[int, RunningJob] = {}
         self._lock = threading.Lock()
+        # job_id -> (last relayed heartbeat ts, bundle names already
+        # relayed): the relay only POSTs on change, so an idle or
+        # heartbeat-less job costs one stat() per poll, no HTTP.
+        self._hb_relayed: Dict[int, list] = {}
 
     # ------------------------------------------------------------- HTTP
     def _get(self, path: str) -> Dict[str, Any]:
@@ -93,6 +122,61 @@ class Worker:
                         logger.info('killing job %d (pid %s)', job_id,
                                     rj.pid)
                         subprocess_utils.kill_process_tree(rj.pid)
+        self._relay_heartbeats()
+
+    def _relay_heartbeats(self) -> None:
+        """Ship this host's rank heartbeat (and any new postmortem
+        bundle paths) to the head's gang watchdog. Change-driven: the
+        POST only happens when the heartbeat advanced or a bundle
+        appeared, and a relay failure is just logged — the watchdog's
+        job is to notice SILENCE, so the relay must never take the
+        work loop down."""
+        from skypilot_tpu.train import heartbeat as heartbeat_lib
+        if not heartbeat_lib.enabled():
+            return
+        with self._lock:
+            jobs = list(self.running.values())
+        for rj in jobs:
+            if rj.hb_path is None:
+                continue
+            rec = heartbeat_lib.read(rj.hb_path)
+            bundles = []
+            if rj.pm_dir is not None:
+                try:
+                    bundles = sorted(
+                        os.path.join(rj.pm_dir, n)
+                        for n in os.listdir(rj.pm_dir)
+                        if n.startswith('postmortem-'))
+                except OSError:
+                    pass
+            with self._lock:
+                last = self._hb_relayed.get(rj.job_id)
+            ts = (rec or {}).get('ts')
+            if last is not None and last[0] == ts and \
+                    set(bundles) <= set(last[1]):
+                continue
+            if rec is None and not bundles:
+                continue
+            try:
+                self._post('/heartbeat',
+                           {'job_id': rj.job_id,
+                            'rank': self.config.rank,
+                            'record': rec or {},
+                            'postmortems': bundles})
+                with self._lock:
+                    self._hb_relayed[rj.job_id] = [ts, bundles]
+            except requests.RequestException as e:
+                logger.warning('heartbeat relay for job %d failed: %s',
+                               rj.job_id, e)
+        # Bounded: drop relay state for jobs no longer running here.
+        # This method runs from the poll loop AND from finishing job
+        # threads (the final relay), so the cleanup must be
+        # lock-guarded and tolerate concurrent removal.
+        live = {rj.job_id for rj in jobs}
+        with self._lock:
+            for jid in list(self._hb_relayed):
+                if jid not in live:
+                    self._hb_relayed.pop(jid, None)
 
     def run_forever(self) -> None:
         while True:
@@ -121,6 +205,17 @@ class Worker:
             # existing sync-down path ships them (`skyt logs --profile`).
             env.setdefault('SKYT_PROFILE_DIR',
                            os.path.join(log_dir, 'profile', f'rank-{rank}'))
+        # Training-plane observability contract (docs/observability.md
+        # "Training plane"): the workload writes per-step heartbeats
+        # here (this worker relays them to the head's gang watchdog)
+        # and postmortem bundles next to the job logs. setdefault: a
+        # task env override wins (e.g. a durable bundle dir).
+        env.setdefault('SKYT_HEARTBEAT_FILE',
+                       _heartbeat_path(job_id, rank))
+        env.setdefault('SKYT_POSTMORTEM_DIR',
+                       os.path.join(log_dir, 'postmortems'))
+        rj.hb_path = env['SKYT_HEARTBEAT_FILE']
+        rj.pm_dir = env['SKYT_POSTMORTEM_DIR']
 
         setup = spec.get('setup')
         if setup:
@@ -145,6 +240,10 @@ class Worker:
         rc, _ = self._run_tracked(script, run_log, rj, docker=docker)
         os.unlink(script)
         self._report(job_id, 'done', rc)
+        # Final relay while the job is still in `running`: a bundle
+        # dumped on the way out (preempt/crash) must reach the head
+        # even though no further poll will see this job.
+        self._relay_heartbeats()
         with self._lock:
             self.running.pop(job_id, None)
 
@@ -257,6 +356,19 @@ def main(argv=None) -> None:
                          name='head-http').start()
         threading.Thread(target=HeadLoop(state).run_forever, daemon=True,
                          name='head-loop').start()
+        from skypilot_tpu.train import heartbeat as heartbeat_lib
+        if heartbeat_lib.enabled():
+            # Gang watchdog on its own (finer) cadence: the 20s event
+            # loop would put a 20s floor under hang detection.
+            def _watchdog_loop() -> None:
+                while True:
+                    try:
+                        state.watchdog_tick()
+                    except Exception:  # pylint: disable=broad-except
+                        logger.exception('watchdog tick failed')
+                    time.sleep(_watchdog_interval_s())
+            threading.Thread(target=_watchdog_loop, daemon=True,
+                             name='gang-watchdog').start()
 
     worker = Worker(config)
     # Graceful shutdown for tests / teardown.

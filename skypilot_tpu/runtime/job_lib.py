@@ -48,7 +48,8 @@ EXIT_CODE_PREEMPTED = 75
 class JobStatus(enum.Enum):
     """Reference: sky/skylet/job_lib.py:86 (same lifecycle, plus
     PREEMPTED for cooperative-preemption exits — see
-    EXIT_CODE_PREEMPTED)."""
+    EXIT_CODE_PREEMPTED — and HUNG for gang-watchdog hang verdicts,
+    which the managed-jobs controller recovers like a preemption)."""
     INIT = 'INIT'
     PENDING = 'PENDING'
     SETTING_UP = 'SETTING_UP'
@@ -58,6 +59,11 @@ class JobStatus(enum.Enum):
     FAILED_SETUP = 'FAILED_SETUP'
     CANCELLED = 'CANCELLED'
     PREEMPTED = 'PREEMPTED'
+    # Gang watchdog verdict (train/watchdog.py): a rank stopped making
+    # step progress while the process stayed alive — the failure mode
+    # exit codes can never surface. Terminal: the gang is killed and
+    # the managed-jobs controller resumes from the last checkpoint.
+    HUNG = 'HUNG'
 
     def is_terminal(self) -> bool:
         return self in _TERMINAL
@@ -68,7 +74,7 @@ class JobStatus(enum.Enum):
 
 
 _TERMINAL = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.FAILED_SETUP,
-             JobStatus.CANCELLED, JobStatus.PREEMPTED}
+             JobStatus.CANCELLED, JobStatus.PREEMPTED, JobStatus.HUNG}
 
 _DB_LOCK = threading.RLock()
 _DB: Optional[sqlite3.Connection] = None
@@ -267,6 +273,33 @@ def gang_any_preempted(job_id: int) -> bool:
     return any(r['status'] == 'DONE' and
                (r['returncode'] or 0) == EXIT_CODE_PREEMPTED
                for r in gang_records(job_id))
+
+
+def postmortem_trailer_lines(job_wire: Dict[str, Any]) -> List[str]:
+    """Log-surface trailer for a finished job: the gang watchdog
+    verdict (HUNG only) plus every rank's postmortem bundle paths
+    (docs/observability.md "Training plane"). ONE formatter shared by
+    both tail surfaces — the on-host rpc `tail` and the client
+    backend's HTTP tail — so the two can't drift."""
+    lines: List[str] = []
+    if job_wire.get('status') == JobStatus.HUNG.value and \
+            job_wire.get('watchdog'):
+        lines.append(f'### gang watchdog verdict: '
+                     f'{json.dumps(job_wire["watchdog"])} ###')
+    bundles = job_wire.get('postmortems') or {}
+
+    def _rank_key(r):
+        try:
+            return (0, int(r), '')
+        except (TypeError, ValueError):
+            return (1, 0, str(r))
+
+    if any(bundles.values()):
+        lines.append('### postmortem bundles:')
+        for rank in sorted(bundles, key=_rank_key):
+            for path in bundles[rank]:
+                lines.append(f'###   rank {rank}: {path}')
+    return lines
 
 
 # ------------------------------------------------------------------ scheduler

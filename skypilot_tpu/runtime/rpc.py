@@ -87,15 +87,22 @@ def op_tail(payload):
                                   job_done=job_done):
         print(line, end='', flush=True)
     status = None
+    wire = {}
     try:
         resp = requests.get(_base_url() + f'/jobs/{job_id}', timeout=10)
         if resp.status_code == 200:
-            status = resp.json()['status']
+            wire = resp.json()
+            status = wire['status']
     except requests.RequestException:
         pass
     print(f'\n### Job {job_id} finished with status: {status} ###'
           if status and job_lib.JobStatus(status).is_terminal() else '',
           file=sys.stderr)
+    # Training-plane postmortems ride the log surface: a HUNG/crashed
+    # gang's bundles (py-stacks, flight-recorder spans, train state)
+    # are the first thing an operator needs next to the logs.
+    for line in job_lib.postmortem_trailer_lines(wire):
+        print(line, file=sys.stderr)
     return None
 
 

@@ -11,10 +11,12 @@ Endpoints (JSON):
   GET  /health                  liveness + cluster identity
   POST /jobs/submit             {spec} -> {job_id}
   GET  /jobs                    [?status=...] -> [job]
-  GET  /jobs/<id>               job + gang records
+  GET  /jobs/<id>               job + gang records + watchdog verdict
   POST /jobs/<id>/cancel        cancel (kill directives fan out via /work)
   GET  /work?rank=r             [{action: run|kill, job_id, spec?, env?}]
   POST /report                  {job_id, rank, event, returncode}
+  POST /heartbeat               {job_id, rank, record, postmortems?}
+                                (agent relay -> gang watchdog)
   POST /autostop                {idle_minutes, down}
   GET  /autostop                current autostop config
 """
@@ -25,7 +27,7 @@ import time
 import traceback
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu.runtime import gang as gang_lib
 from skypilot_tpu.runtime import job_lib
@@ -65,10 +67,20 @@ class HeadState:
     """Gang bookkeeping + scheduling, shared by server handlers and the
     agent's scheduler loop. All mutations funnel through job_lib (sqlite)."""
 
-    def __init__(self, config: ClusterConfig) -> None:
+    def __init__(self, config: ClusterConfig,
+                 clock: Callable[[], float] = time.time) -> None:
         self.config = config
         self.scheduler = job_lib.FIFOScheduler()
         self.lock = threading.RLock()
+        self._clock = clock
+        # Training-plane watchdog state (train/watchdog.py): relayed
+        # heartbeats, one GangWatchdog per active gang job, the last
+        # verdict per job, and every bundle path ranks reported —
+        # all in-memory (a head-agent restart simply re-learns from
+        # the next relay round).
+        self.watchdogs: Dict[int, Any] = {}
+        self.verdicts: Dict[int, Dict[str, Any]] = {}
+        self.postmortems: Dict[int, Dict[int, List[str]]] = {}
 
     # ------------------------------------------------------------- submit
     def submit(self, spec: Dict[str, Any]) -> int:
@@ -106,10 +118,13 @@ class HeadState:
                 job_lib.gang_mark(job['job_id'], rank, 'DISPATCHED')
                 directives.append(self._run_directive(job, rank))
         # Kill directives: job reached a terminal state but this rank's
-        # process may still be running (failure elsewhere / cancellation).
+        # process may still be running (failure elsewhere / cancellation
+        # / a watchdog HUNG verdict — the hung rank by definition never
+        # exits on its own).
         terminal = job_lib.get_jobs([job_lib.JobStatus.CANCELLED,
                                      job_lib.JobStatus.FAILED,
-                                     job_lib.JobStatus.FAILED_SETUP])
+                                     job_lib.JobStatus.FAILED_SETUP,
+                                     job_lib.JobStatus.HUNG])
         for job in terminal:
             for rec in job_lib.gang_records(job['job_id']):
                 if rec['rank'] == rank and rec['status'] in ('DISPATCHED',
@@ -132,6 +147,79 @@ class HeadState:
                                          self.config.cluster_name)
         return {'action': 'run', 'job_id': job['job_id'], 'spec': spec,
                 'env': env}
+
+    # ------------------------------------------------------------ watchdog
+    def record_heartbeat(self, job_id: int, rank: int,
+                         record: Dict[str, Any],
+                         postmortems: Optional[List[str]] = None) -> None:
+        """Ingest one relayed rank heartbeat (+ any bundle paths the
+        rank's host has seen). Lazily creates the job's GangWatchdog
+        sized to its gang."""
+        from skypilot_tpu.train import watchdog as watchdog_lib
+        with self.lock:
+            wd = self.watchdogs.get(job_id)
+            if wd is None:
+                n = len(job_lib.gang_records(job_id)) or \
+                    self.config.num_nodes
+                wd = watchdog_lib.GangWatchdog(n, clock=self._clock,
+                                               job=str(job_id))
+                self.watchdogs[job_id] = wd
+            if postmortems:
+                per_job = self.postmortems.setdefault(job_id, {})
+                known = per_job.setdefault(int(rank), [])
+                for p in postmortems:
+                    if p not in known:
+                        known.append(p)
+        if isinstance(record, dict):
+            wd.observe(int(rank), record)
+
+    def watchdog_tick(self) -> None:
+        """One watchdog pass over active gang jobs: evaluate each
+        job's verdict, escalate a CONFIRMED hang to the terminal HUNG
+        status (kill directives then fan out via /work and the
+        managed-jobs controller recovers from the checkpoint), and
+        drop state for jobs that finished."""
+        active = {j['job_id']: j for j in job_lib.get_jobs(
+            job_lib.JobStatus.nonterminal_statuses())}
+        with self.lock:
+            items = list(self.watchdogs.items())
+        for job_id, wd in items:
+            job = active.get(job_id)
+            if job is None:
+                # Keep the final verdict (the job wire serves it);
+                # retire the evaluator and its gauge series.
+                with self.lock:
+                    retired = self.watchdogs.pop(job_id, None)
+                if retired is not None:
+                    retired.retire()
+                continue
+            verdict = wd.evaluate()
+            with self.lock:
+                self.verdicts[job_id] = verdict.to_wire()
+            if verdict.state == 'hang' and verdict.confirmed and \
+                    job['status'] is job_lib.JobStatus.RUNNING:
+                logger.error(
+                    'gang watchdog: job %d confirmed HUNG (%s); '
+                    'killing the gang for checkpoint-resume recovery',
+                    job_id, verdict.detail.get('stalled_ranks'))
+                job_lib.set_status(job_id, job_lib.JobStatus.HUNG)
+
+    def job_observability(self, job_id: int) -> Dict[str, Any]:
+        """Watchdog verdict + heartbeats + postmortem bundle paths for
+        the job wire (GET /jobs/<id>) — what `skyt logs` and the
+        dashboard surface next to a dead gang."""
+        with self.lock:
+            wd = self.watchdogs.get(job_id)
+            out: Dict[str, Any] = {
+                'watchdog': self.verdicts.get(job_id),
+                'postmortems': {
+                    str(r): list(paths) for r, paths in
+                    self.postmortems.get(job_id, {}).items()},
+            }
+        if wd is not None:
+            out['heartbeats'] = {str(r): rec for r, rec in
+                                 wd.records().items()}
+        return out
 
     # -------------------------------------------------------------- reports
     def report(self, job_id: int, rank: int, event: str,
@@ -162,9 +250,12 @@ class HeadState:
                 # (often arriving first) — that collateral must not
                 # mask the recovery signal. A genuinely failing job
                 # relaunches and fails again WITHOUT any 75, so it
-                # still lands FAILED on the next attempt.
+                # still lands FAILED on the next attempt. HUNG also
+                # stays: the watchdog's kill SIGTERMs the survivors,
+                # whose cooperative 75s must not relabel the hang.
                 if status not in (job_lib.JobStatus.SUCCEEDED,
-                                  job_lib.JobStatus.CANCELLED):
+                                  job_lib.JobStatus.CANCELLED,
+                                  job_lib.JobStatus.HUNG):
                     job_lib.set_status(job_id,
                                        job_lib.JobStatus.PREEMPTED)
             elif rc != 0:
@@ -173,7 +264,8 @@ class HeadState:
             elif job_lib.gang_all_done(job_id):
                 if job_lib.gang_any_preempted(job_id):
                     if status not in (job_lib.JobStatus.SUCCEEDED,
-                                      job_lib.JobStatus.CANCELLED):
+                                      job_lib.JobStatus.CANCELLED,
+                                      job_lib.JobStatus.HUNG):
                         job_lib.set_status(job_id,
                                            job_lib.JobStatus.PREEMPTED)
                 elif job_lib.gang_any_failed(job_id):
@@ -243,6 +335,7 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     wire = _job_wire(job)
                     wire['gang'] = job_lib.gang_records(job['job_id'])
+                    wire.update(st.job_observability(job['job_id']))
                     self._reply(wire)
             elif parts[:1] == ['logs'] and len(parts) == 2:
                 # Incremental log read: head host's rank-0 log for the job.
@@ -297,6 +390,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif parts == ['report']:
                 st.report(body['job_id'], body['rank'], body['event'],
                           body.get('returncode'))
+                self._reply({'ok': True})
+            elif parts == ['heartbeat']:
+                st.record_heartbeat(int(body['job_id']),
+                                    int(body['rank']),
+                                    body.get('record') or {},
+                                    body.get('postmortems'))
                 self._reply({'ok': True})
             elif parts == ['autostop']:
                 job_lib.set_kv('autostop_idle_minutes',

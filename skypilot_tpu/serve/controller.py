@@ -141,6 +141,12 @@ class SkyServeController:
         replica_qos = self.replica_manager.ready_qos()
         if replica_qos:
             resp['replica_qos'] = replica_qos
+        # Per-replica prefix-cache occupancy: surfaced by the LB as
+        # skyt_lb_replica_prefix_cache{replica} — the observable half
+        # of cache-affinity routing (ROADMAP item 2).
+        prefix = self.replica_manager.ready_prefix_cache()
+        if prefix:
+            resp['replica_prefix_cache'] = prefix
         return web.json_response(resp)
 
     async def _handle_update_service(self, request: web.Request

@@ -365,6 +365,30 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
             body.setdefault('replica', rid)
         return web.json_response(body, status=upstream.status_code)
 
+    async def fleet_postmortems(request: web.Request) -> web.Response:
+        """Index of postmortem crash bundles visible to this
+        controller (SKYT_POSTMORTEM_DIR; train/postmortem.py): the
+        training plane's black boxes, served where operators already
+        look for fleet state."""
+        from skypilot_tpu.train import postmortem as postmortem_lib
+        limit = request.query.get('limit', '50')
+        try:
+            limit_n = int(limit)
+            if limit_n <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'limit must be a positive integer, got '
+                          f'{limit!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        bundles = await loop.run_in_executor(
+            None, functools.partial(postmortem_lib.list_bundles,
+                                    limit=limit_n))
+        return web.json_response(
+            {'root': postmortem_lib.bundle_root(),
+             'bundles': bundles})
+
     app.router.add_get('/fleet/metrics', fleet_metrics)
     app.router.add_get('/fleet/slo', fleet_slo)
+    app.router.add_get('/fleet/postmortems', fleet_postmortems)
     app.router.add_post('/fleet/profile', fleet_profile)

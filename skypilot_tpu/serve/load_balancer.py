@@ -118,6 +118,12 @@ class LBState:
     snapshot, with an age bound"."""
     ready_replicas: List[str] = dataclasses.field(default_factory=list)
     replica_qos: Dict[str, dict] = dataclasses.field(default_factory=dict)
+    # Per-replica prefix-cache stats (occupancy, hit/miss pages) from
+    # the controller sync — surfaced as
+    # skyt_lb_replica_prefix_cache{replica} and the observable half of
+    # cache-affinity routing (ROADMAP item 2).
+    replica_prefix_cache: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
     # time.monotonic() of the last successful controller sync; 0.0 =
     # never synced (fresh process: nothing to be stale ABOUT).
     synced_at: float = 0.0
@@ -132,6 +138,8 @@ class LBState:
     def to_json(self) -> str:
         return json.dumps({'ready_replicas': self.ready_replicas,
                            'replica_qos': self.replica_qos,
+                           'replica_prefix_cache':
+                               self.replica_prefix_cache,
                            'age_s': round(self.age_s(), 3),
                            'version': self.version})
 
@@ -141,6 +149,7 @@ class LBState:
         state = cls(
             ready_replicas=[str(r) for r in d.get('ready_replicas', [])],
             replica_qos=d.get('replica_qos') or {},
+            replica_prefix_cache=d.get('replica_prefix_cache') or {},
             version=int(d.get('version', 0)))
         # Imported snapshots carry an age, not a foreign monotonic
         # stamp (monotonic clocks don't transfer between processes).
@@ -423,6 +432,15 @@ class SkyServeLoadBalancer:
             'skyt_lb_qos_sheds_observed_total',
             'Upstream 429 shed responses proxied, by class',
             ('class',))
+        # Prefix-cache occupancy per replica, learned from the
+        # controller sync (the controller scrapes each replica's
+        # /stats 'prefix_cache' block) — groundwork for cache-affinity
+        # routing (ROADMAP item 2).
+        self._m_prefix_cache = reg.gauge(
+            'skyt_lb_replica_prefix_cache',
+            'Prefix-cache occupancy fraction of each ready replica '
+            '(cached pages / pool pages, from the controller sync)',
+            ('replica',))
         # Control-plane crash tolerance: the synced world view lives in
         # one LBState snapshot; on sync failure the LB serves from the
         # stale snapshot (bounded by SKYT_LB_STALE_TTL_S, with its own
@@ -514,9 +532,12 @@ class SkyServeLoadBalancer:
                     data = await resp.json()
                     ready = data.get('ready_replica_urls', [])
                     rq = data.get('replica_qos')
+                    rpc = data.get('replica_prefix_cache')
                     self.apply_state(LBState(
                         ready_replicas=list(ready),
                         replica_qos=rq if isinstance(rq, dict) else {},
+                        replica_prefix_cache=rpc
+                        if isinstance(rpc, dict) else {},
                         synced_at=time.monotonic(),
                         version=self.state.version + 1))
             except Exception as e:  # pylint: disable=broad-except
@@ -534,6 +555,16 @@ class SkyServeLoadBalancer:
         self.state = state
         self.policy.set_ready_replicas(list(state.ready_replicas))
         self._prune_replica_metrics(state.ready_replicas)
+        # Prefix-cache occupancy gauges track the snapshot: one series
+        # per replica that reported a block, pruned with the replica.
+        for key in self._m_prefix_cache.label_keys():
+            if key[0] not in state.replica_prefix_cache:
+                self._m_prefix_cache.remove_labels(*key)
+        for replica, block in state.replica_prefix_cache.items():
+            occ = block.get('occupancy') if isinstance(block, dict) \
+                else None
+            if isinstance(occ, (int, float)):
+                self._m_prefix_cache.labels(replica).set(float(occ))
         if self._stale:
             logger.info('controller sync recovered; leaving stale-'
                         'state mode (%d ready replicas)',
@@ -550,6 +581,7 @@ class SkyServeLoadBalancer:
         return LBState(
             ready_replicas=list(self.policy.ready_replicas),
             replica_qos=dict(self.state.replica_qos),
+            replica_prefix_cache=dict(self.state.replica_prefix_cache),
             synced_at=self.state.synced_at,
             version=self.state.version)
 

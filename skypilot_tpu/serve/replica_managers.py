@@ -520,8 +520,12 @@ class ReplicaManager:
     # 'qos' is the replica's QoS pressure block (overload level,
     # per-class queue depths) — forwarded to the LB via the sync
     # response so replica picking can steer shed-prone classes away.
+    # 'prefix_cache' carries the replica's prefix-cache occupancy —
+    # the LB surfaces it as skyt_lb_replica_prefix_cache{replica},
+    # groundwork for cache-affinity routing (ROADMAP item 2).
     _STATS_KEYS = ('ttft_ms', 'steady_decode_tok_per_sec',
-                   'active_slots', 'num_slots', 'waiting', 'qos')
+                   'active_slots', 'num_slots', 'waiting', 'qos',
+                   'prefix_cache')
     # Scrape /stats only every Kth probe pass: the scrape is a serial
     # blocking GET per READY replica inside the controller's one
     # control thread, and the data is only read by `serve status` and
@@ -712,6 +716,20 @@ class ReplicaManager:
                         r.endpoint and isinstance(r.stats, dict) and \
                         isinstance(r.stats.get('qos'), dict):
                     out[r.endpoint] = r.stats['qos']
+            return out
+
+    def ready_prefix_cache(self) -> dict:
+        """endpoint -> prefix-cache stats block (occupancy, hit/miss
+        pages) for READY replicas whose last /stats scrape carried one
+        (engine servers with paged prefix caching; other services
+        never appear)."""
+        with self._lock:
+            out = {}
+            for r in self.replicas.values():
+                if r.status is serve_state.ReplicaStatus.READY and \
+                        r.endpoint and isinstance(r.stats, dict) and \
+                        isinstance(r.stats.get('prefix_cache'), dict):
+                    out[r.endpoint] = r.stats['prefix_cache']
             return out
 
     def num_alive(self) -> int:

@@ -1,0 +1,201 @@
+"""Postmortem crash bundles: everything a rank knows, dumped at death.
+
+When a gang hangs (rank sentinel / watchdog verdict), is preempted
+(SIGTERM via the PR 4 guard), or crashes, each rank dumps a *bundle*:
+
+    postmortem-<utc>-rank<r>-<pid>/
+        stacks.txt   faulthandler py-stacks of every thread (works
+                     even while the main thread is wedged in a device
+                     call — dumped from the sentinel thread)
+        spans.json   the flight recorder's retained traces
+                     (utils/tracing.py SpanStore.records())
+        state.json   reason, rank/job identity, the last heartbeat,
+                     engine-free train state (step, prefetch depth),
+                     device kind, and the SKYT_*/JAX_* environment
+
+Bundles are written ATOMICALLY (staged under a dot-tmp dir, then one
+rename) into ``SKYT_POSTMORTEM_DIR`` (the per-host agent points this
+at the job's log dir; default ``~/.skyt/postmortems``), so a reader
+never lists a half-written bundle. The directory doubles as the index:
+``list_bundles()`` backs ``GET /fleet/postmortems``, the dashboard
+panel, and the `skyt logs` trailer (docs/observability.md "Training
+plane").
+"""
+import faulthandler
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
+
+logger = log_utils.init_logger(__name__)
+
+ENV_DIR = 'SKYT_POSTMORTEM_DIR'
+PREFIX = 'postmortem-'
+
+# Env prefixes worth preserving in state.json. Deliberately narrow: a
+# bundle may be synced off-host, so the whole environ (tokens, paths,
+# user secrets) must not ride along.
+_ENV_PREFIXES = ('SKYT_', 'JAX_', 'MEGASCALE_', 'SKYPILOT_')
+
+
+def bundle_root() -> str:
+    return os.path.expanduser(
+        os.environ.get(ENV_DIR) or '~/.skyt/postmortems')
+
+
+def _counter() -> 'metrics_lib.Counter':
+    return metrics_lib.REGISTRY.counter(
+        'skyt_train_postmortems_total',
+        'Postmortem bundles dumped, by trigger', ('reason',))
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def dump_bundle(reason: str, *,
+                rank: Optional[int] = None,
+                job_id: Optional[Any] = None,
+                heartbeat: Optional[Dict[str, Any]] = None,
+                train_state: Optional[Dict[str, Any]] = None,
+                extra: Optional[Dict[str, Any]] = None,
+                root: Optional[str] = None,
+                tracer=None,
+                now: Optional[float] = None) -> Optional[str]:
+    """Write one bundle; returns its path, or None if even the dump
+    failed (a postmortem path must never raise into a dying process).
+
+    Safe to call from ANY thread — faulthandler dumps all threads'
+    stacks regardless of which one asks."""
+    try:
+        if now is None:
+            now = time.time()
+        if rank is None:
+            try:
+                rank = int(os.environ.get('SKYT_NODE_RANK', '0') or 0)
+            except ValueError:
+                rank = 0
+        if job_id is None:
+            job_id = os.environ.get('SKYT_JOB_ID')
+        root = root or bundle_root()
+        # Millisecond component + reason: the guard path can dump a
+        # 'preempt' bundle and the crash handler a 'crash' bundle from
+        # the same pid within one second — names must never collide
+        # (os.rename onto an existing bundle dir would fail and lose
+        # the second, usually more interesting, bundle).
+        stamp = time.strftime('%Y%m%d-%H%M%S', time.gmtime(now))
+        ms = int((now % 1) * 1000)
+        safe_reason = ''.join(c if c.isalnum() else '-'
+                              for c in str(reason))[:24]
+        name = (f'{PREFIX}{stamp}.{ms:03d}-rank{rank}-'
+                f'{os.getpid()}-{safe_reason}')
+        tmp = os.path.join(root, f'.tmp-{name}')
+        os.makedirs(tmp, exist_ok=True)
+
+        with open(os.path.join(tmp, 'stacks.txt'), 'w',
+                  encoding='utf-8') as f:
+            f.write(f'# postmortem py-stacks reason={reason} '
+                    f'rank={rank} pid={os.getpid()} ts={now}\n')
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=True)
+
+        from skypilot_tpu.utils import tracing
+        store = (tracer or tracing.TRACER).store
+        with open(os.path.join(tmp, 'spans.json'), 'w',
+                  encoding='utf-8') as f:
+            json.dump({'traces': store.records(),
+                       'summaries': store.summaries()}, f, default=str)
+
+        state = {
+            'reason': reason,
+            'rank': rank,
+            'job_id': job_id,
+            'created': now,
+            'pid': os.getpid(),
+            'task_id': os.environ.get('SKYT_TASK_ID'),
+            'cluster': os.environ.get('SKYT_CLUSTER_NAME'),
+            'device': _device_kind(),
+            'heartbeat': heartbeat,
+            'train': train_state,
+            'env': {k: v for k, v in sorted(os.environ.items())
+                    if k.startswith(_ENV_PREFIXES)},
+        }
+        if extra:
+            state.update(extra)
+        with open(os.path.join(tmp, 'state.json'), 'w',
+                  encoding='utf-8') as f:
+            json.dump(state, f, indent=1, default=str)
+
+        final = os.path.join(root, name)
+        os.rename(tmp, final)
+        _counter().labels(reason).inc()
+        logger.warning('postmortem bundle dumped: %s (reason=%s)',
+                       final, reason)
+        if tracing.enabled():
+            # Forced-sampled: a postmortem span is by definition the
+            # one worth keeping.
+            (tracer or tracing.TRACER).record_span(
+                'postmortem.dump', now, time.time(), sampled=True,
+                attributes={'reason': reason, 'rank': str(rank),
+                            'bundle': final})
+        return final
+    except Exception:  # pylint: disable=broad-except
+        logger.exception('postmortem dump failed (reason=%s)', reason)
+        return None
+
+
+def list_bundles(root: Optional[str] = None, limit: int = 50
+                 ) -> List[Dict[str, Any]]:
+    """Newest-first bundle index from a postmortem dir: one entry per
+    bundle with its state.json summary fields. Tolerant of foreign
+    files and torn state (a broken bundle lists with an 'error')."""
+    root = root or bundle_root()
+    try:
+        names = [n for n in os.listdir(root)
+                 if n.startswith(PREFIX) and
+                 os.path.isdir(os.path.join(root, n))]
+    except OSError:
+        return []
+    names.sort(reverse=True)
+    out: List[Dict[str, Any]] = []
+    for name in names[:max(limit, 0)]:
+        path = os.path.join(root, name)
+        entry: Dict[str, Any] = {'bundle': name, 'path': path}
+        try:
+            with open(os.path.join(path, 'state.json'), 'r',
+                      encoding='utf-8') as f:
+                state = json.load(f)
+            for k in ('reason', 'rank', 'job_id', 'created', 'cluster',
+                      'task_id', 'device'):
+                entry[k] = state.get(k)
+        except (OSError, ValueError) as e:
+            entry['error'] = f'unreadable state.json: {e}'
+        try:
+            entry['files'] = sorted(os.listdir(path))
+        except OSError:
+            entry['files'] = []
+        out.append(entry)
+    return out
+
+
+def make_train_state_reader(live: Dict[str, Any],
+                            prefetcher=None) -> Callable[[], Dict[str, Any]]:
+    """Engine-free train-state snapshot closure for bundles: reads the
+    step loop's live cell (plain dict writes — no device sync) and the
+    prefetch queue depth."""
+    def _read() -> Dict[str, Any]:
+        state = dict(live)
+        if prefetcher is not None:
+            try:
+                state['prefetch_resident'] = prefetcher.resident()
+            except Exception as e:  # pylint: disable=broad-except
+                state['prefetch_resident'] = f'error: {e!r}'
+        return state
+    return _read

@@ -120,6 +120,13 @@ class Prefetcher:
                 continue
         return False
 
+    def resident(self) -> int:
+        """Batches currently staged ahead of the consumer — a hung
+        step loop shows a FULL queue here (producer kept up, device
+        stopped pulling), which is exactly the signal postmortem
+        bundles record (train/postmortem.py)."""
+        return self._q.qsize()
+
     # ------------------------------------------------------------ consumer
     def __iter__(self) -> 'Prefetcher':
         return self

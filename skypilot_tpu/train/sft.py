@@ -149,6 +149,39 @@ def main(argv=None) -> None:
                 jax.process_index(), jax.process_count(),
                 jax.local_device_count(), jax.device_count())
 
+    # Training-plane observability (docs/observability.md "Training
+    # plane"): per-step heartbeats to SKYT_HEARTBEAT_FILE (relayed by
+    # the per-host agent to the gang watchdog) plus a rank-local
+    # sentinel that dumps a postmortem bundle if THIS rank stalls —
+    # the path that still works when the main thread is wedged in a
+    # device call. hb is None with SKYT_WATCHDOG=0: the step loop then
+    # contains no heartbeat call at all.
+    from skypilot_tpu.train import heartbeat as heartbeat_lib
+    from skypilot_tpu.train import postmortem as postmortem_lib
+    from skypilot_tpu.train import watchdog as watchdog_lib
+    hb = heartbeat_lib.writer_from_env(
+        device_kind=jax.devices()[0].device_kind)
+    # Rank comes from the gang env regardless of SKYT_WATCHDOG: the
+    # train.step fault point's `rank` attr (where=rank:R targeting)
+    # must stay correct with the heartbeat plane disabled.
+    try:
+        rank = int(os.environ.get('SKYT_NODE_RANK', '0') or 0)
+    except ValueError:
+        rank = 0
+    # Live step-loop cell for engine-free bundle state: plain dict
+    # writes on the host, no device syncs.
+    live_state = {'step': None, 'steps_total': args.steps,
+                  'model': args.model}
+    train_state_reader = postmortem_lib.make_train_state_reader(
+        live_state)
+    sentinel = None
+    if hb is not None:
+        hb.mark_phase('init')
+        sentinel = watchdog_lib.RankSentinel(
+            hb, lambda snap: postmortem_lib.dump_bundle(
+                'hang', rank=rank, heartbeat=snap,
+                train_state=train_state_reader())).start()
+
     from skypilot_tpu.models import llama
     from skypilot_tpu.models import moe
     from skypilot_tpu.parallel import mesh as mesh_lib
@@ -329,10 +362,19 @@ def main(argv=None) -> None:
                 batches, depth=args.prefetch,
                 place=prefetch_lib.make_sharded_placer(mesh))
             batches = prefetcher
+            # Bundles should record the prefetch queue depth (a full
+            # queue + no steps = the device stopped pulling). The
+            # sentinel's lambda reads this name late-bound.
+            train_state_reader = postmortem_lib.make_train_state_reader(
+                live_state, prefetcher)
 
         # Step loop from here: checkpoint writes begin, so preemption
         # must wait for a step boundary instead of exiting mid-write.
         guard.cooperative()
+        if hb is not None:
+            # First loop iteration traces + compiles; the watchdog's
+            # stall budget must not apply until real steps flow.
+            hb.mark_phase('compile')
         t0 = time.perf_counter()
         last_t = t0
         tokens_seen = 0
@@ -352,12 +394,28 @@ def main(argv=None) -> None:
                     if paths:
                         logger.info('kernel dispatch paths: %s', paths)
                 tokens_seen += args.batch * args.seq * jax.process_count()
+                if hb is not None:
+                    live_state['step'] = step
+                    hb.on_step(step + 1,
+                               tokens_per_sec=tokens_seen /
+                               max(time.perf_counter() - t0, 1e-9))
                 saved = ckpt.save(step + 1, state) \
                     if ckpt is not None else False
                 # Chaos hook: kind=preempt here SIGTERMs this process, so
-                # the guard path below runs deterministically in tests.
-                faults.inject('train.step', step=step)
+                # the guard path below runs deterministically in tests;
+                # kind=hang (rank-targetable via `where=rank:R`) wedges
+                # the step loop so the watchdog/postmortem plane can be
+                # drilled on CPU (docs/robustness.md fault catalog).
+                faults.inject('train.step', step=step, rank=rank)
                 if guard.requested:
+                    if hb is not None:
+                        # SIGTERM path of the bundle contract: the dump
+                        # is cheap and the evidence free (the preempted
+                        # run is one operators ask questions about).
+                        postmortem_lib.dump_bundle(
+                            'preempt', rank=rank,
+                            heartbeat=hb.snapshot(),
+                            train_state=train_state_reader())
                     if ckpt is not None:
                         if not saved:
                             ckpt.save(step + 1, state, force=True)
@@ -401,12 +459,27 @@ def main(argv=None) -> None:
                                 step + 1, args.steps,
                                 host.get('loss', float('nan')),
                                 tokens_seen / dt)
+        except SystemExit:
+            raise
+        except Exception:
+            # Crash path of the bundle contract: stacks + flight
+            # recorder + train state, then re-raise — the bundle must
+            # never mask the real traceback.
+            if hb is not None:
+                postmortem_lib.dump_bundle(
+                    'crash', rank=rank, heartbeat=hb.snapshot(),
+                    train_state=train_state_reader())
+            raise
         finally:
             # A crash inside the profiled window must still flush the trace
             # — the failing run is the one most worth profiling.
             prof.stop()
+            if sentinel is not None:
+                sentinel.stop()
             if prefetcher is not None:
                 prefetcher.close()
+        if hb is not None:
+            hb.mark_phase('done')
         if ckpt is not None:
             if ckpt.latest_step() != args.steps:
                 ckpt.save(args.steps, state, force=True)
@@ -415,7 +488,11 @@ def main(argv=None) -> None:
     finally:
         # In-process callers (tests) outlive main(): give them
         # their SIGTERM/SIGINT handlers back however the run
-        # ends (completion, preemption SystemExit, setup error).
+        # ends (completion, preemption SystemExit, setup error) —
+        # and stop the sentinel thread (idempotent), so a setup
+        # failure can't leave it watching a stale heartbeat.
+        if sentinel is not None:
+            sentinel.stop()
         guard.restore()
 
 

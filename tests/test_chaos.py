@@ -1609,3 +1609,140 @@ def test_preempted_wins_over_collateral_rank_failure(tmp_path,
     head.report(jid3, 0, 'done', 1)
     head.report(jid3, 1, 'done', 0)
     assert job_lib.get_job(jid3)['status'] is job_lib.JobStatus.FAILED
+
+
+# ===================================== gang hang watchdog recovery drill
+@pytest.mark.integration
+def test_chaos_gang_hang_watchdog_recovery(tmp_path, tmp_state_dir,
+                                           monkeypatch):
+    """THE training-plane acceptance drill (docs/observability.md
+    "Training plane"): one rank of a REAL 2-rank gang wedges via
+    SKYT_FAULTS=train.step=hang -> the head agent's gang watchdog
+    confirms the hang and escalates the cluster job to HUNG -> every
+    rank has dumped a postmortem bundle (the hung rank via its
+    sentinel, the survivor via the SIGTERM guard) -> the managed-jobs
+    controller recovers (kill gang, relaunch) -> sft RESUMES from its
+    preemption-era checkpoint -> SUCCEEDED, zero manual intervention.
+    """
+    import json
+    import pathlib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import state
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state as jobs_state
+    from skypilot_tpu.train import postmortem as postmortem_lib
+
+    drill = tmp_path / 'drill'
+    drill.mkdir()
+    pm_dir = tmp_path / 'postmortems'   # durable across the relaunch
+    monkeypatch.setenv('SKYT_LOCAL_ROOT', str(tmp_path / 'local'))
+    monkeypatch.setenv('SKYT_JOBS_CHECK_GAP', '0.3')
+    monkeypatch.setenv('SKYT_JOBS_PREEMPTION_GRACE', '1')
+    # Fast watchdog thresholds (agents inherit this env at provision).
+    monkeypatch.setenv('SKYT_WATCHDOG_MIN_S', '3')
+    monkeypatch.setenv('SKYT_WATCHDOG_FACTOR', '2')
+    monkeypatch.setenv('SKYT_WATCHDOG_CONFIRM', '2')
+    monkeypatch.setenv('SKYT_WATCHDOG_INTERVAL_S', '0.5')
+    monkeypatch.setenv('SKYT_WATCHDOG_POLL_S', '0.3')
+    monkeypatch.setenv('SKYT_HEARTBEAT_INTERVAL_S', '0.1')
+    # The persistent XLA compile cache wedges sft RESUME subprocesses
+    # on this jax 0.4.37 CPU image (documented since PR 4) — the
+    # relaunched ranks pay the recompile instead.
+    monkeypatch.delenv('JAX_COMPILATION_CACHE_DIR', raising=False)
+    monkeypatch.delenv('JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS',
+                       raising=False)
+    state.reset_db_for_testing()
+    jobs_state.reset_db_for_testing()
+
+    # Rank 1 arms the hang fault ONCE (marker-guarded, so the
+    # recovered incarnation runs clean); a small latency fault on
+    # every step keeps rank 0 running long enough to be SIGTERM'd by
+    # the HUNG kill (exercising its preempt-bundle path). The JAX
+    # coordinator triplet is cleared: on the CPU backend each rank is
+    # its own single-process jax runtime (multiprocess CPU collectives
+    # are unimplemented in jax 0.4.x — the watchdog plane is what is
+    # under test).
+    run_cmd = f'''
+RANK="$SKYT_NODE_RANK"
+if [ "$RANK" = "1" ] && [ ! -f "{drill}/armed" ]; then
+  touch "{drill}/armed"
+  export SKYT_FAULTS="$SKYT_FAULTS;train.step=hang,arg=600,after=4"
+fi
+env SKYT_NUM_NODES=1 JAX_COORDINATOR_ADDRESS= JAX_NUM_PROCESSES= \\
+    JAX_PROCESS_ID= \\
+  {sys.executable} -m skypilot_tpu.train.sft --model debug \\
+  --steps 120 --batch 1 --seq 16 --prefetch 0 \\
+  --checkpoint-dir "{drill}/ckpt/rank-$RANK" --checkpoint-every 2 \\
+  --log-every 10 2>&1 | tee -a "{drill}/rank-$RANK.out"
+exit "${{PIPESTATUS[0]}}"
+'''
+    t = sky.Task(name='hangdrill', run=run_cmd, num_nodes=2,
+                 envs={'SKYT_POSTMORTEM_DIR': str(pm_dir),
+                       'SKYT_FAULTS': 'train.step=latency,arg=0.1',
+                       'JAX_PLATFORMS': 'cpu'})
+    t.set_resources(resources_lib.Resources(cloud='local'))
+
+    jid = jobs_core.launch(t, retry_until_up=False)
+    saw_recovering = False
+    deadline = time.time() + 900
+    job = None
+    try:
+        while time.time() < deadline:
+            job = jobs_state.get_job(jid)
+            if job['status'] == jobs_state.ManagedJobStatus.RECOVERING:
+                saw_recovering = True
+            if job['status'].is_terminal():
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail(f'drill never finished: {job}')
+
+        out1 = (drill / 'rank-1.out').read_text() \
+            if (drill / 'rank-1.out').exists() else ''
+        assert job['status'] == jobs_state.ManagedJobStatus.SUCCEEDED, \
+            (job, out1[-2000:])
+        assert job['recovery_count'] >= 1
+        assert saw_recovering
+
+        # Bundles from EVERY rank, durable across the relaunch: the
+        # hung rank's sentinel bundle plus the survivor's SIGTERM
+        # (preempt) bundle — each with stacks + spans + train state.
+        bundles = postmortem_lib.list_bundles(root=str(pm_dir))
+        reasons = {(b.get('rank'), b.get('reason')) for b in bundles}
+        assert (1, 'hang') in reasons, bundles
+        assert (0, 'preempt') in reasons, bundles
+        for b in bundles:
+            assert {'stacks.txt', 'spans.json', 'state.json'} <= \
+                set(b['files']), b
+        hang_state = json.loads(
+            (pathlib.Path(next(
+                b['path'] for b in bundles
+                if (b.get('rank'), b.get('reason')) == (1, 'hang')))
+             / 'state.json').read_text())
+        assert hang_state['heartbeat']['stall']['stalled'] is True
+
+        # The recovered rank resumed from its pre-hang checkpoint
+        # (resume-from-step-k, not step 0).
+        assert 'resumed from step' in out1, out1[-2000:]
+    finally:
+        for j in jobs_state.get_jobs():
+            if not j['status'].is_terminal():
+                try:
+                    jobs_core.cancel([j['job_id']])
+                except Exception:  # pylint: disable=broad-except
+                    pass
+        t_end = time.time() + 30
+        while time.time() < t_end and any(
+                not j['status'].is_terminal()
+                for j in jobs_state.get_jobs()):
+            time.sleep(0.5)
+        for rec in state.get_clusters():
+            try:
+                from skypilot_tpu import core as sky_core
+                sky_core.down(rec['name'], purge=True)
+            except Exception:  # pylint: disable=broad-except
+                pass
+        state.reset_db_for_testing()
+        jobs_state.reset_db_for_testing()

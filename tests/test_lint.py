@@ -82,7 +82,8 @@ def test_lint_forbids_wall_clock_in_slo_and_timeseries(tmp_path):
         import lint
     finally:
         sys.path.pop(0)
-    for rel in ('serve/slo.py', 'utils/timeseries.py'):
+    for rel in ('serve/slo.py', 'utils/timeseries.py',
+                'train/heartbeat.py', 'train/watchdog.py'):
         bad = tmp_path / 'skypilot_tpu' / rel
         bad.parent.mkdir(parents=True, exist_ok=True)
         bad.write_text('import time\n'

@@ -908,6 +908,10 @@ def test_controller_sync_payload_roundtrip(monkeypatch):
         def ready_qos(self):
             return {'http://r1': {'level': 2, 'pressure': 0.8}}
 
+        def ready_prefix_cache(self):
+            return {'http://r1': {'occupancy': 0.25,
+                                  'cached_pages': 4}}
+
     class FakeController:
         pass
 
@@ -935,4 +939,8 @@ def test_controller_sync_payload_roundtrip(monkeypatch):
     data = json.loads(resp.body)
     assert data['ready_replica_urls'] == ['http://r1']
     assert data['replica_qos']['http://r1']['level'] == 2
+    # Prefix-cache occupancy rides the same sync (the LB turns it into
+    # skyt_lb_replica_prefix_cache{replica} — ROADMAP item 2 groundwork).
+    assert data['replica_prefix_cache']['http://r1']['occupancy'] == \
+        0.25
     assert len(ctl.autoscaler._shed_ts) == 1  # pylint: disable=protected-access

@@ -39,11 +39,13 @@ Checks:
     (docs/robustness.md "Control plane"). `# noqa` for deliberate
     exceptions.
   * direct `time.time()` / `time.monotonic()` (and perf_counter)
-    calls in serve/slo.py and utils/timeseries.py — those modules take
-    INJECTABLE clocks so SLO burn-rate math replays deterministically
-    in tests (docs/observability.md "Fleet plane"); a stray wall-clock
-    call would fork the timeline. Referencing `time.time` as a default
-    clock argument is fine — only calls flag. `# noqa` escape hatch.
+    calls in serve/slo.py, utils/timeseries.py, train/heartbeat.py and
+    train/watchdog.py — those modules take INJECTABLE clocks so SLO
+    burn-rate math and the gang watchdog's hang/straggler truth table
+    replay deterministically in tests (docs/observability.md); a stray
+    wall-clock call would fork the timeline. Referencing `time.time`
+    as a default clock argument is fine — only calls flag. `# noqa`
+    escape hatch.
 
 Exit 0 = clean. Used by format.sh and tests/test_lint.py.
 """
@@ -188,13 +190,16 @@ def _sqlite_connect_issues(path: Path, lines):
     return issues
 
 
-# Clock discipline (docs/observability.md "Fleet plane"): these files
-# implement windowed SLO/burn-rate math that tests replay under fake
-# clocks — every timestamp must come through the injected clock, so a
-# direct wall-clock CALL is a determinism bug. Default arguments like
+# Clock discipline (docs/observability.md "Fleet plane" + "Training
+# plane"): these files implement windowed SLO/burn-rate math and the
+# heartbeat/watchdog stall budgets that tests replay under fake clocks
+# — every timestamp must come through the injected clock, so a direct
+# wall-clock CALL is a determinism bug. Default arguments like
 # `clock=time.time` are references, not calls, and pass.
 _INJECTABLE_CLOCK_FILES = ('skypilot_tpu/serve/slo.py',
-                           'skypilot_tpu/utils/timeseries.py')
+                           'skypilot_tpu/utils/timeseries.py',
+                           'skypilot_tpu/train/heartbeat.py',
+                           'skypilot_tpu/train/watchdog.py')
 _CLOCK_CALL_NAMES = ('time', 'monotonic', 'perf_counter')
 
 

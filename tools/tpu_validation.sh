@@ -898,6 +898,23 @@ else
     FAIL=1
 fi
 
+echo "== 12. gang hang drill: one rank of a real 2-rank gang wedges"
+echo "   (SKYT_FAULTS=train.step=hang) — the head agent's watchdog"
+echo "   must confirm the hang, escalate the cluster job to HUNG,"
+echo "   every rank must dump a postmortem bundle (stacks + spans +"
+echo "   train state), and the managed-jobs controller must recover"
+echo "   to a checkpoint-resumed SUCCEEDED run. Runs on CPU by design:"
+echo "   the watchdog plane is host-side and must not need a chip =="
+if timeout 900 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_chaos.py::test_chaos_gang_hang_watchdog_recovery \
+        -q -p no:cacheprovider 2>&1 | tee "$OUT/gang_hang_drill.txt"
+then
+    echo "== gang hang drill: PASS =="
+else
+    echo "== gang hang drill: FAIL (see $OUT/gang_hang_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
