@@ -2,8 +2,10 @@
 # Lint + syntax + test gate (reference: format.sh running black/isort/
 # mypy/pylint + the unit/smoke test split, SURVEY §4). The image ships
 # none of those linters, so this runs the offline equivalents:
-# compileall (syntax across the tree) + tools/lint.py (unused imports,
-# whitespace, line length).
+# compileall (syntax across the tree) + tools/lint.py, the skyanalyze
+# CLI (tools/analysis — AST passes: the nine classic rules plus
+# lock-discipline, async-blocking, tracer-safety, env-registry, and
+# registry-consistency; docs/static_analysis.md). Exit-code gated.
 #
 # Test tiers:
 #   ./format.sh         fast tier: lint + non-heavy unit tests (<2 min)

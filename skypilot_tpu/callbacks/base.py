@@ -11,6 +11,8 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from skypilot_tpu.utils import env
+
 _DEFAULT_DIR = '~/.skyt/benchmarks'
 _FLUSH_INTERVAL_S = 2.0
 
@@ -18,7 +20,7 @@ _FLUSH_INTERVAL_S = 2.0
 def summary_path(benchmark_dir: Optional[str] = None) -> str:
     d = os.path.expanduser(
         benchmark_dir or
-        os.environ.get('SKYT_BENCHMARK_DIR', _DEFAULT_DIR))
+        env.get('SKYT_BENCHMARK_DIR', _DEFAULT_DIR))
     return os.path.join(d, 'summary.json')
 
 

@@ -29,15 +29,18 @@ class LazyDataFrame:
 
     @property
     def df(self) -> pd.DataFrame:
-        if self._df is None:
-            with self._lock:
-                if self._df is None:
-                    path = os.path.join(_CATALOG_DIR, f'{self._name}.csv')
-                    df = pd.read_csv(path)
-                    if self._post_process is not None:
-                        df = self._post_process(df)
-                    self._df = df
-        return self._df
+        # Lock-discipline fix (skyanalyze): the old double-checked
+        # fast path read self._df lock-free, racing invalidate();
+        # catalog lookups are client-side and rare, so the plain
+        # lock costs nothing measurable.
+        with self._lock:
+            if self._df is None:
+                path = os.path.join(_CATALOG_DIR, f'{self._name}.csv')
+                df = pd.read_csv(path)
+                if self._post_process is not None:
+                    df = self._post_process(df)
+                self._df = df
+            return self._df
 
     def invalidate(self) -> None:
         with self._lock:

@@ -11,6 +11,7 @@ import urllib.parse
 from typing import Tuple
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import env
 
 CLOUD_SCHEMES = ('gs', 's3', 'az', 'r2', 'cos', 'local')
 # Schemes we can *download from* on a remote host but not manage as stores.
@@ -47,9 +48,9 @@ def verify_bucket_name(name: str) -> None:
 
 def local_store_root() -> str:
     """Root directory that backs ``local://`` buckets (offline store)."""
-    root = os.environ.get(
+    root = env.get(
         'SKYT_LOCAL_STORAGE_ROOT',
-        os.path.join(os.environ.get('SKYT_LOCAL_ROOT',
-                                    os.path.expanduser('~/.skyt_local')),
+        os.path.join(env.get('SKYT_LOCAL_ROOT',
+                             os.path.expanduser('~/.skyt_local')),
                      '_storage'))
     return os.path.abspath(os.path.expanduser(root))

@@ -24,6 +24,7 @@ from skypilot_tpu.data import data_utils
 from skypilot_tpu.data import mounting_utils
 from skypilot_tpu.data import storage_utils
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -293,7 +294,7 @@ class AzureBlobStore(AbstractStore):
 
     @staticmethod
     def account() -> str:
-        acct = os.environ.get('SKYT_AZURE_STORAGE_ACCOUNT', '')
+        acct = env.get('SKYT_AZURE_STORAGE_ACCOUNT', '')
         if not acct:
             raise exceptions.StorageError(
                 'Azure storage needs SKYT_AZURE_STORAGE_ACCOUNT in the '
@@ -387,7 +388,7 @@ class R2Store(S3Store):
 
     @staticmethod
     def endpoint() -> str:
-        ep = os.environ.get('SKYT_R2_ENDPOINT',
+        ep = env.get('SKYT_R2_ENDPOINT',
                             os.environ.get('R2_ENDPOINT', ''))
         if not ep:
             raise exceptions.StorageError(
@@ -413,7 +414,7 @@ class IbmCosStore(S3Store):
 
     @staticmethod
     def endpoint() -> str:
-        ep = os.environ.get('SKYT_COS_ENDPOINT',
+        ep = env.get('SKYT_COS_ENDPOINT',
                             os.environ.get('COS_ENDPOINT', ''))
         if not ep:
             raise exceptions.StorageError(
@@ -498,7 +499,7 @@ def default_store_type() -> StoreType:
     config `storage.default_store` > GCS. The local provider / test
     harness sets `local` so no cloud CLI is ever invoked offline."""
     from skypilot_tpu import skyt_config
-    name = os.environ.get(
+    name = env.get(
         'SKYT_DEFAULT_STORE',
         skyt_config.get_nested(('storage', 'default_store'), 'gcs'))
     return StoreType(str(name).upper())

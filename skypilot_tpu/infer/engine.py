@@ -21,7 +21,6 @@ distribution) requests.
 import contextlib
 import dataclasses
 import itertools
-import os
 import queue
 import threading
 import time
@@ -36,6 +35,7 @@ from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -608,7 +608,7 @@ class InferenceEngine:
         # interactive arrival prefills immediately instead of waiting
         # out a batch decode. 0 (default) = no reservation.
         self._qos_reserved = 0
-        if os.environ.get('SKYT_QOS', '0') not in ('', '0', 'false'):
+        if env.get('SKYT_QOS', '0') not in ('', '0', 'false'):
             from skypilot_tpu.serve import qos as qos_lib
             self._qos_queue = qos_lib.ClassedRequestQueue(
                 meta=lambda r: qos_lib.RequestMeta(
@@ -618,12 +618,9 @@ class InferenceEngine:
                                + r.params.max_new_tokens),
                     seq=r.req_id, enq_t=r.submitted_at))
             self._waiting: 'queue.Queue[_Request]' = self._qos_queue
-            try:
-                self._qos_reserved = max(0, min(num_slots - 1, int(
-                    os.environ.get('SKYT_QOS_RESERVE_SLOTS', '0')
-                    or 0)))
-            except ValueError:
-                self._qos_reserved = 0
+            self._qos_reserved = max(0, min(
+                num_slots - 1,
+                env.get_int('SKYT_QOS_RESERVE_SLOTS', 0)))
         else:
             self._waiting = queue.Queue()
         # Last scheduled order broadcast to lockstep followers (seq

@@ -65,6 +65,7 @@ from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing as tracing_lib
+from skypilot_tpu.utils import env as env_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -446,7 +447,7 @@ class InferenceServer:
         model runs, so reachability alone must not expose it — and
         single-flight (409 while one is in progress). On CPU the host
         trace is degraded but real."""
-        if os.environ.get('SKYT_PROFILE_REMOTE', '0') not in \
+        if env_lib.get('SKYT_PROFILE_REMOTE', '0') not in \
                 ('1', 'true'):
             return web.json_response(
                 {'error': 'remote profiling disabled; start the '
@@ -1564,7 +1565,7 @@ def main(argv=None) -> None:
     lockstep = None
     if args.multihost == 'on' or (
             args.multihost == 'auto' and
-            int(os.environ.get('SKYT_NUM_NODES', '1')) > 1):
+            env_lib.get_int('SKYT_NUM_NODES', 1) > 1):
         # Same bootstrap as a training gang (runtime/gang.py env
         # triplet): the replica's hosts form one jax.distributed
         # runtime; jax.devices() is global from here on, so --tp counts

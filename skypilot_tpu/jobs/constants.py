@@ -1,18 +1,18 @@
 """Managed-jobs constants. Reference: sky/jobs/constants.py."""
-import os
+from skypilot_tpu.utils import env
 
 # Poll gap of the controller watch loop (reference:
 # sky/jobs/controller.py JOB_STATUS_CHECK_GAP_SECONDS = 20); env-tunable
 # so the offline test harness can run recovery scenarios in seconds.
 def status_check_gap_seconds() -> float:
-    return float(os.environ.get('SKYT_JOBS_CHECK_GAP', '20'))
+    return env.get_float('SKYT_JOBS_CHECK_GAP', 20)
 
 
 # Grace period before a non-terminal, unreachable cluster is declared
 # preempted (reference: sky/jobs/controller.py:240-270 forces a cloud
 # status query after the job status probe fails).
 def preemption_grace_seconds() -> float:
-    return float(os.environ.get('SKYT_JOBS_PREEMPTION_GRACE', '30'))
+    return env.get_float('SKYT_JOBS_PREEMPTION_GRACE', 30)
 
 
 JOBS_CLUSTER_NAME_PREFIX = '{name}-{job_id}'

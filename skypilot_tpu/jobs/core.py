@@ -24,6 +24,7 @@ from skypilot_tpu.jobs import constants
 from skypilot_tpu.jobs import controller as controller_lib
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env as env_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -74,7 +75,7 @@ def launch(entrypoint: Union[Any, 'list'],
 
     if controller is None:
         from skypilot_tpu import skyt_config
-        controller = os.environ.get(
+        controller = env_lib.get(
             'SKYT_JOBS_CONTROLLER',
             skyt_config.get_nested(('jobs', 'controller', 'mode'),
                                    'process'))

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.ops import attention as attention_ops
 from skypilot_tpu.ops import norms, rope
+from skypilot_tpu.utils import env as _env
 
 
 @dataclasses.dataclass(frozen=True)
@@ -430,7 +431,6 @@ class LlamaAttention(nn.Module):
                 # page indirection lives HERE so at most one layer's KV
                 # is ever materialized contiguously (infer/paged_cache.py
                 # holds the pool accounting).
-                import os as _os
 
                 from skypilot_tpu.infer.paged_cache import PagePool
                 k_pool, v_pool, tables = cache
@@ -462,7 +462,7 @@ class LlamaAttention(nn.Module):
                                              window_active)
 
                 if s == 1 and not cfg.needs_xla_attention and \
-                        _os.environ.get(
+                        _env.get(
                             'SKYT_PAGED_ATTN', 'pallas') == 'pallas':
                     # Pallas kernel DMAs each slot's pages directly
                     # (no materialized contiguous view; escape hatch:
@@ -483,7 +483,7 @@ class LlamaAttention(nn.Module):
                         ('xla', _xla_gather),
                     ])
                 elif s > 1 and not cfg.needs_xla_attention and \
-                        _os.environ.get(
+                        _env.get(
                             'SKYT_SPEC_PAGED_ATTN',
                             'pallas') == 'pallas':
                     # Multi-query kernel for the speculative verify

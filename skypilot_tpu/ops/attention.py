@@ -6,13 +6,13 @@ forward/backward. GQA (grouped KV heads) handled by logical head repeat
 folded into the einsum — no materialized K/V repeat.
 """
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.ops import dispatch
+from skypilot_tpu.utils import env
 
 NEG_INF = -1e9  # logits are f32 until softmax, so -1e9 never overflows
 
@@ -212,7 +212,7 @@ def _resolve_impl(q, k, impl: str, window: int, window_active,
     if impl != 'auto':
         return impl
     window_flash = (window > 0 and window_active is None and
-                    os.environ.get('SKYT_WINDOW_FLASH', 'off') == 'on')
+                    env.get('SKYT_WINDOW_FLASH', 'off') == 'on')
     auto_xla = flash_unsupported or (window > 0 and not window_flash)
     return ('flash' if not auto_xla and _flash_ok(q, k, has_seg)
             else 'xla')

@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from skypilot_tpu.ops import dispatch
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -55,11 +56,11 @@ _FLASH_CANDIDATE_BLOCKS = (128, 256, 512)
 
 
 def enabled() -> bool:
-    return os.environ.get(_ENV_ENABLE, '0') == '1'
+    return env.get(_ENV_ENABLE, '0') == '1'
 
 
 def cache_path() -> str:
-    return os.environ.get(_ENV_CACHE) or os.path.expanduser(
+    return env.get(_ENV_CACHE) or os.path.expanduser(
         '~/.cache/skypilot_tpu/autotune.json')
 
 
@@ -84,7 +85,7 @@ class AutotuneCache:
         self._lock = threading.Lock()
         self._entries: Optional[Dict[str, Dict[str, Any]]] = None
 
-    def _load_locked(self) -> Dict[str, Dict[str, Any]]:
+    def _load_locked(self) -> Dict[str, Dict[str, Any]]:  # guarded-by: _lock
         if self._entries is not None:
             return self._entries
         entries: Dict[str, Dict[str, Any]] = {}
@@ -205,7 +206,7 @@ def sweep(op: str, key: str, candidates: Sequence[Any],
     if hit is not None:
         _hits().labels(op).inc()
         return hit
-    repeats = max(1, int(os.environ.get(_ENV_REPEATS, '3') or 3))
+    repeats = env.get_int(_ENV_REPEATS, 3, minimum=1)
     _sweeps().labels(op).inc()
     best: Optional[Tuple[float, Any]] = None
     for cand in candidates:

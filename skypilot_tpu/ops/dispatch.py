@@ -37,12 +37,12 @@ that is exactly why rung selection is static-validation-first: a rung
 is only offered if its block specs pass the mirrored legality rule.
 """
 import math
-import os
 import threading
 from typing import Any, Callable, Dict, List, Tuple
 
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -59,7 +59,7 @@ _SUBLANE_BY_ITEMSIZE = {4: 8, 2: 16, 1: 32}
 # (and as invisible to a trace-time try/except). v5e has 16MB less
 # scratch overheads.
 VMEM_BUDGET_BYTES = int(
-    os.environ.get('SKYT_OPS_VMEM_BUDGET', str(12 * 1024 * 1024)))
+    env.get('SKYT_OPS_VMEM_BUDGET', str(12 * 1024 * 1024)))
 
 _ENV_FORCE = 'SKYT_OPS_FORCE_PATH'
 
@@ -177,7 +177,7 @@ def run_ladder(op: str,
     """
     if not rungs:
         raise ValueError(f'ops.{op}: empty dispatch ladder')
-    forced = os.environ.get(_ENV_FORCE, '')
+    forced = env.get(_ENV_FORCE, '')
     if forced and len(rungs) > 1:
         kept = [r for r in rungs if r[0] == forced]
         if kept:

@@ -23,7 +23,6 @@ Kernel conventions follow /opt/skills/guides/pallas_guide.md (block
 specs, scratch via pl.pallas_call scratch_shapes, MXU-aligned tiles).
 """
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -32,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from skypilot_tpu.ops import dispatch
+from skypilot_tpu.utils import env
 
 # jax renamed TPUCompilerParams -> CompilerParams (~0.5); support both
 # so the kernels work on whichever jax the image ships.
@@ -53,7 +53,7 @@ def _bwd_impl_choice() -> str:
     path recomputes reference attention under custom_vjp (the round-1
     behavior); the escape hatch exists so a pathological kernel compile
     can never take down a training run."""
-    return os.environ.get('SKYT_FLASH_BWD', 'pallas')
+    return env.get('SKYT_FLASH_BWD', 'pallas')
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256

@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from skypilot_tpu.utils import env
 
 NEG_INF = -1e30
 
@@ -68,11 +69,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     SKYT_RING_IMPL=xla overrides globally.
     """
     assert causal, 'non-causal ring attention not yet wired'
-    import os
     b, sq, hq, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     if impl == 'auto':
-        impl = 'xla' if os.environ.get('SKYT_RING_IMPL') == 'xla' \
+        impl = 'xla' if env.get('SKYT_RING_IMPL') == 'xla' \
             else 'flash'
     flash_ok = (d in (64, 128, 256) and sq % 128 == 0 and
                 (sq <= 256 or sq % 256 == 0))

@@ -25,6 +25,7 @@ import requests
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -38,7 +39,7 @@ _token_cache: Dict[str, Any] = {'token': None, 'expiry': 0.0}
 def access_token() -> str:
     # Env token first (documented order; also keeps test fakes immune to a
     # previously-cached real token).
-    env_token = os.environ.get('SKYT_GCP_TOKEN')
+    env_token = env.get('SKYT_GCP_TOKEN')
     if env_token:
         return env_token
     now = time.time()
@@ -67,7 +68,7 @@ def access_token() -> str:
 
 
 def default_project() -> Optional[str]:
-    proj = os.environ.get('SKYT_GCP_PROJECT') or os.environ.get(
+    proj = env.get('SKYT_GCP_PROJECT') or os.environ.get(
         'GOOGLE_CLOUD_PROJECT')
     if proj:
         return proj

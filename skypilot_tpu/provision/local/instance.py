@@ -24,12 +24,13 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env as env_lib
 
 logger = log_utils.init_logger(__name__)
 
 
 def local_root() -> str:
-    d = os.environ.get('SKYT_LOCAL_ROOT',
+    d = env_lib.get('SKYT_LOCAL_ROOT',
                        os.path.expanduser('~/.skyt_local'))
     os.makedirs(d, exist_ok=True)
     return d

@@ -26,6 +26,7 @@ from skypilot_tpu.runtime import log_lib
 from skypilot_tpu.runtime import server as server_lib
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import env as env_lib
 
 logger = log_utils.init_logger(__name__)
 
@@ -36,10 +37,7 @@ EVENT_INTERVAL_S = 20  # reference: sky/skylet/events.py:26
 def _watchdog_interval_s() -> float:
     """Head-side gang-watchdog evaluation cadence (must be finer than
     the 20s event loop: a hang verdict's latency floor is this tick)."""
-    try:
-        return float(os.environ.get('SKYT_WATCHDOG_INTERVAL_S', '') or 2.0)
-    except ValueError:
-        return 2.0
+    return env_lib.get_float('SKYT_WATCHDOG_INTERVAL_S', 2.0)
 
 
 def _heartbeat_path(job_id: int, rank: int) -> str:

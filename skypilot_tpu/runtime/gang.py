@@ -14,6 +14,7 @@ aliases are kept so reference recipes run unmodified.
 import os
 import time
 from typing import Any, Dict, List, Optional
+from skypilot_tpu.utils import env
 
 DEFAULT_COORDINATOR_PORT = 8476
 
@@ -110,7 +111,7 @@ def initialize_jax_distributed() -> None:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=int(n),
                                    process_id=int(pid))
-    elif int(os.environ.get('SKYT_NUM_NODES', '1')) > 1:
+    elif env.get_int('SKYT_NUM_NODES', 1) > 1:
         jax.distributed.initialize()   # TPU-metadata/Slurm detection
 
 

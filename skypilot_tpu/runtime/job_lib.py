@@ -20,10 +20,11 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import sqlite_utils
+from skypilot_tpu.utils import env
 
 
 def agent_home() -> str:
-    return os.path.expanduser(os.environ.get('SKYT_AGENT_HOME', '~'))
+    return os.path.expanduser(env.get('SKYT_AGENT_HOME', '~'))
 
 
 def skyt_dir() -> str:

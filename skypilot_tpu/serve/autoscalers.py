@@ -8,7 +8,6 @@ on-demand base + spot overflow).
 """
 import dataclasses
 import math
-import os
 import time
 from typing import Dict, List, Optional
 
@@ -16,6 +15,7 @@ from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -29,11 +29,8 @@ def _ts_cap() -> int:
     that stops evaluating (or an LB flooding it) must not grow the
     buffer without bound. Drop-oldest — recent timestamps drive the
     decisions."""
-    try:
-        return max(1, int(os.environ.get(
-            'SKYT_AUTOSCALER_MAX_TIMESTAMPS', '') or 16384))
-    except ValueError:
-        return 16384
+    return env.get_int('SKYT_AUTOSCALER_MAX_TIMESTAMPS', 16384,
+                       minimum=1)
 
 
 @dataclasses.dataclass

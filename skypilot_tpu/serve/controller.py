@@ -25,18 +25,18 @@ from skypilot_tpu.utils import metrics as metrics_lib
 
 logger = log_utils.init_logger(__name__)
 
-import os
+from skypilot_tpu.utils import env
 
 def _loop_interval() -> float:
-    return float(os.environ.get('SKYT_SERVE_CONTROLLER_INTERVAL', '2'))
+    return env.get_float('SKYT_SERVE_CONTROLLER_INTERVAL', 2)
 
 
 def _state_prune_interval() -> float:
-    return float(os.environ.get('SKYT_SERVE_STATE_PRUNE_S', '600'))
+    return env.get_float('SKYT_SERVE_STATE_PRUNE_S', 600)
 
 
 def _state_terminal_ttl() -> float:
-    return float(os.environ.get('SKYT_SERVE_STATE_TTL_S', '3600'))
+    return env.get_float('SKYT_SERVE_STATE_TTL_S', 3600)
 
 
 class SkyServeController:

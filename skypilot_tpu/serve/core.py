@@ -20,6 +20,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import state as cluster_state
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -76,7 +77,7 @@ def up(task: Any, service_name: Optional[str] = None,
             'Service task needs a `run` command.')
     if controller is None:
         from skypilot_tpu import skyt_config
-        controller = os.environ.get(
+        controller = env.get(
             'SKYT_SERVE_CONTROLLER',
             skyt_config.get_nested(('serve', 'controller', 'mode'),
                                    'process'))

@@ -20,7 +20,6 @@ timeout. A replica whose scrapes keep failing simply AGES OUT of the
 aggregates after ``SKYT_FLEET_STALE_S`` (stale fleet state is worse
 than honest absence), and comes back on the next successful scrape.
 """
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -30,6 +29,7 @@ from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import timeseries as ts_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -37,14 +37,7 @@ logger = log_utils.init_logger(__name__)
 def enabled() -> bool:
     """Master switch (default ON — the scrape cost is one bounded GET
     per replica per SKYT_FLEET_SCRAPE_S, entirely off the serve path)."""
-    return os.environ.get('SKYT_FLEET', '1') not in ('', '0', 'false')
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, '') or default)
-    except ValueError:
-        return default
+    return env.get('SKYT_FLEET', '1') not in ('', '0', 'false')
 
 
 def _default_http_get(url: str, timeout: float) -> str:
@@ -71,10 +64,10 @@ class FleetTelemetry:
         self._stores: Dict[str, ts_lib.TimeSeriesStore] = {}
         self._last_attempt: Dict[str, float] = {}
         self._last_ok: Dict[str, float] = {}
-        self.scrape_interval_s = _env_float('SKYT_FLEET_SCRAPE_S', 10.0)
-        self.scrape_timeout_s = _env_float('SKYT_FLEET_SCRAPE_TIMEOUT_S',
+        self.scrape_interval_s = env.get_float('SKYT_FLEET_SCRAPE_S', 10.0)
+        self.scrape_timeout_s = env.get_float('SKYT_FLEET_SCRAPE_TIMEOUT_S',
                                            2.0)
-        self.stale_s = _env_float('SKYT_FLEET_STALE_S', 60.0)
+        self.stale_s = env.get_float('SKYT_FLEET_STALE_S', 60.0)
         reg = metrics_registry or metrics_lib.REGISTRY
         self._m_scrapes = reg.counter(
             'skyt_fleet_scrapes_total',

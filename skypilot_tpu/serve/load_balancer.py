@@ -58,6 +58,7 @@ from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing as tracing_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -92,19 +93,12 @@ async def _to_client(coro) -> None:
         raise _ClientGone(repr(e)) from e
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, '') or default)
-    except ValueError:
-        return default
-
-
 def _sync_interval() -> float:
-    return _env_float('SKYT_SERVE_LB_SYNC_INTERVAL', 2.0)
+    return env.get_float('SKYT_SERVE_LB_SYNC_INTERVAL', 2.0)
 
 
 def _stale_ttl() -> float:
-    return _env_float('SKYT_LB_STALE_TTL_S', 300.0)
+    return env.get_float('SKYT_LB_STALE_TTL_S', 300.0)
 
 
 @dataclasses.dataclass
@@ -175,7 +169,7 @@ class LeaderLease:
                  ) -> None:
         self.path = path
         self.interval_s = interval_s if interval_s is not None else \
-            _env_float('SKYT_LB_LEASE_INTERVAL_S', 1.0)
+            env.get_float('SKYT_LB_LEASE_INTERVAL_S', 1.0)
         self._fd: Optional[int] = None
 
     def try_acquire(self) -> bool:
@@ -409,8 +403,8 @@ class SkyServeLoadBalancer:
             'Requests whose client disconnected mid-proxy (not '
             'counted as replica failures)')
         self.breaker = CircuitBreaker(
-            threshold=int(_env_float('SKYT_LB_BREAKER_THRESHOLD', 3)),
-            cooldown_s=_env_float('SKYT_LB_BREAKER_COOLDOWN_S', 2.0),
+            threshold=env.get_int('SKYT_LB_BREAKER_THRESHOLD', 3),
+            cooldown_s=env.get_float('SKYT_LB_BREAKER_COOLDOWN_S', 2.0),
             registry=reg)
         # Bearer token for the controller's authenticated admin API.
         self._controller_headers = (
@@ -487,7 +481,7 @@ class SkyServeLoadBalancer:
         controller unreachable the old code re-queued forever and the
         buffer grew without bound. Drop OLDEST beyond the cap — recent
         timestamps drive autoscaling decisions — and count drops."""
-        cap = int(_env_float('SKYT_LB_MAX_PENDING_TIMESTAMPS', 16384))
+        cap = env.get_int('SKYT_LB_MAX_PENDING_TIMESTAMPS', 16384)
         for buf in (self.request_timestamps, self._qos_demand,
                     self._qos_sheds):
             over = len(buf) - max(cap, 1)
@@ -626,15 +620,15 @@ class SkyServeLoadBalancer:
         snapshot is served untouched — unknown probes would prune
         healthy replicas that simply 404 an uncontracted path."""
         candidates = list(self.state.ready_replicas)
-        path = os.environ.get('SKYT_LB_STALE_PROBE_PATH') or \
+        path = env.get('SKYT_LB_STALE_PROBE_PATH') or \
             self._stale_probe_path
         if not candidates or self._session is None or path is None:
             return
-        timeout = aiohttp.ClientTimeout(total=_env_float(
+        timeout = aiohttp.ClientTimeout(total=env.get_float(
             'SKYT_LB_STALE_PROBE_TIMEOUT_S',
             self._stale_probe_timeout_s or 2.0))
         threshold = max(
-            1, int(_env_float('SKYT_LB_STALE_PROBE_THRESHOLD', 3)))
+            1, env.get_int('SKYT_LB_STALE_PROBE_THRESHOLD', 3))
 
         async def probe(replica: str) -> bool:
             try:
@@ -696,7 +690,7 @@ class SkyServeLoadBalancer:
         """Absolute monotonic deadline for this request's pick+retry
         budget: the client's X-Request-Deadline (seconds) when present
         and well-formed, else SKYT_LB_RETRY_BUDGET_S (default 60)."""
-        budget = _env_float('SKYT_LB_RETRY_BUDGET_S', 60.0)
+        budget = env.get_float('SKYT_LB_RETRY_BUDGET_S', 60.0)
         hdr = request.headers.get('X-Request-Deadline')
         if hdr:
             try:
@@ -774,7 +768,7 @@ class SkyServeLoadBalancer:
         cools down would turn one dead replica into minute-long client
         hangs. Polling is only for the genuinely-empty ready set (a
         service still starting up)."""
-        poll = max(_env_float('SKYT_LB_NO_REPLICA_POLL_S', 1.0), 0.01)
+        poll = max(env.get_float('SKYT_LB_NO_REPLICA_POLL_S', 1.0), 0.01)
         while True:
             replica = self._pick_replica_once(tried, qos_avoid)
             if replica is not None:
@@ -826,8 +820,8 @@ class SkyServeLoadBalancer:
         # in bounded time even under a generous retry budget.
         no_replica_deadline = min(
             deadline, time.monotonic() +
-            _env_float('SKYT_LB_NO_REPLICA_TIMEOUT_S', 30.0))
-        backoff = max(_env_float('SKYT_LB_RETRY_BACKOFF_S', 0.05), 0.001)
+            env.get_float('SKYT_LB_NO_REPLICA_TIMEOUT_S', 30.0))
+        backoff = max(env.get_float('SKYT_LB_RETRY_BACKOFF_S', 0.05), 0.001)
         tried: Set[str] = set()
         attempt = 0
         last_err: Optional[BaseException] = None
@@ -943,10 +937,10 @@ class SkyServeLoadBalancer:
         hardwired to None). total=0 keeps 'unlimited' — correct for
         long token streams; deployments that want a hard cap set
         SKYT_LB_UPSTREAM_TOTAL_S."""
-        total = _env_float('SKYT_LB_UPSTREAM_TOTAL_S', 0.0)
+        total = env.get_float('SKYT_LB_UPSTREAM_TOTAL_S', 0.0)
         return aiohttp.ClientTimeout(
             total=total if total > 0 else None,
-            sock_connect=_env_float('SKYT_LB_UPSTREAM_CONNECT_S', 10.0))
+            sock_connect=env.get_float('SKYT_LB_UPSTREAM_CONNECT_S', 10.0))
 
     async def _proxy_to(
             self, request: web.Request, replica: str, body: bytes,
@@ -1146,7 +1140,7 @@ async def serve_as_leader(lb: 'SkyServeLoadBalancer', lease: LeaderLease,
     runner = web.AppRunner(lb.make_app())
     await runner.setup()
     deadline = time.monotonic() + \
-        _env_float('SKYT_LB_TAKEOVER_BIND_TIMEOUT_S', 30.0)
+        env.get_float('SKYT_LB_TAKEOVER_BIND_TIMEOUT_S', 30.0)
     while True:
         try:
             await web.TCPSite(runner, host, lb.port,

@@ -40,7 +40,6 @@ by the overload controller):
 import collections
 import dataclasses
 import math
-import os
 import queue
 import threading
 import time
@@ -50,6 +49,7 @@ from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -79,14 +79,7 @@ def enabled() -> bool:
     (the engine keeps its plain FIFO queue, the server never consults
     the admission controller). Read at engine/server CONSTRUCTION —
     the waiting-queue type cannot change under a live engine."""
-    return os.environ.get('SKYT_QOS', '0') not in ('', '0', 'false')
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, '') or default)
-    except ValueError:
-        return default
+    return env.get('SKYT_QOS', '0') not in ('', '0', 'false')
 
 
 # ------------------------------------------------------- header parsing
@@ -211,7 +204,7 @@ def _class_weights() -> Dict[str, float]:
     quantum multiplier per class (matters only when aging lands two
     classes in the same band). Malformed entries fall back."""
     out = {'interactive': 8.0, 'standard': 4.0, 'batch': 1.0}
-    raw = os.environ.get('SKYT_QOS_WEIGHTS', '')
+    raw = env.get('SKYT_QOS_WEIGHTS', '')
     for part in (p for p in raw.split(',') if p.strip()):
         k, sep, v = part.partition(':')
         try:
@@ -244,10 +237,10 @@ class FairQueue:
                  weights: Optional[Dict[str, float]] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.quantum = (quantum if quantum is not None
-                        else _env_float('SKYT_QOS_QUANTUM', 256.0))
+                        else env.get_float('SKYT_QOS_QUANTUM', 256.0))
         self.quantum = max(self.quantum, 0.001)
         self.aging_s = (aging_s if aging_s is not None
-                        else _env_float('SKYT_QOS_AGING_S', 30.0))
+                        else env.get_float('SKYT_QOS_AGING_S', 30.0))
         self.aging_s = max(self.aging_s, 0.001)
         self.weights = dict(weights or _class_weights())
         self._clock = clock
@@ -383,12 +376,12 @@ class ClassedRequestQueue(queue.Queue):
         super().__init__()
         self._meta = meta
         self._quantum = (quantum if quantum is not None
-                         else _env_float('SKYT_QOS_QUANTUM', 256.0))
+                         else env.get_float('SKYT_QOS_QUANTUM', 256.0))
         self._aging_s = (aging_s if aging_s is not None
-                         else _env_float('SKYT_QOS_AGING_S', 30.0))
+                         else env.get_float('SKYT_QOS_AGING_S', 30.0))
         self._weights = dict(weights or _class_weights())
         self._halflife = (debt_halflife_s if debt_halflife_s is not None
-                          else _env_float('SKYT_QOS_DEBT_HALFLIFE_S',
+                          else env.get_float('SKYT_QOS_DEBT_HALFLIFE_S',
                                           30.0))
         self._clock = clock
         self._debt: Dict[tuple, float] = {}
@@ -483,21 +476,21 @@ class OverloadController:
                  clock: Callable[[], float] = time.monotonic) -> None:
         self._signals = signals
         self._clock = clock
-        self.queue_degrade = _env_float('SKYT_QOS_QUEUE_DEGRADE', 4.0)
-        self.queue_shed = _env_float('SKYT_QOS_QUEUE_SHED', 8.0)
-        self.kv_degrade = _env_float('SKYT_QOS_KV_DEGRADE', 0.90)
-        self.kv_shed = _env_float('SKYT_QOS_KV_SHED', 0.97)
-        self.ttft_slo_s = _env_float('SKYT_QOS_TTFT_SLO_MS', 500.0) / 1e3
-        self.hold_s = _env_float('SKYT_QOS_HOLD_S', 2.0)
-        self.refresh_s = _env_float('SKYT_QOS_REFRESH_S', 0.25)
-        self.retry_base_s = _env_float('SKYT_QOS_RETRY_AFTER_S', 1.0)
+        self.queue_degrade = env.get_float('SKYT_QOS_QUEUE_DEGRADE', 4.0)
+        self.queue_shed = env.get_float('SKYT_QOS_QUEUE_SHED', 8.0)
+        self.kv_degrade = env.get_float('SKYT_QOS_KV_DEGRADE', 0.90)
+        self.kv_shed = env.get_float('SKYT_QOS_KV_SHED', 0.97)
+        self.ttft_slo_s = env.get_float('SKYT_QOS_TTFT_SLO_MS', 500.0) / 1e3
+        self.hold_s = env.get_float('SKYT_QOS_HOLD_S', 2.0)
+        self.refresh_s = env.get_float('SKYT_QOS_REFRESH_S', 0.25)
+        self.retry_base_s = env.get_float('SKYT_QOS_RETRY_AFTER_S', 1.0)
         self._lock = threading.Lock()
         self._level = 0
         self._below_since: Optional[float] = None
         self._next_refresh = 0.0
         self._pressure = 0.0
 
-    def _raw_level(self, sig: Dict[str, float]) -> int:
+    def _raw_level(self, sig: Dict[str, float]) -> int:  # guarded-by: _lock
         level = 0
         q = float(sig.get('queue_depth', 0) or 0)
         slots = max(1.0, float(sig.get('num_slots', 1) or 1))
@@ -555,11 +548,19 @@ class OverloadController:
 
     @property
     def pressure(self) -> float:
-        return self._pressure
+        # Lock-discipline fix (skyanalyze): _pressure is written by
+        # level() under _lock from the engine loop while the HTTP
+        # handlers read it here — take the lock for a torn-free read.
+        with self._lock:
+            return self._pressure
 
     def retry_after(self, level: Optional[int] = None) -> float:
-        lvl = self._level if level is None else level
-        return min(30.0, self.retry_base_s * (2 ** max(0, lvl - 1)))
+        if level is None:
+            # Lock-discipline fix (skyanalyze): the no-arg fallback
+            # read raced level()'s writes from other threads.
+            with self._lock:
+                level = self._level
+        return min(30.0, self.retry_base_s * (2 ** max(0, level - 1)))
 
 
 @dataclasses.dataclass
@@ -582,12 +583,12 @@ class ServerQoS:
                  clock: Callable[[], float] = time.monotonic) -> None:
         reg = registry or metrics_lib.REGISTRY
         self.overload = OverloadController(signals, clock=clock)
-        rate = _env_float('SKYT_QOS_TENANT_RPS', 0.0)
-        burst = _env_float('SKYT_QOS_TENANT_BURST',
+        rate = env.get_float('SKYT_QOS_TENANT_RPS', 0.0)
+        burst = env.get_float('SKYT_QOS_TENANT_BURST',
                            max(10.0, 2 * rate))
         self.limiter = TenantRateLimiter(rate, burst, clock=clock)
         self.degrade_max_tokens = int(
-            _env_float('SKYT_QOS_DEGRADE_MAX_TOKENS', 32))
+            env.get_float('SKYT_QOS_DEGRADE_MAX_TOKENS', 32))
         self._m_requests = reg.counter(
             'skyt_qos_requests_total',
             'Requests through QoS admission', ('class',))
@@ -698,7 +699,7 @@ def autoscale_class_weights() -> Dict[str, float]:
     Batch demand is deliberately discounted: it tolerates queueing, so
     it should not force scale-ups the way interactive demand does."""
     out = {'interactive': 1.0, 'standard': 1.0, 'batch': 0.25}
-    raw = os.environ.get('SKYT_QOS_AUTOSCALE_WEIGHTS', '')
+    raw = env.get('SKYT_QOS_AUTOSCALE_WEIGHTS', '')
     for part in (p for p in raw.split(',') if p.strip()):
         k, sep, v = part.partition(':')
         try:

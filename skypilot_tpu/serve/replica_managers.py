@@ -13,7 +13,6 @@ preemption detection leans on the provider query (a preempted TPU
 queued-resource is *deleted*, so a missing cluster record == preempted).
 """
 import dataclasses
-import os
 import threading
 import time
 from typing import Dict, List, Optional
@@ -27,6 +26,7 @@ from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -41,13 +41,12 @@ def _drain_grace_seconds() -> float:
     """Grace period a deliberately retired READY replica gets between
     leaving the ready set (the LB stops routing to it at the next
     sync) and the actual teardown, so in-flight requests finish."""
-    return float(os.environ.get('SKYT_SERVE_DRAIN_GRACE_S', '10'))
+    return env.get_float('SKYT_SERVE_DRAIN_GRACE_S', 10)
 
 
 def _relaunch_backoff_bounds() -> 'tuple[float, float]':
-    return (float(os.environ.get('SKYT_SERVE_RELAUNCH_BACKOFF_S', '5')),
-            float(os.environ.get('SKYT_SERVE_RELAUNCH_BACKOFF_MAX_S',
-                                 '120')))
+    return (env.get_float('SKYT_SERVE_RELAUNCH_BACKOFF_S', 5),
+            env.get_float('SKYT_SERVE_RELAUNCH_BACKOFF_MAX_S', 120))
 
 
 @dataclasses.dataclass
@@ -286,8 +285,8 @@ class ReplicaManager:
         # — a single timed-out probe must not cost a healthy replica
         # (the steady-state prober tolerates FAILED_THRESHOLD=10
         # consecutive failures for the same condition).
-        attempts = max(1, int(os.environ.get(
-            'SKYT_SERVE_ADOPT_PROBE_RETRIES', '3') or 3))
+        attempts = env.get_int('SKYT_SERVE_ADOPT_PROBE_RETRIES', 3,
+                               minimum=1)
         for i in range(attempts):
             if self._probe_one(info):
                 return None

@@ -30,7 +30,6 @@ enforces it) — every clock is injected, so the burn-rate truth table
 in tests/test_slo.py replays deterministically.
 """
 import dataclasses
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
@@ -39,6 +38,7 @@ from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
 from skypilot_tpu.utils import tracing
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -50,13 +50,6 @@ _DEFAULT_TTFT_MS = {'interactive': 500.0, 'standard': 2000.0,
 _DEFAULT_ITL_MS = {'interactive': 100.0, 'standard': 250.0,
                    'batch': 1000.0}
 _DEFAULT_TARGET = 0.99
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, '') or default)
-    except ValueError:
-        return default
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,18 +76,18 @@ def objectives() -> Dict[str, ClassObjective]:
     ``SKYT_SLO_TARGET_<CLASS>`` sets the attainment target. Read at
     call time so tests (and mid-incident operators) can retune without
     a restart."""
-    target_all = _env_float('SKYT_SLO_TARGET', _DEFAULT_TARGET)
+    target_all = env.get_float('SKYT_SLO_TARGET', _DEFAULT_TARGET)
     out = {}
     for cls in qos_lib.PRIORITIES:
         up = cls.upper()
         out[cls] = ClassObjective(
             cls=cls,
-            ttft_ms=_env_float(f'SKYT_SLO_TTFT_MS_{up}',
+            ttft_ms=env.get_float(f'SKYT_SLO_TTFT_MS_{up}',
                                _DEFAULT_TTFT_MS[cls]),
-            itl_ms=_env_float(f'SKYT_SLO_ITL_MS_{up}',
+            itl_ms=env.get_float(f'SKYT_SLO_ITL_MS_{up}',
                               _DEFAULT_ITL_MS[cls]),
             target=min(0.999999, max(
-                0.0, _env_float(f'SKYT_SLO_TARGET_{up}', target_all))))
+                0.0, env.get_float(f'SKYT_SLO_TARGET_{up}', target_all))))
     return out
 
 
@@ -206,12 +199,12 @@ class BurnWindows:
     @classmethod
     def from_env(cls) -> 'BurnWindows':
         return cls(
-            fast_short_s=_env_float('SKYT_SLO_FAST_SHORT_S', 300.0),
-            fast_long_s=_env_float('SKYT_SLO_FAST_LONG_S', 3600.0),
-            fast_threshold=_env_float('SKYT_SLO_FAST_BURN', 14.4),
-            slow_short_s=_env_float('SKYT_SLO_SLOW_SHORT_S', 21600.0),
-            slow_long_s=_env_float('SKYT_SLO_SLOW_LONG_S', 259200.0),
-            slow_threshold=_env_float('SKYT_SLO_SLOW_BURN', 6.0))
+            fast_short_s=env.get_float('SKYT_SLO_FAST_SHORT_S', 300.0),
+            fast_long_s=env.get_float('SKYT_SLO_FAST_LONG_S', 3600.0),
+            fast_threshold=env.get_float('SKYT_SLO_FAST_BURN', 14.4),
+            slow_short_s=env.get_float('SKYT_SLO_SLOW_SHORT_S', 21600.0),
+            slow_long_s=env.get_float('SKYT_SLO_SLOW_LONG_S', 259200.0),
+            slow_threshold=env.get_float('SKYT_SLO_SLOW_BURN', 6.0))
 
     def all(self) -> 'Dict[str, float]':
         """window label -> seconds, dedup'd, short-to-long."""
@@ -378,7 +371,7 @@ class BurnRateEvaluator:
 
 # ------------------------------------------------------- cost reporting
 def _chips_per_replica() -> float:
-    return max(0.0, _env_float('SKYT_FLEET_CHIPS_PER_REPLICA', 1.0))
+    return max(0.0, env.get_float('SKYT_FLEET_CHIPS_PER_REPLICA', 1.0))
 
 
 def goodput_report(source: Any, window_s: float, now: float,
@@ -432,7 +425,7 @@ def goodput_report(source: Any, window_s: float, now: float,
         'window_s': window_s,
         'replicas': replicas,
         'chips': chips,
-        'accelerator': os.environ.get('SKYT_FLEET_ACCELERATOR', ''),
+        'accelerator': env.get('SKYT_FLEET_ACCELERATOR', ''),
         'classes': classes,
         'good_tokens': total_good_tokens,
         'tokens': total_tokens,

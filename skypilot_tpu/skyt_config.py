@@ -12,6 +12,7 @@ import threading
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 import yaml
+from skypilot_tpu.utils import env
 
 CONFIG_PATH = '~/.skypilot_tpu/config.yaml'
 ENV_VAR_CONFIG_PATH = 'SKYT_CONFIG'
@@ -23,7 +24,7 @@ _lock = threading.Lock()
 
 def _config_path() -> str:
     return os.path.expanduser(
-        os.environ.get(ENV_VAR_CONFIG_PATH, CONFIG_PATH))
+        env.get(ENV_VAR_CONFIG_PATH, CONFIG_PATH))
 
 
 def _try_load_config() -> Dict[str, Any]:

@@ -15,10 +15,11 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import sqlite_utils
+from skypilot_tpu.utils import env
 
 
 def state_dir() -> str:
-    d = os.environ.get('SKYT_STATE_DIR',
+    d = env.get('SKYT_STATE_DIR',
                        os.path.expanduser('~/.skypilot_tpu'))
     os.makedirs(d, exist_ok=True)
     return d

@@ -25,6 +25,7 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 ENV_FILE = 'SKYT_HEARTBEAT_FILE'
 ENV_ENABLED = 'SKYT_WATCHDOG'
@@ -41,14 +42,11 @@ def enabled() -> bool:
     (heartbeats, rank sentinel, gang watchdog). Default ON; with
     SKYT_WATCHDOG=0 sft never constructs a writer and the step loop is
     byte-identical to before this plane existed."""
-    return os.environ.get(ENV_ENABLED, '1') not in ('', '0', 'false')
+    return env.get_bool(ENV_ENABLED, True)
 
 
 def _interval_s() -> float:
-    try:
-        return float(os.environ.get(ENV_INTERVAL, '') or 1.0)
-    except ValueError:
-        return 1.0
+    return env.get_float(ENV_INTERVAL, 1.0)
 
 
 def read(path: str) -> Optional[Dict[str, Any]]:
@@ -138,13 +136,18 @@ class HeartbeatWriter:
             if tokens_per_sec is not None:
                 self._tokens_per_sec = float(tokens_per_sec)
             rec = self._record_locked(now)
+            # Lock-discipline fix (skyanalyze): capture under the
+            # lock — the sentinel thread calls snapshot() while the
+            # training thread updates the EWMA here.
+            ewma = self._ewma
         self._m_step.labels(str(self.rank)).set(float(step))
-        if self._ewma is not None:
-            self._m_step_s.set(self._ewma)
+        if ewma is not None:
+            self._m_step_s.set(ewma)
         self._write(rec, now)
 
     # ------------------------------------------------------------- views
-    def _record_locked(self, now: float) -> Dict[str, Any]:
+    def _record_locked(self, now: float  # guarded-by: _lock
+                       ) -> Dict[str, Any]:
         return {
             'rank': self.rank,
             'step': self._step,
@@ -202,9 +205,6 @@ def writer_from_env(rank: Optional[int] = None,
     if not enabled():
         return None
     if rank is None:
-        try:
-            rank = int(os.environ.get('SKYT_NODE_RANK', '0') or 0)
-        except ValueError:
-            rank = 0
-    return HeartbeatWriter(os.environ.get(ENV_FILE) or None, rank,
+        rank = env.get_int('SKYT_NODE_RANK', 0)
+    return HeartbeatWriter(env.get(ENV_FILE) or None, rank,
                            clock=clock, device_kind=device_kind)

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -43,7 +44,7 @@ _ENV_PREFIXES = ('SKYT_', 'JAX_', 'MEGASCALE_', 'SKYPILOT_')
 
 def bundle_root() -> str:
     return os.path.expanduser(
-        os.environ.get(ENV_DIR) or '~/.skyt/postmortems')
+        env.get(ENV_DIR) or '~/.skyt/postmortems')
 
 
 def _counter() -> 'metrics_lib.Counter':
@@ -78,12 +79,9 @@ def dump_bundle(reason: str, *,
         if now is None:
             now = time.time()
         if rank is None:
-            try:
-                rank = int(os.environ.get('SKYT_NODE_RANK', '0') or 0)
-            except ValueError:
-                rank = 0
+            rank = env.get_int('SKYT_NODE_RANK', 0)
         if job_id is None:
-            job_id = os.environ.get('SKYT_JOB_ID')
+            job_id = env.get('SKYT_JOB_ID')
         root = root or bundle_root()
         # Millisecond component + reason: the guard path can dump a
         # 'preempt' bundle and the crash handler a 'crash' bundle from
@@ -119,8 +117,8 @@ def dump_bundle(reason: str, *,
             'job_id': job_id,
             'created': now,
             'pid': os.getpid(),
-            'task_id': os.environ.get('SKYT_TASK_ID'),
-            'cluster': os.environ.get('SKYT_CLUSTER_NAME'),
+            'task_id': env.get('SKYT_TASK_ID'),
+            'cluster': env.get('SKYT_CLUSTER_NAME'),
             'device': _device_kind(),
             'heartbeat': heartbeat,
             'train': train_state,

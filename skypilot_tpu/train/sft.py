@@ -19,6 +19,7 @@ import numpy as np
 
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -164,10 +165,7 @@ def main(argv=None) -> None:
     # Rank comes from the gang env regardless of SKYT_WATCHDOG: the
     # train.step fault point's `rank` attr (where=rank:R targeting)
     # must stay correct with the heartbeat plane disabled.
-    try:
-        rank = int(os.environ.get('SKYT_NODE_RANK', '0') or 0)
-    except ValueError:
-        rank = 0
+    rank = env.get_int('SKYT_NODE_RANK', 0)
     # Live step-loop cell for engine-free bundle state: plain dict
     # writes on the host, no device syncs.
     live_state = {'step': None, 'steps_total': args.steps,
@@ -435,8 +433,8 @@ def main(argv=None) -> None:
                     # which overlaps step k's device compute.
                     n_window = min(args.log_every, step + 1 - start_step)
                     step_time = (now - last_t) / max(1, n_window)
-                    if flops_state is None and os.environ.get(
-                            'SKYT_TRAIN_MFU', '1') not in ('0', 'false'):
+                    if flops_state is None and \
+                            env.get_bool('SKYT_TRAIN_MFU', True):
                         flops_state = profiling.train_step_flops(
                             step_fn, state, batch,
                             analytic=_analytic_flops)

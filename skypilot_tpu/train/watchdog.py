@@ -37,50 +37,43 @@ Clock discipline: all time flows through injectable clocks
 (tools/lint.py enforces no direct wall-clock calls in this file).
 """
 import dataclasses
-import os
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
 STATES = ('init', 'ok', 'straggler', 'desync', 'hang')
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, '') or default)
-    except ValueError:
-        return default
-
-
 def factor() -> float:
     """Stall budget multiplier over the rank's rolling step time."""
-    return _env_float('SKYT_WATCHDOG_FACTOR', 10.0)
+    return env.get_float('SKYT_WATCHDOG_FACTOR', 10.0)
 
 
 def min_stall_s() -> float:
     """Stall budget floor: below this, silence is never a hang (log
     boundaries, checkpoint writes, and GC all pause heartbeats)."""
-    return _env_float('SKYT_WATCHDOG_MIN_S', 60.0)
+    return env.get_float('SKYT_WATCHDOG_MIN_S', 60.0)
 
 
 def straggler_k() -> float:
-    return _env_float('SKYT_WATCHDOG_STRAGGLER_K', 3.0)
+    return env.get_float('SKYT_WATCHDOG_STRAGGLER_K', 3.0)
 
 
 def pipeline_depth() -> int:
     """Step skew tolerated before 'desync': pipeline stages (and the
     prefetch depth) legitimately put ranks a few steps apart."""
-    return int(_env_float('SKYT_WATCHDOG_PIPELINE_DEPTH', 2))
+    return int(env.get_float('SKYT_WATCHDOG_PIPELINE_DEPTH', 2))
 
 
 def confirm_evals() -> int:
     """Consecutive hang evaluations before the verdict escalates."""
-    return max(1, int(_env_float('SKYT_WATCHDOG_CONFIRM', 2)))
+    return max(1, int(env.get_float('SKYT_WATCHDOG_CONFIRM', 2)))
 
 
 def stall_budget(ewma_step_s: Optional[float]) -> float:
@@ -267,7 +260,7 @@ class RankSentinel:
         self._writer = writer
         self._on_stall = on_stall
         self._clock = clock
-        self._poll = _env_float('SKYT_WATCHDOG_POLL_S', 1.0) \
+        self._poll = env.get_float('SKYT_WATCHDOG_POLL_S', 1.0) \
             if poll_s is None else float(poll_s)
         self._stop = threading.Event()
         self.fired = threading.Event()

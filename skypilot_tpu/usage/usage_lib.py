@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 
 from skypilot_tpu import skyt_config
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -31,7 +32,7 @@ _RUN_ID = str(uuid.uuid4())
 
 
 def _enabled() -> bool:
-    return os.environ.get('SKYT_USAGE_COLLECTION', '0') == '1'
+    return env.get('SKYT_USAGE_COLLECTION', '0') == '1'
 
 
 def _spool_path() -> str:

@@ -1,6 +1,14 @@
-"""Typed environment flags (reference analog: sky/utils/env_options.py)."""
+"""Typed environment flags (reference analog: sky/utils/env_options.py).
+
+Each member names a registered variable in skypilot_tpu/utils/env.py;
+reads go through env.get_bool so coercion/docs stay centralized. The
+env-registry analysis pass treats the member declarations below as
+reads (the names are static here even though Options.get resolves
+them dynamically).
+"""
 import enum
-import os
+
+from skypilot_tpu.utils import env
 
 
 class Options(enum.Enum):
@@ -15,10 +23,7 @@ class Options(enum.Enum):
         self.default = default
 
     def get(self) -> bool:
-        val = os.environ.get(self.env_var)
-        if val is None:
-            return self.default
-        return val.lower() not in ('0', 'false', 'no', '')
+        return env.get_bool(self.env_var, self.default)
 
     @property
     def env_key(self) -> str:

@@ -61,6 +61,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 _ENV = 'SKYT_FAULTS'
 _ENV_SEED = 'SKYT_FAULTS_SEED'
@@ -108,7 +109,7 @@ def parse_spec(spec: str, seed: Optional[int] = None) -> List[FaultRule]:
     """Parse a SKYT_FAULTS spec string. Raises ValueError naming the
     offending token on malformed input."""
     if seed is None:
-        seed = int(os.environ.get(_ENV_SEED, '0') or 0)
+        seed = env.get_int(_ENV_SEED, 0)
     rules: List[FaultRule] = []
     for i, raw in enumerate(s for s in spec.split(';') if s.strip()):
         head, _, tail = raw.strip().partition(',')
@@ -159,7 +160,7 @@ def _active() -> List[FaultRule]:
     global _cache_spec, _cache_rules, _env_warned
     if _configured:
         return _cache_rules
-    spec = os.environ.get(_ENV, '')
+    spec = env.get(_ENV, '')
     if spec == _cache_spec:
         return _cache_rules
     with _lock:

@@ -6,6 +6,7 @@ info chatter (mirrors SKYPILOT_DEBUG / SKYPILOT_MINIMIZE_LOGGING).
 import logging
 import os
 import sys
+from skypilot_tpu.utils import env
 
 _FORMAT = '%(levelname).1s %(asctime)s %(name)s:%(lineno)d] %(message)s'
 _DATE_FORMAT = '%m-%d %H:%M:%S'
@@ -22,9 +23,9 @@ def _configure_root() -> None:
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATE_FORMAT))
     root.addHandler(handler)
-    if os.environ.get('SKYT_DEBUG'):
+    if env.get('SKYT_DEBUG'):
         root.setLevel(logging.DEBUG)
-    elif os.environ.get('SKYT_MINIMIZE_LOGGING'):
+    elif env.get('SKYT_MINIMIZE_LOGGING'):
         root.setLevel(logging.WARNING)
     else:
         root.setLevel(logging.INFO)

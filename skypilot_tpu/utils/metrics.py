@@ -33,11 +33,11 @@ and tenant labels arrive from clients, so the guard is load-bearing,
 not defensive.
 """
 import math
-import os
 import re
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from skypilot_tpu.utils import env
 
 _NAME_RE = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
 _LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
@@ -51,11 +51,7 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 def _max_series() -> int:
     """Per-family label-set cap (SKYT_METRICS_MAX_SERIES, default
     1000). Read at metric construction; malformed values fall back."""
-    try:
-        return max(1, int(os.environ.get('SKYT_METRICS_MAX_SERIES', '')
-                          or 1000))
-    except ValueError:
-        return 1000
+    return env.get_int('SKYT_METRICS_MAX_SERIES', 1000, minimum=1)
 
 
 def _fmt(v: float) -> str:

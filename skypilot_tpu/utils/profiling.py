@@ -38,6 +38,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -89,7 +90,7 @@ def capture_trace(duration_ms: float,
     try:
         out_dir = tempfile.mkdtemp(
             prefix='skyt-profile-',
-            dir=base_dir or os.environ.get('SKYT_PROFILE_DIR') or None)
+            dir=base_dir or env.get('SKYT_PROFILE_DIR') or None)
         t0 = time.perf_counter()
         jax.profiler.start_trace(out_dir)
         try:
@@ -173,34 +174,13 @@ def train_step_flops(step_fn: Callable, *args,
     return None, 'unavailable'
 
 
-def _env_int(name: str, default: int, minimum: int = 0) -> int:
-    """Parse an int env var, falling back to `default` (with a logged
-    warning) on malformed or out-of-range values — a typo in the launch
-    YAML must degrade to default profiling, not crash the training job
-    with a bare ValueError."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    try:
-        val = int(raw)
-    except ValueError:
-        logger.warning('%s=%r is not an integer; using default %d',
-                       name, raw, default)
-        return default
-    if val < minimum:
-        logger.warning('%s=%d is below the minimum %d; using default '
-                       '%d', name, val, minimum, default)
-        return default
-    return val
-
-
 class StepProfiler:
     """Profiles steps [start, start + num) of a training loop."""
 
     def __init__(self, trace_dir: Optional[str] = None) -> None:
-        self.trace_dir = trace_dir or os.environ.get('SKYT_PROFILE_DIR')
-        self.start_step = _env_int('SKYT_PROFILE_START_STEP', 2)
-        self.num_steps = _env_int('SKYT_PROFILE_NUM_STEPS', 3,
+        self.trace_dir = trace_dir or env.get('SKYT_PROFILE_DIR')
+        self.start_step = env.get_int('SKYT_PROFILE_START_STEP', 2)
+        self.num_steps = env.get_int('SKYT_PROFILE_NUM_STEPS', 3,
                                   minimum=1)
         self._active = False
         self._done = False

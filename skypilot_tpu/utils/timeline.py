@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional
 import filelock
 
 from skypilot_tpu.utils import env_options
+from skypilot_tpu.utils import env
 
 _events: List[Dict[str, Any]] = []
 _events_lock = threading.Lock()
@@ -140,7 +141,7 @@ class FileLockEvent:
 def save_timeline() -> None:
     if not _is_enabled() or not _events:
         return
-    path = os.environ.get(
+    path = env.get(
         'SKYT_TIMELINE_FILE',
         os.path.expanduser(f'~/.skypilot_tpu/timeline-{os.getpid()}.json'))
     parent = os.path.dirname(path)

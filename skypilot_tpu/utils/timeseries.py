@@ -24,13 +24,13 @@ per replica, `serve/slo.py` evaluates burn rates against it, and tests
 feed it synthetic exposition text under a fake clock.
 """
 import collections
-import os
 import re
 import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -110,13 +110,6 @@ def _series_key(name: str, labels: Dict[str, str]
     return name, tuple(sorted(labels.items()))
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return max(1, int(os.environ.get(name, '') or default))
-    except ValueError:
-        return default
-
-
 def _family_of(name: str) -> str:
     """Histogram component samples share their family's base name."""
     for suffix in ('_bucket', '_sum', '_count'):
@@ -138,9 +131,11 @@ class TimeSeriesStore:
                  max_points: Optional[int] = None,
                  clock: Callable[[], float] = time.time) -> None:
         self.max_series = (max_series if max_series is not None
-                           else _env_int('SKYT_TS_MAX_SERIES', 4096))
+                           else env.get_int('SKYT_TS_MAX_SERIES', 4096,
+                                            minimum=1))
         self.max_points = (max_points if max_points is not None
-                           else _env_int('SKYT_TS_MAX_POINTS', 360))
+                           else env.get_int('SKYT_TS_MAX_POINTS', 360,
+                                            minimum=1))
         self._clock = clock
         self._lock = threading.Lock()
         self._series: 'Dict[Tuple[str, Tuple[Tuple[str, str], ...]], collections.deque]' = {}  # noqa

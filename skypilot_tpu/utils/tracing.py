@@ -56,6 +56,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from skypilot_tpu.utils import log_utils
 from skypilot_tpu.utils import metrics as metrics_lib
+from skypilot_tpu.utils import env
 
 logger = log_utils.init_logger(__name__)
 
@@ -81,13 +82,13 @@ _MAX_EVENTS_PER_SPAN = 64
 def enabled() -> bool:
     """Master switch (default on). '0' selects the no-op path: span
     creation returns a shared singleton and records nothing."""
-    return os.environ.get('SKYT_TRACE', '1') != '0'
+    return env.get('SKYT_TRACE', '1') != '0'
 
 
 def sample_rate() -> float:
     """Head-sampling rate in [0, 1]; malformed values fall back to the
     0.0 default with a debug log rather than crashing a request."""
-    raw = os.environ.get('SKYT_TRACE_SAMPLE', '0')
+    raw = env.get('SKYT_TRACE_SAMPLE', '0')
     try:
         return min(1.0, max(0.0, float(raw)))
     except ValueError:
@@ -98,7 +99,7 @@ def sample_rate() -> float:
 def slow_threshold_ms() -> float:
     """Flight-recorder latency threshold (ms); malformed values fall
     back to the 500ms default."""
-    raw = os.environ.get('SKYT_TRACE_SLOW_MS', '500')
+    raw = env.get('SKYT_TRACE_SLOW_MS', '500')
     try:
         return float(raw)
     except ValueError:

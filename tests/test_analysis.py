@@ -1,0 +1,488 @@
+"""skyanalyze (tools/analysis) tests: each pass fires on a seeded
+violation fixture and stays silent on clean equivalents, the noqa
+grammar works per pass id, the JSON artifact is golden, and registry
+drift (env vars, fault points, metrics, JobStatus terminals) reds.
+
+The whole-repo cleanliness gate is tests/test_lint.py::test_lint_clean
+(lint.py now runs all skyanalyze passes); these tests pin each pass's
+behavior in isolation on tmp fixture trees.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import lint
+        from analysis import core
+    finally:
+        sys.path.pop(0)
+    return lint, core
+
+
+lint, core = _load()
+
+
+def _write(root, rel, body):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(body))
+    return p
+
+
+# ------------------------------------------------------ lock-discipline
+def test_lock_discipline_fires_on_unguarded_access(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/serve/racy.py', '''\
+        import threading
+
+
+        class Shared:
+            def __init__(self) -> None:
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def bump(self) -> None:
+                with self._lock:
+                    self._count += 1
+
+            def peek(self) -> int:
+                return self._count
+        ''')
+    issues = lint.check_file(bad)
+    assert any('lock-discipline' in i and 'self._count read' in i
+               for i in issues), issues
+
+
+def test_lock_discipline_guarded_by_method_marker(tmp_path):
+    ok = _write(tmp_path, 'skypilot_tpu/serve/marked.py', '''\
+        import threading
+
+
+        class Shared:
+            def __init__(self) -> None:
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def bump(self) -> None:
+                with self._lock:
+                    self._count += 1
+                    self._flush_locked()
+
+            def _flush_locked(self) -> None:  # guarded-by: _lock
+                self._count = 0
+        ''')
+    assert not any('lock-discipline' in i
+                   for i in lint.check_file(ok))
+
+
+def test_lock_discipline_init_exempt_and_noqa(tmp_path):
+    f = _write(tmp_path, 'skypilot_tpu/serve/init_ok.py', '''\
+        import threading
+
+
+        class Shared:
+            def __init__(self) -> None:
+                self._lock = threading.Lock()
+                self._count = 0          # construction precedes sharing
+
+            def bump(self) -> None:
+                with self._lock:
+                    self._count += 1
+
+            def peek(self) -> int:
+                return self._count  # noqa: lock-discipline (stale ok)
+        ''')
+    assert not any('lock-discipline' in i for i in lint.check_file(f))
+
+
+def test_lock_discipline_closure_resets_held_locks(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/serve/closure.py', '''\
+        import threading
+
+
+        class Shared:
+            def __init__(self) -> None:
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def bump(self) -> None:
+                with self._lock:
+                    self._count += 1
+
+                    def later() -> int:
+                        return self._count
+                    self.cb = later
+        ''')
+    issues = lint.check_file(bad)
+    assert any('lock-discipline' in i and 'later' not in i
+               for i in issues) or \
+        any('self._count read' in i for i in issues), issues
+
+
+# ------------------------------------------------------- async-blocking
+def test_async_blocking_fires_in_serve_async_def(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/serve/slowpath.py', '''\
+        import time
+
+
+        async def handler() -> None:
+            time.sleep(1.0)
+        ''')
+    issues = lint.check_file(bad)
+    assert any('async-blocking' in i and 'time.sleep' in i
+               for i in issues), issues
+
+
+def test_async_blocking_skips_executor_targets_and_sync_code(tmp_path):
+    ok = _write(tmp_path, 'skypilot_tpu/serve/okpath.py', '''\
+        import asyncio
+        import time
+
+
+        def warmup() -> None:
+            time.sleep(0.1)              # sync code may block
+
+
+        async def handler() -> object:
+            def work() -> str:
+                with open('/etc/hostname') as f:   # executor target
+                    return f.read()
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, work)
+        ''')
+    assert not any('async-blocking' in i for i in lint.check_file(ok))
+
+
+def test_async_blocking_scope_is_serve_and_infer_server(tmp_path):
+    elsewhere = _write(tmp_path, 'skypilot_tpu/train/loop.py', '''\
+        import time
+
+
+        async def trainer_side() -> None:
+            time.sleep(1.0)
+        ''')
+    assert not any('async-blocking' in i
+                   for i in lint.check_file(elsewhere))
+
+
+# -------------------------------------------------------- tracer-safety
+def test_tracer_safety_fires_through_call_graph(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/ops/kern.py', '''\
+        import jax
+
+
+        def _inner(x):
+            print(x)
+            return x * 2
+
+
+        @jax.jit
+        def traced(x):
+            return _inner(x)
+        ''')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    msgs = [v.message for v in violations
+            if v.pass_id == 'tracer-safety']
+    assert any('print()' in m and '_inner' in m for m in msgs), \
+        violations
+
+
+def test_tracer_safety_silent_without_traced_roots(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/ops/plain.py', '''\
+        import time
+
+
+        def eager(x):
+            t0 = time.perf_counter()
+            return x, time.perf_counter() - t0
+        ''')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert not [v for v in violations if v.pass_id == 'tracer-safety']
+
+
+# --------------------------------------------------------- env-registry
+def test_env_read_pass_flags_direct_environ_read(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/serve/knobs.py', '''\
+        import os
+
+        FLAG = os.environ.get('SKYT_SOME_FLAG', '0')
+        ''')
+    issues = lint.check_file(bad)
+    assert any('env-registry' in i and 'SKYT_SOME_FLAG' in i
+               for i in issues), issues
+    # non-SKYT reads stay allowed
+    ok = _write(tmp_path, 'skypilot_tpu/serve/other.py', '''\
+        import os
+
+        ADDR = os.environ.get('JAX_COORDINATOR_ADDRESS')
+        ''')
+    assert not any('env-registry' in i for i in lint.check_file(ok))
+
+
+_MINI_ENV = '''\
+import dataclasses
+import os
+from typing import Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    type: str
+    default: object
+    doc: str
+    exported: bool = False
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+
+
+def _var(name, type, default, doc, exported=False):
+    _REGISTRY[name] = EnvVar(name, type, default, doc, exported)
+
+
+_var('SKYT_ALPHA', 'str', None, 'a consumed knob.')
+_var('SKYT_OMEGA', 'str', None, 'set for user jobs.', exported=True)
+
+
+def registry():
+    return dict(_REGISTRY)
+
+
+def get(name, default=None):
+    return os.environ.get(name, default)
+
+
+def generate_docs():
+    lines = ['# Environment variables', '']
+    for name in sorted(_REGISTRY):
+        lines.append(f'| `{name}` |')
+    return '\\n'.join(lines) + '\\n'
+'''
+
+_MINI_READER = '''\
+from skypilot_tpu.utils import env
+
+ALPHA = env.get('SKYT_ALPHA')
+'''
+
+
+def _mini_tree(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/utils/env.py', _MINI_ENV)
+    _write(tmp_path, 'skypilot_tpu/serve/reader.py', _MINI_READER)
+    docs = tmp_path / 'docs' / 'env_vars.md'
+    docs.parent.mkdir(parents=True, exist_ok=True)
+    docs.write_text('# Environment variables\n\n'
+                    '| `SKYT_ALPHA` |\n| `SKYT_OMEGA` |\n')
+
+
+def test_env_registry_consistent_fixture_is_clean(tmp_path):
+    _mini_tree(tmp_path)
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert not [v for v in violations
+                if v.pass_id == 'env-registry'], violations
+
+
+def test_env_registry_drift_unregistered_read_reds(tmp_path):
+    _mini_tree(tmp_path)
+    _write(tmp_path, 'skypilot_tpu/serve/rogue.py', '''\
+        from skypilot_tpu.utils import env
+
+        BETA = env.get('SKYT_BETA')
+        ''')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert any(v.pass_id == 'env-registry' and 'SKYT_BETA' in v.message
+               and 'unregistered' in v.message for v in violations), \
+        violations
+
+
+def test_env_registry_drift_unread_var_reds(tmp_path):
+    _mini_tree(tmp_path)
+    env_py = tmp_path / 'skypilot_tpu' / 'utils' / 'env.py'
+    env_py.write_text(env_py.read_text().replace(
+        "_var('SKYT_ALPHA', 'str', None, 'a consumed knob.')",
+        "_var('SKYT_ALPHA', 'str', None, 'a consumed knob.')\n"
+        "_var('SKYT_GHOST', 'str', None, 'nobody reads me.')"))
+    (tmp_path / 'docs' / 'env_vars.md').write_text(
+        '# Environment variables\n\n| `SKYT_ALPHA` |\n'
+        '| `SKYT_GHOST` |\n| `SKYT_OMEGA` |\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert any(v.pass_id == 'env-registry' and 'SKYT_GHOST' in v.message
+               and 'never read' in v.message for v in violations), \
+        violations
+
+
+def test_env_registry_docs_drift_reds(tmp_path):
+    """The headline drift drill: registry and docs disagree (an
+    undocumented variable) => the analyzer goes red."""
+    _mini_tree(tmp_path)
+    (tmp_path / 'docs' / 'env_vars.md').write_text(
+        '# Environment variables\n\n| `SKYT_ALPHA` |\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert any(v.pass_id == 'env-registry' and 'stale' in v.message
+               for v in violations), violations
+
+
+def test_real_env_docs_are_fresh():
+    """docs/env_vars.md in the repo byte-matches the registry output
+    (regenerate with `python tools/lint.py --write-env-docs`)."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        from analysis import env_registry
+    finally:
+        sys.path.pop(0)
+    mod = env_registry._load_registry(
+        os.path.join(REPO, 'skypilot_tpu', 'utils', 'env.py'))
+    with open(os.path.join(REPO, 'docs', 'env_vars.md'),
+              encoding='utf-8') as f:
+        assert f.read() == mod.generate_docs()
+
+
+# ------------------------------------------------- registry-consistency
+def test_fault_point_drift_reds_both_ways(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/serve/thing.py', '''\
+        from skypilot_tpu.utils import faults
+
+
+        def tick() -> None:
+            faults.inject('thing.tick')
+        ''')
+    _write(tmp_path, 'docs/robustness.md', '''\
+        | point | layer | attrs | kinds |
+        |---|---|---|---|
+        | `ghost.point` | nowhere | — | error |
+        ''')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    msgs = [v.message for v in violations
+            if v.pass_id == 'registry-consistency']
+    assert any("'thing.tick'" in m and 'no row' in m for m in msgs), \
+        violations
+    assert any("'ghost.point'" in m and 'no faults.inject' in m
+               for m in msgs), violations
+
+
+def test_metric_family_doc_presence_and_labels(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/serve/metered.py', '''\
+        from skypilot_tpu.utils import metrics as metrics_lib
+
+        REG = metrics_lib.MetricsRegistry()
+        GOOD = REG.counter('skyt_widget_spins_total', 'spins',
+                           ('widget',))
+        BAD = REG.counter('skyt_widget_drops_total', 'drops')
+        ''')
+    _write(tmp_path, 'docs/observability.md',
+           'Widgets: `skyt_widget_spins_total{widget}`.\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    msgs = [v.message for v in violations
+            if v.pass_id == 'registry-consistency']
+    assert any("'skyt_widget_drops_total'" in m and 'not documented'
+               in m for m in msgs), violations
+    assert not any("'skyt_widget_spins_total'" in m for m in msgs)
+
+    # label mismatch: doc says {gadget}, code says ('widget',)
+    _write(tmp_path, 'docs/observability.md',
+           'Widgets: `skyt_widget_spins_total{gadget}` and '
+           '`skyt_widget_drops_total`.\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    msgs = [v.message for v in violations
+            if v.pass_id == 'registry-consistency']
+    assert any("'skyt_widget_spins_total'" in m and 'label set' in m
+               for m in msgs), violations
+
+
+def test_terminal_state_catalog_equality(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/runtime/job_lib.py', '''\
+        import enum
+
+
+        class JobStatus(enum.Enum):
+            RUNNING = 'RUNNING'
+            SUCCEEDED = 'SUCCEEDED'
+            HUNG = 'HUNG'
+
+
+        _TERMINAL = {JobStatus.SUCCEEDED, JobStatus.HUNG}
+        ''')
+    _write(tmp_path, 'docs/managed-jobs.md',
+           'Terminal states: `SUCCEEDED`.\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    msgs = [v.message for v in violations
+            if v.pass_id == 'registry-consistency']
+    assert any('HUNG is missing' in m for m in msgs), violations
+
+    _write(tmp_path, 'docs/managed-jobs.md',
+           'Terminal states: `SUCCEEDED`, `HUNG`.\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert not [v for v in violations
+                if v.pass_id == 'registry-consistency'], violations
+
+
+# ------------------------------------------------------- noqa semantics
+def test_noqa_grammar_per_pass_id(tmp_path):
+    # named suppression of a DIFFERENT pass does not silence
+    wrong = _write(tmp_path, 'skypilot_tpu/serve/wrongnoqa.py', '''\
+        import os
+
+        F = os.environ.get('SKYT_F', '')  # noqa: kernel-dispatch
+        ''')
+    assert any('env-registry' in i for i in lint.check_file(wrong))
+
+    # named suppression of the RIGHT pass silences only it
+    right = _write(tmp_path, 'skypilot_tpu/serve/rightnoqa.py', '''\
+        import os
+
+        F = os.environ.get('SKYT_F', '')  # noqa: env-registry (why)
+        ''')
+    assert not any('env-registry' in i for i in lint.check_file(right))
+
+    # bare noqa and free-text reasons suppress everything on the line
+    bare = _write(tmp_path, 'skypilot_tpu/serve/barenoqa.py', '''\
+        import os
+
+        F = os.environ.get('SKYT_F', '')  # noqa: startup stamp
+        ''')
+    assert not any('env-registry' in i for i in lint.check_file(bare))
+
+
+# --------------------------------------------------------- JSON output
+def test_json_artifact_golden(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/serve/dirty.py',
+                 "x\t= 1\n")
+    out = tmp_path / 'skyanalyze.json'
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'lint.py'),
+         str(bad), '--json', str(out)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout
+    payload = json.loads(out.read_text())
+    assert payload['schema'] == 1
+    assert payload['tool'] == 'skyanalyze'
+    assert payload['files_checked'] == 1
+    assert 'lock-discipline' in payload['passes']
+    [v] = payload['violations']
+    assert v['path'].endswith('skypilot_tpu/serve/dirty.py')
+    assert (v['line'], v['pass'], v['message']) == \
+        (1, 'whitespace', 'tab character')
+
+
+def test_repo_head_is_clean_with_json():
+    """lint.py over the real tree: exit 0, empty violation list in the
+    JSON artifact (the acceptance gate)."""
+    out = os.path.join(REPO, '.skyanalyze_test.json')
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, 'tools', 'lint.py'),
+             '--json', out],
+            capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout
+        payload = json.loads(open(out, encoding='utf-8').read())
+        assert payload['violations'] == []
+    finally:
+        if os.path.exists(out):
+            os.remove(out)

@@ -107,3 +107,37 @@ def test_lint_forbids_wall_clock_in_slo_and_timeseries(tmp_path):
     other.write_text('import time\nnow = time.time()\n')
     assert not any('injectable clock' in i
                    for i in lint.check_file(other))
+
+
+def test_ported_rules_carry_pass_ids(tmp_path):
+    """The regex rules now run as skyanalyze passes: same message
+    text (asserted above), plus a stable [pass-id] suffix that the
+    per-pass `# noqa: <id>` grammar keys on
+    (docs/static_analysis.md)."""
+    sys.path.insert(0, os.path.join(REPO, 'tools'))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / 'skypilot_tpu' / 'serve' / 'messy.py'
+    bad.parent.mkdir(parents=True)
+    bad.write_text('def f():\n'
+                   '    try:\n'
+                   '        print("hi")\n'
+                   '    except Exception:\n'
+                   '        pass\n')
+    issues = lint.check_file(bad)
+    assert any('bare print()' in i and '[print-call]' in i
+               for i in issues), issues
+    assert any('silent broad swallow' in i and '[except-pass]' in i
+               for i in issues), issues
+
+    # per-pass suppression: naming one id leaves the other firing
+    bad.write_text('def f():\n'
+                   '    try:\n'
+                   '        print("hi")  # noqa: print-call\n'
+                   '    except Exception:\n'
+                   '        pass\n')
+    issues = lint.check_file(bad)
+    assert not any('[print-call]' in i for i in issues)
+    assert any('[except-pass]' in i for i in issues)

@@ -1,0 +1,26 @@
+"""skyanalyze: dependency-free AST static analysis for skypilot-tpu.
+
+The framework (core.py) runs two kinds of passes over the tree:
+
+  * file passes — see one parsed file at a time (the nine rules
+    ported from the original regex linter, plus lock-discipline and
+    async-blocking);
+  * project passes — see every parsed file plus docs/ (tracer-safety
+    reachability, env-registry drift, registry-consistency).
+
+``tools/lint.py`` is the CLI entry point (unchanged invocation;
+``--json`` and ``--write-env-docs`` are additive). Suppression is
+per-line: bare ``# noqa`` (or ``# noqa: <free-text reason>``)
+suppresses every pass on that line; ``# noqa: <pass-id>[, <pass-id>]``
+suppresses only the named passes. docs/static_analysis.md is the pass
+catalog and how-to.
+"""
+from .core import (  # noqa: re-exports
+    FileContext,
+    Project,
+    Violation,
+    all_passes,
+    analyze,
+    check_file,
+    render_json,
+)

@@ -1,0 +1,100 @@
+"""async-blocking: no synchronous blocking calls inside ``async def``
+bodies in the serve plane and the inference server.
+
+One blocking call on the event loop stalls EVERY in-flight request on
+that process — the LB proxies all traffic through one loop, and the
+replica server multiplexes all HTTP + engine callbacks through one.
+Flagged inside async functions (nested *sync* defs are skipped —
+they are what you hand to ``asyncio.to_thread`` / executors):
+
+  * ``time.sleep``                    (use ``asyncio.sleep``)
+  * ``requests.*`` / ``urllib.request.urlopen`` / bare ``urlopen``
+                                      (use the shared aiohttp session)
+  * ``sqlite3.*`` / ``sqlite_utils.connect``
+                                      (DB work goes to a thread)
+  * builtin ``open``                  (file I/O goes to a thread)
+  * ``subprocess.run/call/check_*``, ``os.system``, ``*.wait()`` on a
+    Popen is not detected — use ``asyncio.create_subprocess_exec``
+
+Deliberate exceptions (startup-only paths, tiny local files) carry
+``# noqa: async-blocking`` with a why-comment.
+"""
+import ast
+from typing import List, Optional
+
+from .core import FileContext, Pass, Violation
+
+_BLOCKING_MODULE_CALLS = {
+    'time': ('sleep',),
+    'requests': ('get', 'post', 'put', 'delete', 'head', 'patch',
+                 'request'),
+    'sqlite3': ('connect',),
+    'subprocess': ('run', 'call', 'check_call', 'check_output'),
+    'os': ('system',),
+    'sqlite_utils': ('connect',),
+}
+_BLOCKING_NAMES = ('urlopen',)
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id in _BLOCKING_NAMES:
+            return f'{f.id}()'
+        if f.id == 'open':
+            return 'open()'
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == 'urlopen':
+        return 'urllib urlopen()'
+    base = f.value
+    if isinstance(base, ast.Name):
+        mod = base.id
+        if f.attr in _BLOCKING_MODULE_CALLS.get(mod, ()):
+            return f'{mod}.{f.attr}()'
+    # urllib.request.urlopen handled above via attr == 'urlopen'.
+    return None
+
+
+class AsyncBlockingPass(Pass):
+    id = 'async-blocking'
+    title = 'no blocking calls on the serve/infer event loops'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return 'skypilot_tpu/serve/' in ctx.rel or \
+            ctx.rel.endswith('skypilot_tpu/infer/server.py')
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._check_async_body(ctx, node, out)
+        return out
+
+    def _check_async_body(self, ctx: FileContext,
+                          fn: ast.AsyncFunctionDef,
+                          out: List[Violation]) -> None:
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                # Sync helpers defined inside an async fn are executor
+                # / thread targets — not run on the loop here.
+                continue
+            if isinstance(node, ast.AsyncFunctionDef):
+                # Visited by the outer ast.walk on its own.
+                continue
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node)
+                if reason is not None:
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.id,
+                        f'blocking {reason} inside async def '
+                        f'{fn.name}() — this stalls every request on '
+                        f'the event loop; use the async equivalent '
+                        f'(asyncio.sleep, the aiohttp session, '
+                        f'asyncio.to_thread) or add '
+                        f'`# noqa: async-blocking` with a '
+                        f'why-comment'))
+            stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,254 @@
+"""env-registry: every ``SKYT_*`` environment read resolves through
+the typed registry in ``skypilot_tpu/utils/env.py``.
+
+Two passes share the id ``env-registry``:
+
+  * EnvReadPass (file): framework code must not read ``os.environ``
+    / ``os.getenv`` for a ``SKYT_`` name directly — the accessor adds
+    registration, type coercion, and malformed-value warnings.
+    Writes (``os.environ[k] = v``, ``setdefault``, ``pop``) are
+    allowed: exporting env to child jobs is not a read.
+  * EnvRegistryDriftPass (project): loads the registry (by file path,
+    stdlib-only import) and proves (a) every accessor read names a
+    registered variable, (b) every registered non-exported variable
+    is read somewhere (dead knobs rot), and (c) the checked-in
+    ``docs/env_vars.md`` byte-matches ``env.generate_docs()``
+    (regenerate with ``python tools/lint.py --write-env-docs``).
+"""
+import ast
+import importlib.util
+import itertools
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileContext, Pass, Project, Violation
+
+_GETTERS = ('get', 'get_bool', 'get_int', 'get_float', 'lookup')
+_ENV_MODULE_REL = 'skypilot_tpu/utils/env.py'
+_DOCS_REL = 'docs/env_vars.md'
+
+_counter = itertools.count()
+
+
+def _module_consts(tree: ast.AST) -> Dict[str, str]:
+    """Top-level NAME = 'SKYT_...' constants (env var name aliases)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _env_name_of(arg: ast.AST, consts: Dict[str, str]
+                 ) -> Tuple[Optional[str], bool]:
+    """(name-or-prefix, is_prefix) for an env-name argument: literal,
+    module-level constant, or f-string (literal prefix)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id), False
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and \
+                isinstance(head.value, str):
+            return head.value, True
+    return None, False
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """os.environ (Attribute) or bare environ (from os import)."""
+    return (isinstance(node, ast.Attribute) and
+            node.attr == 'environ') or \
+        (isinstance(node, ast.Name) and node.id == 'environ')
+
+
+class EnvReadPass(Pass):
+    id = 'env-registry'
+    title = 'SKYT_* env reads go through utils/env.py'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return 'skypilot_tpu' in ctx.rel and \
+            not ctx.rel.endswith(_ENV_MODULE_REL)
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        consts = _module_consts(ctx.tree)
+        out: List[Violation] = []
+
+        def flag(lineno: int, name: str) -> None:
+            out.append(Violation(
+                ctx.rel, lineno, self.id,
+                f'direct os.environ read of {name} — SKYT_* '
+                f'variables resolve through the typed registry '
+                f'(skypilot_tpu/utils/env.py: env.get / get_bool / '
+                f'get_int / get_float), which is what keeps '
+                f'docs/env_vars.md true and malformed values '
+                f'non-fatal'))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                f = node.func
+                is_read = False
+                if isinstance(f, ast.Attribute) and f.attr == 'get' \
+                        and _is_environ(f.value):
+                    is_read = True
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr == 'getenv' and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ('os', '_os'):
+                    is_read = True
+                if is_read and node.args:
+                    name, _ = _env_name_of(node.args[0], consts)
+                    if name and name.startswith('SKYT_'):
+                        flag(node.lineno, name)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _is_environ(node.value):
+                name, _ = _env_name_of(node.slice, consts)
+                if name and name.startswith('SKYT_'):
+                    flag(node.lineno, name)
+            elif isinstance(node, ast.Compare) and node.ops and \
+                    isinstance(node.ops[0], ast.In) and \
+                    node.comparators and \
+                    _is_environ(node.comparators[0]):
+                name, _ = _env_name_of(node.left, consts)
+                if name and name.startswith('SKYT_'):
+                    flag(node.lineno, name)
+        return out
+
+
+def _load_registry(path: Path):
+    """Import utils/env.py by path (stdlib-only module) under a
+    unique name so fixture trees can carry their own registries."""
+    name = f'_skyt_env_registry_{next(_counter)}'
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class EnvRegistryDriftPass(Pass):
+    id = 'env-registry'
+    title = 'registry <-> reads <-> docs/env_vars.md stay in sync'
+    scope = 'project'
+
+    def run_project(self, project: Project) -> List[Violation]:
+        env_path = project.root / _ENV_MODULE_REL
+        if not env_path.exists():
+            return []
+        out: List[Violation] = []
+        try:
+            mod = _load_registry(env_path)
+            registry = mod.registry()
+        except Exception as e:  # noqa: surfaced as a violation
+            return [Violation(_ENV_MODULE_REL, 1, self.id,
+                              f'env registry failed to load: {e!r}')]
+
+        exact: Set[str] = {n for n in registry if '<' not in n}
+        patterns: Dict[str, str] = {
+            n[:n.index('<')]: n for n in registry if '<' in n}
+
+        def registered(name: str, is_prefix: bool) -> bool:
+            if not is_prefix and name in exact:
+                return True
+            for prefix in patterns:
+                if name.startswith(prefix) or \
+                        (is_prefix and prefix.startswith(name)):
+                    return True
+            return False
+
+        read: Set[str] = set()
+        for ctx in project.files:
+            if ctx.tree is None or 'skypilot_tpu' not in ctx.rel:
+                continue
+            if ctx.rel.endswith('skypilot_tpu/utils/env_options.py'):
+                # The Options enum reads via env.get_bool with a
+                # dynamic name; its member declarations are the
+                # static read sites.
+                for node in ast.walk(ctx.tree):
+                    if isinstance(node, ast.Constant) and \
+                            isinstance(node.value, str) and \
+                            node.value.startswith('SKYT_'):
+                        read.add(node.value)
+            aliases = self._env_aliases(ctx.tree)
+            if not aliases:
+                continue
+            consts = _module_consts(ctx.tree)
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _GETTERS and
+                        isinstance(node.func.value, ast.Name) and
+                        node.func.value.id in aliases and node.args):
+                    continue
+                name, is_prefix = _env_name_of(node.args[0], consts)
+                if name is None or not name.startswith('SKYT_'):
+                    continue
+                if not registered(name, is_prefix):
+                    out.append(Violation(
+                        ctx.rel, node.lineno, self.id,
+                        f'env read of unregistered variable '
+                        f'{name}{"..." if is_prefix else ""} — '
+                        f'declare it in skypilot_tpu/utils/env.py '
+                        f'(name, type, default, doc) and regenerate '
+                        f'docs/env_vars.md'))
+                    continue
+                if is_prefix:
+                    read.update(p for pre, p in patterns.items()
+                                if name.startswith(pre) or
+                                pre.startswith(name))
+                else:
+                    read.add(name if name in exact else next(
+                        (p for pre, p in patterns.items()
+                         if name.startswith(pre)), name))
+
+        env_src = env_path.read_text(encoding='utf-8').splitlines()
+        for name, ev in sorted(registry.items()):
+            if ev.exported or name in read:
+                continue
+            lineno = next((i for i, ln in enumerate(env_src, 1)
+                           if f"'{name}'" in ln), 1)
+            out.append(Violation(
+                (project.root / _ENV_MODULE_REL).as_posix(), lineno,
+                self.id,
+                f'registered env variable {name} is never read '
+                f'through the accessors — delete the entry or mark '
+                f'it exported=True if the framework only sets it '
+                f'for user jobs'))
+
+        want = mod.generate_docs()
+        have = project.doc(_DOCS_REL)
+        if have != want:
+            detail = 'missing' if have is None else \
+                self._first_diff(have, want)
+            out.append(Violation(
+                (project.root / _DOCS_REL).as_posix(), 1, self.id,
+                f'docs/env_vars.md is stale ({detail}) — it is '
+                f'generated from the registry; run '
+                f'`python tools/lint.py --write-env-docs`'))
+        return out
+
+    @staticmethod
+    def _env_aliases(tree: ast.AST) -> Set[str]:
+        aliases: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == 'skypilot_tpu.utils':
+                for a in node.names:
+                    if a.name == 'env':
+                        aliases.add(a.asname or 'env')
+            elif isinstance(node, ast.ImportFrom) and \
+                    node.module == 'skypilot_tpu.utils.env':
+                pass   # `from ...env import get` unsupported on
+                # purpose: keep reads greppable as env.get(...)
+        return aliases
+
+    @staticmethod
+    def _first_diff(have: str, want: str) -> str:
+        h, w = have.splitlines(), want.splitlines()
+        for i, (a, b) in enumerate(zip(h, w), 1):
+            if a != b:
+                return f'first drift at line {i}: {a!r} != {b!r}'
+        return f'line count {len(h)} != {len(w)}'

@@ -1,0 +1,215 @@
+"""lock-discipline: attributes a class protects with a lock must
+never be touched outside it.
+
+A class opts in by owning a lock (``self.X = threading.Lock() /
+RLock() / Condition()`` — these classes are exactly the ones shared
+across the engine loop, HTTP handlers, the LB, and watchdog threads).
+The guarded set is learned, not declared:
+
+  * any ``self.A = ...`` (or augmented assign / del) inside a
+    ``with self.X:`` block marks A as guarded by X;
+  * ``# guarded-by: X`` on an assignment line declares the same
+    explicitly (useful for attributes initialised in __init__ and
+    thereafter only read).
+
+Every OTHER access to a guarded attribute — read or write — must
+happen while one of its guarding locks is held, with three escape
+hatches:
+
+  * ``__init__``/``__del__`` are exempt (construction/teardown
+    happen-before/after sharing);
+  * a method whose ``def`` line carries ``# guarded-by: X`` asserts
+    "callers hold X" and is analysed as if X were held;
+  * ``# noqa: lock-discipline`` with a why-comment for deliberate
+    lock-free access (e.g. a monotonic flag read).
+
+Nested functions and lambdas reset the held-lock set: a closure
+defined under a lock may run after it is released (thread targets,
+callbacks), so it must re-acquire or be marked.
+"""
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import FileContext, Pass, Violation
+
+_LOCK_FACTORIES = ('Lock', 'RLock', 'Condition')
+_GUARDED_BY_RE = re.compile(r'#\s*guarded-by:\s*([A-Za-z_][\w]*)')
+_EXEMPT_METHODS = ('__init__', '__del__')
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'A' when node is ``self.A``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id == 'self':
+        return node.attr
+    return None
+
+
+def _lock_factory_call(node: ast.AST) -> bool:
+    """True for threading.Lock() / Lock() / threading.RLock() etc."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_FACTORIES and \
+            isinstance(f.value, ast.Name) and \
+            f.value.id == 'threading':
+        return True
+    return isinstance(f, ast.Name) and f.id in _LOCK_FACTORIES
+
+
+class _ClassAnalysis:
+    """One ClassDef: discover locks, learn the guarded set, then
+    re-walk checking every access against the held-lock context."""
+
+    def __init__(self, ctx: FileContext, cls: ast.ClassDef,
+                 pass_id: str) -> None:
+        self.ctx = ctx
+        self.cls = cls
+        self.pass_id = pass_id
+        self.locks: Set[str] = set()
+        self.guarded: Dict[str, Set[str]] = {}   # attr -> lock names
+        self.violations: List[Violation] = []
+        self._meth = ''
+        self._collecting = True
+
+    def _guard_comment(self, lineno: int) -> Optional[str]:
+        m = _GUARDED_BY_RE.search(self.ctx.line_at(lineno))
+        return m.group(1) if m else None
+
+    def methods(self):
+        for node in self.cls.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield node
+
+    def find_locks(self) -> None:
+        for meth in self.methods():
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign) and \
+                        _lock_factory_call(node.value):
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            self.locks.add(attr)
+
+    def find_guarded_comments(self) -> None:
+        """`# guarded-by: X` on assignment lines (any method)."""
+        for meth in self.methods():
+            for node in ast.walk(meth):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                         ast.AugAssign)):
+                    continue
+                lock = self._guard_comment(node.lineno)
+                if lock is None or lock not in self.locks:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr and attr not in self.locks:
+                        self.guarded.setdefault(
+                            attr, set()).add(lock)
+
+    # ------------------------------------------------- shared walker
+    def _with_locks(self, node: ast.With) -> Set[str]:
+        held = set()
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.locks:
+                held.add(attr)
+        return held
+
+    def _entry_held(self, meth) -> Set[str]:
+        lock = self._guard_comment(meth.lineno)
+        return {lock} if lock in self.locks else set()
+
+    def walk_methods(self, collecting: bool) -> None:
+        self._collecting = collecting
+        for meth in self.methods():
+            if not collecting and meth.name in _EXEMPT_METHODS:
+                continue
+            self._meth = meth.name
+            held = self._entry_held(meth)
+            for stmt in meth.body:
+                self._visit(stmt, held)
+
+    def _visit(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self._visit(item.optional_vars, held)
+            inner = held | self._with_locks(node)
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, _FUNC_NODES):
+            # Closures may outlive the lock scope: reset (a
+            # `# guarded-by:` on the def line re-asserts).
+            lock = self._guard_comment(node.lineno)
+            inner = {lock} if lock in self.locks else set()
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            for stmt in body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, ast.Attribute):
+            self._handle_attr(node, held)
+            # fall through: the value side still needs walking
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    def _handle_attr(self, node: ast.Attribute,
+                     held: Set[str]) -> None:
+        attr = _self_attr(node)
+        if attr is None or attr in self.locks:
+            return
+        if self._collecting:
+            if held and isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.guarded.setdefault(attr, set()).update(held)
+            return
+        if attr not in self.guarded:
+            return
+        if self.guarded[attr] & held:
+            return
+        kind = 'written' if isinstance(
+            node.ctx, (ast.Store, ast.Del)) else 'read'
+        locks = ' or '.join(
+            f'self.{x}' for x in sorted(self.guarded[attr]))
+        self.violations.append(Violation(
+            self.ctx.rel, node.lineno, self.pass_id,
+            f'self.{attr} {kind} in {self._meth}() without holding '
+            f'{locks} — this attribute is written under that lock '
+            f'elsewhere in the class, so lock-free access races '
+            f'other threads; hold the lock, mark the method '
+            f'`# guarded-by: <lock>` if callers hold it, or add '
+            f'`# noqa: lock-discipline` with a why-comment'))
+
+
+class LockDisciplinePass(Pass):
+    id = 'lock-discipline'
+    title = 'lock-guarded attributes never accessed lock-free'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return 'skypilot_tpu' in ctx.rel
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            an = _ClassAnalysis(ctx, node, self.id)
+            an.find_locks()
+            if not an.locks:
+                continue
+            an.find_guarded_comments()
+            an.walk_methods(collecting=True)
+            if not an.guarded:
+                continue
+            an.walk_methods(collecting=False)
+            out.extend(an.violations)
+        return out

@@ -1,0 +1,325 @@
+"""The nine rules ported from the original regex linter onto the
+skyanalyze pass framework, message-compatible with tools/lint.py
+(tests/test_lint.py asserts on these strings).
+
+Rules: unused-import, whitespace (tabs/trailing/line length),
+print-call, loop-host-sync, clock-injection, qos-admission,
+kernel-dispatch, sqlite-discipline, except-pass. Rationale for each
+lives in docs/static_analysis.md; the discipline each enforces is
+documented where the original rule pointed (docs/kernels.md,
+docs/robustness.md, docs/observability.md, docs/qos.md,
+docs/performance.md).
+"""
+import ast
+import re
+from typing import List
+
+from .core import FileContext, Pass, Violation
+
+LINE_LIMIT = 88
+
+# Imports that exist for side effects or re-export by convention.
+_SIDE_EFFECT_OK = {'skypilot_tpu', 'conftest'}
+
+# Modules whose stdout IS the interface — CLI surfaces, console log
+# relays streaming remote job output to the user's terminal, and train
+# examples whose printed lines are the job's log contract.
+_PRINT_OK_PREFIXES = (
+    'skypilot_tpu/cli.py',
+    'skypilot_tpu/check.py',
+    'skypilot_tpu/dashboard.py',            # startup URL banner
+    'skypilot_tpu/utils/command_runner.py',  # remote stdout relay
+    'skypilot_tpu/runtime/log_lib.py',       # job log tailing
+    'skypilot_tpu/runtime/rpc.py',           # log streaming + CLI JSON
+    'skypilot_tpu/backends/tpu_backend.py',  # provision log relay
+    'skypilot_tpu/jobs/core.py',             # jobs logs CLI surface
+    'skypilot_tpu/serve/core.py',            # serve logs CLI surface
+    'skypilot_tpu/parallel/collectives.py',  # bench CLI output
+    'skypilot_tpu/catalog/data_fetchers/',   # fetcher CLI scripts
+    'skypilot_tpu/train/examples/',          # example job stdout
+)
+
+# Audited `except Exception: pass` sites that predate the rule — each
+# swallows on a genuinely-best-effort path (crash-handler broadcast,
+# opt-in usage telemetry, profiler teardown).
+_EXCEPT_PASS_OK = (
+    'skypilot_tpu/infer/engine.py',
+    'skypilot_tpu/usage/usage_lib.py',
+    'skypilot_tpu/utils/profiling.py',
+)
+
+_SQLITE_CONNECT_OK = (
+    'skypilot_tpu/utils/sqlite_utils.py',
+    'skypilot_tpu/serve/serve_state.py',
+)
+
+_INJECTABLE_CLOCK_FILES = ('skypilot_tpu/serve/slo.py',
+                           'skypilot_tpu/utils/timeseries.py',
+                           'skypilot_tpu/train/heartbeat.py',
+                           'skypilot_tpu/train/watchdog.py')
+_CLOCK_CALL_NAMES = ('time', 'monotonic', 'perf_counter')
+
+_NO_SYNC_IN_LOOPS = ('skypilot_tpu/train/sft.py',)
+_SYNC_CALL_NAMES = ('device_get', 'block_until_ready')
+
+_WAITING_PUT_RE = re.compile(r'\._waiting\.put\(')
+_PALLAS_CALL_RE = re.compile(r'\bpallas_call\s*\(')
+_SQLITE_CONNECT_RE = re.compile(r'\bsqlite3\s*\.\s*connect\s*\(')
+
+
+def _in_framework(ctx: FileContext) -> bool:
+    return 'skypilot_tpu' in ctx.rel
+
+
+class UnusedImportPass(Pass):
+    id = 'unused-import'
+    title = 'imports must be used (or re-exported/marked)'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.path.name != '__init__.py'
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        used = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+        out = []
+        for lineno, _full, name in self._imported_names(ctx.tree):
+            if name in used or name in _SIDE_EFFECT_OK:
+                continue
+            # String annotations ('spec_lib.ServiceSpec') and __all__.
+            if re.search(rf'[\'"]{re.escape(name)}\b', ctx.src):
+                continue
+            out.append(Violation(ctx.rel, lineno, self.id,
+                                 f'unused import {name!r}'))
+        return out
+
+    @staticmethod
+    def _imported_names(tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    name = alias.asname or alias.name.split('.')[0]
+                    yield node.lineno, alias.name, name
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == '__future__':
+                    continue
+                for alias in node.names:
+                    if alias.name == '*':
+                        continue
+                    name = alias.asname or alias.name
+                    yield node.lineno, alias.name, name
+
+
+class WhitespacePass(Pass):
+    id = 'whitespace'
+    title = 'no tabs, no trailing whitespace, lines <= 88'
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for i, line in enumerate(ctx.lines, 1):
+            if '\t' in line:
+                out.append(Violation(ctx.rel, i, self.id,
+                                     'tab character'))
+            if line != line.rstrip():
+                out.append(Violation(ctx.rel, i, self.id,
+                                     'trailing whitespace'))
+            if len(line) > LINE_LIMIT and 'http' not in line and \
+                    'pylint:' not in line:
+                out.append(Violation(
+                    ctx.rel, i, self.id,
+                    f'line too long ({len(line)} > {LINE_LIMIT})'))
+        return out
+
+
+class PrintCallPass(Pass):
+    id = 'print-call'
+    title = 'framework code logs through log_utils, not print()'
+
+    def applies(self, ctx: FileContext) -> bool:
+        if not _in_framework(ctx):
+            return False
+        for p in _PRINT_OK_PREFIXES:
+            if p.endswith('/'):
+                if p in ctx.rel:
+                    return False
+            elif ctx.rel.endswith(p):
+                return False
+        return True
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == 'print':
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.id,
+                    'bare print() — use a log_utils logger (or add '
+                    'to the lint allowlist if stdout is this '
+                    'module\'s interface)'))
+        return out
+
+
+class LoopHostSyncPass(Pass):
+    id = 'loop-host-sync'
+    title = 'no device_get/block_until_ready in the sft step loop'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return any(ctx.rel.endswith(p) for p in _NO_SYNC_IN_LOOPS)
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out, seen = [], set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    getattr(f, 'id', '')
+                if name not in _SYNC_CALL_NAMES or node.lineno in seen:
+                    continue
+                seen.add(node.lineno)
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.id,
+                    f'{name}() inside the sft step loop — host syncs '
+                    f'stall the device; pull metrics through '
+                    f'trainer.DeferredMetrics (or add `# noqa` for a '
+                    f'deliberate one-off)'))
+        return out
+
+
+class ClockInjectionPass(Pass):
+    id = 'clock-injection'
+    title = 'SLO/watchdog modules read time via injectable clocks'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return any(ctx.rel.endswith(p)
+                   for p in _INJECTABLE_CLOCK_FILES)
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and
+                    f.attr in _CLOCK_CALL_NAMES and
+                    isinstance(f.value, ast.Name) and
+                    f.value.id == 'time'):
+                continue
+            out.append(Violation(
+                ctx.rel, node.lineno, self.id,
+                f'direct time.{f.attr}() — this module must read '
+                f'time through its injectable clock so SLO math '
+                f'replays deterministically (docs/observability.md), '
+                f'or add `# noqa`'))
+        return out
+
+
+class QosAdmissionPass(Pass):
+    id = 'qos-admission'
+    title = 'infer/ enqueues only through the QoS admission path'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return 'skypilot_tpu/infer/' in ctx.rel
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for i, line in enumerate(ctx.lines, 1):
+            if not _WAITING_PUT_RE.search(line):
+                continue
+            if 'qos-admission' in line:
+                continue
+            out.append(Violation(
+                ctx.rel, i, self.id,
+                'direct ._waiting.put( outside the QoS admission '
+                'path — route through engine.submit so priority '
+                'classing cannot be bypassed (or mark a sanctioned '
+                'admission site with `# qos-admission`)'))
+        return out
+
+
+class KernelDispatchPass(Pass):
+    id = 'kernel-dispatch'
+    title = 'pallas_call only under ops/, via the dispatch ladder'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _in_framework(ctx) and \
+            'skypilot_tpu/ops/' not in ctx.rel
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for i, line in enumerate(ctx.lines, 1):
+            if not _PALLAS_CALL_RE.search(line.split('#', 1)[0]):
+                continue
+            out.append(Violation(
+                ctx.rel, i, self.id,
+                'pallas_call outside skypilot_tpu/ops/ — kernels '
+                'live in ops/ and dispatch through '
+                'ops/dispatch.run_ladder so every shape lowers or '
+                'falls back (or add `# noqa` with a justification)'))
+        return out
+
+
+class SqliteDisciplinePass(Pass):
+    id = 'sqlite-discipline'
+    title = 'state DBs open through utils/sqlite_utils.connect'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _in_framework(ctx) and not any(
+            ctx.rel.endswith(p) for p in _SQLITE_CONNECT_OK)
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for i, line in enumerate(ctx.lines, 1):
+            if not _SQLITE_CONNECT_RE.search(line.split('#', 1)[0]):
+                continue
+            out.append(Violation(
+                ctx.rel, i, self.id,
+                'direct sqlite3.connect( — state DBs are '
+                'multi-process; open them through '
+                'utils/sqlite_utils.connect so the WAL + '
+                'busy-timeout recipe applies (or add `# noqa` with a '
+                'justification)'))
+        return out
+
+
+class ExceptPassPass(Pass):
+    id = 'except-pass'
+    title = 'no silent broad exception swallows'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return _in_framework(ctx) and not any(
+            ctx.rel.endswith(p) for p in _EXCEPT_PASS_OK)
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            broad = (t is None or
+                     (isinstance(t, ast.Name) and
+                      t.id in ('Exception', 'BaseException')) or
+                     (isinstance(t, ast.Attribute) and
+                      t.attr in ('Exception', 'BaseException')))
+            if not broad:
+                continue
+            if len(node.body) != 1 or \
+                    not isinstance(node.body[0], ast.Pass):
+                continue
+            out.append(Violation(
+                ctx.rel, node.lineno, self.id,
+                'except Exception: pass — silent broad swallow; log '
+                'it, narrow the exception, or add `# noqa` with a '
+                'justification'))
+        return out
+
+
+PASSES = [UnusedImportPass(), WhitespacePass(), PrintCallPass(),
+          LoopHostSyncPass(), ClockInjectionPass(), QosAdmissionPass(),
+          KernelDispatchPass(), SqliteDisciplinePass(),
+          ExceptPassPass()]

@@ -1,0 +1,258 @@
+"""registry-consistency: runtime registries and their docs catalogs
+cannot drift.
+
+Three sub-checks, one pass id:
+
+  * fault points — every ``faults.inject('p')`` / ``ainject('p')``
+    call site must have a row in docs/robustness.md's fault-point
+    table (`| \\`point\\` | ...`), and every table row must have a
+    live call site (a stale row documents a drill that no longer
+    exists);
+  * metric families — every ``registry.counter/gauge/histogram``
+    family named ``skyt_*`` must appear in docs (observability.md,
+    qos.md, robustness.md, ...); where the docs attach a label set
+    (``name{a,b}``) it must equal the code's label names. Docs may
+    use brace alternation (``skyt_slo_{good_,}requests_total``);
+  * JobStatus terminal states — the ``_TERMINAL`` set in
+    runtime/job_lib.py must equal the backticked list on the
+    ``Terminal states:`` line of docs/managed-jobs.md.
+
+Sub-checks skip silently when their code-side file is absent (small
+fixture trees exercise one check at a time), but doc-side absence
+with code-side presence is drift and flags.
+"""
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Pass, Project, Violation
+
+_FAULT_DOC_REL = 'docs/robustness.md'
+_JOBS_DOC_REL = 'docs/managed-jobs.md'
+_METRIC_DOC_RELS = ('docs/observability.md', 'docs/qos.md',
+                    'docs/robustness.md', 'docs/serving.md',
+                    'docs/kernels.md', 'docs/performance.md')
+
+# Fault points are dotted (`plane.event`); the dot requirement keeps
+# the kinds table (`| error | ... |`) from matching.
+_FAULT_ROW_RE = re.compile(r'^\|\s*`([a-z0-9_]+\.[a-z0-9_.]+)`\s*\|')
+# A metric token: name chars, with {a,b} alternation groups that are
+# part of the NAME only when followed by more name chars (a trailing
+# {...} group is a label set).
+_METRIC_TOK_RE = re.compile(
+    r'skyt_(?:[a-z0-9_]|\{[a-z0-9_,]*\}(?=[a-z0-9_]))*'
+    r'(?:\{(?P<labels>[a-z0-9_,]+)\})?')
+_TERMINAL_LINE_RE = re.compile(r'^Terminal states?:\s*(.*)$')
+
+
+def _expand_braces(tok: str) -> List[str]:
+    m = re.search(r'\{([^{}]*)\}', tok)
+    if not m:
+        return [tok]
+    out: List[str] = []
+    for alt in m.group(1).split(','):
+        out.extend(_expand_braces(tok[:m.start()] + alt + tok[m.end():]))
+    return out
+
+
+class RegistryConsistencyPass(Pass):
+    id = 'registry-consistency'
+    title = 'fault/metric/JobStatus catalogs match the code'
+    scope = 'project'
+
+    def run_project(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        out += self._check_faults(project)
+        out += self._check_metrics(project)
+        out += self._check_terminal_states(project)
+        return out
+
+    # ---------------------------------------------------- fault points
+    def _check_faults(self, project: Project) -> List[Violation]:
+        sites: Dict[str, Tuple[str, int]] = {}
+        for ctx in project.files:
+            if ctx.tree is None or 'skypilot_tpu' not in ctx.rel or \
+                    ctx.rel.endswith('utils/faults.py'):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in ('inject', 'ainject') and
+                        isinstance(node.func.value, ast.Name) and
+                        node.func.value.id == 'faults' and
+                        node.args and
+                        isinstance(node.args[0], ast.Constant)):
+                    continue
+                sites.setdefault(str(node.args[0].value),
+                                 (ctx.rel, node.lineno))
+        if not sites:
+            return []
+        doc = project.doc(_FAULT_DOC_REL)
+        if doc is None:
+            return []
+        documented: Dict[str, int] = {}
+        for i, line in enumerate(doc.splitlines(), 1):
+            m = _FAULT_ROW_RE.match(line.strip())
+            if m:
+                documented.setdefault(m.group(1), i)
+        out: List[Violation] = []
+        for point, (rel, lineno) in sorted(sites.items()):
+            if point not in documented:
+                out.append(Violation(
+                    rel, lineno, self.id,
+                    f'fault point {point!r} has no row in the '
+                    f'docs/robustness.md fault-point table — every '
+                    f'injectable point is part of the chaos-drill '
+                    f'contract and must be cataloged (point, '
+                    f'location, attrs, supported kinds)'))
+        doc_rel = (project.root / _FAULT_DOC_REL).as_posix()
+        for point, lineno in sorted(documented.items()):
+            if point not in sites:
+                out.append(Violation(
+                    doc_rel, lineno, self.id,
+                    f'fault-point table row {point!r} has no '
+                    f'faults.inject/ainject call site — the drill it '
+                    f'documents no longer exists; delete the row or '
+                    f'restore the point'))
+        return out
+
+    # -------------------------------------------------------- metrics
+    def _metric_families(self, project: Project
+                         ) -> Dict[str, Tuple[str, int,
+                                              Optional[Tuple[str, ...]]]]:
+        fams: Dict[str, Tuple[str, int, Optional[Tuple[str, ...]]]] = {}
+        for ctx in project.files:
+            if ctx.tree is None or 'skypilot_tpu' not in ctx.rel:
+                continue
+            consts = {}
+            for node in ctx.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Constant) and \
+                        isinstance(node.value.value, str):
+                    consts[node.targets[0].id] = node.value.value
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in ('counter', 'gauge',
+                                           'histogram') and node.args):
+                    continue
+                a = node.args[0]
+                name = a.value if isinstance(a, ast.Constant) else \
+                    consts.get(getattr(a, 'id', ''))
+                if not (isinstance(name, str) and
+                        name.startswith('skyt_')):
+                    continue
+                labels: Optional[Tuple[str, ...]] = None
+                largs = node.args[2] if len(node.args) > 2 else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == 'labelnames'), None)
+                if isinstance(largs, (ast.Tuple, ast.List)):
+                    if all(isinstance(e, ast.Constant)
+                           for e in largs.elts):
+                        labels = tuple(e.value for e in largs.elts)
+                fams.setdefault(name, (ctx.rel, node.lineno, labels))
+        return fams
+
+    def _doc_metrics(self, project: Project
+                     ) -> Dict[str, Set[Tuple[str, ...]]]:
+        """name -> set of label tuples seen in docs (() = bare)."""
+        seen: Dict[str, Set[Tuple[str, ...]]] = {}
+        for rel in _METRIC_DOC_RELS:
+            doc = project.doc(rel)
+            if doc is None:
+                continue
+            for m in _METRIC_TOK_RE.finditer(doc):
+                tok = m.group(0)
+                labels = m.group('labels')
+                name_part = tok[:-(len(labels) + 2)] if labels else tok
+                ltuple = tuple(labels.split(',')) if labels else ()
+                for name in _expand_braces(name_part):
+                    name = name.rstrip('_')
+                    if len(name) > len('skyt_'):
+                        seen.setdefault(name, set()).add(ltuple)
+        return seen
+
+    def _check_metrics(self, project: Project) -> List[Violation]:
+        fams = self._metric_families(project)
+        if not fams:
+            return []
+        documented = self._doc_metrics(project)
+        out: List[Violation] = []
+        for name, (rel, lineno, labels) in sorted(fams.items()):
+            if name not in documented:
+                out.append(Violation(
+                    rel, lineno, self.id,
+                    f'metric family {name!r} is not documented in '
+                    f'any docs catalog '
+                    f'({", ".join(_METRIC_DOC_RELS[:2])}, ...) — '
+                    f'operators alert on these; add it where its '
+                    f'plane is described'))
+                continue
+            doc_labelsets = {s for s in documented[name] if s}
+            if labels is not None and doc_labelsets and \
+                    not any(set(s) == set(labels)
+                            for s in doc_labelsets):
+                shown = sorted(doc_labelsets)[0]
+                out.append(Violation(
+                    rel, lineno, self.id,
+                    f'metric family {name!r} label set '
+                    f'{tuple(labels)!r} does not match the '
+                    f'documented label set {shown!r} — fix '
+                    f'whichever is stale'))
+        return out
+
+    # ------------------------------------------------ terminal states
+    def _check_terminal_states(self, project: Project
+                               ) -> List[Violation]:
+        job_lib = next((c for c in project.files if c.rel.endswith(
+            'skypilot_tpu/runtime/job_lib.py')), None)
+        if job_lib is None or job_lib.tree is None:
+            return []
+        terminal: Set[str] = set()
+        lineno = 1
+        for node in job_lib.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == '_TERMINAL' and \
+                    isinstance(node.value, ast.Set):
+                lineno = node.lineno
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Attribute):
+                        terminal.add(elt.attr)
+        if not terminal:
+            return []
+        doc = project.doc(_JOBS_DOC_REL)
+        doc_rel = (project.root / _JOBS_DOC_REL).as_posix()
+        documented: Optional[Set[str]] = None
+        doc_line = 1
+        if doc is not None:
+            for i, line in enumerate(doc.splitlines(), 1):
+                m = _TERMINAL_LINE_RE.match(line.strip())
+                if m:
+                    documented = set(re.findall(r'`([A-Z_]+)`',
+                                                m.group(1)))
+                    doc_line = i
+                    break
+        if documented is None:
+            return [Violation(
+                job_lib.rel, lineno, self.id,
+                f'JobStatus terminal set '
+                f'{sorted(terminal)} has no docs catalog — '
+                f'docs/managed-jobs.md needs a `Terminal states:` '
+                f'line listing each backticked state')]
+        out: List[Violation] = []
+        for s in sorted(terminal - documented):
+            out.append(Violation(
+                job_lib.rel, lineno, self.id,
+                f'terminal JobStatus {s} is missing from the '
+                f'`Terminal states:` catalog in '
+                f'docs/managed-jobs.md'))
+        for s in sorted(documented - terminal):
+            out.append(Violation(
+                doc_rel, doc_line, self.id,
+                f'documented terminal state {s} is not in '
+                f'JobStatus._TERMINAL — the catalog is stale'))
+        return out

@@ -1,0 +1,287 @@
+"""tracer-safety: functions reachable from ``jax.jit`` /
+``pallas_call`` / the dispatch ladder must stay tracer-pure.
+
+Inside a traced function, Python side effects run once at trace time
+(prints fire with tracer reprs, wall-clock reads freeze a single
+stamp into the compiled program, module-global mutation desyncs with
+the cache) and value extraction (``.item()``, ``jax.device_get``,
+``block_until_ready``) either raises a ConcretizationError or forces
+a silent host sync on the hot path — the exact stall class PR 9's
+gang watchdog exists to catch at runtime. This pass moves that to a
+CI line number.
+
+Roots: functions decorated with / passed to ``jax.jit``, kernels
+passed to ``pallas_call``, and callables inside the rung list of a
+``dispatch.run_ladder(...)`` call (the ladder runs rungs at trace
+time). Reachability follows statically-resolvable calls: same-module
+functions, ``from m import f`` names, and ``mod.f(...)`` where
+``mod`` is an imported skypilot_tpu module. Dynamic dispatch
+(methods, higher-order callables) is out of scope — mark such
+boundaries with ``# noqa: tracer-safety`` where needed.
+"""
+import ast
+from pathlib import PurePosixPath
+from typing import Dict, List, Optional, Tuple
+
+from .core import Pass, Project, Violation
+
+_FORBIDDEN_TIME = ('time', 'monotonic', 'perf_counter')
+_FORBIDDEN_SYNC = ('device_get', 'block_until_ready')
+
+# Trace-time infrastructure the dispatch ladder deliberately invokes
+# while jax traces (fault injection, path counters, logging setup):
+# their side effects are the POINT — they fire once per trace, not
+# per step — so they are exempt from the purity scan (they stay part
+# of the reachability walk).
+_EXEMPT_MODULES = (
+    'skypilot_tpu.utils.faults',
+    'skypilot_tpu.utils.log_utils',
+    'skypilot_tpu.utils.metrics',
+    'skypilot_tpu.utils.tracing',
+    'skypilot_tpu.utils.timeline',
+)
+
+FuncKey = Tuple[str, str]          # (module, function name)
+
+
+def _module_name(rel: str) -> Optional[str]:
+    """'skypilot_tpu/ops/attention.py' -> 'skypilot_tpu.ops.attention'
+    (None for files outside the package)."""
+    p = PurePosixPath(rel)
+    parts = list(p.parts)
+    if 'skypilot_tpu' not in parts:
+        return None
+    parts = parts[parts.index('skypilot_tpu'):]
+    parts[-1] = parts[-1][:-3]           # strip .py
+    if parts[-1] == '__init__':
+        parts = parts[:-1]
+    return '.'.join(parts)
+
+
+class _Module:
+    def __init__(self, rel: str, name: str, tree: ast.AST) -> None:
+        self.rel = rel
+        self.name = name
+        self.tree = tree
+        self.functions: Dict[str, ast.AST] = {}
+        self.mod_aliases: Dict[str, str] = {}    # alias -> module name
+        self.func_imports: Dict[str, Tuple[str, str]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith('skypilot_tpu'):
+                        alias = a.asname or a.name.split('.')[0]
+                        self.mod_aliases[alias] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.startswith('skypilot_tpu'):
+                for a in node.names:
+                    alias = a.asname or a.name
+                    # `from pkg import mod` vs `from mod import fn`:
+                    # record both; resolution tries module first.
+                    self.mod_aliases.setdefault(
+                        alias, f'{node.module}.{a.name}')
+                    self.func_imports[alias] = (node.module, a.name)
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jit / jax.jit / functools.partial(jax.jit, ...)"""
+    if isinstance(node, ast.Name) and node.id == 'jit':
+        return True
+    if isinstance(node, ast.Attribute) and node.attr == 'jit':
+        return True
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, (ast.Name, ast.Attribute)):
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id
+        if fname == 'partial' and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class TracerSafetyPass(Pass):
+    id = 'tracer-safety'
+    title = 'jit/pallas-reachable functions stay tracer-pure'
+    scope = 'project'
+
+    def run_project(self, project: Project) -> List[Violation]:
+        modules: Dict[str, _Module] = {}
+        for ctx in project.files:
+            if ctx.tree is None or 'skypilot_tpu' not in ctx.rel:
+                continue
+            name = _module_name(ctx.rel)
+            if name is None:
+                continue
+            modules[name] = _Module(ctx.rel, name, ctx.tree)
+
+        roots = self._find_roots(modules)
+        reached = self._reach(modules, roots)
+        out: List[Violation] = []
+        for (mod, fname), root in sorted(reached.items()):
+            if mod in _EXEMPT_MODULES:
+                continue
+            m = modules.get(mod)
+            fn = m.functions.get(fname) if m else None
+            if fn is None:
+                continue
+            out.extend(self._scan(m, fn, root))
+        return out
+
+    # ----------------------------------------------------- call graph
+    def _resolve(self, m: _Module, call: ast.Call,
+                 modules: Dict[str, _Module]
+                 ) -> Optional[FuncKey]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in m.functions:
+                return (m.name, f.id)
+            if f.id in m.func_imports:
+                src_mod, src_name = m.func_imports[f.id]
+                tgt = modules.get(src_mod)
+                if tgt and src_name in tgt.functions:
+                    return (src_mod, src_name)
+            return None
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name):
+            mod_name = m.mod_aliases.get(f.value.id)
+            if mod_name:
+                tgt = modules.get(mod_name)
+                if tgt and f.attr in tgt.functions:
+                    return (mod_name, f.attr)
+        return None
+
+    def _name_target(self, m: _Module, node: ast.AST,
+                     modules: Dict[str, _Module]
+                     ) -> Optional[FuncKey]:
+        """Resolve a bare function REFERENCE (not call)."""
+        if isinstance(node, ast.Name):
+            if node.id in m.functions:
+                return (m.name, node.id)
+            if node.id in m.func_imports:
+                src_mod, src_name = m.func_imports[node.id]
+                tgt = modules.get(src_mod)
+                if tgt and src_name in tgt.functions:
+                    return (src_mod, src_name)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            mod_name = m.mod_aliases.get(node.value.id)
+            if mod_name:
+                tgt = modules.get(mod_name)
+                if tgt and node.attr in tgt.functions:
+                    return (mod_name, node.attr)
+        return None
+
+    def _find_roots(self, modules: Dict[str, _Module]
+                    ) -> Dict[FuncKey, str]:
+        roots: Dict[FuncKey, str] = {}
+        for m in modules.values():
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if _is_jit_expr(dec):
+                            roots.setdefault(
+                                (m.name, node.name),
+                                f'@jit {node.name}')
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else \
+                    getattr(f, 'id', '')
+                if fname == 'jit' and node.args:
+                    tgt = self._name_target(m, node.args[0], modules)
+                    if tgt:
+                        roots.setdefault(tgt, f'jax.jit({tgt[1]})')
+                elif fname == 'pallas_call' and node.args:
+                    tgt = self._name_target(m, node.args[0], modules)
+                    if tgt:
+                        roots.setdefault(
+                            tgt, f'pallas_call({tgt[1]})')
+                elif fname == 'run_ladder':
+                    # Everything callable inside the rung list runs
+                    # at trace time.
+                    for arg in node.args[1:]:
+                        for sub in ast.walk(arg):
+                            tgt = None
+                            if isinstance(sub, ast.Call):
+                                tgt = self._resolve(m, sub, modules)
+                            if tgt:
+                                roots.setdefault(
+                                    tgt, f'run_ladder rung ({tgt[1]})')
+        return roots
+
+    def _reach(self, modules: Dict[str, _Module],
+               roots: Dict[FuncKey, str]) -> Dict[FuncKey, str]:
+        reached: Dict[FuncKey, str] = {}
+        stack = list(roots.items())
+        while stack:
+            key, via = stack.pop()
+            if key in reached:
+                continue
+            reached[key] = via
+            m = modules.get(key[0])
+            fn = m.functions.get(key[1]) if m else None
+            if fn is None:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    tgt = self._resolve(m, node, modules)
+                    if tgt and tgt not in reached:
+                        stack.append((tgt, via))
+        return reached
+
+    # ------------------------------------------------ forbidden scan
+    def _scan(self, m: _Module, fn: ast.AST,
+              root: str) -> List[Violation]:
+        out: List[Violation] = []
+
+        def flag(lineno: int, what: str, why: str) -> None:
+            out.append(Violation(
+                m.rel, lineno, self.id,
+                f'{what} in {fn.name}() (reachable from {root}) — '
+                f'{why}; hoist it out of the traced function or add '
+                f'`# noqa: tracer-safety` with a why-comment'))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                flag(node.lineno, 'global-statement mutation',
+                     'module state mutated under trace desyncs with '
+                     'the compilation cache')
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id == 'print':
+                    flag(node.lineno, 'print()',
+                         'it fires at trace time with tracer reprs '
+                         '(use jax.debug.print for runtime values)')
+                elif f.id in _FORBIDDEN_SYNC:
+                    flag(node.lineno, f'{f.id}()',
+                         'host syncs under trace stall the device '
+                         'pipeline')
+            elif isinstance(f, ast.Attribute):
+                if f.attr == 'item' and not node.args:
+                    flag(node.lineno, '.item()',
+                         'concretizes a tracer (ConcretizationError '
+                         'at trace time, host sync at best)')
+                elif f.attr in _FORBIDDEN_SYNC:
+                    flag(node.lineno, f'{f.attr}()',
+                         'host syncs under trace stall the device '
+                         'pipeline')
+                elif f.attr in _FORBIDDEN_TIME and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == 'time':
+                    flag(node.lineno, f'time.{f.attr}()',
+                         'a wall-clock read freezes one trace-time '
+                         'stamp into the compiled program')
+                elif f.attr == 'now' and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ('datetime', 'dt'):
+                    flag(node.lineno, 'datetime.now()',
+                         'a wall-clock read freezes one trace-time '
+                         'stamp into the compiled program')
+        return out

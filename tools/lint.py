@@ -1,385 +1,103 @@
 #!/usr/bin/env python3
-"""Dependency-free linter (the image ships no ruff/pylint/mypy; the
-reference gates commits on format.sh — this is the offline equivalent).
+"""CLI entry point for skyanalyze (tools/analysis) — the
+dependency-free AST static analyzer that replaced the original
+regex linter. Same invocation format.sh and tests/test_lint.py have
+always used; exit 0 = clean.
 
-Checks:
-  * syntax (ast.parse)
-  * unused imports (module scope and function scope, string-match
-    aware for __all__/docstring re-exports)
-  * tabs and trailing whitespace
-  * lines over the limit (default 88)
-  * bare print() in skypilot_tpu/ — framework code must log through
-    utils/log_utils loggers so serving/metrics output stays structured
-    (exceptions: the console-surface allowlist below, or `# noqa`)
-  * host syncs (jax.device_get / block_until_ready) inside loops in
-    train/sft.py — the step loop must stay off the device's critical
-    path; metrics pulls go through trainer.DeferredMetrics
-    (docs/performance.md). Mark deliberate exceptions with `# noqa`.
-  * silent broad swallows (`except Exception: pass` and bare
-    `except: pass`) in skypilot_tpu/ — a robustness-first codebase
-    must at least log what it ignores (docs/robustness.md). The
-    audited pre-existing sites live in _EXCEPT_PASS_OK; new deliberate
-    ones need `# noqa` plus a comment saying why.
-  * direct `._waiting.put(` callsites in skypilot_tpu/infer/ outside
-    the QoS admission path (docs/qos.md) — with SKYT_QOS=1 the waiting
-    queue is the priority scheduler, and code enqueueing around the
-    sanctioned sites would bypass classing silently. The sanctioned
-    sites carry a `qos-admission` marker comment.
-  * bare `pl.pallas_call(` outside skypilot_tpu/ops/ — every kernel
-    must live in ops/ and route through the dispatch ladder
-    (ops/dispatch.py, docs/kernels.md) so it inherits shape-robust
-    block selection, the XLA fallback rung, and kernel-path metrics.
-    A Pallas call elsewhere would reintroduce the BENCH_r02 class of
-    hard lowering crash. Mark a deliberate exception with `# noqa`.
-  * direct `sqlite3.connect(` in skypilot_tpu/ outside
-    utils/sqlite_utils.py (and serve/serve_state.py, which owns the
-    serve.db open-with-integrity-check) — every state DB is shared
-    across processes (controller, standby LB, client CLI), and a raw
-    connect misses the WAL + busy-timeout recipe that makes that safe
-    (docs/robustness.md "Control plane"). `# noqa` for deliberate
-    exceptions.
-  * direct `time.time()` / `time.monotonic()` (and perf_counter)
-    calls in serve/slo.py, utils/timeseries.py, train/heartbeat.py and
-    train/watchdog.py — those modules take INJECTABLE clocks so SLO
-    burn-rate math and the gang watchdog's hang/straggler truth table
-    replay deterministically in tests (docs/observability.md); a stray
-    wall-clock call would fork the timeline. Referencing `time.time`
-    as a default clock argument is fine — only calls flag. `# noqa`
-    escape hatch.
+    python tools/lint.py                    full tree, human output
+    python tools/lint.py path [path ...]    file passes on those paths
+    python tools/lint.py --json OUT.json    also write the JSON
+                                            artifact (tpu_validation.sh
+                                            archives it with probe.json)
+    python tools/lint.py --write-env-docs   regenerate docs/env_vars.md
+                                            from the env registry
 
-Exit 0 = clean. Used by format.sh and tests/test_lint.py.
+Passes (catalog + noqa grammar: docs/static_analysis.md):
+  * the nine rules ported from the regex linter — unused-import,
+    whitespace, print-call, loop-host-sync, clock-injection,
+    qos-admission, kernel-dispatch, sqlite-discipline, except-pass —
+    plus the syntax gate;
+  * lock-discipline — attributes written under a class's lock are
+    never accessed lock-free (the PR 7/9 review-race class);
+  * async-blocking — no time.sleep / sync HTTP / sqlite / file I/O
+    on the serve/infer event loops;
+  * tracer-safety — functions reachable from jax.jit / pallas_call /
+    the dispatch ladder stay tracer-pure;
+  * env-registry — every SKYT_* read resolves through
+    utils/env.py, and docs/env_vars.md is generated + fresh;
+  * registry-consistency — fault points, metric families, and
+    JobStatus terminal states match their docs catalogs.
+
+Project-wide passes (the last three) run only in full-tree mode (no
+explicit path arguments) — linting one file stays fast and local.
 """
-import ast
-import re
 import sys
 from pathlib import Path
 
-LINE_LIMIT = 88
+_HERE = Path(__file__).resolve().parent
+_REPO = _HERE.parent
+if str(_HERE) not in sys.path:
+    sys.path.insert(0, str(_HERE))
 
-# Imports that exist for side effects or re-export by convention.
-_SIDE_EFFECT_OK = {'skypilot_tpu', 'conftest'}
-
-# Modules whose stdout IS the interface — CLI surfaces, console log
-# relays streaming remote job output to the user's terminal, and train
-# examples whose printed lines are the job's log contract. Everything
-# else under skypilot_tpu/ must use log_utils loggers; mark deliberate
-# one-off exceptions with `# noqa`.
-_PRINT_OK_PREFIXES = (
-    'skypilot_tpu/cli.py',
-    'skypilot_tpu/check.py',
-    'skypilot_tpu/dashboard.py',            # startup URL banner
-    'skypilot_tpu/utils/command_runner.py',  # remote stdout relay
-    'skypilot_tpu/runtime/log_lib.py',       # job log tailing
-    'skypilot_tpu/runtime/rpc.py',           # log streaming + CLI JSON
-    'skypilot_tpu/backends/tpu_backend.py',  # provision log relay
-    'skypilot_tpu/jobs/core.py',             # jobs logs CLI surface
-    'skypilot_tpu/serve/core.py',            # serve logs CLI surface
-    'skypilot_tpu/parallel/collectives.py',  # bench CLI output
-    'skypilot_tpu/catalog/data_fetchers/',   # fetcher CLI scripts
-    'skypilot_tpu/train/examples/',          # example job stdout
-)
+from analysis import core as _core          # noqa: E402
 
 
-# Audited `except Exception: pass` sites that predate the lint rule —
-# each swallows on a genuinely-best-effort path (crash-handler
-# broadcast, opt-in usage telemetry, profiler teardown). New silent
-# swallows must log, narrow the exception, or carry `# noqa`.
-_EXCEPT_PASS_OK = (
-    'skypilot_tpu/infer/engine.py',
-    'skypilot_tpu/usage/usage_lib.py',
-    'skypilot_tpu/utils/profiling.py',
-)
+def check_file(path):
+    """Single-file API kept for tests/test_lint.py: formatted issue
+    strings from every file-scoped pass."""
+    return _core.check_file(path)
 
 
-def _except_pass_issues(path: Path, tree, lines):
-    """Flag broad exception handlers whose entire body is `pass`."""
-    issues = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        t = node.type
-        broad = (t is None or
-                 (isinstance(t, ast.Name) and
-                  t.id in ('Exception', 'BaseException')) or
-                 (isinstance(t, ast.Attribute) and
-                  t.attr in ('Exception', 'BaseException')))
-        if not broad:
-            continue
-        if len(node.body) != 1 or not isinstance(node.body[0], ast.Pass):
-            continue
-        if node.lineno <= len(lines) and 'noqa' in lines[node.lineno - 1]:
-            continue
-        issues.append(
-            f'{path}:{node.lineno}: except Exception: pass — silent '
-            f'broad swallow; log it, narrow the exception, or add '
-            f'`# noqa` with a justification')
-    return issues
-
-
-# QoS admission discipline (docs/qos.md): the engine's waiting queue
-# is the ONE priority-scheduling point — new code in infer/ must route
-# requests through engine.submit / the lockstep tick sync, never
-# enqueue directly. Sanctioned sites are marked `qos-admission`.
-_WAITING_PUT_RE = re.compile(r'\._waiting\.put\(')
-
-
-def _waiting_put_issues(path: Path, lines):
-    issues = []
-    for i, line in enumerate(lines, 1):
-        if not _WAITING_PUT_RE.search(line):
-            continue
-        if 'qos-admission' in line or 'noqa' in line:
-            continue
-        issues.append(
-            f'{path}:{i}: direct ._waiting.put( outside the QoS '
-            f'admission path — route through engine.submit so '
-            f'priority classing cannot be bypassed (or mark a '
-            f'sanctioned admission site with `# qos-admission`)')
-    return issues
-
-
-# Kernel discipline (docs/kernels.md): pl.pallas_call may only appear
-# under skypilot_tpu/ops/ — call sites elsewhere go through the
-# dispatch ladder, which guarantees a legal block spec or an XLA
-# fallback. Comments are stripped before matching so prose can't flag;
-# a docstring mentioning the literal call form still would — mark
-# those (and deliberate exceptions) with `# noqa`.
-_PALLAS_CALL_RE = re.compile(r'\bpallas_call\s*\(')
-
-
-def _pallas_call_issues(path: Path, lines):
-    issues = []
-    for i, line in enumerate(lines, 1):
-        if not _PALLAS_CALL_RE.search(line.split('#', 1)[0]):
-            continue
-        if 'noqa' in line:
-            continue
-        issues.append(
-            f'{path}:{i}: pallas_call outside skypilot_tpu/ops/ — '
-            f'kernels live in ops/ and dispatch through '
-            f'ops/dispatch.run_ladder so every shape lowers or falls '
-            f'back (or add `# noqa` with a justification)')
-    return issues
-
-
-# State-DB discipline (docs/robustness.md "Control plane"): every
-# sqlite connection in framework code goes through
-# utils/sqlite_utils.connect — WAL + busy-timeout is what lets the
-# controller, a standby LB, and the client CLI share one DB without
-# 'database is locked' flakes. serve_state.py additionally wraps the
-# open in its corrupt/fail-fast check and may own raw pragmas.
-_SQLITE_CONNECT_RE = re.compile(r'\bsqlite3\s*\.\s*connect\s*\(')
-_SQLITE_CONNECT_OK = (
-    'skypilot_tpu/utils/sqlite_utils.py',
-    'skypilot_tpu/serve/serve_state.py',
-)
-
-
-def _sqlite_connect_issues(path: Path, lines):
-    issues = []
-    for i, line in enumerate(lines, 1):
-        if not _SQLITE_CONNECT_RE.search(line.split('#', 1)[0]):
-            continue
-        if 'noqa' in line:
-            continue
-        issues.append(
-            f'{path}:{i}: direct sqlite3.connect( — state DBs are '
-            f'multi-process; open them through '
-            f'utils/sqlite_utils.connect so the WAL + busy-timeout '
-            f'recipe applies (or add `# noqa` with a justification)')
-    return issues
-
-
-# Clock discipline (docs/observability.md "Fleet plane" + "Training
-# plane"): these files implement windowed SLO/burn-rate math and the
-# heartbeat/watchdog stall budgets that tests replay under fake clocks
-# — every timestamp must come through the injected clock, so a direct
-# wall-clock CALL is a determinism bug. Default arguments like
-# `clock=time.time` are references, not calls, and pass.
-_INJECTABLE_CLOCK_FILES = ('skypilot_tpu/serve/slo.py',
-                           'skypilot_tpu/utils/timeseries.py',
-                           'skypilot_tpu/train/heartbeat.py',
-                           'skypilot_tpu/train/watchdog.py')
-_CLOCK_CALL_NAMES = ('time', 'monotonic', 'perf_counter')
-
-
-def _clock_call_issues(path: Path, tree, lines):
-    issues = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if not (isinstance(f, ast.Attribute) and
-                f.attr in _CLOCK_CALL_NAMES and
-                isinstance(f.value, ast.Name) and f.value.id == 'time'):
-            continue
-        if node.lineno <= len(lines) and 'noqa' in lines[node.lineno - 1]:
-            continue
-        issues.append(
-            f'{path}:{node.lineno}: direct time.{f.attr}() — this '
-            f'module must read time through its injectable clock so '
-            f'SLO math replays deterministically '
-            f'(docs/observability.md), or add `# noqa`')
-    return issues
-
-
-# Files whose loops may not contain host-sync calls: the sft step loop
-# is the train hot path — one bare jax.device_get per step serializes
-# host and device (the deferred-metrics helper in train/trainer.py is
-# the sanctioned pull point, one step behind the chain's head).
-_NO_SYNC_IN_LOOPS = ('skypilot_tpu/train/sft.py',)
-_SYNC_CALL_NAMES = ('device_get', 'block_until_ready')
-
-
-def _loop_sync_issues(path: Path, tree, lines):
-    """Flag device_get/block_until_ready calls inside any loop."""
-    issues = []
-    seen = set()
-    for loop in ast.walk(tree):
-        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
-            continue
-        for node in ast.walk(loop):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            name = f.attr if isinstance(f, ast.Attribute) else \
-                getattr(f, 'id', '')
-            if name not in _SYNC_CALL_NAMES or node.lineno in seen:
-                continue
-            if node.lineno <= len(lines) and \
-                    'noqa' in lines[node.lineno - 1]:
-                continue
-            seen.add(node.lineno)
-            issues.append(
-                f'{path}:{node.lineno}: {name}() inside the sft step '
-                f'loop — host syncs stall the device; pull metrics '
-                f'through trainer.DeferredMetrics (or add `# noqa` '
-                f'for a deliberate one-off)')
-    return issues
-
-
-def _print_allowed(path: Path) -> bool:
-    posix = path.as_posix()
-    for p in _PRINT_OK_PREFIXES:
-        if p.endswith('/'):
-            if p in posix:
-                return True
-        elif posix.endswith(p):
-            return True
-    return False
-
-
-def _imported_names(tree):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = alias.asname or alias.name.split('.')[0]
-                yield node.lineno, alias.name, name
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == '__future__':
-                continue
-            for alias in node.names:
-                if alias.name == '*':
-                    continue
-                name = alias.asname or alias.name
-                yield node.lineno, alias.name, name
-
-
-def check_file(path: Path):
-    issues = []
-    src = path.read_text(encoding='utf-8')
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [f'{path}:{e.lineno}: syntax error: {e.msg}']
-
-    is_init = path.name == '__init__.py'
-    lines = src.splitlines()
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            pass  # base captured via its Name node
-    # Names referenced inside strings (docstring examples, __all__).
-    text_blob = src
-    if not is_init:
-        for lineno, _full, name in _imported_names(tree):
-            if name in used or name in _SIDE_EFFECT_OK:
-                continue
-            if lineno <= len(lines) and 'noqa' in lines[lineno - 1]:
-                continue
-            # String annotations ('spec_lib.ServiceSpec') and __all__.
-            if re.search(rf'[\'"]{re.escape(name)}\b', text_blob):
-                continue
-            issues.append(f'{path}:{lineno}: unused import {name!r}')
-
-    if any(path.as_posix().endswith(p) for p in _NO_SYNC_IN_LOOPS):
-        issues += _loop_sync_issues(path, tree, lines)
-
-    if any(path.as_posix().endswith(p)
-           for p in _INJECTABLE_CLOCK_FILES):
-        issues += _clock_call_issues(path, tree, lines)
-
-    if 'skypilot_tpu/infer/' in path.as_posix():
-        issues += _waiting_put_issues(path, lines)
-
-    if 'skypilot_tpu' in path.as_posix() and \
-            'skypilot_tpu/ops/' not in path.as_posix():
-        issues += _pallas_call_issues(path, lines)
-
-    if 'skypilot_tpu' in path.as_posix() and not any(
-            path.as_posix().endswith(p) for p in _SQLITE_CONNECT_OK):
-        issues += _sqlite_connect_issues(path, lines)
-
-    if 'skypilot_tpu' in path.as_posix() and not any(
-            path.as_posix().endswith(p) for p in _EXCEPT_PASS_OK):
-        issues += _except_pass_issues(path, tree, lines)
-
-    if 'skypilot_tpu' in path.as_posix() and not _print_allowed(path):
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Name) and \
-                    node.func.id == 'print':
-                if node.lineno <= len(lines) and \
-                        'noqa' in lines[node.lineno - 1]:
-                    continue
-                issues.append(
-                    f'{path}:{node.lineno}: bare print() — use a '
-                    f'log_utils logger (or add to the lint allowlist '
-                    f'if stdout is this module\'s interface)')
-
-    for i, line in enumerate(src.splitlines(), 1):
-        if '\t' in line:
-            issues.append(f'{path}:{i}: tab character')
-        if line != line.rstrip():
-            issues.append(f'{path}:{i}: trailing whitespace')
-        if len(line) > LINE_LIMIT and 'http' not in line and \
-                'noqa' not in line and 'pylint:' not in line:
-            issues.append(f'{path}:{i}: line too long '
-                          f'({len(line)} > {LINE_LIMIT})')
-    return issues
+def write_env_docs() -> Path:
+    """Regenerate docs/env_vars.md from the env registry."""
+    from analysis import env_registry
+    mod = env_registry._load_registry(
+        _REPO / 'skypilot_tpu' / 'utils' / 'env.py')
+    out = _REPO / 'docs' / 'env_vars.md'
+    out.write_text(mod.generate_docs(), encoding='utf-8')
+    return out
 
 
 def main(argv):
-    roots = argv or ['skypilot_tpu', 'tests', 'tools', 'bench.py',
-                     '__graft_entry__.py']
-    files = []
-    for root in roots:
-        p = Path(root)
-        if p.is_dir():
-            files += sorted(p.rglob('*.py'))
-        elif p.exists():
-            files.append(p)
-    all_issues = []
-    for f in files:
-        if '__pycache__' in str(f):
-            continue
-        all_issues += check_file(f)
-    for issue in all_issues:
-        print(issue)
-    print(f'{len(files)} files checked, {len(all_issues)} issue(s)')
-    return 1 if all_issues else 0
+    json_path = None
+    roots = []
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a == '--json':
+            if not args:
+                print('--json needs an output path')
+                return 2
+            json_path = args.pop(0)
+        elif a == '--write-env-docs':
+            path = write_env_docs()
+            print(f'wrote {path}')
+            return 0
+        else:
+            roots.append(a)
+
+    # Explicit paths = file passes only; full default tree = file +
+    # project passes, rooted at the repo (independent of cwd).
+    if roots:
+        root, project = Path('.'), False
+        if any(Path(r).is_absolute() for r in roots):
+            root = Path('/')
+            roots = [str(Path(r).resolve().relative_to(root))
+                     for r in roots]
+    else:
+        root, project = _REPO, True
+        if Path.cwd() == _REPO:
+            root = Path('.')
+    violations = _core.analyze(root, roots or None,
+                               project_passes=project)
+    files = _core.count_files(root, roots or None)
+    for v in violations:
+        print(v.format())
+    print(f'{files} files checked, {len(violations)} issue(s)')
+    if json_path:
+        Path(json_path).write_text(
+            _core.render_json(violations, files), encoding='utf-8')
+    return 1 if violations else 0
 
 
 if __name__ == '__main__':

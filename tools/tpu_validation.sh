@@ -45,6 +45,12 @@ step() {  # step <name> <cmd...>: run, tee, record PASS/FAIL
     fi
 }
 
+echo "== 0. skyanalyze (static analysis; costs no chip time) =="
+# Archived alongside probe.json: a red analyzer is visible in the
+# same bundle as a red probe (docs/static_analysis.md). Not gating —
+# the chip window is the scarce resource — but FAIL is recorded.
+step skyanalyze python tools/lint.py --json "$OUT/skyanalyze.json"
+
 echo "== 1. probe =="
 PROBE_TIMEOUT=${SKYT_TPU_PROBE_TIMEOUT_S:-45}
 if ! timeout "$PROBE_TIMEOUT" python -c "import jax; print(jax.devices())"; then
