@@ -48,6 +48,7 @@ PHASE_DEADLINES = {
     'tracing overhead bench': 420,
     'chaos recovery bench': 600,
     'overload bench': 420,
+    'affinity bench': 600,
     'slo report bench': 420,
     'watchdog overhead bench': 300,
 }
@@ -1138,6 +1139,199 @@ def chaos_recovery_metrics() -> list:
                 os.environ[k] = v
 
 
+def affinity_ab_metrics() -> list:
+    """Prefix-affinity A/B phase (CPU-runnable, docs/serving.md
+    "N-active front door"): the same multi-turn / shared-prefix
+    workload through the SAME two paged-cache replicas, once behind a
+    round-robin LB (affinity off) and once behind a prefix_affinity
+    LB (consistent-hash ring + sticky sessions). Emits each
+    condition's prefix-cache hit rate (hit pages / (hit + miss), from
+    the replicas' own counters), the requests-per-chip-second proxy,
+    and the sticky re-hash count.
+
+    Acceptance: hit rate strictly higher with affinity ON (multi-turn
+    prompts re-land where their prefix KV pages live instead of
+    alternating replicas), and affinity_sticky_rehashes == 0 (a
+    session is never re-hashed while its replica stays ready).
+    """
+    import socket
+    import threading
+
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    # Parked controller sync (same rationale as the chaos phase: the
+    # daemon LB threads outlive the phase).
+    os.environ['SKYT_SERVE_LB_SYNC_INTERVAL'] = '3600'
+    engines = []
+    try:
+        urls = []
+        for _ in range(2):
+            # Paged cache + prefix caching ON — the thing under test.
+            # pool_tokens is sized so the workload's distinct prefixes
+            # fit without eviction noise.
+            # (the debug model caps max_seq_len at 128)
+            eng = server_lib.build_engine(
+                'debug', num_slots=2, max_seq_len=128,
+                decode_chunk=2, cache_mode='paged',
+                prefix_caching=True, pool_tokens=16384)
+            eng.start()
+            engines.append(eng)
+            srv = server_lib.InferenceServer(eng)
+            port = free_port()
+            threading.Thread(target=lambda app=srv.make_app(),
+                             p=port: web.run_app(
+                                 app, port=p, print=None,
+                                 handle_signals=False),
+                             daemon=True).start()
+            urls.append(f'http://127.0.0.1:{port}')
+        sess = requests.Session()
+        for url in urls:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    if sess.get(url + '/health',
+                                timeout=2).status_code == 200:
+                        break
+                except requests.RequestException:
+                    pass
+                time.sleep(0.2)
+            else:
+                raise RuntimeError(f'replica {url} never healthy')
+
+        def make_lb(policy):
+            port = free_port()
+            lb = lb_lib.SkyServeLoadBalancer(
+                'http://127.0.0.1:9', port, policy=policy,
+                metrics_registry=metrics_lib.MetricsRegistry())
+            lb.policy.set_ready_replicas(urls)
+            threading.Thread(target=lambda: web.run_app(
+                lb.make_app(), port=port, print=None,
+                handle_signals=False), daemon=True).start()
+            base = f'http://127.0.0.1:{port}'
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    sess.get(base + '/metrics', timeout=2)
+                    break
+                except requests.RequestException:
+                    time.sleep(0.2)
+            return base
+
+        def cache_counters():
+            # /stats exposes the pool's live hit/miss page counts
+            # (the /metrics mirrors sync on engine-loop ticks — an
+            # idle engine may lag a scrape taken right after the last
+            # response).
+            hits = misses = 0.0
+            for url in urls:
+                block = sess.get(url + '/stats', timeout=5).json() \
+                    .get('prefix_cache', {})
+                hits += float(block.get('hit_pages', 0))
+                misses += float(block.get('miss_pages', 0))
+            return hits, misses
+
+        # page_size=64: a 64-token conversation base is one FULL page
+        # of publishable prefix KV; each turn appends 8 tokens, so
+        # every turn after the first re-reads that page — IF it lands
+        # on the replica that cached it (the debug model caps
+        # max_seq_len at 128, so the conversation stays under one
+        # extra page). n_convs is ODD on purpose: with an even count,
+        # strict round-robin accidentally parity-pins every
+        # conversation to one replica and the OFF condition measures
+        # affinity too.
+        n_convs, n_turns = 7, 5
+
+        # Warm every (replica, bucket) compile BEFORE either
+        # condition: the first condition must not pay the pow2-bucket
+        # prefill compiles the second then amortizes.
+        for url in urls:
+            for turn in range(n_turns):
+                sess.post(url + '/generate',
+                          json={'tokens': [(9000 + turn * 131 + j)
+                                           % 30000
+                                           for j in range(64 + turn * 8)],
+                                'max_tokens': 2},
+                          timeout=300).raise_for_status()
+
+        def run_condition(base, cond):
+            offset = 50 + cond * 7000
+            convs = {
+                i: [(offset + i * 997 + j) % 30000 for j in range(64)]
+                for i in range(n_convs)}
+            homes = {}
+            rehashes = 0
+            n_requests = 0
+            h0, m0 = cache_counters()
+            t0 = time.perf_counter()
+            for turn in range(n_turns):
+                for i in range(n_convs):
+                    prompt = convs[i] + [
+                        (offset + i * 997 + 64 + k) % 30000
+                        for k in range(turn * 8)]
+                    r = sess.post(
+                        base + '/generate',
+                        json={'tokens': prompt, 'max_tokens': 2},
+                        headers={'X-Session-Id': f'conv-{cond}-{i}'},
+                        timeout=120)
+                    r.raise_for_status()
+                    n_requests += 1
+                    rep = r.headers.get('X-Replica-Id')
+                    if i in homes and homes[i] != rep:
+                        rehashes += 1
+                    homes[i] = rep
+            elapsed = time.perf_counter() - t0
+            h1, m1 = cache_counters()
+            dh, dm = h1 - h0, m1 - m0
+            rate = dh / (dh + dm) if (dh + dm) > 0 else 0.0
+            rps_chip = n_requests / elapsed / len(urls)
+            return rate, rps_chip, rehashes
+
+        base_off = make_lb('round_robin')
+        rate_off, rps_off, _ = run_condition(base_off, 0)
+        base_on = make_lb('prefix_affinity')
+        rate_on, rps_on, rehashes_on = run_condition(base_on, 1)
+        print(f'# affinity A/B: prefix hit rate off={rate_off:.3f} '
+              f'on={rate_on:.3f}, req/chip/s off={rps_off:.2f} '
+              f'on={rps_on:.2f}, sticky rehashes={rehashes_on}',
+              file=sys.stderr)
+        return [
+            {'metric': 'affinity_prefix_hit_rate_off',
+             'value': round(rate_off, 4), 'unit': 'fraction',
+             'vs_baseline': None},
+            # Acceptance: > 1.0 (strictly higher hit rate with
+            # affinity on for the multi-turn/shared-prefix workload).
+            {'metric': 'affinity_prefix_hit_rate_on',
+             'value': round(rate_on, 4), 'unit': 'fraction',
+             'vs_baseline': (round(rate_on / rate_off, 4)
+                             if rate_off > 0 else None)},
+            {'metric': 'affinity_requests_per_chip_s_off',
+             'value': round(rps_off, 3), 'unit': 'req/chip/s',
+             'vs_baseline': None},
+            {'metric': 'affinity_requests_per_chip_s_on',
+             'value': round(rps_on, 3), 'unit': 'req/chip/s',
+             'vs_baseline': (round(rps_on / rps_off, 4)
+                             if rps_off > 0 else None)},
+            # Acceptance: exactly 0 — sticky sessions are never
+            # re-hashed while their replica stays ready.
+            {'metric': 'affinity_sticky_rehashes',
+             'value': rehashes_on, 'unit': 'requests',
+             'vs_baseline': None},
+        ]
+    finally:
+        for eng in engines:
+            eng.stop()
+
+
 def watchdog_overhead_metrics() -> list:
     """Heartbeat hot-path cost (CPU-runnable): per-step wall delta of
     hb.on_step (file-backed, interval-throttled — the exact sft call)
@@ -1579,6 +1773,19 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# overload bench failed: {e!r}', file=sys.stderr)
+
+    # Affinity A/B phase: prefix-cache hit rate + requests/chip with
+    # consistent-hash prefix-affinity routing on vs off, same
+    # multi-turn workload, same two paged replicas. CPU-runnable.
+    if on_tpu:
+        _reclaim_hbm('pre-affinity')
+    try:
+        with phase_deadline(PHASE_DEADLINES['affinity bench'],
+                            'affinity bench'):
+            extra = extra + affinity_ab_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# affinity bench failed: {e!r}', file=sys.stderr)
 
     # SLO report phase: per-class attainment + goodput cost report
     # through the fleet telemetry plane, plus the fleet-scrape overhead

@@ -58,15 +58,45 @@ class SkyServeController:
         # observed shed rate from the LB sync (docs/qos.md).
         self.autoscaler = autoscalers.pick_autoscaler_cls(spec)(spec)
         # The LB serves its own /metrics on the externally reachable
-        # port; the fleet store scrapes it under the 'lb' target so
-        # front-door series (breaker state, stale mode, per-replica
-        # traffic) sit beside the replicas' in one page.
+        # port; the fleet store scrapes it so front-door series
+        # (breaker state, stale mode, per-replica traffic) sit beside
+        # the replicas' in one page. N-active tier: every LB that
+        # syncs registers its (lb_id, url) here and is scraped as its
+        # OWN fleet target — one shared target would overwrite each
+        # LB's series with whichever was scraped last. The legacy
+        # single-LB 'lb' target remains the fallback for LBs that
+        # never registered (old processes mid-rolling-update).
         self._lb_url: Optional[str] = None
         svc = serve_state.get_service(service_name)
         if svc is not None and svc.get('lb_port'):
             self._lb_url = f'http://127.0.0.1:{svc["lb_port"]}'
+        self._lbs: 'dict[str, dict]' = {}     # lb_id -> {url, last_sync}
+        self._lbs_lock = threading.Lock()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
+
+    # --------------------------------------------------- LB registration
+    def register_lb(self, lb_id: str, url: str) -> None:
+        with self._lbs_lock:
+            self._lbs[str(lb_id)] = {'url': str(url),
+                                     'last_sync': time.time()}
+
+    def registered_lbs(self, ttl_s: Optional[float] = None
+                       ) -> 'dict[str, dict]':
+        """LBs whose last sync is fresh; stale registrations (crashed
+        or partitioned LBs) drop out of the scrape rotation — the
+        fleet plane's own staleness aging then retires their series."""
+        if ttl_s is None:
+            ttl_s = max(3 * env.get_float(
+                'SKYT_SERVE_LB_SYNC_INTERVAL', 2.0), 10.0)
+        now = time.time()
+        with self._lbs_lock:
+            expired = [lid for lid, rec in self._lbs.items()
+                       if now - rec['last_sync'] > max(10 * ttl_s, 60)]
+            for lid in expired:
+                del self._lbs[lid]
+            return {lid: dict(rec) for lid, rec in self._lbs.items()
+                    if now - rec['last_sync'] <= ttl_s}
 
     # ---------------------------------------------------------- main loop
     def _control_loop(self) -> None:
@@ -95,7 +125,16 @@ class SkyServeController:
                     # LB scrape + SLO evaluation ride the control loop
                     # (throttled internally); both are no-raise by
                     # contract, but the loop's catch-all guards anyway.
-                    if self._lb_url is not None:
+                    # Every registered LB is scraped under its own
+                    # target; the pre-registration 'lb' target is the
+                    # fallback so a bare single-LB deployment keeps
+                    # its front-door series.
+                    lbs = self.registered_lbs()
+                    if lbs:
+                        for lid, rec in lbs.items():
+                            self.fleet.maybe_scrape(
+                                fleet_lib.lb_target(lid), rec['url'])
+                    elif self._lb_url is not None:
                         self.fleet.maybe_scrape('lb', self._lb_url)
                     self.fleet.tick()
                 if time.time() >= next_prune:
@@ -135,6 +174,12 @@ class SkyServeController:
         sheds = payload.get('qos_sheds') or []
         if demand or sheds:
             self.autoscaler.collect_qos(demand, sheds)
+        # Multi-LB registration: each active LB names itself on every
+        # sync; since every LB reports only its OWN timestamps/demand
+        # slice, the autoscaler's aggregation above is already
+        # fleet-wide — N syncs sum, nothing double counts.
+        if payload.get('lb_id') and payload.get('lb_url'):
+            self.register_lb(payload['lb_id'], payload['lb_url'])
         resp = {'ready_replica_urls': self.replica_manager.ready_urls()}
         # Per-replica QoS pressure (from the prober's /stats scrapes):
         # the LB steers shed-prone classes away from hot replicas.
@@ -178,10 +223,17 @@ class SkyServeController:
                 'pid': info.pid,
                 'adopted_at': info.adopted_at,
             })
+        now = time.time()
+        with self._lbs_lock:
+            lbs = {lid: {'url': rec['url'],
+                         'last_sync_age_s':
+                             round(now - rec['last_sync'], 1)}
+                   for lid, rec in self._lbs.items()}
         return web.json_response({
             'service': self.service_name,
             'target_num_replicas': self.autoscaler.target_num_replicas,
             'replicas': replicas,
+            'lbs': lbs,
         })
 
     async def _handle_metrics(self, request: web.Request) -> web.Response:

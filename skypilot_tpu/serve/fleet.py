@@ -40,6 +40,21 @@ def enabled() -> bool:
     return env.get('SKYT_FLEET', '1') not in ('', '0', 'false')
 
 
+def lb_target(lb_id: str) -> str:
+    """Scrape-target name for one member of the N-active LB tier.
+    Targets are namespaced under ``lb`` / ``lb-*`` so front-door
+    series never count as serving capacity (live_replicas) — LB ids
+    default to ``lb-<port>`` already; anything else gets the prefix."""
+    lb_id = str(lb_id)
+    if lb_id == 'lb' or lb_id.startswith('lb-'):
+        return lb_id
+    return f'lb-{lb_id}'
+
+
+def is_lb_target(target: str) -> bool:
+    return target == 'lb' or target.startswith('lb-')
+
+
 def _default_http_get(url: str, timeout: float) -> str:
     import requests
     resp = requests.get(url, timeout=timeout)
@@ -170,10 +185,18 @@ class FleetTelemetry:
         return targets
 
     def live_replicas(self, now: Optional[float] = None) -> List[str]:
-        """Replica targets only (the LB scrape is telemetry about the
-        front door, not serving capacity — it must not inflate the
-        cost report's chip count)."""
-        return [t for t in self.live_targets(now) if t != 'lb']
+        """Replica targets only (LB scrapes — 'lb' or one 'lb-<id>'
+        per member of an N-active tier — are telemetry about the front
+        door, not serving capacity: they must not inflate the cost
+        report's chip count)."""
+        return [t for t in self.live_targets(now)
+                if not is_lb_target(t)]
+
+    def live_lbs(self, now: Optional[float] = None) -> List[str]:
+        """Front-door targets currently contributing series — one per
+        registered LB of the N-active tier ('lb' for a legacy
+        unregistered single LB)."""
+        return [t for t in self.live_targets(now) if is_lb_target(t)]
 
     def _live_stores(self) -> List[ts_lib.TimeSeriesStore]:
         with self._lock:
@@ -245,11 +268,36 @@ class FleetTelemetry:
             lines.extend(chunk)
         return '\n'.join(lines) + ('\n' if lines else '')
 
+    def front_door(self, now: Optional[float] = None
+                   ) -> Dict[str, Dict[str, Any]]:
+        """Per-LB front-door health from the latest scraped samples:
+        one entry per live LB target of the N-active tier (stale mode,
+        active/leader flag, fresh peer count) — the fleet-side answer
+        to 'which LBs are serving and who is degraded?'."""
+        targets = self.live_lbs(now)
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in targets
+                      if t in self._stores]
+        out: Dict[str, Dict[str, Any]] = {}
+        for target, store in stores:
+            info: Dict[str, Any] = {}
+            for fam, field in (('skyt_lb_stale', 'stale'),
+                               ('skyt_lb_leader', 'active'),
+                               ('skyt_lb_peers', 'fresh_peers')):
+                for name, labels in store.series_keys():
+                    if name == fam:
+                        pt = store.latest(name, labels)
+                        if pt is not None:
+                            info[field] = pt[1]
+                        break
+            out[target] = info
+        return out
+
     def fleet_slo(self, window_s: Optional[float] = None
                   ) -> Dict[str, Any]:
         """The ``GET /fleet/slo`` body: burn-rate/alert state per
-        class, the goodput + chip-time cost report, and per-target
-        scrape health."""
+        class, the goodput + chip-time cost report, front-door (LB
+        tier) health, and per-target scrape health."""
         now = self._clock()
         if window_s is None:
             window_s = self.evaluator.windows.fast_long_s
@@ -259,6 +307,7 @@ class FleetTelemetry:
             'slo': self.evaluator.evaluate(now),
             'goodput': slo_lib.goodput_report(self, window_s, now,
                                               replicas=len(replicas)),
+            'front_door': self.front_door(now),
             'targets': {
                 t: {'last_scrape_age_s': round(
                         now - self._last_ok[t], 1)

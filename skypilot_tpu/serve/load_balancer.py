@@ -36,17 +36,46 @@ Control-plane crash tolerance (docs/robustness.md "Control plane"):
     the same controller sync endpoint and takes over the serving port
     within one lease interval of leader death (`skyt_lb_leader`).
 
+N-active front door (docs/serving.md "N-active front door",
+docs/robustness.md "Front door"):
+
+  * any number of LBs can serve CONCURRENTLY (`--role lb --lb-port P
+    --lb-peers ...` per process): each syncs with the controller under
+    its own `lb_id`, and additionally exchanges serialized LBState
+    snapshots with its peers over POST /lb/gossip (push-pull: one RTT
+    carries both views; `lb.gossip` fault point, per-peer staleness
+    discipline — a peer view older than SKYT_LB_PEER_STALE_S is
+    dropped from the aggregates exactly like stale-mode drops a stale
+    controller view). An LB partitioned from the controller adopts the
+    FRESHEST peer view instead of aging out alone, and surviving LBs
+    learn of a crashed peer within one exchange interval
+    (`skyt_lb_peers`);
+  * routing can be prefix-affine (`prefix_affinity` policy): requests
+    carry an affinity key — the hash of the normalized system-prompt /
+    conversation prefix, or `X-Session-Id` for hard stickiness — and
+    land on the consistent-hash ring owner, weighted by each replica's
+    prefix-cache occupancy from the controller sync. The ring is
+    deterministic from the snapshot, so N LBs route a key identically
+    with no coordination, and replica churn re-homes only the departed
+    /arrived node's keys (in-flight requests finish where they were
+    admitted);
+  * every `skyt_lb_*` family carries an `lb` instance label so N
+    expositions aggregate without overwriting each other (the fleet
+    plane scrapes each registered LB as its own target).
+
 Breaker and retry activity is visible in GET /metrics
 (skyt_lb_breaker_state, skyt_lb_retries_total, ...) and on the
 `lb.proxy` span attributes at /debug/traces.
 """
 import asyncio
 import dataclasses
+import hashlib
 import json
 import os
 import random
 import time
 import uuid
+from collections import deque
 from typing import Dict, List, Optional, Set, Union
 
 import aiohttp
@@ -101,6 +130,14 @@ def _stale_ttl() -> float:
     return env.get_float('SKYT_LB_STALE_TTL_S', 300.0)
 
 
+def _peer_interval() -> float:
+    return env.get_float('SKYT_LB_PEER_SYNC_S', 2.0)
+
+
+def _peer_stale_s() -> float:
+    return env.get_float('SKYT_LB_PEER_STALE_S', 10.0)
+
+
 @dataclasses.dataclass
 class LBState:
     """The LB's controller-synced world view as one serializable
@@ -151,6 +188,28 @@ class LBState:
         if age or state.ready_replicas:
             state.synced_at = time.monotonic() - age
         return state
+
+
+@dataclasses.dataclass
+class PeerView:
+    """What one peer LB last told us about its world: its LBState
+    snapshot plus the fleet-pressure slice only it can see (its own
+    per-class demand/shed rates and breaker-open replicas). Two ages
+    matter: `exchange_age_s` (how long since the peer last answered —
+    the liveness signal; past SKYT_LB_PEER_STALE_S the view leaves the
+    aggregates) and the snapshot's own `state.age_s()` (how fresh the
+    peer's CONTROLLER view is — what peer-state adoption compares)."""
+    lb_id: str
+    url: str
+    state: LBState
+    demand_rps: Dict[str, float] = dataclasses.field(default_factory=dict)
+    shed_rps: Dict[str, float] = dataclasses.field(default_factory=dict)
+    breaker_open: List[str] = dataclasses.field(default_factory=list)
+    received_at: float = 0.0          # time.monotonic() of last answer
+
+    def exchange_age_s(self, now: Optional[float] = None) -> float:
+        return max((now if now is not None else time.monotonic()) -
+                   self.received_at, 0.0)
 
 
 class LeaderLease:
@@ -243,20 +302,22 @@ class CircuitBreaker:
     _GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
     def __init__(self, threshold: int, cooldown_s: float,
-                 registry: 'metrics_lib.MetricsRegistry') -> None:
+                 registry: 'metrics_lib.MetricsRegistry',
+                 lb_id: str = 'lb') -> None:
         import threading
         self.threshold = max(1, threshold)
         self.cooldown_s = cooldown_s
+        self._lb = lb_id
         self._lock = threading.Lock()
         # replica -> {fails, open, opened_at, last_trial, trial_inflight}
         self._state: Dict[str, dict] = {}
         self._m_state = registry.gauge(
             'skyt_lb_breaker_state',
             'Circuit breaker per replica (0 closed, 1 half-open, '
-            '2 open)', ('replica',))
+            '2 open)', ('lb', 'replica'))
         self._m_opened = registry.counter(
             'skyt_lb_breaker_opens_total',
-            'closed->open breaker transitions', ('replica',))
+            'closed->open breaker transitions', ('lb', 'replica'))
 
     def _entry(self, replica: str) -> dict:
         return self._state.setdefault(
@@ -296,7 +357,7 @@ class CircuitBreaker:
                 return False
             st['last_trial'] = now
             st['trial_inflight'] = True
-            self._m_state.labels(replica).set(
+            self._m_state.labels(self._lb, replica).set(
                 self._GAUGE[self.HALF_OPEN])
             return True
 
@@ -305,7 +366,8 @@ class CircuitBreaker:
             st = self._entry(replica)
             st.update(fails=0, open=False, trial_inflight=False,
                       last_trial=0.0)
-            self._m_state.labels(replica).set(self._GAUGE[self.CLOSED])
+            self._m_state.labels(self._lb,
+                                 replica).set(self._GAUGE[self.CLOSED])
 
     def record_failure(self, replica: str) -> None:
         now = time.monotonic()
@@ -316,13 +378,15 @@ class CircuitBreaker:
             if st['open']:
                 # Failed half-open trial: restart the open window.
                 st['opened_at'] = now
-                self._m_state.labels(replica).set(self._GAUGE[self.OPEN])
+                self._m_state.labels(self._lb,
+                                     replica).set(self._GAUGE[self.OPEN])
             elif st['fails'] >= self.threshold:
                 st['open'] = True
                 st['opened_at'] = now
                 st['last_trial'] = 0.0
-                self._m_opened.labels(replica).inc()
-                self._m_state.labels(replica).set(self._GAUGE[self.OPEN])
+                self._m_opened.labels(self._lb, replica).inc()
+                self._m_state.labels(self._lb,
+                                     replica).set(self._GAUGE[self.OPEN])
                 logger.warning(
                     'circuit breaker OPEN for %s after %d consecutive '
                     'failures', replica, st['fails'])
@@ -334,10 +398,19 @@ class CircuitBreaker:
                 return self.CLOSED
             return self.HALF_OPEN if st['trial_inflight'] else self.OPEN
 
+    def open_replicas(self) -> List[str]:
+        """Replicas whose breaker is currently open — shared with
+        peer LBs via gossip as a soft avoid hint (a replica one LB
+        sees dying is likely dying for all of them, ahead of each
+        peer's own threshold)."""
+        with self._lock:
+            return sorted(r for r, st in self._state.items()
+                          if st['open'])
+
     def forget(self, replica: str) -> None:
         with self._lock:
             self._state.pop(replica, None)
-            self._m_state.remove_labels(replica)
+            self._m_state.remove_labels(self._lb, replica)
 
     def prune(self, keep) -> None:
         """Drop state for every replica not in `keep` — candidate
@@ -346,7 +419,7 @@ class CircuitBreaker:
         with self._lock:
             for replica in [r for r in self._state if r not in keep]:
                 self._state.pop(replica, None)
-                self._m_state.remove_labels(replica)
+                self._m_state.remove_labels(self._lb, replica)
 
 
 class SkyServeLoadBalancer:
@@ -360,9 +433,31 @@ class SkyServeLoadBalancer:
                  tracer: Optional['tracing_lib.Tracer'] = None,
                  stale_probe_path: Optional[str] = None,
                  stale_probe_post: Optional[dict] = None,
-                 stale_probe_timeout_s: Optional[float] = None) -> None:
+                 stale_probe_timeout_s: Optional[float] = None,
+                 lb_id: Optional[str] = None,
+                 peers: Optional[List[str]] = None,
+                 advertise_url: Optional[str] = None) -> None:
         self.controller_url = controller_url
         self.port = port
+        # Instance identity for the N-active tier: the `lb` label on
+        # every skyt_lb_* family, the id this LB registers with the
+        # controller sync (its own fleet scrape target), and the id
+        # peers key its gossip view under. Stable across restarts by
+        # default (port-derived) so fleet series don't churn.
+        self.lb_id = lb_id or env.get('SKYT_LB_ID') or f'lb-{port}'
+        # Where peers/the controller can reach THIS LB. Local-provider
+        # deployments (one host) default to loopback; multi-host
+        # deployments pass an explicit URL (--lb-advertise-url /
+        # SKYT_LB_ADVERTISE_URL) — otherwise the controller would
+        # fleet-scrape 127.0.0.1 on ITS OWN host.
+        self.advertise_url = (advertise_url or
+                              env.get('SKYT_LB_ADVERTISE_URL') or
+                              f'http://127.0.0.1:{port}').rstrip('/')
+        raw_peers = peers if peers is not None else \
+            (env.get('SKYT_LB_PEER_URLS') or '').split(',')
+        self.peers = [p for p in
+                      (q.strip().rstrip('/') for q in raw_peers)
+                      if p and p != self.advertise_url]
         # Stale-mode health probing uses the SERVICE's readiness
         # contract (serve/service.py passes spec.readiness_path /
         # post_data / probe timeout) — probing a path the replicas
@@ -380,33 +475,43 @@ class SkyServeLoadBalancer:
         self._tracer = tracer or tracing_lib.Tracer(
             service='lb', registry=reg)
         # Per-replica traffic accounting; the 'replica' label is the
-        # replica URL — bounded by the replica count, not by clients.
+        # replica URL — bounded by the replica count, not by clients —
+        # and every family carries this LB's instance id so N active
+        # LBs' series never overwrite each other when aggregated.
         self._m_requests = reg.counter(
-            'skyt_lb_requests_total', 'Requests proxied', ('replica',))
+            'skyt_lb_requests_total', 'Requests proxied',
+            ('lb', 'replica'))
         self._m_errors = reg.counter(
             'skyt_lb_errors_total',
             'Proxy failures (replica="none" = no ready replica)',
-            ('replica',))
+            ('lb', 'replica'))
         self._m_retries = reg.counter(
             'skyt_lb_retries_total',
             'Upstream attempts retried on another replica after a '
-            'transport failure on this one', ('replica',))
+            'transport failure on this one', ('lb', 'replica'))
         self._m_inflight = reg.gauge(
             'skyt_lb_inflight_requests',
-            'Requests currently being proxied', ('replica',))
+            'Requests currently being proxied', ('lb', 'replica'))
         self._m_sync_dropped = reg.counter(
             'skyt_lb_sync_dropped_timestamps_total',
             'Request timestamps dropped because the controller-sync '
-            'buffer hit its cap (controller unreachable)')
+            'buffer hit its cap (controller unreachable)', ('lb',))
         self._m_client_disconnects = reg.counter(
             'skyt_lb_client_disconnects_total',
             'Requests whose client disconnected mid-proxy (not '
-            'counted as replica failures)')
+            'counted as replica failures)', ('lb',))
         self.breaker = CircuitBreaker(
             threshold=env.get_int('SKYT_LB_BREAKER_THRESHOLD', 3),
             cooldown_s=env.get_float('SKYT_LB_BREAKER_COOLDOWN_S', 2.0),
-            registry=reg)
-        # Bearer token for the controller's authenticated admin API.
+            registry=reg, lb_id=self.lb_id)
+        # Bearer token for the controller's authenticated admin API —
+        # ALSO the peer-gossip credential: every LB of a service holds
+        # the same per-service token, so /lb/gossip (which lives on
+        # the client-facing port) requires it whenever it is
+        # configured. Without a token (bare test harnesses), gossip
+        # falls back to sender-URL validation against the configured
+        # peer list.
+        self._auth_token = controller_auth
         self._controller_headers = (
             {'Authorization': f'Bearer {controller_auth}'}
             if controller_auth else {})
@@ -422,19 +527,28 @@ class SkyServeLoadBalancer:
         # SKYT_QOS=0 (one env read per request).
         self._qos_demand: List[tuple] = []     # (ts, class)
         self._qos_sheds: List[tuple] = []      # (ts, class)
+        # Rolling copies of the same events (NOT drained by the
+        # controller sync): the per-class demand/shed RATES this LB
+        # advertises to its peers, so every LB can expose fleet-wide
+        # pressure instead of its own slice. Trimmed by TIMESTAMP on
+        # append (see _note_recent) so the rate window is never
+        # silently shortened under load; maxlen is only a memory
+        # backstop (~6.5k events/s before it clips a 10s window).
+        self._recent_demand: deque = deque(maxlen=65536)
+        self._recent_sheds: deque = deque(maxlen=65536)
         self._m_qos_sheds_seen = reg.counter(
             'skyt_lb_qos_sheds_observed_total',
             'Upstream 429 shed responses proxied, by class',
-            ('class',))
+            ('lb', 'class'))
         # Prefix-cache occupancy per replica, learned from the
         # controller sync (the controller scrapes each replica's
-        # /stats 'prefix_cache' block) — groundwork for cache-affinity
-        # routing (ROADMAP item 2).
+        # /stats 'prefix_cache' block) — the weight input of
+        # prefix-affinity routing (ROADMAP item 2).
         self._m_prefix_cache = reg.gauge(
             'skyt_lb_replica_prefix_cache',
             'Prefix-cache occupancy fraction of each ready replica '
             '(cached pages / pool pages, from the controller sync)',
-            ('replica',))
+            ('lb', 'replica'))
         # Control-plane crash tolerance: the synced world view lives in
         # one LBState snapshot; on sync failure the LB serves from the
         # stale snapshot (bounded by SKYT_LB_STALE_TTL_S, with its own
@@ -448,22 +562,68 @@ class SkyServeLoadBalancer:
         self._m_stale = reg.gauge(
             'skyt_lb_stale',
             '1 while serving from a stale LBState snapshot (controller '
-            'sync failing), else 0')
+            'sync failing), else 0', ('lb',))
         self._m_stale_age = reg.gauge(
             'skyt_lb_stale_age_seconds',
-            'Age of the LBState snapshot being served (0 when synced)')
+            'Age of the LBState snapshot being served (0 when synced)',
+            ('lb',))
         self._m_stale_pruned = reg.counter(
             'skyt_lb_stale_pruned_total',
             'Replicas pruned from the stale ready set by the LB\'s own '
-            'health probes while the controller was unreachable')
+            'health probes while the controller was unreachable',
+            ('lb',))
         # Hot-standby election: 1 = this process holds the leader lease
-        # (owns the serving port), 0 = standby mirroring LBState.
+        # (owns the serving port), 0 = standby mirroring LBState. Every
+        # member of an N-active tier reports 1 (no lease: all serve).
         self._m_leader = reg.gauge(
             'skyt_lb_leader',
-            'Leader-lease state of this LB process (1 leader, '
-            '0 standby)')
+            'Leader-lease state of this LB process (1 leader/active, '
+            '0 standby)', ('lb',))
+        # N-active peer exchange (docs/robustness.md "Front door"):
+        # per-peer exchange health + view ages, the live-peer count,
+        # and the fleet-wide per-class demand/shed rates aggregated
+        # from own + live peers' slices.
+        self._peer_views: Dict[str, PeerView] = {}
+        self._m_peers = reg.gauge(
+            'skyt_lb_peers',
+            'Peer LBs whose gossip view is fresh (exchange age within '
+            'SKYT_LB_PEER_STALE_S)', ('lb',))
+        self._m_peer_exchanges = reg.counter(
+            'skyt_lb_peer_exchanges_total',
+            'Peer gossip exchanges by outcome (peer = configured peer '
+            'URL on the send side, peer lb_id on the receive side)',
+            ('lb', 'peer', 'status'))
+        self._m_peer_state_age = reg.gauge(
+            'skyt_lb_peer_state_age_seconds',
+            'Age of each peer\'s last received LBState snapshot',
+            ('lb', 'peer'))
+        self._m_fleet_demand = reg.gauge(
+            'skyt_lb_fleet_demand_rps',
+            'Fleet-wide per-class request rate: this LB\'s slice plus '
+            'every fresh peer\'s advertised slice', ('lb', 'class'))
+        self._m_fleet_sheds = reg.gauge(
+            'skyt_lb_fleet_sheds_rps',
+            'Fleet-wide per-class observed shed (429) rate across this '
+            'LB and its fresh peers', ('lb', 'class'))
+        # Prefix-affinity routing (docs/serving.md "N-active front
+        # door"): ring size, live sticky sessions, and per-request
+        # routing mode (sticky / ring / none = keyless round-robin).
+        self._m_ring_nodes = reg.gauge(
+            'skyt_lb_ring_nodes',
+            'Replicas on the consistent-hash ring (prefix_affinity '
+            'policy only)', ('lb',))
+        self._m_ring_sessions = reg.gauge(
+            'skyt_lb_ring_sessions',
+            'Sticky sessions currently pinned (bounded by '
+            'SKYT_LB_RING_SESSIONS_MAX)', ('lb',))
+        self._m_affinity = reg.counter(
+            'skyt_lb_affinity_requests_total',
+            'Requests by affinity routing mode: sticky (session pin '
+            'held), ring (prefix-key consistent-hash), none (keyless)',
+            ('lb', 'mode'))
         self._session: Optional[aiohttp.ClientSession] = None
         self._sync_task: Optional[asyncio.Task] = None
+        self._gossip_task: Optional[asyncio.Task] = None
 
     @property
     def _replica_qos(self) -> Dict[str, dict]:
@@ -487,7 +647,7 @@ class SkyServeLoadBalancer:
             over = len(buf) - max(cap, 1)
             if over > 0:
                 del buf[:over]
-                self._m_sync_dropped.inc(over)
+                self._m_sync_dropped.labels(self.lb_id).inc(over)
 
     async def _sync_with_controller(self) -> None:
         """Reference: :58 — report request timestamps (plus per-class
@@ -500,7 +660,12 @@ class SkyServeLoadBalancer:
             ts, self.request_timestamps = self.request_timestamps, []
             qd, self._qos_demand = self._qos_demand, []
             qs, self._qos_sheds = self._qos_sheds, []
-            payload = {'request_timestamps': ts}
+            # Multi-LB registration: the controller learns this LB's
+            # id + reachable URL from every sync, so its fleet plane
+            # scrapes each active LB as its own target.
+            payload = {'request_timestamps': ts,
+                       'lb_id': self.lb_id,
+                       'lb_url': self.advertise_url}
             if qd or qs:
                 payload['qos_demand'] = [[t, c] for t, c in qd]
                 payload['qos_sheds'] = [[t, c] for t, c in qs]
@@ -543,30 +708,56 @@ class SkyServeLoadBalancer:
                 await self._enter_or_hold_stale()
             await asyncio.sleep(_sync_interval())
 
-    def apply_state(self, state: 'LBState') -> None:
-        """Install a fresh LBState snapshot (from a successful sync, or
-        imported by a standby) as the live routing view."""
+    def apply_state(self, state: 'LBState',
+                    source: str = 'controller') -> None:
+        """Install a fresh LBState snapshot as the live routing view.
+        `source='controller'` (a successful sync, or a standby mirror)
+        also clears stale mode; `source='peer'` (adopted from a
+        gossiping peer while the controller is unreachable from HERE)
+        keeps the stale flags — the view is fresher, the partition is
+        not healed."""
         self.state = state
         self.policy.set_ready_replicas(list(state.ready_replicas))
+        self._apply_ring_weights(state)
         self._prune_replica_metrics(state.ready_replicas)
         # Prefix-cache occupancy gauges track the snapshot: one series
         # per replica that reported a block, pruned with the replica.
         for key in self._m_prefix_cache.label_keys():
-            if key[0] not in state.replica_prefix_cache:
+            if key[0] == self.lb_id and \
+                    key[1] not in state.replica_prefix_cache:
                 self._m_prefix_cache.remove_labels(*key)
         for replica, block in state.replica_prefix_cache.items():
             occ = block.get('occupancy') if isinstance(block, dict) \
                 else None
             if isinstance(occ, (int, float)):
-                self._m_prefix_cache.labels(replica).set(float(occ))
+                self._m_prefix_cache.labels(self.lb_id,
+                                            replica).set(float(occ))
+        if source != 'controller':
+            return
         if self._stale:
             logger.info('controller sync recovered; leaving stale-'
                         'state mode (%d ready replicas)',
                         len(state.ready_replicas))
         self._stale = False
         self._stale_probe_fails.clear()
-        self._m_stale.set(0)
-        self._m_stale_age.set(0.0)
+        self._m_stale.labels(self.lb_id).set(0)
+        self._m_stale_age.labels(self.lb_id).set(0.0)
+
+    def _apply_ring_weights(self, state: 'LBState') -> None:
+        """Feed per-replica prefix-cache occupancy to the policy as
+        routing weights (prefix_affinity rebuilds its ring; other
+        policies ignore the call). Deterministic from the snapshot, so
+        every LB holding the same snapshot builds the same ring."""
+        weights: Dict[str, float] = {}
+        for replica, block in state.replica_prefix_cache.items():
+            occ = block.get('occupancy') if isinstance(block, dict) \
+                else None
+            if isinstance(occ, (int, float)):
+                weights[replica] = float(occ)
+        self.policy.set_weights(weights)
+        if self.policy.uses_affinity:
+            self._m_ring_nodes.labels(self.lb_id).set(
+                len(self.policy.ring))
 
     def snapshot_state(self) -> 'LBState':
         """The live view re-narrowed to what the LB itself learned:
@@ -581,9 +772,13 @@ class SkyServeLoadBalancer:
 
     async def _enter_or_hold_stale(self) -> None:
         """One failed-sync step of stale-state mode: surface the mode +
-        snapshot age, prune dead replicas with our own health probes,
-        and drain once the snapshot outlives SKYT_LB_STALE_TTL_S (a
-        too-old view is worse than an honest 503)."""
+        snapshot age, adopt a fresher PEER view when gossip has one
+        (an LB partitioned from the controller but not from its peers
+        keeps a near-live view), prune dead replicas with our own
+        health probes, and drain once the snapshot outlives
+        SKYT_LB_STALE_TTL_S (a too-old view is worse than an honest
+        503)."""
+        self._adopt_peer_state_if_fresher()
         if self.state.synced_at == 0.0:
             return          # never synced: nothing to serve stale FROM
         age = self.state.age_s()
@@ -594,8 +789,8 @@ class SkyServeLoadBalancer:
                 'replica set (%d replicas, age %.1fs, ttl %.0fs) with '
                 'LB-side health probes', len(self.policy.ready_replicas),
                 age, _stale_ttl())
-        self._m_stale.set(1)
-        self._m_stale_age.set(round(age, 3))
+        self._m_stale.labels(self.lb_id).set(1)
+        self._m_stale_age.labels(self.lb_id).set(round(age, 3))
         if age > _stale_ttl():
             if self.policy.ready_replicas:
                 logger.error(
@@ -661,7 +856,7 @@ class SkyServeLoadBalancer:
             logger.warning('stale-state probes pruned %d dead '
                            'replica(s) after %d consecutive failures: '
                            '%s', len(newly_dead), threshold, newly_dead)
-            self._m_stale_pruned.inc(len(newly_dead))
+            self._m_stale_pruned.labels(self.lb_id).inc(len(newly_dead))
         if sorted(alive) != sorted(self.policy.ready_replicas):
             self.policy.set_ready_replicas(alive)
 
@@ -677,13 +872,245 @@ class SkyServeLoadBalancer:
         for metric in (self._m_requests, self._m_errors,
                        self._m_retries):
             for key in metric.label_keys():
-                if key[0] not in keep:
+                if key[0] == self.lb_id and key[1] not in keep:
                     metric.remove_labels(*key)
         for key in self._m_inflight.label_keys():
-            if key[0] not in keep and \
+            if key[0] == self.lb_id and key[1] not in keep and \
                     self._m_inflight.value(*key) == 0:
                 self._m_inflight.remove_labels(*key)
         self.breaker.prune(keep)
+
+    # ----------------------------------------------------- peer exchange
+    @staticmethod
+    def _note_recent(buf: deque, now: float, cls: str) -> None:
+        """Append one (ts, class) event and drop everything older than
+        any rate window we compute (peers and gauges use
+        max(4 * SKYT_LB_PEER_SYNC_S, 10) — 3x that is comfortably
+        past it), so the deque holds exactly the live window instead
+        of a fixed count that shrinks the window under load."""
+        buf.append((now, cls))
+        horizon = now - 3 * max(_peer_interval() * 4, 10.0)
+        while buf and buf[0][0] < horizon:
+            buf.popleft()
+
+    def _gossip_payload(self) -> dict:
+        """What this LB tells a peer: its LBState snapshot (as probed —
+        stale-mode pruning included), its per-class demand/shed rates
+        over a short trailing window, and its breaker-open set."""
+        window = max(_peer_interval() * 4, 10.0)
+        now = time.time()
+        return {
+            'lb_id': self.lb_id,
+            'url': self.advertise_url,
+            'state': json.loads(self.snapshot_state().to_json()),
+            'stale': self._stale,
+            'demand_rps': qos_lib.rate_by_class(self._recent_demand,
+                                                window, now=now),
+            'shed_rps': qos_lib.rate_by_class(self._recent_sheds,
+                                              window, now=now),
+            'breaker_open': self.breaker.open_replicas(),
+        }
+
+    def _absorb_peer(self, payload: dict) -> Optional[str]:
+        """Install one peer's gossip payload as its PeerView. Returns
+        the peer's lb_id, or None for garbage / our own echo / a
+        sender that is not a configured peer. The peer-list check is
+        what bounds `_peer_views` (and its metric series) to the
+        configured tier and — together with the bearer auth in
+        `_handle_gossip` — keeps an arbitrary client from poisoning
+        the routing view with a forged snapshot."""
+        if not isinstance(payload, dict):
+            return None
+        pid = payload.get('lb_id')
+        if not pid or pid == self.lb_id:
+            return None
+        url = str(payload.get('url') or '').rstrip('/')
+        if self.peers and url not in self.peers:
+            logger.warning('ignoring gossip from unconfigured sender '
+                           '%r (url %r not in the peer list)', pid, url)
+            return None
+        pid = str(pid)
+        try:
+            state = LBState.from_json(json.dumps(
+                payload.get('state') or {}))
+        except (ValueError, TypeError):
+            state = LBState()
+        demand = payload.get('demand_rps')
+        sheds = payload.get('shed_rps')
+        breaker = payload.get('breaker_open')
+        self._peer_views[pid] = PeerView(
+            lb_id=pid,
+            url=str(payload.get('url') or ''),
+            state=state,
+            demand_rps=demand if isinstance(demand, dict) else {},
+            shed_rps=sheds if isinstance(sheds, dict) else {},
+            breaker_open=[str(r) for r in breaker]
+            if isinstance(breaker, list) else [],
+            received_at=time.monotonic())
+        return pid
+
+    def _live_peers(self) -> List[PeerView]:
+        """Peer views fresh enough to act on — PR 7's stale-mode
+        discipline applied per peer: a peer that stopped answering
+        (crash, partition) ages out of every aggregate within
+        SKYT_LB_PEER_STALE_S instead of pinning its last view forever."""
+        now = time.monotonic()
+        ttl = _peer_stale_s()
+        return [pv for pv in self._peer_views.values()
+                if pv.exchange_age_s(now) <= ttl]
+
+    def _refresh_peer_gauges(self) -> None:
+        live = self._live_peers()
+        self._m_peers.labels(self.lb_id).set(len(live))
+        known = set(self._peer_views)
+        for key in self._m_peer_state_age.label_keys():
+            if key[0] == self.lb_id and key[1] not in known:
+                self._m_peer_state_age.remove_labels(*key)
+        for pv in self._peer_views.values():
+            self._m_peer_state_age.labels(self.lb_id, pv.lb_id).set(
+                round(pv.state.age_s(), 3))
+        # Fleet-wide pressure: own slice + every fresh peer's slice.
+        window = max(_peer_interval() * 4, 10.0)
+        now = time.time()
+        for gauge, own, attr in (
+                (self._m_fleet_demand, self._recent_demand,
+                 'demand_rps'),
+                (self._m_fleet_sheds, self._recent_sheds, 'shed_rps')):
+            total = dict(qos_lib.rate_by_class(own, window, now=now))
+            for pv in live:
+                for cls, rate in getattr(pv, attr).items():
+                    try:
+                        total[cls] = total.get(cls, 0.0) + float(rate)
+                    except (TypeError, ValueError):
+                        continue
+            for key in gauge.label_keys():
+                if key[0] == self.lb_id and key[1] not in total:
+                    gauge.remove_labels(*key)
+            for cls, rate in total.items():
+                gauge.labels(self.lb_id, cls).set(round(rate, 4))
+        if self.policy.uses_affinity:
+            self._m_ring_nodes.labels(self.lb_id).set(
+                len(self.policy.ring))
+            self._m_ring_sessions.labels(self.lb_id).set(
+                self.policy.session_count())
+
+    def _peer_breaker_avoid(self) -> Set[str]:
+        """Replicas some fresh peer sees breaker-open: a SOFT avoid
+        hint merged into replica picking (dropped entirely when it
+        would leave nothing — a possibly-dying replica still beats no
+        replica)."""
+        avoid: Set[str] = set()
+        for pv in self._live_peers():
+            avoid.update(pv.breaker_open)
+        return avoid
+
+    def _adopt_peer_state_if_fresher(self) -> None:
+        """While OUR controller sync is failing, serve from the
+        freshest view anyone in the tier holds: a peer that still
+        reaches the controller re-syncs every interval, so adopting
+        its snapshot keeps this LB near-live through a partition that
+        only cut this process off. Bounded: only fresh peers are
+        considered, and the adopted snapshot's age keeps ticking into
+        the same SKYT_LB_STALE_TTL_S drain bound."""
+        best: Optional[LBState] = None
+        for pv in self._live_peers():
+            st = pv.state
+            if st.synced_at == 0.0:
+                continue
+            if best is None or st.age_s() < best.age_s():
+                best = st
+        if best is None:
+            return
+        my_age = self.state.age_s()
+        if self.state.synced_at != 0.0 and best.age_s() >= my_age:
+            return
+        logger.info(
+            'adopting peer LBState (age %.1fs vs own %s) while the '
+            'controller sync is failing', best.age_s(),
+            f'{my_age:.1f}s' if self.state.synced_at else 'none')
+        self.apply_state(LBState(
+            ready_replicas=list(best.ready_replicas),
+            replica_qos=dict(best.replica_qos),
+            replica_prefix_cache=dict(best.replica_prefix_cache),
+            synced_at=best.synced_at,
+            version=best.version), source='peer')
+
+    async def _gossip_once(self) -> None:
+        """One push-pull round with every configured peer: POST our
+        payload, absorb the peer's reply. Exchanges run CONCURRENTLY
+        and failures (real, or injected via the `lb.gossip` fault
+        point) only count and age — a dead or SYN-dropping peer must
+        never stall the round for the living ones (sequentially, N-1
+        hung connects would push the one live peer past
+        SKYT_LB_PEER_STALE_S and flap it stale)."""
+        assert self._session is not None
+        timeout = aiohttp.ClientTimeout(
+            total=max(_peer_interval(), 1.0))
+        payload = self._gossip_payload()   # identical for every peer
+
+        async def exchange(peer: str) -> None:
+            try:
+                await faults.ainject('lb.gossip', peer=peer,
+                                     dir='send')
+                async with self._session.post(
+                        peer + '/lb/gossip', json=payload,
+                        headers=self._controller_headers,
+                        timeout=timeout) as resp:
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f'peer gossip HTTP {resp.status}')
+                    self._absorb_peer(await resp.json())
+                self._m_peer_exchanges.labels(self.lb_id, peer,
+                                              'ok').inc()
+            except Exception as e:  # pylint: disable=broad-except
+                self._m_peer_exchanges.labels(self.lb_id, peer,
+                                              'error').inc()
+                logger.debug('gossip to %s failed: %s', peer, e)
+
+        await asyncio.gather(*(exchange(p) for p in self.peers))
+        self._refresh_peer_gauges()
+
+    async def _gossip_loop(self) -> None:
+        while True:
+            try:
+                await self._gossip_once()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('gossip round failed')
+            await asyncio.sleep(_peer_interval())
+
+    async def _handle_gossip(self, request: web.Request) -> web.Response:
+        """POST /lb/gossip — the receive half of the push-pull
+        exchange: absorb the sender's view, answer with ours. Guarded:
+        this route lives on the CLIENT-facing port, so when the
+        service token is configured (every service.py deployment) the
+        sender must present it — otherwise any client could poison
+        the routing view or read the replica topology. Also an
+        `lb.gossip` fault site (dir=recv) so a drill can partition
+        the tier from either end."""
+        if self._auth_token:
+            import hmac
+            got = request.headers.get('Authorization', '')
+            want = f'Bearer {self._auth_token}'
+            if not hmac.compare_digest(
+                    got.encode('utf-8', 'surrogateescape'),
+                    want.encode('utf-8')):
+                return web.json_response(
+                    {'error': 'unauthorized: gossip requires the '
+                              'service bearer token'}, status=401)
+        try:
+            payload = await request.json()
+        except ValueError:
+            return web.json_response({'error': 'gossip body must be '
+                                               'JSON'}, status=400)
+        sender = payload.get('lb_id') if isinstance(payload, dict) \
+            else None
+        await faults.ainject('lb.gossip', peer=str(sender),
+                             dir='recv')
+        pid = self._absorb_peer(payload)
+        if pid is not None:
+            self._m_peer_exchanges.labels(self.lb_id, pid, 'ok').inc()
+            self._refresh_peer_gauges()
+        return web.json_response(self._gossip_payload())
 
     # ------------------------------------------------------- proxy path
     def _request_deadline(self, request: web.Request) -> float:
@@ -715,8 +1142,65 @@ class SkyServeLoadBalancer:
                 avoid.add(replica)
         return avoid
 
+    def _affinity_key(self, body: bytes) -> Optional[str]:
+        """The request's prompt-prefix affinity key: a hash of the
+        normalized conversation prefix. For chat bodies that is the
+        system message(s) plus the FIRST non-system message — stable
+        across every later turn of the same conversation, shared by
+        all conversations over the same system prompt; for completion
+        bodies, the first SKYT_LB_AFFINITY_PREFIX_BYTES of the prompt
+        (token lists included, so token-level clients get affinity
+        too). None = keyless (non-JSON, empty, or no prompt field)."""
+        if not body:
+            return None
+        try:
+            payload = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        def norm(t) -> str:
+            # Whitespace-normalized: formatting wobble (indentation,
+            # trailing newlines) must not split an otherwise-shared
+            # prefix into distinct keys.
+            return ' '.join(str(t).split())
+
+        text = None
+        msgs = payload.get('messages')
+        if isinstance(msgs, list) and msgs:
+            # Only the LEADING run of system messages plus the first
+            # non-system message is the conversation's stable prefix —
+            # a system message injected mid-conversation (tool or
+            # moderation instructions at turn k) must not re-key (and
+            # re-home) the whole conversation.
+            parts = []
+            first = None
+            for m in msgs:
+                if not isinstance(m, dict):
+                    continue
+                if str(m.get('role', '')) == 'system' and first is None:
+                    parts.append(f'system:{norm(m.get("content", ""))}')
+                elif first is None:
+                    first = m
+            if first is not None:
+                parts.append(f'{norm(first.get("role", ""))}:'
+                             f'{norm(first.get("content", ""))}')
+            text = '\n'.join(parts)
+        elif isinstance(payload.get('prompt'), str):
+            text = norm(payload['prompt'])
+        elif isinstance(payload.get('tokens'), list):
+            text = ','.join(str(t) for t in payload['tokens'])
+        if not text:
+            return None
+        n = env.get_int('SKYT_LB_AFFINITY_PREFIX_BYTES', 1024,
+                        minimum=1)
+        return hashlib.sha256(
+            text.encode('utf-8', 'surrogateescape')[:n]).hexdigest()[:16]
+
     def _pick_replica_once(self, tried: Set[str],
-                           qos_avoid: Optional[Set[str]] = None
+                           qos_avoid: Optional[Set[str]] = None,
+                           key: Optional[str] = None,
+                           session: Optional[str] = None
                            ) -> Optional[str]:
         """One selection honoring the breaker, preferring replicas this
         request has not failed on yet; falls back to tried ones (with
@@ -732,14 +1216,16 @@ class SkyServeLoadBalancer:
         soft = set(qos_avoid or ())
         while True:
             replica = self.policy.select_replica(
-                exclude=tried | denied | soft)
+                exclude=tried | denied | soft, key=key,
+                session=session)
             if replica is None and soft:
                 # Pressure avoidance must never turn into an outage:
                 # a shedding replica still beats no replica.
                 soft = set()
                 continue
             if replica is None and tried:
-                replica = self.policy.select_replica(exclude=denied)
+                replica = self.policy.select_replica(
+                    exclude=denied, key=key, session=session)
             if replica is None:
                 return None
             if self.breaker.allow(replica):
@@ -755,7 +1241,9 @@ class SkyServeLoadBalancer:
     async def _wait_for_replica(self, request: web.Request,
                                 tried: Set[str],
                                 deadline: float,
-                                qos_avoid: Optional[Set[str]] = None
+                                qos_avoid: Optional[Set[str]] = None,
+                                key: Optional[str] = None,
+                                session: Optional[str] = None
                                 ) -> Optional[str]:
         """Poll for an eligible replica until `deadline`, aborting the
         moment the client disconnects (satellite: the old code held the
@@ -770,7 +1258,8 @@ class SkyServeLoadBalancer:
         service still starting up)."""
         poll = max(env.get_float('SKYT_LB_NO_REPLICA_POLL_S', 1.0), 0.01)
         while True:
-            replica = self._pick_replica_once(tried, qos_avoid)
+            replica = self._pick_replica_once(tried, qos_avoid,
+                                              key=key, session=session)
             if replica is not None:
                 return replica
             if self.policy.ready_replicas:
@@ -792,13 +1281,18 @@ class SkyServeLoadBalancer:
         otherwise — propagated to the replica and echoed on the
         response alongside `X-Replica-Id`, so client-side correlation
         works even with tracing sampled out."""
+        # Chaos hook for the N-active drill: SKYT_FAULTS='lb.crash=
+        # crash,after=N' SIGKILLs THIS LB process mid-burst — peers
+        # must absorb its traffic with zero client-visible 5xx.
+        await faults.ainject('lb.crash')
         self.request_timestamps.append(time.time())
         qos_cls = None
         if qos_lib.enabled():
             # Early 400 on a malformed header (the replica would
             # reject it anyway); both headers then propagate to the
             # replica untouched. Demand is recorded per class for the
-            # QoS-aware autoscaler.
+            # QoS-aware autoscaler, and mirrored into the rolling
+            # window peers aggregate fleet-wide.
             try:
                 qos_cls = qos_lib.parse_priority(
                     request.headers.get('X-Priority'))
@@ -806,9 +1300,22 @@ class SkyServeLoadBalancer:
             except ValueError as e:
                 return web.json_response({'error': str(e)},
                                          status=400)
-            self._qos_demand.append((time.time(), qos_cls))
+            now = time.time()
+            self._qos_demand.append((now, qos_cls))
+            self._note_recent(self._recent_demand, now, qos_cls)
         self._cap_timestamps()
         body = await request.read()
+        # Affinity inputs (prefix_affinity policy only — other
+        # policies never pay the body parse): the sticky session id
+        # and the prompt-prefix hash key.
+        session_id: Optional[str] = None
+        affinity_key: Optional[str] = None
+        sticky_prev: Optional[str] = None
+        if self.policy.uses_affinity:
+            session_id = request.headers.get('X-Session-Id') or None
+            affinity_key = self._affinity_key(body)
+            if session_id:
+                sticky_prev = self.policy.peek_session(session_id)
         req_id = request.headers.get('X-Request-Id') or \
             uuid.uuid4().hex[:16]
         # Honor an upstream client's traceparent (their tracer keeps
@@ -846,7 +1353,9 @@ class SkyServeLoadBalancer:
                             request, tried,
                             no_replica_deadline if attempt == 0
                             else deadline,
-                            qos_avoid=self._qos_avoid_for(qos_cls))
+                            qos_avoid=self._qos_avoid_for(qos_cls) |
+                            self._peer_breaker_avoid(),
+                            key=affinity_key, session=session_id)
                     except ConnectionResetError:
                         pick.set_attribute('error', 'client gone')
                         span.set_attribute('http.status', 499)
@@ -877,7 +1386,7 @@ class SkyServeLoadBalancer:
                                 text=f'All replicas failing (circuit '
                                      f'open) after {attempt} '
                                      f'attempt(s): {last_err}')
-                        self._m_errors.labels('none').inc()
+                        self._m_errors.labels(self.lb_id, 'none').inc()
                         pick.set_attribute('error', 'no ready replica')
                         span.set_attribute('http.status', 503)
                         return web.Response(
@@ -890,21 +1399,38 @@ class SkyServeLoadBalancer:
                                  'the service.')
                     pick.set_attribute('replica', replica)
                 span.set_attribute('replica', replica)
-                self._m_requests.labels(replica).inc()
-                self._m_inflight.labels(replica).inc()
+                if attempt == 0 and self.policy.uses_affinity:
+                    # Routing-mode accounting: a held session pin is
+                    # 'sticky', a fresh prefix-key placement 'ring',
+                    # keyless traffic 'none'. The affinity hit-rate
+                    # (sticky+ring over total) is the LB-side half of
+                    # the bench A/B.
+                    mode = ('sticky' if sticky_prev is not None and
+                            sticky_prev == replica
+                            else 'ring' if affinity_key is not None
+                            else 'none')
+                    self._m_affinity.labels(self.lb_id, mode).inc()
+                    span.set_attribute('lb.affinity', mode)
+                self._m_requests.labels(self.lb_id, replica).inc()
+                self._m_inflight.labels(self.lb_id, replica).inc()
                 try:
                     result = await self._proxy_to(
                         request, replica, body, req_id, attempt)
                 finally:
-                    self._m_inflight.labels(replica).dec()
+                    self._m_inflight.labels(self.lb_id, replica).dec()
                     self.policy.on_request_done(replica)
                 if isinstance(result, web.StreamResponse):
                     if qos_cls is not None and result.status == 429:
                         # An upstream shed/throttle passed through:
                         # the observed shed rate is the QoS-aware
-                        # autoscaler's scale-up signal.
-                        self._qos_sheds.append((time.time(), qos_cls))
-                        self._m_qos_sheds_seen.labels(qos_cls).inc()
+                        # autoscaler's scale-up signal (and the
+                        # rolling copy feeds the fleet-wide gauges).
+                        now = time.time()
+                        self._qos_sheds.append((now, qos_cls))
+                        self._note_recent(self._recent_sheds, now,
+                                          qos_cls)
+                        self._m_qos_sheds_seen.labels(self.lb_id,
+                                                      qos_cls).inc()
                     span.set_attribute('http.status', result.status)
                     if attempt:
                         span.set_attribute('retries', attempt)
@@ -926,7 +1452,7 @@ class SkyServeLoadBalancer:
                                  'X-Replica-Id': replica},
                         text=f'Replica {replica} failed after '
                              f'{attempt} attempt(s): {last_err}')
-                self._m_retries.labels(replica).inc()
+                self._m_retries.labels(self.lb_id, replica).inc()
                 span.add_event('retry', attempt=attempt,
                                failed_replica=replica,
                                delay_ms=round(delay * 1e3, 1))
@@ -1006,7 +1532,7 @@ class SkyServeLoadBalancer:
                 # then cancels its engine request.
                 logger.info('client disconnected during proxy to %s: '
                             '%s', replica, e)
-                self._m_client_disconnects.inc()
+                self._m_client_disconnects.labels(self.lb_id).inc()
                 span.set_attribute('client_disconnected', True)
                 span.set_attribute('http.status', 499)
                 if response is not None and response.prepared:
@@ -1015,7 +1541,7 @@ class SkyServeLoadBalancer:
                                     reason='Client Closed Request')
             except _UPSTREAM_FAILURES as e:
                 logger.warning('proxy to %s failed: %s', replica, e)
-                self._m_errors.labels(replica).inc()
+                self._m_errors.labels(self.lb_id, replica).inc()
                 self.breaker.record_failure(replica)
                 span.set_attribute('error', repr(e))
                 span.set_attribute('breaker',
@@ -1035,14 +1561,18 @@ class SkyServeLoadBalancer:
                 return e
 
     async def start_sync(self) -> None:
-        """Start the controller-sync loop (idempotent). Split out of
-        app startup so a hot STANDBY can mirror LBState — same sync
-        endpoint, warm replica/QoS view — long before it owns the
-        serving port (lease takeover then starts routing instantly)."""
+        """Start the controller-sync loop, and — with peers configured
+        — the peer-gossip loop (idempotent). Split out of app startup
+        so a hot STANDBY can mirror LBState — same sync endpoint, warm
+        replica/QoS view — long before it owns the serving port (lease
+        takeover then starts routing instantly)."""
         if self._session is None:
             self._session = aiohttp.ClientSession()
             self._sync_task = asyncio.create_task(
                 self._sync_with_controller())
+            if self.peers:
+                self._gossip_task = asyncio.create_task(
+                    self._gossip_loop())
 
     async def _on_startup(self, app: web.Application) -> None:
         del app
@@ -1052,12 +1582,14 @@ class SkyServeLoadBalancer:
         del app
         if self._sync_task:
             self._sync_task.cancel()
+        if self._gossip_task:
+            self._gossip_task.cancel()
         if self._session:
             await self._session.close()
             self._session = None
 
     def set_leader(self, leader: bool) -> None:
-        self._m_leader.set(1 if leader else 0)
+        self._m_leader.labels(self.lb_id).set(1 if leader else 0)
 
     async def _debug_traces(self, request: web.Request) -> web.Response:
         """LB-local trace store (this hop's spans; the replica serves
@@ -1079,13 +1611,31 @@ class SkyServeLoadBalancer:
             headers={'Content-Type': metrics_lib.CONTENT_TYPE})
 
     async def _debug_lb_state(self, request: web.Request) -> web.Response:
-        """The LBState snapshot this LB is routing on, plus the degraded-
-        mode flags — the first stop when diagnosing a controller
-        partition ('is the front door stale, and how stale?')."""
+        """The LBState snapshot this LB is routing on, plus the
+        degraded-mode flags, the peer tier's health, and the affinity
+        ring — the first stop when diagnosing a controller partition
+        or an N-active drill ('is the front door stale, who is in the
+        tier, has the ring reconverged?')."""
         del request
         payload = json.loads(self.snapshot_state().to_json())
         payload['stale'] = self._stale
-        payload['leader'] = self._m_leader.value()
+        payload['lb_id'] = self.lb_id
+        payload['leader'] = self._m_leader.value(self.lb_id)
+        now = time.monotonic()
+        ttl = _peer_stale_s()
+        payload['peers'] = {
+            pv.lb_id: {
+                'url': pv.url,
+                'exchange_age_s': round(pv.exchange_age_s(now), 3),
+                'state_age_s': round(pv.state.age_s(), 3),
+                'fresh': pv.exchange_age_s(now) <= ttl,
+                'ready_replicas': len(pv.state.ready_replicas),
+            } for pv in self._peer_views.values()}
+        if self.policy.uses_affinity:
+            payload['ring'] = {
+                'nodes': self.policy.ring.weights(),
+                'sessions': self.policy.session_count(),
+            }
         return web.json_response(payload)
 
     def make_app(self) -> web.Application:
@@ -1093,13 +1643,33 @@ class SkyServeLoadBalancer:
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         # Registered before the catch-all: /debug/traces, /debug/
-        # lb_state and /metrics are answered by the LB itself, not
-        # proxied (each hop serves its own stores).
+        # lb_state, /lb/gossip and /metrics are answered by the LB
+        # itself, not proxied (each hop serves its own stores).
         app.router.add_get('/debug/traces', self._debug_traces)
         app.router.add_get('/debug/lb_state', self._debug_lb_state)
+        app.router.add_post('/lb/gossip', self._handle_gossip)
         app.router.add_get('/metrics', self._metrics)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app
+
+
+async def serve_active(lb: 'SkyServeLoadBalancer', host: str = '0.0.0.0'
+                       ) -> web.AppRunner:
+    """Run `lb` as one member of an N-active tier: no lease, no
+    standby wait — every LB binds its OWN port and serves immediately,
+    sharing state through the controller sync plus peer gossip
+    (docs/serving.md "N-active front door"). Crash tolerance comes
+    from the tier itself: clients (or the VIP/DNS layer in front) fail
+    over to a surviving peer, which already holds the same LBState and
+    the same deterministic ring."""
+    await lb.start_sync()
+    lb.set_leader(True)
+    runner = web.AppRunner(lb.make_app())
+    await runner.setup()
+    await web.TCPSite(runner, host, lb.port, reuse_address=True).start()
+    logger.info('LB %s active on port %d (%d peer(s): %s)', lb.lb_id,
+                lb.port, len(lb.peers), lb.peers)
+    return runner
 
 
 async def serve_as_leader(lb: 'SkyServeLoadBalancer', lease: LeaderLease,

@@ -4,14 +4,114 @@ Reference: sky/serve/load_balancing_policies.py (:22 base, :47
 RoundRobinPolicy — the only one implemented there). We add
 LeastConnectionsPolicy, which matters for TPU inference replicas where
 requests are long-lived (continuous batching) and round-robin piles onto
-busy replicas.
+busy replicas, and PrefixAffinityPolicy — consistent-hash routing on a
+prompt-prefix key so multi-turn and shared-system-prompt traffic lands
+on replicas whose prefix cache is already warm (docs/serving.md
+"N-active front door"; ROADMAP item 2).
+
+The hash ring is weighted **rendezvous hashing** (highest random
+weight), which is the consistent-hashing construction with *provably*
+minimal disruption: each (node, key) pair gets a deterministic score,
+the highest score owns the key, and removing a node only moves the keys
+that node owned while adding one only moves the keys it now wins —
+nothing else changes owner because no other node's scores change. That
+is exactly the bounded re-hash the serve plane needs on replica churn
+(in-flight requests finish on the target chosen at admission; only
+~K/N keys re-home).
 """
+import hashlib
+import math
 import random
 import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Set
+
+from skypilot_tpu.utils import env
+
+
+class ConsistentHashRing:
+    """Weighted rendezvous-hash ring: key -> node with minimal key
+    movement on node churn and weight updates.
+
+    Scores use the standard weighted-rendezvous form
+    ``-weight / ln(u)`` where ``u in (0, 1)`` is the (node, key) hash
+    mapped to the unit interval — so a node with twice the weight owns
+    (asymptotically) twice the keys, and weight changes move only the
+    proportional sliver of keys. Hashing is sha256 over
+    ``"node|key"``: deterministic across processes, so N active LBs
+    fed the same (ready set, weights) snapshot route every key to the
+    SAME replica with no coordination.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._weights: Dict[str, float] = {}
+
+    def set_nodes(self, weights: Dict[str, float]) -> None:
+        """Replace the node set. Non-positive weights are clamped to a
+        small epsilon (a zero-weight node would divide away; it should
+        still own *some* keys while in the ready set)."""
+        with self._lock:
+            self._weights = {str(n): max(float(w), 1e-6)
+                             for n, w in weights.items()}
+
+    def nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._weights)
+
+    def weights(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._weights)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._weights)
+
+    @staticmethod
+    def _unit(node: str, key: str) -> float:
+        """(node, key) -> u in (0, 1), open on both ends."""
+        h = hashlib.sha256(f'{node}|{key}'.encode('utf-8')).digest()
+        v = int.from_bytes(h[:8], 'big')
+        return (v + 1) / (2**64 + 2)
+
+    def score(self, node: str, key: str) -> float:
+        with self._lock:
+            w = self._weights.get(node)
+        if w is None:
+            return float('-inf')
+        return -w / math.log(self._unit(node, key))
+
+    def ranked(self, key: str) -> List[str]:
+        """Nodes by descending score — the key's natural failover
+        order (the owner first, then where it re-homes if the owner is
+        excluded/departed)."""
+        with self._lock:
+            items = list(self._weights.items())
+        return [n for n, _ in sorted(
+            items,
+            key=lambda nw: -(-nw[1] / math.log(self._unit(nw[0], key))))]
+
+    def owner(self, key: str,
+              exclude: Optional[Set[str]] = None) -> Optional[str]:
+        """Highest-scoring node for `key`, skipping `exclude`."""
+        best, best_score = None, float('-inf')
+        with self._lock:
+            items = list(self._weights.items())
+        for node, w in items:
+            if exclude and node in exclude:
+                continue
+            s = -w / math.log(self._unit(node, key))
+            if s > best_score:
+                best, best_score = node, s
+        return best
 
 
 class LoadBalancingPolicy:
+    # True for policies that consume the per-request affinity key /
+    # session id — the LB only pays the body-hash cost when the active
+    # policy wants it.
+    uses_affinity = False
+
     def __init__(self) -> None:
         self.ready_replicas: List[str] = []
         self._lock = threading.Lock()
@@ -19,12 +119,26 @@ class LoadBalancingPolicy:
     def set_ready_replicas(self, replicas: List[str]) -> None:
         raise NotImplementedError
 
+    def set_weights(self, weights: Dict[str, float]) -> None:
+        """Per-replica routing weights (the LB passes prefix-cache
+        occupancy from the controller sync). Default: ignored."""
+
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       key: Optional[str] = None,
+                       session: Optional[str] = None
                        ) -> Optional[str]:
         """Pick a replica, skipping `exclude` (the LB passes replicas
-        this request already failed on plus breaker-ejected ones)."""
+        this request already failed on plus breaker-ejected ones).
+        `key` is the request's affinity key and `session` its sticky
+        session id — ignored by policies that don't route on them."""
         raise NotImplementedError
+
+    def peek_session(self, session: str) -> Optional[str]:
+        """Read-only: the replica `session` is currently pinned to,
+        if this policy tracks sessions (None otherwise)."""
+        del session
+        return None
 
     def on_request_done(self, replica: str) -> None:
         """Hook for policies that track in-flight requests."""
@@ -47,8 +161,11 @@ class RoundRobinPolicy(LoadBalancingPolicy):
                 self._index = 0
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       key: Optional[str] = None,
+                       session: Optional[str] = None
                        ) -> Optional[str]:
+        del key, session
         with self._lock:
             if not self.ready_replicas:
                 return None
@@ -75,8 +192,11 @@ class LeastConnectionsPolicy(LoadBalancingPolicy):
                               for r in replicas}
 
     def select_replica(self,
-                       exclude: Optional[Set[str]] = None
+                       exclude: Optional[Set[str]] = None,
+                       key: Optional[str] = None,
+                       session: Optional[str] = None
                        ) -> Optional[str]:
+        del key, session
         with self._lock:
             cands = [r for r in self.ready_replicas
                      if not exclude or r not in exclude]
@@ -93,7 +213,105 @@ class LeastConnectionsPolicy(LoadBalancingPolicy):
                 self._inflight[replica] -= 1
 
 
+class PrefixAffinityPolicy(LoadBalancingPolicy):
+    """Consistent-hash routing on the request's prompt-prefix key,
+    weighted by each replica's prefix-cache occupancy, with sticky
+    sessions (docs/serving.md "N-active front door").
+
+    * Keyed requests go to ``ring.owner(key)`` — the same replica from
+      every LB in an N-active tier, so shared-prefix traffic
+      concentrates where the KV prefix pages already live.
+    * ``X-Session-Id`` pins a session to the replica it first landed
+      on for as long as that replica stays ready and eligible — a
+      session is NEVER re-hashed by ring churn (weight updates,
+      joins); only its replica leaving the ready set (or being
+      excluded by the breaker/retry path) re-routes it, at which point
+      it re-pins to its new home. The session table is a bounded LRU
+      (``SKYT_LB_RING_SESSIONS_MAX``).
+    * Keyless traffic (no body prefix, no session) round-robins.
+    """
+
+    uses_affinity = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.ring = ConsistentHashRing()
+        self._sessions: 'OrderedDict[str, str]' = OrderedDict()
+        self._occupancy: Dict[str, float] = {}
+        self._rr = 0
+
+    def _rebuild_ring_locked(self) -> None:
+        # weight = 1 + alpha * occupancy: a cold replica still owns its
+        # base share (new capacity must absorb keys), a warm one pulls
+        # proportionally more of the keyspace toward its cache.
+        alpha = env.get_float('SKYT_LB_RING_WEIGHT_OCCUPANCY', 1.0)
+        self.ring.set_nodes({
+            r: 1.0 + alpha * min(max(self._occupancy.get(r, 0.0), 0.0),
+                                 1.0)
+            for r in self.ready_replicas})
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            self.ready_replicas = list(replicas)
+            self._rebuild_ring_locked()
+            # Sessions whose replica left the ready set re-route on
+            # their next request (and re-pin there); sessions on
+            # surviving replicas are untouched — bounded re-hash.
+            alive = set(replicas)
+            for s in [s for s, r in self._sessions.items()
+                      if r not in alive]:
+                del self._sessions[s]
+
+    def set_weights(self, weights: Dict[str, float]) -> None:
+        with self._lock:
+            self._occupancy = {str(r): float(w)
+                               for r, w in weights.items()}
+            self._rebuild_ring_locked()
+
+    def peek_session(self, session: str) -> Optional[str]:
+        with self._lock:
+            return self._sessions.get(session)
+
+    def session_count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def select_replica(self,
+                       exclude: Optional[Set[str]] = None,
+                       key: Optional[str] = None,
+                       session: Optional[str] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            exclude = exclude or set()
+            cands = [r for r in self.ready_replicas
+                     if r not in exclude]
+            if not cands:
+                return None
+            if session:
+                bound = self._sessions.get(session)
+                if bound is not None and bound in cands:
+                    self._sessions.move_to_end(session)
+                    return bound
+            pick = None
+            if key is not None:
+                pick = self.ring.owner(key, exclude=exclude)
+            if pick is None or pick not in cands:
+                # Keyless request (or the ring lags the ready set for
+                # a beat): spread round-robin instead of hot-spotting.
+                self._rr += 1
+                pick = cands[self._rr % len(cands)]
+            if session:
+                self._sessions[session] = pick
+                self._sessions.move_to_end(session)
+                cap = env.get_int('SKYT_LB_RING_SESSIONS_MAX', 8192,
+                                  minimum=1)
+                while len(self._sessions) > cap:
+                    self._sessions.popitem(last=False)
+            return pick
+
+
 POLICIES = {
     'round_robin': RoundRobinPolicy,
     'least_connections': LeastConnectionsPolicy,
+    'prefix_affinity': PrefixAffinityPolicy,
 }

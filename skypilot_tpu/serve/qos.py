@@ -683,6 +683,28 @@ class ServerQoS:
         return out
 
 
+def rate_by_class(events, window_s: float,
+                  now: Optional[float] = None) -> Dict[str, float]:
+    """Per-class event rate (events/second) over the trailing
+    ``window_s`` of an iterable of ``(wall_ts, class)`` pairs. The
+    N-active LB tier uses this for the demand/shed slices each LB
+    advertises to its peers (docs/robustness.md "Front door"), so
+    fleet-wide pressure is a sum of per-LB rates rather than one LB's
+    view."""
+    if now is None:
+        now = time.time()
+    window_s = max(float(window_s), 1e-9)
+    cut = now - window_s
+    counts: Dict[str, int] = {}
+    for ts, cls in events:
+        try:
+            if float(ts) >= cut:
+                counts[cls] = counts.get(cls, 0) + 1
+        except (TypeError, ValueError):
+            continue
+    return {c: n / window_s for c, n in counts.items()}
+
+
 def shed_avoid_classes(level: int) -> 'Tuple[str, ...]':
     """Classes a replica at `level` would shed — the LB avoids
     routing those classes there while an unpressured replica exists."""

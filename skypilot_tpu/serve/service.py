@@ -19,6 +19,16 @@ mirror LBState via the controller sync as hot standbys and take over
 within one lease interval of leader death. A `--role controller`
 restart ADOPTS the replicas recorded in serve.db instead of
 relaunching them (serve/replica_managers.py).
+
+N-active front door (docs/serving.md "N-active front door"): give
+each `--role lb` process its own port and the peer list, and ALL of
+them serve concurrently — no lease, shared state via controller sync
+plus LB<->LB gossip, consistent-hash prefix-affinity routing if the
+spec asks for it:
+
+    ... --role lb --lb-port 8081 --lb-peers http://h:8082,http://h:8083
+    ... --role lb --lb-port 8082 --lb-peers http://h:8081,http://h:8083
+    ... --role lb --lb-port 8083 --lb-peers http://h:8081,http://h:8082
 """
 import argparse
 import asyncio
@@ -58,16 +68,24 @@ async def _start_controller(
     return controller, runner
 
 
-async def _start_lb(service_name: str, svc: dict
+async def _start_lb(service_name: str, svc: dict,
+                    lb_port: Optional[int] = None,
+                    lb_id: Optional[str] = None,
+                    lb_peers: Optional[str] = None,
+                    lb_advertise_url: Optional[str] = None
                     ) -> Optional[web.AppRunner]:
-    """Build the LB and serve it behind the leader lease (blocks until
-    this process IS the leader — instant when no other LB runs). A
-    standby gives up the wait when the service row disappears (serve
-    down while standing by) and returns None."""
+    """Build the LB and serve it. Default (no peers, no port
+    override): behind the leader lease — blocks until this process IS
+    the leader (instant when no other LB runs); a standby gives up the
+    wait when the service row disappears (serve down while standing
+    by) and returns None. With a peer list (flag or
+    SKYT_LB_PEER_URLS): one member of the N-active tier — own port,
+    no lease, serves immediately."""
     spec = svc['spec']
+    port = lb_port if lb_port is not None else svc['lb_port']
     lb = lb_lib.SkyServeLoadBalancer(
         controller_url=f'http://127.0.0.1:{svc["controller_port"]}',
-        port=svc['lb_port'],
+        port=port,
         policy=getattr(spec, 'load_balancing_policy', None)
         or 'round_robin',
         controller_auth=svc.get('auth_token'),
@@ -77,7 +95,16 @@ async def _start_lb(service_name: str, svc: dict
         # the readiness definition the replicas signed up for.
         stale_probe_path=spec.readiness_path,
         stale_probe_post=spec.post_data,
-        stale_probe_timeout_s=spec.probe_timeout_seconds)
+        stale_probe_timeout_s=spec.probe_timeout_seconds,
+        lb_id=lb_id,
+        # peers=None falls back to SKYT_LB_PEER_URLS inside the
+        # constructor — ONE parser (strip, drop empties, drop own
+        # advertise URL), not a drifting copy here.
+        peers=([p for p in lb_peers.split(',')]
+               if lb_peers is not None else None),
+        advertise_url=lb_advertise_url)
+    if lb.peers:
+        return await lb_lib.serve_active(lb)
     lease = lb_lib.LeaderLease(lb_lease_path(service_name))
     runner, _hb = await lb_lib.serve_as_leader(
         lb, lease,
@@ -85,7 +112,11 @@ async def _start_lb(service_name: str, svc: dict
     return runner
 
 
-async def _serve(service_name: str, role: str = 'both') -> None:
+async def _serve(service_name: str, role: str = 'both',
+                 lb_port: Optional[int] = None,
+                 lb_id: Optional[str] = None,
+                 lb_peers: Optional[str] = None,
+                 lb_advertise_url: Optional[str] = None) -> None:
     svc = serve_state.get_service(service_name)
     assert svc is not None, f'service {service_name} not in state DB'
 
@@ -96,7 +127,9 @@ async def _serve(service_name: str, role: str = 'both') -> None:
         controller, controller_runner = await _start_controller(
             service_name, svc)
     if role in ('both', 'lb'):
-        lb_runner = await _start_lb(service_name, svc)
+        lb_runner = await _start_lb(service_name, svc, lb_port=lb_port,
+                                    lb_id=lb_id, lb_peers=lb_peers,
+                                    lb_advertise_url=lb_advertise_url)
 
     if controller is not None:
         serve_state.set_service_status(
@@ -159,12 +192,30 @@ def main(argv=None) -> None:
                         default='both',
                         help='which halves of the control plane this '
                              'process runs (lb processes beyond the '
-                             'first become hot standbys)')
+                             'first become hot standbys, or N-active '
+                             'peers with --lb-peers)')
+    parser.add_argument('--lb-port', type=int, default=None,
+                        help='serve this port instead of the service '
+                             'row\'s lb_port (one port per member of '
+                             'an N-active tier)')
+    parser.add_argument('--lb-id', default=None,
+                        help='LB instance id (default lb-<port>)')
+    parser.add_argument('--lb-peers', default=None,
+                        help='comma-separated peer LB base URLs; '
+                             'presence switches this LB from the '
+                             'lease/standby model to N-active')
+    parser.add_argument('--lb-advertise-url', default=None,
+                        help='URL peers and the controller reach this '
+                             'LB at (default http://127.0.0.1:<port> — '
+                             'override on multi-host tiers)')
     args = parser.parse_args(argv)
     if args.role in ('both', 'controller'):
         serve_state.set_service_controller_pid(args.service_name,
                                                os.getpid())
-    asyncio.run(_serve(args.service_name, role=args.role))
+    asyncio.run(_serve(args.service_name, role=args.role,
+                       lb_port=args.lb_port, lb_id=args.lb_id,
+                       lb_peers=args.lb_peers,
+                       lb_advertise_url=args.lb_advertise_url))
 
 
 if __name__ == '__main__':

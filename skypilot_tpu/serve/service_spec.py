@@ -28,6 +28,11 @@ class ServiceSpec:
     upscale_delay_seconds: float = DEFAULT_UPSCALE_DELAY_SECONDS
     downscale_delay_seconds: float = DEFAULT_DOWNSCALE_DELAY_SECONDS
     base_ondemand_fallback_replicas: int = 0
+    # Which serve/load_balancing_policies.py policy the LB routes
+    # with; None = round_robin. 'prefix_affinity' turns on
+    # consistent-hash prompt-prefix routing (docs/serving.md
+    # "N-active front door").
+    load_balancing_policy: Optional[str] = None
 
     def __post_init__(self):
         if not self.readiness_path.startswith('/'):
@@ -81,6 +86,9 @@ class ServiceSpec:
                     kwargs[dst] = policy[src]
         elif 'replicas' in config:
             kwargs['min_replicas'] = config['replicas']
+        if 'load_balancing_policy' in config:
+            kwargs['load_balancing_policy'] = \
+                config['load_balancing_policy']
         return cls(**kwargs)
 
     def to_yaml_config(self) -> Dict[str, Any]:
@@ -103,4 +111,7 @@ class ServiceSpec:
         if self.base_ondemand_fallback_replicas:
             policy['base_ondemand_fallback_replicas'] = (
                 self.base_ondemand_fallback_replicas)
-        return {'readiness_probe': probe, 'replica_policy': policy}
+        out = {'readiness_probe': probe, 'replica_policy': policy}
+        if self.load_balancing_policy is not None:
+            out['load_balancing_policy'] = self.load_balancing_policy
+        return out

@@ -260,6 +260,27 @@ _var('SKYT_LB_LEASE_INTERVAL_S', 'float', 1.0,
      'Leader-lease heartbeat/poll interval for hot-standby LBs.')
 _var('SKYT_LB_TAKEOVER_BIND_TIMEOUT_S', 'float', 30.0,
      'How long a promoted standby retries binding the serve port.')
+_var('SKYT_LB_ID', 'str', None,
+     'Instance id of this LB process (metrics `lb` label, gossip '
+     'identity, fleet scrape target); default lb-<port>.')
+_var('SKYT_LB_PEER_URLS', 'str', '',
+     'Comma-separated peer LB base URLs for the N-active tier '
+     '(enables the gossip loop; own advertise URL is filtered out).')
+_var('SKYT_LB_ADVERTISE_URL', 'str', None,
+     'URL peers and the controller reach this LB at '
+     '(default http://127.0.0.1:<port>; override on multi-host tiers).')
+_var('SKYT_LB_PEER_SYNC_S', 'float', 2.0,
+     'LB <-> LB gossip exchange interval (seconds).')
+_var('SKYT_LB_PEER_STALE_S', 'float', 10.0,
+     'Exchange age past which a peer view leaves the aggregates '
+     '(per-peer stale-mode discipline).')
+_var('SKYT_LB_AFFINITY_PREFIX_BYTES', 'int', 1024,
+     'Bytes of normalized prompt prefix hashed into the affinity key.')
+_var('SKYT_LB_RING_WEIGHT_OCCUPANCY', 'float', 1.0,
+     'Ring weight gain per unit of prefix-cache occupancy '
+     '(weight = 1 + gain * occupancy).')
+_var('SKYT_LB_RING_SESSIONS_MAX', 'int', 8192,
+     'Sticky-session LRU capacity of the prefix_affinity policy.')
 
 # ---------------------------------------------------------------- qos
 _var('SKYT_QOS', 'bool', False,

@@ -41,6 +41,12 @@ Example — kill a specific replica's server on its 3rd request:
     SKYT_FAULTS='server.request=preempt,after=2' python -m \
         skypilot_tpu.infer.server ...
 
+Example — the N-active front-door drill: SIGKILL one LB of a tier on
+its 5th proxied request, or partition the LB<->LB gossip:
+
+    SKYT_FAULTS='lb.crash=crash,after=4' ... --role lb --lb-peers ...
+    SKYT_FAULTS='lb.gossip=error' ...        # tier partition
+
 Determinism: probabilistic rules draw from a per-rule
 ``random.Random`` seeded from ``SKYT_FAULTS_SEED`` (default 0) and the
 rule's index, so a chaos run replays identically.

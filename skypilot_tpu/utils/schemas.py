@@ -99,6 +99,10 @@ _SERVICE_SCHEMA = {
             },
         },
         'replicas': {'type': 'integer'},  # shorthand for fixed replica count
+        'load_balancing_policy': {
+            'enum': ['round_robin', 'least_connections',
+                     'prefix_affinity'],
+        },
     },
 }
 

@@ -273,10 +273,11 @@ def test_lb_retries_on_another_replica(monkeypatch):
         assert r.status_code == 200
         assert r.text == 'hello-live'
         assert r.headers['X-Replica-Id'] == live
-    retries = reg.counter('skyt_lb_retries_total', '', ('replica',))
-    assert retries.value(dead) >= 1
-    errors = reg.counter('skyt_lb_errors_total', '', ('replica',))
-    assert errors.value(dead) >= 1
+    retries = reg.counter('skyt_lb_retries_total', '',
+                          ('lb', 'replica'))
+    assert retries.value(lb.lb_id, dead) >= 1
+    errors = reg.counter('skyt_lb_errors_total', '', ('lb', 'replica'))
+    assert errors.value(lb.lb_id, dead) >= 1
     del lb
 
 
@@ -293,15 +294,18 @@ def test_lb_breaker_opens_and_is_visible_in_metrics(monkeypatch):
     for _ in range(8):
         assert requests.get(base + '/g', timeout=10).status_code == 200
     assert lb.breaker.state(dead) == lb.breaker.OPEN
-    requests_m = reg.counter('skyt_lb_requests_total', '', ('replica',))
-    sent_to_dead = requests_m.value(dead)
+    requests_m = reg.counter('skyt_lb_requests_total', '',
+                             ('lb', 'replica'))
+    sent_to_dead = requests_m.value(lb.lb_id, dead)
     # Breaker open: further traffic skips the dead replica entirely.
     for _ in range(4):
         assert requests.get(base + '/g', timeout=10).status_code == 200
-    assert requests_m.value(dead) == sent_to_dead
+    assert requests_m.value(lb.lb_id, dead) == sent_to_dead
     text = requests.get(base + '/metrics', timeout=5).text
-    assert f'skyt_lb_breaker_state{{replica="{dead}"}} 2' in text
-    assert f'skyt_lb_breaker_opens_total{{replica="{dead}"}} 1' in text
+    assert (f'skyt_lb_breaker_state{{lb="{lb.lb_id}",'
+            f'replica="{dead}"}} 2') in text
+    assert (f'skyt_lb_breaker_opens_total{{lb="{lb.lb_id}",'
+            f'replica="{dead}"}} 1') in text
     assert 'skyt_lb_retries_total' in text
 
 
@@ -367,10 +371,10 @@ def test_lb_client_disconnect_is_not_a_replica_failure(monkeypatch):
             pass
     time.sleep(1.5)   # LB finishes handling the aborted exchanges
     assert lb.breaker.state(url) == lb.breaker.CLOSED
-    errors = reg.counter('skyt_lb_errors_total', '', ('replica',))
-    assert errors.value(url) == 0
-    disc = reg.counter('skyt_lb_client_disconnects_total', '')
-    assert disc.value() >= 1
+    errors = reg.counter('skyt_lb_errors_total', '', ('lb', 'replica'))
+    assert errors.value(lb.lb_id, url) == 0
+    disc = reg.counter('skyt_lb_client_disconnects_total', '', ('lb',))
+    assert disc.value(lb.lb_id) >= 1
     # A patient client still gets proxied fine.
     r = requests.get(base + '/g', timeout=10)
     assert r.status_code == 200 and r.text == 'slow-ok'
@@ -391,8 +395,10 @@ def test_lb_retry_budget_exhaustion(monkeypatch):
     assert r.status_code == 502
     assert 'failed after' in r.text
     assert elapsed < 5, elapsed
-    retries = reg.counter('skyt_lb_retries_total', '', ('replica',))
-    assert retries.value(dead1) + retries.value(dead2) >= 1
+    retries = reg.counter('skyt_lb_retries_total', '',
+                          ('lb', 'replica'))
+    assert retries.value(_lb.lb_id, dead1) + \
+        retries.value(_lb.lb_id, dead2) >= 1
 
 
 def test_lb_no_replica_timeout_env(monkeypatch):
@@ -419,8 +425,9 @@ def test_lb_timestamp_buffer_cap(monkeypatch):
     lb.request_timestamps = list(range(25))
     lb._cap_timestamps()  # pylint: disable=protected-access
     assert lb.request_timestamps == list(range(15, 25))
-    dropped = reg.counter('skyt_lb_sync_dropped_timestamps_total', '')
-    assert dropped.value() == 15
+    dropped = reg.counter('skyt_lb_sync_dropped_timestamps_total', '',
+                          ('lb',))
+    assert dropped.value(lb.lb_id) == 15
 
 
 # ===================================================== replica lifecycle
@@ -731,8 +738,8 @@ def test_lb_stale_mode_serves_and_recovers(monkeypatch):
     state = requests.get(base + '/debug/lb_state', timeout=5).json()
     assert state['stale'] is True
     assert state['ready_replicas'] == [live]
-    assert 'skyt_lb_stale 1' in requests.get(base + '/metrics',
-                                             timeout=5).text
+    assert f'skyt_lb_stale{{lb="{lb.lb_id}"}} 1' in requests.get(
+        base + '/metrics', timeout=5).text
 
     # Sync heals: stale mode exits, fresh state applies.
     faults.reset()
@@ -740,8 +747,8 @@ def test_lb_stale_mode_serves_and_recovers(monkeypatch):
     while time.time() < deadline and lb._stale:  # pylint: disable=protected-access
         time.sleep(0.1)
     assert not lb._stale  # pylint: disable=protected-access
-    assert 'skyt_lb_stale 0' in requests.get(base + '/metrics',
-                                             timeout=5).text
+    assert f'skyt_lb_stale{{lb="{lb.lb_id}"}} 0' in requests.get(
+        base + '/metrics', timeout=5).text
 
 
 def test_lb_stale_probe_prunes_dead_replica(monkeypatch):
@@ -804,8 +811,8 @@ def test_lb_stale_probe_prunes_dead_replica(monkeypatch):
                 dead in lb.policy.ready_replicas:
             time.sleep(0.1)
         assert lb.policy.ready_replicas == [live]
-        pruned = reg.counter('skyt_lb_stale_pruned_total', '')
-        assert pruned.value() >= 1
+        pruned = reg.counter('skyt_lb_stale_pruned_total', '', ('lb',))
+        assert pruned.value(lb.lb_id) >= 1
         # And traffic still flows on the survivor.
         r = requests.get(f'http://127.0.0.1:{lb_port}/g', timeout=10)
         assert r.status_code == 200 and r.text == 'hello-sp-live'
@@ -861,14 +868,15 @@ def test_lb_stale_probe_threshold_recovery_and_no_contract(monkeypatch):
                     f'pruned after only {i + 1} failure(s)'
             await lb._prune_stale_replicas()  # pylint: disable=protected-access
             assert lb.policy.ready_replicas == []     # 3rd: pruned
-            pruned = reg.counter('skyt_lb_stale_pruned_total', '')
-            assert pruned.value() == 1
+            pruned = reg.counter('skyt_lb_stale_pruned_total', '',
+                                 ('lb',))
+            assert pruned.value(lb.lb_id) == 1
             # Recovery: the next round re-probes the full snapshot and
             # re-admits the healed replica.
             health['ok'] = True
             await lb._prune_stale_replicas()  # pylint: disable=protected-access
             assert lb.policy.ready_replicas == [url]
-            assert pruned.value() == 1                # no double count
+            assert pruned.value(lb.lb_id) == 1        # no double count
 
             # No contract, no env override: pruning is a no-op even
             # with a stone-dead replica in the snapshot.
@@ -903,7 +911,8 @@ def test_lb_stale_ttl_drains(monkeypatch):
     assert lb.policy.ready_replicas == ['http://r1']
     aio.run(lb._enter_or_hold_stale())  # pylint: disable=protected-access
     assert lb.policy.ready_replicas == []
-    assert reg.gauge('skyt_lb_stale', '').value() == 1
+    assert reg.gauge('skyt_lb_stale', '',
+                     ('lb',)).value(lb.lb_id) == 1
 
 
 def test_leader_lease_survives_nothing_flock_released_on_kill(tmp_path):
@@ -1297,13 +1306,341 @@ def test_lb_standby_takes_over_port(tmp_state_dir, monkeypatch):
             assert __import__('json').loads(f.read())['pid'] == \
                 standby_pid
         # The new leader advertises leadership on its own /metrics.
-        assert 'skyt_lb_leader 1' in requests.get(
+        assert f'skyt_lb_leader{{lb="lb-{lport}"}} 1' in requests.get(
             base + '/metrics', timeout=5).text
     finally:
         for p in lbs:
             if p.poll() is None:
                 p.kill()
         serve_state.remove_service('sbsvc')
+
+
+# ======================================= N-active LB tier (front door)
+def test_lb_gossip_partition_and_reconverge(monkeypatch):
+    """Two active LBs exchanging LBState via gossip. Partition BOTH
+    planes (`lb.sync=error` + `lb.gossip=error`): each LB keeps
+    serving from its own stale view (degraded, never down), the peer
+    views age past SKYT_LB_PEER_STALE_S and leave the aggregates.
+    Heal: stale mode exits and the peers reconverge to fresh."""
+    from aiohttp import web
+
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYT_LB_PEER_SYNC_S', '0.2')
+    monkeypatch.setenv('SKYT_LB_PEER_STALE_S', '0.6')
+    live = _ok_replica('gsp')
+    ctrl_port = _free_port()
+
+    async def sync_handler(request):
+        del request
+        return web.json_response({'ready_replica_urls': [live]})
+
+    ctrl_app = web.Application()
+    ctrl_app.router.add_post('/controller/load_balancer_sync',
+                             sync_handler)
+    _run_app_bg(ctrl_app, ctrl_port)
+
+    ports = [_free_port(), _free_port()]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    lbs = []
+    for port, peer in zip(ports, reversed(urls)):
+        lb = lb_lib.SkyServeLoadBalancer(
+            f'http://127.0.0.1:{ctrl_port}', port,
+            policy='prefix_affinity',
+            metrics_registry=metrics_lib.MetricsRegistry(),
+            peers=[peer])
+        _run_app_bg(lb.make_app(), port)
+        lbs.append(lb)
+
+    def states():
+        return [requests.get(u + '/debug/lb_state', timeout=5).json()
+                for u in urls]
+
+    def all_fresh(sts):
+        return all(s['ready_replicas'] == [live] and s['peers'] and
+                   all(p['fresh'] for p in s['peers'].values())
+                   for s in sts)
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if all_fresh(states()):
+                break
+        except requests.RequestException:
+            pass            # LB apps still binding
+        time.sleep(0.2)
+    assert all_fresh(states()), states()
+
+    # Full partition: controller sync AND gossip fail everywhere.
+    faults.configure('lb.sync=error;lb.gossip=error')
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        sts = states()
+        if all(s['stale'] for s in sts) and \
+                not any(p['fresh'] for s in sts
+                        for p in s['peers'].values()):
+            break
+        time.sleep(0.2)
+    sts = states()
+    assert all(s['stale'] for s in sts), sts
+    assert not any(p['fresh'] for s in sts
+                   for p in s['peers'].values()), sts
+    # Degraded, not down: BOTH keep serving their stale views.
+    for u in urls:
+        r = requests.get(u + '/g', timeout=10)
+        assert r.status_code == 200 and r.text == 'hello-gsp'
+
+    # Heal: stale mode exits and the tier reconverges.
+    faults.reset()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        sts = states()
+        if not any(s['stale'] for s in sts) and all_fresh(sts):
+            break
+        time.sleep(0.2)
+    sts = states()
+    assert not any(s['stale'] for s in sts), sts
+    assert all_fresh(sts), sts
+    del lbs
+
+
+def test_lb_gossip_rejects_unauthenticated_and_unconfigured(monkeypatch):
+    """/lb/gossip lives on the CLIENT-facing port: with the service
+    token configured it 401s unauthenticated senders, and payloads
+    whose advertised URL is not in the configured peer list never
+    become a PeerView — an arbitrary client must not be able to
+    poison the routing view or grow the peer table."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '3600')
+    port = _free_port()
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', port, controller_auth='sekrit',
+        metrics_registry=metrics_lib.MetricsRegistry(),
+        peers=['http://127.0.0.1:1'])
+    _run_app_bg(lb.make_app(), port)
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            requests.get(base + '/metrics', timeout=2)
+            break
+        except requests.RequestException:
+            time.sleep(0.1)
+    forged = {'lb_id': 'evil', 'url': 'http://attacker:80',
+              'state': {'ready_replicas': ['http://attacker:80'],
+                        'age_s': 0.0}}
+    r = requests.post(base + '/lb/gossip', json=forged, timeout=5)
+    assert r.status_code == 401
+    assert lb._peer_views == {}  # pylint: disable=protected-access
+    # Right token, but the sender's URL is not a configured peer:
+    # answered (push-pull still works mid-rolling-update), absorbed
+    # NOT — no PeerView, no poisoned avoid set, no adopted state.
+    r = requests.post(base + '/lb/gossip', json=forged, timeout=5,
+                      headers={'Authorization': 'Bearer sekrit'})
+    assert r.status_code == 200
+    assert lb._peer_views == {}  # pylint: disable=protected-access
+    # A configured peer with the token IS absorbed.
+    ok = {'lb_id': 'lb-1', 'url': 'http://127.0.0.1:1',
+          'state': {'ready_replicas': ['http://r1'], 'age_s': 0.0}}
+    r = requests.post(base + '/lb/gossip', json=ok, timeout=5,
+                      headers={'Authorization': 'Bearer sekrit'})
+    assert r.status_code == 200
+    assert list(lb._peer_views) == ['lb-1']  # pylint: disable=protected-access
+
+
+def _spawn_lb(name, port, peer_urls, extra_env=None):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service-name', name, '--role', 'lb',
+         '--lb-port', str(port), '--lb-peers', ','.join(peer_urls)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+
+@pytest.mark.integration
+def test_chaos_n_active_lb_sigkill_mid_burst(tmp_state_dir,
+                                             monkeypatch):
+    """THE front-door acceptance drill (docs/robustness.md "Front
+    door"): 3 ACTIVE LB processes (prefix_affinity ring, peer gossip)
+    serving a concurrent burst; one SIGKILLs itself mid-burst via the
+    `lb.crash` fault point. Clients that fail over to a surviving LB
+    see ZERO 5xx, the same affinity key keeps routing to the same
+    replica through every survivor (deterministic ring — the dead
+    LB's traffic is absorbed with affinity intact), and the dead peer
+    leaves the survivors' fresh-peer sets within one exchange
+    interval + staleness bound."""
+    from aiohttp import web
+
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    del tmp_state_dir
+    serve_state.reset_db_for_testing()
+    monkeypatch.setenv('SKYT_SERVE_LB_SYNC_INTERVAL', '0.2')
+    monkeypatch.setenv('SKYT_LB_PEER_SYNC_S', '0.2')
+    monkeypatch.setenv('SKYT_LB_PEER_STALE_S', '1.0')
+    r1, r2 = _ok_replica('na-r1'), _ok_replica('na-r2')
+    ctrl_port = _free_port()
+    spec = spec_lib.ServiceSpec(
+        readiness_path='/', min_replicas=2,
+        load_balancing_policy='prefix_affinity')
+    assert serve_state.add_service('nasvc', spec, '/t.yaml',
+                                   ctrl_port, _free_port())
+
+    ctrl_up = {'ok': True}   # flipped to partition the controller
+
+    async def sync_handler(request):
+        del request
+        if not ctrl_up['ok']:
+            return web.json_response({'error': 'partitioned'},
+                                     status=503)
+        return web.json_response({
+            'ready_replica_urls': [r1, r2],
+            'replica_prefix_cache': {r1: {'occupancy': 0.4},
+                                     r2: {'occupancy': 0.1}}})
+
+    ctrl_app = web.Application()
+    ctrl_app.router.add_post('/controller/load_balancer_sync',
+                             sync_handler)
+    _run_app_bg(ctrl_app, ctrl_port)
+
+    ports = [_free_port() for _ in range(3)]
+    urls = [f'http://127.0.0.1:{p}' for p in ports]
+    procs = []
+    for i, port in enumerate(ports):
+        peers = [u for u in urls if u != urls[i]]
+        extra = None
+        if i == 0:
+            # The chaos event comes from INSIDE: the first LB SIGKILLs
+            # itself on its 4th proxied request (lb.crash fires in the
+            # proxy path only — /debug and /lb/gossip don't count).
+            extra = {'SKYT_FAULTS': 'lb.crash=crash,after=3'}
+        procs.append(_spawn_lb('nasvc', port, peers, extra_env=extra))
+
+    def lb_state(u, timeout=5):
+        return requests.get(u + '/debug/lb_state',
+                            timeout=timeout).json()
+
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                sts = [lb_state(u) for u in urls]
+                if all(sorted(s['ready_replicas']) == sorted([r1, r2])
+                       and sum(1 for p in s['peers'].values()
+                               if p['fresh']) == 2 for s in sts):
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError('N-active tier never converged')
+
+        # Ring consistency across the tier, pre-kill: the same keyed
+        # body routes to the SAME replica through the two LBs that
+        # will survive (the doomed one must not see proxy traffic
+        # before the burst).
+        keyed = {'tokens': [7, 8, 9], 'max_tokens': 2}
+        homes = {requests.post(u + '/gen', json=keyed,
+                               timeout=10).headers['X-Replica-Id']
+                 for u in urls[1:]}
+        assert len(homes) == 1, homes
+        home = homes.pop()
+
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            # A front-door client: try LBs in order until one answers
+            # (the VIP/DNS failover a real deployment has). Transport
+            # errors against a dead LB are expected; an HTTP 5xx from
+            # a SURVIVOR is the failure this drill exists to catch.
+            for attempt, u in enumerate(
+                    urls[i % 3:] + urls[:i % 3]):
+                try:
+                    r = requests.post(
+                        u + f'/burst-{i}', json=keyed
+                        if i % 2 == 0 else {'tokens': [i], 'n': i},
+                        headers={'X-Session-Id': f'sess-{i % 4}'},
+                        timeout=30)
+                    with lock:
+                        results.append(
+                            (r.status_code,
+                             r.headers.get('X-Replica-Id')))
+                    return
+                except requests.RequestException:
+                    continue
+            with lock:
+                results.append((599, None))   # no LB answered at all
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(24)]
+        for th in threads[:8]:
+            th.start()
+        # lb.crash fires inside procs[0] during this window.
+        for th in threads[8:]:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        assert len(results) == 24
+        codes = [c for c, _ in results]
+        # Zero client-visible 5xx: every request landed 200 on SOME
+        # active LB.
+        assert codes == [200] * 24, codes
+
+        # The fault actually fired: LB 0 died by SIGKILL.
+        deadline = time.time() + 30
+        while time.time() < deadline and procs[0].poll() is None:
+            time.sleep(0.2)
+        assert procs[0].returncode == -signal.SIGKILL, \
+            procs[0].returncode
+
+        # Survivors drop the dead peer from their fresh sets within
+        # one exchange interval + the staleness bound.
+        dead_id = f'lb-{ports[0]}'
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            sts = [lb_state(u) for u in urls[1:]]
+            if all(not s['peers'].get(dead_id, {}).get('fresh', True)
+                   for s in sts):
+                break
+            time.sleep(0.2)
+        sts = [lb_state(u) for u in urls[1:]]
+        assert all(not s['peers'].get(dead_id, {}).get('fresh', True)
+                   for s in sts), sts
+        # Ring reconvergence: both survivors still route the key to
+        # its pre-kill home (replicas never churned, so no key moved).
+        for u in urls[1:]:
+            r = requests.post(u + '/gen', json=keyed, timeout=10)
+            assert r.status_code == 200
+            assert r.headers['X-Replica-Id'] == home
+            assert lb_state(u)['ring']['nodes'], 'ring emptied'
+
+        # Same window, second chaos event: the CONTROLLER partitions.
+        # Both survivors must degrade to per-LB stale mode — still
+        # serving the full healthy replica set, nothing drained.
+        ctrl_up['ok'] = False
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(lb_state(u)['stale'] for u in urls[1:]):
+                break
+            time.sleep(0.2)
+        for u in urls[1:]:
+            s = lb_state(u)
+            assert s['stale'], s
+            assert sorted(s['ready_replicas']) == sorted([r1, r2]), \
+                'stale mode drained healthy replicas'
+            r = requests.post(u + '/gen', json=keyed, timeout=10)
+            assert r.status_code == 200
+            assert r.headers['X-Replica-Id'] == home
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        serve_state.remove_service('nasvc')
 
 
 # ================================================ preemption guard modes
@@ -1404,10 +1741,11 @@ def test_chaos_replica_kill_mid_burst(monkeypatch):
         # controller sync could eject it.
         assert lb.breaker.state(url1) == lb.breaker.OPEN
         text = requests.get(base + '/metrics', timeout=5).text
-        assert f'skyt_lb_breaker_state{{replica="{url1}"}} 2' in text
+        assert (f'skyt_lb_breaker_state{{lb="{lb.lb_id}",'
+                f'replica="{url1}"}} 2') in text
         retries = reg.counter('skyt_lb_retries_total', '',
-                              ('replica',))
-        assert retries.value(url1) >= 1
+                              ('lb', 'replica'))
+        assert retries.value(lb.lb_id, url1) >= 1
     finally:
         for proc in procs:
             if proc.poll() is None:
@@ -1493,9 +1831,9 @@ def test_chaos_batch_flood_sheds_only_batch(monkeypatch):
         # The LB saw the upstream 429s and attributed them to the
         # batch class (the autoscaler's shed-rate signal).
         observed = reg.counter('skyt_lb_qos_sheds_observed_total', '',
-                               ('class',))
-        assert observed.value('batch') > 0
-        assert observed.value('interactive') == 0
+                               ('lb', 'class'))
+        assert observed.value(lb.lb_id, 'batch') > 0
+        assert observed.value(lb.lb_id, 'interactive') == 0
         del lb
     finally:
         if proc.poll() is None:

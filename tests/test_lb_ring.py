@@ -1,0 +1,251 @@
+"""Prefix-affinity consistent-hash ring + policy, in isolation
+(docs/serving.md "N-active front door", docs/robustness.md "Front
+door"): deterministic placement, bounded key movement on single-node
+join/leave, occupancy weighting, sticky-session semantics, and the
+peer demand-rate helper.
+"""
+import json
+import math
+
+import pytest
+
+from skypilot_tpu.serve import load_balancing_policies as lbp
+
+KEYS = [f'key-{i}' for i in range(600)]
+NODES3 = {'http://r1': 1.0, 'http://r2': 1.0, 'http://r3': 1.0}
+
+
+def _owners(ring):
+    return {k: ring.owner(k) for k in KEYS}
+
+
+# ============================================================== ring
+def test_ring_deterministic_placement_across_instances():
+    """Same (nodes, weights) => same owner for every key, from any
+    ring instance — the property that lets N active LBs route a key
+    identically with zero coordination."""
+    a, b = lbp.ConsistentHashRing(), lbp.ConsistentHashRing()
+    a.set_nodes(NODES3)
+    b.set_nodes(dict(reversed(list(NODES3.items()))))  # order-free
+    assert _owners(a) == _owners(b)
+    # And stable across repeated queries.
+    assert _owners(a) == _owners(a)
+    # All nodes own a non-trivial share under equal weights.
+    counts = {}
+    for owner in _owners(a).values():
+        counts[owner] = counts.get(owner, 0) + 1
+    assert set(counts) == set(NODES3)
+    assert min(counts.values()) > len(KEYS) / (len(NODES3) * 2)
+
+
+def test_ring_bounded_movement_on_leave():
+    """Single-node leave: ONLY keys the departed node owned move
+    (rendezvous scores of every other node are untouched), and the
+    moved count is within the ceil(K/N) fair share."""
+    ring = lbp.ConsistentHashRing()
+    ring.set_nodes(NODES3)
+    before = _owners(ring)
+    ring.set_nodes({n: w for n, w in NODES3.items()
+                    if n != 'http://r3'})
+    after = _owners(ring)
+    moved = [k for k in KEYS if before[k] != after[k]]
+    assert moved, 'departed node owned nothing?'
+    assert all(before[k] == 'http://r3' for k in moved), \
+        'a key not owned by the departed node changed owner'
+    assert len(moved) <= math.ceil(len(KEYS) / len(NODES3))
+    # Rejoin restores the EXACT original placement (deterministic).
+    ring.set_nodes(NODES3)
+    assert _owners(ring) == before
+
+
+def test_ring_bounded_movement_on_join():
+    """Single-node join: only keys the new node wins move."""
+    ring = lbp.ConsistentHashRing()
+    ring.set_nodes(NODES3)
+    before = _owners(ring)
+    joined = dict(NODES3, **{'http://r4': 1.0})
+    ring.set_nodes(joined)
+    after = _owners(ring)
+    moved = [k for k in KEYS if before[k] != after[k]]
+    assert moved, 'new node won nothing?'
+    assert all(after[k] == 'http://r4' for k in moved), \
+        'a key the new node did not win changed owner'
+    assert len(moved) <= math.ceil(len(KEYS) / len(joined))
+
+
+def test_ring_weights_shift_share_toward_warm_nodes():
+    """Weight = occupancy signal: doubling one node's weight grows its
+    key share, and the shift is incremental (keys that moved went TO
+    the upweighted node — nobody else's keys reshuffled)."""
+    ring = lbp.ConsistentHashRing()
+    ring.set_nodes(NODES3)
+    before = _owners(ring)
+    share_before = sum(1 for o in before.values() if o == 'http://r1')
+    ring.set_nodes(dict(NODES3, **{'http://r1': 2.0}))
+    after = _owners(ring)
+    share_after = sum(1 for o in after.values() if o == 'http://r1')
+    assert share_after > share_before
+    moved = [k for k in KEYS if before[k] != after[k]]
+    assert all(after[k] == 'http://r1' for k in moved)
+
+
+def test_ring_owner_exclude_walks_failover_order():
+    ring = lbp.ConsistentHashRing()
+    ring.set_nodes(NODES3)
+    key = 'some-conversation'
+    first = ring.owner(key)
+    second = ring.owner(key, exclude={first})
+    assert second is not None and second != first
+    assert ring.ranked(key)[0] == first
+    assert ring.ranked(key)[1] == second
+    assert ring.owner(key, exclude=set(NODES3)) is None
+
+
+# ============================================================ policy
+def _policy(replicas=('http://r1', 'http://r2', 'http://r3')):
+    pol = lbp.PrefixAffinityPolicy()
+    pol.set_ready_replicas(list(replicas))
+    return pol
+
+
+def test_policy_keyed_requests_follow_the_ring():
+    pol = _policy()
+    for key in ('a', 'b', 'c', 'd'):
+        want = pol.ring.owner(key)
+        for _ in range(3):
+            assert pol.select_replica(key=key) == want
+
+
+def test_policy_session_stickiness_overrides_ring_churn():
+    """A pinned session never re-hashes while its replica stays ready:
+    not on weight updates, not on a JOIN that would re-home its key."""
+    pol = _policy(('http://r1', 'http://r2'))
+    picked = pol.select_replica(key='conv-1', session='sess-1')
+    assert pol.peek_session('sess-1') == picked
+    # Weight update (occupancy refresh) — pin holds.
+    pol.set_weights({'http://r1': 0.9, 'http://r2': 0.1})
+    assert pol.select_replica(key='conv-1', session='sess-1') == picked
+    # Join a replica that may now win the key — pin still holds.
+    pol.set_ready_replicas(['http://r1', 'http://r2', 'http://r3'])
+    for _ in range(4):
+        assert pol.select_replica(key='conv-1',
+                                  session='sess-1') == picked
+
+
+def test_policy_session_reroutes_once_when_replica_leaves():
+    pol = _policy(('http://r1', 'http://r2'))
+    picked = pol.select_replica(key='conv-2', session='sess-2')
+    other = 'http://r1' if picked == 'http://r2' else 'http://r2'
+    pol.set_ready_replicas([other])          # pinned replica retired
+    assert pol.peek_session('sess-2') is None   # pin dropped
+    repick = pol.select_replica(key='conv-2', session='sess-2')
+    assert repick == other
+    # ... and re-pins there.
+    assert pol.peek_session('sess-2') == other
+    # The old replica coming back does NOT steal the session.
+    pol.set_ready_replicas(['http://r1', 'http://r2'])
+    assert pol.select_replica(key='conv-2', session='sess-2') == other
+
+
+def test_policy_exclusion_falls_through_and_repins():
+    """The retry/breaker exclude set beats the pin (a dead replica
+    must not blackhole its sessions); the session re-pins on the
+    fallback target."""
+    pol = _policy(('http://r1', 'http://r2'))
+    picked = pol.select_replica(key='k', session='s')
+    fallback = pol.select_replica(key='k', session='s',
+                                  exclude={picked})
+    assert fallback is not None and fallback != picked
+    assert pol.peek_session('s') == fallback
+    assert pol.select_replica(exclude={'http://r1', 'http://r2'},
+                              key='k', session='s') is None
+
+
+def test_policy_session_lru_bounded(monkeypatch):
+    monkeypatch.setenv('SKYT_LB_RING_SESSIONS_MAX', '4')
+    pol = _policy()
+    for i in range(10):
+        pol.select_replica(key=f'k{i}', session=f's{i}')
+    assert pol.session_count() == 4
+    assert pol.peek_session('s0') is None       # oldest evicted
+    assert pol.peek_session('s9') is not None
+
+
+def test_policy_keyless_traffic_spreads():
+    pol = _policy()
+    picks = {pol.select_replica() for _ in range(9)}
+    assert len(picks) == 3                      # round-robins, no hot spot
+
+
+def test_policy_weights_rebuild_ring_from_occupancy(monkeypatch):
+    monkeypatch.setenv('SKYT_LB_RING_WEIGHT_OCCUPANCY', '1.0')
+    pol = _policy(('http://r1', 'http://r2'))
+    assert pol.ring.weights() == {'http://r1': 1.0, 'http://r2': 1.0}
+    pol.set_weights({'http://r1': 0.5, 'http://r2': 2.5})  # clamped to 1
+    assert pol.ring.weights() == {'http://r1': 1.5, 'http://r2': 2.0}
+
+
+def test_base_policies_accept_affinity_kwargs():
+    """The LB passes key/session to every policy — the non-affinity
+    ones must ignore them, not crash."""
+    for name in ('round_robin', 'least_connections'):
+        pol = lbp.POLICIES[name]()
+        pol.set_ready_replicas(['http://a'])
+        assert pol.select_replica(key='k', session='s') == 'http://a'
+        assert pol.peek_session('s') is None
+        assert pol.uses_affinity is False
+    assert lbp.POLICIES['prefix_affinity'].uses_affinity is True
+
+
+# =================================================== LB-side helpers
+def test_affinity_key_stable_across_turns_and_shared_prefix():
+    """Chat bodies key on system prompt + FIRST user message: stable
+    across later turns of one conversation, shared by conversations
+    over the same opener, distinct across different openers."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', 0, policy='prefix_affinity',
+        metrics_registry=metrics_lib.MetricsRegistry())
+
+    def chat(*msgs):
+        return json.dumps({'messages': [
+            {'role': r, 'content': c} for r, c in msgs]}).encode()
+
+    turn1 = chat(('system', 'You are helpful.'), ('user', 'hi'))
+    turn3 = chat(('system', 'You are helpful.'), ('user', 'hi'),
+                 ('assistant', 'hello!'), ('user', 'tell me more'))
+    other = chat(('system', 'You are helpful.'), ('user', 'bye'))
+    k1, k3, ko = (lb._affinity_key(b)  # pylint: disable=protected-access
+                  for b in (turn1, turn3, other))
+    assert k1 == k3                      # multi-turn: key never moves
+    assert k1 != ko                      # different opener: new key
+    # A system message INJECTED mid-conversation (tool/moderation
+    # instructions at turn k) must not re-key the conversation: only
+    # the leading system run + first user message are the prefix.
+    injected = chat(('system', 'You are helpful.'), ('user', 'hi'),
+                    ('assistant', 'hello!'),
+                    ('system', 'tool result: 42'),
+                    ('user', 'tell me more'))
+    assert lb._affinity_key(injected) == k1  # pylint: disable=protected-access
+    # Normalization: whitespace shape does not split a key.
+    wobbly = chat(('system', ' You   are helpful. '), ('user', 'hi'))
+    assert lb._affinity_key(wobbly) == k1  # pylint: disable=protected-access
+    # Completion + token bodies key on the prompt prefix.
+    assert lb._affinity_key(b'{"prompt": "Once upon"}')  # pylint: disable=protected-access
+    assert lb._affinity_key(b'{"tokens": [1, 2, 3]}')  # pylint: disable=protected-access
+    # Keyless shapes.
+    for body in (b'', b'not json', b'[1,2]', b'{"max_tokens": 4}'):
+        assert lb._affinity_key(body) is None  # pylint: disable=protected-access
+
+
+def test_rate_by_class_windows_and_garbage():
+    from skypilot_tpu.serve import qos as qos_lib
+    now = 1000.0
+    events = [(now - 1, 'interactive'), (now - 2, 'interactive'),
+              (now - 3, 'batch'), (now - 100, 'interactive'),
+              ('garbage', 'batch')]
+    rates = qos_lib.rate_by_class(events, 10.0, now=now)
+    assert rates['interactive'] == pytest.approx(0.2)
+    assert rates['batch'] == pytest.approx(0.1)
+    assert qos_lib.rate_by_class([], 10.0, now=now) == {}

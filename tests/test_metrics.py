@@ -86,16 +86,20 @@ def test_lb_prunes_dead_replica_series():
     reg = metrics_lib.MetricsRegistry()
     lb = lb_lib.SkyServeLoadBalancer('http://c', 0,
                                      metrics_registry=reg)
-    lb._m_requests.labels('http://r1').inc(4)
-    lb._m_errors.labels('none').inc()
-    lb._m_inflight.labels('http://r1').inc()   # still draining
-    lb._m_inflight.labels('http://r2').inc()
-    lb._m_inflight.labels('http://r2').dec()   # idle
+    me = lb.lb_id
+    lb._m_requests.labels(me, 'http://r1').inc(4)
+    lb._m_errors.labels(me, 'none').inc()
+    lb._m_inflight.labels(me, 'http://r1').inc()   # still draining
+    lb._m_inflight.labels(me, 'http://r2').inc()
+    lb._m_inflight.labels(me, 'http://r2').dec()   # idle
+    # Another tier member's series in the SAME registry must survive
+    # this LB's prune untouched (the N-active `lb` label contract).
+    lb._m_requests.labels('lb-other', 'http://r9').inc()
     lb._prune_replica_metrics(['http://r3'])
-    assert lb._m_requests.label_keys() == []
-    assert lb._m_errors.label_keys() == [('none',)]   # kept
+    assert lb._m_requests.label_keys() == [('lb-other', 'http://r9')]
+    assert lb._m_errors.label_keys() == [(me, 'none')]   # kept
     # Nonzero inflight survives (the drain must dec its own child).
-    assert lb._m_inflight.label_keys() == [('http://r1',)]
+    assert lb._m_inflight.label_keys() == [(me, 'http://r1')]
 
 
 def test_histogram_bucket_collision():

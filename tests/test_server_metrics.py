@@ -270,8 +270,8 @@ def test_lb_to_server_trace_propagation(monkeypatch):
         assert '# TYPE skyt_lb_breaker_opens_total counter' in lb_text
         assert ('# TYPE skyt_lb_sync_dropped_timestamps_total counter'
                 in lb_text)
-        assert (f'skyt_lb_requests_total{{replica="{replica_url}"}}'
-                in lb_text)
+        assert (f'skyt_lb_requests_total{{lb="{lb.lb_id}",'
+                f'replica="{replica_url}"}}' in lb_text)
 
         # /stats satellite: unknown ids point at the trace surface,
         # malformed ids name the offending value.

@@ -431,12 +431,12 @@ def test_lb_prefix_cache_gauge_tracks_sync(monkeypatch):
         synced_at=1.0, version=1)
     lb.apply_state(state)
     gauge = reg.get('skyt_lb_replica_prefix_cache')
-    assert gauge.value('http://a') == 0.75
-    assert ('http://b',) not in gauge.label_keys()
+    assert gauge.value(lb.lb_id, 'http://a') == 0.75
+    assert (lb.lb_id, 'http://b') not in gauge.label_keys()
     # Replica leaves the sync: its series is pruned.
     lb.apply_state(lb_lib.LBState(ready_replicas=['http://b'],
                                   synced_at=2.0, version=2))
-    assert ('http://a',) not in gauge.label_keys()
+    assert (lb.lb_id, 'http://a') not in gauge.label_keys()
     # Snapshot roundtrip carries the block (standby mirrors see it).
     restored = lb_lib.LBState.from_json(state.to_json())
     assert restored.replica_prefix_cache['http://a']['occupancy'] == \

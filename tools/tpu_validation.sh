@@ -921,6 +921,25 @@ else
     FAIL=1
 fi
 
+echo "== 13. N-active LB drill: 3 concurrently-active LBs"
+echo "   (prefix-affinity ring + peer gossip) serve a burst while one"
+echo "   SIGKILLs itself mid-burst via SKYT_FAULTS=lb.crash=crash —"
+echo "   zero client-visible 5xx, the dead peer leaves the survivors'"
+echo "   fresh sets within one exchange interval, and the same"
+echo "   affinity key keeps routing to the same replica through every"
+echo "   survivor (ring reconvergence via /debug/lb_state). Runs on"
+echo "   CPU by design: the front door is host-side =="
+if timeout 600 env JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_chaos.py::test_chaos_n_active_lb_sigkill_mid_burst \
+        tests/test_chaos.py::test_lb_gossip_partition_and_reconverge \
+        -q -p no:cacheprovider 2>&1 | tee "$OUT/n_active_lb_drill.txt"
+then
+    echo "== N-active LB drill: PASS =="
+else
+    echo "== N-active LB drill: FAIL (see $OUT/n_active_lb_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
