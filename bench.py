@@ -50,6 +50,7 @@ PHASE_DEADLINES = {
     'overload bench': 420,
     'affinity bench': 600,
     'slo report bench': 420,
+    'kv+ragged bench': 600,
     'watchdog overhead bench': 300,
 }
 
@@ -1332,6 +1333,168 @@ def affinity_ab_metrics() -> list:
             eng.stop()
 
 
+def kv_ragged_metrics() -> list:
+    """kv+ragged phase (CPU-runnable, docs/performance.md "raw-speed
+    stack"): the three acceptance numbers of the int8-KV + ragged-
+    prefill PR.
+
+      * kv_pages_per_pool_ratio_int8 — pages a fixed HBM budget holds
+        at int8 KV vs the fp pool, exact memory_plan arithmetic for
+        the bf16 llama3-8b layout (acceptance >= 1.9; d=128 gives
+        1.94) plus the f32 debug layout as the CPU cross-check.
+      * prefill_padded_frac_{padded,ragged} — measured engine
+        counters (prefill_padded_tokens / prefill_dispatch_tokens) on
+        the SAME page-aligned mixed-length burst through the padded
+        batch path vs the ragged packed path (acceptance: ragged ~0,
+        padded ~0.5 — the pow2 row padding).
+      * kv_ragged_good_tokens_per_chip_second (+ per-class) — the
+        PR 8 SLO/goodput report over a classed burst against a real
+        server running int8 KV + ragged prefill (1 CPU "chip": a
+        mechanism check wiring the whole stack, not a perf claim).
+    """
+    import socket
+    import threading
+
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.infer import memory_plan
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama as llama_lib
+    from skypilot_tpu.serve import fleet as fleet_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+
+    # ---- 1. pages-per-pool arithmetic (the HBM story).
+    ratio_8b = memory_plan.kv_pages_ratio(
+        llama_lib.CONFIGS['llama3-8b'], 'int8')
+    ratio_dbg = memory_plan.kv_pages_ratio(
+        llama_lib.CONFIGS['debug'], 'int8')
+
+    # ---- 2. padded-token fraction, padded vs ragged, same burst.
+    # Page-aligned mixed lengths (32/64/16 tokens, page 16): the
+    # ragged pack is exact while the padded path pads each row to the
+    # 64 bucket AND the batch dim to pow2.
+    prompts = [list(range(1, 33)), list(range(2, 66)),
+               list(range(3, 19))]
+
+    def run_burst(ragged: bool):
+        import jax
+        import jax.numpy as jnp
+        from skypilot_tpu.infer import engine as engine_lib
+        cfg = llama_lib.CONFIGS['debug']
+        model = llama_lib.LlamaModel(cfg)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                     jnp.zeros((1, 8), jnp.int32))
+        eng = engine_lib.InferenceEngine(
+            model, params, num_slots=4, max_seq_len=128,
+            decode_chunk=4, cache_mode='paged', page_size=16,
+            ragged_prefill=ragged)
+        qs = [eng.submit(p, engine_lib.SamplingParams(
+            max_new_tokens=4))[1] for p in prompts]
+        eng.start()
+        try:
+            for q in qs:
+                while q.get(timeout=120) is not None:
+                    pass
+        finally:
+            eng.stop()
+        perf = dict(eng.perf)
+        return perf['prefill_padded_tokens'] / \
+            max(1, perf['prefill_dispatch_tokens'])
+
+    frac_padded = run_burst(ragged=False)
+    frac_ragged = run_burst(ragged=True)
+
+    # ---- 3. goodput through the full stack: int8 KV + ragged serve.
+    os.environ['SKYT_KV_DTYPE'] = 'int8'
+    try:
+        eng = server_lib.build_engine('debug', num_slots=2,
+                                      max_seq_len=64, decode_chunk=8,
+                                      cache_mode='paged',
+                                      prefix_caching=False)
+    finally:
+        os.environ.pop('SKYT_KV_DTYPE', None)
+    assert eng.kv_quantized, 'int8 KV knob did not reach the engine'
+    eng.start()
+    srv = server_lib.InferenceServer(eng)
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    threading.Thread(target=lambda: web.run_app(
+        srv.make_app(), port=port, print=None, handle_signals=False),
+        daemon=True).start()
+    base = f'http://127.0.0.1:{port}'
+    sess = requests.Session()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            if sess.get(base + '/health', timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(0.2)
+
+    def gen(cls, i, n_tok=8):
+        r = sess.post(base + '/generate',
+                      json={'tokens': [i % 50 + 2, 3, 4],
+                            'max_tokens': n_tok},
+                      headers={'X-Priority': cls,
+                               'X-Tenant': 'bench'}, timeout=60)
+        r.raise_for_status()
+
+    try:
+        for cls in ('interactive', 'standard', 'batch'):
+            gen(cls, 0)        # warm compiles + prime counter series
+        fl = fleet_lib.FleetTelemetry(
+            'bench', metrics_registry=metrics_lib.MetricsRegistry())
+        assert fl.scrape('1', base)
+        for i in range(10):
+            gen('interactive', i)
+        for i in range(5):
+            gen('batch', i)
+        time.sleep(0.05)
+        assert fl.scrape('1', base)
+        rep = fl.fleet_slo(window_s=300)
+        goodput = rep['goodput']
+        gtps = goodput['good_tokens_per_chip_second']
+        chip_s = goodput['chips'] * goodput['window_s']
+        per_class = {
+            cls: round(blk['good_tokens'] / chip_s, 4)
+            for cls, blk in goodput['classes'].items()
+            if blk['tokens'] > 0 and chip_s > 0}
+    finally:
+        eng.stop()
+    print(f'# kv+ragged: pages ratio 8b={ratio_8b:.3f} '
+          f'debug={ratio_dbg:.3f}, padded frac '
+          f'padded={frac_padded:.3f} ragged={frac_ragged:.3f}, '
+          f'int8 good_tok/chip_s={gtps} per-class={per_class}',
+          file=sys.stderr)
+    out = [
+        # Acceptance >= 1.9 at bf16 d=128.
+        {'metric': 'kv_pages_per_pool_ratio_int8',
+         'value': round(ratio_8b, 4), 'unit': 'x',
+         'vs_baseline': round(ratio_8b, 4)},
+        {'metric': 'kv_pages_per_pool_ratio_int8_debug_f32',
+         'value': round(ratio_dbg, 4), 'unit': 'x',
+         'vs_baseline': None},
+        {'metric': 'prefill_padded_frac_padded',
+         'value': round(frac_padded, 4), 'unit': 'fraction',
+         'vs_baseline': None},
+        # Acceptance ~0 on the page-aligned mixed burst.
+        {'metric': 'prefill_padded_frac_ragged',
+         'value': round(frac_ragged, 4), 'unit': 'fraction',
+         'vs_baseline': (round(frac_ragged / frac_padded, 4)
+                         if frac_padded > 0 else None)},
+        {'metric': 'kv_ragged_good_tokens_per_chip_second',
+         'value': gtps, 'unit': 'tok/chip-s', 'vs_baseline': None},
+    ]
+    for cls, v in sorted(per_class.items()):
+        out.append({'metric': f'kv_ragged_good_tok_chip_s_{cls}',
+                    'value': v, 'unit': 'tok/chip-s',
+                    'vs_baseline': None})
+    return out
+
+
 def watchdog_overhead_metrics() -> list:
     """Heartbeat hot-path cost (CPU-runnable): per-step wall delta of
     hb.on_step (file-backed, interval-throttled — the exact sft call)
@@ -1799,6 +1962,19 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# slo report bench failed: {e!r}', file=sys.stderr)
+
+    # kv+ragged phase: int8-KV pages-per-pool ratio, padded-token
+    # fraction padded vs ragged, goodput through an int8+ragged
+    # server. CPU-runnable.
+    if on_tpu:
+        _reclaim_hbm('pre-kv-ragged')
+    try:
+        with phase_deadline(PHASE_DEADLINES['kv+ragged bench'],
+                            'kv+ragged bench'):
+            extra = extra + kv_ragged_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# kv+ragged bench failed: {e!r}', file=sys.stderr)
 
     # Watchdog/heartbeat overhead phase: the training-plane heartbeat
     # must be cheap enough to leave ON (acceptance <=1%). CPU-runnable.

@@ -224,6 +224,13 @@ def _fresh_perf() -> Dict[str, float]:
             'spec_verify_steps': 0, 'spec_accepted': 0,
             'prefill_chunks': 0, 'prefill_dispatches': 0,
             'admitted_requests': 0, 'admission_batch_size': 0,
+            # Padding accounting across every prefill path: dispatch
+            # tokens = positions the prefill forward actually computed
+            # (B x bucket / packed T), padded = those holding no real
+            # prompt token. padded/dispatch is the wasted-FLOP
+            # fraction the ragged path drives toward 0.
+            'prefill_dispatch_tokens': 0, 'prefill_padded_tokens': 0,
+            'ragged_dispatches': 0,
             'host_finish_s': 0.0}
 
 
@@ -403,6 +410,8 @@ class InferenceEngine:
                  spec_decode: int = 0,
                  prefill_chunk: int = 0,
                  batch_admission: bool = True,
+                 kv_dtype: str = 'auto',
+                 ragged_prefill: Optional[bool] = None,
                  lockstep=None,
                  draft_model=None, draft_params=None,
                  lora_stack=None,
@@ -474,6 +483,37 @@ class InferenceEngine:
 
         dtype = jnp.dtype(self.cfg.dtype)
         self.cache_mode = cache_mode
+        # KV-cache dtype (paged mode): 'int8' stores the k/v pools as
+        # int8 with per-token per-head scales — ~2x the pages per HBM
+        # byte, so ~2x the concurrent users per chip (docs/
+        # performance.md "int8 KV cache"). Knob precedence: an
+        # explicit engine kv_dtype='int8' forces it; 'auto' (the
+        # default) defers to SKYT_KV_DTYPE, then to the model compute
+        # dtype (no quantization).
+        explicit_kv = kv_dtype not in (None, '', 'auto')
+        kv_req = kv_dtype if explicit_kv \
+            else env.get('SKYT_KV_DTYPE', 'auto')
+        if kv_req in (None, '', 'auto'):
+            kv_req = 'auto'
+        if kv_req not in ('auto', 'int8'):
+            if explicit_kv:
+                raise ValueError(
+                    f"kv_dtype must be 'auto' or 'int8', got {kv_req!r}")
+            # Env-sourced misconfiguration degrades instead of
+            # crash-looping the replica (the registry accessors'
+            # malformed-value convention, and the same treatment the
+            # dense-mode mismatch below gets).
+            logger.warning(
+                "SKYT_KV_DTYPE=%r is not 'auto' or 'int8'; serving at "
+                'the model dtype (%s)', kv_req, self.cfg.dtype)
+            kv_req = 'auto'
+        if kv_req == 'int8' and cache_mode != 'paged':
+            logger.warning(
+                'SKYT_KV_DTYPE/kv_dtype=int8 requires the paged cache; '
+                'the dense cache stays at %s', self.cfg.dtype)
+            kv_req = 'auto'
+        self.kv_dtype = kv_req
+        self.kv_quantized = kv_req == 'int8'
         # Prefix caching (paged mode only): admissions whose prompt
         # shares full pages with a published prefix skip both the KV
         # writes AND the prefill compute for the shared span — the
@@ -503,19 +543,23 @@ class InferenceEngine:
                                      * page_size)
         self.pool = None
         cache_sharding = None
+        scale_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             tp = mesh.shape.get('tp', 1)
             # Shard the cache over tp on kv_heads (matching the model's
             # 'act_kv_heads' constraint); replicate if tp doesn't divide.
             # kv_heads is axis 3 of the dense cache [L, slots, S, H, d]
-            # and axis 2 of the page-major pool [L, pages, H, P, d].
+            # and axis 2 of the page-major pool [L, pages, H, P, d]
+            # (and of the 4D scale pool [L, pages, H, P]).
             kv_axis = 'tp' if tp > 1 and \
                 self.cfg.n_kv_heads % tp == 0 else None
             spec = (P(None, None, kv_axis, None, None)
                     if cache_mode == 'paged'
                     else P(None, None, None, kv_axis, None))
             cache_sharding = NamedSharding(mesh, spec)
+            scale_sharding = NamedSharding(
+                mesh, P(None, None, kv_axis, None))
         if cache_mode == 'paged':
             # Paged (block-table) cache: HBM scales with tokens actually
             # reserved, not slots x max_seq (VERDICT r2 missing #1).
@@ -524,15 +568,21 @@ class InferenceEngine:
                 self.max_seq_len, num_slots, page_size, pool_tokens)
             put = (lambda x: jax.device_put(x, cache_sharding)) \
                 if cache_sharding is not None else None
+            sput = (lambda x: jax.device_put(x, scale_sharding)) \
+                if scale_sharding is not None else None
             with self._ctx():
                 self.pool = paged_cache.PagePool(
                     pcfg, self.cfg.n_layers, self.cfg.n_kv_heads,
-                    self.cfg.head_dim, num_slots, dtype, device_put=put)
+                    self.cfg.head_dim, num_slots, dtype, device_put=put,
+                    kv_dtype=self.kv_dtype, scale_device_put=sput)
             self.cache = {'k': self.pool.pools['k'],
                           'v': self.pool.pools['v'],
                           'tables': jnp.zeros(
                               (num_slots, pcfg.max_pages_per_slot),
                               jnp.int32)}
+            if self.kv_quantized:
+                self.cache['k_scale'] = self.pool.pools['k_scale']
+                self.cache['v_scale'] = self.pool.pools['v_scale']
             self.pool.pools = None   # arrays live in self.cache now
         else:
             shape = (self.cfg.n_layers, num_slots, self.max_seq_len,
@@ -642,6 +692,25 @@ class InferenceEngine:
         # round-trip each. Off => every admission takes the sequential
         # path (the golden reference the overlap tests compare against).
         self.batch_admission = bool(batch_admission)
+        # Ragged (packed variable-length) prefill: mixed-length bursts
+        # pack into ONE [1, T] dispatch separated by segment ids
+        # instead of padding every row to the shared pow2 bucket —
+        # padding positions are masked out of the attention FLOPs and
+        # the projections/MLP run over ~sum(len_i) tokens instead of
+        # B x bucket (docs/performance.md "Ragged prefill"). Rides the
+        # batched-admission machinery, so batch_admission=False keeps
+        # the sequential golden path and _try_admit_batch stays the
+        # padded reference (SKYT_RAGGED_PREFILL=0 restores it as the
+        # default batch path).
+        if ragged_prefill is None:
+            ragged_prefill = env.get_bool('SKYT_RAGGED_PREFILL', True)
+        self.ragged_prefill = bool(ragged_prefill) and \
+            self.batch_admission and cache_mode == 'paged'
+        # Packed-token cap per ragged dispatch (bounds the packed
+        # attention shape the same way prefill buckets bound the
+        # padded one).
+        self._ragged_max = env.get_int(
+            'SKYT_RAGGED_MAX_TOKENS', 0) or max(self.prefill_buckets)
         # Requests popped for an in-flight BATCHED admission — scanned
         # by cancel() alongside _admitting.
         self._admitting_many: List[_Request] = []
@@ -712,6 +781,14 @@ class InferenceEngine:
             'skyt_infer_host_finish_seconds_total',
             'Host seconds spent delivering pulled decode chunks '
             '(post-pull cutoff math + queue delivery)')
+        self._m_prefill_disp_tokens = reg.counter(
+            'skyt_infer_prefill_dispatch_tokens_total',
+            'Token positions prefill dispatches actually computed '
+            '(batch x bucket for padded, packed T for ragged)')
+        self._m_prefill_padded = reg.counter(
+            'skyt_infer_prefill_padded_tokens_total',
+            'Prefill dispatch positions holding no real prompt token '
+            '(the wasted-FLOP fraction ragged prefill removes)')
         self._m_kv_util = reg.gauge(
             'skyt_infer_kv_cache_utilization',
             'KV cache occupancy fraction (0-1)')
@@ -754,6 +831,8 @@ class InferenceEngine:
 
         self._jit_prefill = jax.jit(self._prefill_impl,
                                     static_argnames=('bucket',))
+        self._jit_prefill_ragged = jax.jit(self._prefill_ragged_impl,
+                                           static_argnames=('t_bucket',))
         self._jit_prefill_suffix = jax.jit(self._prefill_suffix_impl,
                                            static_argnames=('bucket',))
         self._jit_decode_spec = jax.jit(
@@ -860,8 +939,36 @@ class InferenceEngine:
                             axis=-1).astype(jnp.int32)
         return greedy, logits, new_cache
 
+    def _prefill_ragged_impl(self, params, tokens, seg_ids, positions,
+                             logit_pos, t_bucket):
+        """Ragged (packed) prefill: several variable-length prompts in
+        ONE [1, T] row. tokens/seg_ids/positions [1, T] — request j's
+        tokens carry segment id j+1 with per-request positions
+        0..n_j-1; padding (page-rounding tails + the bucket tail)
+        carries id 0 and is masked out of attention by the segment
+        machinery (models/llama.py packed branch), so the FLOPs spent
+        on real tokens are ~sum(n_j) instead of B x bucket.
+        logit_pos [1, Bp]: each request's last-token packed index.
+        Returns (greedy [Bp], logits [Bp, V], packed dense cache
+        {'k','v'} [L, 1, T, H, d] the paged inserts then slice per
+        request via src_off)."""
+        del t_bucket
+        b, s = tokens.shape
+        shape = (self.cfg.n_layers, b, s, self.cfg.n_kv_heads,
+                 self.cfg.head_dim)
+        dtype = jnp.dtype(self.cfg.dtype)
+        cache = {'k': jnp.zeros(shape, dtype),
+                 'v': jnp.zeros(shape, dtype)}
+        logits, new_cache = self.model.apply(
+            params, tokens, positions=positions, segment_ids=seg_ids,
+            cache=cache, logit_positions=logit_pos)
+        logits = logits[0].astype(jnp.float32)        # [Bp, V]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return greedy, logits, new_cache
+
     def _prefill_suffix_impl(self, params, tokens, start, length,
-                             k_pool, v_pool, table_row, bucket):
+                             k_pool, v_pool, k_scale, v_scale,
+                             table_row, bucket):
         """Prefix-cached prefill: only the prompt SUFFIX (tokens
         [1, bucket], global positions start..start+bucket) runs through
         the model; the shared prefix KV is gathered from the slot's
@@ -869,13 +976,22 @@ class InferenceEngine:
         continuation path. Returns (greedy, logits [1, V], new_cache
         {'k','v'} [L, 1, max_pages*P, H, d]) — the full per-slot view
         including the prefix, which the paged insert then scatters back
-        (private pages only, via src_off)."""
+        (private pages only, via src_off). k_scale/v_scale: the int8
+        pools' scale pools (None for fp pools) — the gather
+        dequantizes, so the model sees a float view either way."""
         del bucket
         from skypilot_tpu.infer.paged_cache import PagePool
         b, s = tokens.shape
         positions = start + jnp.arange(s)[None, :].repeat(b, 0)
-        view = {'k': PagePool.gather_view(k_pool, table_row[None]),
-                'v': PagePool.gather_view(v_pool, table_row[None])}
+        dtype = jnp.dtype(self.cfg.dtype)
+        if k_scale is not None:
+            view = {'k': PagePool.gather_view_q(
+                        k_pool, k_scale, table_row[None], dtype),
+                    'v': PagePool.gather_view_q(
+                        v_pool, v_scale, table_row[None], dtype)}
+        else:
+            view = {'k': PagePool.gather_view(k_pool, table_row[None]),
+                    'v': PagePool.gather_view(v_pool, table_row[None])}
         logits, new_cache = self.model.apply(
             params, tokens, positions=positions, cache=view,
             logit_positions=(length - start - 1)[:, None])
@@ -902,9 +1018,16 @@ class InferenceEngine:
             from jax.experimental.layout import (Format, Layout,
                                                  with_layout_constraint)
             fmt = Format(Layout(major_to_minor=(0, 1, 2, 3, 4)))
-            return {**cache,
-                    'k': with_layout_constraint(cache['k'], fmt),
-                    'v': with_layout_constraint(cache['v'], fmt)}
+            out = {**cache,
+                   'k': with_layout_constraint(cache['k'], fmt),
+                   'v': with_layout_constraint(cache['v'], fmt)}
+            if 'k_scale' in cache:   # 4D scale pools, same rationale
+                fmt4 = Format(Layout(major_to_minor=(0, 1, 2, 3)))
+                out['k_scale'] = with_layout_constraint(
+                    cache['k_scale'], fmt4)
+                out['v_scale'] = with_layout_constraint(
+                    cache['v_scale'], fmt4)
+            return out
         except Exception:  # pylint: disable=broad-except
             return cache
 
@@ -967,13 +1090,23 @@ class InferenceEngine:
                    (0, 0))
             pk = jnp.pad(pk, pad)
             pv = jnp.pad(pv, pad)
-        new_cache = {
-            'k': paged_cache.PagePool.insert_prompt(cache['k'], pk,
-                                                    page_ids, src_off),
-            'v': paged_cache.PagePool.insert_prompt(cache['v'], pv,
-                                                    page_ids, src_off),
-            'tables': cache['tables'].at[slot].set(table_row),
-        }
+        if 'k_scale' in cache:   # int8 pool: quantize at the scatter
+            qk, sk = paged_cache.PagePool.insert_prompt_q(
+                cache['k'], cache['k_scale'], pk, page_ids, src_off)
+            qv, sv = paged_cache.PagePool.insert_prompt_q(
+                cache['v'], cache['v_scale'], pv, page_ids, src_off)
+            new_cache = {
+                'k': qk, 'v': qv, 'k_scale': sk, 'v_scale': sv,
+                'tables': cache['tables'].at[slot].set(table_row),
+            }
+        else:
+            new_cache = {
+                'k': paged_cache.PagePool.insert_prompt(
+                    cache['k'], pk, page_ids, src_off),
+                'v': paged_cache.PagePool.insert_prompt(
+                    cache['v'], pv, page_ids, src_off),
+                'tables': cache['tables'].at[slot].set(table_row),
+            }
         return self._pin_paged_layouts(new_cache), _update_args(
             args, slot, first_tok, length, temp, key, topk, topp,
             pres, freq, bidx, bval)
@@ -984,13 +1117,23 @@ class InferenceEngine:
         WITHOUT installing the slot's table row or decode args — the
         slot only becomes decodable at the final chunk's full insert."""
         from skypilot_tpu.infer import paged_cache
-        new_cache = {
-            'k': paged_cache.PagePool.insert_prompt(
-                cache['k'], prefill_cache['k'], page_ids, src_off),
-            'v': paged_cache.PagePool.insert_prompt(
-                cache['v'], prefill_cache['v'], page_ids, src_off),
-            'tables': cache['tables'],
-        }
+        if 'k_scale' in cache:   # int8 pool: quantize at the scatter
+            qk, sk = paged_cache.PagePool.insert_prompt_q(
+                cache['k'], cache['k_scale'], prefill_cache['k'],
+                page_ids, src_off)
+            qv, sv = paged_cache.PagePool.insert_prompt_q(
+                cache['v'], cache['v_scale'], prefill_cache['v'],
+                page_ids, src_off)
+            new_cache = {'k': qk, 'v': qv, 'k_scale': sk,
+                         'v_scale': sv, 'tables': cache['tables']}
+        else:
+            new_cache = {
+                'k': paged_cache.PagePool.insert_prompt(
+                    cache['k'], prefill_cache['k'], page_ids, src_off),
+                'v': paged_cache.PagePool.insert_prompt(
+                    cache['v'], prefill_cache['v'], page_ids, src_off),
+                'tables': cache['tables'],
+            }
         return self._pin_paged_layouts(new_cache)
 
     def _clear_slot_impl(self, cache, slot):
@@ -1749,15 +1892,26 @@ class InferenceEngine:
                               jnp.zeros((n, _BIAS_BUCKET), jnp.int32),
                               jnp.zeros((n, _BIAS_BUCKET), jnp.float32))
 
-    def _count_prefill_dispatch(self, n_requests: int) -> None:
+    def _count_prefill_dispatch(self, n_requests: int,
+                                dispatch_tokens: int = 0,
+                                real_tokens: int = 0) -> None:
         """Account one target-model prefill forward serving
         `n_requests` admissions (1 for the sequential path and for
-        chunked-prefill pieces)."""
+        chunked-prefill pieces). dispatch_tokens/real_tokens feed the
+        padding-fraction accounting (perf + /metrics): positions the
+        forward computed vs positions holding real prompt tokens."""
         self.perf['prefill_dispatches'] += 1
         self.perf['admission_batch_size'] = max(
             self.perf['admission_batch_size'], n_requests)
         self._m_prefill_dispatches.inc()
         self._m_admission_batch.observe(n_requests)
+        if dispatch_tokens > 0:
+            padded = max(0, dispatch_tokens - real_tokens)
+            self.perf['prefill_dispatch_tokens'] += dispatch_tokens
+            self.perf['prefill_padded_tokens'] += padded
+            self._m_prefill_disp_tokens.inc(dispatch_tokens)
+            if padded:
+                self._m_prefill_padded.inc(padded)
 
     def _first_token(self, req: '_Request', logits_row, greedy):
         """First-token selection for an admitted prompt — the ONE place
@@ -1802,6 +1956,213 @@ class InferenceEngine:
                 jnp.float32(req.params.presence_penalty),
                 jnp.float32(req.params.frequency_penalty),
                 jnp.asarray(bidx), jnp.asarray(bval))
+
+    def _pop_admission_batch(self, cand: List['_Request']
+                             ) -> List['_Request']:
+        """Pop `cand` (a snapshot of the queue head) with the cancel
+        discipline shared by the batched and ragged admission paths:
+        the requests become visible to cancel() via _admitting_many
+        BEFORE the pops (between pop and _complete_admission they live
+        nowhere else, and a cancel that finds a request in no
+        structure would be silently lost), then cancels that landed
+        between the snapshot and the pops are honored — a
+        cancelled-while-waiting request gets its terminal None without
+        costing a slot or any prefill work. Returns the survivors."""
+        self._admitting_many = list(cand)   # visible BEFORE the pops
+        for _ in cand:
+            self._waiting.get_nowait()
+        live: List[_Request] = []
+        for req in cand:
+            if req.cancelled:
+                self._trace_event(req.req_id, 'done',
+                                  status='deadline' if req.expired
+                                  else 'cancelled')
+                req.out_queue.put(None)
+            else:
+                live.append(req)
+        # Cancelled requests are terminal; only the survivors still
+        # need cancel() visibility (empty -> the window closes).
+        self._admitting_many = list(live)
+        return live
+
+    def _reserve_admission_batch(self, live: List['_Request'],
+                                 free: List[int]):
+        """Positional page reservations for a popped admission batch
+        (paged mode), shared by the batched and ragged paths. A FIRST
+        reservation failure requeues everything and returns
+        (live, None) — the sequential path's _deferred handling owns
+        the pool-full case; a later failure shrinks the batch with the
+        unreserved tail back at the queue HEAD, so FIFO order
+        survives. Returns (surviving live, their table rows)."""
+        rows: List[np.ndarray] = []
+        for j, req in enumerate(live):
+            total = min(len(req.tokens) + req.params.max_new_tokens,
+                        self.max_seq_len)
+            res = self.pool.try_reserve_prefix(free[j], total, ())
+            if res is None:
+                break
+            rows.append(res[0])
+        if not rows:
+            with self._waiting.mutex:
+                self._waiting.queue.extendleft(reversed(live))
+            self._admitting_many = []
+            return live, None
+        if len(rows) < len(live):
+            with self._waiting.mutex:
+                self._waiting.queue.extendleft(
+                    reversed(live[len(rows):]))
+            live = live[:len(rows)]
+        self._admitting_many = list(live)
+        return live, rows
+
+    def _ragged_bucket(self, t: int) -> int:
+        """Packed-length bucket for a ragged dispatch: t rounded up to
+        a page-aligned step of 1/8th of the enclosing pow2 bucket
+        (floor: one page). Compile count stays log-bounded (at most 8
+        sub-buckets per octave) while the tail padding is bounded at
+        ~12.5% instead of the pow2 bucket's ~50%."""
+        psize = self.pool.cfg.page_size
+        b = _round_up_pow2(t, lo=max(32, psize))
+        step = max(psize, (b // 8) - (b // 8) % psize)
+        return -(-t // step) * step
+
+    def _try_admit_ragged(self) -> bool:
+        """Ragged admission fast path (paged mode): pack a FIFO prefix
+        of waiting requests — page-aligned, ANY mix of lengths — into
+        one [1, T] packed prefill separated by segment ids, instead of
+        padding every row to the shared pow2 bucket
+        (_try_admit_batch). Wins twice: mixed-bucket bursts that the
+        padded path cannot batch at all collapse into one dispatch,
+        and the FLOPs spent on padding drop from (B x bucket -
+        sum n_j) to the page-rounding tails (~0 for page-aligned
+        prompts). Same ordering/fallback discipline as the padded
+        path: candidates are a FIFO prefix; prefix-cache hits, long
+        prompts wanting chunked prefill, QoS reserve gating, and
+        pool-full reservations all fall through to the sequential
+        path. Candidates must share one lora_id (the packed row is a
+        single batch element, and adapters route per batch row)."""
+        if not self.ragged_prefill or self._deferred is not None:
+            return False
+        if self._chunked is not None:
+            return False
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        if len(free) < 2 or self._waiting.qsize() < 2:
+            return False
+        psize = self.pool.cfg.page_size
+        with self._waiting.mutex:
+            queued = list(itertools.islice(self._waiting.queue,
+                                           len(free)))
+        cand: List[_Request] = []
+        total = 0
+        lora0: Optional[int] = None
+        for req in queued:
+            if req.cancelled:
+                break   # let _admit_one deliver its terminal None
+            if self._qos_reserved and \
+                    req.params.priority != 'interactive' and \
+                    len(cand) >= len(free) - self._qos_reserved:
+                break
+            n = len(req.tokens)
+            if self.prefill_chunk and n > self.prefill_chunk:
+                break
+            if self.prefix_caching:
+                if req.page_hashes is None:
+                    req.page_hashes = paged_cache_hashes(
+                        req.tokens, psize, salt=req.params.lora_id)
+                if self.pool.prefix_peek(
+                        req.page_hashes[:(n - 1) // psize]) > 0:
+                    break   # prefix hit -> suffix path, sequential
+            if lora0 is None:
+                lora0 = req.params.lora_id
+            elif req.params.lora_id != lora0:
+                break
+            span = -(-n // psize) * psize
+            if cand and total + span > self._ragged_max:
+                break
+            cand.append(req)
+            total += span
+        if len(cand) < 2:
+            return False
+        live = self._pop_admission_batch(cand)
+        if not live:
+            return True   # progress: the queue head was consumed
+        live, rows = self._reserve_admission_batch(live, free)
+        if rows is None:
+            return False
+        cand = live
+        nb = len(cand)
+        spans = [-(-len(r.tokens) // psize) * psize for r in cand]
+        offs = list(itertools.accumulate([0] + spans[:-1]))
+        real = sum(len(r.tokens) for r in cand)
+        t_bucket = self._ragged_bucket(sum(spans))
+        tokens = np.zeros((1, t_bucket), np.int32)
+        segs = np.zeros((1, t_bucket), np.int32)
+        poss = np.zeros((1, t_bucket), np.int32)
+        bp = 1 << (nb - 1).bit_length()       # pow2 pad: fewer compiles
+        logit_pos = np.zeros((1, bp), np.int32)
+        trace_on = tracing.enabled()
+        for j, req in enumerate(cand):
+            n = len(req.tokens)
+            off = offs[j]
+            tokens[0, off:off + n] = req.tokens
+            segs[0, off:off + n] = j + 1
+            # Page-rounding tail keeps id 0 (masked everywhere); its
+            # positions continue the request's arange so the junk KV
+            # written above n lands with sane rope — overwritten by
+            # the feed-at-lens invariant before it is ever attended,
+            # exactly like the padded path's bucket junk.
+            poss[0, off:off + spans[j]] = np.arange(spans[j])
+            logit_pos[0, j] = off + n - 1
+            if req.prefill_start_at is None:
+                req.prefill_start_at = time.time()
+            self._trace_event(req.req_id, 'prefill_start',
+                              status='running')
+            if trace_on:
+                self._trace_span_event(req.req_id, 'ragged_admission',
+                                       batch_size=nb,
+                                       packed_tokens=t_bucket)
+        self.perf['ragged_dispatches'] += 1
+        with self._ctx():
+            greedy, logits, prefill_cache = self._jit_prefill_ragged(
+                self._vars([lora0]), jnp.asarray(tokens),
+                jnp.asarray(segs), jnp.asarray(poss),
+                jnp.asarray(logit_pos), t_bucket=t_bucket)
+            self._count_prefill_dispatch(nb, dispatch_tokens=t_bucket,
+                                         real_tokens=real)
+            need_rows = any(
+                r.params.temperature > 0.0 or r.params.logprobs
+                or r.params.logit_bias for r in cand)
+            logits_np = self._pull(logits) if need_rows else None
+            greedy_np = self._pull(greedy) if any(
+                r.params.temperature <= 0.0 and not r.params.logit_bias
+                for r in cand) else None
+            p = psize
+            for j, req in enumerate(cand):
+                slot = free[j]
+                n = len(req.tokens)
+                logits_row = logits_np[j] \
+                    if req.params.temperature > 0.0 or \
+                    req.params.logprobs or req.params.logit_bias \
+                    else None
+                first, first_lp, temp = self._first_token(
+                    req, logits_row,
+                    lambda j=j: int(greedy_np[j]))
+                ins_args = self._ins_args(slot, req, first, temp)
+                row = rows[j]
+                n_ins = min(-(-n // p), int((row > 0).sum()))
+                # Row 0 of the packed cache at src_off = this
+                # request's packed offset: insert_prompt slices
+                # [off, off + n_ins*P) — exactly the request's span.
+                self.cache, self._dev_args = self._jit_insert_paged(
+                    self.cache, prefill_cache, jnp.int32(0),
+                    *ins_args, jnp.asarray(row[:n_ins]),
+                    jnp.asarray(row), jnp.int32(offs[j]))
+                if self.prefix_caching and req.page_hashes:
+                    self.pool.publish(slot, req.page_hashes[:n // p])
+                self._complete_admission(req, slot, n, first, temp,
+                                         first_lp=first_lp)
+        self._admitting_many = []
+        return True
 
     def _try_admit_batch(self) -> bool:
         """Batched admission fast path: when several WAITING requests
@@ -1866,56 +2227,15 @@ class InferenceEngine:
             cand.append(req)
         if len(cand) < 2:
             return False
-        # Pop the candidates (they are the queue head; only the engine
-        # thread consumes _waiting) and make them visible to cancel()
-        # IMMEDIATELY — between the pop and _complete_admission they
-        # live nowhere else, and a cancel that finds a request in no
-        # structure would be silently lost. Then honor cancels that
-        # landed between the snapshot and the pops — like _admit_one
-        # does for its head, a cancelled-while-waiting request gets its
-        # terminal None without costing a slot or any prefill work.
-        self._admitting_many = list(cand)   # visible BEFORE the pops
-        for _ in cand:
-            self._waiting.get_nowait()
-        live: List[_Request] = []
-        for req in cand:
-            if req.cancelled:
-                self._trace_event(req.req_id, 'done',
-                                  status='deadline' if req.expired
-                                  else 'cancelled')
-                req.out_queue.put(None)
-            else:
-                live.append(req)
+        live = self._pop_admission_batch(cand)
         if not live:
-            self._admitting_many = []
             return True   # progress: the queue head was consumed
-        # Reserve pages (paged mode) for the survivors, positionally on
-        # the free slots. A FIRST-reservation failure requeues all of
-        # them and falls back (the sequential path's _deferred handling
-        # owns the pool-full case); a later failure just shrinks the
-        # batch — the unreserved tail goes back to the queue HEAD, so
-        # FIFO order survives.
         rows: List[np.ndarray] = []
         if self.cache_mode == 'paged':
-            for j, req in enumerate(live):
-                total = min(len(req.tokens) + req.params.max_new_tokens,
-                            self.max_seq_len)
-                res = self.pool.try_reserve_prefix(free[j], total, ())
-                if res is None:
-                    break
-                rows.append(res[0])
-            if not rows:
-                with self._waiting.mutex:
-                    self._waiting.queue.extendleft(reversed(live))
-                self._admitting_many = []
+            live, rows = self._reserve_admission_batch(live, free)
+            if rows is None:
                 return False
-            if len(rows) < len(live):
-                with self._waiting.mutex:
-                    self._waiting.queue.extendleft(
-                        reversed(live[len(rows):]))
-                live = live[:len(rows)]
         cand = live
-        self._admitting_many = list(cand)
         nb = len(cand)
         bp = 1 << (nb - 1).bit_length()          # pow2 pad: fewer compiles
         padded = np.zeros((bp, bucket), np.int32)
@@ -1940,7 +2260,9 @@ class InferenceEngine:
             greedy, logits, prefill_cache = self._jit_prefill(
                 self._vars(lora_ids), jnp.asarray(padded),
                 jnp.asarray(lengths), bucket=bucket)
-            self._count_prefill_dispatch(nb)
+            self._count_prefill_dispatch(
+                nb, dispatch_tokens=bp * bucket,
+                real_tokens=sum(len(r.tokens) for r in cand))
             # Pull each array at most once, and only when some request
             # needs it (in multi-host mode every _pull is a cross-host
             # collective — same rule as _admit_one's single-pull logic).
@@ -2117,7 +2439,11 @@ class InferenceEngine:
                     self._vars([req.params.lora_id]),
                     jnp.asarray(padded), jnp.int32(start),
                     jnp.asarray([n]), self.cache['k'], self.cache['v'],
+                    self.cache.get('k_scale'),
+                    self.cache.get('v_scale'),
                     jnp.asarray(row), bucket=sb)
+                self._count_prefill_dispatch(
+                    1, dispatch_tokens=sb, real_tokens=len(suffix))
             else:
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :n] = req.tokens
@@ -2125,7 +2451,8 @@ class InferenceEngine:
                     self._vars([req.params.lora_id]),
                     jnp.asarray(padded), jnp.asarray([n]),
                     bucket=bucket)
-            self._count_prefill_dispatch(1)
+                self._count_prefill_dispatch(
+                    1, dispatch_tokens=bucket, real_tokens=n)
             # Pull the logits row at most ONCE (multi-host: every
             # _pull is a cross-host collective, not a cached host
             # copy); greedy is a lazy 4-byte pull. logprobs: the row
@@ -2278,8 +2605,10 @@ class InferenceEngine:
                 self._vars([req.params.lora_id]),
                 jnp.asarray(padded), jnp.int32(start),
                 jnp.asarray([length_arg]), self.cache['k'],
-                self.cache['v'], jnp.asarray(row), bucket=sb)
-            self._count_prefill_dispatch(1)
+                self.cache['v'], self.cache.get('k_scale'),
+                self.cache.get('v_scale'), jnp.asarray(row), bucket=sb)
+            self._count_prefill_dispatch(
+                1, dispatch_tokens=sb, real_tokens=piece)
             if not final:
                 self.cache = self._jit_insert_pages(
                     self.cache, pc, jnp.asarray(ids),
@@ -2443,6 +2772,9 @@ class InferenceEngine:
             # any in-flight chunk via the dispatch chain.
             admitted = False
             while None in self._slots:
+                if self._try_admit_ragged():
+                    admitted = True
+                    continue
                 if self._try_admit_batch():
                     admitted = True
                     continue

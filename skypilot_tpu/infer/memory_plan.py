@@ -61,10 +61,38 @@ class ServingMemoryPlan:
                 f'(headroom {self.headroom_gib:+.2f} GiB)')
 
 
+def kv_bytes_per_token(cfg, kv_dtype: str = 'auto') -> int:
+    """KV pool bytes one token costs across all layers (k + v).
+
+    'auto': head_dim values at cfg.dtype width. 'int8': head_dim int8
+    bytes plus one f32 per-token per-head scale
+    (infer/paged_cache.py), so the ratio auto/int8 — the
+    pages-per-pool multiplier at equal HBM — is
+    d*itemsize / (d + 4): 1.94x for bf16 d=128, 3.76x for f32 d=64.
+    """
+    dtype_bytes = 2 if cfg.dtype == 'bfloat16' else 4
+    if kv_dtype == 'int8':
+        per_head = cfg.head_dim * 1 + 4
+    elif kv_dtype in ('auto', None, ''):
+        per_head = cfg.head_dim * dtype_bytes
+    else:
+        raise ValueError(f'unknown kv_dtype {kv_dtype!r}')
+    return cfg.n_layers * 2 * cfg.n_kv_heads * per_head
+
+
+def kv_pages_ratio(cfg, kv_dtype: str = 'int8') -> float:
+    """Pages a fixed HBM budget holds at `kv_dtype` relative to the
+    float pool — the concurrent-users-per-chip multiplier the
+    quantized KV cache buys (bench.py 'kv+ragged bench')."""
+    return kv_bytes_per_token(cfg, 'auto') / \
+        kv_bytes_per_token(cfg, kv_dtype)
+
+
 def plan_serving(cfg, *, tp: int, num_slots: int = 8,
                  max_seq_len: int = 4096,
                  pool_tokens: Optional[int] = None,
                  quantize: str = 'none',
+                 kv_dtype: str = 'auto',
                  accelerator: str = 'v5e',
                  page_size: int = 64) -> ServingMemoryPlan:
     """Per-chip memory plan for the paged engine serving `cfg` tp-wide.
@@ -102,12 +130,14 @@ def plan_serving(cfg, *, tp: int, num_slots: int = 8,
         raise ValueError(f'unknown quantize mode {quantize!r}')
     param_bytes = math.ceil(param_total / tp)
 
-    # Paged pool geometry (PagedConfig.for_engine).
+    # Paged pool geometry (PagedConfig.for_engine). kv_dtype='int8'
+    # sizes by the quantized itemsize + the f32 scale pools
+    # (kv_bytes_per_token), which is what roughly doubles
+    # pages-per-pool at equal HBM (engine SKYT_KV_DTYPE/kv_dtype).
     tokens = pool_tokens if pool_tokens is not None \
         else num_slots * max_seq_len
     n_pages = -(-tokens // page_size) + 1
-    kv_total = (cfg.n_layers * 2 * n_pages * page_size *
-                cfg.n_kv_heads * cfg.head_dim * dtype_bytes)
+    kv_total = n_pages * page_size * kv_bytes_per_token(cfg, kv_dtype)
     kv_sharded = tp > 1 and cfg.n_kv_heads % tp == 0
     kv_pool_bytes = kv_total // tp if kv_sharded else kv_total
 

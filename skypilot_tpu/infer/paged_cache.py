@@ -29,6 +29,20 @@ Device-side ops are shape-static for XLA:
 
 Page 0 is a shared dummy: unreserved table entries point at it and are
 never read unmasked (attention masks positions >= length).
+
+int8 KV quantization (``kv_dtype='int8'``): the k/v pools store int8
+with a PER-TOKEN, PER-HEAD f32 scale pool ``[L, n_pages, H, P]``
+(scale = amax over head_dim / 127 — the JetStream/vLLM per-token
+scheme: each written token row quantizes independently, so appends
+never re-scale already-written entries). Scales add 4/head_dim to the
+bytes per token (~3% at d=128), so pages-per-pool at equal HBM is
+~1.9-3.8x the fp pool (infer/memory_plan.py does the exact
+arithmetic). Dequantization folds into the attention matmuls: the
+paged Pallas kernels read int8 pages + the scale block and multiply
+the scores/weights by the scales (ops/paged_attention.py *_q), and
+the XLA floor dequantizes at the gather (gather_view_layer_q).
+Prefix-cache sharing is unchanged — quantized pages are what's
+published and shared.
 """
 import collections
 import dataclasses
@@ -38,6 +52,22 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# KV pool quantization modes ('auto' = store at the model's compute
+# dtype, no quantization).
+KV_DTYPES = ('auto', 'int8')
+
+
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """x [..., d] float -> (int8 [..., d], f32 scale [...]) with a
+    symmetric per-row (per-token, per-head) scale = amax/127. amax == 0
+    rows get scale 1.0 so zero KV stays exactly zero."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def page_hashes(tokens: Sequence[int], page_size: int,
@@ -91,9 +121,15 @@ class PagePool:
 
     def __init__(self, cfg: PagedConfig, n_layers: int, kv_heads: int,
                  head_dim: int, num_slots: int, dtype,
-                 device_put=None) -> None:
+                 device_put=None, kv_dtype: str = 'auto',
+                 scale_device_put=None) -> None:
         self.cfg = cfg
         self.num_slots = num_slots
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f'kv_dtype must be one of {KV_DTYPES}, '
+                             f'got {kv_dtype!r}')
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == 'int8'
         # Page-major pool: one page holds ALL kv heads ([H, P, d]
         # contiguous), so the Pallas paged-attention kernel
         # (ops/paged_attention.py) fetches a slot's whole page in ONE
@@ -101,9 +137,18 @@ class PagePool:
         # invocation and DMA-issue overhead dominate at decode sizes.
         shape = (n_layers, cfg.n_pages, kv_heads, cfg.page_size, head_dim)
         put = device_put or (lambda x: x)
+        pool_dtype = jnp.int8 if self.quantized else dtype
         self.pools: Dict[str, jax.Array] = {
-            'k': put(jnp.zeros(shape, dtype)),
-            'v': put(jnp.zeros(shape, dtype))}
+            'k': put(jnp.zeros(shape, pool_dtype)),
+            'v': put(jnp.zeros(shape, pool_dtype))}
+        if self.quantized:
+            # Per-token, per-head scales (see module docstring). Scale
+            # of the never-written dummy page stays 0 -> dequantizes
+            # to exact zeros, like the fp pool's zero init.
+            sshape = shape[:-1]
+            sput = scale_device_put or (lambda x: x)
+            self.pools['k_scale'] = sput(jnp.zeros(sshape, jnp.float32))
+            self.pools['v_scale'] = sput(jnp.zeros(sshape, jnp.float32))
         # Page 0 is the dummy; never allocated.
         self._free: List[int] = list(range(1, cfg.n_pages))
         self._owned: List[List[int]] = [[] for _ in range(num_slots)]
@@ -329,6 +374,75 @@ class PagePool:
         off = pos % p
         return pool.at[page.reshape(-1), :, off.reshape(-1)].set(
             new_kv.reshape(slots * s, h, d).astype(pool.dtype))
+
+    # ------------------------------------------- int8-quantized kernels
+    @staticmethod
+    def insert_prompt_q(pool, scale_pool, prompt_kv, page_ids,
+                        src_off=0):
+        """Quantized insert_prompt: same contract, plus the per-token
+        per-head scales scattered into scale_pool [L, n_pages, H, P].
+        Returns (new_pool, new_scale_pool)."""
+        n = page_ids.shape[0]
+        l, _, _, h, d = prompt_kv.shape
+        p = pool.shape[3]
+        chunk = jax.lax.dynamic_slice(
+            prompt_kv, (0, 0, src_off, 0, 0),
+            (l, 1, n * p, h, d))[:, 0]             # [L, n*P, H, d]
+        chunk = chunk.reshape(l, n, p, h, d).transpose(0, 1, 3, 2, 4)
+        q, s = quantize_kv(chunk)                  # q [L,n,H,P,d] s [L,n,H,P]
+        return (pool.at[:, page_ids].set(q),
+                scale_pool.at[:, page_ids].set(s))
+
+    @staticmethod
+    def gather_view_layer_q(pool, scale_pool, tables, dtype):
+        """Dequantizing gather — the XLA floor of the quantized decode
+        path. pool [n_pages, H, P, d] int8 + scale_pool [n_pages, H, P]
+        -> [slots, max_pages*P, H, d] at `dtype` (exactly the float
+        gather_view_layer contract)."""
+        _, h, p, d = pool.shape
+        slots, mp = tables.shape
+        v = pool[tables].astype(jnp.float32)   # [slots, mp, H, P, d]
+        s = scale_pool[tables]                 # [slots, mp, H, P]
+        v = (v * s[..., None]).astype(dtype)
+        return v.transpose(0, 1, 3, 2, 4).reshape(slots, mp * p, h, d)
+
+    @staticmethod
+    def append_token_layer_q(pool, scale_pool, new_kv, tables, lengths):
+        """Quantized append_token_layer: quantize the new row, scatter
+        value + scale. Returns (new_pool, new_scale_pool)."""
+        p = pool.shape[2]
+        mp = tables.shape[1]
+        page = jnp.take_along_axis(
+            tables, jnp.clip(lengths // p, 0, mp - 1)[:, None],
+            axis=1)[:, 0]                                    # [slots]
+        off = lengths % p
+        q, s = quantize_kv(new_kv)             # [slots, H, d], [slots, H]
+        return (pool.at[page, :, off].set(q),
+                scale_pool.at[page, :, off].set(s))
+
+    @staticmethod
+    def append_tokens_layer_q(pool, scale_pool, new_kv, tables, start):
+        """Quantized append_tokens_layer (speculative-decode run of s
+        tokens per slot). Returns (new_pool, new_scale_pool)."""
+        slots, s_run, h, d = new_kv.shape
+        p = pool.shape[2]
+        mp = tables.shape[1]
+        pos = start[:, None] + jnp.arange(s_run)[None, :]   # [slots, s]
+        page = jnp.take_along_axis(
+            tables, jnp.clip(pos // p, 0, mp - 1), axis=1)  # [slots, s]
+        off = pos % p
+        q, s = quantize_kv(new_kv.reshape(slots * s_run, h, d))
+        return (pool.at[page.reshape(-1), :, off.reshape(-1)].set(q),
+                scale_pool.at[page.reshape(-1), :,
+                              off.reshape(-1)].set(s))
+
+    @staticmethod
+    def gather_view_q(pool, scale_pool, tables, dtype):
+        """All-layer dequantizing gather: [L, n_pages, H, P, d] int8 +
+        [L, n_pages, H, P] scales -> [L, slots, mp*P, H, d] float."""
+        return jax.vmap(
+            lambda pl, sl: PagePool.gather_view_layer_q(
+                pl, sl, tables, dtype))(pool, scale_pool)
 
     @staticmethod
     def gather_view(pool, tables):

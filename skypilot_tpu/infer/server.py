@@ -1313,6 +1313,7 @@ def build_engine(model_name: Optional[str] = None,
                  prefix_caching: bool = True,
                  spec_decode: int = 0,
                  quantize: str = 'none',
+                 kv_dtype: str = 'auto',
                  prefill_chunk: int = 0,
                  lockstep=None,
                  draft_model_name: Optional[str] = None,
@@ -1477,6 +1478,7 @@ def build_engine(model_name: Optional[str] = None,
                                       cache_mode=cache_mode,
                                       pool_tokens=pool_tokens,
                                       prefix_caching=prefix_caching,
+                                      kv_dtype=kv_dtype,
                                       spec_decode=spec_decode,
                                       prefill_chunk=prefill_chunk,
                                       lockstep=lockstep,
@@ -1535,6 +1537,13 @@ def main(argv=None) -> None:
                              'halves decode HBM traffic; int4 = w4a16 '
                              'group-128 scales, quarters it — '
                              'llama-family only)')
+    parser.add_argument('--kv-dtype', default='auto',
+                        choices=['auto', 'int8'],
+                        help='KV-cache dtype (paged mode): int8 stores '
+                             'the k/v pools quantized with per-token '
+                             'scales — ~2x pages (concurrent users) '
+                             'per HBM byte. auto defers to '
+                             'SKYT_KV_DTYPE, then the model dtype')
     parser.add_argument('--prefill-chunk', type=int, default=0,
                         help='chunked prefill: long prompts prefill in '
                              'chunks of this many tokens, interleaved '
@@ -1586,6 +1595,7 @@ def main(argv=None) -> None:
                           prefix_caching=not args.no_prefix_caching,
                           spec_decode=args.spec_decode,
                           quantize=args.quantize,
+                          kv_dtype=args.kv_dtype,
                           prefill_chunk=args.prefill_chunk,
                           lockstep=lockstep,
                           draft_model_name=args.draft_model,

@@ -422,45 +422,83 @@ class LlamaAttention(nn.Module):
 
         if cache is not None:
             assert positions is not None, 'cache path needs positions'
-            if len(cache) == 3:
+            if len(cache) in (3, 5):
                 # Paged decode path: cache = (k_pool [n_pages, Hkv, P,
-                # hd], v_pool, tables [B, max_pages]). Each sequence's
-                # new token(s) scatter into (tables[b, pos//P], pos%P);
-                # attention either runs the Pallas paged kernel (reads
-                # pages directly) or the gathered per-layer view — the
-                # page indirection lives HERE so at most one layer's KV
-                # is ever materialized contiguously (infer/paged_cache.py
+                # hd], v_pool, tables [B, max_pages]) — plus per-token
+                # scale pools (k_scale, v_scale) when the KV pool is
+                # int8-quantized (infer/paged_cache.py module doc).
+                # Each sequence's new token(s) scatter into
+                # (tables[b, pos//P], pos%P); attention either runs
+                # the Pallas paged kernel (reads pages directly) or
+                # the gathered per-layer view — the page indirection
+                # lives HERE so at most one layer's KV is ever
+                # materialized contiguously (infer/paged_cache.py
                 # holds the pool accounting).
 
                 from skypilot_tpu.infer.paged_cache import PagePool
-                k_pool, v_pool, tables = cache
+                quantized = len(cache) == 5
+                k_scale = v_scale = None
+                if quantized:
+                    k_pool, v_pool, tables, k_scale, v_scale = cache
+                else:
+                    k_pool, v_pool, tables = cache
                 pos = positions[:, 0]
                 if s == 1:
-                    k_pool = PagePool.append_token_layer(
-                        k_pool, k[:, 0], tables, pos)
-                    v_pool = PagePool.append_token_layer(
-                        v_pool, v[:, 0], tables, pos)
+                    if quantized:
+                        k_pool, k_scale = PagePool.append_token_layer_q(
+                            k_pool, k_scale, k[:, 0], tables, pos)
+                        v_pool, v_scale = PagePool.append_token_layer_q(
+                            v_pool, v_scale, v[:, 0], tables, pos)
+                    else:
+                        k_pool = PagePool.append_token_layer(
+                            k_pool, k[:, 0], tables, pos)
+                        v_pool = PagePool.append_token_layer(
+                            v_pool, v[:, 0], tables, pos)
                 else:
                     # Speculative decode: a short run of s = draft+1
                     # tokens per slot is written and attended in one
                     # step (infer/engine.py _decode_spec_impl).
-                    k_pool = PagePool.append_tokens_layer(
-                        k_pool, k, tables, pos)
-                    v_pool = PagePool.append_tokens_layer(
-                        v_pool, v, tables, pos)
+                    if quantized:
+                        k_pool, k_scale = \
+                            PagePool.append_tokens_layer_q(
+                                k_pool, k_scale, k, tables, pos)
+                        v_pool, v_scale = \
+                            PagePool.append_tokens_layer_q(
+                                v_pool, v_scale, v, tables, pos)
+                    else:
+                        k_pool = PagePool.append_tokens_layer(
+                            k_pool, k, tables, pos)
+                        v_pool = PagePool.append_tokens_layer(
+                            v_pool, v, tables, pos)
                 from skypilot_tpu.ops import dispatch
 
                 def _xla_gather():
                     # Gather view + masked XLA reference: the
                     # correctness floor of the paged ladder, and the
                     # only correct math for window/softcap/scale
-                    # models (cfg.needs_xla_attention).
-                    k_view = PagePool.gather_view_layer(k_pool, tables)
-                    v_view = PagePool.gather_view_layer(v_pool, tables)
+                    # models (cfg.needs_xla_attention). Quantized
+                    # pools dequantize at the gather.
+                    if quantized:
+                        k_view = PagePool.gather_view_layer_q(
+                            k_pool, k_scale, tables, dtype)
+                        v_view = PagePool.gather_view_layer_q(
+                            v_pool, v_scale, tables, dtype)
+                    else:
+                        k_view = PagePool.gather_view_layer(k_pool,
+                                                            tables)
+                        v_view = PagePool.gather_view_layer(v_pool,
+                                                            tables)
                     return _cached_attention(q, k_view, v_view,
                                              positions, cfg, window,
                                              window_active)
 
+                # Quantized pools dispatch under their own op labels
+                # (paged_attention{,_mq}_int8) so the kernel-path
+                # counter tells the int8 read path apart from fp.
+                op_sq = 'paged_attention_int8' if quantized \
+                    else 'paged_attention'
+                op_mq = 'paged_attention_mq_int8' if quantized \
+                    else 'paged_attention_mq'
                 if s == 1 and not cfg.needs_xla_attention and \
                         _env.get(
                             'SKYT_PAGED_ATTN', 'pallas') == 'pallas':
@@ -475,11 +513,18 @@ class LlamaAttention(nn.Module):
                     # path, and the chosen path lands in
                     # skyt_ops_kernel_path_total{op="paged_attention"}.
                     from skypilot_tpu.ops import paged_attention
-                    out = dispatch.run_ladder('paged_attention', [
-                        ('pallas',
-                         lambda: paged_attention.paged_decode_attention(
-                             q[:, 0], k_pool, v_pool, tables,
-                             pos)[:, None]),
+
+                    def _pallas_sq():
+                        if quantized:
+                            return \
+                                paged_attention.paged_decode_attention_q(
+                                    q[:, 0], k_pool, v_pool, k_scale,
+                                    v_scale, tables, pos)[:, None]
+                        return paged_attention.paged_decode_attention(
+                            q[:, 0], k_pool, v_pool, tables,
+                            pos)[:, None]
+                    out = dispatch.run_ladder(op_sq, [
+                        ('pallas', _pallas_sq),
                         ('xla', _xla_gather),
                     ])
                 elif s > 1 and not cfg.needs_xla_attention and \
@@ -496,10 +541,17 @@ class LlamaAttention(nn.Module):
                     # hatch: SKYT_SPEC_PAGED_ATTN=xla. Same ladder as
                     # the single-query path.
                     from skypilot_tpu.ops import paged_attention
-                    out = dispatch.run_ladder('paged_attention_mq', [
-                        ('pallas', lambda:
-                         paged_attention.paged_decode_attention_mq(
-                             q, k_pool, v_pool, tables, pos)),
+
+                    def _pallas_mq():
+                        if quantized:
+                            return paged_attention.\
+                                paged_decode_attention_mq_q(
+                                    q, k_pool, v_pool, k_scale,
+                                    v_scale, tables, pos)
+                        return paged_attention.paged_decode_attention_mq(
+                            q, k_pool, v_pool, tables, pos)
+                    out = dispatch.run_ladder(op_mq, [
+                        ('pallas', _pallas_mq),
                         ('xla', _xla_gather),
                     ])
                 else:
@@ -508,10 +560,10 @@ class LlamaAttention(nn.Module):
                     # ladder degradation — distinct label so the
                     # degradation signal stays clean.
                     out = dispatch.run_ladder(
-                        'paged_attention' if s == 1
-                        else 'paged_attention_mq',
+                        op_sq if s == 1 else op_mq,
                         [('xla_native', _xla_gather)])
-                new_cache = (k_pool, v_pool)
+                new_cache = (k_pool, v_pool, k_scale, v_scale) \
+                    if quantized else (k_pool, v_pool)
             else:
                 k_cache, v_cache = cache
                 start = positions[:, 0]  # write offset per sequence
@@ -521,8 +573,29 @@ class LlamaAttention(nn.Module):
                 v_cache = jax.vmap(
                     lambda c, vv, i: jax.lax.dynamic_update_slice(
                         c, vv, (i, 0, 0)))(v_cache, v, start)
-                out = _cached_attention(q, k_cache, v_cache, positions,
-                                        cfg, window, window_active)
+                if segment_ids is not None:
+                    # Packed RAGGED prefill (infer/engine.py
+                    # _try_admit_ragged): several variable-length
+                    # prompts ride ONE [1, T] row, separated by
+                    # segment ids (pad positions carry id 0). The
+                    # cache starts zeroed and the writes above cover
+                    # the whole packed span, so attending the fresh
+                    # k/v with segment masking IS attention over the
+                    # cache — and it runs the packed-sequence flash
+                    # machinery (ops/flash_attention.py segment
+                    # blocks) instead of a positions-vs-index mask
+                    # that packed (per-segment-restarting) positions
+                    # would break.
+                    out = attention_ops.attention(
+                        q, k, v, causal=True, segment_ids=segment_ids,
+                        impl=cfg.attn_impl, window=window,
+                        window_active=window_active,
+                        logit_softcap=cfg.attn_softcap,
+                        softmax_scale=cfg.attn_scale or None)
+                else:
+                    out = _cached_attention(q, k_cache, v_cache,
+                                            positions, cfg, window,
+                                            window_active)
                 new_cache = (k_cache, v_cache)
             out = out.reshape(b, s, h * hd)
             out = proj('wo', cfg.dim, ('heads', 'embed'), out)
@@ -723,6 +796,12 @@ class LlamaModel(nn.Module):
         if cfg.scan_layers:
             if cache is not None:
                 kv_cache = {'k': cache['k'], 'v': cache['v']}
+                # int8-quantized paged pools carry per-layer scale
+                # pools; they scan alongside k/v (paged_cache.py).
+                quant_kv = 'k_scale' in cache
+                if quant_kv:
+                    kv_cache['k_scale'] = cache['k_scale']
+                    kv_cache['v_scale'] = cache['v_scale']
                 if need_idx:
                     kv_cache['idx'] = jnp.arange(cfg.n_layers)
 
@@ -730,11 +809,18 @@ class LlamaModel(nn.Module):
                     lc = (layer_cache['k'], layer_cache['v'])
                     if tables is not None:
                         lc = lc + (tables,)
+                        if 'k_scale' in layer_cache:
+                            lc = lc + (layer_cache['k_scale'],
+                                       layer_cache['v_scale'])
                     y, upd = mdl(carry, cos, sin, segment_ids, lc,
                                  positions, lora_ids=lora_ids,
                                  lora_scale=lora_scale,
                                  layer_idx=layer_cache.get('idx'))
-                    return y, {'k': upd[0], 'v': upd[1]}
+                    out = {'k': upd[0], 'v': upd[1]}
+                    if len(upd) == 4:
+                        out['k_scale'] = upd[2]
+                        out['v_scale'] = upd[3]
+                    return y, out
                 x, new_cache = nn.scan(
                     body,
                     variable_axes={'params': 0, 'lora': 0},
@@ -765,6 +851,9 @@ class LlamaModel(nn.Module):
                     layer_cache = (cache['k'][i], cache['v'][i])
                     if tables is not None:
                         layer_cache = layer_cache + (tables,)
+                        if 'k_scale' in cache:
+                            layer_cache = layer_cache + (
+                                cache['k_scale'][i], cache['v_scale'][i])
                     x, upd = block(cfg, name=f'layer_{i}')(
                         x, cos, sin, segment_ids, layer_cache, positions,
                         lora_ids=lora_ids, lora_scale=lora_scale,
@@ -780,6 +869,11 @@ class LlamaModel(nn.Module):
                     'k': jnp.stack([c[0] for c in caches_out]),
                     'v': jnp.stack([c[1] for c in caches_out]),
                 }
+                if caches_out and len(caches_out[0]) == 4:
+                    new_cache['k_scale'] = jnp.stack(
+                        [c[2] for c in caches_out])
+                    new_cache['v_scale'] = jnp.stack(
+                        [c[3] for c in caches_out])
                 if tables is not None:
                     new_cache['tables'] = tables
 

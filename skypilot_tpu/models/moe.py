@@ -209,15 +209,26 @@ class MixtralModel(nn.Module):
         if cfg.scan_layers:
             if cache is not None:
                 kv_cache = {'k': cache['k'], 'v': cache['v']}
+                # int8-quantized paged pools: per-layer scale pools
+                # scan alongside k/v (same plumbing as llama).
+                if 'k_scale' in cache:
+                    kv_cache['k_scale'] = cache['k_scale']
+                    kv_cache['v_scale'] = cache['v_scale']
 
                 def body(mdl, carry, layer_cache):
                     lc = (layer_cache['k'], layer_cache['v'])
                     if tables is not None:
                         lc = lc + (tables,)
+                        if 'k_scale' in layer_cache:
+                            lc = lc + (layer_cache['k_scale'],
+                                       layer_cache['v_scale'])
                     (y, aux), upd = mdl(
                         carry[0], cos, sin, segment_ids, lc, positions)
-                    return (y, carry[1] + aux), {'k': upd[0],
-                                                 'v': upd[1]}
+                    out = {'k': upd[0], 'v': upd[1]}
+                    if len(upd) == 4:
+                        out['k_scale'] = upd[2]
+                        out['v_scale'] = upd[3]
+                    return (y, carry[1] + aux), out
                 (x, aux_total), new_cache = nn.scan(
                     body,
                     variable_axes={'params': 0},
@@ -247,6 +258,9 @@ class MixtralModel(nn.Module):
                     layer_cache = (cache['k'][i], cache['v'][i])
                     if tables is not None:
                         layer_cache = layer_cache + (tables,)
+                        if 'k_scale' in cache:
+                            layer_cache = layer_cache + (
+                                cache['k_scale'][i], cache['v_scale'][i])
                     (x, aux), upd = block(cfg, self.moe,
                                           name=f'layer_{i}')(
                         x, cos, sin, segment_ids, layer_cache,
@@ -261,6 +275,11 @@ class MixtralModel(nn.Module):
                     'k': jnp.stack([c[0] for c in caches_out]),
                     'v': jnp.stack([c[1] for c in caches_out]),
                 }
+                if caches_out and len(caches_out[0]) == 4:
+                    new_cache['k_scale'] = jnp.stack(
+                        [c[2] for c in caches_out])
+                    new_cache['v_scale'] = jnp.stack(
+                        [c[3] for c in caches_out])
                 if tables is not None:
                     new_cache['tables'] = tables
         x = llama_lib.RMSNorm(cfg, name='final_norm')(x)

@@ -205,6 +205,10 @@ class PeerView:
     demand_rps: Dict[str, float] = dataclasses.field(default_factory=dict)
     shed_rps: Dict[str, float] = dataclasses.field(default_factory=dict)
     breaker_open: List[str] = dataclasses.field(default_factory=list)
+    # Per-replica requests in flight THROUGH this peer — summed across
+    # fresh peers and fed to the policy so an N-active tier's
+    # least-connections sees the whole tier's load, not one LB's slice.
+    inflight: Dict[str, float] = dataclasses.field(default_factory=dict)
     received_at: float = 0.0          # time.monotonic() of last answer
 
     def exchange_age_s(self, now: Optional[float] = None) -> float:
@@ -893,10 +897,24 @@ class SkyServeLoadBalancer:
         while buf and buf[0][0] < horizon:
             buf.popleft()
 
+    def _own_inflight(self) -> Dict[str, float]:
+        """This LB's per-replica in-flight request counts (the
+        inflight gauge's own-lb slice) — the gossip payload's
+        cross-LB least-connections signal."""
+        out: Dict[str, float] = {}
+        for key in self._m_inflight.label_keys():
+            if key[0] != self.lb_id:
+                continue
+            v = self._m_inflight.value(*key)
+            if v:
+                out[key[1]] = v
+        return out
+
     def _gossip_payload(self) -> dict:
         """What this LB tells a peer: its LBState snapshot (as probed —
         stale-mode pruning included), its per-class demand/shed rates
-        over a short trailing window, and its breaker-open set."""
+        over a short trailing window, its breaker-open set, and its
+        per-replica inflight counts (cross-LB least-connections)."""
         window = max(_peer_interval() * 4, 10.0)
         now = time.time()
         return {
@@ -909,6 +927,7 @@ class SkyServeLoadBalancer:
             'shed_rps': qos_lib.rate_by_class(self._recent_sheds,
                                               window, now=now),
             'breaker_open': self.breaker.open_replicas(),
+            'inflight': self._own_inflight(),
         }
 
     def _absorb_peer(self, payload: dict) -> Optional[str]:
@@ -938,6 +957,14 @@ class SkyServeLoadBalancer:
         demand = payload.get('demand_rps')
         sheds = payload.get('shed_rps')
         breaker = payload.get('breaker_open')
+        raw_inflight = payload.get('inflight')
+        inflight: Dict[str, float] = {}
+        if isinstance(raw_inflight, dict):
+            for rep, v in raw_inflight.items():
+                try:
+                    inflight[str(rep)] = max(0.0, float(v))
+                except (TypeError, ValueError):
+                    continue
         self._peer_views[pid] = PeerView(
             lb_id=pid,
             url=str(payload.get('url') or ''),
@@ -946,6 +973,7 @@ class SkyServeLoadBalancer:
             shed_rps=sheds if isinstance(sheds, dict) else {},
             breaker_open=[str(r) for r in breaker]
             if isinstance(breaker, list) else [],
+            inflight=inflight,
             received_at=time.monotonic())
         return pid
 
@@ -988,6 +1016,16 @@ class SkyServeLoadBalancer:
                     gauge.remove_labels(*key)
             for cls, rate in total.items():
                 gauge.labels(self.lb_id, cls).set(round(rate, 4))
+        # Cross-LB least-connections: sum every fresh peer's
+        # per-replica inflight slice and hand it to the policy (a
+        # no-op for policies that don't track connections). A peer
+        # aging out drops its slice the same round, so a dead LB's
+        # last counts can't pin a replica as busy forever.
+        peer_inflight: Dict[str, float] = {}
+        for pv in live:
+            for rep, v in pv.inflight.items():
+                peer_inflight[rep] = peer_inflight.get(rep, 0.0) + v
+        self.policy.set_peer_inflight(peer_inflight)
         if self.policy.uses_affinity:
             self._m_ring_nodes.labels(self.lb_id).set(
                 len(self.policy.ring))

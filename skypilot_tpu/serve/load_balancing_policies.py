@@ -123,6 +123,12 @@ class LoadBalancingPolicy:
         """Per-replica routing weights (the LB passes prefix-cache
         occupancy from the controller sync). Default: ignored."""
 
+    def set_peer_inflight(self, counts: Dict[str, float]) -> None:
+        """Per-replica in-flight counts observed by PEER LBs (summed
+        across the fresh gossip views) — lets an N-active tier's
+        least-connections rank replicas by tier-wide load instead of
+        one LB's slice. Default: ignored."""
+
     def select_replica(self,
                        exclude: Optional[Set[str]] = None,
                        key: Optional[str] = None,
@@ -179,17 +185,30 @@ class RoundRobinPolicy(LoadBalancingPolicy):
 
 
 class LeastConnectionsPolicy(LoadBalancingPolicy):
-    """Pick the ready replica with the fewest in-flight requests."""
+    """Pick the ready replica with the fewest in-flight requests.
+
+    In an N-active LB tier the local count sees only this LB's slice
+    of the load; ``set_peer_inflight`` (fed from the LB↔LB gossip
+    payload) adds the other LBs' slices so the ranking reflects
+    tier-wide connections. Peer counts are advisory — refreshed each
+    gossip round, dropped when a peer ages out — while the local count
+    stays the exact, immediately-updated half."""
 
     def __init__(self) -> None:
         super().__init__()
         self._inflight: Dict[str, int] = {}
+        self._peer_inflight: Dict[str, float] = {}
 
     def set_ready_replicas(self, replicas: List[str]) -> None:
         with self._lock:
             self.ready_replicas = list(replicas)
             self._inflight = {r: self._inflight.get(r, 0)
                               for r in replicas}
+
+    def set_peer_inflight(self, counts: Dict[str, float]) -> None:
+        with self._lock:
+            self._peer_inflight = {str(r): max(0.0, float(v))
+                                   for r, v in counts.items()}
 
     def select_replica(self,
                        exclude: Optional[Set[str]] = None,
@@ -203,7 +222,8 @@ class LeastConnectionsPolicy(LoadBalancingPolicy):
             if not cands:
                 return None
             replica = min(cands,
-                          key=lambda r: self._inflight.get(r, 0))
+                          key=lambda r: self._inflight.get(r, 0)
+                          + self._peer_inflight.get(r, 0.0))
             self._inflight[replica] = self._inflight.get(replica, 0) + 1
             return replica
 

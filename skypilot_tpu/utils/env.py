@@ -156,6 +156,19 @@ _var('SKYT_PAGED_ATTN', 'str', 'pallas',
      'Paged decode attention impl: "pallas" or "xla".')
 _var('SKYT_SPEC_PAGED_ATTN', 'str', 'pallas',
      'Speculative-verify paged attention impl: "pallas" or "xla".')
+_var('SKYT_KV_DTYPE', 'str', 'auto',
+     'Paged KV-cache dtype: "int8" quantizes the k/v pools (per-token '
+     'per-head scales, ~2x pages per HBM byte); "auto" = model dtype. '
+     'An explicit engine kv_dtype="int8" / --kv-dtype int8 forces it; '
+     'the default "auto" defers to this env var.')
+_var('SKYT_RAGGED_PREFILL', 'bool', True,
+     'Ragged (packed variable-length) batched prefill: mixed-length '
+     'bursts pack into one segment-masked dispatch instead of padding '
+     'every row to the pow2 bucket. "0" restores the padded batch '
+     'path.')
+_var('SKYT_RAGGED_MAX_TOKENS', 'int', 0,
+     'Packed-token cap per ragged prefill dispatch (0 = the largest '
+     'prefill bucket).')
 _var('SKYT_RING_IMPL', 'str', None,
      'Ring-attention impl override ("xla" forces the XLA path).')
 

@@ -249,3 +249,55 @@ def test_rate_by_class_windows_and_garbage():
     assert rates['interactive'] == pytest.approx(0.2)
     assert rates['batch'] == pytest.approx(0.1)
     assert qos_lib.rate_by_class([], 10.0, now=now) == {}
+
+
+def test_least_connections_uses_peer_inflight():
+    """Cross-LB least-connections (ROADMAP item 2 leftover): the peer
+    LBs' gossiped inflight slices add to the local count, so a replica
+    saturated THROUGH another LB stops looking idle here."""
+    pol = lbp.LeastConnectionsPolicy()
+    pol.set_ready_replicas(['http://r1', 'http://r2'])
+    # Locally idle everywhere; peers report r1 busy -> pick r2.
+    pol.set_peer_inflight({'http://r1': 5.0})
+    assert pol.select_replica() == 'http://r2'
+    pol.on_request_done('http://r2')
+    # Peer view refresh drops the old slice entirely (no accumulation).
+    pol.set_peer_inflight({})
+    picks = {pol.select_replica() for _ in range(2)}
+    assert picks == {'http://r1', 'http://r2'}
+    # Garbage-tolerant: negative counts clamp, unknown replicas are
+    # inert, and the base policy ignores the hook entirely.
+    pol.set_peer_inflight({'http://r9': -3})
+    assert pol.select_replica() in ('http://r1', 'http://r2')
+    lbp.RoundRobinPolicy().set_peer_inflight({'http://r1': 2})
+
+
+def test_gossip_payload_carries_inflight():
+    """The LB->LB payload includes this LB's per-replica inflight
+    slice; _absorb_peer parses a peer's (garbage included) and the
+    fresh-peer aggregate feeds the policy."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', 0, policy='least_connections',
+        metrics_registry=metrics_lib.MetricsRegistry())
+    lb.peers = ['http://peer-a']
+    lb.policy.set_ready_replicas(['http://r1', 'http://r2'])
+    lb._m_inflight.labels(lb.lb_id, 'http://r1').inc()  # pylint: disable=protected-access
+    lb._m_inflight.labels(lb.lb_id, 'http://r1').inc()  # pylint: disable=protected-access
+    payload = lb._gossip_payload()  # pylint: disable=protected-access
+    assert payload['inflight'] == {'http://r1': 2}
+    # Round-trips through JSON (the wire format).
+    assert json.loads(json.dumps(payload))['inflight'] == \
+        {'http://r1': 2}
+    pid = lb._absorb_peer({  # pylint: disable=protected-access
+        'lb_id': 'lb-peer', 'url': 'http://peer-a',
+        'state': {}, 'inflight': {'http://r2': 3, 'http://bad': 'x',
+                                  'http://neg': -1}})
+    assert pid == 'lb-peer'
+    view = lb._peer_views[pid]  # pylint: disable=protected-access
+    assert view.inflight == {'http://r2': 3.0, 'http://neg': 0.0}
+    lb._refresh_peer_gauges()  # pylint: disable=protected-access
+    # Peer slice reached the policy: r2 now looks loaded, r1 carries
+    # only the LOCAL count (2) vs r2's peer count (3).
+    assert lb.policy.select_replica() == 'http://r1'

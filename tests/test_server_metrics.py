@@ -250,7 +250,8 @@ def test_lb_to_server_trace_propagation(monkeypatch):
         # Engine span events (overlap machinery) rode along.
         names = [e['name'] for s in srv_rec['spans']
                  for e in s.get('events', [])]
-        assert any(n in ('admission', 'batch_admission')
+        assert any(n in ('admission', 'batch_admission',
+                         'ragged_admission')
                    for n in names)
         assert 'decode_chunk' in names
 

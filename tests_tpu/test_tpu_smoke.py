@@ -232,6 +232,42 @@ class TestPagedAttentionLowers:
                                                     np.float32),
             atol=3e-2, rtol=3e-2)
 
+    def test_paged_int8_kernel_matches_dequant_gather(self):
+        """The int8-KV kernel (k/v int8 pages + scale blocks, dequant
+        folded into the matmuls) must lower through Mosaic at the same
+        serving shapes and match the dequantizing gather floor."""
+        from skypilot_tpu.infer.paged_cache import PagePool
+        from skypilot_tpu.ops import attention as attention_ops
+        from skypilot_tpu.ops import paged_attention
+
+        rng = np.random.default_rng(1)
+        slots, hq, hkv, d, p, mp = 8, 32, 8, 64, 64, 16
+        n_pages = slots * mp + 1
+        q = jnp.asarray(rng.normal(size=(slots, hq, d)), jnp.bfloat16)
+        kp = jnp.asarray(rng.integers(-127, 128,
+                                      (n_pages, hkv, p, d)), jnp.int8)
+        vp = jnp.asarray(rng.integers(-127, 128,
+                                      (n_pages, hkv, p, d)), jnp.int8)
+        ks = jnp.asarray(rng.uniform(0.005, 0.02, (n_pages, hkv, p)),
+                         jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.005, 0.02, (n_pages, hkv, p)),
+                         jnp.float32)
+        tables = jnp.asarray(
+            np.arange(1, 1 + slots * mp).reshape(slots, mp), jnp.int32)
+        lengths = jnp.asarray([575, 3, 100, 64, 63, 200, 17, 512],
+                              jnp.int32)
+        out = paged_attention.paged_decode_attention_q(
+            q, kp, vp, ks, vs, tables, lengths)
+        kv = PagePool.gather_view_layer_q(kp, ks, tables, jnp.float32)
+        vv = PagePool.gather_view_layer_q(vp, vs, tables, jnp.float32)
+        ref = attention_ops.mha_reference(
+            q.astype(jnp.float32)[:, None], kv, vv,
+            q_positions=lengths[:, None])
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref[:, 0],
+                                                    np.float32),
+            atol=3e-2, rtol=3e-2)
+
 
 class TestEnginePrefillDecode:
     """One prefill + a few decode steps on the chip, both cache modes
@@ -372,6 +408,75 @@ class TestEnginePrefillDecode:
             assert len(out) == 4
         finally:
             engine.stop()
+
+    def test_int8_kv_engine_lowers(self):
+        """int8 KV serving (quantized pools + in-kernel dequant read
+        path + quantizing insert/append scatters) must lower and
+        decode on the chip, agreeing with the fp engine's first
+        token."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+
+        def run(kv_dtype):
+            engine = server_lib.build_engine('debug', num_slots=2,
+                                             max_seq_len=128,
+                                             cache_mode='paged',
+                                             kv_dtype=kv_dtype)
+            engine.start()
+            try:
+                return engine.generate(
+                    prompt,
+                    engine_lib.SamplingParams(max_new_tokens=4))
+            finally:
+                engine.stop()
+
+        q8 = run('int8')
+        fp = run('auto')
+        assert len(q8) == 4
+        assert q8[0] == fp[0]   # prefill is float either way
+
+    def test_ragged_prefill_lowers(self):
+        """The packed ragged admission path (segment-masked prefill +
+        per-request src_off page scatters) must lower on the chip and
+        match sequential admission byte-for-byte."""
+        from skypilot_tpu.infer import engine as engine_lib
+        from skypilot_tpu.infer import server as server_lib
+
+        prompts = [list(range(1, 20)), list(range(5, 55)),
+                   list(range(7, 40))]
+        base = server_lib.build_engine('debug', num_slots=4,
+                                       max_seq_len=128,
+                                       cache_mode='paged')
+        model, params = base.model, base.params
+
+        def run(**kw):
+            engine = engine_lib.InferenceEngine(
+                model, params, num_slots=4, max_seq_len=128,
+                cache_mode='paged', **kw)
+            qs = [engine.submit(
+                p, engine_lib.SamplingParams(max_new_tokens=4))[1]
+                for p in prompts]
+            engine.start()
+            try:
+                outs = []
+                for q in qs:
+                    toks = []
+                    while True:
+                        t = q.get(timeout=300)
+                        if t is None:
+                            break
+                        toks.append(t)
+                    outs.append(toks)
+                return outs, dict(engine.perf)
+            finally:
+                engine.stop()
+
+        rag, perf = run()
+        assert perf['ragged_dispatches'] >= 1
+        seq, _ = run(batch_admission=False)
+        assert rag == seq
 
     def test_prefix_cached_admission(self):
         """The prefix-cache suffix-prefill path (pool gather + dense

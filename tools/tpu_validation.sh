@@ -940,6 +940,106 @@ else
     FAIL=1
 fi
 
+echo "== 14. quantized-KV serve drill: one replica with"
+echo "   SKYT_KV_DTYPE=int8 against an fp replica — greedy token"
+echo "   parity on a fixed prompt set (first tokens exact + >=70%"
+echo "   aggregate agreement, the documented quantization bound) and"
+echo "   the int8 kernel path visible in skyt_ops_kernel_path_total"
+echo "   on /metrics. Runs on CPU too (interpret-mode kernels) =="
+if timeout 900 python - <<'PYEOF' 2>&1 | tee "$OUT/kv_int8_drill.txt"
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+ports = {'fp': free_port(), 'int8': free_port()}
+env_int8 = dict(os.environ, SKYT_KV_DTYPE='int8')
+procs = {
+    'fp': subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--model', 'debug', '--port', str(ports['fp']),
+         '--num-slots', '2', '--max-seq-len', '128',
+         '--cache-mode', 'paged']),
+    'int8': subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--model', 'debug', '--port', str(ports['int8']),
+         '--num-slots', '2', '--max-seq-len', '128',
+         '--cache-mode', 'paged'], env=env_int8),
+}
+urls = {k: f'http://127.0.0.1:{p}' for k, p in ports.items()}
+try:
+    for name, proc in procs.items():
+        deadline = time.time() + 480
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise SystemExit(f'{name} replica died '
+                                 f'rc={proc.returncode}')
+            try:
+                if requests.get(urls[name] + '/health',
+                                timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(1)
+        else:
+            raise SystemExit(f'{name} replica never became healthy')
+
+    prompts = [list(range(1, 20)), list(range(5, 55)),
+               list(range(7, 40)), list(range(2, 11))]
+
+    def gen(base, toks):
+        r = requests.post(base + '/generate',
+                          json={'tokens': toks, 'max_tokens': 8},
+                          timeout=300)
+        r.raise_for_status()
+        return r.json()['tokens']
+
+    total = agree = first_ok = 0
+    for p in prompts:
+        fp = gen(urls['fp'], p)
+        q8 = gen(urls['int8'], p)
+        assert len(fp) == len(q8), (fp, q8)
+        first_ok += int(fp[0] == q8[0])
+        for a, b in zip(fp, q8):
+            total += 1
+            agree += int(a == b)
+    assert first_ok == len(prompts), \
+        f'first tokens diverged: {first_ok}/{len(prompts)}'
+    frac = agree / total
+    assert frac >= 0.7, f'token agreement {frac:.2f} below the bound'
+
+    # The int8 read path must be the one serving: its op label shows
+    # in the kernel-path counter, and the fp replica's must NOT.
+    text = requests.get(urls['int8'] + '/metrics', timeout=10).text
+    line = [l for l in text.splitlines()
+            if 'skyt_ops_kernel_path_total' in l
+            and 'paged_attention_int8' in l]
+    assert line, 'no paged_attention_int8 kernel-path series'
+    fp_text = requests.get(urls['fp'] + '/metrics', timeout=10).text
+    assert 'paged_attention_int8' not in fp_text
+    print(f'KV_INT8_DRILL_OK agreement={frac:.2f} '
+          f'first_tokens={first_ok}/{len(prompts)} '
+          f'path_series={line[0].strip()}')
+finally:
+    for proc in procs.values():
+        if proc.poll() is None:
+            proc.kill()
+PYEOF
+then
+    echo "== quantized-KV drill: PASS =="
+else
+    echo "== quantized-KV drill: FAIL (see $OUT/kv_int8_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
