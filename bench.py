@@ -52,6 +52,7 @@ PHASE_DEADLINES = {
     'slo report bench': 420,
     'kv+ragged bench': 600,
     'watchdog overhead bench': 300,
+    'weight swap bench': 480,
 }
 
 # The bench's own rank-0 heartbeat (train/heartbeat.py): the train
@@ -1495,6 +1496,183 @@ def kv_ragged_metrics() -> list:
     return out
 
 
+def weight_swap_metrics() -> list:
+    """Weight-swap phase (CPU-runnable, docs/robustness.md
+    "Zero-downtime rollouts"): one real engine-server subprocess
+    serving a streaming workload while ``POST /admin/weights`` hot-
+    swaps its checkpoint in place. Reports:
+
+      * weight_swap_itl_p95_ms — p95 inter-token latency over the
+        swap window (stage + validate + drain + apply under load);
+      * steady_itl_p95_ms — the same stream's p95 with no swap (the
+        pause is the delta);
+      * weight_swap_duration_s — end-to-end swap time from the admin
+        response;
+      * weight_swap_dropped_requests — MUST be 0: the drain holds
+        queued work, it never drops it;
+      * weight_swap_relaunches — MUST be 0: same server process (same
+        pid) before and after the swap.
+    """
+    import dataclasses as _dc
+    import shutil
+    import socket
+    import statistics
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import requests
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import weights as weights_lib
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    tmp = tempfile.mkdtemp(prefix='skyt-swapbench-')
+    cfg = _dc.replace(llama.CONFIGS['debug'], max_seq_len=64,
+                      param_dtype='float32', dtype='float32')
+    model = llama.LlamaModel(cfg)
+    zeros = jnp.zeros((1, 8), jnp.int32)
+    ckpts = []
+    for i, seed in enumerate((0, 7)):
+        params = jax.jit(model.init)(jax.random.PRNGKey(seed), zeros)
+        path = os.path.join(tmp, f'ckpt_{i}')
+        weights_lib.save_hf_checkpoint(cfg, params, path)
+        ckpts.append(path)
+    port = free_port()
+    url = f'http://127.0.0.1:{port}'
+    env = dict(os.environ, SKYT_ADMIN_TOKEN='bench-token')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--checkpoint', ckpts[0], '--port', str(port),
+         '--num-slots', '2', '--max-seq-len', '64'],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    sess = requests.Session()
+    itls = {'steady': [], 'swap': []}
+    lock = threading.Lock()
+    window = {'mode': 'steady'}
+    dropped = [0]
+    stop = threading.Event()
+
+    def worker(wid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                t_last = None
+                with requests.post(
+                        url + '/generate',
+                        json={'tokens': [wid + 1, (i % 7) + 1, 3],
+                              'max_tokens': 16, 'stream': True},
+                        stream=True, timeout=120) as r:
+                    if r.status_code != 200:
+                        with lock:
+                            dropped[0] += 1
+                        continue
+                    for line in r.iter_lines():
+                        if not line:
+                            continue
+                        now = time.perf_counter()
+                        if t_last is not None:
+                            with lock:
+                                itls[window['mode']].append(
+                                    now - t_last)
+                        t_last = now
+            except requests.RequestException:
+                with lock:
+                    dropped[0] += 1
+
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f'replica died rc={proc.returncode}')
+            try:
+                if sess.get(url + '/health',
+                            timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError('replica never became healthy')
+        pid_before = proc.pid
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(4.0)                        # steady window
+        with lock:
+            window['mode'] = 'swap'
+        t0 = time.perf_counter()
+        resp = sess.post(url + '/admin/weights',
+                         json={'checkpoint': ckpts[1]},
+                         headers={'Authorization':
+                                  'Bearer bench-token'},
+                         timeout=240)
+        swap_wall = time.perf_counter() - t0
+        if resp.status_code != 200:
+            raise RuntimeError(f'swap failed: {resp.status_code} '
+                               f'{resp.text[:200]}')
+        swap_info = resp.json()
+        time.sleep(1.0)                        # post-swap tail traffic
+        with lock:
+            window['mode'] = 'steady'
+        time.sleep(1.0)
+        stop.set()
+        for th in threads:
+            th.join(timeout=120)
+        relaunches = 0 if (proc.poll() is None and
+                           proc.pid == pid_before) else 1
+        stats = sess.get(url + '/stats', timeout=10).json()
+        if stats.get('weight_version') != swap_info['weight_version']:
+            raise RuntimeError('swap did not land: /stats '
+                               f'weight_version={stats.get("weight_version")}')
+
+        def p95(xs):
+            return (statistics.quantiles(xs, n=20)[-1]
+                    if len(xs) >= 20 else max(xs)) if xs else None
+
+        steady_p95 = p95(itls['steady'])
+        swap_p95 = p95(itls['swap'])
+        print(f'# weight swap: duration={swap_wall:.3f}s '
+              f'(apply={swap_info.get("apply_s")}s) steady_itl_p95='
+              f'{steady_p95 * 1e3 if steady_p95 else -1:.1f}ms '
+              f'swap_itl_p95={swap_p95 * 1e3 if swap_p95 else -1:.1f}ms '
+              f'dropped={dropped[0]} relaunches={relaunches}',
+              file=sys.stderr)
+        out = [
+            {'metric': 'weight_swap_duration_s',
+             'value': round(swap_wall, 3), 'unit': 's',
+             'vs_baseline': None},
+            {'metric': 'weight_swap_dropped_requests',
+             'value': dropped[0], 'unit': 'requests',
+             'vs_baseline': None},
+            {'metric': 'weight_swap_relaunches',
+             'value': relaunches, 'unit': 'relaunches',
+             'vs_baseline': None},
+        ]
+        if steady_p95 is not None:
+            out.append({'metric': 'steady_itl_p95_ms',
+                        'value': round(steady_p95 * 1e3, 2),
+                        'unit': 'ms', 'vs_baseline': None})
+        if swap_p95 is not None:
+            out.append({'metric': 'weight_swap_itl_p95_ms',
+                        'value': round(swap_p95 * 1e3, 2),
+                        'unit': 'ms', 'vs_baseline': None})
+        return out
+    finally:
+        stop.set()
+        if proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def watchdog_overhead_metrics() -> list:
     """Heartbeat hot-path cost (CPU-runnable): per-step wall delta of
     hb.on_step (file-backed, interval-throttled — the exact sft call)
@@ -1975,6 +2153,20 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# kv+ragged bench failed: {e!r}', file=sys.stderr)
+
+    # Weight-swap phase: in-place hot-swap pause (p95 ITL during the
+    # swap window vs steady), dropped requests (must be 0), relaunches
+    # (must be 0). CPU-runnable — docs/robustness.md "Zero-downtime
+    # rollouts".
+    if on_tpu:
+        _reclaim_hbm('pre-weight-swap')
+    try:
+        with phase_deadline(PHASE_DEADLINES['weight swap bench'],
+                            'weight swap bench'):
+            extra = extra + weight_swap_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# weight swap bench failed: {e!r}', file=sys.stderr)
 
     # Watchdog/heartbeat overhead phase: the training-plane heartbeat
     # must be cheap enough to leave ON (acceptance <=1%). CPU-runnable.

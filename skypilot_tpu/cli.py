@@ -685,9 +685,18 @@ def serve_status(service_names):
     for svc in serve_core.status(list(service_names) or None):
         click.echo(f'{svc["name"]}: {svc["status"].value} '
                    f'(v{svc["version"]}) endpoint={svc["endpoint"]}')
+        ro = svc.get('rollout')
+        if ro:
+            detail = f' ({ro["error"]})' if ro.get('error') else ''
+            click.echo(f'  rollout: v{ro.get("baseline_version")}'
+                       f'->v{ro.get("target_version")} '
+                       f'phase={ro.get("phase")} '
+                       f'updated={len(ro.get("updated") or [])}'
+                       f'{detail}')
         rows = [[r['replica_id'], r['cluster_name'],
                  r['status'].value, r['endpoint'] or '-',
-                 r['version'], _replica_perf(r)] for r in svc['replicas']]
+                 f'{r["version"]}/w{r.get("weight_version", 1)}',
+                 _replica_perf(r)] for r in svc['replicas']]
         click.echo(_fmt_table(rows, ['ID', 'CLUSTER', 'STATUS',
                                      'ENDPOINT', 'VERSION', 'PERF']))
 

@@ -398,6 +398,13 @@ def _update_args(args, slot, first_tok, length, temp, key, topk,
 class InferenceEngine:
     """Slot-based continuous batching over a jitted prefill/decode pair."""
 
+    # Attached by build_engine (infer/server.py): a callable(path) ->
+    # params tree matching this engine's config, plus the checkpoint
+    # the engine booted from — the staging hooks of the weight-swap
+    # manager (infer/weight_swap.py). None for hand-built engines.
+    param_loader = None
+    checkpoint_path: Optional[str] = None
+
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
@@ -673,6 +680,16 @@ class InferenceEngine:
                 env.get_int('SKYT_QOS_RESERVE_SLOTS', 0)))
         else:
             self._waiting = queue.Queue()
+        # In-place weight swap (docs/robustness.md "Zero-downtime
+        # rollouts"): a pending request staged by request_weight_swap
+        # (new device params + version + drain flag + completion
+        # event), applied by the engine loop at a decode-tick boundary
+        # — never mid-dispatch, so every chunk is computed entirely
+        # under one weight version. weight_version counts applied
+        # swaps (gauge skyt_infer_weight_version; starts at 1, the
+        # launch weights).
+        self.weight_version = 1
+        self._swap_req: Optional[Dict[str, Any]] = None
         # Last scheduled order broadcast to lockstep followers (seq
         # list); reorders only rebroadcast when the order changed.
         self._last_qorder: Optional[List[int]] = None
@@ -792,6 +809,11 @@ class InferenceEngine:
         self._m_kv_util = reg.gauge(
             'skyt_infer_kv_cache_utilization',
             'KV cache occupancy fraction (0-1)')
+        self._m_weight_version = reg.gauge(
+            'skyt_infer_weight_version',
+            'Weight version the engine is serving (starts at 1; each '
+            'applied in-place swap bumps it to the pushed version)')
+        self._m_weight_version.set(self.weight_version)
         self._m_deadline_expired = reg.counter(
             'skyt_infer_deadline_expired_total',
             'Requests expired by their per-request deadline (slot and '
@@ -1696,6 +1718,7 @@ class InferenceEngine:
         return {'active_slots': active, 'num_slots': self.num_slots,
                 'waiting': waiting,
                 'ready': self.ready.is_set(),
+                'weight_version': self.weight_version,
                 'kernel_paths': ops_dispatch.snapshot(),
                 **self.perf_stats()}
 
@@ -1858,6 +1881,108 @@ class InferenceEngine:
         self._last_pull_t = None
         with self._lock:
             self._ttfts.clear()   # percentiles cover the same window
+
+    # ------------------------------------------------- in-place weight swap
+    def request_weight_swap(self, new_params, *,
+                            version: Optional[int] = None,
+                            drain: Optional[bool] = None,
+                            timeout: Optional[float] = None
+                            ) -> Dict[str, Any]:
+        """Install `new_params` as the live weights at a decode-tick
+        boundary (docs/robustness.md "Zero-downtime rollouts").
+
+        The caller (infer/weight_swap.py) has already staged the tree
+        onto the live shardings, so the apply is a reference swap plus
+        a prefix-cache flush — decoding continues through the staging.
+        drain=True (the SKYT_SWAP_DRAIN default) waits for in-flight
+        requests to finish on the OLD weights — new admissions hold at
+        the queue until the swap lands; drain=False applies at the next
+        tick boundary and in-flight requests continue on the new
+        weights (their earlier tokens came from the old ones — the
+        mid-stream version mix a drain exists to avoid). Blocks until
+        applied; returns {'weight_version', 'flushed_prefix_pages',
+        'apply_s'}. Raises TimeoutError if the engine never reaches an
+        applicable boundary within `timeout` (SKYT_SWAP_TIMEOUT_S) —
+        the old weights then stay live."""
+        if self._lockstep is not None:
+            raise RuntimeError(
+                'in-place weight swap is not supported on multi-host '
+                'lockstep replicas (the swap boundary would have to '
+                'ride the tick broadcast); roll these replicas by '
+                'relaunch')
+        if drain is None:
+            drain = env.get_bool('SKYT_SWAP_DRAIN', True)
+        if timeout is None:
+            timeout = env.get_float('SKYT_SWAP_TIMEOUT_S', 120.0)
+        if version is None:
+            version = self.weight_version + 1
+        swap: Dict[str, Any] = {'params': new_params,
+                                'version': int(version),
+                                'drain': bool(drain),
+                                'event': threading.Event(),
+                                'result': None}
+        running = self._thread is not None and self._thread.is_alive()
+        with self._lock:
+            if self._swap_req is not None:
+                raise RuntimeError('a weight swap is already pending')
+            self._swap_req = swap
+        if not running:
+            # No engine loop (cold engine, unit tests): every moment
+            # is a tick boundary; apply inline.
+            self._maybe_apply_swap()
+        if not swap['event'].wait(timeout):
+            with self._lock:
+                if self._swap_req is swap:
+                    self._swap_req = None
+                    raise TimeoutError(
+                        f'engine did not reach a weight-swap boundary '
+                        f'within {timeout}s (drain={drain}); old '
+                        f'weights stay live')
+            # Lost the race: the loop applied it while we timed out.
+            swap['event'].wait(5)
+        if swap['result'] is None:
+            raise RuntimeError('engine loop died before the weight '
+                               'swap applied; old weights stay live')
+        return swap['result']
+
+    def _maybe_apply_swap(self) -> None:
+        """Apply a pending weight swap if this tick boundary is
+        eligible (engine-loop thread, or inline when no loop runs). A
+        draining swap waits until no slot is occupied and no chunked
+        prefill is mid-flight; admissions are held while it waits
+        (see _loop_body) so the drain converges.
+
+        The eligibility check AND the claim happen under one lock
+        hold: once claimed (_swap_req cleared), the waiter's timeout
+        path can no longer abort it — without the atomic claim, a
+        drain completing exactly at the timeout could apply the new
+        weights while the caller records an abort, leaving a replica
+        silently serving weights nobody believes it has."""
+        with self._lock:
+            swap = self._swap_req
+            if swap is None:
+                return
+            if swap['drain'] and (
+                    self._chunked is not None or
+                    any(s is not None for s in self._slots)):
+                return
+            self._swap_req = None   # claimed: apply is now inevitable
+        t0 = time.perf_counter()
+        self.params = swap['params']
+        self.weight_version = int(swap['version'])
+        flushed = 0
+        if self.pool is not None and self.prefix_caching:
+            # Stale-KV correctness: cached prefixes were computed under
+            # the old weights and must never be shared across versions.
+            flushed = self.pool.flush_prefix()
+        self._m_weight_version.set(self.weight_version)
+        swap['result'] = {'weight_version': self.weight_version,
+                          'flushed_prefix_pages': flushed,
+                          'apply_s': round(time.perf_counter() - t0, 6)}
+        logger.info('weight swap applied: version %d (drain=%s, '
+                    '%d prefix pages flushed)', self.weight_version,
+                    swap['drain'], flushed)
+        swap['event'].set()
 
     # ---------------------------------------------------------- main loop
     def _bucket_for(self, n: int) -> int:
@@ -2730,6 +2855,14 @@ class InferenceEngine:
                 self._trace_event(req.req_id, 'done', status='failed')
                 req.out_queue.put(None)
             self.ready.clear()
+        finally:
+            # A pending weight swap must not wedge its waiter on a
+            # dead or stopped loop: fail it loudly (old weights stay
+            # live; request_weight_swap raises on a None result).
+            with self._lock:
+                swap, self._swap_req = self._swap_req, None
+            if swap is not None:
+                swap['event'].set()
 
     def _loop_body(self) -> None:
         # PIPELINED decode: dispatch chunk k+1 BEFORE pulling chunk k's
@@ -2755,6 +2888,19 @@ class InferenceEngine:
             # requests and /health flips 503; 'latency' makes this a
             # slow replica.
             faults.inject('engine.loop')
+            # In-place weight swap: apply at THIS tick boundary when
+            # eligible (immediately, or once a draining swap's
+            # in-flight requests have finished). While a draining swap
+            # is still pending, admissions hold below so the drain
+            # converges instead of racing new seats.
+            # Lock-free peek on the hot path: _swap_req is rebound
+            # under _lock by request_weight_swap, and a stale read
+            # here only delays the apply/hold by ONE tick —
+            # _maybe_apply_swap re-reads under the lock before acting.
+            if self._swap_req is not None:  # noqa: lock-discipline
+                self._maybe_apply_swap()
+            swap_draining = \
+                self._swap_req is not None  # noqa: lock-discipline
             # Deadline enforcement: expired requests cancel in place
             # (slot + KV pages free at the next delivery boundary).
             self._expire_deadlines()
@@ -2771,7 +2917,7 @@ class InferenceEngine:
             # sequential path. Device-side arg/cache updates order after
             # any in-flight chunk via the dispatch chain.
             admitted = False
-            while None in self._slots:
+            while None in self._slots and not swap_draining:
                 if self._try_admit_ragged():
                     admitted = True
                     continue

@@ -207,6 +207,23 @@ class PagePool:
             else:
                 self._free.append(page)
 
+    def flush_prefix(self) -> int:
+        """Unpublish every prefix-cache entry (weight swap: cached KV
+        was computed under the OLD weights, so sharing it after the
+        swap would silently mix versions — docs/robustness.md
+        "Zero-downtime rollouts"). Warm unreferenced pages return to
+        the plain free list; pages still referenced by live slots keep
+        their reservations (their requests finish normally) but lose
+        their registry entry, so they can never be shared again and
+        free as plain pages on release. Returns entries flushed."""
+        flushed = len(self._registry)
+        self._registry.clear()
+        self._page_hash.clear()
+        for page in self._cached_free:
+            self._free.append(page)
+        self._cached_free.clear()
+        return flushed
+
     def prefix_peek(self, lookup_hashes) -> int:
         """Length of the leading registered-page run for these hashes —
         a READ-ONLY probe of what try_reserve_prefix would share (no

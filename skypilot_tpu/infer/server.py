@@ -59,6 +59,7 @@ from aiohttp import web
 
 from skypilot_tpu.infer import engine as engine_lib
 from skypilot_tpu.infer import tokenizer as tokenizer_lib
+from skypilot_tpu.infer import weight_swap as weight_swap_lib
 from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.utils import faults
@@ -193,6 +194,12 @@ class InferenceServer:
         # scraper aggregates the resulting counters across replicas.
         self._goodput = slo_lib.GoodputTracker(
             registry=engine.metrics_registry)
+        # In-place weight swap (docs/robustness.md "Zero-downtime
+        # rollouts"): POST /admin/weights stages+validates+applies a
+        # new checkpoint at a decode-tick boundary with zero requests
+        # dropped. Gated on SKYT_ADMIN_TOKEN (403 otherwise) and
+        # single-flight (409 concurrent).
+        self._swap_mgr = weight_swap_lib.WeightSwapManager(engine)
         # Multi-LoRA routing (vLLM's OpenAI convention): 'model' in a
         # request names either the base model or a loaded adapter.
         self.lora_names = dict(lora_names or {})
@@ -331,6 +338,10 @@ class InferenceServer:
             'queue_depth': eng._waiting.qsize(),  # pylint: disable=protected-access
             'running_slots': running,
             'num_slots': eng.num_slots,
+            # Mixed-version windows during rolling updates must be
+            # visible on flight-recorded slow traces ("slow because
+            # the swap was draining under it").
+            'weight_version': eng.weight_version,
         }
         if eng.pool is not None:
             total = eng.pool.cfg.n_pages - 1
@@ -475,6 +486,76 @@ class InferenceServer:
             return web.json_response(
                 {'error': f'profile capture failed: {e!r}'},
                 status=500)
+        return web.json_response(result)
+
+    async def _admin_weights(self, request: web.Request
+                             ) -> web.Response:
+        """``POST /admin/weights`` — in-place weight hot-swap
+        (docs/robustness.md "Zero-downtime rollouts").
+
+        Body: ``{"checkpoint": <dir>, "version": N?, "drain": bool?}``
+        or ``{"swap_back": true}``. Auth: requires SKYT_ADMIN_TOKEN to
+        be configured AND presented as a bearer (403 otherwise — a
+        weight push is a code push; reachability alone must never be
+        enough). Single-flight: 409 while a swap is in progress; 400
+        on a malformed body or a swap that failed validation/loading
+        (old weights intact in every error case)."""
+        token = env_lib.get('SKYT_ADMIN_TOKEN')
+        if not token:
+            return web.json_response(
+                {'error': 'admin API disabled: start the replica with '
+                          'SKYT_ADMIN_TOKEN set (the serve controller '
+                          'exports the per-service token)'},
+                status=403)
+        import hmac
+        got = request.headers.get('Authorization', '')
+        if not hmac.compare_digest(
+                got.encode('utf-8', 'surrogateescape'),
+                f'Bearer {token}'.encode('utf-8')):
+            return web.json_response(
+                {'error': 'unauthorized: missing or bad Authorization '
+                          'bearer token'}, status=403)
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {'error': 'body must be a JSON object'}, status=400)
+        drain = payload.get('drain')
+        if drain is not None and not isinstance(drain, bool):
+            return web.json_response(
+                {'error': f'drain must be a boolean, got {drain!r}'},
+                status=400)
+        version = payload.get('version')
+        if version is not None and (isinstance(version, bool) or
+                                    not isinstance(version, int) or
+                                    version < 1):
+            return web.json_response(
+                {'error': f'version must be an integer >= 1, got '
+                          f'{version!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        if payload.get('swap_back'):
+            op = functools.partial(self._swap_mgr.swap_back,
+                                   drain=drain)
+        else:
+            ckpt = payload.get('checkpoint')
+            if not isinstance(ckpt, str) or not ckpt:
+                return web.json_response(
+                    {'error': 'checkpoint must be a non-empty path '
+                              '(or pass swap_back: true)'}, status=400)
+            op = functools.partial(self._swap_mgr.swap,
+                                   checkpoint=ckpt, version=version,
+                                   drain=drain)
+        try:
+            result = await loop.run_in_executor(None, op)
+        except weight_swap_lib.SwapInFlight as e:
+            return web.json_response({'error': str(e)}, status=409)
+        except weight_swap_lib.WeightSwapError as e:
+            return web.json_response(
+                {'error': str(e),
+                 'weight_version': self.engine.weight_version},
+                status=400)
         return web.json_response(result)
 
     async def _health(self, request: web.Request) -> web.Response:
@@ -1293,6 +1374,7 @@ class InferenceServer:
         app.router.add_get('/metrics', self._metrics)
         app.router.add_get('/debug/traces', self._debug_traces)
         app.router.add_post('/debug/profile', self._debug_profile)
+        app.router.add_post('/admin/weights', self._admin_weights)
         app.router.add_post('/generate', self._generate)
         app.router.add_get('/v1/models', self._models)
         app.router.add_post('/v1/completions', self._completions)
@@ -1357,6 +1439,7 @@ def build_engine(model_name: Optional[str] = None,
         from skypilot_tpu.parallel import mesh as mesh_lib
         mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(tp=tp))
 
+    moe_cfg = None   # set by the MoE branches; routes the swap loader
     already_quantized = False
     if checkpoint:
         from skypilot_tpu.models import weights as weights_lib
@@ -1470,21 +1553,39 @@ def build_engine(model_name: Optional[str] = None,
                 'making decode strictly SLOWER than --spec-decode 0. '
                 'Debug use only — point --draft-checkpoint at real '
                 'small-model weights for a speedup.', draft_model_name)
-    return engine_lib.InferenceEngine(model, params,
-                                      num_slots=num_slots,
-                                      max_seq_len=cfg.max_seq_len,
-                                      decode_chunk=decode_chunk,
-                                      mesh=mesh,
-                                      cache_mode=cache_mode,
-                                      pool_tokens=pool_tokens,
-                                      prefix_caching=prefix_caching,
-                                      kv_dtype=kv_dtype,
-                                      spec_decode=spec_decode,
-                                      prefill_chunk=prefill_chunk,
-                                      lockstep=lockstep,
-                                      draft_model=draft_model,
-                                      draft_params=draft_params,
-                                      lora_stack=lora_stack)
+    engine = engine_lib.InferenceEngine(model, params,
+                                        num_slots=num_slots,
+                                        max_seq_len=cfg.max_seq_len,
+                                        decode_chunk=decode_chunk,
+                                        mesh=mesh,
+                                        cache_mode=cache_mode,
+                                        pool_tokens=pool_tokens,
+                                        prefix_caching=prefix_caching,
+                                        kv_dtype=kv_dtype,
+                                        spec_decode=spec_decode,
+                                        prefill_chunk=prefill_chunk,
+                                        lockstep=lockstep,
+                                        draft_model=draft_model,
+                                        draft_params=draft_params,
+                                        lora_stack=lora_stack)
+    # In-place weight swap staging hooks (infer/weight_swap.py): a
+    # loader that reads ANOTHER checkpoint of the same architecture
+    # into a tree matching this engine's params — same config, same
+    # mesh placement, same stream-quantize mode as the boot load, so
+    # the swap validation compares like with like.
+    engine.checkpoint_path = checkpoint
+    qmode = quantize if quantize in ('int8', 'int4') else 'none'
+
+    def _param_loader(path: str):
+        from skypilot_tpu.models import weights as weights_lib
+        if moe_cfg is not None:
+            return weights_lib.load_mixtral_params(
+                cfg, moe_cfg, path, mesh=mesh, quantize=qmode)
+        return weights_lib.load_llama_params(
+            cfg, path, mesh=mesh, quantize=qmode)
+
+    engine.param_loader = _param_loader
+    return engine
 
 
 def main(argv=None) -> None:
@@ -1570,6 +1671,17 @@ def main(argv=None) -> None:
                              'Host 0 serves HTTP; other hosts run the '
                              'engine in lockstep.')
     args = parser.parse_args(argv)
+
+    # Rolling-update composition (docs/robustness.md "Zero-downtime
+    # rollouts"): the serve controller exports the service spec's
+    # current `weights:` checkpoint, so a replica launched mid- or
+    # post-rollout boots on the weights the fleet is SERVING rather
+    # than the task's original --checkpoint.
+    env_ckpt = env_lib.get('SKYT_WEIGHTS_CHECKPOINT')
+    if env_ckpt:
+        logger.info('SKYT_WEIGHTS_CHECKPOINT overrides the startup '
+                    'checkpoint: %s', env_ckpt)
+        args.checkpoint = env_ckpt
 
     lockstep = None
     if args.multihost == 'on' or (

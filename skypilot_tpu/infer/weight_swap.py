@@ -1,0 +1,292 @@
+"""In-place weight hot-swap for a live inference engine.
+
+The zero-downtime-rollout enabler (docs/robustness.md "Zero-downtime
+rollouts", ROADMAP item 5): a fine-tune push replaces a replica's
+weights WITHOUT a relaunch — no recompile, no cold KV cache, no
+drained connections. The manager owns the swap lifecycle:
+
+  1. **stage** — load the new checkpoint into host memory and
+     ``jax.device_put`` each leaf onto the LIVE tree's sharding while
+     decoding continues (staging shares HBM with the old tree for its
+     duration; the apply itself is a reference swap);
+  2. **validate** — the new tree must match the live one in structure,
+     per-leaf shape, and dtype (sharding is imposed at stage time from
+     the live leaves). Any mismatch aborts with the old weights
+     intact and the offending path named;
+  3. **apply** — the engine installs the staged tree at a decode-tick
+     boundary (engine.request_weight_swap): in-flight requests drain
+     to the boundary by default (``SKYT_SWAP_DRAIN=0`` lets them
+     continue onto the new weights), the prefix cache is flushed
+     (stale-KV correctness), and ``skyt_infer_weight_version`` bumps.
+
+Single-flight: a second swap while one is in flight raises
+SwapInFlight (the server's 409). The previous checkpoint reference is
+retained so a canary that fails its bake can ``swap_back()`` — the
+rollout orchestrator's rollback lever. Every attempt runs through the
+``weights.swap`` fault point (kinds error/hang/latency), so the
+abort-keeps-old-weights contract is chaos-testable.
+
+The base params are the only thing swapped: LoRA adapter stacks and
+draft-model params are untouched (adapters are versioned by their own
+export flow).
+"""
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from skypilot_tpu.utils import faults
+from skypilot_tpu.utils import jax_compat
+from skypilot_tpu.utils import log_utils
+from skypilot_tpu.utils import metrics as metrics_lib
+
+logger = log_utils.init_logger(__name__)
+
+
+class WeightSwapError(RuntimeError):
+    """A swap attempt failed; the old weights are still live."""
+
+
+class SwapInFlight(WeightSwapError):
+    """A swap is already in progress (single-flight; HTTP 409)."""
+
+
+def _path_str(path) -> str:
+    out = []
+    for p in path:
+        out.append(str(getattr(p, 'key', getattr(p, 'name',
+                                                 getattr(p, 'idx', p)))))
+    return '/'.join(out) or '<root>'
+
+
+def validate_tree(live, new) -> None:
+    """Reject a replacement params tree that does not match the live
+    one in structure, per-leaf shape, or dtype. Raises WeightSwapError
+    naming the first offending path — the swap must abort BEFORE any
+    device state changes."""
+    live_leaves = jax_compat.tree_leaves_with_path(live)
+    new_leaves = jax_compat.tree_leaves_with_path(new)
+    live_map = {_path_str(p): leaf for p, leaf in live_leaves}
+    new_map = {_path_str(p): leaf for p, leaf in new_leaves}
+    missing = sorted(set(live_map) - set(new_map))
+    extra = sorted(set(new_map) - set(live_map))
+    if missing or extra:
+        raise WeightSwapError(
+            f'param tree structure mismatch: '
+            f'{len(missing)} missing (e.g. {missing[:3]}), '
+            f'{len(extra)} unexpected (e.g. {extra[:3]})')
+    for path, leaf in live_map.items():
+        cand = new_map[path]
+        l_shape = tuple(getattr(leaf, 'shape', ()))
+        c_shape = tuple(getattr(cand, 'shape', ()))
+        if l_shape != c_shape:
+            raise WeightSwapError(
+                f'param {path}: shape {c_shape} does not match the '
+                f'live {l_shape}')
+        l_dtype = getattr(leaf, 'dtype', None)
+        c_dtype = getattr(cand, 'dtype', None)
+        if l_dtype is not None and c_dtype is not None and \
+                str(l_dtype) != str(c_dtype):
+            raise WeightSwapError(
+                f'param {path}: dtype {c_dtype} does not match the '
+                f'live {l_dtype}')
+
+
+class WeightSwapManager:
+    """Owns staging, validation, single-flight, history, and metrics
+    for one engine's in-place weight swaps. One instance per replica
+    server (infer/server.py exposes it at ``POST /admin/weights``)."""
+
+    def __init__(self, engine, loader=None,
+                 checkpoint: Optional[str] = None,
+                 registry: Optional['metrics_lib.MetricsRegistry'] = None
+                 ) -> None:
+        self.engine = engine
+        self._loader = loader if loader is not None \
+            else getattr(engine, 'param_loader', None)
+        self.checkpoint: Optional[str] = checkpoint if checkpoint \
+            else getattr(engine, 'checkpoint_path', None)
+        # (version, {'checkpoint': path} | {'params': tree}) of the
+        # weights the LAST successful swap replaced — the swap_back
+        # target. A host/path reference, never a retained device tree:
+        # pinning the old tree in HBM for the whole bake would double
+        # weight memory (swap-back restages instead).
+        self._prev: Optional[tuple] = None
+        self._old_params = None
+        self._flight = threading.Lock()
+        self.last: Optional[Dict[str, Any]] = None
+        reg = registry or getattr(engine, 'metrics_registry', None) \
+            or metrics_lib.REGISTRY
+        self._m_swaps = reg.counter(
+            'skyt_infer_weight_swaps_total',
+            'In-place weight swap attempts by result (ok / aborted — '
+            'aborted leaves the old weights live)', ('result',))
+        self._m_swap_s = reg.histogram(
+            'skyt_infer_weight_swap_seconds',
+            'End-to-end weight swap duration (stage + validate + '
+            'tick-boundary apply)')
+
+    # ------------------------------------------------------------ views
+    def info(self) -> Dict[str, Any]:
+        return {
+            'weight_version': self.engine.weight_version,
+            'checkpoint': self.checkpoint,
+            'swap_back_available': self._prev is not None,
+            'last_swap': dict(self.last) if self.last else None,
+        }
+
+    # ------------------------------------------------------------ swaps
+    def swap(self, checkpoint: Optional[str] = None,
+             params=None, version: Optional[int] = None,
+             drain: Optional[bool] = None) -> Dict[str, Any]:
+        """Stage + validate + apply one weight swap. Exactly one of
+        `checkpoint` (loaded via the engine's param loader) or
+        `params` (an already-built tree; tests and in-process pushes)
+        must be given. Raises SwapInFlight on concurrency,
+        WeightSwapError on any failure — the old weights are intact in
+        both cases."""
+        if not self._flight.acquire(blocking=False):
+            raise SwapInFlight(
+                'a weight swap is already in flight on this replica')
+        try:
+            return self._swap_locked(checkpoint, params, version,
+                                     drain)
+        finally:
+            self._flight.release()
+
+    def swap_back(self, drain: Optional[bool] = None) -> Dict[str, Any]:
+        """Restage + apply the weights the last successful swap
+        replaced (the rollout orchestrator's rollback lever)."""
+        if not self._flight.acquire(blocking=False):
+            raise SwapInFlight(
+                'a weight swap is already in flight on this replica')
+        try:
+            if self._prev is None:
+                raise WeightSwapError(
+                    'no previous weights retained: nothing to swap '
+                    'back to')
+            version, ref = self._prev
+            return self._swap_locked(ref.get('checkpoint'),
+                                     ref.get('params'), version, drain,
+                                     is_back=True)
+        finally:
+            self._flight.release()
+
+    def _swap_locked(self, checkpoint, params, version, drain,
+                     is_back: bool = False) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        old_version = self.engine.weight_version
+        old_checkpoint = self.checkpoint
+        target = int(version) if version is not None \
+            else old_version + 1
+        try:
+            # Chaos hook (docs/robustness.md fault catalog): 'error'
+            # aborts the swap with the old weights intact — the canary
+            # auto-rollback drill's lever; latency/hang stretch the
+            # single-flight window (concurrent swaps then 409).
+            faults.inject('weights.swap', version=target,
+                          checkpoint=checkpoint or '')
+            if (checkpoint is None) == (params is None):
+                raise WeightSwapError(
+                    'exactly one of checkpoint= or params= is '
+                    'required')
+            if params is None:
+                if self._loader is None:
+                    raise WeightSwapError(
+                        'this replica has no checkpoint loader (engine '
+                        'built without build_engine); push a params '
+                        'tree instead')
+                try:
+                    params = self._loader(checkpoint)
+                except WeightSwapError:
+                    raise
+                except Exception as e:
+                    raise WeightSwapError(
+                        f'loading checkpoint {checkpoint!r} failed: '
+                        f'{e}') from e
+            validate_tree(self.engine.params, params)
+            staged = self._stage(params)
+            result = self.engine.request_weight_swap(
+                staged, version=target, drain=drain)
+        except faults.FaultError as e:
+            self._abort(t0, target, checkpoint, f'injected fault: {e}')
+            raise WeightSwapError(
+                f'weight swap aborted (old weights intact): {e}'
+            ) from e
+        except WeightSwapError as e:
+            self._abort(t0, target, checkpoint, str(e))
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            self._abort(t0, target, checkpoint, str(e))
+            raise WeightSwapError(
+                f'weight swap failed (old weights intact): {e}') from e
+        dur = time.perf_counter() - t0
+        # Retain what we REPLACED so a failed bake can roll back (a
+        # swap_back re-points history at what IT replaced, so repeated
+        # flips keep working). A checkpoint PATH when the old weights
+        # came from one — swap-back restages from disk instead of
+        # pinning a second full tree in HBM for the whole bake; the
+        # old tree reference otherwise (params-tree swaps: tests and
+        # in-process pushes, where trees are debug-sized).
+        if old_checkpoint is not None:
+            self._prev = (old_version, {'checkpoint': old_checkpoint})
+            # Release the staging-time reference to the REPLACED
+            # device tree: with a path to restage from, keeping it
+            # would pin 2x weight HBM for the whole bake window.
+            self._old_params = None
+        else:
+            self._prev = (old_version, {'params': self._old_params})
+        # The live weights now correspond to what was pushed: the new
+        # path, or no path at all for a params-tree push.
+        self.checkpoint = checkpoint
+        self._m_swaps.labels('ok').inc()
+        self._m_swap_s.observe(dur)
+        self.last = {
+            'ok': True, 'weight_version': result['weight_version'],
+            'from_version': old_version,
+            'checkpoint': checkpoint, 'swap_back': is_back,
+            'duration_s': round(dur, 4),
+            'apply_s': result['apply_s'],
+            'flushed_prefix_pages': result['flushed_prefix_pages'],
+            'at': time.time(),
+        }
+        logger.info('weight swap ok: v%d -> v%d in %.3fs (%s)',
+                    old_version, result['weight_version'], dur,
+                    checkpoint or 'params tree')
+        return dict(self.last)
+
+    def _abort(self, t0: float, target: int, checkpoint,
+               error: str) -> None:
+        self._m_swaps.labels('aborted').inc()
+        self.last = {
+            'ok': False, 'weight_version': self.engine.weight_version,
+            'target_version': target, 'checkpoint': checkpoint,
+            'error': error,
+            'duration_s': round(time.perf_counter() - t0, 4),
+            'at': time.time(),
+        }
+        logger.warning('weight swap to v%d aborted (old weights '
+                       'intact): %s', target, error)
+
+    def _stage(self, params):
+        """Device-stage the validated tree onto the live leaves'
+        placements (sharded engines keep their NamedShardings), fully
+        materialized BEFORE the tick-boundary apply so the engine-side
+        swap is a reference assignment, not a transfer."""
+        self._old_params = self.engine.params
+
+        def put(new_leaf, live_leaf):
+            sharding = getattr(live_leaf, 'sharding', None)
+            if sharding is not None:
+                return jax.device_put(new_leaf, sharding)
+            return jax.device_put(new_leaf)
+
+        staged = jax.tree_util.tree_map(put, params,
+                                        self.engine.params)
+        try:
+            jax.block_until_ready(staged)
+        except AttributeError:   # very old jax: per-leaf fallback
+            for leaf in jax.tree_util.tree_leaves(staged):
+                getattr(leaf, 'block_until_ready', lambda: None)()
+        return staged

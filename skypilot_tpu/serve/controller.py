@@ -52,8 +52,14 @@ class SkyServeController:
         self.fleet: Optional[fleet_lib.FleetTelemetry] = \
             fleet_lib.FleetTelemetry(service_name) \
             if fleet_lib.enabled() else None
+        svc_row = serve_state.get_service(service_name)
         self.replica_manager = replica_managers.ReplicaManager(
-            service_name, spec, task_yaml, telemetry=self.fleet)
+            service_name, spec, task_yaml,
+            # The PERSISTED spec version: a restarting controller must
+            # compare adoption candidates against the version the
+            # fleet was actually rolled to, not a hardcoded 1.
+            version=(svc_row or {}).get('version') or 1,
+            telemetry=self.fleet)
         # QoS-aware mode (SKYT_QOS=1) scales on per-class demand +
         # observed shed rate from the LB sync (docs/qos.md).
         self.autoscaler = autoscalers.pick_autoscaler_cls(spec)(spec)
@@ -113,6 +119,10 @@ class SkyServeController:
             faults.inject('controller.crash')
             try:
                 self.replica_manager.probe_all()
+                # Rolling in-place weight update: one state-machine
+                # step per pass (canary -> bake -> fleet, or
+                # rollback); no-op without an active rollout.
+                self.replica_manager.rollout_tick()
                 ready = len(self.replica_manager.ready_urls())
                 decision = self.autoscaler.evaluate_scaling(ready)
                 ondemand_base = getattr(self.autoscaler, 'ondemand_base',
@@ -192,15 +202,66 @@ class SkyServeController:
         prefix = self.replica_manager.ready_prefix_cache()
         if prefix:
             resp['replica_prefix_cache'] = prefix
+        # Per-replica serving weight versions: mixed-version windows
+        # during a rollout are visible at the front door
+        # (skyt_lb_replica_weight_version).
+        wv = self.replica_manager.ready_weight_versions()
+        if wv:
+            resp['replica_weight_versions'] = wv
+        # Peer discovery (docs/serving.md "N-active front door"): the
+        # registered-LB list rides every sync so N-active LBs learn
+        # each other's advertise URLs without manual --lb-peers lists.
+        lbs = self.registered_lbs()
+        if lbs:
+            resp['lbs'] = {lid: rec['url'] for lid, rec in lbs.items()}
         return web.json_response(resp)
+
+    @staticmethod
+    def _task_body_equal(yaml_a: str, yaml_b: str) -> bool:
+        """True when two task YAMLs describe the same task apart from
+        their `service:` section — the other half of weights-only
+        rollout eligibility (a changed run command or resources needs
+        the relaunch path no matter what the spec diff says)."""
+        import yaml as yaml_lib
+        try:
+            with open(yaml_a, encoding='utf-8') as f:
+                a = yaml_lib.safe_load(f) or {}
+            with open(yaml_b, encoding='utf-8') as f:
+                b = yaml_lib.safe_load(f) or {}
+        except (OSError, yaml_lib.YAMLError):
+            return False
+        a.pop('service', None)
+        b.pop('service', None)
+        return a == b
 
     async def _handle_update_service(self, request: web.Request
                                      ) -> web.Response:
-        """Reference: /controller/update_service — rolling update."""
+        """Reference: /controller/update_service — rolling update.
+
+        A spec bump whose diff is WEIGHTS-ONLY (same probes/policy/
+        task, new `weights:` checkpoint) routes to the in-place
+        rolling update (canary -> bake -> fleet hot-swap, zero
+        relaunches) instead of the drain+relaunch path
+        (docs/robustness.md "Zero-downtime rollouts")."""
         payload = await request.json()
         spec = spec_lib.ServiceSpec.from_yaml_config(payload['service'])
         task_yaml = payload['task_yaml']
         version = int(payload['version'])
+        old_spec = self.replica_manager.spec
+        if old_spec.weights_only_diff(spec) and self._task_body_equal(
+                self.replica_manager.task_yaml, task_yaml):
+            from skypilot_tpu import exceptions
+            try:
+                status = self.replica_manager.start_rolling_update(
+                    spec, task_yaml, version)
+            except exceptions.SkyTpuError as e:
+                return web.json_response({'error': str(e)}, status=409)
+            logger.info('service %s: weights-only update to version '
+                        '%d -> in-place rolling update',
+                        self.service_name, version)
+            return web.json_response({'ok': True, 'version': version,
+                                      'rolling': True,
+                                      'rollout': status})
         self.replica_manager.update_version(spec, task_yaml, version)
         self.autoscaler.update_spec(spec)
         serve_state.set_service_spec(self.service_name, spec, task_yaml,
@@ -208,6 +269,45 @@ class SkyServeController:
         logger.info('service %s updated to version %d', self.service_name,
                     version)
         return web.json_response({'ok': True, 'version': version})
+
+    async def _handle_rolling_update(self, request: web.Request
+                                     ) -> web.Response:
+        """``POST /controller/rolling_update`` — the weight-push entry
+        point (train/push_weights.py): bump ONLY the spec's weights
+        checkpoint and start the canaried in-place rollout. Body:
+        ``{"checkpoint": <dir>}``. 409 while a rollout is active, 400
+        on a malformed body."""
+        import dataclasses as _dc
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        ckpt = payload.get('checkpoint') \
+            if isinstance(payload, dict) else None
+        if not isinstance(ckpt, str) or not ckpt:
+            return web.json_response(
+                {'error': 'checkpoint must be a non-empty path'},
+                status=400)
+        svc = serve_state.get_service(self.service_name)
+        if svc is None:
+            return web.json_response(
+                {'error': 'service row missing'}, status=500)
+        new_spec = _dc.replace(self.replica_manager.spec,
+                               weights=ckpt)
+        if new_spec.weights == getattr(self.replica_manager.spec,
+                                       'weights', None):
+            return web.json_response(
+                {'error': f'service already serves weights {ckpt!r}'},
+                status=400)
+        version = int(svc['version']) + 1
+        from skypilot_tpu import exceptions
+        try:
+            status = self.replica_manager.start_rolling_update(
+                new_spec, self.replica_manager.task_yaml, version)
+        except exceptions.SkyTpuError as e:
+            return web.json_response({'error': str(e)}, status=409)
+        return web.json_response({'ok': True, 'version': version,
+                                  'rollout': status})
 
     async def _handle_status(self, request: web.Request) -> web.Response:
         del request
@@ -219,6 +319,7 @@ class SkyServeController:
                 'status': info.status.value,
                 'endpoint': info.endpoint,
                 'version': info.version,
+                'weight_version': getattr(info, 'weight_version', 1),
                 'use_spot': info.use_spot,
                 'pid': info.pid,
                 'adopted_at': info.adopted_at,
@@ -234,6 +335,7 @@ class SkyServeController:
             'target_num_replicas': self.autoscaler.target_num_replicas,
             'replicas': replicas,
             'lbs': lbs,
+            'rollout': self.replica_manager.rollout_status(),
         })
 
     async def _handle_metrics(self, request: web.Request) -> web.Response:
@@ -290,6 +392,8 @@ class SkyServeController:
                             self._handle_lb_sync)
         app.router.add_post('/controller/update_service',
                             self._handle_update_service)
+        app.router.add_post('/controller/rolling_update',
+                            self._handle_rolling_update)
         app.router.add_post('/controller/terminate',
                             self._handle_terminate)
         app.router.add_get('/controller/status', self._handle_status)

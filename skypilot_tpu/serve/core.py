@@ -300,6 +300,7 @@ def status(service_names: Optional[List[str]] = None
             'status': r.status,
             'endpoint': r.endpoint,
             'version': r.version,
+            'weight_version': getattr(r, 'weight_version', 1),
             'use_spot': r.use_spot,
             'stats': r.stats,
             'pid': r.pid,
@@ -312,6 +313,9 @@ def status(service_names: Optional[List[str]] = None
             'version': svc['version'],
             'endpoint': f'http://127.0.0.1:{svc["lb_port"]}',
             'replicas': replicas,
+            # Active/last rolling weight update (docs/robustness.md
+            # "Zero-downtime rollouts"); None outside rollouts.
+            'rollout': serve_state.get_rollout(svc['name']),
         })
     return out
 

@@ -268,6 +268,20 @@ class FleetTelemetry:
             lines.extend(chunk)
         return '\n'.join(lines) + ('\n' if lines else '')
 
+    def alerts_firing(self) -> 'List[str]':
+        """Classes whose SLO burn-rate alert is currently firing — the
+        health signal a rolling weight update's bake window watches
+        (docs/robustness.md "Zero-downtime rollouts"). Never raises:
+        an evaluator hiccup reads as 'no alert', the same no-raise
+        contract every other fleet read has."""
+        try:
+            report = self.evaluator.evaluate(self._clock())
+            return sorted(cls for cls, blk in report.items()
+                          if isinstance(blk, dict) and blk.get('alert'))
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('alert evaluation failed')
+            return []
+
     def front_door(self, now: Optional[float] = None
                    ) -> Dict[str, Dict[str, Any]]:
         """Per-LB front-door health from the latest scraped samples:

@@ -155,6 +155,12 @@ class LBState:
     # cache-affinity routing (ROADMAP item 2).
     replica_prefix_cache: Dict[str, dict] = dataclasses.field(
         default_factory=dict)
+    # Per-replica serving weight version from the controller sync —
+    # surfaced as skyt_lb_replica_weight_version{replica} so mixed-
+    # version windows during a rolling weight update are visible at
+    # the front door (docs/robustness.md "Zero-downtime rollouts").
+    replica_weight_version: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
     # time.monotonic() of the last successful controller sync; 0.0 =
     # never synced (fresh process: nothing to be stale ABOUT).
     synced_at: float = 0.0
@@ -171,16 +177,27 @@ class LBState:
                            'replica_qos': self.replica_qos,
                            'replica_prefix_cache':
                                self.replica_prefix_cache,
+                           'replica_weight_version':
+                               self.replica_weight_version,
                            'age_s': round(self.age_s(), 3),
                            'version': self.version})
 
     @classmethod
     def from_json(cls, text: str) -> 'LBState':
         d = json.loads(text)
+        raw_wv = d.get('replica_weight_version') or {}
+        wv: Dict[str, int] = {}
+        if isinstance(raw_wv, dict):
+            for rep, v in raw_wv.items():
+                try:
+                    wv[str(rep)] = int(v)
+                except (TypeError, ValueError):
+                    continue
         state = cls(
             ready_replicas=[str(r) for r in d.get('ready_replicas', [])],
             replica_qos=d.get('replica_qos') or {},
             replica_prefix_cache=d.get('replica_prefix_cache') or {},
+            replica_weight_version=wv,
             version=int(d.get('version', 0)))
         # Imported snapshots carry an age, not a foreign monotonic
         # stamp (monotonic clocks don't transfer between processes).
@@ -459,9 +476,15 @@ class SkyServeLoadBalancer:
                               f'http://127.0.0.1:{port}').rstrip('/')
         raw_peers = peers if peers is not None else \
             (env.get('SKYT_LB_PEER_URLS') or '').split(',')
-        self.peers = [p for p in
-                      (q.strip().rstrip('/') for q in raw_peers)
-                      if p and p != self.advertise_url]
+        cleaned = [q.strip().rstrip('/') for q in raw_peers]
+        self.peers = [p for p in cleaned
+                      if p and p != 'auto' and p != self.advertise_url]
+        # Peer discovery (docs/serving.md "N-active front door"): the
+        # literal peer 'auto' asks this LB to learn its tier-mates'
+        # advertise URLs from the controller's registered-LB list on
+        # every sync, instead of a hand-maintained --lb-peers list.
+        # An explicit manual list always wins (discovery stays off).
+        self.peer_discovery = 'auto' in cleaned and not self.peers
         # Stale-mode health probing uses the SERVICE's readiness
         # contract (serve/service.py passes spec.readiness_path /
         # post_data / probe timeout) — probing a path the replicas
@@ -553,6 +576,14 @@ class SkyServeLoadBalancer:
             'Prefix-cache occupancy fraction of each ready replica '
             '(cached pages / pool pages, from the controller sync)',
             ('lb', 'replica'))
+        # Serving weight version per replica (controller sync + LB<->LB
+        # gossip): the front door's view of mixed-version windows
+        # during rolling weight updates.
+        self._m_weight_version = reg.gauge(
+            'skyt_lb_replica_weight_version',
+            'Weight version each ready replica is serving (from the '
+            'controller sync; mixed values = a rolling update is in '
+            'its canary/bake window)', ('lb', 'replica'))
         # Control-plane crash tolerance: the synced world view lives in
         # one LBState snapshot; on sync failure the LB serves from the
         # stale snapshot (bounded by SKYT_LB_STALE_TTL_S, with its own
@@ -696,13 +727,23 @@ class SkyServeLoadBalancer:
                     ready = data.get('ready_replica_urls', [])
                     rq = data.get('replica_qos')
                     rpc = data.get('replica_prefix_cache')
+                    raw_wv = data.get('replica_weight_versions')
+                    wv: Dict[str, int] = {}
+                    if isinstance(raw_wv, dict):
+                        for rep, v in raw_wv.items():
+                            try:
+                                wv[str(rep)] = int(v)
+                            except (TypeError, ValueError):
+                                continue
                     self.apply_state(LBState(
                         ready_replicas=list(ready),
                         replica_qos=rq if isinstance(rq, dict) else {},
                         replica_prefix_cache=rpc
                         if isinstance(rpc, dict) else {},
+                        replica_weight_version=wv,
                         synced_at=time.monotonic(),
                         version=self.state.version + 1))
+                    self._discover_peers(data.get('lbs'))
             except Exception as e:  # pylint: disable=broad-except
                 logger.warning('controller sync failed: %s', e)
                 self.request_timestamps = ts + self.request_timestamps
@@ -736,6 +777,14 @@ class SkyServeLoadBalancer:
             if isinstance(occ, (int, float)):
                 self._m_prefix_cache.labels(self.lb_id,
                                             replica).set(float(occ))
+        # Weight-version gauges track the snapshot the same way.
+        for key in self._m_weight_version.label_keys():
+            if key[0] == self.lb_id and \
+                    key[1] not in state.replica_weight_version:
+                self._m_weight_version.remove_labels(*key)
+        for replica, wv in state.replica_weight_version.items():
+            self._m_weight_version.labels(self.lb_id,
+                                          replica).set(int(wv))
         if source != 'controller':
             return
         if self._stale:
@@ -746,6 +795,25 @@ class SkyServeLoadBalancer:
         self._stale_probe_fails.clear()
         self._m_stale.labels(self.lb_id).set(0)
         self._m_stale_age.labels(self.lb_id).set(0.0)
+
+    def _discover_peers(self, lbs) -> None:
+        """Adopt the controller's registered-LB list as this LB's peer
+        set (docs/serving.md "N-active front door"): with
+        peer-discovery on (peers given as the literal 'auto'), every
+        successful sync refreshes the tier membership — an LB joining
+        or leaving propagates within one sync+registration interval,
+        with no hand-maintained --lb-peers lists. Manual peer lists
+        keep discovery off entirely."""
+        if not self.peer_discovery or not isinstance(lbs, dict):
+            return
+        discovered = sorted({
+            str(url).rstrip('/') for lid, url in lbs.items()
+            if url and str(lid) != self.lb_id and
+            str(url).rstrip('/') != self.advertise_url})
+        if discovered != sorted(self.peers):
+            logger.info('peer discovery: tier is now %s (was %s)',
+                        discovered, self.peers)
+            self.peers = discovered
 
     def _apply_ring_weights(self, state: 'LBState') -> None:
         """Feed per-replica prefix-cache occupancy to the policy as
@@ -771,6 +839,8 @@ class SkyServeLoadBalancer:
             ready_replicas=list(self.policy.ready_replicas),
             replica_qos=dict(self.state.replica_qos),
             replica_prefix_cache=dict(self.state.replica_prefix_cache),
+            replica_weight_version=dict(
+                self.state.replica_weight_version),
             synced_at=self.state.synced_at,
             version=self.state.version)
 
@@ -1070,6 +1140,7 @@ class SkyServeLoadBalancer:
             ready_replicas=list(best.ready_replicas),
             replica_qos=dict(best.replica_qos),
             replica_prefix_cache=dict(best.replica_prefix_cache),
+            replica_weight_version=dict(best.replica_weight_version),
             synced_at=best.synced_at,
             version=best.version), source='peer')
 
@@ -1608,7 +1679,10 @@ class SkyServeLoadBalancer:
             self._session = aiohttp.ClientSession()
             self._sync_task = asyncio.create_task(
                 self._sync_with_controller())
-            if self.peers:
+            if self.peers or self.peer_discovery:
+                # Discovery mode starts the loop with an empty peer
+                # set; the first successful sync fills it from the
+                # controller's registered-LB list.
                 self._gossip_task = asyncio.create_task(
                     self._gossip_loop())
 

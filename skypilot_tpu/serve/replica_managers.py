@@ -49,6 +49,55 @@ def _relaunch_backoff_bounds() -> 'tuple[float, float]':
             env.get_float('SKYT_SERVE_RELAUNCH_BACKOFF_MAX_S', 120))
 
 
+def _rollout_bake_s() -> float:
+    return env.get_float('SKYT_ROLLOUT_BAKE_S', 30.0)
+
+
+def _rollout_retries() -> int:
+    return env.get_int('SKYT_ROLLOUT_RETRIES', 3, minimum=1)
+
+
+# Rolling-update phases (docs/robustness.md "Zero-downtime rollouts").
+# Active phases are ticked by the control loop; terminal ones are kept
+# (persisted) for status surfaces only.
+ROLLOUT_ACTIVE_PHASES = ('canary', 'bake', 'rollout', 'rollback')
+ROLLOUT_PHASES = ROLLOUT_ACTIVE_PHASES + ('done', 'rolled_back')
+
+
+@dataclasses.dataclass
+class RolloutState:
+    """One rolling in-place weight update, JSON-persisted on the
+    service row (serve_state.set_rollout) after every transition so a
+    controller crash mid-rollout resumes (phase 'rollout'/'rollback')
+    or conservatively rolls back (phase 'canary'/'bake' — the bake
+    observations died with the old process)."""
+    phase: str
+    target_version: int            # spec version being rolled TO
+    baseline_version: int          # spec version rolled FROM
+    checkpoint: str                # target weights (spec.weights)
+    baseline_checkpoint: Optional[str]
+    spec_config: dict              # new spec yaml config (commit input)
+    task_yaml: str
+    started_at: float
+    canary: Optional[int] = None   # replica id
+    updated: List[int] = dataclasses.field(default_factory=list)
+    bake_until: float = 0.0
+    fails: int = 0                 # consecutive per-replica failures
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> 'RolloutState':
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    @property
+    def active(self) -> bool:
+        return self.phase in ROLLOUT_ACTIVE_PHASES
+
+
 @dataclasses.dataclass
 class ReplicaInfo:
     """Reference: sky/serve/replica_managers.py:382."""
@@ -80,6 +129,11 @@ class ReplicaInfo:
     # Set when the replica reached a terminal/preempted state; the
     # serve_state.prune_terminal_replicas sweep keys on it.
     terminal_at: Optional[float] = None
+    # Weight version the replica is serving (in-place swaps bump it
+    # without touching `version`, the SPEC version — mixed-version
+    # windows during a rollout are visible here, in /controller/status,
+    # and through the LB sync as skyt_lb_replica_weight_version).
+    weight_version: int = 1
 
     @property
     def is_alive(self) -> bool:
@@ -95,7 +149,8 @@ class ReplicaInfo:
 # older build come back WITHOUT the newer attributes. Backfill them so
 # adoption logic never needs getattr() guards.
 _PICKLE_BACKFILL = {'stats': None, 'pid': None, 'pid_start': None,
-                    'adopted_at': None, 'terminal_at': None}
+                    'adopted_at': None, 'terminal_at': None,
+                    'weight_version': 1}
 
 
 def backfill(info: 'ReplicaInfo') -> 'ReplicaInfo':
@@ -153,6 +208,20 @@ class ReplicaManager:
             'skyt_serve_replica_reaps_total',
             'Persisted replicas reaped as orphans by a restarting '
             'controller', ('service', 'reason'))
+        # Rolling in-place weight updates (docs/robustness.md
+        # "Zero-downtime rollouts").
+        self._m_rollout_state = reg.gauge(
+            'skyt_serve_rollout_state',
+            'Rolling weight update state (1 on the current phase, 0 '
+            'elsewhere)', ('service', 'phase'))
+        self._m_rollout_swaps = reg.counter(
+            'skyt_serve_rollout_swaps_total',
+            'Per-replica /admin/weights calls made by the rollout '
+            'orchestrator, by result', ('service', 'result'))
+        self._m_rollouts = reg.counter(
+            'skyt_serve_rollouts_total',
+            'Rolling weight updates finished, by outcome',
+            ('service', 'outcome'))
         # Relaunch backoff: repeated replica failures (probe-failure ->
         # FAILED -> reconcile relaunch) back off exponentially instead
         # of tight-looping launches against a broken image/config; any
@@ -171,7 +240,27 @@ class ReplicaManager:
         self._next_id = max(self.replicas, default=0) + 1
         self._threads: Dict[int, threading.Thread] = {}
         self._lock = threading.RLock()
+        # Per-service bearer token: the replica admin API credential
+        # (exported to replicas as SKYT_ADMIN_TOKEN at launch, carried
+        # on the orchestrator's /admin/weights calls).
+        svc = serve_state.get_service(service_name)
+        self._admin_token: Optional[str] = \
+            svc.get('auth_token') if svc else None
+        # Injectable for tests: (info, payload) -> (ok, error | None).
+        self._swap_fn = self._swap_replica_http
+        # Restart-safe rollout state: loaded BEFORE restart adoption so
+        # the orphan check can recognize versions a crashed rollout
+        # legitimately left behind (composes with PR 7 adoption).
+        self._rollout: Optional[RolloutState] = None
+        raw = serve_state.get_rollout(service_name)
+        if raw is not None:
+            try:
+                self._rollout = RolloutState.from_dict(raw)
+            except TypeError:
+                logger.warning('persisted rollout state unreadable; '
+                               'ignoring: %r', raw)
         self._reconcile_restart()
+        self._resume_rollout()
 
     # ------------------------------------------------- restart adoption
     def _reconcile_restart(self) -> None:
@@ -269,7 +358,19 @@ class ReplicaManager:
         except faults.FaultError:
             return 'fault_injected'
         if info.version != self.version:
-            return 'stale_spec_version'
+            # Mid-rollout crash windows legitimately leave replicas
+            # one version AHEAD of the committed spec (the commit
+            # orders replica rows before the spec row): a replica
+            # whose version matches the recorded rollout's baseline
+            # or target is part of that rollout, not an orphan —
+            # reaping it would relaunch a healthy replica the resume
+            # logic is about to reconcile.
+            with self._lock:
+                ro = self._rollout
+            if not (ro is not None and
+                    info.version in (ro.baseline_version,
+                                     ro.target_version)):
+                return 'stale_spec_version'
         if cluster_state.get_cluster(info.cluster_name) is None:
             return 'cluster_gone'
         if info.pid is not None:
@@ -351,7 +452,12 @@ class ReplicaManager:
                 version=self.version,
                 status=serve_state.ReplicaStatus.PROVISIONING,
                 use_spot=bool(use_spot),
-                launched_at=time.time())
+                launched_at=time.time(),
+                # The launch env exports the spec's CURRENT weights
+                # (SKYT_WEIGHTS_CHECKPOINT), so the replica boots on
+                # the committed version — not the task's original
+                # checkpoint from version 1.
+                weight_version=self.version)
             self.replicas[rid] = info
             self._save(info)
             self._m_launches.labels(self.service_name).inc()
@@ -367,6 +473,17 @@ class ReplicaManager:
             task = self._load_task()
             port = self._replica_port(task)
             task.envs['SKYT_REPLICA_PORT'] = str(port)
+            # Weight-rollout plumbing (docs/robustness.md "Zero-
+            # downtime rollouts"): the service token doubles as the
+            # replica admin-API credential, and the spec's CURRENT
+            # weights checkpoint rides along so replicas launched
+            # mid/post-rollout boot on what the fleet is serving.
+            if self._admin_token and \
+                    'SKYT_ADMIN_TOKEN' not in task.envs:
+                task.envs['SKYT_ADMIN_TOKEN'] = self._admin_token
+            weights = getattr(self.spec, 'weights', None)
+            if weights and 'SKYT_WEIGHTS_CHECKPOINT' not in task.envs:
+                task.envs['SKYT_WEIGHTS_CHECKPOINT'] = weights
             if info.use_spot:
                 for res in task.resources:
                     res.use_spot = True  # spot overflow replicas
@@ -697,6 +814,310 @@ class ReplicaManager:
         self.task_yaml = task_yaml
         self.version = version
 
+    # ------------------------------------- rolling in-place weight update
+    def start_rolling_update(self, spec: 'spec_lib.ServiceSpec',
+                             task_yaml: str, version: int) -> dict:
+        """Begin a canaried in-place weight rollout to `spec.weights`
+        (docs/robustness.md "Zero-downtime rollouts"). The spec/
+        version commit is DEFERRED to rollout completion — until then
+        every replica keeps its baseline spec version, so a controller
+        crash at any point restarts into a consistent adoption view.
+        Raises if a rollout is already active."""
+        assert spec.weights, 'rolling update requires spec.weights'
+        with self._lock:
+            if self._rollout is not None and self._rollout.active:
+                raise exceptions.SkyTpuError(
+                    f'a rolling update to version '
+                    f'{self._rollout.target_version} is already in '
+                    f'progress (phase {self._rollout.phase})')
+            self._rollout = RolloutState(
+                phase='canary',
+                target_version=int(version),
+                baseline_version=self.version,
+                checkpoint=spec.weights,
+                baseline_checkpoint=getattr(self.spec, 'weights',
+                                            None),
+                spec_config=spec.to_yaml_config(),
+                task_yaml=task_yaml,
+                started_at=time.time())
+        self._save_rollout()
+        logger.info('rolling update started: v%d -> v%d (weights %s)',
+                    self.version, version, spec.weights)
+        return self.rollout_status()
+
+    def _resume_rollout(self) -> None:
+        """Recover a rollout a dead controller left behind: 'rollout'
+        and 'rollback' phases resume exactly where they stopped (the
+        updated-set is persisted per transition); 'canary'/'bake'
+        conservatively roll back — the bake-window observations died
+        with the old process, and re-baking a canary nobody watched is
+        how bad weights reach a fleet."""
+        with self._lock:
+            ro = self._rollout
+        if ro is None or not ro.active:
+            return
+        if ro.phase in ('canary', 'bake'):
+            ro.error = (f'controller restarted during {ro.phase}; '
+                        f'rolling back')
+            ro.phase = 'rollback'
+            logger.warning('resumed rollout v%d: %s',
+                           ro.target_version, ro.error)
+        else:
+            logger.info('resumed rollout v%d in phase %s '
+                        '(%d replica(s) updated)', ro.target_version,
+                        ro.phase, len(ro.updated))
+        self._save_rollout()
+
+    def _save_rollout(self) -> None:
+        with self._lock:
+            ro = self._rollout
+        serve_state.set_rollout(self.service_name,
+                                ro.to_dict() if ro is not None
+                                else None)
+        for phase in ROLLOUT_PHASES:
+            self._m_rollout_state.labels(self.service_name, phase).set(
+                1 if (ro is not None and ro.phase == phase) else 0)
+
+    def rollout_status(self) -> Optional[dict]:
+        with self._lock:
+            ro = self._rollout
+        if ro is None:
+            return None
+        out = ro.to_dict()
+        out.pop('spec_config', None)   # bulky; not a status surface
+        return out
+
+    def _swap_replica_http(self, info: ReplicaInfo,
+                           payload: dict) -> 'tuple[bool, Optional[str]]':
+        """One POST /admin/weights against a replica (the injectable
+        default of self._swap_fn)."""
+        if not info.endpoint:
+            return False, 'replica has no endpoint'
+        headers = {}
+        if self._admin_token:
+            headers['Authorization'] = f'Bearer {self._admin_token}'
+        try:
+            resp = requests.post(
+                info.endpoint + '/admin/weights', json=payload,
+                headers=headers,
+                timeout=env.get_float('SKYT_ROLLOUT_SWAP_TIMEOUT_S',
+                                      180.0))
+            if resp.status_code == 200:
+                return True, None
+            try:
+                msg = resp.json().get('error', '')
+            except ValueError:
+                msg = resp.text[:200]
+            return False, f'HTTP {resp.status_code}: {msg}'
+        except requests.RequestException as e:
+            return False, str(e)
+
+    def _rollout_candidates(self, ro: RolloutState) -> List[ReplicaInfo]:
+        """READY replicas not yet swapped, lowest id first (stable
+        canary choice)."""
+        with self._lock:
+            return sorted(
+                (r for r in self.replicas.values()
+                 if r.status is serve_state.ReplicaStatus.READY and
+                 r.endpoint and r.replica_id not in ro.updated),
+                key=lambda r: r.replica_id)
+
+    def _rollout_unhealthy(self, ro: RolloutState) -> Optional[str]:
+        """Why the bake looks bad (None = healthy): the canary must
+        still be READY, and the PR 8 SLO plane must not be burning
+        error budget anywhere in the fleet."""
+        if ro.canary is not None:
+            info = self.replicas.get(ro.canary)
+            if info is None or \
+                    info.status is not serve_state.ReplicaStatus.READY:
+                return (f'canary replica {ro.canary} left READY '
+                        f'({info.status.value if info else "gone"})')
+        if self._telemetry is not None:
+            firing = self._telemetry.alerts_firing()
+            if firing:
+                return ('SLO burn-rate alert firing for class(es) '
+                        + ', '.join(firing))
+        return None
+
+    def _swap_one(self, ro: RolloutState, info: ReplicaInfo) -> bool:
+        """Swap one replica to the target weights; True on success."""
+        ok, err = self._swap_fn(info, {'checkpoint': ro.checkpoint,
+                                       'version': ro.target_version})
+        if ok:
+            self._m_rollout_swaps.labels(self.service_name, 'ok').inc()
+            ro.updated.append(info.replica_id)
+            ro.fails = 0
+            info.weight_version = ro.target_version
+            self._save(info)
+            logger.info('rollout v%d: replica %d swapped in place',
+                        ro.target_version, info.replica_id)
+            return True
+        self._m_rollout_swaps.labels(self.service_name, 'error').inc()
+        ro.fails += 1
+        ro.error = f'replica {info.replica_id} swap failed: {err}'
+        logger.warning('rollout v%d: %s (consecutive fails: %d)',
+                       ro.target_version, ro.error, ro.fails)
+        return False
+
+    def rollout_tick(self) -> None:
+        """One state-machine step of the active rollout — called from
+        the controller's control loop each pass, persisted after every
+        transition (restart-safe). Phases: canary (swap one replica)
+        -> bake (watch SLO burn + canary health for
+        SKYT_ROLLOUT_BAKE_S) -> rollout (one replica per tick) ->
+        done; any failure or unhealthy bake -> rollback (swap back
+        every updated replica, newest first) -> rolled_back."""
+        with self._lock:
+            ro = self._rollout
+        if ro is None or not ro.active:
+            return
+        before = (ro.phase, list(ro.updated), ro.fails, ro.error)
+        if ro.phase == 'canary':
+            self._tick_canary(ro)
+        elif ro.phase == 'bake':
+            self._tick_bake(ro)
+        elif ro.phase == 'rollout':
+            self._tick_rollout(ro)
+        elif ro.phase == 'rollback':
+            self._tick_rollback(ro)
+        # Persist on ANY field delta — fails/error included, so a
+        # controller crash mid-retry resumes with the true
+        # consecutive-failure count instead of re-granting the full
+        # SKYT_ROLLOUT_RETRIES budget to a wedged replica.
+        if (ro.phase, ro.updated, ro.fails, ro.error) != before:
+            self._save_rollout()
+
+    def _tick_canary(self, ro: RolloutState) -> None:
+        cand = self._rollout_candidates(ro)
+        if not cand:
+            return          # nothing READY yet; try next tick
+        info = cand[0]
+        ro.canary = info.replica_id
+        if self._swap_one(ro, info):
+            ro.bake_until = time.time() + _rollout_bake_s()
+            ro.phase = 'bake'
+            logger.info('rollout v%d: canary %d baking for %.0fs',
+                        ro.target_version, info.replica_id,
+                        _rollout_bake_s())
+        else:
+            # The canary is THE blast-radius bound: any failure —
+            # validation reject, injected weights.swap fault, timeout
+            # — aborts the whole rollout before a second replica is
+            # touched.
+            ro.phase = 'rollback'
+
+    def _tick_bake(self, ro: RolloutState) -> None:
+        bad = self._rollout_unhealthy(ro)
+        if bad is not None:
+            ro.error = f'bake failed: {bad}'
+            logger.warning('rollout v%d: %s -> rolling back',
+                           ro.target_version, ro.error)
+            ro.phase = 'rollback'
+            return
+        if time.time() >= ro.bake_until:
+            ro.phase = 'rollout'
+            logger.info('rollout v%d: bake clean; proceeding '
+                        'fleet-wide', ro.target_version)
+
+    def _tick_rollout(self, ro: RolloutState) -> None:
+        bad = self._rollout_unhealthy(ro)
+        if bad is not None:
+            ro.error = f'rollout halted: {bad}'
+            logger.warning('rollout v%d: %s -> rolling back',
+                           ro.target_version, ro.error)
+            ro.phase = 'rollback'
+            return
+        cand = self._rollout_candidates(ro)
+        if cand:
+            # One replica per tick: capacity dips by at most one
+            # swap's drain at a time, and every tick re-reads health.
+            if not self._swap_one(ro, cand[0]) and \
+                    ro.fails >= _rollout_retries():
+                ro.phase = 'rollback'
+            return
+        # No READY stragglers: wait for any replica still coming up
+        # (it will boot on the baseline weights and get swapped here),
+        # commit once the whole alive fleet is on the target.
+        with self._lock:
+            pending = [r for r in self.replicas.values()
+                       if r.is_alive and
+                       r.replica_id not in ro.updated]
+        if pending:
+            return
+        self._commit_rollout(ro)
+
+    def _commit_rollout(self, ro: RolloutState) -> None:
+        """Every alive replica serves the target weights: make the new
+        spec/version durable. Ordering matters for crash windows:
+        replica rows first, then the spec row, then the terminal
+        rollout phase — at every intermediate point a restarting
+        controller adopts (the orphan check recognizes the rollout's
+        baseline/target versions) and the resumed 'rollout' phase
+        re-runs this commit idempotently."""
+        new_spec = spec_lib.ServiceSpec.from_yaml_config(
+            dict(ro.spec_config))
+        with self._lock:
+            for info in self.replicas.values():
+                if info.is_alive:
+                    info.version = ro.target_version
+                    info.weight_version = ro.target_version
+                    self._save(info)
+        serve_state.set_service_spec(self.service_name, new_spec,
+                                     ro.task_yaml, ro.target_version)
+        self.update_version(new_spec, ro.task_yaml, ro.target_version)
+        ro.phase = 'done'
+        self._m_rollouts.labels(self.service_name, 'done').inc()
+        logger.info('rollout v%d: committed — fleet on %s with zero '
+                    'relaunches', ro.target_version, ro.checkpoint)
+
+    def _tick_rollback(self, ro: RolloutState) -> None:
+        """Swap every updated replica back to the baseline weights,
+        newest first (the canary — most likely already degraded — goes
+        last-in-first-out). A replica that refuses to swap back after
+        SKYT_ROLLOUT_RETRIES attempts is drained and relaunched: the
+        spec was never committed, so reconcile brings it back on the
+        baseline."""
+        while ro.updated:
+            rid = ro.updated[-1]
+            info = self.replicas.get(rid)
+            if info is None or not info.is_alive:
+                ro.updated.pop()   # gone; nothing to roll back
+                continue
+            ok, err = self._swap_fn(info, {'swap_back': True})
+            if ok:
+                self._m_rollout_swaps.labels(self.service_name,
+                                             'rollback_ok').inc()
+                ro.updated.pop()
+                ro.fails = 0
+                info.weight_version = ro.baseline_version
+                self._save(info)
+                logger.info('rollout v%d: replica %d rolled back',
+                            ro.target_version, rid)
+                continue
+            self._m_rollout_swaps.labels(self.service_name,
+                                         'rollback_error').inc()
+            ro.fails += 1
+            logger.warning('rollout v%d: replica %d swap-back failed '
+                           '(%d/%d): %s', ro.target_version, rid,
+                           ro.fails, _rollout_retries(), err)
+            if ro.fails >= _rollout_retries():
+                # Last resort: relaunch puts it back on the baseline
+                # (spec never committed). Still zero impact on the
+                # replicas that rolled back in place.
+                logger.warning('rollout v%d: draining replica %d for '
+                               'relaunch on the baseline', ro.target_version, rid)
+                self.terminate_replica(rid, drain=True)
+                ro.updated.pop()
+                ro.fails = 0
+            return   # failed attempt: retry/escalate next tick
+        ro.phase = 'rolled_back'
+        self._m_rollouts.labels(self.service_name,
+                                'rolled_back').inc()
+        logger.warning('rollout v%d: rolled back fleet-wide (%s); '
+                       'serving baseline v%d', ro.target_version,
+                       ro.error or 'unspecified failure',
+                       ro.baseline_version)
+
     # ------------------------------------------------------------- views
     def ready_urls(self) -> List[str]:
         with self._lock:
@@ -716,6 +1137,18 @@ class ReplicaManager:
                         isinstance(r.stats.get('qos'), dict):
                     out[r.endpoint] = r.stats['qos']
             return out
+
+    def ready_weight_versions(self) -> dict:
+        """endpoint -> serving weight version for READY replicas —
+        synced to the LB (skyt_lb_replica_weight_version) so mixed-
+        version windows during a rollout are visible at the front
+        door."""
+        with self._lock:
+            return {r.endpoint: int(getattr(r, 'weight_version', 1)
+                                    or 1)
+                    for r in self.replicas.values()
+                    if r.status is serve_state.ReplicaStatus.READY and
+                    r.endpoint}
 
     def ready_prefix_cache(self) -> dict:
         """endpoint -> prefix-cache stats block (occupancy, hit/miss

@@ -140,6 +140,11 @@ def _get_db() -> sqlite3.Connection:
                     'ALTER TABLE services ADD COLUMN auth_token TEXT')
             except sqlite3.OperationalError:
                 pass  # column already exists
+            try:  # migrate pre-rollout DBs (restart-safe rollouts)
+                _DB.execute(
+                    'ALTER TABLE services ADD COLUMN rollout TEXT')
+            except sqlite3.OperationalError:
+                pass  # column already exists
             _DB.execute("""
                 CREATE TABLE IF NOT EXISTS replicas (
                     service_name TEXT,
@@ -237,6 +242,42 @@ def set_service_spec(name: str, spec: Any, task_yaml: str,
             'WHERE name=?',
             (pickle.dumps(spec), task_yaml, version, name))
         db.commit()
+
+
+def set_rollout(name: str, state: Optional[Dict[str, Any]]) -> None:
+    """Persist a rolling weight update's state machine (JSON) on the
+    service row — the crash-recovery source of truth: a controller
+    restarting mid-rollout resumes or rolls back from here instead of
+    stranding the fleet half-updated (docs/robustness.md
+    "Zero-downtime rollouts"). None clears it."""
+    db = _get_db()
+    import json
+    with _DB_LOCK:
+        db.execute('UPDATE services SET rollout=? WHERE name=?',
+                   (json.dumps(state) if state is not None else None,
+                    name))
+        db.commit()
+
+
+def get_rollout(name: str) -> Optional[Dict[str, Any]]:
+    """The persisted rollout state, or None (no rollout recorded, or
+    an unreadable blob — which is logged, not raised: a torn rollout
+    row must not wedge a restarting controller)."""
+    db = _get_db()
+    row = db.execute('SELECT rollout FROM services WHERE name=?',
+                     (name,)).fetchone()
+    if row is None or row['rollout'] is None:
+        return None
+    import json
+    try:
+        state = json.loads(row['rollout'])
+        return state if isinstance(state, dict) else None
+    except ValueError:
+        from skypilot_tpu.utils import log_utils
+        log_utils.init_logger(__name__).warning(
+            'rollout state for %s is unreadable; ignoring', name,
+            exc_info=True)
+        return None
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:

@@ -103,7 +103,7 @@ async def _start_lb(service_name: str, svc: dict,
         peers=([p for p in lb_peers.split(',')]
                if lb_peers is not None else None),
         advertise_url=lb_advertise_url)
-    if lb.peers:
+    if lb.peers or lb.peer_discovery:
         return await lb_lib.serve_active(lb)
     lease = lb_lib.LeaderLease(lb_lease_path(service_name))
     runner, _hb = await lb_lib.serve_as_leader(
@@ -203,7 +203,10 @@ def main(argv=None) -> None:
     parser.add_argument('--lb-peers', default=None,
                         help='comma-separated peer LB base URLs; '
                              'presence switches this LB from the '
-                             'lease/standby model to N-active')
+                             'lease/standby model to N-active. The '
+                             "literal 'auto' discovers the tier from "
+                             "the controller's registered-LB list on "
+                             'every sync instead (manual lists win)')
     parser.add_argument('--lb-advertise-url', default=None,
                         help='URL peers and the controller reach this '
                              'LB at (default http://127.0.0.1:<port> — '

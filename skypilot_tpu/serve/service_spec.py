@@ -33,6 +33,12 @@ class ServiceSpec:
     # consistent-hash prompt-prefix routing (docs/serving.md
     # "N-active front door").
     load_balancing_policy: Optional[str] = None
+    # Weights checkpoint the service serves (docs/robustness.md
+    # "Zero-downtime rollouts"). Exported to replicas at launch as
+    # SKYT_WEIGHTS_CHECKPOINT; a spec bump whose ONLY diff is this
+    # field rolls out as an in-place weight hot-swap (canary -> bake
+    # -> fleet, auto-rollback) instead of a drain+relaunch.
+    weights: Optional[str] = None
 
     def __post_init__(self):
         if not self.readiness_path.startswith('/'):
@@ -89,7 +95,25 @@ class ServiceSpec:
         if 'load_balancing_policy' in config:
             kwargs['load_balancing_policy'] = \
                 config['load_balancing_policy']
+        if 'weights' in config:
+            kwargs['weights'] = config['weights']
         return cls(**kwargs)
+
+    def weights_only_diff(self, other: 'ServiceSpec') -> bool:
+        """True when `other` differs from this spec ONLY in the
+        `weights` checkpoint (and actually changes it) — the rolling
+        in-place-swap eligibility test: everything else about the
+        service (probes, replica policy, LB policy) is untouched, so
+        no replica needs a relaunch."""
+        if not isinstance(other, ServiceSpec):
+            return False
+        if other.weights == self.weights or other.weights is None:
+            return False
+        mine = dataclasses.asdict(self)
+        theirs = dataclasses.asdict(other)
+        mine.pop('weights')
+        theirs.pop('weights')
+        return mine == theirs
 
     def to_yaml_config(self) -> Dict[str, Any]:
         probe: Dict[str, Any] = {'path': self.readiness_path}
@@ -114,4 +138,6 @@ class ServiceSpec:
         out = {'readiness_probe': probe, 'replica_policy': policy}
         if self.load_balancing_policy is not None:
             out['load_balancing_policy'] = self.load_balancing_policy
+        if self.weights is not None:
+            out['weights'] = self.weights
         return out

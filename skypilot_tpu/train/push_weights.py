@@ -1,0 +1,189 @@
+"""Push trained weights to a live serving fleet — zero downtime.
+
+The train->serve half of the RL/rollout loop (ROADMAP item 5,
+docs/robustness.md "Zero-downtime rollouts"): publish a checkpoint the
+serving replicas can load, then drive the serve controller's canaried
+in-place rolling update — no replica relaunch, no recompile, no cold
+KV cache. Podracer-style learners (PAPERS.md, 2104.06272) call
+``push()`` after every training burst; the sft/export flow calls the
+CLI once per fine-tune.
+
+Library:
+
+    from skypilot_tpu.train import push_weights
+    out = push_weights.publish_checkpoint(cfg, variables, '/ckpts/v7')
+    state = push_weights.push_to_service('my-svc', out)   # blocks
+
+CLI:
+
+    python -m skypilot_tpu.train.push_weights \
+        --service-name my-svc --checkpoint /ckpts/v7      # wait (default)
+    ... --no-wait                                          # fire and poll later
+    ... --controller-url http://host:port --token T        # without serve.db
+
+Exit code 0 only when the rollout COMMITS (phase 'done'); a rollback
+or failure exits 1 with the rollout's recorded error — a CI step
+pushing weights fails loudly when the canary bounced.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import requests
+
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+TERMINAL_PHASES = ('done', 'rolled_back')
+
+
+class PushError(RuntimeError):
+    """Weight push failed (HTTP error, rollback, or timeout)."""
+
+
+def publish_checkpoint(cfg, variables: Dict[str, Any],
+                       out_dir: str) -> str:
+    """Write a params tree as an HF-format checkpoint the serving
+    replicas' swap loader reads — ATOMICALLY: staged into a sibling
+    tmp dir, then renamed, so a replica that loads mid-publish sees
+    either nothing or a complete checkpoint (the swap validation turns
+    'nothing' into a clean abort)."""
+    from skypilot_tpu.models import weights as weights_lib
+    out_dir = out_dir.rstrip('/')
+    stage = f'{out_dir}.staging-{os.getpid()}'
+    weights_lib.save_hf_checkpoint(cfg, variables, stage)
+    if os.path.isdir(out_dir):
+        # Replace-in-place: rename the old dir aside first (rename
+        # onto a non-empty dir fails on POSIX).
+        old = f'{out_dir}.old-{os.getpid()}'
+        os.rename(out_dir, old)
+        os.rename(stage, out_dir)
+        import shutil
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(stage, out_dir)
+    logger.info('published checkpoint: %s', out_dir)
+    return out_dir
+
+
+def _controller_for(service_name: str) -> 'tuple[str, Optional[str]]':
+    from skypilot_tpu.serve import serve_state
+    svc = serve_state.get_service(service_name)
+    if svc is None:
+        raise PushError(f'service {service_name!r} not in serve state')
+    return (f'http://127.0.0.1:{svc["controller_port"]}',
+            svc.get('auth_token'))
+
+
+def push(controller_url: str, checkpoint: str,
+         token: Optional[str] = None, wait: bool = True,
+         timeout_s: float = 600.0, poll_s: float = 2.0
+         ) -> Dict[str, Any]:
+    """Start a rolling in-place weight update via ``POST
+    /controller/rolling_update`` and (by default) block until it
+    reaches a terminal phase. Returns the final rollout state; raises
+    PushError on HTTP failure, timeout, or a rollout that did not
+    commit."""
+    url = controller_url.rstrip('/')
+    headers = {'Authorization': f'Bearer {token}'} if token else {}
+    try:
+        resp = requests.post(url + '/controller/rolling_update',
+                             json={'checkpoint': checkpoint},
+                             headers=headers, timeout=30)
+    except requests.RequestException as e:
+        raise PushError(f'controller unreachable: {e}') from e
+    if resp.status_code != 200:
+        raise PushError(
+            f'rolling_update HTTP {resp.status_code}: '
+            f'{resp.text[:300]}')
+    body = resp.json()
+    version = body.get('version')
+    logger.info('rolling update to version %s started (%s)', version,
+                checkpoint)
+    if not wait:
+        return body.get('rollout') or {}
+    deadline = time.time() + timeout_s
+    state: Dict[str, Any] = {}
+    while time.time() < deadline:
+        try:
+            status = requests.get(url + '/controller/status',
+                                  headers=headers, timeout=10).json()
+        except (requests.RequestException, ValueError) as e:
+            logger.warning('status poll failed: %s', e)
+            time.sleep(poll_s)
+            continue
+        state = status.get('rollout') or {}
+        if state.get('target_version') == version and \
+                state.get('phase') in TERMINAL_PHASES:
+            break
+        time.sleep(poll_s)
+    else:
+        raise PushError(
+            f'rollout to version {version} not terminal within '
+            f'{timeout_s}s (last phase: {state.get("phase")!r})')
+    if state.get('phase') != 'done':
+        raise PushError(
+            f'rollout to version {version} did not commit: phase '
+            f'{state.get("phase")!r}, error {state.get("error")!r}')
+    logger.info('rollout v%s committed: fleet serving %s with zero '
+                'relaunches', version, checkpoint)
+    return state
+
+
+def push_to_service(service_name: str, checkpoint: str,
+                    wait: bool = True, timeout_s: float = 600.0
+                    ) -> Dict[str, Any]:
+    """push() with the controller URL + bearer token resolved from the
+    local serve state DB (the in-process / same-host caller's path —
+    train/rollout loops, the CLI)."""
+    url, token = _controller_for(service_name)
+    return push(url, checkpoint, token=token, wait=wait,
+                timeout_s=timeout_s)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description='Push a checkpoint to a serving fleet as a '
+                    'canaried in-place rolling update.')
+    parser.add_argument('--checkpoint', required=True,
+                        help='HF-format checkpoint dir the replicas '
+                             'can load (same architecture as the '
+                             'serving model)')
+    parser.add_argument('--service-name', default=None,
+                        help='resolve the controller from the local '
+                             'serve state DB')
+    parser.add_argument('--controller-url', default=None,
+                        help='controller base URL (instead of '
+                             '--service-name)')
+    parser.add_argument('--token', default=None,
+                        help='controller bearer token (with '
+                             '--controller-url)')
+    parser.add_argument('--no-wait', action='store_true',
+                        help='start the rollout and exit without '
+                             'waiting for it to commit')
+    parser.add_argument('--timeout', type=float, default=600.0,
+                        help='seconds to wait for the rollout to '
+                             'reach a terminal phase')
+    args = parser.parse_args(argv)
+    if (args.service_name is None) == (args.controller_url is None):
+        parser.error('exactly one of --service-name or '
+                     '--controller-url is required')
+    try:
+        if args.service_name:
+            url, token = _controller_for(args.service_name)
+        else:
+            url, token = args.controller_url, args.token
+        state = push(url, args.checkpoint, token=token,
+                     wait=not args.no_wait, timeout_s=args.timeout)
+    except PushError as e:
+        print(f'push failed: {e}', file=sys.stderr)
+        sys.exit(1)
+    print(json.dumps(state, indent=2, default=str))
+
+
+if __name__ == '__main__':
+    main()

@@ -295,6 +295,39 @@ _var('SKYT_LB_RING_WEIGHT_OCCUPANCY', 'float', 1.0,
 _var('SKYT_LB_RING_SESSIONS_MAX', 'int', 8192,
      'Sticky-session LRU capacity of the prefix_affinity policy.')
 
+# ------------------------------------------- weight swap / rollouts
+_var('SKYT_SWAP_DRAIN', 'bool', True,
+     'In-place weight swap: drain in-flight requests to the decode-'
+     'tick boundary (finish on the OLD weights) before applying; '
+     '"0" applies at the next boundary and in-flight requests '
+     'continue on the new weights.')
+_var('SKYT_SWAP_TIMEOUT_S', 'float', 120.0,
+     'How long a weight swap waits for the engine to reach an '
+     'applicable tick boundary before aborting (old weights stay '
+     'live).')
+_var('SKYT_ADMIN_TOKEN', 'str', None,
+     'Bearer token guarding the replica admin API (POST '
+     '/admin/weights). Unset disables the route (403); the serve '
+     'controller exports the per-service token to its replicas.',
+     exported=True)
+_var('SKYT_WEIGHTS_CHECKPOINT', 'str', None,
+     'Weights checkpoint override applied at replica startup '
+     '(exported from the service spec\'s `weights:` field, so '
+     'replicas launched mid/post-rollout boot on the current '
+     'weights instead of the task\'s original --checkpoint).',
+     exported=True)
+_var('SKYT_ROLLOUT_BAKE_S', 'float', 30.0,
+     'Canary bake window of a rolling weight update: seconds the '
+     'canary serves the new weights (watched against SLO burn-rate '
+     'alerts and replica health) before the fleet follows.')
+_var('SKYT_ROLLOUT_SWAP_TIMEOUT_S', 'float', 180.0,
+     'Per-replica HTTP timeout of the controller\'s POST '
+     '/admin/weights calls during a rolling update.')
+_var('SKYT_ROLLOUT_RETRIES', 'int', 3,
+     'Consecutive per-replica swap/rollback failures a rolling '
+     'update tolerates before escalating (rollback, then drain+'
+     'relaunch of the stuck replica).')
+
 # ---------------------------------------------------------------- qos
 _var('SKYT_QOS', 'bool', False,
      'Master switch for the QoS plane (admission, DRR, shedding).')

@@ -103,6 +103,11 @@ _SERVICE_SCHEMA = {
             'enum': ['round_robin', 'least_connections',
                      'prefix_affinity'],
         },
+        # Weights checkpoint the service serves (docs/robustness.md
+        # "Zero-downtime rollouts"): a spec bump that changes ONLY
+        # this field rolls out as an in-place weight hot-swap instead
+        # of a drain+relaunch.
+        'weights': {'type': 'string'},
     },
 }
 

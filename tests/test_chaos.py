@@ -676,14 +676,68 @@ def test_lbstate_snapshot_roundtrip():
     state = lb_lib.LBState(
         ready_replicas=['http://r1', 'http://r2'],
         replica_qos={'http://r1': {'level': 2}},
+        replica_weight_version={'http://r1': 2, 'http://r2': 1},
         synced_at=time.monotonic() - 5.0, version=7)
     restored = lb_lib.LBState.from_json(state.to_json())
     assert restored.ready_replicas == state.ready_replicas
     assert restored.replica_qos == state.replica_qos
+    assert restored.replica_weight_version == \
+        state.replica_weight_version
     assert restored.version == 7
     assert 4.0 < restored.age_s() < 7.0
     # Fresh state: nothing to be stale about.
     assert lb_lib.LBState().age_s() == 0.0
+    # Garbage weight versions are dropped, not crashed on.
+    mangled = lb_lib.LBState.from_json(
+        '{"ready_replicas": ["http://r1"], '
+        '"replica_weight_version": {"http://r1": "bogus", '
+        '"http://r2": 4}}')
+    assert mangled.replica_weight_version == {'http://r2': 4}
+
+
+def test_lb_peer_discovery_from_sync(monkeypatch):
+    """`--lb-peers auto`: the tier's advertise URLs come from the
+    controller's registered-LB list on each sync; a manual list keeps
+    discovery off; own URL and own lb_id are filtered out."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    reg = metrics_lib.MetricsRegistry()
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', 18080, metrics_registry=reg,
+        lb_id='lb-me', peers=['auto'])
+    assert lb.peer_discovery and lb.peers == []
+    lb._discover_peers({  # pylint: disable=protected-access
+        'lb-me': 'http://127.0.0.1:18080',        # own id: dropped
+        'lb-b': 'http://h2:18081/',
+        'lb-c': 'http://h3:18082'})
+    assert lb.peers == ['http://h2:18081', 'http://h3:18082']
+    # Membership churn propagates on the next sync.
+    lb._discover_peers({'lb-b': 'http://h2:18081'})  # pylint: disable=protected-access
+    assert lb.peers == ['http://h2:18081']
+    # Garbage payloads are ignored.
+    lb._discover_peers(['not', 'a', 'dict'])  # pylint: disable=protected-access
+    assert lb.peers == ['http://h2:18081']
+    # Manual list: discovery off, sync lists ignored.
+    lb2 = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:1', 18090, metrics_registry=reg,
+        lb_id='lb-2', peers=['http://manual:1'])
+    assert not lb2.peer_discovery
+    lb2._discover_peers({'lb-x': 'http://h9:1'})  # pylint: disable=protected-access
+    assert lb2.peers == ['http://manual:1']
+    # And weight versions land on the per-replica gauge via
+    # apply_state, pruned with the snapshot.
+    lb.apply_state(lb_lib.LBState(
+        ready_replicas=['http://r1'],
+        replica_weight_version={'http://r1': 5},
+        synced_at=time.monotonic()))
+    gauge = reg.gauge('skyt_lb_replica_weight_version', '',
+                      ('lb', 'replica'))
+    assert gauge.value('lb-me', 'http://r1') == 5
+    lb.apply_state(lb_lib.LBState(
+        ready_replicas=['http://r2'],
+        replica_weight_version={'http://r2': 6},
+        synced_at=time.monotonic()))
+    assert ('lb-me', 'http://r1') not in gauge.label_keys()
+    assert gauge.value('lb-me', 'http://r2') == 6
 
 
 def test_lb_stale_mode_serves_and_recovers(monkeypatch):
@@ -2084,3 +2138,313 @@ exit "${{PIPESTATUS[0]}}"
                 pass
         state.reset_db_for_testing()
         jobs_state.reset_db_for_testing()
+
+
+# ===================================== zero-downtime rolling updates
+def _save_debug_checkpoints(tmp_path, seeds=(0, 7, 11)):
+    """HF-format debug-model checkpoints (one per seed) the engine
+    server's swap loader can read."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import weights as weights_lib
+    cfg = _dc.replace(llama.CONFIGS['debug'], max_seq_len=64,
+                      param_dtype='float32', dtype='float32')
+    model = llama.LlamaModel(cfg)
+    zeros = jnp.zeros((1, 8), jnp.int32)
+    out = []
+    for i, seed in enumerate(seeds):
+        params = jax.jit(model.init)(jax.random.PRNGKey(seed), zeros)
+        path = str(tmp_path / f'ckpt_{chr(ord("a") + i)}')
+        weights_lib.save_hf_checkpoint(cfg, params, path)
+        out.append(path)
+    return out
+
+
+_ENGINE_REPLICA = (
+    'python -m skypilot_tpu.infer.server --model debug '
+    '--port "$SKYT_REPLICA_PORT" --num-slots 2 --max-seq-len 64')
+
+
+def _wait_rollout_phase(cport, token, phases, timeout=180):
+    headers = {'Authorization': f'Bearer {token}'}
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = requests.get(
+                f'http://127.0.0.1:{cport}/controller/status',
+                headers=headers, timeout=10).json()
+            ro = last.get('rollout') or {}
+            if ro.get('phase') in phases:
+                return last
+        except requests.RequestException:
+            pass
+        time.sleep(0.3)
+    raise AssertionError(
+        f'rollout never reached {phases}: '
+        f'{(last or {}).get("rollout")}')
+
+
+@pytest.mark.integration
+def test_chaos_rolling_update_canary_rollback(control_plane_env,
+                                              monkeypatch):
+    """THE zero-downtime-rollout drill (docs/robustness.md
+    "Zero-downtime rollouts", validation step 15): 2 REAL engine
+    replicas behind the real controller + an in-process LB.
+
+    Run 1 (unfaulted): a mid-burst rolling update to checkpoint B
+    lands the new weight version fleet-wide — zero client-visible
+    5xx, zero relaunches (the launch counter never ticks past the
+    initial 2), every replica at weight_version 2.
+
+    Run 2 (faulted): `weights.swap=error` armed on checkpoint C — the
+    canary's swap aborts with its old weights intact, the rollout
+    auto-rolls-back, the mid-burst traffic still sees zero 5xx, and
+    the fleet ends on the OLD version with the spec uncommitted."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.train import push_weights
+
+    tmp_path = control_plane_env
+    ckpt_a, ckpt_b, ckpt_c = _save_debug_checkpoints(tmp_path)
+    # Arm the canary-kill for run 2 ONLY: the where= filter keys on
+    # the pushed checkpoint, so run 1 (ckpt_b) is untouched. The env
+    # is inherited by the replica processes at launch.
+    monkeypatch.setenv('SKYT_FAULTS',
+                       f'weights.swap=error,where=checkpoint:{ckpt_c}')
+    monkeypatch.setenv('SKYT_ROLLOUT_BAKE_S', '0.5')
+    task = sky.Task(name='rsvc', run=_ENGINE_REPLICA)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    spec = spec_lib.ServiceSpec(
+        readiness_path='/health', min_replicas=2,
+        initial_delay_seconds=600, probe_timeout_seconds=5,
+        weights=ckpt_a)
+    task.service = spec
+    task_yaml = str(tmp_path / 'rsvc.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(task.to_yaml_config(), f)
+    cport, lport = _free_port(), _free_port()
+    assert serve_state.add_service('rsvc', spec, task_yaml, cport,
+                                   lport)
+    token = serve_state.get_service('rsvc')['auth_token']
+    headers = {'Authorization': f'Bearer {token}'}
+    curl = f'http://127.0.0.1:{cport}'
+
+    ctrl = _spawn_service('rsvc', 'controller')
+    lb = None
+    try:
+        _wait_replicas_ready('rsvc', 2, timeout=420)
+        reg = metrics_lib.MetricsRegistry()
+        lb_port = _free_port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            curl, lb_port, controller_auth=token,
+            metrics_registry=reg)
+        _run_app_bg(lb.make_app(), lb_port)
+        base = f'http://127.0.0.1:{lb_port}'
+        deadline = time.time() + 120
+        while time.time() < deadline and \
+                len(lb.policy.ready_replicas) < 2:
+            time.sleep(0.2)
+        assert len(lb.policy.ready_replicas) == 2
+
+        results = []
+        stop_burst = threading.Event()
+        lock = threading.Lock()
+
+        def burst():
+            i = 0
+            while not stop_burst.is_set():
+                i += 1
+                try:
+                    r = requests.post(
+                        base + '/generate',
+                        json={'tokens': [1 + (i % 5), 2, 3],
+                              'max_tokens': 6},
+                        timeout=120)
+                    code = r.status_code
+                except requests.RequestException as e:
+                    code = f'EXC:{e!r}'
+                with lock:
+                    results.append(code)
+
+        threads = [threading.Thread(target=burst) for _ in range(3)]
+        for th in threads:
+            th.start()
+        try:
+            # ---- run 1: clean rolling update, driven through the
+            # real weight-push client (train/push_weights.py).
+            state = push_weights.push(curl, ckpt_b, token=token,
+                                      wait=True, timeout_s=300)
+            assert state['phase'] == 'done'
+        finally:
+            time.sleep(1.0)     # a little post-rollout traffic
+            stop_burst.set()
+            for th in threads:
+                th.join(timeout=120)
+        with lock:
+            run1 = list(results)
+        assert run1 and all(c == 200 for c in run1), run1[:20]
+        status = requests.get(curl + '/controller/status',
+                              headers=headers, timeout=10).json()
+        assert all(r['weight_version'] == 2 and r['version'] == 2
+                   for r in status['replicas']), status['replicas']
+        # Zero relaunches: the launch counter holds at the initial 2.
+        mtext = requests.get(curl + '/controller/metrics',
+                             headers=headers, timeout=10).text
+        assert 'skyt_serve_replica_launches_total{service="rsvc"} 2' \
+            in mtext, mtext
+        # The LB saw the new version through the sync.
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                set(lb.state.replica_weight_version.values()) != {2}:
+            time.sleep(0.3)
+        assert set(lb.state.replica_weight_version.values()) == {2}
+
+        # ---- run 2: the armed fault kills the canary's swap.
+        results.clear()
+        stop_burst.clear()
+        threads = [threading.Thread(target=burst) for _ in range(3)]
+        for th in threads:
+            th.start()
+        try:
+            resp = requests.post(curl + '/controller/rolling_update',
+                                 json={'checkpoint': ckpt_c},
+                                 headers=headers, timeout=30)
+            assert resp.status_code == 200, resp.text
+            status = _wait_rollout_phase(cport, token,
+                                         ('rolled_back',),
+                                         timeout=240)
+        finally:
+            time.sleep(1.0)
+            stop_burst.set()
+            for th in threads:
+                th.join(timeout=120)
+        with lock:
+            run2 = list(results)
+        assert run2 and all(c == 200 for c in run2), run2[:20]
+        ro = status['rollout']
+        assert ro['phase'] == 'rolled_back'
+        assert 'swap failed' in (ro['error'] or '')
+        # Fleet ends on the OLD version; spec never committed.
+        assert all(r['weight_version'] == 2 and r['version'] == 2
+                   for r in status['replicas']), status['replicas']
+        assert serve_state.get_service('rsvc')['version'] == 2
+        # Still zero relaunches across BOTH runs.
+        mtext = requests.get(curl + '/controller/metrics',
+                             headers=headers, timeout=10).text
+        assert 'skyt_serve_replica_launches_total{service="rsvc"} 2' \
+            in mtext, mtext
+        assert ('skyt_serve_rollouts_total{service="rsvc",'
+                'outcome="done"} 1') in mtext
+        assert ('skyt_serve_rollouts_total{service="rsvc",'
+                'outcome="rolled_back"} 1') in mtext
+    finally:
+        if ctrl.poll() is None:
+            try:
+                requests.post(curl + '/controller/terminate', json={},
+                              headers=headers, timeout=60)
+            except requests.RequestException:
+                pass
+            ctrl.kill()
+        del lb
+
+
+_ADMIN_FAKE_REPLICA = (
+    "python -c \""
+    "import http.server, json, os;\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def _ok(self, body=b'ok'):\n"
+    "        self.send_response(200); self.end_headers();\n"
+    "        self.wfile.write(body)\n"
+    "    def do_GET(self):\n"
+    "        self._ok()\n"
+    "    def do_POST(self):\n"
+    "        n = int(self.headers.get('Content-Length') or 0);\n"
+    "        self.rfile.read(n);\n"
+    "        self._ok(json.dumps({'ok': True}).encode())\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYT_REPLICA_PORT'])), H).serve_forever()\"")
+
+
+@pytest.mark.integration
+def test_chaos_rollout_resume_after_controller_sigkill(
+        control_plane_env, monkeypatch):
+    """Controller SIGKILLed mid-BAKE: the restarted controller adopts
+    both replicas (zero relaunches) AND recovers the persisted
+    rollout — canary/bake observations died with the process, so it
+    conservatively swaps the canary back and lands 'rolled_back' with
+    the baseline spec intact."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    tmp_path = control_plane_env
+    # A bake long enough that the kill lands inside it.
+    monkeypatch.setenv('SKYT_ROLLOUT_BAKE_S', '600')
+    task = sky.Task(name='rrsvc', run=_ADMIN_FAKE_REPLICA)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    spec = spec_lib.ServiceSpec(
+        readiness_path='/', min_replicas=2, initial_delay_seconds=60,
+        probe_timeout_seconds=2, weights=str(tmp_path / 'w1'))
+    task.service = spec
+    task_yaml = str(tmp_path / 'rrsvc.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(task.to_yaml_config(), f)
+    cport = _free_port()
+    assert serve_state.add_service('rrsvc', spec, task_yaml, cport,
+                                   _free_port())
+    token = serve_state.get_service('rrsvc')['auth_token']
+    headers = {'Authorization': f'Bearer {token}'}
+    curl = f'http://127.0.0.1:{cport}'
+
+    ctrl = _spawn_service('rrsvc', 'controller')
+    try:
+        _wait_replicas_ready('rrsvc', 2)
+        resp = requests.post(curl + '/controller/rolling_update',
+                             json={'checkpoint': str(tmp_path / 'w2')},
+                             headers=headers, timeout=30)
+        assert resp.status_code == 200, resp.text
+        _wait_rollout_phase(cport, token, ('bake',), timeout=60)
+        # The chaos event: SIGKILL mid-bake, no cleanup of any kind.
+        ctrl.kill()
+        ctrl.wait(timeout=30)
+        assert serve_state.get_rollout('rrsvc')['phase'] == 'bake'
+
+        ctrl = _spawn_service('rrsvc', 'controller')
+        status = _wait_rollout_phase(cport, token, ('rolled_back',),
+                                     timeout=120)
+        ro = status['rollout']
+        assert 'restarted during bake' in ro['error']
+        assert ro['updated'] == []
+        # Adopted, not relaunched — and back on the baseline.
+        assert all(r['weight_version'] == 1 and r['version'] == 1
+                   for r in status['replicas']), status['replicas']
+        mtext = requests.get(curl + '/controller/metrics',
+                             headers=headers, timeout=10).text
+        assert ('skyt_serve_replica_adoptions_total{service="rrsvc"} '
+                '2') in mtext, mtext
+        assert 'skyt_serve_replica_launches_total{service="rrsvc"}' \
+            not in mtext, mtext
+        assert serve_state.get_service('rrsvc')['version'] == 1
+    finally:
+        if ctrl.poll() is None:
+            try:
+                requests.post(curl + '/controller/terminate', json={},
+                              headers=headers, timeout=60)
+            except requests.RequestException:
+                pass
+            ctrl.kill()

@@ -912,8 +912,13 @@ def test_controller_sync_payload_roundtrip(monkeypatch):
             return {'http://r1': {'occupancy': 0.25,
                                   'cached_pages': 4}}
 
+        def ready_weight_versions(self):
+            return {'http://r1': 3}
+
     class FakeController:
-        pass
+        def registered_lbs(self):
+            return {'lb-a': {'url': 'http://lb-a:8080',
+                             'last_sync': time.time()}}
 
     from skypilot_tpu.serve import controller as controller_lib
     from skypilot_tpu.serve import service_spec as spec_lib
@@ -943,4 +948,9 @@ def test_controller_sync_payload_roundtrip(monkeypatch):
     # skyt_lb_replica_prefix_cache{replica} — ROADMAP item 2 groundwork).
     assert data['replica_prefix_cache']['http://r1']['occupancy'] == \
         0.25
+    # Serving weight versions + the registered-LB list (peer
+    # discovery) ride the same sync (docs/robustness.md
+    # "Zero-downtime rollouts").
+    assert data['replica_weight_versions'] == {'http://r1': 3}
+    assert data['lbs'] == {'lb-a': 'http://lb-a:8080'}
     assert len(ctl.autoscaler._shed_ts) == 1  # pylint: disable=protected-access

@@ -35,6 +35,7 @@ _PRINT_OK_PREFIXES = (
     'skypilot_tpu/jobs/core.py',             # jobs logs CLI surface
     'skypilot_tpu/serve/core.py',            # serve logs CLI surface
     'skypilot_tpu/parallel/collectives.py',  # bench CLI output
+    'skypilot_tpu/train/push_weights.py',    # rollout-state CLI JSON
     'skypilot_tpu/catalog/data_fetchers/',   # fetcher CLI scripts
     'skypilot_tpu/train/examples/',          # example job stdout
 )

@@ -1040,6 +1040,175 @@ else
     FAIL=1
 fi
 
+echo "== 15. rolling-update drill: 2 real engine replicas, a mid-"
+echo "   burst in-place weight rollout (canary -> bake -> fleet) to a"
+echo "   new checkpoint with zero dropped requests and zero"
+echo "   relaunches; then a second rollout with weights.swap=error"
+echo "   armed on the canary's checkpoint -> automatic fleet-wide"
+echo "   rollback, fleet ending on the old version. CPU-verified =="
+if timeout 900 python - <<'PYEOF' 2>&1 | tee "$OUT/rolling_update_drill.txt"
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import requests
+
+os.environ['SKYT_STATE_DIR'] = tempfile.mkdtemp(prefix='skyt-ru-state-')
+os.environ['SKYT_ROLLOUT_BAKE_S'] = '0.5'
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.models import weights as weights_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+tmp = tempfile.mkdtemp(prefix='skyt-ru-ckpt-')
+cfg = dataclasses.replace(llama.CONFIGS['debug'], max_seq_len=64,
+                          param_dtype='float32', dtype='float32')
+model = llama.LlamaModel(cfg)
+zeros = jnp.zeros((1, 8), jnp.int32)
+ckpts = []
+for i, seed in enumerate((0, 7, 11)):
+    params = jax.jit(model.init)(jax.random.PRNGKey(seed), zeros)
+    path = os.path.join(tmp, f'ckpt_{i}')
+    weights_lib.save_hf_checkpoint(cfg, params, path)
+    ckpts.append(path)
+
+spec = spec_lib.ServiceSpec(readiness_path='/health', min_replicas=2,
+                            weights=ckpts[0])
+assert serve_state.add_service('ruv', spec, '/tmp/none.yaml',
+                               free_port(), free_port())
+token = serve_state.get_service('ruv')['auth_token']
+# The canary-kill fault for run 2, keyed on the target checkpoint so
+# run 1 is untouched; inherited by the replica processes at spawn.
+env = dict(os.environ, SKYT_ADMIN_TOKEN=token,
+           SKYT_FAULTS=f'weights.swap=error,where=checkpoint:{ckpts[2]}')
+ports = [free_port(), free_port()]
+procs = [subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--checkpoint', ckpts[0], '--port', str(p),
+     '--num-slots', '2', '--max-seq-len', '64'],
+    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for p in ports]
+urls = [f'http://127.0.0.1:{p}' for p in ports]
+try:
+    for proc, url in zip(procs, urls):
+        deadline = time.time() + 480
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise SystemExit(f'replica died rc={proc.returncode}')
+            try:
+                if requests.get(url + '/health',
+                                timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.5)
+        else:
+            raise SystemExit('replica never became healthy')
+
+    mgr = replica_managers.ReplicaManager('ruv', spec, '/tmp/none.yaml')
+    for rid, url in enumerate(urls, start=1):
+        info = replica_managers.ReplicaInfo(
+            replica_id=rid, cluster_name=f'ruv-{rid}', version=1,
+            status=serve_state.ReplicaStatus.READY, endpoint=url)
+        mgr.replicas[rid] = info
+
+    results = []
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def burst(wid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                code = requests.post(
+                    urls[(wid + i) % 2] + '/generate',
+                    json={'tokens': [wid + 1, (i % 5) + 1, 3],
+                          'max_tokens': 6}, timeout=120).status_code
+            except requests.RequestException as e:
+                code = f'EXC:{e!r}'
+            with lock:
+                results.append(code)
+
+    def drive(target_ckpt, version, want):
+        threads = [threading.Thread(target=burst, args=(w,))
+                   for w in range(2)]
+        stop.clear()
+        results.clear()
+        for th in threads:
+            th.start()
+        try:
+            mgr.start_rolling_update(
+                dataclasses.replace(spec, weights=target_ckpt),
+                '/tmp/none.yaml', version)
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                mgr.rollout_tick()
+                ro = mgr.rollout_status()
+                if ro['phase'] in ('done', 'rolled_back'):
+                    break
+                time.sleep(0.3)
+        finally:
+            time.sleep(0.5)
+            stop.set()
+            for th in threads:
+                th.join(timeout=120)
+        ro = mgr.rollout_status()
+        assert ro['phase'] == want, ro
+        with lock:
+            bad = [c for c in results if c != 200]
+        assert results and not bad, (len(results), bad[:5])
+        return ro, len(results)
+
+    # Run 1: clean rollout to ckpt_1 -> fleet on version 2.
+    ro, n1 = drive(ckpts[1], 2, 'done')
+    wv = {requests.get(u + '/stats', timeout=10).json()['weight_version']
+          for u in urls}
+    assert wv == {2}, wv
+    assert mgr.version == 2
+
+    # Run 2: armed fault kills the canary's swap -> auto-rollback.
+    ro2, n2 = drive(ckpts[2], 3, 'rolled_back')
+    wv = {requests.get(u + '/stats', timeout=10).json()['weight_version']
+          for u in urls}
+    assert wv == {2}, wv                 # fleet ended on the OLD version
+    assert mgr.version == 2              # spec never committed
+    assert 'swap failed' in (ro2['error'] or '')
+    # Zero relaunches anywhere: both server processes never restarted.
+    assert all(p.poll() is None for p in procs)
+    launches = mgr._m_launches.value('ruv')
+    assert not launches, launches
+    print(f'ROLLING_UPDATE_DRILL_OK run1={n1}/{n1} ok -> v2; '
+          f'run2={n2}/{n2} ok, rolled back to v2; relaunches=0')
+finally:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.kill()
+PYEOF
+then
+    echo "== rolling-update drill: PASS =="
+else
+    echo "== rolling-update drill: FAIL (see $OUT/rolling_update_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
