@@ -53,6 +53,7 @@ PHASE_DEADLINES = {
     'kv+ragged bench': 600,
     'watchdog overhead bench': 300,
     'weight swap bench': 480,
+    'comms plane bench': 600,
 }
 
 # The bench's own rank-0 heartbeat (train/heartbeat.py): the train
@@ -1728,6 +1729,179 @@ def watchdog_overhead_metrics() -> list:
     ]
 
 
+# The comms-plane phase runs in a CPU subprocess with 8 forced host
+# devices: the plane is CPU-runnable by design (emulated slices), an
+# 8-way mesh exists regardless of the bench host's chip count, and the
+# probe/census compiles stay out of this process. On-chip comms
+# numbers come from tools/tpu_validation.sh step 16.
+_COMMS_PHASE_SCRIPT = r'''
+import json, sys, time
+
+import jax, jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import comms_census, comms_profile
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import trainer
+
+out = {}
+
+def make_step(mesh, batch, seq):
+    cfg = llama.CONFIGS['debug']
+    model = llama.LlamaModel(cfg)
+    tx = trainer.make_optimizer(trainer.TrainerConfig(
+        warmup_steps=1, total_steps=1000))
+    sample = jnp.zeros((batch, seq), jnp.int32)
+    state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
+                                            jax.random.PRNGKey(0))
+    step = trainer.make_train_step(model, tx, mesh, donate=False)
+    data = {'tokens': sample, 'targets': sample}
+    return step, state, data
+
+def timed_steps(step, state, data, n):
+    s = state
+    t0 = time.perf_counter()
+    for _ in range(n):
+        s, metrics = step(s, data)
+    jax.block_until_ready(metrics['loss'])
+    return time.perf_counter() - t0
+
+# --- probe + census one-shot costs + overhead A/B on the train loop
+mesh = mesh_lib.build_hybrid_mesh(
+    mesh_lib.MeshSpec(fsdp=2, tp=2), mesh_lib.MeshSpec(dp=2),
+    num_slices=2)
+step, state, data = make_step(mesh, 4, 64)
+for _ in range(3):
+    state, m = step(state, data)
+jax.block_until_ready(m['loss'])
+
+t0 = time.perf_counter()
+profile, _src = comms_profile.load_or_probe(
+    mesh, dcn_axes=('dp',), payloads_mb=[0.25], iters=2, force=True)
+out['comms_probe_s'] = round(time.perf_counter() - t0, 3)
+t0 = time.perf_counter()
+entries, source = comms_census.census_step(step, state, data,
+                                           mesh=mesh, mode='compiled')
+rep = comms_census.report(
+    entries, source, profile=profile, dcn_axes=('dp',),
+    link_classes=comms_profile.axis_link_classes(mesh, ('dp',)))
+out['comms_census_s'] = round(time.perf_counter() - t0, 3)
+out['comms_census_sites'] = rep['sites']
+out['comms_census_total_mib'] = round(rep['total_bytes'] / 2**20, 4)
+if rep['total_seconds'] is not None:
+    out['comms_predicted_step_comms_ms'] = round(
+        rep['total_seconds'] * 1e3, 4)
+summ = comms_profile.summary(profile)
+ar = summ.get('ici.all_reduce') or {}
+out['comms_probe_ici_allreduce_busbw_gbps'] = round(
+    ar.get('busbw_gbps', 0.0), 4)
+
+# Overhead: the plane adds no per-step work (census/probe are
+# one-shot, metrics publish at log boundaries) — measure it anyway.
+# Interleaved best-of-3 per mode, publish every 10 steps in ON mode.
+N = 30
+best_off = best_on = float('inf')
+for _ in range(3):
+    best_off = min(best_off, timed_steps(step, state, data, N))
+    t0 = time.perf_counter()
+    s = state
+    for i in range(N):
+        s, metrics = step(s, data)
+        if (i + 1) % 10 == 0:
+            comms_census.publish_metrics(rep, steps=10)
+            comms_profile.publish_profile_metrics(profile)
+    jax.block_until_ready(metrics['loss'])
+    best_on = min(best_on, time.perf_counter() - t0)
+out['comms_plane_overhead_pct'] = round(
+    (best_on - best_off) / best_off * 100.0, 3)
+
+# --- placement A/B: emulated heterogeneous 4-slice mesh. Injected
+# per-pair DCN costs (slow links on (0,3) and (1,2)) make the
+# advisor's win assertable on homogeneous CPU hardware: the predicted
+# DCN ring cost is what differs; the real step-time A/B proves the
+# permuted mesh trains (its links are equal here, so the times should
+# match — the prediction is the measurement on this host).
+HET = {'entries': profile.get('entries', {}), 'dcn_pairs': {
+    '0,1': {'busbw_gbps': 10.0}, '0,2': {'busbw_gbps': 10.0},
+    '0,3': {'busbw_gbps': 1.0}, '1,2': {'busbw_gbps': 1.0},
+    '1,3': {'busbw_gbps': 10.0}, '2,3': {'busbw_gbps': 10.0}}}
+dec = comms_profile.choose_dcn_permutation(4, HET)
+out['comms_placement_perm'] = dec['perm']
+out['comms_placement_ring_score_rowmajor'] = round(
+    dec['rowmajor_score'], 4)
+out['comms_placement_ring_score_measured'] = round(dec['score'], 4)
+out['comms_placement_predicted_speedup'] = round(
+    dec['rowmajor_score'] / max(dec['score'], 1e-12), 3)
+
+ici, dcn = mesh_lib.MeshSpec(tp=2), mesh_lib.MeshSpec(dp=4)
+times = {}
+for name, kwargs in (('rowmajor', {'placement': 'rowmajor'}),
+                     ('measured', {'placement': 'measured',
+                                   'profile': HET})):
+    m = mesh_lib.build_hybrid_mesh(ici, dcn, num_slices=4, **kwargs)
+    st, s0, d0 = make_step(m, 8, 64)
+    for _ in range(2):
+        s0, mm = st(s0, d0)
+    jax.block_until_ready(mm['loss'])
+    times[name] = min(timed_steps(st, s0, d0, 10) for _ in range(2))
+    out[f'comms_placement_steptime_{name}_ms'] = round(
+        times[name] / 10 * 1e3, 3)
+
+print('COMMS_PHASE_JSON ' + json.dumps(out))
+'''
+
+
+def comms_plane_metrics() -> list:
+    """Comms-plane phase (docs/observability.md "Comms plane"),
+    CPU-runnable: probe + census one-shot costs, the train-loop
+    overhead with the plane on vs off (acceptance <=1% — the plane
+    adds no per-step work), and the measured-vs-rowmajor placement
+    A/B on the emulated heterogeneous 4-slice mesh."""
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    flags = env.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        env['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=8').strip()
+    with tempfile.TemporaryDirectory() as d:
+        env['SKYT_COMMS_CACHE'] = os.path.join(d, 'comms_profile.json')
+        proc = subprocess.run(
+            [sys.executable, '-c', _COMMS_PHASE_SCRIPT],
+            capture_output=True, text=True, env=env,
+            timeout=PHASE_DEADLINES['comms plane bench'] - 60)
+    line = next((l for l in proc.stdout.splitlines()
+                 if l.startswith('COMMS_PHASE_JSON ')), None)
+    if proc.returncode != 0 or line is None:
+        tail = (proc.stderr or '').strip().splitlines()[-5:]
+        raise RuntimeError(
+            f'comms phase subprocess rc={proc.returncode}: '
+            f'{" | ".join(tail)}')
+    data = json.loads(line[len('COMMS_PHASE_JSON '):])
+    print(f"# comms plane: probe {data.get('comms_probe_s')}s, census "
+          f"{data.get('comms_census_s')}s "
+          f"({data.get('comms_census_sites')} sites, "
+          f"{data.get('comms_census_total_mib')}MiB/step), overhead "
+          f"{data.get('comms_plane_overhead_pct')}%, placement "
+          f"{data.get('comms_placement_perm')} predicted speedup "
+          f"{data.get('comms_placement_predicted_speedup')}x",
+          file=sys.stderr)
+    unit = {'comms_probe_s': 's', 'comms_census_s': 's',
+            'comms_census_total_mib': 'MiB',
+            'comms_predicted_step_comms_ms': 'ms',
+            'comms_plane_overhead_pct': '%',
+            'comms_probe_ici_allreduce_busbw_gbps': 'GB/s',
+            'comms_placement_predicted_speedup': 'x'}
+    return [
+        {'metric': k,
+         'value': v, 'unit': unit.get(
+             k, 'ms' if k.endswith('_ms') else ''),
+         'vs_baseline': None}
+        for k, v in data.items() if not isinstance(v, list)]
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -2177,6 +2351,18 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# watchdog overhead bench failed: {e!r}', file=sys.stderr)
+
+    # Comms-plane phase: probe/census one-shot costs + train overhead
+    # (acceptance <=1%) + the measured-placement A/B on the emulated
+    # heterogeneous mesh. Runs in its own CPU subprocess (8 forced
+    # host devices), so it is safe on any bench host.
+    try:
+        with phase_deadline(PHASE_DEADLINES['comms plane bench'],
+                            'comms plane bench'):
+            extra = extra + comms_plane_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# comms plane bench failed: {e!r}', file=sys.stderr)
 
     line = {
         'metric': metric_name,

@@ -36,6 +36,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Managed jobs</h2>{jobs}
 <h2>Services</h2>{services}
 <h2>SLO / fleet</h2>{slo}
+<h2>Comms</h2>{comms}
 <h2>Postmortems</h2>{postmortems}
 <h2>Metrics</h2>{metrics}
 <h2>Slowest traces</h2>{traces}
@@ -103,15 +104,13 @@ def _services_html() -> str:
                   rows)
 
 
-def _slo_html() -> str:
-    """Fleet SLO panel: each service's controller answers
-    GET /fleet/slo on its (loopback, bearer-authed) admin port —
-    burn-rate alert state, per-class attainment, and the goodput cost
-    report (docs/observability.md "Fleet plane"). Best-effort and
-    CONCURRENT: controllers are fetched in parallel with a short
-    timeout, so N dead controllers cost one timeout per page render,
-    not N; a dead or pre-fleet controller renders as unreachable,
-    never an error page."""
+def _fetch_controllers(path: str):
+    """Fetch one admin-API path from every service's controller
+    (loopback, bearer-authed). Best-effort and CONCURRENT: controllers
+    are fetched in parallel with a short timeout, so N dead
+    controllers cost one timeout per page render, not N; a dead or
+    pre-fleet controller yields its exception, never an error page.
+    Returns (services, {name: json_dict | Exception})."""
     import concurrent.futures as futures
 
     import requests
@@ -120,7 +119,7 @@ def _slo_html() -> str:
 
     def fetch(svc):
         resp = requests.get(
-            f'http://127.0.0.1:{svc["controller_port"]}/fleet/slo',
+            f'http://127.0.0.1:{svc["controller_port"]}{path}',
             headers={'Authorization':
                      f'Bearer {svc.get("auth_token", "")}'},
             timeout=1.0)
@@ -140,6 +139,14 @@ def _slo_html() -> str:
                     results[name] = fut.result()
                 except Exception as e:  # pylint: disable=broad-except
                     results[name] = e
+    return services, results
+
+
+def _slo_html() -> str:
+    """Fleet SLO panel: each service's controller answers
+    GET /fleet/slo — burn-rate alert state, per-class attainment, and
+    the goodput cost report (docs/observability.md "Fleet plane")."""
+    services, results = _fetch_controllers('/fleet/slo')
     rows = []
     for svc in services:
         name = svc['name']
@@ -162,6 +169,40 @@ def _slo_html() -> str:
                 f'{gtps}' if gtps is not None else '-'])
     return _table(['service', 'class', 'alert', 'attainment (1h)',
                    'burn (5m)', 'good tok/chip-s'], rows)
+
+
+def _comms_html() -> str:
+    """Comms-plane panel: each service's controller answers
+    GET /fleet/comms — probed ICI/DCN link bandwidth and the
+    predicted per-step per-axis comms time from scraped targets
+    (docs/observability.md "Comms plane")."""
+    services, results = _fetch_controllers('/fleet/comms')
+    rows = []
+    for svc in services:
+        name = svc['name']
+        data = results.get(name)
+        if not isinstance(data, dict):
+            rows.append([name, '-', '-', f'unreachable ({data})', '-'])
+            continue
+        for target, info in sorted(data.get('targets', {}).items()):
+            secs = info.get('comm_seconds_estimate') or {}
+            bw = info.get('probe_busbw_gbps') or {}
+            rows.append([
+                name, target,
+                '; '.join(f'{a}={v * 1e3:.2f}ms'
+                          for a, v in sorted(secs.items())) or '-',
+                '; '.join(f'{k}={v:.2f}'
+                          for k, v in sorted(bw.items())[:6]) or '-',
+                '; '.join(f'{a}={v / 2**20:.2f}MiB/s' for a, v in
+                          sorted((info.get('comm_bytes_per_s') or
+                                  {}).items())) or '-'])
+        for topo, summ in sorted((data.get('local_profiles')
+                                  or {}).items()):
+            bw = '; '.join(f'{k}={v["busbw_gbps"]:.2f}'
+                           for k, v in sorted(summ.items())[:6])
+            rows.append([name, f'profile {topo}', '-', bw or '-', '-'])
+    return _table(['service', 'target', 'predicted comms /step',
+                   'probe busbw (GB/s)', 'comm bytes rate'], rows)
 
 
 def _postmortems_html() -> str:
@@ -234,6 +275,7 @@ def _render_page() -> str:
         jobs=_jobs_html(),
         services=_services_html(),
         slo=_slo_html(),
+        comms=_comms_html(),
         postmortems=_postmortems_html(),
         metrics=_metrics_html(),
         traces=_traces_html())

@@ -7,11 +7,19 @@ jitted psum/all-gather/ppermute over a mesh axis, timed after warmup.
 
 Run standalone on any host (real TPU slice or CPU mesh):
     python -m skypilot_tpu.parallel.collectives --axis tp --mb 64
+
+``--json <path>`` additionally writes a structured artifact with the
+PR 6 ``status:`` discipline (``ok | tpu_unreachable |
+backend_init_failed | device_error``) so the multichip harness and
+validation scripts parse results instead of scraping prose. Payloads
+are MiB (2**20 bytes), matching the docs.
 """
 import argparse
+import json
 import os
+import sys
 import time
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 
@@ -39,6 +47,16 @@ def _busbw_factor(op: str, n: int) -> float:
     raise ValueError(f'unknown op {op}')
 
 
+# Public name for the census/estimate consumers (comms_census.py):
+# predicted_time = payload_bytes * busbw_factor(op, n) / busbw.
+busbw_factor = _busbw_factor
+
+# The canonical op set, shared by bench_all, the CLI, and the comms
+# probe sweep (comms_profile.probe_mesh) — one list, no drift.
+DEFAULT_OPS = ('all_reduce', 'all_gather', 'reduce_scatter',
+               'ppermute')
+
+
 def _make_op(op: str, axis: str, mesh: Mesh):
     n = mesh.shape[axis]
 
@@ -62,16 +80,20 @@ def _make_op(op: str, axis: str, mesh: Mesh):
 
 def bench_collective(mesh: Mesh, axis: str, op: str,
                      payload_mb: float = 64.0,
-                     iters: int = 10) -> Dict[str, float]:
+                     iters: int = 10,
+                     clock: Callable[[], float] = time.perf_counter
+                     ) -> Dict[str, float]:
     """Time `op` over `axis`; returns {algbw_gbps, busbw_gbps, time_ms}.
 
-    Payload is the per-device shard size (matching nccl-tests' per-rank
-    message size convention).
+    Payload is the per-device shard size in MiB (matching nccl-tests'
+    per-rank message size convention). `clock` is injectable so the
+    comms-profile probe replays deterministically in tests.
     """
     n = mesh.shape[axis]
     # Round to a multiple of n: psum_scatter(tiled=True) needs the
-    # scattered dimension divisible by the axis size.
-    elems = max(n, int(payload_mb * 1e6 / 4) // n * n)
+    # scattered dimension divisible by the axis size. MiB, not 1e6:
+    # the docs and the profile's payload buckets are power-of-two.
+    elems = max(n, int(payload_mb * (2 ** 20) / 4) // n * n)
     spec = P(axis)
     sharding = NamedSharding(mesh, spec)
     # Materialize directly sharded (jit with out_shardings): a host-side
@@ -93,11 +115,11 @@ def bench_collective(mesh: Mesh, axis: str, op: str,
                                     out_specs=P()))
 
     fn(x).block_until_ready()  # compile + warm
-    start = time.perf_counter()
+    start = clock()
     for _ in range(iters):
         out = fn(x)
     out.block_until_ready()
-    elapsed = (time.perf_counter() - start) / iters
+    elapsed = max((clock() - start) / iters, 1e-12)
 
     # nccl-tests size conventions: all_reduce/ppermute report the
     # per-rank buffer; all_gather/reduce_scatter report the total
@@ -114,31 +136,106 @@ def bench_collective(mesh: Mesh, axis: str, op: str,
 
 
 def bench_all(mesh: Mesh, axis: str, payload_mb: float = 64.0,
-              ops: Optional[List[str]] = None) -> List[Dict[str, float]]:
-    ops = ops or ['all_reduce', 'all_gather', 'reduce_scatter',
-                  'ppermute']
-    return [bench_collective(mesh, axis, op, payload_mb) for op in ops]
+              ops: Optional[List[str]] = None,
+              iters: int = 10) -> List[Dict[str, float]]:
+    ops = ops or list(DEFAULT_OPS)
+    return [bench_collective(mesh, axis, op, payload_mb, iters=iters)
+            for op in ops]
+
+
+def _acquire_devices(timeout_s: float):
+    """jax.devices() behind a bounded join: a wedged TPU tunnel hangs
+    backend init inside a C call, so the only safe ask is from a
+    joinable thread. Raises TimeoutError (-> tpu_unreachable) on a
+    hang, propagates init errors (-> backend_init_failed)."""
+    import threading
+    cell: Dict[str, object] = {}
+
+    def _init():
+        try:
+            cell['devices'] = jax.devices()
+        except Exception as e:  # pylint: disable=broad-except
+            cell['err'] = e
+    t = threading.Thread(target=_init, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if 'devices' in cell:
+        return cell['devices']
+    if t.is_alive():
+        raise TimeoutError(
+            f'backend init did not return within {timeout_s:.0f}s')
+    raise cell['err']  # type: ignore[misc]
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--axis', default='tp')
     parser.add_argument('--mb', type=float, default=64.0,
-                        help='per-device payload in MB')
+                        help='per-device payload in MiB (2**20 bytes)')
     parser.add_argument('--ops', nargs='*', default=None)
+    parser.add_argument('--iters', type=int, default=10)
+    parser.add_argument('--json', default=None, metavar='PATH',
+                        help='write a structured artifact (results + '
+                             'status) instead of relying on prose')
     args = parser.parse_args(argv)
 
-    devices = jax.devices()
+    from skypilot_tpu.utils import env
+    artifact: Dict[str, object] = {
+        'axis': args.axis, 'payload_mib': args.mb,
+        'ops': args.ops, 'results': [], 'status': 'ok',
+    }
+
+    def _emit() -> None:
+        if args.json:
+            tmp = args.json + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                json.dump(artifact, f, indent=1)
+            os.replace(tmp, args.json)
+        if artifact['status'] != 'ok':
+            print(f"status: {artifact['status']}: "
+                  f"{artifact.get('error')}", file=sys.stderr)
+
+    try:
+        devices = _acquire_devices(
+            env.get_float('SKYT_COMMS_PROBE_TIMEOUT_S', 120.0))
+    except TimeoutError as e:
+        artifact.update(status='tpu_unreachable', error=repr(e))
+        _emit()
+        # A wedged init thread may hold jax's backend lock; interpreter
+        # shutdown could block on it. The artifact is already written.
+        sys.stdout.flush()
+        os._exit(0)
+    except Exception as e:  # pylint: disable=broad-except
+        artifact.update(status='backend_init_failed', error=repr(e))
+        _emit()
+        return
+
     n = len(devices)
     spec = mesh_lib.MeshSpec(**{args.axis: n})
     mesh = mesh_lib.build_mesh(spec, devices)
+    artifact.update(n_devices=n, device_kind=devices[0].device_kind,
+                    platform=devices[0].platform)
     print(f'# {n}x {devices[0].device_kind} over axis {args.axis!r}')
-    for r in bench_all(mesh, args.axis, args.mb, args.ops):
+    ops = args.ops or list(DEFAULT_OPS)
+    results: List[Dict[str, float]] = artifact['results']  # type: ignore
+    for op in ops:
+        try:
+            r = bench_collective(mesh, args.axis, op, args.mb,
+                                 iters=args.iters)
+        except Exception as e:  # pylint: disable=broad-except
+            # One op lowering/executing badly must not cost the other
+            # ops' numbers; the artifact names the failure.
+            artifact['status'] = 'device_error'
+            artifact['error'] = f'{op}: {e!r}'
+            print(f'# {op} failed: {e!r}', file=sys.stderr)
+            continue
+        results.append(r)
         print(f"{r['op']:<16} ranks={r['ranks']} "
-              f"payload={r['payload_mb']:.0f}MB "
+              f"payload={r['payload_mb']:.0f}MiB "
               f"time={r['time_ms']:.2f}ms "
               f"algbw={r['algbw_gbps']:.2f}GB/s "
               f"busbw={r['busbw_gbps']:.2f}GB/s")
+    _emit()
 
 
 if __name__ == '__main__':

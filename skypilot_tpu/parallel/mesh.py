@@ -99,9 +99,71 @@ def build_mesh(spec: MeshSpec,
     return Mesh(dev_array, MESH_AXES)
 
 
+def hybrid_topology_key(ici: MeshSpec, dcn: MeshSpec,
+                        devices: Sequence[jax.Device]) -> str:
+    """The comms-profile topology key this hybrid layout probes as
+    (same formatter as comms_profile.topology_key of the built mesh),
+    so the placement advisor can find the measured profile before the
+    mesh exists."""
+    from skypilot_tpu.parallel import comms_profile
+    ici_sizes = ici.axis_sizes()
+    dcn_sizes = dcn.axis_sizes()
+    return comms_profile.format_topology_key(
+        getattr(devices[0], 'device_kind', 'unknown'),
+        ici.num_devices * dcn.num_devices,
+        [(a, ici_sizes[a] * dcn_sizes[a]) for a in MESH_AXES],
+        [a for a in MESH_AXES if dcn_sizes[a] > 1])
+
+
+def _interleave_chunks(devices: Sequence[jax.Device], ici: MeshSpec,
+                       dcn: MeshSpec) -> np.ndarray:
+    """Contiguous n_ici-sized chunks = slices. Shape the array as
+    dcn_axes + ici_axes, then interleave to (dcn_0, ici_0, ...) and
+    merge each pair — identical semantics to
+    mesh_utils.create_hybrid_device_mesh."""
+    arr = np.array(devices[:ici.num_devices * dcn.num_devices]).reshape(
+        dcn.shape + ici.shape)
+    order = []
+    for i in range(len(MESH_AXES)):
+        order += [i, i + len(MESH_AXES)]
+    arr = arr.transpose(order)
+    return arr.reshape(tuple(
+        d * i for d, i in zip(dcn.shape, ici.shape)))
+
+
+def _permute_dcn_slices(dev_array: np.ndarray, ici: MeshSpec,
+                        dcn: MeshSpec,
+                        perm: Sequence[int]) -> np.ndarray:
+    """Reorder WHOLE SLICES along the DCN factor of an already-built
+    hybrid device array: position k of the dcn ordering gets the
+    slice that row-major position perm[k] held. Each slice's internal
+    (ICI) assignment — including the topology-aware layout
+    mesh_utils.create_hybrid_device_mesh computed on real TPUs — is
+    moved as an opaque block, never rearranged."""
+    nd = len(MESH_AXES)
+    # Merged axes are dcn-major: split each back into (dcn_a, ici_a),
+    # bring the dcn dims together as one slice-position axis, permute,
+    # and merge back.
+    inter = dev_array.reshape(
+        [x for pair in zip(dcn.shape, ici.shape) for x in pair])
+    t = inter.transpose([2 * i for i in range(nd)] +
+                        [2 * i + 1 for i in range(nd)])
+    flat = t.reshape((dcn.num_devices,) + tuple(ici.shape))
+    flat = flat[list(perm)]
+    back = flat.reshape(tuple(dcn.shape) + tuple(ici.shape))
+    order = []
+    for i in range(nd):
+        order += [i, i + nd]
+    back = back.transpose(order)
+    return back.reshape(tuple(
+        d * i for d, i in zip(dcn.shape, ici.shape)))
+
+
 def build_hybrid_mesh(ici: MeshSpec, dcn: MeshSpec,
                       devices: Optional[Sequence[jax.Device]] = None,
-                      num_slices: Optional[int] = None) -> Mesh:
+                      num_slices: Optional[int] = None,
+                      placement: Optional[str] = None,
+                      profile=None) -> Mesh:
     """Multi-slice mesh: `ici` axes live within a slice (fast ICI
     torus), `dcn` axes cross slices (data-center network). Final mesh
     axis size = ici_axis * dcn_axis, DCN-major — so e.g.
@@ -116,6 +178,23 @@ def build_hybrid_mesh(ici: MeshSpec, dcn: MeshSpec,
     runtime under multi-slice env vars — runtime/gang.py exports them);
     CPU/test devices are chunked into `num_slices` contiguous groups so
     the same code dry-runs on a forced-host-platform mesh.
+
+    ``placement`` (default from ``SKYT_COMMS_PLACEMENT``, 'rowmajor'):
+
+      * ``'rowmajor'`` — today's layout, byte-identical to the
+        pre-advisor behavior;
+      * ``'measured'`` — Cloud Collectives-style rank reorder
+        (arXiv 2105.14088) restricted to the DCN factor: the
+        row-major layout is built first (so each slice keeps the
+        exact internal ICI assignment row-major would have given it,
+        including mesh_utils' topology-aware layout on real TPUs),
+        then whole slices are reordered along the dcn axis by the
+        cheapest ring permutation under the measured comms profile's
+        per-pair costs (``profile`` argument, else the cached probe
+        for this topology — parallel/comms_profile.py). The winner is
+        cached per (topology, spec) like an autotune entry. Without
+        any profile the permutation is the identity, i.e. exactly the
+        row-major mesh.
     """
     if devices is None:
         devices = jax.devices()
@@ -131,6 +210,12 @@ def build_hybrid_mesh(ici: MeshSpec, dcn: MeshSpec,
         raise ValueError(
             f'{ici} x {dcn} needs {n_ici * n_dcn} devices, '
             f'have {len(devices)}')
+    if placement is None:
+        from skypilot_tpu.utils import env
+        placement = env.get('SKYT_COMMS_PLACEMENT') or 'rowmajor'
+    if placement not in ('rowmajor', 'measured'):
+        raise ValueError(f"placement must be 'rowmajor' or 'measured',"
+                         f' got {placement!r}')
 
     have_slice_attr = len({getattr(d, 'slice_index', 0)
                            for d in devices}) > 1
@@ -140,18 +225,14 @@ def build_hybrid_mesh(ici: MeshSpec, dcn: MeshSpec,
             ici.shape, dcn.shape, devices=devices,
             allow_split_physical_axes=True)
     else:
-        # Emulated slices: contiguous device chunks. Shape the array as
-        # dcn_axes + ici_axes, then interleave to (dcn_0, ici_0, ...)
-        # and merge each pair — identical semantics to
-        # mesh_utils.create_hybrid_device_mesh.
-        arr = np.array(devices[:n_ici * n_dcn]).reshape(
-            dcn.shape + ici.shape)
-        order = []
-        for i in range(len(MESH_AXES)):
-            order += [i, i + len(MESH_AXES)]
-        arr = arr.transpose(order)
-        dev_array = arr.reshape(tuple(
-            d * i for d, i in zip(dcn.shape, ici.shape)))
+        dev_array = _interleave_chunks(devices, ici, dcn)
+    if placement == 'measured':
+        from skypilot_tpu.parallel import comms_profile
+        key = (f'{hybrid_topology_key(ici, dcn, devices)}'
+               f'#ici{ici.shape}|dcn{dcn.shape}')
+        perm = comms_profile.placement_for(key, n_dcn, profile=profile)
+        if perm != list(range(n_dcn)):
+            dev_array = _permute_dcn_slices(dev_array, ici, dcn, perm)
     return Mesh(dev_array, MESH_AXES)
 
 

@@ -307,6 +307,67 @@ class FleetTelemetry:
             out[target] = info
         return out
 
+    def comms_report(self, window_s: float = 600.0,
+                     now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /fleet/comms`` body (docs/observability.md
+        "Comms plane"): per-target comms telemetry from the scraped
+        series — probed link bandwidth
+        (skyt_comms_probe_busbw_gbps), the predicted per-step
+        per-axis comms seconds (skyt_train_comm_seconds_estimate),
+        and the windowed per-(axis, op) byte rate
+        (skyt_train_comm_bytes_total increase) — plus this
+        controller host's own cached link profile when one exists."""
+        if now is None:
+            now = self._clock()
+        targets = self.live_targets(now)
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in targets
+                      if t in self._stores]
+        out_targets: Dict[str, Dict[str, Any]] = {}
+        for target, store in stores:
+            info: Dict[str, Any] = {}
+            busbw: Dict[str, float] = {}
+            seconds: Dict[str, float] = {}
+            for name, labels in store.series_keys():
+                if name == 'skyt_comms_probe_busbw_gbps':
+                    pt = store.latest(name, labels)
+                    if pt is not None:
+                        key = '|'.join(labels.get(k, '?') for k in
+                                       ('axis', 'op', 'link'))
+                        busbw[key] = pt[1]
+                elif name == 'skyt_train_comm_seconds_estimate':
+                    pt = store.latest(name, labels)
+                    if pt is not None:
+                        seconds[labels.get('axis', '?')] = pt[1]
+            rate = store.grouped_delta('skyt_train_comm_bytes_total',
+                                       'axis', window_s, now=now)
+            if busbw:
+                info['probe_busbw_gbps'] = busbw
+            if seconds:
+                info['comm_seconds_estimate'] = seconds
+            if any(v > 0 for v in rate.values()):
+                info['comm_bytes_per_s'] = {
+                    k: v / window_s for k, v in rate.items() if v > 0}
+            if info:
+                out_targets[target] = info
+        # The controller host's cached profiles (if a probe ran here):
+        # summarized, never re-probed on a serve path.
+        from skypilot_tpu.parallel import comms_profile
+        try:
+            profiles = {
+                k[len('profile|'):]: comms_profile.summary(v)
+                for k, v in comms_profile.get_cache().entries().items()
+                if k.startswith('profile|') and isinstance(v, dict)}
+            local = profiles or None
+        except Exception:  # pylint: disable=broad-except
+            local = None
+        return {
+            'service': self.service_name,
+            'window_s': window_s,
+            'targets': out_targets,
+            'local_profiles': local,
+        }
+
     def fleet_slo(self, window_s: Optional[float] = None
                   ) -> Dict[str, Any]:
         """The ``GET /fleet/slo`` body: burn-rate/alert state per
@@ -421,6 +482,25 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
             body.setdefault('replica', rid)
         return web.json_response(body, status=upstream.status_code)
 
+    async def fleet_comms(request: web.Request) -> web.Response:
+        """Comms-plane aggregate (docs/observability.md "Comms
+        plane"): per-target probed link bandwidth, predicted per-step
+        comms seconds, and windowed comm byte rates."""
+        window = request.query.get('window_s')
+        try:
+            window_f = float(window) if window else 600.0
+            if window_f <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'window_s must be a positive number, got '
+                          f'{window!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, functools.partial(telemetry.comms_report,
+                                    window_s=window_f))
+        return web.json_response(payload)
+
     async def fleet_postmortems(request: web.Request) -> web.Response:
         """Index of postmortem crash bundles visible to this
         controller (SKYT_POSTMORTEM_DIR; train/postmortem.py): the
@@ -446,5 +526,6 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
 
     app.router.add_get('/fleet/metrics', fleet_metrics)
     app.router.add_get('/fleet/slo', fleet_slo)
+    app.router.add_get('/fleet/comms', fleet_comms)
     app.router.add_get('/fleet/postmortems', fleet_postmortems)
     app.router.add_post('/fleet/profile', fleet_profile)

@@ -39,6 +39,47 @@ def parse_mesh(spec: Optional[str], n_devices: int):
     return mesh_lib.MeshSpec(**axes)
 
 
+def _comms_report(step_fn, state, batch, mesh, dcn_axes, lowered,
+                  dmetrics, live_state) -> Optional[Dict]:
+    """Comms plane at the first log boundary (docs/observability.md
+    "Comms plane"): census the step's collectives, multiply by the
+    CACHED link profile (sft never probes — the probe runs in bench/
+    validation or `python -m skypilot_tpu.parallel.collectives`), log
+    the per-axis breakdown next to MFU, attach it to train.steps spans
+    and the postmortem live state. Never raises; returns the report
+    dict or None when the plane is off."""
+    from skypilot_tpu.parallel import comms_census
+    from skypilot_tpu.parallel import comms_profile
+    if comms_census.census_mode() == 'off':
+        return None
+    try:
+        entries, source = comms_census.census_step(
+            step_fn, state, batch, mesh=mesh, lowered=lowered)
+        link_classes = comms_profile.axis_link_classes(mesh, dcn_axes)
+        profile = comms_profile.load_cached(mesh, dcn_axes)
+        rep = comms_census.report(entries, source, profile=profile,
+                                  dcn_axes=dcn_axes,
+                                  link_classes=link_classes)
+        logger.info('comms census (%s%s): %s', source,
+                    '' if profile else '; no cached link profile — '
+                    'bytes only', comms_census.format_report(rep))
+        if profile:
+            comms_profile.publish_profile_metrics(profile)
+        if rep['axes']:
+            attrs = {'comm_bytes_per_step': rep['total_bytes'],
+                     'comm_breakdown': comms_census.format_report(rep)}
+            if rep['total_seconds'] is not None:
+                attrs['comm_seconds_estimate'] = round(
+                    rep['total_seconds'], 6)
+            dmetrics.set_span_attrs(attrs)
+        live_state['comms'] = rep
+        return rep
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning('comms report failed (%r); continuing without',
+                       e)
+        return None
+
+
 def synthetic_batches(vocab_size: int, batch: int, seq: int,
                       seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
     rng = np.random.default_rng(seed)
@@ -202,14 +243,20 @@ def main(argv=None) -> None:
             f'unknown model {args.model}; choose from '
             f'{sorted([*llama.CONFIGS, *moe.MIXTRAL_CONFIGS])}')
 
+    dcn_axes = ()
     if args.dcn_mesh:
         # Hybrid mesh: --mesh shards within a slice (ICI), --dcn-mesh
         # crosses slices (DCN). Keep bandwidth-hungry axes (fsdp/tp)
-        # intra-slice; dp tolerates DCN latency.
+        # intra-slice; dp tolerates DCN latency. Slice placement along
+        # the DCN axis follows SKYT_COMMS_PLACEMENT (default rowmajor;
+        # 'measured' reorders by the cached comms profile —
+        # docs/observability.md "Comms plane").
         dcn_spec = parse_mesh(args.dcn_mesh, 0)
         per_slice = jax.device_count() // max(1, dcn_spec.num_devices)
         spec = parse_mesh(args.mesh, per_slice)
         mesh = mesh_lib.build_hybrid_mesh(spec, dcn_spec)
+        dcn_axes = tuple(a for a, s in dcn_spec.axis_sizes().items()
+                         if s > 1)
         logger.info('hybrid mesh: ici=%s dcn=%s', spec, dcn_spec)
     else:
         spec = parse_mesh(args.mesh, jax.device_count())
@@ -346,6 +393,8 @@ def main(argv=None) -> None:
                 jax.process_count()
 
         flops_state = None      # resolved -> (flops_per_step, source)
+        comms_rep = None        # resolved -> comms census report dict
+        first_boundary_done = False
         # Deferred metrics: publish() pulls step k-1's loss/grad-norm while
         # step k runs — the log boundary never syncs the step chain's head
         # (logged loss lags one step; see trainer.DeferredMetrics).
@@ -433,15 +482,42 @@ def main(argv=None) -> None:
                     # which overlaps step k's device compute.
                     n_window = min(args.log_every, step + 1 - start_step)
                     step_time = (now - last_t) / max(1, n_window)
-                    if flops_state is None and \
-                            env.get_bool('SKYT_TRAIN_MFU', True):
-                        flops_state = profiling.train_step_flops(
-                            step_fn, state, batch,
-                            analytic=_analytic_flops)
-                        logger.info('train FLOPs/step: %s (%s)',
-                                    f'{flops_state[0]:.3e}'
-                                    if flops_state[0] else 'unknown',
-                                    flops_state[1])
+                    if not first_boundary_done:
+                        first_boundary_done = True
+                        from skypilot_tpu.parallel import comms_census
+                        mfu_on = env.get_bool('SKYT_TRAIN_MFU', True)
+                        census_on = comms_census.census_mode() != 'off'
+                        # One lowering feeds BOTH the MFU cost
+                        # analysis and the comms census (same stage,
+                        # no backend compile — docs/observability.md
+                        # "Comms plane").
+                        lowered = None
+                        if mfu_on or census_on:
+                            try:
+                                lowered = step_fn.lower(state, batch)
+                            except Exception as e:  # pylint: disable=broad-except
+                                logger.warning('step lowering failed '
+                                               '(%r)', e)
+                        if mfu_on:
+                            flops_state = profiling.train_step_flops(
+                                step_fn, state, batch,
+                                analytic=_analytic_flops,
+                                lowered=lowered)
+                            logger.info('train FLOPs/step: %s (%s)',
+                                        f'{flops_state[0]:.3e}'
+                                        if flops_state[0] else
+                                        'unknown', flops_state[1])
+                        if census_on:
+                            comms_rep = _comms_report(
+                                step_fn, state, batch, mesh, dcn_axes,
+                                lowered, dmetrics, live_state)
+                    if comms_rep and comms_rep.get('axes'):
+                        # Per-window publication: the bytes counter
+                        # grows with the steps the census covers, the
+                        # per-step seconds gauge just refreshes.
+                        from skypilot_tpu.parallel import comms_census
+                        comms_census.publish_metrics(comms_rep,
+                                                     steps=n_window)
                     mfu_val = None
                     if flops_state and flops_state[0]:
                         denom = profiling.peak_flops(

@@ -122,6 +122,13 @@ class DeferredMetrics:
         # on_step, advanced at every publish) — the step span's start.
         self._window_t0: Optional[float] = None
         self._steps_published = 0
+        self._static_attrs: Dict[str, Any] = {}
+
+    def set_span_attrs(self, attrs: Dict[str, Any]) -> None:
+        """Static attributes merged into every subsequent train.steps
+        span (e.g. the comms-census per-axis breakdown, resolved once
+        after the first compiled step)."""
+        self._static_attrs.update(attrs)
 
     def on_step(self, metrics: Dict[str, Any]) -> None:
         """Record step k's device metrics (no transfer, no sync)."""
@@ -160,7 +167,8 @@ class DeferredMetrics:
             attrs: Dict[str, Any] = {'steps': steps,
                                      'step_counter':
                                          self._steps_published + steps,
-                                     'metrics_lag_steps': 1, **host}
+                                     'metrics_lag_steps': 1,
+                                     **self._static_attrs, **host}
             if step_time_s is not None:
                 attrs['step_time_s'] = step_time_s
             if tokens_per_sec is not None:

@@ -172,6 +172,29 @@ _var('SKYT_RAGGED_MAX_TOKENS', 'int', 0,
 _var('SKYT_RING_IMPL', 'str', None,
      'Ring-attention impl override ("xla" forces the XLA path).')
 
+# -------------------------------------------------------- comms plane
+_var('SKYT_COMMS_PROBE_MB', 'str', '1,16',
+     'Comma-separated per-device payload sweep (MiB) of the comms '
+     'link probe (parallel/comms_profile.py).')
+_var('SKYT_COMMS_PROBE_ITERS', 'int', 5,
+     'Timed iterations per comms probe measurement.')
+_var('SKYT_COMMS_PROBE_TIMEOUT_S', 'float', 120.0,
+     'Soft wall-clock budget of one comms probe sweep (checked '
+     'between measurements), and the backend-init bound of the '
+     'collectives CLI.')
+_var('SKYT_COMMS_CACHE', 'str',
+     '~/.cache/skypilot_tpu/comms_profile.json',
+     'Persistent comms-profile cache path (probe results + placement '
+     'advisor winners; autotune-cache write discipline).')
+_var('SKYT_COMMS_PLACEMENT', 'str', 'rowmajor',
+     'DCN slice placement of build_hybrid_mesh: "rowmajor" (today\'s '
+     'layout) or "measured" (cheapest ring permutation under the '
+     'cached comms profile; ICI layout untouched).')
+_var('SKYT_COMMS_CENSUS', 'str', 'lowered',
+     'HLO communication census mode: "lowered" (explicit shard_map '
+     'collectives, no backend compile), "compiled" (post-SPMD module '
+     '— one extra AOT compile), or "off".')
+
 # ------------------------------------------------------------ tracing
 _var('SKYT_TRACE', 'bool', True,
      'Master switch for the request-tracing plane (off iff "0").')

@@ -140,7 +140,8 @@ compiled_flops = cost_analysis_flops
 
 
 def train_step_flops(step_fn: Callable, *args,
-                     analytic: Optional[Any] = None
+                     analytic: Optional[Any] = None,
+                     lowered: Optional[Any] = None
                      ) -> 'Tuple[Optional[float], str]':
     """FLOPs of one call of `step_fn(*args)` -> (flops, source).
 
@@ -154,11 +155,17 @@ def train_step_flops(step_fn: Callable, *args,
     per-device FLOPs against our global-peak denominator and remat
     recompute must not inflate MFU. Falls back to `analytic` (a float
     or zero-arg callable — the hand-maintained 6ND-style count) and
-    ultimately (None, 'unavailable')."""
-    lower = getattr(step_fn, 'lower', None)
-    if lower is not None:
+    ultimately (None, 'unavailable').
+
+    ``lowered``: a precomputed ``step_fn.lower(*args)`` stage, so a
+    caller that also feeds the comms census (sft) lowers once for
+    both reads."""
+    if lowered is not None or getattr(step_fn, 'lower', None) \
+            is not None:
         try:
-            flops = cost_analysis_flops(lower(*args))
+            if lowered is None:
+                lowered = step_fn.lower(*args)
+            flops = cost_analysis_flops(lowered)
             if flops is not None:
                 return flops, 'hlo_cost_analysis'
         except Exception as e:  # pylint: disable=broad-except

@@ -1209,6 +1209,75 @@ else
     FAIL=1
 fi
 
+echo "== 16. comms plane: link probe + HLO census on the chip; the"
+echo "   profile is archived as comms_profile.json alongside"
+echo "   probe.json and the collectives CLI writes its structured"
+echo "   artifact (docs/observability.md 'Comms plane') =="
+if SKYT_COMMS_CACHE="$OUT/comms_profile.json" timeout 600 python - \
+        <<'PYEOF' 2>&1 | tee "$OUT/comms_plane.txt"
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import comms_census
+from skypilot_tpu.parallel import comms_profile
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import trainer
+
+n = jax.device_count()
+axis = 'fsdp'
+mesh = mesh_lib.build_mesh(mesh_lib.MeshSpec(**{axis: n}))
+profile, src = comms_profile.load_or_probe(
+    mesh, payloads_mb=[1.0], iters=3, budget_s=240.0, force=True)
+summ = comms_profile.summary(profile)
+print(f'probe ({src}): {json.dumps(summ, sort_keys=True)}')
+if profile['entries']:
+    assert os.path.exists(os.environ['SKYT_COMMS_CACHE']), \
+        'profile cache not archived'
+
+if n >= 2:
+    cfg = llama.CONFIGS['debug']
+    model = llama.LlamaModel(cfg)
+    tx = trainer.make_optimizer(trainer.TrainerConfig(
+        warmup_steps=1, total_steps=4))
+    sample = jnp.zeros((4, 64), jnp.int32)
+    state, _ = trainer.create_sharded_state(model, tx, mesh, sample,
+                                            jax.random.PRNGKey(0))
+    step = trainer.make_train_step(model, tx, mesh, donate=False)
+    data = {'tokens': sample, 'targets': sample}
+    entries, source = comms_census.census_step(
+        step, state, data, mesh=mesh, mode='compiled')
+    rep = comms_census.report(
+        entries, source, profile=profile,
+        link_classes=comms_profile.axis_link_classes(mesh))
+    print(f'census ({source}): {comms_census.format_report(rep)}')
+    assert rep['sites'] > 0, 'census found no collectives'
+    assert rep['axes'][axis]['bytes'] > 0
+    assert rep['axes'][axis]['seconds'] is not None, \
+        'profile did not price the census'
+    print(f'COMMS_PLANE_OK sites={rep["sites"]} '
+          f'bytes={rep["total_bytes"]} '
+          f'predicted_ms={round((rep["total_seconds"] or 0) * 1e3, 3)}')
+else:
+    print('COMMS_PLANE_OK single-device (probe only)')
+PYEOF
+then
+    echo "== comms plane: PASS =="
+else
+    echo "== comms plane: FAIL (see $OUT/comms_plane.txt) =="
+    FAIL=1
+fi
+# The structured collectives artifact (PR 6 status discipline).
+timeout 300 python -m skypilot_tpu.parallel.collectives \
+    --mb 1 --iters 3 --json "$OUT/collectives.json" \
+    > "$OUT/collectives.txt" 2>&1 || true
+if [ -f "$OUT/collectives.json" ]; then
+    echo "collectives artifact: $(head -c 200 "$OUT/collectives.json")"
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
