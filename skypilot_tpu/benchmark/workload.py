@@ -1,0 +1,394 @@
+"""Deterministic open-loop traffic engine (the capacity plane's load
+model; docs/observability.md "Capacity plane").
+
+Every bench before this module drove a short uniform burst, which
+cannot answer the two questions every scale claim must quote: "max
+sustained QPS at SLO" and "chip-seconds per good token". Capacity
+decisions must be made against heterogeneous multiplexed workloads —
+multi-tenant, multi-model, mixed QoS classes, heavy-tailed lengths,
+diurnal rates, flash crowds — not uniform load.
+
+Three design rules:
+
+  * **Deterministic.** A `WorkloadSpec` plus a seed fully determines
+    the arrival schedule: every draw comes from one `random.Random`
+    in a fixed order (faults.py's replay discipline), so two runs
+    with the same spec produce byte-identical schedules
+    (`schedule_digest`) and a chaos run replays exactly.
+  * **Open-loop.** Arrivals fire at their scheduled times whether or
+    not earlier requests finished. A closed-loop generator (fixed
+    concurrency, next request waits for the previous) self-throttles
+    under overload and hides it; open-loop keeps offering load, so
+    queue growth, shed decisions, and SLO misses are OBSERVABLE.
+  * **Virtual time.** `compression=N` replays the schedule N× faster
+    than spec time, so a CPU test replays a "day" of diurnal shape in
+    seconds. Compression scales WHEN arrivals fire, never what they
+    contain — the schedule itself is compression-independent.
+
+The runner wounds itself through the `traffic.arrival` fault point
+(error/latency/hang), so chaos drills can inject generator-side
+failure exactly like any other plane.
+"""
+import dataclasses
+import hashlib
+import json
+import math
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.utils import env
+from skypilot_tpu.utils import faults
+
+_TWO_PI = 2.0 * math.pi
+
+
+def default_seed() -> int:
+    """The environment's default schedule seed (SKYT_TRAFFIC_SEED):
+    bench/validation runs key their replayable schedules on it."""
+    return env.get_int('SKYT_TRAFFIC_SEED', 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantProfile:
+    """One (tenant, model, QoS class) traffic slice in the mix.
+
+    Lengths are lognormal — the heavy-tailed shape real prompt/output
+    distributions have (most requests short, a fat tail of huge ones)
+    — clamped to [1, cap]. ``session_pool`` sessions per tenant are
+    reused with probability ``session_reuse``: a reused session
+    resends its fixed prefix (shared tokens), which is exactly what
+    the LB affinity tier and the engine prefix cache key on.
+    """
+    tenant: str
+    cls: str = 'standard'
+    model: str = 'base'
+    weight: float = 1.0            # share of total arrival rate
+    prompt_mean: float = 64.0      # lognormal mean, tokens
+    prompt_sigma: float = 0.8      # lognormal shape (tail heaviness)
+    prompt_cap: int = 2048
+    output_mean: float = 32.0
+    output_sigma: float = 0.6
+    output_cap: int = 512
+    session_pool: int = 8          # distinct sessions per tenant
+    session_reuse: float = 0.5     # P(arrival reuses a live session)
+    prefix_len: int = 16           # shared tokens per session prefix
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """A full workload: arrival process + tenant mix + seed.
+
+    ``arrival``:
+      * 'steady'  — evenly spaced at ``rate_rps``;
+      * 'poisson' — homogeneous Poisson at ``rate_rps``, optionally
+        modulated by a diurnal sinusoid (``diurnal_amplitude`` > 0,
+        period ``diurnal_period_s``) and/or a flash-crowd step
+        (``flash_factor``× rate over [flash_at_s, flash_at_s +
+        flash_duration_s]), realized by thinning against the peak
+        rate so the draw sequence stays deterministic.
+    """
+    seed: int = 0
+    duration_s: float = 60.0
+    rate_rps: float = 10.0
+    arrival: str = 'poisson'        # 'poisson' | 'steady'
+    diurnal_amplitude: float = 0.0  # 0..1 fraction of rate_rps
+    diurnal_period_s: float = 86400.0
+    flash_at_s: Optional[float] = None
+    flash_factor: float = 1.0
+    flash_duration_s: float = 0.0
+    tenants: Tuple[TenantProfile, ...] = (
+        TenantProfile(tenant='default'),)
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous offered rate at spec-time ``t`` (rps)."""
+        r = self.rate_rps
+        if self.diurnal_amplitude > 0.0:
+            r *= 1.0 + self.diurnal_amplitude * math.sin(
+                _TWO_PI * t / self.diurnal_period_s)
+        if self.flash_at_s is not None and \
+                self.flash_at_s <= t < self.flash_at_s + \
+                self.flash_duration_s:
+            r *= self.flash_factor
+        return max(r, 0.0)
+
+    def peak_rate(self) -> float:
+        r = self.rate_rps * (1.0 + max(self.diurnal_amplitude, 0.0))
+        if self.flash_at_s is not None:
+            r = max(r, self.rate_rps * self.flash_factor *
+                    (1.0 + max(self.diurnal_amplitude, 0.0)))
+        return r
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled request. ``t`` is spec-time seconds from start;
+    ``prompt_tokens`` already carries the session's shared prefix."""
+    index: int
+    t: float
+    tenant: str
+    cls: str
+    model: str
+    session: str
+    prompt_tokens: Tuple[int, ...]
+    max_new_tokens: int
+
+
+def _lognormal_int(rng: random.Random, mean: float, sigma: float,
+                   cap: int) -> int:
+    """Lognormal draw with ARITHMETIC mean ``mean`` (mu derived), so a
+    profile reads naturally ("mean 64-token prompts, sigma 0.8")."""
+    mu = math.log(max(mean, 1e-9)) - 0.5 * sigma * sigma
+    return max(1, min(cap, int(round(rng.lognormvariate(mu, sigma)))))
+
+
+def _arrival_times(spec: WorkloadSpec,
+                   rng: random.Random) -> List[float]:
+    if spec.arrival == 'steady':
+        if spec.rate_rps <= 0:
+            return []
+        step = 1.0 / spec.rate_rps
+        n = int(spec.duration_s * spec.rate_rps)
+        return [i * step for i in range(n)]
+    if spec.arrival != 'poisson':
+        raise ValueError(
+            f'unknown arrival process {spec.arrival!r} '
+            f"(have 'poisson', 'steady')")
+    # Nonhomogeneous Poisson by thinning: draw a homogeneous process
+    # at the peak rate, keep each point with p = rate(t)/peak. Both
+    # draws come from the single rng in arrival order — determinism
+    # holds for any rate curve.
+    peak = spec.peak_rate()
+    if peak <= 0:
+        return []
+    out: List[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= spec.duration_s:
+            return out
+        if rng.random() < spec.rate_at(t) / peak:
+            out.append(t)
+
+
+def generate_schedule(spec: WorkloadSpec) -> List[Arrival]:
+    """Spec -> full arrival schedule. One seeded rng, fixed draw order
+    (times, then per-arrival: tenant, session, lengths, prompt) — the
+    determinism contract tests byte-compare `schedule_digest` on."""
+    rng = random.Random(spec.seed)
+    times = _arrival_times(spec, rng)
+    profiles = list(spec.tenants)
+    if not profiles:
+        raise ValueError('WorkloadSpec needs at least one tenant')
+    weights = [max(p.weight, 0.0) for p in profiles]
+    if sum(weights) <= 0:
+        raise ValueError('tenant weights sum to zero')
+    # Session state: per tenant, a bounded pool of (name, prefix).
+    sessions: Dict[str, List[Tuple[str, Tuple[int, ...]]]] = {}
+    out: List[Arrival] = []
+    for i, t in enumerate(times):
+        prof = rng.choices(profiles, weights=weights)[0]
+        pool = sessions.setdefault(prof.tenant, [])
+        reuse = bool(pool) and prof.session_pool > 0 and \
+            rng.random() < prof.session_reuse
+        if reuse:
+            session, prefix = pool[rng.randrange(len(pool))]
+        else:
+            session = f's{len(pool) % max(prof.session_pool, 1)}'
+            prefix = tuple(rng.randrange(2, 256)
+                           for _ in range(prof.prefix_len))
+            if prof.session_pool > 0:
+                if len(pool) >= prof.session_pool:
+                    pool[rng.randrange(len(pool))] = (session, prefix)
+                else:
+                    pool.append((session, prefix))
+        n_prompt = _lognormal_int(rng, prof.prompt_mean,
+                                  prof.prompt_sigma, prof.prompt_cap)
+        n_out = _lognormal_int(rng, prof.output_mean,
+                               prof.output_sigma, prof.output_cap)
+        body = tuple(rng.randrange(2, 256)
+                     for _ in range(max(n_prompt - len(prefix), 1)))
+        out.append(Arrival(
+            index=i, t=t, tenant=prof.tenant, cls=prof.cls,
+            model=prof.model, session=f'{prof.tenant}/{session}',
+            prompt_tokens=prefix + body, max_new_tokens=n_out))
+    return out
+
+
+def schedule_json(schedule: Sequence[Arrival]) -> str:
+    """Canonical JSON of a schedule — the byte-identity surface for
+    the determinism test and the archivable workload artifact."""
+    return json.dumps(
+        [dataclasses.asdict(a) for a in schedule],
+        sort_keys=True, separators=(',', ':'))
+
+
+def schedule_digest(schedule: Sequence[Arrival]) -> str:
+    return hashlib.sha256(
+        schedule_json(schedule).encode('utf-8')).hexdigest()
+
+
+@dataclasses.dataclass
+class Outcome:
+    """What the submitter observed for one arrival (filled by the
+    runner's worker thread). ``status`` 0 = transport/injected error
+    (never reached a response)."""
+    arrival: Arrival
+    status: int = 0
+    ttft_s: Optional[float] = None
+    latency_s: Optional[float] = None
+    tokens: int = 0
+    error: Optional[str] = None
+    lateness_s: float = 0.0   # fire time slip vs schedule (open-loop
+    #                           health: large => generator saturated)
+
+
+class OpenLoopRunner:
+    """Fire a schedule open-loop against a ``submit`` callable.
+
+    ``submit(arrival) -> (status, ttft_s, latency_s, tokens)`` runs in
+    a worker thread per in-flight request (open-loop: the NEXT arrival
+    never waits for it). ``compression`` divides spec time: the
+    arrival at t=3600s fires at wall +36s with compression=100. The
+    `traffic.arrival` fault point fires per arrival BEFORE submit, so
+    an armed error/latency/hang rule wounds the generator itself.
+    """
+
+    def __init__(self, submit: Callable[[Arrival], Tuple], *,
+                 compression: Optional[float] = None,
+                 max_inflight: Optional[int] = None) -> None:
+        self.submit = submit
+        if compression is None:
+            compression = env.get_float('SKYT_TRAFFIC_COMPRESSION',
+                                        1.0)
+        if compression <= 0:
+            raise ValueError(
+                f'compression must be > 0, got {compression}')
+        self.compression = compression
+        if max_inflight is None:
+            max_inflight = env.get_int('SKYT_TRAFFIC_MAX_INFLIGHT',
+                                       256, minimum=1)
+        self._sem = threading.BoundedSemaphore(max_inflight)
+
+    def run(self, schedule: Sequence[Arrival]) -> List[Outcome]:
+        outcomes = [Outcome(arrival=a) for a in schedule]
+        threads: List[threading.Thread] = []
+        start = time.perf_counter()
+        for i, a in enumerate(schedule):
+            due = start + a.t / self.compression
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            out = outcomes[i]
+            out.lateness_s = max(
+                0.0, time.perf_counter() - due)
+            try:
+                faults.inject('traffic.arrival', tenant=a.tenant,
+                              cls=a.cls, model=a.model)
+            except Exception as e:  # pylint: disable=broad-except
+                out.error = f'fault: {e!r}'
+                continue
+            # The inflight bound is a GENERATOR-health backstop (don't
+            # spawn unbounded threads into a dead server), not a
+            # closed-loop throttle: it is sized far above any sane
+            # operating point and hitting it shows up as lateness.
+            self._sem.acquire()
+            th = threading.Thread(
+                target=self._one, args=(a, out), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120)
+        return outcomes
+
+    def _one(self, a: Arrival, out: Outcome) -> None:
+        try:
+            status, ttft, latency, tokens = self.submit(a)
+            out.status = int(status)
+            out.ttft_s = ttft
+            out.latency_s = latency
+            out.tokens = int(tokens or 0)
+        except Exception as e:  # pylint: disable=broad-except
+            out.error = repr(e)
+        finally:
+            self._sem.release()
+
+
+def http_submitter(base_url: str, *, timeout_s: float = 60.0,
+                   session_factory=None) -> Callable[[Arrival], Tuple]:
+    """Submitter POSTing /generate with the QoS header contract
+    (X-Priority / X-Tenant) and streaming so TTFT is client-observed.
+    Thread-safe: one requests.Session per worker thread."""
+    import requests
+    local = threading.local()
+    factory = session_factory or requests.Session
+
+    def submit(a: Arrival):
+        sess = getattr(local, 'sess', None)
+        if sess is None:
+            sess = local.sess = factory()
+        body: Dict[str, Any] = {
+            'tokens': list(a.prompt_tokens),
+            'max_tokens': a.max_new_tokens,
+            'stream': True,
+        }
+        if a.model not in ('', 'base'):
+            body['lora'] = a.model
+        t0 = time.perf_counter()
+        ttft = None
+        tokens = 0
+        with sess.post(f'{base_url}/generate', json=body, headers={
+                'X-Priority': a.cls, 'X-Tenant': a.tenant,
+                'X-Session-Id': a.session}, stream=True,
+                timeout=timeout_s) as resp:
+            if resp.status_code == 200:
+                for chunk in resp.iter_content(chunk_size=None):
+                    if chunk:
+                        if ttft is None:
+                            ttft = time.perf_counter() - t0
+                        tokens += 1
+            else:
+                resp.content  # drain  pylint: disable=pointless-statement
+        return (resp.status_code, ttft,
+                time.perf_counter() - t0, tokens)
+
+    return submit
+
+
+def summarize(outcomes: Sequence[Outcome],
+              compression: float = 1.0) -> Dict[str, Any]:
+    """Per-class roll-up of an open-loop run: counts by status family,
+    shed (429) and 5xx fractions, TTFT percentiles. TTFTs are wall
+    measurements — under compression they are NOT spec-time and are
+    only comparable between runs at the same compression."""
+    by_cls: Dict[str, Dict[str, Any]] = {}
+    for o in outcomes:
+        rec = by_cls.setdefault(o.arrival.cls, {
+            'offered': 0, 'ok': 0, 'shed': 0, 'errors_5xx': 0,
+            'transport_errors': 0, 'tokens': 0, 'ttfts': []})
+        rec['offered'] += 1
+        rec['tokens'] += o.tokens
+        if o.status == 200:
+            rec['ok'] += 1
+            if o.ttft_s is not None:
+                rec['ttfts'].append(o.ttft_s)
+        elif o.status == 429:
+            rec['shed'] += 1
+        elif o.status >= 500:
+            rec['errors_5xx'] += 1
+        elif o.status == 0:
+            rec['transport_errors'] += 1
+    out: Dict[str, Any] = {'compression': compression, 'classes': {}}
+    for cls, rec in sorted(by_cls.items()):
+        ttfts = sorted(rec.pop('ttfts'))
+        rec['ttft_p50_s'] = ttfts[len(ttfts) // 2] if ttfts else None
+        rec['ttft_p95_s'] = (ttfts[min(len(ttfts) - 1,
+                                       int(0.95 * len(ttfts)))]
+                             if ttfts else None)
+        rec['shed_fraction'] = (rec['shed'] / rec['offered']
+                                if rec['offered'] else 0.0)
+        out['classes'][cls] = rec
+    out['offered'] = sum(r['offered'] for r in out['classes'].values())
+    out['ok'] = sum(r['ok'] for r in out['classes'].values())
+    return out

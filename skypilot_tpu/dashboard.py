@@ -37,6 +37,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>Services</h2>{services}
 <h2>SLO / fleet</h2>{slo}
 <h2>Comms</h2>{comms}
+<h2>Capacity</h2>{capacity}
 <h2>Postmortems</h2>{postmortems}
 <h2>Metrics</h2>{metrics}
 <h2>Slowest traces</h2>{traces}
@@ -205,6 +206,38 @@ def _comms_html() -> str:
                    'probe busbw (GB/s)', 'comm bytes rate'], rows)
 
 
+def _capacity_html() -> str:
+    """Capacity-plane panel: each service's controller answers
+    GET /fleet/capacity — per-(class, tenant, model) attributed
+    chip-seconds and chip-seconds-per-good-token, plus per-replica
+    engine utilization (docs/observability.md "Capacity plane")."""
+    services, results = _fetch_controllers('/fleet/capacity')
+    rows = []
+    for svc in services:
+        name = svc['name']
+        data = results.get(name)
+        if not isinstance(data, dict):
+            rows.append([name, '-', f'unreachable ({data})', '-', '-',
+                         '-'])
+            continue
+        util = '; '.join(f'{t}={v:.0%}' for t, v in
+                         sorted((data.get('replica_utilization')
+                                 or {}).items()))
+        for slice_key, rec in sorted(data.get('slices', {}).items()):
+            cspgt = rec.get('chip_seconds_per_good_token')
+            rows.append([
+                name, slice_key,
+                f"{rec.get('attributed_chip_seconds', 0):.2f}",
+                f"{rec.get('good_tokens', 0):.0f}",
+                f'{cspgt:.6f}' if cspgt is not None else '-',
+                util or '-'])
+        if not data.get('slices'):
+            rows.append([name, '-', '-', '-', '-', util or '-'])
+    return _table(['service', 'class/tenant/model', 'chip-s',
+                   'good tokens', 'chip-s / good token',
+                   'replica util'], rows)
+
+
 def _postmortems_html() -> str:
     """Training-plane crash bundles (train/postmortem.py): the local
     SKYT_POSTMORTEM_DIR index — reason, rank, job, and the bundle path
@@ -276,6 +309,7 @@ def _render_page() -> str:
         services=_services_html(),
         slo=_slo_html(),
         comms=_comms_html(),
+        capacity=_capacity_html(),
         postmortems=_postmortems_html(),
         metrics=_metrics_html(),
         traces=_traces_html())

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer import ledger as ledger_lib
 from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
@@ -842,6 +843,14 @@ class InferenceEngine:
             self._m_qos_ttft = reg.histogram(
                 'skyt_qos_ttft_seconds',
                 'Time to first token by QoS class', ('class',))
+        # Capacity ledger (infer/ledger.py): engine busy seconds
+        # attributed per (class, tenant, model) — the chip-seconds-
+        # per-good-token numerator. model_labels maps lora stack ids
+        # to bounded display names; the server overwrites it with the
+        # served model id + loaded adapter names.
+        self.ledger = ledger_lib.BusyLedger(reg)
+        self.model_labels: Dict[int, str] = {0: 'base'}
+        self._busy_mark: Optional[float] = None
         # --- request-phase traces: req_id -> monotonic-free wall-clock
         # timestamps (queued -> prefill_start -> first_token -> done),
         # queryable via the server's /stats?request_id=. Bounded FIFO.
@@ -1715,12 +1724,15 @@ class InferenceEngine:
         # Pallas or the XLA floor) — silent kernel degradation must be
         # visible wherever operators already look (docs/kernels.md).
         from skypilot_tpu.ops import dispatch as ops_dispatch
-        return {'active_slots': active, 'num_slots': self.num_slots,
-                'waiting': waiting,
-                'ready': self.ready.is_set(),
-                'weight_version': self.weight_version,
-                'kernel_paths': ops_dispatch.snapshot(),
-                **self.perf_stats()}
+        out = {'active_slots': active, 'num_slots': self.num_slots,
+               'waiting': waiting,
+               'ready': self.ready.is_set(),
+               'weight_version': self.weight_version,
+               'kernel_paths': ops_dispatch.snapshot(),
+               **self.perf_stats()}
+        if self.ledger.enabled:
+            out['capacity_ledger'] = self.ledger.snapshot()
+        return out
 
     def perf_stats(self) -> Dict[str, float]:
         """Decode counters; steady_decode_tok_per_sec is the pipelined
@@ -2016,6 +2028,16 @@ class InferenceEngine:
                               # padding is a harmless +0 on token 0).
                               jnp.zeros((n, _BIAS_BUCKET), jnp.int32),
                               jnp.zeros((n, _BIAS_BUCKET), jnp.float32))
+
+    def _ledger_key(self, req: '_Request') -> 'ledger_lib.Key':
+        """Bounded (class, tenant, model) attribution key: class and
+        tenant are already parsed/bounded by the server's QoS header
+        contract; the model label comes from the bounded lora-id map
+        (never a raw request string)."""
+        p = req.params
+        lid = p.lora_id
+        return (p.priority or 'standard', p.tenant or 'default',
+                self.model_labels.get(lid) or f'lora{lid}')
 
     def _count_prefill_dispatch(self, n_requests: int,
                                 dispatch_tokens: int = 0,
@@ -2665,6 +2687,9 @@ class InferenceEngine:
                 max(0.0, start - req.submitted_at))
         self._m_prefill_tokens.inc(n)
         self.perf['admitted_requests'] += 1
+        # Capacity ledger: this admission's prefill work, weighted by
+        # real prompt tokens, lands in the interval being accumulated.
+        self.ledger.note(self._ledger_key(req), n)
         self._trace_event(req.req_id, 'first_token',
                           ts=req.first_token_at)
         req.slot = slot
@@ -2888,6 +2913,12 @@ class InferenceEngine:
             # requests and /health flips 503; 'latency' makes this a
             # slow replica.
             faults.inject('engine.loop')
+            # Capacity-ledger busy mark: opened at the first tick of a
+            # busy span, advanced at every _finish_chunk settle, and
+            # cleared by the idle branch below — so busy intervals
+            # cover admission + prefill + the in-flight chunk.
+            if self._busy_mark is None:
+                self._busy_mark = time.perf_counter()
             # In-place weight swap: apply at THIS tick boundary when
             # eligible (immediately, or once a draining swap's
             # in-flight requests have finished). While a draining swap
@@ -3029,6 +3060,14 @@ class InferenceEngine:
             if pending is not None:
                 self._finish_chunk(pending)
             elif not active and not admitted and not chunking:
+                # Going idle: settle any unsettled work (a request that
+                # finished at admission — prefill-only — never reaches
+                # a _finish_chunk pull), then drop the busy mark so
+                # idle scanning never counts as busy time.
+                if self.ledger.pending() and self._busy_mark is not None:
+                    self.ledger.settle(
+                        time.perf_counter() - self._busy_mark)
+                self._busy_mark = None
                 time.sleep(0.002)
             # Resync the sizing estimate: confirmed lengths plus the
             # in-flight chunk's worst-case advance.
@@ -3175,6 +3214,7 @@ class InferenceEngine:
                 req.generated += n_del
                 delivered += n_del
                 base[i] += n_del
+                self.ledger.note(self._ledger_key(req), n_del)
                 if trace_on:
                     # Pipelined-delivery boundary: n tokens of this
                     # request surfaced from a `chunk`-wide dispatch.
@@ -3213,6 +3253,13 @@ class InferenceEngine:
                                     / delivered)
         self._last_pull_t = now
         self._had_admission = False
+        # Capacity ledger: the pull is the pipeline's sync point, so
+        # mark -> now is a measured busy interval; split it across the
+        # work noted since the last settle (admitted prompt tokens +
+        # this chunk's delivered tokens).
+        if self._busy_mark is not None:
+            self.ledger.settle(now - self._busy_mark)
+        self._busy_mark = now
         host_s = time.perf_counter() - now
         self.perf['host_finish_s'] += host_s
         self._m_host_finish.inc(host_s)

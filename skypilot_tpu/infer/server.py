@@ -203,6 +203,23 @@ class InferenceServer:
         # Multi-LoRA routing (vLLM's OpenAI convention): 'model' in a
         # request names either the base model or a loaded adapter.
         self.lora_names = dict(lora_names or {})
+        # Capacity plane (docs/observability.md "Capacity plane"):
+        # bounded model labels for the engine's busy-time ledger (the
+        # served id + loaded adapter names — never request strings),
+        # and the per-(class, tenant, model) good-token counters the
+        # fleet capacity report joins against attributed chip-seconds.
+        self.engine.model_labels = {
+            0: model_id, **{lid: name for name, lid
+                            in self.lora_names.items()}}
+        self._m_cap_tokens = engine.metrics_registry.counter(
+            'skyt_capacity_tokens_total',
+            'Generated tokens by QoS class, tenant, and model',
+            ('class', 'tenant', 'model'))
+        self._m_cap_good_tokens = engine.metrics_registry.counter(
+            'skyt_capacity_good_tokens_total',
+            'Generated tokens of requests that met their class SLO, '
+            'by QoS class, tenant, and model',
+            ('class', 'tenant', 'model'))
         if model_id in self.lora_names:
             # _resolve_lora matches the base id first, so a colliding
             # adapter would be silently unreachable.
@@ -210,13 +227,18 @@ class InferenceServer:
                 f'--lora adapter name {model_id!r} collides with the '
                 f'served model id; rename the adapter')
 
-    def _resolve_lora(self, payload):
+    def _resolve_lora(self, payload, request=None):
         """-> (lora_id, error response | None). The base model id (or
         an absent 'model' field) routes to id 0; a loaded adapter name
         routes to its stack id; anything else is the OpenAI
-        model_not_found error."""
+        model_not_found error. When ``request`` is passed, the
+        RESOLVED model label (base id or adapter name — a bounded
+        set, never the raw request string) is stashed for the
+        capacity-plane counters and flight-recorder snapshot."""
         name = payload.get('model')
         if name is None or name == self.model_id:
+            if request is not None:
+                request['skyt_model'] = self.model_id
             return 0, None
         lid = self.lora_names.get(name)
         if lid is None:
@@ -224,6 +246,8 @@ class InferenceServer:
                 {'error': {'message': f'model {name!r} not found',
                            'type': 'invalid_request_error',
                            'code': 'model_not_found'}}, status=404)
+        if request is not None:
+            request['skyt_model'] = name
         return lid, None
 
     async def _q_get(self, request: web.Request, out_q,
@@ -332,8 +356,11 @@ class InferenceServer:
         /metrics gauges read; cheap enough to run per retained trace."""
         eng = self.engine
         with eng._lock:  # pylint: disable=protected-access
-            running = sum(1 for s in eng._slots  # pylint: disable=protected-access
-                          if s is not None)
+            occupants = [
+                eng._ledger_key(s)  # pylint: disable=protected-access
+                for s in eng._slots  # pylint: disable=protected-access
+                if s is not None]
+        running = len(occupants)
         snap: Dict[str, object] = {
             'queue_depth': eng._waiting.qsize(),  # pylint: disable=protected-access
             'running_slots': running,
@@ -353,6 +380,16 @@ class InferenceServer:
         # Per-class queue depths + overload level on flight-recorded
         # slow traces: "slow because 40 batch requests sat ahead of
         # it" is the QoS plane's headline diagnosis.
+        # Capacity plane: WHO held the slots when a slow trace was
+        # captured — per-(class, tenant, model) occupancy, so every
+        # SLO-violating exemplar from a capacity run is attributable
+        # ("slow while 6 of 8 slots ran batch/analytics/base").
+        if occupants:
+            by_key: Dict[str, int] = {}
+            for key in occupants:
+                k = '/'.join(key)
+                by_key[k] = by_key.get(k, 0) + 1
+            snap['slot_occupancy'] = by_key
         depths = eng.qos_depths()
         if depths is not None:
             snap['qos_queue'] = depths
@@ -443,8 +480,19 @@ class InferenceServer:
                 itl = ((done - first) / (gen - 1)
                        if done is not None and first is not None
                        and gen >= 2 else None)
-                self._goodput.record(cls, tenant, ok=ok, ttft_s=ttft,
-                                     itl_s=itl, tokens=gen)
+                good = self._goodput.record(cls, tenant, ok=ok,
+                                            ttft_s=ttft, itl_s=itl,
+                                            tokens=gen)
+                # Capacity plane: good-token counters per (class,
+                # tenant, model) — the denominator the fleet capacity
+                # report divides attributed chip-seconds by.
+                if gen > 0:
+                    model = request.get('skyt_model') or self.model_id
+                    self._m_cap_tokens.labels(
+                        cls, tenant, model).inc(gen)
+                    if good:
+                        self._m_cap_good_tokens.labels(
+                            cls, tenant, model).inc(gen)
         except Exception:  # pylint: disable=broad-except
             # Accounting must never turn a served request into a 500.
             logger.exception('SLO goodput recording failed')
@@ -627,7 +675,8 @@ class InferenceServer:
         # Optional 'lora': adapter name (same names the OpenAI routes
         # accept in 'model').
         lora_id, lora_err = self._resolve_lora(
-            {'model': payload['lora']} if payload.get('lora') else {})
+            {'model': payload['lora']} if payload.get('lora') else {},
+            request=request)
         if lora_err is not None:
             return lora_err
         try:
@@ -1049,7 +1098,8 @@ class InferenceServer:
             return web.json_response(
                 {'error': 'echo cannot combine with stream'},
                 status=400)
-        lora_id, lora_err = self._resolve_lora(payload)
+        lora_id, lora_err = self._resolve_lora(payload,
+                                               request=request)
         if lora_err is not None:
             return lora_err
         deadline, dl_err = self._deadline_from(request)
@@ -1190,7 +1240,8 @@ class InferenceServer:
         if payload.get('stream') and n != 1:
             return web.json_response(
                 {'error': 'stream supports n=1'}, status=400)
-        lora_id, lora_err = self._resolve_lora(payload)
+        lora_id, lora_err = self._resolve_lora(payload,
+                                               request=request)
         if lora_err is not None:
             return lora_err
         deadline, dl_err = self._deadline_from(request)

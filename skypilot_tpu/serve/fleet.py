@@ -368,6 +368,80 @@ class FleetTelemetry:
             'local_profiles': local,
         }
 
+    def capacity_report(self, window_s: Optional[float] = None,
+                        now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /fleet/capacity`` body (docs/observability.md
+        "Capacity plane"): per-(class, tenant, model) cost slices from
+        the scraped capacity families — attributed chip-seconds, good
+        tokens, and chip-seconds-per-good-token — plus per-replica
+        engine utilization (ledger busy fraction) and the wall-clock
+        goodput report as a cross-reference. Attribution caveat: the
+        ledger allocates measured busy time by token weights, so
+        slices are a cost ALLOCATION, not isolated measurements."""
+        if now is None:
+            now = self._clock()
+        if window_s is None:
+            window_s = env.get_float('SKYT_CAPACITY_WINDOW_S', 300.0)
+        chips_per_replica = env.get_float(
+            'SKYT_FLEET_CHIPS_PER_REPLICA', 1.0)
+        replicas = self.live_replicas(now)
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in replicas
+                      if t in self._stores]
+        # Slice enumeration: every (class, tenant, model) the ledger
+        # attributed seconds to on any live replica. Bounded by
+        # construction (parsed class, bounded tenant, loaded models).
+        matches: Dict[str, Dict[str, str]] = {}
+        for _t, store in stores:
+            for name, labels in store.series_keys():
+                if name == 'skyt_capacity_attributed_seconds_total':
+                    key = '/'.join((labels.get('class', ''),
+                                    labels.get('tenant', ''),
+                                    labels.get('model', '')))
+                    matches.setdefault(key, {
+                        'class': labels.get('class', ''),
+                        'tenant': labels.get('tenant', ''),
+                        'model': labels.get('model', '')})
+        slices: Dict[str, Dict[str, Any]] = {}
+        for key, match in sorted(matches.items()):
+            attr_s = self.sum_delta(
+                'skyt_capacity_attributed_seconds_total', match,
+                window_s, now)
+            tokens = self.sum_delta(
+                'skyt_capacity_tokens_total', match, window_s, now)
+            good = self.sum_delta(
+                'skyt_capacity_good_tokens_total', match, window_s,
+                now)
+            chip_s = (attr_s or 0.0) * chips_per_replica
+            slices[key] = {
+                'attributed_chip_seconds': round(chip_s, 6),
+                'tokens': tokens or 0.0,
+                'good_tokens': good or 0.0,
+                'chip_seconds_per_good_token': (
+                    round(chip_s / good, 9)
+                    if chip_s > 0 and good else None),
+            }
+        util: Dict[str, float] = {}
+        for target, store in stores:
+            busy = store.sum_delta(
+                'skyt_capacity_busy_seconds_total', None, window_s,
+                now=now)
+            if busy is not None:
+                util[target] = round(min(busy / window_s, 1.0), 4)
+        return {
+            'service': self.service_name,
+            'window_s': window_s,
+            'chips_per_replica': chips_per_replica,
+            'replicas': len(replicas),
+            'slices': slices,
+            'replica_utilization': util,
+            # Wall-clock cost (chips x wall seconds / good tokens,
+            # slo.py): the upper-bound cross-reference for the
+            # ledger's busy-time attribution above.
+            'goodput': slo_lib.goodput_report(self, window_s, now,
+                                              replicas=len(replicas)),
+        }
+
     def fleet_slo(self, window_s: Optional[float] = None
                   ) -> Dict[str, Any]:
         """The ``GET /fleet/slo`` body: burn-rate/alert state per
@@ -501,6 +575,25 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
                                     window_s=window_f))
         return web.json_response(payload)
 
+    async def fleet_capacity(request: web.Request) -> web.Response:
+        """Capacity-plane aggregate (docs/observability.md "Capacity
+        plane"): per-(class, tenant, model) attributed chip-seconds
+        and chip-seconds-per-good-token, per-replica utilization."""
+        window = request.query.get('window_s')
+        try:
+            window_f = float(window) if window else None
+            if window_f is not None and window_f <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'window_s must be a positive number, got '
+                          f'{window!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, functools.partial(telemetry.capacity_report,
+                                    window_s=window_f))
+        return web.json_response(payload)
+
     async def fleet_postmortems(request: web.Request) -> web.Response:
         """Index of postmortem crash bundles visible to this
         controller (SKYT_POSTMORTEM_DIR; train/postmortem.py): the
@@ -527,5 +620,6 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
     app.router.add_get('/fleet/metrics', fleet_metrics)
     app.router.add_get('/fleet/slo', fleet_slo)
     app.router.add_get('/fleet/comms', fleet_comms)
+    app.router.add_get('/fleet/capacity', fleet_capacity)
     app.router.add_get('/fleet/postmortems', fleet_postmortems)
     app.router.add_post('/fleet/profile', fleet_profile)

@@ -411,6 +411,25 @@ _var('SKYT_SLO_SLOW_LONG_S', 'float', 259200.0,
 _var('SKYT_SLO_SLOW_BURN', 'float', 6.0,
      'Slow burn-rate alert threshold.')
 
+# ------------------------------------------------- capacity / traffic
+_var('SKYT_CAPACITY_LEDGER', 'bool', True,
+     'Engine busy-time ledger: chip-seconds attributed per (class, '
+     'tenant, model) slice (infer/ledger.py).')
+_var('SKYT_CAPACITY_TARGET', 'float', None,
+     'Capacity-search SLO attainment target (defaults to '
+     'SKYT_SLO_TARGET).')
+_var('SKYT_CAPACITY_WINDOW_S', 'float', 300.0,
+     'Default window of the /fleet/capacity report (seconds).')
+_var('SKYT_TRAFFIC_COMPRESSION', 'float', 1.0,
+     'Open-loop traffic engine virtual-time compression: N replays '
+     'the schedule N times faster than spec time.')
+_var('SKYT_TRAFFIC_MAX_INFLIGHT', 'int', 256,
+     'Generator-health backstop on concurrently in-flight open-loop '
+     'requests (hitting it shows up as arrival lateness, not as '
+     'closed-loop throttling).')
+_var('SKYT_TRAFFIC_SEED', 'int', 0,
+     'Default seed of the deterministic workload schedule.')
+
 # -------------------------------------------------------------- train
 _var('SKYT_WATCHDOG', 'bool', True,
      'Master switch for heartbeats + rank sentinel + gang watchdog.')

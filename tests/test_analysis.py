@@ -344,6 +344,59 @@ def test_real_env_docs_are_fresh():
         assert f.read() == mod.generate_docs()
 
 
+# ------------------------------------------------- metric-cardinality
+def test_metric_cardinality_flags_id_label_declaration(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/infer/leaky.py', '''\
+        from skypilot_tpu.utils import metrics
+
+        M = metrics.REGISTRY.counter(
+            'skyt_leaky_requests_total', 'per-request counter',
+            ('request_id', 'path'))
+        ''')
+    issues = lint.check_file(bad)
+    assert any('metric-cardinality' in i and "'request_id'" in i
+               for i in issues), issues
+
+
+def test_metric_cardinality_flags_unbounded_label_values(tmp_path):
+    bad = _write(tmp_path, 'skypilot_tpu/infer/leaky2.py', '''\
+        from skypilot_tpu.utils import metrics
+
+        M = metrics.REGISTRY.counter(
+            'skyt_thing_total', 'ok names', ('who', 'route'))
+
+
+        def record(req, request):
+            M.labels(req.trace_id, 'x').inc()
+            M.labels('y', request.headers.get('X-Tenant')).inc()
+        ''')
+    issues = [i for i in lint.check_file(bad)
+              if 'metric-cardinality' in i]
+    assert any("'trace_id'" in i for i in issues), issues
+    assert any('request-controlled' in i for i in issues), issues
+
+
+def test_metric_cardinality_clean_on_bounded_values_and_noqa(tmp_path):
+    ok = _write(tmp_path, 'skypilot_tpu/infer/clean.py', '''\
+        from skypilot_tpu.utils import metrics
+        from skypilot_tpu.utils import qos
+
+        M = metrics.REGISTRY.counter(
+            'skyt_thing_total', 'bounded', ('class', 'tenant'))
+        N = metrics.REGISTRY.counter(
+            'skyt_noqa_total', 'justified',
+            ('session_id',))  # noqa: metric-cardinality
+
+
+        def record(request):
+            cls = qos.parse_priority(request.headers.get('X-Priority'))
+            tenant = qos.parse_tenant(request.headers.get('X-Tenant'))
+            M.labels(cls, tenant).inc()
+        ''')
+    assert not any('metric-cardinality' in i
+                   for i in lint.check_file(ok))
+
+
 # ------------------------------------------------- registry-consistency
 def test_fault_point_drift_reds_both_ways(tmp_path):
     _write(tmp_path, 'skypilot_tpu/serve/thing.py', '''\

@@ -1894,6 +1894,111 @@ def test_chaos_batch_flood_sheds_only_batch(monkeypatch):
             proc.kill()
 
 
+@pytest.mark.integration
+def test_chaos_flash_crowd_sheds_only_sheddable_class(monkeypatch):
+    """Capacity-plane acceptance drill (docs/observability.md
+    "Capacity plane"): a deterministic workload-engine schedule with a
+    20x flash-crowd step, replayed open-loop through the REAL
+    in-process LB -> server -> engine stack with SKYT_QOS=1. The
+    protected interactive class rides through the step with zero
+    429/5xx, only the sheddable batch class sheds (and the sheds land
+    inside the crowd window), and both classes serve again after the
+    crowd passes."""
+    from skypilot_tpu.benchmark import workload
+
+    port = _free_port()
+    proc = _spawn_replica(port, extra_env={
+        'SKYT_QOS': '1',
+        # Aggressive thresholds sized to the 2-slot debug replica:
+        # batch sheds as soon as 2 requests queue (ratio q/slots >= 1).
+        'SKYT_QOS_QUEUE_DEGRADE': '0.5',
+        'SKYT_QOS_QUEUE_SHED': '1',
+        'SKYT_QOS_DEGRADE_MAX_TOKENS': '4',
+        'SKYT_QOS_RESERVE_SLOTS': '1',
+        'SKYT_QOS_REFRESH_S': '0.05',
+        'SKYT_QOS_HOLD_S': '2',
+        'SKYT_QOS_TTFT_SLO_MS': '0',
+    })
+    url = f'http://127.0.0.1:{port}'
+    try:
+        _wait_http(url + '/health', timeout=180, proc=proc)
+        lb, base, reg = _make_lb([url], monkeypatch, SKYT_QOS='1')
+        spec = workload.WorkloadSpec(
+            seed=7, duration_s=16.0, rate_rps=1.5, arrival='poisson',
+            flash_at_s=6.0, flash_factor=20.0, flash_duration_s=4.0,
+            tenants=(
+                workload.TenantProfile(
+                    tenant='clicky', cls='interactive', weight=1.0,
+                    prompt_mean=3.0, prompt_sigma=0.3, prompt_cap=6,
+                    output_mean=3.0, output_sigma=0.3, output_cap=4,
+                    session_pool=2, session_reuse=0.5, prefix_len=2),
+                workload.TenantProfile(
+                    tenant='cruncher', cls='batch', weight=3.0,
+                    prompt_mean=4.0, prompt_sigma=0.3, prompt_cap=8,
+                    output_mean=40.0, output_sigma=0.5, output_cap=48,
+                    session_pool=2, session_reuse=0.2, prefix_len=2)))
+        sched = workload.generate_schedule(spec)
+        # The drill is replayable: same spec, byte-identical schedule.
+        assert workload.schedule_digest(sched) == \
+            workload.schedule_digest(workload.generate_schedule(spec))
+        runner = workload.OpenLoopRunner(
+            workload.http_submitter(base, timeout_s=120.0),
+            compression=2.0)
+        outcomes = runner.run(sched)
+        summary = workload.summarize(outcomes, compression=2.0)
+        inter = summary['classes']['interactive']
+        batch = summary['classes']['batch']
+        # Protected class: zero 429/5xx/transport errors through a
+        # 20x step the 2-slot replica cannot possibly serve in full.
+        assert inter['shed'] == 0, summary
+        assert inter['errors_5xx'] == 0, summary
+        assert inter['transport_errors'] == 0, summary
+        assert inter['ok'] == inter['offered'], summary
+        # Sheddable class absorbed the crowd — sheds happened, inside
+        # the flash window, and never as a 5xx.
+        assert batch['shed'] > 0, summary
+        assert any(o.status == 429 and 6.0 <= o.arrival.t < 10.0
+                   for o in outcomes), summary
+        assert batch['errors_5xx'] == 0, summary
+        text = requests.get(url + '/metrics', timeout=5).text
+        assert 'skyt_qos_shed_total{class="batch"}' in text
+        assert 'skyt_qos_shed_total{class="interactive"}' not in text
+        # The busy ledger attributed the drill's engine time to both
+        # (class, tenant, model) slices — the cost half of the plane.
+        led = requests.get(url + '/stats',
+                           timeout=5).json()['capacity_ledger']
+        attr = led['attributed_seconds']
+        assert 'interactive/clicky/debug' in attr or \
+            any(k.startswith('interactive/clicky/') for k in attr), led
+        assert any(k.startswith('batch/cruncher/') for k in attr), led
+        assert sum(attr.values()) <= led['busy_seconds'] + 1e-6
+        # Recovery: once the crowd passes and the hold expires, BOTH
+        # classes serve again (batch included).
+        sess = requests.Session()
+        for cls in ('interactive', 'batch'):
+            deadline = time.time() + 60
+            status = None
+            while time.time() < deadline:
+                r = sess.post(base + '/generate',
+                              json={'tokens': [2, 3, 4],
+                                    'max_tokens': 4},
+                              headers={'X-Priority': cls,
+                                       'X-Tenant': 'probe'},
+                              timeout=60)
+                status = r.status_code
+                if status == 200:
+                    break
+                time.sleep(0.5)
+            assert status == 200, \
+                f'{cls} did not recover after the flash crowd'
+        observed = reg.counter('skyt_lb_qos_sheds_observed_total', '',
+                               ('lb', 'class'))
+        assert observed.value(lb.lb_id, 'interactive') == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
 # ========================================== preemption-safe training exit
 @pytest.mark.integration
 def test_sft_preemption_checkpoint_and_resume(tmp_path):

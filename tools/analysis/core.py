@@ -119,13 +119,14 @@ class Project:
 def _registry() -> List[Pass]:
     # Imported lazily so `import analysis.core` never cycles.
     from . import async_blocking, env_registry, lock_discipline, \
-        ported, registry_consistency, tracer_safety
+        metric_cardinality, ported, registry_consistency, tracer_safety
     return (ported.PASSES +
             [lock_discipline.LockDisciplinePass(),
              async_blocking.AsyncBlockingPass(),
              tracer_safety.TracerSafetyPass(),
              env_registry.EnvReadPass(),
              env_registry.EnvRegistryDriftPass(),
+             metric_cardinality.MetricCardinalityPass(),
              registry_consistency.RegistryConsistencyPass()])
 
 

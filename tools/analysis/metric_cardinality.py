@@ -1,0 +1,135 @@
+"""metric-cardinality: metric label values must come from bounded
+sets.
+
+A Prometheus-style registry keeps one child series per distinct label
+tuple forever, so a label fed from an unbounded source — request ids,
+trace/span ids, raw session keys, raw header strings — grows the
+registry without bound until the per-family series cap starts
+dropping REAL series (utils/metrics.py SKYT_METRICS_MAX_SERIES). The
+capacity plane's per-(class, tenant, model) families make this easy
+to get wrong: class is a parsed enum, tenant is charset/length-
+bounded by qos.parse_tenant, model is the loaded-adapter set — and
+every new family must keep that discipline.
+
+Two checks (docs/static_analysis.md):
+
+  * **declarations** — a ``registry.counter/gauge/histogram`` family
+    whose label NAMES include an id-like name (``request_id``,
+    ``trace_id``, ``session_id``, ...) is flagged: the name promises
+    per-identifier series, which is a time-series DB's job, not a
+    metric registry's;
+  * **label call sites** — a ``.labels(...)`` argument that is an
+    id-like variable/attribute (``req.req_id``), or a raw read of
+    request-controlled strings (``request.headers.get(...)``,
+    ``request.query[...]``, ``match_info``), is flagged: route label
+    values through a parser that bounds them (qos.parse_priority /
+    parse_tenant, a resolved-model lookup) first.
+
+Suppress a justified site with ``# noqa: metric-cardinality``.
+"""
+import ast
+from typing import List, Optional
+
+from .core import FileContext, Pass, Violation
+
+# Label names that promise one series per identifier. 'path' and
+# 'code' are NOT here: route templates and status codes are bounded.
+_ID_LABEL_NAMES = frozenset({
+    'id', 'request_id', 'req_id', 'rid', 'trace_id', 'span_id',
+    'session', 'session_id', 'user_id', 'uuid', 'url'})
+
+# Attributes whose reads yield request-controlled strings.
+_RAW_REQUEST_ATTRS = frozenset({'headers', 'query', 'match_info'})
+
+_FAMILY_METHODS = ('counter', 'gauge', 'histogram')
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _raw_request_read(node: ast.AST) -> bool:
+    """request.headers.get(...), request.query['x'], ...match_info —
+    a request-controlled string reaching a label unparsed."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == 'get':
+            return _raw_request_read(f.value) or (
+                isinstance(f.value, ast.Attribute) and
+                f.value.attr in _RAW_REQUEST_ATTRS)
+        return False
+    if isinstance(node, ast.Subscript):
+        return isinstance(node.value, ast.Attribute) and \
+            node.value.attr in _RAW_REQUEST_ATTRS
+    if isinstance(node, ast.Attribute):
+        return node.attr in _RAW_REQUEST_ATTRS
+    return False
+
+
+class MetricCardinalityPass(Pass):
+    id = 'metric-cardinality'
+    title = 'metric label values must come from bounded sets'
+
+    def applies(self, ctx: FileContext) -> bool:
+        return 'skypilot_tpu' in ctx.rel
+
+    def run(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr in _FAMILY_METHODS:
+                out += self._check_declaration(ctx, node)
+            elif node.func.attr == 'labels':
+                out += self._check_labels_call(ctx, node)
+        return out
+
+    def _check_declaration(self, ctx: FileContext,
+                           node: ast.Call) -> List[Violation]:
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith('skyt_')):
+            return []
+        largs = node.args[2] if len(node.args) > 2 else next(
+            (kw.value for kw in node.keywords
+             if kw.arg == 'labelnames'), None)
+        if not isinstance(largs, (ast.Tuple, ast.List)):
+            return []
+        out = []
+        for elt in largs.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, str) and \
+                    elt.value in _ID_LABEL_NAMES:
+                out.append(Violation(
+                    ctx.rel, elt.lineno, self.id,
+                    f'metric family {node.args[0].value!r} declares '
+                    f'id-like label {elt.value!r} — one series per '
+                    f'identifier is unbounded cardinality; put '
+                    f'per-request detail on traces, not metrics'))
+        return out
+
+    def _check_labels_call(self, ctx: FileContext,
+                           node: ast.Call) -> List[Violation]:
+        out = []
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            name = _terminal_name(arg)
+            if name in _ID_LABEL_NAMES:
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.id,
+                    f'.labels() argument {name!r} looks like an '
+                    f'unbounded identifier — label values must come '
+                    f'from a bounded set (parsed class/tenant, '
+                    f'resolved model, enum)'))
+            elif _raw_request_read(arg):
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.id,
+                    f'.labels() argument on line {node.lineno} reads '
+                    f'request-controlled input directly — bound it '
+                    f'first (qos.parse_priority/parse_tenant or an '
+                    f'allowlist lookup)'))
+        return out

@@ -1278,6 +1278,118 @@ if [ -f "$OUT/collectives.json" ]; then
     echo "collectives artifact: $(head -c 200 "$OUT/collectives.json")"
 fi
 
+echo "== 17. capacity plane: seeded open-loop probe against an"
+echo "   on-chip replica — short capacity search at the TTFT SLO,"
+echo "   busy-ledger sums-to-busy check via /stats, structured"
+echo "   capacity_probe.json artifact (docs/observability.md"
+echo "   'Capacity plane') =="
+if SKYT_VALIDATION_OUT="$OUT" timeout 900 python - \
+        <<'PYEOF' 2>&1 | tee "$OUT/capacity_probe.txt"
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+from skypilot_tpu.benchmark import capacity
+from skypilot_tpu.benchmark import workload
+
+OUT = os.environ['SKYT_VALIDATION_OUT']
+ART = os.path.join(OUT, 'capacity_probe.json')
+TTFT_SLO_S = 2.0    # generous: on-chip debug model, cold HBM
+
+
+def artifact(status, **kw):
+    rec = {'status': status, 'step': 'capacity_probe', **kw}
+    with open(ART, 'w') as f:
+        json.dump(rec, f, sort_keys=True)
+    print(f'capacity artifact: {json.dumps(rec, sort_keys=True)}')
+
+
+with socket.socket() as s:
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+env = dict(os.environ, SKYT_CAPACITY_LEDGER='1', SKYT_QOS='1')
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(port),
+     '--num-slots', '2', '--max-seq-len', '64'], env=env)
+base = f'http://127.0.0.1:{port}'
+try:
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            artifact('replica_died', rc=proc.returncode)
+            raise SystemExit(f'server died rc={proc.returncode}')
+        time.sleep(1)
+    else:
+        artifact('replica_unhealthy', timeout_s=480)
+        raise SystemExit('server never became healthy')
+
+    submit = workload.http_submitter(base, timeout_s=120.0)
+    tenants = (workload.TenantProfile(
+        tenant='probe', cls='interactive', prompt_mean=12.0,
+        prompt_cap=16, output_mean=8.0, output_cap=8),)
+
+    def measure(rate):
+        spec = workload.WorkloadSpec(
+            seed=workload.default_seed(), duration_s=4.0,
+            rate_rps=rate, arrival='poisson', tenants=tenants)
+        outs = workload.OpenLoopRunner(
+            submit, compression=1.0).run(
+                workload.generate_schedule(spec))
+        good = sum(1 for o in outs if o.status == 200
+                   and o.ttft_s is not None
+                   and o.ttft_s <= TTFT_SLO_S)
+        return good / max(1, len(outs))
+
+    res = capacity.capacity_search(
+        measure, target=0.9, rate_lo=1.0, rate_hi=16.0,
+        resolution=0.5, max_trials=5)
+    led = requests.get(base + '/stats',
+                       timeout=5).json().get('capacity_ledger', {})
+    busy = led.get('busy_seconds', 0.0)
+    attr = sum(led.get('attributed_seconds', {}).values())
+    toks = sum(led.get('tokens', {}).values())
+    assert res.max_sustained_qps > 0, \
+        f'probe could not sustain the floor rate: {res.as_dict()}'
+    assert any(k.startswith('interactive/probe/')
+               for k in led.get('tokens', {})), led
+    assert attr <= busy + 1e-6, (attr, busy)
+    assert toks > 0, led
+    artifact('ok',
+             max_sustained_qps=res.max_sustained_qps,
+             slo_attainment=res.slo_attainment,
+             ttft_slo_s=TTFT_SLO_S, trials=len(res.trials),
+             busy_seconds=round(busy, 6),
+             attributed_seconds=round(attr, 6),
+             chip_seconds_per_token=round(attr / toks, 9))
+    print(f'CAPACITY_PROBE_OK qps={res.max_sustained_qps} '
+          f'attainment={res.slo_attainment:.3f} '
+          f's_per_tok={attr / toks:.6f}')
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PYEOF
+then
+    echo "== capacity probe: PASS =="
+else
+    echo "== capacity probe: FAIL (see $OUT/capacity_probe.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
