@@ -51,6 +51,7 @@ PHASE_DEADLINES = {
     'affinity bench': 600,
     'slo report bench': 420,
     'kv+ragged bench': 600,
+    'kv tier bench': 600,
     'watchdog overhead bench': 300,
     'weight swap bench': 480,
     'comms plane bench': 600,
@@ -1336,6 +1337,255 @@ def affinity_ab_metrics() -> list:
             eng.stop()
 
 
+def kv_tier_metrics() -> list:
+    """kv tier phase (CPU-runnable, docs/performance.md "Tiered
+    prefix cache"): restart-warm vs cold TTFT through the real
+    prefix-affinity LB. Two paged replicas serve 384-token shared
+    prefixes, and every timed request routes (by the rendezvous
+    ring) to a replica that has NEVER prefilled its prefix while the
+    OTHER replica holds the pages — exactly the post-restart /
+    failover-return shape the tier exists for. With SKYT_KV_TIER=off
+    the owner recomputes the full ~400-token prefill (cold); with
+    =fleet it fetches the six int-hash-chained pages from the peer
+    the LB names in X-KV-Peer, splices them in, and prefills only
+    the 16-token tail (warm).
+
+    Acceptance: kv_tier_restart_hit_rate_on strictly higher than
+    _off (off is structurally 0 — the owner never saw the prefix),
+    and warm TTFT p50 below cold (vs_baseline < 1.0).
+    """
+    import dataclasses as _dc
+    import hashlib
+    import socket
+    import statistics
+    import threading
+
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.infer import engine as engine_lib
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    # Parked controller sync (daemon LB threads outlive the phase);
+    # the /kv/prefix donor endpoint and the fetch worker share the
+    # bearer token via env.
+    os.environ['SKYT_SERVE_LB_SYNC_INTERVAL'] = '3600'
+    saved_env = {k: os.environ.get(k)
+                 for k in ('SKYT_KV_TIER', 'SKYT_ADMIN_TOKEN')}
+    os.environ['SKYT_ADMIN_TOKEN'] = 'bench-kv'
+
+    # 384 tokens = exactly 6 full 64-token pages of publishable
+    # prefix KV (the build_engine debug preset caps max_seq_len at
+    # 128, so the engines are built by hand at 512). Token ids are
+    # >= 10000 so the LB affinity key's 1024-byte window covers only
+    # prefix tokens — the 16-token tail never re-keys the request.
+    def prefix_tokens(i):
+        return [10000 + (i * 613 + j * 7) % 19000 for j in range(384)]
+
+    def tail_tokens(i):
+        return [3 + (i * 31 + k) % 97 for k in range(16)]
+
+    def affinity_key(toks):
+        text = ','.join(str(t) for t in toks)
+        return hashlib.sha256(
+            text.encode('utf-8')[:1024]).hexdigest()[:16]
+
+    sess = requests.Session()
+
+    def run_condition(tier):
+        os.environ['SKYT_KV_TIER'] = tier
+        engines, urls = [], []
+        try:
+            cfg = _dc.replace(llama.CONFIGS['debug'], remat=False,
+                              max_seq_len=512)
+            if cfg.param_dtype == 'float32' and cfg.dtype == 'bfloat16':
+                cfg = _dc.replace(cfg, param_dtype='bfloat16')
+            model = llama.LlamaModel(cfg)
+            params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                         jnp.zeros((1, 8), jnp.int32))
+            for _ in range(2):
+                eng = engine_lib.InferenceEngine(
+                    model, params, num_slots=2, max_seq_len=512,
+                    decode_chunk=2, cache_mode='paged',
+                    prefix_caching=True, pool_tokens=16384)
+                eng.start()
+                engines.append(eng)
+                srv = server_lib.InferenceServer(eng)
+                port = free_port()
+                threading.Thread(target=lambda app=srv.make_app(),
+                                 p=port: web.run_app(
+                                     app, port=p, print=None,
+                                     handle_signals=False),
+                                 daemon=True).start()
+                urls.append(f'http://127.0.0.1:{port}')
+            for url in urls:
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    try:
+                        if sess.get(url + '/health',
+                                    timeout=2).status_code == 200:
+                            break
+                    except requests.RequestException:
+                        pass
+                    time.sleep(0.2)
+                else:
+                    raise RuntimeError(f'replica {url} never healthy')
+            lb_port = free_port()
+            lb = lb_lib.SkyServeLoadBalancer(
+                'http://127.0.0.1:9', lb_port, policy='prefix_affinity',
+                metrics_registry=metrics_lib.MetricsRegistry())
+            lb.policy.set_ready_replicas(urls)
+            threading.Thread(target=lambda: web.run_app(
+                lb.make_app(), port=lb_port, print=None,
+                handle_signals=False), daemon=True).start()
+            base = f'http://127.0.0.1:{lb_port}'
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    sess.get(base + '/metrics', timeout=2)
+                    break
+                except requests.RequestException:
+                    time.sleep(0.2)
+            ring = getattr(lb.policy, 'ring', None)
+            if ring is None:
+                raise RuntimeError('prefix_affinity LB has no ring')
+
+            def ranked(toks):
+                return list(ring.ranked(affinity_key(toks)))
+
+            # Warmup (untimed): pay every compile BOTH conditions
+            # share — the 512-token prefill bucket and decode step on
+            # each replica directly, then one full seeded fetch cycle
+            # per replica THROUGH the LB so the fleet condition also
+            # compiles its page-install dispatch (the off condition
+            # just recomputes — same traffic, fair A/B). Warmup
+            # prefixes are probed until each replica has been the
+            # ring's first choice at least once.
+            for url in urls:
+                sess.post(url + '/generate',
+                          json={'tokens': prefix_tokens(9001),
+                                'max_tokens': 1},
+                          timeout=600).raise_for_status()
+                sess.post(url + '/generate',
+                          json={'tokens': prefix_tokens(9002)
+                                + tail_tokens(9002),
+                                'max_tokens': 1},
+                          timeout=600).raise_for_status()
+            owners_warmed = set()
+            i = 9100
+            while len(owners_warmed) < len(urls) and i < 9200:
+                toks = prefix_tokens(i)
+                order = ranked(toks)
+                if order[0] not in owners_warmed:
+                    owners_warmed.add(order[0])
+                    # Seed the donor (2nd-ranked = the X-KV-Peer the
+                    # LB will hint), then route through the LB.
+                    sess.post(order[1] + '/generate',
+                              json={'tokens': toks, 'max_tokens': 1},
+                              timeout=600).raise_for_status()
+                    sess.post(base + '/generate',
+                              json={'tokens': toks + tail_tokens(i),
+                                    'max_tokens': 1},
+                              timeout=600).raise_for_status()
+                i += 1
+
+            def cache_counters():
+                hits = misses = 0.0
+                for eng in engines:
+                    block = eng.stats().get('prefix_cache', {})
+                    hits += float(block.get('hit_pages', 0))
+                    misses += float(block.get('miss_pages', 0))
+                return hits, misses
+
+            def fetched_pages():
+                total = 0.0
+                for eng in engines:
+                    tier_block = eng.stats().get('kv_tier') or {}
+                    total += float(tier_block.get('fetched_pages', 0))
+                return total
+
+            # Timed: R distinct prefixes, each seeded ONLY on its
+            # donor, then requested once through the LB (lands on
+            # the cold owner; client-side elapsed of a max_tokens=1
+            # request is the TTFT proxy).
+            n_prefixes = 6
+            ttfts = []
+            seeded = []
+            for i in range(n_prefixes):
+                toks = prefix_tokens(i)
+                order = ranked(toks)
+                sess.post(order[1] + '/generate',
+                          json={'tokens': toks, 'max_tokens': 1},
+                          timeout=600).raise_for_status()
+                seeded.append(toks + tail_tokens(i))
+            h0, m0 = cache_counters()
+            f0 = fetched_pages()
+            for body_tokens in seeded:
+                t0 = time.perf_counter()
+                r = sess.post(base + '/generate',
+                              json={'tokens': body_tokens,
+                                    'max_tokens': 1},
+                              timeout=600)
+                ttfts.append(time.perf_counter() - t0)
+                r.raise_for_status()
+            h1, m1 = cache_counters()
+            dh, dm = h1 - h0, m1 - m0
+            rate = dh / (dh + dm) if (dh + dm) > 0 else 0.0
+            return (rate, statistics.median(ttfts),
+                    fetched_pages() - f0)
+        finally:
+            for eng in engines:
+                eng.stop()
+
+    try:
+        rate_off, ttft_cold, _ = run_condition('off')
+        rate_on, ttft_warm, pages_on = run_condition('fleet')
+        print(f'# kv tier: restart hit rate off={rate_off:.3f} '
+              f'on={rate_on:.3f}, ttft p50 cold={ttft_cold * 1e3:.1f}ms '
+              f'warm={ttft_warm * 1e3:.1f}ms '
+              f'({ttft_warm / ttft_cold:.2f}x), fetched pages='
+              f'{pages_on:.0f}', file=sys.stderr)
+        return [
+            {'metric': 'kv_tier_restart_hit_rate_off',
+             'value': round(rate_off, 4), 'unit': 'fraction',
+             'vs_baseline': None},
+            # Acceptance: strictly higher than _off (whose value is
+            # structurally 0 here — the ring owner never saw the
+            # prefix, so without the tier every page is a miss).
+            {'metric': 'kv_tier_restart_hit_rate_on',
+             'value': round(rate_on, 4), 'unit': 'fraction',
+             'vs_baseline': (round(rate_on / rate_off, 4)
+                             if rate_off > 0 else None)},
+            {'metric': 'kv_tier_restart_ttft_p50_cold_s',
+             'value': round(ttft_cold, 4), 'unit': 's',
+             'vs_baseline': None},
+            # Acceptance: vs_baseline < 1.0 (fetch six pages from
+            # the peer + tail prefill beats recomputing the full
+            # prefix prefill).
+            {'metric': 'kv_tier_restart_ttft_p50_warm_s',
+             'value': round(ttft_warm, 4), 'unit': 's',
+             'vs_baseline': (round(ttft_warm / ttft_cold, 4)
+                             if ttft_cold > 0 else None)},
+            {'metric': 'kv_tier_fetched_pages',
+             'value': pages_on, 'unit': 'pages',
+             'vs_baseline': None},
+        ]
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def kv_ragged_metrics() -> list:
     """kv+ragged phase (CPU-runnable, docs/performance.md "raw-speed
     stack"): the three acceptance numbers of the int8-KV + ragged-
@@ -2604,6 +2854,20 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# kv+ragged bench failed: {e!r}', file=sys.stderr)
+
+    # kv tier phase: restart-warm vs cold TTFT and post-restart
+    # prefix hit rate through the real prefix-affinity LB, tiers off
+    # vs fleet. CPU-runnable — docs/performance.md "Tiered prefix
+    # cache".
+    if on_tpu:
+        _reclaim_hbm('pre-kv-tier')
+    try:
+        with phase_deadline(PHASE_DEADLINES['kv tier bench'],
+                            'kv tier bench'):
+            extra = extra + kv_tier_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# kv tier bench failed: {e!r}', file=sys.stderr)
 
     # Weight-swap phase: in-place hot-swap pause (p95 ITL during the
     # swap window vs steady), dropped requests (must be 0), relaunches

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.infer import kv_tier as kv_tier_lib
 from skypilot_tpu.infer import ledger as ledger_lib
 from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
 from skypilot_tpu.utils import faults
@@ -201,6 +202,14 @@ class _Request:
     # deferred request retries every loop tick; re-hashing the prompt
     # each time is O(n) host work for an unchanging value).
     page_hashes: Optional[List[bytes]] = None
+    # Fleet KV tier (SKYT_KV_TIER=fleet): peer URL the LB's rendezvous
+    # ring designates as this prefix's owner (X-KV-Peer header), and
+    # the in-flight fetch state dict ({'state': 'pending'|'done'|
+    # 'failed', 'deadline': ...}) while the request is parked waiting
+    # for the cross-replica page transfer. kv_fetch stays non-None
+    # afterwards so one request never fetches twice.
+    kv_peer: Optional[str] = None
+    kv_fetch: Optional[Dict[str, Any]] = None
 
 
 def _round_up_pow2(n: int, lo: int = 32) -> int:
@@ -902,6 +911,61 @@ class InferenceEngine:
         self._jit_clear_slot = jax.jit(self._clear_slot_impl,
                                        donate_argnums=(0,))
 
+        # --- tiered prefix cache (infer/kv_tier.py; docs/performance.md
+        # "Tiered prefix cache"). SKYT_KV_TIER=off (the default) leaves
+        # kv_tier None and the hot path byte-for-byte: no hook on the
+        # pool, no per-tick work beyond one `is not None` check.
+        self.kv_tier = None
+        self._kv_fetching: Optional[_Request] = None
+        # /kv/prefix export requests parked for the loop thread:
+        # {'hashes', 'max_pages', 'event', 'pages', 'version'}.
+        self._kv_export_q = _collections.deque()
+        self._m_kv_tier_hits = None
+        self._m_prefix_evictions = reg.counter(
+            'skyt_infer_prefix_cache_evictions_total',
+            'Published prefix pages reclaimed by allocation pressure '
+            '(each one is warm KV dropped from HBM — and spilled to '
+            'the host tier when SKYT_KV_TIER is on)')
+        self._m_prefix_pages = reg.gauge(
+            'skyt_infer_prefix_cache_pages',
+            'Pages currently holding published (reusable) prefix KV')
+        self._m_prefix_occupancy = reg.gauge(
+            'skyt_infer_prefix_cache_occupancy',
+            'Published prefix pages / allocatable pool pages (0-1)')
+        tier = kv_tier_lib.tier_from_env()
+        if tier != 'off' and not (self.cache_mode == 'paged'
+                                  and self.prefix_caching):
+            logger.warning(
+                'SKYT_KV_TIER=%s requires the paged cache with prefix '
+                'caching; tiering stays off', tier)
+            tier = 'off'
+        if tier != 'off' and self._lockstep is not None:
+            # Same gate as request_weight_swap: per-host tier state
+            # (host stores, fetch timing) would desync the lockstep
+            # admission sequence across hosts.
+            logger.warning('SKYT_KV_TIER=%s is not supported under '
+                           'multi-host lockstep; tiering stays off',
+                           tier)
+            tier = 'off'
+        if tier != 'off':
+            self.kv_tier = kv_tier_lib.KVTierManager(tier)
+            self.pool.on_evict = self._kv_spill
+            self._m_kv_tier_hits = reg.counter(
+                'skyt_infer_kv_tier_hit_pages_total',
+                'Prefix pages served per cache tier: hbm = registry '
+                'hits, host = pages promoted host->device, fleet = '
+                'pages landed by cross-replica fetch', ('tier',))
+            self._prefix_seen['tier_hbm'] = 0
+            self._kv_tier_seen = {'promoted_pages': 0,
+                                  'fetched_pages': 0}
+            # Pages install host->device in chunks of <= 8 ids padded
+            # to pow2 (4 compiles: n in {1,2,4,8}); arrays arrive
+            # stacked [L, n, H, P(, d)] at pool dtype, so .set() is a
+            # pure byte copy — the golden-equality property.
+            self._jit_kv_install = jax.jit(self._kv_install_impl,
+                                           donate_argnums=(0,))
+            self.kv_tier.start()
+
     def _pull(self, x) -> np.ndarray:
         """Device→host fetch for control decisions (tokens, logits,
         counts). Single-host: plain np.asarray. Multi-host: a
@@ -1174,6 +1238,231 @@ class InferenceEngine:
         return {**cache,
                 'tables': cache['tables'].at[slot].set(
                     jnp.zeros_like(cache['tables'][slot]))}
+
+    # ------------------------------------------- tiered prefix cache
+    # (infer/kv_tier.py; docs/performance.md "Tiered prefix cache").
+    # All methods below are loop-thread-only except kv_export_encoded
+    # (server executor threads) and the kv_tier worker internals.
+
+    def _kv_pool_keys(self) -> List[str]:
+        return ['k', 'v', 'k_scale', 'v_scale'] if self.kv_quantized \
+            else ['k', 'v']
+
+    def _kv_slice_page(self, page: int) -> Dict[str, Any]:
+        """Eager per-pool slices of one page ([L, H, P(, d)], pool
+        dtype). The slices are fresh device buffers whose fill is
+        dispatched NOW — before any later insert overwrites the page —
+        so device-stream ordering guarantees they capture the
+        pre-overwrite contents even though nothing blocks here."""
+        return {name: self.cache[name][:, page]
+                for name in self._kv_pool_keys()}
+
+    def _kv_spill(self, page: int, h: bytes) -> None:
+        """PagePool.on_evict hook: snapshot the page being reclaimed
+        and hand it to the tier writer thread (which pays the
+        device->host pull). Never raises into pool accounting."""
+        try:
+            self.kv_tier.enqueue_spill(h, self.weight_version,
+                                       self._kv_slice_page(page))
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('kv tier spill enqueue failed')
+
+    def _kv_install_impl(self, cache, page_ids, arrays):
+        """Scatter promoted page contents ([L, n, H, P(, d)], pool
+        dtype) into the pool at `page_ids` ([n] int32). A pure byte
+        copy — no re-quantization — so a promoted page is bit-equal to
+        the page that spilled. Duplicate ids (pow2 padding repeats the
+        last page) scatter identical data, so the result is
+        deterministic."""
+        new_cache = dict(cache)
+        for name, a in arrays.items():
+            new_cache[name] = cache[name].at[:, page_ids].set(a)
+        return self._pin_paged_layouts(new_cache)
+
+    def _kv_install(self, pages: List[int],
+                    datas: List[Dict[str, Any]]) -> None:
+        """Write host-resident page contents into the pool pages
+        install_prefix just allocated. Chunks of <= 8, padded to pow2
+        by repeating the last (id, data) pair, bound the compile count
+        at 4 shapes per pool layout."""
+        i = 0
+        while i < len(pages):
+            n = min(8, len(pages) - i)
+            chunk_ids = list(pages[i:i + n])
+            chunk_datas = list(datas[i:i + n])
+            m = 1
+            while m < n:
+                m *= 2
+            while len(chunk_ids) < m:
+                chunk_ids.append(chunk_ids[-1])
+                chunk_datas.append(chunk_datas[-1])
+            ids = jnp.asarray(np.asarray(chunk_ids, np.int32))
+            arrays = {name: np.stack([d[name] for d in chunk_datas],
+                                     axis=1)
+                      for name in self._kv_pool_keys()}
+            self.cache = self._jit_kv_install(self.cache, ids, arrays)
+            i += n
+
+    def _kv_try_promote(self, req: '_Request') -> int:
+        """L2 splice: if the HBM registry run for `req` stops short but
+        the host store holds the continuation at the current weight
+        version, install those pages (refcount 0, warm LRU) and write
+        their contents — the try_reserve_prefix that follows then
+        shares them exactly as if they had never been evicted. Returns
+        pages promoted."""
+        if self.kv_tier is None or not req.page_hashes:
+            return 0
+        psize = self.pool.cfg.page_size
+        lookup = req.page_hashes[:(len(req.tokens) - 1) // psize]
+        have = self.pool.prefix_peek(lookup)
+        if have >= len(lookup):
+            return 0
+        run = self.kv_tier.host.run(lookup[have:], self.weight_version)
+        if not run:
+            return 0
+        pages = self.pool.install_prefix([h for h, _ in run])
+        if pages is None:   # free list can't cover it: recompute
+            return 0
+        self._kv_install(pages, [arrays for _, arrays in run])
+        self.kv_tier.note_promotion(len(pages))
+        return len(pages)
+
+    def _kv_missing_run(self, req: '_Request') -> List[bytes]:
+        """Full-page hashes of `req` covered by neither the HBM
+        registry nor the host store — what a fleet fetch would ask the
+        peer for."""
+        psize = self.pool.cfg.page_size
+        lookup = req.page_hashes[:(len(req.tokens) - 1) // psize]
+        have = self.pool.prefix_peek(lookup)
+        missing = lookup[have:]
+        while missing and self.kv_tier.host.contains(
+                missing[0], self.weight_version):
+            missing = missing[1:]
+        return list(missing)
+
+    def _kv_admission_break(self, req: '_Request', n: int,
+                            psize: int) -> bool:
+        """Batched-admission peek helper: True when the tier could
+        serve this request's prefix without recompute, so it should
+        leave the batched path for the sequential one (where the host
+        splice / fleet fetch happens). Called only after the HBM peek
+        missed, so this covers peek==0 cases: host-resident head, or a
+        fetchable peer hint."""
+        if self.kv_tier is None:
+            return False
+        lookup = req.page_hashes[:(n - 1) // psize]
+        if not lookup:
+            return False
+        if self.kv_tier.host.contains(lookup[0], self.weight_version):
+            return True
+        return self.kv_tier.fleet and bool(req.kv_peer) and \
+            req.kv_fetch is None and self._kv_fetching is None
+
+    def _kv_start_fetch(self, req: '_Request') -> bool:
+        """L3: park `req` and fetch its missing prefix run from the
+        peer the LB designated (X-KV-Peer) into the host store; the
+        re-admission then promotes through the L2 splice. At most one
+        fetch in flight; every failure mode (fault injection, HTTP
+        error, timeout, version mismatch) degrades to recompute.
+        Returns True if the request was parked."""
+        tier = self.kv_tier
+        missing = self._kv_missing_run(req)
+        if not missing:
+            return False
+        req.kv_fetch = {
+            'state': 'pending',
+            # The loop abandons the wait past this even if the worker
+            # is hung inside a kv.fetch=hang injection; an abandoned
+            # worker's late host.put is version-gated and harmless.
+            'deadline': time.monotonic() + 1.5 * tier.fetch_timeout_s,
+        }
+        self._kv_fetching = req
+        st = req.kv_fetch
+        peer, version = req.kv_peer, self.weight_version
+        token = env.get('SKYT_ADMIN_TOKEN') or ''
+        def _worker():
+            try:
+                tier.fetch_into_host(peer, missing, version, token)
+                st['state'] = 'done'
+            except Exception as e:  # pylint: disable=broad-except
+                tier.note_fetch_error()
+                logger.info('kv fetch from %s failed: %s', peer, e)
+                st['state'] = 'failed'
+        threading.Thread(target=_worker, daemon=True,
+                         name='kv-fetch').start()
+        return True
+
+    def _kv_tick(self) -> None:
+        """Per-tick tier work on the loop thread: re-admit a parked
+        fetch once its worker finished (or its deadline/cancel fired),
+        and serve parked /kv/prefix exports."""
+        req = self._kv_fetching
+        if req is not None:
+            st = req.kv_fetch
+            if st['state'] != 'pending' or req.cancelled or \
+                    time.monotonic() > st['deadline']:
+                self._kv_fetching = None
+                # Back into admission: promotion picks up whatever the
+                # fetch landed; a failed fetch recomputes; a cancelled
+                # request takes _admit_one's terminal-None path.
+                if self._deferred is None:
+                    self._deferred = req
+                else:
+                    # Re-queue of an ALREADY-ADMITTED request whose
+                    # class was assigned at submit; no bypass.
+                    self._waiting.put(req)   # qos-admission (sanctioned)
+        if self._kv_export_q:
+            self._kv_drain_exports()
+
+    def _kv_drain_exports(self) -> None:
+        """Resolve parked /kv/prefix export requests: walk the leading
+        registered run, take eager page slices (lazy — the requester's
+        thread pays the device->host pull), stamp the weight version,
+        wake the requester."""
+        while self._kv_export_q:
+            rq = self._kv_export_q.popleft()
+            try:
+                out = []
+                for h in rq['hashes']:
+                    page = self.pool.registered_page(h)
+                    if page is None:
+                        break
+                    out.append((h, self._kv_slice_page(page)))
+                rq['pages'] = out
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('kv export slice failed')
+                rq['pages'] = []
+            rq['version'] = self.weight_version
+            rq['event'].set()
+
+    def kv_export_encoded(self, hashes: List[bytes],
+                          max_pages: Optional[int] = None
+                          ) -> Optional[bytes]:
+        """Server-side of GET /kv/prefix (executor thread): the leading
+        locally-resident run of `hashes` — HBM registry first, host
+        store continuation — encoded for transfer, or None when
+        nothing is resident (the server answers 404, never 5xx)."""
+        if self.kv_tier is None or self.pool is None:
+            return None
+        cap = max_pages if max_pages is not None \
+            else self.kv_tier.fetch_max_pages
+        hashes = list(hashes)[:max(0, cap)]
+        if not hashes:
+            return None
+        rq = {'hashes': hashes, 'pages': None, 'version': None,
+              'event': threading.Event()}
+        self._kv_export_q.append(rq)
+        if not rq['event'].wait(timeout=5.0):
+            return None   # loop gone/stuck: miss, not an error
+        version = rq['version']
+        out = [(h, {k: np.asarray(v) for k, v in arrays.items()})
+               for h, arrays in (rq['pages'] or [])]
+        if len(out) < len(hashes):
+            out.extend(self.kv_tier.host.run(hashes[len(out):],
+                                             version))
+        if not out:
+            return None
+        return kv_tier_lib.encode_pages(out, version)
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
                        keys, topks, topps, press, freqs, counts, hist,
@@ -1481,10 +1770,16 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- public
     def submit(self, tokens: List[int],
-               params: Optional[SamplingParams] = None
+               params: Optional[SamplingParams] = None,
+               kv_peer: Optional[str] = None
                ) -> 'tuple[int, queue.Queue]':
         """Enqueue a request; returns (req_id, token queue). The queue
-        yields generated token ids, then None when finished."""
+        yields generated token ids, then None when finished.
+
+        kv_peer: peer replica base URL the LB's rendezvous ring
+        designates as this prefix's owner (X-KV-Peer). Only consulted
+        under SKYT_KV_TIER=fleet on a local prefix miss; ignored
+        otherwise."""
         params = params or SamplingParams()
         params.validate()
         if params.lora_id >= max(1, self.num_adapters):
@@ -1511,6 +1806,8 @@ class InferenceEngine:
         req = _Request(req_id=req_id, tokens=list(tokens), params=params,
                        out_queue=queue.Queue(),
                        rng=np.random.default_rng(params.seed + req_id))
+        if kv_peer and self.kv_tier is not None and self.kv_tier.fleet:
+            req.kv_peer = kv_peer
         self._m_requests.inc()
         self._trace_event(req_id, 'queued', ts=req.submitted_at,
                           prompt_tokens=len(tokens), status='waiting')
@@ -1551,6 +1848,7 @@ class InferenceEngine:
             return True
         return any(d is not None and d.req_id == req_id
                    for d in (self._deferred, self._admitting,
+                             self._kv_fetching,
                              *self._admitting_many))
 
     def _drain_peek(self) -> List['_Request']:
@@ -1565,7 +1863,7 @@ class InferenceEngine:
             if req is not None and req.req_id == req_id:
                 req.cancelled = True
                 found = True
-        for d in (self._deferred, self._admitting,
+        for d in (self._deferred, self._admitting, self._kv_fetching,
                   *self._admitting_many):
             if d is not None and d.req_id == req_id:
                 d.cancelled = True
@@ -1596,7 +1894,7 @@ class InferenceEngine:
         # without it an already-flagged request would re-match (and
         # re-count) every tick until then.
         for req in (*self._slots, self._deferred, self._admitting,
-                    *self._admitting_many):
+                    self._kv_fetching, *self._admitting_many):
             if req is not None and not req.cancelled and \
                     not req.expired and \
                     req.params.deadline is not None and \
@@ -1640,6 +1938,8 @@ class InferenceEngine:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.kv_tier is not None:
+            self.kv_tier.stop()
         if self._thread:
             # Lockstep: the loop exits at the next tick broadcast (the
             # stop flag must reach followers), which can be mid-compile
@@ -1757,6 +2057,8 @@ class InferenceEngine:
             p['prefix_cache']['cached_pages'] = cached
             if total > 0:
                 p['prefix_cache']['occupancy'] = round(cached / total, 4)
+        if self.kv_tier is not None:
+            p['kv_tier'] = self.kv_tier.snapshot()
         # Snapshot under the lock: the engine thread appends
         # concurrently, and iterating a mutating deque raises
         # RuntimeError (ADVICE r5) — a /stats request must never 500.
@@ -1843,11 +2145,33 @@ class InferenceEngine:
                 ps = self.pool.prefix_stats
                 for key, metric in (('hit_pages', self._m_prefix_hit),
                                     ('miss_pages',
-                                     self._m_prefix_miss)):
+                                     self._m_prefix_miss),
+                                    ('evictions',
+                                     self._m_prefix_evictions)):
                     cur = int(ps.get(key, 0))
-                    if cur > self._prefix_seen[key]:
-                        metric.inc(cur - self._prefix_seen[key])
+                    if cur > self._prefix_seen.get(key, 0):
+                        metric.inc(cur - self._prefix_seen.get(key, 0))
                         self._prefix_seen[key] = cur
+                cached = self.pool.prefix_cached_pages()
+                self._m_prefix_pages.set(cached)
+                if total > 0:
+                    self._m_prefix_occupancy.set(cached / total)
+                if self._m_kv_tier_hits is not None:
+                    # hbm rides the pool's hit_pages; host/fleet ride
+                    # the tier manager's monotone counters — all
+                    # delta-folded so rate() math survives resets.
+                    cur = int(ps.get('hit_pages', 0))
+                    if cur > self._prefix_seen['tier_hbm']:
+                        self._m_kv_tier_hits.labels('hbm').inc(
+                            cur - self._prefix_seen['tier_hbm'])
+                        self._prefix_seen['tier_hbm'] = cur
+                    for key, tname in (('promoted_pages', 'host'),
+                                       ('fetched_pages', 'fleet')):
+                        cur = int(self.kv_tier.stats.get(key, 0))
+                        if cur > self._kv_tier_seen[key]:
+                            self._m_kv_tier_hits.labels(tname).inc(
+                                cur - self._kv_tier_seen[key])
+                            self._kv_tier_seen[key] = cur
         else:
             denom = self.num_slots * self.max_seq_len
             if denom > 0:
@@ -1987,6 +2311,13 @@ class InferenceEngine:
             # Stale-KV correctness: cached prefixes were computed under
             # the old weights and must never be shared across versions.
             flushed = self.pool.flush_prefix()
+        if self.kv_tier is not None:
+            # The outer tiers obey the same contract: drop every host-
+            # store entry of the old version AND gate in-flight spills
+            # (a snapshot taken pre-swap must not land post-swap);
+            # fetches reject peers on another version, so the fleet
+            # tier invalidates transitively.
+            self.kv_tier.host.set_version(self.weight_version)
         self._m_weight_version.set(self.weight_version)
         swap['result'] = {'weight_version': self.weight_version,
                           'flushed_prefix_pages': flushed,
@@ -2219,6 +2550,8 @@ class InferenceEngine:
                 if self.pool.prefix_peek(
                         req.page_hashes[:(n - 1) // psize]) > 0:
                     break   # prefix hit -> suffix path, sequential
+                if self._kv_admission_break(req, n, psize):
+                    break   # outer tier can serve it -> sequential
             if lora0 is None:
                 lora0 = req.params.lora_id
             elif req.params.lora_id != lora0:
@@ -2370,6 +2703,8 @@ class InferenceEngine:
                     if self.pool.prefix_peek(
                             req.page_hashes[:(n - 1) // psize]) > 0:
                         break   # prefix hit -> suffix path, sequential
+                    if self._kv_admission_break(req, n, psize):
+                        break   # outer tier can serve it -> sequential
             bucket = b
             cand.append(req)
         if len(cand) < 2:
@@ -2516,6 +2851,20 @@ class InferenceEngine:
                     req.page_hashes = paged_cache_hashes(
                         req.tokens, psize, salt=req.params.lora_id)
                 hashes = req.page_hashes
+            if self.kv_tier is not None and hashes:
+                # Outer tiers, cheapest first: splice any host-resident
+                # continuation into the pool (L2), then — still missing
+                # pages, with a peer hint and no fetch in flight — park
+                # the request behind a cross-replica fetch (L3). The
+                # reserve below then shares whatever landed; every
+                # failure mode falls through to plain recompute.
+                self._kv_try_promote(req)
+                if self.kv_tier.fleet and req.kv_peer and \
+                        req.kv_fetch is None and \
+                        self._kv_fetching is None and \
+                        self._kv_start_fetch(req):
+                    self._admitting = None
+                    return True   # parked; _kv_tick re-admits it
             # Cap the shared span at (n-1)//P pages: at least one real
             # token must run through the model to produce next-token
             # logits.
@@ -2858,7 +3207,8 @@ class InferenceEngine:
             for i, req in enumerate(self._slots):
                 if req is not None:
                     self._release(i, status='failed')
-            for req in (*self._admitting_many, self._admitting):
+            for req in (*self._admitting_many, self._admitting,
+                        self._kv_fetching):
                 if req is not None and req.slot is None:
                     # Died mid-admission, before _complete_admission
                     # installed it in _slots.
@@ -2867,6 +3217,13 @@ class InferenceEngine:
                     req.out_queue.put(None)
             self._admitting_many = []
             self._admitting = None
+            self._kv_fetching = None
+            # Parked /kv/prefix exports must not wedge their server
+            # executor threads on a dead loop.
+            while self._kv_export_q:
+                rq = self._kv_export_q.popleft()
+                rq['pages'], rq['version'] = [], self.weight_version
+                rq['event'].set()
             if self._deferred is not None:
                 self._trace_event(self._deferred.req_id, 'done',
                                   status='failed')
@@ -2935,6 +3292,11 @@ class InferenceEngine:
             # Deadline enforcement: expired requests cancel in place
             # (slot + KV pages free at the next delivery boundary).
             self._expire_deadlines()
+            # Tiered prefix cache: re-admit a parked fleet fetch and
+            # serve parked /kv/prefix exports (off path: one None
+            # check).
+            if self.kv_tier is not None:
+                self._kv_tick()
             # QoS: re-run the fair scheduler over the backlog (class
             # order + aging credit + DRR tenant fairness) before this
             # tick's admissions. Lockstep engines reorder inside

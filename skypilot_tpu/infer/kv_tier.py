@@ -1,0 +1,401 @@
+"""Tiered prefix-KV cache: host-RAM spillover (L2) and fleet-wide
+page transfer (L3).
+
+The paged prefix cache (infer/paged_cache.py) is per-replica HBM: when
+``_alloc_page`` runs dry it drops the least-recently-released published
+page, and a replica restart (or a weight-swap ``flush_prefix``) starts
+cold — prefixes the fleet already paid to compute are recomputed. This
+module adds the two outer tiers (docs/performance.md "Tiered prefix
+cache"):
+
+  L1 (HBM)   the PagePool registry — unchanged, still the only tier the
+             decode kernels ever read.
+  L2 (host)  HostKVStore: a byte-budgeted LRU of evicted pages, keyed by
+             the SAME chained content hashes. The engine's eviction hook
+             snapshots the page device-side (an eager slice dispatched
+             before the overwriting insert, so stream order guarantees
+             pre-overwrite content) and a writer thread pulls it to host
+             RAM — int8 pages + their scale rows, so PR 12's
+             quantization halves the PCIe bytes. On a registry miss
+             whose hash run is host-resident the engine promotes
+             host→device and splices the pages in as shared pages.
+  L3 (fleet) a bearer-authed ``GET /kv/prefix?hashes=`` endpoint serves
+             encoded page runs to peers; on a local miss the engine
+             asks the replica the LB's rendezvous ring designates (the
+             ``X-KV-Peer`` hint), behind the ``kv.fetch`` fault point —
+             error/latency/hang all degrade to recompute, never a
+             client-visible failure.
+
+Every entry is stamped with the engine ``weight_version``; the store
+version-gates both lookups and writes, so KV computed under old weights
+can never be served after a swap (docs/robustness.md "Zero-downtime
+rollouts" invalidation contract).
+
+Pages are stored at pool dtype (int8 + f32 scales, or the model dtype)
+so a promoted or fetched page is byte-identical to what recompute would
+have written — the golden-equality property tests/test_kv_tier.py
+asserts on token streams.
+"""
+import collections
+import json
+import logging
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from skypilot_tpu.utils import env
+from skypilot_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+TIERS = ('off', 'host', 'fleet')
+
+# Wire format of /kv/prefix payloads: magic + u32 header length + JSON
+# header + concatenated raw array bytes (header order).
+_MAGIC = b'SKV1'
+
+PageArrays = Dict[str, np.ndarray]
+
+
+def tier_from_env() -> str:
+    """The configured tier, degraded (not crashed) on a bad value —
+    the env registry's malformed-value convention."""
+    t = (env.get('SKYT_KV_TIER', 'off') or 'off').strip().lower()
+    if t not in TIERS:
+        logger.warning('SKYT_KV_TIER=%r is not one of %s; tiering off',
+                       t, TIERS)
+        return 'off'
+    return t
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, including ml_dtypes extension types (the pool
+    stores bfloat16 when unquantized)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_pages(pages: Sequence[Tuple[bytes, PageArrays]],
+                 weight_version: int) -> bytes:
+    """Serialize a page run for the /kv/prefix transfer. Arrays travel
+    as raw bytes (no pickle — the peer is another process)."""
+    header: Dict[str, Any] = {'v': 1,
+                              'weight_version': int(weight_version),
+                              'pages': []}
+    blobs: List[bytes] = []
+    for h, arrays in pages:
+        entry = {'hash': h.hex(), 'arrays': []}
+        for name in sorted(arrays):
+            a = np.ascontiguousarray(arrays[name])
+            entry['arrays'].append({'name': name, 'dtype': a.dtype.name,
+                                    'shape': list(a.shape)})
+            blobs.append(a.tobytes())
+        header['pages'].append(entry)
+    hj = json.dumps(header, sort_keys=True).encode('utf-8')
+    return b''.join([_MAGIC, struct.pack('<I', len(hj)), hj] + blobs)
+
+
+def decode_pages(data: bytes
+                 ) -> Tuple[int, List[Tuple[bytes, PageArrays]]]:
+    """Inverse of encode_pages. Returns (weight_version, pages).
+    Raises ValueError on a malformed payload (the fetch path treats
+    that as a miss, not a crash)."""
+    if len(data) < 8 or data[:4] != _MAGIC:
+        raise ValueError('bad kv transfer magic')
+    (hlen,) = struct.unpack('<I', data[4:8])
+    if 8 + hlen > len(data):
+        raise ValueError('truncated kv transfer header')
+    header = json.loads(data[8:8 + hlen].decode('utf-8'))
+    off = 8 + hlen
+    out: List[Tuple[bytes, PageArrays]] = []
+    for entry in header['pages']:
+        arrays: PageArrays = {}
+        for spec in entry['arrays']:
+            dt = _np_dtype(spec['dtype'])
+            n = int(np.prod(spec['shape'])) * dt.itemsize
+            if off + n > len(data):
+                raise ValueError('truncated kv transfer body')
+            arrays[spec['name']] = np.frombuffer(
+                data[off:off + n], dtype=dt).reshape(spec['shape'])
+            off += n
+        out.append((bytes.fromhex(entry['hash']), arrays))
+    return int(header['weight_version']), out
+
+
+def page_nbytes(arrays: PageArrays) -> int:
+    return sum(int(a.nbytes) for a in arrays.values())
+
+
+class HostKVStore:
+    """Thread-safe byte-budgeted LRU of spilled prefix pages (L2).
+
+    Keys are the pool's chained content hashes; values carry the
+    weight_version they were computed under. ``set_version`` is the
+    swap-invalidation hook: it prunes every entry of another version
+    AND gates future puts, so a spill snapshot taken before a swap can
+    never land (and later serve) after it.
+    """
+
+    def __init__(self, budget_bytes: int) -> None:
+        self.budget_bytes = max(0, int(budget_bytes))
+        self._lock = threading.Lock()
+        # hash -> (weight_version, arrays, nbytes); insertion order is
+        # recency (move_to_end on hit).
+        self._entries: 'collections.OrderedDict[bytes, Tuple[int, PageArrays, int]]' = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._version: Optional[int] = None
+        self.stats = {'puts': 0, 'put_drops': 0, 'evictions': 0,
+                      'hits': 0, 'misses': 0, 'invalidated': 0}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def set_version(self, version: int) -> int:
+        """Invalidate every entry not computed under `version` and gate
+        future puts to it. Returns entries dropped."""
+        with self._lock:
+            self._version = int(version)
+            stale = [h for h, (v, _, _) in self._entries.items()
+                     if v != self._version]
+            for h in stale:
+                _, _, nb = self._entries.pop(h)
+                self._bytes -= nb
+            self.stats['invalidated'] += len(stale)
+            return len(stale)
+
+    def put(self, h: bytes, version: int, arrays: PageArrays) -> bool:
+        nb = page_nbytes(arrays)
+        with self._lock:
+            if self._version is not None and int(version) != self._version:
+                self.stats['put_drops'] += 1   # stale spill: post-swap
+                return False
+            if nb > self.budget_bytes:
+                self.stats['put_drops'] += 1
+                return False
+            old = self._entries.pop(h, None)
+            if old is not None:
+                self._bytes -= old[2]
+            while self._bytes + nb > self.budget_bytes and self._entries:
+                _, (_, _, enb) = self._entries.popitem(last=False)
+                self._bytes -= enb
+                self.stats['evictions'] += 1
+            self._entries[h] = (int(version), arrays, nb)
+            self._bytes += nb
+            self.stats['puts'] += 1
+            return True
+
+    def get(self, h: bytes, version: int) -> Optional[PageArrays]:
+        with self._lock:
+            ent = self._entries.get(h)
+            if ent is None or ent[0] != int(version):
+                self.stats['misses'] += 1
+                return None
+            self._entries.move_to_end(h)
+            self.stats['hits'] += 1
+            return ent[1]
+
+    def contains(self, h: bytes, version: int) -> bool:
+        """Cheap presence probe (no recency bump, no stats) — the
+        admission peek loops call this per queued request."""
+        with self._lock:
+            ent = self._entries.get(h)
+            return ent is not None and ent[0] == int(version)
+
+    def run(self, hashes: Sequence[bytes], version: int
+            ) -> List[Tuple[bytes, PageArrays]]:
+        """The leading resident run of `hashes` at `version` — the
+        host-tier analog of PagePool.prefix_peek."""
+        out: List[Tuple[bytes, PageArrays]] = []
+        for h in hashes:
+            arrays = self.get(h, version)
+            if arrays is None:
+                break
+            out.append((h, arrays))
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {'entries': len(self._entries), 'bytes': self._bytes,
+                    'budget_bytes': self.budget_bytes,
+                    **{k: int(v) for k, v in self.stats.items()}}
+
+
+def fetch_pages(peer: str, hashes: Sequence[bytes], token: str,
+                timeout_s: float, max_pages: int
+                ) -> Tuple[int, List[Tuple[bytes, PageArrays]]]:
+    """GET a page run from a peer replica's /kv/prefix (L3). Runs on
+    the engine's fetch worker thread — never the engine loop. The
+    ``kv.fetch`` fault point injects here: 'error' raises (degrade to
+    recompute), 'latency'/'hang' stall only this worker (the loop
+    abandons the wait at its own deadline). Raises on any transport or
+    payload problem; the caller converts every failure to a recompute,
+    never a client-visible error."""
+    import requests
+    faults.inject('kv.fetch', peer=peer)
+    qs = ','.join(h.hex() for h in list(hashes)[:max_pages])
+    headers = {'Authorization': f'Bearer {token}'} if token else {}
+    r = requests.get(peer.rstrip('/') + '/kv/prefix',
+                     params={'hashes': qs}, headers=headers,
+                     timeout=timeout_s)
+    if r.status_code != 200:
+        raise RuntimeError(f'peer {peer} /kv/prefix -> {r.status_code}')
+    return decode_pages(r.content)
+
+
+class KVTierManager:
+    """The engine's handle on the outer tiers: the host store, the
+    async spill writer, and the fetch worker. Owned by the engine;
+    constructed only when SKYT_KV_TIER != 'off' — the off path never
+    touches this module."""
+
+    def __init__(self, tier: str, *,
+                 host_bytes: Optional[int] = None,
+                 fetch_max_pages: Optional[int] = None,
+                 fetch_timeout_s: Optional[float] = None) -> None:
+        assert tier in ('host', 'fleet'), tier
+        self.tier = tier
+        self.fleet = tier == 'fleet'
+        self.host = HostKVStore(
+            host_bytes if host_bytes is not None
+            else env.get_int('SKYT_KV_HOST_BYTES', 256 * 1024 * 1024))
+        self.fetch_max_pages = (
+            fetch_max_pages if fetch_max_pages is not None
+            else max(1, env.get_int('SKYT_KV_FETCH_MAX_PAGES', 64)))
+        self.fetch_timeout_s = (
+            fetch_timeout_s if fetch_timeout_s is not None
+            else env.get_float('SKYT_KV_FETCH_TIMEOUT_S', 2.0))
+        # Spill queue: (hash, version, device-array dict). Bounded —
+        # under eviction storms dropping a spill only costs a future
+        # recompute, while an unbounded queue would pin device arrays.
+        self._spill_q: 'collections.deque[Tuple[bytes, int, Dict[str, Any]]]' = \
+            collections.deque()
+        self._spill_limit = 256
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._writer: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # Monotone tier counters (the engine delta-folds them into the
+        # skyt_infer_kv_tier_hit_pages_total{tier} metric).
+        self.stats = {'spill_enqueued': 0, 'spill_dropped': 0,
+                      'spill_stored': 0, 'promotions': 0,
+                      'promoted_pages': 0, 'fetches': 0,
+                      'fetch_errors': 0, 'fetched_pages': 0}
+
+    # ------------------------------------------------------ spill (L2)
+    def start(self) -> None:
+        if self._writer is None or not self._writer.is_alive():
+            self._stop.clear()
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name='kv-tier-writer')
+            self._writer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._writer is not None:
+            self._writer.join(timeout=5)
+
+    def enqueue_spill(self, h: bytes, version: int,
+                      device_arrays: Dict[str, Any]) -> None:
+        """Engine-loop side of the async eviction writer: the caller
+        has already taken eager device slices (dispatched BEFORE the
+        overwriting insert, so their contents are the pre-eviction
+        page); the writer thread pulls them to host RAM off the loop."""
+        with self._lock:
+            if len(self._spill_q) >= self._spill_limit:
+                self.stats['spill_dropped'] += 1
+                return
+            self._spill_q.append((h, int(version), device_arrays))
+            self.stats['spill_enqueued'] += 1
+        self._wake.set()
+
+    def _writer_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._lock:
+                    item = self._spill_q.popleft() if self._spill_q \
+                        else None
+            except IndexError:
+                item = None
+            if item is None:
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            h, version, device_arrays = item
+            try:
+                # np.asarray blocks THIS thread until the device slice
+                # is ready — the device->host copy the loop never pays.
+                arrays = {k: np.asarray(v)
+                          for k, v in device_arrays.items()}
+                if self.host.put(h, version, arrays):
+                    with self._lock:
+                        self.stats['spill_stored'] += 1
+            except Exception:  # pylint: disable=broad-except
+                # Best-effort tier: a failed spill costs a future
+                # recompute, never a serving failure.
+                logger.exception('kv spill write failed')
+
+    def drain(self, timeout_s: float = 5.0) -> bool:
+        """Block until the spill queue is empty (tests/benches)."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._spill_q:
+                    return True
+            self._wake.set()
+            time.sleep(0.01)
+        return False
+
+    # ------------------------------------------------------ fetch (L3)
+    def fetch_into_host(self, peer: str, hashes: Sequence[bytes],
+                        version: int, token: str) -> int:
+        """Fetch a page run from `peer` and land it in the host store
+        (the re-admitted request then promotes host->device through
+        the same splice as an L2 hit). Returns pages stored; raises on
+        failure (the worker converts that to a recompute)."""
+        with self._lock:
+            self.stats['fetches'] += 1
+        peer_version, pages = fetch_pages(
+            peer, hashes, token, self.fetch_timeout_s,
+            self.fetch_max_pages)
+        if peer_version != int(version):
+            # The peer is serving another weight version: its KV must
+            # never splice into this engine (invalidation contract).
+            raise RuntimeError(
+                f'peer {peer} weight_version {peer_version} != '
+                f'local {version}')
+        stored = 0
+        for h, arrays in pages:
+            if self.host.put(h, version, arrays):
+                stored += 1
+        with self._lock:
+            self.stats['fetched_pages'] += stored
+        return stored
+
+    def note_fetch_error(self) -> None:
+        with self._lock:
+            self.stats['fetch_errors'] += 1
+
+    def note_promotion(self, pages: int) -> None:
+        with self._lock:
+            self.stats['promotions'] += 1
+            self.stats['promoted_pages'] += pages
+
+    # ------------------------------------------------------- reporting
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            stats = dict(self.stats)
+        return {'tier': self.tier, 'host': self.host.snapshot(), **stats}

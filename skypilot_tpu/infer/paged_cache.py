@@ -168,6 +168,13 @@ class PagePool:
             collections.OrderedDict()
         self.prefix_stats = {'hit_pages': 0, 'miss_pages': 0,
                              'evictions': 0}
+        # Spillover hook (infer/kv_tier.py): called as on_evict(page, h)
+        # when _alloc_page reclaims a published page — the one moment a
+        # page's KV is about to be lost. NOT called from flush_prefix:
+        # version-invalidated pages must not outlive the swap in any
+        # tier. The engine wraps its hook defensively; pool accounting
+        # must not depend on it.
+        self.on_evict = None
 
     # --------------------------------------------------- host accounting
     def pages_needed(self, total_tokens: int) -> int:
@@ -194,6 +201,8 @@ class PagePool:
             h = self._page_hash.pop(page)
             del self._registry[h]
             self.prefix_stats['evictions'] += 1
+            if self.on_evict is not None:
+                self.on_evict(page, h)
             return page
         return None
 
@@ -224,6 +233,11 @@ class PagePool:
         self._cached_free.clear()
         return flushed
 
+    def registered_page(self, h: bytes) -> Optional[int]:
+        """Page currently published under hash `h`, or None — the KV
+        export path (/kv/prefix) resolves hash runs through this."""
+        return self._registry.get(h)
+
     def prefix_peek(self, lookup_hashes) -> int:
         """Length of the leading registered-page run for these hashes —
         a READ-ONLY probe of what try_reserve_prefix would share (no
@@ -236,6 +250,31 @@ class PagePool:
                 break
             n += 1
         return n
+
+    def install_prefix(self, hashes: Sequence[bytes]
+                       ) -> Optional[List[int]]:
+        """Allocate and register one page per hash at refcount 0 (warm
+        LRU), for pages whose contents arrive from an outer tier (host
+        promotion / fleet fetch) instead of a slot's prefill. The
+        caller must write the page contents before any reservation can
+        read them — same single-dispatch-chain ordering contract as
+        publish(). Draws from the plain free list ONLY: promotion must
+        never evict already-published pages (that would churn the warm
+        set it is trying to grow). Returns the page ids, or None if
+        the free list cannot cover the run or a hash is already
+        registered (the caller re-peeks instead)."""
+        new = [h for h in hashes if h not in self._registry]
+        if len(new) != len(hashes) or len(new) > len(self._free):
+            return None
+        pages: List[int] = []
+        for h in new:
+            page = self._free.pop()
+            self._registry[h] = page
+            self._page_hash[page] = h
+            self._cached_free[page] = None
+            self._cached_free.move_to_end(page)
+            pages.append(page)
+        return pages
 
     def try_reserve(self, slot: int, total_tokens: int) -> Optional[np.ndarray]:
         """Reserve pages covering total_tokens for `slot`. Returns the

@@ -606,6 +606,80 @@ class InferenceServer:
                 status=400)
         return web.json_response(result)
 
+    @staticmethod
+    def _kv_peer_from(request: web.Request) -> Optional[str]:
+        """The LB's X-KV-Peer hint (base URL of the replica its
+        rendezvous ring designates as this prefix's owner), validated
+        to an http(s) URL — anything else is dropped, never an
+        error (the hint is advisory; SKYT_KV_TIER=off engines ignore
+        it entirely)."""
+        peer = request.headers.get('X-KV-Peer', '').strip()
+        if peer.startswith(('http://', 'https://')) and \
+                len(peer) <= 512:
+            return peer
+        return None
+
+    async def _kv_prefix(self, request: web.Request) -> web.Response:
+        """``GET /kv/prefix?hashes=<hex16>,...`` — serve this replica's
+        leading resident run of a prefix-page hash chain (HBM registry
+        first, host-store continuation), encoded with the engine's
+        weight_version (infer/kv_tier.py codec; docs/performance.md
+        "Tiered prefix cache"). Peers fetch through this on a local
+        miss. Auth mirrors /admin/weights: KV pages are model
+        activations — reachability alone must never be enough. 404
+        (not 5xx) when nothing is resident or tiering is off."""
+        token = env_lib.get('SKYT_ADMIN_TOKEN')
+        if not token:
+            return web.json_response(
+                {'error': 'kv transfer disabled: start the replica '
+                          'with SKYT_ADMIN_TOKEN set'}, status=403)
+        import hmac
+        got = request.headers.get('Authorization', '')
+        if not hmac.compare_digest(
+                got.encode('utf-8', 'surrogateescape'),
+                f'Bearer {token}'.encode('utf-8')):
+            return web.json_response(
+                {'error': 'unauthorized: missing or bad Authorization '
+                          'bearer token'}, status=403)
+        raw = request.query.get('hashes', '')
+        hashes: List[bytes] = []
+        for part in raw.split(','):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                h = bytes.fromhex(part)
+            except ValueError:
+                h = b''
+            if len(h) != 16:   # chained blake2b-16 page hashes
+                return web.json_response(
+                    {'error': f'hashes must be 32-hex-char page '
+                              f'hashes, got {part[:40]!r}'}, status=400)
+            hashes.append(h)
+        if not hashes:
+            return web.json_response(
+                {'error': 'need ?hashes=<hex>,<hex>,...'}, status=400)
+        max_pages = env_lib.get_int('SKYT_KV_FETCH_MAX_PAGES', 64)
+        loop = asyncio.get_running_loop()
+        try:
+            body = await loop.run_in_executor(
+                None, functools.partial(self.engine.kv_export_encoded,
+                                        hashes, max_pages))
+        except Exception:  # pylint: disable=broad-except
+            # A failed export is a cache miss to the peer, never a 5xx
+            # chain (it would recompute anyway).
+            logger.exception('kv export failed')
+            body = None
+        if not body:
+            return web.json_response(
+                {'error': 'no resident pages for this hash run'},
+                status=404)
+        return web.Response(
+            body=body,
+            headers={'Content-Type': 'application/octet-stream',
+                     'X-Weight-Version':
+                         str(self.engine.weight_version)})
+
     async def _health(self, request: web.Request) -> web.Response:
         del request
         if self.engine.ready.is_set():
@@ -716,7 +790,8 @@ class InferenceServer:
         err = self._params_error(params)
         if err is not None:
             return web.json_response({'error': err}, status=400)
-        req_id, out_q = self.engine.submit(tokens, params)
+        req_id, out_q = self.engine.submit(
+            tokens, params, kv_peer=self._kv_peer_from(request))
         # Seen by the tracing middleware after the handler returns:
         # the engine's phase trace for each id is bridged in as child
         # spans of this request's server span.
@@ -1158,7 +1233,8 @@ class InferenceServer:
         # n completions per prompt, choices prompt-major (OpenAI
         # layout). Distinct req_ids already decorrelate the sampling
         # streams (device keys seed with seed + req_id).
-        subs = [self.engine.submit(t, params)
+        kv_peer = self._kv_peer_from(request)
+        subs = [self.engine.submit(t, params, kv_peer=kv_peer)
                 for t in token_lists for _ in range(n)]
         request['skyt_engine_rids'] = [r for r, _ in subs]
 
@@ -1281,7 +1357,9 @@ class InferenceServer:
                 status=400)
         tokens = self.tokenizer.encode(
             self._apply_chat_template(messages))
-        subs = [self.engine.submit(tokens, params) for _ in range(n)]
+        kv_peer = self._kv_peer_from(request)
+        subs = [self.engine.submit(tokens, params, kv_peer=kv_peer)
+                for _ in range(n)]
         request['skyt_engine_rids'] = [r for r, _ in subs]
         rid = subs[0][0]
 
@@ -1426,6 +1504,7 @@ class InferenceServer:
         app.router.add_get('/debug/traces', self._debug_traces)
         app.router.add_post('/debug/profile', self._debug_profile)
         app.router.add_post('/admin/weights', self._admin_weights)
+        app.router.add_get('/kv/prefix', self._kv_prefix)
         app.router.add_post('/generate', self._generate)
         app.router.add_get('/v1/models', self._models)
         app.router.add_post('/v1/completions', self._completions)

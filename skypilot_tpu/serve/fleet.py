@@ -368,6 +368,60 @@ class FleetTelemetry:
             'local_profiles': local,
         }
 
+    def kv_report(self, window_s: float = 600.0,
+                  now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /fleet/kv`` body (docs/performance.md "Tiered
+        prefix cache"): per-replica prefix-cache economy from the
+        scraped series — resident published pages and pool occupancy
+        (latest samples), plus windowed increases of hit/miss/eviction
+        counters and the per-tier hit-page counters (hbm / host /
+        fleet). The fleet view answers 'where are prefixes resident
+        and which replicas are serving them to peers?'."""
+        if now is None:
+            now = self._clock()
+        replicas = self.live_replicas(now)
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in replicas
+                      if t in self._stores]
+        out_targets: Dict[str, Dict[str, Any]] = {}
+        for target, store in stores:
+            info: Dict[str, Any] = {}
+            for fam, field in (
+                    ('skyt_infer_prefix_cache_pages', 'resident_pages'),
+                    ('skyt_infer_prefix_cache_occupancy', 'occupancy')):
+                for name, labels in store.series_keys():
+                    if name == fam:
+                        pt = store.latest(name, labels)
+                        if pt is not None:
+                            info[field] = pt[1]
+                        break
+            for fam, field in (
+                    ('skyt_infer_prefix_cache_hit_pages_total',
+                     'hit_pages'),
+                    ('skyt_infer_prefix_cache_miss_pages_total',
+                     'miss_pages'),
+                    ('skyt_infer_prefix_cache_evictions_total',
+                     'evictions')):
+                inc = store.sum_delta(fam, None, window_s, now=now)
+                if inc is not None:
+                    info[field] = inc
+            tiers = store.grouped_delta(
+                'skyt_infer_kv_tier_hit_pages_total', 'tier',
+                window_s, now=now)
+            if tiers:
+                info['tier_hit_pages'] = tiers
+            if info:
+                out_targets[target] = info
+        tier_totals = self.grouped_delta(
+            'skyt_infer_kv_tier_hit_pages_total', 'tier', window_s,
+            now=now)
+        return {
+            'service': self.service_name,
+            'window_s': window_s,
+            'targets': out_targets,
+            'tier_hit_pages': tier_totals,
+        }
+
     def capacity_report(self, window_s: Optional[float] = None,
                         now: Optional[float] = None) -> Dict[str, Any]:
         """The ``GET /fleet/capacity`` body (docs/observability.md
@@ -594,6 +648,25 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
                                     window_s=window_f))
         return web.json_response(payload)
 
+    async def fleet_kv(request: web.Request) -> web.Response:
+        """KV-economy aggregate (docs/performance.md "Tiered prefix
+        cache"): per-replica resident prefix pages / occupancy and
+        windowed per-tier hit-page increases."""
+        window = request.query.get('window_s')
+        try:
+            window_f = float(window) if window else 600.0
+            if window_f <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'window_s must be a positive number, got '
+                          f'{window!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, functools.partial(telemetry.kv_report,
+                                    window_s=window_f))
+        return web.json_response(payload)
+
     async def fleet_postmortems(request: web.Request) -> web.Response:
         """Index of postmortem crash bundles visible to this
         controller (SKYT_POSTMORTEM_DIR; train/postmortem.py): the
@@ -621,5 +694,6 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
     app.router.add_get('/fleet/slo', fleet_slo)
     app.router.add_get('/fleet/comms', fleet_comms)
     app.router.add_get('/fleet/capacity', fleet_capacity)
+    app.router.add_get('/fleet/kv', fleet_kv)
     app.router.add_get('/fleet/postmortems', fleet_postmortems)
     app.router.add_post('/fleet/profile', fleet_profile)

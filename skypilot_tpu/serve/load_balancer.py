@@ -1524,7 +1524,9 @@ class SkyServeLoadBalancer:
                 self._m_inflight.labels(self.lb_id, replica).inc()
                 try:
                     result = await self._proxy_to(
-                        request, replica, body, req_id, attempt)
+                        request, replica, body, req_id, attempt,
+                        kv_peer=self._kv_peer_hint(affinity_key,
+                                                   replica))
                 finally:
                     self._m_inflight.labels(self.lb_id, replica).dec()
                     self.policy.on_request_done(replica)
@@ -1567,6 +1569,29 @@ class SkyServeLoadBalancer:
                                delay_ms=round(delay * 1e3, 1))
                 await asyncio.sleep(delay)
 
+    def _kv_peer_hint(self, affinity_key: Optional[str],
+                      replica: str) -> Optional[str]:
+        """X-KV-Peer hint for the tiered prefix cache (docs/
+        performance.md "Tiered prefix cache"): the highest-ranked
+        OTHER replica on the rendezvous ring for this prefix. For the
+        ring's own first choice (e.g. a just-restarted owner) that is
+        the failover replica that absorbed its traffic — the peer
+        most likely to hold its pages; for spill-routed traffic it is
+        the owner itself. Advisory only: replicas without
+        SKYT_KV_TIER=fleet ignore the header."""
+        if affinity_key is None or not self.policy.uses_affinity:
+            return None
+        try:
+            ring = getattr(self.policy, 'ring', None)
+            if ring is None:
+                return None
+            for r in ring.ranked(affinity_key):
+                if r != replica:
+                    return r
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('kv peer hint failed')
+        return None
+
     def _upstream_timeout(self) -> aiohttp.ClientTimeout:
         """Connect/total upstream timeouts (satellite: total used to be
         hardwired to None). total=0 keeps 'unlimited' — correct for
@@ -1579,7 +1604,8 @@ class SkyServeLoadBalancer:
 
     async def _proxy_to(
             self, request: web.Request, replica: str, body: bytes,
-            req_id: str, attempt: int
+            req_id: str, attempt: int,
+            kv_peer: Optional[str] = None
     ) -> Union[web.StreamResponse, BaseException]:
         """One upstream attempt. Returns the client-facing response on
         success OR after headers went out (no longer retryable — a
@@ -1592,6 +1618,8 @@ class SkyServeLoadBalancer:
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
         headers['X-Request-Id'] = req_id
+        if kv_peer:
+            headers['X-KV-Peer'] = kv_peer
         with self._tracer.start_span(
                 'lb.proxy',
                 attributes={'replica': replica, 'attempt': attempt,

@@ -172,6 +172,23 @@ _var('SKYT_RAGGED_MAX_TOKENS', 'int', 0,
 _var('SKYT_RING_IMPL', 'str', None,
      'Ring-attention impl override ("xla" forces the XLA path).')
 
+# ------------------------------------------------- tiered prefix cache
+_var('SKYT_KV_TIER', 'str', 'off',
+     'Prefix-KV cache tiering: "off" (HBM only, the byte-for-byte '
+     'hot path), "host" (spill evicted pages to a host-RAM LRU and '
+     'promote on miss), or "fleet" (host tier + cross-replica page '
+     'fetch over GET /kv/prefix). Requires paged cache + prefix '
+     'caching; ignored (with a warning) under lockstep.')
+_var('SKYT_KV_HOST_BYTES', 'int', 256 * 1024 * 1024,
+     'Byte budget of the host-RAM prefix-page LRU (L2). Evicted '
+     'int8 pages + scale rows (or model-dtype pages) spill here.')
+_var('SKYT_KV_FETCH_MAX_PAGES', 'int', 64,
+     'Cap on pages per cross-replica /kv/prefix transfer, enforced '
+     'on both the requesting engine and the serving endpoint.')
+_var('SKYT_KV_FETCH_TIMEOUT_S', 'float', 2.0,
+     'HTTP timeout of one cross-replica KV fetch; the engine '
+     'abandons the fetch (and recomputes) at 1.5x this deadline.')
+
 # -------------------------------------------------------- comms plane
 _var('SKYT_COMMS_PROBE_MB', 'str', '1,16',
      'Comma-separated per-device payload sweep (MiB) of the comms '

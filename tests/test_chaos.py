@@ -1735,13 +1735,14 @@ def test_preemption_guard_immediate_exit_during_startup():
 
 
 # ==================================== real stack: replica kill mid-burst
-def _spawn_replica(port: int, extra_env=None) -> subprocess.Popen:
+def _spawn_replica(port: int, extra_env=None,
+                   max_seq_len: int = 64) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, '-m', 'skypilot_tpu.infer.server',
          '--model', 'debug', '--port', str(port),
-         '--num-slots', '2', '--max-seq-len', '64'],
+         '--num-slots', '2', '--max-seq-len', str(max_seq_len)],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
@@ -2553,3 +2554,119 @@ def test_chaos_rollout_resume_after_controller_sigkill(
             except requests.RequestException:
                 pass
             ctrl.kill()
+
+
+@pytest.mark.integration
+def test_chaos_kv_warm_restart_drill(monkeypatch):
+    """Tiered-KV warm restart (docs/performance.md "Tiered prefix
+    cache"): two SKYT_KV_TIER=fleet replica processes behind a
+    prefix-affinity LB; the prefix's owner is SIGKILLed mid-burst
+    (failover publishes the prefix on the survivor, zero 5xx), then
+    relaunched on the same port. The relaunched replica warms from its
+    peer over /kv/prefix — fleet-tier hits > 0 — and every burst's
+    token stream is byte-identical to the pre-kill golden."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    kv_env = {'SKYT_KV_TIER': 'fleet', 'SKYT_ADMIN_TOKEN': 'kv-drill'}
+    p1, p2 = _free_port(), _free_port()
+    urls = [f'http://127.0.0.1:{p1}', f'http://127.0.0.1:{p2}']
+    procs = {urls[0]: _spawn_replica(p1, kv_env, max_seq_len=128),
+             urls[1]: _spawn_replica(p2, kv_env, max_seq_len=128)}
+    # One shared 100-token prompt: its first 64-token page is the
+    # prefix the fleet economy moves between replicas.
+    prompt = [(j * 37) % 97 + 3 for j in range(100)]
+    body = {'tokens': prompt, 'max_tokens': 8}
+    try:
+        for url in urls:
+            _wait_http(url + '/health', timeout=300,
+                       proc=procs[url])
+        for k, v in (('SKYT_SERVE_LB_SYNC_INTERVAL', '3600'),
+                     ('SKYT_LB_RETRY_BACKOFF_S', '0.02'),
+                     ('SKYT_LB_BREAKER_THRESHOLD', '2'),
+                     ('SKYT_LB_BREAKER_COOLDOWN_S', '1')):
+            monkeypatch.setenv(k, v)
+        lb_port = _free_port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:9', lb_port, policy='prefix_affinity',
+            metrics_registry=metrics_lib.MetricsRegistry())
+        lb.policy.set_ready_replicas(list(urls))
+        _run_app_bg(lb.make_app(), lb_port)
+        base = f'http://127.0.0.1:{lb_port}'
+        _wait_http(base + '/metrics', timeout=30)
+
+        def burst(n=4):
+            out = []
+            for _ in range(n):
+                r = requests.post(base + '/generate', json=body,
+                                  timeout=120)
+                out.append((r.status_code,
+                            r.headers.get('X-Replica-Id'),
+                            tuple(r.json().get('tokens', ()))
+                            if r.status_code == 200 else None))
+            return out
+
+        # Warm burst: the affinity ring homes every request on one
+        # owner; later requests prefix-hit its published page.
+        first = burst()
+        assert all(code == 200 for code, _, _ in first), first
+        owner = first[0][1]
+        assert owner in urls and \
+            all(rep == owner for _, rep, _ in first), first
+        golden = first[0][2]
+        assert len(golden) == 8
+        assert all(toks == golden for _, _, toks in first), first
+        survivor = urls[1 - urls.index(owner)]
+
+        # Kill the owner MID-burst: concurrent requests fail over to
+        # the survivor — zero client-visible 5xx, identical streams —
+        # and the survivor now holds (and publishes) the prefix.
+        results, lock = [], threading.Lock()
+
+        def one():
+            r = requests.post(base + '/generate', json=body,
+                              timeout=120)
+            with lock:
+                results.append((r.status_code,
+                                tuple(r.json().get('tokens', ()))
+                                if r.status_code == 200 else None))
+
+        threads = [threading.Thread(target=one) for _ in range(6)]
+        for th in threads[:2]:
+            th.start()
+        procs[owner].kill()
+        for th in threads[2:]:
+            th.start()
+        for th in threads:
+            th.join(timeout=180)
+        assert len(results) == 6
+        assert all(code == 200 for code, _ in results), results
+        assert all(toks == golden for _, toks in results), results
+
+        # Relaunch the owner on ITS port (cold HBM, empty host store)
+        # and let the breaker's cooldown lapse.
+        procs[owner] = _spawn_replica(
+            int(owner.rsplit(':', 1)[1]), kv_env, max_seq_len=128)
+        _wait_http(owner + '/health', timeout=300, proc=procs[owner])
+        time.sleep(1.2)
+
+        # Re-burst: the ring still homes the key on the relaunched
+        # owner; the LB's X-KV-Peer hint names the survivor and the
+        # owner warms from it instead of recomputing.
+        deadline = time.time() + 60
+        warmed = None
+        while time.time() < deadline:
+            third = burst(2)
+            assert all(code == 200 for code, _, _ in third), third
+            assert all(toks == golden for _, _, toks in third), third
+            stats = requests.get(owner + '/stats', timeout=30).json()
+            warmed = stats.get('kv_tier')
+            if warmed and warmed.get('fetched_pages', 0) > 0:
+                break
+            time.sleep(0.5)
+        assert warmed and warmed['fetched_pages'] > 0, warmed
+        assert warmed['promotions'] > 0, warmed
+        served = requests.get(owner + '/stats', timeout=30).json()
+        assert served['prefix_cache']['hit_pages'] > 0, served
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()

@@ -1390,6 +1390,115 @@ else
     FAIL=1
 fi
 
+echo "== 18. tiered KV cache: cross-replica page transfer on-chip —"
+echo "   two fleet-tier replicas, golden prompt seeded on the donor,"
+echo "   refetched via X-KV-Peer on the cold replica; asserts the"
+echo "   fetched stream is byte-identical and /kv/prefix is authed"
+echo "   (docs/performance.md 'Tiered prefix cache') =="
+if SKYT_VALIDATION_OUT="$OUT" timeout 900 python - \
+        <<'PYEOF' 2>&1 | tee "$OUT/kv_tier_drill.txt"
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+OUT = os.environ['SKYT_VALIDATION_OUT']
+ART = os.path.join(OUT, 'kv_tier_drill.json')
+TOKEN = 'kv-validation'
+
+
+def artifact(status, **kw):
+    rec = {'status': status, 'step': 'kv_tier_drill', **kw}
+    with open(ART, 'w') as f:
+        json.dump(rec, f, sort_keys=True)
+    print(f'kv tier artifact: {json.dumps(rec, sort_keys=True)}')
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+ports = [free_port(), free_port()]
+env = dict(os.environ, SKYT_KV_TIER='fleet', SKYT_ADMIN_TOKEN=TOKEN)
+procs = [subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(p),
+     '--num-slots', '2', '--max-seq-len', '128'], env=env)
+    for p in ports]
+bases = [f'http://127.0.0.1:{p}' for p in ports]
+try:
+    for proc, base in zip(procs, bases):
+        deadline = time.time() + 480
+        while time.time() < deadline:
+            try:
+                if requests.get(base + '/health',
+                                timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            if proc.poll() is not None:
+                artifact('replica_died', rc=proc.returncode)
+                raise SystemExit(f'server died rc={proc.returncode}')
+            time.sleep(1)
+        else:
+            artifact('replica_unhealthy', timeout_s=480)
+            raise SystemExit('server never became healthy')
+
+    donor, fetcher = bases
+    # 100 tokens = one full publishable 64-token page on the donor;
+    # greedy so the streams must match byte for byte.
+    prompt = [(j * 37) % 97 + 3 for j in range(100)]
+    body = {'tokens': prompt, 'max_tokens': 8}
+    golden = requests.post(donor + '/generate', json=body,
+                           timeout=300).json()['tokens']
+
+    # Donor endpoint auth: no bearer -> 403 (the fetch worker sends
+    # SKYT_ADMIN_TOKEN; an unauthed scrape must not leak KV bytes).
+    rc = requests.get(donor + '/kv/prefix?hashes=' + 'ab' * 8,
+                      timeout=10).status_code
+    assert rc == 403, f'/kv/prefix without bearer returned {rc}'
+
+    # Cold replica + X-KV-Peer hint: pages are fetched from the
+    # donor over HTTP, promoted through the host store, spliced in,
+    # and the stream must equal the donor's golden.
+    got = requests.post(fetcher + '/generate', json=body,
+                        headers={'X-KV-Peer': donor},
+                        timeout=300).json()['tokens']
+    stats = requests.get(fetcher + '/stats', timeout=10).json()
+    tier = stats.get('kv_tier') or {}
+    fetched = tier.get('fetched_pages', 0)
+    promoted = tier.get('promotions', 0)
+    identical = got == golden
+    assert fetched > 0, f'no pages fetched from peer: {tier}'
+    assert promoted > 0, f'no host->device promotions: {tier}'
+    assert identical, f'fetched stream diverged: {got} != {golden}'
+    artifact('ok', fleet_fetched_pages=fetched,
+             promotions=promoted, byte_identical=identical,
+             prefix_cache=stats.get('prefix_cache', {}))
+    print(f'KV_TIER_DRILL_OK fetched_pages={fetched} '
+          f'promotions={promoted} byte_identical={identical}')
+finally:
+    for proc in procs:
+        proc.terminate()
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+PYEOF
+then
+    echo "== kv tier drill: PASS =="
+else
+    echo "== kv tier drill: FAIL (see $OUT/kv_tier_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
