@@ -950,6 +950,14 @@ class InferenceEngine:
         if tier != 'off':
             self.kv_tier = kv_tier_lib.KVTierManager(tier)
             self.pool.on_evict = self._kv_spill
+            # Per-page array layout ([L, H, P(, d)] at pool dtype) the
+            # tier validates fetched pages against before they can
+            # reach the promote/install path.
+            self.kv_tier.set_page_layout({
+                name: (np.dtype(self.cache[name].dtype),
+                       tuple(self.cache[name].shape[:1]
+                             + self.cache[name].shape[2:]))
+                for name in self._kv_pool_keys()})
             self._m_kv_tier_hits = reg.counter(
                 'skyt_infer_kv_tier_hit_pages_total',
                 'Prefix pages served per cache tier: hbm = registry '
@@ -1318,6 +1326,19 @@ class InferenceEngine:
         if have >= len(lookup):
             return 0
         run = self.kv_tier.host.run(lookup[have:], self.weight_version)
+        # Belt-and-suspenders before install_prefix registers anything:
+        # a page that does not match the pool layout (should be
+        # unreachable — spills come from this pool and fetches are
+        # validated on ingest) truncates the run at the first offender,
+        # which is also purged so it cannot re-trip every admission.
+        for i, (h, arrays) in enumerate(run):
+            bad = self.kv_tier.validate_page(arrays)
+            if bad is not None:
+                logger.warning('kv host page %s rejected: %s',
+                               h.hex(), bad)
+                self.kv_tier.host.discard(h)
+                run = run[:i]
+                break
         if not run:
             return 0
         pages = self.pool.install_prefix([h for h, _ in run])
@@ -1408,9 +1429,17 @@ class InferenceEngine:
                 if self._deferred is None:
                     self._deferred = req
                 else:
-                    # Re-queue of an ALREADY-ADMITTED request whose
-                    # class was assigned at submit; no bypass.
-                    self._waiting.put(req)   # qos-admission (sanctioned)
+                    # Head re-queue (the pool-full path's _deferred
+                    # discipline): the request already waited out the
+                    # fetch — a tail put would additionally forfeit its
+                    # FIFO/QoS position to everything that arrived
+                    # meanwhile. Direct deque access under the queue
+                    # mutex is the sanctioned requeue pattern (see
+                    # _reserve_admission_batch); this is an
+                    # ALREADY-ADMITTED request whose class was assigned
+                    # at submit; no bypass.
+                    with self._waiting.mutex:
+                        self._waiting.queue.appendleft(req)
         if self._kv_export_q:
             self._kv_drain_exports()
 
@@ -2858,11 +2887,26 @@ class InferenceEngine:
                 # the request behind a cross-replica fetch (L3). The
                 # reserve below then shares whatever landed; every
                 # failure mode falls through to plain recompute.
-                self._kv_try_promote(req)
-                if self.kv_tier.fleet and req.kv_peer and \
-                        req.kv_fetch is None and \
-                        self._kv_fetching is None and \
-                        self._kv_start_fetch(req):
+                try:
+                    self._kv_try_promote(req)
+                    parked = (self.kv_tier.fleet and req.kv_peer and
+                              req.kv_fetch is None and
+                              self._kv_fetching is None and
+                              self._kv_start_fetch(req))
+                except Exception:  # pylint: disable=broad-except
+                    # The tier must never fail admission: any splice
+                    # error (poisoned page, install bug) degrades to
+                    # plain recompute, not a loop crash that would
+                    # fail every in-flight request.
+                    logger.exception('kv tier admission splice failed; '
+                                     'recomputing')
+                    if self._kv_fetching is req:
+                        # Never leave the request both parked and
+                        # admitted: _kv_tick must not re-admit it.
+                        self._kv_fetching = None
+                        req.kv_fetch = None
+                    parked = False
+                if parked:
                     self._admitting = None
                     return True   # parked; _kv_tick re-admits it
             # Cap the shared span at (n-1)//P pages: at least one real

@@ -195,6 +195,15 @@ class HostKVStore:
             self.stats['puts'] += 1
             return True
 
+    def discard(self, h: bytes) -> None:
+        """Drop one entry (any version) — used to purge a page that
+        fails the pool-layout check at promotion time, so it cannot
+        re-trip the check on every subsequent lookup."""
+        with self._lock:
+            ent = self._entries.pop(h, None)
+            if ent is not None:
+                self._bytes -= ent[2]
+
     def get(self, h: bytes, version: int) -> Optional[PageArrays]:
         with self._lock:
             ent = self._entries.get(h)
@@ -275,6 +284,14 @@ class KVTierManager:
         self.fetch_timeout_s = (
             fetch_timeout_s if fetch_timeout_s is not None
             else env.get_float('SKYT_KV_FETCH_TIMEOUT_S', 2.0))
+        # Expected per-page array layout, set by the engine from its
+        # pool (set_page_layout): name -> (np.dtype, shape). Fetched
+        # pages are validated against it BEFORE they enter the host
+        # store — a peer with a different quantization/page-size config
+        # (or a malicious one) must fail the fetch (-> recompute), not
+        # poison the store and crash the promote/install path on the
+        # engine loop. None (standalone/unit use) skips the check.
+        self.page_layout: Optional[Dict[str, Tuple[Any, Tuple[int, ...]]]] = None
         # Spill queue: (hash, version, device-array dict). Bounded —
         # under eviction storms dropping a spill only costs a future
         # recompute, while an unbounded queue would pin device arrays.
@@ -360,6 +377,27 @@ class KVTierManager:
         return False
 
     # ------------------------------------------------------ fetch (L3)
+    def set_page_layout(self,
+                        layout: Dict[str, Tuple[Any, Tuple[int, ...]]]
+                        ) -> None:
+        self.page_layout = dict(layout)
+
+    def validate_page(self, arrays: PageArrays) -> Optional[str]:
+        """None when `arrays` matches the engine pool's per-page
+        layout, else a human-readable mismatch reason."""
+        layout = self.page_layout
+        if layout is None:
+            return None
+        if set(arrays) != set(layout):
+            return (f'array keys {sorted(arrays)} != pool keys '
+                    f'{sorted(layout)}')
+        for name, (dt, shape) in layout.items():
+            a = arrays[name]
+            if a.dtype != dt or tuple(a.shape) != tuple(shape):
+                return (f'{name}: {a.dtype.name}{list(a.shape)} != '
+                        f'pool {np.dtype(dt).name}{list(shape)}')
+        return None
+
     def fetch_into_host(self, peer: str, hashes: Sequence[bytes],
                         version: int, token: str) -> int:
         """Fetch a page run from `peer` and land it in the host store
@@ -379,6 +417,12 @@ class KVTierManager:
                 f'local {version}')
         stored = 0
         for h, arrays in pages:
+            bad = self.validate_page(arrays)
+            if bad is not None:
+                # A page that does not match the local pool layout
+                # would raise inside the engine-loop install path;
+                # reject the whole transfer instead (-> recompute).
+                raise ValueError(f'peer {peer} page {h.hex()}: {bad}')
             if self.host.put(h, version, arrays):
                 stored += 1
         with self._lock:

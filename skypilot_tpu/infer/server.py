@@ -610,12 +610,38 @@ class InferenceServer:
     def _kv_peer_from(request: web.Request) -> Optional[str]:
         """The LB's X-KV-Peer hint (base URL of the replica its
         rendezvous ring designates as this prefix's owner), validated
-        to an http(s) URL — anything else is dropped, never an
-        error (the hint is advisory; SKYT_KV_TIER=off engines ignore
-        it entirely)."""
+        against the known replica set — anything else is dropped,
+        never an error (the hint is advisory; SKYT_KV_TIER=off engines
+        ignore it entirely). The LB strips any client-supplied
+        X-KV-Peer before proxying (_HOP_HEADERS), so this check is the
+        direct-to-replica half of the defense: the engine fetches from
+        the peer with its admin bearer token, so an arbitrary URL here
+        would be an SSRF + credential-leak vector. Accepted peers:
+        loopback (single-host fleets, tests), or a scheme://host:port
+        listed in SKYT_KV_PEER_ALLOW (fleets spanning hosts)."""
+        from urllib.parse import urlsplit
         peer = request.headers.get('X-KV-Peer', '').strip()
-        if peer.startswith(('http://', 'https://')) and \
-                len(peer) <= 512:
+        if not peer or len(peer) > 512:
+            return None
+        try:
+            u = urlsplit(peer)
+            port = u.port   # raises on a malformed port
+        except ValueError:
+            return None
+        if u.scheme not in ('http', 'https') or not u.hostname:
+            return None
+        for entry in (env_lib.get('SKYT_KV_PEER_ALLOW') or '').split(','):
+            entry = entry.strip()
+            if not entry:
+                continue
+            try:
+                a = urlsplit(entry)
+                if (a.scheme, a.hostname, a.port) == \
+                        (u.scheme, u.hostname, port):
+                    return peer
+            except ValueError:
+                continue
+        if u.hostname in ('127.0.0.1', 'localhost', '::1'):
             return peer
         return None
 

@@ -93,7 +93,13 @@ logger = log_utils.init_logger(__name__)
 
 _HOP_HEADERS = {'transfer-encoding', 'connection', 'keep-alive',
                 'proxy-authenticate', 'proxy-authorization', 'te',
-                'trailers', 'upgrade', 'content-length', 'host'}
+                'trailers', 'upgrade', 'content-length', 'host',
+                # LB-internal: X-KV-Peer is the LB's OWN routing hint
+                # (_kv_peer_hint). A client-supplied value must never
+                # pass through — under SKYT_KV_TIER=fleet the replica
+                # would fetch from it with its admin bearer token, so a
+                # forwarded header is an SSRF + credential-leak vector.
+                'x-kv-peer'}
 
 # Exceptions that mean "the upstream attempt failed at transport level"
 # — retryable on another replica when nothing reached the client.

@@ -101,6 +101,15 @@ def dump_bundle(reason: str, *,
                   encoding='utf-8') as f:
             f.write(f'# postmortem py-stacks reason={reason} '
                     f'rank={rank} pid={os.getpid()} ts={now}\n')
+            # faulthandler caps the all-threads dump at 100 threads,
+            # newest first — in a thread-heavy process the requesting
+            # thread (the one that diagnosed the hang, usually the
+            # most interesting stack) is exactly the one truncated
+            # away. Dump it separately first so it always survives.
+            f.write('# requesting thread:\n')
+            f.flush()
+            faulthandler.dump_traceback(file=f, all_threads=False)
+            f.write('# all threads (oldest may be truncated):\n')
             f.flush()
             faulthandler.dump_traceback(file=f, all_threads=True)
 

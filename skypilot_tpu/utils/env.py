@@ -188,6 +188,13 @@ _var('SKYT_KV_FETCH_MAX_PAGES', 'int', 64,
 _var('SKYT_KV_FETCH_TIMEOUT_S', 'float', 2.0,
      'HTTP timeout of one cross-replica KV fetch; the engine '
      'abandons the fetch (and recomputes) at 1.5x this deadline.')
+_var('SKYT_KV_PEER_ALLOW', 'str', '',
+     'Comma-separated replica base URLs (scheme://host:port) a '
+     'replica accepts in the X-KV-Peer fetch hint, matched on '
+     'scheme+host+port. Loopback peers are always accepted; any '
+     'other unlisted peer is dropped — the engine fetches with its '
+     'admin bearer token, so fleets spanning hosts must list their '
+     'replica URLs here.')
 
 # -------------------------------------------------------- comms plane
 _var('SKYT_COMMS_PROBE_MB', 'str', '1,16',

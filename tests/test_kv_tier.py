@@ -242,7 +242,11 @@ class TestGoldenPromotion:
                         seed=1000 + rid1 - rid2) == golden_seeded
             assert eng.kv_tier.stats['promotions'] >= 1
             assert eng.kv_tier.stats['promoted_pages'] >= 1
-            # Greedy rerun now HBM-hits the promoted page.
+            # Greedy rerun now HBM-hits the promoted page. Un-throttle
+            # the ~4Hz gauge refresh first so its ticks fold the
+            # promotion delta into the per-tier counter even when the
+            # warm-cache reruns all fit inside one throttle window.
+            eng._last_gauge_t = 0.0
             assert _gen(eng, prompt) == golden_greedy
             # Satellite telemetry: eviction counter, occupancy gauges,
             # and the per-tier hit counter are exported.
@@ -290,6 +294,55 @@ class TestSwapInvalidation:
         with pytest.raises(RuntimeError, match='weight_version'):
             mgr.fetch_into_host('http://peer', [_h(1)], 1, 'tok')
         assert len(mgr.host) == 0
+
+    def test_fetch_rejects_pool_layout_mismatch(self, monkeypatch):
+        """A well-formed SKV1 payload whose arrays do not match the
+        local pool layout (misconfigured or malicious peer — other
+        quantization, page size, or bogus keys) must fail the fetch
+        (-> recompute) BEFORE anything enters the host store, never
+        reach the engine-loop install path."""
+        mgr = kv_tier_lib.KVTierManager('fleet', host_bytes=10_000,
+                                        fetch_max_pages=8,
+                                        fetch_timeout_s=1.0)
+        mgr.set_page_layout({'k': (np.dtype(np.int8), (2, 4, 8))})
+        for bad in ({'k': np.zeros((2, 4, 8), np.int16)},    # dtype
+                    {'k': np.zeros((2, 4, 4), np.int8)},     # shape
+                    {'v': np.zeros((2, 4, 8), np.int8)},     # keys
+                    {'k': np.zeros((2, 4, 8), np.int8),
+                     'extra': np.zeros(1, np.int8)}):        # extra key
+            monkeypatch.setattr(
+                kv_tier_lib, 'fetch_pages',
+                lambda *a, bad=bad, **k: (1, [(_h(1), bad)]))
+            with pytest.raises(ValueError, match='page'):
+                mgr.fetch_into_host('http://peer', [_h(1)], 1, 'tok')
+            assert len(mgr.host) == 0
+        # A matching page passes; a later bad page in the same run
+        # still fails the whole transfer.
+        ok = {'k': np.zeros((2, 4, 8), np.int8)}
+        monkeypatch.setattr(kv_tier_lib, 'fetch_pages',
+                            lambda *a, **k: (1, [(_h(1), ok)]))
+        assert mgr.fetch_into_host('http://peer', [_h(1)], 1,
+                                   'tok') == 1
+        assert mgr.host.contains(_h(1), 1)
+        # Unconfigured layout (standalone use) skips the check.
+        mgr2 = kv_tier_lib.KVTierManager('fleet', host_bytes=10_000,
+                                         fetch_max_pages=8,
+                                         fetch_timeout_s=1.0)
+        monkeypatch.setattr(
+            kv_tier_lib, 'fetch_pages',
+            lambda *a, **k: (1, [(_h(2), _arrays())]))
+        assert mgr2.fetch_into_host('http://peer', [_h(2)], 1,
+                                    'tok') == 1
+
+    def test_host_store_discard(self):
+        store = kv_tier_lib.HostKVStore(budget_bytes=10_000)
+        store.put(_h(1), 1, _arrays(100))
+        store.put(_h(2), 1, _arrays(100))
+        store.discard(_h(1))
+        store.discard(_h(9))   # absent: no-op
+        assert not store.contains(_h(1), 1)
+        assert store.contains(_h(2), 1)
+        assert store.nbytes() == 100
 
 
 # ------------------------------------------- kv.fetch fault -> recompute
@@ -414,6 +467,43 @@ class TestFleetTransfer:
             if fetcher is not None:
                 fetcher.stop()
             donor.stop()
+
+
+# --------------------------------------------- replica-side peer check
+def test_kv_peer_from_validates_against_known_replicas(monkeypatch):
+    """The replica half of the X-KV-Peer defense (the LB strips the
+    client-supplied header; this guards direct-to-replica callers):
+    only loopback peers or SKYT_KV_PEER_ALLOW-listed scheme://host:port
+    are accepted — the engine fetches from the peer with its admin
+    bearer token, so an arbitrary URL would exfiltrate it."""
+    from skypilot_tpu.infer import server as server_lib
+
+    class _Req:
+        def __init__(self, peer):
+            self.headers = {} if peer is None else {'X-KV-Peer': peer}
+
+    peer_from = server_lib.InferenceServer._kv_peer_from
+    monkeypatch.delenv('SKYT_KV_PEER_ALLOW', raising=False)
+    # Loopback (single-host fleets, the chaos drill) always passes.
+    assert peer_from(_Req('http://127.0.0.1:8001')) == \
+        'http://127.0.0.1:8001'
+    assert peer_from(_Req('http://localhost:8001')) is not None
+    # Everything else is dropped, never an error.
+    for bad in (None, '', 'not-a-url', 'http://', 'ftp://127.0.0.1:1',
+                'http://evil.example:8001', 'https://10.0.0.5:8001',
+                'http://127.0.0.1:notaport',
+                'http://127.0.0.1:' + '9' * 510):
+        assert peer_from(_Req(bad)) is None
+    # Fleets spanning hosts list replica base URLs explicitly;
+    # matching is exact on scheme+host+port.
+    monkeypatch.setenv('SKYT_KV_PEER_ALLOW',
+                       'http://10.0.0.5:8001, http://10.0.0.6:8001,')
+    assert peer_from(_Req('http://10.0.0.5:8001')) is not None
+    assert peer_from(_Req('http://10.0.0.6:8001')) is not None
+    assert peer_from(_Req('http://127.0.0.1:8001')) is not None
+    for bad in ('http://10.0.0.5:9999', 'https://10.0.0.5:8001',
+                'http://10.0.0.7:8001'):
+        assert peer_from(_Req(bad)) is None
 
 
 # --------------------------------------------------------- off == inert

@@ -239,6 +239,35 @@ def test_affinity_key_stable_across_turns_and_shared_prefix():
         assert lb._affinity_key(body) is None  # pylint: disable=protected-access
 
 
+def test_kv_peer_header_is_lb_internal():
+    """X-KV-Peer is LB-internal routing state: a client-supplied value
+    is stripped with the hop-by-hop set before proxying (under
+    SKYT_KV_TIER=fleet the replica fetches from the named URL with its
+    admin bearer token, so a forwarded header would be an SSRF +
+    credential-leak vector), and the LB's own hint only ever names
+    another member of the ready-replica ring."""
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+    assert 'x-kv-peer' in lb_lib._HOP_HEADERS  # pylint: disable=protected-access
+    lb = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', 0, policy='prefix_affinity',
+        metrics_registry=metrics_lib.MetricsRegistry())
+    replicas = ['http://r1', 'http://r2', 'http://r3']
+    lb.policy.set_ready_replicas(replicas)
+    for chosen in replicas:
+        hint = lb._kv_peer_hint('opener-key', chosen)  # pylint: disable=protected-access
+        assert hint in replicas and hint != chosen
+    # Keyless traffic gets no hint — and with the incoming header
+    # stripped, the upstream request then carries no X-KV-Peer at all.
+    assert lb._kv_peer_hint(None, 'http://r1') is None  # pylint: disable=protected-access
+    # Non-affinity policies never hint.
+    lb_rr = lb_lib.SkyServeLoadBalancer(
+        'http://127.0.0.1:9', 0, policy='round_robin',
+        metrics_registry=metrics_lib.MetricsRegistry())
+    lb_rr.policy.set_ready_replicas(replicas)
+    assert lb_rr._kv_peer_hint('opener-key', 'http://r1') is None  # pylint: disable=protected-access
+
+
 def test_rate_by_class_windows_and_garbage():
     from skypilot_tpu.serve import qos as qos_lib
     now = 1000.0

@@ -310,6 +310,31 @@ def test_postmortem_bundle_contents_and_index(tmp_path, monkeypatch):
     assert any('error' in e for e in idx)
 
 
+def test_postmortem_stacks_survive_thread_truncation(tmp_path,
+                                                     monkeypatch):
+    """faulthandler's all-threads dump caps at 100 threads (newest
+    first), so in a thread-heavy process the requesting thread — the
+    one that diagnosed the hang — is exactly the one truncated away.
+    dump_bundle writes it separately so it always survives."""
+    import threading
+    monkeypatch.setenv('SKYT_POSTMORTEM_DIR', str(tmp_path))
+    release = threading.Event()
+    extra = [threading.Thread(target=release.wait, daemon=True)
+             for _ in range(110)]
+    try:
+        for t in extra:
+            t.start()
+        path = postmortem_lib.dump_bundle('hang', rank=0)
+        stacks = open(os.path.join(path, 'stacks.txt')).read()
+        assert '...' in stacks          # the cap really was hit
+        assert 'test_postmortem_stacks_survive_thread_truncation' \
+            in stacks
+    finally:
+        release.set()
+        for t in extra:
+            t.join(timeout=5)
+
+
 def test_postmortem_dump_never_raises(tmp_path, monkeypatch):
     # Unusable root (a FILE occupies the path — mkdir can never
     # succeed, even for root): dump returns None instead of raising
