@@ -38,6 +38,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>SLO / fleet</h2>{slo}
 <h2>Comms</h2>{comms}
 <h2>Capacity</h2>{capacity}
+<h2>Interference</h2>{interference}
 <h2>Postmortems</h2>{postmortems}
 <h2>Metrics</h2>{metrics}
 <h2>Slowest traces</h2>{traces}
@@ -238,6 +239,42 @@ def _capacity_html() -> str:
                    'replica util'], rows)
 
 
+def _interference_html() -> str:
+    """Tick-plane panel: each service's controller answers
+    GET /fleet/interference — per-replica mixed-tick fraction,
+    attributed interference share of ITL, and the measured
+    disaggregation-advisor verdict (docs/observability.md "Tick
+    plane")."""
+    services, results = _fetch_controllers('/fleet/interference')
+    rows = []
+    for svc in services:
+        name = svc['name']
+        data = results.get(name)
+        if not isinstance(data, dict):
+            rows.append([name, '-', f'unreachable ({data})', '-', '-',
+                         '-'])
+            continue
+        targets = data.get('targets') or {}
+        for target, rec in sorted(targets.items()):
+            frac = rec.get('interference_frac')
+            itl = rec.get('itl_p99_s')
+            verdict = (rec.get('advisor') or {}).get(
+                'recommendation', '-')
+            rows.append([
+                name, target,
+                f"{rec.get('mixed_tick_frac', 0):.0%}",
+                f'{frac:.1%}' if frac is not None else '-',
+                f'{itl * 1e3:.1f}ms' if itl is not None else '-',
+                verdict])
+        if not targets:
+            verdict = (data.get('advisor') or {}).get(
+                'recommendation', '-')
+            rows.append([name, '-', '-', '-', '-', verdict])
+    return _table(['service', 'replica', 'mixed ticks',
+                   'interference share of ITL', 'ITL p99',
+                   'advisor'], rows)
+
+
 def _postmortems_html() -> str:
     """Training-plane crash bundles (train/postmortem.py): the local
     SKYT_POSTMORTEM_DIR index — reason, rank, job, and the bundle path
@@ -310,6 +347,7 @@ def _render_page() -> str:
         slo=_slo_html(),
         comms=_comms_html(),
         capacity=_capacity_html(),
+        interference=_interference_html(),
         postmortems=_postmortems_html(),
         metrics=_metrics_html(),
         traces=_traces_html())

@@ -32,6 +32,7 @@ import numpy as np
 
 from skypilot_tpu.infer import kv_tier as kv_tier_lib
 from skypilot_tpu.infer import ledger as ledger_lib
+from skypilot_tpu.infer import tickstats as tickstats_lib
 from skypilot_tpu.infer.paged_cache import page_hashes as paged_cache_hashes
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import log_utils
@@ -210,6 +211,13 @@ class _Request:
     # afterwards so one request never fetches twice.
     kv_peer: Optional[str] = None
     kv_fetch: Optional[Dict[str, Any]] = None
+    # Tick-plane ITL split (infer/tickstats.py): seconds of this
+    # request's decode wall time attributed to the pure-decode floor
+    # vs prefill co-residency. Accrued per finished chunk by the
+    # engine loop; surfaced in the 'done' trace event and the
+    # per-class skyt_interference_* counters at release.
+    itl_decode_s: float = 0.0
+    itl_interference_s: float = 0.0
 
 
 def _round_up_pow2(n: int, lo: int = 32) -> int:
@@ -973,6 +981,38 @@ class InferenceEngine:
             self._jit_kv_install = jax.jit(self._kv_install_impl,
                                            donate_argnums=(0,))
             self.kv_tier.start()
+
+        # --- tick plane (infer/tickstats.py; docs/observability.md
+        # "Tick plane"): one structured record per engine-loop tick +
+        # the prefill<->decode interference attributor. SKYT_TICKSTATS=0
+        # leaves this None and the loop body contains NO recording call
+        # at all (the watchdog-heartbeat precedent — disabled means
+        # structurally absent, not branched around).
+        self._tickstats = tickstats_lib.from_env(reg)
+        self._tick_t0: Optional[float] = None
+        self._tick_perf0 = (0, 0, 0)
+        # Prefill isolation (the disaggregation counterfactual measured
+        # by bench.py's interference phase): admit prefill only from
+        # ticks with no active decode slots, so decode chunks never
+        # share a tick with prefill. A schedule property fixed at
+        # construction, like the recorder itself.
+        self._isolate_prefill = env.get_bool(
+            'SKYT_TICKSTATS_ISOLATE', False)
+        # KV bytes per decoded token at the active kv dtype (PR 12
+        # page math) — the disaggregation advisor's transfer-cost
+        # input, exported so /fleet/interference can price the
+        # prefill->decode page move from a scrape alone.
+        try:
+            from skypilot_tpu.infer import memory_plan as _memory_plan
+            reg.gauge(
+                'skyt_infer_kv_bytes_per_token',
+                'KV cache bytes per token at the active KV dtype '
+                '(memory_plan page math) — the disaggregation '
+                'advisor transfer-cost input').set(float(
+                    _memory_plan.kv_bytes_per_token(self.cfg,
+                                                    self.kv_dtype)))
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('kv_bytes_per_token gauge export failed')
 
     def _pull(self, x) -> np.ndarray:
         """Device→host fetch for control decisions (tokens, logits,
@@ -2061,7 +2101,16 @@ class InferenceEngine:
                **self.perf_stats()}
         if self.ledger.enabled:
             out['capacity_ledger'] = self.ledger.snapshot()
+        if self._tickstats is not None:
+            out['tickstats'] = self._tickstats.summary()
         return out
+
+    @property
+    def tickstats(self):
+        """The tick-plane recorder (infer/tickstats.py), or None when
+        SKYT_TICKSTATS=0 — the server's /debug/ticks handler and the
+        flight-recorder snapshot read through this."""
+        return self._tickstats
 
     def perf_stats(self) -> Dict[str, float]:
         """Decode counters; steady_decode_tok_per_sec is the pipelined
@@ -3197,6 +3246,19 @@ class InferenceEngine:
         normal completion in /stats)."""
         req = self._slots[slot]
         if req is not None:
+            # Tick-plane ITL split: fold the request's accrued
+            # decode-floor/interference seconds into the per-class
+            # counters and its trace (visible at /stats?request_id=).
+            extra: Dict[str, Any] = {}
+            if self._tickstats is not None and (
+                    req.itl_decode_s or req.itl_interference_s):
+                extra = {
+                    'itl_decode_s': round(req.itl_decode_s, 6),
+                    'itl_interference_s':
+                        round(req.itl_interference_s, 6)}
+                self._tickstats.note_request(
+                    req.params.priority or 'standard',
+                    req.itl_decode_s, req.itl_interference_s)
             # Trace BEFORE the terminal None: put() unblocks the HTTP
             # handler, and a client hitting /stats?request_id= right
             # after its response must see the completed trace.
@@ -3204,7 +3266,8 @@ class InferenceEngine:
                 req.req_id, 'done', generated=req.generated,
                 status=status or ('deadline' if req.expired
                                   else 'cancelled' if req.cancelled
-                                  else 'done'))
+                                  else 'done'),
+                **extra)
             req.out_queue.put(None)
         if self._chunked is not None and self._chunked['slot'] == slot:
             # Crash-path release mid-chunked-prefill: abandon it.
@@ -3320,6 +3383,17 @@ class InferenceEngine:
             # cover admission + prefill + the in-flight chunk.
             if self._busy_mark is None:
                 self._busy_mark = time.perf_counter()
+            # Tick plane: open this tick's measurement window. Perf
+            # counters snapshot here so the record can tell what THIS
+            # tick admitted (deltas), without threading state through
+            # every admission path.
+            ts = self._tickstats
+            if ts is not None:
+                self._tick_t0 = time.perf_counter()
+                self._tick_perf0 = (
+                    self.perf['admitted_requests'],
+                    self.perf['prefill_dispatch_tokens'],
+                    self.perf['prefill_dispatches'])
             # In-place weight swap: apply at THIS tick boundary when
             # eligible (immediately, or once a draining swap's
             # in-flight requests have finished). While a draining swap
@@ -3354,7 +3428,15 @@ class InferenceEngine:
             # sequential path. Device-side arg/cache updates order after
             # any in-flight chunk via the dispatch chain.
             admitted = False
-            while None in self._slots and not swap_draining:
+            # Isolated-prefill schedule (SKYT_TICKSTATS_ISOLATE): hold
+            # admission while any decode slot is live, so prefill only
+            # runs from all-idle ticks and decode chunks never share a
+            # tick with it — the measured counterfactual bench.py's
+            # interference phase compares the mixed schedule against.
+            hold_admission = swap_draining or (
+                self._isolate_prefill and
+                any(s is not None for s in self._slots))
+            while None in self._slots and not hold_admission:
                 if self._try_admit_ragged():
                     admitted = True
                     continue
@@ -3475,6 +3557,15 @@ class InferenceEngine:
                         time.perf_counter() - self._busy_mark)
                 self._busy_mark = None
                 time.sleep(0.002)
+            if ts is not None and pending is None and (admitted or
+                                                       chunking):
+                # Prefill-only tick: admission / chunked-prefill work
+                # with no chunk pull. Mixed and pure-decode ticks
+                # record inside _finish_chunk at the pipeline sync
+                # point instead (before releases, so a request that
+                # completes in its first chunk still gets a split);
+                # idle ticks are never recorded.
+                self._tick_record(time.perf_counter(), (), 0)
             # Resync the sizing estimate: confirmed lengths plus the
             # in-flight chunk's worst-case advance.
             self._lengths = self._conf_lengths + upper
@@ -3568,6 +3659,18 @@ class InferenceEngine:
         now = time.perf_counter()
         delivered = 0
         trace_on = tracing.enabled()
+        # Tick plane: the pull is this tick's measurement endpoint —
+        # record the tick and accrue its attributed interference to
+        # the chunk's requests BEFORE delivery, so a request that
+        # completes (and releases) in this very chunk still reports
+        # its ITL split in the 'done' trace event.
+        if self._tickstats is not None and self._tick_t0 is not None:
+            if kind == 'spec':
+                pulled = int(counts_np[:, [i for i, _ in
+                                           entries]].sum())
+            else:
+                pulled = chunk * len(entries)
+            self._tick_record(now, entries, pulled, trace_on=trace_on)
         # Per-slot ACTUAL start position of this chunk's first token
         # (confirmed length is only advanced at chunk pulls, so it is
         # this chunk's true starting point).
@@ -3669,3 +3772,70 @@ class InferenceEngine:
         host_s = time.perf_counter() - now
         self.perf['host_finish_s'] += host_s
         self._m_host_finish.inc(host_s)
+        if self._tickstats is not None:
+            # Delivery host work postdates the record cut at the pull;
+            # attach it to the tick it belongs to.
+            self._tickstats.note_host(host_s)
+
+    def _tick_record(self, end_t: float, entries, tokens: int, *,
+                     trace_on: bool = False) -> None:
+        """Fold one engine tick into the tick plane (only reachable
+        with tickstats on; no-op if this tick's window was already
+        recorded). Composition comes from the perf-counter deltas
+        snapshotted at the tick top, so no admission path needed
+        instrumenting; ``entries`` is the finished chunk's
+        (slot, req) list — each of those requests accrues the tick's
+        attributed interference before any release path can run."""
+        ts = self._tickstats
+        t0 = self._tick_t0
+        if ts is None or t0 is None:
+            return
+        self._tick_t0 = None
+        dur = max(end_t - t0, 0.0)
+        a0, pt0, pd0 = self._tick_perf0
+        prefill_reqs = int(self.perf['admitted_requests'] - a0)
+        prefill_toks = int(self.perf['prefill_dispatch_tokens'] - pt0)
+        dispatches = int(self.perf['prefill_dispatches'] - pd0)
+        if prefill_reqs == 0 and prefill_toks > 0:
+            # A chunked long-prompt prefill advanced (admission only
+            # counts at completion) — still prefill co-residency.
+            prefill_reqs = 1
+        if not entries and prefill_reqs == 0 and prefill_toks == 0:
+            return   # nothing measurable happened (deferred admission)
+        # Per-dispatch width = the compiled bucket (B x bucket padded,
+        # packed T ragged) — measured from the counters rather than
+        # threaded through three admission paths.
+        bucket = prefill_toks // dispatches if dispatches > 0 else 0
+        if self.pool is not None:
+            total = self.pool.cfg.n_pages - 1   # page 0 is the dummy
+            kv_frac = ((total - self.pool.free_pages()) / total
+                       if total > 0 else None)
+        else:
+            denom = self.num_slots * self.max_seq_len
+            kv_frac = (float(self._conf_lengths.sum()) / denom
+                       if denom > 0 else None)
+        from skypilot_tpu.ops import dispatch as ops_dispatch
+        _, baseline, excess = ts.on_tick(
+            dur_s=dur,
+            active_slots=len(entries),
+            decode_reqs=len(entries),
+            tokens=int(tokens),
+            prefill_reqs=prefill_reqs,
+            prefill_tokens=prefill_toks,
+            prefill_bucket=bucket,
+            kv_frac=kv_frac,
+            kernel_paths=ops_dispatch.snapshot())
+        if not entries:
+            return
+        # Every request decoding in a mixed tick pays the FULL excess:
+        # ITL is per-request wall time, not a pool shared across the
+        # batch.
+        floor = max(dur - excess, 0.0)
+        for _, req in entries:
+            req.itl_decode_s += floor
+            req.itl_interference_s += excess
+            if trace_on and excess > 0.0:
+                self._trace_span_event(
+                    req.req_id, 'interference',
+                    excess_ms=round(excess * 1e3, 3),
+                    baseline_ms=round((baseline or 0.0) * 1e3, 3))

@@ -142,6 +142,13 @@ class InferenceServer:
         self._tracer = tracer or tracing_lib.Tracer(
             service='infer', registry=engine.metrics_registry)
         self._tracer.store.slow_snapshot = self._engine_state_snapshot
+        # Postmortem enrichment: a crash/hang bundle dumped from this
+        # process shows the engine loop's last tick records (what was
+        # the loop doing — mixed prefill/decode? pure decode? idle?).
+        if engine.tickstats is not None:
+            from skypilot_tpu.train import postmortem
+            postmortem.register_state_reader(
+                'recent_ticks', lambda: engine.tickstats.last(16))
         # The checkpoint's HF chat template (jinja source), rendered
         # for /v1/chat/completions the way vLLM renders it; None falls
         # back to the generic role-tag format.
@@ -401,6 +408,11 @@ class InferenceServer:
         paths = ops_dispatch.snapshot()
         if paths:
             snap['kernel_paths'] = paths
+        # Tick plane: what the engine loop was actually doing when the
+        # snapshot was cut — the last few tick records show whether
+        # the slow window was mixed prefill/decode or pure decode.
+        if eng.tickstats is not None:
+            snap['recent_ticks'] = eng.tickstats.last(8)
         return snap
 
     def _bridge_engine_spans(self, span, rids) -> None:
@@ -747,6 +759,30 @@ class InferenceServer:
         payload, status = tracing_lib.debug_traces_payload(
             self._tracer, request.query)
         return web.json_response(payload, status=status)
+
+    async def _debug_ticks(self, request: web.Request) -> web.Response:
+        """The tick plane's ring (docs/observability.md "Tick plane"):
+        summary + the last-N per-tick records, `?format=chrome` for a
+        chrome://tracing / Perfetto dump of the engine loop's tick
+        slices, `?last=N` to size the record tail."""
+        ts = self.engine.tickstats
+        if ts is None:
+            return web.json_response(
+                {'error': 'tick plane is disabled on this replica',
+                 'hint': 'start the server with SKYT_TICKSTATS=1 '
+                         '(the default) to record per-tick anatomy'},
+                status=404)
+        if request.query.get('format') == 'chrome':
+            return web.json_response(ts.chrome_trace())
+        last = request.query.get('last', '32')
+        try:
+            n = int(last)
+        except ValueError:
+            return web.json_response(
+                {'error': f'last must be an integer, got {last!r}'},
+                status=400)
+        return web.json_response({'summary': ts.summary(),
+                                  'ticks': ts.last(n)})
 
     async def _metrics(self, request: web.Request) -> web.Response:
         del request
@@ -1528,6 +1564,7 @@ class InferenceServer:
         app.router.add_get('/stats', self._stats)
         app.router.add_get('/metrics', self._metrics)
         app.router.add_get('/debug/traces', self._debug_traces)
+        app.router.add_get('/debug/ticks', self._debug_ticks)
         app.router.add_post('/debug/profile', self._debug_profile)
         app.router.add_post('/admin/weights', self._admin_weights)
         app.router.add_get('/kv/prefix', self._kv_prefix)

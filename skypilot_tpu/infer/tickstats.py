@@ -1,0 +1,330 @@
+"""Tick anatomy & interference attribution — the ninth observability
+plane (docs/observability.md "Tick plane").
+
+The engine loop is a sequence of TICKS: one `_loop_body` iteration
+that may admit prefill, dispatch a decode chunk, and pull the
+previous chunk's tokens back to the host. Aggregate histograms
+(`skyt_infer_itl_seconds`) say decode got slower; they cannot say
+WHY. This module records one structured record per tick — wall
+duration, composition, KV pressure, host-finish time, kernel path —
+into a bounded ring (`GET /debug/ticks`, `?format=chrome` for
+Perfetto), and runs an interference ATTRIBUTOR on top of it:
+
+  * a pure-decode tick-time EWMA per active-slot bucket is the
+    baseline — what a tick costs when nothing but decode runs;
+  * each mixed tick's excess over that baseline is attributed to
+    prefill co-residency, and every request decoding in that tick
+    accrues the FULL excess (ITL is per-request wall time, not a
+    shared pool) as its `interference` ITL component, the remainder
+    as its `decode floor`.
+
+The split feeds `skyt_interference_*{cls}` metrics, per-request
+breakdowns in `/stats?request_id=`, the `/fleet/interference` rollup,
+and `infer/disagg_advisor.py`'s measured disaggregation verdict.
+
+Design rules (house style of utils/timeseries.py):
+  * dependency-free, thread-safe (one lock, never held across I/O);
+  * the clock is INJECTABLE — attribution math replays
+    deterministically in tests under a FakeClock;
+  * hard caps everywhere: the record ring is a bounded deque
+    (drop-oldest, counted), baselines are bounded by the pow2
+    slot-bucket domain;
+  * with SKYT_TICKSTATS=0, `from_env` returns None and the engine
+    loop contains NO recording call at all (the watchdog-heartbeat
+    precedent): zero overhead, not merely cheap overhead.
+"""
+import collections
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu.utils import env
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+_KINDS = ('decode', 'mixed', 'prefill')
+
+
+def slot_bucket(active_slots: int) -> int:
+    """Pow2 bucket (1, 2, 4, 8, ...) for an active-decode-slot count.
+
+    Baselines are per-bucket because pure-decode tick time scales with
+    batch width; bucketing keeps the baseline table bounded and each
+    bucket's sample stream dense enough for the EWMA to settle."""
+    n = max(int(active_slots), 1)
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class TickStats:
+    """Per-tick records + the interference attributor.
+
+    All mutating entry points (`on_tick`, `note_request`) take the
+    lock once and touch only plain Python state; the engine loop calls
+    them once per tick, so cost is O(1) dict/deque work.
+    """
+
+    def __init__(self,
+                 registry=None,
+                 *,
+                 ring: int = 512,
+                 ewma_alpha: float = 0.2,
+                 min_samples: int = 4,
+                 clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._ring: 'collections.deque[Dict[str, Any]]' = \
+            collections.deque(maxlen=max(int(ring), 1))
+        self._dropped = 0
+        self._seq = 0
+        self._alpha = min(max(float(ewma_alpha), 1e-6), 1.0)
+        self._min_samples = max(int(min_samples), 1)
+        # slot bucket -> (EWMA pure-decode tick seconds, sample count)
+        self._baseline: Dict[int, float] = {}
+        self._baseline_n: Dict[int, int] = {}
+        # Local aggregates: the summary must work even with no
+        # registry injected (unit tests, ad-hoc engines).
+        self._counts = {k: 0 for k in _KINDS}
+        self._seconds = {k: 0.0 for k in _KINDS}
+        self._excess_s = 0.0
+        self._req_floor: Dict[str, float] = {}
+        self._req_interference: Dict[str, float] = {}
+        self._req_n: Dict[str, int] = {}
+        if registry is not None:
+            self._m_ticks = registry.counter(
+                'skyt_tick_total',
+                'Engine loop ticks by composition', ('kind',))
+            self._m_tick_s = registry.counter(
+                'skyt_tick_seconds_total',
+                'Engine tick wall seconds by composition', ('kind',))
+            self._m_excess = registry.counter(
+                'skyt_tick_excess_seconds_total',
+                'Mixed-tick seconds above the pure-decode baseline, '
+                'attributed to prefill co-residency')
+            self._m_baseline = registry.gauge(
+                'skyt_tick_baseline_seconds',
+                'EWMA pure-decode tick seconds per active-slot '
+                'bucket', ('slots',))
+            self._m_itl_interference = registry.counter(
+                'skyt_interference_itl_seconds',
+                'Request ITL seconds attributed to prefill '
+                'interference, by class', ('cls',))
+            self._m_itl_floor = registry.counter(
+                'skyt_interference_decode_floor_seconds',
+                'Request ITL seconds attributed to the pure-decode '
+                'floor, by class', ('cls',))
+        else:
+            self._m_ticks = self._m_tick_s = self._m_excess = None
+            self._m_baseline = None
+            self._m_itl_interference = self._m_itl_floor = None
+
+    # ------------------------------------------------------ recording
+    def on_tick(self, *,
+                dur_s: float,
+                active_slots: int,
+                decode_reqs: int,
+                tokens: int = 0,
+                prefill_reqs: int = 0,
+                prefill_tokens: int = 0,
+                prefill_bucket: int = 0,
+                kv_frac: Optional[float] = None,
+                host_s: float = 0.0,
+                kernel_paths: Optional[Dict[str, str]] = None,
+                end: Optional[float] = None
+                ) -> Tuple[str, Optional[float], float]:
+        """Record one tick; returns ``(kind, baseline_s, excess_s)``.
+
+        ``kind`` is 'decode' (pure decode), 'mixed' (prefill admitted
+        while decode slots were active), or 'prefill' (admission with
+        no finished decode chunk). Idle ticks must not reach here —
+        the engine skips the call when nothing happened.
+
+        ``baseline_s`` is the pure-decode EWMA for this tick's
+        active-slot bucket (None until the bucket has
+        ``min_samples`` pure-decode observations — attribution stays
+        conservative while cold). ``excess_s`` is nonzero only for
+        mixed ticks with a warm baseline: ``max(0, dur - baseline)``.
+        """
+        dur_s = max(float(dur_s), 0.0)
+        if prefill_reqs > 0:
+            kind = 'mixed' if decode_reqs > 0 else 'prefill'
+        else:
+            kind = 'decode'
+        bucket = slot_bucket(active_slots)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            baseline: Optional[float] = None
+            excess = 0.0
+            if kind == 'decode':
+                prev = self._baseline.get(bucket)
+                ewma = dur_s if prev is None else \
+                    prev + self._alpha * (dur_s - prev)
+                self._baseline[bucket] = ewma
+                n = self._baseline_n.get(bucket, 0) + 1
+                self._baseline_n[bucket] = n
+                if n >= self._min_samples:
+                    baseline = ewma
+            elif kind == 'mixed':
+                if self._baseline_n.get(bucket, 0) >= self._min_samples:
+                    baseline = self._baseline[bucket]
+                    excess = max(0.0, dur_s - baseline)
+            self._counts[kind] += 1
+            self._seconds[kind] += dur_s
+            self._excess_s += excess
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            rec = {
+                'seq': seq,
+                'end': self._clock() if end is None else float(end),
+                'dur_s': dur_s,
+                'kind': kind,
+                'active_slots': int(active_slots),
+                'slot_bucket': bucket,
+                'tokens': int(tokens),
+                'prefill_reqs': int(prefill_reqs),
+                'prefill_tokens': int(prefill_tokens),
+                'prefill_bucket': int(prefill_bucket),
+                'kv_frac': kv_frac,
+                'host_s': float(host_s),
+                'kernel_paths': dict(kernel_paths or {}),
+                'baseline_s': baseline,
+                'excess_s': excess,
+            }
+            self._ring.append(rec)
+        if self._m_ticks is not None:
+            self._m_ticks.labels(kind).inc()
+            self._m_tick_s.labels(kind).inc(dur_s)
+            # inc(0) too: the series must exist from the FIRST tick
+            # so fleet-scrape windowed deltas have a baseline edge
+            # before the first attributed excess lands.
+            self._m_excess.inc(excess)
+            if kind == 'decode' and self._m_baseline is not None:
+                self._m_baseline.labels(str(bucket)).set(
+                    self._baseline[bucket])
+        return kind, baseline, excess
+
+    def note_host(self, host_s: float) -> None:
+        """Attach post-pull host-delivery seconds to the most recent
+        record — the delivery work happens after the record is cut at
+        the pull sync point, so the engine back-fills it."""
+        with self._lock:
+            if self._ring:
+                self._ring[-1]['host_s'] = float(host_s)
+
+    def note_request(self, cls: str, floor_s: float,
+                     interference_s: float) -> None:
+        """Fold one finished request's ITL split into the per-class
+        accounting (called from the engine's release path)."""
+        floor_s = max(float(floor_s), 0.0)
+        interference_s = max(float(interference_s), 0.0)
+        with self._lock:
+            self._req_floor[cls] = \
+                self._req_floor.get(cls, 0.0) + floor_s
+            self._req_interference[cls] = \
+                self._req_interference.get(cls, 0.0) + interference_s
+            self._req_n[cls] = self._req_n.get(cls, 0) + 1
+        if self._m_itl_floor is not None:
+            self._m_itl_floor.labels(cls).inc(floor_s)
+            self._m_itl_interference.labels(cls).inc(interference_s)
+
+    # -------------------------------------------------------- reading
+    def last(self, n: int = 32) -> List[Dict[str, Any]]:
+        """Newest-last copies of the most recent ``n`` records."""
+        with self._lock:
+            recs = list(self._ring)
+        return [dict(r) for r in recs[-max(int(n), 0):]]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = dict(self._counts)
+            seconds = dict(self._seconds)
+            excess = self._excess_s
+            baselines = {
+                str(b): {'ewma_s': self._baseline[b],
+                         'samples': self._baseline_n.get(b, 0),
+                         'warm': self._baseline_n.get(b, 0) >=
+                                 self._min_samples}
+                for b in sorted(self._baseline)}
+            classes = {
+                cls: {'requests': self._req_n.get(cls, 0),
+                      'decode_floor_s': self._req_floor.get(cls, 0.0),
+                      'interference_s':
+                          self._req_interference.get(cls, 0.0)}
+                for cls in sorted(self._req_n)}
+            retained = len(self._ring)
+            dropped = self._dropped
+        total = sum(counts.values())
+        total_s = sum(seconds.values())
+        for cls, c in classes.items():
+            itl = c['decode_floor_s'] + c['interference_s']
+            c['interference_frac'] = \
+                (c['interference_s'] / itl) if itl > 0 else 0.0
+        return {
+            'ticks': total,
+            'by_kind': counts,
+            'seconds_by_kind': seconds,
+            'mixed_frac': (counts['mixed'] / total) if total else 0.0,
+            'excess_seconds': excess,
+            'excess_frac': (excess / total_s) if total_s > 0 else 0.0,
+            'baselines': baselines,
+            'classes': classes,
+            'ring': {'retained': retained, 'dropped': dropped},
+        }
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """The ring as a Chrome/Perfetto trace (`chrome://tracing`
+        JSON object format) — one 'X' slice per tick on a single
+        engine-loop track, prefill/mixed slices carrying the admitted
+        batch and attributed excess in ``args``."""
+        events: List[Dict[str, Any]] = [{
+            'name': 'process_name', 'ph': 'M', 'pid': 0,
+            'args': {'name': 'skypilot-tpu engine'},
+        }, {
+            'name': 'thread_name', 'ph': 'M', 'pid': 0, 'tid': 0,
+            'args': {'name': 'engine loop (ticks)'},
+        }]
+        for rec in self.last(n=len(self._ring)):
+            args = {
+                'kind': rec['kind'],
+                'active_slots': rec['active_slots'],
+                'tokens': rec['tokens'],
+            }
+            if rec['prefill_reqs']:
+                args['prefill_reqs'] = rec['prefill_reqs']
+                args['prefill_tokens'] = rec['prefill_tokens']
+                args['prefill_bucket'] = rec['prefill_bucket']
+            if rec['excess_s'] > 0.0:
+                args['interference_excess_ms'] = rec['excess_s'] * 1e3
+            if rec['kv_frac'] is not None:
+                args['kv_frac'] = rec['kv_frac']
+            events.append({
+                'name': rec['kind'],
+                'cat': 'tick',
+                'ph': 'X',
+                'ts': (rec['end'] - rec['dur_s']) * 1e6,
+                'dur': rec['dur_s'] * 1e6,
+                'pid': 0,
+                'tid': 0,
+                'args': args,
+            })
+        return {'traceEvents': events, 'displayTimeUnit': 'ms'}
+
+
+def from_env(registry=None,
+             clock: Callable[[], float] = time.perf_counter
+             ) -> Optional[TickStats]:
+    """Build a TickStats from the env knobs, or None when
+    SKYT_TICKSTATS=0 — the caller then wires NO recording path at all
+    (structural disablement, not a per-tick branch)."""
+    if not env.get_bool('SKYT_TICKSTATS', True):
+        return None
+    return TickStats(
+        registry,
+        ring=env.get_int('SKYT_TICKSTATS_RING', 512),
+        ewma_alpha=env.get_float('SKYT_TICKSTATS_EWMA', 0.2),
+        min_samples=env.get_int('SKYT_INTERFERENCE_MIN_SAMPLES', 4),
+        clock=clock)

@@ -22,7 +22,7 @@ than honest absence), and comes back on the next successful scrape.
 """
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu.serve import slo as slo_lib
 from skypilot_tpu.utils import faults
@@ -496,6 +496,164 @@ class FleetTelemetry:
                                               replicas=len(replicas)),
         }
 
+    def _dcn_busbw_gbps(self) -> Tuple[Optional[float], str]:
+        """Measured DCN bandwidth for the advisor's transfer cost:
+        the bottleneck (min) pair busbw across this controller host's
+        cached comms profiles (PR 15 census x profile — never
+        re-probed on a serve path), else the SKYT_INTERFERENCE_
+        DCN_GBPS fallback marked 'assumed'."""
+        from skypilot_tpu.parallel import comms_profile
+        best: Optional[float] = None
+        try:
+            for key, prof in comms_profile.get_cache() \
+                    .entries().items():
+                if not (key.startswith('profile|') and
+                        isinstance(prof, dict)):
+                    continue
+                pairs = comms_profile.summary(prof).get('dcn_pairs',
+                                                        {}) or {}
+                for info in pairs.values():
+                    bw = info.get('busbw_gbps')
+                    if bw and (best is None or bw < best):
+                        best = float(bw)
+        except Exception:  # pylint: disable=broad-except
+            best = None
+        if best is not None and best > 0:
+            return best, 'measured'
+        return env.get_float('SKYT_INTERFERENCE_DCN_GBPS', 10.0), \
+            'assumed'
+
+    def interference_report(self, window_s: Optional[float] = None,
+                            now: Optional[float] = None
+                            ) -> Dict[str, Any]:
+        """The ``GET /fleet/interference`` body (docs/observability.md
+        "Tick plane"): per-replica prefill<->decode interference from
+        the scraped tick families — tick composition (mixed fraction),
+        attributed excess seconds, the per-class decode-floor vs
+        interference ITL split, ITL p99 — each combined with the
+        replica's KV bytes-per-token gauge, its measured request shape
+        (prompt/output tokens per request), and the controller's
+        measured DCN busbw into a per-replica disaggregation-advisor
+        verdict, plus one fleet-aggregate verdict."""
+        from skypilot_tpu.infer import disagg_advisor
+        if now is None:
+            now = self._clock()
+        if window_s is None:
+            window_s = env.get_float('SKYT_CAPACITY_WINDOW_S', 300.0)
+        dcn_gbps, dcn_source = self._dcn_busbw_gbps()
+        replicas = self.live_replicas(now)
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in replicas
+                      if t in self._stores]
+        out_targets: Dict[str, Dict[str, Any]] = {}
+        agg = {'floor_s': 0.0, 'interference_s': 0.0, 'excess_s': 0.0,
+               'requests': 0.0, 'prefill_tokens': 0.0,
+               'decode_tokens': 0.0}
+        agg_kv: Optional[float] = None
+        agg_itl: List[float] = []
+        for target, store in stores:
+            ticks = store.grouped_delta('skyt_tick_total', 'kind',
+                                        window_s, now=now)
+            total_ticks = sum(ticks.values())
+            if total_ticks <= 0:
+                continue
+            tick_s = store.grouped_delta('skyt_tick_seconds_total',
+                                         'kind', window_s, now=now)
+            excess = store.sum_delta('skyt_tick_excess_seconds_total',
+                                     None, window_s, now=now) or 0.0
+            floor_by_cls = store.grouped_delta(
+                'skyt_interference_decode_floor_seconds', 'cls',
+                window_s, now=now)
+            intf_by_cls = store.grouped_delta(
+                'skyt_interference_itl_seconds', 'cls', window_s,
+                now=now)
+            floor_s = sum(floor_by_cls.values())
+            intf_s = sum(intf_by_cls.values())
+            itl_total = floor_s + intf_s
+            interference_frac = (intf_s / itl_total
+                                 if itl_total > 0 else None)
+            mixed_frac = ticks.get('mixed', 0.0) / total_ticks
+            itl_p99 = store.quantile('skyt_infer_itl_seconds', None,
+                                     0.99, window_s, now=now)
+            kv_bpt: Optional[float] = None
+            for name, labels in store.series_keys():
+                if name == 'skyt_infer_kv_bytes_per_token':
+                    pt = store.latest(name, labels)
+                    if pt is not None:
+                        kv_bpt = pt[1]
+                    break
+            requests = store.sum_delta('skyt_infer_requests_total',
+                                       None, window_s, now=now) or 0.0
+            prefill_toks = store.sum_delta(
+                'skyt_infer_prefill_tokens_total', None, window_s,
+                now=now) or 0.0
+            decode_toks = store.sum_delta(
+                'skyt_infer_decode_tokens_total', None, window_s,
+                now=now) or 0.0
+            prompt_per_req = (prefill_toks / requests
+                              if requests > 0 else None)
+            output_per_req = (decode_toks / requests
+                              if requests > 0 else None)
+            classes = {
+                cls: {'decode_floor_s': floor_by_cls.get(cls, 0.0),
+                      'interference_s': intf_by_cls.get(cls, 0.0)}
+                for cls in sorted(set(floor_by_cls) | set(intf_by_cls))}
+            out_targets[target] = {
+                'ticks': ticks,
+                'tick_seconds': tick_s,
+                'mixed_tick_frac': round(mixed_frac, 4),
+                'excess_seconds': excess,
+                'itl_split': classes,
+                'interference_frac': interference_frac,
+                'itl_p99_s': itl_p99,
+                'kv_bytes_per_token': kv_bpt,
+                'advisor': disagg_advisor.advise(
+                    itl_p99_s=itl_p99,
+                    interference_frac=interference_frac,
+                    mixed_tick_frac=mixed_frac,
+                    kv_bytes_per_token=kv_bpt,
+                    prompt_tokens_per_request=prompt_per_req,
+                    output_tokens_per_request=output_per_req,
+                    dcn_gbps=dcn_gbps,
+                    dcn_source=dcn_source),
+            }
+            agg['floor_s'] += floor_s
+            agg['interference_s'] += intf_s
+            agg['excess_s'] += excess
+            agg['requests'] += requests
+            agg['prefill_tokens'] += prefill_toks
+            agg['decode_tokens'] += decode_toks
+            if kv_bpt is not None:
+                agg_kv = max(agg_kv or 0.0, kv_bpt)
+            if itl_p99 is not None:
+                agg_itl.append(itl_p99)
+        itl_total = agg['floor_s'] + agg['interference_s']
+        fleet_frac = (agg['interference_s'] / itl_total
+                      if itl_total > 0 else None)
+        fleet_advice = disagg_advisor.advise(
+            itl_p99_s=max(agg_itl) if agg_itl else None,
+            interference_frac=fleet_frac,
+            mixed_tick_frac=0.0,
+            kv_bytes_per_token=agg_kv,
+            prompt_tokens_per_request=(
+                agg['prefill_tokens'] / agg['requests']
+                if agg['requests'] > 0 else None),
+            output_tokens_per_request=(
+                agg['decode_tokens'] / agg['requests']
+                if agg['requests'] > 0 else None),
+            dcn_gbps=dcn_gbps,
+            dcn_source=dcn_source)
+        return {
+            'service': self.service_name,
+            'window_s': window_s,
+            'dcn_gbps': dcn_gbps,
+            'dcn_source': dcn_source,
+            'targets': out_targets,
+            'interference_frac': fleet_frac,
+            'attributed_excess_seconds': agg['excess_s'],
+            'advisor': fleet_advice,
+        }
+
     def fleet_slo(self, window_s: Optional[float] = None
                   ) -> Dict[str, Any]:
         """The ``GET /fleet/slo`` body: burn-rate/alert state per
@@ -667,6 +825,25 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
                                     window_s=window_f))
         return web.json_response(payload)
 
+    async def fleet_interference(request: web.Request) -> web.Response:
+        """Tick-plane aggregate (docs/observability.md "Tick plane"):
+        per-replica prefill<->decode interference attribution and the
+        measured disaggregation-advisor verdicts."""
+        window = request.query.get('window_s')
+        try:
+            window_f = float(window) if window else None
+            if window_f is not None and window_f <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'window_s must be a positive number, got '
+                          f'{window!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, functools.partial(telemetry.interference_report,
+                                    window_s=window_f))
+        return web.json_response(payload)
+
     async def fleet_postmortems(request: web.Request) -> web.Response:
         """Index of postmortem crash bundles visible to this
         controller (SKYT_POSTMORTEM_DIR; train/postmortem.py): the
@@ -695,5 +872,6 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
     app.router.add_get('/fleet/comms', fleet_comms)
     app.router.add_get('/fleet/capacity', fleet_capacity)
     app.router.add_get('/fleet/kv', fleet_kv)
+    app.router.add_get('/fleet/interference', fleet_interference)
     app.router.add_get('/fleet/postmortems', fleet_postmortems)
     app.router.add_post('/fleet/profile', fleet_profile)

@@ -41,6 +41,21 @@ PREFIX = 'postmortem-'
 # user secrets) must not ride along.
 _ENV_PREFIXES = ('SKYT_', 'JAX_', 'MEGASCALE_', 'SKYPILOT_')
 
+# Process-wide state.json enrichers: every bundle dumped from this
+# process gains key = fn(). Registered by subsystems that know what a
+# dying process should leave behind (the inference server registers
+# 'recent_ticks' — the tick plane's last records, i.e. what the engine
+# loop was actually doing at capture). Per-reader guarded: a broken
+# reader writes an error string into its key, never kills the dump.
+_STATE_READERS: Dict[str, Callable[[], Any]] = {}
+
+
+def register_state_reader(key: str, fn: Callable[[], Any]) -> None:
+    """Enrich every future bundle's state.json with ``key = fn()``
+    (last registration wins — an engine restart re-registers its
+    reader over the dead engine's)."""
+    _STATE_READERS[key] = fn
+
 
 def bundle_root() -> str:
     return os.path.expanduser(
@@ -134,6 +149,11 @@ def dump_bundle(reason: str, *,
             'env': {k: v for k, v in sorted(os.environ.items())
                     if k.startswith(_ENV_PREFIXES)},
         }
+        for key, fn in sorted(_STATE_READERS.items()):
+            try:
+                state[key] = fn()
+            except Exception as e:  # pylint: disable=broad-except
+                state[key] = f'reader error: {e!r}'
         if extra:
             state.update(extra)
         with open(os.path.join(tmp, 'state.json'), 'w',

@@ -454,6 +454,32 @@ _var('SKYT_TRAFFIC_MAX_INFLIGHT', 'int', 256,
 _var('SKYT_TRAFFIC_SEED', 'int', 0,
      'Default seed of the deterministic workload schedule.')
 
+# ---------------------------------------- tick plane / interference
+_var('SKYT_TICKSTATS', 'bool', True,
+     'Tick plane (infer/tickstats.py): per-tick records at '
+     '/debug/ticks + prefill<->decode interference attribution. 0 '
+     'removes the recording call from the engine loop entirely.')
+_var('SKYT_TICKSTATS_RING', 'int', 512,
+     'Tick records retained in the /debug/ticks ring (drop-oldest).')
+_var('SKYT_TICKSTATS_EWMA', 'float', 0.2,
+     'EWMA weight of the pure-decode tick-time baseline per '
+     'active-slot bucket.')
+_var('SKYT_TICKSTATS_ISOLATE', 'bool', False,
+     'Isolated-prefill schedule: admit prefill only from ticks with '
+     'no active decode slots (the disaggregation counterfactual '
+     'bench.py\'s interference phase measures against).')
+_var('SKYT_INTERFERENCE_MIN_SAMPLES', 'int', 4,
+     'Pure-decode ticks a slot bucket needs before its baseline is '
+     'warm enough to attribute mixed-tick excess.')
+_var('SKYT_INTERFERENCE_MIN_INFLATION', 'float', 0.1,
+     'Disaggregation advisor floor: measured interference below this '
+     'fraction of ITL is treated as noise, not a reason to split '
+     'prefill off-replica.')
+_var('SKYT_INTERFERENCE_DCN_GBPS', 'float', 10.0,
+     'Fallback DCN bandwidth (GB/s) for the advisor\'s KV transfer '
+     'cost when no measured comms profile covers a DCN pair '
+     '(verdicts mark it "assumed").')
+
 # -------------------------------------------------------------- train
 _var('SKYT_WATCHDOG', 'bool', True,
      'Master switch for heartbeats + rank sentinel + gang watchdog.')

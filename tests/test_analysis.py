@@ -476,6 +476,37 @@ def test_terminal_state_catalog_equality(tmp_path):
                 if v.pass_id == 'registry-consistency'], violations
 
 
+def test_http_route_drift_reds_both_ways(tmp_path):
+    _write(tmp_path, 'skypilot_tpu/serve/surfaced.py', '''\
+        def wire(app, handler):
+            app.router.add_get('/debug/widgets', handler)
+            app.router.add_get('/fleet/widgets', handler)
+            app.router.add_post('/internal/not_checked', handler)
+        ''')
+    _write(tmp_path, 'docs/observability.md', '''\
+        Routes: `GET /debug/widgets` and `GET /fleet/ghost_route`.
+        ''')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    msgs = [v.message for v in violations
+            if v.pass_id == 'registry-consistency']
+    # Code-side drift: a registered surface missing from the catalog.
+    assert any("'/fleet/widgets'" in m and 'not documented' in m
+               for m in msgs), violations
+    # Doc-side drift: a cataloged route with no registration.
+    assert any("'/fleet/ghost_route'" in m and 'no add_get/add_post'
+               in m for m in msgs), violations
+    # Documented routes and non-debug/fleet prefixes stay quiet.
+    assert not any("'/debug/widgets'" in m for m in msgs), violations
+    assert not any('not_checked' in m for m in msgs), violations
+
+    # Both sides reconciled -> exit clean.
+    _write(tmp_path, 'docs/observability.md',
+           'Routes: `GET /debug/widgets`, `GET /fleet/widgets`.\n')
+    violations = core.analyze(tmp_path, ['skypilot_tpu'])
+    assert not [v for v in violations
+                if v.pass_id == 'registry-consistency'], violations
+
+
 # ------------------------------------------------------- noqa semantics
 def test_noqa_grammar_per_pass_id(tmp_path):
     # named suppression of a DIFFERENT pass does not silence

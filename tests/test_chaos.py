@@ -1808,6 +1808,109 @@ def test_chaos_replica_kill_mid_burst(monkeypatch):
 
 
 @pytest.mark.integration
+def test_chaos_interference_survives_replica_kill():
+    """Tick-plane drill (docs/observability.md "Tick plane"): a
+    mid-burst replica SIGKILL must not poison the survivor's
+    interference accounting. The survivor's pure-decode baselines stay
+    warm and finite, fresh requests still get a decode-floor/
+    interference ITL split, and the fleet rollup ages the dead replica
+    out past the stale horizon instead of carrying its frozen series
+    into the advisor's inputs forever."""
+    from skypilot_tpu.serve import fleet as fleet_lib
+
+    class Clock:
+        def __init__(self):
+            self.t = time.time()
+
+        def __call__(self):
+            return self.t
+
+    p1, p2 = _free_port(), _free_port()
+    tick_env = {'SKYT_TICKSTATS': '1',
+                'SKYT_INTERFERENCE_MIN_SAMPLES': '2'}
+    procs = [_spawn_replica(p1, tick_env), _spawn_replica(p2, tick_env)]
+    urls = [f'http://127.0.0.1:{p1}', f'http://127.0.0.1:{p2}']
+    try:
+        for proc, url in zip(procs, urls):
+            _wait_http(url + '/health', timeout=180, proc=proc)
+        # Warm both replicas: multi-chunk decodes give every tick/ITL
+        # series a first scrape edge and warm the decode baselines.
+        for url in urls:
+            for _ in range(3):
+                requests.post(
+                    url + '/generate',
+                    json={'tokens': [5, 6, 7], 'max_tokens': 24},
+                    timeout=120).raise_for_status()
+        clock = Clock()
+        fl = fleet_lib.FleetTelemetry(
+            'chaos', metrics_registry=metrics_lib.MetricsRegistry(),
+            clock=clock)
+        assert fl.scrape('0', urls[0])
+        assert fl.scrape('1', urls[1])
+
+        def burst(url):
+            for i in range(30):
+                try:
+                    requests.post(
+                        url + '/generate',
+                        json={'tokens': [i % 13 + 2, 3, 4],
+                              'max_tokens': 16},
+                        timeout=30)
+                except requests.RequestException:
+                    pass   # in-flight work on the killed replica
+
+        threads = [threading.Thread(target=burst, args=(u,))
+                   for u in urls for _ in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(1.0)
+        procs[0].kill()   # SIGKILL mid-burst: no graceful anything
+        for th in threads:
+            th.join(timeout=180)
+
+        time.sleep(0.3)
+        clock.t += 40
+        assert not fl.scrape('0', urls[0])   # dead: scrape fails
+        assert fl.scrape('1', urls[1])
+
+        # Survivor's baselines are warm, finite, and un-poisoned.
+        summ = requests.get(urls[1] + '/debug/ticks?last=16',
+                            timeout=10).json()['summary']
+        assert summ['ticks'] > 0
+        assert summ['baselines'], summ
+        for b in summ['baselines'].values():
+            assert 0.0 < b['ewma_s'] < 5.0, summ['baselines']
+        # Fresh work after the kill still accrues an ITL split.
+        before = summ['classes']['standard']['decode_floor_s']
+        requests.post(urls[1] + '/generate',
+                      json={'tokens': [9, 9, 9], 'max_tokens': 24},
+                      timeout=120).raise_for_status()
+        after = requests.get(urls[1] + '/debug/ticks?last=1',
+                             timeout=10).json()['summary']
+        assert after['classes']['standard']['decode_floor_s'] > before
+
+        # Rollup at the scrape horizon: both targets present, the
+        # survivor's families advanced through the burst.
+        rep = fl.interference_report(window_s=600, now=clock.t)
+        t1 = rep['targets']['1']
+        assert sum(t1['ticks'].values()) > 0
+        assert t1['itl_split'], t1
+        assert t1['advisor']['recommendation'] in (
+            'disaggregate', 'keep_colocated', 'insufficient_data')
+
+        # Past the stale horizon the dead replica ages out of the
+        # rollup; the recently-scraped survivor stays.
+        rep2 = fl.interference_report(window_s=600,
+                                      now=clock.t + fl.stale_s - 5)
+        assert '0' not in rep2['targets'], sorted(rep2['targets'])
+        assert '1' in rep2['targets'], sorted(rep2['targets'])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+
+
+@pytest.mark.integration
 def test_chaos_batch_flood_sheds_only_batch(monkeypatch):
     """QoS acceptance scenario (docs/qos.md) through the REAL LB ->
     server -> engine stack: a batch-class flood against one replica

@@ -1,0 +1,334 @@
+"""infer/tickstats.py + infer/disagg_advisor.py: the tick plane's
+ring and attribution math under an injectable clock, the structural
+disablement path, the per-request ITL split, and the advisor goldens
+(docs/observability.md "Tick plane")."""
+import threading
+
+import pytest
+
+from skypilot_tpu.infer import disagg_advisor
+from skypilot_tpu.infer import tickstats
+from skypilot_tpu.utils import metrics as metrics_lib
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def make(**kw):
+    kw.setdefault('clock', FakeClock())
+    return tickstats.TickStats(**kw)
+
+
+# ------------------------------------------------------------ buckets
+def test_slot_bucket_is_pow2():
+    assert tickstats.slot_bucket(0) == 1
+    assert tickstats.slot_bucket(1) == 1
+    assert tickstats.slot_bucket(2) == 2
+    assert tickstats.slot_bucket(3) == 4
+    assert tickstats.slot_bucket(5) == 8
+    assert tickstats.slot_bucket(8) == 8
+    assert tickstats.slot_bucket(9) == 16
+
+
+# ----------------------------------------------------- classification
+def test_tick_kinds():
+    ts = make()
+    kind, _, _ = ts.on_tick(dur_s=0.01, active_slots=2, decode_reqs=2)
+    assert kind == 'decode'
+    kind, _, _ = ts.on_tick(dur_s=0.01, active_slots=2, decode_reqs=2,
+                            prefill_reqs=1, prefill_tokens=16)
+    assert kind == 'mixed'
+    kind, _, _ = ts.on_tick(dur_s=0.01, active_slots=0, decode_reqs=0,
+                            prefill_reqs=2, prefill_tokens=32)
+    assert kind == 'prefill'
+    s = ts.summary()
+    assert s['by_kind'] == {'decode': 1, 'mixed': 1, 'prefill': 1}
+    assert s['ticks'] == 3
+    assert s['mixed_frac'] == pytest.approx(1 / 3)
+
+
+# ------------------------------------------------------ ring eviction
+def test_ring_eviction_counts_drops_and_keeps_newest():
+    ts = make(ring=8)
+    for i in range(20):
+        ts.on_tick(dur_s=0.001 * (i + 1), active_slots=1,
+                   decode_reqs=1)
+    s = ts.summary()
+    assert s['ring'] == {'retained': 8, 'dropped': 12}
+    recs = ts.last(100)
+    assert len(recs) == 8
+    assert [r['seq'] for r in recs] == list(range(13, 21))
+    assert ts.last(3) == recs[-3:]
+    # Aggregates survive eviction: all 20 ticks counted.
+    assert s['ticks'] == 20
+
+
+# -------------------------------------------------------- attribution
+def test_baseline_warms_after_min_samples():
+    ts = make(min_samples=4, ewma_alpha=0.2)
+    for _ in range(3):
+        _, baseline, _ = ts.on_tick(dur_s=0.010, active_slots=1,
+                                    decode_reqs=1)
+        assert baseline is None
+    # A mixed tick against a cold baseline attributes nothing.
+    kind, baseline, excess = ts.on_tick(
+        dur_s=0.050, active_slots=1, decode_reqs=1, prefill_reqs=1)
+    assert (kind, baseline, excess) == ('mixed', None, 0.0)
+    # Fourth pure-decode sample warms the bucket.
+    _, baseline, _ = ts.on_tick(dur_s=0.010, active_slots=1,
+                                decode_reqs=1)
+    assert baseline == pytest.approx(0.010)
+    kind, baseline, excess = ts.on_tick(
+        dur_s=0.015, active_slots=1, decode_reqs=1, prefill_reqs=1)
+    assert kind == 'mixed'
+    assert baseline == pytest.approx(0.010)
+    assert excess == pytest.approx(0.005)
+    s = ts.summary()
+    assert s['excess_seconds'] == pytest.approx(0.005)
+    assert s['baselines']['1']['warm'] is True
+    assert s['baselines']['1']['samples'] == 4
+
+
+def test_ewma_update_math():
+    ts = make(min_samples=1, ewma_alpha=0.5)
+    ts.on_tick(dur_s=0.010, active_slots=1, decode_reqs=1)
+    _, baseline, _ = ts.on_tick(dur_s=0.020, active_slots=1,
+                                decode_reqs=1)
+    # 0.010 + 0.5 * (0.020 - 0.010)
+    assert baseline == pytest.approx(0.015)
+
+
+def test_baselines_are_per_slot_bucket():
+    ts = make(min_samples=1)
+    ts.on_tick(dur_s=0.010, active_slots=1, decode_reqs=1)
+    ts.on_tick(dur_s=0.030, active_slots=2, decode_reqs=2)
+    # A mixed tick at width 2 compares against bucket 2, not 1.
+    _, baseline, excess = ts.on_tick(
+        dur_s=0.032, active_slots=2, decode_reqs=2, prefill_reqs=1)
+    assert baseline == pytest.approx(0.030)
+    assert excess == pytest.approx(0.002)
+    # Bucket 4 has no samples: cold, nothing attributed.
+    _, baseline, excess = ts.on_tick(
+        dur_s=0.1, active_slots=4, decode_reqs=4, prefill_reqs=1)
+    assert (baseline, excess) == (None, 0.0)
+
+
+def test_mixed_excess_never_negative():
+    ts = make(min_samples=1)
+    ts.on_tick(dur_s=0.010, active_slots=1, decode_reqs=1)
+    _, _, excess = ts.on_tick(dur_s=0.002, active_slots=1,
+                              decode_reqs=1, prefill_reqs=1)
+    assert excess == 0.0
+
+
+def test_mixed_ticks_do_not_move_the_baseline():
+    ts = make(min_samples=1, ewma_alpha=0.5)
+    ts.on_tick(dur_s=0.010, active_slots=1, decode_reqs=1)
+    for _ in range(5):
+        ts.on_tick(dur_s=0.100, active_slots=1, decode_reqs=1,
+                   prefill_reqs=1)
+    assert ts.summary()['baselines']['1']['ewma_s'] == \
+        pytest.approx(0.010)
+
+
+# ------------------------------------------------- per-request split
+def test_per_request_itl_split_by_class():
+    ts = make()
+    ts.note_request('interactive', 0.08, 0.02)
+    ts.note_request('interactive', 0.04, 0.0)
+    ts.note_request('batch', 0.5, 0.0)
+    cls = ts.summary()['classes']
+    assert cls['interactive']['requests'] == 2
+    assert cls['interactive']['decode_floor_s'] == pytest.approx(0.12)
+    assert cls['interactive']['interference_s'] == pytest.approx(0.02)
+    assert cls['interactive']['interference_frac'] == \
+        pytest.approx(0.02 / 0.14)
+    assert cls['batch']['interference_frac'] == 0.0
+
+
+# ------------------------------------------------------------ metrics
+def test_metric_families_and_first_tick_edge():
+    reg = metrics_lib.MetricsRegistry()
+    ts = make(registry=reg, min_samples=1)
+    ts.on_tick(dur_s=0.010, active_slots=1, decode_reqs=1)
+    ts.note_request('standard', 0.01, 0.0)
+    text = reg.expose()
+    # The excess counter must exist from the FIRST tick (inc(0)) so
+    # fleet-scrape windowed deltas get a baseline edge before the
+    # first attributed excess lands.
+    assert 'skyt_tick_excess_seconds_total 0' in text
+    assert 'skyt_tick_total{kind="decode"} 1' in text
+    ts.on_tick(dur_s=0.015, active_slots=1, decode_reqs=1,
+               prefill_reqs=1)
+    reg2 = reg.expose()
+    assert 'skyt_tick_total{kind="mixed"} 1' in reg2
+    assert 'skyt_tick_baseline_seconds{slots="1"}' in reg2
+    assert 'skyt_interference_decode_floor_seconds' \
+        '{cls="standard"}' in reg2
+    assert ts._m_excess.value() == pytest.approx(0.005)
+
+
+# -------------------------------------------------------- note_host
+def test_note_host_backfills_last_record():
+    ts = make()
+    ts.on_tick(dur_s=0.01, active_slots=1, decode_reqs=1)
+    ts.note_host(0.003)
+    assert ts.last(1)[0]['host_s'] == pytest.approx(0.003)
+
+
+# ------------------------------------------------------ chrome trace
+def test_chrome_trace_slices():
+    clock = FakeClock(10.0)
+    ts = make(clock=clock, min_samples=1)
+    ts.on_tick(dur_s=0.010, active_slots=1, decode_reqs=1)
+    clock.tick(0.02)
+    ts.on_tick(dur_s=0.015, active_slots=1, decode_reqs=1,
+               prefill_reqs=2, prefill_tokens=32, prefill_bucket=16)
+    trace = ts.chrome_trace()
+    assert trace['displayTimeUnit'] == 'ms'
+    meta = [e for e in trace['traceEvents'] if e['ph'] == 'M']
+    slices = [e for e in trace['traceEvents'] if e['ph'] == 'X']
+    assert len(meta) == 2 and len(slices) == 2
+    mixed = slices[1]
+    assert mixed['name'] == 'mixed'
+    assert mixed['dur'] == pytest.approx(0.015 * 1e6)
+    assert mixed['ts'] == pytest.approx((10.02 - 0.015) * 1e6)
+    assert mixed['args']['prefill_reqs'] == 2
+    assert mixed['args']['prefill_bucket'] == 16
+    assert mixed['args']['interference_excess_ms'] == \
+        pytest.approx(5.0)
+
+
+# ------------------------------------------------------- concurrency
+def test_concurrency_hammer():
+    ts = make(ring=64)
+    n_threads, per = 8, 500
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(per):
+                ts.on_tick(dur_s=0.001, active_slots=(i % 4) + 1,
+                           decode_reqs=1,
+                           prefill_reqs=1 if j % 3 == 0 else 0)
+                ts.note_request('standard', 0.001, 0.0)
+                if j % 50 == 0:
+                    ts.summary()
+                    ts.last(8)
+        except Exception as e:  # pylint: disable=broad-except
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    s = ts.summary()
+    assert s['ticks'] == n_threads * per
+    assert s['ring']['retained'] == 64
+    assert s['ring']['dropped'] == n_threads * per - 64
+    assert s['classes']['standard']['requests'] == n_threads * per
+    # seq stayed unique under contention.
+    seqs = [r['seq'] for r in ts.last(64)]
+    assert len(set(seqs)) == 64
+
+
+# ------------------------------------------- structural disablement
+def test_from_env_disabled_returns_none(monkeypatch):
+    monkeypatch.setenv('SKYT_TICKSTATS', '0')
+    assert tickstats.from_env() is None
+
+
+def test_from_env_knobs(monkeypatch):
+    monkeypatch.setenv('SKYT_TICKSTATS', '1')
+    monkeypatch.setenv('SKYT_TICKSTATS_RING', '16')
+    monkeypatch.setenv('SKYT_TICKSTATS_EWMA', '0.5')
+    monkeypatch.setenv('SKYT_INTERFERENCE_MIN_SAMPLES', '2')
+    ts = tickstats.from_env()
+    assert ts is not None
+    assert ts._ring.maxlen == 16
+    assert ts._alpha == 0.5
+    assert ts._min_samples == 2
+
+
+# --------------------------------------------------- advisor goldens
+def test_advisor_insufficient_without_attribution():
+    v = disagg_advisor.advise(
+        itl_p99_s=None, interference_frac=None,
+        kv_bytes_per_token=512.0, prompt_tokens_per_request=100.0,
+        output_tokens_per_request=64.0, dcn_gbps=10.0,
+        dcn_source='measured')
+    assert v['recommendation'] == 'insufficient_data'
+    assert v['tradeoff']['benefit_s_per_request'] is None
+
+
+def test_advisor_insufficient_without_transfer_inputs():
+    v = disagg_advisor.advise(
+        itl_p99_s=0.02, interference_frac=0.3,
+        kv_bytes_per_token=None, prompt_tokens_per_request=100.0,
+        output_tokens_per_request=64.0, dcn_gbps=10.0)
+    assert v['recommendation'] == 'insufficient_data'
+    assert 'transfer-cost inputs missing' in v['reason']
+
+
+def test_advisor_keep_colocated_below_noise_floor():
+    v = disagg_advisor.advise(
+        itl_p99_s=0.02, interference_frac=0.05,
+        kv_bytes_per_token=512.0, prompt_tokens_per_request=100.0,
+        output_tokens_per_request=64.0, dcn_gbps=10.0,
+        dcn_source='measured', min_inflation=0.1)
+    assert v['recommendation'] == 'keep_colocated'
+    assert 'below the 10% floor' in v['reason']
+
+
+def test_advisor_keep_colocated_when_transfer_dominates():
+    # Benefit 1e-6 * 0.5 * 2 = 1e-6 s/request; transfer
+    # 512 * 4096 / (0.001 * 1e9) ≈ 2.1 s/request.
+    v = disagg_advisor.advise(
+        itl_p99_s=1e-6, interference_frac=0.5,
+        kv_bytes_per_token=512.0, prompt_tokens_per_request=4096.0,
+        output_tokens_per_request=2.0, dcn_gbps=0.001,
+        dcn_source='measured', min_inflation=0.1)
+    assert v['recommendation'] == 'keep_colocated'
+    assert 'does not cover' in v['reason']
+
+
+def test_advisor_disaggregate_golden():
+    v = disagg_advisor.advise(
+        itl_p99_s=0.020, interference_frac=0.3,
+        mixed_tick_frac=0.4,
+        kv_bytes_per_token=512.0, prompt_tokens_per_request=100.0,
+        output_tokens_per_request=64.0, dcn_gbps=10.0,
+        dcn_source='measured', min_inflation=0.1)
+    assert v['recommendation'] == 'disaggregate'
+    assert v['measured']['predicted_itl_improvement_s'] == \
+        pytest.approx(0.006)
+    assert v['transfer']['bytes_per_request'] == pytest.approx(51200.0)
+    assert v['transfer']['predicted_transfer_cost_s_per_request'] == \
+        pytest.approx(51200.0 / 1e10)
+    assert v['tradeoff']['benefit_s_per_request'] == \
+        pytest.approx(0.384)
+    assert 'measured DCN' in v['reason']
+    assert v['inputs']['min_inflation'] == 0.1
+
+
+def test_advisor_env_fallback_marks_assumed(monkeypatch):
+    monkeypatch.setenv('SKYT_INTERFERENCE_DCN_GBPS', '25.0')
+    v = disagg_advisor.advise(
+        itl_p99_s=0.020, interference_frac=0.3,
+        kv_bytes_per_token=512.0, prompt_tokens_per_request=100.0,
+        output_tokens_per_request=64.0, dcn_gbps=None,
+        dcn_source='measured')   # source is overridden: no profile
+    assert v['transfer']['dcn_gbps'] == 25.0
+    assert v['transfer']['dcn_source'] == 'assumed'

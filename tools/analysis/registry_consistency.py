@@ -1,7 +1,7 @@
 """registry-consistency: runtime registries and their docs catalogs
 cannot drift.
 
-Three sub-checks, one pass id:
+Four sub-checks, one pass id:
 
   * fault points — every ``faults.inject('p')`` / ``ainject('p')``
     call site must have a row in docs/robustness.md's fault-point
@@ -13,6 +13,11 @@ Three sub-checks, one pass id:
     qos.md, robustness.md, ...); where the docs attach a label set
     (``name{a,b}``) it must equal the code's label names. Docs may
     use brace alternation (``skyt_slo_{good_,}requests_total``);
+  * HTTP debug/fleet surface — every ``add_get``/``add_post`` route
+    under ``/debug/*`` or ``/fleet/*`` must appear in
+    docs/observability.md, and every such route token in the doc
+    must have a live registration (the surface grew to ~10 routes
+    across five PRs with no machine check);
   * JobStatus terminal states — the ``_TERMINAL`` set in
     runtime/job_lib.py must equal the backticked list on the
     ``Terminal states:`` line of docs/managed-jobs.md.
@@ -43,6 +48,11 @@ _METRIC_TOK_RE = re.compile(
     r'skyt_(?:[a-z0-9_]|\{[a-z0-9_,]*\}(?=[a-z0-9_]))*'
     r'(?:\{(?P<labels>[a-z0-9_,]+)\})?')
 _TERMINAL_LINE_RE = re.compile(r'^Terminal states?:\s*(.*)$')
+# A /debug/* or /fleet/* route token (code-side: the literal first
+# argument of add_get/add_post; doc-side: any occurrence in
+# docs/observability.md's prose or route-catalog table).
+_ROUTE_DOC_REL = 'docs/observability.md'
+_ROUTE_TOK_RE = re.compile(r'/(?:debug|fleet)/[a-z_]+')
 
 
 def _expand_braces(tok: str) -> List[str]:
@@ -64,6 +74,7 @@ class RegistryConsistencyPass(Pass):
         out: List[Violation] = []
         out += self._check_faults(project)
         out += self._check_metrics(project)
+        out += self._check_http_routes(project)
         out += self._check_terminal_states(project)
         return out
 
@@ -201,6 +212,56 @@ class RegistryConsistencyPass(Pass):
                     f'{tuple(labels)!r} does not match the '
                     f'documented label set {shown!r} — fix '
                     f'whichever is stale'))
+        return out
+
+    # ---------------------------------------------- HTTP debug surface
+    def _check_http_routes(self, project: Project) -> List[Violation]:
+        """Route registrations (`add_get('/debug/x', ...)` /
+        `add_post('/fleet/y', ...)`) vs the docs/observability.md
+        surface catalog, both ways."""
+        sites: Dict[str, Tuple[str, int]] = {}
+        for ctx in project.files:
+            if ctx.tree is None or 'skypilot_tpu' not in ctx.rel:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in ('add_get', 'add_post') and
+                        node.args and
+                        isinstance(node.args[0], ast.Constant) and
+                        isinstance(node.args[0].value, str)):
+                    continue
+                route = node.args[0].value
+                if not route.startswith(('/debug/', '/fleet/')):
+                    continue
+                sites.setdefault(route, (ctx.rel, node.lineno))
+        if not sites:
+            return []
+        doc = project.doc(_ROUTE_DOC_REL)
+        if doc is None:
+            return []
+        documented: Dict[str, int] = {}
+        for i, line in enumerate(doc.splitlines(), 1):
+            for m in _ROUTE_TOK_RE.finditer(line):
+                documented.setdefault(m.group(0), i)
+        out: List[Violation] = []
+        for route, (rel, lineno) in sorted(sites.items()):
+            if route not in documented:
+                out.append(Violation(
+                    rel, lineno, self.id,
+                    f'HTTP route {route!r} is not documented in '
+                    f'{_ROUTE_DOC_REL} — every /debug/* and /fleet/* '
+                    f'surface is part of the observability contract '
+                    f'and must appear in the route catalog'))
+        doc_rel = (project.root / _ROUTE_DOC_REL).as_posix()
+        for route, lineno in sorted(documented.items()):
+            if route not in sites:
+                out.append(Violation(
+                    doc_rel, lineno, self.id,
+                    f'documented HTTP route {route!r} has no '
+                    f'add_get/add_post registration — the surface it '
+                    f'describes no longer exists; delete the mention '
+                    f'or restore the route'))
         return out
 
     # ------------------------------------------------ terminal states

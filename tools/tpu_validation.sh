@@ -1499,6 +1499,150 @@ else
     FAIL=1
 fi
 
+echo "== 19. tick plane: interference observatory on-chip — mixed"
+echo "   burst through a real server, /debug/ticks populated (+ the"
+echo "   chrome export), /fleet/interference shows a nonzero"
+echo "   attributed component, and the disaggregation advisor returns"
+echo "   a structured verdict (docs/observability.md 'Tick plane') =="
+if SKYT_VALIDATION_OUT="$OUT" timeout 900 python - \
+        <<'PYEOF' 2>&1 | tee "$OUT/interference_probe.txt"
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import requests
+
+from skypilot_tpu.benchmark import workload
+from skypilot_tpu.serve import fleet as fleet_lib
+from skypilot_tpu.utils import metrics as metrics_lib
+
+OUT = os.environ['SKYT_VALIDATION_OUT']
+ART = os.path.join(OUT, 'interference_probe.json')
+
+
+def artifact(status, **kw):
+    rec = {'status': status, 'step': 'interference_probe', **kw}
+    with open(ART, 'w') as f:
+        json.dump(rec, f, sort_keys=True)
+    print(f'interference artifact: {json.dumps(rec, sort_keys=True)}')
+
+
+with socket.socket() as s:
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+env = dict(os.environ, SKYT_TICKSTATS='1')
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(port),
+     '--num-slots', '2', '--max-seq-len', '64'], env=env)
+base = f'http://127.0.0.1:{port}'
+try:
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        try:
+            if requests.get(base + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            artifact('replica_died', rc=proc.returncode)
+            raise SystemExit(f'server died rc={proc.returncode}')
+        time.sleep(1)
+    else:
+        artifact('replica_unhealthy', timeout_s=480)
+        raise SystemExit('server never became healthy')
+
+    # Prime: multi-chunk decodes warm the pure-decode baselines and
+    # give every counter/histogram series a first scrape edge (the
+    # ITL histogram only observes steady pull-to-pull intervals).
+    for _ in range(4):
+        requests.post(base + '/generate',
+                      json={'tokens': [7, 8, 9, 10],
+                            'max_tokens': 24},
+                      headers={'X-Priority': 'interactive'},
+                      timeout=300).raise_for_status()
+    time.sleep(0.5)
+    fl = fleet_lib.FleetTelemetry(
+        'validation', metrics_registry=metrics_lib.MetricsRegistry())
+    assert fl.scrape('1', base), 'baseline scrape failed'
+
+    # Mixed burst: open-loop arrivals force prefill admission while
+    # earlier requests are still decoding -> mixed ticks.
+    spec = workload.WorkloadSpec(
+        seed=workload.default_seed(), duration_s=8.0, rate_rps=5.0,
+        arrival='poisson',
+        tenants=(workload.TenantProfile(
+            tenant='probe', cls='interactive',
+            prompt_mean=6.0, prompt_sigma=0.4, prompt_cap=12,
+            output_mean=20.0, output_sigma=0.4, output_cap=32,
+            session_pool=4, session_reuse=0.3, prefix_len=2),))
+    outs = workload.OpenLoopRunner(
+        workload.http_submitter(base, timeout_s=300.0),
+        compression=2.0).run(workload.generate_schedule(spec))
+    ok = sum(1 for o in outs if o.status == 200)
+    assert ok > 0, f'no successful requests in the burst ({len(outs)})'
+    time.sleep(0.5)
+    assert fl.scrape('1', base), 'post-burst scrape failed'
+
+    # /debug/ticks: populated ring, sane summary, chrome export.
+    body = requests.get(base + '/debug/ticks?last=64',
+                        timeout=10).json()
+    summ = body['summary']
+    assert summ['ticks'] > 0, summ
+    assert summ['by_kind'].get('mixed', 0) > 0, \
+        f'burst produced no mixed ticks: {summ["by_kind"]}'
+    assert body['ticks'], 'tick ring empty'
+    chrome = requests.get(base + '/debug/ticks?format=chrome',
+                          timeout=10).json()
+    assert chrome.get('traceEvents'), 'chrome export empty'
+
+    # /fleet/interference through the real read path: a nonzero
+    # attributed component and a structured advisor verdict.
+    rep = fl.interference_report(window_s=600)
+    tgt = rep['targets'].get('1')
+    assert tgt, f'replica missing from rollup: {rep}'
+    attributed = tgt['excess_seconds']
+    assert attributed > 0, \
+        f'no attributed interference despite mixed ticks: {tgt}'
+    adv = tgt['advisor']
+    assert adv['recommendation'] in ('disaggregate',
+                                     'keep_colocated'), adv
+    assert 'benefit_s_per_request' in adv['tradeoff'], adv
+    assert 'predicted_transfer_cost_s_per_request' in \
+        adv['transfer'], adv
+
+    artifact('ok',
+             requests_ok=ok,
+             ticks=summ['ticks'],
+             by_kind=summ['by_kind'],
+             mixed_tick_frac=tgt['mixed_tick_frac'],
+             attributed_excess_seconds=round(attributed, 6),
+             interference_frac=tgt['interference_frac'],
+             advisor_recommendation=adv['recommendation'],
+             advisor_reason=adv['reason'],
+             dcn_source=rep['dcn_source'])
+    print(f'INTERFERENCE_PROBE_OK ticks={summ["ticks"]} '
+          f'mixed={summ["by_kind"].get("mixed", 0)} '
+          f'excess_s={attributed:.6f} '
+          f'advisor={adv["recommendation"]}')
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PYEOF
+then
+    echo "== interference probe: PASS =="
+else
+    echo "== interference probe: FAIL (see $OUT/interference_probe.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
