@@ -57,6 +57,7 @@ PHASE_DEADLINES = {
     'comms plane bench': 600,
     'capacity bench': 600,
     'interference bench': 600,
+    'elastic bench': 600,
 }
 
 # The bench's own rank-0 heartbeat (train/heartbeat.py): the train
@@ -2654,6 +2655,286 @@ def interference_bench_metrics() -> list:
                 os.environ[k] = v
 
 
+def elastic_bench_metrics() -> list:
+    """Elastic-capacity phase (CPU-runnable, docs/serving.md
+    "Elastic capacity"):
+
+      * elastic_cold_start_ttft_s — client-observed latency through a
+        scale-to-zero wake: a 4-wide arrival wave parks in the LB
+        surge queue while the fleet "cold-starts" (a controlled wake
+        delay), and every parked request must be served — zero 5xx
+        for the parked class;
+      * elastic_forecast_slo_attainment — a deterministic simulated-
+        clock decision replay: the SAME periodic demand wave through
+        the reactive autoscaler and the predictive wrapper, with a
+        60 s provisioning lead. Attainment = fraction of measured
+        steps where provisioned capacity covers offered demand; the
+        predictive path must not be worse (it pre-scales before each
+        wave instead of paying delay + lead after it);
+      * elastic_reshard_qps_per_chip_delta_pct — the PR 16 capacity
+        search before and after an in-place /admin/reshard layout
+        flip on the live replica. On CPU the flip is an identity
+        restage, so the honest claim is that resharding is ~free in
+        throughput (mechanism check); on a real mesh the layouts
+        genuinely differ.
+    """
+    import socket
+    import threading
+    import types
+
+    import requests
+    from aiohttp import web
+
+    from skypilot_tpu.benchmark import capacity as capacity_lib
+    from skypilot_tpu.benchmark import workload
+    from skypilot_tpu.infer import server as server_lib
+    from skypilot_tpu.serve import autoscalers as asc_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.utils import env as env_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+
+    phase_env = {
+        'SKYT_SERVE_LB_SYNC_INTERVAL': '3600',
+        'SKYT_LB_NO_REPLICA_POLL_S': '0.05',
+        'SKYT_LB_NO_REPLICA_TIMEOUT_S': '60',
+        'SKYT_ADMIN_TOKEN': 'bench-elastic',
+    }
+    saved = {k: os.environ.get(k) for k in phase_env}
+    os.environ.update(phase_env)
+
+    def _port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    eng = server_lib.build_engine('debug', num_slots=2, max_seq_len=64,
+                                  decode_chunk=8, cache_mode='dense',
+                                  prefix_caching=False)
+    eng.start()
+    try:
+        srv = server_lib.InferenceServer(eng)
+        rport = _port()
+        threading.Thread(target=lambda: web.run_app(
+            srv.make_app(), port=rport, print=None,
+            handle_signals=False), daemon=True).start()
+        rbase = f'http://127.0.0.1:{rport}'
+        sess = requests.Session()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                if sess.get(rbase + '/health',
+                            timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.2)
+        # Warm the compile so the cold-start number measures the
+        # surge-queue wake, not XLA.
+        sess.post(rbase + '/generate',
+                  json={'tokens': [2, 3, 4], 'max_tokens': 4},
+                  timeout=120).raise_for_status()
+
+        # The LB starts with an EMPTY ready set: scaled to zero.
+        lport = _port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            'http://127.0.0.1:9', lport,
+            metrics_registry=metrics_lib.MetricsRegistry())
+        threading.Thread(target=lambda: web.run_app(
+            lb.make_app(), port=lport, print=None,
+            handle_signals=False), daemon=True).start()
+        base = f'http://127.0.0.1:{lport}'
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                sess.get(base + '/metrics', timeout=2)
+                break
+            except requests.RequestException:
+                time.sleep(0.1)
+
+        # -- Cold-start TTFT through the surge queue.
+        wake_delay_s = 1.0
+        lat, codes, lock = [], [], threading.Lock()
+
+        def arrival():
+            s2 = requests.Session()
+            t0 = time.perf_counter()
+            r = s2.post(base + '/generate',
+                        json={'tokens': [3, 4, 5], 'max_tokens': 4},
+                        timeout=120)
+            with lock:
+                lat.append(time.perf_counter() - t0)
+                codes.append(r.status_code)
+
+        threads = [threading.Thread(target=arrival) for _ in range(4)]
+        for th in threads:
+            th.start()
+        time.sleep(wake_delay_s)     # the fleet cold-starts...
+        lb.policy.set_ready_replicas([rbase])   # ...and wakes
+        for th in threads:
+            th.join(timeout=180)
+        parked_5xx = sum(1 for c in codes if c >= 500)
+        cold_ttft = sorted(lat)[len(lat) // 2] if lat else None
+
+        # -- Forecast-vs-reactive attainment: simulated clock, same
+        # wave, 60 s provisioning lead. Square wave, period 300 s =
+        # the default season (30 x 10 s buckets).
+        sim = {'t': 1_000_000.0}
+        real_time_mod = asc_lib.time
+        asc_lib.time = types.SimpleNamespace(time=lambda: sim['t'])
+        try:
+            # Downscale delay shorter than the low phase (200 s) so
+            # the reactive path genuinely shrinks between waves and
+            # pays upscale-delay + lead on every rise; 600 s would
+            # let the first wave's capacity coast through the rest.
+            spec = spec_lib.ServiceSpec(
+                readiness_path='/', min_replicas=1, max_replicas=10,
+                target_qps_per_replica=2.0,
+                upscale_delay_seconds=30,
+                downscale_delay_seconds=60)
+            lead_s, dt = 60.0, 5.0
+            period, high_s, low_q, high_q = 300.0, 100.0, 2.0, 18.0
+
+            def demand(rel_t):
+                return high_q if (rel_t % period) < high_s else low_q
+
+            def replay(make_autoscaler):
+                sim['t'] = 1_000_000.0
+                t0 = sim['t']
+                a = make_autoscaler()
+                ready, pending = spec.min_replicas, []
+                ok = n = 0
+                # 3 seasons of warmup (the forecaster's trust gate),
+                # 2 measured.
+                while sim['t'] - t0 < 5 * period:
+                    d = demand(sim['t'] - t0)
+                    n_arr = int(d * dt)
+                    a.collect_request_timestamps(
+                        [sim['t'] + i * dt / n_arr
+                         for i in range(n_arr)])
+                    sim['t'] += dt
+                    for item in list(pending):
+                        if item[0] <= sim['t']:
+                            ready += item[1]
+                            pending.remove(item)
+                    tgt = a.evaluate_scaling(
+                        ready).target_num_replicas
+                    inflight = sum(c for _, c in pending)
+                    if tgt > ready + inflight:
+                        pending.append((sim['t'] + lead_s,
+                                        tgt - ready - inflight))
+                    elif tgt < ready:
+                        ready = tgt
+                    if sim['t'] - t0 >= 3 * period:
+                        n += 1
+                        if ready * spec.target_qps_per_replica \
+                                >= d - 1e-9:
+                            ok += 1
+                return ok / n if n else 0.0
+
+            reactive_att = replay(
+                lambda: asc_lib.RequestRateAutoscaler(
+                    spec, metrics_registry=metrics_lib
+                    .MetricsRegistry()))
+            forecast_att = replay(
+                lambda: asc_lib.PredictiveAutoscaler(
+                    asc_lib.RequestRateAutoscaler(
+                        spec, metrics_registry=metrics_lib
+                        .MetricsRegistry()),
+                    metrics_registry=metrics_lib.MetricsRegistry(),
+                    clock=lambda: sim['t']))
+        finally:
+            asc_lib.time = real_time_mod
+
+        # -- QPS-per-chip before/after an in-place reshard (the PR 16
+        # capacity search, shortened: the A/B needs a stable knee,
+        # not the full artifact).
+        seed = workload.default_seed()
+        target = env_lib.get_float('SKYT_CAPACITY_TARGET', 0.0) or 0.9
+
+        def measure(rate):
+            wspec = workload.WorkloadSpec(
+                seed=seed, duration_s=4.0, rate_rps=rate,
+                arrival='poisson',
+                tenants=(workload.TenantProfile(
+                    tenant='bench', cls='interactive',
+                    prompt_mean=4.0, prompt_sigma=0.4, prompt_cap=8,
+                    output_mean=6.0, output_sigma=0.4, output_cap=8,
+                    session_pool=4, session_reuse=0.4,
+                    prefix_len=2),))
+            runner = workload.OpenLoopRunner(
+                workload.http_submitter(base, timeout_s=60.0),
+                compression=3.0)
+            outs = runner.run(workload.generate_schedule(wspec))
+            good = sum(1 for o in outs
+                       if o.status == 200 and o.ttft_s is not None
+                       and o.ttft_s <= 0.75)
+            return good / len(outs) if outs else 0.0
+
+        def search():
+            return capacity_lib.capacity_search(
+                measure, target=target, rate_lo=2.0, rate_hi=32.0,
+                resolution=0.5, max_trials=4)
+
+        before = search()
+        resp = sess.post(
+            rbase + '/admin/reshard', json={'virtual_nodes': 2},
+            headers={'Authorization': 'Bearer bench-elastic'},
+            timeout=120)
+        resp.raise_for_status()
+        stats = sess.get(rbase + '/stats', timeout=30).json()
+        assert stats['virtual_nodes'] == 2, stats
+        assert stats['weight_version'] == 1, stats
+        # The layout flip recompiles prefill/decode for the new
+        # sharding (~1.3 s on CPU); warm it so the second search
+        # measures steady-state serving, not XLA.
+        for _ in range(3):
+            sess.post(rbase + '/generate',
+                      json={'tokens': [2, 3, 4], 'max_tokens': 4},
+                      timeout=120).raise_for_status()
+        after = search()
+        chips = 1.0   # CPU bench: one "chip"
+        qpc_before = before.max_sustained_qps / chips
+        qpc_after = after.max_sustained_qps / chips
+        delta_pct = ((qpc_after - qpc_before) / qpc_before * 100.0
+                     if qpc_before else None)
+
+        print(f'# elastic bench: cold_start_ttft={cold_ttft:.3f}s '
+              f'(parked_5xx={parked_5xx}), attainment '
+              f'forecast={forecast_att:.3f} vs '
+              f'reactive={reactive_att:.3f}, qps/chip '
+              f'{qpc_before:.2f} -> {qpc_after:.2f} '
+              f'({delta_pct:+.1f}% across reshard)',
+              file=sys.stderr)
+        return [
+            {'metric': 'elastic_cold_start_ttft_s',
+             'value': round(cold_ttft, 4) if cold_ttft else None,
+             'unit': 's', 'vs_baseline': None,
+             'parked_5xx': parked_5xx,
+             'wake_delay_s': wake_delay_s},
+            {'metric': 'elastic_forecast_slo_attainment',
+             'value': round(forecast_att, 4), 'unit': 'fraction',
+             'vs_baseline': (round(forecast_att / reactive_att, 4)
+                             if reactive_att else None),
+             'reactive_attainment': round(reactive_att, 4),
+             'lead_s': 60.0},
+            {'metric': 'elastic_reshard_qps_per_chip_delta_pct',
+             'value': (round(delta_pct, 2)
+                       if delta_pct is not None else None),
+             'unit': '%', 'vs_baseline': None,
+             'qps_per_chip_before': round(qpc_before, 3),
+             'qps_per_chip_after': round(qpc_after, 3),
+             'trials': len(before.trials) + len(after.trials)},
+        ]
+    finally:
+        eng.stop()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def train_mfu(dev, on_tpu: bool) -> 'tuple[float, str]':
     """Train-throughput phase; returns (MFU, metric name). Raises on
     failure — main() isolates it so one phase crashing never loses the
@@ -3152,6 +3433,18 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# interference bench failed: {e!r}', file=sys.stderr)
+
+    # Elastic-capacity phase: scale-to-zero cold-start TTFT through
+    # the surge queue, forecast-vs-reactive SLO attainment on a
+    # simulated clock, and the capacity search across an in-place
+    # reshard. CPU-runnable.
+    try:
+        with phase_deadline(PHASE_DEADLINES['elastic bench'],
+                            'elastic bench'):
+            extra = extra + elastic_bench_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# elastic bench failed: {e!r}', file=sys.stderr)
 
     line = {
         'metric': metric_name,

@@ -693,6 +693,26 @@ def serve_status(service_names):
                        f'phase={ro.get("phase")} '
                        f'updated={len(ro.get("updated") or [])}'
                        f'{detail}')
+        asc = svc.get('autoscaler')
+        if isinstance(asc, dict):
+            line = (f'  autoscaler: mode={asc.get("mode")} '
+                    f'target={asc.get("target_num_replicas")}')
+            fc = asc.get('forecast')
+            if isinstance(fc, dict) and \
+                    fc.get('qps_at_lead') is not None:
+                line += (f' forecast={fc["qps_at_lead"]}qps'
+                         f'@+{fc.get("lead_s")}s')
+            last = asc.get('last_decision')
+            if isinstance(last, dict):
+                line += f' last={last.get("reason")}'
+            click.echo(line)
+        rs = svc.get('reshard')
+        if isinstance(rs, dict):
+            detail = f' ({rs["error"]})' if rs.get('error') else ''
+            click.echo(f'  reshard: ->{rs.get("target_nodes")} '
+                       f'virtual nodes phase={rs.get("phase")} '
+                       f'updated={len(rs.get("updated") or [])}'
+                       f'{detail}')
         rows = [[r['replica_id'], r['cluster_name'],
                  r['status'].value, r['endpoint'] or '-',
                  f'{r["version"]}/w{r.get("weight_version", 1)}',

@@ -708,6 +708,15 @@ class InferenceEngine:
         # launch weights).
         self.weight_version = 1
         self._swap_req: Optional[Dict[str, Any]] = None
+        # Elastic resharding (docs/robustness.md "Elastic capacity"):
+        # the logical layout the live weights are laid out over —
+        # virtual nodes in the VirtualFlow sense, decoupled from the
+        # physical chip count. Starts at the mesh size (one virtual
+        # node per device); request_reshard() re-stages the weights
+        # onto a new layout at the same tick-boundary contract the
+        # weight swap uses.
+        self.virtual_nodes = int(getattr(mesh, 'size', 1) or 1) \
+            if mesh is not None else 1
         # Last scheduled order broadcast to lockstep followers (seq
         # list); reorders only rebroadcast when the order changed.
         self._last_qorder: Optional[List[int]] = None
@@ -832,6 +841,11 @@ class InferenceEngine:
             'Weight version the engine is serving (starts at 1; each '
             'applied in-place swap bumps it to the pushed version)')
         self._m_weight_version.set(self.weight_version)
+        self._m_virtual_nodes = reg.gauge(
+            'skyt_infer_virtual_nodes',
+            'Virtual-node layout the engine is serving (starts at the '
+            'mesh size; each applied in-place reshard moves it)')
+        self._m_virtual_nodes.set(self.virtual_nodes)
         self._m_deadline_expired = reg.counter(
             'skyt_infer_deadline_expired_total',
             'Requests expired by their per-request deadline (slot and '
@@ -973,7 +987,8 @@ class InferenceEngine:
                 'pages landed by cross-replica fetch', ('tier',))
             self._prefix_seen['tier_hbm'] = 0
             self._kv_tier_seen = {'promoted_pages': 0,
-                                  'fetched_pages': 0}
+                                  'fetched_pages': 0,
+                                  'prewarm_pages': 0}
             # Pages install host->device in chunks of <= 8 ids padded
             # to pow2 (4 compiles: n in {1,2,4,8}); arrays arrive
             # stacked [L, n, H, P(, d)] at pool dtype, so .set() is a
@@ -1491,13 +1506,20 @@ class InferenceEngine:
         while self._kv_export_q:
             rq = self._kv_export_q.popleft()
             try:
-                out = []
-                for h in rq['hashes']:
-                    page = self.pool.registered_page(h)
-                    if page is None:
-                        break
-                    out.append((h, self._kv_slice_page(page)))
-                rq['pages'] = out
+                if rq.get('index'):
+                    # Inventory request (/kv/index): the registry read
+                    # rides the loop like every other export, so the
+                    # snapshot is tick-consistent.
+                    rq['hashes_out'] = self.pool.registered_hashes()
+                    rq['pages'] = []
+                else:
+                    out = []
+                    for h in rq['hashes']:
+                        page = self.pool.registered_page(h)
+                        if page is None:
+                            break
+                        out.append((h, self._kv_slice_page(page)))
+                    rq['pages'] = out
             except Exception:  # pylint: disable=broad-except
                 logger.exception('kv export slice failed')
                 rq['pages'] = []
@@ -1532,6 +1554,41 @@ class InferenceEngine:
         if not out:
             return None
         return kv_tier_lib.encode_pages(out, version)
+
+    def kv_index(self) -> Optional[Dict[str, Any]]:
+        """Server-side of GET /kv/index (executor thread): every
+        locally resident published prefix hash — HBM registry in
+        publish order, then host-store-only continuations — plus the
+        serving weight version. None when the tier is off or the loop
+        never answers (the server 404s, never 5xx)."""
+        if self.kv_tier is None or self.pool is None:
+            return None
+        rq: Dict[str, Any] = {'index': True, 'hashes_out': None,
+                              'pages': None, 'version': None,
+                              'event': threading.Event()}
+        self._kv_export_q.append(rq)
+        if not rq['event'].wait(timeout=5.0):
+            return None
+        hashes: List[bytes] = list(rq['hashes_out'] or [])
+        seen = set(hashes)
+        version = int(rq['version'])
+        hashes.extend(h for h in self.kv_tier.host.keys(version)
+                      if h not in seen)
+        return {'weight_version': version,
+                'hashes': [h.hex() for h in hashes]}
+
+    def kv_prewarm(self, self_node: str, peers: List[str],
+                   token: str) -> Dict[str, Any]:
+        """Bulk-fetch the prefix pages this replica will own from its
+        peers (POST /admin/kv_prewarm, executor thread) — the scale-up
+        prewarm of ROADMAP 5c. Pages land in the host store and
+        promote on first demand through the normal L2 splice; counted
+        under skyt_infer_kv_tier_hit_pages_total{tier="prewarm"}."""
+        if self.kv_tier is None or not self.kv_tier.fleet:
+            return {'peers': 0, 'owned_pages': 0, 'stored_pages': 0,
+                    'errors': 0, 'skipped': 'kv tier is not fleet'}
+        return self.kv_tier.prewarm_from_peers(
+            self_node, peers, self.weight_version, token)
 
     def _decode_n_impl(self, params, cache, last_tokens, lengths, temps,
                        keys, topks, topps, press, freqs, counts, hist,
@@ -2097,6 +2154,7 @@ class InferenceEngine:
                'waiting': waiting,
                'ready': self.ready.is_set(),
                'weight_version': self.weight_version,
+               'virtual_nodes': self.virtual_nodes,
                'kernel_paths': ops_dispatch.snapshot(),
                **self.perf_stats()}
         if self.ledger.enabled:
@@ -2244,7 +2302,8 @@ class InferenceEngine:
                             cur - self._prefix_seen['tier_hbm'])
                         self._prefix_seen['tier_hbm'] = cur
                     for key, tname in (('promoted_pages', 'host'),
-                                       ('fetched_pages', 'fleet')):
+                                       ('fetched_pages', 'fleet'),
+                                       ('prewarm_pages', 'prewarm')):
                         cur = int(self.kv_tier.stats.get(key, 0))
                         if cur > self._kv_tier_seen[key]:
                             self._m_kv_tier_hits.labels(tname).inc(
@@ -2335,10 +2394,48 @@ class InferenceEngine:
                                 'drain': bool(drain),
                                 'event': threading.Event(),
                                 'result': None}
+        return self._submit_swap(swap, timeout, 'weight-swap')
+
+    def request_reshard(self, new_params, *,
+                        virtual_nodes: int,
+                        drain: Optional[bool] = None,
+                        timeout: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Install a re-laid-out copy of the CURRENT weights as the
+        live params at a decode-tick boundary — the elastic-reshard
+        apply (docs/robustness.md "Elastic capacity"). Rides the exact
+        weight-swap machinery (same drain semantics, same atomic-claim
+        timeout contract, same single pending slot — a reshard and a
+        swap cannot race each other), but the weight VERSION does not
+        move: the values are unchanged, only their layout over
+        `virtual_nodes` virtual nodes is new. The prefix cache is
+        still flushed conservatively — page tiling is layout-derived
+        and cross-layout reuse is not validated."""
+        if self._lockstep is not None:
+            raise RuntimeError(
+                'in-place resharding is not supported on multi-host '
+                'lockstep replicas (the apply boundary would have to '
+                'ride the tick broadcast); reshape these replicas by '
+                'relaunch')
+        if drain is None:
+            drain = env.get_bool('SKYT_SWAP_DRAIN', True)
+        if timeout is None:
+            timeout = env.get_float('SKYT_SWAP_TIMEOUT_S', 120.0)
+        swap: Dict[str, Any] = {'params': new_params,
+                                'version': self.weight_version,
+                                'virtual_nodes': int(virtual_nodes),
+                                'drain': bool(drain),
+                                'event': threading.Event(),
+                                'result': None}
+        return self._submit_swap(swap, timeout, 'reshard')
+
+    def _submit_swap(self, swap: Dict[str, Any], timeout: float,
+                     what: str) -> Dict[str, Any]:
         running = self._thread is not None and self._thread.is_alive()
         with self._lock:
             if self._swap_req is not None:
-                raise RuntimeError('a weight swap is already pending')
+                raise RuntimeError(
+                    'a weight swap or reshard is already pending')
             self._swap_req = swap
         if not running:
             # No engine loop (cold engine, unit tests): every moment
@@ -2349,14 +2446,14 @@ class InferenceEngine:
                 if self._swap_req is swap:
                     self._swap_req = None
                     raise TimeoutError(
-                        f'engine did not reach a weight-swap boundary '
-                        f'within {timeout}s (drain={drain}); old '
-                        f'weights stay live')
+                        f'engine did not reach a {what} boundary '
+                        f'within {timeout}s (drain={swap["drain"]}); '
+                        f'old weights stay live')
             # Lost the race: the loop applied it while we timed out.
             swap['event'].wait(5)
         if swap['result'] is None:
-            raise RuntimeError('engine loop died before the weight '
-                               'swap applied; old weights stay live')
+            raise RuntimeError(f'engine loop died before the {what} '
+                               f'applied; old weights stay live')
         return swap['result']
 
     def _maybe_apply_swap(self) -> None:
@@ -2387,8 +2484,28 @@ class InferenceEngine:
         flushed = 0
         if self.pool is not None and self.prefix_caching:
             # Stale-KV correctness: cached prefixes were computed under
-            # the old weights and must never be shared across versions.
+            # the old weights and must never be shared across versions
+            # (for a reshard the values are unchanged but the page
+            # tiling is layout-derived: flush conservatively).
             flushed = self.pool.flush_prefix()
+        if swap.get('virtual_nodes') is not None:
+            # Reshard apply: layout moves, version does not — the host/
+            # fleet KV tiers stay valid (same weight version), so a
+            # freshly resharded replica re-promotes its prefixes from
+            # the host store instead of recomputing them.
+            self.virtual_nodes = int(swap['virtual_nodes'])
+            self._m_virtual_nodes.set(self.virtual_nodes)
+            swap['result'] = {
+                'weight_version': self.weight_version,
+                'virtual_nodes': self.virtual_nodes,
+                'flushed_prefix_pages': flushed,
+                'apply_s': round(time.perf_counter() - t0, 6)}
+            logger.info('reshard applied: %d virtual node(s) at weight '
+                        'version %d (drain=%s, %d prefix pages '
+                        'flushed)', self.virtual_nodes,
+                        self.weight_version, swap['drain'], flushed)
+            swap['event'].set()
+            return
         if self.kv_tier is not None:
             # The outer tiers obey the same contract: drop every host-
             # store entry of the old version AND gate in-flight spills

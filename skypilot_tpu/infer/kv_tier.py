@@ -214,6 +214,13 @@ class HostKVStore:
             self.stats['hits'] += 1
             return ent[1]
 
+    def keys(self, version: int) -> List[bytes]:
+        """Resident hashes at `version`, LRU order (no recency bump) —
+        the host-store half of the /kv/index inventory."""
+        with self._lock:
+            return [h for h, (v, _, _) in self._entries.items()
+                    if v == int(version)]
+
     def contains(self, h: bytes, version: int) -> bool:
         """Cheap presence probe (no recency bump, no stats) — the
         admission peek loops call this per queued request."""
@@ -262,6 +269,29 @@ def fetch_pages(peer: str, hashes: Sequence[bytes], token: str,
     return decode_pages(r.content)
 
 
+def fetch_index(peer: str, token: str, timeout_s: float
+                ) -> Tuple[int, List[bytes]]:
+    """GET a peer replica's resident-prefix inventory (/kv/index) —
+    the input of the prewarm ownership map (docs/performance.md
+    "Tiered prefix cache", ROADMAP 5c). Shares the ``kv.fetch`` fault
+    point with the page transfer: a drill that breaks fetches breaks
+    prewarm the same way (degrade to cold start, never a failure the
+    prober sees). Raises on transport/payload problems."""
+    import requests
+    faults.inject('kv.fetch', peer=peer)
+    headers = {'Authorization': f'Bearer {token}'} if token else {}
+    r = requests.get(peer.rstrip('/') + '/kv/index', headers=headers,
+                     timeout=timeout_s)
+    if r.status_code != 200:
+        raise RuntimeError(f'peer {peer} /kv/index -> {r.status_code}')
+    data = r.json()
+    hashes = []
+    for hx in data.get('hashes', []):
+        if isinstance(hx, str) and len(hx) == 32:
+            hashes.append(bytes.fromhex(hx))
+    return int(data.get('weight_version', 0)), hashes
+
+
 class KVTierManager:
     """The engine's handle on the outer tiers: the host store, the
     async spill writer, and the fetch worker. Owned by the engine;
@@ -307,7 +337,8 @@ class KVTierManager:
         self.stats = {'spill_enqueued': 0, 'spill_dropped': 0,
                       'spill_stored': 0, 'promotions': 0,
                       'promoted_pages': 0, 'fetches': 0,
-                      'fetch_errors': 0, 'fetched_pages': 0}
+                      'fetch_errors': 0, 'fetched_pages': 0,
+                      'prewarm_pages': 0}
 
     # ------------------------------------------------------ spill (L2)
     def start(self) -> None:
@@ -399,11 +430,15 @@ class KVTierManager:
         return None
 
     def fetch_into_host(self, peer: str, hashes: Sequence[bytes],
-                        version: int, token: str) -> int:
+                        version: int, token: str,
+                        stat_key: str = 'fetched_pages') -> int:
         """Fetch a page run from `peer` and land it in the host store
         (the re-admitted request then promotes host->device through
         the same splice as an L2 hit). Returns pages stored; raises on
-        failure (the worker converts that to a recompute)."""
+        failure (the worker converts that to a recompute). `stat_key`
+        picks which monotone counter the stored pages fold into —
+        'fetched_pages' (demand fetch, tier="fleet") or
+        'prewarm_pages' (scale-up bulk prewarm, tier="prewarm")."""
         with self._lock:
             self.stats['fetches'] += 1
         peer_version, pages = fetch_pages(
@@ -426,8 +461,58 @@ class KVTierManager:
             if self.host.put(h, version, arrays):
                 stored += 1
         with self._lock:
-            self.stats['fetched_pages'] += stored
+            self.stats[stat_key] += stored
         return stored
+
+    def prewarm_from_peers(self, self_node: str, peers: Sequence[str],
+                           version: int, token: str) -> Dict[str, Any]:
+        """Proactive KV pre-warm on scale-up (ROADMAP 5c): bulk-fetch
+        the prefix pages THIS replica will own into the host store
+        before it enters the ready set, instead of faulting them in
+        one miss at a time.
+
+        Ownership rides the same rendezvous-ring math the LB's
+        prefix-affinity routing uses, over (self + peers): each peer's
+        /kv/index inventory is split into fetch-sized contiguous
+        batches (index order = publish order, which approximates chain
+        order, so batches mostly preserve leading runs) and a batch is
+        claimed when the ring ranks this replica first for its leading
+        hash. Best-effort by contract: every per-peer failure is
+        counted and skipped — a failed prewarm costs recomputes, never
+        readiness."""
+        from skypilot_tpu.serve import load_balancing_policies as \
+            lb_policies
+        ring = lb_policies.ConsistentHashRing()
+        nodes = {str(self_node): 1.0}
+        for p in peers:
+            nodes[str(p)] = 1.0
+        ring.set_nodes(nodes)
+        stored = 0
+        owned = 0
+        errors = 0
+        for peer in peers:
+            if str(peer) == str(self_node):
+                continue
+            try:
+                peer_version, hashes = fetch_index(
+                    peer, token, self.fetch_timeout_s)
+                if peer_version != int(version):
+                    raise RuntimeError(
+                        f'peer {peer} weight_version {peer_version} '
+                        f'!= local {version}')
+                for i in range(0, len(hashes), self.fetch_max_pages):
+                    batch = hashes[i:i + self.fetch_max_pages]
+                    if ring.owner(batch[0].hex()) != str(self_node):
+                        continue
+                    owned += len(batch)
+                    stored += self.fetch_into_host(
+                        peer, batch, version, token,
+                        stat_key='prewarm_pages')
+            except Exception:  # pylint: disable=broad-except
+                errors += 1
+                logger.exception('kv prewarm from %s failed', peer)
+        return {'peers': len(list(peers)), 'owned_pages': owned,
+                'stored_pages': stored, 'errors': errors}
 
     def note_fetch_error(self) -> None:
         with self._lock:

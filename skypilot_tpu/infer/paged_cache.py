@@ -238,6 +238,14 @@ class PagePool:
         export path (/kv/prefix) resolves hash runs through this."""
         return self._registry.get(h)
 
+    def registered_hashes(self) -> List[bytes]:
+        """Every published page hash in registration (publish) order —
+        the /kv/index inventory the prewarm ownership map is computed
+        over. Publish order approximates chain order for each prefix,
+        so contiguous slices of this list mostly preserve leading
+        runs. Engine-loop only (like every registry read)."""
+        return list(self._registry.keys())
+
     def prefix_peek(self, lookup_hashes) -> int:
         """Length of the leading registered-page run for these hashes —
         a READ-ONLY probe of what try_reserve_prefix would share (no

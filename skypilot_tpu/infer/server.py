@@ -718,6 +718,145 @@ class InferenceServer:
                      'X-Weight-Version':
                          str(self.engine.weight_version)})
 
+    @staticmethod
+    def _require_admin(request: web.Request
+                       ) -> Optional[web.Response]:
+        """Shared bearer gate for the admin/KV-transfer surface:
+        requires SKYT_ADMIN_TOKEN to be configured AND presented (403
+        otherwise — reachability alone must never be enough)."""
+        token = env_lib.get('SKYT_ADMIN_TOKEN')
+        if not token:
+            return web.json_response(
+                {'error': 'admin API disabled: start the replica with '
+                          'SKYT_ADMIN_TOKEN set (the serve controller '
+                          'exports the per-service token)'},
+                status=403)
+        import hmac
+        got = request.headers.get('Authorization', '')
+        if not hmac.compare_digest(
+                got.encode('utf-8', 'surrogateescape'),
+                f'Bearer {token}'.encode('utf-8')):
+            return web.json_response(
+                {'error': 'unauthorized: missing or bad Authorization '
+                          'bearer token'}, status=403)
+        return None
+
+    async def _admin_reshard(self, request: web.Request
+                             ) -> web.Response:
+        """``POST /admin/reshard`` — in-place elastic reshard
+        (docs/robustness.md "Elastic capacity").
+
+        Body: ``{"virtual_nodes": N, "drain": bool?}`` or
+        ``{"reshard_back": true}``. Auth mirrors /admin/weights.
+        Single-flight with weight swaps: 409 while either is in
+        progress; 400 on a malformed body or a layout that cannot
+        tile the mesh (old layout intact in every error case)."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {'error': 'body must be a JSON object'}, status=400)
+        drain = payload.get('drain')
+        if drain is not None and not isinstance(drain, bool):
+            return web.json_response(
+                {'error': f'drain must be a boolean, got {drain!r}'},
+                status=400)
+        loop = asyncio.get_running_loop()
+        if payload.get('reshard_back'):
+            op = functools.partial(self._swap_mgr.reshard_back,
+                                   drain=drain)
+        else:
+            nodes = payload.get('virtual_nodes')
+            if isinstance(nodes, bool) or not isinstance(nodes, int) \
+                    or nodes < 1:
+                return web.json_response(
+                    {'error': f'virtual_nodes must be an integer >= 1 '
+                              f'(or pass reshard_back: true), got '
+                              f'{nodes!r}'}, status=400)
+            op = functools.partial(self._swap_mgr.reshard, nodes,
+                                   drain=drain)
+        try:
+            result = await loop.run_in_executor(None, op)
+        except weight_swap_lib.SwapInFlight as e:
+            return web.json_response({'error': str(e)}, status=409)
+        except weight_swap_lib.WeightSwapError as e:
+            return web.json_response(
+                {'error': str(e),
+                 'virtual_nodes': getattr(self.engine, 'virtual_nodes',
+                                          None)},
+                status=400)
+        return web.json_response(result)
+
+    async def _admin_kv_prewarm(self, request: web.Request
+                                ) -> web.Response:
+        """``POST /admin/kv_prewarm`` — pull this replica's rendezvous
+        share of the fleet's resident prefix pages from its peers into
+        the host KV store (docs/serving.md "Elastic capacity": scale-up
+        pre-warm). Body: ``{"self": <url>, "peers": [<url>, ...]}``.
+        Auth mirrors /admin/weights. Best-effort by contract: per-peer
+        failures are counted, never raised — a failed pre-warm costs
+        recomputes, not readiness."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {'error': 'body must be a JSON object'}, status=400)
+        self_node = payload.get('self')
+        peers = payload.get('peers')
+        if not isinstance(self_node, str) or not self_node:
+            return web.json_response(
+                {'error': 'self must be this replica\'s base URL'},
+                status=400)
+        if not isinstance(peers, list) or \
+                not all(isinstance(p, str) and p for p in peers):
+            return web.json_response(
+                {'error': 'peers must be a list of replica base URLs'},
+                status=400)
+        token = env_lib.get('SKYT_ADMIN_TOKEN')
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, functools.partial(self.engine.kv_prewarm,
+                                        self_node, peers, token))
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception('kv prewarm failed')
+            return web.json_response(
+                {'error': f'kv prewarm failed: {e!r}'}, status=500)
+        return web.json_response(result)
+
+    async def _kv_index(self, request: web.Request) -> web.Response:
+        """``GET /kv/index`` — this replica's resident prefix-page
+        inventory (HBM registry + host-store keys) at the current
+        weight_version, snapshotted at a tick boundary. Peers use it
+        to compute their rendezvous share during scale-up pre-warm.
+        Auth mirrors /kv/prefix. 404 (not 5xx) when tiering is off or
+        the engine loop is too busy to answer."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        loop = asyncio.get_running_loop()
+        try:
+            data = await loop.run_in_executor(None,
+                                              self.engine.kv_index)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('kv index failed')
+            data = None
+        if data is None:
+            return web.json_response(
+                {'error': 'no kv inventory (tiering off or engine '
+                          'busy)'}, status=404)
+        return web.json_response(data)
+
     async def _health(self, request: web.Request) -> web.Response:
         del request
         if self.engine.ready.is_set():
@@ -1567,7 +1706,10 @@ class InferenceServer:
         app.router.add_get('/debug/ticks', self._debug_ticks)
         app.router.add_post('/debug/profile', self._debug_profile)
         app.router.add_post('/admin/weights', self._admin_weights)
+        app.router.add_post('/admin/reshard', self._admin_reshard)
+        app.router.add_post('/admin/kv_prewarm', self._admin_kv_prewarm)
         app.router.add_get('/kv/prefix', self._kv_prefix)
+        app.router.add_get('/kv/index', self._kv_index)
         app.router.add_post('/generate', self._generate)
         app.router.add_get('/v1/models', self._models)
         app.router.add_post('/v1/completions', self._completions)

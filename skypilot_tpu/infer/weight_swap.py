@@ -126,6 +126,19 @@ class WeightSwapManager:
             'skyt_infer_weight_swap_seconds',
             'End-to-end weight swap duration (stage + validate + '
             'tick-boundary apply)')
+        # Elastic resharding (docs/robustness.md "Elastic capacity"):
+        # previous virtual-node layout retained for reshard_back — the
+        # controller's rollback lever, mirroring _prev for weights.
+        self._prev_layout: Optional[int] = None
+        self.last_reshard: Optional[Dict[str, Any]] = None
+        self._m_reshards = reg.counter(
+            'skyt_infer_reshards_total',
+            'In-place elastic reshard attempts by result (ok / aborted '
+            '— aborted leaves the old layout live)', ('result',))
+        self._m_reshard_s = reg.histogram(
+            'skyt_infer_reshard_seconds',
+            'End-to-end reshard duration (re-stage + tick-boundary '
+            'apply)')
 
     # ------------------------------------------------------------ views
     def info(self) -> Dict[str, Any]:
@@ -134,6 +147,11 @@ class WeightSwapManager:
             'checkpoint': self.checkpoint,
             'swap_back_available': self._prev is not None,
             'last_swap': dict(self.last) if self.last else None,
+            'virtual_nodes': getattr(self.engine, 'virtual_nodes',
+                                     None),
+            'reshard_back_available': self._prev_layout is not None,
+            'last_reshard': (dict(self.last_reshard)
+                             if self.last_reshard else None),
         }
 
     # ------------------------------------------------------------ swaps
@@ -290,3 +308,136 @@ class WeightSwapManager:
             for leaf in jax.tree_util.tree_leaves(staged):
                 getattr(leaf, 'block_until_ready', lambda: None)()
         return staged
+
+    # --------------------------------------------------------- reshard
+    def reshard(self, virtual_nodes: int,
+                drain: Optional[bool] = None) -> Dict[str, Any]:
+        """Change the per-replica virtual-node layout at a decode-tick
+        boundary, weights and weight_version unchanged. Rides the same
+        single-flight + stage + tick-boundary-apply contract as weight
+        swaps (a reshard and a swap cannot overlap). Raises
+        SwapInFlight on concurrency, WeightSwapError on any failure —
+        the old layout stays live in both cases."""
+        if not self._flight.acquire(blocking=False):
+            raise SwapInFlight(
+                'a weight swap or reshard is already in flight on '
+                'this replica')
+        try:
+            return self._reshard_locked(virtual_nodes, drain)
+        finally:
+            self._flight.release()
+
+    def reshard_back(self, drain: Optional[bool] = None
+                     ) -> Dict[str, Any]:
+        """Re-apply the layout the last successful reshard replaced
+        (the controller's mid-reshard rollback lever)."""
+        if not self._flight.acquire(blocking=False):
+            raise SwapInFlight(
+                'a weight swap or reshard is already in flight on '
+                'this replica')
+        try:
+            if self._prev_layout is None:
+                raise WeightSwapError(
+                    'no previous layout retained: nothing to reshard '
+                    'back to')
+            return self._reshard_locked(self._prev_layout, drain,
+                                        is_back=True)
+        finally:
+            self._flight.release()
+
+    def _reshard_locked(self, virtual_nodes, drain,
+                        is_back: bool = False) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        old_layout = int(getattr(self.engine, 'virtual_nodes', 1) or 1)
+        try:
+            try:
+                target = int(virtual_nodes)
+            except (TypeError, ValueError):
+                raise WeightSwapError(
+                    f'virtual_nodes must be an integer, got '
+                    f'{virtual_nodes!r}')
+            if target < 1:
+                raise WeightSwapError(
+                    f'virtual_nodes must be >= 1, got {target}')
+            mesh_size = int(getattr(self.engine.mesh, 'size', 1) or 1) \
+                if self.engine.mesh is not None else 1
+            # Each physical device must hold an integer number of
+            # virtual nodes (or vice versa) or the layout cannot tile.
+            if target % mesh_size and mesh_size % target:
+                raise WeightSwapError(
+                    f'virtual_nodes={target} does not tile the '
+                    f'{mesh_size}-device mesh (one must divide the '
+                    f'other)')
+            # Chaos hook (docs/robustness.md fault catalog): 'error'
+            # aborts with the old layout intact — the mid-reshard
+            # SIGKILL/rollback drill's lever; latency/hang stretch the
+            # single-flight window (concurrent reshards then 409).
+            faults.inject('reshard', virtual_nodes=target,
+                          from_nodes=old_layout)
+            if target == old_layout:
+                # Idempotent no-op: the controller retries through
+                # restarts and must be able to re-assert a layout.
+                self._m_reshards.labels('ok').inc()
+                self.last_reshard = {
+                    'ok': True, 'virtual_nodes': old_layout,
+                    'from_nodes': old_layout, 'reshard_back': is_back,
+                    'noop': True, 'duration_s': 0.0, 'at': time.time(),
+                }
+                return dict(self.last_reshard)
+            # Re-stage the LIVE weights onto the target layout's
+            # placements. On a single-device/CPU engine this is an
+            # identity restage (same shardings); on a real mesh the
+            # virtual-node count maps to different NamedShardings —
+            # either way the engine-side apply stays a reference
+            # assignment at a tick boundary. _stage would clobber
+            # _old_params (the swap_back retention), so save/restore
+            # it: a reshard must not eat weight-rollback history.
+            keep_old = self._old_params
+            try:
+                staged = self._stage(self.engine.params)
+            finally:
+                self._old_params = keep_old
+            result = self.engine.request_reshard(
+                staged, virtual_nodes=target, drain=drain)
+        except faults.FaultError as e:
+            self._abort_reshard(t0, virtual_nodes, f'injected fault: '
+                                f'{e}')
+            raise WeightSwapError(
+                f'reshard aborted (old layout intact): {e}') from e
+        except WeightSwapError as e:
+            self._abort_reshard(t0, virtual_nodes, str(e))
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            self._abort_reshard(t0, virtual_nodes, str(e))
+            raise WeightSwapError(
+                f'reshard failed (old layout intact): {e}') from e
+        dur = time.perf_counter() - t0
+        # Retain what we REPLACED; a reshard_back re-points history at
+        # what IT replaced so repeated flips keep working.
+        self._prev_layout = old_layout
+        self._m_reshards.labels('ok').inc()
+        self._m_reshard_s.observe(dur)
+        self.last_reshard = {
+            'ok': True, 'virtual_nodes': result['virtual_nodes'],
+            'from_nodes': old_layout, 'reshard_back': is_back,
+            'weight_version': result['weight_version'],
+            'duration_s': round(dur, 4), 'apply_s': result['apply_s'],
+            'flushed_prefix_pages': result['flushed_prefix_pages'],
+            'at': time.time(),
+        }
+        logger.info('reshard ok: %d -> %d virtual nodes in %.3fs',
+                    old_layout, result['virtual_nodes'], dur)
+        return dict(self.last_reshard)
+
+    def _abort_reshard(self, t0: float, target, error: str) -> None:
+        self._m_reshards.labels('aborted').inc()
+        self.last_reshard = {
+            'ok': False,
+            'virtual_nodes': getattr(self.engine, 'virtual_nodes',
+                                     None),
+            'target_nodes': target, 'error': error,
+            'duration_s': round(time.perf_counter() - t0, 4),
+            'at': time.time(),
+        }
+        logger.warning('reshard to %r virtual nodes aborted (old '
+                       'layout intact): %s', target, error)

@@ -11,6 +11,7 @@ import math
 import time
 from typing import Dict, List, Optional
 
+from skypilot_tpu.serve import forecast as forecast_lib
 from skypilot_tpu.serve import qos as qos_lib
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import log_utils
@@ -61,10 +62,21 @@ class Autoscaler:
             'skyt_autoscaler_dropped_timestamps_total',
             'Request timestamps dropped because an autoscaler buffer '
             'hit its cap (SKYT_AUTOSCALER_MAX_TIMESTAMPS)')
+        # Last decision, for the status surfaces (`serve status`,
+        # /controller/status 'autoscaler' block) — the counters say how
+        # often; this says what, why, and when, without log archaeology.
+        self.last_decision: Optional[dict] = None
 
-    def _record_decision(self, kind: str) -> None:
+    def _record_decision(self, kind: str,
+                         reason: Optional[str] = None) -> None:
         self._m_decisions.labels(kind).inc()
         self._m_target.set(self.target_num_replicas)
+        self.last_decision = {
+            'kind': kind,
+            'reason': reason or kind,
+            'target_num_replicas': self.target_num_replicas,
+            'at': time.time(),
+        }
 
     def _cap_buffer(self, buf: List) -> List:
         """Drop-oldest bound on a timestamp buffer, counting drops
@@ -88,6 +100,16 @@ class Autoscaler:
 
     def evaluate_scaling(self, num_ready: int) -> AutoscalerDecision:
         raise NotImplementedError
+
+    def status(self) -> dict:
+        """Mode + last decision for the status surfaces (satellite:
+        mirrors the PR 14 rollout block)."""
+        return {
+            'mode': 'reactive',
+            'class': type(self).__name__,
+            'target_num_replicas': self.target_num_replicas,
+            'last_decision': self.last_decision,
+        }
 
 
 class RequestRateAutoscaler(Autoscaler):
@@ -133,7 +155,9 @@ class RequestRateAutoscaler(Autoscaler):
                 # sizes, not to gate cold starts. Launch immediately.
                 self.target_num_replicas = raw
                 self._upscale_since = None
-                self._record_decision('wake_from_zero')
+                self._record_decision(
+                    'wake_from_zero',
+                    f'wake from zero -> upscale to {raw}')
                 return AutoscalerDecision(
                     raw, f'wake from zero -> upscale to {raw}')
             if self._upscale_since is None:
@@ -141,7 +165,8 @@ class RequestRateAutoscaler(Autoscaler):
             if now - self._upscale_since >= self.spec.upscale_delay_seconds:
                 self.target_num_replicas = raw
                 self._upscale_since = None
-                self._record_decision('upscale')
+                self._record_decision(
+                    'upscale', f'sustained load -> upscale to {raw}')
                 return AutoscalerDecision(
                     raw, f'sustained load -> upscale to {raw}')
         elif raw < current:
@@ -152,7 +177,8 @@ class RequestRateAutoscaler(Autoscaler):
                     self.spec.downscale_delay_seconds:
                 self.target_num_replicas = raw
                 self._downscale_since = None
-                self._record_decision('downscale')
+                self._record_decision(
+                    'downscale', f'sustained idle -> downscale to {raw}')
                 return AutoscalerDecision(
                     raw, f'sustained idle -> downscale to {raw}')
         else:
@@ -246,6 +272,233 @@ class QoSAwareAutoscaler(RequestRateAutoscaler):
         return max(spec.min_replicas, min(upper, target))
 
 
+class PredictiveAutoscaler:
+    """Scale BEFORE the wave (docs/serving.md "Elastic capacity").
+
+    Composition wrapper around whichever reactive autoscaler
+    `pick_autoscaler_cls` selected: every observation stream tees into
+    per-curve demand forecasters (total + one per QoS class), and each
+    evaluation first takes the reactive decision, then — only while
+    the forecast's error bound holds — raises the target to cover the
+    demand predicted at now + SKYT_FORECAST_LEAD_S (the provisioning
+    lead time: capacity bought now lands when the wave does).
+
+    Safety contract: predictive only ever RAISES the target. Downscale
+    stays with the reactive path and its damping delays, and a blown
+    error bound (or an injected `forecast.fit` fault) degrades the
+    whole thing to exactly the reactive behavior — mode is visible in
+    skyt_autoscaler_forecast_mode and the status block.
+    """
+
+    def __init__(self, inner: Autoscaler,
+                 fleet=None,
+                 metrics_registry: Optional[
+                     'metrics_lib.MetricsRegistry'] = None,
+                 clock=None) -> None:
+        self.inner = inner
+        self._fleet = fleet
+        self._clock = clock or time.time
+        self._curves: Dict[str, forecast_lib.DemandForecaster] = {
+            'total': forecast_lib.DemandForecaster(clock=self._clock)}
+        # Flips True on the first directly-observed timestamp; until
+        # then (an LB fleet that only reaches us through the PR 8
+        # rings) demand is synthesized from the fleet rollup's
+        # skyt_lb_requests_total delta each tick.
+        self._saw_timestamps = False
+        self._fleet_last: Optional[float] = None
+        self._dropped_reported = 0
+        self._fit_errors_reported = 0
+        reg = metrics_registry or metrics_lib.REGISTRY
+        self._m_forecast_qps = reg.gauge(
+            'skyt_autoscaler_forecast_qps',
+            'Forecast demand (requests/s) at now + SKYT_FORECAST_LEAD_S,'
+            ' per demand curve (class "total" = all traffic)',
+            ('class',))
+        self._m_forecast_err = reg.gauge(
+            'skyt_autoscaler_forecast_error',
+            'EWMA relative one-step-ahead error of the total demand '
+            'forecast (compared against SKYT_FORECAST_ERR_BOUND)')
+        self._m_forecast_mode = reg.gauge(
+            'skyt_autoscaler_forecast_mode',
+            '1 while the forecast is trusted (predictive pre-scaling '
+            'active), 0 while degraded to the reactive path')
+        self._m_forecast_decisions = reg.counter(
+            'skyt_autoscaler_forecast_decisions_total',
+            'Predictive-autoscaler outcomes per evaluation: prescale '
+            '(forecast raised the target), hold (reactive target '
+            'already covers the forecast), reactive_fallback (error '
+            'bound blown or insufficient history)', ('decision',))
+        self._m_forecast_dropped = reg.counter(
+            'skyt_autoscaler_forecast_dropped_points_total',
+            'Forecast history points dropped because a bounded curve '
+            'buffer hit SKYT_FORECAST_MAX_POINTS (drop-oldest)')
+        self._m_forecast_fit_errors = reg.counter(
+            'skyt_autoscaler_forecast_fit_errors_total',
+            'Forecast fit failures (forecast.fit fault point or '
+            'internal error); each one degrades to the reactive path')
+
+    # ------------------------------------------------ inner delegation
+    @property
+    def spec(self) -> 'spec_lib.ServiceSpec':
+        return self.inner.spec
+
+    @property
+    def target_num_replicas(self) -> int:
+        return self.inner.target_num_replicas
+
+    @target_num_replicas.setter
+    def target_num_replicas(self, value: int) -> None:
+        self.inner.target_num_replicas = value
+
+    @property
+    def ondemand_base(self) -> int:
+        return getattr(self.inner, 'ondemand_base', 0)
+
+    @property
+    def last_decision(self) -> Optional[dict]:
+        return self.inner.last_decision
+
+    def update_spec(self, spec: 'spec_lib.ServiceSpec') -> None:
+        self.inner.update_spec(spec)
+
+    def collect_request_timestamps(self, ts: List[float]) -> None:
+        self.inner.collect_request_timestamps(ts)
+        if ts:
+            self._saw_timestamps = True
+        curve = self._curves['total']
+        for t in ts:
+            curve.observe(t)
+
+    def collect_qos(self, demand: List, sheds: List) -> None:
+        self.inner.collect_qos(demand, sheds)
+        for entry in demand:
+            try:
+                t, cls = float(entry[0]), str(entry[1])
+            except (TypeError, ValueError, IndexError):
+                continue
+            if cls not in qos_lib.PRIORITIES:
+                cls = 'standard'
+            if cls not in self._curves:
+                self._curves[cls] = forecast_lib.DemandForecaster(
+                    clock=self._clock)
+            self._curves[cls].observe(t)
+
+    # --------------------------------------------------------- forecast
+    def _ingest_fleet(self) -> None:
+        """Fallback intake when no LB sync delivers raw timestamps:
+        synthesize bucket demand from the PR 8 fleet rings'
+        skyt_lb_requests_total delta since the previous tick."""
+        if self._fleet is None or self._saw_timestamps:
+            return
+        now = self._clock()
+        if self._fleet_last is None:
+            self._fleet_last = now
+            return
+        window = now - self._fleet_last
+        self._fleet_last = now
+        if window <= 0:
+            return
+        try:
+            delta = self._fleet.sum_delta('skyt_lb_requests_total',
+                                          None, window, now=now)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception('fleet demand ingest failed')
+            return
+        if delta and delta > 0:
+            self._curves['total'].observe_count(now, round(delta))
+
+    def _fit_all(self) -> bool:
+        ok = True
+        for curve in self._curves.values():
+            try:
+                if not curve.fit():
+                    ok = False
+            except Exception:  # pylint: disable=broad-except
+                logger.exception('forecast fit crashed')
+                self._m_forecast_fit_errors.inc()
+                ok = False
+        dropped = sum(c.dropped_points for c in self._curves.values())
+        if dropped > self._dropped_reported:
+            self._m_forecast_dropped.inc(dropped -
+                                         self._dropped_reported)
+            self._dropped_reported = dropped
+        fit_errors = sum(c.fit_errors for c in self._curves.values())
+        if fit_errors > self._fit_errors_reported:
+            self._m_forecast_fit_errors.inc(
+                fit_errors - self._fit_errors_reported)
+            self._fit_errors_reported = fit_errors
+        return ok
+
+    def _forecast_qps(self, horizon_s: float) -> float:
+        """Weighted demand forecast at now + horizon: per-class curves
+        under the QoS class weights when any class curve is usable
+        (batch discounted exactly as the reactive QoS path discounts
+        it), else the total curve at weight 1."""
+        weights = qos_lib.autoscale_class_weights()
+        per_class = {cls: c for cls, c in self._curves.items()
+                     if cls != 'total' and c.healthy()}
+        if per_class:
+            qps = sum(weights.get(cls, 1.0) * c.predict_qps(horizon_s)
+                      for cls, c in per_class.items())
+        else:
+            qps = self._curves['total'].predict_qps(horizon_s)
+        for cls, curve in self._curves.items():
+            self._m_forecast_qps.labels(cls).set(
+                round(curve.predict_qps(horizon_s), 4))
+        return qps
+
+    def evaluate_scaling(self, num_ready: int) -> AutoscalerDecision:
+        decision = self.inner.evaluate_scaling(num_ready)
+        self._ingest_fleet()
+        fits_ok = self._fit_all()
+        total = self._curves['total']
+        if total.rel_err is not None:
+            self._m_forecast_err.set(round(total.rel_err, 4))
+        spec = self.inner.spec
+        trusted = fits_ok and total.healthy()
+        self._m_forecast_mode.set(1 if trusted else 0)
+        if not spec.autoscaling_enabled or \
+                spec.target_qps_per_replica is None:
+            return decision
+        if not trusted:
+            self._m_forecast_decisions.labels('reactive_fallback').inc()
+            return decision
+        horizon = forecast_lib.lead_s()
+        qps = self._forecast_qps(horizon)
+        target = math.ceil(qps / spec.target_qps_per_replica)
+        upper = spec.max_replicas or spec.min_replicas
+        target = max(spec.min_replicas, min(upper, target))
+        if target > decision.target_num_replicas:
+            reason = (f'forecast {qps:.2f} qps at +{horizon:.0f}s -> '
+                      f'prescale to {target}')
+            # Keep the reactive state in sync so its next delta
+            # reasons from the pre-scaled target, not a stale one.
+            self.inner.target_num_replicas = target
+            self.inner._record_decision(  # pylint: disable=protected-access
+                'prescale', reason)
+            self._m_forecast_decisions.labels('prescale').inc()
+            return AutoscalerDecision(target, reason)
+        self._m_forecast_decisions.labels('hold').inc()
+        return decision
+
+    def status(self) -> dict:
+        total = self._curves['total']
+        out = self.inner.status()
+        out.update({
+            'mode': ('predictive' if total.healthy() else 'reactive'),
+            'class': f'Predictive({type(self.inner).__name__})',
+            'forecast': {
+                'lead_s': forecast_lib.lead_s(),
+                'err_bound': forecast_lib.err_bound(),
+                'qps_at_lead': round(
+                    total.predict_qps(forecast_lib.lead_s()), 4),
+                'curves': {cls: c.status()
+                           for cls, c in self._curves.items()},
+            },
+        })
+        return out
+
+
 def pick_autoscaler_cls(spec: 'spec_lib.ServiceSpec'):
     """Controller-side selection: the on-demand-fallback mode keeps
     priority (its replica-mix contract is orthogonal), then the
@@ -256,3 +509,14 @@ def pick_autoscaler_cls(spec: 'spec_lib.ServiceSpec'):
     if qos_lib.enabled():
         return QoSAwareAutoscaler
     return RequestRateAutoscaler
+
+
+def make_autoscaler(spec: 'spec_lib.ServiceSpec', fleet=None):
+    """The controller's constructor: the reactive autoscaler picked by
+    `pick_autoscaler_cls`, wrapped predictive when
+    SKYT_AUTOSCALE_PREDICT=1. Off (the default) returns the bare
+    reactive instance — behavior byte-for-byte unchanged."""
+    inner = pick_autoscaler_cls(spec)(spec)
+    if env.get_bool('SKYT_AUTOSCALE_PREDICT', False):
+        return PredictiveAutoscaler(inner, fleet=fleet)
+    return inner

@@ -61,8 +61,12 @@ class SkyServeController:
             version=(svc_row or {}).get('version') or 1,
             telemetry=self.fleet)
         # QoS-aware mode (SKYT_QOS=1) scales on per-class demand +
-        # observed shed rate from the LB sync (docs/qos.md).
-        self.autoscaler = autoscalers.pick_autoscaler_cls(spec)(spec)
+        # observed shed rate from the LB sync (docs/qos.md); with
+        # SKYT_AUTOSCALE_PREDICT=1 the reactive autoscaler is wrapped
+        # in the predictive one (serve/forecast.py), which can read
+        # fleet timeseries as its demand fallback.
+        self.autoscaler = autoscalers.make_autoscaler(
+            spec, fleet=self.fleet)
         # The LB serves its own /metrics on the externally reachable
         # port; the fleet store scrapes it so front-door series
         # (breaker state, stale mode, per-replica traffic) sit beside
@@ -123,6 +127,9 @@ class SkyServeController:
                 # step per pass (canary -> bake -> fleet, or
                 # rollback); no-op without an active rollout.
                 self.replica_manager.rollout_tick()
+                # In-place elastic reshard: same one-replica-per-tick
+                # discipline; no-op without an active reshard.
+                self.replica_manager.reshard_tick()
                 ready = len(self.replica_manager.ready_urls())
                 decision = self.autoscaler.evaluate_scaling(ready)
                 ondemand_base = getattr(self.autoscaler, 'ondemand_base',
@@ -309,6 +316,31 @@ class SkyServeController:
         return web.json_response({'ok': True, 'version': version,
                                   'rollout': status})
 
+    async def _handle_reshard(self, request: web.Request
+                              ) -> web.Response:
+        """``POST /controller/reshard`` — start flipping the fleet's
+        virtual-node layout in place, one replica per control tick
+        (docs/robustness.md "Elastic capacity"). Body:
+        ``{"virtual_nodes": N}``. 409 while a rollout or another
+        reshard is active, 400 on a malformed body."""
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        nodes = payload.get('virtual_nodes') \
+            if isinstance(payload, dict) else None
+        if isinstance(nodes, bool) or not isinstance(nodes, int) or \
+                nodes < 1:
+            return web.json_response(
+                {'error': f'virtual_nodes must be an integer >= 1, '
+                          f'got {nodes!r}'}, status=400)
+        from skypilot_tpu import exceptions
+        try:
+            status = self.replica_manager.start_reshard(nodes)
+        except exceptions.SkyTpuError as e:
+            return web.json_response({'error': str(e)}, status=409)
+        return web.json_response({'ok': True, 'reshard': status})
+
     async def _handle_status(self, request: web.Request) -> web.Response:
         del request
         replicas = []
@@ -336,6 +368,10 @@ class SkyServeController:
             'replicas': replicas,
             'lbs': lbs,
             'rollout': self.replica_manager.rollout_status(),
+            # Elastic capacity plane: autoscaler mode + forecast and
+            # the in-flight reshard, mirrored into `serve status`.
+            'autoscaler': self.autoscaler.status(),
+            'reshard': self.replica_manager.reshard_status(),
         })
 
     async def _handle_metrics(self, request: web.Request) -> web.Response:
@@ -394,6 +430,8 @@ class SkyServeController:
                             self._handle_update_service)
         app.router.add_post('/controller/rolling_update',
                             self._handle_rolling_update)
+        app.router.add_post('/controller/reshard',
+                            self._handle_reshard)
         app.router.add_post('/controller/terminate',
                             self._handle_terminate)
         app.router.add_get('/controller/status', self._handle_status)

@@ -316,7 +316,30 @@ def status(service_names: Optional[List[str]] = None
             # Active/last rolling weight update (docs/robustness.md
             # "Zero-downtime rollouts"); None outside rollouts.
             'rollout': serve_state.get_rollout(svc['name']),
+            # Elastic capacity plane: autoscaler mode/forecast/last
+            # decision and any in-flight reshard live only in the
+            # controller's memory — best-effort fetch, None when the
+            # controller is unreachable (status must keep working
+            # through a controller crash).
+            **_controller_live_status(svc),
         })
+    return out
+
+
+def _controller_live_status(svc: Dict[str, Any]) -> Dict[str, Any]:
+    """The /controller/status fields that have no persisted mirror
+    (autoscaler block, reshard state). Never raises: `serve status`
+    is the tool operators reach for WHILE the control plane is sick."""
+    out: Dict[str, Any] = {'autoscaler': None, 'reshard': None}
+    try:
+        resp = requests.get(_controller_url(svc) + '/controller/status',
+                            headers=_auth_headers(svc), timeout=2)
+        if resp.status_code == 200:
+            data = resp.json()
+            out['autoscaler'] = data.get('autoscaler')
+            out['reshard'] = data.get('reshard')
+    except (requests.RequestException, ValueError):
+        pass
     return out
 
 

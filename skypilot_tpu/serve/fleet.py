@@ -102,6 +102,22 @@ class FleetTelemetry:
             'over live targets')
         self.evaluator = slo_lib.BurnRateEvaluator(
             source=self, registry=reg, clock=clock, tracer=tracer)
+        # Cold-start attribution (docs/serving.md "Elastic capacity"):
+        # launch->first-READY seconds the prober reports per replica,
+        # folded into capacity_report as chip-seconds burned before a
+        # single token was served. Bounded: kind is a two-value enum
+        # (wake_from_zero / scale_up).
+        self._cold_counts: Dict[str, int] = {}
+        self._cold_seconds: Dict[str, float] = {}
+
+    def note_cold_start(self, kind: str, seconds: float) -> None:
+        """Record one replica's launch->first-READY window (called by
+        the prober exactly once per replica)."""
+        with self._lock:
+            self._cold_counts[kind] = \
+                self._cold_counts.get(kind, 0) + 1
+            self._cold_seconds[kind] = \
+                self._cold_seconds.get(kind, 0.0) + float(seconds)
 
     # ----------------------------------------------------------- scrape
     def _store_for(self, target: str) -> ts_lib.TimeSeriesStore:
@@ -482,6 +498,10 @@ class FleetTelemetry:
                 now=now)
             if busy is not None:
                 util[target] = round(min(busy / window_s, 1.0), 4)
+        with self._lock:
+            cold_counts = dict(self._cold_counts)
+            cold_seconds = {k: round(v, 3)
+                            for k, v in self._cold_seconds.items()}
         return {
             'service': self.service_name,
             'window_s': window_s,
@@ -489,6 +509,15 @@ class FleetTelemetry:
             'replicas': len(replicas),
             'slices': slices,
             'replica_utilization': util,
+            # Capacity burned before first token (scale-to-zero wakes
+            # and ordinary scale-ups), service-lifetime totals — the
+            # ledger-side cost of elasticity.
+            'cold_start': {
+                'count': cold_counts,
+                'seconds': cold_seconds,
+                'chip_seconds': round(
+                    sum(cold_seconds.values()) * chips_per_replica, 3),
+            },
             # Wall-clock cost (chips x wall seconds / good tokens,
             # slo.py): the upper-bound cross-reference for the
             # ledger's busy-time attribution above.

@@ -1,0 +1,212 @@
+"""Demand forecasting for the predictive autoscaler (docs/serving.md
+"Elastic capacity").
+
+A dependency-free Holt-Winters-style additive forecaster: level +
+trend + a per-slot seasonal component over a configurable season of
+fixed-width buckets. Observations are raw request timestamps (the same
+stream the reactive autoscaler scales on — LB sync buffers, themselves
+the source feeding the PR 8 fleet timeseries rings); `fit()` folds
+every COMPLETED bucket into the smoothing state, scoring its own
+one-step-ahead prediction first so the forecaster carries a live
+error estimate (EWMA of relative error). The predictive autoscaler
+only trusts a forecaster whose error bound holds (`healthy()`);
+anything else degrades to the reactive path.
+
+Determinism: no RNG anywhere — the clock is injectable, so seeded
+tests drive time explicitly (the faults.py discipline: all
+nondeterminism injected, none ambient). Gaps between observations
+fold in as true zero-demand buckets, not skipped time.
+
+Memory: the raw-point buffer is bounded drop-oldest with a dropped
+counter (`dropped_points`), mirroring the PR 5 autoscaler-timestamp
+precedent; the smoothing state itself is O(season).
+"""
+import math
+import time
+from typing import Callable, List, Optional
+
+from skypilot_tpu.utils import env
+from skypilot_tpu.utils import faults
+from skypilot_tpu.utils import log_utils
+
+logger = log_utils.init_logger(__name__)
+
+
+def _bucket_s() -> float:
+    return max(env.get_float('SKYT_FORECAST_BUCKET_S', 10.0), 0.001)
+
+
+def _season_buckets() -> int:
+    return env.get_int('SKYT_FORECAST_SEASON_BUCKETS', 30, minimum=1)
+
+
+def _max_points() -> int:
+    return env.get_int('SKYT_FORECAST_MAX_POINTS', 16384, minimum=1)
+
+
+class DemandForecaster:
+    """One demand curve (total, or one QoS class)."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 bucket_s: Optional[float] = None,
+                 season_buckets: Optional[int] = None) -> None:
+        self._clock = clock or time.time
+        self.bucket_s = bucket_s if bucket_s is not None else _bucket_s()
+        self.season = (season_buckets if season_buckets is not None
+                       else _season_buckets())
+        self._alpha = min(max(
+            env.get_float('SKYT_FORECAST_ALPHA', 0.5), 0.01), 1.0)
+        self._beta = min(max(
+            env.get_float('SKYT_FORECAST_BETA', 0.1), 0.0), 1.0)
+        self._gamma = min(max(
+            env.get_float('SKYT_FORECAST_GAMMA', 0.3), 0.0), 1.0)
+        self._err_lam = 0.2     # EWMA weight for the error estimate
+        # Raw, not-yet-folded observation timestamps (bounded).
+        self._pending: List[float] = []
+        self.dropped_points = 0
+        self.fit_errors = 0
+        # Holt-Winters state. `_level is None` = nothing fitted yet.
+        self._level: Optional[float] = None
+        self._trend = 0.0
+        self._season_adj = [0.0] * self.season
+        self._season_seen = [False] * self.season
+        self._last_bucket: Optional[int] = None
+        self.fitted_buckets = 0
+        self.rel_err: Optional[float] = None
+
+    # ------------------------------------------------------------ intake
+    def observe(self, ts: float) -> None:
+        """One demand event (a request) at `ts`."""
+        self._pending.append(float(ts))
+        over = len(self._pending) - _max_points()
+        if over > 0:
+            del self._pending[:over]
+            self.dropped_points += over
+
+    def observe_count(self, ts: float, count: int) -> None:
+        """`count` events folded at one timestamp — the fleet-rollup
+        intake (PR 8 rings surface deltas, not per-event times). The
+        cap still applies: a huge delta collapses into capped events
+        plus dropped-point accounting, never unbounded memory."""
+        for _ in range(max(int(count), 0)):
+            self.observe(ts)
+
+    # --------------------------------------------------------------- fit
+    def _bucket_of(self, ts: float) -> int:
+        return int(math.floor(ts / self.bucket_s))
+
+    def fit(self) -> bool:
+        """Fold every completed bucket into the smoothing state.
+        Returns False on an injected fit failure (`forecast.fit` fault
+        point): the error estimate is blown past any bound so the
+        caller's healthy() check fails and the reactive path takes
+        over; sustained clean fits decay it back."""
+        try:
+            faults.inject('forecast.fit')
+        except faults.FaultError as e:
+            self.fit_errors += 1
+            bound = err_bound()
+            self.rel_err = max(self.rel_err or 0.0, bound * 4.0)
+            logger.warning('forecast fit failed: %s', e)
+            return False
+        now_bucket = self._bucket_of(self._clock())
+        ready = [t for t in self._pending
+                 if self._bucket_of(t) < now_bucket]
+        if not ready and (self._last_bucket is None or
+                          self._last_bucket >= now_bucket - 1):
+            return True       # nothing newly completed
+        self._pending = [t for t in self._pending
+                         if self._bucket_of(t) >= now_bucket]
+        counts: dict = {}
+        for t in ready:
+            b = self._bucket_of(t)
+            counts[b] = counts.get(b, 0) + 1
+        if self._last_bucket is None:
+            start = min(counts) if counts else now_bucket - 1
+        else:
+            start = self._last_bucket + 1
+        for b in range(start, now_bucket):
+            self._fold(b, counts.get(b, 0))
+        self._last_bucket = now_bucket - 1
+        return True
+
+    def _fold(self, bucket: int, count: int) -> None:
+        slot = bucket % self.season
+        # Score the one-step-ahead prediction BEFORE updating: the
+        # error estimate is honest out-of-sample error, not residuals.
+        if self._level is not None:
+            pred = self._predict_bucket(bucket)
+            rel = abs(count - pred) / max(count, pred, 1.0)
+            if self.rel_err is None:
+                self.rel_err = rel
+            else:
+                self.rel_err = ((1.0 - self._err_lam) * self.rel_err +
+                                self._err_lam * rel)
+        s = self._season_adj[slot] if self._season_seen[slot] else 0.0
+        if self._level is None:
+            self._level = float(count) - s
+        else:
+            prev = self._level
+            self._level = (self._alpha * (count - s) +
+                           (1.0 - self._alpha) *
+                           (self._level + self._trend))
+            self._trend = (self._beta * (self._level - prev) +
+                           (1.0 - self._beta) * self._trend)
+        self._season_adj[slot] = (self._gamma * (count - self._level) +
+                                  (1.0 - self._gamma) * s)
+        self._season_seen[slot] = True
+        self.fitted_buckets += 1
+
+    # ----------------------------------------------------------- predict
+    def _predict_bucket(self, bucket: int) -> float:
+        """Expected event count for `bucket`, from the state as of the
+        last folded bucket."""
+        assert self._level is not None
+        if self._last_bucket is None:
+            steps = 1
+        else:
+            steps = max(bucket - self._last_bucket, 1)
+        slot = bucket % self.season
+        s = self._season_adj[slot] if self._season_seen[slot] else 0.0
+        return max(self._level + steps * self._trend + s, 0.0)
+
+    def predict_qps(self, horizon_s: float) -> float:
+        """Forecast demand rate (requests/s) at now + horizon_s."""
+        if self._level is None:
+            return 0.0
+        bucket = self._bucket_of(self._clock() + max(horizon_s, 0.0))
+        return self._predict_bucket(bucket) / self.bucket_s
+
+    # ------------------------------------------------------------ health
+    def healthy(self) -> bool:
+        """Trustworthy = enough buckets fitted AND the out-of-sample
+        error EWMA within the configured bound."""
+        if self.fitted_buckets < env.get_int('SKYT_FORECAST_MIN_BUCKETS',
+                                             8, minimum=1):
+            return False
+        return self.rel_err is not None and self.rel_err <= err_bound()
+
+    def status(self) -> dict:
+        return {
+            'fitted_buckets': self.fitted_buckets,
+            'bucket_s': self.bucket_s,
+            'season_buckets': self.season,
+            'rel_err': (round(self.rel_err, 4)
+                        if self.rel_err is not None else None),
+            'healthy': self.healthy(),
+            'dropped_points': self.dropped_points,
+            'fit_errors': self.fit_errors,
+        }
+
+
+def err_bound() -> float:
+    """Relative-error ceiling above which the forecast is not acted
+    on (the predictive autoscaler degrades to reactive)."""
+    return max(env.get_float('SKYT_FORECAST_ERR_BOUND', 0.5), 0.0)
+
+
+def lead_s() -> float:
+    """Provisioning lead time: how far ahead the predictive autoscaler
+    scales — the horizon must cover launch + cold start, or capacity
+    lands after the wave it was bought for."""
+    return max(env.get_float('SKYT_FORECAST_LEAD_S', 60.0), 0.0)

@@ -662,6 +662,27 @@ class SkyServeLoadBalancer:
             'Requests by affinity routing mode: sticky (session pin '
             'held), ring (prefix-key consistent-hash), none (keyless)',
             ('lb', 'mode'))
+        # Scale-to-zero surge queue (docs/serving.md "Elastic
+        # capacity"): while the ready set is EMPTY (service scaled to
+        # zero, or waking), up to SKYT_LB_SURGE_QUEUE_MAX arrivals park
+        # in _wait_for_replica instead of failing — the bounded
+        # cold-start survival window. Beyond the cap or past the
+        # request's deadline the honest answer is an immediate
+        # 503 + Retry-After, never a silent hang.
+        self._surge_parked = 0
+        self._m_surge_depth = reg.gauge(
+            'skyt_lb_surge_queue_depth',
+            'Requests currently parked awaiting a cold-starting '
+            'replica (empty ready set)', ('lb',))
+        self._m_surge = reg.counter(
+            'skyt_lb_surge_requests_total',
+            'Surge-queue outcomes: served (a replica appeared in '
+            'time), overflow (queue at cap, immediate 503), timeout '
+            '(deadline passed while parked, 503)', ('lb', 'outcome'))
+        # Set by the first parked request; the sync loop waits on it
+        # so the controller learns about post-scale-to-zero demand on
+        # the next tick instead of after a full sync interval.
+        self._sync_nudge: Optional[asyncio.Event] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._sync_task: Optional[asyncio.Task] = None
         self._gossip_task: Optional[asyncio.Task] = None
@@ -757,7 +778,19 @@ class SkyServeLoadBalancer:
                 self._qos_sheds = qs + self._qos_sheds
                 self._cap_timestamps()
                 await self._enter_or_hold_stale()
-            await asyncio.sleep(_sync_interval())
+            # Interruptible sleep: a request parking in the surge
+            # queue nudges the next sync immediately, so a
+            # scaled-to-zero service's controller sees the demand
+            # (its wake-from-zero lever) on the next control tick
+            # instead of up to a full sync interval later.
+            if self._sync_nudge is None:
+                self._sync_nudge = asyncio.Event()
+            try:
+                await asyncio.wait_for(self._sync_nudge.wait(),
+                                       timeout=_sync_interval())
+            except asyncio.TimeoutError:
+                pass
+            self._sync_nudge.clear()
 
     def apply_state(self, state: 'LBState',
                     source: str = 'controller') -> None:
@@ -1372,21 +1405,54 @@ class SkyServeLoadBalancer:
         hangs. Polling is only for the genuinely-empty ready set (a
         service still starting up)."""
         poll = max(env.get_float('SKYT_LB_NO_REPLICA_POLL_S', 1.0), 0.01)
-        while True:
-            replica = self._pick_replica_once(tried, qos_avoid,
-                                              key=key, session=session)
-            if replica is not None:
-                return replica
-            if self.policy.ready_replicas:
-                return None     # all breaker-blocked: fail fast
-            now = time.monotonic()
-            if now >= deadline:
-                return None
-            tr = request.transport
-            if tr is None or tr.is_closing():
-                raise ConnectionResetError(
-                    'client disconnected while waiting for a replica')
-            await asyncio.sleep(min(poll, deadline - now))
+        parked = False
+        try:
+            while True:
+                replica = self._pick_replica_once(tried, qos_avoid,
+                                                  key=key,
+                                                  session=session)
+                if replica is not None:
+                    if parked:
+                        self._m_surge.labels(self.lb_id,
+                                             'served').inc()
+                    return replica
+                if self.policy.ready_replicas:
+                    return None     # all breaker-blocked: fail fast
+                now = time.monotonic()
+                if now >= deadline:
+                    if parked:
+                        self._m_surge.labels(self.lb_id,
+                                             'timeout').inc()
+                    return None
+                if not parked:
+                    # Scale-to-zero surge queue: park behind the
+                    # bounded queue while the fleet cold-starts. At
+                    # cap, overflow answers 503 + Retry-After NOW —
+                    # an unbounded queue would just convert a flash
+                    # crowd into a memory bomb plus timeouts.
+                    cap = max(
+                        env.get_int('SKYT_LB_SURGE_QUEUE_MAX', 256), 0)
+                    if self._surge_parked >= cap:
+                        self._m_surge.labels(self.lb_id,
+                                             'overflow').inc()
+                        return None
+                    parked = True
+                    self._surge_parked += 1
+                    self._m_surge_depth.labels(self.lb_id).set(
+                        self._surge_parked)
+                    if self._sync_nudge is not None:
+                        self._sync_nudge.set()
+                tr = request.transport
+                if tr is None or tr.is_closing():
+                    raise ConnectionResetError(
+                        'client disconnected while waiting for a '
+                        'replica')
+                await asyncio.sleep(min(poll, deadline - now))
+        finally:
+            if parked:
+                self._surge_parked -= 1
+                self._m_surge_depth.labels(self.lb_id).set(
+                    self._surge_parked)
 
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         """Reference: :116 _proxy_request_to — with streaming, retries,

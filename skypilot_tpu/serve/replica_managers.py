@@ -98,6 +98,37 @@ class RolloutState:
         return self.phase in ROLLOUT_ACTIVE_PHASES
 
 
+RESHARD_ACTIVE_PHASES = ('reshard', 'rollback')
+RESHARD_PHASES = RESHARD_ACTIVE_PHASES + ('done', 'rolled_back')
+
+
+@dataclasses.dataclass
+class ReshardState:
+    """One in-place elastic reshard (docs/robustness.md "Elastic
+    capacity"): flip every READY replica's virtual-node layout through
+    POST /admin/reshard, one replica per control tick, rolling back the
+    already-resharded set (newest first) after repeated failures.
+
+    Deliberately IN-MEMORY, unlike RolloutState: the layout is a
+    performance knob, not a correctness hazard — a controller restart
+    mid-reshard leaves each replica serving on whatever layout it
+    holds, and the operator re-issues the reshard. Persisting it would
+    buy crash-resume for an operation that is cheap to re-request."""
+    target_nodes: int
+    phase: str = 'reshard'
+    started_at: float = dataclasses.field(default_factory=time.time)
+    updated: List[int] = dataclasses.field(default_factory=list)
+    fails: int = 0                 # consecutive per-replica failures
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def active(self) -> bool:
+        return self.phase in RESHARD_ACTIVE_PHASES
+
+
 @dataclasses.dataclass
 class ReplicaInfo:
     """Reference: sky/serve/replica_managers.py:382."""
@@ -222,6 +253,34 @@ class ReplicaManager:
             'skyt_serve_rollouts_total',
             'Rolling weight updates finished, by outcome',
             ('service', 'outcome'))
+        # Elastic capacity plane (docs/serving.md "Elastic capacity"):
+        # cold-start attribution (scale-to-zero wakes vs ordinary
+        # scale-ups), KV pre-warm pushes, and reshard orchestration.
+        self._m_cold_starts = reg.counter(
+            'skyt_serve_cold_starts_total',
+            'Replicas that reached first-READY, by cold-start kind '
+            '(wake_from_zero = no other replica was READY)',
+            ('service', 'kind'))
+        self._m_cold_start_s = reg.counter(
+            'skyt_serve_cold_start_seconds_total',
+            'Total launch->first-READY seconds, the chip-seconds '
+            'ledger\'s cold-start attribution input', ('service',))
+        self._m_prewarms = reg.counter(
+            'skyt_serve_prewarms_total',
+            'KV pre-warm pushes to newly READY replicas, by result',
+            ('service', 'result'))
+        self._m_reshard_calls = reg.counter(
+            'skyt_serve_reshard_calls_total',
+            'Per-replica /admin/reshard calls made by the reshard '
+            'orchestrator, by result', ('service', 'result'))
+        self._m_reshards = reg.counter(
+            'skyt_serve_reshards_total',
+            'Elastic reshards finished, by outcome',
+            ('service', 'outcome'))
+        self._m_reshard_state = reg.gauge(
+            'skyt_serve_reshard_state',
+            'Elastic reshard state (1 on the current phase, 0 '
+            'elsewhere)', ('service', 'phase'))
         # Relaunch backoff: repeated replica failures (probe-failure ->
         # FAILED -> reconcile relaunch) back off exponentially instead
         # of tight-looping launches against a broken image/config; any
@@ -248,6 +307,11 @@ class ReplicaManager:
             svc.get('auth_token') if svc else None
         # Injectable for tests: (info, payload) -> (ok, error | None).
         self._swap_fn = self._swap_replica_http
+        self._reshard_fn = self._reshard_replica_http
+        # Injectable prewarm push: (info, peers) -> (ok, error | None).
+        self._prewarm_fn = self._prewarm_replica_http
+        # In-memory by design — see ReshardState.
+        self._reshard: Optional[ReshardState] = None
         # Restart-safe rollout state: loaded BEFORE restart adoption so
         # the orphan check can recognize versions a crashed rollout
         # legitimately left behind (composes with PR 7 adoption).
@@ -487,6 +551,14 @@ class ReplicaManager:
             if info.use_spot:
                 for res in task.resources:
                     res.use_spot = True  # spot overflow replicas
+            # Chaos hook (docs/robustness.md fault catalog): 'latency'
+            # stalls provisioning in THIS launch thread — the surge-
+            # queue honesty drill's lever (parked requests must get a
+            # bounded 503, not a hang); 'error' fails the launch into
+            # the ordinary FAILED + relaunch-backoff path.
+            faults.inject('scale.provision',
+                          replica=info.replica_id,
+                          service=self.service_name)
             execution.launch(task, cluster_name=info.cluster_name,
                              detach_run=True, stream_logs=False)
             record = cluster_state.get_cluster(info.cluster_name)
@@ -501,7 +573,7 @@ class ReplicaManager:
             self._save(info)
             logger.info('replica %d up at %s', info.replica_id,
                         info.endpoint)
-        except exceptions.SkyTpuError as e:
+        except (exceptions.SkyTpuError, faults.FaultError) as e:
             logger.warning('replica %d launch failed: %s',
                            info.replica_id, e)
             info.status = serve_state.ReplicaStatus.FAILED
@@ -540,6 +612,78 @@ class ReplicaManager:
         self._next_launch_ok = time.time() + self._relaunch_backoff
         logger.info('replica failure: relaunches gated for %.1fs',
                     self._relaunch_backoff)
+
+    def _note_first_ready(self, info: ReplicaInfo) -> None:
+        """Cold-start attribution + pre-warm push, fired exactly once
+        per replica (its launch->first-READY transition). The seconds
+        feed the chip-seconds ledger: capacity burned before the
+        replica served its first token. kind='wake_from_zero' when no
+        OTHER replica was READY at the moment this one arrived — the
+        scale-to-zero wake the surge queue was bridging."""
+        seconds = max(0.0, (info.first_ready_at or 0.0) -
+                      info.launched_at)
+        with self._lock:
+            others = [r for r in self.replicas.values()
+                      if r.replica_id != info.replica_id and
+                      r.status is serve_state.ReplicaStatus.READY]
+        kind = 'scale_up' if others else 'wake_from_zero'
+        self._m_cold_starts.labels(self.service_name, kind).inc()
+        self._m_cold_start_s.labels(self.service_name).inc(seconds)
+        if self._telemetry is not None:
+            try:
+                self._telemetry.note_cold_start(kind, seconds)
+            except AttributeError:
+                pass   # older telemetry object (tests with stubs)
+        logger.info('replica %d cold start: %.1fs (%s)',
+                    info.replica_id, seconds, kind)
+        # Proactive KV pre-warm (opt-in; docs/serving.md "Elastic
+        # capacity"): ask the new replica to pull its rendezvous share
+        # of the fleet's resident prefix pages from its peers, in a
+        # daemon thread so the probe loop never blocks on it.
+        # Best-effort by contract: a failed pre-warm costs prefix
+        # recomputes, never readiness.
+        if not env.get_bool('SKYT_SERVE_PREWARM', False):
+            return
+        peers = [r.endpoint for r in others if r.endpoint]
+        if not peers or not info.endpoint:
+            return
+
+        def _push() -> None:
+            ok, err = self._prewarm_fn(info, peers)
+            self._m_prewarms.labels(self.service_name,
+                                    'ok' if ok else 'error').inc()
+            if not ok:
+                logger.warning('replica %d kv prewarm failed: %s',
+                               info.replica_id, err)
+
+        threading.Thread(target=_push, daemon=True,
+                         name=f'prewarm-{info.replica_id}').start()
+
+    def _prewarm_replica_http(self, info: ReplicaInfo,
+                              peers: List[str]
+                              ) -> 'tuple[bool, Optional[str]]':
+        """One POST /admin/kv_prewarm against a newly READY replica
+        (the injectable default of self._prewarm_fn)."""
+        if not info.endpoint:
+            return False, 'replica has no endpoint'
+        headers = {}
+        if self._admin_token:
+            headers['Authorization'] = f'Bearer {self._admin_token}'
+        try:
+            resp = requests.post(
+                info.endpoint + '/admin/kv_prewarm',
+                json={'self': info.endpoint, 'peers': peers},
+                headers=headers,
+                timeout=env.get_float('SKYT_PREWARM_TIMEOUT_S', 10.0))
+            if resp.status_code == 200:
+                return True, None
+            try:
+                msg = resp.json().get('error', '')
+            except ValueError:
+                msg = resp.text[:200]
+            return False, f'HTTP {resp.status_code}: {msg}'
+        except requests.RequestException as e:
+            return False, str(e)
 
     def _replica_port(self, task) -> int:
         """Replica serving port: first task resources port, else (local
@@ -697,6 +841,7 @@ class ReplicaManager:
             if ok:
                 if info.first_ready_at is None:
                     info.first_ready_at = time.time()
+                    self._note_first_ready(info)
                 info.consecutive_failures = 0
                 # A healthy replica proves the config launches: clear
                 # the relaunch backoff gate.
@@ -830,6 +975,11 @@ class ReplicaManager:
                     f'a rolling update to version '
                     f'{self._rollout.target_version} is already in '
                     f'progress (phase {self._rollout.phase})')
+            if self._reshard is not None and self._reshard.active:
+                raise exceptions.SkyTpuError(
+                    f'an elastic reshard is in progress (phase '
+                    f'{self._reshard.phase}); roll out after it '
+                    f'finishes')
             self._rollout = RolloutState(
                 phase='canary',
                 target_version=int(version),
@@ -1117,6 +1267,172 @@ class ReplicaManager:
                        'serving baseline v%d', ro.target_version,
                        ro.error or 'unspecified failure',
                        ro.baseline_version)
+
+    # ---------------------------------------- in-place elastic reshard
+    def start_reshard(self, virtual_nodes: int) -> dict:
+        """Begin flipping every READY replica's virtual-node layout to
+        `virtual_nodes`, one replica per control tick (docs/
+        robustness.md "Elastic capacity"). Refuses while a rollout OR
+        another reshard is active — both ride the replicas' single-
+        flight swap slot, and interleaving them would make 409s
+        ambiguous. Raises SkyTpuError on conflict or a bad target."""
+        try:
+            target = int(virtual_nodes)
+        except (TypeError, ValueError):
+            raise exceptions.SkyTpuError(
+                f'virtual_nodes must be an integer, got '
+                f'{virtual_nodes!r}')
+        if target < 1:
+            raise exceptions.SkyTpuError(
+                f'virtual_nodes must be >= 1, got {target}')
+        with self._lock:
+            if self._rollout is not None and self._rollout.active:
+                raise exceptions.SkyTpuError(
+                    f'a rolling update is in progress (phase '
+                    f'{self._rollout.phase}); reshard after it '
+                    f'finishes')
+            if self._reshard is not None and self._reshard.active:
+                raise exceptions.SkyTpuError(
+                    f'a reshard to {self._reshard.target_nodes} '
+                    f'virtual nodes is already in progress (phase '
+                    f'{self._reshard.phase})')
+            self._reshard = ReshardState(target_nodes=target)
+        self._update_reshard_gauge()
+        logger.info('reshard started: -> %d virtual nodes', target)
+        return self.reshard_status()
+
+    def reshard_status(self) -> Optional[dict]:
+        with self._lock:
+            rs = self._reshard
+        return rs.to_dict() if rs is not None else None
+
+    def _update_reshard_gauge(self) -> None:
+        with self._lock:
+            rs = self._reshard
+        for phase in RESHARD_PHASES:
+            self._m_reshard_state.labels(self.service_name, phase).set(
+                1 if (rs is not None and rs.phase == phase) else 0)
+
+    def _reshard_replica_http(self, info: ReplicaInfo,
+                              payload: dict
+                              ) -> 'tuple[bool, Optional[str]]':
+        """One POST /admin/reshard against a replica (the injectable
+        default of self._reshard_fn)."""
+        if not info.endpoint:
+            return False, 'replica has no endpoint'
+        headers = {}
+        if self._admin_token:
+            headers['Authorization'] = f'Bearer {self._admin_token}'
+        try:
+            resp = requests.post(
+                info.endpoint + '/admin/reshard', json=payload,
+                headers=headers,
+                timeout=env.get_float('SKYT_ROLLOUT_SWAP_TIMEOUT_S',
+                                      180.0))
+            if resp.status_code == 200:
+                return True, None
+            try:
+                msg = resp.json().get('error', '')
+            except ValueError:
+                msg = resp.text[:200]
+            return False, f'HTTP {resp.status_code}: {msg}'
+        except requests.RequestException as e:
+            return False, str(e)
+
+    def _reshard_candidates(self, rs: ReshardState) -> List[ReplicaInfo]:
+        with self._lock:
+            return sorted(
+                (r for r in self.replicas.values()
+                 if r.status is serve_state.ReplicaStatus.READY and
+                 r.endpoint and r.replica_id not in rs.updated),
+                key=lambda r: r.replica_id)
+
+    def reshard_tick(self) -> None:
+        """One state-machine step of the active reshard — called from
+        the control loop beside rollout_tick. One replica per tick so
+        capacity dips by at most one tick-boundary apply at a time;
+        repeated failures roll the already-resharded set back (newest
+        first). Covers the replicas READY during the window: a replica
+        still STARTING boots on the default layout — the layout is a
+        performance knob, so a partially-covered fleet is degraded
+        throughput, never an outage."""
+        with self._lock:
+            rs = self._reshard
+        if rs is None or not rs.active:
+            return
+        before = rs.phase
+        if rs.phase == 'reshard':
+            self._tick_reshard(rs)
+        elif rs.phase == 'rollback':
+            self._tick_reshard_rollback(rs)
+        if rs.phase != before:
+            self._update_reshard_gauge()
+
+    def _tick_reshard(self, rs: ReshardState) -> None:
+        cand = self._reshard_candidates(rs)
+        if not cand:
+            rs.phase = 'done'
+            self._m_reshards.labels(self.service_name, 'done').inc()
+            logger.info('reshard done: %d replica(s) on %d virtual '
+                        'nodes', len(rs.updated), rs.target_nodes)
+            return
+        info = cand[0]
+        ok, err = self._reshard_fn(
+            info, {'virtual_nodes': rs.target_nodes})
+        if ok:
+            self._m_reshard_calls.labels(self.service_name,
+                                         'ok').inc()
+            rs.updated.append(info.replica_id)
+            rs.fails = 0
+            logger.info('reshard: replica %d on %d virtual nodes',
+                        info.replica_id, rs.target_nodes)
+            return
+        self._m_reshard_calls.labels(self.service_name, 'error').inc()
+        rs.fails += 1
+        rs.error = f'replica {info.replica_id} reshard failed: {err}'
+        logger.warning('reshard: %s (consecutive fails: %d)',
+                       rs.error, rs.fails)
+        if rs.fails >= _rollout_retries():
+            rs.phase = 'rollback'
+
+    def _tick_reshard_rollback(self, rs: ReshardState) -> None:
+        """Reshard every updated replica back to its previous layout,
+        newest first. A replica that refuses after the retry budget is
+        SKIPPED, not drained — a wrong layout is degraded throughput,
+        and relaunching a serving replica over it would turn a perf
+        hiccup into a capacity dip."""
+        while rs.updated:
+            rid = rs.updated[-1]
+            info = self.replicas.get(rid)
+            if info is None or not info.is_alive:
+                rs.updated.pop()   # gone; nothing to roll back
+                continue
+            ok, err = self._reshard_fn(info, {'reshard_back': True})
+            if ok:
+                self._m_reshard_calls.labels(self.service_name,
+                                             'rollback_ok').inc()
+                rs.updated.pop()
+                rs.fails = 0
+                logger.info('reshard: replica %d rolled back', rid)
+                continue
+            self._m_reshard_calls.labels(self.service_name,
+                                         'rollback_error').inc()
+            rs.fails += 1
+            logger.warning('reshard: replica %d rollback failed '
+                           '(%d/%d): %s', rid, rs.fails,
+                           _rollout_retries(), err)
+            if rs.fails >= _rollout_retries():
+                logger.warning('reshard: skipping replica %d (layout '
+                               'left as-is)', rid)
+                rs.updated.pop()
+                rs.fails = 0
+            return   # failed attempt: retry/escalate next tick
+        rs.phase = 'rolled_back'
+        self._m_reshards.labels(self.service_name,
+                                'rolled_back').inc()
+        logger.warning('reshard to %d virtual nodes rolled back (%s)',
+                       rs.target_nodes, rs.error or
+                       'unspecified failure')
 
     # ------------------------------------------------------------- views
     def ready_urls(self) -> List[str]:

@@ -373,7 +373,44 @@ _var('SKYT_ROLLOUT_SWAP_TIMEOUT_S', 'float', 180.0,
 _var('SKYT_ROLLOUT_RETRIES', 'int', 3,
      'Consecutive per-replica swap/rollback failures a rolling '
      'update tolerates before escalating (rollback, then drain+'
-     'relaunch of the stuck replica).')
+     'relaunch of the stuck replica). The elastic reshard '
+     'orchestrator shares this budget.')
+
+# ------------------------------------------------- elastic capacity
+_var('SKYT_AUTOSCALE_PREDICT', 'bool', False,
+     'Wrap the reactive autoscaler in the predictive one '
+     '(serve/forecast.py): scale BEFORE a forecast demand wave, '
+     'degrade to reactive when the error bound blows. Off = '
+     'behavior unchanged.')
+_var('SKYT_FORECAST_BUCKET_S', 'float', 10.0,
+     'Width of one demand-forecast bucket (seconds).')
+_var('SKYT_FORECAST_SEASON_BUCKETS', 'int', 30,
+     'Buckets per season of the Holt-Winters seasonal component.')
+_var('SKYT_FORECAST_LEAD_S', 'float', 60.0,
+     'Provisioning lead time: how far ahead the predictive '
+     'autoscaler scales (must cover launch + cold start).')
+_var('SKYT_FORECAST_ALPHA', 'float', 0.5,
+     'Holt-Winters level smoothing factor.')
+_var('SKYT_FORECAST_BETA', 'float', 0.1,
+     'Holt-Winters trend smoothing factor.')
+_var('SKYT_FORECAST_GAMMA', 'float', 0.3,
+     'Holt-Winters seasonal smoothing factor.')
+_var('SKYT_FORECAST_ERR_BOUND', 'float', 0.5,
+     'Relative one-step-ahead error (EWMA) above which the forecast '
+     'is not acted on (predictive degrades to reactive).')
+_var('SKYT_FORECAST_MIN_BUCKETS', 'int', 8,
+     'Fitted buckets required before a forecast is trusted.')
+_var('SKYT_FORECAST_MAX_POINTS', 'int', 16384,
+     'Cap on buffered raw observations per demand curve '
+     '(drop-oldest, counted).')
+_var('SKYT_LB_SURGE_QUEUE_MAX', 'int', 256,
+     'Requests the LB parks awaiting a cold-starting replica while '
+     'the ready set is empty; beyond it, immediate 503+Retry-After.')
+_var('SKYT_SERVE_PREWARM', 'bool', False,
+     'Push a KV pre-warm to each newly READY replica: it pulls its '
+     'rendezvous share of fleet-resident prefix pages from peers.')
+_var('SKYT_PREWARM_TIMEOUT_S', 'float', 10.0,
+     'HTTP timeout of the controller\'s POST /admin/kv_prewarm push.')
 
 # ---------------------------------------------------------------- qos
 _var('SKYT_QOS', 'bool', False,

@@ -8,6 +8,7 @@ The integration tests drive the REAL LB -> server -> engine HTTP stack
 on CPU; replica death is a SIGKILL'd subprocess, not a mock.
 """
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -2773,3 +2774,471 @@ def test_chaos_kv_warm_restart_drill(monkeypatch):
         for proc in procs.values():
             if proc.poll() is None:
                 proc.kill()
+
+
+# ==================== elastic capacity: surge queue + reshard drills
+def _surge_metrics(reg, lb):
+    outcomes = reg.counter('skyt_lb_surge_requests_total', '',
+                           ('lb', 'outcome'))
+    depth = reg.gauge('skyt_lb_surge_queue_depth', '', ('lb',))
+    return (lambda o: outcomes.value(lb.lb_id, o),
+            lambda: depth.value(lb.lb_id))
+
+
+def _wait_gauge(read, want, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if read() == want:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f'gauge never reached {want}: {read()}')
+
+
+def test_lb_surge_queue_parks_then_serves(monkeypatch):
+    """Scale-to-zero survival: with the ready set EMPTY a request
+    parks in the surge queue (depth gauge ticks up) instead of
+    eating the 503 — and is served the moment a replica appears."""
+    lb, base, reg = _make_lb([], monkeypatch,
+                             SKYT_LB_NO_REPLICA_POLL_S='0.05',
+                             SKYT_LB_NO_REPLICA_TIMEOUT_S='30')
+    outcome, depth = _surge_metrics(reg, lb)
+    results = []
+
+    def one():
+        results.append(requests.get(base + '/g', timeout=30))
+
+    th = threading.Thread(target=one)
+    th.start()
+    _wait_gauge(depth, 1)           # parked, not rejected
+    url = _ok_replica('woke')
+    lb.policy.set_ready_replicas([url])
+    th.join(timeout=30)
+    assert results and results[0].status_code == 200
+    assert results[0].text == 'hello-woke'
+    assert outcome('served') == 1
+    assert outcome('overflow') == 0 and outcome('timeout') == 0
+    _wait_gauge(depth, 0)
+
+
+def test_lb_surge_queue_overflow_is_honest_503(monkeypatch):
+    """At SKYT_LB_SURGE_QUEUE_MAX the queue answers 503 + Retry-After
+    IMMEDIATELY (no park): a flash crowd against a scaled-to-zero
+    fleet must not become a memory bomb plus timeouts."""
+    lb, base, reg = _make_lb([], monkeypatch,
+                             SKYT_LB_SURGE_QUEUE_MAX='2',
+                             SKYT_LB_NO_REPLICA_POLL_S='0.05',
+                             SKYT_LB_NO_REPLICA_TIMEOUT_S='30')
+    outcome, depth = _surge_metrics(reg, lb)
+    parked = []
+
+    def one():
+        parked.append(requests.get(base + '/g', timeout=30))
+
+    threads = [threading.Thread(target=one) for _ in range(2)]
+    for th in threads:
+        th.start()
+    _wait_gauge(depth, 2)
+    t0 = time.time()
+    r = requests.get(base + '/g', timeout=10)    # third: over cap
+    assert r.status_code == 503
+    assert time.time() - t0 < 3                  # immediate, no park
+    assert float(r.headers['Retry-After']) >= 1.0
+    assert outcome('overflow') == 1
+    lb.policy.set_ready_replicas([_ok_replica()])
+    for th in threads:
+        th.join(timeout=30)
+    assert [p.status_code for p in parked] == [200, 200]
+    assert outcome('served') == 2
+
+
+def test_lb_surge_queue_timeout_is_bounded(monkeypatch):
+    """A parked request past the no-replica deadline gets an honest
+    503 + Retry-After in bounded time — never a silent hang."""
+    lb, base, reg = _make_lb([], monkeypatch,
+                             SKYT_LB_NO_REPLICA_POLL_S='0.05',
+                             SKYT_LB_NO_REPLICA_TIMEOUT_S='0.5')
+    outcome, _depth = _surge_metrics(reg, lb)
+    t0 = time.time()
+    r = requests.get(base + '/g', timeout=10)
+    elapsed = time.time() - t0
+    assert r.status_code == 503
+    assert elapsed < 5, elapsed
+    assert float(r.headers['Retry-After']) >= 1.0
+    assert outcome('timeout') == 1 and outcome('served') == 0
+
+
+def test_chaos_flash_crowd_scaled_to_zero(monkeypatch):
+    """THE flash-crowd-vs-scaled-to-zero drill (docs/robustness.md
+    "Elastic capacity"): 8 simultaneous arrivals against an EMPTY
+    ready set with a 4-deep surge queue. Exactly 4 park (the queue is
+    deterministic: the LB's event loop admits serially); the 4
+    overflows get an immediate honest 503 + Retry-After. When the
+    fleet wakes, every parked request is served 200 — zero 5xx for
+    the protected (parked) class across the cold start."""
+    lb, base, reg = _make_lb([], monkeypatch,
+                             SKYT_LB_SURGE_QUEUE_MAX='4',
+                             SKYT_LB_NO_REPLICA_POLL_S='0.05',
+                             SKYT_LB_NO_REPLICA_TIMEOUT_S='60')
+    outcome, depth = _surge_metrics(reg, lb)
+    results, lock = [], threading.Lock()
+
+    def one():
+        r = requests.get(base + '/g', timeout=60)
+        with lock:
+            results.append((r.status_code, r.headers.get('Retry-After')))
+
+    threads = [threading.Thread(target=one) for _ in range(8)]
+    for th in threads:
+        th.start()
+    # The crowd splits 4 parked / 4 overflowed before any wake.
+    _wait_gauge(depth, 4, timeout=20)
+    deadline = time.time() + 20
+    while time.time() < deadline and outcome('overflow') < 4:
+        time.sleep(0.05)
+    assert outcome('overflow') == 4
+    # Fleet wakes: one replica appears (controller sync, simulated).
+    lb.policy.set_ready_replicas([_ok_replica('cold')])
+    for th in threads:
+        th.join(timeout=60)
+    assert len(results) == 8
+    served = [r for r in results if r[0] == 200]
+    rejected = [r for r in results if r[0] == 503]
+    assert len(served) == 4 and len(rejected) == 4, results
+    # Every overflow carried an actionable Retry-After.
+    assert all(ra is not None and float(ra) >= 1.0
+               for _, ra in rejected), rejected
+    assert outcome('served') == 4 and outcome('timeout') == 0
+    _wait_gauge(depth, 0)
+
+
+def _wait_reshard_phase(cport, token, phases, timeout=180):
+    headers = {'Authorization': f'Bearer {token}'}
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            last = requests.get(
+                f'http://127.0.0.1:{cport}/controller/status',
+                headers=headers, timeout=10).json()
+            rs = last.get('reshard') or {}
+            if rs.get('phase') in phases:
+                return last
+        except requests.RequestException:
+            pass
+        time.sleep(0.3)
+    raise AssertionError(
+        f'reshard never reached {phases}: '
+        f'{(last or {}).get("reshard")}')
+
+
+@pytest.mark.integration
+def test_chaos_reshard_rollback_and_controller_sigkill(
+        control_plane_env, monkeypatch):
+    """THE mid-reshard chaos drill (docs/robustness.md "Elastic
+    capacity"): 2 REAL engine replicas behind the real controller +
+    an in-process LB.
+
+    Run 1 (clean): an in-place reshard 1 -> 2 virtual nodes lands
+    fleet-wide mid-burst — zero client-visible 5xx, zero relaunches,
+    weight_version untouched.
+
+    Run 2 (faulted): `reshard=error` armed on target 4 — every
+    replica refuses, the orchestrator rolls back automatically, the
+    mid-burst traffic still sees zero 5xx and the fleet keeps the
+    old layout.
+
+    Run 3 (SIGKILL mid-reshard): the controller is SIGKILLed while a
+    replica's reshard POST is in flight. Reshard state is in-memory
+    BY DESIGN: the restarted controller adopts both replicas (zero
+    relaunches), reports no reshard, the mixed-layout fleet keeps
+    serving 200s, and re-issuing the reshard converges — the
+    already-flipped replica no-ops (idempotent re-assert)."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    tmp_path = control_plane_env
+    # where= keys on the reshard target, so each run picks its fault:
+    # target 4 errors (run 2); target 1 stalls 2.5s (run 3's kill
+    # window + the idempotent re-assert). Inherited by the replica
+    # processes at launch.
+    monkeypatch.setenv('SKYT_FAULTS',
+                       'reshard=error,where=virtual_nodes:4;'
+                       'reshard=latency,arg=2.5,where=virtual_nodes:1')
+    monkeypatch.setenv('SKYT_ROLLOUT_RETRIES', '2')
+    task = sky.Task(name='esvc', run=_ENGINE_REPLICA)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    spec = spec_lib.ServiceSpec(
+        readiness_path='/health', min_replicas=2,
+        initial_delay_seconds=600, probe_timeout_seconds=5)
+    task.service = spec
+    task_yaml = str(tmp_path / 'esvc.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(task.to_yaml_config(), f)
+    cport, lport = _free_port(), _free_port()
+    assert serve_state.add_service('esvc', spec, task_yaml, cport,
+                                   lport)
+    token = serve_state.get_service('esvc')['auth_token']
+    headers = {'Authorization': f'Bearer {token}'}
+    curl = f'http://127.0.0.1:{cport}'
+
+    ctrl = _spawn_service('esvc', 'controller')
+    lb = None
+    try:
+        _wait_replicas_ready('esvc', 2, timeout=420)
+        reg = metrics_lib.MetricsRegistry()
+        lb_port = _free_port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            curl, lb_port, controller_auth=token,
+            metrics_registry=reg)
+        _run_app_bg(lb.make_app(), lb_port)
+        base = f'http://127.0.0.1:{lb_port}'
+        deadline = time.time() + 120
+        while time.time() < deadline and \
+                len(lb.policy.ready_replicas) < 2:
+            time.sleep(0.2)
+        assert len(lb.policy.ready_replicas) == 2
+
+        def replica_stats():
+            status = requests.get(curl + '/controller/status',
+                                  headers=headers, timeout=10).json()
+            out = {}
+            for rep in status['replicas']:
+                stats = requests.get(rep['endpoint'] + '/stats',
+                                     timeout=30).json()
+                out[rep['replica_id']] = (stats['virtual_nodes'],
+                                          stats['weight_version'])
+            return out
+
+        assert set(replica_stats().values()) == {(1, 1)}
+
+        results = []
+        stop_burst = threading.Event()
+        lock = threading.Lock()
+
+        def burst():
+            i = 0
+            while not stop_burst.is_set():
+                i += 1
+                try:
+                    r = requests.post(
+                        base + '/generate',
+                        json={'tokens': [1 + (i % 5), 2, 3],
+                              'max_tokens': 6},
+                        timeout=120)
+                    code = r.status_code
+                except requests.RequestException as e:
+                    code = f'EXC:{e!r}'
+                with lock:
+                    results.append(code)
+
+        def run_burst_during(fn):
+            results.clear()
+            stop_burst.clear()
+            threads = [threading.Thread(target=burst)
+                       for _ in range(2)]
+            for th in threads:
+                th.start()
+            try:
+                out = fn()
+            finally:
+                time.sleep(0.5)
+                stop_burst.set()
+                for th in threads:
+                    th.join(timeout=120)
+            with lock:
+                codes = list(results)
+            assert codes and all(c == 200 for c in codes), codes[:20]
+            return out
+
+        # ---- run 1: clean elastic flip 1 -> 2, mid-burst.
+        def clean_flip():
+            resp = requests.post(curl + '/controller/reshard',
+                                 json={'virtual_nodes': 2},
+                                 headers=headers, timeout=30)
+            assert resp.status_code == 200, resp.text
+            return _wait_reshard_phase(cport, token, ('done',),
+                                       timeout=120)
+
+        status = run_burst_during(clean_flip)
+        assert status['reshard']['phase'] == 'done'
+        # Layout flipped fleet-wide; the weights plane untouched.
+        assert set(replica_stats().values()) == {(2, 1)}
+
+        # ---- run 2: the armed fault refuses target 4 -> rollback.
+        def faulted_flip():
+            resp = requests.post(curl + '/controller/reshard',
+                                 json={'virtual_nodes': 4},
+                                 headers=headers, timeout=30)
+            assert resp.status_code == 200, resp.text
+            return _wait_reshard_phase(cport, token, ('rolled_back',),
+                                       timeout=120)
+
+        status = run_burst_during(faulted_flip)
+        rs = status['reshard']
+        assert rs['phase'] == 'rolled_back'
+        assert 'replica' in (rs['error'] or '')
+        # Old layout intact everywhere; still zero relaunches.
+        assert set(replica_stats().values()) == {(2, 1)}
+        mtext = requests.get(curl + '/controller/metrics',
+                             headers=headers, timeout=10).text
+        assert 'skyt_serve_replica_launches_total{service="esvc"} 2' \
+            in mtext, mtext
+        assert ('skyt_serve_reshards_total{service="esvc",'
+                'outcome="done"} 1') in mtext
+        assert ('skyt_serve_reshards_total{service="esvc",'
+                'outcome="rolled_back"} 1') in mtext
+
+        # ---- run 3: SIGKILL mid-reshard (target 1 stalls 2.5s per
+        # replica call — the kill lands inside the first POST).
+        resp = requests.post(curl + '/controller/reshard',
+                             json={'virtual_nodes': 1},
+                             headers=headers, timeout=30)
+        assert resp.status_code == 200, resp.text
+        _wait_reshard_phase(cport, token, ('reshard',), timeout=30)
+        time.sleep(1.0)
+        ctrl.kill()
+        ctrl.wait(timeout=30)
+
+        ctrl = _spawn_service('esvc', 'controller')
+        _wait_replicas_ready('esvc', 2, timeout=120)
+        deadline = time.time() + 60
+        status = None
+        while time.time() < deadline:
+            try:
+                status = requests.get(curl + '/controller/status',
+                                      headers=headers,
+                                      timeout=10).json()
+                break
+            except requests.RequestException:
+                time.sleep(0.3)
+        assert status is not None
+        # In-memory by design: the restarted controller has no
+        # reshard; the replicas were adopted, not relaunched.
+        assert status['reshard'] is None
+        mtext = requests.get(curl + '/controller/metrics',
+                             headers=headers, timeout=10).text
+        assert ('skyt_serve_replica_adoptions_total{service="esvc"} '
+                '2') in mtext, mtext
+        assert 'skyt_serve_replica_launches_total{service="esvc"}' \
+            not in mtext, mtext
+        # Mixed layouts are fine to serve: zero 5xx either way.
+        for i in range(4):
+            r = requests.post(base + '/generate',
+                              json={'tokens': [2 + i, 3, 4],
+                                    'max_tokens': 4},
+                              timeout=120)
+            assert r.status_code == 200, r.text
+        # Re-issue: the operator's recovery lever. The already-
+        # flipped replica no-ops; the straggler flips.
+        resp = requests.post(curl + '/controller/reshard',
+                             json={'virtual_nodes': 1},
+                             headers=headers, timeout=30)
+        assert resp.status_code == 200, resp.text
+        _wait_reshard_phase(cport, token, ('done',), timeout=120)
+        assert set(replica_stats().values()) == {(1, 1)}
+    finally:
+        if ctrl.poll() is None:
+            try:
+                requests.post(curl + '/controller/terminate', json={},
+                              headers=headers, timeout=60)
+            except requests.RequestException:
+                pass
+            ctrl.kill()
+        del lb
+
+
+@pytest.mark.integration
+def test_chaos_scale_provision_latency_surge_honesty(
+        control_plane_env, monkeypatch):
+    """THE surge-honesty drill: provisioning of the only replica is
+    stalled (`scale.provision=latency`) while a client arrives — the
+    request parks in the surge queue and gets a BOUNDED honest
+    503 + Retry-After (never a silent hang). Once the stalled launch
+    completes, traffic serves and the cold start is attributed:
+    skyt_serve_cold_starts_total{kind="wake_from_zero"} with
+    cold-start seconds covering the stall."""
+    import yaml as yaml_lib
+
+    import skypilot_tpu as sky
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    tmp_path = control_plane_env
+    monkeypatch.setenv('SKYT_FAULTS',
+                       'scale.provision=latency,arg=6,count=1')
+    monkeypatch.setenv('SKYT_LB_NO_REPLICA_TIMEOUT_S', '2')
+    monkeypatch.setenv('SKYT_LB_NO_REPLICA_POLL_S', '0.1')
+    task = sky.Task(name='zsvc', run=_ADMIN_FAKE_REPLICA)
+    task.set_resources(resources_lib.Resources(cloud='local'))
+    spec = spec_lib.ServiceSpec(
+        readiness_path='/', min_replicas=1, initial_delay_seconds=60,
+        probe_timeout_seconds=2)
+    task.service = spec
+    task_yaml = str(tmp_path / 'zsvc.task.yaml')
+    with open(task_yaml, 'w', encoding='utf-8') as f:
+        yaml_lib.safe_dump(task.to_yaml_config(), f)
+    cport = _free_port()
+    assert serve_state.add_service('zsvc', spec, task_yaml, cport,
+                                   _free_port())
+    token = serve_state.get_service('zsvc')['auth_token']
+    headers = {'Authorization': f'Bearer {token}'}
+    curl = f'http://127.0.0.1:{cport}'
+
+    ctrl = _spawn_service('zsvc', 'controller')
+    lb = None
+    try:
+        reg = metrics_lib.MetricsRegistry()
+        lb_port = _free_port()
+        lb = lb_lib.SkyServeLoadBalancer(
+            curl, lb_port, controller_auth=token,
+            metrics_registry=reg)
+        _run_app_bg(lb.make_app(), lb_port)
+        base = f'http://127.0.0.1:{lb_port}'
+        _wait_http(base + '/metrics', timeout=30)
+        outcome, _depth = _surge_metrics(reg, lb)
+
+        # The flash arrival during the stalled provision: parked,
+        # then honestly rejected within the bounded window.
+        t0 = time.time()
+        r = requests.get(base + '/g', timeout=20)
+        elapsed = time.time() - t0
+        assert r.status_code == 503, r.text
+        assert elapsed < 10, elapsed          # bounded, not a hang
+        assert float(r.headers['Retry-After']) >= 1.0
+        assert outcome('timeout') == 1
+
+        # The stalled launch eventually lands; the fleet wakes.
+        _wait_replicas_ready('zsvc', 1, timeout=180)
+        deadline = time.time() + 60
+        while time.time() < deadline and not lb.policy.ready_replicas:
+            time.sleep(0.2)
+        assert lb.policy.ready_replicas
+        r = requests.get(base + '/g', timeout=30)
+        assert r.status_code == 200
+
+        # Cold-start attribution: a wake-from-zero whose seconds
+        # include the provisioning stall.
+        mtext = requests.get(curl + '/controller/metrics',
+                             headers=headers, timeout=10).text
+        assert ('skyt_serve_cold_starts_total{service="zsvc",'
+                'kind="wake_from_zero"} 1') in mtext, mtext
+        m = re.search(r'skyt_serve_cold_start_seconds_total'
+                      r'\{service="zsvc"\} ([0-9.e+-]+)', mtext)
+        assert m is not None, mtext
+        assert float(m.group(1)) >= 5.0, m.group(1)
+    finally:
+        if ctrl.poll() is None:
+            try:
+                requests.post(curl + '/controller/terminate', json={},
+                              headers=headers, timeout=60)
+            except requests.RequestException:
+                pass
+            ctrl.kill()
+        del lb

@@ -1,0 +1,368 @@
+"""Predictive autoscaling (docs/serving.md "Elastic capacity").
+
+Forecaster half: deterministic fake-clock fits over synthetic demand
+(constant, seasonal, gappy), honest out-of-sample error tracking, the
+`forecast.fit` fault blowing the error bound (and clean fits decaying
+it back), and the bounded drop-oldest history buffer. Autoscaler
+half: the PredictiveAutoscaler wrapper — prescale raises the reactive
+target ahead of the wave, untrusted forecasts degrade to exactly the
+reactive decision, and `make_autoscaler` returns the bare reactive
+instance unless SKYT_AUTOSCALE_PREDICT=1.
+"""
+
+import pytest
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import forecast
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import faults
+from skypilot_tpu.utils import metrics as metrics_lib
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _feed(fc, buckets, per_bucket=4, start=0):
+    """`per_bucket` events in each of `buckets` consecutive 1s buckets."""
+    for b in range(start, start + buckets):
+        for i in range(per_bucket):
+            fc.observe(b + (i + 0.5) / (per_bucket + 1))
+
+
+def _forecaster(clock, **kw):
+    kw.setdefault('bucket_s', 1.0)
+    kw.setdefault('season_buckets', 5)
+    return forecast.DemandForecaster(clock=clock, **kw)
+
+
+# ------------------------------------------------------------ forecaster
+def test_constant_demand_fit_and_predict():
+    """Constant 4 req/s: the forecast converges to 4 qps at any
+    horizon, the out-of-sample error goes to ~0, and healthy() flips
+    once SKYT_FORECAST_MIN_BUCKETS completed buckets are fitted."""
+    clock = _Clock()
+    fc = _forecaster(clock)
+    assert fc.predict_qps(60.0) == 0.0       # nothing fitted yet
+    assert not fc.healthy()
+    _feed(fc, buckets=20, per_bucket=4)
+    clock.t = 20.0
+    assert fc.fit()
+    assert fc.fitted_buckets == 20
+    assert fc.rel_err is not None and fc.rel_err < 0.05
+    assert fc.healthy()
+    for horizon in (0.0, 10.0, 60.0):
+        assert fc.predict_qps(horizon) == pytest.approx(4.0, rel=0.1)
+    st = fc.status()
+    assert st['healthy'] and st['fitted_buckets'] == 20
+    assert st['dropped_points'] == 0 and st['fit_errors'] == 0
+
+
+def test_min_buckets_gate():
+    """Too little history is never trusted, even with perfect error."""
+    clock = _Clock()
+    fc = _forecaster(clock)
+    _feed(fc, buckets=4, per_bucket=4)
+    clock.t = 4.0
+    assert fc.fit()
+    assert fc.fitted_buckets == 4
+    assert not fc.healthy()      # < SKYT_FORECAST_MIN_BUCKETS (8)
+
+
+def test_seasonal_pattern_is_learned():
+    """Alternating 8/0 demand with season=2: the seasonal component
+    separates the even-bucket forecast from the odd-bucket one."""
+    clock = _Clock()
+    fc = _forecaster(clock, season_buckets=2)
+    for b in range(20):
+        if b % 2 == 0:
+            for i in range(8):
+                fc.observe(b + (i + 0.5) / 9)
+    clock.t = 20.0
+    assert fc.fit()
+    high = fc.predict_qps(0.0)    # bucket 20: even slot
+    low = fc.predict_qps(1.0)     # bucket 21: odd slot
+    assert high > low + 2.0, (high, low)
+
+
+def test_gaps_fold_as_zero_demand():
+    """Silence is data: a gap folds in as true zero-demand buckets, so
+    the level decays instead of freezing at the last busy bucket."""
+    clock = _Clock()
+    fc = _forecaster(clock)
+    _feed(fc, buckets=1, per_bucket=6)
+    clock.t = 10.0
+    assert fc.fit()
+    assert fc.fitted_buckets == 10    # bucket 0 busy + 9 silent
+    assert fc.predict_qps(0.0) < 1.0
+
+
+def test_incremental_fits_are_equivalent_to_one_shot():
+    """fit() called every bucket and fit() called once at the end fold
+    the same state — the fold is per-completed-bucket, not per-call."""
+    c1, c2 = _Clock(), _Clock()
+    one, inc = _forecaster(c1), _forecaster(c2)
+    _feed(one, buckets=12, per_bucket=3)
+    c1.t = 12.0
+    one.fit()
+    for b in range(12):
+        for i in range(3):
+            inc.observe(b + (i + 0.5) / 4)
+        c2.t = b + 1.0
+        inc.fit()
+    assert inc.fitted_buckets == one.fitted_buckets == 12
+    assert inc.predict_qps(5.0) == pytest.approx(one.predict_qps(5.0))
+
+
+def test_history_buffer_drop_oldest(monkeypatch):
+    """The raw-point buffer is bounded: overflow drops the OLDEST
+    points and counts them — memory is O(cap) no matter the flood."""
+    monkeypatch.setenv('SKYT_FORECAST_MAX_POINTS', '10')
+    clock = _Clock()
+    fc = _forecaster(clock)
+    for i in range(25):
+        fc.observe(float(i))
+    assert fc.dropped_points == 15
+    assert len(fc._pending) == 10
+    assert min(fc._pending) == 15.0   # oldest gone, newest kept
+    # observe_count floods respect the same cap.
+    fc.observe_count(30.0, 100)
+    assert len(fc._pending) == 10
+    assert fc.dropped_points == 115
+
+
+def test_fit_fault_blows_error_bound_then_decays_back(monkeypatch):
+    """`forecast.fit=error` degrades honestly: rel_err jumps past the
+    bound (healthy() False -> reactive fallback upstream) and decays
+    back under it only after sustained clean fits."""
+    clock = _Clock()
+    fc = _forecaster(clock)
+    _feed(fc, buckets=12, per_bucket=4)
+    clock.t = 12.0
+    assert fc.fit() and fc.healthy()
+    faults.configure('forecast.fit=error,count=1')
+    assert fc.fit() is False
+    assert fc.fit_errors == 1
+    assert fc.rel_err >= forecast.err_bound() * 4.0
+    assert not fc.healthy()
+    # Clean buckets keep arriving; the EWMA decays the blown estimate
+    # back under the bound — the degradation self-heals.
+    _feed(fc, buckets=15, per_bucket=4, start=12)
+    clock.t = 27.0
+    assert fc.fit()
+    assert fc.healthy(), fc.status()
+
+
+# ------------------------------------------- predictive autoscaler wrapper
+def _spec(**kw):
+    base = dict(readiness_path='/', min_replicas=1, max_replicas=10,
+                target_qps_per_replica=1.0, upscale_delay_seconds=300,
+                downscale_delay_seconds=300)
+    base.update(kw)
+    return spec_lib.ServiceSpec(**base)
+
+
+def _predictive(monkeypatch, clock, spec=None):
+    monkeypatch.setenv('SKYT_FORECAST_BUCKET_S', '1')
+    monkeypatch.setenv('SKYT_FORECAST_SEASON_BUCKETS', '5')
+    reg = metrics_lib.MetricsRegistry()
+    inner = autoscalers.RequestRateAutoscaler(spec or _spec())
+    return autoscalers.PredictiveAutoscaler(
+        inner, metrics_registry=reg, clock=clock), inner, reg
+
+
+def test_prescale_raises_target_ahead_of_reactive(monkeypatch):
+    """A trusted 4-qps forecast prescales to 4 replicas while the
+    reactive path (long upscale delay, stale window) still says 1 —
+    and the reactive state is synced so it reasons from the new
+    target."""
+    clock = _Clock()
+    a, inner, reg = _predictive(monkeypatch, clock)
+    ts = [b + (i + 0.5) / 5 for b in range(12) for i in range(4)]
+    a.collect_request_timestamps(ts)
+    clock.t = 12.0
+    d = a.evaluate_scaling(num_ready=1)
+    assert d.target_num_replicas == 4, d
+    assert 'prescale' in d.reason
+    assert inner.target_num_replicas == 4
+    assert a.last_decision['kind'] == 'prescale'
+    dec = reg.counter('skyt_autoscaler_forecast_decisions_total', '',
+                      ('decision',))
+    assert dec.value('prescale') == 1
+    assert reg.gauge('skyt_autoscaler_forecast_mode', '').value() == 1
+    st = a.status()
+    assert st['mode'] == 'predictive'
+    assert st['forecast']['qps_at_lead'] == pytest.approx(4.0, rel=0.1)
+    assert 'total' in st['forecast']['curves']
+
+
+def test_untrusted_forecast_degrades_to_reactive(monkeypatch):
+    """Insufficient history: the decision IS the inner reactive
+    decision, counted as reactive_fallback with mode gauge 0."""
+    clock = _Clock()
+    a, inner, reg = _predictive(monkeypatch, clock)
+    a.collect_request_timestamps([0.1, 0.2])   # 1 completed bucket
+    clock.t = 2.0
+    d = a.evaluate_scaling(num_ready=1)
+    assert d.target_num_replicas == inner.target_num_replicas == 1
+    dec = reg.counter('skyt_autoscaler_forecast_decisions_total', '',
+                      ('decision',))
+    assert dec.value('reactive_fallback') == 1
+    assert reg.gauge('skyt_autoscaler_forecast_mode', '').value() == 0
+    assert a.status()['mode'] == 'reactive'
+
+
+def test_fit_fault_forces_reactive_and_counts(monkeypatch):
+    """An injected forecast.fit failure on an otherwise-healthy
+    forecaster degrades THAT evaluation to reactive and lands in
+    skyt_autoscaler_forecast_fit_errors_total."""
+    clock = _Clock()
+    a, _inner, reg = _predictive(monkeypatch, clock)
+    ts = [b + (i + 0.5) / 5 for b in range(12) for i in range(4)]
+    a.collect_request_timestamps(ts)
+    clock.t = 12.0
+    assert a.evaluate_scaling(1).target_num_replicas == 4
+    faults.configure('forecast.fit=error,count=1')
+    d = a.evaluate_scaling(num_ready=4)
+    assert d.target_num_replicas == 4   # reactive target, pre-synced
+    dec = reg.counter('skyt_autoscaler_forecast_decisions_total', '',
+                      ('decision',))
+    assert dec.value('reactive_fallback') == 1
+    errs = reg.counter('skyt_autoscaler_forecast_fit_errors_total', '')
+    assert errs.value() == 1
+
+
+def test_dropped_points_land_in_metrics(monkeypatch):
+    monkeypatch.setenv('SKYT_FORECAST_MAX_POINTS', '8')
+    clock = _Clock()
+    a, _inner, reg = _predictive(monkeypatch, clock)
+    a.collect_request_timestamps([float(i) / 10 for i in range(30)])
+    clock.t = 3.0
+    a.evaluate_scaling(1)
+    dropped = reg.counter(
+        'skyt_autoscaler_forecast_dropped_points_total', '')
+    assert dropped.value() == 22
+    # Delta-folded: a second tick with no new drops adds nothing.
+    a.evaluate_scaling(1)
+    assert dropped.value() == 22
+
+
+def test_forecast_never_lowers_the_target(monkeypatch):
+    """Safety contract: predictive only RAISES. A forecast below the
+    reactive target is a hold, not a downscale."""
+    clock = _Clock()
+    spec = _spec(min_replicas=3)
+    a, inner, reg = _predictive(monkeypatch, clock, spec=spec)
+    ts = [b + (i + 0.5) / 3 for b in range(12) for i in range(2)]
+    a.collect_request_timestamps(ts)    # 2 qps < min_replicas 3
+    clock.t = 12.0
+    d = a.evaluate_scaling(num_ready=3)
+    assert d.target_num_replicas == 3
+    dec = reg.counter('skyt_autoscaler_forecast_decisions_total', '',
+                      ('decision',))
+    assert dec.value('hold') == 1
+    assert inner.target_num_replicas == 3
+
+
+def test_fleet_ring_fallback_intake(monkeypatch):
+    """With no LB delivering raw timestamps, demand is synthesized
+    from the fleet rollup's skyt_lb_requests_total delta; the first
+    direct timestamp batch switches intake off the fleet path."""
+    class _FakeFleet:
+        def __init__(self):
+            self.calls = 0
+
+        def sum_delta(self, name, labels, window, now=None):
+            del name, labels, window, now
+            self.calls += 1
+            return 12.0
+
+    clock = _Clock()
+    monkeypatch.setenv('SKYT_FORECAST_BUCKET_S', '1')
+    reg = metrics_lib.MetricsRegistry()
+    inner = autoscalers.RequestRateAutoscaler(_spec())
+    a = autoscalers.PredictiveAutoscaler(inner, fleet=_FakeFleet(),
+                                         metrics_registry=reg,
+                                         clock=clock)
+    a.evaluate_scaling(1)         # first tick only arms the window
+    clock.t = 1.0
+    a.evaluate_scaling(1)
+    assert a._curves['total'].fitted_buckets + \
+        len(a._curves['total']._pending) >= 12
+    a.collect_request_timestamps([1.5])
+    clock.t = 2.0
+    fleet = a._fleet
+    before = fleet.calls
+    a.evaluate_scaling(1)
+    assert fleet.calls == before  # direct timestamps win
+
+
+def test_qos_class_curves_feed_weighted_forecast(monkeypatch):
+    """collect_qos tees per-class curves; once a class curve is
+    healthy the forecast is the weight-combined class sum (batch
+    discounted), visible per class in the qps gauge."""
+    clock = _Clock()
+    a, _inner, reg = _predictive(monkeypatch, clock)
+    demand = [(b + (i + 0.5) / 5, 'interactive')
+              for b in range(12) for i in range(4)]
+    a.collect_qos(demand, sheds=[])
+    # The trusted gate rides the TOTAL curve — feed it too (the real
+    # LB sync always delivers both streams).
+    a.collect_request_timestamps([t for t, _ in demand])
+    clock.t = 12.0
+    a.evaluate_scaling(1)
+    assert 'interactive' in a._curves
+    qps = reg.gauge('skyt_autoscaler_forecast_qps', '', ('class',))
+    assert qps.value('interactive') == pytest.approx(4.0, rel=0.15)
+
+
+def test_make_autoscaler_gating(monkeypatch):
+    """SKYT_AUTOSCALE_PREDICT unset/0 -> the bare reactive instance
+    (byte-for-byte existing behavior); =1 -> the predictive wrapper
+    around the same pick."""
+    monkeypatch.delenv('SKYT_AUTOSCALE_PREDICT', raising=False)
+    a = autoscalers.make_autoscaler(_spec())
+    assert isinstance(a, autoscalers.RequestRateAutoscaler)
+    monkeypatch.setenv('SKYT_AUTOSCALE_PREDICT', '0')
+    a = autoscalers.make_autoscaler(_spec())
+    assert isinstance(a, autoscalers.RequestRateAutoscaler)
+    monkeypatch.setenv('SKYT_AUTOSCALE_PREDICT', '1')
+    a = autoscalers.make_autoscaler(_spec())
+    assert isinstance(a, autoscalers.PredictiveAutoscaler)
+    assert isinstance(a.inner, autoscalers.RequestRateAutoscaler)
+    st = a.status()
+    assert st['class'].startswith('Predictive(')
+
+
+def test_reactive_status_has_mode_and_last_decision():
+    """The base reactive autoscaler self-reports for `serve status` /
+    /controller/status even without the predictive wrapper."""
+    a = autoscalers.RequestRateAutoscaler(_spec())
+    st = a.status()
+    assert st['mode'] == 'reactive'
+    assert st['target_num_replicas'] == 1
+    a.evaluate_scaling(1)
+    assert a.status()['last_decision'] is not None
+
+
+def test_target_ceiling_respects_max_replicas(monkeypatch):
+    """A huge forecast clamps at max_replicas, never past it."""
+    clock = _Clock()
+    a, _inner, _reg = _predictive(
+        monkeypatch, clock, spec=_spec(max_replicas=3))
+    ts = [b + (i + 0.5) / 41 for b in range(12) for i in range(40)]
+    a.collect_request_timestamps(ts)
+    clock.t = 12.0
+    d = a.evaluate_scaling(1)
+    assert d.target_num_replicas == 3

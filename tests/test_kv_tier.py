@@ -514,3 +514,107 @@ def test_tier_off_leaves_engine_untouched(kv_setup, monkeypatch):
     # Bad values degrade to off with a warning, never a crash.
     monkeypatch.setenv('SKYT_KV_TIER', 'warp-drive')
     assert kv_tier_lib.tier_from_env() == 'off'
+
+
+# -------------------------------------- scale-up prewarm (ROADMAP 5c)
+class TestPrewarm:
+    """Proactive KV pre-warm on scale-up: a freshly READY replica
+    pulls its rendezvous share of the fleet's resident prefix pages
+    into the host store (docs/serving.md "Elastic capacity")."""
+
+    def _mgr(self):
+        return kv_tier_lib.KVTierManager('fleet', host_bytes=1 << 20,
+                                         fetch_max_pages=1,
+                                         fetch_timeout_s=1.0)
+
+    def test_prewarm_claims_exactly_the_owned_share(self, monkeypatch):
+        """Ownership is the same rendezvous-ring math the LB's
+        prefix-affinity routing uses: the replica fetches the batches
+        the ring ranks it first for — no more, no less — and they land
+        in the host store under the prewarm counter."""
+        from skypilot_tpu.serve import load_balancing_policies as \
+            lb_policies
+        mgr = self._mgr()
+        hashes = [_h(i) for i in range(40)]
+        monkeypatch.setattr(
+            kv_tier_lib, 'fetch_index',
+            lambda peer, token, timeout_s: (1, list(hashes)))
+        monkeypatch.setattr(
+            kv_tier_lib, 'fetch_pages',
+            lambda peer, hs, token, timeout_s, max_pages:
+            (1, [(h, _arrays()) for h in hs]))
+        me, peer = 'http://127.0.0.1:9001', 'http://127.0.0.1:9002'
+        res = mgr.prewarm_from_peers(me, [peer], 1, 'tok')
+        ring = lb_policies.ConsistentHashRing()
+        ring.set_nodes({me: 1.0, peer: 1.0})
+        expected = [h for h in hashes if ring.owner(h.hex()) == me]
+        # The split is real: both replicas own a nonempty share.
+        assert 0 < len(expected) < len(hashes)
+        assert res['owned_pages'] == res['stored_pages'] == \
+            len(expected)
+        assert res['errors'] == 0 and res['peers'] == 1
+        assert mgr.stats['prewarm_pages'] == len(expected)
+        assert all(mgr.host.contains(h, 1) for h in expected)
+        assert not any(mgr.host.contains(h, 1)
+                       for h in hashes if h not in expected)
+        # A self-entry in the peer list is skipped, not fetched.
+        res2 = self._mgr().prewarm_from_peers(me, [me], 1, 'tok')
+        assert res2 == {'peers': 1, 'owned_pages': 0,
+                        'stored_pages': 0, 'errors': 0}
+
+    def test_prewarm_failures_counted_never_raised(self, monkeypatch):
+        """Best-effort contract: version-mismatched peers and kv.fetch
+        faults are counted and skipped — a failed prewarm costs
+        recomputes, never readiness (and never an exception)."""
+        mgr = self._mgr()
+        # Peer on another weight version: its KV must never splice in.
+        monkeypatch.setattr(
+            kv_tier_lib, 'fetch_index',
+            lambda peer, token, timeout_s: (2, [_h(1)]))
+        res = mgr.prewarm_from_peers('http://a:1', ['http://b:2'],
+                                     1, 'tok')
+        assert res['errors'] == 1 and res['stored_pages'] == 0
+        assert len(mgr.host) == 0
+        # The shared kv.fetch fault point breaks prewarm the same way
+        # it breaks demand fetches: degrade, count, carry on.
+        monkeypatch.undo()
+        faults.reset()
+        faults.configure('kv.fetch=error')
+        try:
+            res = mgr.prewarm_from_peers('http://a:1',
+                                         ['http://b:2',
+                                          'http://c:3'], 1, 'tok')
+        finally:
+            faults.reset()
+        assert res['errors'] == 2 and res['stored_pages'] == 0
+
+
+@pytest.mark.integration
+def test_kv_index_inventory_roundtrip(kv_setup, monkeypatch):
+    """engine.kv_index() snapshots the resident inventory at a tick
+    boundary: HBM registry pages first, host-store continuations
+    deduplicated in, weight version stamped — the /kv/index body peers
+    batch their prewarm claims over."""
+    eng, _ = _make_engine(kv_setup, monkeypatch, tier='host')
+    eng.start()
+    try:
+        prompt = _prompt(0)
+        _gen(eng, prompt)
+        idx = eng.kv_index()
+        assert idx is not None
+        assert idx['weight_version'] == eng.weight_version == 1
+        h0 = paged_cache.page_hashes(prompt,
+                                     eng.pool.cfg.page_size)[0]
+        assert h0.hex() in idx['hashes']
+        assert len(set(idx['hashes'])) == len(idx['hashes'])
+        # Host-only pages (evicted from HBM) stay in the inventory.
+        _fill_until_evicted(eng, prompt)
+        idx2 = eng.kv_index()
+        assert h0.hex() in idx2['hashes']
+        # A host-tier engine refuses the prewarm pull itself (fleet
+        # transfers are the fleet tier's contract) — explicitly, not
+        # with an error.
+        res = eng.kv_prewarm('http://me:1', ['http://peer:2'], 'tok')
+        assert res['skipped'] and res['stored_pages'] == 0
+    finally:
+        eng.stop()

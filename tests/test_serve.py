@@ -460,3 +460,74 @@ def test_replica_stats_scrape(tmp_state_dir):
     # No server at all -> None, not an exception.
     info.endpoint = 'http://127.0.0.1:1'
     assert mgr._fetch_stats(info) is None
+
+
+def test_cold_start_attribution_and_prewarm(tmp_state_dir, monkeypatch):
+    """First-READY fires cold-start attribution exactly once per
+    replica: kind wake_from_zero when no other replica was READY,
+    scale_up otherwise, seconds = launch -> first READY. With
+    SKYT_SERVE_PREWARM=1 the new replica is asked to pre-warm its KV
+    from the already-READY peers (daemon push, injectable transport);
+    off by default."""
+    import threading
+
+    class _Telemetry:
+        def __init__(self):
+            self.cold = []
+
+        def note_cold_start(self, kind, seconds):
+            self.cold.append((kind, seconds))
+
+    tel = _Telemetry()
+    spec = spec_lib.ServiceSpec(readiness_path='/health')
+    mgr = replica_managers.ReplicaManager('cold-svc', spec,
+                                          task_yaml='/dev/null',
+                                          telemetry=tel)
+    prewarms = []
+    done = threading.Event()
+
+    def fake_prewarm(info, peers):
+        prewarms.append((info.replica_id, list(peers)))
+        done.set()
+        return True, None
+
+    mgr._prewarm_fn = fake_prewarm  # pylint: disable=protected-access
+    now = time.time()
+
+    def _ready(rid):
+        info = replica_managers.ReplicaInfo(
+            replica_id=rid, cluster_name=f'c-{rid}', version=1,
+            status=serve_state.ReplicaStatus.READY,
+            endpoint=f'http://127.0.0.1:{9100 + rid}',
+            launched_at=now - 5.0, first_ready_at=now)
+        mgr.replicas[rid] = info
+        return info
+
+    # Fleet was scaled to zero: the first arrival is the wake.
+    monkeypatch.delenv('SKYT_SERVE_PREWARM', raising=False)
+    mgr._note_first_ready(_ready(1))  # pylint: disable=protected-access
+    assert tel.cold == [('wake_from_zero', pytest.approx(5.0, abs=1.0))]
+    assert not prewarms                # prewarm is opt-in
+    # A second replica joins a serving fleet: scale_up.
+    mgr._note_first_ready(_ready(2))  # pylint: disable=protected-access
+    assert tel.cold[-1][0] == 'scale_up'
+    # Opt in: the NEW replica pulls from the already-READY peers.
+    monkeypatch.setenv('SKYT_SERVE_PREWARM', '1')
+    mgr._note_first_ready(_ready(3))  # pylint: disable=protected-access
+    assert done.wait(10)
+    assert prewarms == [(3, ['http://127.0.0.1:9101',
+                             'http://127.0.0.1:9102'])]
+    assert tel.cold[-1][0] == 'scale_up'
+    # The fleet capacity report attributes the burned chip-seconds.
+    from skypilot_tpu.serve import fleet as fleet_lib
+    from skypilot_tpu.utils import metrics as metrics_lib
+    monkeypatch.setenv('SKYT_FLEET_CHIPS_PER_REPLICA', '4')
+    ft = fleet_lib.FleetTelemetry(
+        'cold-svc', metrics_registry=metrics_lib.MetricsRegistry())
+    for kind, seconds in tel.cold:
+        ft.note_cold_start(kind, seconds)
+    rep = ft.capacity_report()
+    assert rep['cold_start']['count'] == {'wake_from_zero': 1,
+                                          'scale_up': 2}
+    assert rep['cold_start']['chip_seconds'] == \
+        pytest.approx(3 * 5.0 * 4, rel=0.3)

@@ -398,6 +398,249 @@ def test_admin_weights_route_contract(debug_setup, monkeypatch):
         eng.stop()
 
 
+# ------------------------------------------------- elastic reshard
+def test_reshard_changes_layout_not_weights(debug_setup):
+    """In-place reshard (docs/robustness.md "Elastic capacity"): the
+    virtual-node layout moves at a tick boundary, the weight VALUES
+    and VERSION do not — outputs are identical before/after, and the
+    layout lands in the gauge + result metrics. reshard_back restores
+    the replaced layout."""
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    eng.start()
+    try:
+        golden = _gen(eng, [1, 2, 3])
+        mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+        assert eng.virtual_nodes == 1
+        res = mgr.reshard(2)
+        assert res['ok'] and res['virtual_nodes'] == 2, res
+        assert res['from_nodes'] == 1 and not res['reshard_back']
+        assert res['weight_version'] == 1
+        assert eng.virtual_nodes == 2
+        assert eng.weight_version == 1     # version did NOT move
+        assert _gen(eng, [1, 2, 3]) == golden   # same weights
+        text = reg.expose()
+        assert 'skyt_infer_virtual_nodes 2' in text
+        assert 'skyt_infer_reshards_total{result="ok"} 1' in text
+        assert 'skyt_infer_reshard_seconds_count 1' in text
+        info = mgr.info()
+        assert info['virtual_nodes'] == 2
+        assert info['reshard_back_available']
+        assert info['last_reshard']['ok']
+        back = mgr.reshard_back()
+        assert back['ok'] and back['virtual_nodes'] == 1
+        assert back['reshard_back']
+        assert eng.virtual_nodes == 1
+        assert _gen(eng, [1, 2, 3]) == golden
+    finally:
+        eng.stop()
+
+
+def test_reshard_noop_is_idempotent(debug_setup):
+    """Re-asserting the current layout is an ok no-op (the controller
+    retries through restarts) and retains no rollback history."""
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+    res = mgr.reshard(1)
+    assert res['ok'] and res.get('noop')
+    assert eng.virtual_nodes == 1
+    with pytest.raises(weight_swap.WeightSwapError):
+        mgr.reshard_back()      # nothing was replaced
+
+
+def test_reshard_validation_rejects(debug_setup):
+    """Bad layouts are rejected BEFORE anything is staged: non-int,
+    < 1, and a target that cannot tile the mesh (neither divides the
+    other). Old layout intact in every case."""
+    import types
+
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+    for bad, needle in (('two', 'integer'), (None, 'integer'),
+                        (0, '>= 1'), (-3, '>= 1')):
+        with pytest.raises(weight_swap.WeightSwapError) as ei:
+            mgr.reshard(bad)
+        assert needle in str(ei.value), (bad, str(ei.value))
+    eng.mesh = types.SimpleNamespace(size=4)
+    with pytest.raises(weight_swap.WeightSwapError) as ei:
+        mgr.reshard(3)          # 3 vs 4: neither divides the other
+    assert 'tile' in str(ei.value)
+    assert eng.virtual_nodes == 1
+    assert mgr.last_reshard is not None and not mgr.last_reshard['ok']
+
+
+def test_reshard_fault_error_aborts_with_old_layout(debug_setup):
+    """`reshard=error` aborts with the old layout intact and lands in
+    skyt_infer_reshards_total{result="aborted"}; a clean retry then
+    succeeds."""
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    eng.start()
+    try:
+        golden = _gen(eng, [4, 5, 6])
+        mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+        faults.configure('reshard=error,count=1')
+        with pytest.raises(weight_swap.WeightSwapError) as ei:
+            mgr.reshard(2)
+        assert 'old layout intact' in str(ei.value)
+        assert eng.virtual_nodes == 1
+        assert _gen(eng, [4, 5, 6]) == golden
+        assert 'skyt_infer_reshards_total{result="aborted"} 1' \
+            in reg.expose()
+        assert not mgr.last_reshard['ok']
+        res = mgr.reshard(2)    # fault exhausted: clean retry lands
+        assert res['ok'] and eng.virtual_nodes == 2
+    finally:
+        eng.stop()
+
+
+def test_reshard_shares_swap_single_flight(debug_setup):
+    """One flight lock for the whole staging surface: a hung reshard
+    409s BOTH a concurrent reshard and a concurrent weight swap (they
+    ride the same engine slot and must never race)."""
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    _, _, _, p1 = debug_setup
+    mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+    faults.configure('reshard=hang,arg=1.0,count=1')
+    results = {}
+
+    def slow():
+        results['slow'] = mgr.reshard(2)
+
+    th = threading.Thread(target=slow)
+    th.start()
+    time.sleep(0.3)                    # inside the hang window
+    with pytest.raises(weight_swap.SwapInFlight):
+        mgr.reshard(4)
+    with pytest.raises(weight_swap.SwapInFlight):
+        mgr.swap(params=p1)
+    th.join(timeout=30)
+    assert results['slow']['ok']
+    assert eng.weight_version == 1     # the blocked swap never landed
+
+
+def test_reshard_preserves_swap_back_history(debug_setup):
+    """A reshard between a swap and its swap_back must not eat the
+    weight-rollback retention: swap to v2, reshard, swap_back still
+    restores v1 behavior (on the resharded layout)."""
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    _, _, _, p1 = debug_setup
+    eng.start()
+    try:
+        golden = _gen(eng, [1, 2, 3])
+        mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+        assert mgr.swap(params=p1)['weight_version'] == 2
+        assert mgr.reshard(2)['ok']
+        back = mgr.swap_back()
+        assert back['weight_version'] == 1
+        assert eng.virtual_nodes == 2  # layout survives the swap_back
+        assert _gen(eng, [1, 2, 3]) == golden
+    finally:
+        eng.stop()
+
+
+def test_reshard_flushes_prefix_cache(debug_setup):
+    """Page tiling is layout-derived: a reshard flushes the HBM prefix
+    registry conservatively (host/fleet KV tiers stay valid — same
+    weight version — and re-promote on demand)."""
+    from skypilot_tpu.infer import weight_swap
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg, cache_mode='paged',
+                       page_size=8, prefix_caching=True)
+    eng.start()
+    try:
+        prompt = list(range(1, 18))
+        _gen(eng, prompt)
+        _gen(eng, prompt)
+        assert eng.pool.prefix_cached_pages() >= 1
+        mgr = weight_swap.WeightSwapManager(eng, registry=reg)
+        res = mgr.reshard(2)
+        assert res['flushed_prefix_pages'] >= 1
+        assert eng.pool.prefix_cached_pages() == 0
+    finally:
+        eng.stop()
+
+
+def test_admin_reshard_route_contract(debug_setup, monkeypatch):
+    """403 unauthed / disabled, 400 malformed or un-tileable, 200 on a
+    real reshard, 409 concurrent, reshard_back — mirrors the
+    /admin/weights contract on the same single-flight."""
+    import requests as req_lib
+
+    from skypilot_tpu.infer import server as server_lib
+    from tests.test_chaos import _free_port, _run_app_bg, _wait_http
+    reg = metrics_lib.MetricsRegistry()
+    eng = _make_engine(debug_setup, reg)
+    eng.start()
+    try:
+        srv = server_lib.InferenceServer(eng)
+        port = _free_port()
+        _run_app_bg(srv.make_app(), port)
+        base = f'http://127.0.0.1:{port}'
+        _wait_http(base + '/health', timeout=120)
+        body = {'virtual_nodes': 2}
+        monkeypatch.delenv('SKYT_ADMIN_TOKEN', raising=False)
+        assert req_lib.post(base + '/admin/reshard', json=body,
+                            timeout=30).status_code == 403
+        monkeypatch.setenv('SKYT_ADMIN_TOKEN', 'sesame')
+        hdr = {'Authorization': 'Bearer sesame'}
+        assert req_lib.post(base + '/admin/reshard', json=body,
+                            timeout=30).status_code == 403
+        for bad in ([1], {}, {'virtual_nodes': 0},
+                    {'virtual_nodes': 'two'}, {'virtual_nodes': True},
+                    {'virtual_nodes': 2, 'drain': 'yes'}):
+            r = req_lib.post(base + '/admin/reshard', json=bad,
+                             headers=hdr, timeout=30)
+            assert r.status_code == 400, (bad, r.status_code, r.text)
+        # reshard_back before any reshard: clean 400, layout named.
+        r = req_lib.post(base + '/admin/reshard',
+                         json={'reshard_back': True}, headers=hdr,
+                         timeout=60)
+        assert r.status_code == 400 and r.json()['virtual_nodes'] == 1
+        # The real reshard.
+        r = req_lib.post(base + '/admin/reshard', json=body,
+                         headers=hdr, timeout=120)
+        assert r.status_code == 200, r.text
+        assert r.json()['virtual_nodes'] == 2
+        assert eng.virtual_nodes == 2
+        # Concurrent -> 409 (hold the flight with a hang fault).
+        faults.configure('reshard=hang,arg=1.5,count=1')
+        codes = {}
+
+        def push(name, payload):
+            codes[name] = req_lib.post(
+                base + '/admin/reshard', json=payload, headers=hdr,
+                timeout=120).status_code
+
+        t1 = threading.Thread(target=push,
+                              args=('a', {'virtual_nodes': 4}))
+        t1.start()
+        time.sleep(0.5)
+        push('b', {'virtual_nodes': 8})
+        t1.join(timeout=60)
+        faults.reset()
+        assert sorted(codes.values()) == [200, 409], codes
+        # reshard_back restores what the LAST reshard replaced.
+        r = req_lib.post(base + '/admin/reshard',
+                         json={'reshard_back': True}, headers=hdr,
+                         timeout=120)
+        assert r.status_code == 200 and r.json()['virtual_nodes'] == 2
+        stats = req_lib.get(base + '/stats', timeout=30).json()
+        assert stats['weight_version'] == 1    # never moved
+    finally:
+        eng.stop()
+
+
 # ===================================== rollout orchestrator (no HTTP)
 class _FakeTelemetry:
     def __init__(self):
@@ -710,3 +953,135 @@ def test_publish_checkpoint_atomic(tmp_path, debug_setup):
                                    param_dtype='float32',
                                    dtype='float32')
     assert cfg2.n_layers == cfg.n_layers
+
+
+# ===================================== reshard orchestrator (no HTTP)
+def _wire_reshard(rollout_mgr):
+    """Point the rollout fixture's manager at an injectable reshard
+    transport (same shape as the swap one)."""
+    mgr, spec, tel, _fake = rollout_mgr
+    calls = []
+
+    def fake_reshard(info, payload):
+        calls.append((info.replica_id, dict(payload)))
+        fail = getattr(fake_reshard, 'fail_on', None)
+        if fail and info.replica_id in fail and \
+                not payload.get('reshard_back'):
+            return False, 'injected reshard failure'
+        if getattr(fake_reshard, 'fail_back', None) and \
+                info.replica_id in fake_reshard.fail_back and \
+                payload.get('reshard_back'):
+            return False, 'injected reshard-back failure'
+        return True, None
+
+    fake_reshard.calls = calls
+    mgr._reshard_fn = fake_reshard  # pylint: disable=protected-access
+    return mgr, spec, tel, fake_reshard
+
+
+def test_reshard_orchestrator_happy_path(rollout_mgr):
+    """start -> one replica per tick in id order -> done; the fleet
+    outcome and per-call results land in the service metrics."""
+    mgr, _spec, _tel, fake = _wire_reshard(rollout_mgr)
+    st = mgr.start_reshard(4)
+    assert st['phase'] == 'reshard' and st['target_nodes'] == 4
+    mgr.reshard_tick()
+    assert mgr.reshard_status()['updated'] == [1]
+    mgr.reshard_tick()
+    mgr.reshard_tick()
+    assert mgr.reshard_status()['updated'] == [1, 2, 3]
+    mgr.reshard_tick()                     # no candidates left -> done
+    st = mgr.reshard_status()
+    assert st['phase'] == 'done' and st['error'] is None
+    assert [c[0] for c in fake.calls] == [1, 2, 3]
+    assert all(c[1] == {'virtual_nodes': 4} for c in fake.calls)
+    assert mgr._m_reshards.value('wsvc', 'done') == 1  # pylint: disable=protected-access
+    assert mgr._m_reshard_calls.value('wsvc', 'ok') == 3  # pylint: disable=protected-access
+    # Terminal state: a new reshard may start.
+    assert mgr.start_reshard(2)['phase'] == 'reshard'
+
+
+def test_reshard_orchestrator_rolls_back_newest_first(rollout_mgr,
+                                                      monkeypatch):
+    """A replica that keeps refusing the new layout burns the retry
+    budget; the already-resharded set rolls back NEWEST FIRST and the
+    run ends rolled_back with the failure named."""
+    monkeypatch.setenv('SKYT_ROLLOUT_RETRIES', '2')
+    mgr, _spec, _tel, fake = _wire_reshard(rollout_mgr)
+    fake.fail_on = {3}
+    mgr.start_reshard(2)
+    mgr.reshard_tick()                     # 1 ok
+    mgr.reshard_tick()                     # 2 ok
+    mgr.reshard_tick()                     # 3 fails (1/2)
+    assert mgr.reshard_status()['phase'] == 'reshard'
+    mgr.reshard_tick()                     # 3 fails (2/2) -> rollback
+    assert mgr.reshard_status()['phase'] == 'rollback'
+    mgr.reshard_tick()                     # rolls 2 then 1 back
+    st = mgr.reshard_status()
+    assert st['phase'] == 'rolled_back'
+    assert 'replica 3' in st['error']
+    backs = [c[0] for c in fake.calls if c[1].get('reshard_back')]
+    assert backs == [2, 1]                 # newest first
+    assert mgr._m_reshards.value('wsvc', 'rolled_back') == 1  # pylint: disable=protected-access
+    # Nobody was drained or relaunched over a layout problem.
+    from skypilot_tpu.serve import serve_state
+    assert all(r.status is serve_state.ReplicaStatus.READY
+               for r in mgr.replicas.values())
+
+
+def test_reshard_rollback_skips_stubborn_replica(rollout_mgr,
+                                                 monkeypatch):
+    """A replica that refuses even the rollback is SKIPPED (layout
+    left as-is), never drained: wrong layout is degraded throughput,
+    not an outage worth a capacity dip."""
+    monkeypatch.setenv('SKYT_ROLLOUT_RETRIES', '1')
+    mgr, _spec, _tel, fake = _wire_reshard(rollout_mgr)
+    fake.fail_on = {3}
+    fake.fail_back = {2}
+    mgr.start_reshard(2)
+    mgr.reshard_tick()                     # 1 ok
+    mgr.reshard_tick()                     # 2 ok
+    mgr.reshard_tick()                     # 3 fails -> rollback
+    assert mgr.reshard_status()['phase'] == 'rollback'
+    mgr.reshard_tick()                     # 2 refuses (1/1) -> skipped
+    mgr.reshard_tick()                     # 1 rolls back -> rolled_back
+    st = mgr.reshard_status()
+    assert st['phase'] == 'rolled_back', st
+    from skypilot_tpu.serve import serve_state
+    assert all(r.status is serve_state.ReplicaStatus.READY
+               for r in mgr.replicas.values())
+    assert mgr._m_reshard_calls.value('wsvc', 'rollback_error') >= 1  # pylint: disable=protected-access
+
+
+def test_reshard_validation_and_concurrency(rollout_mgr):
+    from skypilot_tpu import exceptions
+    mgr, _spec, _tel, _fake = _wire_reshard(rollout_mgr)
+    for bad in ('two', None, 0, -1):
+        with pytest.raises(exceptions.SkyTpuError):
+            mgr.start_reshard(bad)
+    mgr.start_reshard(2)
+    with pytest.raises(exceptions.SkyTpuError):
+        mgr.start_reshard(4)               # one at a time
+
+
+def test_reshard_and_rollout_are_mutually_exclusive(rollout_mgr):
+    """Both ride the replicas' single-flight swap slot: a reshard
+    refuses while a rollout is active, and vice versa."""
+    from skypilot_tpu import exceptions
+    mgr, spec, _tel, _fake = _wire_reshard(rollout_mgr)
+    mgr.start_rolling_update(_bump_spec(spec, '/ckpts/v2'),
+                             '/tmp/none.yaml', 2)
+    with pytest.raises(exceptions.SkyTpuError) as ei:
+        mgr.start_reshard(2)
+    assert 'rolling update' in str(ei.value)
+    # Finish the rollout, then invert the order.
+    mgr.rollout_tick()                     # canary
+    time.sleep(0.25)
+    for _ in range(4):
+        mgr.rollout_tick()
+    assert mgr.rollout_status()['phase'] == 'done'
+    mgr.start_reshard(2)
+    with pytest.raises(exceptions.SkyTpuError) as ei:
+        mgr.start_rolling_update(_bump_spec(spec, '/ckpts/v3'),
+                                 '/tmp/none.yaml', 3)
+    assert 'reshard' in str(ei.value)

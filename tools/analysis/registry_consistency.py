@@ -38,9 +38,11 @@ _METRIC_DOC_RELS = ('docs/observability.md', 'docs/qos.md',
                     'docs/robustness.md', 'docs/serving.md',
                     'docs/kernels.md', 'docs/performance.md')
 
-# Fault points are dotted (`plane.event`); the dot requirement keeps
-# the kinds table (`| error | ... |`) from matching.
-_FAULT_ROW_RE = re.compile(r'^\|\s*`([a-z0-9_]+\.[a-z0-9_.]+)`\s*\|')
+# Fault points are usually dotted (`plane.event`) but may be bare
+# (`reshard`); requiring two more table cells after the name keeps
+# the two-column kinds table (`| error | ... |`) from matching.
+_FAULT_ROW_RE = re.compile(
+    r'^\|\s*`([a-z0-9_.]+)`\s*\|[^|]*\|[^|]*\|')
 # A metric token: name chars, with {a,b} alternation groups that are
 # part of the NAME only when followed by more name chars (a trailing
 # {...} group is a label set).

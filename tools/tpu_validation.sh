@@ -1643,6 +1643,201 @@ else
     FAIL=1
 fi
 
+echo "== 20. elastic capacity drill — a scaled-to-zero wake through"
+echo "   the LB surge queue (parked class served with zero 5xx,"
+echo "   overflow gets honest 503 + Retry-After), then an in-place"
+echo "   /admin/reshard layout flip on the live replica: outputs"
+echo "   unchanged, an injected reshard fault leaves the old layout"
+echo "   intact, and re-asserting the layout is an idempotent no-op"
+echo "   (docs/robustness.md 'Elastic capacity') =="
+if SKYT_VALIDATION_OUT="$OUT" timeout 900 python - \
+        <<'PYEOF' 2>&1 | tee "$OUT/elastic_drill.txt"
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import requests
+from aiohttp import web
+
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.utils import metrics as metrics_lib
+
+OUT = os.environ['SKYT_VALIDATION_OUT']
+ART = os.path.join(OUT, 'elastic_drill.json')
+TOKEN = 'elastic-validation'
+
+
+def artifact(status, **kw):
+    rec = {'status': status, 'step': 'elastic_drill', **kw}
+    with open(ART, 'w') as f:
+        json.dump(rec, f, sort_keys=True)
+    print(f'elastic artifact: {json.dumps(rec, sort_keys=True)}')
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+rport = free_port()
+# The fault is armed per-target: only virtual_nodes=4 aborts, so the
+# same process serves the clean flip, the fault, and the no-op.
+env = dict(os.environ, SKYT_ADMIN_TOKEN=TOKEN,
+           SKYT_FAULTS='reshard=error,where=virtual_nodes:4')
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(rport),
+     '--num-slots', '2', '--max-seq-len', '64'], env=env)
+rbase = f'http://127.0.0.1:{rport}'
+try:
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        try:
+            if requests.get(rbase + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            artifact('replica_died', rc=proc.returncode)
+            raise SystemExit(f'server died rc={proc.returncode}')
+        time.sleep(1)
+    else:
+        artifact('replica_unhealthy', timeout_s=480)
+        raise SystemExit('server never became healthy')
+
+    body = {'tokens': [5, 6, 7], 'max_tokens': 6}
+    golden = requests.post(rbase + '/generate', json=body,
+                           timeout=300).json()['tokens']
+
+    # -- Scale-to-zero wake: LB with an EMPTY ready set, surge cap 4.
+    os.environ.update({'SKYT_SERVE_LB_SYNC_INTERVAL': '3600',
+                       'SKYT_LB_SURGE_QUEUE_MAX': '4',
+                       'SKYT_LB_NO_REPLICA_POLL_S': '0.05',
+                       'SKYT_LB_NO_REPLICA_TIMEOUT_S': '60'})
+    reg = metrics_lib.MetricsRegistry()
+    lport = free_port()
+    lb = lb_lib.SkyServeLoadBalancer('http://127.0.0.1:9', lport,
+                                     metrics_registry=reg)
+    threading.Thread(target=lambda: web.run_app(
+        lb.make_app(), port=lport, print=None,
+        handle_signals=False), daemon=True).start()
+    base = f'http://127.0.0.1:{lport}'
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            requests.get(base + '/metrics', timeout=2)
+            break
+        except requests.RequestException:
+            time.sleep(0.1)
+    outcomes = reg.counter('skyt_lb_surge_requests_total', '',
+                           ('lb', 'outcome'))
+    depth = reg.gauge('skyt_lb_surge_queue_depth', '', ('lb',))
+
+    results, lock = [], threading.Lock()
+
+    def arrival():
+        s2 = requests.Session()
+        t0 = time.perf_counter()
+        r = s2.post(base + '/generate', json=body, timeout=120)
+        with lock:
+            results.append((r.status_code, time.perf_counter() - t0,
+                            r.headers.get('Retry-After')))
+
+    threads = [threading.Thread(target=arrival) for _ in range(6)]
+    for th in threads:
+        th.start()
+    # 4 park (cap), 2 overflow to an immediate honest 503.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if depth.value(lb.lb_id) == 4 \
+                and outcomes.value(lb.lb_id, 'overflow') == 2:
+            break
+        time.sleep(0.05)
+    else:
+        raise SystemExit(
+            f'surge queue never settled: depth={depth.value(lb.lb_id)} '
+            f'overflow={outcomes.value(lb.lb_id, "overflow")}')
+    time.sleep(1.0)                         # the fleet cold-starts...
+    lb.policy.set_ready_replicas([rbase])   # ...and wakes
+    for th in threads:
+        th.join(timeout=120)
+    ok = [r for r in results if r[0] == 200]
+    rejected = [r for r in results if r[0] == 503]
+    assert len(ok) == 4 and len(rejected) == 2, results
+    assert all(r[2] is not None and float(r[2]) >= 1.0
+               for r in rejected), rejected
+    assert outcomes.value(lb.lb_id, 'served') == 4
+    assert outcomes.value(lb.lb_id, 'timeout') == 0
+    cold_ttft = sorted(lat for _, lat, _ in ok)[len(ok) // 2]
+
+    # -- In-place reshard on the live replica: layout flips, outputs
+    # don't.
+    hdr = {'Authorization': f'Bearer {TOKEN}'}
+    r = requests.post(rbase + '/admin/reshard',
+                      json={'virtual_nodes': 2}, headers=hdr,
+                      timeout=120)
+    assert r.status_code == 200, (r.status_code, r.text)
+    flip = r.json()
+    stats = requests.get(rbase + '/stats', timeout=30).json()
+    assert stats['virtual_nodes'] == 2, stats
+    assert stats['weight_version'] == 1, stats
+    got = requests.post(rbase + '/generate', json=body,
+                        timeout=300).json()['tokens']
+    assert got == golden, f'reshard changed outputs: {got} != {golden}'
+
+    # -- Injected fault (virtual_nodes=4): aborts with the old layout
+    # intact, serving unharmed.
+    r = requests.post(rbase + '/admin/reshard',
+                      json={'virtual_nodes': 4}, headers=hdr,
+                      timeout=120)
+    assert r.status_code == 400, (r.status_code, r.text)
+    assert 'old layout intact' in r.json()['error'], r.json()
+    stats = requests.get(rbase + '/stats', timeout=30).json()
+    assert stats['virtual_nodes'] == 2, stats
+    got = requests.post(rbase + '/generate', json=body,
+                        timeout=300).json()['tokens']
+    assert got == golden, f'aborted reshard broke serving: {got}'
+
+    # -- Idempotent re-assert (the controller's restart-convergence
+    # contract): same layout again is a no-op success.
+    r = requests.post(rbase + '/admin/reshard',
+                      json={'virtual_nodes': 2}, headers=hdr,
+                      timeout=120)
+    assert r.status_code == 200 and r.json().get('noop'), r.text
+    artifact('ok',
+             parked_served=len(ok),
+             overflow_503=len(rejected),
+             cold_start_ttft_s=round(cold_ttft, 4),
+             reshard_duration_s=flip['duration_s'],
+             reshard_from_nodes=flip['from_nodes'],
+             reshard_virtual_nodes=flip['virtual_nodes'],
+             fault_left_layout_intact=True,
+             noop_reassert=True,
+             outputs_byte_identical=True)
+    print(f'ELASTIC_DRILL_OK parked_served={len(ok)} '
+          f'overflow_503={len(rejected)} '
+          f'cold_ttft_s={cold_ttft:.3f} '
+          f'reshard_s={flip["duration_s"]}')
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PYEOF
+then
+    echo "== elastic drill: PASS =="
+else
+    echo "== elastic drill: FAIL (see $OUT/elastic_drill.txt) =="
+    FAIL=1
+fi
+
 echo "artifacts in $OUT"
 if [ "$FAIL" = "1" ]; then
     echo "OVERALL: FAIL — if a Pallas kernel failed, serve with the"
