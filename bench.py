@@ -54,6 +54,7 @@ PHASE_DEADLINES = {
     'kv tier bench': 600,
     'watchdog overhead bench': 300,
     'weight swap bench': 480,
+    'adapter fleet bench': 720,
     'comms plane bench': 600,
     'capacity bench': 600,
     'interference bench': 600,
@@ -810,11 +811,12 @@ def overload_bench_metrics() -> list:
         text = sess.get(base + '/metrics', timeout=5).text
 
         def counter(cls: str) -> float:
+            total = 0.0
             for line in text.splitlines():
                 if line.startswith(
-                        f'skyt_qos_shed_total{{class="{cls}"}}'):
-                    return float(line.rsplit(' ', 1)[1])
-            return 0.0
+                        f'skyt_qos_shed_total{{class="{cls}"'):
+                    total += float(line.rsplit(' ', 1)[1])
+            return total
 
         shed_batch = counter('batch')
         shed_interactive = counter('interactive')
@@ -1919,6 +1921,455 @@ def weight_swap_metrics() -> list:
             out.append({'metric': 'weight_swap_itl_p95_ms',
                         'value': round(swap_p95 * 1e3, 2),
                         'unit': 'ms', 'vs_baseline': None})
+        return out
+    finally:
+        stop.set()
+        if proc.poll() is None:
+            proc.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def adapter_fleet_metrics() -> list:
+    """Adapter-fleet phase (CPU-runnable, docs/serving.md "Adapter
+    fleet"): one real engine-server subprocess serving a streaming
+    workload while ``POST /admin/adapters`` hot-loads a LoRA adapter
+    into the live stack. Reports:
+
+      * adapter_load_duration_s — end-to-end hot-load time from the
+        admin response (stage + validate + graft under load);
+      * adapter_load_itl_p95_ms — p95 inter-token latency over the
+        load window;
+      * adapter_steady_itl_p95_ms — the same stream's p95 with no
+        load in flight (the hot-load pause is the delta);
+      * adapter_load_dropped_requests — MUST be 0: a hot load grafts
+        at a tick boundary, it never drops in-flight work;
+      * adapter_routed_requests — lora-routed generations served by
+        the freshly loaded adapter (must be > 0: the load is live,
+        not just acknowledged);
+      * adapter_{consolidated,dedicated}_req_per_chip_s and
+        adapter_consolidation_gain — the SAME two-model workload
+        through the real LB front door against ONE replica hosting
+        both adapters vs one dedicated single-adapter replica per
+        model (the tenants-per-chip claim), with per-model
+        chip-seconds-per-good-token read from the replicas' own
+        capacity-ledger counters.
+    """
+    import dataclasses as _dc
+    import shutil
+    import socket
+    import statistics
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import requests
+    import flax.linen as nn
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.models import weights as weights_lib
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    from skypilot_tpu.train import lora as tlora
+    from skypilot_tpu.train import trainer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            return s.getsockname()[1]
+
+    tmp = tempfile.mkdtemp(prefix='skyt-adapterbench-')
+    cfg = _dc.replace(llama.CONFIGS['debug'], max_seq_len=64,
+                      param_dtype='float32', dtype='float32')
+    model = llama.LlamaModel(cfg)
+    zeros = jnp.zeros((1, 8), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), zeros)
+    base_ckpt = os.path.join(tmp, 'base')
+    weights_lib.save_hf_checkpoint(cfg, params, base_ckpt)
+    # An adapter dir shaped exactly like an `sft --lora-rank` run
+    # writes (TrainStateS), for the debug model the server serves.
+    lcfg = tlora.LoRAConfig(rank=2, alpha=4.0)
+    tx = trainer.make_optimizer(trainer.TrainerConfig())
+
+    def save_adapter(subdir, seed):
+        tree = tlora.init_lora_params(nn.meta.unbox(params['params']),
+                                      lcfg, jax.random.PRNGKey(seed))
+        rng = np.random.default_rng(seed)
+        tree = jax.tree.map(
+            lambda x: jnp.asarray(rng.normal(0, 0.1, x.shape),
+                                  x.dtype), tree)
+        state = trainer.TrainStateS(step=jnp.zeros((), jnp.int32),
+                                    params=tree,
+                                    opt_state=tx.init(tree))
+        path = os.path.join(tmp, subdir)
+        ck = ckpt_lib.Checkpointer(path, async_save=False)
+        ck.save(0, state, force=True)
+        ck.wait()
+        ck.close()
+        return path
+
+    adapter_dir = save_adapter('adapter_fr', 9)
+    adapter_de = save_adapter('adapter_de', 11)
+    port = free_port()
+    url = f'http://127.0.0.1:{port}'
+    env = dict(os.environ, SKYT_ADMIN_TOKEN='bench-token')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.infer.server',
+         '--checkpoint', base_ckpt, '--port', str(port),
+         '--num-slots', '2', '--max-seq-len', '64'],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    sess = requests.Session()
+    itls = {'steady': [], 'load': []}
+    lock = threading.Lock()
+    window = {'mode': 'steady'}
+    dropped = [0]
+    routed = [0]
+    stop = threading.Event()
+
+    def worker(wid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            body = {'tokens': [wid + 1, (i % 7) + 1, 3],
+                    'max_tokens': 16, 'stream': True}
+            with lock:
+                lora_live = window['mode'] == 'routed'
+            if lora_live:
+                body['lora'] = 'fr'
+            try:
+                t_last = None
+                with requests.post(url + '/generate', json=body,
+                                   stream=True, timeout=120) as r:
+                    if r.status_code != 200:
+                        with lock:
+                            dropped[0] += 1
+                        continue
+                    for line in r.iter_lines():
+                        if not line:
+                            continue
+                        now = time.perf_counter()
+                        if t_last is not None:
+                            with lock:
+                                key = ('load'
+                                       if window['mode'] == 'load'
+                                       else 'steady')
+                                itls[key].append(now - t_last)
+                        t_last = now
+                if lora_live:
+                    with lock:
+                        routed[0] += 1
+            except requests.RequestException:
+                with lock:
+                    dropped[0] += 1
+
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(f'replica died rc={proc.returncode}')
+            try:
+                if sess.get(url + '/health',
+                            timeout=2).status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.5)
+        else:
+            raise RuntimeError('replica never became healthy')
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(4.0)                        # steady window
+        with lock:
+            window['mode'] = 'load'
+        t0 = time.perf_counter()
+        resp = sess.post(url + '/admin/adapters',
+                         json={'op': 'load', 'name': 'fr',
+                               'checkpoint': adapter_dir,
+                               'alpha': 4.0},
+                         headers={'Authorization':
+                                  'Bearer bench-token'},
+                         timeout=240)
+        load_wall = time.perf_counter() - t0
+        if resp.status_code != 200:
+            raise RuntimeError(f'adapter load failed: '
+                               f'{resp.status_code} {resp.text[:200]}')
+        time.sleep(1.0)                        # post-load tail traffic
+        with lock:
+            window['mode'] = 'routed'
+        # The first post-load dispatch recompiles the decode step with
+        # the grafted stack (~10s on a CPU host), so the routed window
+        # is completion-gated, not a fixed sleep.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with lock:
+                if routed[0] >= 4:
+                    break
+            time.sleep(0.2)
+        stop.set()
+        for th in threads:
+            th.join(timeout=120)
+        stats = sess.get(url + '/stats', timeout=10).json()
+        hosted = (stats.get('adapters') or {}).get('adapters') or {}
+        if 'fr' not in hosted:
+            raise RuntimeError(f'load did not land: /stats '
+                               f'adapters={hosted}')
+        if routed[0] == 0:
+            raise RuntimeError(f'no lora-routed generation completed '
+                               f'(dropped={dropped[0]})')
+
+        # -- Consolidation A/B (the tenants-per-chip claim): the SAME
+        # two-model workload through the real LB front door against
+        # (a) ONE replica hosting both adapters and (b) one dedicated
+        # single-adapter replica per model. requests/chip/s, plus the
+        # per-model chip-seconds-per-good-token ledger read from the
+        # replicas' own capacity counters (what GET /fleet/adapters
+        # rolls up fleet-wide).
+        import re
+
+        from aiohttp import web
+
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        from skypilot_tpu.utils import metrics as metrics_lib
+
+        # Park the LBs' controller-sync loops (no controller here);
+        # deliberately not restored — the daemon LB threads outlive
+        # the phase (same reasoning as the affinity phase).
+        os.environ['SKYT_SERVE_LB_SYNC_INTERVAL'] = '3600'
+        r = sess.post(url + '/admin/adapters',
+                      json={'op': 'load', 'name': 'de',
+                            'checkpoint': adapter_de, 'alpha': 4.0},
+                      headers={'Authorization': 'Bearer bench-token'},
+                      timeout=240)
+        if r.status_code != 200:
+            raise RuntimeError(f'de load failed: {r.status_code} '
+                               f'{r.text[:200]}')
+
+        line_re = re.compile(r'^(skyt_capacity_attributed_seconds_'
+                             r'total|skyt_capacity_good_tokens_total)'
+                             r'\{[^}]*model="([^"]*)"[^}]*\} '
+                             r'([0-9.eE+-]+)$')
+
+        def scrape(rep_url):
+            attr, good = {}, {}
+            for ln in sess.get(rep_url + '/metrics',
+                               timeout=10).text.splitlines():
+                m = line_re.match(ln)
+                if not m:
+                    continue
+                fam, model, val = m.groups()
+                dst = attr if fam.endswith('seconds_total') else good
+                dst[model] = dst.get(model, 0.0) + float(val)
+            return attr, good
+
+        def start_lb(replica_urls, adapters_by_replica):
+            lport = free_port()
+            lb = lb_lib.SkyServeLoadBalancer(
+                'http://127.0.0.1:9', lport,
+                metrics_registry=metrics_lib.MetricsRegistry())
+            lb.policy.set_ready_replicas(replica_urls)
+            lb.state.replica_adapters.update(adapters_by_replica)
+            threading.Thread(target=lambda: web.run_app(
+                lb.make_app(), port=lport, print=None,
+                handle_signals=False), daemon=True).start()
+            lbase = f'http://127.0.0.1:{lport}'
+            wait_deadline = time.time() + 30
+            while time.time() < wait_deadline:
+                try:
+                    sess.get(lbase + '/metrics', timeout=2)
+                    break
+                except requests.RequestException:
+                    time.sleep(0.2)
+            return lb, lbase
+
+        def run_fleet(lbase, chips, replica_urls):
+            # Warm both model paths first: the post-load dispatch
+            # recompiles the decode step with the grafted stack, and
+            # a compile inside the timed window would charge XLA to
+            # the serving numbers.
+            for m in ('fr', 'de'):
+                rw = requests.post(
+                    lbase + '/generate',
+                    json={'tokens': [1, 2, 3], 'max_tokens': 4,
+                          'lora': m, 'model': m}, timeout=240)
+                if rw.status_code != 200:
+                    raise RuntimeError(f'warmup {m} failed: '
+                                       f'{rw.status_code} '
+                                       f'{rw.text[:200]}')
+            before = {u: scrape(u) for u in replica_urls}
+            served = {'fr': 0, 'de': 0}
+            errors = [0]
+            stop2 = threading.Event()
+
+            def fleet_worker(model, wid):
+                s2 = requests.Session()
+                i = 0
+                while not stop2.is_set():
+                    i += 1
+                    try:
+                        r2 = s2.post(
+                            lbase + '/generate',
+                            json={'tokens': [wid + 1, (i % 7) + 1, 3],
+                                  'max_tokens': 8, 'lora': model,
+                                  'model': model}, timeout=120)
+                        with lock:
+                            if r2.status_code == 200:
+                                served[model] += 1
+                            else:
+                                errors[0] += 1
+                    except requests.RequestException:
+                        with lock:
+                            errors[0] += 1
+
+            ths = [threading.Thread(target=fleet_worker,
+                                    args=(m, wid))
+                   for m in ('fr', 'de') for wid in range(2)]
+            t0 = time.perf_counter()
+            for th in ths:
+                th.start()
+            time.sleep(8.0)
+            stop2.set()
+            for th in ths:
+                th.join(timeout=120)
+            dur = time.perf_counter() - t0
+            if errors[0]:
+                raise RuntimeError(f'{errors[0]} routed requests '
+                                   f'failed through the LB')
+            after = {u: scrape(u) for u in replica_urls}
+            per_model = {}
+            for m in ('fr', 'de'):
+                attr_d = sum(after[u][0].get(m, 0.0) -
+                             before[u][0].get(m, 0.0)
+                             for u in replica_urls)
+                good_d = sum(after[u][1].get(m, 0.0) -
+                             before[u][1].get(m, 0.0)
+                             for u in replica_urls)
+                per_model[m] = {
+                    'attributed_chip_s': attr_d,
+                    'good_tokens': good_d,
+                    'chip_s_per_good_ktok':
+                        (round(attr_d / good_d * 1e3, 4)
+                         if good_d > 0 else None)}
+            return {'req_per_chip_s':
+                    round(sum(served.values()) / dur / chips, 3),
+                    'served': dict(served), 'per_model': per_model}
+
+        lb_a, lbase_a = start_lb(
+            [url], {url: {'fr': 1, 'de': 1}})
+        consolidated = run_fleet(lbase_a, 1, [url])
+
+        dports = [free_port(), free_port()]
+        dprocs = [subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.infer.server',
+             '--checkpoint', base_ckpt, '--port', str(p),
+             '--num-slots', '2', '--max-seq-len', '64'],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL) for p in dports]
+        durls = [f'http://127.0.0.1:{p}' for p in dports]
+        dedicated = None
+        try:
+            deadline = time.time() + 300
+            pending = set(durls)
+            while time.time() < deadline and pending:
+                for du, dp in zip(durls, dprocs):
+                    if dp.poll() is not None:
+                        raise RuntimeError(
+                            f'dedicated replica died '
+                            f'rc={dp.returncode}')
+                    if du in pending:
+                        try:
+                            if sess.get(du + '/health',
+                                        timeout=2).status_code == 200:
+                                pending.discard(du)
+                        except requests.RequestException:
+                            pass
+                time.sleep(0.5)
+            if pending:
+                raise RuntimeError('dedicated replicas never became '
+                                   'healthy')
+            for du, (name, path) in zip(
+                    durls, (('fr', adapter_dir), ('de', adapter_de))):
+                r = sess.post(du + '/admin/adapters',
+                              json={'op': 'load', 'name': name,
+                                    'checkpoint': path, 'alpha': 4.0},
+                              headers={'Authorization':
+                                       'Bearer bench-token'},
+                              timeout=240)
+                if r.status_code != 200:
+                    raise RuntimeError(
+                        f'dedicated {name} load failed: '
+                        f'{r.status_code} {r.text[:200]}')
+            lb_b, lbase_b = start_lb(
+                durls, {durls[0]: {'fr': 1}, durls[1]: {'de': 1}})
+            dedicated = run_fleet(lbase_b, 2, durls)
+            del lb_b
+        finally:
+            for dp in dprocs:
+                if dp.poll() is None:
+                    dp.kill()
+        del lb_a
+        gain = (consolidated['req_per_chip_s'] /
+                dedicated['req_per_chip_s']
+                if dedicated['req_per_chip_s'] else None)
+        print(f'# adapter consolidation: 2-adapters-1-chip '
+              f'{consolidated["req_per_chip_s"]} req/chip/s vs '
+              f'dedicated {dedicated["req_per_chip_s"]} '
+              f'(gain {gain and round(gain, 2)}x) '
+              f'per_model={consolidated["per_model"]}',
+              file=sys.stderr)
+
+        def p95(xs):
+            return (statistics.quantiles(xs, n=20)[-1]
+                    if len(xs) >= 20 else max(xs)) if xs else None
+
+        steady_p95 = p95(itls['steady'])
+        load_p95 = p95(itls['load'])
+        print(f'# adapter fleet: load={load_wall:.3f}s steady_itl_p95='
+              f'{steady_p95 * 1e3 if steady_p95 else -1:.1f}ms '
+              f'load_itl_p95={load_p95 * 1e3 if load_p95 else -1:.1f}ms '
+              f'dropped={dropped[0]} routed={routed[0]}',
+              file=sys.stderr)
+        out = [
+            {'metric': 'adapter_load_duration_s',
+             'value': round(load_wall, 3), 'unit': 's',
+             'vs_baseline': None},
+            {'metric': 'adapter_load_dropped_requests',
+             'value': dropped[0], 'unit': 'requests',
+             'vs_baseline': None},
+            {'metric': 'adapter_routed_requests',
+             'value': routed[0], 'unit': 'requests',
+             'vs_baseline': None},
+        ]
+        if steady_p95 is not None:
+            out.append({'metric': 'adapter_steady_itl_p95_ms',
+                        'value': round(steady_p95 * 1e3, 2),
+                        'unit': 'ms', 'vs_baseline': None})
+        if load_p95 is not None:
+            out.append({'metric': 'adapter_load_itl_p95_ms',
+                        'value': round(load_p95 * 1e3, 2),
+                        'unit': 'ms', 'vs_baseline': None})
+        out.append({'metric': 'adapter_consolidated_req_per_chip_s',
+                    'value': consolidated['req_per_chip_s'],
+                    'unit': 'req/chip/s', 'vs_baseline': None})
+        out.append({'metric': 'adapter_dedicated_req_per_chip_s',
+                    'value': dedicated['req_per_chip_s'],
+                    'unit': 'req/chip/s', 'vs_baseline': None})
+        if gain is not None:
+            out.append({'metric': 'adapter_consolidation_gain',
+                        'value': round(gain, 3), 'unit': 'x',
+                        'vs_baseline': None})
+        for fleet_name, fleet in (('consolidated', consolidated),
+                                  ('dedicated', dedicated)):
+            for m in ('fr', 'de'):
+                cost = fleet['per_model'][m]['chip_s_per_good_ktok']
+                if cost is not None:
+                    out.append(
+                        {'metric': f'adapter_{fleet_name}_chip_s_'
+                                   f'per_good_ktok_{m}',
+                         'value': cost, 'unit': 'chip-s/ktok',
+                         'vs_baseline': None})
         return out
     finally:
         stop.set()
@@ -3388,6 +3839,20 @@ def main() -> None:
         partial['extra'] = extra
     except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
         print(f'# weight swap bench failed: {e!r}', file=sys.stderr)
+
+    # Adapter-fleet phase: hot-load pause (p95 ITL during the load
+    # window vs steady), dropped requests (must be 0), and lora-routed
+    # generations through the freshly loaded adapter (must be > 0).
+    # CPU-runnable — docs/serving.md "Adapter fleet".
+    if on_tpu:
+        _reclaim_hbm('pre-adapter-fleet')
+    try:
+        with phase_deadline(PHASE_DEADLINES['adapter fleet bench'],
+                            'adapter fleet bench'):
+            extra = extra + adapter_fleet_metrics()
+        partial['extra'] = extra
+    except (Exception, PhaseTimeout) as e:  # pylint: disable=broad-except
+        print(f'# adapter fleet bench failed: {e!r}', file=sys.stderr)
 
     # Watchdog/heartbeat overhead phase: the training-plane heartbeat
     # must be cheap enough to leave ON (acceptance <=1%). CPU-runnable.

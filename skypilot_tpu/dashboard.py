@@ -38,6 +38,7 @@ _PAGE = """<!DOCTYPE html>
 <h2>SLO / fleet</h2>{slo}
 <h2>Comms</h2>{comms}
 <h2>Capacity</h2>{capacity}
+<h2>Adapters</h2>{adapters}
 <h2>Interference</h2>{interference}
 <h2>Postmortems</h2>{postmortems}
 <h2>Metrics</h2>{metrics}
@@ -239,6 +240,37 @@ def _capacity_html() -> str:
                    'replica util'], rows)
 
 
+def _adapters_html() -> str:
+    """Adapter-fleet panel: each service's controller answers
+    GET /fleet/adapters — the capacity ledger rolled up per model
+    (adapter or base), hosted-adapter counts per replica, and the
+    windowed hot-load churn (docs/serving.md "Adapter fleet")."""
+    services, results = _fetch_controllers('/fleet/adapters')
+    rows = []
+    for svc in services:
+        name = svc['name']
+        data = results.get(name)
+        if not isinstance(data, dict):
+            rows.append([name, '-', f'unreachable ({data})', '-', '-',
+                         '-'])
+            continue
+        hosted = '; '.join(f'{t}={int(v)}' for t, v in
+                           sorted((data.get('hosted_per_replica')
+                                   or {}).items()))
+        for model, rec in sorted(data.get('adapters', {}).items()):
+            cspgt = rec.get('chip_seconds_per_good_token')
+            rows.append([
+                name, model,
+                f"{rec.get('attributed_chip_seconds', 0):.2f}",
+                f"{rec.get('good_tokens', 0):.0f}",
+                f'{cspgt:.6f}' if cspgt is not None else '-',
+                hosted or '-'])
+        if not data.get('adapters'):
+            rows.append([name, '-', '-', '-', '-', hosted or '-'])
+    return _table(['service', 'model', 'chip-s', 'good tokens',
+                   'chip-s / good token', 'adapters hosted'], rows)
+
+
 def _interference_html() -> str:
     """Tick-plane panel: each service's controller answers
     GET /fleet/interference — per-replica mixed-tick fraction,
@@ -347,6 +379,7 @@ def _render_page() -> str:
         slo=_slo_html(),
         comms=_comms_html(),
         capacity=_capacity_html(),
+        adapters=_adapters_html(),
         interference=_interference_html(),
         postmortems=_postmortems_html(),
         metrics=_metrics_html(),

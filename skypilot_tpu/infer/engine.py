@@ -691,7 +691,12 @@ class InferenceEngine:
                     tenant=r.params.tenant or 'default',
                     cost=float(len(r.tokens)
                                + r.params.max_new_tokens),
-                    seq=r.req_id, enq_t=r.submitted_at))
+                    seq=r.req_id, enq_t=r.submitted_at,
+                    # Adapter fleet: flows isolate per served model
+                    # (the label map is bounded; ids without one
+                    # collapse to the id string).
+                    model=str(self.model_labels.get(
+                        r.params.lora_id, r.params.lora_id))))
             self._waiting: 'queue.Queue[_Request]' = self._qos_queue
             self._qos_reserved = max(0, min(
                 num_slots - 1,
@@ -2429,6 +2434,65 @@ class InferenceEngine:
                                 'result': None}
         return self._submit_swap(swap, timeout, 'reshard')
 
+    def request_adapter_update(self, lora_stack, *,
+                               num_adapters: int,
+                               flush_prefix: bool = True,
+                               drain: bool = False,
+                               timeout: Optional[float] = None
+                               ) -> Dict[str, Any]:
+        """Install a new stacked 'lora' collection as the live adapter
+        stack at a decode-tick boundary — the adapter-fleet hot-load
+        apply (docs/serving.md "Adapter fleet"). Rides the exact
+        weight-swap machinery (same single pending slot, same
+        atomic-claim timeout contract — an adapter update cannot race
+        a swap or reshard), but base params and weight VERSION are
+        untouched: only the adapter stack reference moves. Adapter ids
+        are stable across updates (the registry appends or zero-fills
+        freed slots, never renumbers), so in-flight requests stay
+        pinned to their adapter through the apply; drain=True is for
+        in-place REPLACEMENT of a referenced id, where pinning demands
+        the old values survive until those requests finish. A grown
+        stack changes the 'lora' leaves' [N, ...] shapes, so the next
+        prefill/decode dispatch retraces (one-time compile cost,
+        visible as a tick-time spike)."""
+        if self._lockstep is not None:
+            raise RuntimeError(
+                'adapter hot-load is not supported on multi-host '
+                'lockstep replicas (the apply boundary would have to '
+                'ride the tick broadcast); roll these replicas by '
+                'relaunch')
+        if timeout is None:
+            timeout = env.get_float('SKYT_ADAPTER_TIMEOUT_S', 120.0)
+        swap: Dict[str, Any] = {'lora_stack': lora_stack,
+                                'num_adapters': int(num_adapters),
+                                'flush_prefix': bool(flush_prefix),
+                                'version': self.weight_version,
+                                'drain': bool(drain),
+                                'event': threading.Event(),
+                                'result': None}
+        return self._submit_swap(swap, timeout, 'adapter update')
+
+    def adapter_in_use(self, lora_id: int) -> bool:
+        """True while any active, chunked, deferred, or waiting request
+        references the adapter id — the registry's unload-refusal
+        check. A freed id's stack slot zeroes (scaling 0), so an
+        in-flight reference surviving an unload would silently serve
+        base-model outputs under the adapter's name."""
+        lid = int(lora_id)
+        with self._lock:
+            if any(s is not None and s.params.lora_id == lid
+                   for s in self._slots):
+                return True
+            ch = self._chunked
+            if ch is not None and ch['req'].params.lora_id == lid:
+                return True
+        d = self._deferred
+        if d is not None and d.params.lora_id == lid:
+            return True
+        with self._waiting.mutex:
+            return any(r.params.lora_id == lid
+                       for r in self._waiting.queue)
+
     def _submit_swap(self, swap: Dict[str, Any], timeout: float,
                      what: str) -> Dict[str, Any]:
         running = self._thread is not None and self._thread.is_alive()
@@ -2479,6 +2543,30 @@ class InferenceEngine:
                 return
             self._swap_req = None   # claimed: apply is now inevitable
         t0 = time.perf_counter()
+        if 'lora_stack' in swap:
+            # Adapter-stack update: base params, weight version, and
+            # layout are untouched — only the 'lora' collection
+            # reference moves (ids stable; see request_adapter_update).
+            self._lora_stack = swap['lora_stack']
+            self.num_adapters = int(swap['num_adapters'])
+            flushed = 0
+            if swap['flush_prefix'] and self.pool is not None and \
+                    self.prefix_caching:
+                # Prefix pages are salted by lora_id; a reused or
+                # re-versioned id would otherwise hit pages computed
+                # under the previous adapter's values.
+                flushed = self.pool.flush_prefix()
+            swap['result'] = {
+                'weight_version': self.weight_version,
+                'num_adapters': self.num_adapters,
+                'flushed_prefix_pages': flushed,
+                'apply_s': round(time.perf_counter() - t0, 6)}
+            logger.info('adapter stack applied: %d slot(s) at weight '
+                        'version %d (drain=%s, %d prefix pages '
+                        'flushed)', self.num_adapters,
+                        self.weight_version, swap['drain'], flushed)
+            swap['event'].set()
+            return
         self.params = swap['params']
         self.weight_version = int(swap['version'])
         flushed = 0
@@ -2712,8 +2800,10 @@ class InferenceEngine:
         path: candidates are a FIFO prefix; prefix-cache hits, long
         prompts wanting chunked prefill, QoS reserve gating, and
         pool-full reservations all fall through to the sequential
-        path. Candidates must share one lora_id (the packed row is a
-        single batch element, and adapters route per batch row)."""
+        path. Candidates may mix adapters: the packed row carries
+        PER-TOKEN lora ids (each segment's tokens tagged with its
+        request's adapter), dispatched through the ops/lora.py grouped
+        path — golden-equal to splitting the pack per adapter."""
         if not self.ragged_prefill or self._deferred is not None:
             return False
         if self._chunked is not None:
@@ -2727,7 +2817,6 @@ class InferenceEngine:
                                            len(free)))
         cand: List[_Request] = []
         total = 0
-        lora0: Optional[int] = None
         for req in queued:
             if req.cancelled:
                 break   # let _admit_one deliver its terminal None
@@ -2747,10 +2836,6 @@ class InferenceEngine:
                     break   # prefix hit -> suffix path, sequential
                 if self._kv_admission_break(req, n, psize):
                     break   # outer tier can serve it -> sequential
-            if lora0 is None:
-                lora0 = req.params.lora_id
-            elif req.params.lora_id != lora0:
-                break
             span = -(-n // psize) * psize
             if cand and total + span > self._ragged_max:
                 break
@@ -2773,6 +2858,12 @@ class InferenceEngine:
         tokens = np.zeros((1, t_bucket), np.int32)
         segs = np.zeros((1, t_bucket), np.int32)
         poss = np.zeros((1, t_bucket), np.int32)
+        # Per-token adapter ids: each segment's tokens carry their
+        # request's lora_id (page tails + bucket padding stay 0 — the
+        # zeros adapter, and those positions are never read). The
+        # grouped ops/lora.py path makes a mixed-adapter pack exactly
+        # equal to splitting it per adapter.
+        lora_row = np.zeros((1, t_bucket), np.int32)
         bp = 1 << (nb - 1).bit_length()       # pow2 pad: fewer compiles
         logit_pos = np.zeros((1, bp), np.int32)
         trace_on = tracing.enabled()
@@ -2781,6 +2872,7 @@ class InferenceEngine:
             off = offs[j]
             tokens[0, off:off + n] = req.tokens
             segs[0, off:off + n] = j + 1
+            lora_row[0, off:off + n] = req.params.lora_id
             # Page-rounding tail keeps id 0 (masked everywhere); its
             # positions continue the request's arange so the junk KV
             # written above n lands with sane rope — overwritten by
@@ -2799,7 +2891,7 @@ class InferenceEngine:
         self.perf['ragged_dispatches'] += 1
         with self._ctx():
             greedy, logits, prefill_cache = self._jit_prefill_ragged(
-                self._vars([lora0]), jnp.asarray(tokens),
+                self._vars(lora_row), jnp.asarray(tokens),
                 jnp.asarray(segs), jnp.asarray(poss),
                 jnp.asarray(logit_pos), t_bucket=t_bucket)
             self._count_prefill_dispatch(nb, dispatch_tokens=t_bucket,

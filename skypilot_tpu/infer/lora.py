@@ -40,15 +40,37 @@ def load_adapter_dir(path: str) -> Dict[str, Any]:
 
     Template-free restore: adapters are tiny (MBs) and host-side, so
     the topology-mismatch risk StandardRestore's template guards
-    against is caught instead by build_stack's structure check."""
+    against is caught instead by build_stack's structure check.
+
+    The restore goes through PyTreeCheckpointer against the step's
+    item directory, NOT CheckpointManager.restore(step): orbax 0.7.x
+    resolves a template-free manager restore through a per-process
+    CheckpointHandlerRegistry that only knows the 'default' item if an
+    earlier save/restore in the SAME process registered it — a fresh
+    manager raises KeyError ('Item "default" ... could not be
+    restored'), which is exactly the suite-order flake that kept
+    test_adapter_roundtrip_through_orbax quarantined since PR 12. The
+    item-level checkpointer needs no registry and restores as-saved
+    regardless of process history."""
+    import orbax.checkpoint as ocp
+    from etils import epath
+
     from skypilot_tpu.train import checkpoint as ckpt_lib
 
     ck = ckpt_lib.Checkpointer(path, async_save=False)
     step = ck.latest_step()
     if step is None:
+        ck.close()
         raise FileNotFoundError(f'no Orbax checkpoint under {path}')
-    raw = ck._mgr.restore(step)  # pylint: disable=protected-access
+    step_dir = epath.Path(ck.directory) / str(step)
     ck.close()
+    # CheckpointManager(StandardSave) writes the tree under the
+    # 'default' item; a bare Checkpointer.save writes it at the step
+    # root. Accept both.
+    item_dir = step_dir / 'default'
+    if not item_dir.is_dir():
+        item_dir = step_dir
+    raw = ocp.PyTreeCheckpointer().restore(item_dir)
     if isinstance(raw, dict) and 'params' in raw:
         raw = raw['params']
     return raw
@@ -134,6 +156,155 @@ def build_stack(adapters: Sequence[Tuple[Dict[str, Any], float]],
                 '%d), %d adapted projections', len(adapters), ranks,
                 rmax, len(keys0))
     return stack
+
+
+def adapter_rank(tree: Dict[str, Any]) -> int:
+    """The LoRA rank of an adapter tree (from any 'a' leaf's trailing
+    dim — all projections of one adapter share the rank)."""
+    flat = _flatten_adapter(tree)
+    return int(next(iter(flat.values()))['a'].shape[-1])
+
+
+def build_stack_assigned(
+        assigned: Dict[int, Tuple[Dict[str, Any], float]],
+        num_slots: int, dtype: str = 'bfloat16') -> Dict[str, Any]:
+    """{slot id: (adapter tree, alpha)} -> the 'lora' collection with
+    exactly `num_slots` entries. The AdapterRegistry's rebuild
+    primitive: ids are caller-assigned and STABLE — id 0 and every
+    unassigned id are zeros with scaling 0 (the no-op adapter), so an
+    unloaded adapter leaves a hole instead of renumbering its
+    neighbors (in-flight requests stay pinned to their id)."""
+    if not assigned:
+        raise ValueError('build_stack_assigned needs at least one '
+                         'assigned adapter')
+    for aid in assigned:
+        if not 1 <= aid < num_slots:
+            raise ValueError(f'adapter id {aid} out of range '
+                             f'[1, {num_slots})')
+    flats = {aid: _flatten_adapter(t) for aid, (t, _) in
+             assigned.items()}
+    ids = sorted(flats)
+    keys0 = sorted(flats[ids[0]])
+    for aid in ids[1:]:
+        if sorted(flats[aid]) != keys0:
+            raise ValueError(
+                f'adapter at id {aid} targets different projections '
+                f'than id {ids[0]} — all served adapters must share '
+                f'targets')
+    ranks = {aid: f[keys0[0]]['a'].shape[-1]
+             for aid, f in flats.items()}
+    rmax = max(ranks.values())
+    np_dtype = jnp.dtype(dtype)
+
+    stack: Dict[str, Any] = {}
+    for ckey in keys0:
+        a0 = flats[ids[0]][ckey]['a']
+        b0 = flats[ids[0]][ckey]['b']
+        za = np.zeros(a0.shape[:-1] + (rmax,), a0.dtype)
+        zb = np.zeros(b0.shape[:-2] + (rmax,) + b0.shape[-1:],
+                      b0.dtype)
+        a_list, b_list = [], []
+        for slot in range(num_slots):
+            if slot in flats:
+                a, b = _pad_rank(flats[slot][ckey]['a'],
+                                 flats[slot][ckey]['b'], rmax)
+                a_list.append(a)
+                b_list.append(b)
+            else:
+                a_list.append(za)
+                b_list.append(zb)
+        axis = a0.ndim - 2
+        node = stack
+        for k in ckey[:-1]:
+            node = node.setdefault(k, {})
+        node[ckey[-1]] = {
+            'a': jnp.asarray(np.stack(a_list, axis=axis), np_dtype),
+            'b': jnp.asarray(np.stack(b_list, axis=axis), np_dtype),
+        }
+    scaling = np.zeros(num_slots, np.float32)
+    for aid, (_, alpha) in assigned.items():
+        scaling[aid] = alpha / ranks[aid]
+    stack['scaling'] = jnp.asarray(scaling)
+    logger.info('multi-LoRA stack (assigned): %d/%d slot(s) occupied, '
+                'ranks %s (padded to %d), %d adapted projections',
+                len(assigned), num_slots,
+                sorted(ranks.values()), rmax, len(keys0))
+    return stack
+
+
+def _stack_keys(stack: Dict[str, Any]) -> set:
+    """The '<proj>_ab' collection paths present in a built stack."""
+    out = set()
+    for path, _ in jax.tree_util.tree_leaves_with_path(stack):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        if keys != ('scaling',):
+            out.add(keys[:-1])
+    return out
+
+
+def graft_adapter(stack: Dict[str, Any], aid: int,
+                  tree: Dict[str, Any], alpha: float) -> Dict[str, Any]:
+    """Graft one adapter into slot `aid` of an existing stack — set in
+    place for aid < n, append for aid == n — WITHOUT the other
+    adapters' original trees (the registry's fast path; a full
+    build_stack_assigned rebuild is only needed when the new rank
+    exceeds the stack's padded rank). Pure: returns a new stack, the
+    input is untouched. Raises ValueError when the adapter targets
+    different projections than the stack or its rank does not fit."""
+    flat = _flatten_adapter(tree)
+    n = int(stack['scaling'].shape[0])
+    if not 1 <= aid <= n:
+        raise ValueError(f'adapter id {aid} out of range [1, {n}]')
+    if set(flat) != _stack_keys(stack):
+        raise ValueError(
+            'adapter targets different projections than the live '
+            'stack — all served adapters must share targets')
+    r = flat[next(iter(flat))]['a'].shape[-1]
+
+    def _graft(path, leaf):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        if keys == ('scaling',):
+            val = jnp.asarray(float(alpha) / r, leaf.dtype)
+            if aid < n:
+                return leaf.at[aid].set(val)
+            return jnp.concatenate([leaf, val[None]])
+        ckey, part = keys[:-1], keys[-1]
+        stack_r = leaf.shape[-1] if part == 'a' else leaf.shape[-2]
+        if r > stack_r:
+            raise ValueError(
+                f'adapter rank {r} exceeds the stack\'s padded rank '
+                f'{stack_r}')
+        a, b = _pad_rank(flat[ckey]['a'], flat[ckey]['b'], stack_r)
+        new = jnp.asarray(a if part == 'a' else b, leaf.dtype)
+        axis = leaf.ndim - 3   # adapter axis sits after the scan axis
+        if aid < n:
+            idx = (slice(None),) * axis + (aid,)
+            return leaf.at[idx].set(new)
+        return jnp.concatenate([leaf, jnp.expand_dims(new, axis)],
+                               axis=axis)
+
+    return jax.tree_util.tree_map_with_path(_graft, stack)
+
+
+def zero_slot(stack: Dict[str, Any], aid: int) -> Dict[str, Any]:
+    """Zero one adapter slot (A, B, and scaling) — the unload apply:
+    the slot becomes the no-op adapter, ids of every other adapter
+    unchanged. Pure: returns a new stack."""
+    n = int(stack['scaling'].shape[0])
+    if not 1 <= aid < n:
+        raise ValueError(f'adapter id {aid} out of range [1, {n})')
+
+    def _zero(path, leaf):
+        keys = tuple(k.key for k in path
+                     if isinstance(k, jax.tree_util.DictKey))
+        if keys == ('scaling',):
+            return leaf.at[aid].set(0.0)
+        idx = (slice(None),) * (leaf.ndim - 3) + (aid,)
+        return leaf.at[idx].set(0)
+
+    return jax.tree_util.tree_map_with_path(_zero, stack)
 
 
 def build_stack_from_specs(specs: Sequence[AdapterSpec],

@@ -128,6 +128,7 @@ class InferenceServer:
     def __init__(self, engine: 'engine_lib.InferenceEngine',
                  tokenizer=None, model_id: str = 'skypilot-tpu',
                  lora_names: Optional[Dict[str, int]] = None,
+                 lora_specs=None,
                  chat_template: Optional[str] = None,
                  special_tokens: Optional[Dict[str, str]] = None,
                  tracer: Optional['tracing_lib.Tracer'] = None) -> None:
@@ -233,6 +234,30 @@ class InferenceServer:
             raise ValueError(
                 f'--lora adapter name {model_id!r} collides with the '
                 f'served model id; rename the adapter')
+        # Adapter fleet (docs/serving.md "Adapter fleet"): dynamic
+        # hot-load/unload of LoRA adapters at decode-tick boundaries
+        # via POST /admin/adapters. Shares the swap manager's
+        # single-flight lock; every change resyncs the routing map
+        # and the bounded capacity-plane model labels.
+        self._adapters = weight_swap_lib.AdapterRegistry(
+            engine, self._swap_mgr, reserved_names={model_id},
+            on_change=self._adapters_changed)
+        if lora_specs:
+            # Boot adapters with retained host trees: future loads
+            # whose rank outgrows the stack can rebuild in full.
+            self._adapters.seed(lora_specs)
+        elif self.lora_names:
+            self._adapters.seed_names(self.lora_names)
+
+    def _adapters_changed(self) -> None:
+        """AdapterRegistry change hook: resync routing ('model' name ->
+        stack id) and the engine's bounded model-label map. Runs under
+        the registry's single-flight lock, after the tick-boundary
+        apply commits."""
+        self.lora_names = self._adapters.name_ids()
+        self.engine.model_labels = {
+            0: self.model_id, **{lid: name for name, lid
+                                 in self.lora_names.items()}}
 
     def _resolve_lora(self, payload, request=None):
         """-> (lora_id, error response | None). The base model id (or
@@ -339,7 +364,18 @@ class InferenceServer:
         request['skyt_qos_tenant'] = tenant
         if self._qos is None:
             return cls, tenant, None, None
-        dec = self._qos.admit(cls, tenant, max_new_tokens=max_new)
+        # Bounded model label for QoS (docs/serving.md "Adapter
+        # fleet"): only names that RESOLVE to a loaded adapter key a
+        # bucket/counter; everything else (absent, base, unknown-404)
+        # collapses to the base id, so cardinality is the adapter
+        # count, never the request-string space.
+        model = self.model_id
+        if payload is not None:
+            named = payload.get('model')
+            if isinstance(named, str) and named in self.lora_names:
+                model = named
+        dec = self._qos.admit(cls, tenant, max_new_tokens=max_new,
+                              model=model)
         if dec.action in ('shed', 'throttle'):
             verb = ('shed by overload control'
                     if dec.action == 'shed'
@@ -792,6 +828,80 @@ class InferenceServer:
                 status=400)
         return web.json_response(result)
 
+    async def _admin_adapters(self, request: web.Request
+                              ) -> web.Response:
+        """``POST /admin/adapters`` — the adapter fleet's replica
+        surface (docs/serving.md "Adapter fleet").
+
+        Body: ``{"op": "load", "name": n, "checkpoint": dir,
+        "alpha": f?, "drain": bool?}`` |
+        ``{"op": "unload", "name": n, "drain": bool?}`` |
+        ``{"op": "list"}``. Auth and error mapping mirror
+        /admin/weights: 403 unauthenticated, 409 while any weight
+        swap / reshard / adapter update is in flight OR while an
+        unload's adapter id is still referenced by live requests, 400
+        on a malformed body or a failed load — the old adapter stack
+        is live in every error case."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {'error': 'body must be a JSON object'}, status=400)
+        op_name = payload.get('op', 'load')
+        if op_name == 'list':
+            snap = self._adapters.snapshot()
+            snap['last'] = self._adapters.last
+            return web.json_response(snap)
+        if op_name not in ('load', 'unload'):
+            return web.json_response(
+                {'error': f"op must be 'load', 'unload', or 'list', "
+                          f'got {op_name!r}'}, status=400)
+        name = payload.get('name')
+        if not isinstance(name, str) or not name:
+            return web.json_response(
+                {'error': f'name must be a non-empty string, got '
+                          f'{name!r}'}, status=400)
+        drain = payload.get('drain')
+        if drain is not None and not isinstance(drain, bool):
+            return web.json_response(
+                {'error': f'drain must be a boolean, got {drain!r}'},
+                status=400)
+        if op_name == 'load':
+            ckpt = payload.get('checkpoint')
+            if not isinstance(ckpt, str) or not ckpt:
+                return web.json_response(
+                    {'error': f'checkpoint must be a non-empty '
+                              f'string (an adapter dir an `sft '
+                              f'--lora-rank` run wrote), got '
+                              f'{ckpt!r}'}, status=400)
+            alpha = payload.get('alpha', 16.0)
+            if isinstance(alpha, bool) or \
+                    not isinstance(alpha, (int, float)):
+                return web.json_response(
+                    {'error': f'alpha must be a number, got '
+                              f'{alpha!r}'}, status=400)
+            op = functools.partial(self._adapters.load, name,
+                                   checkpoint=ckpt,
+                                   alpha=float(alpha), drain=drain)
+        else:
+            op = functools.partial(self._adapters.unload, name,
+                                   drain=drain)
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(None, op)
+        except weight_swap_lib.AdapterInUse as e:
+            return web.json_response({'error': str(e)}, status=409)
+        except weight_swap_lib.SwapInFlight as e:
+            return web.json_response({'error': str(e)}, status=409)
+        except weight_swap_lib.WeightSwapError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        return web.json_response(result)
+
     async def _admin_kv_prewarm(self, request: web.Request
                                 ) -> web.Response:
         """``POST /admin/kv_prewarm`` — pull this replica's rendezvous
@@ -884,6 +994,10 @@ class InferenceServer:
                     status=404)
             return web.json_response(trace)
         data = self.engine.stats()
+        # Adapter fleet: the per-adapter name/id/version map rides the
+        # controller's stats probe to the LB, which routes
+        # model-named requests only to replicas hosting the adapter.
+        data['adapters'] = self._adapters.snapshot()
         if self._qos is not None:
             # Scraped by the serve controller's replica prober and
             # forwarded to the LB through the sync response — the
@@ -1707,6 +1821,7 @@ class InferenceServer:
         app.router.add_post('/debug/profile', self._debug_profile)
         app.router.add_post('/admin/weights', self._admin_weights)
         app.router.add_post('/admin/reshard', self._admin_reshard)
+        app.router.add_post('/admin/adapters', self._admin_adapters)
         app.router.add_post('/admin/kv_prewarm', self._admin_kv_prewarm)
         app.router.add_get('/kv/prefix', self._kv_prefix)
         app.router.add_get('/kv/index', self._kv_index)
@@ -2029,12 +2144,12 @@ def main(argv=None) -> None:
         from skypilot_tpu.infer import multihost as multihost_lib
         lockstep = multihost_lib.initialize_from_env()
 
-    lora_stack, lora_names = None, {}
+    lora_stack, lora_names, lora_specs = None, {}, None
     if args.lora:
         from skypilot_tpu.infer import lora as lora_lib
-        specs = lora_lib.parse_lora_flag(args.lora)
+        lora_specs = lora_lib.parse_lora_flag(args.lora)
         lora_stack, lora_names = lora_lib.build_stack_from_specs(
-            specs, dtype=args.dtype)
+            lora_specs, dtype=args.dtype)
 
     engine = build_engine(args.model, args.num_slots, args.max_seq_len,
                           checkpoint=args.checkpoint, tp=args.tp,
@@ -2091,6 +2206,7 @@ def main(argv=None) -> None:
                 if args.checkpoint else args.model)
     server = InferenceServer(engine, tokenizer, model_id=model_id,
                              lora_names=lora_names,
+                             lora_specs=lora_specs,
                              chat_template=chat_template,
                              special_tokens=special_tokens)
     logger.info('inference server: model=%s ckpt=%s tp=%d port=%d '

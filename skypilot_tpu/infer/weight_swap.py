@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional
 
 import jax
 
+from skypilot_tpu.utils import env
 from skypilot_tpu.utils import faults
 from skypilot_tpu.utils import jax_compat
 from skypilot_tpu.utils import log_utils
@@ -50,6 +51,11 @@ class WeightSwapError(RuntimeError):
 
 class SwapInFlight(WeightSwapError):
     """A swap is already in progress (single-flight; HTTP 409)."""
+
+
+class AdapterInUse(WeightSwapError):
+    """Unload refused: live requests still reference the adapter id
+    (the server's 409 — retry after those requests drain)."""
 
 
 def _path_str(path) -> str:
@@ -441,3 +447,391 @@ class WeightSwapManager:
         }
         logger.warning('reshard to %r virtual nodes aborted (old '
                        'layout intact): %s', target, error)
+
+
+class AdapterRegistry:
+    """Dynamic multi-LoRA registry: hot-load/unload adapters into a
+    live engine's stacked 'lora' collection at decode-tick boundaries
+    (docs/serving.md "Adapter fleet"). One instance per replica server
+    (infer/server.py exposes it at ``POST /admin/adapters``).
+
+    The lifecycle mirrors weight swaps — build/stage off the engine
+    loop, validate against the live param tree, apply as a reference
+    assignment at a tick boundary (engine.request_adapter_update) —
+    and SHARES the WeightSwapManager's single-flight lock, so an
+    adapter update can never race a weight swap or reshard (HTTP 409).
+
+    Invariants:
+
+    * **Stable ids.** A load takes the lowest free slot (or the same
+      slot when replacing by name); an unload ZEROES its slot instead
+      of renumbering. In-flight requests therefore stay pinned to
+      their adapter across any update.
+    * **Old stack intact on any error.** Loading, structure
+      validation, staging, and the ``adapter.load`` fault point all
+      fire before the engine sees anything.
+    * **Unload refuses while referenced.** AdapterInUse (409) while
+      any waiting/active request carries the id — a zeroed slot under
+      a live request would silently serve base-model outputs. (A
+      request that resolves the name and submits in the tick between
+      the check and the apply can still slip through — one
+      resolve-to-submit race, accepted; the prefix flush keeps its
+      pages from polluting the cache.)
+    * **Replacement drains.** Reloading a name in place changes the
+      values behind a possibly-referenced id, so the apply waits for
+      empty slots by default (drain=True); fresh ids apply immediately.
+    """
+
+    def __init__(self, engine, swap_mgr: 'WeightSwapManager',
+                 dtype: Optional[str] = None,
+                 reserved_names=(),
+                 on_change=None,
+                 registry: Optional['metrics_lib.MetricsRegistry'] = None
+                 ) -> None:
+        self.engine = engine
+        # Shared single-flight with swaps/reshards — one lock, three
+        # mutation planes, zero interleavings.
+        self._flight = swap_mgr._flight  # pylint: disable=protected-access
+        self._dtype = dtype or str(getattr(engine.cfg, 'dtype',
+                                           'bfloat16'))
+        self._reserved = set(reserved_names)
+        self._on_change = on_change
+        # name -> {'id', 'alpha', 'path', 'version', 'rank',
+        # 'loaded_at'}; per-name versions surface in /stats so the
+        # controller can converge "name@version" fleet-wide.
+        self._adapters: Dict[str, Dict[str, Any]] = {}
+        # Host trees retained per id: tiny (MBs) and they make a full
+        # rebuild possible when a new adapter's rank outgrows the
+        # stack's padding.
+        self._trees: Dict[int, tuple] = {}
+        # Every id that ever held an adapter: reusing one must flush
+        # the prefix cache (pages are salted by lora_id, and the salt
+        # would collide across occupants).
+        self._used_ids: set = set()
+        self.last: Optional[Dict[str, Any]] = None
+        reg = registry or getattr(engine, 'metrics_registry', None) \
+            or metrics_lib.REGISTRY
+        self._m_loaded = reg.gauge(
+            'skyt_infer_adapters_loaded',
+            'Adapters currently loaded on this replica (excluding the '
+            'id-0 base slot)')
+        self._m_loads = reg.counter(
+            'skyt_infer_adapter_loads_total',
+            'Adapter hot-load attempts by result (ok / aborted — '
+            'aborted leaves the old stack live)', ('result',))
+        self._m_unloads = reg.counter(
+            'skyt_infer_adapter_unloads_total',
+            'Adapter unload attempts by result (ok / refused — live '
+            'requests still reference the id / aborted)', ('result',))
+        self._m_loaded.set(0)
+
+    # ------------------------------------------------------------ seeding
+    def seed(self, specs) -> None:
+        """Boot-time adapters (--lora flags): register under the same
+        ids build_stack_from_specs assigned (spec order, 1-based) and
+        retain the host trees for future rebuilds. The engine already
+        holds the boot stack; this is bookkeeping only."""
+        from skypilot_tpu.infer import lora as lora_lib
+        for i, s in enumerate(specs, 1):
+            tree = lora_lib.load_adapter_dir(s.path)
+            self._trees[i] = (tree, float(s.alpha))
+            self._adapters[s.name] = {
+                'id': i, 'alpha': float(s.alpha), 'path': s.path,
+                'version': 1, 'rank': lora_lib.adapter_rank(tree),
+                'loaded_at': time.time()}
+            self._used_ids.add(i)
+        self._m_loaded.set(len(self._adapters))
+
+    def seed_names(self, name_ids: Dict[str, int]) -> None:
+        """Bookkeeping-only seed for engines handed a prebuilt stack
+        (tests, embedded use): ids registered without retained trees,
+        so a later rank-growing load needs every OTHER adapter
+        reloaded first (grafts within the stack's rank always work)."""
+        for name, lid in name_ids.items():
+            self._adapters[name] = {
+                'id': int(lid), 'alpha': None, 'path': None,
+                'version': 1, 'rank': None, 'loaded_at': time.time()}
+            self._used_ids.add(int(lid))
+        self._m_loaded.set(len(self._adapters))
+
+    # ------------------------------------------------------------ views
+    def name_ids(self) -> Dict[str, int]:
+        """{adapter name: stack id} — the server's routing map."""
+        return {n: a['id'] for n, a in self._adapters.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /stats 'adapters' block: per-adapter id/version/rank —
+        what the controller scrapes and the LB routes on."""
+        return {
+            'count': len(self._adapters),
+            'stack_slots': int(getattr(self.engine, 'num_adapters', 0)
+                               or 0),
+            'adapters': {
+                n: {'id': a['id'], 'version': a['version'],
+                    'alpha': a['alpha'], 'rank': a['rank'],
+                    'path': a['path']}
+                for n, a in self._adapters.items()},
+        }
+
+    # ------------------------------------------------------------- load
+    def load(self, name: str, checkpoint: Optional[str] = None,
+             params=None, alpha: float = 16.0,
+             drain: Optional[bool] = None) -> Dict[str, Any]:
+        """Stage + validate + apply one adapter load (new name) or
+        in-place replacement (existing name; same id, version bump).
+        Exactly one of `checkpoint` (an Orbax dir an `sft --lora-rank`
+        run wrote) or `params` (an adapter tree; tests and in-process
+        pushes) must be given. Raises SwapInFlight on concurrency,
+        WeightSwapError on any failure — the old stack is intact in
+        both cases."""
+        if not self._flight.acquire(blocking=False):
+            raise SwapInFlight(
+                'a weight swap, reshard, or adapter update is already '
+                'in flight on this replica')
+        try:
+            return self._load_locked(name, checkpoint, params, alpha,
+                                     drain)
+        finally:
+            self._flight.release()
+
+    def _load_locked(self, name, checkpoint, params, alpha,
+                     drain) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        from skypilot_tpu.infer import lora as lora_lib
+        try:
+            # Chaos hook (docs/robustness.md fault catalog): 'error'
+            # aborts the load with the old stack intact; latency/hang
+            # stretch the single-flight window (concurrent admin
+            # mutations then 409).
+            faults.inject('adapter.load', name=str(name),
+                          checkpoint=checkpoint or '', op='load')
+            if not isinstance(name, str) or not name:
+                raise WeightSwapError(
+                    'adapter name must be a non-empty string')
+            if name in self._reserved:
+                raise WeightSwapError(
+                    f'adapter name {name!r} collides with the served '
+                    f'model id')
+            if (checkpoint is None) == (params is None):
+                raise WeightSwapError(
+                    'exactly one of checkpoint= or params= is required')
+            if params is None:
+                try:
+                    tree = lora_lib.load_adapter_dir(checkpoint)
+                except Exception as e:
+                    raise WeightSwapError(
+                        f'loading adapter {checkpoint!r} failed: '
+                        f'{e}') from e
+            else:
+                tree = params
+            try:
+                rank = lora_lib.adapter_rank(tree)
+                alpha = float(alpha)
+            except Exception as e:
+                raise WeightSwapError(
+                    f'not a LoRA adapter tree: {e}') from e
+            replacing = name in self._adapters
+            if replacing:
+                aid = self._adapters[name]['id']
+            else:
+                limit = env.get_int('SKYT_ADAPTER_MAX', 32)
+                if len(self._adapters) >= limit:
+                    raise WeightSwapError(
+                        f'adapter limit reached ({limit} loaded; '
+                        f'raise SKYT_ADAPTER_MAX)')
+                taken = {a['id'] for a in self._adapters.values()}
+                aid = 1
+                while aid in taken:
+                    aid += 1
+            # The stack never shrinks (stable shapes = no retrace
+            # churn); it grows one slot at a time as ids append.
+            num_slots = max(int(getattr(self.engine, 'num_adapters',
+                                        0) or 0), aid + 1, 2)
+            stack = self._build_with(aid, tree, alpha, num_slots,
+                                     lora_lib)
+            # A layout/family mismatch must abort loudly BEFORE the
+            # engine sees anything (a mismatched projection would
+            # otherwise serve base outputs silently).
+            lora_lib.validate_stack(stack, self.engine.params['params'])
+            stack = self._stage_stack(stack)
+            if drain is None:
+                drain = replacing
+            flush = aid in self._used_ids
+            result = self.engine.request_adapter_update(
+                stack, num_adapters=num_slots, flush_prefix=flush,
+                drain=bool(drain))
+        except faults.FaultError as e:
+            self._abort_load(t0, name, checkpoint,
+                             f'injected fault: {e}')
+            raise WeightSwapError(
+                f'adapter load aborted (old stack intact): {e}') from e
+        except WeightSwapError as e:
+            self._abort_load(t0, name, checkpoint, str(e))
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            self._abort_load(t0, name, checkpoint, str(e))
+            raise WeightSwapError(
+                f'adapter load failed (old stack intact): {e}') from e
+        dur = time.perf_counter() - t0
+        self._trees[aid] = (tree, alpha)
+        version = self._adapters[name]['version'] + 1 if replacing \
+            else 1
+        self._adapters[name] = {
+            'id': aid, 'alpha': alpha, 'path': checkpoint,
+            'version': version, 'rank': rank, 'loaded_at': time.time()}
+        self._used_ids.add(aid)
+        self._m_loaded.set(len(self._adapters))
+        self._m_loads.labels('ok').inc()
+        self.last = {
+            'ok': True, 'op': 'load', 'name': name, 'id': aid,
+            'version': version, 'rank': rank, 'alpha': alpha,
+            'replaced': replacing, 'num_adapters': num_slots,
+            'flushed_prefix_pages': result['flushed_prefix_pages'],
+            'duration_s': round(dur, 4), 'apply_s': result['apply_s'],
+            'at': time.time(),
+        }
+        if self._on_change is not None:
+            self._on_change()
+        logger.info('adapter load ok: %r -> id %d v%d (rank %d, '
+                    'alpha %g) in %.3fs', name, aid, version, rank,
+                    alpha, dur)
+        return dict(self.last)
+
+    def _build_with(self, aid, tree, alpha, num_slots, lora_lib):
+        """The new stack with `tree` at slot `aid`: graft into the
+        live stack when the rank fits (no other trees needed), else a
+        full rebuild from retained trees."""
+        live = getattr(self.engine, '_lora_stack', None)
+        if live is None:
+            return lora_lib.build_stack_assigned(
+                {aid: (tree, alpha)}, num_slots, self._dtype)
+        try:
+            return lora_lib.graft_adapter(live, aid, tree, alpha)
+        except ValueError as graft_err:
+            assigned = {i: t for i, t in self._trees.items()
+                        if i != aid}
+            missing = sorted(
+                n for n, a in self._adapters.items()
+                if a['id'] != aid and a['id'] not in self._trees)
+            if missing:
+                raise WeightSwapError(
+                    f'cannot graft adapter ({graft_err}) and cannot '
+                    f'rebuild the stack: no retained trees for '
+                    f'{missing} (loaded before this registry; reload '
+                    f'them first)') from graft_err
+            assigned[aid] = (tree, alpha)
+            return lora_lib.build_stack_assigned(assigned, num_slots,
+                                                 self._dtype)
+
+    def _stage_stack(self, stack):
+        """Device-stage the new stack (replicated under a mesh —
+        adapters are tiny) fully materialized BEFORE the tick-boundary
+        apply, so the engine-side install is a reference assignment."""
+        if self.engine.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec
+            stack = jax.device_put(
+                stack, NamedSharding(self.engine.mesh,
+                                     PartitionSpec()))
+        try:
+            jax.block_until_ready(stack)
+        except Exception as e:  # pylint: disable=broad-except
+            # Best-effort pre-materialization only: a failed wait
+            # just moves the device copy to the tick-boundary apply.
+            logger.debug('adapter stack pre-stage wait failed: %s', e)
+        return stack
+
+    def _abort_load(self, t0, name, checkpoint, error: str) -> None:
+        self._m_loads.labels('aborted').inc()
+        self.last = {
+            'ok': False, 'op': 'load', 'name': name,
+            'checkpoint': checkpoint, 'error': error,
+            'duration_s': round(time.perf_counter() - t0, 4),
+            'at': time.time(),
+        }
+        logger.warning('adapter load %r aborted (old stack intact): '
+                       '%s', name, error)
+
+    # ----------------------------------------------------------- unload
+    def unload(self, name: str,
+               drain: Optional[bool] = None) -> Dict[str, Any]:
+        """Zero one adapter's slot (id retired until reused). Raises
+        AdapterInUse (409) while live requests reference the id,
+        SwapInFlight on concurrency, WeightSwapError otherwise — the
+        old stack is intact in every error case."""
+        if not self._flight.acquire(blocking=False):
+            raise SwapInFlight(
+                'a weight swap, reshard, or adapter update is already '
+                'in flight on this replica')
+        try:
+            return self._unload_locked(name, drain)
+        finally:
+            self._flight.release()
+
+    def _unload_locked(self, name, drain) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        from skypilot_tpu.infer import lora as lora_lib
+        aid = None
+        try:
+            faults.inject('adapter.load', name=str(name),
+                          checkpoint='', op='unload')
+            if name not in self._adapters:
+                raise WeightSwapError(
+                    f'adapter {name!r} is not loaded')
+            aid = self._adapters[name]['id']
+            if self.engine.adapter_in_use(aid):
+                raise AdapterInUse(
+                    f'adapter {name!r} (id {aid}) is still referenced '
+                    f'by live requests; retry after they drain')
+            live = getattr(self.engine, '_lora_stack', None)
+            if live is None:
+                raise WeightSwapError(
+                    'engine has no adapter stack loaded')
+            stack = self._stage_stack(lora_lib.zero_slot(live, aid))
+            result = self.engine.request_adapter_update(
+                stack,
+                num_adapters=int(self.engine.num_adapters),
+                flush_prefix=True,
+                drain=bool(drain) if drain is not None else False)
+        except AdapterInUse:
+            self._m_unloads.labels('refused').inc()
+            raise
+        except faults.FaultError as e:
+            self._abort_unload(t0, name, f'injected fault: {e}')
+            raise WeightSwapError(
+                f'adapter unload aborted (old stack intact): '
+                f'{e}') from e
+        except WeightSwapError as e:
+            self._abort_unload(t0, name, str(e))
+            raise
+        except Exception as e:  # pylint: disable=broad-except
+            self._abort_unload(t0, name, str(e))
+            raise WeightSwapError(
+                f'adapter unload failed (old stack intact): '
+                f'{e}') from e
+        dur = time.perf_counter() - t0
+        del self._adapters[name]
+        self._trees.pop(aid, None)
+        self._m_loaded.set(len(self._adapters))
+        self._m_unloads.labels('ok').inc()
+        self.last = {
+            'ok': True, 'op': 'unload', 'name': name, 'id': aid,
+            'flushed_prefix_pages': result['flushed_prefix_pages'],
+            'duration_s': round(dur, 4), 'apply_s': result['apply_s'],
+            'at': time.time(),
+        }
+        if self._on_change is not None:
+            self._on_change()
+        logger.info('adapter unload ok: %r (id %d freed) in %.3fs',
+                    name, aid, dur)
+        return dict(self.last)
+
+    def _abort_unload(self, t0, name, error: str) -> None:
+        self._m_unloads.labels('aborted').inc()
+        self.last = {
+            'ok': False, 'op': 'unload', 'name': name, 'error': error,
+            'duration_s': round(time.perf_counter() - t0, 4),
+            'at': time.time(),
+        }
+        logger.warning('adapter unload %r aborted (old stack intact): '
+                       '%s', name, error)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.ops import attention as attention_ops
+from skypilot_tpu.ops import lora as ops_lora
 from skypilot_tpu.ops import norms, rope
 from skypilot_tpu.utils import env as _env
 
@@ -333,17 +334,17 @@ def _lora_delta(mdl, name, x, lora_ids, lora_scale, dtype):
     variable collection — a [n_adapters, in, r] / [n_adapters, r, out]
     pair per projection at this module's scope, id 0 = zeros (no
     adapter) — and each sequence in the batch gathers its own A/B by
-    `lora_ids`. Two rank-r einsums per projection (~r/in of the main
-    matmul's FLOPs); returns None when no adapters are loaded so the
+    `lora_ids` ([B] per-sequence, or [B, S] per-token for ragged
+    prefill packs mixing adapters). The gather + two rank-r
+    contractions (~r/in of the main matmul's FLOPs) dispatch through
+    the ops/lora.py 'lora_grouped' ladder (fused Pallas kernel, exact
+    einsum floor); returns None when no adapters are loaded so the
     base path traces unchanged."""
     if lora_ids is None or not mdl.has_variable('lora', f'{name}_ab'):
         return None
     ab = mdl.get_variable('lora', f'{name}_ab')
-    a = jnp.take(ab['a'], lora_ids, axis=0).astype(dtype)  # [B, in, r]
-    b = jnp.take(ab['b'], lora_ids, axis=0).astype(dtype)  # [B, r, out]
-    t = jnp.einsum('bsi,bir->bsr', x, a)
-    d = jnp.einsum('bsr,bro->bso', t, b)
-    return d * lora_scale[:, None, None].astype(dtype)
+    return ops_lora.grouped_lora_delta(x.astype(dtype), ab['a'],
+                                       ab['b'], lora_ids, lora_scale)
 
 
 def _proj(mdl, cfg, dtype, lora_ids, lora_scale, name, feats, axes,

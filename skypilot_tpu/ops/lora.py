@@ -1,0 +1,299 @@
+"""Grouped multi-LoRA delta as a Pallas TPU kernel + dispatch ladder.
+
+The batched multi-LoRA delta (models/llama.py ``_lora_delta``) is two
+rank-r contractions per projection, preceded by a per-sequence gather
+of each request's A/B out of the stacked ``lora`` collection. The XLA
+path materializes the gathered [B, in, r] / [B, r, out] operands in
+HBM before contracting; this module fuses the gather INTO the kernel —
+the adapter id rides a scalar-prefetched BlockSpec index map (the same
+trick the paged attention kernels use for block tables), so each grid
+step DMAs only its own sequence's A/B slices straight from the stack.
+
+Two input shapes, one op (``lora_grouped`` in
+``skyt_ops_kernel_path_total``):
+
+* per-sequence ids (``lora_ids`` of shape [B] — the decode path and
+  uniform prefill rows): grid (B, S-blocks), A/B blocks selected by
+  ``ids[b]`` at index-map time; no accumulation, each grid step owns
+  its output block.
+* per-token ids (``lora_ids`` of shape [B, S] — ragged prefill packs
+  mixing adapters in one packed row): tokens flatten to [T, in] and
+  the grid becomes (T-blocks, adapters) with adapters innermost; each
+  adapter pass masks the token block to its own segments and
+  accumulates into the output block (init under ``pl.when(k == 0)``).
+
+The final rung is the pure-XLA floor: for per-sequence ids the exact
+gather-einsum the model ran before this op existed; for per-token ids
+a ``lax.scan`` over adapters with the same mask-and-accumulate math
+(gathering per token would materialize [B, S, in, r]). The per-id
+alpha/rank scale is applied OUTSIDE the kernels, as the floor's final
+multiply, so every rung shares that op byte-for-byte. Ladder
+selection, fault injection (``ops.lowering``), and path accounting
+ride ops/dispatch.py; block sizes are swept through the generic
+``autotune.sweep`` helper.
+"""
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from skypilot_tpu.ops import autotune
+from skypilot_tpu.ops import dispatch
+
+_CompilerParams = getattr(pltpu, 'CompilerParams',
+                          getattr(pltpu, 'TPUCompilerParams', None))
+
+OP = 'lora_grouped'
+
+# Candidate token/seq block extents, pruned per shape by legality.
+_CANDIDATE_BLOCKS = (128, 256, 512)
+_DEFAULT_BLOCK = 256
+
+
+def _interpret_mode() -> bool:
+    try:
+        return jax.devices()[0].platform != 'tpu'
+    except Exception:  # pylint: disable=broad-except
+        return True
+
+
+# ------------------------------------------------------------ kernels
+def _gather_kernel(ids_ref, x_ref, a_ref, b_ref, o_ref):
+    """Per-sequence ids: one grid step = one (sequence, seq-block);
+    the A/B blocks arriving here were already selected by ids[b] in
+    the BlockSpec index maps — the gather happened in the DMA."""
+    del ids_ref  # consumed by the index maps
+    x = x_ref[0]                               # [bs, in]
+    t = jnp.dot(x, a_ref[0].astype(x.dtype))   # [bs, r]
+    o_ref[0] = jnp.dot(t, b_ref[0].astype(x.dtype))
+
+
+def _grouped_kernel(x_ref, ids_ref, a_ref, b_ref, o_ref):
+    """Per-token ids: grid (T-blocks, adapters), adapters innermost so
+    the output block stays resident across the accumulation sweep.
+    Adapter 0 is the zeros no-op entry: its pass adds exact zeros, so
+    no special-casing is needed for parity with the floor."""
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    x = x_ref[:]                                   # [bt, in]
+    mask = (ids_ref[:] == k).astype(x.dtype)       # [bt, 1]
+    t = jnp.dot(x * mask, a_ref[0].astype(x.dtype))
+    o_ref[:] += jnp.dot(t, b_ref[0].astype(x.dtype))
+
+
+# ----------------------------------------------------- pallas wrappers
+@functools.partial(jax.jit, static_argnames=('block_s', 'interpret'))
+def _pallas_gather(x, a, b, lora_ids, lora_scale, block_s: int,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    bsz, seq, din = x.shape
+    r = a.shape[-1]
+    dout = b.shape[-1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bsz, seq // block_s),
+        in_specs=[
+            pl.BlockSpec((1, block_s, din), lambda bi, j, ids: (bi, j, 0)),
+            pl.BlockSpec((1, din, r), lambda bi, j, ids: (ids[bi], 0, 0)),
+            pl.BlockSpec((1, r, dout), lambda bi, j, ids: (ids[bi], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_s, dout),
+                               lambda bi, j, ids: (bi, j, 0)),
+    )
+    d = pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, seq, dout), x.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel')),
+        interpret=_interpret_mode() if interpret is None else interpret,
+    )(lora_ids.astype(jnp.int32), x, a, b)
+    return d * lora_scale[:, None, None].astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=('block_t', 'interpret'))
+def _pallas_grouped(x, a, b, lora_ids, lora_scale, block_t: int,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    bsz, seq, din = x.shape
+    n, _, r = a.shape
+    dout = b.shape[-1]
+    tok = bsz * seq
+    xt = x.reshape(tok, din)
+    ids = lora_ids.reshape(tok, 1).astype(jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(tok // block_t, n),
+        in_specs=[
+            pl.BlockSpec((block_t, din), lambda j, k: (j, 0)),
+            pl.BlockSpec((block_t, 1), lambda j, k: (j, 0)),
+            pl.BlockSpec((1, din, r), lambda j, k: (k, 0, 0)),
+            pl.BlockSpec((1, r, dout), lambda j, k: (k, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, dout), lambda j, k: (j, 0)),
+    )
+    d = pl.pallas_call(
+        _grouped_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tok, dout), x.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary')),
+        interpret=_interpret_mode() if interpret is None else interpret,
+    )(xt, ids, a, b)
+    return d.reshape(bsz, seq, dout) * \
+        lora_scale[..., None].astype(x.dtype)
+
+
+# --------------------------------------------------------- XLA floors
+def _xla_gather(x, a, b, lora_ids, lora_scale) -> jax.Array:
+    """The exact einsum path _lora_delta ran before this op existed —
+    the correctness floor per-sequence requests must stay byte-
+    identical to."""
+    dtype = x.dtype
+    ga = jnp.take(a, lora_ids, axis=0).astype(dtype)    # [B, in, r]
+    gb = jnp.take(b, lora_ids, axis=0).astype(dtype)    # [B, r, out]
+    t = jnp.einsum('bsi,bir->bsr', x, ga)
+    d = jnp.einsum('bsr,bro->bso', t, gb)
+    return d * lora_scale[:, None, None].astype(dtype)
+
+
+def _xla_grouped(x, a, b, lora_ids, lora_scale) -> jax.Array:
+    """Per-token floor: scan over adapters with mask-and-accumulate —
+    a per-token gather would materialize [B, S, in, r]. Adapter 0 is
+    skipped (zeros by construction; its tokens contribute exactly 0)."""
+    dtype = x.dtype
+    n = a.shape[0]
+    dout = b.shape[-1]
+    acc0 = jnp.zeros(x.shape[:2] + (dout,), dtype)
+    if n <= 1:
+        return acc0
+
+    def body(acc, k):
+        mask = (lora_ids == k).astype(dtype)            # [B, S]
+        t = jnp.einsum('bsi,ir->bsr', x * mask[..., None],
+                       a[k].astype(dtype))
+        d = jnp.einsum('bsr,ro->bso', t, b[k].astype(dtype))
+        return acc + d, None
+
+    acc, _ = jax.lax.scan(body, acc0, jnp.arange(1, n))
+    return acc * lora_scale[..., None].astype(dtype)
+
+
+# ------------------------------------------------------------ autotune
+def _tune_key(mode: str, tokens: int, din: int, r: int, dout: int,
+              n: int, dtype) -> str:
+    bucket = (f'{mode}.t{dispatch.shape_bucket(tokens)}.i{din}.r{r}'
+              f'.o{dout}.n{dispatch.shape_bucket(n)}')
+    return (f'{dispatch.device_kind()}|{OP}|{bucket}'
+            f'|{jnp.dtype(dtype).name}')
+
+
+def _block_candidates(dim: int, dtype) -> Tuple[int, ...]:
+    mult = dispatch.sublane_multiple(dtype)
+    out = []
+    for want in _CANDIDATE_BLOCKS:
+        cand = dispatch.choose_block(dim, want, mult)
+        if cand not in out:
+            out.append(cand)
+    if dim not in out:
+        out.append(dim)
+    return tuple(out)
+
+
+def _tuned_block(mode: str, dim: int, tokens: int, din: int, r: int,
+                 dout: int, n: int, dtype) -> int:
+    """Trace-time cache read: tuned block extent, else the clamped
+    default. Shapes are concrete even on tracers."""
+    entry = autotune.get_cache().get(
+        _tune_key(mode, tokens, din, r, dout, n, dtype))
+    if entry:
+        try:
+            blk = int(entry['block'])
+            if dispatch.block_dim_ok(blk, dim,
+                                     dispatch.sublane_multiple(dtype)):
+                return blk
+        except (KeyError, TypeError, ValueError):
+            pass   # stale/hand-edited entry: behave as a miss
+    return dispatch.choose_block(dim, _DEFAULT_BLOCK,
+                                 dispatch.sublane_multiple(dtype))
+
+
+def maybe_sweep_lora(x, a, b, lora_ids, lora_scale) -> None:
+    """Sweep block extents for this shape if enabled, concrete, and
+    not already cached (autotune.sweep semantics: cache-hit skip,
+    failures skipped, all-fail negative-cached)."""
+    if not autotune.enabled() or dispatch.is_tracer(x):
+        return
+    bsz, seq, din = x.shape
+    n, _, r = a.shape
+    dout = b.shape[-1]
+    per_token = lora_ids.ndim == 2
+    mode = 'tok' if per_token else 'seq'
+    dim = bsz * seq if per_token else seq
+    tokens = bsz * seq
+    key = _tune_key(mode, tokens, din, r, dout, n, x.dtype)
+
+    def run(cand):
+        if per_token:
+            out = _pallas_grouped(x, a, b, lora_ids, lora_scale, cand)
+        else:
+            out = _pallas_gather(x, a, b, lora_ids, lora_scale, cand)
+        out.block_until_ready()
+
+    autotune.sweep(OP, key, _block_candidates(dim, x.dtype), run,
+                   lambda cand: {'block': cand})
+
+
+# ------------------------------------------------------------ dispatch
+def _vmem_bytes(block: int, din: int, r: int, dout: int,
+                itemsize: int) -> int:
+    """Per-invocation VMEM working set: x/out token blocks + one
+    adapter's A/B + the rank-r intermediate."""
+    io = (block * din + block * dout + din * r + r * dout) * itemsize
+    return io + block * r * itemsize
+
+
+def grouped_lora_delta(x, a, b, lora_ids, lora_scale) -> jax.Array:
+    """Batched multi-LoRA delta through the dispatch ladder.
+
+    x: [B, S, in] activations (model dtype); a: [N, in, r] stacked
+    down-projections; b: [N, r, out]; lora_ids: [B] (per-sequence) or
+    [B, S] (per-token, ragged mixed packs) int adapter ids;
+    lora_scale: alpha/rank per id, same shape as lora_ids. Returns the
+    [B, S, out] delta in x's dtype."""
+    maybe_sweep_lora(x, a, b, lora_ids, lora_scale)
+    bsz, seq, din = x.shape
+    n, _, r = a.shape
+    dout = b.shape[-1]
+    per_token = lora_ids.ndim == 2
+    itemsize = jnp.dtype(x.dtype).itemsize
+    mult = dispatch.sublane_multiple(x.dtype)
+    tokens = bsz * seq
+
+    rungs = []
+    if per_token:
+        blk = _tuned_block('tok', tokens, tokens, din, r, dout, n,
+                           x.dtype)
+        if dispatch.block_dim_ok(blk, tokens, mult) and \
+                _vmem_bytes(blk, din, r, dout, itemsize) <= \
+                dispatch.VMEM_BUDGET_BYTES:
+            rungs.append(('pallas', functools.partial(
+                _pallas_grouped, x, a, b, lora_ids, lora_scale, blk)))
+        rungs.append(('xla', functools.partial(
+            _xla_grouped, x, a, b, lora_ids, lora_scale)))
+    else:
+        blk = _tuned_block('seq', seq, tokens, din, r, dout, n,
+                           x.dtype)
+        if dispatch.block_dim_ok(blk, seq, mult) and \
+                _vmem_bytes(blk, din, r, dout, itemsize) <= \
+                dispatch.VMEM_BUDGET_BYTES:
+            rungs.append(('pallas', functools.partial(
+                _pallas_gather, x, a, b, lora_ids, lora_scale, blk)))
+        rungs.append(('xla', functools.partial(
+            _xla_gather, x, a, b, lora_ids, lora_scale)))
+    return dispatch.run_ladder(OP, rungs)

@@ -130,6 +130,10 @@ class SkyServeController:
                 # In-place elastic reshard: same one-replica-per-tick
                 # discipline; no-op without an active reshard.
                 self.replica_manager.reshard_tick()
+                # Fleet-wide adapter convergence (docs/serving.md
+                # "Adapter fleet"): same discipline again; no-op
+                # without an active update.
+                self.replica_manager.adapter_tick()
                 ready = len(self.replica_manager.ready_urls())
                 decision = self.autoscaler.evaluate_scaling(ready)
                 ondemand_base = getattr(self.autoscaler, 'ondemand_base',
@@ -215,6 +219,12 @@ class SkyServeController:
         wv = self.replica_manager.ready_weight_versions()
         if wv:
             resp['replica_weight_versions'] = wv
+        # Per-replica adapter sets (docs/serving.md "Adapter fleet"):
+        # the LB routes model-named requests only to replicas whose
+        # set carries the adapter, and answers /v1/models fleet-wide.
+        adapters = self.replica_manager.ready_adapters()
+        if adapters:
+            resp['replica_adapters'] = adapters
         # Peer discovery (docs/serving.md "N-active front door"): the
         # registered-LB list rides every sync so N-active LBs learn
         # each other's advertise URLs without manual --lb-peers lists.
@@ -341,6 +351,45 @@ class SkyServeController:
             return web.json_response({'error': str(e)}, status=409)
         return web.json_response({'ok': True, 'reshard': status})
 
+    async def _handle_adapters(self, request: web.Request
+                               ) -> web.Response:
+        """``POST /controller/adapters`` — converge one adapter
+        load/unload across the fleet, one replica per control tick
+        (docs/serving.md "Adapter fleet"). Body:
+        ``{"op": "load"|"unload", "name": n, "checkpoint": dir?,
+        "alpha": f?, "drain": bool?}``. 409 while a rollout, reshard,
+        or another adapter update is active; 400 on a malformed body.
+        Progress rides /controller/status under 'adapter_update'."""
+        try:
+            payload = await request.json()
+        except ValueError:
+            payload = None
+        if not isinstance(payload, dict):
+            return web.json_response(
+                {'error': 'body must be a JSON object'}, status=400)
+        alpha = payload.get('alpha', 16.0)
+        if isinstance(alpha, bool) or \
+                not isinstance(alpha, (int, float)):
+            return web.json_response(
+                {'error': f'alpha must be a number, got {alpha!r}'},
+                status=400)
+        drain = payload.get('drain')
+        if drain is not None and not isinstance(drain, bool):
+            return web.json_response(
+                {'error': f'drain must be a boolean, got {drain!r}'},
+                status=400)
+        from skypilot_tpu import exceptions
+        try:
+            status = self.replica_manager.start_adapter_update(
+                payload.get('op', 'load'), payload.get('name'),
+                checkpoint=payload.get('checkpoint'),
+                alpha=float(alpha), drain=drain)
+        except exceptions.SkyTpuError as e:
+            busy = 'in progress' in str(e) or 'already' in str(e)
+            return web.json_response({'error': str(e)},
+                                     status=409 if busy else 400)
+        return web.json_response({'ok': True, 'adapter_update': status})
+
     async def _handle_status(self, request: web.Request) -> web.Response:
         del request
         replicas = []
@@ -372,6 +421,10 @@ class SkyServeController:
             # the in-flight reshard, mirrored into `serve status`.
             'autoscaler': self.autoscaler.status(),
             'reshard': self.replica_manager.reshard_status(),
+            # Adapter fleet: the in-flight convergence, mirrored into
+            # `serve status` beside the reshard.
+            'adapter_update':
+                self.replica_manager.adapter_update_status(),
         })
 
     async def _handle_metrics(self, request: web.Request) -> web.Response:
@@ -432,6 +485,8 @@ class SkyServeController:
                             self._handle_rolling_update)
         app.router.add_post('/controller/reshard',
                             self._handle_reshard)
+        app.router.add_post('/controller/adapters',
+                            self._handle_adapters)
         app.router.add_post('/controller/terminate',
                             self._handle_terminate)
         app.router.add_get('/controller/status', self._handle_status)

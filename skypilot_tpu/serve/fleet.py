@@ -525,6 +525,81 @@ class FleetTelemetry:
                                               replicas=len(replicas)),
         }
 
+    def adapter_report(self, window_s: Optional[float] = None,
+                       now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /fleet/adapters`` body (docs/serving.md "Adapter
+        fleet"): the ledger rolled up per MODEL — attributed
+        chip-seconds, good tokens and chip-seconds-per-good-token
+        summed across every (class, tenant) slice that named the
+        model — plus per-replica hosted-adapter counts and the
+        windowed hot-load/unload outcomes. Same attribution caveat as
+        capacity_report: slices are a cost allocation by token
+        weights, not isolated measurements. The model enumeration is
+        bounded: model labels only ever come from the base id or a
+        loaded adapter name (server-side resolution)."""
+        if now is None:
+            now = self._clock()
+        if window_s is None:
+            window_s = env.get_float('SKYT_CAPACITY_WINDOW_S', 300.0)
+        chips_per_replica = env.get_float(
+            'SKYT_FLEET_CHIPS_PER_REPLICA', 1.0)
+        replicas = self.live_replicas(now)
+        with self._lock:
+            stores = [(t, self._stores[t]) for t in replicas
+                      if t in self._stores]
+        models = set()
+        for _t, store in stores:
+            for name, labels in store.series_keys():
+                if name == 'skyt_capacity_attributed_seconds_total':
+                    models.add(labels.get('model', ''))
+        adapters: Dict[str, Dict[str, Any]] = {}
+        for model in sorted(models):
+            match = {'model': model}
+            attr_s = self.sum_delta(
+                'skyt_capacity_attributed_seconds_total', match,
+                window_s, now)
+            tokens = self.sum_delta(
+                'skyt_capacity_tokens_total', match, window_s, now)
+            good = self.sum_delta(
+                'skyt_capacity_good_tokens_total', match, window_s,
+                now)
+            chip_s = (attr_s or 0.0) * chips_per_replica
+            adapters[model or '<unlabeled>'] = {
+                'attributed_chip_seconds': round(chip_s, 6),
+                'tokens': tokens or 0.0,
+                'good_tokens': good or 0.0,
+                'chip_seconds_per_good_token': (
+                    round(chip_s / good, 9)
+                    if chip_s > 0 and good else None),
+            }
+        # Hosting + churn: latest stacked-adapter count per replica
+        # and the fleet-summed load/unload outcomes in the window.
+        hosted: Dict[str, int] = {}
+        churn: Dict[str, Dict[str, float]] = {}
+        for fam, key in (('skyt_infer_adapter_loads_total', 'loads'),
+                         ('skyt_infer_adapter_unloads_total',
+                          'unloads')):
+            by_result: Dict[str, float] = {}
+            for _t, store in stores:
+                for result, inc in store.grouped_delta(
+                        fam, 'result', window_s, now=now).items():
+                    by_result[result] = (by_result.get(result, 0.0)
+                                         + inc)
+            churn[key] = by_result
+        for target, store in stores:
+            point = store.latest('skyt_infer_adapters_loaded', {})
+            if point is not None:
+                hosted[target] = int(point[1])
+        return {
+            'service': self.service_name,
+            'window_s': window_s,
+            'chips_per_replica': chips_per_replica,
+            'replicas': len(replicas),
+            'adapters': adapters,
+            'hosted_per_replica': hosted,
+            'churn': churn,
+        }
+
     def _dcn_busbw_gbps(self) -> Tuple[Optional[float], str]:
         """Measured DCN bandwidth for the advisor's transfer cost:
         the bottleneck (min) pair busbw across this controller host's
@@ -835,6 +910,25 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
                                     window_s=window_f))
         return web.json_response(payload)
 
+    async def fleet_adapters(request: web.Request) -> web.Response:
+        """Adapter-fleet rollup (docs/serving.md "Adapter fleet"):
+        per-adapter chip-seconds-per-good-token from the capacity
+        ledger, hosted-adapter counts, and hot-load churn."""
+        window = request.query.get('window_s')
+        try:
+            window_f = float(window) if window else None
+            if window_f is not None and window_f <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {'error': f'window_s must be a positive number, got '
+                          f'{window!r}'}, status=400)
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, functools.partial(telemetry.adapter_report,
+                                    window_s=window_f))
+        return web.json_response(payload)
+
     async def fleet_kv(request: web.Request) -> web.Response:
         """KV-economy aggregate (docs/performance.md "Tiered prefix
         cache"): per-replica resident prefix pages / occupancy and
@@ -900,6 +994,7 @@ def add_fleet_routes(app, telemetry: 'FleetTelemetry',
     app.router.add_get('/fleet/slo', fleet_slo)
     app.router.add_get('/fleet/comms', fleet_comms)
     app.router.add_get('/fleet/capacity', fleet_capacity)
+    app.router.add_get('/fleet/adapters', fleet_adapters)
     app.router.add_get('/fleet/kv', fleet_kv)
     app.router.add_get('/fleet/interference', fleet_interference)
     app.router.add_get('/fleet/postmortems', fleet_postmortems)

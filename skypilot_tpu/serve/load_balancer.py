@@ -167,6 +167,12 @@ class LBState:
     # the front door (docs/robustness.md "Zero-downtime rollouts").
     replica_weight_version: Dict[str, int] = dataclasses.field(
         default_factory=dict)
+    # Per-replica loaded-adapter sets ({replica: {name: version}})
+    # from the controller sync (docs/serving.md "Adapter fleet") —
+    # model-named requests route only to replicas hosting the
+    # adapter, and the aggregated /v1/models answers fleet-wide.
+    replica_adapters: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
     # time.monotonic() of the last successful controller sync; 0.0 =
     # never synced (fresh process: nothing to be stale ABOUT).
     synced_at: float = 0.0
@@ -185,8 +191,29 @@ class LBState:
                                self.replica_prefix_cache,
                            'replica_weight_version':
                                self.replica_weight_version,
+                           'replica_adapters': self.replica_adapters,
                            'age_s': round(self.age_s(), 3),
                            'version': self.version})
+
+    @staticmethod
+    def _parse_adapters(raw) -> Dict[str, Dict[str, int]]:
+        """Garbage-tolerant {replica: {adapter: version}} parse — a
+        peer speaking a different schema (or plain garbage) must never
+        crash the gossip/sync path, it just contributes nothing."""
+        out: Dict[str, Dict[str, int]] = {}
+        if not isinstance(raw, dict):
+            return out
+        for rep, named in raw.items():
+            if not isinstance(named, dict):
+                continue
+            entry: Dict[str, int] = {}
+            for name, v in named.items():
+                try:
+                    entry[str(name)] = int(v)
+                except (TypeError, ValueError):
+                    continue
+            out[str(rep)] = entry
+        return out
 
     @classmethod
     def from_json(cls, text: str) -> 'LBState':
@@ -204,6 +231,8 @@ class LBState:
             replica_qos=d.get('replica_qos') or {},
             replica_prefix_cache=d.get('replica_prefix_cache') or {},
             replica_weight_version=wv,
+            replica_adapters=cls._parse_adapters(
+                d.get('replica_adapters')),
             version=int(d.get('version', 0)))
         # Imported snapshots carry an age, not a foreign monotonic
         # stamp (monotonic clocks don't transfer between processes).
@@ -590,6 +619,14 @@ class SkyServeLoadBalancer:
             'Weight version each ready replica is serving (from the '
             'controller sync; mixed values = a rolling update is in '
             'its canary/bake window)', ('lb', 'replica'))
+        # Adapter fleet (docs/serving.md "Adapter fleet"): how many
+        # adapters each ready replica hosts — mixed values mid-
+        # convergence are the front door's view of a partial rollout.
+        self._m_replica_adapters = reg.gauge(
+            'skyt_lb_replica_adapters',
+            'Loaded adapters on each ready replica (from the '
+            'controller sync; mixed values = an adapter fleet update '
+            'is converging)', ('lb', 'replica'))
         # Control-plane crash tolerance: the synced world view lives in
         # one LBState snapshot; on sync failure the LB serves from the
         # stale snapshot (bounded by SKYT_LB_STALE_TTL_S, with its own
@@ -686,6 +723,10 @@ class SkyServeLoadBalancer:
         self._session: Optional[aiohttp.ClientSession] = None
         self._sync_task: Optional[asyncio.Task] = None
         self._gossip_task: Optional[asyncio.Task] = None
+        # Base-model id, learned from the first aggregated /v1/models
+        # answer — the honest fleet-wide model_not_found check must
+        # never 404 the base model.
+        self._base_model_id: Optional[str] = None
 
     @property
     def _replica_qos(self) -> Dict[str, dict]:
@@ -768,6 +809,8 @@ class SkyServeLoadBalancer:
                         replica_prefix_cache=rpc
                         if isinstance(rpc, dict) else {},
                         replica_weight_version=wv,
+                        replica_adapters=LBState._parse_adapters(
+                            data.get('replica_adapters')),
                         synced_at=time.monotonic(),
                         version=self.state.version + 1))
                     self._discover_peers(data.get('lbs'))
@@ -824,6 +867,15 @@ class SkyServeLoadBalancer:
         for replica, wv in state.replica_weight_version.items():
             self._m_weight_version.labels(self.lb_id,
                                           replica).set(int(wv))
+        # Adapter-count gauges too: one series per replica reporting
+        # an adapter set, pruned with the snapshot.
+        for key in self._m_replica_adapters.label_keys():
+            if key[0] == self.lb_id and \
+                    key[1] not in state.replica_adapters:
+                self._m_replica_adapters.remove_labels(*key)
+        for replica, named in state.replica_adapters.items():
+            self._m_replica_adapters.labels(self.lb_id,
+                                            replica).set(len(named))
         if source != 'controller':
             return
         if self._stale:
@@ -880,6 +932,7 @@ class SkyServeLoadBalancer:
             replica_prefix_cache=dict(self.state.replica_prefix_cache),
             replica_weight_version=dict(
                 self.state.replica_weight_version),
+            replica_adapters=dict(self.state.replica_adapters),
             synced_at=self.state.synced_at,
             version=self.state.version)
 
@@ -1180,6 +1233,7 @@ class SkyServeLoadBalancer:
             replica_qos=dict(best.replica_qos),
             replica_prefix_cache=dict(best.replica_prefix_cache),
             replica_weight_version=dict(best.replica_weight_version),
+            replica_adapters=dict(best.replica_adapters),
             synced_at=best.synced_at,
             version=best.version), source='peer')
 
@@ -1340,10 +1394,117 @@ class SkyServeLoadBalancer:
             text = ','.join(str(t) for t in payload['tokens'])
         if not text:
             return None
+        # The adapter id folds into the key (docs/serving.md "Adapter
+        # fleet"): replicas salt prefix-cache pages by lora_id, so the
+        # same prompt under two models has two disjoint page sets —
+        # homing them together would halve both hit rates.
+        model = payload.get('model')
+        if isinstance(model, str) and model:
+            text = f'model:{norm(model)}\n{text}'
         n = env.get_int('SKYT_LB_AFFINITY_PREFIX_BYTES', 1024,
                         minimum=1)
         return hashlib.sha256(
             text.encode('utf-8', 'surrogateescape')[:n]).hexdigest()[:16]
+
+    def _request_model(self, body: bytes) -> Optional[str]:
+        """The request body's 'model' field — parsed only when the
+        synced world view carries adapter sets at all (non-engine
+        services never pay the JSON parse)."""
+        if not body or not self.state.replica_adapters:
+            return None
+        try:
+            payload = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            return None
+        if not isinstance(payload, dict):
+            return None
+        model = payload.get('model')
+        return model if isinstance(model, str) and model else None
+
+    def _adapter_hosts(self, model: str) -> Set[str]:
+        """Replicas whose last-synced adapter set carries `model`."""
+        return {rep for rep, named
+                in self.state.replica_adapters.items()
+                if model in named}
+
+    def _adapter_avoid_for(self, model: Optional[str]) -> Set[str]:
+        """Replicas to soft-avoid for a model-named request: every
+        replica that reported an adapter set WITHOUT the adapter.
+        Empty when the model is unnamed or hosted nowhere (then it is
+        the base model, a 404, or our view is stale — all cases where
+        steering would only thrash). Soft like _qos_avoid_for: dropped
+        when honoring it would leave nothing to pick."""
+        if model is None:
+            return set()
+        hosts = self._adapter_hosts(model)
+        if not hosts:
+            return set()
+        return {rep for rep in self.state.replica_adapters
+                if rep not in hosts}
+
+    def _model_not_found(self, model: Optional[str]
+                         ) -> Optional[web.Response]:
+        """The honest fleet-wide 404 (docs/serving.md "Adapter
+        fleet"): a model name NO replica hosts — and that is not the
+        base model — answers model_not_found at the front door
+        instead of proxying to a replica that would 404 anyway.
+        Requires a live (non-stale) view and a learned base-model id
+        (from the aggregated /v1/models); otherwise the replica's own
+        404 stays the source of truth."""
+        if model is None or self._stale or \
+                not self.state.replica_adapters or \
+                self._base_model_id is None or \
+                model == self._base_model_id or \
+                self._adapter_hosts(model):
+            return None
+        return web.json_response(
+            {'error': {'message': f'model {model!r} not found on any '
+                                  f'replica',
+                       'type': 'invalid_request_error',
+                       'code': 'model_not_found'}}, status=404)
+
+    async def _models(self, request: web.Request) -> web.Response:
+        """Aggregated ``GET /v1/models``: the base entry proxied from
+        any ready replica, plus the UNION of every replica's adapter
+        set — a client asking the front door sees every model the
+        fleet can serve, not one replica's slice. Also how the LB
+        learns the base-model id its honest-404 check needs."""
+        del request
+        base_entries = []
+        if self._session is not None:
+            for replica in list(self.policy.ready_replicas):
+                try:
+                    async with self._session.get(
+                            replica + '/v1/models',
+                            timeout=aiohttp.ClientTimeout(
+                                total=2)) as resp:
+                        if resp.status != 200:
+                            continue
+                        data = await resp.json()
+                except Exception:  # pylint: disable=broad-except
+                    continue
+                entries = data.get('data') \
+                    if isinstance(data, dict) else None
+                if not isinstance(entries, list):
+                    continue
+                base_entries = [e for e in entries
+                                if isinstance(e, dict) and
+                                not e.get('parent')]
+                if base_entries:
+                    self._base_model_id = str(
+                        base_entries[0].get('id'))
+                break
+        fleet: Dict[str, int] = {}
+        for named in self.state.replica_adapters.values():
+            for name in named:
+                fleet[name] = fleet.get(name, 0) + 1
+        adapters = [{'id': name, 'object': 'model',
+                     'owned_by': 'skypilot-tpu',
+                     'parent': self._base_model_id,
+                     'replicas': fleet[name]}
+                    for name in sorted(fleet)]
+        return web.json_response({'object': 'list',
+                                  'data': base_entries + adapters})
 
     def _pick_replica_once(self, tried: Set[str],
                            qos_avoid: Optional[Set[str]] = None,
@@ -1486,6 +1647,15 @@ class SkyServeLoadBalancer:
             self._note_recent(self._recent_demand, now, qos_cls)
         self._cap_timestamps()
         body = await request.read()
+        # Model-aware routing (docs/serving.md "Adapter fleet"): a
+        # 'model'-named request soft-avoids replicas not hosting the
+        # adapter, and a name NO replica hosts 404s honestly at the
+        # front door.
+        req_model = self._request_model(body)
+        not_found = self._model_not_found(req_model)
+        if not_found is not None:
+            return not_found
+        adapter_avoid = self._adapter_avoid_for(req_model)
         # Affinity inputs (prefix_affinity policy only — other
         # policies never pay the body parse): the sticky session id
         # and the prompt-prefix hash key.
@@ -1535,7 +1705,8 @@ class SkyServeLoadBalancer:
                             no_replica_deadline if attempt == 0
                             else deadline,
                             qos_avoid=self._qos_avoid_for(qos_cls) |
-                            self._peer_breaker_avoid(),
+                            self._peer_breaker_avoid() |
+                            adapter_avoid,
                             key=affinity_key, session=session_id)
                     except ConnectionResetError:
                         pick.set_attribute('error', 'client gone')
@@ -1861,6 +2032,7 @@ class SkyServeLoadBalancer:
         app.router.add_get('/debug/lb_state', self._debug_lb_state)
         app.router.add_post('/lb/gossip', self._handle_gossip)
         app.router.add_get('/metrics', self._metrics)
+        app.router.add_get('/v1/models', self._models)
         app.router.add_route('*', '/{path:.*}', self._proxy)
         return app
 

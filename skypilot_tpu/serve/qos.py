@@ -217,24 +217,48 @@ def _class_weights() -> Dict[str, float]:
     return out
 
 
+def _model_weights() -> Dict[str, float]:
+    """SKYT_QOS_MODEL_WEIGHTS='summarize:4,translate:1' — the DRR
+    quantum multiplier per served model/adapter name (docs/serving.md
+    "Adapter fleet"), multiplied with the class weight. Unlisted
+    models weigh 1.0; malformed entries are dropped (model names are
+    operator-chosen, so unlike class weights any key is legal)."""
+    out: Dict[str, float] = {}
+    raw = env.get('SKYT_QOS_MODEL_WEIGHTS', '')
+    for part in (p for p in raw.split(',') if p.strip()):
+        k, sep, v = part.partition(':')
+        try:
+            if not sep or not k.strip():
+                raise ValueError
+            out[k.strip()] = max(float(v), 0.001)
+        except ValueError:
+            logger.warning('ignoring malformed SKYT_QOS_MODEL_WEIGHTS '
+                           'entry %r', part)
+    return out
+
+
 class FairQueue:
     """Deficit-round-robin weighted fair queue with strict class
     priority and aging (the scheduling core; ClassedRequestQueue
     adapts it to the engine's queue.Queue contract).
 
-    Items are grouped into FLOWS keyed (class, tenant). A flow's BAND
+    Items are grouped into FLOWS keyed (class, tenant, model) — the
+    model key (docs/serving.md "Adapter fleet") isolates adapters
+    within a tenant, so one adapter's burst queues behind its own
+    flow instead of starving the tenant's other models. A flow's BAND
     is its class rank minus the aging credit of its oldest item
     (``wait // aging_s``) — unbounded below, so a starved batch flow
     eventually outranks fresh interactive traffic (no starvation).
     pop() serves the lowest band; within a band, classic DRR over the
     flows in first-arrival order: each visit grants
-    ``quantum * class_weight`` deficit, a flow emits while its deficit
-    covers its head's cost, and an emptied flow forfeits its deficit.
-    FIFO within a flow, always."""
+    ``quantum * class_weight * model_weight`` deficit, a flow emits
+    while its deficit covers its head's cost, and an emptied flow
+    forfeits its deficit. FIFO within a flow, always."""
 
     def __init__(self, quantum: Optional[float] = None,
                  aging_s: Optional[float] = None,
                  weights: Optional[Dict[str, float]] = None,
+                 model_weights: Optional[Dict[str, float]] = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.quantum = (quantum if quantum is not None
                         else env.get_float('SKYT_QOS_QUANTUM', 256.0))
@@ -243,6 +267,9 @@ class FairQueue:
                         else env.get_float('SKYT_QOS_AGING_S', 30.0))
         self.aging_s = max(self.aging_s, 0.001)
         self.weights = dict(weights or _class_weights())
+        self.model_weights = dict(model_weights
+                                  if model_weights is not None
+                                  else _model_weights())
         self._clock = clock
         # flow key -> deque[(item, cost, seq, enq_t)]
         self._flows: 'collections.OrderedDict[tuple, collections.deque]' \
@@ -257,13 +284,14 @@ class FairQueue:
     def push(self, item: Any, cls: str = DEFAULT_CLASS,
              tenant: str = DEFAULT_TENANT, cost: float = 1.0,
              seq: Optional[int] = None,
-             t: Optional[float] = None) -> None:
+             t: Optional[float] = None,
+             model: str = '') -> None:
         if cls not in CLASS_RANK:
             cls = DEFAULT_CLASS
         if seq is None:
             seq = self._seq
             self._seq += 1
-        flow = (cls, tenant)
+        flow = (cls, tenant, model)
         dq = self._flows.get(flow)
         if dq is None:
             dq = collections.deque()
@@ -292,8 +320,8 @@ class FairQueue:
 
     def depths(self) -> Dict[str, int]:
         out = {c: 0 for c in PRIORITIES}
-        for (cls, _t), dq in self._flows.items():
-            out[cls] += len(dq)
+        for flow, dq in self._flows.items():
+            out[flow[0]] += len(dq)
         return out
 
     def pop(self, now: Optional[float] = None) -> Optional[Any]:
@@ -322,8 +350,9 @@ class FairQueue:
                         del self._deficit[flow]
                     return item
             for flow in cand:
-                self._deficit[flow] += \
-                    self.quantum * self.weights.get(flow[0], 1.0)
+                self._deficit[flow] += (
+                    self.quantum * self.weights.get(flow[0], 1.0) *
+                    self.model_weights.get(flow[2], 1.0))
 
     def drain(self, now: Optional[float] = None) -> List[Any]:
         """Full scheduling order (consumes the queue)."""
@@ -344,6 +373,10 @@ class RequestMeta:
     cost: float
     seq: int
     enq_t: float
+    # Served model/adapter name (docs/serving.md "Adapter fleet") —
+    # the third flow key; '' (the default, and pre-adapter callers)
+    # collapses to per-(class, tenant) flows as before.
+    model: str = ''
 
 
 class ClassedRequestQueue(queue.Queue):
@@ -380,6 +413,7 @@ class ClassedRequestQueue(queue.Queue):
         self._aging_s = (aging_s if aging_s is not None
                          else env.get_float('SKYT_QOS_AGING_S', 30.0))
         self._weights = dict(weights or _class_weights())
+        self._model_weights = _model_weights()
         self._halflife = (debt_halflife_s if debt_halflife_s is not None
                           else env.get_float('SKYT_QOS_DEBT_HALFLIFE_S',
                                           30.0))
@@ -392,8 +426,8 @@ class ClassedRequestQueue(queue.Queue):
         item = self.queue.popleft()
         try:
             m = self._meta(item)
-            self._debt[(m.cls, m.tenant)] = \
-                self._debt.get((m.cls, m.tenant), 0.0) + m.cost
+            flow = (m.cls, m.tenant, m.model)
+            self._debt[flow] = self._debt.get(flow, 0.0) + m.cost
         except Exception:  # pylint: disable=broad-except
             logger.exception('qos meta extraction failed on pop')
         return item
@@ -410,11 +444,13 @@ class ClassedRequestQueue(queue.Queue):
 
     def _schedule(self, items: List[Any], now: float) -> List[Any]:
         fq = FairQueue(quantum=self._quantum, aging_s=self._aging_s,
-                       weights=self._weights, clock=lambda: now)
+                       weights=self._weights,
+                       model_weights=self._model_weights,
+                       clock=lambda: now)
         for item in items:
             m = self._meta(item)
             fq.push(item, m.cls, m.tenant, m.cost, seq=m.seq,
-                    t=m.enq_t)
+                    t=m.enq_t, model=m.model)
         fq.seed_debt(self._debt)
         return fq.drain(now)
 
@@ -592,14 +628,17 @@ class ServerQoS:
         self._m_requests = reg.counter(
             'skyt_qos_requests_total',
             'Requests through QoS admission', ('class',))
+        # The 'model' label (docs/serving.md "Adapter fleet") is the
+        # RESOLVED base-model id or loaded-adapter name — a bounded
+        # set (SKYT_ADAPTER_MAX + 1), never the raw request string.
         self._m_shed = reg.counter(
             'skyt_qos_shed_total',
             'Requests shed by the overload controller (429)',
-            ('class',))
+            ('class', 'model'))
         self._m_throttled = reg.counter(
             'skyt_qos_throttled_total',
-            'Requests throttled by the per-tenant token bucket (429)',
-            ('class',))
+            'Requests throttled by the per-(tenant, model) token '
+            'bucket (429)', ('class', 'model'))
         self._m_degraded = reg.counter(
             'skyt_qos_degraded_total',
             'Requests admitted with degraded limits (max_tokens '
@@ -609,10 +648,14 @@ class ServerQoS:
             'Current overload ladder level (0 ok .. 3 shed standard)')
 
     def admit(self, cls: str, tenant: str,
-              max_new_tokens: Optional[int] = None) -> 'Decision':
+              max_new_tokens: Optional[int] = None,
+              model: str = '') -> 'Decision':
         """Decide for one request. The caller (HTTP handler) turns
         shed/throttle into 429 + Retry-After and applies the degrade
-        clamp before building SamplingParams."""
+        clamp before building SamplingParams. `model` MUST be a
+        resolved label (base id or loaded-adapter name), never the
+        raw request string — it keys a token bucket and two counter
+        labels, both cardinality-bounded only if the caller is."""
         self._m_requests.labels(cls).inc()
         level = self.overload.level()
         self._m_level.set(level)
@@ -633,7 +676,11 @@ class ServerQoS:
             span.set_attribute('qos.tenant', tenant)
             span.set_attribute('qos.level', level)
         if not forced_shed and not forced_throttle:
-            ok, wait = self.limiter.try_take(tenant)
+            # Buckets keyed (class, tenant, model): one adapter's
+            # burst exhausts ITS bucket, not the tenant's other
+            # models' (docs/serving.md "Adapter fleet").
+            ok, wait = self.limiter.try_take(
+                f'{cls}|{tenant}|{model}')
             if not ok:
                 forced_throttle = True
                 retry = wait
@@ -642,7 +689,7 @@ class ServerQoS:
         else:
             retry = self.overload.retry_after(max(level, 1))
         if forced_throttle:
-            self._m_throttled.labels(cls).inc()
+            self._m_throttled.labels(cls, model).inc()
             if span is not None:
                 span.add_event('qos.throttle', cls=cls, tenant=tenant)
             return Decision('throttle', level, max(retry, 0.1))
@@ -650,7 +697,7 @@ class ServerQoS:
             (level >= 3 and cls != 'interactive') or \
             (level >= 2 and cls == 'batch')
         if shed:
-            self._m_shed.labels(cls).inc()
+            self._m_shed.labels(cls, model).inc()
             if span is not None:
                 span.add_event('qos.shed', cls=cls, tenant=tenant,
                                level=level)

@@ -129,6 +129,53 @@ class ReshardState:
         return self.phase in RESHARD_ACTIVE_PHASES
 
 
+ADAPTER_ACTIVE_PHASES = ('update', 'rollback')
+ADAPTER_PHASES = ADAPTER_ACTIVE_PHASES + ('done', 'rolled_back')
+
+
+@dataclasses.dataclass
+class AdapterState:
+    """One fleet-wide adapter convergence (docs/serving.md "Adapter
+    fleet"): push one load/unload through every READY replica's
+    POST /admin/adapters, one replica per control tick, rolling the
+    already-updated set back (newest first) after repeated failures —
+    a load rolls back by unloading, an unload by reloading from the
+    recorded checkpoint.
+
+    IN-MEMORY like ReshardState and for the same reason: each
+    replica's adapter set is re-readable from its /stats, and the
+    operator re-issues a half-applied convergence after a controller
+    restart — persisting it would buy crash-resume for an operation
+    that is cheap to re-request."""
+    op: str                        # 'load' | 'unload'
+    name: str
+    checkpoint: Optional[str] = None
+    alpha: float = 16.0
+    drain: Optional[bool] = None
+    phase: str = 'update'
+    started_at: float = dataclasses.field(default_factory=time.time)
+    updated: List[int] = dataclasses.field(default_factory=list)
+    fails: int = 0                 # consecutive per-replica failures
+    error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def payload(self) -> dict:
+        """The /admin/adapters body this convergence applies."""
+        body = {'op': self.op, 'name': self.name}
+        if self.op == 'load':
+            body['checkpoint'] = self.checkpoint
+            body['alpha'] = self.alpha
+        if self.drain is not None:
+            body['drain'] = self.drain
+        return body
+
+    @property
+    def active(self) -> bool:
+        return self.phase in ADAPTER_ACTIVE_PHASES
+
+
 @dataclasses.dataclass
 class ReplicaInfo:
     """Reference: sky/serve/replica_managers.py:382."""
@@ -281,6 +328,20 @@ class ReplicaManager:
             'skyt_serve_reshard_state',
             'Elastic reshard state (1 on the current phase, 0 '
             'elsewhere)', ('service', 'phase'))
+        # Adapter fleet (docs/serving.md "Adapter fleet"): fleet-wide
+        # adapter load/unload convergence, one replica per tick.
+        self._m_adapter_calls = reg.counter(
+            'skyt_serve_adapter_calls_total',
+            'Per-replica /admin/adapters calls made by the adapter '
+            'fleet orchestrator, by result', ('service', 'result'))
+        self._m_adapter_updates = reg.counter(
+            'skyt_serve_adapter_updates_total',
+            'Fleet-wide adapter convergences finished, by outcome',
+            ('service', 'outcome'))
+        self._m_adapter_state = reg.gauge(
+            'skyt_serve_adapter_state',
+            'Fleet-wide adapter convergence state (1 on the current '
+            'phase, 0 elsewhere)', ('service', 'phase'))
         # Relaunch backoff: repeated replica failures (probe-failure ->
         # FAILED -> reconcile relaunch) back off exponentially instead
         # of tight-looping launches against a broken image/config; any
@@ -308,10 +369,13 @@ class ReplicaManager:
         # Injectable for tests: (info, payload) -> (ok, error | None).
         self._swap_fn = self._swap_replica_http
         self._reshard_fn = self._reshard_replica_http
+        self._adapter_fn = self._adapter_replica_http
         # Injectable prewarm push: (info, peers) -> (ok, error | None).
         self._prewarm_fn = self._prewarm_replica_http
         # In-memory by design — see ReshardState.
         self._reshard: Optional[ReshardState] = None
+        # In-memory by design — see AdapterState.
+        self._adapter_update: Optional[AdapterState] = None
         # Restart-safe rollout state: loaded BEFORE restart adoption so
         # the orphan check can recognize versions a crashed rollout
         # legitimately left behind (composes with PR 7 adoption).
@@ -783,9 +847,12 @@ class ReplicaManager:
     # 'prefix_cache' carries the replica's prefix-cache occupancy —
     # the LB surfaces it as skyt_lb_replica_prefix_cache{replica},
     # groundwork for cache-affinity routing (ROADMAP item 2).
+    # 'adapters' is the replica's loaded-adapter map (name -> id/
+    # version) — synced to the LB so model-named requests route only
+    # to replicas hosting the adapter.
     _STATS_KEYS = ('ttft_ms', 'steady_decode_tok_per_sec',
                    'active_slots', 'num_slots', 'waiting', 'qos',
-                   'prefix_cache')
+                   'prefix_cache', 'adapters')
     # Scrape /stats only every Kth probe pass: the scrape is a serial
     # blocking GET per READY replica inside the controller's one
     # control thread, and the data is only read by `serve status` and
@@ -980,6 +1047,12 @@ class ReplicaManager:
                     f'an elastic reshard is in progress (phase '
                     f'{self._reshard.phase}); roll out after it '
                     f'finishes')
+            if self._adapter_update is not None and \
+                    self._adapter_update.active:
+                raise exceptions.SkyTpuError(
+                    f'an adapter fleet update is in progress (phase '
+                    f'{self._adapter_update.phase}); roll out after '
+                    f'it finishes')
             self._rollout = RolloutState(
                 phase='canary',
                 target_version=int(version),
@@ -1296,6 +1369,12 @@ class ReplicaManager:
                     f'a reshard to {self._reshard.target_nodes} '
                     f'virtual nodes is already in progress (phase '
                     f'{self._reshard.phase})')
+            if self._adapter_update is not None and \
+                    self._adapter_update.active:
+                raise exceptions.SkyTpuError(
+                    f'an adapter fleet update is in progress (phase '
+                    f'{self._adapter_update.phase}); reshard after '
+                    f'it finishes')
             self._reshard = ReshardState(target_nodes=target)
         self._update_reshard_gauge()
         logger.info('reshard started: -> %d virtual nodes', target)
@@ -1434,6 +1513,220 @@ class ReplicaManager:
                        rs.target_nodes, rs.error or
                        'unspecified failure')
 
+    # ------------------------------------- fleet-wide adapter updates
+    def start_adapter_update(self, op: str, name: str,
+                             checkpoint: Optional[str] = None,
+                             alpha: float = 16.0,
+                             drain: Optional[bool] = None) -> dict:
+        """Begin converging one adapter load/unload across every READY
+        replica, one per control tick (docs/serving.md "Adapter
+        fleet"). Refuses while a rollout, reshard, or another adapter
+        update is active — all three ride the replicas' single-flight
+        swap slot. Raises SkyTpuError on conflict or a bad request."""
+        if op not in ('load', 'unload'):
+            raise exceptions.SkyTpuError(
+                f"op must be 'load' or 'unload', got {op!r}")
+        if not isinstance(name, str) or not name:
+            raise exceptions.SkyTpuError(
+                f'name must be a non-empty string, got {name!r}')
+        if op == 'load' and (not isinstance(checkpoint, str)
+                             or not checkpoint):
+            raise exceptions.SkyTpuError(
+                f'load requires a checkpoint dir, got {checkpoint!r}')
+        with self._lock:
+            if self._rollout is not None and self._rollout.active:
+                raise exceptions.SkyTpuError(
+                    f'a rolling update is in progress (phase '
+                    f'{self._rollout.phase}); update adapters after '
+                    f'it finishes')
+            if self._reshard is not None and self._reshard.active:
+                raise exceptions.SkyTpuError(
+                    f'an elastic reshard is in progress (phase '
+                    f'{self._reshard.phase}); update adapters after '
+                    f'it finishes')
+            if self._adapter_update is not None and \
+                    self._adapter_update.active:
+                au = self._adapter_update
+                raise exceptions.SkyTpuError(
+                    f'an adapter fleet update ({au.op} {au.name!r}) '
+                    f'is already in progress (phase {au.phase})')
+            if op == 'unload' and checkpoint is None:
+                # Best-effort rollback recipe: the checkpoint recorded
+                # in any READY replica's /stats adapters block.
+                for r in self.replicas.values():
+                    block = self._replica_adapter_block(r)
+                    meta = (block or {}).get('adapters', {}).get(name)
+                    if isinstance(meta, dict) and meta.get('path'):
+                        checkpoint = meta['path']
+                        if meta.get('alpha') is not None:
+                            alpha = float(meta['alpha'])
+                        break
+            self._adapter_update = AdapterState(
+                op=op, name=name, checkpoint=checkpoint,
+                alpha=float(alpha), drain=drain)
+        self._update_adapter_gauge()
+        logger.info('adapter fleet update started: %s %r%s', op, name,
+                    f' from {checkpoint}' if op == 'load' else '')
+        return self.adapter_update_status()
+
+    def adapter_update_status(self) -> Optional[dict]:
+        with self._lock:
+            au = self._adapter_update
+        return au.to_dict() if au is not None else None
+
+    def _update_adapter_gauge(self) -> None:
+        with self._lock:
+            au = self._adapter_update
+        for phase in ADAPTER_PHASES:
+            self._m_adapter_state.labels(self.service_name, phase).set(
+                1 if (au is not None and au.phase == phase) else 0)
+
+    @staticmethod
+    def _replica_adapter_block(info: ReplicaInfo) -> Optional[dict]:
+        """The replica's /stats 'adapters' block, shape-checked."""
+        if isinstance(info.stats, dict) and \
+                isinstance(info.stats.get('adapters'), dict):
+            return info.stats['adapters']
+        return None
+
+    def _adapter_replica_http(self, info: ReplicaInfo, payload: dict
+                              ) -> 'tuple[bool, Optional[str]]':
+        """One POST /admin/adapters against a replica (the injectable
+        default of self._adapter_fn)."""
+        if not info.endpoint:
+            return False, 'replica has no endpoint'
+        headers = {}
+        if self._admin_token:
+            headers['Authorization'] = f'Bearer {self._admin_token}'
+        try:
+            resp = requests.post(
+                info.endpoint + '/admin/adapters', json=payload,
+                headers=headers,
+                timeout=env.get_float('SKYT_ADAPTER_ROLLOUT_TIMEOUT_S',
+                                      120.0))
+            if resp.status_code == 200:
+                return True, None
+            try:
+                msg = resp.json().get('error', '')
+            except ValueError:
+                msg = resp.text[:200]
+            return False, f'HTTP {resp.status_code}: {msg}'
+        except requests.RequestException as e:
+            return False, str(e)
+
+    def _adapter_candidates(self, au: AdapterState) -> List[ReplicaInfo]:
+        with self._lock:
+            return sorted(
+                (r for r in self.replicas.values()
+                 if r.status is serve_state.ReplicaStatus.READY and
+                 r.endpoint and r.replica_id not in au.updated),
+                key=lambda r: r.replica_id)
+
+    def adapter_tick(self) -> None:
+        """One state-machine step of the active adapter convergence —
+        called from the control loop beside reshard_tick. One replica
+        per tick: at most one replica is ever mid-apply, so the
+        routable set for the adapter shrinks/grows one replica at a
+        time and the LB's model-aware routing always has somewhere to
+        send in-flight traffic. Covers the replicas READY during the
+        window; one that boots later converges on the NEXT issued
+        update (its /stats adapter set makes the gap visible)."""
+        with self._lock:
+            au = self._adapter_update
+        if au is None or not au.active:
+            return
+        before = au.phase
+        if au.phase == 'update':
+            self._tick_adapter(au)
+        elif au.phase == 'rollback':
+            self._tick_adapter_rollback(au)
+        if au.phase != before:
+            self._update_adapter_gauge()
+
+    def _tick_adapter(self, au: AdapterState) -> None:
+        cand = self._adapter_candidates(au)
+        if not cand:
+            au.phase = 'done'
+            self._m_adapter_updates.labels(self.service_name,
+                                           'done').inc()
+            logger.info('adapter fleet update done: %s %r on %d '
+                        'replica(s)', au.op, au.name, len(au.updated))
+            return
+        info = cand[0]
+        ok, err = self._adapter_fn(info, au.payload())
+        if ok:
+            self._m_adapter_calls.labels(self.service_name,
+                                         'ok').inc()
+            au.updated.append(info.replica_id)
+            au.fails = 0
+            logger.info('adapter fleet update: replica %d %sed %r',
+                        info.replica_id, au.op, au.name)
+            return
+        self._m_adapter_calls.labels(self.service_name, 'error').inc()
+        au.fails += 1
+        au.error = (f'replica {info.replica_id} adapter {au.op} '
+                    f'failed: {err}')
+        logger.warning('adapter fleet update: %s (consecutive fails: '
+                       '%d)', au.error, au.fails)
+        if au.fails >= _rollout_retries():
+            au.phase = 'rollback'
+
+    def _tick_adapter_rollback(self, au: AdapterState) -> None:
+        """Reverse the already-updated replicas, newest first: a load
+        rolls back by unloading the name, an unload by reloading from
+        the recorded checkpoint. A replica that refuses after the
+        retry budget — or an unload with no recorded checkpoint — is
+        SKIPPED, not drained: a divergent adapter set is degraded
+        routing (the LB sees it in /stats and steers around it),
+        and relaunching a serving replica over it would turn that
+        into a capacity dip."""
+        if au.op == 'unload' and not au.checkpoint:
+            logger.warning('adapter fleet update: cannot roll back '
+                           'unload of %r (no recorded checkpoint); '
+                           'leaving %d replica(s) without it',
+                           au.name, len(au.updated))
+            au.updated.clear()
+        while au.updated:
+            rid = au.updated[-1]
+            info = self.replicas.get(rid)
+            if info is None or not info.is_alive:
+                au.updated.pop()   # gone; nothing to roll back
+                continue
+            if au.op == 'load':
+                payload = {'op': 'unload', 'name': au.name}
+            else:
+                payload = {'op': 'load', 'name': au.name,
+                           'checkpoint': au.checkpoint,
+                           'alpha': au.alpha}
+            ok, err = self._adapter_fn(info, payload)
+            if ok:
+                self._m_adapter_calls.labels(self.service_name,
+                                             'rollback_ok').inc()
+                au.updated.pop()
+                au.fails = 0
+                logger.info('adapter fleet update: replica %d rolled '
+                            'back', rid)
+                continue
+            self._m_adapter_calls.labels(self.service_name,
+                                         'rollback_error').inc()
+            au.fails += 1
+            logger.warning('adapter fleet update: replica %d rollback '
+                           'failed (%d/%d): %s', rid, au.fails,
+                           _rollout_retries(), err)
+            if au.fails >= _rollout_retries():
+                logger.warning('adapter fleet update: skipping '
+                               'replica %d (adapter set left '
+                               'divergent)', rid)
+                au.updated.pop()
+                au.fails = 0
+            return   # failed attempt: retry/escalate next tick
+        au.phase = 'rolled_back'
+        self._m_adapter_updates.labels(self.service_name,
+                                       'rolled_back').inc()
+        logger.warning('adapter fleet update %s %r rolled back (%s)',
+                       au.op, au.name,
+                       au.error or 'unspecified failure')
+
     # ------------------------------------------------------------- views
     def ready_urls(self) -> List[str]:
         with self._lock:
@@ -1478,6 +1771,30 @@ class ReplicaManager:
                         r.endpoint and isinstance(r.stats, dict) and \
                         isinstance(r.stats.get('prefix_cache'), dict):
                     out[r.endpoint] = r.stats['prefix_cache']
+            return out
+
+    def ready_adapters(self) -> dict:
+        """endpoint -> {adapter name: version} for READY replicas
+        whose last /stats scrape carried an adapters block — the
+        model-aware routing map synced to the LB. Versions ride along
+        so a mid-replacement fleet (same name, mixed versions) is
+        visible at the front door."""
+        with self._lock:
+            out = {}
+            for r in self.replicas.values():
+                if r.status is not serve_state.ReplicaStatus.READY \
+                        or not r.endpoint:
+                    continue
+                block = self._replica_adapter_block(r)
+                if block is None:
+                    continue
+                named = block.get('adapters')
+                if not isinstance(named, dict):
+                    continue
+                out[r.endpoint] = {
+                    str(n): int(meta.get('version', 1) or 1)
+                    for n, meta in named.items()
+                    if isinstance(meta, dict)}
             return out
 
     def num_alive(self) -> int:

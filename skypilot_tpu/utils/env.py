@@ -376,6 +376,18 @@ _var('SKYT_ROLLOUT_RETRIES', 'int', 3,
      'relaunch of the stuck replica). The elastic reshard '
      'orchestrator shares this budget.')
 
+# ------------------------------------------------------ adapter fleet
+_var('SKYT_ADAPTER_TIMEOUT_S', 'float', 120.0,
+     'How long an adapter hot-load/unload waits for the engine to '
+     'reach an applicable decode-tick boundary before aborting (the '
+     'old adapter stack stays live).')
+_var('SKYT_ADAPTER_MAX', 'int', 32,
+     'Max adapters loadable on one replica via POST /admin/adapters '
+     '(bounds stack HBM growth and per-model metric cardinality).')
+_var('SKYT_ADAPTER_ROLLOUT_TIMEOUT_S', 'float', 120.0,
+     'Per-replica HTTP timeout of the controller\'s POST '
+     '/admin/adapters calls during a fleet-wide adapter update.')
+
 # ------------------------------------------------- elastic capacity
 _var('SKYT_AUTOSCALE_PREDICT', 'bool', False,
      'Wrap the reactive autoscaler in the predictive one '
@@ -449,6 +461,10 @@ _var('SKYT_QOS_TENANT_BURST', 'float', 0.0,
      'Per-tenant burst allowance (0 = 2x the rate).')
 _var('SKYT_QOS_AUTOSCALE_WEIGHTS', 'str', '',
      'Class weights for QoS-aware autoscaling demand.')
+_var('SKYT_QOS_MODEL_WEIGHTS', 'str', '',
+     'Per-model DRR quantum multipliers for the fair queue, e.g. '
+     '"summarize:4,translate:1" (multiplied with the class weight; '
+     'unlisted models weigh 1.0).')
 
 # ----------------------------------------------------------------- slo
 _var('SKYT_SLO_TARGET', 'float', 0.99,

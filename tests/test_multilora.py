@@ -202,20 +202,6 @@ def test_out_of_range_lora_id_rejected():
         eng.submit([1, 2, 3], engine_lib.SamplingParams(lora_id=2))
 
 
-@pytest.mark.skipif(
-    __import__('orbax.checkpoint', fromlist=['checkpoint'])
-    .__version__.startswith('0.7.'),
-    reason="orbax 0.7.x CompositeCheckpointHandler refuses the "
-           "template-free restore() load_adapter_dir performs when the "
-           "restoring CheckpointManager is a FRESH instance (KeyError: "
-           "'Item \"default\" was found in the checkpoint, but could "
-           "not be restored') — it only resolves the item handler when "
-           "an earlier save/restore in the same process registered it, "
-           "which is why this test flaked with suite order (the "
-           "documented 'only red anywhere' since PR 4). Re-enable when "
-           "the image ships an orbax that restores item metadata "
-           "without a registry, or when load_adapter_dir grows a "
-           "StandardRestore template.")
 def test_adapter_roundtrip_through_orbax(tmp_path):
     """load_adapter_dir reads what an sft LoRA run writes (Orbax
     TrainStateS), and build_stack_from_specs maps names to ids."""

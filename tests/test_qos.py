@@ -446,9 +446,9 @@ def test_shed_metrics_count_by_class():
         for k in ('SKYT_QOS_QUEUE_SHED', 'SKYT_QOS_REFRESH_S',
                   'SKYT_QOS_HOLD_S'):
             os.environ.pop(k, None)
-    shed = reg.counter('skyt_qos_shed_total', '', ('class',))
-    assert shed.value('batch') == 1
-    assert shed.value('interactive') == 0
+    shed = reg.counter('skyt_qos_shed_total', '', ('class', 'model'))
+    assert shed.value('batch', '') == 1
+    assert shed.value('interactive', '') == 0
 
 
 def test_snapshot_shape():
@@ -757,7 +757,10 @@ def test_server_qos_headers_and_forced_shed(monkeypatch):
             'interactive': 0, 'standard': 0, 'batch': 0}
         # Shed decisions visible at /metrics by class.
         text = requests.get(base + '/metrics', timeout=10).text
-        assert 'skyt_qos_shed_total{class="batch"} 2' in text
+        shed_batch = sum(
+            float(line.rsplit(' ', 1)[1]) for line in text.splitlines()
+            if line.startswith('skyt_qos_shed_total{class="batch"'))
+        assert shed_batch == 2, text
     finally:
         eng.stop()
 
@@ -914,6 +917,9 @@ def test_controller_sync_payload_roundtrip(monkeypatch):
 
         def ready_weight_versions(self):
             return {'http://r1': 3}
+
+        def ready_adapters(self):
+            return {'http://r1': {'summarize': 1}}
 
     class FakeController:
         def registered_lbs(self):

@@ -509,6 +509,52 @@ class TestEnginePrefillDecode:
         uncached, _ = run_twice(False)
         assert cached == uncached
 
+    def test_lora_grouped_lowers(self):
+        """The grouped-LoRA delta kernels (per-sequence gather and
+        per-token grouped paths, docs/serving.md "Adapter fleet") must
+        lower through Mosaic on the chip — not silently descend to the
+        XLA floor — and match it numerically."""
+        from skypilot_tpu.ops import dispatch
+        from skypilot_tpu.ops import lora as lora_ops
+
+        b, s, din, r, dout, n = 4, 256, 512, 8, 512, 4
+        x = _rand(0, (b, s, din))
+        a = _rand(1, (n, din, r))
+        bb = _rand(2, (n, r, dout))
+        # Slot 0 is the base model: its adapter rows are zero.
+        a = a.at[0].set(0)
+        bb = bb.at[0].set(0)
+        key = jax.random.PRNGKey(3)
+        scale_of = jnp.asarray([0.0, 2.0, 0.5, 1.0], jnp.float32)
+
+        dispatch.reset_for_tests()
+        jax.clear_caches()
+        # Per-sequence ids [B]: the assigned-slot decode path.
+        ids = jax.random.randint(key, (b,), 0, n)
+        got = jax.jit(lora_ops.grouped_lora_delta)(
+            x, a, bb, ids, scale_of[ids])
+        ref = jax.jit(lora_ops._xla_gather)(x, a, bb, ids,
+                                            scale_of[ids])
+        assert dispatch.snapshot().get(lora_ops.OP) == 'pallas', \
+            dispatch.snapshot()
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
+        dispatch.reset_for_tests()
+        jax.clear_caches()
+        # Per-token ids [B, S]: the mixed-adapter ragged-pack path.
+        tids = jax.random.randint(key, (b, s), 0, n)
+        got = jax.jit(lora_ops.grouped_lora_delta)(
+            x, a, bb, tids, scale_of[tids])
+        ref = jax.jit(lora_ops._xla_grouped)(x, a, bb, tids,
+                                             scale_of[tids])
+        assert dispatch.snapshot().get(lora_ops.OP) == 'pallas', \
+            dispatch.snapshot()
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2)
+
 
 class TestCommsPlane:
     """On-chip comms plane gate (docs/observability.md "Comms plane"):

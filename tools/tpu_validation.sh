@@ -484,10 +484,11 @@ try:
     assert not bad, f'interactive failures under flood: {codes}'
     text = requests.get(url + '/metrics', timeout=5).text
     def shed(cls):
+        total = 0.0
         for line in text.splitlines():
-            if line.startswith(f'skyt_qos_shed_total{{class="{cls}"}}'):
-                return float(line.rsplit(' ', 1)[1])
-        return 0.0
+            if line.startswith(f'skyt_qos_shed_total{{class="{cls}"'):
+                total += float(line.rsplit(' ', 1)[1])
+        return total
     assert shed('batch') > 0, 'batch flood never shed'
     assert shed('interactive') == 0, 'interactive was shed'
     print(f'QOS_DRILL_OK 12/12 interactive ok, '
@@ -1835,6 +1836,231 @@ then
     echo "== elastic drill: PASS =="
 else
     echo "== elastic drill: FAIL (see $OUT/elastic_drill.txt) =="
+    FAIL=1
+fi
+
+echo "== 21. adapter hot-load drill — a LoRA adapter lands on the"
+echo "   live replica mid-burst through POST /admin/adapters (zero"
+echo "   client-visible non-200s), generations route by adapter name"
+echo "   (unknown name gets an honest 404), an unload is REFUSED with"
+echo "   409 while live requests reference the adapter, and the clean"
+echo "   unload leaves base serving byte-identical"
+echo "   (docs/serving.md 'Adapter fleet') =="
+if SKYT_VALIDATION_OUT="$OUT" timeout 900 python - \
+        <<'PYEOF' 2>&1 | tee "$OUT/adapter_drill.txt"
+import dataclasses as _dc
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import requests
+
+OUT = os.environ['SKYT_VALIDATION_OUT']
+ART = os.path.join(OUT, 'adapter_drill.json')
+TOKEN = 'adapter-validation'
+
+
+def artifact(status, **kw):
+    rec = {'status': status, 'step': 'adapter_drill', **kw}
+    with open(ART, 'w') as f:
+        json.dump(rec, f, sort_keys=True)
+    print(f'adapter artifact: {json.dumps(rec, sort_keys=True)}')
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def save_debug_adapter(path, rank=2, alpha=4.0, seed=9):
+    # An Orbax adapter dir shaped exactly like an `sft --lora-rank`
+    # run writes (TrainStateS), for the debug model the replica
+    # serves.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import flax.linen as nn
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.train import checkpoint as ckpt_lib
+    from skypilot_tpu.train import lora as tlora
+    from skypilot_tpu.train import trainer
+
+    cfg = _dc.replace(llama.CONFIGS['debug'], max_seq_len=64)
+    model = llama.LlamaModel(cfg)
+    params = nn.meta.unbox(
+        jax.jit(model.init)(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))['params'])
+    lcfg = tlora.LoRAConfig(rank=rank, alpha=alpha)
+    tree = tlora.init_lora_params(params, lcfg,
+                                  jax.random.PRNGKey(seed))
+    rng = np.random.default_rng(seed)
+    tree = jax.tree.map(
+        lambda x: jnp.asarray(rng.normal(0, 0.1, x.shape), x.dtype),
+        tree)
+    tx = trainer.make_optimizer(trainer.TrainerConfig())
+    state = trainer.TrainStateS(step=jnp.zeros((), jnp.int32),
+                                params=tree, opt_state=tx.init(tree))
+    ck = ckpt_lib.Checkpointer(path, async_save=False)
+    ck.save(0, state, force=True)
+    ck.wait()
+    ck.close()
+    return path
+
+
+tmp = tempfile.mkdtemp(prefix='skyt-adapterdrill-')
+adapter_dir = save_debug_adapter(os.path.join(tmp, 'adapter_fr'))
+rport = free_port()
+env = dict(os.environ, SKYT_ADMIN_TOKEN=TOKEN)
+proc = subprocess.Popen(
+    [sys.executable, '-m', 'skypilot_tpu.infer.server',
+     '--model', 'debug', '--port', str(rport),
+     '--num-slots', '2', '--max-seq-len', '64'], env=env)
+rbase = f'http://127.0.0.1:{rport}'
+hdr = {'Authorization': f'Bearer {TOKEN}'}
+try:
+    deadline = time.time() + 480
+    while time.time() < deadline:
+        try:
+            if requests.get(rbase + '/health',
+                            timeout=2).status_code == 200:
+                break
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            artifact('replica_died', rc=proc.returncode)
+            raise SystemExit(f'server died rc={proc.returncode}')
+        time.sleep(1)
+    else:
+        artifact('replica_unhealthy', timeout_s=480)
+        raise SystemExit('server never became healthy')
+
+    body = {'tokens': [5, 6, 7], 'max_tokens': 6}
+    golden = requests.post(rbase + '/generate', json=body,
+                           timeout=300).json()['tokens']
+
+    # -- Hot load mid-burst: zero client-visible non-200s.
+    codes, lock = [], threading.Lock()
+    stop = threading.Event()
+
+    def burst(wid):
+        s2 = requests.Session()
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                r = s2.post(rbase + '/generate',
+                            json={'tokens': [wid + 1, (i % 7) + 1, 3],
+                                  'max_tokens': 8}, timeout=120)
+                with lock:
+                    codes.append(r.status_code)
+            except requests.RequestException:
+                with lock:
+                    codes.append(599)
+    threads = [threading.Thread(target=burst, args=(w,))
+               for w in range(3)]
+    for th in threads:
+        th.start()
+    time.sleep(1.0)
+    t0 = time.perf_counter()
+    r = requests.post(rbase + '/admin/adapters',
+                      json={'op': 'load', 'name': 'fr',
+                            'checkpoint': adapter_dir, 'alpha': 4.0},
+                      headers=hdr, timeout=240)
+    load_s = time.perf_counter() - t0
+    assert r.status_code == 200, (r.status_code, r.text)
+    time.sleep(1.0)
+    stop.set()
+    for th in threads:
+        th.join(timeout=120)
+    bad = [c for c in codes if c != 200]
+    assert codes and not bad, f'burst saw non-200s: {bad}'
+
+    # -- Model-aware routing: the adapter serves by name, a ghost
+    # gets an honest 404.
+    models = requests.get(rbase + '/v1/models', timeout=30).json()
+    ids = [m['id'] for m in models['data']]
+    assert 'fr' in ids, ids
+    r = requests.post(rbase + '/generate',
+                      json=dict(body, lora='fr'), timeout=300)
+    assert r.status_code == 200, (r.status_code, r.text)
+    routed = r.json()['tokens']
+    r = requests.post(rbase + '/generate',
+                      json=dict(body, lora='ghost'), timeout=120)
+    assert r.status_code == 404, (r.status_code, r.text)
+
+    # -- Unload refused while referenced: long lora decodes in
+    # flight, the unload 409s, the decodes finish clean.
+    ref_codes = []
+
+    def long_lora(wid):
+        s2 = requests.Session()
+        r2 = s2.post(rbase + '/generate',
+                     json={'tokens': [wid + 1, 2, 3],
+                           'max_tokens': 60, 'lora': 'fr'},
+                     timeout=300)
+        with lock:
+            ref_codes.append(r2.status_code)
+    refs = [threading.Thread(target=long_lora, args=(w,))
+            for w in range(4)]
+    for th in refs:
+        th.start()
+    time.sleep(0.05)
+    r = requests.post(rbase + '/admin/adapters',
+                      json={'op': 'unload', 'name': 'fr'},
+                      headers=hdr, timeout=120)
+    refused = r.status_code == 409 and 'referenced' in r.json()['error']
+    assert refused, (r.status_code, r.text)
+    for th in refs:
+        th.join(timeout=300)
+    assert ref_codes == [200] * 4, ref_codes
+
+    # -- Clean unload: stack drops to base-only, base serving is
+    # byte-identical to the pre-load golden.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        r = requests.post(rbase + '/admin/adapters',
+                          json={'op': 'unload', 'name': 'fr'},
+                          headers=hdr, timeout=120)
+        if r.status_code == 200:
+            break
+        time.sleep(0.5)
+    else:
+        raise SystemExit(f'unload never drained: {r.status_code} '
+                         f'{r.text[:200]}')
+    snap = requests.get(rbase + '/stats', timeout=30).json()['adapters']
+    assert snap['count'] == 0, snap
+    got = requests.post(rbase + '/generate', json=body,
+                        timeout=300).json()['tokens']
+    assert got == golden, f'unload broke base serving: {got}'
+    artifact('ok',
+             burst_requests=len(codes),
+             burst_non_200=0,
+             adapter_load_s=round(load_s, 4),
+             routed_changed_outputs=routed != golden,
+             ghost_404=True,
+             unload_refused_while_referenced=True,
+             referenced_decodes_ok=len(ref_codes),
+             base_outputs_byte_identical=True)
+    print(f'ADAPTER_DRILL_OK burst={len(codes)} load_s={load_s:.3f} '
+          f'refused=409 clean_unload=ok')
+finally:
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PYEOF
+then
+    echo "== adapter drill: PASS =="
+else
+    echo "== adapter drill: FAIL (see $OUT/adapter_drill.txt) =="
     FAIL=1
 fi
 
